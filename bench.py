@@ -1,0 +1,144 @@
+#!/usr/bin/env python
+"""Headline benchmark: batched ECDSA-P256 verification throughput.
+
+Prints ONE JSON line:
+  {"metric": "ecdsa_p256_verify_throughput", "value": <verifies/s on the
+   accelerator>, "unit": "verifies/s", "vs_baseline": <x over the
+   single-core CPU software path>}
+
+Baseline config #1 (BASELINE.md): SW BCCSP ECDSA-P256 verify over 10k
+pre-generated (msg, sig, pubkey) triples. The CPU baseline is measured
+here with the `cryptography` package (OpenSSL) — the same order as Go
+crypto/ecdsa (~1e4/s/core), i.e. an honest stand-in for the reference's
+bccsp/sw hot loop. North-star target: >= 50k verifies/s per host.
+"""
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("FABRIC_TPU_CIOS_UNROLL", "1")
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
+)
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2")
+
+import numpy as np
+
+
+def gen_triples(n, num_keys=8):
+    """(key, der_sig, digest) triples signed with the fast OpenSSL path,
+    normalized to low-S like the reference signer."""
+    import hashlib
+
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.hazmat.primitives.asymmetric.utils import (
+        decode_dss_signature,
+    )
+
+    from fabric_tpu.crypto import der, p256
+    from fabric_tpu.crypto.bccsp import ECDSAPublicKey
+
+    keys = []
+    for _ in range(num_keys):
+        sk = ec.generate_private_key(ec.SECP256R1())
+        nums = sk.public_key().public_numbers()
+        keys.append((sk, ECDSAPublicKey(nums.x, nums.y)))
+
+    triples = []
+    for i in range(n):
+        sk, pub = keys[i % num_keys]
+        msg = f"benchmark tx payload {i}".encode() * 8
+        digest = hashlib.sha256(msg).digest()
+        r, s = decode_dss_signature(sk.sign(msg, ec.ECDSA(hashes.SHA256())))
+        if not p256.is_low_s(s):
+            s = p256.N - s
+        triples.append((pub, der.marshal_signature(r, s), digest))
+    return triples
+
+
+def bench_cpu_baseline(triples, budget_s=2.0):
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.hazmat.primitives.asymmetric.utils import (
+        Prehashed,
+        encode_dss_signature,
+    )
+
+    from fabric_tpu.crypto import der as der_mod
+
+    pubkeys = {}
+    count = 0
+    start = time.perf_counter()
+    while time.perf_counter() - start < budget_s:
+        pub, sig, digest = triples[count % len(triples)]
+        key = pubkeys.get(id(pub))
+        if key is None:
+            key = ec.EllipticCurvePublicNumbers(
+                pub.x, pub.y, ec.SECP256R1()
+            ).public_key()
+            pubkeys[id(pub)] = key
+        r, s = der_mod.unmarshal_signature(sig)
+        try:
+            key.verify(
+                encode_dss_signature(r, s),
+                digest,
+                ec.ECDSA(Prehashed(hashes.SHA256())),
+            )
+        except InvalidSignature:
+            raise RuntimeError("benchmark signature should verify")
+        count += 1
+    return count / (time.perf_counter() - start)
+
+
+def main():
+    n = int(os.environ.get("BENCH_N", "8192"))
+    iters = int(os.environ.get("BENCH_ITERS", "5"))
+
+    import jax
+
+    from fabric_tpu.crypto.tpu_provider import TPUProvider
+
+    triples = gen_triples(n)
+    keys = [t[0] for t in triples]
+    sigs = [t[1] for t in triples]
+    digests = [t[2] for t in triples]
+
+    prov = TPUProvider()
+    # warmup / compile
+    out = prov.batch_verify(keys, sigs, digests)
+    if not all(out):
+        raise RuntimeError("verification failed in warmup — kernel bug")
+
+    start = time.perf_counter()
+    for _ in range(iters):
+        prov.batch_verify(keys, sigs, digests)
+    device_rate = n * iters / (time.perf_counter() - start)
+
+    cpu_rate = bench_cpu_baseline(triples)
+
+    print(
+        json.dumps(
+            {
+                "metric": "ecdsa_p256_verify_throughput",
+                "value": round(device_rate, 1),
+                "unit": "verifies/s",
+                "vs_baseline": round(device_rate / cpu_rate, 2),
+                "detail": {
+                    "batch": n,
+                    "iters": iters,
+                    "cpu_baseline_verifies_per_s": round(cpu_rate, 1),
+                    "device": str(jax.devices()[0]),
+                    "target_verifies_per_s": 50000,
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,28 @@
+"""fabric-tpu: a TPU-native framework with the capabilities of Hyperledger Fabric.
+
+The reference system (mounted read-only at /root/reference) is Hyperledger
+Fabric v2.x: a permissioned blockchain whose commit-time validation pipeline
+(batch ECDSA-P256 endorsement verification, signature-policy evaluation, MVCC
+read-set conflict checks) is the performance-critical core. This package
+rebuilds that system TPU-first:
+
+- ``fabric_tpu.crypto``     -- BCCSP-style pluggable crypto providers
+                               (host software provider + batched TPU provider).
+- ``fabric_tpu.ops``        -- JAX/XLA device kernels: limb bignum arithmetic,
+                               batched P-256 ECDSA verification.
+- ``fabric_tpu.policy``     -- signature-policy (cauthdsl) compile + eval.
+- ``fabric_tpu.msp``        -- X.509 identity layer (deserialize/validate/
+                               principal matching) + test-crypto generator.
+- ``fabric_tpu.ledger``     -- rwsets, versioned state DB, MVCC validation.
+- ``fabric_tpu.validation`` -- txflags bitmask + block validator pipeline.
+- ``fabric_tpu.protos``     -- Fabric-wire-compatible datamodel (protobuf).
+
+Planned next (SURVEY.md §7 stages 3-6): block store/kvledger commit,
+ordering service, device MVCC probes, gossip/state transfer, Idemix.
+
+Parity contract: per-transaction VALID/INVALID bitmask (uint8
+TxValidationCode, reference usable-inter-nal/pkg/txflags/validation_flags.go)
+is bit-exact with the reference software path.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,6 @@
+"""Crypto layer: curve math oracle, DER codecs, BCCSP-style provider SPI."""
+
+from fabric_tpu.crypto import der, p256
+from fabric_tpu.crypto.bccsp import SoftwareProvider
+
+__all__ = ["der", "p256", "SoftwareProvider"]

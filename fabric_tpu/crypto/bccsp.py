@@ -1,0 +1,140 @@
+"""BCCSP-style pluggable crypto provider SPI.
+
+Shaped after the reference provider interface (bccsp/bccsp.go:90-130:
+KeyGen / KeyImport / Hash / Sign / Verify) with one TPU-native extension:
+``batch_verify`` — the single-verify API is kept for drop-in compatibility
+while batches are what the device kernels actually consume (SURVEY.md §7
+Stage 1: the sidecar collects per-block batches under the hood).
+
+Providers:
+- SoftwareProvider: host-only, mirrors bccsp/sw (verifyECDSA:
+  DER unmarshal -> low-S check -> ecdsa.Verify, bccsp/sw/ecdsa.go:41-57).
+- TPUProvider (fabric_tpu.crypto.tpu_provider): same decision function,
+  ECDSA math executed as a batched JAX kernel.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+from fabric_tpu.crypto import der, p256
+
+
+@dataclass(frozen=True)
+class ECDSAPublicKey:
+    """An imported P-256 public key (reference bccsp/sw/ecdsakey.go analog)."""
+
+    x: int
+    y: int
+
+    @property
+    def point(self) -> Tuple[int, int]:
+        return (self.x, self.y)
+
+    def ski(self) -> bytes:
+        """Subject Key Identifier: SHA-256 of the uncompressed point, as the
+        reference computes it (bccsp/sw/ecdsakey.go SKI)."""
+        return hashlib.sha256(p256.pubkey_to_bytes(self.point)).digest()
+
+
+@dataclass(frozen=True)
+class ECDSAPrivateKey:
+    d: int
+    public: ECDSAPublicKey
+
+
+class VerifyError(Exception):
+    """Verification *errors* (vs. clean False) — mirrors the reference's
+    (bool, error) split: malformed DER and high-S return an error, a failed
+    curve equation check returns (false, nil)."""
+
+
+class Provider:
+    """SPI. Verify semantics contract (bccsp/sw/ecdsa.go verifyECDSA):
+
+    - signature fails DER unmarshal or has non-positive R/S -> VerifyError
+    - S > N/2 (not low-S)                                   -> VerifyError
+    - otherwise                                             -> bool
+    """
+
+    def hash(self, msg: bytes) -> bytes:
+        return hashlib.sha256(msg).digest()
+
+    def key_import(self, raw: bytes) -> ECDSAPublicKey:
+        x, y = p256.pubkey_from_bytes(raw)
+        return ECDSAPublicKey(x, y)
+
+    def key_gen(self) -> ECDSAPrivateKey:
+        kp = p256.generate_keypair()
+        return ECDSAPrivateKey(kp.priv, ECDSAPublicKey(*kp.pub))
+
+    def sign(self, key: ECDSAPrivateKey, digest: bytes) -> bytes:
+        r, s = p256.sign_digest(key.d, digest)
+        return der.marshal_signature(r, s)
+
+    def verify(self, key: ECDSAPublicKey, signature: bytes, digest: bytes) -> bool:
+        raise NotImplementedError
+
+    def batch_verify(
+        self,
+        keys: Sequence[ECDSAPublicKey],
+        signatures: Sequence[bytes],
+        digests: Sequence[bytes],
+    ) -> List[bool]:
+        """Batched verification; the host parse/low-S failures map to False
+        (batch callers care about the boolean mask, not error strings)."""
+        out = []
+        for k, sig, d in zip(keys, signatures, digests, strict=True):
+            try:
+                out.append(self.verify(k, sig, d))
+            except VerifyError:
+                out.append(False)
+        return out
+
+
+def parse_and_precheck(signature: bytes) -> Tuple[int, int]:
+    """Host-side DER unmarshal + low-S gate shared by all providers.
+
+    Raises VerifyError exactly where the reference returns an error.
+    """
+    try:
+        r, s = der.unmarshal_signature(signature)
+    except der.DerError as e:
+        raise VerifyError(f"failed unmarshalling signature [{e}]") from e
+    if not p256.is_low_s(s):
+        raise VerifyError("invalid S, must be smaller than half the order")
+    return r, s
+
+
+class SoftwareProvider(Provider):
+    """Pure-host provider; the differential oracle for the TPU provider."""
+
+    def verify(self, key: ECDSAPublicKey, signature: bytes, digest: bytes) -> bool:
+        r, s = parse_and_precheck(signature)
+        return p256.verify_digest(key.point, digest, r, s)
+
+
+_default: Optional[Provider] = None
+
+
+def default_provider() -> Provider:
+    """Factory (reference bccsp/factory analog): the TPU provider if an
+    actual accelerator device is present, else the software provider.
+    (A CPU-only jax install must NOT route single verifies through the
+    XLA kernel — its compile cost alone is minutes.)"""
+    global _default
+    if _default is None:
+        try:
+            import jax
+
+            if any(d.platform != "cpu" for d in jax.devices()):
+                from fabric_tpu.crypto.tpu_provider import TPUProvider
+
+                _default = TPUProvider()
+            else:
+                _default = SoftwareProvider()
+        except Exception:
+            _default = SoftwareProvider()
+    return _default

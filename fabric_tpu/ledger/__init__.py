@@ -1,0 +1,1 @@
+"""Ledger layer: rwsets, versioned state DB, MVCC, block store, kvledger."""

@@ -1,0 +1,193 @@
+"""MVCC validation and update-batch preparation.
+
+Host-sequential reference semantics, mirroring
+core/ledger/kvledger/txmgmt/validation/validator.go:82-281 exactly:
+
+- transactions scan in block order; each VALID tx's writes apply to the
+  running update batch before the next tx validates (apply-as-you-go);
+- a public read conflicts if (a) the key was written by a preceding valid
+  tx in this block (updates.Exists) or (b) the committed version differs
+  from the read version (version.AreSame) -> MVCC_READ_CONFLICT;
+- range queries re-execute against committed-state + in-block updates
+  (updates shadow, deletes hide) and compare results ->
+  PHANTOM_READ_CONFLICT;
+- hashed (private-collection) reads check like public reads ->
+  MVCC_READ_CONFLICT.
+
+This module is the oracle and the fallback; a device-accelerated probe
+path for the no-range-query common case is planned (SURVEY.md §7 Stage 3).
+Merkle-summarized range queries (rangequery_validator.go hash variant) are
+not implemented yet: they raise UnsupportedRangeQueryError loudly instead
+of mis-validating.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+from fabric_tpu.ledger.rwset import (
+    KVRead,
+    RangeQueryInfo,
+    TxRwSet,
+    Version,
+    versions_same,
+)
+from fabric_tpu.ledger.statedb import (
+    HashedUpdateBatch,
+    UpdateBatch,
+    VersionedDB,
+    VersionedValue,
+)
+from fabric_tpu.validation.txflags import TxValidationCode
+
+
+def _combined_range_iter(
+    db: VersionedDB,
+    updates: UpdateBatch,
+    ns: str,
+    start_key: str,
+    end_key: str,
+    include_end: bool,
+) -> Iterator[Tuple[str, Version]]:
+    """Merge committed state with pending in-block updates for a range scan
+    (reference combined_iterator.go): updates take precedence; deletes in
+    updates hide committed keys."""
+    upd_in_range = sorted(
+        (key, val)
+        for (uns, key), val in updates.items()
+        if uns == ns
+        and key >= start_key
+        and (not end_key or (key <= end_key if include_end else key < end_key))
+    )
+    upd_idx = 0
+    committed = db.get_state_range(ns, start_key, end_key, include_end)
+
+    def next_committed():
+        return next(committed, None)
+
+    cur = next_committed()
+    while cur is not None or upd_idx < len(upd_in_range):
+        if upd_idx < len(upd_in_range) and (cur is None or upd_in_range[upd_idx][0] <= cur[0]):
+            key, (value, version) = upd_in_range[upd_idx]
+            if cur is not None and cur[0] == key:
+                cur = next_committed()  # shadowed
+            upd_idx += 1
+            if value is not None:  # deletes yield nothing
+                yield key, version
+        else:
+            assert cur is not None
+            yield cur[0], cur[1].version
+            cur = next_committed()
+
+
+class UnsupportedRangeQueryError(NotImplementedError):
+    """Raised for merkle-summarized range queries (not yet supported) so the
+    caller halts instead of emitting a wrong validation code."""
+
+
+class Validator:
+    """Block-level MVCC validator over a VersionedDB."""
+
+    def __init__(self, db: VersionedDB):
+        self.db = db
+
+    def validate_and_prepare_batch(
+        self,
+        block_num: int,
+        tx_rwsets: Sequence[Optional[TxRwSet]],
+        incoming_codes: Sequence[TxValidationCode],
+        do_mvcc: bool = True,
+    ) -> Tuple[List[TxValidationCode], UpdateBatch, HashedUpdateBatch]:
+        """Returns final per-tx codes plus the prepared update batches.
+
+        incoming_codes carry the upstream (signature/policy) verdicts:
+        only txs arriving VALID are MVCC-checked and applied
+        (reference kvledger commit path: txvalidator flags first, then
+        validateAndPrepareBatch skips already-invalid txs).
+        """
+        updates = UpdateBatch()
+        hashed_updates = HashedUpdateBatch()
+        out: List[TxValidationCode] = []
+        for tx_num, (rwset, code) in enumerate(zip(tx_rwsets, incoming_codes, strict=True)):
+            if code != TxValidationCode.VALID or rwset is None:
+                out.append(code)
+                continue
+            vcode = self._validate_tx(rwset, updates, hashed_updates) if do_mvcc else TxValidationCode.VALID
+            out.append(vcode)
+            if vcode == TxValidationCode.VALID:
+                self._apply_write_set(
+                    rwset, Version(block_num, tx_num), updates, hashed_updates
+                )
+        return out, updates, hashed_updates
+
+    # -- per-tx validation (validator.go validateTx) ----------------------
+    def _validate_tx(
+        self, rwset: TxRwSet, updates: UpdateBatch, hashed_updates: HashedUpdateBatch
+    ) -> TxValidationCode:
+        for ns_rw in rwset.ns_rw_sets:
+            ns = ns_rw.namespace
+            for read in ns_rw.reads:
+                if not self._validate_kv_read(ns, read, updates):
+                    return TxValidationCode.MVCC_READ_CONFLICT
+            for rqi in ns_rw.range_queries:
+                if not self._validate_range_query(ns, rqi, updates):
+                    return TxValidationCode.PHANTOM_READ_CONFLICT
+            for coll in ns_rw.coll_hashed:
+                for hread in coll.hashed_reads:
+                    if hashed_updates.contains(ns, coll.collection_name, hread.key_hash):
+                        return TxValidationCode.MVCC_READ_CONFLICT
+                    committed = self.db.get_key_hash_version(
+                        ns, coll.collection_name, hread.key_hash
+                    )
+                    if not versions_same(committed, hread.version):
+                        return TxValidationCode.MVCC_READ_CONFLICT
+        return TxValidationCode.VALID
+
+    def _validate_kv_read(self, ns: str, read: KVRead, updates: UpdateBatch) -> bool:
+        if updates.exists(ns, read.key):
+            return False
+        return versions_same(self.db.get_version(ns, read.key), read.version)
+
+    def _validate_range_query(
+        self, ns: str, rqi: RangeQueryInfo, updates: UpdateBatch
+    ) -> bool:
+        if rqi.reads_merkle_hashes is not None:
+            raise UnsupportedRangeQueryError(
+                "merkle-summarized range query validation not implemented"
+            )
+        # ItrExhausted=false: EndKey is the last key actually seen, so the
+        # re-execution must include it (validator.go validateRangeQuery).
+        include_end = not rqi.itr_exhausted
+        actual = _combined_range_iter(
+            self.db, updates, ns, rqi.start_key, rqi.end_key, include_end
+        )
+        for expected in rqi.raw_reads:
+            got = next(actual, None)
+            if got is None or got[0] != expected.key or not versions_same(got[1], expected.version):
+                return False
+        return next(actual, None) is None
+
+    # -- write application (tx_ops.go applyWriteSet, public+hashed) -------
+    def _apply_write_set(
+        self,
+        rwset: TxRwSet,
+        height: Version,
+        updates: UpdateBatch,
+        hashed_updates: HashedUpdateBatch,
+    ) -> None:
+        for ns_rw in rwset.ns_rw_sets:
+            ns = ns_rw.namespace
+            for w in ns_rw.writes:
+                if w.is_delete:
+                    updates.delete(ns, w.key, height)
+                else:
+                    updates.put(ns, w.key, w.value, height)
+            for coll in ns_rw.coll_hashed:
+                for hw in coll.hashed_writes:
+                    hashed_updates.put(
+                        ns,
+                        coll.collection_name,
+                        hw.key_hash,
+                        None if hw.is_delete else hw.value_hash,
+                        height,
+                    )

@@ -1,0 +1,133 @@
+"""Versioned state database (reference statedb SPI + stateleveldb).
+
+An embedded ordered KV store holding (value, version) per (namespace, key)
+plus the hashed private-data namespaces (privacyenabledstate analog). The
+in-memory index is a dict plus a sorted-key view for range scans; the
+kvledger layer persists through snapshots of the block store (state is a
+derived cache, rebuildable — the reference's crash-consistency model,
+SURVEY.md §5 checkpoint/resume).
+"""
+
+from __future__ import annotations
+
+import bisect
+from dataclasses import dataclass
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from fabric_tpu.ledger.rwset import Version
+
+
+@dataclass(frozen=True)
+class VersionedValue:
+    value: bytes
+    version: Version
+
+
+class UpdateBatch:
+    """Pending writes of a block (reference statedb.UpdateBatch): puts AND
+    deletes both carry the committing version; deletes shadow reads."""
+
+    def __init__(self):
+        self._updates: Dict[Tuple[str, str], Tuple[Optional[bytes], Version]] = {}
+
+    def put(self, ns: str, key: str, value: bytes, version: Version) -> None:
+        self._updates[(ns, key)] = (value, version)
+
+    def delete(self, ns: str, key: str, version: Version) -> None:
+        self._updates[(ns, key)] = (None, version)
+
+    def exists(self, ns: str, key: str) -> bool:
+        return (ns, key) in self._updates
+
+    def get(self, ns: str, key: str) -> Optional[Tuple[Optional[bytes], Version]]:
+        return self._updates.get((ns, key))
+
+    def items(self):
+        return self._updates.items()
+
+    def __len__(self):
+        return len(self._updates)
+
+
+class HashedUpdateBatch:
+    """Private-data hashed writes: keyed (ns, collection, key_hash)."""
+
+    def __init__(self):
+        self._updates: Dict[Tuple[str, str, bytes], Tuple[Optional[bytes], Version]] = {}
+
+    def put(self, ns: str, coll: str, key_hash: bytes, value_hash: Optional[bytes], version: Version) -> None:
+        self._updates[(ns, coll, key_hash)] = (value_hash, version)
+
+    def contains(self, ns: str, coll: str, key_hash: bytes) -> bool:
+        return (ns, coll, key_hash) in self._updates
+
+    def items(self):
+        return self._updates.items()
+
+    def __len__(self):
+        return len(self._updates)
+
+
+class VersionedDB:
+    """Committed state: (ns, key) -> VersionedValue, ordered per namespace."""
+
+    def __init__(self):
+        self._data: Dict[str, Dict[str, VersionedValue]] = {}
+        self._sorted_keys: Dict[str, List[str]] = {}
+        self._hashed: Dict[Tuple[str, str, bytes], Tuple[Optional[bytes], Version]] = {}
+
+    # -- reads ------------------------------------------------------------
+    def get_state(self, ns: str, key: str) -> Optional[VersionedValue]:
+        return self._data.get(ns, {}).get(key)
+
+    def get_version(self, ns: str, key: str) -> Optional[Version]:
+        vv = self.get_state(ns, key)
+        return vv.version if vv else None
+
+    def get_key_hash_version(self, ns: str, coll: str, key_hash: bytes) -> Optional[Version]:
+        entry = self._hashed.get((ns, coll, key_hash))
+        return entry[1] if entry else None
+
+    def get_state_range(
+        self, ns: str, start_key: str, end_key: str, include_end: bool
+    ) -> Iterator[Tuple[str, VersionedValue]]:
+        """Sorted iteration over [start_key, end_key) or [..., end_key].
+        Empty end_key means an open-ended scan (reference semantics)."""
+        keys = self._sorted_keys.get(ns, [])
+        i = bisect.bisect_left(keys, start_key)
+        table = self._data.get(ns, {})
+        while i < len(keys):
+            k = keys[i]
+            if end_key:
+                if include_end:
+                    if k > end_key:
+                        break
+                elif k >= end_key:
+                    break
+            yield k, table[k]
+            i += 1
+
+    # -- writes -----------------------------------------------------------
+    def apply_updates(self, batch: UpdateBatch, hashed: Optional[HashedUpdateBatch] = None) -> None:
+        for (ns, key), (value, version) in batch.items():
+            table = self._data.setdefault(ns, {})
+            keys = self._sorted_keys.setdefault(ns, [])
+            if value is None:
+                if key in table:
+                    del table[key]
+                    idx = bisect.bisect_left(keys, key)
+                    if idx < len(keys) and keys[idx] == key:
+                        keys.pop(idx)
+            else:
+                if key not in table:
+                    bisect.insort(keys, key)
+                table[key] = VersionedValue(value, version)
+        if hashed is not None:
+            for (ns, coll, key_hash), (vh, version) in hashed.items():
+                if vh is None:
+                    self._hashed.pop((ns, coll, key_hash), None)
+                else:
+                    self._hashed[(ns, coll, key_hash)] = (vh, version)
+
+    def num_keys(self) -> int:
+        return sum(len(t) for t in self._data.values())

@@ -1,0 +1,5 @@
+"""Membership Service Provider: X.509 identities, validation, principals."""
+
+from fabric_tpu.msp.identity import MSP, Identity, MSPConfig
+
+__all__ = ["MSP", "Identity", "MSPConfig"]

@@ -1,0 +1,158 @@
+"""Test crypto-material generator (reference cmd/cryptogen +
+usable-inter-nal/cryptogen/ca): per-org ECDSA P-256 root CA, node/user
+certs with NodeOU subject entries, ready-made MSPConfig objects.
+"""
+
+from __future__ import annotations
+
+import datetime
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from cryptography import x509
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.x509.oid import NameOID
+
+from fabric_tpu.msp.identity import MSP, MSPConfig, NodeOUs
+
+
+def _name(common_name: str, org: str, ou: Optional[str] = None) -> x509.Name:
+    attrs = [
+        x509.NameAttribute(NameOID.COUNTRY_NAME, "US"),
+        x509.NameAttribute(NameOID.ORGANIZATION_NAME, org),
+    ]
+    if ou:
+        attrs.append(x509.NameAttribute(NameOID.ORGANIZATIONAL_UNIT_NAME, ou))
+    attrs.append(x509.NameAttribute(NameOID.COMMON_NAME, common_name))
+    return x509.Name(attrs)
+
+
+def _pem_cert(cert: x509.Certificate) -> bytes:
+    return cert.public_bytes(serialization.Encoding.PEM)
+
+
+@dataclass
+class NodeIdentity:
+    name: str
+    cert_pem: bytes
+    key: ec.EllipticCurvePrivateKey
+    msp_id: str
+
+    @property
+    def priv_scalar(self) -> int:
+        return self.key.private_numbers().private_value
+
+
+class OrgCA:
+    """A self-signed org root CA that can enroll node/user identities."""
+
+    def __init__(self, org_name: str, msp_id: str):
+        self.org_name = org_name
+        self.msp_id = msp_id
+        self.key = ec.generate_private_key(ec.SECP256R1())
+        subject = _name(f"ca.{org_name}", org_name)
+        now = datetime.datetime.now(datetime.timezone.utc)
+        self.cert = (
+            x509.CertificateBuilder()
+            .subject_name(subject)
+            .issuer_name(subject)
+            .public_key(self.key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - datetime.timedelta(days=1))
+            .not_valid_after(now + datetime.timedelta(days=3650))
+            .add_extension(x509.BasicConstraints(ca=True, path_length=None), critical=True)
+            .add_extension(
+                x509.KeyUsage(
+                    digital_signature=True,
+                    key_cert_sign=True,
+                    crl_sign=True,
+                    content_commitment=False,
+                    key_encipherment=False,
+                    data_encipherment=False,
+                    key_agreement=False,
+                    encipher_only=False,
+                    decipher_only=False,
+                ),
+                critical=True,
+            )
+            .sign(self.key, hashes.SHA256())
+        )
+        self.cert_pem = _pem_cert(self.cert)
+        self._revoked: List[x509.Certificate] = []
+
+    def enroll(self, name: str, ou: str = "peer") -> NodeIdentity:
+        key = ec.generate_private_key(ec.SECP256R1())
+        now = datetime.datetime.now(datetime.timezone.utc)
+        cert = (
+            x509.CertificateBuilder()
+            .subject_name(_name(name, self.org_name, ou=ou))
+            .issuer_name(self.cert.subject)
+            .public_key(key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - datetime.timedelta(days=1))
+            .not_valid_after(now + datetime.timedelta(days=365))
+            .add_extension(x509.BasicConstraints(ca=False, path_length=None), critical=True)
+            .sign(self.key, hashes.SHA256())
+        )
+        return NodeIdentity(name, _pem_cert(cert), key, self.msp_id)
+
+    def revoke(self, identity: NodeIdentity) -> None:
+        self._revoked.append(x509.load_pem_x509_certificate(identity.cert_pem))
+
+    def crl_pem(self) -> bytes:
+        now = datetime.datetime.now(datetime.timezone.utc)
+        builder = (
+            x509.CertificateRevocationListBuilder()
+            .issuer_name(self.cert.subject)
+            .last_update(now - datetime.timedelta(hours=1))
+            .next_update(now + datetime.timedelta(days=365))
+        )
+        for cert in self._revoked:
+            builder = builder.add_revoked_certificate(
+                x509.RevokedCertificateBuilder()
+                .serial_number(cert.serial_number)
+                .revocation_date(now - datetime.timedelta(minutes=5))
+                .build()
+            )
+        crl = builder.sign(self.key, hashes.SHA256())
+        return crl.public_bytes(serialization.Encoding.PEM)
+
+
+@dataclass
+class Org:
+    """One generated organization: CA + standard identities + MSP."""
+
+    ca: OrgCA
+    admin: NodeIdentity
+    peers: List[NodeIdentity]
+    users: List[NodeIdentity]
+
+    @property
+    def msp_id(self) -> str:
+        return self.ca.msp_id
+
+    def msp_config(self, with_crl: bool = False) -> MSPConfig:
+        return MSPConfig(
+            msp_id=self.ca.msp_id,
+            root_certs=[self.ca.cert_pem],
+            admins=[self.admin.cert_pem],
+            revocation_list=[self.ca.crl_pem()] if with_crl else [],
+            node_ous=NodeOUs(enable=True),
+        )
+
+    def msp(self, provider=None, with_crl: bool = False) -> MSP:
+        return MSP(self.msp_config(with_crl=with_crl), provider=provider)
+
+
+def generate_org(
+    org_name: str,
+    msp_id: Optional[str] = None,
+    num_peers: int = 1,
+    num_users: int = 1,
+) -> Org:
+    ca = OrgCA(org_name, msp_id or f"{org_name}MSP")
+    admin = ca.enroll(f"Admin@{org_name}", ou="admin")
+    peers = [ca.enroll(f"peer{i}.{org_name}", ou="peer") for i in range(num_peers)]
+    users = [ca.enroll(f"User{i}@{org_name}", ou="client") for i in range(num_users)]
+    return Org(ca, admin, peers, users)

@@ -1,0 +1,1 @@
+"""Device kernels: limb bignum, batched P-256 ECDSA verify, SHA-256."""

@@ -1,0 +1,266 @@
+"""Multi-limb modular arithmetic for JAX/TPU.
+
+Replaces the Go-stdlib constant-time P-256 assembly the reference leans on
+(SURVEY.md §2.12: crypto/elliptic P-256 under bccsp/sw) with batched,
+compiler-friendly integer math. Design notes:
+
+- **Radix 2^13, 20 limbs** (260 bits for 256-bit fields). 13-bit limbs make
+  products fit comfortably in 32 bits (26-bit products), so a full CIOS
+  Montgomery multiplication can run with *lazy carries* entirely in uint32:
+  each of the 20 outer iterations adds two <2^27 products per limb, for a
+  worst-case accumulator below 20 * 2^27 * (1 + eps) < 2^32.
+- **Limb-major layout `(NLIMBS, *batch)`**: the batch dimension is the
+  trailing (lane) dimension on the TPU VPU, carry chains walk the leading
+  axis via `lax.scan`, and no transposes appear in the inner loop.
+- **No constant-time requirement**: verification consumes public data
+  (signatures, public keys, digests), so we freely use data-dependent
+  selects — but never data-dependent *shapes* or control flow, keeping
+  everything one fixed XLA program.
+
+Values "at rest" are canonical: every limb < 2^13 and the value < modulus
+unless a caller explicitly tracks a laxer bound (see fabric_tpu.ops.
+p256_kernel.FE). Host-side conversions use Python ints (arbitrary
+precision) and numpy.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+LIMB_BITS = 13
+NLIMBS = 20
+LIMB_MASK = (1 << LIMB_BITS) - 1
+RADIX_BITS = LIMB_BITS * NLIMBS  # 260
+
+# Fully unroll the 20-iteration CIOS outer loop at trace time. Costs trace
+# size (and thus XLA compile time), removes per-limb loop overhead at run
+# time. Defaults on; tests on the CPU backend export
+# FABRIC_TPU_CIOS_UNROLL=0 where compile time dominates.
+import os as _os
+
+CIOS_UNROLL = _os.environ.get("FABRIC_TPU_CIOS_UNROLL", "1") != "0"
+
+
+# ---------------------------------------------------------------------------
+# Host conversions
+# ---------------------------------------------------------------------------
+
+
+def int_to_limbs(x: int, nlimbs: int = NLIMBS) -> np.ndarray:
+    """Python int -> little-endian 13-bit limbs, shape (nlimbs,) uint32."""
+    if x < 0:
+        raise ValueError("negative")
+    out = np.zeros(nlimbs, dtype=np.uint32)
+    for i in range(nlimbs):
+        out[i] = x & LIMB_MASK
+        x >>= LIMB_BITS
+    if x:
+        raise ValueError("value does not fit in limbs")
+    return out
+
+
+def ints_to_limbs(xs, nlimbs: int = NLIMBS) -> np.ndarray:
+    """Batch of ints -> (nlimbs, B) uint32 (limb-major)."""
+    out = np.zeros((nlimbs, len(xs)), dtype=np.uint32)
+    for j, x in enumerate(xs):
+        out[:, j] = int_to_limbs(x, nlimbs)
+    return out
+
+
+def limbs_to_int(a) -> int:
+    """(nlimbs,) limbs -> Python int."""
+    a = np.asarray(a)
+    val = 0
+    for i in range(a.shape[0] - 1, -1, -1):
+        val = (val << LIMB_BITS) | int(a[i])
+    return val
+
+
+def limbs_to_ints(a) -> list:
+    """(nlimbs, B) -> list of B Python ints."""
+    a = np.asarray(a)
+    return [limbs_to_int(a[:, j]) for j in range(a.shape[1])]
+
+
+# ---------------------------------------------------------------------------
+# Carry propagation
+# ---------------------------------------------------------------------------
+
+
+def carry_u32(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Unsigned carry propagation along axis 0.
+
+    Input limbs may be anything < 2^32 - 2^19 (so limb + incoming carry
+    cannot wrap). Returns (canonical limbs, carry_out).
+    """
+    c0 = jnp.zeros(x.shape[1:], dtype=jnp.uint32)
+
+    def body(c, xi):
+        t = xi + c
+        return t >> LIMB_BITS, t & LIMB_MASK
+
+    c, ys = lax.scan(body, c0, x)
+    return ys, c
+
+
+def carry_i32(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Signed carry propagation along axis 0 (arithmetic shift = floor div,
+    so negative limbs borrow correctly). Returns (canonical limbs in
+    [0, 2^13), signed carry_out)."""
+    c0 = jnp.zeros(x.shape[1:], dtype=jnp.int32)
+
+    def body(c, xi):
+        t = xi + c
+        return t >> LIMB_BITS, t & LIMB_MASK
+
+    c, ys = lax.scan(body, c0, x)
+    return ys, c
+
+
+# ---------------------------------------------------------------------------
+# Montgomery context
+# ---------------------------------------------------------------------------
+
+
+class MontCtx:
+    """Precomputed Montgomery constants for an odd modulus m < 2^256.
+
+    R = 2^260 (one limb-width above 256 bits). All device constants are
+    numpy arrays; they become XLA constants at trace time.
+    """
+
+    def __init__(self, modulus: int):
+        if modulus % 2 == 0:
+            raise ValueError("modulus must be odd")
+        self.m = modulus
+        r = 1 << RADIX_BITS
+        self.m_limbs = int_to_limbs(modulus)
+        self.m_limbs_i32 = self.m_limbs.astype(np.int32)
+        self.r2_limbs = int_to_limbs((r * r) % modulus)
+        self.one_mont = int_to_limbs(r % modulus)
+        self.one = int_to_limbs(1)
+        # m' = -m^-1 mod 2^13 for the REDC quotient digit.
+        self.m0inv = np.uint32((-pow(modulus, -1, 1 << LIMB_BITS)) % (1 << LIMB_BITS))
+        # k*m for the borrow-free subtraction path (k in 1..8).
+        self.km_limbs_i32 = {
+            k: int_to_limbs(k * modulus).astype(np.int32) for k in range(1, 9)
+        }
+
+
+def cond_sub(x: jax.Array, m_limbs_i32: np.ndarray) -> jax.Array:
+    """One conditional subtract: x - m if x >= m else x (values canonical)."""
+    d = x.astype(jnp.int32) - m_limbs_i32.reshape((NLIMBS,) + (1,) * (x.ndim - 1))
+    limbs, c = carry_i32(d)
+    keep = c < 0  # borrow out -> x < m
+    return jnp.where(keep, x, limbs.astype(jnp.uint32))
+
+
+def reduce_canonical(x: jax.Array, ctx: MontCtx, times: int) -> jax.Array:
+    """Reduce a value known to be < (times+1)*m to canonical via repeated
+    conditional subtraction (static count, data-dependent selects only)."""
+    for _ in range(times):
+        x = cond_sub(x, ctx.m_limbs_i32)
+    return x
+
+
+# ---------------------------------------------------------------------------
+# Core multiply (CIOS Montgomery with lazy carries)
+# ---------------------------------------------------------------------------
+
+
+def mont_mul(ctx: MontCtx, a: jax.Array, b: jax.Array, nreduce: int = 1) -> jax.Array:
+    """Montgomery product a*b*R^-1 mod m on canonical-limb inputs.
+
+    Inputs may have value up to 4m (limbs canonical); with inputs <= c1*m,
+    c2*m the pre-reduction output is < m*(1 + c1*c2*m/2^260), so nreduce=1
+    suffices for c1*c2 <= 16. Shapes: (NLIMBS, *batch) uint32.
+    """
+    batch_shape = a.shape[1:]
+    m = jnp.asarray(ctx.m_limbs).reshape((NLIMBS,) + (1,) * len(batch_shape))
+    m0inv = jnp.uint32(ctx.m0inv)
+    t0 = jnp.zeros((NLIMBS,) + batch_shape, dtype=jnp.uint32)
+
+    def body(i, t):
+        ai = lax.dynamic_index_in_dim(a, i, axis=0, keepdims=True)  # (1, *batch)
+        u = t + ai * b + (((t[0] + ai[0] * b[0]) & LIMB_MASK) * m0inv & LIMB_MASK) * m
+        # u[0] is divisible by 2^13 by construction; shift down one limb.
+        carry0 = u[0] >> LIMB_BITS
+        shifted = jnp.concatenate(
+            [
+                (u[1] + carry0)[None],
+                u[2:],
+                jnp.zeros((1,) + batch_shape, dtype=jnp.uint32),
+            ],
+            axis=0,
+        )
+        return shifted
+
+    t = lax.fori_loop(0, NLIMBS, body, t0, unroll=CIOS_UNROLL)
+    limbs, c = carry_u32(t)
+    del c  # value < 2m for canonical inputs; carry-out is provably zero
+    return reduce_canonical(limbs, ctx, nreduce)
+
+
+def add_raw(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Limb-canonical addition WITHOUT modular reduction (value = a+b)."""
+    limbs, c = carry_u32(a + b)
+    return limbs  # caller guarantees value < 2^260 (c == 0)
+
+
+def sub_mod(ctx: MontCtx, a: jax.Array, b: jax.Array, b_bound: int, nreduce: int) -> jax.Array:
+    """a - b + b_bound*m, carried in int32 (no borrow underflow), then
+    reduced with `nreduce` conditional subtracts."""
+    kp = ctx.km_limbs_i32[b_bound].reshape((NLIMBS,) + (1,) * (a.ndim - 1))
+    t = a.astype(jnp.int32) + kp - b.astype(jnp.int32)
+    limbs, c = carry_i32(t)
+    return reduce_canonical(limbs.astype(jnp.uint32), ctx, nreduce)
+
+
+def to_mont(ctx: MontCtx, x: jax.Array, nreduce: int = 1) -> jax.Array:
+    return mont_mul(ctx, x, _bc(ctx.r2_limbs, x), nreduce=nreduce)
+
+
+def from_mont(ctx: MontCtx, x: jax.Array) -> jax.Array:
+    return mont_mul(ctx, x, _bc(ctx.one, x))
+
+
+def _bc(const_limbs: np.ndarray, like: jax.Array) -> jax.Array:
+    """Broadcast a (NLIMBS,) numpy constant against like's batch dims."""
+    return jnp.broadcast_to(
+        jnp.asarray(const_limbs).reshape((NLIMBS,) + (1,) * (like.ndim - 1)),
+        like.shape,
+    )
+
+
+def mont_pow(ctx: MontCtx, x: jax.Array, exponent: int) -> jax.Array:
+    """x^exponent in the Montgomery domain, square-and-multiply over the
+    (static) exponent bits via lax.scan — the trace stays small and the
+    schedule is branch-free (select instead of branch on each bit)."""
+    nbits = exponent.bit_length()
+    bits = np.array(
+        [(exponent >> (nbits - 1 - i)) & 1 for i in range(nbits)], dtype=np.bool_
+    )
+    acc0 = _bc(ctx.one_mont, x)
+
+    def body(acc, bit):
+        acc = mont_mul(ctx, acc, acc)
+        acc_x = mont_mul(ctx, acc, x)
+        return jnp.where(bit, acc_x, acc), None
+
+    acc, _ = lax.scan(body, acc0, jnp.asarray(bits))
+    return acc
+
+
+def eq_limbs(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Limbwise equality reduced over axis 0 -> bool (*batch)."""
+    return jnp.all(a == b, axis=0)
+
+
+def is_zero(a: jax.Array) -> jax.Array:
+    return jnp.all(a == 0, axis=0)

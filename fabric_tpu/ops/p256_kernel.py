@@ -1,0 +1,350 @@
+"""Batched ECDSA-P-256 verification as one XLA program.
+
+This is the TPU replacement for the per-endorsement `ecdsa.Verify` hot loop
+the reference burns CPU on (reference: common/policies/policy.go:369-399 ->
+msp/identities.go:169 -> bccsp/sw/ecdsa.go:41; SURVEY.md §3.1 "HOT").
+Instead of one goroutine per transaction (reference v20/validator.go:193-208),
+we flatten (tx × endorsement) into one padded batch dimension and verify the
+whole block in a single fixed-shape device program.
+
+Math layout:
+
+- field elements: Montgomery residues in 20×13-bit limbs, limb-major
+  ``(20, B)`` (see fabric_tpu.ops.bignum);
+- point arithmetic: *complete* projective formulas for a=-3 short
+  Weierstrass curves (Renes–Costello–Batina, EUROCRYPT 2016, algs 4/6).
+  Complete formulas have no special cases for infinity/doubling, which is
+  exactly what a branch-free SIMD batch needs;
+- scalar recomposition: u1*G + u2*Q with 4-bit fixed windows, MSB-first.
+  The G part uses a host-precomputed 64×16-entry comb table (G is a global
+  constant); the Q part builds a per-lane 16-entry table of small multiples;
+- scalar inversion s^-1 mod n and the final Z^-1 mod p use Fermat
+  exponentiation (branch-free square-and-multiply over static exponent
+  bits).
+
+The per-lane boolean output is bit-exact with the reference's
+`ecdsa.Verify` decision; DER parsing, the low-S rule and r/s range checks
+happen host-side (cheap, irregular) and arrive here as the `valid_in` mask.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from fabric_tpu.crypto import p256
+from fabric_tpu.ops import bignum as bn
+
+CTX_P = bn.MontCtx(p256.P)
+CTX_N = bn.MontCtx(p256.N)
+
+_R = 1 << bn.RADIX_BITS
+B_MONT = bn.int_to_limbs((p256.B * _R) % p256.P)
+N_LIMBS = bn.int_to_limbs(p256.N)
+
+WINDOW_BITS = 4
+NUM_WINDOWS = 64  # 256 bits / 4
+
+
+class FE(NamedTuple):
+    """A mod-p field element with a static value bound (value < bound * p).
+
+    Bounds are tracked at trace time so the lazy-reduction discipline of the
+    RCB formulas is machine-checked: `mul` requires bound products <= 16
+    (then a single conditional subtract renormalizes), `add` accumulates
+    bounds, `sub` renormalizes to canonical.
+    """
+
+    limbs: jax.Array
+    bound: int
+
+
+def fe(limbs: jax.Array, bound: int = 1) -> FE:
+    return FE(limbs, bound)
+
+
+def fe_mul(a: FE, b: FE) -> FE:
+    assert a.bound * b.bound <= 16, (a.bound, b.bound)
+    return FE(bn.mont_mul(CTX_P, a.limbs, b.limbs, nreduce=1), 1)
+
+
+def fe_add(a: FE, b: FE) -> FE:
+    assert a.bound + b.bound <= 8, (a.bound, b.bound)
+    return FE(bn.add_raw(a.limbs, b.limbs), a.bound + b.bound)
+
+
+def fe_sub(a: FE, b: FE) -> FE:
+    # a - b + bound(b)*p, then conditional subtracts back to canonical.
+    return FE(
+        bn.sub_mod(CTX_P, a.limbs, b.limbs, b.bound, nreduce=a.bound + b.bound - 1), 1
+    )
+
+
+def fe_norm(a: FE) -> FE:
+    return FE(bn.reduce_canonical(a.limbs, CTX_P, a.bound - 1), 1)
+
+
+def _const_fe(value_mod_p: int, like: jax.Array) -> FE:
+    return FE(bn._bc(bn.int_to_limbs(value_mod_p), like), 1)
+
+
+class Point(NamedTuple):
+    """Projective (X:Y:Z), coordinates in the Montgomery domain."""
+
+    x: FE
+    y: FE
+    z: FE
+
+
+def point_identity(like: jax.Array) -> Point:
+    one_m = (_R % p256.P)
+    return Point(_const_fe(0, like), _const_fe(one_m, like), _const_fe(0, like))
+
+
+def _b_fe(like: jax.Array) -> FE:
+    return FE(bn._bc(B_MONT, like), 1)
+
+
+def point_add(p: Point, q: Point) -> Point:
+    """Complete addition, RCB 2016 algorithm 4 (a = -3). Handles identity
+    and p == q with no branches."""
+    x1, y1, z1 = p
+    x2, y2, z2 = q
+    bb = _b_fe(x1.limbs)
+
+    t0 = fe_mul(x1, x2)
+    t1 = fe_mul(y1, y2)
+    t2 = fe_mul(z1, z2)
+    t3 = fe_add(x1, y1)
+    t4 = fe_add(x2, y2)
+    t3 = fe_mul(t3, t4)
+    t4 = fe_add(t0, t1)
+    t3 = fe_sub(t3, t4)
+    t4 = fe_add(y1, z1)
+    t5 = fe_add(y2, z2)
+    t4 = fe_mul(t4, t5)
+    t5 = fe_add(t1, t2)
+    t4 = fe_sub(t4, t5)
+    x3 = fe_add(x1, z1)
+    y3 = fe_add(x2, z2)
+    x3 = fe_mul(x3, y3)
+    y3 = fe_add(t0, t2)
+    y3 = fe_sub(x3, y3)
+    z3 = fe_mul(bb, t2)
+    x3 = fe_sub(y3, z3)
+    z3 = fe_add(x3, x3)
+    x3 = fe_add(x3, z3)
+    z3 = fe_sub(t1, x3)
+    x3 = fe_add(t1, x3)  # bound 4
+    y3 = fe_mul(bb, y3)
+    t1 = fe_add(t2, t2)
+    t2 = fe_add(t1, t2)
+    y3 = fe_sub(y3, t2)
+    y3 = fe_sub(y3, t0)
+    t1 = fe_add(y3, y3)
+    y3 = fe_add(t1, y3)  # bound 3
+    t1 = fe_add(t0, t0)
+    t0 = fe_add(t1, t0)
+    t0 = fe_sub(t0, t2)
+    t1 = fe_mul(t4, y3)
+    t2 = fe_mul(t0, y3)
+    y3 = fe_mul(x3, z3)
+    y3 = fe_add(y3, t2)
+    x3 = fe_mul(t3, x3)
+    x3 = fe_sub(x3, t1)
+    z3 = fe_mul(t4, z3)
+    t1 = fe_mul(t3, t0)
+    z3 = fe_add(z3, t1)
+    return Point(x3, fe_norm(y3), fe_norm(z3))
+
+
+def point_double(p: Point) -> Point:
+    """Complete doubling, RCB 2016 algorithm 6 (a = -3)."""
+    x, y, z = p
+    bb = _b_fe(x.limbs)
+
+    t0 = fe_mul(x, x)
+    t1 = fe_mul(y, y)
+    t2 = fe_mul(z, z)
+    t3 = fe_mul(x, y)
+    t3 = fe_add(t3, t3)
+    z3 = fe_mul(x, z)
+    z3 = fe_add(z3, z3)
+    y3 = fe_mul(bb, t2)
+    y3 = fe_sub(y3, z3)
+    x3 = fe_add(y3, y3)
+    y3 = fe_add(x3, y3)  # bound 3
+    x3 = fe_sub(t1, y3)
+    y3 = fe_add(t1, y3)  # bound 4
+    y3 = fe_mul(x3, y3)
+    x3 = fe_mul(x3, t3)
+    t3 = fe_add(t2, t2)
+    t2 = fe_add(t2, t3)  # bound 3
+    z3 = fe_mul(bb, z3)
+    z3 = fe_sub(z3, t2)
+    z3 = fe_sub(z3, t0)
+    t3 = fe_add(z3, z3)
+    z3 = fe_add(z3, t3)  # bound 3
+    t3 = fe_add(t0, t0)
+    t0 = fe_add(t3, t0)
+    t0 = fe_sub(t0, t2)
+    t0 = fe_mul(t0, z3)
+    y3 = fe_add(y3, t0)
+    t0 = fe_mul(y, z)
+    t0 = fe_add(t0, t0)
+    z3 = fe_mul(t0, z3)
+    x3 = fe_sub(x3, z3)
+    z3 = fe_mul(t0, t1)
+    z3 = fe_add(z3, z3)
+    z3 = fe_add(z3, z3)  # bound 4
+    return Point(x3, fe_norm(y3), fe_norm(z3))
+
+
+# ---------------------------------------------------------------------------
+# Fixed-base comb table for G (host precompute)
+# ---------------------------------------------------------------------------
+
+_G_TABLE: np.ndarray | None = None
+
+
+def g_small_table() -> np.ndarray:
+    """(16, 3, 20) uint32: entry d = projective Montgomery coords of d*G.
+
+    Used inside the Horner window loop (R = 16R + d1*G + d2*Q): everything
+    added at window w is scaled by the remaining doublings, so the table
+    holds *plain* small multiples — a pre-scaled comb table would get
+    double-scaled.
+    """
+    global _G_TABLE
+    if _G_TABLE is not None:
+        return _G_TABLE
+
+    one_m = _R % p256.P
+    table = np.zeros((16, 3, bn.NLIMBS), dtype=np.uint32)
+    table[0, 1] = bn.int_to_limbs(one_m)  # identity (0 : R : 0)
+    acc = None
+    for d in range(1, 16):
+        acc = p256.point_add(acc, p256.GENERATOR)
+        x, y = acc
+        table[d, 0] = bn.int_to_limbs((x * _R) % p256.P)
+        table[d, 1] = bn.int_to_limbs((y * _R) % p256.P)
+        table[d, 2] = bn.int_to_limbs(one_m)
+    _G_TABLE = table
+    return table
+
+
+# ---------------------------------------------------------------------------
+# Scalar digit extraction
+# ---------------------------------------------------------------------------
+
+
+def scalar_digits_msb(u: jax.Array) -> jax.Array:
+    """(20, B) canonical limbs -> (64, B) 4-bit digits, MSB window first."""
+    digits = []
+    for w in range(NUM_WINDOWS):  # w = 0 is the most significant window
+        bit = (NUM_WINDOWS - 1 - w) * WINDOW_BITS
+        limb, off = divmod(bit, bn.LIMB_BITS)
+        d = u[limb] >> off
+        if off > bn.LIMB_BITS - WINDOW_BITS and limb + 1 < bn.NLIMBS:
+            d = d | (u[limb + 1] << (bn.LIMB_BITS - off))
+        digits.append(d & (16 - 1))
+    return jnp.stack(digits, axis=0)
+
+
+def _one_hot_select(table: jax.Array, idx: jax.Array) -> Tuple[jax.Array, ...]:
+    """table (16, 3, 20, B) or (16, 3, 20); idx (B,) -> three (20, B) arrays."""
+    oh = (jnp.arange(16, dtype=jnp.uint32)[:, None] == idx[None, :]).astype(jnp.uint32)
+    if table.ndim == 4:  # per-lane table
+        sel = (table * oh[:, None, None, :]).sum(axis=0)  # (3, 20, B)
+    else:  # shared constant table
+        sel = jnp.einsum("kcl,kb->clb", table, oh)  # (3, 20, B)
+    return sel[0], sel[1], sel[2]
+
+
+# ---------------------------------------------------------------------------
+# The batched verifier
+# ---------------------------------------------------------------------------
+
+
+def verify_batch_device(
+    e: jax.Array,
+    r: jax.Array,
+    s: jax.Array,
+    qx: jax.Array,
+    qy: jax.Array,
+    valid_in: jax.Array,
+) -> jax.Array:
+    """Core batched verify. All limb inputs (20, B) uint32 canonical;
+    valid_in (B,) bool (host prechecks: DER ok, low-S, 1 <= r,s < n, Q on
+    curve). Returns (B,) bool.
+
+    Semantics (Go crypto/ecdsa.Verify): w = s^-1 mod n; u1 = e*w; u2 = r*w;
+    (x, y) = u1*G + u2*Q; accept iff the sum is not infinity and
+    x mod n == r.
+    """
+    batch = e.shape[1:]
+
+    # --- scalar field: u1 = e/s, u2 = r/s (mod n) ---
+    s_m = bn.to_mont(CTX_N, s)
+    s_inv = bn.mont_pow(CTX_N, s_m, p256.N - 2)
+    e_m = bn.to_mont(CTX_N, e)  # e < 2^256 (may exceed n; to_mont reduces)
+    r_m = bn.to_mont(CTX_N, r)
+    u1 = bn.from_mont(CTX_N, bn.mont_mul(CTX_N, e_m, s_inv))
+    u2 = bn.from_mont(CTX_N, bn.mont_mul(CTX_N, r_m, s_inv))
+
+    d1 = scalar_digits_msb(u1)  # (64, B)
+    d2 = scalar_digits_msb(u2)
+
+    # --- per-lane table of small multiples of Q ---
+    q_pt = Point(
+        fe(bn.to_mont(CTX_P, qx)),
+        fe(bn.to_mont(CTX_P, qy)),
+        _const_fe(_R % p256.P, qx),
+    )
+
+    def _pack(p: Point) -> jax.Array:
+        return jnp.stack([p.x.limbs, p.y.limbs, p.z.limbs], axis=0)
+
+    def _unpack(a: jax.Array) -> Point:
+        return Point(fe(a[0]), fe(a[1]), fe(a[2]))
+
+    def tab_body(acc, _):
+        pt = _unpack(acc)
+        return _pack(point_add(pt, q_pt)), acc
+
+    _, q_multiples = lax.scan(tab_body, _pack(q_pt), None, length=15)
+    ident_row = _pack(point_identity(qx))[None]
+    q_table = jnp.concatenate([ident_row, q_multiples], axis=0)  # (16, 3, 20, B)
+
+    # --- main window loop: R = 16R + d1*G + d2*Q, MSB first (Horner) ---
+    g_table = jnp.asarray(g_small_table())  # (16, 3, 20)
+
+    def win_body(carry, xs):
+        d1w, d2w = xs
+        acc = _unpack(carry)
+        for _ in range(WINDOW_BITS):
+            acc = point_double(acc)
+        qx_s, qy_s, qz_s = _one_hot_select(q_table, d2w)
+        acc = point_add(acc, Point(fe(qx_s), fe(qy_s), fe(qz_s)))
+        gx_s, gy_s, gz_s = _one_hot_select(g_table, d1w)
+        acc = point_add(acc, Point(fe(gx_s), fe(gy_s), fe(gz_s)))
+        return _pack(acc), None
+
+    carry, _ = lax.scan(win_body, _pack(point_identity(qx)), (d1, d2))
+    acc = _unpack(carry)
+
+    # --- affine x and the final comparison ---
+    z_inv = bn.mont_pow(CTX_P, acc.z.limbs, p256.P - 2)
+    x_aff = bn.from_mont(CTX_P, bn.mont_mul(CTX_P, acc.x.limbs, z_inv))
+    r_plus_n, _ = bn.carry_u32(r + bn._bc(N_LIMBS, r))  # value < 2^257, fits
+    matches = bn.eq_limbs(x_aff, r) | bn.eq_limbs(x_aff, r_plus_n)
+    not_inf = ~bn.is_zero(acc.z.limbs)
+    return valid_in & not_inf & matches
+
+
+verify_batch_jit = jax.jit(verify_batch_device)

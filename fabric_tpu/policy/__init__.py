@@ -1,0 +1,24 @@
+"""Signature policies (reference common/cauthdsl + common/policydsl)."""
+
+from fabric_tpu.policy.ast import (
+    MSPPrincipal,
+    MSPRole,
+    NOutOf,
+    Role,
+    SignaturePolicyEnvelope,
+    SignedBy,
+    from_dsl,
+)
+from fabric_tpu.policy.evaluator import compile_batched, evaluate_host
+
+__all__ = [
+    "MSPPrincipal",
+    "MSPRole",
+    "NOutOf",
+    "Role",
+    "SignaturePolicyEnvelope",
+    "SignedBy",
+    "from_dsl",
+    "compile_batched",
+    "evaluate_host",
+]

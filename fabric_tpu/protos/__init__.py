@@ -1,0 +1,36 @@
+"""Fabric-wire-compatible protobuf messages.
+
+Field numbers and package names mirror the public fabric-protos schemas
+(the reference consumes them via the fabric-protos-go module, go.mod:42),
+so envelopes/blocks produced here parse in stock Fabric tooling and vice
+versa. Sources in src/, generated modules committed; regenerate with
+gen.sh.
+
+protoc's generated modules import each other by bare module name, so this
+package directory is appended to sys.path before loading them.
+"""
+
+import os
+import sys
+
+_here = os.path.dirname(__file__)
+if _here not in sys.path:
+    sys.path.append(_here)
+
+from fabric_tpu.protos import common_pb2  # noqa: E402
+from fabric_tpu.protos import identities_pb2  # noqa: E402
+from fabric_tpu.protos import kv_rwset_pb2  # noqa: E402
+from fabric_tpu.protos import msp_principal_pb2  # noqa: E402
+from fabric_tpu.protos import peer_pb2  # noqa: E402
+from fabric_tpu.protos import policies_pb2  # noqa: E402
+from fabric_tpu.protos import rwset_pb2  # noqa: E402
+
+__all__ = [
+    "common_pb2",
+    "identities_pb2",
+    "kv_rwset_pb2",
+    "msp_principal_pb2",
+    "peer_pb2",
+    "policies_pb2",
+    "rwset_pb2",
+]

@@ -1,0 +1,7 @@
+#!/bin/sh
+# Regenerate Python protobuf modules from src/*.proto.
+# Generated *_pb2.py files are committed; rerun after editing any .proto.
+set -e
+cd "$(dirname "$0")"
+protoc --proto_path=src --python_out=. src/*.proto
+echo "generated:" *_pb2.py

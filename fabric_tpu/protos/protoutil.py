@@ -1,0 +1,145 @@
+"""Proto helpers mirroring the reference's protoutil package.
+
+Byte-exact parity surfaces (reference protoutil/):
+- TxID = hex(SHA-256(nonce || creator))                  (proputils.go:357)
+- BlockHeaderHash = SHA-256(ASN.1-DER(SEQUENCE{number INTEGER,
+  previous_hash OCTET STRING, data_hash OCTET STRING})) (blockutils.go:60)
+- BlockDataHash = SHA-256(concat(data...))               (blockutils.go:65)
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Iterable, List, Optional, Sequence
+
+from fabric_tpu.protos import common_pb2, identities_pb2, peer_pb2
+
+
+def compute_tx_id(nonce: bytes, creator: bytes) -> str:
+    return hashlib.sha256(nonce + creator).hexdigest()
+
+
+def check_tx_id(tx_id: str, nonce: bytes, creator: bytes) -> bool:
+    """reference protoutil.CheckTxID (proputils.go:368)."""
+    return tx_id == compute_tx_id(nonce, creator)
+
+
+# --- minimal DER encoder for the block-header triple -----------------------
+
+
+def _der_len(n: int) -> bytes:
+    if n < 0x80:
+        return bytes([n])
+    body = n.to_bytes((n.bit_length() + 7) // 8, "big")
+    return bytes([0x80 | len(body)]) + body
+
+
+def _der_integer(v: int) -> bytes:
+    # two's-complement minimal encoding, matching Go asn1.Marshal of *big.Int
+    if v == 0:
+        content = b"\x00"
+    elif v > 0:
+        content = v.to_bytes((v.bit_length() + 8) // 8, "big")
+        if len(content) > 1 and content[0] == 0 and content[1] & 0x80 == 0:
+            content = content[1:]
+    else:
+        raise ValueError("negative block numbers do not occur")
+    return b"\x02" + _der_len(len(content)) + content
+
+
+def _der_octet_string(b: bytes) -> bytes:
+    return b"\x04" + _der_len(len(b)) + b
+
+
+def block_header_bytes(header: common_pb2.BlockHeader) -> bytes:
+    body = (
+        _der_integer(header.number)
+        + _der_octet_string(header.previous_hash)
+        + _der_octet_string(header.data_hash)
+    )
+    return b"\x30" + _der_len(len(body)) + body
+
+
+def block_header_hash(header: common_pb2.BlockHeader) -> bytes:
+    return hashlib.sha256(block_header_bytes(header)).digest()
+
+
+def block_data_hash(data: common_pb2.BlockData) -> bytes:
+    return hashlib.sha256(b"".join(data.data)).digest()
+
+
+# --- block assembly --------------------------------------------------------
+
+
+def new_block(number: int, previous_hash: bytes) -> common_pb2.Block:
+    block = common_pb2.Block()
+    block.header.number = number
+    block.header.previous_hash = previous_hash
+    block.data.SetInParent()
+    init_block_metadata(block)
+    return block
+
+
+def init_block_metadata(block: common_pb2.Block) -> None:
+    """Ensure the metadata array covers all BlockMetadataIndex slots
+    (reference protoutil.InitBlockMetadata)."""
+    want = len(common_pb2.BlockMetadataIndex.keys())
+    while len(block.metadata.metadata) < want:
+        block.metadata.metadata.append(b"")
+
+
+def seal_block(block: common_pb2.Block) -> common_pb2.Block:
+    block.header.data_hash = block_data_hash(block.data)
+    return block
+
+
+# --- envelope/tx plumbing --------------------------------------------------
+
+
+def make_signature_header(creator: bytes, nonce: bytes) -> common_pb2.SignatureHeader:
+    sh = common_pb2.SignatureHeader()
+    sh.creator = creator
+    sh.nonce = nonce
+    return sh
+
+
+def make_channel_header(
+    header_type: int,
+    channel_id: str,
+    tx_id: str = "",
+    epoch: int = 0,
+    extension: bytes = b"",
+    version: int = 0,
+) -> common_pb2.ChannelHeader:
+    ch = common_pb2.ChannelHeader()
+    ch.type = header_type
+    ch.version = version
+    ch.channel_id = channel_id
+    ch.tx_id = tx_id
+    ch.epoch = epoch
+    if extension:
+        ch.extension = extension
+    return ch
+
+
+def serialize_identity(mspid: str, cert_pem: bytes) -> bytes:
+    sid = identities_pb2.SerializedIdentity()
+    sid.mspid = mspid
+    sid.id_bytes = cert_pem
+    return sid.SerializeToString()
+
+
+def get_envelope_from_block_data(data: bytes) -> common_pb2.Envelope:
+    env = common_pb2.Envelope()
+    env.ParseFromString(data)
+    return env
+
+
+def unmarshal(msg_cls, raw: bytes):
+    """Parse or raise ValueError (Go-style unmarshal-with-error wrapper)."""
+    msg = msg_cls()
+    try:
+        msg.ParseFromString(raw)
+    except Exception as e:
+        raise ValueError(f"error unmarshalling {msg_cls.__name__}: {e}") from e
+    return msg

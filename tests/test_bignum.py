@@ -1,0 +1,119 @@
+"""Differential tests: limb bignum vs Python arbitrary-precision ints."""
+
+import secrets
+
+import numpy as np
+import pytest
+
+from fabric_tpu.crypto import p256
+from fabric_tpu.ops import bignum as bn
+
+
+def rand_below(m, count):
+    return [secrets.randbelow(m) for _ in range(count)]
+
+
+@pytest.fixture(scope="module", params=[p256.P, p256.N])
+def ctx(request):
+    return bn.MontCtx(request.param)
+
+
+def test_limb_roundtrip():
+    xs = [0, 1, p256.P - 1, p256.N, 2**256 - 1] + rand_below(2**256, 5)
+    arr = bn.ints_to_limbs(xs)
+    assert bn.limbs_to_ints(arr) == xs
+    assert arr.dtype == np.uint32
+    assert (arr <= bn.LIMB_MASK).all()
+
+
+def test_carry_u32():
+    import jax.numpy as jnp
+
+    # limbs deliberately far out of canonical range
+    vals = np.array([[bn.LIMB_MASK * 1000, 2**31, 12345, 0]] * 2, dtype=np.uint32).T
+    want = [
+        sum(int(v) << (bn.LIMB_BITS * i) for i, v in enumerate(col))
+        for col in vals.T
+    ]
+    got, carry = bn.carry_u32(jnp.asarray(vals))
+    got = np.asarray(got)
+    carry = np.asarray(carry)
+    for j in range(vals.shape[1]):
+        total = bn.limbs_to_int(got[:, j]) + (int(carry[j]) << (bn.LIMB_BITS * 4))
+        assert total == want[j]
+
+
+def test_mont_mul_random(ctx):
+    m = ctx.m
+    B = 64
+    a_int = rand_below(m, B)
+    b_int = rand_below(m, B)
+    a = bn.ints_to_limbs(a_int)
+    b = bn.ints_to_limbs(b_int)
+    rinv = pow(1 << bn.RADIX_BITS, -1, m)
+    got = bn.limbs_to_ints(np.asarray(bn.mont_mul(ctx, a, b)))
+    want = [(x * y * rinv) % m for x, y in zip(a_int, b_int)]
+    assert got == want
+
+
+def test_mont_mul_edge_values(ctx):
+    m = ctx.m
+    edges = [0, 1, 2, m - 1, m - 2, (m - 1) // 2, bn.LIMB_MASK]
+    pairs = [(x, y) for x in edges for y in edges]
+    a = bn.ints_to_limbs([x for x, _ in pairs])
+    b = bn.ints_to_limbs([y for _, y in pairs])
+    rinv = pow(1 << bn.RADIX_BITS, -1, m)
+    got = bn.limbs_to_ints(np.asarray(bn.mont_mul(ctx, a, b)))
+    want = [(x * y * rinv) % m for x, y in pairs]
+    assert got == want
+
+
+def test_mont_mul_lax_value_bounds(ctx):
+    """Inputs up to 4m (limb-canonical, value non-canonical) still reduce
+    correctly with nreduce=1."""
+    m = ctx.m
+    vals = [4 * m - 1, 2 * m + 12345, m, 3 * m + 7]
+    a = bn.ints_to_limbs(vals)
+    b = bn.ints_to_limbs(list(reversed(vals)))
+    rinv = pow(1 << bn.RADIX_BITS, -1, m)
+    got = bn.limbs_to_ints(np.asarray(bn.mont_mul(ctx, a, b)))
+    want = [(x * y * rinv) % m for x, y in zip(vals, reversed(vals))]
+    assert got == want
+
+
+def test_to_from_mont(ctx):
+    m = ctx.m
+    xs = rand_below(m, 16) + [0, 1, m - 1]
+    # include values above m (e < 2^256 with m = N case)
+    if m < 2**256:
+        xs += [m + 1, 2**256 - 1]
+    arr = bn.ints_to_limbs(xs)
+    mont = bn.to_mont(ctx, arr)
+    back = bn.limbs_to_ints(np.asarray(bn.from_mont(ctx, mont)))
+    assert back == [x % m for x in xs]
+
+
+def test_sub_mod(ctx):
+    m = ctx.m
+    cases = [(5, 7), (m - 1, 1), (0, m - 1), (12345, 12345)]
+    a = bn.ints_to_limbs([x for x, _ in cases])
+    b = bn.ints_to_limbs([y for _, y in cases])
+    got = bn.limbs_to_ints(np.asarray(bn.sub_mod(ctx, a, b, b_bound=1, nreduce=1)))
+    assert got == [(x - y) % m for x, y in cases]
+
+
+def test_mont_pow_inverse(ctx):
+    m = ctx.m
+    xs = rand_below(m - 1, 8)
+    xs = [x + 1 for x in xs]  # nonzero
+    arr = bn.to_mont(ctx, bn.ints_to_limbs(xs))
+    inv_m = bn.mont_pow(ctx, arr, m - 2)
+    got = bn.limbs_to_ints(np.asarray(bn.from_mont(ctx, inv_m)))
+    assert got == [pow(x, -1, m) for x in xs]
+
+
+def test_mont_pow_zero(ctx):
+    """0^(m-2) = 0: the infinity-Z path relies on this."""
+    arr = bn.ints_to_limbs([0, 0])
+    got = bn.limbs_to_ints(np.asarray(bn.mont_pow(ctx, arr, ctx.m - 2)))
+    assert got == [0, 0]

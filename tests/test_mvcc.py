@@ -1,0 +1,187 @@
+"""MVCC validator tests — table-driven, modeled on the reference's
+validation/validator_test.go scenarios."""
+
+from fabric_tpu.ledger.mvcc import Validator
+from fabric_tpu.ledger.rwset import (
+    CollHashedRwSet,
+    KVRead,
+    KVReadHash,
+    KVWrite,
+    KVWriteHash,
+    NsRwSet,
+    RangeQueryInfo,
+    TxRwSet,
+    Version,
+)
+from fabric_tpu.ledger.statedb import UpdateBatch, VersionedDB
+from fabric_tpu.validation.txflags import TxValidationCode
+
+V = TxValidationCode.VALID
+MVCC = TxValidationCode.MVCC_READ_CONFLICT
+PHANTOM = TxValidationCode.PHANTOM_READ_CONFLICT
+
+
+def seed_db(entries):
+    db = VersionedDB()
+    batch = UpdateBatch()
+    for ns, key, value, ver in entries:
+        batch.put(ns, key, value, ver)
+    db.apply_updates(batch)
+    return db
+
+
+def tx(reads=(), writes=(), rq=(), coll=(), ns="cc1"):
+    return TxRwSet((NsRwSet(ns, tuple(reads), tuple(writes), tuple(rq), tuple(coll)),))
+
+
+def run(db, txs, block_num=5):
+    v = Validator(db)
+    codes, updates, hashed = v.validate_and_prepare_batch(
+        block_num, txs, [V] * len(txs)
+    )
+    return codes, updates, hashed
+
+
+def test_version_match_and_mismatch():
+    db = seed_db([("cc1", "k1", b"v1", Version(1, 0)), ("cc1", "k2", b"v2", Version(1, 1))])
+    txs = [
+        tx(reads=[KVRead("k1", Version(1, 0))], writes=[KVWrite("k1", value=b"new")]),
+        tx(reads=[KVRead("k2", Version(9, 9))]),  # stale
+        tx(reads=[KVRead("missing", None)]),  # correctly read-as-absent
+        tx(reads=[KVRead("missing", Version(1, 0))]),  # phantom existence
+    ]
+    codes, updates, _ = run(db, txs)
+    assert codes == [V, MVCC, V, MVCC]
+    assert updates.get("cc1", "k1") == (b"new", Version(5, 0))
+
+
+def test_intra_block_conflict_and_apply_as_you_go():
+    db = seed_db([("cc1", "k1", b"v1", Version(1, 0))])
+    txs = [
+        tx(reads=[KVRead("k1", Version(1, 0))], writes=[KVWrite("k1", value=b"a")]),
+        # reads k1 at committed version, but tx0 wrote it in-block -> conflict
+        tx(reads=[KVRead("k1", Version(1, 0))]),
+        # doesn't read k1; writes something else -> fine
+        tx(writes=[KVWrite("k9", value=b"z")]),
+    ]
+    codes, updates, _ = run(db, txs)
+    assert codes == [V, MVCC, V]
+    assert updates.get("cc1", "k9") == (b"z", Version(5, 2))
+
+
+def test_invalid_tx_does_not_apply_writes():
+    db = seed_db([("cc1", "k1", b"v1", Version(1, 0))])
+    txs = [
+        tx(reads=[KVRead("k1", Version(0, 0))], writes=[KVWrite("k2", value=b"x")]),
+        tx(reads=[KVRead("k2", None)]),  # k2 not written since tx0 invalid
+    ]
+    codes, updates, _ = run(db, txs)
+    assert codes == [MVCC, V]
+    assert updates.get("cc1", "k2") is None
+
+
+def test_upstream_invalid_skipped():
+    db = seed_db([])
+    txs = [tx(writes=[KVWrite("k", value=b"v")])] * 2
+    v = Validator(db)
+    codes, updates, _ = v.validate_and_prepare_batch(
+        7, txs, [TxValidationCode.ENDORSEMENT_POLICY_FAILURE, V]
+    )
+    assert codes == [TxValidationCode.ENDORSEMENT_POLICY_FAILURE, V]
+    assert updates.get("cc1", "k") == (b"v", Version(7, 1))
+
+
+def test_delete_write_and_read_of_deleted():
+    db = seed_db([("cc1", "k1", b"v1", Version(1, 0))])
+    txs = [
+        tx(reads=[KVRead("k1", Version(1, 0))], writes=[KVWrite("k1", is_delete=True)]),
+    ]
+    codes, updates, _ = run(db, txs)
+    assert codes == [V]
+    db.apply_updates(updates)
+    assert db.get_state("cc1", "k1") is None
+
+
+class TestRangeQueries:
+    def seed(self):
+        return seed_db(
+            [("cc1", f"k{i}", b"v", Version(1, i)) for i in range(1, 6)]
+        )  # k1..k5
+
+    def rq(self, start, end, reads, exhausted=True):
+        return RangeQueryInfo(start, end, exhausted, tuple(reads))
+
+    def test_unchanged_range_ok(self):
+        db = self.seed()
+        reads = [KVRead(f"k{i}", Version(1, i)) for i in range(1, 4)]  # k1..k3 < k4
+        txs = [tx(rq=[self.rq("k1", "k4", reads)])]
+        codes, _, _ = run(db, txs)
+        assert codes == [V]
+
+    def test_phantom_insert_by_prior_tx(self):
+        db = self.seed()
+        reads = [KVRead(f"k{i}", Version(1, i)) for i in range(1, 4)]
+        txs = [
+            tx(writes=[KVWrite("k25", value=b"new")]),  # k25 sorts inside [k1,k4)
+            tx(rq=[self.rq("k1", "k4", reads)]),
+        ]
+        codes, _, _ = run(db, txs)
+        assert codes == [V, PHANTOM]
+
+    def test_phantom_delete_by_prior_tx(self):
+        db = self.seed()
+        reads = [KVRead(f"k{i}", Version(1, i)) for i in range(1, 4)]
+        txs = [
+            tx(writes=[KVWrite("k2", is_delete=True)]),
+            tx(rq=[self.rq("k1", "k4", reads)]),
+        ]
+        codes, _, _ = run(db, txs)
+        assert codes == [V, PHANTOM]
+
+    def test_version_change_in_range(self):
+        db = self.seed()
+        reads = [KVRead(f"k{i}", Version(1, i)) for i in range(1, 4)]
+        txs = [
+            tx(writes=[KVWrite("k2", value=b"upd")]),
+            tx(rq=[self.rq("k1", "k4", reads)]),
+        ]
+        codes, _, _ = run(db, txs)
+        assert codes == [V, PHANTOM]
+
+    def test_itr_not_exhausted_includes_end_key(self):
+        db = self.seed()
+        # Simulation stopped at k3: EndKey=k3 must be included on re-check.
+        reads = [KVRead(f"k{i}", Version(1, i)) for i in range(1, 4)]
+        txs = [tx(rq=[self.rq("k1", "k3", reads, exhausted=False)])]
+        codes, _, _ = run(db, txs)
+        assert codes == [V]
+        # A write to k3 by a prior tx now matters.
+        txs = [
+            tx(writes=[KVWrite("k3", value=b"!")]),
+            tx(rq=[self.rq("k1", "k3", reads, exhausted=False)]),
+        ]
+        codes, _, _ = run(db, txs)
+        assert codes == [V, PHANTOM]
+
+
+class TestHashedReads:
+    def test_hashed_read_conflicts(self):
+        db = VersionedDB()
+        from fabric_tpu.ledger.statedb import HashedUpdateBatch
+
+        pre = HashedUpdateBatch()
+        pre.put("cc1", "collA", b"\x01" * 32, b"\xaa" * 32, Version(1, 0))
+        db.apply_updates(UpdateBatch(), pre)
+
+        ok_read = KVReadHash(b"\x01" * 32, Version(1, 0))
+        stale_read = KVReadHash(b"\x01" * 32, Version(0, 0))
+        txs = [
+            tx(coll=[CollHashedRwSet("collA", (ok_read,))]),
+            tx(coll=[CollHashedRwSet("collA", (stale_read,))]),
+            # writes the hash, then a later tx reads it -> in-block conflict
+            tx(coll=[CollHashedRwSet("collA", (), (KVWriteHash(b"\x01" * 32, value_hash=b"\xbb" * 32),))]),
+            tx(coll=[CollHashedRwSet("collA", (ok_read,))]),
+        ]
+        codes, _, hashed = run(db, txs)
+        assert codes == [V, MVCC, V, MVCC]
+        assert len(hashed) == 1

@@ -1,0 +1,164 @@
+"""Oracle tests: fabric_tpu.crypto.p256 vs the `cryptography` package."""
+
+import hashlib
+import secrets
+
+import pytest
+from cryptography.exceptions import InvalidSignature
+from cryptography.hazmat.primitives import hashes
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.hazmat.primitives.asymmetric.utils import (
+    Prehashed,
+    decode_dss_signature,
+    encode_dss_signature,
+)
+
+from fabric_tpu.crypto import der, p256
+from fabric_tpu.crypto.bccsp import SoftwareProvider, VerifyError
+
+
+def _cryptography_verify(pub, digest, r, s) -> bool:
+    key = ec.EllipticCurvePublicNumbers(pub[0], pub[1], ec.SECP256R1()).public_key()
+    try:
+        key.verify(
+            encode_dss_signature(r, s), digest, ec.ECDSA(Prehashed(hashes.SHA256()))
+        )
+        return True
+    except InvalidSignature:
+        return False
+
+
+def test_generator_on_curve():
+    assert p256.is_on_curve(p256.GENERATOR)
+    assert p256.scalar_mult(p256.N, p256.GENERATOR) is None
+
+
+def test_sign_verify_roundtrip():
+    kp = p256.generate_keypair()
+    digest = hashlib.sha256(b"hello fabric").digest()
+    r, s = p256.sign_digest(kp.priv, digest)
+    assert p256.is_low_s(s)
+    assert p256.verify_digest(kp.pub, digest, r, s)
+    assert not p256.verify_digest(kp.pub, digest, r, (s + 1) % p256.N)
+    assert not p256.verify_digest(kp.pub, hashlib.sha256(b"x").digest(), r, s)
+
+
+def test_verify_matches_cryptography_library():
+    for _ in range(8):
+        kp = p256.generate_keypair()
+        digest = hashlib.sha256(secrets.token_bytes(32)).digest()
+        r, s = p256.sign_digest(kp.priv, digest, low_s=False)
+        assert p256.verify_digest(kp.pub, digest, r, s)
+        assert _cryptography_verify(kp.pub, digest, r, s)
+        # Corrupt cases agree too.
+        bad = (r, (s * 2) % p256.N)
+        assert p256.verify_digest(kp.pub, digest, *bad) == _cryptography_verify(
+            kp.pub, digest, *bad
+        )
+
+
+def test_cryptography_signature_verifies_in_oracle():
+    key = ec.generate_private_key(ec.SECP256R1())
+    msg = b"signed by the cryptography package"
+    sig = key.sign(msg, ec.ECDSA(hashes.SHA256()))
+    r, s = decode_dss_signature(sig)
+    pub_nums = key.public_key().public_numbers()
+    pub = (pub_nums.x, pub_nums.y)
+    assert p256.verify_digest(pub, hashlib.sha256(msg).digest(), r, s)
+
+
+def test_edge_scalars():
+    kp = p256.generate_keypair()
+    digest = hashlib.sha256(b"edge").digest()
+    assert not p256.verify_digest(kp.pub, digest, 0, 1)
+    assert not p256.verify_digest(kp.pub, digest, 1, 0)
+    assert not p256.verify_digest(kp.pub, digest, p256.N, 1)
+    assert not p256.verify_digest(kp.pub, digest, 1, p256.N)
+
+
+class TestDer:
+    def test_roundtrip(self):
+        for r, s in [(1, 1), (p256.N - 1, p256.HALF_N), (2**255, 127), (128, 255)]:
+            raw = der.marshal_signature(r, s)
+            assert der.unmarshal_signature(raw) == (r, s)
+
+    def test_matches_cryptography_encoding(self):
+        for _ in range(4):
+            r = secrets.randbelow(p256.N - 1) + 1
+            s = secrets.randbelow(p256.N - 1) + 1
+            assert der.marshal_signature(r, s) == encode_dss_signature(r, s)
+
+    def test_rejects_zero_and_negative(self):
+        # R = 0
+        with pytest.raises(der.DerError):
+            der.unmarshal_signature(bytes.fromhex("3006020100020101"))
+        # R = -1 (0xFF single byte)
+        with pytest.raises(der.DerError):
+            der.unmarshal_signature(bytes.fromhex("30060201FF020101"))
+
+    def test_rejects_non_minimal_integer(self):
+        # R = 1 encoded as 00 01
+        with pytest.raises(der.DerError):
+            der.unmarshal_signature(bytes.fromhex("3007020200010201 01".replace(" ", "")))
+
+    def test_rejects_non_minimal_length(self):
+        # SEQUENCE length 6 encoded in long form 0x81 0x06
+        with pytest.raises(der.DerError):
+            der.unmarshal_signature(bytes.fromhex("308106020101020101"))
+
+    def test_rejects_indefinite_length(self):
+        with pytest.raises(der.DerError):
+            der.unmarshal_signature(bytes.fromhex("3080020101020101 0000".replace(" ", "")))
+
+    def test_trailing_bytes_after_sequence_tolerated(self):
+        raw = der.marshal_signature(5, 7) + b"\xde\xad"
+        assert der.unmarshal_signature(raw) == (5, 7)
+
+    def test_extra_bytes_inside_sequence_tolerated(self):
+        # Go allows extra members at the end of a SEQUENCE.
+        body = b"\x02\x01\x05" + b"\x02\x01\x07" + b"\x01\x01\x00"
+        raw = b"\x30" + bytes([len(body)]) + body
+        assert der.unmarshal_signature(raw) == (5, 7)
+
+    def test_truncated(self):
+        raw = der.marshal_signature(5, 7)
+        with pytest.raises(der.DerError):
+            der.unmarshal_signature(raw[:-1])
+
+
+class TestSoftwareProvider:
+    def test_verify_semantics(self):
+        prov = SoftwareProvider()
+        key = prov.key_gen()
+        digest = prov.hash(b"payload bytes")
+        sig = prov.sign(key, digest)
+        assert prov.verify(key.public, sig, digest)
+
+        # High-S rejection is an *error*, like the reference.
+        r, s = der.unmarshal_signature(sig)
+        high = der.marshal_signature(r, p256.N - s)
+        with pytest.raises(VerifyError):
+            prov.verify(key.public, high, digest)
+
+        # Malformed DER is an error.
+        with pytest.raises(VerifyError):
+            prov.verify(key.public, b"\x30\x00", digest)
+
+        # Wrong digest is a clean False.
+        assert not prov.verify(key.public, sig, prov.hash(b"other"))
+
+    def test_batch_verify_mask(self):
+        prov = SoftwareProvider()
+        keys, sigs, digests, expect = [], [], [], []
+        key = prov.key_gen()
+        for i in range(16):
+            digest = prov.hash(f"msg {i}".encode())
+            sig = prov.sign(key, digest)
+            ok = i % 3 != 0
+            if not ok:
+                digest = prov.hash(f"tampered {i}".encode())
+            keys.append(key.public)
+            sigs.append(sig)
+            digests.append(digest)
+            expect.append(ok)
+        assert prov.batch_verify(keys, sigs, digests) == expect

@@ -1,0 +1,136 @@
+"""cauthdsl semantics tests: DSL parse, greedy oracle, batched parity."""
+
+import itertools
+import random
+
+import numpy as np
+
+from fabric_tpu.policy import (
+    NOutOf,
+    Role,
+    SignaturePolicyEnvelope,
+    SignedBy,
+    compile_batched,
+    evaluate_host,
+    from_dsl,
+)
+
+
+class TestDsl:
+    def test_and(self):
+        env = from_dsl("AND('Org1.member','Org2.member')")
+        assert env.rule == NOutOf(2, [SignedBy(0), SignedBy(1)])
+        assert [p.msp_id for p in env.identities] == ["Org1", "Org2"]
+        assert env.identities[0].role is Role.MEMBER
+
+    def test_or_nested_outof(self):
+        env = from_dsl("OutOf(2, 'A.admin', OR('B.member','C.peer'), 'A.admin')")
+        rule = env.rule
+        assert isinstance(rule, NOutOf) and rule.n == 2 and len(rule.rules) == 3
+        # duplicate principal terms share one identities slot
+        assert rule.rules[0] == rule.rules[2] == SignedBy(0)
+        assert len(env.identities) == 3
+
+
+def _2of3():
+    return from_dsl("OutOf(2,'A.member','B.member','C.member')")
+
+
+class TestGreedySemantics:
+    def test_2of3(self):
+        env = _2of3()
+        sat = np.array([[1, 0, 0], [0, 1, 0]], dtype=bool)
+        assert evaluate_host(env, sat)
+        sat = np.array([[1, 0, 0]], dtype=bool)
+        assert not evaluate_host(env, sat)
+
+    def test_identity_not_reusable_within_branch(self):
+        # AND(A.member, A.member) needs TWO distinct signers even though one
+        # signer satisfies the principal twice.
+        env = from_dsl("AND('A.member','A.member')")
+        one = np.array([[1]], dtype=bool)
+        two = np.array([[1], [1]], dtype=bool)
+        assert not evaluate_host(env, one)
+        assert evaluate_host(env, two)
+
+    def test_greedy_ordering_can_fail(self):
+        # Classic greedy artifact: signer0 satisfies BOTH principals,
+        # signer1 satisfies only P0. AND(P0, P1) with signer order
+        # [s0, s1]: s0 is consumed by the P0 leaf, then the P1 leaf has
+        # only s1 left, which does not match -> the whole policy FAILS
+        # even though assignment (s1->P0, s0->P1) exists. The reference
+        # behaves this way; we must too.
+        env = from_dsl("AND('A.member','B.member')")
+        sat = np.array([[1, 1], [1, 0]], dtype=bool)
+        assert not evaluate_host(env, sat)
+        # Swapped signer order succeeds.
+        assert evaluate_host(env, sat[::-1].copy())
+
+    def test_failed_branch_does_not_consume(self):
+        # OutOf(1, AND(A,B), A): the failing AND child must not leave the
+        # A-signer marked used (scratch-copy semantics).
+        env = from_dsl("OutOf(1, AND('A.member','B.member'), 'A.member')")
+        sat = np.array([[1, 0]], dtype=bool)  # one signer, satisfies A only
+        assert evaluate_host(env, sat)
+
+    def test_all_children_evaluated_no_short_circuit(self):
+        # NOutOf evaluates EVERY child (no short-circuit), and every
+        # SUCCEEDING child commits its signer consumption. So an OR whose
+        # two branches match two different signers consumes BOTH signers.
+        env = from_dsl(
+            "AND( OR('A.member','B.member'), 'B.member' )"
+        )
+        # signer0: A only; signer1: B only. The OR succeeds via both
+        # branches and consumes both signers; the outer B leaf starves.
+        sat = np.array([[1, 0], [0, 1]], dtype=bool)
+        assert not evaluate_host(env, sat)
+        # single signer satisfying both: OR consumes it via the A branch
+        # only (B branch finds it used), but the outer B leaf still starves.
+        sat = np.array([[1, 1]], dtype=bool)
+        assert not evaluate_host(env, sat)
+        # a third signer un-starves the outer leaf.
+        sat = np.array([[1, 0], [0, 1], [0, 1]], dtype=bool)
+        assert evaluate_host(env, sat)
+
+
+def random_policy(rng, num_principals, depth=0):
+    if depth >= 2 or rng.random() < 0.4:
+        return SignedBy(rng.randrange(num_principals))
+    k = rng.randint(1, 3)
+    rules = [random_policy(rng, num_principals, depth + 1) for _ in range(k)]
+    return NOutOf(rng.randint(1, k), rules)
+
+
+class TestBatchedParity:
+    def test_exhaustive_small(self):
+        """Every sat matrix for 2 signers x 2 principals, several policies."""
+        policies = [
+            from_dsl("AND('A.member','B.member')"),
+            from_dsl("OR('A.member','B.member')"),
+            from_dsl("AND('A.member','A.member')"),
+            from_dsl("OutOf(1, AND('A.member','B.member'), 'B.member')"),
+            from_dsl("OutOf(2, 'A.member', 'B.member', 'A.member')"),
+        ]
+        for env in policies:
+            num_p = len(env.identities)
+            mats = []
+            for bits in itertools.product([0, 1], repeat=2 * num_p):
+                mats.append(np.array(bits, dtype=bool).reshape(2, num_p))
+            batch = np.stack(mats)
+            fn = compile_batched(env, num_signers=2)
+            got = np.asarray(fn(batch))
+            want = np.array([evaluate_host(env, m) for m in mats])
+            assert (got == want).all(), env
+
+    def test_randomized(self):
+        rng = random.Random(1234)
+        for trial in range(25):
+            num_p = rng.randint(1, 4)
+            num_s = rng.randint(1, 4)
+            ids = [object()] * num_p  # placeholder principals
+            env = SignaturePolicyEnvelope(random_policy(rng, num_p), ids)
+            batch = np.random.default_rng(trial).random((16, num_s, num_p)) < 0.45
+            fn = compile_batched(env, num_signers=num_s)
+            got = np.asarray(fn(batch))
+            want = np.array([evaluate_host(env, m) for m in batch])
+            assert (got == want).all(), (trial, env.rule)
