@@ -1,0 +1,16 @@
+"""Endorsement-side transaction construction (reference core/endorser +
+protoutil/txutils.go CreateSignedTx)."""
+
+from fabric_tpu.endorser.txbuilder import (
+    ProposalBundle,
+    create_proposal,
+    create_signed_tx,
+    endorse_proposal,
+)
+
+__all__ = [
+    "ProposalBundle",
+    "create_proposal",
+    "create_signed_tx",
+    "endorse_proposal",
+]

@@ -1,0 +1,152 @@
+"""Proposal/transaction assembly (reference protoutil/txutils.go:
+CreateChaincodeProposal, CreateProposalResponse/GetProposalHash1,
+CreateSignedTx; and the endorsement-plugin signature of
+plugin_endorser.go)."""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence
+
+from fabric_tpu.msp.signer import SigningIdentity
+from fabric_tpu.protos import common_pb2, peer_pb2, protoutil
+
+
+@dataclass
+class ProposalBundle:
+    """A proposal plus the pieces later steps need."""
+
+    channel_id: str
+    tx_id: str
+    channel_header: bytes
+    signature_header: bytes
+    cc_proposal_payload: bytes  # WITH transient fields (endorser input)
+    cc_proposal_payload_tx: bytes  # sanitized: no transient map (goes in tx)
+    chaincode_name: str
+
+
+def create_proposal(
+    signer: SigningIdentity,
+    channel_id: str,
+    chaincode_name: str,
+    args: Sequence[bytes],
+    transient: Optional[Dict[str, bytes]] = None,
+) -> ProposalBundle:
+    nonce = signer.new_nonce()
+    creator = signer.serialize()
+    tx_id = protoutil.compute_tx_id(nonce, creator)
+
+    ext = peer_pb2.ChaincodeHeaderExtension()
+    ext.chaincode_id.name = chaincode_name
+    chdr = protoutil.make_channel_header(
+        common_pb2.ENDORSER_TRANSACTION,
+        channel_id,
+        tx_id=tx_id,
+        extension=ext.SerializeToString(),
+    )
+    shdr = protoutil.make_signature_header(creator, nonce)
+
+    cis = peer_pb2.ChaincodeInvocationSpec()
+    cis.chaincode_spec.type = peer_pb2.ChaincodeSpec.GOLANG
+    cis.chaincode_spec.chaincode_id.name = chaincode_name
+    cis.chaincode_spec.input.args.extend(args)
+
+    ccpp = peer_pb2.ChaincodeProposalPayload()
+    ccpp.input = cis.SerializeToString()
+    for k, v in (transient or {}).items():
+        ccpp.TransientMap[k] = v
+    ccpp_tx = peer_pb2.ChaincodeProposalPayload()
+    ccpp_tx.input = ccpp.input  # sanitized copy (GetBytesProposalPayloadForTx)
+
+    return ProposalBundle(
+        channel_id=channel_id,
+        tx_id=tx_id,
+        channel_header=chdr.SerializeToString(),
+        signature_header=shdr.SerializeToString(),
+        cc_proposal_payload=ccpp.SerializeToString(),
+        cc_proposal_payload_tx=ccpp_tx.SerializeToString(),
+        chaincode_name=chaincode_name,
+    )
+
+
+def proposal_hash(bundle: ProposalBundle) -> bytes:
+    """GetProposalHash1: sha256 over channel header || signature header ||
+    sanitized chaincode proposal payload."""
+    h = hashlib.sha256()
+    h.update(bundle.channel_header)
+    h.update(bundle.signature_header)
+    h.update(bundle.cc_proposal_payload_tx)
+    return h.digest()
+
+
+def endorse_proposal(
+    bundle: ProposalBundle,
+    endorser: SigningIdentity,
+    results: bytes,
+    response_payload: bytes = b"",
+    events: bytes = b"",
+) -> peer_pb2.ProposalResponse:
+    """Simulate-free endorsement: wrap the given simulation `results`
+    (serialized TxReadWriteSet) and sign prp || endorser identity
+    (reference CreateProposalResponse + plugin_endorser)."""
+    action = peer_pb2.ChaincodeAction()
+    action.results = results
+    action.events = events
+    action.response.status = 200
+    action.response.payload = response_payload
+    action.chaincode_id.name = bundle.chaincode_name
+
+    prp = peer_pb2.ProposalResponsePayload()
+    prp.proposal_hash = proposal_hash(bundle)
+    prp.extension = action.SerializeToString()
+    prp_bytes = prp.SerializeToString()
+
+    endorser_bytes = endorser.serialize()
+    out = peer_pb2.ProposalResponse()
+    out.version = 1
+    out.response.status = 200
+    out.payload = prp_bytes
+    out.endorsement.endorser = endorser_bytes
+    out.endorsement.signature = endorser.sign(prp_bytes + endorser_bytes)
+    return out
+
+
+def create_signed_tx(
+    bundle: ProposalBundle,
+    signer: SigningIdentity,
+    responses: Sequence[peer_pb2.ProposalResponse],
+) -> common_pb2.Envelope:
+    """Assemble the final envelope (protoutil.CreateSignedTx): all
+    endorsements must agree on the proposal response payload."""
+    if not responses:
+        raise ValueError("at least one proposal response is required")
+    payload_bytes = responses[0].payload
+    for r in responses[1:]:
+        if r.payload != payload_bytes:
+            raise ValueError("ProposalResponsePayloads do not match")
+
+    cap = peer_pb2.ChaincodeActionPayload()
+    cap.chaincode_proposal_payload = bundle.cc_proposal_payload_tx
+    cap.action.proposal_response_payload = payload_bytes
+    for r in responses:
+        e = cap.action.endorsements.add()
+        e.endorser = r.endorsement.endorser
+        e.signature = r.endorsement.signature
+
+    taa = peer_pb2.TransactionAction()
+    taa.header = bundle.signature_header
+    taa.payload = cap.SerializeToString()
+    tx = peer_pb2.Transaction()
+    tx.actions.append(taa)
+
+    payload = common_pb2.Payload()
+    payload.header.channel_header = bundle.channel_header
+    payload.header.signature_header = bundle.signature_header
+    payload.data = tx.SerializeToString()
+    payload_ser = payload.SerializeToString()
+
+    env = common_pb2.Envelope()
+    env.payload = payload_ser
+    env.signature = signer.sign(payload_ser)
+    return env

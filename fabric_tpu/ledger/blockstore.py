@@ -1,0 +1,165 @@
+"""Append-only block store with indexes (reference common/ledger/blkstorage).
+
+Format: one file per channel of varint-length-prefixed serialized Block
+protos (the reference's blockfile format, blockfile_mgr.go). Indexes
+(number -> offset, hash -> number, txid -> (number, txNum)) are rebuilt by
+scanning on open — the block file is the source of truth, everything else
+is a derived cache (the reference's crash-consistency model, SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from fabric_tpu.protos import common_pb2, protoutil
+
+
+def _write_varint(f, n: int) -> None:
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            f.write(bytes([b | 0x80]))
+        else:
+            f.write(bytes([b]))
+            return
+
+
+def _read_varint(f) -> Optional[int]:
+    shift = 0
+    out = 0
+    while True:
+        c = f.read(1)
+        if not c:
+            return None if shift == 0 else _raise_trunc()
+        b = c[0]
+        out |= (b & 0x7F) << shift
+        if not (b & 0x80):
+            return out
+        shift += 7
+        if shift > 63:
+            raise ValueError("varint too long")
+
+
+def _raise_trunc():
+    raise ValueError("truncated block file")
+
+
+def extract_tx_ids(block: common_pb2.Block) -> List[str]:
+    """Best-effort TxID extraction per tx (empty string when unparsable)."""
+    out = []
+    for data in block.data.data:
+        txid = ""
+        try:
+            env = protoutil.unmarshal(common_pb2.Envelope, data)
+            payload = protoutil.unmarshal(common_pb2.Payload, env.payload)
+            chdr = protoutil.unmarshal(
+                common_pb2.ChannelHeader, payload.header.channel_header
+            )
+            txid = chdr.tx_id
+        except ValueError:
+            pass
+        out.append(txid)
+    return out
+
+
+class BlockStore:
+    """One channel's chain on disk."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._offsets: List[int] = []  # block number -> file offset
+        self._by_hash: Dict[bytes, int] = {}
+        self._by_txid: Dict[str, Tuple[int, int]] = {}
+        self._last_hash = b""
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._rebuild_index()
+        self._f = open(self.path, "ab")
+
+    # -- index ------------------------------------------------------------
+    def _rebuild_index(self) -> None:
+        if not os.path.exists(self.path):
+            return
+        with open(self.path, "rb") as f:
+            valid_end = 0
+            while True:
+                off = f.tell()
+                try:
+                    ln = _read_varint(f)
+                    if ln is None:
+                        break
+                    raw = f.read(ln)
+                    if len(raw) != ln:
+                        break  # partial tail write -> truncate
+                    block = protoutil.unmarshal(common_pb2.Block, raw)
+                except ValueError:
+                    break
+                self._index_block(block, off)
+                valid_end = f.tell()
+        size = os.path.getsize(self.path)
+        if size != valid_end:
+            # crash recovery: drop the partial tail (blockfile_helper.go)
+            with open(self.path, "ab") as f:
+                f.truncate(valid_end)
+
+    def _index_block(self, block: common_pb2.Block, offset: int) -> None:
+        num = block.header.number
+        assert num == len(self._offsets), f"out-of-order block {num}"
+        self._offsets.append(offset)
+        h = protoutil.block_header_hash(block.header)
+        self._by_hash[h] = num
+        self._last_hash = h
+        for tx_num, txid in enumerate(extract_tx_ids(block)):
+            if txid and txid not in self._by_txid:
+                self._by_txid[txid] = (num, tx_num)
+
+    # -- writes -----------------------------------------------------------
+    def add_block(self, block: common_pb2.Block) -> None:
+        if block.header.number != self.height:
+            raise ValueError(
+                f"block number should be {self.height} but is {block.header.number}"
+            )
+        if self.height > 0 and block.header.previous_hash != self._last_hash:
+            raise ValueError("unexpected previous-block hash")
+        off = self._f.tell()
+        raw = block.SerializeToString()
+        _write_varint(self._f, len(raw))
+        self._f.write(raw)
+        self._f.flush()
+        os.fsync(self._f.fileno())
+        self._index_block(block, off)
+
+    # -- reads ------------------------------------------------------------
+    @property
+    def height(self) -> int:
+        return len(self._offsets)
+
+    @property
+    def last_block_hash(self) -> bytes:
+        return self._last_hash
+
+    def get_block_by_number(self, number: int) -> Optional[common_pb2.Block]:
+        if number >= len(self._offsets):
+            return None
+        with open(self.path, "rb") as f:
+            f.seek(self._offsets[number])
+            ln = _read_varint(f)
+            return protoutil.unmarshal(common_pb2.Block, f.read(ln))
+
+    def get_block_by_hash(self, block_hash: bytes) -> Optional[common_pb2.Block]:
+        num = self._by_hash.get(block_hash)
+        return None if num is None else self.get_block_by_number(num)
+
+    def get_tx_loc(self, txid: str) -> Optional[Tuple[int, int]]:
+        return self._by_txid.get(txid)
+
+    def tx_exists(self, txid: str) -> bool:
+        return txid in self._by_txid
+
+    def iter_blocks(self, start: int = 0) -> Iterator[common_pb2.Block]:
+        for n in range(start, self.height):
+            yield self.get_block_by_number(n)
+
+    def close(self) -> None:
+        self._f.close()

@@ -1,0 +1,222 @@
+"""Per-channel ledger: commit orchestration (reference
+core/ledger/kvledger/kv_ledger.go:596-680 + lockbased_txmgr.go).
+
+Commit path per block:
+1. MVCC validate-and-prepare against committed state + in-block writes
+   (updates TRANSACTIONS_FILTER for MVCC/phantom conflicts);
+2. commit-hash chaining: commitHash = SHA-256(varint(len(filter)) ||
+   filter || deterministic-update-bytes || previousCommitHash), stored in
+   block metadata COMMIT_HASH (kv_ledger.go:758-770) — byte-exact with
+   the reference, including the txmgr Updates/KVWrite proto and
+   order-preserving version encoding;
+3. block appended to the block store;
+4. state DB apply; history DB entries.
+
+State and history are derived caches: on open, any blocks present in the
+store but missing from state are replayed (recoverDBs analog).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from fabric_tpu.ledger.blockstore import BlockStore
+from fabric_tpu.ledger.mvcc import Validator
+from fabric_tpu.ledger.rwset import TxRwSet, Version
+from fabric_tpu.ledger.statedb import HashedUpdateBatch, UpdateBatch, VersionedDB
+from fabric_tpu.protos import common_pb2, protoutil, txmgr_updates_pb2
+from fabric_tpu.validation.msgvalidation import parse_transaction
+from fabric_tpu.validation.txflags import TxValidationCode, ValidationFlags
+
+
+def encode_order_preserving_varuint64(n: int) -> bytes:
+    """reference common/ledger/util EncodeOrderPreservingVarUint64:
+    [num-significant-bytes][big-endian significant bytes]."""
+    be = n.to_bytes(8, "big")
+    stripped = be.lstrip(b"\x00")
+    return bytes([len(stripped)]) + stripped
+
+
+def version_to_bytes(v: Version) -> bytes:
+    return encode_order_preserving_varuint64(
+        v.block_num
+    ) + encode_order_preserving_varuint64(v.tx_num)
+
+
+def _proto_varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def deterministic_update_bytes(
+    updates: UpdateBatch, hashed: HashedUpdateBatch
+) -> bytes:
+    """txmgr deterministicBytesForPubAndHashUpdates: namespaces sorted,
+    public writes then collections (sorted), keys sorted; namespace/
+    collection fields set only on the first entry of each group; the empty
+    namespace (channel config) is skipped."""
+    pub_by_ns: Dict[str, Dict[str, Tuple[Optional[bytes], Version]]] = {}
+    for (ns, key), (value, version) in updates.items():
+        pub_by_ns.setdefault(ns, {})[key] = (value, version)
+    hashed_by_ns: Dict[str, Dict[str, Dict[bytes, Tuple[Optional[bytes], Version]]]] = {}
+    for (ns, coll, key_hash), (vh, version) in hashed.items():
+        hashed_by_ns.setdefault(ns, {}).setdefault(coll, {})[key_hash] = (vh, version)
+
+    msg = txmgr_updates_pb2.Updates()
+    for ns in sorted(set(pub_by_ns) | set(hashed_by_ns)):
+        if ns == "":
+            continue
+        first_in_ns = True
+
+        def add(key: bytes, value: Optional[bytes], version: Version, coll: str = ""):
+            nonlocal first_in_ns
+            kv = msg.kvwrites.add()
+            if first_in_ns:
+                kv.namespace = ns.encode()
+                first_in_ns = False
+            if coll:
+                kv.collection = coll.encode()
+            kv.key = key
+            kv.isDelete = value is None
+            if value is not None:
+                kv.value = value
+            kv.version_bytes = version_to_bytes(version)
+
+        for i, key in enumerate(sorted(pub_by_ns.get(ns, {}))):
+            value, version = pub_by_ns[ns][key]
+            add(key.encode(), value, version)
+        for coll in sorted(hashed_by_ns.get(ns, {})):
+            first_in_coll = True
+            for key_hash in sorted(hashed_by_ns[ns][coll]):
+                vh, version = hashed_by_ns[ns][coll][key_hash]
+                kv = msg.kvwrites.add()
+                if first_in_ns:
+                    kv.namespace = ns.encode()
+                    first_in_ns = False
+                if first_in_coll:
+                    kv.collection = coll.encode()
+                    first_in_coll = False
+                kv.key = key_hash
+                kv.isDelete = vh is None
+                if vh is not None:
+                    kv.value = vh
+                kv.version_bytes = version_to_bytes(version)
+    return msg.SerializeToString()
+
+
+class KVLedger:
+    """One channel's ledger (block store + state + history)."""
+
+    def __init__(self, ledger_dir: str, channel_id: str):
+        self.channel_id = channel_id
+        self.block_store = BlockStore(os.path.join(ledger_dir, f"{channel_id}.chain"))
+        self.state_db = VersionedDB()
+        self.history: Dict[Tuple[str, str], List[Version]] = {}
+        self.commit_hash = b""
+        self._recover()
+
+    # -- recovery: replay the block store into derived state ---------------
+    def _recover(self) -> None:
+        for block in self.block_store.iter_blocks():
+            self._apply_committed_block(block)
+
+    def _apply_committed_block(self, block: common_pb2.Block) -> None:
+        flags, rwsets = self._extract(block)
+        codes = [
+            TxValidationCode.VALID
+            if flags.is_valid(i)
+            else TxValidationCode(int(flags.asarray()[i]))
+            for i in range(len(flags))
+        ]
+        validator = Validator(self.state_db)
+        # On replay the stored filter already includes MVCC verdicts; apply
+        # writes of the VALID txs without re-deciding.
+        updates = UpdateBatch()
+        hashed = HashedUpdateBatch()
+        for tx_num, (rwset, code) in enumerate(zip(rwsets, codes)):
+            if code == TxValidationCode.VALID and rwset is not None:
+                validator._apply_write_set(
+                    rwset, Version(block.header.number, tx_num), updates, hashed
+                )
+        self._commit_state(block, updates, hashed)
+
+    def _extract(
+        self, block: common_pb2.Block
+    ) -> Tuple[ValidationFlags, List[Optional[TxRwSet]]]:
+        raw = bytes(block.metadata.metadata[common_pb2.TRANSACTIONS_FILTER])
+        flags = (
+            ValidationFlags.from_bytes(raw)
+            if raw
+            else ValidationFlags(len(block.data.data), TxValidationCode.VALID)
+        )
+        rwsets: List[Optional[TxRwSet]] = []
+        for i, data in enumerate(block.data.data):
+            parsed = parse_transaction(i, data)
+            rwsets.append(parsed.rwset)
+        return flags, rwsets
+
+    # -- the commit path ---------------------------------------------------
+    def commit(self, block: common_pb2.Block) -> ValidationFlags:
+        """ValidateAndPrepare + commit (kv_ledger.go commit): assumes the
+        block already carries the txvalidator's TRANSACTIONS_FILTER; MVCC
+        verdicts are merged in here and the final filter is what gets
+        stored."""
+        flags, rwsets = self._extract(block)
+        incoming = [TxValidationCode(int(c)) for c in flags.asarray()]
+        validator = Validator(self.state_db)
+        codes, updates, hashed = validator.validate_and_prepare_batch(
+            block.header.number, rwsets, incoming
+        )
+        for i, code in enumerate(codes):
+            flags.set_flag(i, code)
+        protoutil.init_block_metadata(block)
+        block.metadata.metadata[common_pb2.TRANSACTIONS_FILTER] = flags.tobytes()
+
+        # commit hash (kv_ledger.go addBlockCommitHash)
+        update_bytes = deterministic_update_bytes(updates, hashed)
+        filter_bytes = flags.tobytes()
+        value = (
+            _proto_varint(len(filter_bytes))
+            + filter_bytes
+            + update_bytes
+            + self.commit_hash
+        )
+        self.commit_hash = hashlib.sha256(value).digest()
+        meta = common_pb2.Metadata()
+        meta.value = self.commit_hash
+        block.metadata.metadata[common_pb2.COMMIT_HASH] = meta.SerializeToString()
+
+        self.block_store.add_block(block)
+        self._commit_state(block, updates, hashed)
+        return flags
+
+    def _commit_state(
+        self, block: common_pb2.Block, updates: UpdateBatch, hashed: HashedUpdateBatch
+    ) -> None:
+        for (ns, key), (value, version) in updates.items():
+            self.history.setdefault((ns, key), []).append(version)
+        self.state_db.apply_updates(updates, hashed)
+
+    # -- queries (qscc analog) --------------------------------------------
+    @property
+    def height(self) -> int:
+        return self.block_store.height
+
+    def get_state(self, ns: str, key: str) -> Optional[bytes]:
+        vv = self.state_db.get_state(ns, key)
+        return vv.value if vv else None
+
+    def get_history_for_key(self, ns: str, key: str) -> List[Version]:
+        return list(self.history.get((ns, key), []))
+
+    def tx_exists(self, txid: str) -> bool:
+        return self.block_store.tx_exists(txid)

@@ -1,0 +1,64 @@
+"""TxRwSet <-> proto bytes (reference rwsetutil/rwset_proto_util.go)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from fabric_tpu.ledger import rwset as rw
+from fabric_tpu.protos import kv_rwset_pb2, protoutil, rwset_pb2
+
+
+def _set_version(msg, version: Optional[rw.Version]) -> None:
+    if version is not None:
+        msg.version.block_num = version.block_num
+        msg.version.tx_num = version.tx_num
+
+
+def serialize_tx_rwset(txrw: rw.TxRwSet) -> bytes:
+    out = rwset_pb2.TxReadWriteSet()
+    out.data_model = rwset_pb2.TxReadWriteSet.KV
+    for ns in txrw.ns_rw_sets:
+        kv = kv_rwset_pb2.KVRWSet()
+        for r in ns.reads:
+            kr = kv.reads.add()
+            kr.key = r.key
+            _set_version(kr, r.version)
+        for q in ns.range_queries:
+            rq = kv.range_queries_info.add()
+            rq.start_key = q.start_key
+            rq.end_key = q.end_key
+            rq.itr_exhausted = q.itr_exhausted
+            if q.reads_merkle_hashes is not None:
+                rq.reads_merkle_hashes.max_level = q.reads_merkle_hashes[0]
+                rq.reads_merkle_hashes.max_level_hashes.extend(
+                    q.reads_merkle_hashes[1]
+                )
+            else:
+                rq.raw_reads.SetInParent()
+                for r in q.raw_reads:
+                    kr = rq.raw_reads.kv_reads.add()
+                    kr.key = r.key
+                    _set_version(kr, r.version)
+        for w in ns.writes:
+            kw = kv.writes.add()
+            kw.key = w.key
+            kw.is_delete = w.is_delete
+            kw.value = w.value
+        ns_out = out.ns_rwset.add()
+        ns_out.namespace = ns.namespace
+        ns_out.rwset = kv.SerializeToString()
+        for coll in ns.coll_hashed:
+            h = kv_rwset_pb2.HashedRWSet()
+            for hr in coll.hashed_reads:
+                m = h.hashed_reads.add()
+                m.key_hash = hr.key_hash
+                _set_version(m, hr.version)
+            for hw in coll.hashed_writes:
+                m = h.hashed_writes.add()
+                m.key_hash = hw.key_hash
+                m.is_delete = hw.is_delete
+                m.value_hash = hw.value_hash
+            c = ns_out.collection_hashed_rwset.add()
+            c.collection_name = coll.collection_name
+            c.hashed_rwset = h.SerializeToString()
+    return out.SerializeToString()

@@ -1,0 +1,34 @@
+"""Signing identities (reference msp SigningIdentity + signer package)."""
+
+from __future__ import annotations
+
+import secrets
+from typing import Optional
+
+from fabric_tpu.crypto import der, p256
+from fabric_tpu.crypto.bccsp import Provider, default_provider
+from fabric_tpu.msp.cryptogen import NodeIdentity
+from fabric_tpu.protos import protoutil
+
+
+class SigningIdentity:
+    """An identity that can sign: wraps a NodeIdentity's cert + key."""
+
+    def __init__(self, node: NodeIdentity, provider: Optional[Provider] = None):
+        self.node = node
+        self.msp_id = node.msp_id
+        self._provider = provider or default_provider()
+        self._serialized = protoutil.serialize_identity(node.msp_id, node.cert_pem)
+
+    def serialize(self) -> bytes:
+        return self._serialized
+
+    def sign(self, msg: bytes) -> bytes:
+        """SHA-256 digest then low-S ECDSA, DER-encoded (the reference
+        signer path: bccsp Hash + Sign, msp/identities.go Sign)."""
+        digest = self._provider.hash(msg)
+        r, s = p256.sign_digest(self.node.priv_scalar, digest)
+        return der.marshal_signature(r, s)
+
+    def new_nonce(self) -> bytes:
+        return secrets.token_bytes(24)

@@ -6,45 +6,43 @@ compiler-friendly integer math. Design notes:
 
 - **Radix 2^13, 20 limbs** (260 bits for 256-bit fields). 13-bit limbs make
   products fit comfortably in 32 bits (26-bit products), so a full CIOS
-  Montgomery multiplication can run with *lazy carries* entirely in uint32:
+  Montgomery multiplication runs with *lazy carries* entirely in uint32:
   each of the 20 outer iterations adds two <2^27 products per limb, for a
   worst-case accumulator below 20 * 2^27 * (1 + eps) < 2^32.
-- **Limb-major layout `(NLIMBS, *batch)`**: the batch dimension is the
-  trailing (lane) dimension on the TPU VPU, carry chains walk the leading
-  axis via `lax.scan`, and no transposes appear in the inner loop.
+- **Limb-unpacked representation**: inside kernels a big number is a
+  *tuple of 20 arrays*, each shaped (*batch) — plain SSA values. This is
+  the crucial TPU design choice: a stacked (20, B) layout forces
+  dynamic-index/concatenate ops inside the CIOS loop, each of which
+  breaks XLA fusion and round-trips every intermediate through HBM
+  (measured ~5x whole-kernel slowdown). Unpacked limbs give XLA one pure
+  elementwise DAG it can fuse freely; carries become ordinary data
+  dependencies. The batch dimension rides the VPU lanes.
 - **No constant-time requirement**: verification consumes public data
-  (signatures, public keys, digests), so we freely use data-dependent
-  selects — but never data-dependent *shapes* or control flow, keeping
-  everything one fixed XLA program.
+  (signatures, public keys, digests), so data-dependent selects are fine —
+  but never data-dependent *shapes* or control flow; everything is one
+  fixed XLA program.
 
-Values "at rest" are canonical: every limb < 2^13 and the value < modulus
-unless a caller explicitly tracks a laxer bound (see fabric_tpu.ops.
-p256_kernel.FE). Host-side conversions use Python ints (arbitrary
-precision) and numpy.
+Stacked (NLIMBS, *batch) arrays remain the interface at kernel boundaries
+(`split`/`restack` convert). Values are canonical (every limb < 2^13,
+value < modulus) unless a caller tracks a laxer bound (see
+fabric_tpu.ops.p256_kernel.FE).
 """
 
 from __future__ import annotations
 
-from functools import partial
-from typing import Tuple
+from typing import List, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import lax
 
 LIMB_BITS = 13
 NLIMBS = 20
 LIMB_MASK = (1 << LIMB_BITS) - 1
 RADIX_BITS = LIMB_BITS * NLIMBS  # 260
 
-# Fully unroll the 20-iteration CIOS outer loop at trace time. Costs trace
-# size (and thus XLA compile time), removes per-limb loop overhead at run
-# time. Defaults on; tests on the CPU backend export
-# FABRIC_TPU_CIOS_UNROLL=0 where compile time dominates.
-import os as _os
-
-CIOS_UNROLL = _os.environ.get("FABRIC_TPU_CIOS_UNROLL", "1") != "0"
+# A big number inside a kernel: tuple of NLIMBS arrays, each (*batch).
+LimbVec = Tuple[jax.Array, ...]
 
 
 # ---------------------------------------------------------------------------
@@ -89,38 +87,42 @@ def limbs_to_ints(a) -> list:
 
 
 # ---------------------------------------------------------------------------
-# Carry propagation
+# Packing between stacked arrays and unpacked limb tuples
 # ---------------------------------------------------------------------------
 
 
+def split(x: jax.Array) -> LimbVec:
+    """(NLIMBS, *batch) -> tuple of NLIMBS (*batch) arrays."""
+    return tuple(x[i] for i in range(x.shape[0]))
+
+def restack(xs: Sequence[jax.Array]) -> jax.Array:
+    return jnp.stack(tuple(xs), axis=0)
+
+
+# ---------------------------------------------------------------------------
+# Carry propagation (pure data-dependency chains; fusion-friendly)
+# ---------------------------------------------------------------------------
+
+
+def carry_l(xs: Sequence[jax.Array]) -> Tuple[List[jax.Array], jax.Array]:
+    """Carry-propagate a limb list (uint32 or int32; the arithmetic shift
+    on int32 makes negative limbs borrow). Returns (canonical limbs,
+    carry_out)."""
+    out = []
+    c = None
+    for x in xs:
+        t = x if c is None else x + c
+        c = t >> LIMB_BITS
+        out.append(t & LIMB_MASK)
+    return out, c
+
+
 def carry_u32(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
-    """Unsigned carry propagation along axis 0.
-
-    Input limbs may be anything < 2^32 - 2^19 (so limb + incoming carry
-    cannot wrap). Returns (canonical limbs, carry_out).
-    """
-    c0 = jnp.zeros(x.shape[1:], dtype=jnp.uint32)
-
-    def body(c, xi):
-        t = xi + c
-        return t >> LIMB_BITS, t & LIMB_MASK
-
-    c, ys = lax.scan(body, c0, x)
-    return ys, c
+    ys, c = carry_l(split(x))
+    return restack(ys), c
 
 
-def carry_i32(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
-    """Signed carry propagation along axis 0 (arithmetic shift = floor div,
-    so negative limbs borrow correctly). Returns (canonical limbs in
-    [0, 2^13), signed carry_out)."""
-    c0 = jnp.zeros(x.shape[1:], dtype=jnp.int32)
-
-    def body(c, xi):
-        t = xi + c
-        return t >> LIMB_BITS, t & LIMB_MASK
-
-    c, ys = lax.scan(body, c0, x)
-    return ys, c
+carry_i32 = carry_u32  # dtype decides signedness; same chain
 
 
 # ---------------------------------------------------------------------------
@@ -131,8 +133,9 @@ def carry_i32(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
 class MontCtx:
     """Precomputed Montgomery constants for an odd modulus m < 2^256.
 
-    R = 2^260 (one limb-width above 256 bits). All device constants are
-    numpy arrays; they become XLA constants at trace time.
+    R = 2^260 (one limb-width above 256 bits). Per-limb constants are
+    numpy uint32/int32 *scalars* so they enter traces as broadcastable
+    XLA constants.
     """
 
     def __init__(self, modulus: int):
@@ -141,126 +144,208 @@ class MontCtx:
         self.m = modulus
         r = 1 << RADIX_BITS
         self.m_limbs = int_to_limbs(modulus)
-        self.m_limbs_i32 = self.m_limbs.astype(np.int32)
+        self.m_scalars = tuple(np.uint32(v) for v in self.m_limbs)
+        self.m_scalars_i32 = tuple(np.int32(v) for v in self.m_limbs)
         self.r2_limbs = int_to_limbs((r * r) % modulus)
         self.one_mont = int_to_limbs(r % modulus)
         self.one = int_to_limbs(1)
         # m' = -m^-1 mod 2^13 for the REDC quotient digit.
         self.m0inv = np.uint32((-pow(modulus, -1, 1 << LIMB_BITS)) % (1 << LIMB_BITS))
-        # k*m for the borrow-free subtraction path (k in 1..8).
-        self.km_limbs_i32 = {
-            k: int_to_limbs(k * modulus).astype(np.int32) for k in range(1, 9)
+        # k*m as int32 per-limb scalars, for borrow-free subtraction.
+        self.km_scalars_i32 = {
+            k: tuple(np.int32(v) for v in int_to_limbs(k * modulus))
+            for k in range(1, 9)
         }
 
+    def const(self, value_limbs: np.ndarray) -> Tuple[np.uint32, ...]:
+        return tuple(np.uint32(v) for v in value_limbs)
 
-def cond_sub(x: jax.Array, m_limbs_i32: np.ndarray) -> jax.Array:
-    """One conditional subtract: x - m if x >= m else x (values canonical)."""
-    d = x.astype(jnp.int32) - m_limbs_i32.reshape((NLIMBS,) + (1,) * (x.ndim - 1))
-    limbs, c = carry_i32(d)
+
+def cond_sub_l(ctx: MontCtx, xs: Sequence[jax.Array]) -> List[jax.Array]:
+    """One conditional subtract: x - m if x >= m else x (limbs canonical)."""
+    d = [x.astype(jnp.int32) - mj for x, mj in zip(xs, ctx.m_scalars_i32)]
+    limbs, c = carry_l(d)
     keep = c < 0  # borrow out -> x < m
-    return jnp.where(keep, x, limbs.astype(jnp.uint32))
+    return [jnp.where(keep, x, l.astype(jnp.uint32)) for x, l in zip(xs, limbs)]
 
 
-def reduce_canonical(x: jax.Array, ctx: MontCtx, times: int) -> jax.Array:
-    """Reduce a value known to be < (times+1)*m to canonical via repeated
-    conditional subtraction (static count, data-dependent selects only)."""
+def reduce_canonical_l(ctx: MontCtx, xs: Sequence[jax.Array], times: int) -> List[jax.Array]:
+    xs = list(xs)
     for _ in range(times):
-        x = cond_sub(x, ctx.m_limbs_i32)
-    return x
+        xs = cond_sub_l(ctx, xs)
+    return xs
 
 
 # ---------------------------------------------------------------------------
-# Core multiply (CIOS Montgomery with lazy carries)
+# Core multiply (CIOS Montgomery, lazy carries, fully unrolled)
+# ---------------------------------------------------------------------------
+
+
+def mont_mul_l(
+    ctx: MontCtx,
+    a: Sequence[jax.Array],
+    b: Sequence[jax.Array],
+    nreduce: int = 1,
+) -> List[jax.Array]:
+    """Montgomery product a*b*R^-1 mod m on canonical-limb inputs.
+
+    Values may be up to 4m; with inputs <= c1*m, c2*m the pre-reduction
+    output is < m*(1 + c1*c2*m/2^260), so nreduce=1 suffices for
+    c1*c2 <= 16.
+    """
+    m = ctx.m_scalars
+    m0inv = ctx.m0inv
+    zero = jnp.zeros_like(a[0])
+    t: List[jax.Array] = [zero] * NLIMBS
+    for i in range(NLIMBS):
+        ai = a[i]
+        t0 = t[0] + ai * b[0]
+        q = ((t0 & LIMB_MASK) * m0inv) & LIMB_MASK
+        carry0 = (t0 + q * m[0]) >> LIMB_BITS
+        # u_j for j=1..19, shifted down one limb; u_0's low bits vanish.
+        nt = [t[j] + ai * b[j] + q * m[j] for j in range(1, NLIMBS)]
+        nt[0] = nt[0] + carry0
+        nt.append(zero)
+        t = nt
+    limbs, _ = carry_l(t)  # value < 2m for canonical inputs; carry_out 0
+    return reduce_canonical_l(ctx, limbs, nreduce)
+
+
+def add_raw_l(a: Sequence[jax.Array], b: Sequence[jax.Array]) -> List[jax.Array]:
+    """Limb-canonical addition WITHOUT modular reduction (value = a+b)."""
+    limbs, _ = carry_l([x + y for x, y in zip(a, b)])
+    return limbs
+
+
+def sub_mod_l(
+    ctx: MontCtx,
+    a: Sequence[jax.Array],
+    b: Sequence[jax.Array],
+    b_bound: int,
+    nreduce: int,
+) -> List[jax.Array]:
+    """a - b + b_bound*m, carried in int32 (no borrow underflow), reduced
+    with `nreduce` conditional subtracts."""
+    kp = ctx.km_scalars_i32[b_bound]
+    d = [
+        x.astype(jnp.int32) + kpj - y.astype(jnp.int32)
+        for x, y, kpj in zip(a, b, kp)
+    ]
+    limbs, _ = carry_l(d)
+    return reduce_canonical_l(ctx, [l.astype(jnp.uint32) for l in limbs], nreduce)
+
+
+def const_l(limbs: np.ndarray) -> Tuple[np.uint32, ...]:
+    """A compile-time constant as broadcastable per-limb scalars."""
+    return tuple(np.uint32(v) for v in limbs)
+
+
+def bcast_l(limbs: np.ndarray, like: jax.Array) -> List[jax.Array]:
+    """A constant materialized at `like`'s batch shape."""
+    return [jnp.full(like.shape, np.uint32(v), dtype=jnp.uint32) for v in limbs]
+
+
+def to_mont_l(ctx: MontCtx, xs: Sequence[jax.Array], nreduce: int = 1) -> List[jax.Array]:
+    return mont_mul_l(ctx, xs, const_l(ctx.r2_limbs), nreduce=nreduce)
+
+
+def from_mont_l(ctx: MontCtx, xs: Sequence[jax.Array]) -> List[jax.Array]:
+    return mont_mul_l(ctx, xs, const_l(ctx.one))
+
+
+def mont_pow_l(ctx: MontCtx, xs: Sequence[jax.Array], exponent: int) -> List[jax.Array]:
+    """x^exponent in the Montgomery domain.
+
+    Branch-free fixed-window form: scan over static 2-bit exponent digits
+    (MSB-first); each step squares twice and multiplies by a selected
+    entry of {1, x, x^2, x^3}. 384 multiplies for a 256-bit exponent —
+    the same count as optimal square-and-multiply — while keeping the
+    traced graph small (the scan body traces once).
+    """
+    from jax import lax
+
+    nbits = exponent.bit_length()
+    ndigits = (nbits + 1) // 2
+    digits = np.array(
+        [(exponent >> (2 * (ndigits - 1 - i))) & 3 for i in range(ndigits)],
+        dtype=np.int32,
+    )
+    x1 = list(xs)
+    x2 = mont_mul_l(ctx, x1, x1)
+    x3 = mont_mul_l(ctx, x2, x1)
+    one = const_l(ctx.one_mont)
+    # table[d][j]: limb j of the digit-d multiplier, materialized (4, B)
+    table = [jnp.stack([jnp.broadcast_to(one[j], x1[j].shape), x1[j], x2[j], x3[j]])
+             for j in range(NLIMBS)]
+    acc0 = [jnp.broadcast_to(jnp.asarray(one[j]), x1[j].shape) for j in range(NLIMBS)]
+
+    def body(acc, d):
+        acc = list(acc)
+        acc = mont_mul_l(ctx, acc, acc)
+        acc = mont_mul_l(ctx, acc, acc)
+        mult = [t[d] for t in table]
+        return tuple(mont_mul_l(ctx, acc, mult)), None
+
+    acc, _ = lax.scan(body, tuple(acc0), jnp.asarray(digits))
+    return list(acc)
+
+
+def eq_l(a: Sequence[jax.Array], b: Sequence[jax.Array]) -> jax.Array:
+    out = None
+    for x, y in zip(a, b):
+        e = x == y
+        out = e if out is None else (out & e)
+    return out
+
+
+def is_zero_l(a: Sequence[jax.Array]) -> jax.Array:
+    out = None
+    for x in a:
+        e = x == 0
+        out = e if out is None else (out & e)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Stacked-array wrappers (interface / test convenience)
 # ---------------------------------------------------------------------------
 
 
 def mont_mul(ctx: MontCtx, a: jax.Array, b: jax.Array, nreduce: int = 1) -> jax.Array:
-    """Montgomery product a*b*R^-1 mod m on canonical-limb inputs.
-
-    Inputs may have value up to 4m (limbs canonical); with inputs <= c1*m,
-    c2*m the pre-reduction output is < m*(1 + c1*c2*m/2^260), so nreduce=1
-    suffices for c1*c2 <= 16. Shapes: (NLIMBS, *batch) uint32.
-    """
-    batch_shape = a.shape[1:]
-    m = jnp.asarray(ctx.m_limbs).reshape((NLIMBS,) + (1,) * len(batch_shape))
-    m0inv = jnp.uint32(ctx.m0inv)
-    t0 = jnp.zeros((NLIMBS,) + batch_shape, dtype=jnp.uint32)
-
-    def body(i, t):
-        ai = lax.dynamic_index_in_dim(a, i, axis=0, keepdims=True)  # (1, *batch)
-        u = t + ai * b + (((t[0] + ai[0] * b[0]) & LIMB_MASK) * m0inv & LIMB_MASK) * m
-        # u[0] is divisible by 2^13 by construction; shift down one limb.
-        carry0 = u[0] >> LIMB_BITS
-        shifted = jnp.concatenate(
-            [
-                (u[1] + carry0)[None],
-                u[2:],
-                jnp.zeros((1,) + batch_shape, dtype=jnp.uint32),
-            ],
-            axis=0,
-        )
-        return shifted
-
-    t = lax.fori_loop(0, NLIMBS, body, t0, unroll=CIOS_UNROLL)
-    limbs, c = carry_u32(t)
-    del c  # value < 2m for canonical inputs; carry-out is provably zero
-    return reduce_canonical(limbs, ctx, nreduce)
+    return restack(mont_mul_l(ctx, split(a), split(b), nreduce))
 
 
 def add_raw(a: jax.Array, b: jax.Array) -> jax.Array:
-    """Limb-canonical addition WITHOUT modular reduction (value = a+b)."""
-    limbs, c = carry_u32(a + b)
-    return limbs  # caller guarantees value < 2^260 (c == 0)
+    return restack(add_raw_l(split(a), split(b)))
 
 
 def sub_mod(ctx: MontCtx, a: jax.Array, b: jax.Array, b_bound: int, nreduce: int) -> jax.Array:
-    """a - b + b_bound*m, carried in int32 (no borrow underflow), then
-    reduced with `nreduce` conditional subtracts."""
-    kp = ctx.km_limbs_i32[b_bound].reshape((NLIMBS,) + (1,) * (a.ndim - 1))
-    t = a.astype(jnp.int32) + kp - b.astype(jnp.int32)
-    limbs, c = carry_i32(t)
-    return reduce_canonical(limbs.astype(jnp.uint32), ctx, nreduce)
+    return restack(sub_mod_l(ctx, split(a), split(b), b_bound, nreduce))
 
 
 def to_mont(ctx: MontCtx, x: jax.Array, nreduce: int = 1) -> jax.Array:
-    return mont_mul(ctx, x, _bc(ctx.r2_limbs, x), nreduce=nreduce)
+    return restack(to_mont_l(ctx, split(x), nreduce))
 
 
 def from_mont(ctx: MontCtx, x: jax.Array) -> jax.Array:
-    return mont_mul(ctx, x, _bc(ctx.one, x))
-
-
-def _bc(const_limbs: np.ndarray, like: jax.Array) -> jax.Array:
-    """Broadcast a (NLIMBS,) numpy constant against like's batch dims."""
-    return jnp.broadcast_to(
-        jnp.asarray(const_limbs).reshape((NLIMBS,) + (1,) * (like.ndim - 1)),
-        like.shape,
-    )
+    return restack(from_mont_l(ctx, split(x)))
 
 
 def mont_pow(ctx: MontCtx, x: jax.Array, exponent: int) -> jax.Array:
-    """x^exponent in the Montgomery domain, square-and-multiply over the
-    (static) exponent bits via lax.scan — the trace stays small and the
-    schedule is branch-free (select instead of branch on each bit)."""
-    nbits = exponent.bit_length()
-    bits = np.array(
-        [(exponent >> (nbits - 1 - i)) & 1 for i in range(nbits)], dtype=np.bool_
-    )
-    acc0 = _bc(ctx.one_mont, x)
+    return restack(mont_pow_l(ctx, split(x), exponent))
 
-    def body(acc, bit):
-        acc = mont_mul(ctx, acc, acc)
-        acc_x = mont_mul(ctx, acc, x)
-        return jnp.where(bit, acc_x, acc), None
 
-    acc, _ = lax.scan(body, acc0, jnp.asarray(bits))
-    return acc
+def reduce_canonical(x: jax.Array, ctx: MontCtx, times: int) -> jax.Array:
+    return restack(reduce_canonical_l(ctx, split(x), times))
+
+
+def cond_sub(x: jax.Array, ctx: MontCtx) -> jax.Array:
+    return restack(cond_sub_l(ctx, split(x)))
 
 
 def eq_limbs(a: jax.Array, b: jax.Array) -> jax.Array:
-    """Limbwise equality reduced over axis 0 -> bool (*batch)."""
-    return jnp.all(a == b, axis=0)
+    return eq_l(split(a), split(b))
 
 
 def is_zero(a: jax.Array) -> jax.Array:
-    return jnp.all(a == 0, axis=0)
+    return is_zero_l(split(a))

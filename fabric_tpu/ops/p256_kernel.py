@@ -9,28 +9,29 @@ whole block in a single fixed-shape device program.
 
 Math layout:
 
-- field elements: Montgomery residues in 20×13-bit limbs, limb-major
-  ``(20, B)`` (see fabric_tpu.ops.bignum);
+- field elements: Montgomery residues as *unpacked* 13-bit limbs — tuples
+  of 20 (B,) arrays (see fabric_tpu.ops.bignum for why unpacked limbs are
+  the TPU-critical choice: pure elementwise DAGs fuse; stacked layouts
+  spill every intermediate to HBM);
 - point arithmetic: *complete* projective formulas for a=-3 short
   Weierstrass curves (Renes–Costello–Batina, EUROCRYPT 2016, algs 4/6).
   Complete formulas have no special cases for infinity/doubling, which is
   exactly what a branch-free SIMD batch needs;
-- scalar recomposition: u1*G + u2*Q with 4-bit fixed windows, MSB-first.
-  The G part uses a host-precomputed 64×16-entry comb table (G is a global
-  constant); the Q part builds a per-lane 16-entry table of small multiples;
-- scalar inversion s^-1 mod n and the final Z^-1 mod p use Fermat
-  exponentiation (branch-free square-and-multiply over static exponent
-  bits).
+- scalar recomposition: u1*G + u2*Q with 4-bit fixed windows, MSB-first
+  Horner loop (R = 16R + d1*G + d2*Q). G multiples come from a host
+  precomputed table; Q multiples are built per lane;
+- scalar inversion s^-1 mod n and the final Z^-1 mod p use branch-free
+  fixed-window Fermat exponentiation.
 
 The per-lane boolean output is bit-exact with the reference's
 `ecdsa.Verify` decision; DER parsing, the low-S rule and r/s range checks
-happen host-side (cheap, irregular) and arrive here as the `valid_in` mask.
+happen host-side (cheap, irregular) and arrive here as the `valid_in`
+mask.
 """
 
 from __future__ import annotations
 
-from functools import partial
-from typing import NamedTuple, Tuple
+from typing import List, NamedTuple, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -45,52 +46,59 @@ CTX_N = bn.MontCtx(p256.N)
 
 _R = 1 << bn.RADIX_BITS
 B_MONT = bn.int_to_limbs((p256.B * _R) % p256.P)
+ONE_MONT_P = bn.int_to_limbs(_R % p256.P)
 N_LIMBS = bn.int_to_limbs(p256.N)
 
 WINDOW_BITS = 4
 NUM_WINDOWS = 64  # 256 bits / 4
 
+LimbVec = bn.LimbVec
+
 
 class FE(NamedTuple):
-    """A mod-p field element with a static value bound (value < bound * p).
+    """A mod-p field element (unpacked limbs) with a static value bound
+    (value < bound * p).
 
-    Bounds are tracked at trace time so the lazy-reduction discipline of the
-    RCB formulas is machine-checked: `mul` requires bound products <= 16
-    (then a single conditional subtract renormalizes), `add` accumulates
-    bounds, `sub` renormalizes to canonical.
+    Bounds are tracked at trace time so the lazy-reduction discipline of
+    the RCB formulas is machine-checked: `mul` requires bound products
+    <= 16 (then a single conditional subtract renormalizes), `add`
+    accumulates bounds, `sub` renormalizes to canonical.
     """
 
-    limbs: jax.Array
+    limbs: tuple
     bound: int
 
 
-def fe(limbs: jax.Array, bound: int = 1) -> FE:
-    return FE(limbs, bound)
+def fe(limbs, bound: int = 1) -> FE:
+    return FE(tuple(limbs), bound)
 
 
 def fe_mul(a: FE, b: FE) -> FE:
     assert a.bound * b.bound <= 16, (a.bound, b.bound)
-    return FE(bn.mont_mul(CTX_P, a.limbs, b.limbs, nreduce=1), 1)
+    return FE(tuple(bn.mont_mul_l(CTX_P, a.limbs, b.limbs, nreduce=1)), 1)
 
 
 def fe_add(a: FE, b: FE) -> FE:
     assert a.bound + b.bound <= 8, (a.bound, b.bound)
-    return FE(bn.add_raw(a.limbs, b.limbs), a.bound + b.bound)
+    return FE(tuple(bn.add_raw_l(a.limbs, b.limbs)), a.bound + b.bound)
 
 
 def fe_sub(a: FE, b: FE) -> FE:
     # a - b + bound(b)*p, then conditional subtracts back to canonical.
     return FE(
-        bn.sub_mod(CTX_P, a.limbs, b.limbs, b.bound, nreduce=a.bound + b.bound - 1), 1
+        tuple(bn.sub_mod_l(CTX_P, a.limbs, b.limbs, b.bound, nreduce=a.bound + b.bound - 1)),
+        1,
     )
 
 
 def fe_norm(a: FE) -> FE:
-    return FE(bn.reduce_canonical(a.limbs, CTX_P, a.bound - 1), 1)
+    return FE(tuple(bn.reduce_canonical_l(CTX_P, a.limbs, a.bound - 1)), 1)
 
 
-def _const_fe(value_mod_p: int, like: jax.Array) -> FE:
-    return FE(bn._bc(bn.int_to_limbs(value_mod_p), like), 1)
+_B_FE = FE(bn.const_l(B_MONT), 1)
+_IDENT_X = FE(bn.const_l(bn.int_to_limbs(0)), 1)
+_IDENT_Y = FE(bn.const_l(ONE_MONT_P), 1)
+_IDENT_Z = FE(bn.const_l(bn.int_to_limbs(0)), 1)
 
 
 class Point(NamedTuple):
@@ -101,13 +109,12 @@ class Point(NamedTuple):
     z: FE
 
 
-def point_identity(like: jax.Array) -> Point:
-    one_m = (_R % p256.P)
-    return Point(_const_fe(0, like), _const_fe(one_m, like), _const_fe(0, like))
-
-
-def _b_fe(like: jax.Array) -> FE:
-    return FE(bn._bc(B_MONT, like), 1)
+def point_identity_like(like: jax.Array) -> Point:
+    return Point(
+        FE(tuple(bn.bcast_l(bn.int_to_limbs(0), like)), 1),
+        FE(tuple(bn.bcast_l(ONE_MONT_P, like)), 1),
+        FE(tuple(bn.bcast_l(bn.int_to_limbs(0), like)), 1),
+    )
 
 
 def point_add(p: Point, q: Point) -> Point:
@@ -115,7 +122,7 @@ def point_add(p: Point, q: Point) -> Point:
     and p == q with no branches."""
     x1, y1, z1 = p
     x2, y2, z2 = q
-    bb = _b_fe(x1.limbs)
+    bb = _B_FE
 
     t0 = fe_mul(x1, x2)
     t1 = fe_mul(y1, y2)
@@ -166,7 +173,7 @@ def point_add(p: Point, q: Point) -> Point:
 def point_double(p: Point) -> Point:
     """Complete doubling, RCB 2016 algorithm 6 (a = -3)."""
     x, y, z = p
-    bb = _b_fe(x.limbs)
+    bb = _B_FE
 
     t0 = fe_mul(x, x)
     t1 = fe_mul(y, y)
@@ -206,7 +213,7 @@ def point_double(p: Point) -> Point:
 
 
 # ---------------------------------------------------------------------------
-# Fixed-base comb table for G (host precompute)
+# Fixed-base small-multiples table for G (host precompute)
 # ---------------------------------------------------------------------------
 
 _G_TABLE: np.ndarray | None = None
@@ -243,8 +250,8 @@ def g_small_table() -> np.ndarray:
 # ---------------------------------------------------------------------------
 
 
-def scalar_digits_msb(u: jax.Array) -> jax.Array:
-    """(20, B) canonical limbs -> (64, B) 4-bit digits, MSB window first."""
+def scalar_digits_msb(u: Sequence[jax.Array]) -> jax.Array:
+    """Canonical limbs (tuple) -> (64, B) 4-bit digits, MSB window first."""
     digits = []
     for w in range(NUM_WINDOWS):  # w = 0 is the most significant window
         bit = (NUM_WINDOWS - 1 - w) * WINDOW_BITS
@@ -256,14 +263,28 @@ def scalar_digits_msb(u: jax.Array) -> jax.Array:
     return jnp.stack(digits, axis=0)
 
 
-def _one_hot_select(table: jax.Array, idx: jax.Array) -> Tuple[jax.Array, ...]:
-    """table (16, 3, 20, B) or (16, 3, 20); idx (B,) -> three (20, B) arrays."""
+def _select_point(table: jax.Array, idx: jax.Array) -> Point:
+    """table (16, 3, 20, B) or (16, 3, 20); idx (B,) -> Point (one-hot
+    contraction — gathers on TPU lower poorly, multiply-accumulate over
+    16 rows fuses)."""
     oh = (jnp.arange(16, dtype=jnp.uint32)[:, None] == idx[None, :]).astype(jnp.uint32)
-    if table.ndim == 4:  # per-lane table
+    if table.ndim == 4:
         sel = (table * oh[:, None, None, :]).sum(axis=0)  # (3, 20, B)
-    else:  # shared constant table
-        sel = jnp.einsum("kcl,kb->clb", table, oh)  # (3, 20, B)
-    return sel[0], sel[1], sel[2]
+    else:
+        sel = jnp.einsum("kcl,kb->clb", table, oh)
+    return Point(
+        fe(tuple(sel[0, i] for i in range(bn.NLIMBS))),
+        fe(tuple(sel[1, i] for i in range(bn.NLIMBS))),
+        fe(tuple(sel[2, i] for i in range(bn.NLIMBS))),
+    )
+
+
+def _pack_point(p: Point) -> Tuple[tuple, tuple, tuple]:
+    return (p.x.limbs, p.y.limbs, p.z.limbs)
+
+
+def _unpack_point(c) -> Point:
+    return Point(fe(c[0]), fe(c[1]), fe(c[2]))
 
 
 # ---------------------------------------------------------------------------
@@ -279,71 +300,75 @@ def verify_batch_device(
     qy: jax.Array,
     valid_in: jax.Array,
 ) -> jax.Array:
-    """Core batched verify. All limb inputs (20, B) uint32 canonical;
-    valid_in (B,) bool (host prechecks: DER ok, low-S, 1 <= r,s < n, Q on
-    curve). Returns (B,) bool.
+    """Core batched verify. Limb inputs (20, B) uint32 canonical; valid_in
+    (B,) bool (host prechecks: DER ok, low-S, 1 <= r,s < n, Q on curve).
+    Returns (B,) bool.
 
     Semantics (Go crypto/ecdsa.Verify): w = s^-1 mod n; u1 = e*w; u2 = r*w;
     (x, y) = u1*G + u2*Q; accept iff the sum is not infinity and
     x mod n == r.
     """
-    batch = e.shape[1:]
+    e_t, r_t, s_t = bn.split(e), bn.split(r), bn.split(s)
+    qx_t, qy_t = bn.split(qx), bn.split(qy)
 
     # --- scalar field: u1 = e/s, u2 = r/s (mod n) ---
-    s_m = bn.to_mont(CTX_N, s)
-    s_inv = bn.mont_pow(CTX_N, s_m, p256.N - 2)
-    e_m = bn.to_mont(CTX_N, e)  # e < 2^256 (may exceed n; to_mont reduces)
-    r_m = bn.to_mont(CTX_N, r)
-    u1 = bn.from_mont(CTX_N, bn.mont_mul(CTX_N, e_m, s_inv))
-    u2 = bn.from_mont(CTX_N, bn.mont_mul(CTX_N, r_m, s_inv))
+    s_m = bn.to_mont_l(CTX_N, s_t)
+    s_inv = bn.mont_pow_l(CTX_N, s_m, p256.N - 2)
+    e_m = bn.to_mont_l(CTX_N, e_t)  # e < 2^256 (may exceed n; reduced here)
+    r_m = bn.to_mont_l(CTX_N, r_t)
+    u1 = bn.from_mont_l(CTX_N, bn.mont_mul_l(CTX_N, e_m, s_inv))
+    u2 = bn.from_mont_l(CTX_N, bn.mont_mul_l(CTX_N, r_m, s_inv))
 
     d1 = scalar_digits_msb(u1)  # (64, B)
     d2 = scalar_digits_msb(u2)
 
     # --- per-lane table of small multiples of Q ---
     q_pt = Point(
-        fe(bn.to_mont(CTX_P, qx)),
-        fe(bn.to_mont(CTX_P, qy)),
-        _const_fe(_R % p256.P, qx),
+        fe(bn.to_mont_l(CTX_P, qx_t)),
+        fe(bn.to_mont_l(CTX_P, qy_t)),
+        FE(tuple(bn.bcast_l(ONE_MONT_P, qx[0])), 1),
     )
 
-    def _pack(p: Point) -> jax.Array:
-        return jnp.stack([p.x.limbs, p.y.limbs, p.z.limbs], axis=0)
+    def tab_body(carry, _):
+        pt = _unpack_point(carry)
+        nxt = point_add(pt, q_pt)
+        packed = _pack_point(nxt)
+        return packed, jnp.stack(
+            [bn.restack(carry[0]), bn.restack(carry[1]), bn.restack(carry[2])]
+        )
 
-    def _unpack(a: jax.Array) -> Point:
-        return Point(fe(a[0]), fe(a[1]), fe(a[2]))
-
-    def tab_body(acc, _):
-        pt = _unpack(acc)
-        return _pack(point_add(pt, q_pt)), acc
-
-    _, q_multiples = lax.scan(tab_body, _pack(q_pt), None, length=15)
-    ident_row = _pack(point_identity(qx))[None]
-    q_table = jnp.concatenate([ident_row, q_multiples], axis=0)  # (16, 3, 20, B)
+    _, q_multiples = lax.scan(tab_body, _pack_point(q_pt), None, length=15)
+    ident = point_identity_like(qx[0])
+    ident_row = jnp.stack(
+        [bn.restack(ident.x.limbs), bn.restack(ident.y.limbs), bn.restack(ident.z.limbs)]
+    )[None]
+    q_table = jnp.concatenate([ident_row, q_multiples], axis=0)  # (16,3,20,B)
 
     # --- main window loop: R = 16R + d1*G + d2*Q, MSB first (Horner) ---
     g_table = jnp.asarray(g_small_table())  # (16, 3, 20)
 
     def win_body(carry, xs):
         d1w, d2w = xs
-        acc = _unpack(carry)
+        acc = _unpack_point(carry)
         for _ in range(WINDOW_BITS):
             acc = point_double(acc)
-        qx_s, qy_s, qz_s = _one_hot_select(q_table, d2w)
-        acc = point_add(acc, Point(fe(qx_s), fe(qy_s), fe(qz_s)))
-        gx_s, gy_s, gz_s = _one_hot_select(g_table, d1w)
-        acc = point_add(acc, Point(fe(gx_s), fe(gy_s), fe(gz_s)))
-        return _pack(acc), None
+        acc = point_add(acc, _select_point(q_table, d2w))
+        acc = point_add(acc, _select_point(g_table, d1w))
+        return _pack_point(acc), None
 
-    carry, _ = lax.scan(win_body, _pack(point_identity(qx)), (d1, d2))
-    acc = _unpack(carry)
+    carry, _ = lax.scan(
+        win_body, _pack_point(point_identity_like(qx[0])), (d1, d2)
+    )
+    acc = _unpack_point(carry)
 
     # --- affine x and the final comparison ---
-    z_inv = bn.mont_pow(CTX_P, acc.z.limbs, p256.P - 2)
-    x_aff = bn.from_mont(CTX_P, bn.mont_mul(CTX_P, acc.x.limbs, z_inv))
-    r_plus_n, _ = bn.carry_u32(r + bn._bc(N_LIMBS, r))  # value < 2^257, fits
-    matches = bn.eq_limbs(x_aff, r) | bn.eq_limbs(x_aff, r_plus_n)
-    not_inf = ~bn.is_zero(acc.z.limbs)
+    z_inv = bn.mont_pow_l(CTX_P, acc.z.limbs, p256.P - 2)
+    x_aff = bn.from_mont_l(CTX_P, bn.mont_mul_l(CTX_P, acc.x.limbs, z_inv))
+    r_plus_n, _ = bn.carry_l(
+        [x + np.uint32(nv) for x, nv in zip(r_t, N_LIMBS)]
+    )  # value < 2^257, fits in 20 limbs
+    matches = bn.eq_l(x_aff, r_t) | bn.eq_l(x_aff, r_plus_n)
+    not_inf = ~bn.is_zero_l(acc.z.limbs)
     return valid_in & not_inf & matches
 
 

@@ -1,0 +1,6 @@
+"""Ordering service (reference orderer/): blockcutter, block writer, solo."""
+
+from fabric_tpu.orderer.blockcutter import BlockCutter
+from fabric_tpu.orderer.solo import SoloChain
+
+__all__ = ["BlockCutter", "SoloChain"]

@@ -1,0 +1,67 @@
+"""Envelope batching (reference orderer/common/blockcutter/blockcutter.go).
+
+Ordered() semantics replicated:
+- a message larger than preferred_max_bytes is cut into its own batch
+  (after first cutting any pending batch);
+- appending a message that would overflow preferred_max_bytes cuts the
+  pending batch first;
+- reaching max_message_count cuts immediately;
+- `pending` tells the caller whether a timer should be armed.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Tuple
+
+from fabric_tpu.protos import common_pb2
+
+
+@dataclass
+class BatchConfig:
+    max_message_count: int = 10
+    absolute_max_bytes: int = 10 * 1024 * 1024
+    preferred_max_bytes: int = 2 * 1024 * 1024
+
+
+class BlockCutter:
+    def __init__(self, config: BatchConfig = BatchConfig()):
+        self.config = config
+        self._pending: List[common_pb2.Envelope] = []
+        self._pending_bytes = 0
+
+    @staticmethod
+    def _size(env: common_pb2.Envelope) -> int:
+        return len(env.SerializeToString())
+
+    def ordered(self, env: common_pb2.Envelope) -> Tuple[List[List[common_pb2.Envelope]], bool]:
+        """Returns (batches_to_cut, pending_remaining)."""
+        batches: List[List[common_pb2.Envelope]] = []
+        size = self._size(env)
+
+        if size > self.config.preferred_max_bytes:
+            # oversized message: flush pending, isolate this one
+            if self._pending:
+                batches.append(self._cut())
+            batches.append([env])
+            return batches, False
+
+        if self._pending_bytes + size > self.config.preferred_max_bytes and self._pending:
+            batches.append(self._cut())
+
+        self._pending.append(env)
+        self._pending_bytes += size
+
+        if len(self._pending) >= self.config.max_message_count:
+            batches.append(self._cut())
+
+        return batches, bool(self._pending)
+
+    def cut(self) -> List[common_pb2.Envelope]:
+        return self._cut() if self._pending else []
+
+    def _cut(self) -> List[common_pb2.Envelope]:
+        batch = self._pending
+        self._pending = []
+        self._pending_bytes = 0
+        return batch

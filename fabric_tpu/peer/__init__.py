@@ -1,0 +1,5 @@
+"""Peer runtime: per-channel wiring of validator + ledger."""
+
+from fabric_tpu.peer.channel import Channel
+
+__all__ = ["Channel"]

@@ -1,0 +1,78 @@
+"""Per-channel peer pipeline (reference core/peer/peer.go createChannel
+wiring + gossip/privdata/coordinator.go StoreBlock + the MCS block checks).
+
+Block intake order matches the reference (SURVEY.md §3.1):
+1. MCS.VerifyBlock: recompute DataHash, check the header chain, verify the
+   orderer block signature when a verifier is configured
+   (usable-inter-nal/peer/gossip/mcs.go:124);
+2. txvalidator.Validate -> TRANSACTIONS_FILTER (signatures + policies,
+   TPU-batched);
+3. kvledger.commit -> MVCC merge + block store + state/history commit.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from fabric_tpu.crypto.bccsp import Provider, default_provider
+from fabric_tpu.ledger.kvledger import KVLedger
+from fabric_tpu.msp.identity import MSPManager
+from fabric_tpu.protos import common_pb2, protoutil
+from fabric_tpu.validation.txflags import ValidationFlags
+from fabric_tpu.validation.validator import BlockValidator, ChaincodeRegistry
+
+
+class BlockVerificationError(Exception):
+    pass
+
+
+class Channel:
+    def __init__(
+        self,
+        channel_id: str,
+        ledger_dir: str,
+        msp_manager: MSPManager,
+        registry: ChaincodeRegistry,
+        provider: Optional[Provider] = None,
+        verify_orderer_sig: Optional[Callable[[common_pb2.Block], bool]] = None,
+        apply_config: Optional[Callable[[bytes], None]] = None,
+    ):
+        self.channel_id = channel_id
+        self.provider = provider or default_provider()
+        self.ledger = KVLedger(ledger_dir, channel_id)
+        self.verify_orderer_sig = verify_orderer_sig
+        self.validator = BlockValidator(
+            channel_id,
+            msp_manager,
+            self.provider,
+            registry,
+            tx_exists=self.ledger.tx_exists,
+            apply_config=apply_config,
+        )
+
+    def store_block(self, block: common_pb2.Block) -> ValidationFlags:
+        """The full commit pipeline for one delivered block."""
+        self._verify_block(block)
+        self.validator.validate(block)
+        return self.ledger.commit(block)
+
+    def _verify_block(self, block: common_pb2.Block) -> None:
+        if block.header.number != self.ledger.height:
+            raise BlockVerificationError(
+                f"expected block {self.ledger.height}, got {block.header.number}"
+            )
+        if protoutil.block_data_hash(block.data) != block.header.data_hash:
+            raise BlockVerificationError(
+                "Header.DataHash is different from Hash(block.Data)"
+            )
+        if (
+            self.ledger.height > 0
+            and block.header.previous_hash != self.ledger.block_store.last_block_hash
+        ):
+            raise BlockVerificationError("previous-hash mismatch")
+        if self.verify_orderer_sig is not None and not self.verify_orderer_sig(block):
+            raise BlockVerificationError("orderer block signature invalid")
+
+    @property
+    def height(self) -> int:
+        return self.ledger.height

@@ -24,6 +24,7 @@ from fabric_tpu.protos import msp_principal_pb2  # noqa: E402
 from fabric_tpu.protos import peer_pb2  # noqa: E402
 from fabric_tpu.protos import policies_pb2  # noqa: E402
 from fabric_tpu.protos import rwset_pb2  # noqa: E402
+from fabric_tpu.protos import txmgr_updates_pb2  # noqa: E402
 
 __all__ = [
     "common_pb2",
@@ -33,4 +34,5 @@ __all__ = [
     "peer_pb2",
     "policies_pb2",
     "rwset_pb2",
+    "txmgr_updates_pb2",
 ]

@@ -1,0 +1,260 @@
+"""Per-transaction structural validation (reference
+core/common/validation/msgvalidation.go) — host-side parsing phase.
+
+The reference validates each tx in its own goroutine, verifying the
+creator signature inline (ValidateTransaction :248-330). The TPU pipeline
+splits that into:
+
+  parse phase (this module, host): all structural checks; emits
+      *signature jobs* instead of verifying inline;
+  batch phase (device): every signature in the block — creator sigs and
+      endorsement sigs — verified in ONE batched kernel call;
+  assembly phase (validation.validator): reference-ordered code priority
+      consuming the boolean results.
+
+Check order replicated exactly (msgvalidation.go ValidateTransaction):
+nil envelope -> NIL_ENVELOPE; payload unmarshal -> BAD_PAYLOAD; header/
+channel-header/signature-header problems -> BAD_COMMON_HEADER; creator
+deserialize/cert-validate/signature -> BAD_CREATOR_SIGNATURE; TxID
+recompute -> BAD_PROPOSAL_TXID; endorser-tx structure (single action,
+proposal-hash binding) -> INVALID_ENDORSER_TRANSACTION; unknown type ->
+UNSUPPORTED_TX_PAYLOAD.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+from fabric_tpu.protos import common_pb2, kv_rwset_pb2, peer_pb2, protoutil, rwset_pb2
+from fabric_tpu.ledger import rwset as rw
+from fabric_tpu.validation.txflags import TxValidationCode
+
+SUPPORTED_HEADER_TYPES = {
+    common_pb2.ENDORSER_TRANSACTION,
+    common_pb2.CONFIG_UPDATE,
+    common_pb2.CONFIG,
+}
+
+
+@dataclass
+class SigJob:
+    """One deferred signature check: verify `signature` by the identity
+    serialized in `identity_bytes` over `data`."""
+
+    identity_bytes: bytes
+    signature: bytes
+    data: bytes
+
+
+@dataclass
+class ParsedTx:
+    """Host-parse result for one block position."""
+
+    index: int
+    code: TxValidationCode = TxValidationCode.NOT_VALIDATED
+    header_type: int = -1
+    channel_id: str = ""
+    tx_id: str = ""
+    creator: bytes = b""
+    # deferred signature checks
+    creator_sig_job: Optional[SigJob] = None
+    endorsement_jobs: List[SigJob] = field(default_factory=list)
+    # endorser-tx artifacts (builtin v20 VSCC inputs)
+    namespace: str = ""
+    rwset: Optional[rw.TxRwSet] = None
+    config_data: bytes = b""
+
+    @property
+    def structurally_valid(self) -> bool:
+        return self.code == TxValidationCode.NOT_VALIDATED
+
+
+def _parse_version(v: kv_rwset_pb2.Version, present: bool) -> Optional[rw.Version]:
+    if not present:
+        return None
+    return rw.Version(v.block_num, v.tx_num)
+
+
+def parse_tx_rwset(results: bytes) -> rw.TxRwSet:
+    """proto TxReadWriteSet bytes -> internal TxRwSet
+    (reference rwsetutil.TxRwSetFromProtoMsg)."""
+    txrw = protoutil.unmarshal(rwset_pb2.TxReadWriteSet, results)
+    ns_sets = []
+    for ns in txrw.ns_rwset:
+        kv = protoutil.unmarshal(kv_rwset_pb2.KVRWSet, ns.rwset)
+        reads = tuple(
+            rw.KVRead(r.key, _parse_version(r.version, r.HasField("version")))
+            for r in kv.reads
+        )
+        writes = tuple(
+            rw.KVWrite(w.key, w.is_delete, w.value) for w in kv.writes
+        )
+        rqs = []
+        for q in kv.range_queries_info:
+            raw_reads: Tuple[rw.KVRead, ...] = ()
+            merkle = None
+            if q.HasField("raw_reads"):
+                raw_reads = tuple(
+                    rw.KVRead(r.key, _parse_version(r.version, r.HasField("version")))
+                    for r in q.raw_reads.kv_reads
+                )
+            if q.HasField("reads_merkle_hashes"):
+                merkle = (
+                    q.reads_merkle_hashes.max_level,
+                    tuple(q.reads_merkle_hashes.max_level_hashes),
+                )
+            rqs.append(
+                rw.RangeQueryInfo(
+                    q.start_key, q.end_key, q.itr_exhausted, raw_reads, merkle
+                )
+            )
+        colls = []
+        for coll in ns.collection_hashed_rwset:
+            h = protoutil.unmarshal(kv_rwset_pb2.HashedRWSet, coll.hashed_rwset)
+            colls.append(
+                rw.CollHashedRwSet(
+                    coll.collection_name,
+                    tuple(
+                        rw.KVReadHash(
+                            r.key_hash,
+                            _parse_version(r.version, r.HasField("version")),
+                        )
+                        for r in h.hashed_reads
+                    ),
+                    tuple(
+                        rw.KVWriteHash(w.key_hash, w.is_delete, w.value_hash)
+                        for w in h.hashed_writes
+                    ),
+                )
+            )
+        ns_sets.append(rw.NsRwSet(ns.namespace, reads, writes, tuple(rqs), tuple(colls)))
+    return rw.TxRwSet(tuple(ns_sets))
+
+
+def parse_transaction(index: int, data: bytes) -> ParsedTx:
+    """Structural validation of one block entry; fills early codes and
+    deferred signature jobs. Never verifies a signature."""
+    out = ParsedTx(index)
+    if not data:
+        out.code = TxValidationCode.NIL_ENVELOPE
+        return out
+    try:
+        env = protoutil.unmarshal(common_pb2.Envelope, data)
+    except ValueError:
+        out.code = TxValidationCode.INVALID_OTHER_REASON
+        return out
+
+    if not env.payload:
+        out.code = TxValidationCode.BAD_PAYLOAD
+        return out
+    try:
+        payload = protoutil.unmarshal(common_pb2.Payload, env.payload)
+    except ValueError:
+        out.code = TxValidationCode.BAD_PAYLOAD
+        return out
+
+    # validateCommonHeader
+    if not payload.HasField("header"):
+        out.code = TxValidationCode.BAD_COMMON_HEADER
+        return out
+    try:
+        chdr = protoutil.unmarshal(
+            common_pb2.ChannelHeader, payload.header.channel_header
+        )
+        shdr = protoutil.unmarshal(
+            common_pb2.SignatureHeader, payload.header.signature_header
+        )
+    except ValueError:
+        out.code = TxValidationCode.BAD_COMMON_HEADER
+        return out
+    if chdr.type not in SUPPORTED_HEADER_TYPES or chdr.epoch != 0:
+        out.code = TxValidationCode.BAD_COMMON_HEADER
+        return out
+    if not shdr.nonce or not shdr.creator:
+        out.code = TxValidationCode.BAD_COMMON_HEADER
+        return out
+
+    out.header_type = chdr.type
+    out.channel_id = chdr.channel_id
+    out.tx_id = chdr.tx_id
+    out.creator = shdr.creator
+    # checkSignatureFromCreator, deferred: signature over the full payload
+    # bytes (msgvalidation.go:284 verifies env.Signature over env.Payload).
+    out.creator_sig_job = SigJob(shdr.creator, env.signature, env.payload)
+
+    if chdr.type == common_pb2.ENDORSER_TRANSACTION:
+        if not protoutil.check_tx_id(chdr.tx_id, shdr.nonce, shdr.creator):
+            out.code = TxValidationCode.BAD_PROPOSAL_TXID
+            return out
+        code = _parse_endorser_tx(out, payload)
+        if code is not None:
+            out.code = code
+        return out
+    if chdr.type == common_pb2.CONFIG:
+        out.config_data = payload.data
+        return out
+    # CONFIG_UPDATE passes header validation but is not expected inside
+    # blocks; the reference codes it UNKNOWN_TX_TYPE at the validator level.
+    return out
+
+
+def _parse_endorser_tx(out: ParsedTx, payload: common_pb2.Payload) -> Optional[TxValidationCode]:
+    """validateEndorserTransaction + the artifact extraction the builtin
+    v20 plugin performs (validation_logic.go extractValidationArtifacts)."""
+    try:
+        tx = protoutil.unmarshal(peer_pb2.Transaction, payload.data)
+    except ValueError:
+        return TxValidationCode.INVALID_ENDORSER_TRANSACTION
+    if len(tx.actions) != 1:
+        return TxValidationCode.INVALID_ENDORSER_TRANSACTION
+    action = tx.actions[0]
+    try:
+        act_shdr = protoutil.unmarshal(common_pb2.SignatureHeader, action.header)
+    except ValueError:
+        return TxValidationCode.INVALID_ENDORSER_TRANSACTION
+    if not act_shdr.nonce or not act_shdr.creator:
+        return TxValidationCode.INVALID_ENDORSER_TRANSACTION
+    try:
+        cap = protoutil.unmarshal(peer_pb2.ChaincodeActionPayload, action.payload)
+        prp_bytes = cap.action.proposal_response_payload
+        prp = protoutil.unmarshal(peer_pb2.ProposalResponsePayload, prp_bytes)
+    except ValueError:
+        return TxValidationCode.INVALID_ENDORSER_TRANSACTION
+
+    # proposal-hash binding: sha256(channel_header || action sig header ||
+    # chaincode proposal payload) must equal prp.proposal_hash
+    # (GetProposalHash2, protoutil/txutils.go:431).
+    h = hashlib.sha256()
+    h.update(payload.header.channel_header)
+    h.update(action.header)
+    h.update(cap.chaincode_proposal_payload)
+    if h.digest() != prp.proposal_hash:
+        return TxValidationCode.INVALID_ENDORSER_TRANSACTION
+
+    # --- builtin v20 artifact extraction (runs later in the reference,
+    # inside the plugin; failure codes preserved) ---
+    try:
+        cc_action = protoutil.unmarshal(peer_pb2.ChaincodeAction, prp.extension)
+    except ValueError:
+        return TxValidationCode.BAD_RESPONSE_PAYLOAD
+    if not cc_action.HasField("chaincode_id") or not cc_action.chaincode_id.name:
+        return TxValidationCode.INVALID_OTHER_REASON
+    try:
+        out.rwset = parse_tx_rwset(cc_action.results)
+    except ValueError:
+        return TxValidationCode.BAD_RWSET
+    out.namespace = cc_action.chaincode_id.name
+
+    # endorsement signature jobs: data = prp_bytes || endorser identity
+    # (statebased/validator_keylevel.go:243-251)
+    for endorsement in cap.action.endorsements:
+        out.endorsement_jobs.append(
+            SigJob(
+                endorsement.endorser,
+                endorsement.signature,
+                prp_bytes + endorsement.endorser,
+            )
+        )
+    return None

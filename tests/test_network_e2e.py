@@ -1,0 +1,208 @@
+"""Minimal end-to-end network: client -> solo orderer -> two peers.
+
+The NWO-analog smoke test (reference integration/e2e): endorse real
+transactions, order them into signed blocks, run the full peer commit
+pipeline on two independent peers, and check that state, the
+TRANSACTIONS_FILTER and the chained COMMIT_HASH agree byte-for-byte
+(cross-peer state-divergence detection, kv_ledger.go:630-636).
+"""
+
+import pytest
+
+from fabric_tpu.crypto.bccsp import SoftwareProvider
+from fabric_tpu.endorser import create_proposal, create_signed_tx, endorse_proposal
+from fabric_tpu.ledger import rwset as rw
+from fabric_tpu.ledger.rwset_proto import serialize_tx_rwset
+from fabric_tpu.msp.cryptogen import generate_org
+from fabric_tpu.msp.identity import MSPManager
+from fabric_tpu.msp.signer import SigningIdentity
+from fabric_tpu.orderer import SoloChain
+from fabric_tpu.orderer.blockcutter import BatchConfig
+from fabric_tpu.peer import Channel
+from fabric_tpu.policy import from_dsl
+from fabric_tpu.protos import common_pb2, protoutil
+from fabric_tpu.validation.txflags import TxValidationCode
+from fabric_tpu.validation.validator import ChaincodeDefinition, ChaincodeRegistry
+
+CHANNEL = "e2echannel"
+PROVIDER = SoftwareProvider()
+
+
+@pytest.fixture(scope="module")
+def net():
+    org1 = generate_org("org1.example.com", "Org1MSP")
+    org2 = generate_org("org2.example.com", "Org2MSP")
+    orderer_org = generate_org("orderer.example.com", "OrdererMSP")
+    mgr = MSPManager([org1.msp(provider=PROVIDER), org2.msp(provider=PROVIDER)])
+    registry = ChaincodeRegistry(
+        [ChaincodeDefinition("mycc", from_dsl("AND('Org1MSP.member','Org2MSP.member')"))]
+    )
+    return {
+        "mgr": mgr,
+        "registry": registry,
+        "client": SigningIdentity(org1.users[0], PROVIDER),
+        "p1": SigningIdentity(org1.peers[0], PROVIDER),
+        "p2": SigningIdentity(org2.peers[0], PROVIDER),
+        "oid": SigningIdentity(orderer_org.peers[0], PROVIDER),
+    }
+
+
+def invoke(net, key, value, reads=()):
+    results = serialize_tx_rwset(
+        rw.TxRwSet(
+            (
+                rw.NsRwSet(
+                    "mycc",
+                    tuple(rw.KVRead(k, v) for k, v in reads),
+                    (rw.KVWrite(key, False, value),),
+                ),
+            )
+        )
+    )
+    bundle = create_proposal(net["client"], CHANNEL, "mycc", [b"put", key.encode()])
+    responses = [
+        endorse_proposal(bundle, net["p1"], results),
+        endorse_proposal(bundle, net["p2"], results),
+    ]
+    return create_signed_tx(bundle, net["client"], responses)
+
+
+def test_full_pipeline_two_peers(net, tmp_path):
+    delivered = []
+    chain = SoloChain(
+        CHANNEL,
+        signer=net["oid"],
+        batch_config=BatchConfig(max_message_count=3),
+        deliver=delivered.append,
+    )
+
+    peers = [
+        Channel(CHANNEL, str(tmp_path / f"peer{i}"), net["mgr"], net["registry"], PROVIDER)
+        for i in range(2)
+    ]
+
+    # 6 txs -> two blocks of 3; tx 4 reads a key at a stale version -> MVCC
+    envs = [invoke(net, f"k{i}", f"v{i}".encode()) for i in range(3)]
+    envs.append(invoke(net, "k9", b"x", reads=[("k0", rw.Version(0, 0))]))  # stale
+    envs.append(invoke(net, "k1", b"v1b", reads=[("k1", rw.Version(0, 0))]))  # correct
+    envs.append(invoke(net, "k5", b"v5"))
+    for env in envs:
+        chain.order(env)
+    assert len(delivered) == 2
+
+    for block in delivered:
+        for peer in peers:
+            peer.store_block(common_pb2.Block.FromString(block.SerializeToString()))
+
+    V = TxValidationCode
+    for peer in peers:
+        assert peer.height == 2
+        assert peer.ledger.get_state("mycc", "k0") == b"v0"
+        assert peer.ledger.get_state("mycc", "k1") == b"v1b"  # updated by tx4
+        assert peer.ledger.get_state("mycc", "k9") is None  # MVCC-invalidated
+        assert peer.ledger.get_state("mycc", "k5") == b"v5"
+
+    # stored filter: block 2 = [MVCC_READ_CONFLICT, VALID, VALID]
+    stored = peers[0].ledger.block_store.get_block_by_number(1)
+    assert list(stored.metadata.metadata[common_pb2.TRANSACTIONS_FILTER]) == [
+        int(V.MVCC_READ_CONFLICT),
+        int(V.VALID),
+        int(V.VALID),
+    ]
+
+    # commit hashes identical across peers (divergence detector)
+    assert peers[0].ledger.commit_hash == peers[1].ledger.commit_hash
+    assert len(peers[0].ledger.commit_hash) == 32
+
+    # history index
+    assert [v.block_num for v in peers[0].ledger.get_history_for_key("mycc", "k1")] == [0, 1]
+
+
+def test_recovery_replays_block_store(net, tmp_path):
+    chain = SoloChain(CHANNEL, signer=net["oid"], batch_config=BatchConfig(max_message_count=1))
+    blocks = []
+    chain.deliver = blocks.append
+    chain.order(invoke(net, "ka", b"1"))
+    chain.order(invoke(net, "ka", b"2"))
+
+    path = str(tmp_path / "peer")
+    peer = Channel(CHANNEL, path, net["mgr"], net["registry"], PROVIDER)
+    for b in blocks:
+        peer.store_block(b)
+    want_hash = peer.ledger.block_store.last_block_hash
+    peer.ledger.block_store.close()
+
+    # fresh process: state rebuilt from the chain file alone
+    peer2 = Channel(CHANNEL, path, net["mgr"], net["registry"], PROVIDER)
+    assert peer2.height == 2
+    assert peer2.ledger.get_state("mycc", "ka") == b"2"
+    assert peer2.ledger.block_store.last_block_hash == want_hash
+
+
+def test_tampered_block_rejected(net, tmp_path):
+    from fabric_tpu.peer.channel import BlockVerificationError
+
+    chain = SoloChain(CHANNEL, signer=net["oid"], batch_config=BatchConfig(max_message_count=1))
+    blocks = []
+    chain.deliver = blocks.append
+    chain.order(invoke(net, "kb", b"1"))
+    block = blocks[0]
+    block.data.data[0] = block.data.data[0] + b"tampered"
+    peer = Channel(CHANNEL, str(tmp_path / "peer"), net["mgr"], net["registry"], PROVIDER)
+    with pytest.raises(BlockVerificationError):
+        peer.store_block(block)
+
+
+def test_orderer_signature_verified(net, tmp_path):
+    chain = SoloChain(CHANNEL, signer=net["oid"], batch_config=BatchConfig(max_message_count=1))
+    blocks = []
+    chain.deliver = blocks.append
+    chain.order(invoke(net, "kc", b"1"))
+    block = blocks[0]
+
+    def verify_sig(b):
+        meta = protoutil.unmarshal(
+            common_pb2.Metadata, b.metadata.metadata[common_pb2.SIGNATURES]
+        )
+        if not meta.signatures:
+            return False
+        sig = meta.signatures[0]
+        shdr = protoutil.unmarshal(common_pb2.SignatureHeader, sig.signature_header)
+        signed = meta.value + sig.signature_header + protoutil.block_header_bytes(b.header)
+        from fabric_tpu.crypto.bccsp import VerifyError
+        from fabric_tpu.msp.identity import Identity
+        from cryptography import x509
+        from fabric_tpu.protos import identities_pb2
+
+        sid = protoutil.unmarshal(identities_pb2.SerializedIdentity, shdr.creator)
+        cert = x509.load_pem_x509_certificate(sid.id_bytes)
+        ident = Identity(sid.mspid, cert, PROVIDER)
+        try:
+            ident.verify(signed, sig.signature)
+            return True
+        except Exception:
+            return False
+
+    peer = Channel(
+        CHANNEL,
+        str(tmp_path / "peer"),
+        net["mgr"],
+        net["registry"],
+        PROVIDER,
+        verify_orderer_sig=verify_sig,
+    )
+    peer.store_block(block)
+    assert peer.height == 1
+
+    # a block with a corrupted signature is rejected
+    chain.order(invoke(net, "kd", b"2"))
+    bad = blocks[1]
+    meta = protoutil.unmarshal(
+        common_pb2.Metadata, bad.metadata.metadata[common_pb2.SIGNATURES]
+    )
+    meta.signatures[0].signature = b"\x30\x06\x02\x01\x01\x02\x01\x01"
+    bad.metadata.metadata[common_pb2.SIGNATURES] = meta.SerializeToString()
+    from fabric_tpu.peer.channel import BlockVerificationError
+
+    with pytest.raises(BlockVerificationError):
+        peer.store_block(bad)

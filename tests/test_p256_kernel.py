@@ -39,9 +39,9 @@ def make_point_batch(pts):
 
 def read_affine(point):
     """device projective Montgomery -> list of affine pts / None."""
-    xs = bn.limbs_to_ints(np.asarray(bn.from_mont(pk.CTX_P, point.x.limbs)))
-    ys = bn.limbs_to_ints(np.asarray(bn.from_mont(pk.CTX_P, point.y.limbs)))
-    zs = bn.limbs_to_ints(np.asarray(bn.from_mont(pk.CTX_P, point.z.limbs)))
+    xs = bn.limbs_to_ints(np.asarray(bn.from_mont(pk.CTX_P, bn.restack(point.x.limbs))))
+    ys = bn.limbs_to_ints(np.asarray(bn.from_mont(pk.CTX_P, bn.restack(point.y.limbs))))
+    zs = bn.limbs_to_ints(np.asarray(bn.from_mont(pk.CTX_P, bn.restack(point.z.limbs))))
     out = []
     for x, y, z in zip(xs, ys, zs):
         if z == 0:

@@ -1,0 +1,226 @@
+"""End-to-end block validation: build real envelopes with real crypto and
+check the TRANSACTIONS_FILTER mask scenario by scenario (modeled on the
+reference's txvalidator_test.go)."""
+
+import pytest
+
+from fabric_tpu.crypto.bccsp import SoftwareProvider
+from fabric_tpu.endorser import create_proposal, create_signed_tx, endorse_proposal
+from fabric_tpu.ledger import rwset as rw
+from fabric_tpu.ledger.rwset_proto import serialize_tx_rwset
+from fabric_tpu.msp.cryptogen import generate_org
+from fabric_tpu.msp.identity import MSPManager
+from fabric_tpu.msp.signer import SigningIdentity
+from fabric_tpu.policy import from_dsl
+from fabric_tpu.protos import common_pb2, peer_pb2, protoutil
+from fabric_tpu.validation.txflags import TxValidationCode
+from fabric_tpu.validation.validator import (
+    BlockValidator,
+    ChaincodeDefinition,
+    ChaincodeRegistry,
+)
+
+CHANNEL = "testchannel"
+PROVIDER = SoftwareProvider()
+
+
+@pytest.fixture(scope="module")
+def net():
+    org1 = generate_org("org1.example.com", "Org1MSP")
+    org2 = generate_org("org2.example.com", "Org2MSP")
+    mgr = MSPManager([org1.msp(provider=PROVIDER), org2.msp(provider=PROVIDER)])
+    registry = ChaincodeRegistry(
+        [
+            ChaincodeDefinition(
+                "mycc", from_dsl("AND('Org1MSP.member','Org2MSP.member')")
+            ),
+            ChaincodeDefinition("anycc", from_dsl("OR('Org1MSP.member','Org2MSP.member')")),
+        ]
+    )
+    return {
+        "org1": org1,
+        "org2": org2,
+        "mgr": mgr,
+        "registry": registry,
+        "client": SigningIdentity(org1.users[0], PROVIDER),
+        "p1": SigningIdentity(org1.peers[0], PROVIDER),
+        "p2": SigningIdentity(org2.peers[0], PROVIDER),
+    }
+
+
+def results_bytes(key="k1", value=b"v1"):
+    return serialize_tx_rwset(
+        rw.TxRwSet(
+            (rw.NsRwSet("mycc", (), (rw.KVWrite(key, False, value),)),)
+        )
+    )
+
+
+def make_tx(net, cc="mycc", endorsers=("p1", "p2"), channel=CHANNEL, mangle=None):
+    bundle = create_proposal(net["client"], channel, cc, [b"invoke", b"a"])
+    responses = [
+        endorse_proposal(bundle, net[e], results_bytes()) for e in endorsers
+    ]
+    env = create_signed_tx(bundle, net["client"], responses)
+    if mangle:
+        env = mangle(env, bundle)
+    return env
+
+
+def make_block(envelopes, number=7):
+    block = protoutil.new_block(number, b"\x11" * 32)
+    for env in envelopes:
+        data = env if isinstance(env, bytes) else env.SerializeToString()
+        block.data.data.append(data)
+    protoutil.seal_block(block)
+    return block
+
+
+def validator(net, tx_exists=None):
+    return BlockValidator(
+        CHANNEL,
+        net["mgr"],
+        PROVIDER,
+        net["registry"],
+        tx_exists=tx_exists,
+    )
+
+
+V = TxValidationCode
+
+
+class TestBlockValidation:
+    def test_scenarios(self, net):
+        def bad_creator_sig(env, bundle):
+            env.signature = env.signature[:-6] + b"\x00\x01\x02\x03\x04\x05"
+            return env
+
+        def bad_txid(env, bundle):
+            payload = protoutil.unmarshal(common_pb2.Payload, env.payload)
+            chdr = protoutil.unmarshal(
+                common_pb2.ChannelHeader, payload.header.channel_header
+            )
+            chdr.tx_id = "deadbeef" * 8
+            payload.header.channel_header = chdr.SerializeToString()
+            env.payload = payload.SerializeToString()
+            env.signature = net["client"].sign(env.payload)
+            return env
+
+        def tampered_proposal_payload(env, bundle):
+            payload = protoutil.unmarshal(common_pb2.Payload, env.payload)
+            tx = protoutil.unmarshal(peer_pb2.Transaction, payload.data)
+            cap = protoutil.unmarshal(
+                peer_pb2.ChaincodeActionPayload, tx.actions[0].payload
+            )
+            cap.chaincode_proposal_payload = cap.chaincode_proposal_payload + b"x"
+            tx.actions[0].payload = cap.SerializeToString()
+            payload.data = tx.SerializeToString()
+            env.payload = payload.SerializeToString()
+            env.signature = net["client"].sign(env.payload)
+            return env
+
+        def tampered_endorsement(env, bundle):
+            payload = protoutil.unmarshal(common_pb2.Payload, env.payload)
+            tx = protoutil.unmarshal(peer_pb2.Transaction, payload.data)
+            cap = protoutil.unmarshal(
+                peer_pb2.ChaincodeActionPayload, tx.actions[0].payload
+            )
+            sig = bytearray(cap.action.endorsements[1].signature)
+            sig[-1] ^= 0xFF
+            cap.action.endorsements[1].signature = bytes(sig)
+            tx.actions[0].payload = cap.SerializeToString()
+            payload.data = tx.SerializeToString()
+            env.payload = payload.SerializeToString()
+            env.signature = net["client"].sign(env.payload)
+            return env
+
+        dup = make_tx(net)
+        envs = [
+            make_tx(net),  # 0 VALID
+            make_tx(net, endorsers=("p1",)),  # 1 policy failure (1 of 2)
+            make_tx(net, mangle=bad_creator_sig),  # 2
+            make_tx(net, mangle=bad_txid),  # 3
+            b"\x03\x01garbage-not-an-envelope",  # 4
+            b"",  # 5 nil
+            dup,  # 6 VALID
+            dup,  # 7 duplicate of 6
+            make_tx(net, cc="nosuchcc"),  # 8 unknown chaincode
+            make_tx(net, channel="otherchannel"),  # 9 wrong channel
+            make_tx(net, mangle=tampered_proposal_payload),  # 10
+            make_tx(net, mangle=tampered_endorsement),  # 11 sig fails -> 1of2
+            make_tx(net, cc="anycc", endorsers=("p2",)),  # 12 OR policy
+        ]
+        block = make_block(envs)
+        flags = validator(net).validate(block)
+        expected = [
+            V.VALID,
+            V.ENDORSEMENT_POLICY_FAILURE,
+            V.BAD_CREATOR_SIGNATURE,
+            V.BAD_PROPOSAL_TXID,
+            V.INVALID_OTHER_REASON,
+            V.NIL_ENVELOPE,
+            V.VALID,
+            V.DUPLICATE_TXID,
+            V.INVALID_CHAINCODE,
+            V.TARGET_CHAIN_NOT_FOUND,
+            V.INVALID_ENDORSER_TRANSACTION,
+            V.ENDORSEMENT_POLICY_FAILURE,
+            V.VALID,
+        ]
+        got = [flags.flag(i) for i in range(len(envs))]
+        assert got == expected
+        # metadata write parity: uint8 array in TRANSACTIONS_FILTER slot
+        assert block.metadata.metadata[common_pb2.TRANSACTIONS_FILTER] == bytes(
+            int(c) for c in expected
+        )
+
+    def test_ledger_duplicate(self, net):
+        env = make_tx(net)
+        payload = protoutil.unmarshal(common_pb2.Payload, env.payload)
+        chdr = protoutil.unmarshal(common_pb2.ChannelHeader, payload.header.channel_header)
+        block = make_block([env])
+        flags = validator(net, tx_exists=lambda t: t == chdr.tx_id).validate(block)
+        assert flags.flag(0) == V.DUPLICATE_TXID
+
+    def test_duplicate_endorsements_dedupe(self, net):
+        # The same endorser twice satisfies AND(Org1, Org2) only once ->
+        # dedupe must make this fail (policy.go:383-388 anti-DoS).
+        env = make_tx(net, endorsers=("p1", "p1"))
+        flags = validator(net).validate(make_block([env]))
+        assert flags.flag(0) == V.ENDORSEMENT_POLICY_FAILURE
+
+    def test_revoked_endorser(self, net):
+        org1, org2 = net["org1"], net["org2"]
+        revoked = org1.ca.enroll("peer9.org1.example.com", ou="peer")
+        org1.ca.revoke(revoked)
+        mgr = MSPManager(
+            [org1.msp(provider=PROVIDER, with_crl=True), org2.msp(provider=PROVIDER)]
+        )
+        v = BlockValidator(CHANNEL, mgr, PROVIDER, net["registry"])
+        env = make_tx(
+            {**net, "p1": SigningIdentity(revoked, PROVIDER)},
+        )
+        flags = v.validate(make_block([env]))
+        assert flags.flag(0) == V.ENDORSEMENT_POLICY_FAILURE
+
+    def test_config_tx_valid(self, net):
+        applied = []
+        env = common_pb2.Envelope()
+        payload = common_pb2.Payload()
+        chdr = protoutil.make_channel_header(common_pb2.CONFIG, CHANNEL)
+        payload.header.channel_header = chdr.SerializeToString()
+        shdr = protoutil.make_signature_header(net["client"].serialize(), b"\x01" * 24)
+        payload.header.signature_header = shdr.SerializeToString()
+        payload.data = b"\x0a\x00"  # empty-ish config envelope
+        env.payload = payload.SerializeToString()
+        env.signature = net["client"].sign(env.payload)
+        v = BlockValidator(
+            CHANNEL,
+            net["mgr"],
+            PROVIDER,
+            net["registry"],
+            apply_config=lambda d: applied.append(d),
+        )
+        flags = v.validate(make_block([env]))
+        assert flags.flag(0) == V.VALID
+        assert applied
