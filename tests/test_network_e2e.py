@@ -83,8 +83,9 @@ def test_full_pipeline_two_peers(net, tmp_path):
 
     # 6 txs -> two blocks of 3; tx 4 reads a key at a stale version -> MVCC
     envs = [invoke(net, f"k{i}", f"v{i}".encode()) for i in range(3)]
-    envs.append(invoke(net, "k9", b"x", reads=[("k0", rw.Version(0, 0))]))  # stale
-    envs.append(invoke(net, "k1", b"v1b", reads=[("k1", rw.Version(0, 0))]))  # correct
+    # k0 was committed at height (0,0), k1 at (0,1): tx index = position in block
+    envs.append(invoke(net, "k9", b"x", reads=[("k0", rw.Version(0, 2))]))  # stale
+    envs.append(invoke(net, "k1", b"v1b", reads=[("k1", rw.Version(0, 1))]))  # correct
     envs.append(invoke(net, "k5", b"v5"))
     for env in envs:
         chain.order(env)
