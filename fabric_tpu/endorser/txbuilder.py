@@ -121,6 +121,13 @@ def create_signed_tx(
     endorsements must agree on the proposal response payload."""
     if not responses:
         raise ValueError("at least one proposal response is required")
+    for r in responses:
+        # protoutil.CreateSignedTx rejects non-success endorsements
+        if not (200 <= r.response.status < 400):
+            raise ValueError(
+                f"proposal response was not successful, error code "
+                f"{r.response.status}, msg {r.response.message}"
+            )
     payload_bytes = responses[0].payload
     for r in responses[1:]:
         if r.payload != payload_bytes:

@@ -1,0 +1,59 @@
+"""Idemix anonymous-credential suite (reference idemix/ package).
+
+BBS+-style credentials and signatures of knowledge on FP256BN
+(fabric_tpu.crypto.fp256bn host oracle; batched device kernels in
+fabric_tpu.ops). Wire messages in fabric_tpu.protos.idemix_pb2 are
+field-compatible with the reference's idemix.proto.
+"""
+
+from fabric_tpu.idemix.scheme import (
+    ALG_NO_REVOCATION,
+    IdemixError,
+    ecp2_from_proto,
+    ecp2_to_proto,
+    ecp_from_proto,
+    ecp_to_proto,
+    check_issuer_public_key,
+    create_cri,
+    generate_long_term_revocation_key,
+    make_nym,
+    new_cred_request,
+    new_credential,
+    new_issuer_key,
+    new_nym_signature,
+    new_signature,
+    verify_cred_request,
+    verify_credential,
+    verify_epoch_pk,
+    verify_nym_signature,
+    verify_signature,
+    wbb_keygen,
+    wbb_sign,
+    wbb_verify,
+)
+
+__all__ = [
+    "ALG_NO_REVOCATION",
+    "IdemixError",
+    "ecp2_from_proto",
+    "ecp2_to_proto",
+    "ecp_from_proto",
+    "ecp_to_proto",
+    "check_issuer_public_key",
+    "create_cri",
+    "generate_long_term_revocation_key",
+    "make_nym",
+    "new_cred_request",
+    "new_credential",
+    "new_issuer_key",
+    "new_nym_signature",
+    "new_signature",
+    "verify_cred_request",
+    "verify_credential",
+    "verify_epoch_pk",
+    "verify_nym_signature",
+    "verify_signature",
+    "wbb_keygen",
+    "wbb_sign",
+    "wbb_verify",
+]

@@ -1,0 +1,744 @@
+"""Idemix credential scheme on FP256BN (reference idemix/*.go).
+
+Implements, with byte-exact Fiat-Shamir transcripts:
+- issuer key generation + public-key ZK proof (issuerkey.go)
+- credential request (credrequest.go)
+- credential issuance/verification, a BBS+ signature (credential.go)
+- signature of knowledge over a credential (signature.go NewSignature/Ver)
+- pseudonym signatures (nymsignature.go)
+- weak Boneh-Boyen signatures (weak-bb.go)
+- revocation authority: long-term ECDSA-P384 key, per-epoch CRI
+  (revocation_authority.go); only ALG_NO_REVOCATION is implemented, as
+  in the reference.
+
+All transcript layouts (labels, G1/G2/BIG byte appends, double-hash with
+nonce) mirror idemix/signature.go:161-194 and friends so that a signature
+produced here verifies under any faithful implementation and vice versa.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+from fabric_tpu.crypto import fp256bn as bn
+from fabric_tpu.protos import idemix_pb2
+
+SIGN_LABEL = b"sign"
+CRED_REQUEST_LABEL = b"credRequest"
+
+ALG_NO_REVOCATION = 0
+
+# per-algorithm byte length of the non-revocation FS contribution
+PROOF_BYTES = {ALG_NO_REVOCATION: 0}
+
+FIELD_BYTES = bn.FIELD_BYTES
+G1_BYTES = 2 * FIELD_BYTES + 1
+
+
+class IdemixError(Exception):
+    pass
+
+
+# --------------------------------------------------------------------------
+# proto converters (util.go EcpToProto & co.)
+# --------------------------------------------------------------------------
+
+
+def ecp_to_proto(pt: bn.G1Point) -> idemix_pb2.ECP:
+    out = idemix_pb2.ECP()
+    out.x = bn.big_to_bytes(pt[0] if pt else 0)
+    out.y = bn.big_to_bytes(pt[1] if pt else 0)
+    return out
+
+
+def ecp_from_proto(msg: idemix_pb2.ECP) -> bn.G1Point:
+    pt = (bn.big_from_bytes(msg.x), bn.big_from_bytes(msg.y))
+    if pt == (0, 0):
+        return None
+    if not bn.g1_is_on_curve(pt):
+        raise IdemixError("G1 point not on curve")
+    return pt
+
+
+def ecp2_to_proto(pt: bn.G2Point) -> idemix_pb2.ECP2:
+    out = idemix_pb2.ECP2()
+    (xa, xb), (ya, yb) = pt if pt else ((0, 0), (0, 0))
+    out.xa = bn.big_to_bytes(xa)
+    out.xb = bn.big_to_bytes(xb)
+    out.ya = bn.big_to_bytes(ya)
+    out.yb = bn.big_to_bytes(yb)
+    return out
+
+
+def ecp2_from_proto(msg: idemix_pb2.ECP2) -> bn.G2Point:
+    pt = (
+        (bn.big_from_bytes(msg.xa), bn.big_from_bytes(msg.xb)),
+        (bn.big_from_bytes(msg.ya), bn.big_from_bytes(msg.yb)),
+    )
+    if pt == ((0, 0), (0, 0)):
+        return None
+    if not bn.g2_is_on_curve(pt):
+        raise IdemixError("G2 point not on twist")
+    return pt
+
+
+def _append_g1(buf: bytearray, pt: bn.G1Point) -> None:
+    buf += bn.g1_to_bytes(pt)
+
+
+def _append_g2(buf: bytearray, pt: bn.G2Point) -> None:
+    buf += bn.g2_to_bytes(pt)
+
+
+def _append_big(buf: bytearray, v: int) -> None:
+    buf += bn.big_to_bytes(v)
+
+
+def _hidden_indices(disclosure: Sequence[int]) -> List[int]:
+    return [i for i, d in enumerate(disclosure) if d == 0]
+
+
+def _mod(a: int) -> int:
+    return a % bn.R
+
+
+# --------------------------------------------------------------------------
+# Issuer key (issuerkey.go)
+# --------------------------------------------------------------------------
+
+
+def new_issuer_key(attribute_names: Sequence[str], rng) -> idemix_pb2.IssuerKey:
+    if len(set(attribute_names)) != len(attribute_names):
+        raise IdemixError("attribute list contains duplicates")
+
+    isk = bn.rand_mod_order(rng)
+    key = idemix_pb2.IssuerKey()
+    key.isk = bn.big_to_bytes(isk)
+    ipk = key.ipk
+    ipk.attribute_names.extend(attribute_names)
+
+    w = bn.g2_mul(bn.G2_GEN, isk)
+    ipk.w.CopyFrom(ecp2_to_proto(w))
+
+    for _ in attribute_names:
+        ipk.h_attrs.append(
+            ecp_to_proto(bn.g1_mul(bn.G1_GEN, bn.rand_mod_order(rng)))
+        )
+    h_sk = bn.g1_mul(bn.G1_GEN, bn.rand_mod_order(rng))
+    ipk.h_sk.CopyFrom(ecp_to_proto(h_sk))
+    h_rand = bn.g1_mul(bn.G1_GEN, bn.rand_mod_order(rng))
+    ipk.h_rand.CopyFrom(ecp_to_proto(h_rand))
+    bar_g1 = bn.g1_mul(bn.G1_GEN, bn.rand_mod_order(rng))
+    ipk.bar_g1.CopyFrom(ecp_to_proto(bar_g1))
+    bar_g2 = bn.g1_mul(bar_g1, isk)
+    ipk.bar_g2.CopyFrom(ecp_to_proto(bar_g2))
+
+    # ZK PoK of isk in W and BarG2 (issuerkey.go:76-100)
+    r = bn.rand_mod_order(rng)
+    t1 = bn.g2_mul(bn.G2_GEN, r)
+    t2 = bn.g1_mul(bar_g1, r)
+    buf = bytearray()
+    _append_g2(buf, t1)
+    _append_g1(buf, t2)
+    _append_g2(buf, bn.G2_GEN)
+    _append_g1(buf, bar_g1)
+    _append_g2(buf, w)
+    _append_g1(buf, bar_g2)
+    proof_c = bn.hash_mod_order(bytes(buf))
+    ipk.proof_c = bn.big_to_bytes(proof_c)
+    ipk.proof_s = bn.big_to_bytes(_mod(proof_c * isk + r))
+
+    ipk.hash = bn.big_to_bytes(
+        bn.hash_mod_order(ipk.SerializeToString())
+    )
+    return key
+
+
+def check_issuer_public_key(ipk: idemix_pb2.IssuerPublicKey) -> None:
+    """IssuerPublicKey.Check: well-formedness + PoK verify; recomputes
+    the embedded hash (SetHash)."""
+    num_attrs = len(ipk.attribute_names)
+    if len(ipk.h_attrs) < num_attrs:
+        raise IdemixError("some part of the public key is undefined")
+    h_sk = ecp_from_proto(ipk.h_sk)
+    h_rand = ecp_from_proto(ipk.h_rand)
+    bar_g1 = ecp_from_proto(ipk.bar_g1)
+    bar_g2 = ecp_from_proto(ipk.bar_g2)
+    w = ecp2_from_proto(ipk.w)
+    if h_sk is None or h_rand is None or bar_g1 is None:
+        raise IdemixError("some part of the public key is undefined")
+    proof_c = bn.big_from_bytes(ipk.proof_c)
+    proof_s = bn.big_from_bytes(ipk.proof_s)
+
+    neg_c = _mod(-proof_c)
+    t1 = bn.g2_add(bn.g2_mul(bn.G2_GEN, proof_s), bn.g2_mul(w, neg_c))
+    t2 = bn.g1_add(bn.g1_mul(bar_g1, proof_s), bn.g1_mul(bar_g2, neg_c))
+    buf = bytearray()
+    _append_g2(buf, t1)
+    _append_g1(buf, t2)
+    _append_g2(buf, bn.G2_GEN)
+    _append_g1(buf, bar_g1)
+    _append_g2(buf, w)
+    _append_g1(buf, bar_g2)
+    if proof_c != bn.hash_mod_order(bytes(buf)):
+        raise IdemixError("zero knowledge proof in public key invalid")
+
+    tmp = idemix_pb2.IssuerPublicKey()
+    tmp.CopyFrom(ipk)
+    tmp.hash = b""
+    ipk.hash = bn.big_to_bytes(bn.hash_mod_order(tmp.SerializeToString()))
+
+
+# --------------------------------------------------------------------------
+# Credential request (credrequest.go)
+# --------------------------------------------------------------------------
+
+
+def new_cred_request(
+    sk: int, issuer_nonce: bytes, ipk: idemix_pb2.IssuerPublicKey, rng
+) -> idemix_pb2.CredRequest:
+    h_sk = ecp_from_proto(ipk.h_sk)
+    nym = bn.g1_mul(h_sk, sk)
+    r_sk = bn.rand_mod_order(rng)
+    t = bn.g1_mul(h_sk, r_sk)
+    buf = bytearray()
+    buf += CRED_REQUEST_LABEL
+    _append_g1(buf, t)
+    _append_g1(buf, h_sk)
+    _append_g1(buf, nym)
+    buf += issuer_nonce
+    buf += ipk.hash
+    proof_c = bn.hash_mod_order(bytes(buf))
+    proof_s = _mod(proof_c * sk + r_sk)
+
+    out = idemix_pb2.CredRequest()
+    out.nym.CopyFrom(ecp_to_proto(nym))
+    out.issuer_nonce = issuer_nonce
+    out.proof_c = bn.big_to_bytes(proof_c)
+    out.proof_s = bn.big_to_bytes(proof_s)
+    return out
+
+
+def verify_cred_request(
+    req: idemix_pb2.CredRequest, ipk: idemix_pb2.IssuerPublicKey
+) -> None:
+    nym = ecp_from_proto(req.nym)
+    proof_c = bn.big_from_bytes(req.proof_c)
+    proof_s = bn.big_from_bytes(req.proof_s)
+    h_sk = ecp_from_proto(ipk.h_sk)
+    t = bn.g1_add(
+        bn.g1_mul(h_sk, proof_s), bn.g1_neg(bn.g1_mul(nym, proof_c))
+    )
+    buf = bytearray()
+    buf += CRED_REQUEST_LABEL
+    _append_g1(buf, t)
+    _append_g1(buf, h_sk)
+    _append_g1(buf, nym)
+    buf += req.issuer_nonce
+    buf += ipk.hash
+    if proof_c != bn.hash_mod_order(bytes(buf)):
+        raise IdemixError("zero knowledge proof is invalid")
+
+
+# --------------------------------------------------------------------------
+# Credential = BBS+ signature (credential.go)
+# --------------------------------------------------------------------------
+
+
+def _attr_bases_product(
+    ipk: idemix_pb2.IssuerPublicKey, scalars: Sequence[int]
+) -> bn.G1Point:
+    """prod_i HAttrs[i]^scalars[i]."""
+    acc: bn.G1Point = None
+    for base, s in zip(ipk.h_attrs, scalars):
+        acc = bn.g1_add(acc, bn.g1_mul(ecp_from_proto(base), s))
+    return acc
+
+
+def new_credential(
+    key: idemix_pb2.IssuerKey,
+    req: idemix_pb2.CredRequest,
+    attrs: Sequence[int],
+    rng,
+) -> idemix_pb2.Credential:
+    verify_cred_request(req, key.ipk)
+    if len(attrs) != len(key.ipk.attribute_names):
+        raise IdemixError("incorrect number of attribute values passed")
+
+    e = bn.rand_mod_order(rng)
+    s = bn.rand_mod_order(rng)
+
+    b = bn.G1_GEN
+    b = bn.g1_add(b, ecp_from_proto(req.nym))
+    b = bn.g1_add(b, bn.g1_mul(ecp_from_proto(key.ipk.h_rand), s))
+    b = bn.g1_add(b, _attr_bases_product(key.ipk, attrs))
+
+    isk = bn.big_from_bytes(key.isk)
+    exp = pow(_mod(isk + e), bn.R - 2, bn.R)  # 1/(e + isk) mod r
+    a = bn.g1_mul(b, exp)
+
+    out = idemix_pb2.Credential()
+    out.a.CopyFrom(ecp_to_proto(a))
+    out.b.CopyFrom(ecp_to_proto(b))
+    out.e = bn.big_to_bytes(e)
+    out.s = bn.big_to_bytes(s)
+    out.attrs.extend(bn.big_to_bytes(v) for v in attrs)
+    return out
+
+
+def verify_credential(
+    cred: idemix_pb2.Credential, sk: int, ipk: idemix_pb2.IssuerPublicKey
+) -> None:
+    a = ecp_from_proto(cred.a)
+    b = ecp_from_proto(cred.b)
+    e = bn.big_from_bytes(cred.e)
+    s = bn.big_from_bytes(cred.s)
+    attrs = [bn.big_from_bytes(v) for v in cred.attrs]
+
+    b_prime = bn.G1_GEN
+    b_prime = bn.g1_add(
+        b_prime,
+        bn.g1_mul2(
+            ecp_from_proto(ipk.h_sk), sk, ecp_from_proto(ipk.h_rand), s
+        ),
+    )
+    b_prime = bn.g1_add(b_prime, _attr_bases_product(ipk, attrs))
+    if b != b_prime:
+        raise IdemixError(
+            "b-value from credential does not match the attribute values"
+        )
+
+    # e(w * g2^e, A) == e(g2, B)
+    lhs_g2 = bn.g2_add(bn.g2_mul(bn.G2_GEN, e), ecp2_from_proto(ipk.w))
+    left = bn.pairing(lhs_g2, a)
+    right = bn.pairing(bn.G2_GEN, b)
+    if left != right:
+        raise IdemixError("credential is not cryptographically valid")
+
+
+# --------------------------------------------------------------------------
+# Pseudonyms (util.go MakeNym)
+# --------------------------------------------------------------------------
+
+
+def make_nym(
+    sk: int, ipk: idemix_pb2.IssuerPublicKey, rng
+) -> Tuple[bn.G1Point, int]:
+    rand_nym = bn.rand_mod_order(rng)
+    nym = bn.g1_mul2(
+        ecp_from_proto(ipk.h_sk), sk, ecp_from_proto(ipk.h_rand), rand_nym
+    )
+    return nym, rand_nym
+
+
+# --------------------------------------------------------------------------
+# Signature of knowledge (signature.go)
+# --------------------------------------------------------------------------
+
+
+def new_signature(
+    cred: idemix_pb2.Credential,
+    sk: int,
+    nym: bn.G1Point,
+    r_nym: int,
+    ipk: idemix_pb2.IssuerPublicKey,
+    disclosure: Sequence[int],
+    msg: bytes,
+    rh_index: int,
+    cri: idemix_pb2.CredentialRevocationInformation,
+    rng,
+) -> idemix_pb2.Signature:
+    if rh_index < 0 or rh_index >= len(ipk.attribute_names) or len(
+        disclosure
+    ) != len(ipk.attribute_names):
+        raise IdemixError("cannot create idemix signature: invalid input")
+    if cri.revocation_alg != ALG_NO_REVOCATION and disclosure[rh_index] == 1:
+        raise IdemixError("revocation handle attribute must remain hidden")
+    if cri.revocation_alg != ALG_NO_REVOCATION:
+        raise IdemixError(
+            f"unknown revocation algorithm {cri.revocation_alg}"
+        )
+
+    hidden = _hidden_indices(disclosure)
+
+    r1 = bn.rand_mod_order(rng)
+    r2 = bn.rand_mod_order(rng)
+    r3 = pow(r1, bn.R - 2, bn.R)
+    nonce = bn.rand_mod_order(rng)
+
+    a = ecp_from_proto(cred.a)
+    b = ecp_from_proto(cred.b)
+    e = bn.big_from_bytes(cred.e)
+    s = bn.big_from_bytes(cred.s)
+
+    a_prime = bn.g1_mul(a, r1)
+    a_bar = bn.g1_add(bn.g1_mul(b, r1), bn.g1_neg(bn.g1_mul(a_prime, e)))
+    h_rand = ecp_from_proto(ipk.h_rand)
+    h_sk = ecp_from_proto(ipk.h_sk)
+    b_prime = bn.g1_add(bn.g1_mul(b, r1), bn.g1_neg(bn.g1_mul(h_rand, r2)))
+
+    s_prime = _mod(s - r2 * r3)
+
+    r_sk = bn.rand_mod_order(rng)
+    r_e = bn.rand_mod_order(rng)
+    r_r2 = bn.rand_mod_order(rng)
+    r_r3 = bn.rand_mod_order(rng)
+    r_s_prime = bn.rand_mod_order(rng)
+    r_r_nym = bn.rand_mod_order(rng)
+    r_attrs = [bn.rand_mod_order(rng) for _ in hidden]
+
+    # non-revocation FS contribution: empty for ALG_NO_REVOCATION
+    non_revoked_hash_data = b""
+
+    # t-values (signature.go:136-159)
+    t1 = bn.g1_mul2(a_prime, r_e, h_rand, r_r2)
+    t2 = bn.g1_add(
+        bn.g1_mul(h_rand, r_s_prime), bn.g1_mul2(b_prime, r_r3, h_sk, r_sk)
+    )
+    t2 = bn.g1_add(
+        t2,
+        _attr_bases_product_indices(ipk, hidden, r_attrs),
+    )
+    t3 = bn.g1_mul2(h_sk, r_sk, h_rand, r_r_nym)
+
+    c = _signature_challenge(
+        t1, t2, t3, a_prime, a_bar, b_prime, nym,
+        non_revoked_hash_data, ipk.hash, disclosure, msg,
+    )
+    proof_c = _second_challenge(c, nonce)
+
+    proof_s_sk = _mod(r_sk + proof_c * sk)
+    proof_s_e = _mod(r_e - proof_c * e)
+    proof_s_r2 = _mod(r_r2 + proof_c * r2)
+    proof_s_r3 = _mod(r_r3 - proof_c * r3)
+    proof_s_s_prime = _mod(r_s_prime + proof_c * s_prime)
+    proof_s_r_nym = _mod(r_r_nym + proof_c * r_nym)
+    proof_s_attrs = [
+        bn.big_to_bytes(
+            _mod(r_attrs[i] + proof_c * bn.big_from_bytes(cred.attrs[j]))
+        )
+        for i, j in enumerate(hidden)
+    ]
+
+    sig = idemix_pb2.Signature()
+    sig.a_prime.CopyFrom(ecp_to_proto(a_prime))
+    sig.a_bar.CopyFrom(ecp_to_proto(a_bar))
+    sig.b_prime.CopyFrom(ecp_to_proto(b_prime))
+    sig.proof_c = bn.big_to_bytes(proof_c)
+    sig.proof_s_sk = bn.big_to_bytes(proof_s_sk)
+    sig.proof_s_e = bn.big_to_bytes(proof_s_e)
+    sig.proof_s_r2 = bn.big_to_bytes(proof_s_r2)
+    sig.proof_s_r3 = bn.big_to_bytes(proof_s_r3)
+    sig.proof_s_s_prime = bn.big_to_bytes(proof_s_s_prime)
+    sig.proof_s_attrs.extend(proof_s_attrs)
+    sig.nonce = bn.big_to_bytes(nonce)
+    sig.nym.CopyFrom(ecp_to_proto(nym))
+    sig.proof_s_r_nym = bn.big_to_bytes(proof_s_r_nym)
+    sig.revocation_epoch_pk.CopyFrom(cri.epoch_pk)
+    sig.revocation_pk_sig = cri.epoch_pk_sig
+    sig.epoch = cri.epoch
+    sig.non_revocation_proof.revocation_alg = ALG_NO_REVOCATION
+    return sig
+
+
+def _attr_bases_product_indices(
+    ipk: idemix_pb2.IssuerPublicKey,
+    indices: Sequence[int],
+    scalars: Sequence[int],
+) -> bn.G1Point:
+    acc: bn.G1Point = None
+    for idx, s in zip(indices, scalars):
+        acc = bn.g1_add(acc, bn.g1_mul(ecp_from_proto(ipk.h_attrs[idx]), s))
+    return acc
+
+
+def _signature_challenge(
+    t1, t2, t3, a_prime, a_bar, b_prime, nym,
+    non_revoked_bytes: bytes, ipk_hash: bytes,
+    disclosure: Sequence[int], msg: bytes,
+) -> int:
+    """First Fiat-Shamir hash over the fixed transcript layout
+    (signature.go:161-187)."""
+    buf = bytearray()
+    buf += SIGN_LABEL
+    for pt in (t1, t2, t3, a_prime, a_bar, b_prime, nym):
+        _append_g1(buf, pt)
+    buf += non_revoked_bytes
+    buf += ipk_hash
+    buf += bytes(disclosure)
+    buf += msg
+    return bn.hash_mod_order(bytes(buf))
+
+
+def _second_challenge(c: int, nonce: int) -> int:
+    """signature.go:189-194: ProofC = H(c || nonce)."""
+    buf = bytearray()
+    _append_big(buf, c)
+    _append_big(buf, nonce)
+    return bn.hash_mod_order(bytes(buf))
+
+
+def verify_signature(
+    sig: idemix_pb2.Signature,
+    disclosure: Sequence[int],
+    ipk: idemix_pb2.IssuerPublicKey,
+    msg: bytes,
+    attribute_values: Sequence[Optional[int]],
+    rh_index: int,
+    rev_pk,
+    epoch: int,
+) -> None:
+    """Signature.Ver (signature.go:243-405). attribute_values[i] is
+    checked for each disclosed attribute i. rev_pk is the revocation
+    authority's long-term ECDSA public key (may be None to skip the
+    epoch-PK check the way the reference's msp layer does when no
+    revocation is configured)."""
+    if rh_index < 0 or rh_index >= len(ipk.attribute_names) or len(
+        disclosure
+    ) != len(ipk.attribute_names):
+        raise IdemixError("cannot verify idemix signature: invalid input")
+    alg = sig.non_revocation_proof.revocation_alg
+    if alg != ALG_NO_REVOCATION:
+        raise IdemixError(f"unknown revocation algorithm {alg}")
+    if alg != ALG_NO_REVOCATION and disclosure[rh_index] == 1:
+        raise IdemixError("revocation handle must remain hidden")
+
+    hidden = _hidden_indices(disclosure)
+
+    a_prime = ecp_from_proto(sig.a_prime)
+    a_bar = ecp_from_proto(sig.a_bar)
+    b_prime = ecp_from_proto(sig.b_prime)
+    nym = ecp_from_proto(sig.nym)
+    proof_c = bn.big_from_bytes(sig.proof_c)
+    proof_s_sk = bn.big_from_bytes(sig.proof_s_sk)
+    proof_s_e = bn.big_from_bytes(sig.proof_s_e)
+    proof_s_r2 = bn.big_from_bytes(sig.proof_s_r2)
+    proof_s_r3 = bn.big_from_bytes(sig.proof_s_r3)
+    proof_s_s_prime = bn.big_from_bytes(sig.proof_s_s_prime)
+    proof_s_r_nym = bn.big_from_bytes(sig.proof_s_r_nym)
+    if len(sig.proof_s_attrs) != len(hidden):
+        raise IdemixError(
+            "signature invalid: incorrect amount of s-values for "
+            "AttributeProofSpec"
+        )
+    proof_s_attrs = [bn.big_from_bytes(v) for v in sig.proof_s_attrs]
+    nonce = bn.big_from_bytes(sig.nonce)
+
+    w = ecp2_from_proto(ipk.w)
+    h_rand = ecp_from_proto(ipk.h_rand)
+    h_sk = ecp_from_proto(ipk.h_sk)
+
+    if a_prime is None:
+        raise IdemixError("signature invalid: APrime = 1")
+
+    # pairing check: e(W, A') * e(g2, ABar)^-1 == 1 (Ate output is not
+    # unitary, so a true Fp12 inverse is needed, not the conjugate)
+    t = bn.fp12_mul(
+        bn.ate(w, a_prime), bn.fp12_inv(bn.ate(bn.G2_GEN, a_bar))
+    )
+    if not bn.gt_is_unity(bn.fexp(t)):
+        raise IdemixError(
+            "signature invalid: APrime and ABar don't have the expected "
+            "structure"
+        )
+
+    # recompute t1
+    t1 = bn.g1_mul2(a_prime, proof_s_e, h_rand, proof_s_r2)
+    temp = bn.g1_add(a_bar, bn.g1_neg(b_prime))
+    t1 = bn.g1_add(t1, bn.g1_neg(bn.g1_mul(temp, proof_c)))
+
+    # recompute t2
+    t2 = bn.g1_add(
+        bn.g1_mul(h_rand, proof_s_s_prime),
+        bn.g1_mul2(b_prime, proof_s_r3, h_sk, proof_s_sk),
+    )
+    t2 = bn.g1_add(
+        t2, _attr_bases_product_indices(ipk, hidden, proof_s_attrs)
+    )
+    temp = bn.G1_GEN
+    for index, disclose in enumerate(disclosure):
+        if disclose != 0:
+            temp = bn.g1_add(
+                temp,
+                bn.g1_mul(
+                    ecp_from_proto(ipk.h_attrs[index]),
+                    attribute_values[index],
+                ),
+            )
+    t2 = bn.g1_add(t2, bn.g1_mul(temp, proof_c))
+
+    # recompute t3
+    t3 = bn.g1_mul2(h_sk, proof_s_sk, h_rand, proof_s_r_nym)
+    t3 = bn.g1_add(t3, bn.g1_neg(bn.g1_mul(nym, proof_c)))
+
+    non_revoked_bytes = b""  # ALG_NO_REVOCATION recompute contribution
+
+    c = _signature_challenge(
+        t1, t2, t3, a_prime, a_bar, b_prime, nym,
+        non_revoked_bytes, ipk.hash, disclosure, msg,
+    )
+    if proof_c != _second_challenge(c, nonce):
+        raise IdemixError(
+            "signature invalid: zero-knowledge proof is invalid"
+        )
+
+
+# --------------------------------------------------------------------------
+# Nym signatures (nymsignature.go)
+# --------------------------------------------------------------------------
+
+
+def new_nym_signature(
+    sk: int,
+    nym: bn.G1Point,
+    r_nym: int,
+    ipk: idemix_pb2.IssuerPublicKey,
+    msg: bytes,
+    rng,
+) -> idemix_pb2.NymSignature:
+    nonce = bn.rand_mod_order(rng)
+    h_rand = ecp_from_proto(ipk.h_rand)
+    h_sk = ecp_from_proto(ipk.h_sk)
+
+    r_sk = bn.rand_mod_order(rng)
+    r_r_nym = bn.rand_mod_order(rng)
+    t = bn.g1_mul2(h_sk, r_sk, h_rand, r_r_nym)
+
+    c = _nym_challenge(t, nym, ipk.hash, msg)
+    proof_c = _second_challenge(c, nonce)
+
+    out = idemix_pb2.NymSignature()
+    out.proof_c = bn.big_to_bytes(proof_c)
+    out.proof_s_sk = bn.big_to_bytes(_mod(r_sk + proof_c * sk))
+    out.proof_s_r_nym = bn.big_to_bytes(_mod(r_r_nym + proof_c * r_nym))
+    out.nonce = bn.big_to_bytes(nonce)
+    return out
+
+
+def _nym_challenge(t, nym, ipk_hash: bytes, msg: bytes) -> int:
+    buf = bytearray()
+    buf += SIGN_LABEL
+    _append_g1(buf, t)
+    _append_g1(buf, nym)
+    buf += ipk_hash
+    buf += msg
+    return bn.hash_mod_order(bytes(buf))
+
+
+def verify_nym_signature(
+    sig: idemix_pb2.NymSignature,
+    nym: bn.G1Point,
+    ipk: idemix_pb2.IssuerPublicKey,
+    msg: bytes,
+) -> None:
+    proof_c = bn.big_from_bytes(sig.proof_c)
+    proof_s_sk = bn.big_from_bytes(sig.proof_s_sk)
+    proof_s_r_nym = bn.big_from_bytes(sig.proof_s_r_nym)
+    nonce = bn.big_from_bytes(sig.nonce)
+    h_rand = ecp_from_proto(ipk.h_rand)
+    h_sk = ecp_from_proto(ipk.h_sk)
+
+    t = bn.g1_mul2(h_sk, proof_s_sk, h_rand, proof_s_r_nym)
+    t = bn.g1_add(t, bn.g1_neg(bn.g1_mul(nym, proof_c)))
+
+    c = _nym_challenge(t, nym, ipk.hash, msg)
+    if proof_c != _second_challenge(c, nonce):
+        raise IdemixError(
+            "pseudonym signature invalid: zero-knowledge proof is invalid"
+        )
+
+
+# --------------------------------------------------------------------------
+# Weak Boneh-Boyen signatures (weak-bb.go)
+# --------------------------------------------------------------------------
+
+
+def wbb_keygen(rng) -> Tuple[int, bn.G2Point]:
+    sk = bn.rand_mod_order(rng)
+    return sk, bn.g2_mul(bn.G2_GEN, sk)
+
+
+def wbb_sign(sk: int, m: int) -> bn.G1Point:
+    exp = pow(_mod(sk + m), bn.R - 2, bn.R)
+    return bn.g1_mul(bn.G1_GEN, exp)
+
+
+_GEN_GT = None
+
+
+def _gen_gt():
+    global _GEN_GT
+    if _GEN_GT is None:
+        _GEN_GT = bn.pairing(bn.G2_GEN, bn.G1_GEN)
+    return _GEN_GT
+
+
+def wbb_verify(pk: bn.G2Point, sig: bn.G1Point, m: int) -> None:
+    if pk is None or sig is None:
+        raise IdemixError("Weak-BB signature invalid: received nil input")
+    p = bn.g2_add(pk, bn.g2_mul(bn.G2_GEN, m))
+    if bn.pairing(p, sig) != _gen_gt():
+        raise IdemixError("Weak-BB signature is invalid")
+
+
+# --------------------------------------------------------------------------
+# Revocation authority (revocation_authority.go)
+# --------------------------------------------------------------------------
+
+
+def generate_long_term_revocation_key():
+    """Long-term revocation key: ECDSA on P-384 like the reference."""
+    from cryptography.hazmat.primitives.asymmetric import ec
+
+    return ec.generate_private_key(ec.SECP384R1())
+
+
+def create_cri(
+    key, unrevoked_handles: Sequence[int], epoch: int, alg: int, rng
+) -> idemix_pb2.CredentialRevocationInformation:
+    if alg != ALG_NO_REVOCATION:
+        raise IdemixError(
+            "the specified revocation algorithm is not supported."
+        )
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric import ec
+
+    cri = idemix_pb2.CredentialRevocationInformation()
+    cri.revocation_alg = alg
+    cri.epoch = epoch
+    cri.epoch_pk.CopyFrom(ecp2_to_proto(bn.G2_GEN))  # dummy PK
+
+    to_sign = cri.SerializeToString()
+    digest = hashlib.sha256(to_sign).digest()
+    cri.epoch_pk_sig = key.sign(
+        digest, ec.ECDSA(Prehashed_sha256())
+    )
+    return cri
+
+
+def Prehashed_sha256():
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric.utils import Prehashed
+
+    return Prehashed(hashes.SHA256())
+
+
+def verify_epoch_pk(
+    pk, epoch_pk: idemix_pb2.ECP2, epoch_pk_sig: bytes, epoch: int, alg: int
+) -> None:
+    """VerifyEpochPK: check the revocation authority's signature over the
+    (alg, epoch_pk, epoch) CRI prefix."""
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives.asymmetric import ec
+
+    cri = idemix_pb2.CredentialRevocationInformation()
+    cri.revocation_alg = alg
+    cri.epoch_pk.CopyFrom(epoch_pk)
+    cri.epoch = epoch
+    digest = hashlib.sha256(cri.SerializeToString()).digest()
+    try:
+        pk.verify(epoch_pk_sig, digest, ec.ECDSA(Prehashed_sha256()))
+    except InvalidSignature as e:
+        raise IdemixError("EpochPKSig invalid") from e

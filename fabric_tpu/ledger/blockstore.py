@@ -93,9 +93,9 @@ class BlockStore:
                     if len(raw) != ln:
                         break  # partial tail write -> truncate
                     block = protoutil.unmarshal(common_pb2.Block, raw)
+                    self._index_block(block, off)
                 except ValueError:
-                    break
-                self._index_block(block, off)
+                    break  # parseable-but-wrong tail (e.g. torn re-append)
                 valid_end = f.tell()
         size = os.path.getsize(self.path)
         if size != valid_end:
@@ -105,7 +105,8 @@ class BlockStore:
 
     def _index_block(self, block: common_pb2.Block, offset: int) -> None:
         num = block.header.number
-        assert num == len(self._offsets), f"out-of-order block {num}"
+        if num != len(self._offsets):
+            raise ValueError(f"out-of-order block {num}")
         self._offsets.append(offset)
         h = protoutil.block_header_hash(block.header)
         self._by_hash[h] = num

@@ -64,12 +64,17 @@ def deterministic_update_bytes(
     public writes then collections (sorted), keys sorted; namespace/
     collection fields set only on the first entry of each group; the empty
     namespace (channel config) is skipped."""
+    # NB: metadata is deliberately excluded from the commit hash —
+    # reference update_batch_bytes.go only serializes value writes.
     pub_by_ns: Dict[str, Dict[str, Tuple[Optional[bytes], Version]]] = {}
-    for (ns, key), (value, version) in updates.items():
-        pub_by_ns.setdefault(ns, {})[key] = (value, version)
+    for (ns, key), entry in updates.items():
+        pub_by_ns.setdefault(ns, {})[key] = (entry.value, entry.version)
     hashed_by_ns: Dict[str, Dict[str, Dict[bytes, Tuple[Optional[bytes], Version]]]] = {}
-    for (ns, coll, key_hash), (vh, version) in hashed.items():
-        hashed_by_ns.setdefault(ns, {}).setdefault(coll, {})[key_hash] = (vh, version)
+    for (ns, coll, key_hash), entry in hashed.items():
+        hashed_by_ns.setdefault(ns, {}).setdefault(coll, {})[key_hash] = (
+            entry.value,
+            entry.version,
+        )
 
     msg = txmgr_updates_pb2.Updates()
     for ns in sorted(set(pub_by_ns) | set(hashed_by_ns)):
@@ -78,6 +83,9 @@ def deterministic_update_bytes(
         first_in_ns = True
 
         def add(key: bytes, value: Optional[bytes], version: Version, coll: str = ""):
+            # `coll` is set only on the first entry of a collection group
+            # (caller passes "" for the rest), matching the reference's
+            # field-elision rule for both namespace and collection.
             nonlocal first_in_ns
             kv = msg.kvwrites.add()
             if first_in_ns:
@@ -91,25 +99,13 @@ def deterministic_update_bytes(
                 kv.value = value
             kv.version_bytes = version_to_bytes(version)
 
-        for i, key in enumerate(sorted(pub_by_ns.get(ns, {}))):
+        for key in sorted(pub_by_ns.get(ns, {})):
             value, version = pub_by_ns[ns][key]
             add(key.encode(), value, version)
         for coll in sorted(hashed_by_ns.get(ns, {})):
-            first_in_coll = True
-            for key_hash in sorted(hashed_by_ns[ns][coll]):
+            for j, key_hash in enumerate(sorted(hashed_by_ns[ns][coll])):
                 vh, version = hashed_by_ns[ns][coll][key_hash]
-                kv = msg.kvwrites.add()
-                if first_in_ns:
-                    kv.namespace = ns.encode()
-                    first_in_ns = False
-                if first_in_coll:
-                    kv.collection = coll.encode()
-                    first_in_coll = False
-                kv.key = key_hash
-                kv.isDelete = vh is None
-                if vh is not None:
-                    kv.value = vh
-                kv.version_bytes = version_to_bytes(version)
+                add(key_hash, vh, version, coll=coll if j == 0 else "")
     return msg.SerializeToString()
 
 
@@ -130,7 +126,17 @@ class KVLedger:
             self._apply_committed_block(block)
 
     def _apply_committed_block(self, block: common_pb2.Block) -> None:
-        flags, rwsets = self._extract(block)
+        flags = self._extract_flags(block)
+        rwsets = self._extract_rwsets(block)
+        # Restore the COMMIT_HASH chain so post-restart commits keep
+        # chaining from the last stored hash (kv_ledger.go recoverDBs +
+        # addBlockCommitHash: the chain must not reset on restart).
+        metas = block.metadata.metadata
+        if len(metas) > common_pb2.COMMIT_HASH and metas[common_pb2.COMMIT_HASH]:
+            meta = protoutil.unmarshal(
+                common_pb2.Metadata, metas[common_pb2.COMMIT_HASH]
+            )
+            self.commit_hash = meta.value
         codes = [
             TxValidationCode.VALID
             if flags.is_valid(i)
@@ -149,28 +155,34 @@ class KVLedger:
                 )
         self._commit_state(block, updates, hashed)
 
-    def _extract(
-        self, block: common_pb2.Block
-    ) -> Tuple[ValidationFlags, List[Optional[TxRwSet]]]:
+    def _extract_flags(self, block: common_pb2.Block) -> ValidationFlags:
         raw = bytes(block.metadata.metadata[common_pb2.TRANSACTIONS_FILTER])
-        flags = (
+        return (
             ValidationFlags.from_bytes(raw)
             if raw
             else ValidationFlags(len(block.data.data), TxValidationCode.VALID)
         )
-        rwsets: List[Optional[TxRwSet]] = []
-        for i, data in enumerate(block.data.data):
-            parsed = parse_transaction(i, data)
-            rwsets.append(parsed.rwset)
-        return flags, rwsets
+
+    def _extract_rwsets(self, block: common_pb2.Block) -> List[Optional[TxRwSet]]:
+        return [
+            parse_transaction(i, data).rwset
+            for i, data in enumerate(block.data.data)
+        ]
 
     # -- the commit path ---------------------------------------------------
-    def commit(self, block: common_pb2.Block) -> ValidationFlags:
+    def commit(
+        self,
+        block: common_pb2.Block,
+        rwsets: Optional[List[Optional[TxRwSet]]] = None,
+    ) -> ValidationFlags:
         """ValidateAndPrepare + commit (kv_ledger.go commit): assumes the
         block already carries the txvalidator's TRANSACTIONS_FILTER; MVCC
         verdicts are merged in here and the final filter is what gets
-        stored."""
-        flags, rwsets = self._extract(block)
+        stored. `rwsets` lets the caller share the validator's parse pass
+        (hot path); when absent the block is re-decoded (replay path)."""
+        flags = self._extract_flags(block)
+        if rwsets is None:
+            rwsets = self._extract_rwsets(block)
         incoming = [TxValidationCode(int(c)) for c in flags.asarray()]
         validator = Validator(self.state_db)
         codes, updates, hashed = validator.validate_and_prepare_batch(
@@ -202,8 +214,8 @@ class KVLedger:
     def _commit_state(
         self, block: common_pb2.Block, updates: UpdateBatch, hashed: HashedUpdateBatch
     ) -> None:
-        for (ns, key), (value, version) in updates.items():
-            self.history.setdefault((ns, key), []).append(version)
+        for (ns, key), entry in updates.items():
+            self.history.setdefault((ns, key), []).append(entry.version)
         self.state_db.apply_updates(updates, hashed)
 
     # -- queries (qscc analog) --------------------------------------------

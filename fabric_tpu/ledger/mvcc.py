@@ -25,6 +25,28 @@ from __future__ import annotations
 
 from typing import Iterator, List, Optional, Sequence, Tuple
 
+from fabric_tpu.protos import kv_rwset_pb2
+
+
+def serialize_metadata_entries(entries) -> bytes:
+    """statemetadata.Serialize: KVMetadataWrite{entries} proto bytes (the
+    statedb storage form of key metadata)."""
+    msg = kv_rwset_pb2.KVMetadataWrite()
+    for name, value in entries:
+        e = msg.entries.add()
+        e.name = name
+        e.value = value
+    return msg.SerializeToString()
+
+
+def deserialize_metadata(metadata_bytes: Optional[bytes]) -> Optional[dict]:
+    """statemetadata.Deserialize: storage bytes -> {name: value}."""
+    if metadata_bytes is None:
+        return None
+    msg = kv_rwset_pb2.KVMetadataWrite()
+    msg.ParseFromString(metadata_bytes)
+    return {e.name: e.value for e in msg.entries}
+
 from fabric_tpu.ledger.rwset import (
     KVRead,
     RangeQueryInfo,
@@ -68,12 +90,12 @@ def _combined_range_iter(
     cur = next_committed()
     while cur is not None or upd_idx < len(upd_in_range):
         if upd_idx < len(upd_in_range) and (cur is None or upd_in_range[upd_idx][0] <= cur[0]):
-            key, (value, version) = upd_in_range[upd_idx]
+            key, entry = upd_in_range[upd_idx]
             if cur is not None and cur[0] == key:
                 cur = next_committed()  # shadowed
             upd_idx += 1
-            if value is not None:  # deletes yield nothing
-                yield key, version
+            if entry.value is not None:  # deletes yield nothing
+                yield key, entry.version
         else:
             assert cur is not None
             yield cur[0], cur[1].version
@@ -167,7 +189,13 @@ class Validator:
                 return False
         return next(actual, None) is None
 
-    # -- write application (tx_ops.go applyWriteSet, public+hashed) -------
+    # -- write application (tx_ops.go prepareTxOps + applyWriteSet) -------
+    # keyOps flags mirroring tx_ops.go:160-167
+    _UPSERT = 1
+    _MD_UPDATE = 2
+    _MD_DELETE = 4
+    _KEY_DELETE = 8
+
     def _apply_write_set(
         self,
         rwset: TxRwSet,
@@ -175,19 +203,94 @@ class Validator:
         updates: UpdateBatch,
         hashed_updates: HashedUpdateBatch,
     ) -> None:
+        """Apply one VALID tx's writes to the running batch, merging value
+        and metadata updates like the reference's prepareTxOps: a
+        value-only write carries forward the latest metadata, a
+        metadata-only write carries forward the latest value (and is a
+        no-op if the key does not exist)."""
+        txops: dict = {}  # (ns, coll, key) -> [flags, value, metadata]
+
+        def op(ck):
+            return txops.setdefault(ck, [0, None, None])
+
         for ns_rw in rwset.ns_rw_sets:
             ns = ns_rw.namespace
             for w in ns_rw.writes:
+                o = op((ns, "", w.key))
                 if w.is_delete:
-                    updates.delete(ns, w.key, height)
+                    o[0] |= self._KEY_DELETE
                 else:
-                    updates.put(ns, w.key, w.value, height)
+                    o[0] |= self._UPSERT
+                    o[1] = w.value
+            for mw in ns_rw.metadata_writes:
+                o = op((ns, "", mw.key))
+                if mw.entries is None:
+                    o[0] |= self._MD_DELETE
+                else:
+                    o[0] |= self._MD_UPDATE
+                    o[2] = serialize_metadata_entries(mw.entries)
             for coll in ns_rw.coll_hashed:
+                cname = coll.collection_name
                 for hw in coll.hashed_writes:
-                    hashed_updates.put(
-                        ns,
-                        coll.collection_name,
-                        hw.key_hash,
-                        None if hw.is_delete else hw.value_hash,
-                        height,
-                    )
+                    o = op((ns, cname, hw.key_hash))
+                    if hw.is_delete:
+                        o[0] |= self._KEY_DELETE
+                    else:
+                        o[0] |= self._UPSERT
+                        o[1] = hw.value_hash
+                for mw in coll.metadata_writes:
+                    o = op((ns, cname, mw.key_hash))
+                    if mw.entries is None:
+                        o[0] |= self._MD_DELETE
+                    else:
+                        o[0] |= self._MD_UPDATE
+                        o[2] = serialize_metadata_entries(mw.entries)
+
+        for (ns, coll, key), (flags, value, metadata) in txops.items():
+            if flags & self._KEY_DELETE:
+                if coll == "":
+                    updates.delete(ns, key, height)
+                else:
+                    hashed_updates.put(ns, coll, key, None, height)
+                continue
+            upsert = bool(flags & self._UPSERT)
+            md_touched = bool(flags & (self._MD_UPDATE | self._MD_DELETE))
+            if upsert and not md_touched:
+                # merge the latest committed / in-block metadata
+                metadata = self._latest_metadata(
+                    ns, coll, key, updates, hashed_updates
+                )
+            elif md_touched and not upsert:
+                value = self._latest_value(
+                    ns, coll, key, updates, hashed_updates
+                )
+                if value is None:
+                    continue  # metadata on a non-existent key: no-op
+            if coll == "":
+                updates.put(ns, key, value, height, metadata)
+            else:
+                hashed_updates.put(ns, coll, key, value, height, metadata)
+
+    def _latest_value(self, ns, coll, key, updates, hashed_updates):
+        if coll == "":
+            entry = updates.get(ns, key)
+            if entry is not None:
+                return entry.value
+            vv = self.db.get_state(ns, key)
+            return vv.value if vv else None
+        entry = hashed_updates.get(ns, coll, key)
+        if entry is not None:
+            return entry.value
+        vv = self.db.get_hashed_state(ns, coll, key)
+        return vv.value if vv else None
+
+    def _latest_metadata(self, ns, coll, key, updates, hashed_updates):
+        if coll == "":
+            entry = updates.get(ns, key)
+            if entry is not None:
+                return entry.metadata
+            return self.db.get_state_metadata(ns, key)
+        entry = hashed_updates.get(ns, coll, key)
+        if entry is not None:
+            return entry.metadata
+        return self.db.get_hashed_metadata(ns, coll, key)

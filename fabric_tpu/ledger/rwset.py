@@ -53,6 +53,22 @@ class RangeQueryInfo:
 
 
 @dataclass(frozen=True)
+class KVMetadataWrite:
+    """Key-level metadata update (kvrwset.KVMetadataWrite). `entries` is
+    a name->value tuple list; None entries means metadata delete
+    (reference tx_ops.go applyMetadata: nil Entries -> metadataDelete)."""
+
+    key: str
+    entries: Optional[Tuple[Tuple[str, bytes], ...]] = None
+
+
+@dataclass(frozen=True)
+class KVMetadataWriteHash:
+    key_hash: bytes
+    entries: Optional[Tuple[Tuple[str, bytes], ...]] = None
+
+
+@dataclass(frozen=True)
 class KVReadHash:
     key_hash: bytes
     version: Optional[Version]
@@ -70,6 +86,7 @@ class CollHashedRwSet:
     collection_name: str
     hashed_reads: Tuple[KVReadHash, ...] = ()
     hashed_writes: Tuple[KVWriteHash, ...] = ()
+    metadata_writes: Tuple[KVMetadataWriteHash, ...] = ()
 
 
 @dataclass(frozen=True)
@@ -79,6 +96,7 @@ class NsRwSet:
     writes: Tuple[KVWrite, ...] = ()
     range_queries: Tuple[RangeQueryInfo, ...] = ()
     coll_hashed: Tuple[CollHashedRwSet, ...] = ()
+    metadata_writes: Tuple[KVMetadataWrite, ...] = ()
 
 
 @dataclass(frozen=True)

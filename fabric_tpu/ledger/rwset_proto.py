@@ -44,6 +44,13 @@ def serialize_tx_rwset(txrw: rw.TxRwSet) -> bytes:
             kw.key = w.key
             kw.is_delete = w.is_delete
             kw.value = w.value
+        for mw in ns.metadata_writes:
+            m = kv.metadata_writes.add()
+            m.key = mw.key
+            for name, value in mw.entries or ():
+                e = m.entries.add()
+                e.name = name
+                e.value = value
         ns_out = out.ns_rwset.add()
         ns_out.namespace = ns.namespace
         ns_out.rwset = kv.SerializeToString()
@@ -58,6 +65,13 @@ def serialize_tx_rwset(txrw: rw.TxRwSet) -> bytes:
                 m.key_hash = hw.key_hash
                 m.is_delete = hw.is_delete
                 m.value_hash = hw.value_hash
+            for mw in coll.metadata_writes:
+                m = h.metadata_writes.add()
+                m.key_hash = mw.key_hash
+                for name, value in mw.entries or ():
+                    e = m.entries.add()
+                    e.name = name
+                    e.value = value
             c = ns_out.collection_hashed_rwset.add()
             c.collection_name = coll.collection_name
             c.hashed_rwset = h.SerializeToString()

@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import bisect
 from dataclasses import dataclass
-from typing import Dict, Iterator, List, Optional, Tuple
+from typing import Dict, Iterator, List, NamedTuple, Optional, Tuple
 
 from fabric_tpu.ledger.rwset import Version
 
@@ -21,6 +21,17 @@ from fabric_tpu.ledger.rwset import Version
 class VersionedValue:
     value: bytes
     version: Version
+    metadata: Optional[bytes] = None  # serialized KVMetadataWrite entries
+
+
+class BatchEntry(NamedTuple):
+    """One pending update: value None = key delete; metadata is the
+    serialized state metadata carried with the write (None = no
+    metadata / metadata deleted)."""
+
+    value: Optional[bytes]
+    version: Version
+    metadata: Optional[bytes] = None
 
 
 class UpdateBatch:
@@ -28,18 +39,25 @@ class UpdateBatch:
     deletes both carry the committing version; deletes shadow reads."""
 
     def __init__(self):
-        self._updates: Dict[Tuple[str, str], Tuple[Optional[bytes], Version]] = {}
+        self._updates: Dict[Tuple[str, str], BatchEntry] = {}
 
-    def put(self, ns: str, key: str, value: bytes, version: Version) -> None:
-        self._updates[(ns, key)] = (value, version)
+    def put(
+        self,
+        ns: str,
+        key: str,
+        value: bytes,
+        version: Version,
+        metadata: Optional[bytes] = None,
+    ) -> None:
+        self._updates[(ns, key)] = BatchEntry(value, version, metadata)
 
     def delete(self, ns: str, key: str, version: Version) -> None:
-        self._updates[(ns, key)] = (None, version)
+        self._updates[(ns, key)] = BatchEntry(None, version)
 
     def exists(self, ns: str, key: str) -> bool:
         return (ns, key) in self._updates
 
-    def get(self, ns: str, key: str) -> Optional[Tuple[Optional[bytes], Version]]:
+    def get(self, ns: str, key: str) -> Optional[BatchEntry]:
         return self._updates.get((ns, key))
 
     def items(self):
@@ -53,13 +71,26 @@ class HashedUpdateBatch:
     """Private-data hashed writes: keyed (ns, collection, key_hash)."""
 
     def __init__(self):
-        self._updates: Dict[Tuple[str, str, bytes], Tuple[Optional[bytes], Version]] = {}
+        self._updates: Dict[Tuple[str, str, bytes], BatchEntry] = {}
 
-    def put(self, ns: str, coll: str, key_hash: bytes, value_hash: Optional[bytes], version: Version) -> None:
-        self._updates[(ns, coll, key_hash)] = (value_hash, version)
+    def put(
+        self,
+        ns: str,
+        coll: str,
+        key_hash: bytes,
+        value_hash: Optional[bytes],
+        version: Version,
+        metadata: Optional[bytes] = None,
+    ) -> None:
+        self._updates[(ns, coll, key_hash)] = BatchEntry(
+            value_hash, version, metadata
+        )
 
     def contains(self, ns: str, coll: str, key_hash: bytes) -> bool:
         return (ns, coll, key_hash) in self._updates
+
+    def get(self, ns: str, coll: str, key_hash: bytes) -> Optional[BatchEntry]:
+        return self._updates.get((ns, coll, key_hash))
 
     def items(self):
         return self._updates.items()
@@ -74,19 +105,36 @@ class VersionedDB:
     def __init__(self):
         self._data: Dict[str, Dict[str, VersionedValue]] = {}
         self._sorted_keys: Dict[str, List[str]] = {}
-        self._hashed: Dict[Tuple[str, str, bytes], Tuple[Optional[bytes], Version]] = {}
+        self._hashed: Dict[Tuple[str, str, bytes], VersionedValue] = {}
 
     # -- reads ------------------------------------------------------------
     def get_state(self, ns: str, key: str) -> Optional[VersionedValue]:
         return self._data.get(ns, {}).get(key)
 
+    def get_state_metadata(self, ns: str, key: str) -> Optional[bytes]:
+        """Serialized VALIDATION_PARAMETER et al. for a key (reference
+        statedb GetStateMetadata)."""
+        vv = self.get_state(ns, key)
+        return vv.metadata if vv else None
+
     def get_version(self, ns: str, key: str) -> Optional[Version]:
         vv = self.get_state(ns, key)
         return vv.version if vv else None
 
+    def get_hashed_state(
+        self, ns: str, coll: str, key_hash: bytes
+    ) -> Optional[VersionedValue]:
+        return self._hashed.get((ns, coll, key_hash))
+
+    def get_hashed_metadata(
+        self, ns: str, coll: str, key_hash: bytes
+    ) -> Optional[bytes]:
+        vv = self._hashed.get((ns, coll, key_hash))
+        return vv.metadata if vv else None
+
     def get_key_hash_version(self, ns: str, coll: str, key_hash: bytes) -> Optional[Version]:
         entry = self._hashed.get((ns, coll, key_hash))
-        return entry[1] if entry else None
+        return entry.version if entry else None
 
     def get_state_range(
         self, ns: str, start_key: str, end_key: str, include_end: bool
@@ -109,10 +157,10 @@ class VersionedDB:
 
     # -- writes -----------------------------------------------------------
     def apply_updates(self, batch: UpdateBatch, hashed: Optional[HashedUpdateBatch] = None) -> None:
-        for (ns, key), (value, version) in batch.items():
+        for (ns, key), entry in batch.items():
             table = self._data.setdefault(ns, {})
             keys = self._sorted_keys.setdefault(ns, [])
-            if value is None:
+            if entry.value is None:
                 if key in table:
                     del table[key]
                     idx = bisect.bisect_left(keys, key)
@@ -121,13 +169,17 @@ class VersionedDB:
             else:
                 if key not in table:
                     bisect.insort(keys, key)
-                table[key] = VersionedValue(value, version)
+                table[key] = VersionedValue(
+                    entry.value, entry.version, entry.metadata
+                )
         if hashed is not None:
-            for (ns, coll, key_hash), (vh, version) in hashed.items():
-                if vh is None:
+            for (ns, coll, key_hash), entry in hashed.items():
+                if entry.value is None:
                     self._hashed.pop((ns, coll, key_hash), None)
                 else:
-                    self._hashed[(ns, coll, key_hash)] = (vh, version)
+                    self._hashed[(ns, coll, key_hash)] = VersionedValue(
+                        entry.value, entry.version, entry.metadata
+                    )
 
     def num_keys(self) -> int:
         return sum(len(t) for t in self._data.values())

@@ -1,0 +1,359 @@
+"""Idemix MSP: anonymous credentials as a membership service provider.
+
+Reference: msp/idemixmsp.go + msp/idemix_roles.go + the bccsp idemix
+bridge's attribute encoding (bccsp/idemix/bridge/credential.go:50-60:
+bytes attributes enter the credential as HashModOrder(bytes), int
+attributes as the integer itself).
+
+The idemix credential carries 4 attributes (msp/idemixmsp.go:25-35):
+  0: OU   (disclosed)   — organizational unit identifier
+  1: Role (disclosed)   — idemix role bitmask (MEMBER=1, ADMIN=2, ...)
+  2: EnrollmentId (hidden)
+  3: RevocationHandle (hidden, rhIndex=3)
+
+An identity serializes as SerializedIdentity{mspid,
+SerializedIdemixIdentity{nym_x, nym_y, ou, role, proof}} where `proof`
+is an idemix signature over the EMPTY message disclosing OU+Role —
+the cryptographic association between the pseudonym and the issuer.
+Message signatures (Identity.Verify) are pseudonym signatures.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass
+from typing import Optional, Sequence, Tuple
+
+from fabric_tpu import idemix
+from fabric_tpu.crypto import fp256bn as bn
+from fabric_tpu.protos import (
+    identities_pb2,
+    idemix_pb2,
+    msp_config_pb2,
+    msp_principal_pb2,
+)
+
+# idemix role bitmask (msp/idemix_roles.go:16-22)
+ROLE_MEMBER = 1
+ROLE_ADMIN = 2
+ROLE_CLIENT = 4
+ROLE_PEER = 8
+
+ATTR_OU = 0
+ATTR_ROLE = 1
+ATTR_ENROLLMENT_ID = 2
+ATTR_REVOCATION_HANDLE = 3
+RH_INDEX = ATTR_REVOCATION_HANDLE
+
+ATTRIBUTE_NAMES = ["OU", "Role", "EnrollmentId", "RevocationHandle"]
+
+PROOF_DISCLOSURE = [1, 1, 0, 0]  # disclose OU + Role
+_EMPTY_MSG = b""
+
+
+class IdemixMSPError(Exception):
+    pass
+
+
+def _msp_role_to_idemix(role_type: int) -> int:
+    """msp/idemix_roles.go getIdemixRoleFromMSPRoleValue."""
+    if role_type == msp_principal_pb2.MSPRole.ADMIN:
+        return ROLE_ADMIN
+    if role_type == msp_principal_pb2.MSPRole.CLIENT:
+        return ROLE_CLIENT
+    if role_type == msp_principal_pb2.MSPRole.PEER:
+        return ROLE_PEER
+    return ROLE_MEMBER
+
+
+def _attr_bytes(value: bytes) -> int:
+    return bn.hash_mod_order(value)
+
+
+@dataclass
+class IdemixIdentity:
+    """A deserialized anonymous identity."""
+
+    msp_id: str
+    nym: bn.G1Point
+    ou: msp_principal_pb2.OrganizationUnit
+    role: msp_principal_pb2.MSPRole
+    proof: idemix_pb2.Signature
+    raw: bytes  # the SerializedIdentity bytes
+
+    def serialize(self) -> bytes:
+        return self.raw
+
+    @property
+    def role_mask(self) -> int:
+        return _msp_role_to_idemix(self.role.role)
+
+
+class IdemixMSP:
+    """Verification-side idemix MSP (reference idemixmsp.go Setup with no
+    signer)."""
+
+    def __init__(self, config: msp_config_pb2.IdemixMSPConfig, rev_pk=None):
+        self.name = config.name
+        self.epoch = config.epoch
+        self.ipk = idemix_pb2.IssuerPublicKey()
+        self.ipk.ParseFromString(config.ipk)
+        idemix.check_issuer_public_key(self.ipk)
+        if len(self.ipk.attribute_names) != len(ATTRIBUTE_NAMES) or list(
+            self.ipk.attribute_names
+        ) != ATTRIBUTE_NAMES:
+            raise IdemixMSPError(
+                "issuer public key must have attributes OU, Role, "
+                "EnrollmentId, and RevocationHandle"
+            )
+        self.rev_pk = rev_pk  # ECDSA-P384 public key object or None
+
+    # -- identity plane (msp.MSP surface) -----------------------------------
+
+    def deserialize_identity(self, serialized: bytes) -> IdemixIdentity:
+        sid = identities_pb2.SerializedIdentity()
+        sid.ParseFromString(serialized)
+        if sid.mspid != self.name:
+            raise IdemixMSPError(
+                f"expected MSP ID {self.name}, received {sid.mspid}"
+            )
+        inner = identities_pb2.SerializedIdemixIdentity()
+        inner.ParseFromString(sid.id_bytes)
+        if not inner.nym_x or not inner.nym_y:
+            raise IdemixMSPError("pseudonym is invalid")
+        nym = (bn.big_from_bytes(inner.nym_x), bn.big_from_bytes(inner.nym_y))
+        if not bn.g1_is_on_curve(nym):
+            raise IdemixMSPError("pseudonym is not on the curve")
+        ou = msp_principal_pb2.OrganizationUnit()
+        ou.ParseFromString(inner.ou)
+        role = msp_principal_pb2.MSPRole()
+        role.ParseFromString(inner.role)
+        proof = idemix_pb2.Signature()
+        proof.ParseFromString(inner.proof)
+        return IdemixIdentity(self.name, nym, ou, role, proof, serialized)
+
+    def validate(self, ident: IdemixIdentity) -> None:
+        """Verify the association proof (idemixmsp.go verifyProof):
+        disclosure = [OU, Role, hidden, hidden] over the empty message."""
+        if ident.msp_id != self.name:
+            raise IdemixMSPError(
+                "the supplied identity does not belong to this msp"
+            )
+        attr_values = [
+            _attr_bytes(ident.ou.organizational_unit_identifier.encode()),
+            ident.role_mask,
+            None,
+            None,
+        ]
+        try:
+            idemix.verify_signature(
+                ident.proof,
+                PROOF_DISCLOSURE,
+                self.ipk,
+                _EMPTY_MSG,
+                attr_values,
+                RH_INDEX,
+                self.rev_pk,
+                self.epoch,
+            )
+        except idemix.IdemixError as e:
+            raise IdemixMSPError(f"identity proof invalid: {e}") from e
+
+    def verify(self, ident: IdemixIdentity, msg: bytes, sig: bytes) -> None:
+        """Identity.Verify: pseudonym signature over msg."""
+        nym_sig = idemix_pb2.NymSignature()
+        nym_sig.ParseFromString(sig)
+        try:
+            idemix.verify_nym_signature(nym_sig, ident.nym, self.ipk, msg)
+        except idemix.IdemixError as e:
+            raise IdemixMSPError(f"signature invalid: {e}") from e
+
+    def satisfies_principal(
+        self, ident: IdemixIdentity, principal: msp_principal_pb2.MSPPrincipal
+    ) -> None:
+        """idemixmsp.go SatisfiesPrincipal: validate, then match role/OU."""
+        self.validate(ident)
+        cls = principal.principal_classification
+        if cls == msp_principal_pb2.MSPPrincipal.ROLE:
+            role = msp_principal_pb2.MSPRole()
+            role.ParseFromString(principal.principal)
+            if role.msp_identifier != self.name:
+                raise IdemixMSPError(
+                    f"the identity is a member of a different MSP "
+                    f"({role.msp_identifier})"
+                )
+            want = role.role
+            if want == msp_principal_pb2.MSPRole.MEMBER:
+                return
+            if want == msp_principal_pb2.MSPRole.ADMIN:
+                if ident.role_mask & ROLE_ADMIN:
+                    return
+                raise IdemixMSPError("user is not an admin")
+            if want in (
+                msp_principal_pb2.MSPRole.CLIENT,
+                msp_principal_pb2.MSPRole.PEER,
+            ):
+                wanted_mask = _msp_role_to_idemix(want)
+                if ident.role_mask & wanted_mask:
+                    return
+                raise IdemixMSPError("user does not have the required role")
+            raise IdemixMSPError(f"invalid MSP role type {want}")
+        if cls == msp_principal_pb2.MSPPrincipal.ORGANIZATION_UNIT:
+            ou = msp_principal_pb2.OrganizationUnit()
+            ou.ParseFromString(principal.principal)
+            if ou.msp_identifier != self.name:
+                raise IdemixMSPError(
+                    "the identity is a member of a different MSP"
+                )
+            if (
+                ou.organizational_unit_identifier
+                != ident.ou.organizational_unit_identifier
+            ):
+                raise IdemixMSPError("OU identifier does not match")
+            return
+        raise IdemixMSPError(f"invalid principal type {cls}")
+
+
+class IdemixSigningIdentity:
+    """Signer side: a fresh pseudonym + the proof binding it to the
+    issuer's credential (idemixSigningIdentity)."""
+
+    def __init__(
+        self,
+        msp: IdemixMSP,
+        signer_config: msp_config_pb2.IdemixMSPSignerConfig,
+        rng: Optional[random.Random] = None,
+    ):
+        self.msp = msp
+        self.rng = rng or random.SystemRandom()
+        self.sk = bn.big_from_bytes(signer_config.sk)
+        self.cred = idemix_pb2.Credential()
+        self.cred.ParseFromString(signer_config.cred)
+        self.ou_id = signer_config.organizational_unit_identifier
+        self.enrollment_id = signer_config.enrollment_id
+        self.role_mask = signer_config.role
+        self.cri = idemix_pb2.CredentialRevocationInformation()
+        self.cri.ParseFromString(signer_config.credential_revocation_information)
+
+        idemix.verify_credential(self.cred, self.sk, msp.ipk)
+        self.nym, self.r_nym = idemix.make_nym(self.sk, msp.ipk, self.rng)
+
+        role = msp_principal_pb2.MSPRole()
+        role.msp_identifier = msp.name
+        role.role = (
+            msp_principal_pb2.MSPRole.ADMIN
+            if self.role_mask & ROLE_ADMIN
+            else msp_principal_pb2.MSPRole.MEMBER
+        )
+        self._role = role
+        ou = msp_principal_pb2.OrganizationUnit()
+        ou.msp_identifier = msp.name
+        ou.organizational_unit_identifier = self.ou_id
+        self._ou = ou
+
+        proof = idemix.new_signature(
+            self.cred,
+            self.sk,
+            self.nym,
+            self.r_nym,
+            msp.ipk,
+            PROOF_DISCLOSURE,
+            _EMPTY_MSG,
+            RH_INDEX,
+            self.cri,
+            self.rng,
+        )
+
+        inner = identities_pb2.SerializedIdemixIdentity()
+        inner.nym_x = bn.big_to_bytes(self.nym[0])
+        inner.nym_y = bn.big_to_bytes(self.nym[1])
+        inner.ou = ou.SerializeToString()
+        inner.role = role.SerializeToString()
+        inner.proof = proof.SerializeToString()
+        sid = identities_pb2.SerializedIdentity()
+        sid.mspid = msp.name
+        sid.id_bytes = inner.SerializeToString()
+        self._serialized = sid.SerializeToString()
+
+    def serialize(self) -> bytes:
+        return self._serialized
+
+    def sign(self, msg: bytes) -> bytes:
+        """Pseudonym signature (idemixSigningIdentity.Sign)."""
+        return idemix.new_nym_signature(
+            self.sk, self.nym, self.r_nym, self.msp.ipk, msg, self.rng
+        ).SerializeToString()
+
+
+# --------------------------------------------------------------------------
+# idemixgen analog (cmd/idemixgen): issuer + default signer config
+# --------------------------------------------------------------------------
+
+
+def generate_issuer(rng: Optional[random.Random] = None):
+    """idemixgen ca-keygen: issuer key with the 4 fixed attributes +
+    long-term revocation key."""
+    rng = rng or random.SystemRandom()
+    ikey = idemix.new_issuer_key(ATTRIBUTE_NAMES, rng)
+    rev_key = idemix.generate_long_term_revocation_key()
+    return ikey, rev_key
+
+
+def generate_signer_config(
+    ikey,
+    rev_key,
+    ou_id: str,
+    role_mask: int,
+    enrollment_id: str,
+    rng: Optional[random.Random] = None,
+) -> msp_config_pb2.IdemixMSPSignerConfig:
+    """idemixgen signerconfig: run the issuance protocol locally."""
+    rng = rng or random.SystemRandom()
+    sk = bn.rand_mod_order(rng)
+    issuer_nonce = bn.big_to_bytes(bn.rand_mod_order(rng))
+    req = idemix.new_cred_request(sk, issuer_nonce, ikey.ipk, rng)
+    rh = bn.rand_mod_order(rng)
+    attrs = [
+        _attr_bytes(ou_id.encode()),
+        role_mask,
+        _attr_bytes(enrollment_id.encode()),
+        rh,
+    ]
+    cred = idemix.new_credential(ikey, req, attrs, rng)
+    cri = idemix.create_cri(rev_key, [rh], 0, idemix.ALG_NO_REVOCATION, rng)
+
+    out = msp_config_pb2.IdemixMSPSignerConfig()
+    out.cred = cred.SerializeToString()
+    out.sk = bn.big_to_bytes(sk)
+    out.organizational_unit_identifier = ou_id
+    out.role = role_mask
+    out.enrollment_id = enrollment_id
+    out.credential_revocation_information = cri.SerializeToString()
+    return out
+
+
+def generate_msp_config(
+    name: str,
+    ou_id: str = "OU1",
+    role_mask: int = ROLE_MEMBER,
+    enrollment_id: str = "user1",
+    rng: Optional[random.Random] = None,
+) -> Tuple[msp_config_pb2.IdemixMSPConfig, object]:
+    """Full idemix MSP config (verification + default signer). Returns
+    (config, revocation private key object)."""
+    rng = rng or random.SystemRandom()
+    ikey, rev_key = generate_issuer(rng)
+    signer = generate_signer_config(
+        ikey, rev_key, ou_id, role_mask, enrollment_id, rng
+    )
+    cfg = msp_config_pb2.IdemixMSPConfig()
+    cfg.name = name
+    cfg.ipk = ikey.ipk.SerializeToString()
+    from cryptography.hazmat.primitives import serialization
+
+    cfg.revocation_pk = rev_key.public_key().public_bytes(
+        serialization.Encoding.PEM,
+        serialization.PublicFormat.SubjectPublicKeyInfo,
+    )
+    cfg.signer.CopyFrom(signer)
+    return cfg, rev_key

@@ -12,7 +12,7 @@ Ordered() semantics replicated:
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import List, Tuple
+from typing import List, Optional, Tuple
 
 from fabric_tpu.protos import common_pb2
 
@@ -25,8 +25,8 @@ class BatchConfig:
 
 
 class BlockCutter:
-    def __init__(self, config: BatchConfig = BatchConfig()):
-        self.config = config
+    def __init__(self, config: Optional[BatchConfig] = None):
+        self.config = config if config is not None else BatchConfig()
         self._pending: List[common_pb2.Envelope] = []
         self._pending_bytes = 0
 

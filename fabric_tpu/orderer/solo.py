@@ -23,7 +23,7 @@ class SoloChain:
         self,
         channel_id: str,
         signer: Optional[SigningIdentity] = None,
-        batch_config: BatchConfig = BatchConfig(),
+        batch_config: Optional[BatchConfig] = None,
         deliver: Optional[Callable[[common_pb2.Block], None]] = None,
         genesis_block: Optional[common_pb2.Block] = None,
     ):

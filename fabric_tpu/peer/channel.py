@@ -18,6 +18,7 @@ from fabric_tpu.crypto.bccsp import Provider, default_provider
 from fabric_tpu.ledger.kvledger import KVLedger
 from fabric_tpu.msp.identity import MSPManager
 from fabric_tpu.protos import common_pb2, protoutil
+from fabric_tpu.validation.msgvalidation import parse_transaction
 from fabric_tpu.validation.txflags import ValidationFlags
 from fabric_tpu.validation.validator import BlockValidator, ChaincodeRegistry
 
@@ -41,6 +42,12 @@ class Channel:
         self.provider = provider or default_provider()
         self.ledger = KVLedger(ledger_dir, channel_id)
         self.verify_orderer_sig = verify_orderer_sig
+
+        def get_state_metadata(ns: str, coll: str, key) -> Optional[bytes]:
+            if coll:
+                return self.ledger.state_db.get_hashed_metadata(ns, coll, key)
+            return self.ledger.state_db.get_state_metadata(ns, key)
+
         self.validator = BlockValidator(
             channel_id,
             msp_manager,
@@ -48,13 +55,18 @@ class Channel:
             registry,
             tx_exists=self.ledger.tx_exists,
             apply_config=apply_config,
+            get_state_metadata=get_state_metadata,
         )
 
     def store_block(self, block: common_pb2.Block) -> ValidationFlags:
-        """The full commit pipeline for one delivered block."""
+        """The full commit pipeline for one delivered block. Envelopes are
+        parsed once and the result shared between validation and commit."""
         self._verify_block(block)
-        self.validator.validate(block)
-        return self.ledger.commit(block)
+        parsed = [
+            parse_transaction(i, d) for i, d in enumerate(block.data.data)
+        ]
+        self.validator.validate(block, parsed=parsed)
+        return self.ledger.commit(block, rwsets=[p.rwset for p in parsed])
 
     def _verify_block(self, block: common_pb2.Block) -> None:
         if block.header.number != self.ledger.height:

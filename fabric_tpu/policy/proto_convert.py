@@ -1,0 +1,117 @@
+"""ast <-> proto conversion for signature policies.
+
+The validation plane receives policies as serialized proto
+SignaturePolicyEnvelope (chaincode definitions, key-level VALIDATION_
+PARAMETER metadata wrapped in ApplicationPolicy — the v20 dispatcher's
+toApplicationPolicyTranslator, reference core/handlers/validation/
+builtin/v20/validation_logic.go:44-67) and evaluates the compiled ast
+form (fabric_tpu.policy.ast).
+"""
+
+from __future__ import annotations
+
+from fabric_tpu.policy.ast import (
+    MSPRole,
+    NOutOf,
+    Role,
+    SignaturePolicyEnvelope,
+    SignedBy,
+)
+from fabric_tpu.protos import msp_principal_pb2, policies_pb2
+
+_ROLE_TO_PROTO = {
+    Role.MEMBER: msp_principal_pb2.MSPRole.MEMBER,
+    Role.ADMIN: msp_principal_pb2.MSPRole.ADMIN,
+    Role.CLIENT: msp_principal_pb2.MSPRole.CLIENT,
+    Role.PEER: msp_principal_pb2.MSPRole.PEER,
+    Role.ORDERER: msp_principal_pb2.MSPRole.ORDERER,
+}
+_ROLE_FROM_PROTO = {v: k for k, v in _ROLE_TO_PROTO.items()}
+
+
+class PolicyConversionError(ValueError):
+    pass
+
+
+def envelope_to_proto(env: SignaturePolicyEnvelope) -> policies_pb2.SignaturePolicyEnvelope:
+    out = policies_pb2.SignaturePolicyEnvelope()
+    out.version = env.version
+    out.rule.CopyFrom(_rule_to_proto(env.rule))
+    for pr in env.identities:
+        p = out.identities.add()
+        p.principal_classification = msp_principal_pb2.MSPPrincipal.ROLE
+        role = msp_principal_pb2.MSPRole()
+        role.msp_identifier = pr.msp_id
+        role.role = _ROLE_TO_PROTO[pr.role]
+        p.principal = role.SerializeToString()
+    return out
+
+
+def _rule_to_proto(rule) -> policies_pb2.SignaturePolicy:
+    out = policies_pb2.SignaturePolicy()
+    if isinstance(rule, SignedBy):
+        out.signed_by = rule.index
+    else:
+        out.n_out_of.n = rule.n
+        for sub in rule.rules:
+            out.n_out_of.rules.append(_rule_to_proto(sub))
+    return out
+
+
+def envelope_from_proto(
+    msg: policies_pb2.SignaturePolicyEnvelope,
+) -> SignaturePolicyEnvelope:
+    identities = []
+    for p in msg.identities:
+        if p.principal_classification != msp_principal_pb2.MSPPrincipal.ROLE:
+            raise PolicyConversionError(
+                f"unsupported principal classification "
+                f"{p.principal_classification}"
+            )
+        role = msp_principal_pb2.MSPRole()
+        role.ParseFromString(p.principal)
+        identities.append(
+            MSPRole(role.msp_identifier, _ROLE_FROM_PROTO[role.role])
+        )
+    return SignaturePolicyEnvelope(_rule_from_proto(msg.rule), identities, msg.version)
+
+
+def _rule_from_proto(msg: policies_pb2.SignaturePolicy):
+    kind = msg.WhichOneof("Type")
+    if kind == "signed_by":
+        return SignedBy(msg.signed_by)
+    if kind == "n_out_of":
+        return NOutOf(
+            msg.n_out_of.n,
+            [_rule_from_proto(r) for r in msg.n_out_of.rules],
+        )
+    raise PolicyConversionError("empty signature policy rule")
+
+
+def marshal_envelope(env: SignaturePolicyEnvelope) -> bytes:
+    return envelope_to_proto(env).SerializeToString()
+
+
+def unmarshal_envelope(raw: bytes) -> SignaturePolicyEnvelope:
+    msg = policies_pb2.SignaturePolicyEnvelope()
+    msg.ParseFromString(raw)
+    return envelope_from_proto(msg)
+
+
+def marshal_application_policy(env: SignaturePolicyEnvelope) -> bytes:
+    """Wrap as peer.ApplicationPolicy{signature_policy} — the on-ledger
+    form of chaincode EPs and key-level validation parameters."""
+    ap = policies_pb2.ApplicationPolicy()
+    ap.signature_policy.CopyFrom(envelope_to_proto(env))
+    return ap.SerializeToString()
+
+
+def unmarshal_application_policy(raw: bytes) -> SignaturePolicyEnvelope:
+    ap = policies_pb2.ApplicationPolicy()
+    ap.ParseFromString(raw)
+    kind = ap.WhichOneof("Type")
+    if kind != "signature_policy":
+        raise PolicyConversionError(
+            f"unsupported application policy type {kind!r}"
+        )
+    return envelope_from_proto(ap.signature_policy)

@@ -91,6 +91,15 @@ def parse_tx_rwset(results: bytes) -> rw.TxRwSet:
         writes = tuple(
             rw.KVWrite(w.key, w.is_delete, w.value) for w in kv.writes
         )
+        # proto3 cannot distinguish nil from empty entries; like the
+        # reference, empty means metadata delete (None here)
+        md_writes = tuple(
+            rw.KVMetadataWrite(
+                m.key,
+                tuple((e.name, e.value) for e in m.entries) or None,
+            )
+            for m in kv.metadata_writes
+        )
         rqs = []
         for q in kv.range_queries_info:
             raw_reads: Tuple[rw.KVRead, ...] = ()
@@ -127,9 +136,21 @@ def parse_tx_rwset(results: bytes) -> rw.TxRwSet:
                         rw.KVWriteHash(w.key_hash, w.is_delete, w.value_hash)
                         for w in h.hashed_writes
                     ),
+                    tuple(
+                        rw.KVMetadataWriteHash(
+                            m.key_hash,
+                            tuple((e.name, e.value) for e in m.entries)
+                            or None,
+                        )
+                        for m in h.metadata_writes
+                    ),
                 )
             )
-        ns_sets.append(rw.NsRwSet(ns.namespace, reads, writes, tuple(rqs), tuple(colls)))
+        ns_sets.append(
+            rw.NsRwSet(
+                ns.namespace, reads, writes, tuple(rqs), tuple(colls), md_writes
+            )
+        )
     return rw.TxRwSet(tuple(ns_sets))
 
 

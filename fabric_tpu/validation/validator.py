@@ -33,6 +33,12 @@ from fabric_tpu.policy.ast import SignaturePolicyEnvelope
 from fabric_tpu.policy.evaluator import compile_batched, evaluate_host
 from fabric_tpu.protos import common_pb2, msp_principal_pb2, protoutil
 from fabric_tpu.validation.msgvalidation import ParsedTx, SigJob, parse_transaction
+from fabric_tpu.validation.statebased import (
+    VALIDATION_PARAMETER,
+    BlockDependencies,
+    KeyLevelEvaluator,
+)
+from fabric_tpu.ledger.mvcc import deserialize_metadata
 from fabric_tpu.validation.txflags import TxValidationCode, ValidationFlags
 
 
@@ -96,6 +102,10 @@ class BlockValidator:
         registry: ChaincodeRegistry,
         tx_exists: Optional[Callable[[str], bool]] = None,
         apply_config: Optional[Callable[[bytes], None]] = None,
+        get_state_metadata: Optional[Callable[[str, str, object], Optional[bytes]]] = None,
+        get_collection_ep: Optional[
+            Callable[[str, str], Optional[SignaturePolicyEnvelope]]
+        ] = None,
     ):
         self.channel_id = channel_id
         self.msp_manager = msp_manager
@@ -103,16 +113,32 @@ class BlockValidator:
         self.registry = registry
         self.tx_exists = tx_exists or (lambda txid: False)
         self.apply_config = apply_config
+        # committed key metadata for state-based endorsement:
+        # (ns, coll, key) -> serialized metadata bytes
+        self.get_state_metadata = get_state_metadata or (
+            lambda ns, coll, key: None
+        )
+        self.get_collection_ep = get_collection_ep
         # caches (reference msp/cache + discovery/authcache analogs)
         self._principal_cache: Dict[Tuple[bytes, bytes], bool] = {}
-        self._policy_fn_cache: Dict[Tuple[int, int], Callable] = {}
+        # keyed by the (hashable, frozen) envelope itself — id() would
+        # alias freed envelopes after a policy upgrade
+        self._policy_fn_cache: Dict[Tuple[SignaturePolicyEnvelope, int], Callable] = {}
 
     # ------------------------------------------------------------------
-    def validate(self, block: common_pb2.Block) -> ValidationFlags:
+    def validate(
+        self,
+        block: common_pb2.Block,
+        parsed: Optional[Sequence[ParsedTx]] = None,
+    ) -> ValidationFlags:
         """Validate a block; writes TRANSACTIONS_FILTER metadata and
-        returns the flags (reference Validate, v20/validator.go:180-265)."""
+        returns the flags (reference Validate, v20/validator.go:180-265).
+
+        `parsed` lets the caller share one parse pass with the commit
+        stage instead of re-decoding every envelope."""
         data = list(block.data.data)
-        parsed = [parse_transaction(i, d) for i, d in enumerate(data)]
+        if parsed is None:
+            parsed = [parse_transaction(i, d) for i, d in enumerate(data)]
 
         sig_results = self._batch_verify_sigs(parsed)
         flags = ValidationFlags(len(data))
@@ -250,36 +276,135 @@ class BlockValidator:
         parsed: Sequence[ParsedTx],
         flags: ValidationFlags,
     ) -> None:
+        """Endorsement-policy evaluation. The common case — no key-level
+        validation parameters anywhere in sight — takes the batched
+        device path; blocks touching state-based endorsement fall back
+        to the exact sequential key-level pass (reference
+        validator_keylevel.go semantics)."""
+        deps = BlockDependencies([tx.rwset for tx in parsed])
+        if deps.has_writers() or self._any_vp_on_written_keys(groups, parsed):
+            self._evaluate_policies_sbe(groups, parsed, flags, deps)
+        else:
+            self._evaluate_policies_batched(groups, parsed, flags)
+
+    def _any_vp_on_written_keys(
+        self,
+        groups: Dict[int, Tuple[ChaincodeDefinition, List[int]]],
+        parsed: Sequence[ParsedTx],
+    ) -> bool:
+        for _definition, tx_indices in groups.values():
+            for i in tx_indices:
+                rwset = parsed[i].rwset
+                if rwset is None:
+                    continue
+                for ns_rw in rwset.ns_rw_sets:
+                    ns = ns_rw.namespace
+                    for w in ns_rw.writes:
+                        if self._has_vp(ns, "", w.key):
+                            return True
+                    for coll in ns_rw.coll_hashed:
+                        for hw in coll.hashed_writes:
+                            if self._has_vp(ns, coll.collection_name, hw.key_hash):
+                                return True
+        return False
+
+    def _has_vp(self, ns: str, coll: str, key) -> bool:
+        md = deserialize_metadata(self.get_state_metadata(ns, coll, key))
+        return bool(md) and VALIDATION_PARAMETER in md
+
+    def _evaluate_policies_sbe(
+        self,
+        groups: Dict[int, Tuple[ChaincodeDefinition, List[int]]],
+        parsed: Sequence[ParsedTx],
+        flags: ValidationFlags,
+        deps: BlockDependencies,
+    ) -> None:
+        """Sequential key-level pass in tx order. Signature verification
+        already happened in the batched device phase; per-policy checks
+        reduce to cached circuit walks over satisfaction bits."""
+        def_by_tx: Dict[int, ChaincodeDefinition] = {}
+        for definition, tx_indices in groups.values():
+            for i in tx_indices:
+                def_by_tx[i] = definition
+
+        for tx in parsed:
+            i = tx.index
+            rwset = tx.rwset
+            namespaces = (
+                [ns.namespace for ns in rwset.ns_rw_sets] if rwset else []
+            )
+            definition = def_by_tx.get(i)
+            if definition is None or rwset is None:
+                # invalidated earlier / config tx: its metadata writes do
+                # not update validation parameters
+                for ns in namespaces:
+                    deps.set_result(i, ns, False)
+                continue
+            evaluator = KeyLevelEvaluator(
+                definition.endorsement_policy,
+                deps,
+                self.get_state_metadata,
+                lambda env, _tx_num, _tx=tx: self._eval_policy_host(_tx, env),
+                self.get_collection_ep,
+            )
+            ok, why = evaluator.evaluate(rwset, tx.namespace, i)
+            if not ok:
+                flags.set_flag(i, TxValidationCode.ENDORSEMENT_POLICY_FAILURE)
+            for ns in namespaces:
+                deps.set_result(i, ns, ok)
+            if tx.namespace not in namespaces:
+                deps.set_result(i, tx.namespace, ok)
+
+    def _eval_policy_host(
+        self, tx: ParsedTx, env: SignaturePolicyEnvelope
+    ) -> bool:
+        sat = self._signer_sat_rows(tx, env)
+        return evaluate_host(env, sat)
+
+    def _signer_sat_rows(
+        self, tx: ParsedTx, env: SignaturePolicyEnvelope
+    ) -> np.ndarray:
+        """(valid deduped signers x principals) satisfaction matrix for
+        one tx (SignatureSetToValidIdentities + principal matching)."""
+        principals = [principal_for(p) for p in env.identities]
+        rows = []
+        seen_ids = set()
+        for job in tx.endorsement_jobs:
+            ident = self._job_identity.get(id(job))
+            if ident is None:
+                continue
+            fp = (ident.msp_id, hashlib.sha256(ident.serialize()).digest())
+            if fp in seen_ids:
+                continue
+            seen_ids.add(fp)
+            if not self._sig_ok(job):
+                continue
+            rows.append([self._satisfies(ident, pr) for pr in principals])
+        return np.array(rows, dtype=bool).reshape(len(rows), len(principals))
+
+    def _evaluate_policies_batched(
+        self,
+        groups: Dict[int, Tuple[ChaincodeDefinition, List[int]]],
+        parsed: Sequence[ParsedTx],
+        flags: ValidationFlags,
+    ) -> None:
         """Batched endorsement-policy evaluation per chaincode definition."""
         for definition, tx_indices in groups.values():
             env = definition.endorsement_policy
-            principals = [principal_for(p) for p in env.identities]
-            per_tx_sat: List[np.ndarray] = []
-            for i in tx_indices:
-                tx = parsed[i]
-                # SignatureSetToValidIdentities: dedupe by identity, drop
-                # non-verifying signers, preserve order (policy.go:365-402)
-                rows = []
-                seen_ids = set()
-                for job in tx.endorsement_jobs:
-                    ident = self._job_identity.get(id(job))
-                    if ident is None:
-                        continue
-                    fp = (ident.msp_id, hashlib.sha256(ident.serialize()).digest())
-                    if fp in seen_ids:
-                        continue
-                    seen_ids.add(fp)
-                    if not self._sig_ok(job):
-                        continue
-                    rows.append([self._satisfies(ident, pr) for pr in principals])
-                per_tx_sat.append(np.array(rows, dtype=bool).reshape(len(rows), len(principals)))
+            # SignatureSetToValidIdentities: dedupe by identity, drop
+            # non-verifying signers, preserve order (policy.go:365-402)
+            per_tx_sat: List[np.ndarray] = [
+                self._signer_sat_rows(parsed[i], env) for i in tx_indices
+            ]
 
             max_signers = max((s.shape[0] for s in per_tx_sat), default=0)
             if max_signers == 0:
                 for i in tx_indices:
                     flags.set_flag(i, TxValidationCode.ENDORSEMENT_POLICY_FAILURE)
                 continue
-            batch = np.zeros((len(tx_indices), max_signers, len(principals)), dtype=bool)
+            batch = np.zeros(
+                (len(tx_indices), max_signers, len(env.identities)), dtype=bool
+            )
             for j, sat in enumerate(per_tx_sat):
                 batch[j, : sat.shape[0]] = sat
             fn = self._policy_fn(env, max_signers)
@@ -292,7 +417,7 @@ class BlockValidator:
         return self._sig_results.get(id(job), False)
 
     def _policy_fn(self, env: SignaturePolicyEnvelope, num_signers: int):
-        key = (id(env), num_signers)
+        key = (env, num_signers)
         fn = self._policy_fn_cache.get(key)
         if fn is None:
             fn = compile_batched(env, num_signers)

@@ -1,0 +1,229 @@
+"""Idemix suite tests (mirror of reference idemix/idemix_test.go):
+curve/pairing sanity, issuer keys, credential issuance, signature
+roundtrips with selective disclosure, nym signatures, weak-BB, CRI."""
+
+import random
+
+import pytest
+
+from fabric_tpu.crypto import fp256bn as bn
+from fabric_tpu import idemix
+from fabric_tpu.protos import idemix_pb2
+
+RNG = random.Random(42)
+
+
+# ---------------------------------------------------------------------------
+# curve-level sanity
+# ---------------------------------------------------------------------------
+
+
+def test_curve_parameters():
+    u = bn.U
+    assert bn.P == 36 * u**4 + 36 * u**3 + 24 * u**2 + 6 * u + 1
+    assert bn.R == 36 * u**4 + 36 * u**3 + 18 * u**2 + 6 * u + 1
+    assert bn.g1_is_on_curve(bn.G1_GEN)
+    assert bn.g2_is_on_curve(bn.G2_GEN)
+    assert bn.g1_mul(bn.G1_GEN, bn.R) is None
+    assert bn.g2_mul(bn.G2_GEN, bn.R) is None
+
+
+def test_pairing_bilinear():
+    a = RNG.randrange(bn.R)
+    b = RNG.randrange(bn.R)
+    gt = bn.pairing(bn.G2_GEN, bn.G1_GEN)
+    assert gt != bn.FP12_ONE
+    assert bn.fp12_pow(gt, bn.R) == bn.FP12_ONE
+    lhs = bn.pairing(bn.g2_mul(bn.G2_GEN, a), bn.g1_mul(bn.G1_GEN, b))
+    assert lhs == bn.fp12_pow(gt, a * b % bn.R)
+
+
+def test_serialization_roundtrip():
+    p1 = bn.g1_mul(bn.G1_GEN, RNG.randrange(bn.R))
+    assert bn.g1_from_bytes(bn.g1_to_bytes(p1)) == p1
+    assert len(bn.g1_to_bytes(p1)) == 65
+    p2 = bn.g2_mul(bn.G2_GEN, RNG.randrange(bn.R))
+    assert bn.g2_from_bytes(bn.g2_to_bytes(p2)) == p2
+    assert len(bn.g2_to_bytes(p2)) == 128
+
+
+# ---------------------------------------------------------------------------
+# scheme fixtures
+# ---------------------------------------------------------------------------
+
+ATTRS = ["Attr1", "Attr2", "Attr3", "Attr4", "Attr5"]
+ATTR_VALUES = [1, 2, 3, 4, 5]
+RH_INDEX = 4
+
+
+@pytest.fixture(scope="module")
+def issuer_key():
+    return idemix.new_issuer_key(ATTRS, RNG)
+
+
+@pytest.fixture(scope="module")
+def user(issuer_key):
+    sk = bn.rand_mod_order(RNG)
+    nonce = bn.big_to_bytes(bn.rand_mod_order(RNG))
+    req = idemix.new_cred_request(sk, nonce, issuer_key.ipk, RNG)
+    cred = idemix.new_credential(issuer_key, req, ATTR_VALUES, RNG)
+    return sk, cred
+
+
+@pytest.fixture(scope="module")
+def rev_key():
+    return idemix.generate_long_term_revocation_key()
+
+
+@pytest.fixture(scope="module")
+def cri(rev_key):
+    return idemix.create_cri(rev_key, [], 0, idemix.ALG_NO_REVOCATION, RNG)
+
+
+def test_issuer_key_proof(issuer_key):
+    idemix.check_issuer_public_key(issuer_key.ipk)
+    # tampered W fails the PoK
+    bad = idemix_pb2.IssuerPublicKey()
+    bad.CopyFrom(issuer_key.ipk)
+    bad.w.CopyFrom(
+        idemix.ecp2_to_proto(bn.g2_mul(bn.G2_GEN, 123))
+    )
+    with pytest.raises(idemix.IdemixError):
+        idemix.check_issuer_public_key(bad)
+
+
+def test_duplicate_attributes_rejected():
+    with pytest.raises(idemix.IdemixError):
+        idemix.new_issuer_key(["a", "a"], RNG)
+
+
+def test_cred_request_verifies(issuer_key):
+    sk = bn.rand_mod_order(RNG)
+    nonce = bn.big_to_bytes(bn.rand_mod_order(RNG))
+    req = idemix.new_cred_request(sk, nonce, issuer_key.ipk, RNG)
+    idemix.verify_cred_request(req, issuer_key.ipk)
+    req.proof_s = bn.big_to_bytes(bn.big_from_bytes(req.proof_s) ^ 1)
+    with pytest.raises(idemix.IdemixError):
+        idemix.verify_cred_request(req, issuer_key.ipk)
+
+
+def test_credential_verifies(issuer_key, user):
+    sk, cred = user
+    idemix.verify_credential(cred, sk, issuer_key.ipk)
+
+
+def test_credential_wrong_sk_fails(issuer_key, user):
+    _, cred = user
+    with pytest.raises(idemix.IdemixError):
+        idemix.verify_credential(cred, 12345, issuer_key.ipk)
+
+
+def test_credential_tampered_attr_fails(issuer_key, user):
+    sk, cred = user
+    bad = idemix_pb2.Credential()
+    bad.CopyFrom(cred)
+    bad.attrs[0] = bn.big_to_bytes(999)
+    with pytest.raises(idemix.IdemixError):
+        idemix.verify_credential(bad, sk, issuer_key.ipk)
+
+
+def test_signature_roundtrip_no_disclosure(issuer_key, user, cri):
+    sk, cred = user
+    nym, r_nym = idemix.make_nym(sk, issuer_key.ipk, RNG)
+    disclosure = [0, 0, 0, 0, 0]
+    msg = b"some message"
+    sig = idemix.new_signature(
+        cred, sk, nym, r_nym, issuer_key.ipk, disclosure, msg,
+        RH_INDEX, cri, RNG,
+    )
+    idemix.verify_signature(
+        sig, disclosure, issuer_key.ipk, msg,
+        [None] * 5, RH_INDEX, None, 0,
+    )
+
+
+def test_signature_roundtrip_selective_disclosure(issuer_key, user, cri):
+    sk, cred = user
+    nym, r_nym = idemix.make_nym(sk, issuer_key.ipk, RNG)
+    disclosure = [0, 1, 1, 0, 0]  # disclose attrs 1 and 2
+    msg = b"some message"
+    sig = idemix.new_signature(
+        cred, sk, nym, r_nym, issuer_key.ipk, disclosure, msg,
+        RH_INDEX, cri, RNG,
+    )
+    attr_values = [None, ATTR_VALUES[1], ATTR_VALUES[2], None, None]
+    idemix.verify_signature(
+        sig, disclosure, issuer_key.ipk, msg,
+        attr_values, RH_INDEX, None, 0,
+    )
+    # wrong disclosed value -> invalid
+    with pytest.raises(idemix.IdemixError):
+        idemix.verify_signature(
+            sig, disclosure, issuer_key.ipk, msg,
+            [None, 999, ATTR_VALUES[2], None, None], RH_INDEX, None, 0,
+        )
+
+
+def test_signature_wrong_message_fails(issuer_key, user, cri):
+    sk, cred = user
+    nym, r_nym = idemix.make_nym(sk, issuer_key.ipk, RNG)
+    disclosure = [0, 0, 0, 0, 0]
+    sig = idemix.new_signature(
+        cred, sk, nym, r_nym, issuer_key.ipk, disclosure, b"msg",
+        RH_INDEX, cri, RNG,
+    )
+    with pytest.raises(idemix.IdemixError):
+        idemix.verify_signature(
+            sig, disclosure, issuer_key.ipk, b"other msg",
+            [None] * 5, RH_INDEX, None, 0,
+        )
+
+
+def test_signature_tampered_aprime_fails(issuer_key, user, cri):
+    sk, cred = user
+    nym, r_nym = idemix.make_nym(sk, issuer_key.ipk, RNG)
+    disclosure = [0, 0, 0, 0, 0]
+    sig = idemix.new_signature(
+        cred, sk, nym, r_nym, issuer_key.ipk, disclosure, b"msg",
+        RH_INDEX, cri, RNG,
+    )
+    sig.a_prime.CopyFrom(
+        idemix.ecp_to_proto(bn.g1_mul(bn.G1_GEN, 7))
+    )
+    with pytest.raises(idemix.IdemixError):
+        idemix.verify_signature(
+            sig, disclosure, issuer_key.ipk, b"msg",
+            [None] * 5, RH_INDEX, None, 0,
+        )
+
+
+def test_nym_signature_roundtrip(issuer_key, user):
+    sk, _ = user
+    nym, r_nym = idemix.make_nym(sk, issuer_key.ipk, RNG)
+    sig = idemix.new_nym_signature(
+        sk, nym, r_nym, issuer_key.ipk, b"testing", RNG
+    )
+    idemix.verify_nym_signature(sig, nym, issuer_key.ipk, b"testing")
+    with pytest.raises(idemix.IdemixError):
+        idemix.verify_nym_signature(sig, nym, issuer_key.ipk, b"wrong")
+
+
+def test_wbb_roundtrip():
+    sk, pk = idemix.wbb_keygen(RNG)
+    m = bn.rand_mod_order(RNG)
+    sig = idemix.wbb_sign(sk, m)
+    idemix.wbb_verify(pk, sig, m)
+    with pytest.raises(idemix.IdemixError):
+        idemix.wbb_verify(pk, sig, (m + 1) % bn.R)
+
+
+def test_cri_epoch_pk(rev_key, cri):
+    idemix.verify_epoch_pk(
+        rev_key.public_key(), cri.epoch_pk, cri.epoch_pk_sig, 0,
+        idemix.ALG_NO_REVOCATION,
+    )
+    with pytest.raises(idemix.IdemixError):
+        idemix.verify_epoch_pk(
+            rev_key.public_key(), cri.epoch_pk, cri.epoch_pk_sig, 1,
+            idemix.ALG_NO_REVOCATION,
+        )

@@ -1,0 +1,90 @@
+"""Lifecycle (_lifecycle analog) tests — reference flows from
+core/chaincode/lifecycle/lifecycle_test.go: approve/check-readiness/
+commit sequencing, parameter mismatch detection, validation info."""
+
+import pytest
+
+from fabric_tpu.lifecycle import (
+    ChaincodeDefinition,
+    LifecycleError,
+    LifecycleResources,
+)
+
+
+@pytest.fixture()
+def resources():
+    pub, orgs = {}, {}
+    lr = LifecycleResources(
+        pub.get,
+        pub.__setitem__,
+        lambda o, k: orgs.get((o, k)),
+        lambda o, k, v: orgs.__setitem__((o, k), v),
+        ["Org1", "Org2", "Org3"],
+    )
+    return lr
+
+
+def test_approve_then_commit_majority(resources):
+    cd = ChaincodeDefinition(sequence=1, validation_parameter=b"pol")
+    resources.approve_chaincode_definition_for_org("Org1", "cc", cd, "pkg1")
+    assert resources.check_commit_readiness("cc", cd) == {
+        "Org1": True,
+        "Org2": False,
+        "Org3": False,
+    }
+    with pytest.raises(LifecycleError):
+        resources.commit_chaincode_definition("cc", cd)
+    resources.approve_chaincode_definition_for_org("Org2", "cc", cd)
+    approvals = resources.commit_chaincode_definition("cc", cd)
+    assert approvals["Org1"] and approvals["Org2"] and not approvals["Org3"]
+    assert resources.current_sequence("cc") == 1
+    assert resources.validation_info("cc") == ("vscc", b"pol")
+
+
+def test_sequence_must_advance_by_one(resources):
+    cd = ChaincodeDefinition(sequence=3)
+    with pytest.raises(LifecycleError):
+        resources.approve_chaincode_definition_for_org("Org1", "cc", cd)
+    with pytest.raises(LifecycleError):
+        resources.check_commit_readiness("cc", cd)
+
+
+def test_approval_with_different_params_not_ready(resources):
+    cd1 = ChaincodeDefinition(sequence=1, validation_parameter=b"a")
+    cd2 = ChaincodeDefinition(sequence=1, validation_parameter=b"b")
+    resources.approve_chaincode_definition_for_org("Org1", "cc", cd1)
+    resources.approve_chaincode_definition_for_org("Org2", "cc", cd2)
+    # readiness is per exact parameter match
+    assert resources.check_commit_readiness("cc", cd1) == {
+        "Org1": True,
+        "Org2": False,
+        "Org3": False,
+    }
+
+
+def test_upgrade_sequence(resources):
+    cd1 = ChaincodeDefinition(sequence=1)
+    for org in ("Org1", "Org2"):
+        resources.approve_chaincode_definition_for_org(org, "cc", cd1)
+    resources.commit_chaincode_definition("cc", cd1)
+
+    # re-approving the committed sequence with identical params is fine
+    resources.approve_chaincode_definition_for_org("Org3", "cc", cd1)
+    # ... but with different params is rejected
+    with pytest.raises(LifecycleError):
+        resources.approve_chaincode_definition_for_org(
+            "Org3", "cc", ChaincodeDefinition(sequence=1, version="2.0")
+        )
+
+    cd2 = ChaincodeDefinition(sequence=2, version="2.0")
+    for org in ("Org2", "Org3"):
+        resources.approve_chaincode_definition_for_org(org, "cc", cd2)
+    resources.commit_chaincode_definition("cc", cd2)
+    assert resources.current_sequence("cc") == 2
+    assert resources.query_chaincode_definition("cc").version == "2.0"
+
+
+def test_undefined_chaincode(resources):
+    assert resources.query_chaincode_definition("nope") is None
+    assert resources.validation_info("nope") is None
+    assert resources.current_sequence("nope") == 0

@@ -52,7 +52,7 @@ def test_version_match_and_mismatch():
     ]
     codes, updates, _ = run(db, txs)
     assert codes == [V, MVCC, V, MVCC]
-    assert updates.get("cc1", "k1") == (b"new", Version(5, 0))
+    assert updates.get("cc1", "k1") == (b"new", Version(5, 0), None)
 
 
 def test_intra_block_conflict_and_apply_as_you_go():
@@ -66,7 +66,7 @@ def test_intra_block_conflict_and_apply_as_you_go():
     ]
     codes, updates, _ = run(db, txs)
     assert codes == [V, MVCC, V]
-    assert updates.get("cc1", "k9") == (b"z", Version(5, 2))
+    assert updates.get("cc1", "k9") == (b"z", Version(5, 2), None)
 
 
 def test_invalid_tx_does_not_apply_writes():
@@ -88,7 +88,7 @@ def test_upstream_invalid_skipped():
         7, txs, [TxValidationCode.ENDORSEMENT_POLICY_FAILURE, V]
     )
     assert codes == [TxValidationCode.ENDORSEMENT_POLICY_FAILURE, V]
-    assert updates.get("cc1", "k") == (b"v", Version(7, 1))
+    assert updates.get("cc1", "k") == (b"v", Version(7, 1), None)
 
 
 def test_delete_write_and_read_of_deleted():

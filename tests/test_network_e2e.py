@@ -140,6 +140,31 @@ def test_recovery_replays_block_store(net, tmp_path):
     assert peer2.ledger.block_store.last_block_hash == want_hash
 
 
+def test_commit_hash_chain_survives_restart(net, tmp_path):
+    """A peer that restarts mid-chain must keep chaining COMMIT_HASH from
+    the stored value — otherwise the divergence detector false-positives
+    against a peer that never restarted."""
+    chain = SoloChain(CHANNEL, signer=net["oid"], batch_config=BatchConfig(max_message_count=1))
+    blocks = []
+    chain.deliver = blocks.append
+    for i in range(3):
+        chain.order(invoke(net, f"kr{i}", str(i).encode()))
+
+    steady = Channel(CHANNEL, str(tmp_path / "steady"), net["mgr"], net["registry"], PROVIDER)
+    for b in blocks:
+        steady.store_block(common_pb2.Block.FromString(b.SerializeToString()))
+
+    path = str(tmp_path / "restarting")
+    restarting = Channel(CHANNEL, path, net["mgr"], net["registry"], PROVIDER)
+    for b in blocks[:2]:
+        restarting.store_block(common_pb2.Block.FromString(b.SerializeToString()))
+    restarting.ledger.block_store.close()
+
+    reopened = Channel(CHANNEL, path, net["mgr"], net["registry"], PROVIDER)
+    reopened.store_block(common_pb2.Block.FromString(blocks[2].SerializeToString()))
+    assert reopened.ledger.commit_hash == steady.ledger.commit_hash
+
+
 def test_tampered_block_rejected(net, tmp_path):
     from fabric_tpu.peer.channel import BlockVerificationError
 
