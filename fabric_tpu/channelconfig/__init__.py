@@ -1,0 +1,35 @@
+"""On-ledger channel configuration (reference common/channelconfig +
+common/configtx + common/capabilities + configtxgen encoder)."""
+
+from fabric_tpu.channelconfig.bundle import (
+    Bundle,
+    ConfigError,
+    bundle_from_envelope,
+    bundle_from_genesis_block,
+)
+from fabric_tpu.channelconfig.configtx import ConfigTxError, Validator
+from fabric_tpu.channelconfig.encoder import (
+    ApplicationProfile,
+    OrdererProfile,
+    OrganizationProfile,
+    Profile,
+    genesis_block,
+    new_channel_group,
+    new_config,
+)
+
+__all__ = [
+    "ApplicationProfile",
+    "Bundle",
+    "ConfigError",
+    "ConfigTxError",
+    "OrdererProfile",
+    "OrganizationProfile",
+    "Profile",
+    "Validator",
+    "bundle_from_envelope",
+    "bundle_from_genesis_block",
+    "genesis_block",
+    "new_channel_group",
+    "new_config",
+]

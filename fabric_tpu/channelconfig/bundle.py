@@ -1,0 +1,307 @@
+"""Typed view over the on-ledger channel config tree (reference
+common/channelconfig/bundle.go + {channel,orderer,application,org,msp}
+config handlers).
+
+A Bundle is an immutable snapshot of one Config: typed accessors for the
+channel/orderer/application values, the per-channel MSPManager assembled
+from every org's MSP config value, and the policy Manager tree. Config
+blocks swap in a whole new Bundle (reference bundlesource.go) — nothing
+here mutates.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from fabric_tpu.channelconfig import capabilities as caps
+from fabric_tpu.msp.identity import MSP, MSPConfig, MSPManager, NodeOUs
+from fabric_tpu.policy.manager import Manager, build_manager
+from fabric_tpu.protos import (
+    common_pb2,
+    configtx_pb2,
+    configuration_pb2,
+    msp_config_pb2,
+    protoutil,
+)
+
+# Config tree group names (reference common/channelconfig/channel.go etc.)
+APPLICATION_GROUP = "Application"
+ORDERER_GROUP = "Orderer"
+CONSORTIUMS_GROUP = "Consortiums"
+
+# Config value names
+HASHING_ALGORITHM_KEY = "HashingAlgorithm"
+BLOCK_DATA_HASHING_STRUCTURE_KEY = "BlockDataHashingStructure"
+ORDERER_ADDRESSES_KEY = "OrdererAddresses"
+CONSORTIUM_KEY = "Consortium"
+CAPABILITIES_KEY = "Capabilities"
+MSP_KEY = "MSP"
+ANCHOR_PEERS_KEY = "AnchorPeers"
+ACLS_KEY = "ACLs"
+ENDPOINTS_KEY = "Endpoints"
+CONSENSUS_TYPE_KEY = "ConsensusType"
+BATCH_SIZE_KEY = "BatchSize"
+BATCH_TIMEOUT_KEY = "BatchTimeout"
+CHANNEL_RESTRICTIONS_KEY = "ChannelRestrictions"
+CHANNEL_CREATION_POLICY_KEY = "ChannelCreationPolicy"
+
+# MSPConfig.type values (reference msp/msp.go ProviderType)
+MSP_TYPE_FABRIC = 0
+MSP_TYPE_IDEMIX = 1
+
+
+class ConfigError(Exception):
+    pass
+
+
+def _value(group: configtx_pb2.ConfigGroup, key: str, msg_cls):
+    cv = group.values.get(key)
+    if cv is None:
+        return None
+    return protoutil.unmarshal(msg_cls, cv.value)
+
+
+def _capability_names(group: configtx_pb2.ConfigGroup) -> List[str]:
+    v = _value(group, CAPABILITIES_KEY, configuration_pb2.Capabilities)
+    return sorted(v.capabilities) if v is not None else []
+
+
+@dataclass(frozen=True)
+class OrgConfig:
+    name: str
+    msp_id: str
+    anchor_peers: Tuple[Tuple[str, int], ...] = ()
+    ordererendpoints: Tuple[str, ...] = ()
+
+
+@dataclass(frozen=True)
+class OrdererConfig:
+    consensus_type: str
+    consensus_metadata: bytes
+    consensus_state: int
+    batch_size_max_messages: int
+    batch_size_absolute_max_bytes: int
+    batch_size_preferred_max_bytes: int
+    batch_timeout: str
+    orgs: Tuple[OrgConfig, ...]
+    capabilities: caps.OrdererCapabilities
+    max_channels: int = 0
+
+
+@dataclass(frozen=True)
+class ApplicationConfig:
+    orgs: Tuple[OrgConfig, ...]
+    capabilities: caps.ApplicationCapabilities
+    acls: Dict[str, str] = field(default_factory=dict)
+
+
+def fabric_msp_config_to_local(cfg: msp_config_pb2.FabricMSPConfig) -> MSPConfig:
+    node_ous = NodeOUs()
+    if cfg.HasField("fabric_node_ous"):
+        f = cfg.fabric_node_ous
+        node_ous = NodeOUs(
+            enable=f.enable,
+            client_ou=f.client_ou_identifier.organizational_unit_identifier
+            or "client",
+            peer_ou=f.peer_ou_identifier.organizational_unit_identifier or "peer",
+            admin_ou=f.admin_ou_identifier.organizational_unit_identifier
+            or "admin",
+            orderer_ou=f.orderer_ou_identifier.organizational_unit_identifier
+            or "orderer",
+        )
+    return MSPConfig(
+        msp_id=cfg.name,
+        root_certs=list(cfg.root_certs),
+        intermediate_certs=list(cfg.intermediate_certs),
+        admins=list(cfg.admins),
+        revocation_list=list(cfg.revocation_list),
+        node_ous=node_ous,
+    )
+
+
+def local_msp_config_to_proto(cfg: MSPConfig) -> msp_config_pb2.MSPConfig:
+    f = msp_config_pb2.FabricMSPConfig()
+    f.name = cfg.msp_id
+    f.root_certs.extend(cfg.root_certs)
+    f.intermediate_certs.extend(cfg.intermediate_certs)
+    f.admins.extend(cfg.admins)
+    f.revocation_list.extend(cfg.revocation_list)
+    if cfg.node_ous.enable:
+        f.fabric_node_ous.enable = True
+        f.fabric_node_ous.client_ou_identifier.organizational_unit_identifier = (
+            cfg.node_ous.client_ou
+        )
+        f.fabric_node_ous.peer_ou_identifier.organizational_unit_identifier = (
+            cfg.node_ous.peer_ou
+        )
+        f.fabric_node_ous.admin_ou_identifier.organizational_unit_identifier = (
+            cfg.node_ous.admin_ou
+        )
+        f.fabric_node_ous.orderer_ou_identifier.organizational_unit_identifier = (
+            cfg.node_ous.orderer_ou
+        )
+    out = msp_config_pb2.MSPConfig()
+    out.type = MSP_TYPE_FABRIC
+    out.config = f.SerializeToString()
+    return out
+
+
+def _parse_org(name: str, group: configtx_pb2.ConfigGroup, provider) -> Tuple[OrgConfig, Optional[MSP]]:
+    msp_cfg = _value(group, MSP_KEY, msp_config_pb2.MSPConfig)
+    msp_obj = None
+    msp_id = name
+    if msp_cfg is not None and msp_cfg.type == MSP_TYPE_FABRIC:
+        fabric_cfg = protoutil.unmarshal(
+            msp_config_pb2.FabricMSPConfig, msp_cfg.config
+        )
+        local = fabric_msp_config_to_local(fabric_cfg)
+        msp_id = local.msp_id
+        msp_obj = MSP(local, provider)
+    anchors: Tuple[Tuple[str, int], ...] = ()
+    ap = _value(group, ANCHOR_PEERS_KEY, configuration_pb2.AnchorPeers)
+    if ap is not None:
+        anchors = tuple((p.host, p.port) for p in ap.anchor_peers)
+    endpoints: Tuple[str, ...] = ()
+    ep = _value(group, ENDPOINTS_KEY, configuration_pb2.OrdererAddresses)
+    if ep is not None:
+        endpoints = tuple(ep.addresses)
+    return OrgConfig(name, msp_id, anchors, endpoints), msp_obj
+
+
+class Bundle:
+    """Immutable typed snapshot of one channel Config."""
+
+    def __init__(
+        self,
+        channel_id: str,
+        config: configtx_pb2.Config,
+        provider=None,
+    ):
+        if not config.HasField("channel_group"):
+            raise ConfigError("config must contain a channel group")
+        if provider is None:
+            from fabric_tpu.crypto.bccsp import default_provider
+
+            provider = default_provider()
+        self.channel_id = channel_id
+        self.config = config
+        root = config.channel_group
+
+        # -- channel-level values ------------------------------------------
+        ha = _value(root, HASHING_ALGORITHM_KEY, configuration_pb2.HashingAlgorithm)
+        self.hashing_algorithm = ha.name if ha is not None else "SHA256"
+        if self.hashing_algorithm not in ("SHA256", "SHA2_256"):
+            raise ConfigError(
+                f"unsupported hashing algorithm {self.hashing_algorithm}"
+            )
+        bdhs = _value(
+            root,
+            BLOCK_DATA_HASHING_STRUCTURE_KEY,
+            configuration_pb2.BlockDataHashingStructure,
+        )
+        self.block_data_hashing_width = bdhs.width if bdhs is not None else 2**32 - 1
+        oa = _value(root, ORDERER_ADDRESSES_KEY, configuration_pb2.OrdererAddresses)
+        self.orderer_addresses = list(oa.addresses) if oa is not None else []
+        cons = _value(root, CONSORTIUM_KEY, configuration_pb2.Consortium)
+        self.consortium_name = cons.name if cons is not None else ""
+        self.channel_capabilities = caps.ChannelCapabilities(_capability_names(root))
+
+        msps: List[MSP] = []
+
+        # -- orderer group --------------------------------------------------
+        self.orderer: Optional[OrdererConfig] = None
+        og = root.groups.get(ORDERER_GROUP)
+        if og is not None:
+            ct = _value(og, CONSENSUS_TYPE_KEY, configuration_pb2.ConsensusType)
+            bs = _value(og, BATCH_SIZE_KEY, configuration_pb2.BatchSize)
+            bt = _value(og, BATCH_TIMEOUT_KEY, configuration_pb2.BatchTimeout)
+            cr = _value(
+                og, CHANNEL_RESTRICTIONS_KEY, configuration_pb2.ChannelRestrictions
+            )
+            orgs = []
+            for name, sub in sorted(og.groups.items()):
+                org, msp_obj = _parse_org(name, sub, provider)
+                orgs.append(org)
+                if msp_obj is not None:
+                    msps.append(msp_obj)
+            self.orderer = OrdererConfig(
+                consensus_type=ct.type if ct is not None else "solo",
+                consensus_metadata=ct.metadata if ct is not None else b"",
+                consensus_state=ct.state if ct is not None else 0,
+                batch_size_max_messages=bs.max_message_count if bs else 500,
+                batch_size_absolute_max_bytes=bs.absolute_max_bytes
+                if bs
+                else 10 * 1024 * 1024,
+                batch_size_preferred_max_bytes=bs.preferred_max_bytes
+                if bs
+                else 2 * 1024 * 1024,
+                batch_timeout=bt.timeout if bt is not None else "2s",
+                orgs=tuple(orgs),
+                capabilities=caps.OrdererCapabilities(_capability_names(og)),
+                max_channels=cr.max_count if cr is not None else 0,
+            )
+
+        # -- application group ----------------------------------------------
+        self.application: Optional[ApplicationConfig] = None
+        ag = root.groups.get(APPLICATION_GROUP)
+        if ag is not None:
+            orgs = []
+            for name, sub in sorted(ag.groups.items()):
+                org, msp_obj = _parse_org(name, sub, provider)
+                orgs.append(org)
+                if msp_obj is not None:
+                    msps.append(msp_obj)
+            acls: Dict[str, str] = {}
+            av = _value(ag, ACLS_KEY, configuration_pb2.ACLs)
+            if av is not None:
+                acls = {k: v.policy_ref for k, v in av.acls.items()}
+            self.application = ApplicationConfig(
+                orgs=tuple(orgs),
+                capabilities=caps.ApplicationCapabilities(_capability_names(ag)),
+                acls=acls,
+            )
+
+        # -- consortiums (system channel only) ------------------------------
+        self.consortiums: Dict[str, List[OrgConfig]] = {}
+        cg = root.groups.get(CONSORTIUMS_GROUP)
+        if cg is not None:
+            for cname, consortium in sorted(cg.groups.items()):
+                corgs = []
+                for name, sub in sorted(consortium.groups.items()):
+                    org, msp_obj = _parse_org(name, sub, provider)
+                    corgs.append(org)
+                    if msp_obj is not None:
+                        msps.append(msp_obj)
+                self.consortiums[cname] = corgs
+
+        self.msp_manager = MSPManager(msps)
+        self.policy_manager: Manager = build_manager(
+            "Channel", root, self.msp_manager, provider
+        )
+
+    # convenience ----------------------------------------------------------
+    @property
+    def sequence(self) -> int:
+        return self.config.sequence
+
+    def acl_policy_ref(self, resource: str, default: str) -> str:
+        if self.application is not None and resource in self.application.acls:
+            ref = self.application.acls[resource]
+            return ref if ref.startswith("/") else f"/Channel/Application/{ref}"
+        return default
+
+
+def bundle_from_envelope(env: common_pb2.Envelope, provider=None) -> Bundle:
+    """Extract a Bundle from a CONFIG envelope (e.g. from a genesis block)."""
+    payload = protoutil.unmarshal(common_pb2.Payload, env.payload)
+    chdr = protoutil.unmarshal(
+        common_pb2.ChannelHeader, payload.header.channel_header
+    )
+    cenv = protoutil.unmarshal(configtx_pb2.ConfigEnvelope, payload.data)
+    return Bundle(chdr.channel_id, cenv.config, provider)
+
+
+def bundle_from_genesis_block(block: common_pb2.Block, provider=None) -> Bundle:
+    env = protoutil.get_envelope_from_block_data(block.data.data[0])
+    return bundle_from_envelope(env, provider)

@@ -1,0 +1,339 @@
+"""Config transaction validation (reference common/configtx/validator.go,
+update.go).
+
+A ConfigUpdate names a read set (elements whose versions must match the
+current config) and a write set (the new state). The delta = write-set
+elements whose version advanced; each delta element must advance by
+exactly one and be authorized by the MOD_POLICY of the existing element
+(for new elements: the enclosing group's mod policy), evaluated over the
+ConfigSignatures. The result is the current config with the write set
+merged and sequence+1.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from fabric_tpu.policy.manager import Manager, PolicyError, SignedData
+from fabric_tpu.protos import common_pb2, configtx_pb2, protoutil
+
+
+class ConfigTxError(Exception):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Flatten the config tree into path-keyed elements (update.go works on
+# "scoped values"; paths here are ("groups", name, ...) tuples).
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class _Elem:
+    kind: str  # "group" | "value" | "policy"
+    path: Tuple[str, ...]  # group path from root (excluding the root)
+    name: str  # "" for the group itself
+    version: int
+    mod_policy: str
+    data: bytes  # serialized payload for equality checks
+
+
+def _flatten(group: configtx_pb2.ConfigGroup, path: Tuple[str, ...] = ()) -> Dict:
+    out: Dict[Tuple[str, str, Tuple[str, ...]], _Elem] = {}
+    out[("group", "", path)] = _Elem(
+        "group", path, "", group.version, group.mod_policy, b""
+    )
+    for name, cv in group.values.items():
+        out[("value", name, path)] = _Elem(
+            "value", path, name, cv.version, cv.mod_policy, cv.value
+        )
+    for name, cp in group.policies.items():
+        out[("policy", name, path)] = _Elem(
+            "policy",
+            path,
+            name,
+            cp.version,
+            cp.mod_policy,
+            cp.policy.SerializeToString(),
+        )
+    for name, sub in group.groups.items():
+        out.update(_flatten(sub, path + (name,)))
+    return out
+
+
+def _group_at(root: configtx_pb2.ConfigGroup, path: Tuple[str, ...]):
+    g = root
+    for seg in path:
+        if seg not in g.groups:
+            return None
+        g = g.groups[seg]
+    return g
+
+
+def _resolve_mod_policy(mod_policy: str, path: Tuple[str, ...]) -> str:
+    """Relative mod policies resolve against the element's group path
+    (reference policies/util.go / validator relativity rules)."""
+    if not mod_policy:
+        return ""
+    if mod_policy.startswith("/"):
+        return mod_policy
+    return "/" + "/".join(("Channel",) + path + (mod_policy,))
+
+
+class Validator:
+    """Per-channel config state machine (reference configtx.ValidatorImpl)."""
+
+    def __init__(
+        self,
+        channel_id: str,
+        config: configtx_pb2.Config,
+        policy_manager: Optional[Manager] = None,
+    ):
+        if not config.HasField("channel_group"):
+            raise ConfigTxError("config did not contain a channel group")
+        self.channel_id = channel_id
+        self.config = config
+        self.policy_manager = policy_manager
+
+    @property
+    def sequence(self) -> int:
+        return self.config.sequence
+
+    def propose_config_update(
+        self, update_env: common_pb2.Envelope
+    ) -> configtx_pb2.ConfigEnvelope:
+        """CONFIG_UPDATE envelope -> the resulting ConfigEnvelope, or raise."""
+        payload = protoutil.unmarshal(common_pb2.Payload, update_env.payload)
+        cue = protoutil.unmarshal(configtx_pb2.ConfigUpdateEnvelope, payload.data)
+        return self.propose_config_update_envelope(cue, last_update=update_env)
+
+    def propose_config_update_envelope(
+        self,
+        cue: configtx_pb2.ConfigUpdateEnvelope,
+        last_update: Optional[common_pb2.Envelope] = None,
+    ) -> configtx_pb2.ConfigEnvelope:
+        update = protoutil.unmarshal(configtx_pb2.ConfigUpdate, cue.config_update)
+        if update.channel_id != self.channel_id:
+            raise ConfigTxError(
+                f"update is for channel {update.channel_id!r}, not "
+                f"{self.channel_id!r}"
+            )
+
+        current = _flatten(self.config.channel_group)
+        read_set = _flatten(update.read_set)
+        write_set = _flatten(update.write_set)
+
+        # 1. verify read set versions (update.go verifyReadSet)
+        for key, elem in read_set.items():
+            cur = current.get(key)
+            if cur is None:
+                raise ConfigTxError(
+                    f"existing config does not contain element for "
+                    f"{key[0]} {'/'.join(key[2] + (key[1],))} but was in the read set"
+                )
+            if cur.version != elem.version:
+                raise ConfigTxError(
+                    f"readset expected key {'/'.join(key[2] + (key[1],))} at "
+                    f"version {elem.version}, but got version {cur.version}"
+                )
+
+        # 2. compute the delta set (update.go computeDeltaSet)
+        delta: Dict[Tuple[str, str, Tuple[str, ...]], _Elem] = {}
+        for key, elem in write_set.items():
+            read = read_set.get(key)
+            if read is not None and read.version == elem.version:
+                continue  # unmodified carry-over
+            delta[key] = elem
+
+        # 3. verify the delta set + authorize (update.go verifyDeltaSet)
+        signed_data = []
+        for s in cue.signatures:
+            data, creator = _config_update_signed_data(cue, s)
+            signed_data.append(SignedData(data, creator, s.signature))
+        for key, elem in delta.items():
+            cur = current.get(key)
+            expected = (cur.version + 1) if cur is not None else 0
+            if elem.version != expected:
+                raise ConfigTxError(
+                    f"attempt to set key {'/'.join(key[2] + (key[1],))} to "
+                    f"version {elem.version}, but key is at version "
+                    f"{cur.version if cur else '<absent>'}"
+                )
+            mod_policy = (
+                cur.mod_policy
+                if cur is not None
+                else self._new_item_mod_policy(key, write_set, current)
+            )
+            self._authorize(mod_policy, key, signed_data)
+
+        # 4. apply: overlay ONLY the delta onto the current config (reference
+        # computeUpdateResult, update.go:192-203 — same-version write-set
+        # content is discarded, keeping current bytes, so tampered
+        # unmodified-version elements cannot bypass authorization).
+        new_group = _merge_delta(
+            self.config.channel_group, update.write_set, delta, ()
+        )
+
+        out = configtx_pb2.ConfigEnvelope()
+        out.config.sequence = self.config.sequence + 1
+        out.config.channel_group.CopyFrom(new_group)
+        if last_update is not None:
+            out.last_update.CopyFrom(last_update)
+        return out
+
+    def validate(self, config_env: configtx_pb2.ConfigEnvelope) -> None:
+        """Validate a proposed full config against the current one
+        (reference Validator.Validate): recompute from last_update and
+        require equality."""
+        if config_env.config.sequence != self.config.sequence + 1:
+            raise ConfigTxError(
+                f"config currently at sequence {self.config.sequence}, cannot "
+                f"validate config at sequence {config_env.config.sequence}"
+            )
+        if config_env.HasField("last_update"):
+            computed = self.propose_config_update(config_env.last_update)
+            if (
+                computed.config.channel_group.SerializeToString(deterministic=True)
+                != config_env.config.channel_group.SerializeToString(
+                    deterministic=True
+                )
+            ):
+                raise ConfigTxError(
+                    "config proposed does not match calculated config"
+                )
+
+    def apply(self, config_env: configtx_pb2.ConfigEnvelope) -> None:
+        self.validate(config_env)
+        self.config = configtx_pb2.Config()
+        self.config.CopyFrom(config_env.config)
+
+    # -- helpers -----------------------------------------------------------
+
+    def _new_item_mod_policy(self, key, write_set, current) -> str:
+        """New elements are governed by the nearest existing ancestor
+        group's mod policy (reference update.go verifyDeltaSet uses the
+        group's mod_policy for adds)."""
+        path = key[2]
+        while True:
+            cur = current.get(("group", "", path))
+            if cur is not None:
+                return cur.mod_policy
+            if not path:
+                return ""
+            path = path[:-1]
+
+    def _authorize(self, mod_policy: str, key, signed_data) -> None:
+        if self.policy_manager is None:
+            return  # unauthenticated mode (tests / local tooling)
+        if not mod_policy:
+            raise ConfigTxError(
+                f"key {'/'.join(key[2] + (key[1],))} has no mod policy; "
+                f"cannot modify"
+            )
+        resolved = _resolve_mod_policy(mod_policy, key[2])
+        policy, ok = self.policy_manager.get_policy(resolved)
+        if not ok:
+            raise ConfigTxError(f"mod policy {resolved} not found")
+        try:
+            policy.evaluate_signed_data(signed_data)
+        except PolicyError as e:
+            raise ConfigTxError(
+                f"config update is not authorized by mod policy {resolved}: {e}"
+            ) from e
+
+
+def _config_update_signed_data(
+    cue: configtx_pb2.ConfigUpdateEnvelope, sig: configtx_pb2.ConfigSignature
+) -> Tuple[bytes, bytes]:
+    """Signed bytes = signature_header || config_update (reference
+    ConfigUpdateEnvelope.AsSignedData, protoutil/signeddata.go:35-53);
+    returns (data, creator identity bytes)."""
+    sh = protoutil.unmarshal(common_pb2.SignatureHeader, sig.signature_header)
+    return sig.signature_header + cue.config_update, sh.creator
+
+
+def sign_config_update(cue: configtx_pb2.ConfigUpdateEnvelope, signer) -> None:
+    """Append one ConfigSignature using a fabric_tpu.msp.signer-style signer
+    (has .serialize() and .sign(bytes))."""
+    import os
+
+    sig = cue.signatures.add()
+    sh = common_pb2.SignatureHeader()
+    sh.creator = signer.serialize()
+    sh.nonce = os.urandom(24)
+    sig.signature_header = sh.SerializeToString()
+    sig.signature = signer.sign(sig.signature_header + cue.config_update)
+
+
+def _merge_delta(
+    current: Optional[configtx_pb2.ConfigGroup],
+    write: Optional[configtx_pb2.ConfigGroup],
+    delta: Dict,
+    path: Tuple[str, ...],
+) -> configtx_pb2.ConfigGroup:
+    """Current tree with delta elements overlaid. Content for non-delta
+    elements always comes from CURRENT (never the write set). Group
+    membership follows the write set only when the group itself is in the
+    delta (a version bump authorizes adds/removes); otherwise membership
+    is current plus any new delta children."""
+    out = configtx_pb2.ConfigGroup()
+    group_in_delta = ("group", "", path) in delta
+    meta_src = write if (group_in_delta and write is not None) else current
+    if meta_src is not None:
+        out.version = meta_src.version
+        out.mod_policy = meta_src.mod_policy
+
+    cur_values = dict(current.values) if current is not None else {}
+    cur_policies = dict(current.policies) if current is not None else {}
+    cur_groups = dict(current.groups) if current is not None else {}
+    wr_values = dict(write.values) if write is not None else {}
+    wr_policies = dict(write.policies) if write is not None else {}
+    wr_groups = dict(write.groups) if write is not None else {}
+
+    if group_in_delta:
+        value_names = set(wr_values)
+        policy_names = set(wr_policies)
+        group_names = set(wr_groups)
+    else:
+        value_names = set(cur_values) | {
+            n for n in wr_values if ("value", n, path) in delta
+        }
+        policy_names = set(cur_policies) | {
+            n for n in wr_policies if ("policy", n, path) in delta
+        }
+        group_names = set(cur_groups) | {
+            n for n in wr_groups if _subtree_has_delta(delta, path + (n,))
+        }
+
+    for name in value_names:
+        src = (
+            wr_values[name]
+            if ("value", name, path) in delta
+            else cur_values.get(name)
+        )
+        if src is not None:
+            out.values[name].CopyFrom(src)
+    for name in policy_names:
+        src = (
+            wr_policies[name]
+            if ("policy", name, path) in delta
+            else cur_policies.get(name)
+        )
+        if src is not None:
+            out.policies[name].CopyFrom(src)
+    for name in group_names:
+        sub_path = path + (name,)
+        if _subtree_has_delta(delta, sub_path):
+            out.groups[name].CopyFrom(
+                _merge_delta(
+                    cur_groups.get(name), wr_groups.get(name), delta, sub_path
+                )
+            )
+        elif name in cur_groups:
+            out.groups[name].CopyFrom(cur_groups[name])
+    return out
+
+
+def _subtree_has_delta(delta: Dict, path: Tuple[str, ...]) -> bool:
+    return any(key[2][: len(path)] == path for key in delta)

@@ -1,0 +1,348 @@
+"""Genesis/config-tx generation (reference cmd/configtxgen +
+usable-inter-nal/configtxgen/encoder/encoder.go).
+
+Profiles are plain dataclasses (the reference reads configtx.yaml into
+equivalent structs). The encoder builds the ConfigGroup tree with the
+reference's default implicit-meta channel policies and per-org signature
+policies, then wraps it as a genesis block or a channel-creation
+ConfigUpdate envelope.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from fabric_tpu.channelconfig import bundle as bundlemod
+from fabric_tpu.msp.identity import MSPConfig
+from fabric_tpu.policy import ast as policy_ast
+from fabric_tpu.policy import proto_convert
+from fabric_tpu.protos import (
+    common_pb2,
+    configtx_pb2,
+    configuration_pb2,
+    policies_pb2,
+    protoutil,
+)
+
+ADMINS_POLICY_KEY = "Admins"
+READERS_POLICY_KEY = "Readers"
+WRITERS_POLICY_KEY = "Writers"
+ENDORSEMENT_POLICY_KEY = "Endorsement"
+LIFECYCLE_ENDORSEMENT_POLICY_KEY = "LifecycleEndorsement"
+BLOCK_VALIDATION_POLICY_KEY = "BlockValidation"
+
+
+@dataclass
+class OrganizationProfile:
+    name: str
+    msp: MSPConfig
+    anchor_peers: List[Tuple[str, int]] = field(default_factory=list)
+    orderer_endpoints: List[str] = field(default_factory=list)
+    # policy name -> policy DSL string; defaults derived from msp_id if empty
+    policies: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class ApplicationProfile:
+    organizations: List[OrganizationProfile] = field(default_factory=list)
+    capabilities: List[str] = field(default_factory=lambda: ["V2_0"])
+    acls: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class OrdererProfile:
+    orderer_type: str = "solo"
+    addresses: List[str] = field(default_factory=list)
+    batch_timeout: str = "2s"
+    max_message_count: int = 500
+    absolute_max_bytes: int = 10 * 1024 * 1024
+    preferred_max_bytes: int = 2 * 1024 * 1024
+    organizations: List[OrganizationProfile] = field(default_factory=list)
+    capabilities: List[str] = field(default_factory=lambda: ["V2_0"])
+    raft_consenters: List[Tuple[str, int, bytes, bytes]] = field(
+        default_factory=list
+    )  # (host, port, client_tls_cert, server_tls_cert)
+
+
+@dataclass
+class Profile:
+    """One configtx.yaml profile."""
+
+    consortium: str = ""
+    application: Optional[ApplicationProfile] = None
+    orderer: Optional[OrdererProfile] = None
+    consortiums: Dict[str, List[OrganizationProfile]] = field(default_factory=dict)
+    capabilities: List[str] = field(default_factory=lambda: ["V2_0"])
+    policies: Dict[str, str] = field(default_factory=dict)
+
+
+class EncoderError(Exception):
+    pass
+
+
+def _implicit_meta(rule: int, sub_policy: str) -> policies_pb2.Policy:
+    meta = policies_pb2.ImplicitMetaPolicy()
+    meta.rule = rule
+    meta.sub_policy = sub_policy
+    out = policies_pb2.Policy()
+    out.type = policies_pb2.Policy.IMPLICIT_META
+    out.value = meta.SerializeToString()
+    return out
+
+
+def _signature_policy(dsl: str) -> policies_pb2.Policy:
+    env = policy_ast.from_dsl(dsl)
+    out = policies_pb2.Policy()
+    out.type = policies_pb2.Policy.SIGNATURE
+    out.value = proto_convert.marshal_envelope(env)
+    return out
+
+
+def _add_policy(
+    group: configtx_pb2.ConfigGroup,
+    name: str,
+    policy: policies_pb2.Policy,
+    mod_policy: str = ADMINS_POLICY_KEY,
+) -> None:
+    cp = group.policies[name]
+    cp.policy.CopyFrom(policy)
+    cp.mod_policy = mod_policy
+
+
+def _add_value(
+    group: configtx_pb2.ConfigGroup,
+    name: str,
+    msg,
+    mod_policy: str = ADMINS_POLICY_KEY,
+) -> None:
+    cv = group.values[name]
+    cv.value = msg.SerializeToString()
+    cv.mod_policy = mod_policy
+
+
+def _implicit_meta_defaults(group: configtx_pb2.ConfigGroup) -> None:
+    R = policies_pb2.ImplicitMetaPolicy
+    _add_policy(group, READERS_POLICY_KEY, _implicit_meta(R.ANY, READERS_POLICY_KEY))
+    _add_policy(group, WRITERS_POLICY_KEY, _implicit_meta(R.ANY, WRITERS_POLICY_KEY))
+    _add_policy(
+        group, ADMINS_POLICY_KEY, _implicit_meta(R.MAJORITY, ADMINS_POLICY_KEY)
+    )
+
+
+def _capabilities_value(names: Sequence[str]) -> configuration_pb2.Capabilities:
+    v = configuration_pb2.Capabilities()
+    for n in names:
+        v.capabilities[n].SetInParent()
+    return v
+
+
+def new_org_group(
+    org: OrganizationProfile, with_anchors: bool = False, orderer_org: bool = False
+) -> configtx_pb2.ConfigGroup:
+    """Reference encoder.NewOrgConfigGroup: MSP value + org-scoped
+    Readers/Writers/Admins (+Endorsement) signature policies."""
+    g = configtx_pb2.ConfigGroup()
+    g.mod_policy = ADMINS_POLICY_KEY
+    msp_id = org.msp.msp_id
+    defaults = {
+        READERS_POLICY_KEY: f"OR('{msp_id}.member')",
+        WRITERS_POLICY_KEY: f"OR('{msp_id}.member')",
+        ADMINS_POLICY_KEY: f"OR('{msp_id}.admin')",
+    }
+    if not orderer_org:
+        defaults[ENDORSEMENT_POLICY_KEY] = f"OR('{msp_id}.member')"
+    defaults.update(org.policies)
+    for name, dsl in defaults.items():
+        _add_policy(g, name, _signature_policy(dsl))
+    _add_value(g, bundlemod.MSP_KEY, bundlemod.local_msp_config_to_proto(org.msp))
+    if with_anchors and org.anchor_peers:
+        ap = configuration_pb2.AnchorPeers()
+        for host, port in org.anchor_peers:
+            p = ap.anchor_peers.add()
+            p.host = host
+            p.port = port
+        _add_value(g, bundlemod.ANCHOR_PEERS_KEY, ap)
+    if orderer_org and org.orderer_endpoints:
+        ep = configuration_pb2.OrdererAddresses()
+        ep.addresses.extend(org.orderer_endpoints)
+        _add_value(g, bundlemod.ENDPOINTS_KEY, ep)
+    return g
+
+
+def new_application_group(profile: ApplicationProfile) -> configtx_pb2.ConfigGroup:
+    g = configtx_pb2.ConfigGroup()
+    g.mod_policy = ADMINS_POLICY_KEY
+    _implicit_meta_defaults(g)
+    R = policies_pb2.ImplicitMetaPolicy
+    _add_policy(
+        g,
+        ENDORSEMENT_POLICY_KEY,
+        _implicit_meta(R.MAJORITY, ENDORSEMENT_POLICY_KEY),
+    )
+    _add_policy(
+        g,
+        LIFECYCLE_ENDORSEMENT_POLICY_KEY,
+        _implicit_meta(R.MAJORITY, ENDORSEMENT_POLICY_KEY),
+    )
+    if profile.capabilities:
+        _add_value(
+            g, bundlemod.CAPABILITIES_KEY, _capabilities_value(profile.capabilities)
+        )
+    if profile.acls:
+        acls = configuration_pb2.ACLs()
+        for k, ref in profile.acls.items():
+            acls.acls[k].policy_ref = ref
+        _add_value(g, bundlemod.ACLS_KEY, acls)
+    for org in profile.organizations:
+        g.groups[org.name].CopyFrom(new_org_group(org, with_anchors=True))
+    return g
+
+
+def new_orderer_group(profile: OrdererProfile) -> configtx_pb2.ConfigGroup:
+    g = configtx_pb2.ConfigGroup()
+    g.mod_policy = ADMINS_POLICY_KEY
+    _implicit_meta_defaults(g)
+    R = policies_pb2.ImplicitMetaPolicy
+    _add_policy(
+        g,
+        BLOCK_VALIDATION_POLICY_KEY,
+        _implicit_meta(R.ANY, WRITERS_POLICY_KEY),
+    )
+    ct = configuration_pb2.ConsensusType()
+    ct.type = profile.orderer_type
+    if profile.orderer_type == "etcdraft":
+        meta = configuration_pb2.RaftConfigMetadata()
+        for host, port, client_cert, server_cert in profile.raft_consenters:
+            c = meta.consenters.add()
+            c.host = host
+            c.port = port
+            c.client_tls_cert = client_cert
+            c.server_tls_cert = server_cert
+        meta.options.tick_interval = "500ms"
+        meta.options.election_tick = 10
+        meta.options.heartbeat_tick = 1
+        meta.options.max_inflight_blocks = 5
+        meta.options.snapshot_interval_size = 16 * 1024 * 1024
+        ct.metadata = meta.SerializeToString()
+    _add_value(g, bundlemod.CONSENSUS_TYPE_KEY, ct)
+    bs = configuration_pb2.BatchSize()
+    bs.max_message_count = profile.max_message_count
+    bs.absolute_max_bytes = profile.absolute_max_bytes
+    bs.preferred_max_bytes = profile.preferred_max_bytes
+    _add_value(g, bundlemod.BATCH_SIZE_KEY, bs)
+    bt = configuration_pb2.BatchTimeout()
+    bt.timeout = profile.batch_timeout
+    _add_value(g, bundlemod.BATCH_TIMEOUT_KEY, bt)
+    if profile.capabilities:
+        _add_value(
+            g, bundlemod.CAPABILITIES_KEY, _capabilities_value(profile.capabilities)
+        )
+    for org in profile.organizations:
+        g.groups[org.name].CopyFrom(new_org_group(org, orderer_org=True))
+    return g
+
+
+def new_channel_group(profile: Profile) -> configtx_pb2.ConfigGroup:
+    """Reference encoder.NewChannelGroup."""
+    root = configtx_pb2.ConfigGroup()
+    root.mod_policy = ADMINS_POLICY_KEY
+    _implicit_meta_defaults(root)
+    ha = configuration_pb2.HashingAlgorithm()
+    ha.name = "SHA256"
+    _add_value(root, bundlemod.HASHING_ALGORITHM_KEY, ha)
+    bdhs = configuration_pb2.BlockDataHashingStructure()
+    bdhs.width = 2**32 - 1
+    _add_value(root, bundlemod.BLOCK_DATA_HASHING_STRUCTURE_KEY, bdhs)
+    if profile.orderer is not None and profile.orderer.addresses:
+        oa = configuration_pb2.OrdererAddresses()
+        oa.addresses.extend(profile.orderer.addresses)
+        _add_value(root, bundlemod.ORDERER_ADDRESSES_KEY, oa)
+    if profile.consortium:
+        cons = configuration_pb2.Consortium()
+        cons.name = profile.consortium
+        _add_value(root, bundlemod.CONSORTIUM_KEY, cons)
+    if profile.capabilities:
+        _add_value(
+            root, bundlemod.CAPABILITIES_KEY, _capabilities_value(profile.capabilities)
+        )
+    if profile.orderer is not None:
+        root.groups[bundlemod.ORDERER_GROUP].CopyFrom(
+            new_orderer_group(profile.orderer)
+        )
+    if profile.application is not None:
+        root.groups[bundlemod.APPLICATION_GROUP].CopyFrom(
+            new_application_group(profile.application)
+        )
+    if profile.consortiums:
+        cg = configtx_pb2.ConfigGroup()
+        cg.mod_policy = "/Channel/Orderer/Admins"
+        for cname, orgs in profile.consortiums.items():
+            consortium = configtx_pb2.ConfigGroup()
+            consortium.mod_policy = "/Channel/Orderer/Admins"
+            ccp = configtx_pb2.ConfigPolicy()
+            ccp.policy.CopyFrom(
+                _implicit_meta(policies_pb2.ImplicitMetaPolicy.ANY, ADMINS_POLICY_KEY)
+            )
+            consortium.values[bundlemod.CHANNEL_CREATION_POLICY_KEY].value = (
+                ccp.policy.SerializeToString()
+            )
+            for org in orgs:
+                consortium.groups[org.name].CopyFrom(new_org_group(org))
+            cg.groups[cname].CopyFrom(consortium)
+        root.groups[bundlemod.CONSORTIUMS_GROUP].CopyFrom(cg)
+    return root
+
+
+def new_config(profile: Profile, sequence: int = 0) -> configtx_pb2.Config:
+    cfg = configtx_pb2.Config()
+    cfg.sequence = sequence
+    cfg.channel_group.CopyFrom(new_channel_group(profile))
+    return cfg
+
+
+def genesis_block(profile: Profile, channel_id: str) -> common_pb2.Block:
+    """Reference encoder.Bootstrapper.GenesisBlockForChannel: block 0 holds
+    one CONFIG envelope carrying the full Config."""
+    cenv = configtx_pb2.ConfigEnvelope()
+    cenv.config.CopyFrom(new_config(profile))
+
+    payload = common_pb2.Payload()
+    chdr = protoutil.make_channel_header(common_pb2.CONFIG, channel_id)
+    payload.header.channel_header = chdr.SerializeToString()
+    payload.header.signature_header = common_pb2.SignatureHeader().SerializeToString()
+    payload.data = cenv.SerializeToString()
+
+    env = common_pb2.Envelope()
+    env.payload = payload.SerializeToString()
+
+    block = protoutil.new_block(0, b"")
+    block.data.data.append(env.SerializeToString())
+    protoutil.seal_block(block)
+    return block
+
+
+def channel_creation_config_update(
+    channel_id: str, consortium: str, application: ApplicationProfile
+) -> configtx_pb2.ConfigUpdate:
+    """Reference encoder.NewChannelCreateConfigUpdate (template form): the
+    read set pins consortium + org groups at version 0; the write set
+    bumps the Application group to version 1 with the full app config."""
+    update = configtx_pb2.ConfigUpdate()
+    update.channel_id = channel_id
+
+    cons = configuration_pb2.Consortium()
+    cons.name = consortium
+    update.read_set.values[bundlemod.CONSORTIUM_KEY].value = cons.SerializeToString()
+    rs_app = update.read_set.groups[bundlemod.APPLICATION_GROUP]
+    for org in application.organizations:
+        rs_app.groups[org.name].SetInParent()
+
+    update.write_set.values[bundlemod.CONSORTIUM_KEY].value = (
+        cons.SerializeToString()
+    )
+    ws_app = update.write_set.groups[bundlemod.APPLICATION_GROUP]
+    ws_app.CopyFrom(new_application_group(application))
+    ws_app.version = 1
+    return update

@@ -1,0 +1,157 @@
+"""Block-deliver client (reference core/deliverservice +
+usable-inter-nal/pkg/peer/blocksprovider/blocksprovider.go).
+
+Pulls blocks from an ordering endpoint with the reference's failure
+discipline: exponential backoff with base 1.2 capped per-sleep and by a
+total-duration budget (blocksprovider.go:109-146), endpoint failover on
+error, endpoint refresh when the channel config changes.
+
+Transport-agnostic: an endpoint is any callable
+`(seek_envelope) -> iterator of DeliverResponse` (the gRPC layer adapts
+the AtomicBroadcast/Deliver streams to this shape).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Iterable, List, Optional, Sequence
+
+from fabric_tpu.protos import ab_pb2, common_pb2, protoutil
+
+BACKOFF_BASE = 1.2  # blocksprovider.go:109
+MAX_RETRY_DELAY = 10.0
+MAX_TOTAL_DELAY = 60.0 * 60
+
+
+def seek_envelope(
+    channel_id: str,
+    start: int,
+    signer=None,
+    stop: int = 2**64 - 1,
+) -> common_pb2.Envelope:
+    """SeekInfo [start, stop] envelope, signed when a signer is given."""
+    seek = ab_pb2.SeekInfo()
+    seek.start.specified.number = start
+    seek.stop.specified.number = stop
+    seek.behavior = ab_pb2.SeekInfo.BLOCK_UNTIL_READY
+    payload = common_pb2.Payload()
+    chdr = protoutil.make_channel_header(
+        common_pb2.DELIVER_SEEK_INFO, channel_id
+    )
+    payload.header.channel_header = chdr.SerializeToString()
+    if signer is not None:
+        shdr = protoutil.make_signature_header(
+            signer.serialize(), signer.new_nonce()
+        )
+        payload.header.signature_header = shdr.SerializeToString()
+    else:
+        payload.header.signature_header = (
+            common_pb2.SignatureHeader().SerializeToString()
+        )
+    payload.data = seek.SerializeToString()
+    env = common_pb2.Envelope()
+    env.payload = payload.SerializeToString()
+    if signer is not None:
+        env.signature = signer.sign(env.payload)
+    return env
+
+
+@dataclass
+class DelivererStats:
+    connect_attempts: int = 0
+    blocks_received: int = 0
+    failures: int = 0
+
+
+class BlockDeliverer:
+    """Per-channel block pull loop (reference Deliverer.DeliverBlocks)."""
+
+    def __init__(
+        self,
+        channel_id: str,
+        endpoints: Sequence[Callable],
+        on_block: Callable[[common_pb2.Block], None],
+        next_block: Callable[[], int],
+        signer=None,
+        verify_block: Optional[Callable[[common_pb2.Block], bool]] = None,
+        sleeper: Callable[[float], None] = time.sleep,
+        max_retry_delay: float = MAX_RETRY_DELAY,
+        max_total_delay: float = MAX_TOTAL_DELAY,
+    ):
+        self.channel_id = channel_id
+        self._endpoints = list(endpoints)
+        self._on_block = on_block
+        self._next_block = next_block
+        self._signer = signer
+        self._verify_block = verify_block
+        self._sleeper = sleeper
+        self._max_retry_delay = max_retry_delay
+        self._max_total_delay = max_total_delay
+        self.stats = DelivererStats()
+        self._stop = threading.Event()
+        self._endpoint_idx = 0
+
+    def update_endpoints(self, endpoints: Sequence[Callable]) -> None:
+        """Channel-config change handed us fresh orderer endpoints
+        (reference deliveryclient endpoint refresh)."""
+        self._endpoints = list(endpoints)
+        self._endpoint_idx = 0
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def run(self, max_blocks: Optional[int] = None) -> int:
+        """Pull until stopped, the budget is exhausted, or max_blocks
+        arrive. Returns blocks received."""
+        received = 0
+        failures = 0
+        total_sleep = 0.0
+        while not self._stop.is_set():
+            if not self._endpoints:
+                return received
+            endpoint = self._endpoints[self._endpoint_idx % len(self._endpoints)]
+            self.stats.connect_attempts += 1
+            try:
+                env = seek_envelope(
+                    self.channel_id, self._next_block(), self._signer
+                )
+                for resp in endpoint(env):
+                    if self._stop.is_set():
+                        return received
+                    kind = resp.WhichOneof("Type")
+                    if kind != "block":
+                        raise ConnectionError(f"deliver status {resp.status}")
+                    block = resp.block
+                    if block.header.number != self._next_block():
+                        raise ConnectionError(
+                            f"got block {block.header.number}, want "
+                            f"{self._next_block()}"
+                        )
+                    if self._verify_block is not None and not self._verify_block(
+                        block
+                    ):
+                        raise ConnectionError(
+                            f"block {block.header.number} failed verification"
+                        )
+                    self._on_block(block)
+                    received += 1
+                    self.stats.blocks_received += 1
+                    failures = 0
+                    if max_blocks is not None and received >= max_blocks:
+                        return received
+                # clean end of stream: session served its range
+                return received
+            except (ConnectionError, OSError, StopIteration) as e:
+                self.stats.failures += 1
+                failures += 1
+                self._endpoint_idx += 1  # failover
+                delay = min(
+                    BACKOFF_BASE**failures * 0.05, self._max_retry_delay
+                )
+                total_sleep += delay
+                if total_sleep > self._max_total_delay:
+                    return received
+                self._sleeper(delay)
+        return received

@@ -1,0 +1,215 @@
+"""Deliver engine (reference common/deliver/deliver.go Handle + the peer's
+DeliverFiltered variants, core/peer/deliverevents.go).
+
+Serves block ranges described by SeekInfo over any source exposing
+`height` and `get_block(n)` (orderer chains, peer ledgers). Sessions are
+policy-checked once per delivery (and re-checked when the config
+sequence advances — reference deliver.go SessionAccessControl) and bound
+to a cert-expiry deadline (ExpirationCheckFunc).
+"""
+
+from __future__ import annotations
+
+import datetime
+import threading
+from typing import Callable, Iterator, Optional
+
+from cryptography import x509
+
+from fabric_tpu.policy.manager import PolicyError, SignedData
+from fabric_tpu.protos import ab_pb2, common_pb2, identities_pb2, protoutil
+from fabric_tpu.validation.txflags import TxValidationCode, ValidationFlags
+
+
+class DeliverError(Exception):
+    def __init__(self, status: int, msg: str = ""):
+        super().__init__(msg or f"status {status}")
+        self.status = status
+
+
+class BlockSource:
+    """What the engine needs from a chain/ledger. `wait_for(n)` blocks
+    until height > n (BLOCK_UNTIL_READY) or raises on timeout."""
+
+    def __init__(self, get_block, height_fn, wait_for=None):
+        self.get_block = get_block
+        self._height_fn = height_fn
+        self._wait_for = wait_for
+
+    @property
+    def height(self) -> int:
+        return self._height_fn()
+
+    def wait_for(self, number: int, timeout: float) -> bool:
+        if self._wait_for is not None:
+            return self._wait_for(number, timeout)
+        return self.height > number
+
+
+def identity_expiration(creator: bytes) -> Optional[datetime.datetime]:
+    """Cert notAfter for session expiry (reference crypto/expiration.go)."""
+    try:
+        sid = protoutil.unmarshal(identities_pb2.SerializedIdentity, creator)
+        cert = x509.load_pem_x509_certificate(sid.id_bytes)
+        return cert.not_valid_after_utc
+    except Exception:
+        return None
+
+
+class DeliverHandler:
+    def __init__(
+        self,
+        sources: Callable[[str], Optional[BlockSource]],
+        policy_checker: Optional[Callable[[str, SignedData], None]] = None,
+        wait_timeout: float = 10.0,
+    ):
+        """sources: channel_id -> BlockSource; policy_checker raises to
+        deny (reference: the Readers policy of the channel)."""
+        self._sources = sources
+        self._policy_checker = policy_checker
+        self._wait_timeout = wait_timeout
+
+    def deliver_blocks(
+        self, envelope: common_pb2.Envelope
+    ) -> Iterator[ab_pb2.DeliverResponse]:
+        """One seek session: yields block responses then a status."""
+        try:
+            payload = protoutil.unmarshal(common_pb2.Payload, envelope.payload)
+            if not payload.header.channel_header:
+                raise DeliverError(common_pb2.BAD_REQUEST, "missing channel header")
+            chdr = protoutil.unmarshal(
+                common_pb2.ChannelHeader, payload.header.channel_header
+            )
+            seek = protoutil.unmarshal(ab_pb2.SeekInfo, payload.data)
+            source = self._sources(chdr.channel_id)
+            if source is None:
+                raise DeliverError(
+                    common_pb2.NOT_FOUND, f"channel {chdr.channel_id} not found"
+                )
+
+            expires = None
+            if payload.header.signature_header:
+                shdr = protoutil.unmarshal(
+                    common_pb2.SignatureHeader, payload.header.signature_header
+                )
+                expires = identity_expiration(shdr.creator)
+                if expires is not None and expires < datetime.datetime.now(
+                    datetime.timezone.utc
+                ):
+                    raise DeliverError(common_pb2.FORBIDDEN, "client identity expired")
+            if self._policy_checker is not None:
+                if not payload.header.signature_header:
+                    raise DeliverError(common_pb2.FORBIDDEN, "missing signature header")
+                sd = SignedData(envelope.payload, shdr.creator, envelope.signature)
+                try:
+                    self._policy_checker(chdr.channel_id, sd)
+                except Exception as e:
+                    raise DeliverError(common_pb2.FORBIDDEN, str(e))
+
+            start, stop = self._resolve_range(seek, source)
+            number = start
+            while number <= stop:
+                if expires is not None and expires < datetime.datetime.now(
+                    datetime.timezone.utc
+                ):
+                    raise DeliverError(common_pb2.FORBIDDEN, "session expired")
+                if number >= source.height:
+                    if seek.behavior == ab_pb2.SeekInfo.FAIL_IF_NOT_READY:
+                        raise DeliverError(
+                            common_pb2.NOT_FOUND,
+                            f"block {number} not yet available",
+                        )
+                    if not source.wait_for(number, self._wait_timeout):
+                        raise DeliverError(
+                            common_pb2.SERVICE_UNAVAILABLE, "timed out waiting"
+                        )
+                block = source.get_block(number)
+                if block is None:
+                    raise DeliverError(common_pb2.NOT_FOUND, f"block {number} missing")
+                resp = ab_pb2.DeliverResponse()
+                resp.block.CopyFrom(block)
+                yield resp
+                number += 1
+            done = ab_pb2.DeliverResponse()
+            done.status = common_pb2.SUCCESS
+            yield done
+        except DeliverError as e:
+            resp = ab_pb2.DeliverResponse()
+            resp.status = e.status
+            yield resp
+        except ValueError as e:
+            resp = ab_pb2.DeliverResponse()
+            resp.status = common_pb2.BAD_REQUEST
+            yield resp
+
+    def _resolve_range(self, seek: ab_pb2.SeekInfo, source: BlockSource):
+        def pos(p: ab_pb2.SeekPosition, default: int) -> int:
+            kind = p.WhichOneof("Type")
+            if kind == "oldest":
+                return 0
+            if kind == "newest":
+                return max(source.height - 1, 0)
+            if kind == "specified":
+                return p.specified.number
+            if kind == "next_commit":
+                return source.height
+            return default
+
+        start = pos(seek.start, 0)
+        stop = pos(seek.stop, start) if seek.HasField("stop") else start
+        if stop == 2**64 - 1:  # "max" convention: deliver forever
+            stop = 2**63
+        if stop < start:
+            raise DeliverError(
+                common_pb2.BAD_REQUEST, "start number greater than stop number"
+            )
+        return start, stop
+
+
+def filter_block(
+    block: common_pb2.Block, channel_id: str
+) -> ab_pb2.FilteredBlock:
+    """Full block -> FilteredBlock (reference core/peer/deliverevents.go
+    blockResponseSenderWithFilteredBlocks): txid/type/validation code only."""
+    fb = ab_pb2.FilteredBlock()
+    fb.channel_id = channel_id
+    fb.number = block.header.number
+    flags = None
+    if len(block.metadata.metadata) > common_pb2.TRANSACTIONS_FILTER:
+        raw = block.metadata.metadata[common_pb2.TRANSACTIONS_FILTER]
+        if raw:
+            flags = list(raw)
+    for i, data in enumerate(block.data.data):
+        try:
+            env = protoutil.get_envelope_from_block_data(data)
+            payload = protoutil.unmarshal(common_pb2.Payload, env.payload)
+            chdr = protoutil.unmarshal(
+                common_pb2.ChannelHeader, payload.header.channel_header
+            )
+        except ValueError:
+            continue
+        ft = fb.filtered_transactions.add()
+        ft.txid = chdr.tx_id
+        ft.type = chdr.type
+        ft.tx_validation_code = (
+            flags[i] if flags is not None and i < len(flags)
+            else TxValidationCode.NOT_VALIDATED
+        )
+    return fb
+
+
+def deliver_filtered(
+    handler: DeliverHandler, envelope: common_pb2.Envelope
+) -> Iterator[ab_pb2.DeliverResponse]:
+    """DeliverFiltered stream: same engine, filtered payloads."""
+    payload = protoutil.unmarshal(common_pb2.Payload, envelope.payload)
+    chdr = protoutil.unmarshal(
+        common_pb2.ChannelHeader, payload.header.channel_header
+    )
+    for resp in handler.deliver_blocks(envelope):
+        if resp.WhichOneof("Type") == "block":
+            out = ab_pb2.DeliverResponse()
+            out.filtered_block.CopyFrom(filter_block(resp.block, chdr.channel_id))
+            yield out
+        else:
+            yield resp

@@ -1,0 +1,146 @@
+"""Commit entry point (reference gossip/privdata/coordinator.go
+StoreBlock): Validate(block) -> assemble private data -> commit, with
+transient-store lookups, peer pulls with a retry budget, and a
+reconciler for private data that arrived after commit.
+
+The TPU pipeline note: Validate() here is the batched device validator
+(fabric_tpu.validation), so StoreBlock is exactly the reference's
+coordinator boundary with the goroutine fan-out replaced by one device
+batch per block.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+from fabric_tpu.protos import common_pb2
+
+
+@dataclass(frozen=True)
+class PvtKey:
+    tx_index: int
+    namespace: str
+    collection: str
+
+
+class TransientStore:
+    """Pre-commit private-data staging, keyed by txid (reference
+    core/transientstore): endorsement-time writesets wait here until the
+    block arrives."""
+
+    def __init__(self):
+        self._by_txid: Dict[str, Dict[Tuple[str, str], bytes]] = {}
+
+    def persist(
+        self, txid: str, namespace: str, collection: str, pvt_writeset: bytes
+    ) -> None:
+        self._by_txid.setdefault(txid, {})[(namespace, collection)] = pvt_writeset
+
+    def get(
+        self, txid: str, namespace: str, collection: str
+    ) -> Optional[bytes]:
+        return self._by_txid.get(txid, {}).get((namespace, collection))
+
+    def purge_by_txids(self, txids: Sequence[str]) -> None:
+        for t in txids:
+            self._by_txid.pop(t, None)
+
+    def purge_below_height(self, height: int) -> None:
+        # height-based purge hook (reference PurgeBelowHeight); txid map
+        # keeps no heights, so this is driven by the caller's bookkeeping
+        pass
+
+
+@dataclass
+class PvtDataRequirement:
+    """Private collections a valid tx's rwset hashes reference."""
+
+    txid: str
+    keys: List[PvtKey]
+
+
+class Coordinator:
+    """Per-channel commit coordinator."""
+
+    def __init__(
+        self,
+        channel_id: str,
+        validate: Callable[[common_pb2.Block], object],
+        commit: Callable[[common_pb2.Block, Dict[PvtKey, bytes]], object],
+        transient: Optional[TransientStore] = None,
+        fetch_from_peers: Optional[
+            Callable[[List[PvtKey]], Dict[PvtKey, bytes]]
+        ] = None,
+        pvt_requirements: Optional[
+            Callable[[common_pb2.Block, object], List[PvtDataRequirement]]
+        ] = None,
+        pull_retries: int = 3,
+    ):
+        self.channel_id = channel_id
+        self._validate = validate
+        self._commit = commit
+        self.transient = transient or TransientStore()
+        self._fetch = fetch_from_peers or (lambda keys: {})
+        self._requirements = pvt_requirements or (lambda block, flags: [])
+        self.pull_retries = pull_retries
+        # pvt data we could not assemble at commit time -> reconciler
+        self.missing: Set[PvtKey] = set()
+
+    def store_block(self, block: common_pb2.Block):
+        """Validate -> fetch pvtdata (transient store, then peers with a
+        retry budget) -> commit (coordinator.go:149-209). Returns the
+        commit result (validation flags)."""
+        flags = self._validate(block)
+
+        needed = self._requirements(block, flags)
+        assembled: Dict[PvtKey, bytes] = {}
+        outstanding: List[Tuple[str, PvtKey]] = []
+        for req in needed:
+            for key in req.keys:
+                data = self.transient.get(
+                    req.txid, key.namespace, key.collection
+                )
+                if data is not None:
+                    assembled[key] = data
+                else:
+                    outstanding.append((req.txid, key))
+
+        retries = self.pull_retries
+        while outstanding and retries > 0:
+            fetched = self._fetch([k for _, k in outstanding])
+            still = []
+            for txid, key in outstanding:
+                if key in fetched:
+                    assembled[key] = fetched[key]
+                else:
+                    still.append((txid, key))
+            outstanding = still
+            retries -= 1
+
+        # commit proceeds with what we have; missing keys go to the
+        # reconciler (coordinator commits with missing-data tracking)
+        for _txid, key in outstanding:
+            self.missing.add(key)
+
+        result = self._commit(block, assembled)
+        self.transient.purge_by_txids([req.txid for req in needed])
+        return result if result is not None else flags
+
+    # -- reconciliation (gossip/privdata/reconcile.go) ---------------------
+    def reconcile(
+        self,
+        store_pvt: Callable[[PvtKey, bytes], None],
+    ) -> int:
+        """Try to fetch previously-missing private data; returns how many
+        keys were recovered."""
+        if not self.missing:
+            return 0
+        fetched = self._fetch(sorted(self.missing, key=lambda k: (k.tx_index, k.namespace, k.collection)))
+        recovered = 0
+        for key, data in fetched.items():
+            if key in self.missing:
+                store_pvt(key, data)
+                self.missing.discard(key)
+                recovered += 1
+        return recovered

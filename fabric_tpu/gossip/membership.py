@@ -1,0 +1,130 @@
+"""Gossip membership (reference gossip/discovery/discovery_impl.go):
+alive/dead peer tracking from periodically-gossiped alive messages, with
+sequence-number freshness and expiration sweeps, plus leader election
+(reference gossip/election/election.go) built on the same view.
+
+Deterministic, tick-driven (like the raft core): callers advance time via
+tick() and inject messages via handle_alive(); the network layer carries
+the message bytes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+
+@dataclass
+class PeerState:
+    endpoint: str
+    seq: int
+    last_seen_tick: int
+    metadata: bytes = b""
+
+
+class Membership:
+    """One node's view of the channel membership."""
+
+    def __init__(
+        self,
+        self_id: str,
+        endpoint: str = "",
+        alive_expiration_ticks: int = 25,
+        metadata: bytes = b"",
+    ):
+        self.self_id = self_id
+        self.endpoint = endpoint
+        self.metadata = metadata
+        self._seq = 0
+        self._now = 0
+        self._alive: Dict[str, PeerState] = {}
+        self._dead: Dict[str, PeerState] = {}
+        self.expiration = alive_expiration_ticks
+
+    # -- outgoing -----------------------------------------------------------
+    def tick(self) -> dict:
+        """Advance time; returns this node's alive message to broadcast
+        (reference periodicalSendAlive)."""
+        self._now += 1
+        self._expire()
+        self._seq += 1
+        return {
+            "id": self.self_id,
+            "endpoint": self.endpoint,
+            "seq": self._seq,
+            "metadata": self.metadata,
+        }
+
+    # -- incoming -----------------------------------------------------------
+    def handle_alive(self, msg: dict) -> bool:
+        """Returns True if the message advanced our view (and should be
+        forwarded — push gossip)."""
+        pid = msg["id"]
+        if pid == self.self_id:
+            return False
+        seq = msg["seq"]
+        known = self._alive.get(pid) or self._dead.get(pid)
+        if known is not None and seq <= known.seq:
+            return False
+        state = PeerState(
+            endpoint=msg.get("endpoint", ""),
+            seq=seq,
+            last_seen_tick=self._now,
+            metadata=msg.get("metadata", b""),
+        )
+        self._dead.pop(pid, None)
+        self._alive[pid] = state
+        return True
+
+    def _expire(self) -> None:
+        for pid in list(self._alive):
+            st = self._alive[pid]
+            if self._now - st.last_seen_tick > self.expiration:
+                self._dead[pid] = self._alive.pop(pid)
+
+    # -- views --------------------------------------------------------------
+    def alive_peers(self) -> List[str]:
+        return sorted(self._alive)
+
+    def dead_peers(self) -> List[str]:
+        return sorted(self._dead)
+
+    def endpoint_of(self, pid: str) -> Optional[str]:
+        st = self._alive.get(pid)
+        return st.endpoint if st else None
+
+    def metadata_of(self, pid: str) -> Optional[bytes]:
+        st = self._alive.get(pid)
+        return st.metadata if st else None
+
+
+class LeaderElection:
+    """Per-channel leader election (reference gossip/election): the peer
+    with the smallest id among alive candidates leads; peers declare
+    themselves via the membership metadata. Deterministic and quiescent —
+    no extra message type needed beyond the alive heartbeats."""
+
+    def __init__(self, membership: Membership):
+        self.membership = membership
+        self.on_leadership_change: Optional[Callable[[bool], None]] = None
+        self._is_leader = False
+
+    @property
+    def is_leader(self) -> bool:
+        return self._is_leader
+
+    @property
+    def leader(self) -> str:
+        candidates = [self.membership.self_id] + self.membership.alive_peers()
+        return min(candidates)
+
+    def evaluate(self) -> bool:
+        """Recompute leadership after membership changes; fires the
+        callback on transitions (reference leaderElection beLeader /
+        stopBeingLeader)."""
+        now_leader = self.leader == self.membership.self_id
+        if now_leader != self._is_leader:
+            self._is_leader = now_leader
+            if self.on_leadership_change is not None:
+                self.on_leadership_change(now_leader)
+        return now_leader

@@ -93,9 +93,12 @@ class BlockStore:
                     if len(raw) != ln:
                         break  # partial tail write -> truncate
                     block = protoutil.unmarshal(common_pb2.Block, raw)
-                    self._index_block(block, off)
                 except ValueError:
-                    break  # parseable-but-wrong tail (e.g. torn re-append)
+                    break  # unparseable tail (torn write) -> truncate
+                # A parseable block with the wrong number is NOT a torn
+                # tail: halt and preserve the file rather than silently
+                # truncating committed blocks.
+                self._index_block(block, off)
                 valid_end = f.tell()
         size = os.path.getsize(self.path)
         if size != valid_end:

@@ -288,6 +288,94 @@ def _unpack_point(c) -> Point:
 
 
 # ---------------------------------------------------------------------------
+# Window-loop variants
+#
+# Two trace shapes for the same math, picked per backend:
+# - "inline": 64-step scan whose body inlines 4 doubles + 2 adds (~6 point
+#   ops). Fastest to compile on the CPU backend (tests, dryrun) and
+#   cheapest at runtime.
+# - "micro": 384-step UNIFORM scan whose body is a single complete
+#   point_add — completeness (RCB16) makes add(acc, acc) a correct double
+#   and handles the identity, so every step is the same op with a selected
+#   operand: 64 windows x [dbl,dbl,dbl,dbl,+Q(d2),+G(d1)]. The traced
+#   graph is ~6x smaller, which is what gets it through the axon remote-
+#   compile service (it drops oversized XLA programs with an EOF).
+# Override with FABRIC_TPU_KERNEL_VARIANT=inline|micro.
+# ---------------------------------------------------------------------------
+
+
+def _kernel_variant() -> str:
+    import os
+
+    forced = os.environ.get("FABRIC_TPU_KERNEL_VARIANT", "auto")
+    if forced in ("inline", "micro"):
+        return forced
+    return "micro" if jax.default_backend() not in ("cpu",) else "inline"
+
+
+def _horner_loop(d1, d2, q_table, g_table, qx) -> Point:
+    if _kernel_variant() == "micro":
+        return _horner_micro(d1, d2, q_table, g_table, qx)
+    return _horner_inline(d1, d2, q_table, g_table, qx)
+
+
+def _horner_inline(d1, d2, q_table, g_table, qx) -> Point:
+    def win_body(carry, xs):
+        d1w, d2w = xs
+        acc = _unpack_point(carry)
+        for _ in range(WINDOW_BITS):
+            acc = point_double(acc)
+        acc = point_add(acc, _select_point(q_table, d2w))
+        acc = point_add(acc, _select_point(g_table, d1w))
+        return _pack_point(acc), None
+
+    carry, _ = lax.scan(
+        win_body, _pack_point(point_identity_like(qx[0])), (d1, d2)
+    )
+    return _unpack_point(carry)
+
+
+def _horner_micro(d1, d2, q_table, g_table, qx) -> Point:
+    steps = NUM_WINDOWS * 6
+    kinds = jnp.asarray(np.tile([0, 0, 0, 0, 1, 2], NUM_WINDOWS), dtype=jnp.uint32)
+    digits = jnp.zeros((steps, d1.shape[1]), dtype=d1.dtype)
+    digits = digits.at[4::6].set(d2).at[5::6].set(d1)
+
+    def micro_body(carry, xs):
+        kind, digit = xs
+        # the carried x3 leaves point_add with bound 4 (y3/z3 are normed);
+        # renormalize so add(acc, acc) respects the lazy-reduction bounds
+        acc = Point(
+            fe_norm(FE(tuple(carry[0]), 4)), fe(carry[1]), fe(carry[2])
+        )
+        q_op = _select_point(q_table, digit)
+        g_op = _select_point(g_table, digit)
+
+        def mix(coord_idx):
+            a = [acc.x, acc.y, acc.z][coord_idx]
+            qo = [q_op.x, q_op.y, q_op.z][coord_idx]
+            go = [g_op.x, g_op.y, g_op.z][coord_idx]
+            is_dbl = kind == 0
+            is_q = kind == 1
+            return FE(
+                tuple(
+                    jnp.where(is_dbl, al, jnp.where(is_q, ql, gl))
+                    for al, ql, gl in zip(a.limbs, qo.limbs, go.limbs)
+                ),
+                1,
+            )
+
+        operand = Point(mix(0), mix(1), mix(2))
+        res = point_add(acc, operand)
+        return _pack_point(res), None
+
+    carry, _ = lax.scan(
+        micro_body, _pack_point(point_identity_like(qx[0])), (kinds, digits)
+    )
+    return _unpack_point(carry)
+
+
+# ---------------------------------------------------------------------------
 # The batched verifier
 # ---------------------------------------------------------------------------
 
@@ -346,20 +434,7 @@ def verify_batch_device(
 
     # --- main window loop: R = 16R + d1*G + d2*Q, MSB first (Horner) ---
     g_table = jnp.asarray(g_small_table())  # (16, 3, 20)
-
-    def win_body(carry, xs):
-        d1w, d2w = xs
-        acc = _unpack_point(carry)
-        for _ in range(WINDOW_BITS):
-            acc = point_double(acc)
-        acc = point_add(acc, _select_point(q_table, d2w))
-        acc = point_add(acc, _select_point(g_table, d1w))
-        return _pack_point(acc), None
-
-    carry, _ = lax.scan(
-        win_body, _pack_point(point_identity_like(qx[0])), (d1, d2)
-    )
-    acc = _unpack_point(carry)
+    acc = _horner_loop(d1, d2, q_table, g_table, qx)
 
     # --- affine x and the final comparison ---
     z_inv = bn.mont_pow_l(CTX_P, acc.z.limbs, p256.P - 2)

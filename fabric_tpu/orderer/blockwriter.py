@@ -1,0 +1,132 @@
+"""Block creation + signing shared by all consenters (reference
+orderer/common/multichannel/blockwriter.go).
+
+The writer chains blocks by previous_hash, tracks the latest config block
+index, signs the SIGNATURES metadata (value = OrdererBlockMetadata-style
+LastConfig, signed bytes = value || signature_header || block_header DER),
+and hands finished blocks to a sink (the channel's block store and any
+deliver subscribers).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+from fabric_tpu.protos import common_pb2, protoutil
+
+
+class BlockWriter:
+    def __init__(
+        self,
+        signer=None,
+        sink: Optional[Callable[[common_pb2.Block], None]] = None,
+        last_block: Optional[common_pb2.Block] = None,
+        last_config_index: int = 0,
+    ):
+        self.signer = signer
+        self.sink = sink
+        self._last_config_index = last_config_index
+        if last_block is not None:
+            self.height = last_block.header.number + 1
+            self._last_hash = protoutil.block_header_hash(last_block.header)
+        else:
+            self.height = 0
+            self._last_hash = b""
+
+    def create_next_block(
+        self, envelopes: Sequence[common_pb2.Envelope]
+    ) -> common_pb2.Block:
+        block = protoutil.new_block(self.height, self._last_hash)
+        for env in envelopes:
+            block.data.data.append(env.SerializeToString())
+        return protoutil.seal_block(block)
+
+    def append_bootstrap(self, block: common_pb2.Block) -> None:
+        """Adopt an externally-created block (genesis or latest config
+        block on join) AS-IS: no re-signing, no mutation — the stored
+        bytes must stay identical to the configtx artifact. Initializes
+        the chain position from the block's own number."""
+        self.height = block.header.number + 1
+        self._last_hash = protoutil.block_header_hash(block.header)
+        self._last_config_index = block.header.number
+        if self.sink is not None:
+            self.sink(block)
+
+    def write_block(self, block: common_pb2.Block, is_config: bool = False) -> None:
+        """Sign + advance the chain. Blocks must arrive in order."""
+        if block.header.number != self.height:
+            raise ValueError(
+                f"wrote block {block.header.number}, expected {self.height}"
+            )
+        if is_config:
+            self._last_config_index = block.header.number
+        self._add_signature_metadata(block)
+        self.height += 1
+        self._last_hash = protoutil.block_header_hash(block.header)
+        if self.sink is not None:
+            self.sink(block)
+
+    def _add_signature_metadata(self, block: common_pb2.Block) -> None:
+        protoutil.init_block_metadata(block)
+        last_config = common_pb2.LastConfig()
+        last_config.index = self._last_config_index
+        meta = common_pb2.Metadata()
+        meta.value = last_config.SerializeToString()
+        if self.signer is not None:
+            sig = meta.signatures.add()
+            shdr = protoutil.make_signature_header(
+                self.signer.serialize(), self.signer.new_nonce()
+            )
+            sig.signature_header = shdr.SerializeToString()
+            signed = (
+                meta.value
+                + sig.signature_header
+                + protoutil.block_header_bytes(block.header)
+            )
+            sig.signature = self.signer.sign(signed)
+        block.metadata.metadata[common_pb2.SIGNATURES] = meta.SerializeToString()
+
+    @property
+    def last_config_index(self) -> int:
+        return self._last_config_index
+
+
+def block_signature_verifier(bundle_getter, policy_name: str = "/Channel/Orderer/BlockValidation"):
+    """Returns verify(block) -> bool for the peer's MCS.VerifyBlock
+    (reference usable-inter-nal/peer/gossip/mcs.go:124): evaluate the
+    BlockValidation policy over the SIGNATURES metadata signatures."""
+    from fabric_tpu.policy.manager import SignedData
+
+    def verify(block: common_pb2.Block) -> bool:
+        bundle = bundle_getter()
+        if bundle is None:
+            return True
+        if len(block.metadata.metadata) <= common_pb2.SIGNATURES:
+            return False
+        meta = protoutil.unmarshal(
+            common_pb2.Metadata, block.metadata.metadata[common_pb2.SIGNATURES]
+        )
+        signed_data = []
+        for sig in meta.signatures:
+            shdr = protoutil.unmarshal(
+                common_pb2.SignatureHeader, sig.signature_header
+            )
+            signed_data.append(
+                SignedData(
+                    meta.value
+                    + sig.signature_header
+                    + protoutil.block_header_bytes(block.header),
+                    shdr.creator,
+                    sig.signature,
+                )
+            )
+        policy, ok = bundle.policy_manager.get_policy(policy_name)
+        if not ok:
+            return False
+        try:
+            policy.evaluate_signed_data(signed_data)
+            return True
+        except Exception:
+            return False
+
+    return verify

@@ -1,0 +1,83 @@
+"""Broadcast ingest handler (reference orderer/common/broadcast/
+broadcast.go: classify -> msgprocessor -> WaitReady -> Order/Configure).
+
+Returns a BroadcastResponse-style (status, info) pair per envelope instead
+of streaming; the gRPC layer adapts this to the AtomicBroadcast service.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from fabric_tpu.orderer.msgprocessor import (
+    MsgProcessorError,
+    MsgTooLarge,
+    PermissionDenied,
+    classify,
+)
+from fabric_tpu.orderer.multichannel import Registrar, RegistrarError
+from fabric_tpu.orderer.raft_chain import NotLeaderError
+from fabric_tpu.protos import common_pb2, protoutil
+
+
+class BroadcastHandler:
+    def __init__(self, registrar: Registrar, signer=None):
+        self.registrar = registrar
+        self.signer = signer
+
+    def process_message(
+        self, env: common_pb2.Envelope
+    ) -> Tuple[int, str]:
+        """One Broadcast message -> (common.Status, info)."""
+        try:
+            payload = protoutil.unmarshal(common_pb2.Payload, env.payload)
+            if not payload.header.channel_header:
+                raise ValueError("missing channel header")
+            chdr = protoutil.unmarshal(
+                common_pb2.ChannelHeader, payload.header.channel_header
+            )
+        except ValueError as e:
+            return common_pb2.BAD_REQUEST, str(e)
+
+        kind = classify(chdr)
+        support = self.registrar.get_chain(chdr.channel_id)
+
+        try:
+            if kind == "normal":
+                if support is None:
+                    return (
+                        common_pb2.NOT_FOUND,
+                        f"channel {chdr.channel_id} not found",
+                    )
+                support.processor.process_normal_msg(env)
+                support.chain.order(env)
+            elif kind == "config_update":
+                if support is None:
+                    # channel creation through the system channel
+                    self.registrar.new_channel_from_update(env)
+                    return common_pb2.SUCCESS, ""
+                config_env, _seq = support.processor.process_config_update_msg(
+                    env, signer=self.signer
+                )
+                support.chain.configure(config_env)
+            else:  # a full CONFIG envelope resubmitted for re-validation
+                if support is None:
+                    return (
+                        common_pb2.NOT_FOUND,
+                        f"channel {chdr.channel_id} not found",
+                    )
+                config_env, _seq = support.processor.process_config_msg(
+                    env, signer=self.signer
+                )
+                support.chain.configure(config_env)
+        except MsgTooLarge as e:
+            return common_pb2.REQUEST_ENTITY_TOO_LARGE, str(e)
+        except PermissionDenied as e:
+            return common_pb2.FORBIDDEN, str(e)
+        except (MsgProcessorError, RegistrarError) as e:
+            return common_pb2.BAD_REQUEST, str(e)
+        except NotLeaderError as e:
+            return common_pb2.SERVICE_UNAVAILABLE, str(e)
+        except ValueError as e:
+            return common_pb2.BAD_REQUEST, str(e)
+        return common_pb2.SUCCESS, ""

@@ -1,0 +1,197 @@
+"""Orderer-side message validation rules (reference
+orderer/common/msgprocessor/*.go: classification, SigFilter, size filter,
+expiration, StandardChannel/SystemChannel processors).
+
+ProcessNormalMsg runs the filter chain (expiration -> size -> sig) and
+returns the current config sequence; ProcessConfigUpdateMsg additionally
+drives the configtx Validator to produce the CONFIG envelope the
+consenter will order (reference standardchannel.go:147-201).
+"""
+
+from __future__ import annotations
+
+import datetime
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+from cryptography import x509
+
+from fabric_tpu.channelconfig.bundle import Bundle
+from fabric_tpu.channelconfig.configtx import Validator
+from fabric_tpu.policy.manager import (
+    CHANNEL_WRITERS,
+    PolicyError,
+    SignedData,
+)
+from fabric_tpu.protos import common_pb2, configtx_pb2, identities_pb2, protoutil
+
+
+class MsgProcessorError(Exception):
+    pass
+
+
+class PermissionDenied(MsgProcessorError):
+    pass
+
+
+class MsgTooLarge(MsgProcessorError):
+    pass
+
+
+# -- classification (reference broadcast.go + msgprocessor interfaces) ------
+
+
+def classify(chdr: common_pb2.ChannelHeader) -> str:
+    """CONFIG_UPDATE messages take the config path; everything else is a
+    normal message (reference standardchannel.go ClassifyMsg)."""
+    if chdr.type in (common_pb2.CONFIG_UPDATE,):
+        return "config_update"
+    if chdr.type in (common_pb2.CONFIG, common_pb2.ORDERER_TRANSACTION):
+        return "config"
+    return "normal"
+
+
+# -- filters ----------------------------------------------------------------
+
+
+class SizeFilter:
+    """Reject messages above absolute_max_bytes (sizefilter.go)."""
+
+    def __init__(self, bundle: Bundle):
+        self._max = (
+            bundle.orderer.batch_size_absolute_max_bytes
+            if bundle.orderer
+            else 10 * 1024 * 1024
+        )
+
+    def apply(self, env: common_pb2.Envelope) -> None:
+        size = len(env.SerializeToString())
+        if size > self._max:
+            raise MsgTooLarge(
+                f"message payload is {size} bytes and exceeds maximum "
+                f"allowed {self._max} bytes"
+            )
+
+
+class SigFilter:
+    """Evaluate the channel Writers policy over the envelope signature
+    (sigfilter.go:41-77). In maintenance mode the orderers policy is used
+    instead ('/Channel/Orderer/Writers')."""
+
+    def __init__(
+        self,
+        bundle: Bundle,
+        normal_policy: str = CHANNEL_WRITERS,
+        maintenance_policy: str = "/Channel/Orderer/Writers",
+    ):
+        self._bundle = bundle
+        self._normal = normal_policy
+        self._maintenance = maintenance_policy
+
+    def apply(self, env: common_pb2.Envelope) -> None:
+        payload = protoutil.unmarshal(common_pb2.Payload, env.payload)
+        if not payload.header.signature_header:
+            raise MsgProcessorError("missing signature header")
+        shdr = protoutil.unmarshal(
+            common_pb2.SignatureHeader, payload.header.signature_header
+        )
+        name = self._normal
+        orderer = self._bundle.orderer
+        if orderer is not None and orderer.consensus_state == 1:  # MAINTENANCE
+            name = self._maintenance
+        policy, ok = self._bundle.policy_manager.get_policy(name)
+        if not ok:
+            raise MsgProcessorError(f"could not find policy {name}")
+        sd = SignedData(env.payload, shdr.creator, env.signature)
+        try:
+            policy.evaluate_signed_data([sd])
+        except PolicyError as e:
+            raise PermissionDenied(
+                f"implicit policy evaluation failed: {e}"
+            ) from e
+
+
+class ExpirationFilter:
+    """Reject envelopes whose signer cert is expired (expiration.go);
+    gated on orderer V1_1 capabilities in the reference — always on here."""
+
+    def apply(self, env: common_pb2.Envelope) -> None:
+        payload = protoutil.unmarshal(common_pb2.Payload, env.payload)
+        if not payload.header.signature_header:
+            return
+        shdr = protoutil.unmarshal(
+            common_pb2.SignatureHeader, payload.header.signature_header
+        )
+        if not shdr.creator:
+            return
+        try:
+            sid = protoutil.unmarshal(
+                identities_pb2.SerializedIdentity, shdr.creator
+            )
+            cert = x509.load_pem_x509_certificate(sid.id_bytes)
+        except Exception:
+            return  # not an x509 identity; sig filter will judge it
+        now = datetime.datetime.now(datetime.timezone.utc)
+        if cert.not_valid_after_utc < now:
+            raise MsgProcessorError("identity expired")
+
+
+class StandardChannelProcessor:
+    """Per-channel msgprocessor (reference standardchannel.go)."""
+
+    def __init__(self, channel_id: str, bundle: Bundle, validator: Validator):
+        self.channel_id = channel_id
+        self.validator = validator
+        self.update_bundle(bundle)
+
+    def update_bundle(self, bundle: Bundle) -> None:
+        """Swap in the post-config-block bundle: filters AND the configtx
+        validator's authorization tree must both follow the new config."""
+        self.bundle = bundle
+        self._filters = [ExpirationFilter(), SizeFilter(bundle), SigFilter(bundle)]
+        self.validator.policy_manager = bundle.policy_manager
+
+    def process_normal_msg(self, env: common_pb2.Envelope) -> int:
+        """Returns the config sequence the message was validated against."""
+        for f in self._filters:
+            f.apply(env)
+        return self.validator.sequence
+
+    def process_config_update_msg(
+        self, env: common_pb2.Envelope, signer=None
+    ) -> Tuple[common_pb2.Envelope, int]:
+        """CONFIG_UPDATE -> (CONFIG envelope ready to order, sequence)
+        (reference standardchannel.go ProcessConfigUpdateMsg)."""
+        for f in self._filters:
+            f.apply(env)
+        config_env = self.validator.propose_config_update(env)
+
+        payload = common_pb2.Payload()
+        chdr = protoutil.make_channel_header(common_pb2.CONFIG, self.channel_id)
+        payload.header.channel_header = chdr.SerializeToString()
+        if signer is not None:
+            shdr = protoutil.make_signature_header(
+                signer.serialize(), signer.new_nonce()
+            )
+            payload.header.signature_header = shdr.SerializeToString()
+        else:
+            payload.header.signature_header = (
+                common_pb2.SignatureHeader().SerializeToString()
+            )
+        payload.data = config_env.SerializeToString()
+        out = common_pb2.Envelope()
+        out.payload = payload.SerializeToString()
+        if signer is not None:
+            out.signature = signer.sign(out.payload)
+        return out, self.validator.sequence
+
+    def process_config_msg(
+        self, env: common_pb2.Envelope, signer=None
+    ) -> Tuple[common_pb2.Envelope, int]:
+        """Re-validate a CONFIG envelope by re-running its embedded update
+        (reference standardchannel.go ProcessConfigMsg)."""
+        payload = protoutil.unmarshal(common_pb2.Payload, env.payload)
+        cenv = protoutil.unmarshal(configtx_pb2.ConfigEnvelope, payload.data)
+        if not cenv.HasField("last_update"):
+            raise MsgProcessorError("config envelope has no last_update")
+        return self.process_config_update_msg(cenv.last_update, signer=signer)

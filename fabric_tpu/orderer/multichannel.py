@@ -1,0 +1,268 @@
+"""Multichannel registrar (reference orderer/common/multichannel/
+registrar.go): per-channel chain resources on the ordering side.
+
+Each channel owns: a config Bundle + configtx Validator (hot-swapped on
+config blocks), a msgprocessor, and a consenter chain (solo or raft).
+Channel creation happens either through the system channel's Consortiums
+group (a CONFIG_UPDATE for an unknown channel id) or by direct join with
+a genesis/config block (channel participation API,
+registrar.go JoinChannel).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence
+
+from fabric_tpu.channelconfig.bundle import Bundle, bundle_from_genesis_block
+from fabric_tpu.channelconfig.configtx import Validator
+from fabric_tpu.channelconfig import encoder
+from fabric_tpu.orderer.blockcutter import BatchConfig
+from fabric_tpu.orderer.msgprocessor import (
+    MsgProcessorError,
+    StandardChannelProcessor,
+    classify,
+)
+from fabric_tpu.orderer.raft_chain import RaftChain
+from fabric_tpu.orderer.solo import SoloChain
+from fabric_tpu.protos import common_pb2, configtx_pb2, protoutil
+
+
+class RegistrarError(Exception):
+    pass
+
+
+@dataclass
+class ChainSupport:
+    channel_id: str
+    bundle: Bundle
+    validator: Validator
+    processor: StandardChannelProcessor
+    chain: object  # SoloChain | RaftChain
+
+    @property
+    def height(self) -> int:
+        return self.chain.height
+
+    def get_block(self, number: int):
+        return self.chain.get_block(number)
+
+
+class Registrar:
+    def __init__(
+        self,
+        work_dir: str,
+        signer=None,
+        system_channel_id: Optional[str] = None,
+        raft_node_id: int = 1,
+        raft_transport_factory: Optional[Callable[[str, int], Callable]] = None,
+        provider=None,
+    ):
+        self.work_dir = work_dir
+        self.signer = signer
+        self.provider = provider
+        self.system_channel_id = system_channel_id
+        self.raft_node_id = raft_node_id
+        self.raft_transport_factory = raft_transport_factory or (
+            lambda channel_id, node_id: (lambda to, msg: None)
+        )
+        self.chains: Dict[str, ChainSupport] = {}
+        self._block_listeners: List[Callable[[str, common_pb2.Block], None]] = []
+
+    # -- wiring -------------------------------------------------------------
+    def on_block(self, fn: Callable[[str, common_pb2.Block], None]) -> None:
+        """Deliver-service hook: called for every block written anywhere."""
+        self._block_listeners.append(fn)
+
+    def _sink_for(self, channel_id: str) -> Callable[[common_pb2.Block], None]:
+        def sink(block: common_pb2.Block) -> None:
+            for fn in self._block_listeners:
+                fn(channel_id, block)
+
+        return sink
+
+    # -- channel lifecycle --------------------------------------------------
+    def join_channel(self, genesis_block: common_pb2.Block) -> ChainSupport:
+        """Channel-participation join (registrar.go JoinChannel): bootstrap
+        a chain from its genesis (or latest config) block."""
+        bundle = bundle_from_genesis_block(genesis_block, self.provider)
+        channel_id = bundle.channel_id
+        if channel_id in self.chains:
+            raise RegistrarError(f"channel {channel_id} already exists")
+        return self._start_chain(channel_id, bundle, genesis_block)
+
+    def _start_chain(
+        self,
+        channel_id: str,
+        bundle: Bundle,
+        genesis_block: Optional[common_pb2.Block],
+    ) -> ChainSupport:
+        validator = Validator(
+            channel_id,
+            _config_from_bundle(bundle),
+            policy_manager=bundle.policy_manager,
+        )
+        processor = StandardChannelProcessor(channel_id, bundle, validator)
+        batch_config = BatchConfig(
+            max_message_count=bundle.orderer.batch_size_max_messages,
+            absolute_max_bytes=bundle.orderer.batch_size_absolute_max_bytes,
+            preferred_max_bytes=bundle.orderer.batch_size_preferred_max_bytes,
+        ) if bundle.orderer else BatchConfig()
+
+        support_holder: List[ChainSupport] = []
+
+        def on_config_block(block: common_pb2.Block) -> None:
+            self._apply_config_block(support_holder[0], block)
+
+        consensus = bundle.orderer.consensus_type if bundle.orderer else "solo"
+        if consensus == "etcdraft":
+            from fabric_tpu.protos import configuration_pb2
+
+            meta = protoutil.unmarshal(
+                configuration_pb2.RaftConfigMetadata,
+                bundle.orderer.consensus_metadata,
+            )
+            peer_ids = list(range(1, len(meta.consenters) + 1)) or [1]
+            chain = RaftChain(
+                channel_id,
+                self.raft_node_id,
+                peer_ids,
+                wal_dir=os.path.join(self.work_dir, "etcdraft"),
+                signer=self.signer,
+                batch_config=batch_config,
+                sink=self._sink_for(channel_id),
+                genesis_block=genesis_block,
+                transport=self.raft_transport_factory(
+                    channel_id, self.raft_node_id
+                ),
+                on_config_block=on_config_block,
+            )
+        else:
+            chain = SoloChain(
+                channel_id,
+                signer=self.signer,
+                batch_config=batch_config,
+                deliver=self._sink_for(channel_id),
+                genesis_block=genesis_block,
+                on_config_block=on_config_block,
+            )
+        support = ChainSupport(channel_id, bundle, validator, processor, chain)
+        support_holder.append(support)
+        self.chains[channel_id] = support
+        return support
+
+    def _apply_config_block(
+        self, support: ChainSupport, block: common_pb2.Block
+    ) -> None:
+        """Hot-swap the bundle when a config block commits (reference
+        bundlesource.go + registrar's config-block callback)."""
+        env = protoutil.get_envelope_from_block_data(block.data.data[0])
+        payload = protoutil.unmarshal(common_pb2.Payload, env.payload)
+        cenv = protoutil.unmarshal(configtx_pb2.ConfigEnvelope, payload.data)
+        new_bundle = Bundle(support.channel_id, cenv.config, self.provider)
+        support.bundle = new_bundle
+        support.validator.config = cenv.config
+        support.processor.update_bundle(new_bundle)
+
+    # -- lookup -------------------------------------------------------------
+    def get_chain(self, channel_id: str) -> Optional[ChainSupport]:
+        return self.chains.get(channel_id)
+
+    def channel_list(self) -> List[str]:
+        return sorted(self.chains)
+
+    # -- system-channel channel creation ------------------------------------
+    def new_channel_from_update(
+        self, env: common_pb2.Envelope
+    ) -> ChainSupport:
+        """CONFIG_UPDATE addressed to a non-existent channel, arriving via
+        the system channel (reference systemchannel.go
+        NewChannelConfig): instantiate the channel from the consortium
+        definition + the update's Application write set."""
+        if self.system_channel_id is None:
+            raise RegistrarError(
+                "no system channel: create channels via join_channel"
+            )
+        sys_support = self.chains[self.system_channel_id]
+        payload = protoutil.unmarshal(common_pb2.Payload, env.payload)
+        cue = protoutil.unmarshal(
+            configtx_pb2.ConfigUpdateEnvelope, payload.data
+        )
+        update = protoutil.unmarshal(
+            configtx_pb2.ConfigUpdate, cue.config_update
+        )
+        channel_id = update.channel_id
+        if channel_id in self.chains:
+            raise RegistrarError(f"channel {channel_id} already exists")
+
+        cons_value = update.write_set.values.get("Consortium")
+        if cons_value is None:
+            raise RegistrarError("channel creation update names no consortium")
+        from fabric_tpu.protos import configuration_pb2
+
+        consortium = protoutil.unmarshal(
+            configuration_pb2.Consortium, cons_value.value
+        ).name
+        sys_root = sys_support.validator.config.channel_group
+        consortiums = sys_root.groups.get("Consortiums")
+        if consortiums is None or consortium not in consortiums.groups:
+            raise RegistrarError(f"unknown consortium {consortium}")
+
+        # template: channel root from the system channel minus Consortiums,
+        # with the Application group from the update's write set and org
+        # definitions resolved from the consortium.
+        template = configtx_pb2.ConfigGroup()
+        template.CopyFrom(sys_root)
+        del template.groups["Consortiums"]
+        template.values["Consortium"].value = cons_value.value
+        app = update.write_set.groups.get("Application")
+        if app is None:
+            raise RegistrarError("channel creation update has no Application group")
+        new_app = template.groups["Application"]
+        new_app.Clear()
+        new_app.CopyFrom(app)
+        new_app.version = 0
+        cons_group = consortiums.groups[consortium]
+        for org_name in list(new_app.groups):
+            if org_name in cons_group.groups:
+                new_app.groups[org_name].CopyFrom(cons_group.groups[org_name])
+            elif not new_app.groups[org_name].values:
+                raise RegistrarError(
+                    f"org {org_name} not defined in consortium {consortium}"
+                )
+
+        cfg = configtx_pb2.Config()
+        cfg.sequence = 0
+        cfg.channel_group.CopyFrom(template)
+
+        cenv = configtx_pb2.ConfigEnvelope()
+        cenv.config.CopyFrom(cfg)
+        cenv.last_update.CopyFrom(env)
+        genesis = _config_block(channel_id, cenv, 0, b"")
+        bundle = Bundle(channel_id, cfg, self.provider)
+        return self._start_chain(channel_id, bundle, genesis)
+
+
+def _config_from_bundle(bundle: Bundle) -> configtx_pb2.Config:
+    return bundle.config
+
+
+def _config_block(
+    channel_id: str,
+    cenv: configtx_pb2.ConfigEnvelope,
+    number: int,
+    prev_hash: bytes,
+) -> common_pb2.Block:
+    payload = common_pb2.Payload()
+    chdr = protoutil.make_channel_header(common_pb2.CONFIG, channel_id)
+    payload.header.channel_header = chdr.SerializeToString()
+    payload.header.signature_header = (
+        common_pb2.SignatureHeader().SerializeToString()
+    )
+    payload.data = cenv.SerializeToString()
+    env = common_pb2.Envelope()
+    env.payload = payload.SerializeToString()
+    block = protoutil.new_block(number, prev_hash)
+    block.data.data.append(env.SerializeToString())
+    return protoutil.seal_block(block)

@@ -1,0 +1,299 @@
+"""Raft consenter chain (reference orderer/consensus/etcdraft/chain.go):
+ties the raft core to block cutting, block writing, WAL persistence and
+snapshot-based catch-up for one channel.
+
+Block creation happens only on the raft leader (chain.go run loop):
+normal envelopes go through the blockcutter; each batch becomes a block
+proposed as one raft entry (data = serialized block). Every node writes
+committed blocks through its BlockWriter; stale blocks re-proposed by a
+deposed leader are dropped by block-number dedup (chain.go writeBlock
+checks block number == lastBlock+1).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import Callable, Dict, List, Optional, Sequence
+
+from fabric_tpu.orderer.blockcutter import BatchConfig, BlockCutter
+from fabric_tpu.orderer.blockwriter import BlockWriter
+from fabric_tpu.orderer.raft import (
+    ENTRY_CONF,
+    ENTRY_NORMAL,
+    Entry,
+    Message,
+    RaftNode,
+    SnapshotFile,
+    WAL,
+)
+from fabric_tpu.protos import common_pb2, protoutil
+
+
+def _is_config_block(block: common_pb2.Block) -> bool:
+    if len(block.data.data) != 1:
+        return False
+    try:
+        env = protoutil.get_envelope_from_block_data(block.data.data[0])
+        payload = protoutil.unmarshal(common_pb2.Payload, env.payload)
+        chdr = protoutil.unmarshal(
+            common_pb2.ChannelHeader, payload.header.channel_header
+        )
+    except ValueError:
+        return False
+    return chdr.type == common_pb2.CONFIG
+
+
+class NotLeaderError(Exception):
+    """Submit must be forwarded to the raft leader (cluster Step RPC)."""
+
+    def __init__(self, leader_id: int):
+        super().__init__(f"not leader; current leader is {leader_id}")
+        self.leader_id = leader_id
+
+
+class RaftChain:
+    def __init__(
+        self,
+        channel_id: str,
+        node_id: int,
+        peers: Sequence[int],
+        wal_dir: str,
+        signer=None,
+        batch_config: Optional[BatchConfig] = None,
+        sink: Optional[Callable[[common_pb2.Block], None]] = None,
+        genesis_block: Optional[common_pb2.Block] = None,
+        snapshot_interval: int = 100,
+        transport: Optional[Callable[[int, Message], None]] = None,
+        on_config_block: Optional[Callable[[common_pb2.Block], None]] = None,
+    ):
+        self.channel_id = channel_id
+        self.node = RaftNode(node_id, peers)
+        self.cutter = BlockCutter(batch_config)
+        self.blocks: List[common_pb2.Block] = []
+        self._sink = sink
+        self._on_config_block = on_config_block
+        self.writer = BlockWriter(signer=signer, sink=self._store_block)
+        self.snapshot_interval = snapshot_interval
+        self.transport = transport or (lambda to, msg: None)
+        self._applied_index = 0
+
+        base = os.path.join(wal_dir, channel_id)
+        self.wal = WAL(os.path.join(base, "wal.log"))
+        self.snap = SnapshotFile(os.path.join(base, "snapshot"))
+        self._persisted_snap_index = 0
+        self._recover()
+        self._persisted_snap_index = self.node.snap_index
+
+        if genesis_block is not None and self.writer.height == 0:
+            self.writer.append_bootstrap(genesis_block)
+
+    # -- persistence --------------------------------------------------------
+    def _recover(self) -> None:
+        """Replay snapshot + WAL into the raft core (storage.go:175-)."""
+        snap = self.snap.load()
+        if snap is not None:
+            index, term, data = snap
+            self.node.snap_index = index
+            self.node.snap_term = term
+            self.node.snap_data = data
+            self.node.commit_index = index
+            self._applied_index = index
+        hard, entries = self.wal.replay()
+        self.node.term, self.node.voted_for = max(
+            (self.node.term, self.node.voted_for), hard
+        )
+        for e in entries:
+            if e.index > self.node.snap_index:
+                self.node.log.append(e)
+
+    def _store_block(self, block: common_pb2.Block) -> None:
+        self.blocks.append(block)
+        if self._sink is not None:
+            self._sink(block)
+
+    @property
+    def height(self) -> int:
+        return self.writer.height
+
+    def get_block(self, number: int) -> Optional[common_pb2.Block]:
+        # account for a snapshot-truncated prefix
+        if not self.blocks:
+            return None
+        first = self.blocks[0].header.number
+        off = number - first
+        if 0 <= off < len(self.blocks):
+            return self.blocks[off]
+        return None
+
+    # -- consensus.Chain surface -------------------------------------------
+    def order(self, env: common_pb2.Envelope) -> None:
+        if self.node.role != "leader":
+            raise NotLeaderError(self.node.leader_id)
+        batches, _ = self.cutter.ordered(env)
+        for batch in batches:
+            self._propose_batch(batch)
+
+    def configure(self, env: common_pb2.Envelope) -> None:
+        if self.node.role != "leader":
+            raise NotLeaderError(self.node.leader_id)
+        pending = self.cutter.cut()
+        if pending:
+            self._propose_batch(pending)
+        self._propose_batch([env], is_config=True)
+
+    def flush(self) -> None:
+        """Batch timeout expiry."""
+        if self.node.role != "leader":
+            return
+        pending = self.cutter.cut()
+        if pending:
+            self._propose_batch(pending)
+
+    def _propose_batch(
+        self, batch: List[common_pb2.Envelope], is_config: bool = False
+    ) -> None:
+        block = self._next_proposed_block(batch)
+        flag = b"\x01" if is_config else b"\x00"
+        self.node.propose(flag + block.SerializeToString())
+
+    _proposed_height: Optional[int] = None
+    _proposed_term: int = -1
+
+    def _next_proposed_block(self, batch) -> common_pb2.Block:
+        """Leader-side block numbering: continues from the last *proposed*
+        block this term, not the last committed one, so multiple in-flight
+        proposals chain correctly. Resets on (re-)election so a deposed
+        leader's uncommitted proposals don't poison its numbering."""
+        if (
+            self._proposed_term != self.node.term
+            or self._proposed_height is None
+            or self._proposed_height < self.writer.height
+        ):
+            self._proposed_term = self.node.term
+            self._proposed_height = self.writer.height
+            self._proposed_hash = (
+                protoutil.block_header_hash(self.blocks[-1].header)
+                if self.blocks
+                else b""
+            )
+        block = protoutil.new_block(self._proposed_height, self._proposed_hash)
+        for env in batch:
+            block.data.data.append(env.SerializeToString())
+        protoutil.seal_block(block)
+        self._proposed_height += 1
+        self._proposed_hash = protoutil.block_header_hash(block.header)
+        return block
+
+    # -- raft plumbing ------------------------------------------------------
+    def tick(self) -> None:
+        self.node.tick()
+        self._pump()
+
+    def step(self, msg: Message) -> None:
+        self.node.step(msg)
+        self._pump()
+
+    def _pump(self) -> None:
+        msgs, hard, new_entries = self.node.ready()
+        self.wal.save(hard, new_entries)
+        self._persist_received_snapshot()
+        self._apply_committed()
+        for m in msgs:
+            self.transport(m.to, m)
+
+    def _persist_received_snapshot(self) -> None:
+        """A leader-installed snapshot (raft _on_snap) must hit disk like a
+        self-taken one, or restart replays the WAL against snap_index=0
+        with mis-based log offsets."""
+        if (
+            self.node.applied_snapshot is not None
+            and self.node.snap_index > self._persisted_snap_index
+        ):
+            self.snap.save(
+                self.node.snap_index, self.node.snap_term, self.node.snap_data
+            )
+            self._persisted_snap_index = self.node.snap_index
+            self.wal.rotate((self.node.term, self.node.voted_for), self.node.log)
+
+    def _apply_committed(self) -> None:
+        while self._applied_index < self.node.commit_index:
+            idx = self._applied_index + 1
+            term = self.node._term_at(idx)
+            if term is None:
+                # below our log start: state arrives via snapshot instead
+                self._applied_index = self.node.snap_index
+                continue
+            off = idx - self.node.snap_index - 1
+            entry = self.node.log[off]
+            self._apply_entry(entry)
+            self._applied_index = idx
+            if (
+                self.snapshot_interval
+                and self._applied_index - self.node.snap_index
+                >= self.snapshot_interval
+            ):
+                self._take_snapshot()
+
+    def _apply_entry(self, entry: Entry) -> None:
+        if entry.type == ENTRY_CONF:
+            new_peers = [int(p) for p in entry.data.decode().split(",") if p]
+            removed = self.node.peers - set(new_peers)
+            if self.node.role == "leader":
+                # final append so removed nodes see the committed conf entry
+                # and self-evict (reference etcdraft/eviction.go suspicion)
+                for p in removed - {self.node.id}:
+                    self.node._send_append(p)
+            self.node.apply_conf_change(new_peers)
+            return
+        if not entry.data:
+            return  # leader noop
+        is_config = entry.data[0:1] == b"\x01"
+        block = common_pb2.Block()
+        block.ParseFromString(entry.data[1:])
+        if block.header.number != self.writer.height:
+            return  # stale re-proposal from a deposed leader
+        self.writer.write_block(block, is_config=is_config)
+        if is_config and self._on_config_block is not None:
+            self._on_config_block(block)
+
+    def _take_snapshot(self) -> None:
+        data = struct.pack("<Q", self.writer.height)
+        self.node.compact(self._applied_index, data)
+        self.snap.save(self._applied_index, self.node.snap_term, data)
+        self._persisted_snap_index = self._applied_index
+        # rotate the WAL: replay only needs entries beyond the snapshot
+        self.wal.rotate((self.node.term, self.node.voted_for), self.node.log)
+
+    # -- membership ---------------------------------------------------------
+    def propose_conf_change(self, new_peers: Sequence[int]) -> None:
+        if self.node.role != "leader":
+            raise NotLeaderError(self.node.leader_id)
+        data = ",".join(str(p) for p in sorted(new_peers)).encode()
+        self.node.propose(data, etype=ENTRY_CONF)
+
+    # -- catch-up (blockpuller.go analog) -----------------------------------
+    def catch_up(self, blocks: Sequence[common_pb2.Block]) -> None:
+        """Feed missing blocks pulled from another orderer after receiving
+        a snapshot that outran our log. Config blocks are detected from the
+        channel header so last-config tracking and the bundle stay fresh."""
+        for b in sorted(blocks, key=lambda b: b.header.number):
+            if b.header.number != self.writer.height:
+                continue
+            is_config = _is_config_block(b)
+            self.writer.write_block(b, is_config=is_config)
+            if is_config and self._on_config_block is not None:
+                self._on_config_block(b)
+
+    @property
+    def needs_catch_up(self) -> Optional[int]:
+        """If a received snapshot implies blocks we don't have, the height
+        we must reach; else None."""
+        if self.node.applied_snapshot is None:
+            return None
+        _, data = self.node.applied_snapshot
+        if len(data) >= 8:
+            (target,) = struct.unpack_from("<Q", data, 0)
+            if target > self.writer.height:
+                return target
+        return None

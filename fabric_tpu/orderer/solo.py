@@ -1,10 +1,10 @@
-"""Solo consenter + block writer (reference orderer/consensus/solo +
-orderer/common/multichannel/blockwriter.go).
+"""Solo consenter (reference orderer/consensus/solo/consensus.go).
 
 Single-node ordering for dev/test networks: envelopes go straight through
 the blockcutter; each batch becomes a signed block chained by
-previous_hash. Config messages cut their own block (msgprocessor
-classification), matching the reference's isolation of config txs.
+previous_hash via the shared BlockWriter. Config messages cut their own
+block (msgprocessor classification), matching the reference's isolation
+of config txs.
 """
 
 from __future__ import annotations
@@ -13,7 +13,8 @@ from typing import Callable, List, Optional
 
 from fabric_tpu.msp.signer import SigningIdentity
 from fabric_tpu.orderer.blockcutter import BatchConfig, BlockCutter
-from fabric_tpu.protos import common_pb2, protoutil
+from fabric_tpu.orderer.blockwriter import BlockWriter
+from fabric_tpu.protos import common_pb2
 
 
 class SoloChain:
@@ -26,16 +27,21 @@ class SoloChain:
         batch_config: Optional[BatchConfig] = None,
         deliver: Optional[Callable[[common_pb2.Block], None]] = None,
         genesis_block: Optional[common_pb2.Block] = None,
+        on_config_block: Optional[Callable[[common_pb2.Block], None]] = None,
     ):
         self.channel_id = channel_id
-        self.signer = signer
         self.cutter = BlockCutter(batch_config)
         self.deliver = deliver
         self.blocks: List[common_pb2.Block] = []
-        self._last_hash = b""
-        self._last_config_index = 0
+        self._on_config_block = on_config_block
+        self.writer = BlockWriter(signer=signer, sink=self._store)
         if genesis_block is not None:
-            self._append(genesis_block)
+            self.writer.append_bootstrap(genesis_block)
+
+    def _store(self, block: common_pb2.Block) -> None:
+        self.blocks.append(block)
+        if self.deliver is not None:
+            self.deliver(block)
 
     # -- consensus.Chain surface -------------------------------------------
     def order(self, env: common_pb2.Envelope) -> None:
@@ -57,50 +63,18 @@ class SoloChain:
         if pending:
             self._write_batch(pending)
 
-    # -- block writer (multichannel/blockwriter.go) ------------------------
-    @property
-    def height(self) -> int:
-        return len(self.blocks)
-
-    def _write_batch(self, batch: List[common_pb2.Envelope], is_config: bool = False) -> None:
-        block = protoutil.new_block(self.height, self._last_hash)
-        for env in batch:
-            block.data.data.append(env.SerializeToString())
-        protoutil.seal_block(block)
-        if is_config:
-            self._last_config_index = block.header.number
-        self._add_metadata(block)
-        self._append(block)
-        if self.deliver is not None:
-            self.deliver(block)
-
-    def _add_metadata(self, block: common_pb2.Block) -> None:
-        protoutil.init_block_metadata(block)
-        # LAST_CONFIG index rides inside the SIGNATURES metadata value
-        # (blockwriter.go addBlockSignature: OrdererBlockMetadata).
-        last_config = common_pb2.LastConfig()
-        last_config.index = self._last_config_index
-        meta = common_pb2.Metadata()
-        meta.value = last_config.SerializeToString()
-        if self.signer is not None:
-            sig = meta.signatures.add()
-            shdr = protoutil.make_signature_header(
-                self.signer.serialize(), self.signer.new_nonce()
-            )
-            sig.signature_header = shdr.SerializeToString()
-            # signed bytes: metadata value || signature header || block header
-            signed = (
-                meta.value
-                + sig.signature_header
-                + protoutil.block_header_bytes(block.header)
-            )
-            sig.signature = self.signer.sign(signed)
-        block.metadata.metadata[common_pb2.SIGNATURES] = meta.SerializeToString()
-
-    def _append(self, block: common_pb2.Block) -> None:
-        self.blocks.append(block)
-        self._last_hash = protoutil.block_header_hash(block.header)
+    def _write_batch(
+        self, batch: List[common_pb2.Envelope], is_config: bool = False
+    ) -> None:
+        block = self.writer.create_next_block(batch)
+        self.writer.write_block(block, is_config=is_config)
+        if is_config and self._on_config_block is not None:
+            self._on_config_block(block)
 
     # -- deliver service surface -------------------------------------------
+    @property
+    def height(self) -> int:
+        return self.writer.height
+
     def get_block(self, number: int) -> Optional[common_pb2.Block]:
         return self.blocks[number] if number < len(self.blocks) else None

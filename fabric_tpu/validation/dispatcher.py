@@ -73,8 +73,14 @@ class LifecycleRegistry:
         if info is None:
             return self._legacy.get(name) if self._legacy else None
         plugin_name, vp_bytes = info
+        plugin_name = plugin_name or "builtin"
+        if not self.plugins.exists(plugin_name):
+            # unresolvable plugin invalidates the tx (reference
+            # plugin_validator.go getOrCreatePlugin error path) — surfaced
+            # as a missing definition -> INVALID_CHAINCODE
+            return None
         try:
             policy = unmarshal_application_policy(vp_bytes)
         except PolicyConversionError:
             return None
-        return self._cd_cls(name, policy, plugin=plugin_name or "builtin")
+        return self._cd_cls(name, policy, plugin=plugin_name)

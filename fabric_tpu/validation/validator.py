@@ -70,6 +70,25 @@ class ChaincodeRegistry:
         return self._defs.get(name)
 
 
+# policy-group map: (policy envelope, plugin) -> (definition,
+# [(tx index, namespace), ...])
+PolicyGroups = Dict[
+    Tuple[SignaturePolicyEnvelope, str],
+    Tuple["ChaincodeDefinition", List[Tuple[int, str]]],
+]
+
+
+def _writes_to_namespace(ns_rw) -> bool:
+    """Reference dispatcher.txWritesToNamespace: public writes, metadata
+    writes, or per-collection hashed (metadata) writes."""
+    if ns_rw.writes or ns_rw.metadata_writes:
+        return True
+    for coll in ns_rw.coll_hashed:
+        if coll.hashed_writes or coll.metadata_writes:
+            return True
+    return False
+
+
 def principal_for(ast_principal) -> msp_principal_pb2.MSPPrincipal:
     """fabric_tpu.policy.ast principal -> proto MSPPrincipal."""
     from fabric_tpu.policy.ast import MSPRole as AstRole
@@ -216,10 +235,10 @@ class BlockValidator:
         sig_results: Dict[int, bool],
         flags: ValidationFlags,
         txid_array: List[str],
-    ) -> Dict[int, Tuple[ChaincodeDefinition, List[int]]]:
+    ) -> PolicyGroups:
         """Reference-ordered early code assembly; returns policy groups
         {id(definition): (definition, [tx indices])} for phase 4."""
-        groups: Dict[int, Tuple[ChaincodeDefinition, List[int]]] = {}
+        groups: PolicyGroups = {}
         for tx in parsed:
             i = tx.index
             if not tx.structurally_valid:
@@ -245,11 +264,39 @@ class BlockValidator:
             if tx.header_type != common_pb2.ENDORSER_TRANSACTION:
                 flags.set_flag(i, TxValidationCode.UNKNOWN_TX_TYPE)
                 continue
-            definition = self.registry.get(tx.namespace)
-            if definition is None:
-                flags.set_flag(i, TxValidationCode.INVALID_CHAINCODE)
+            # the invoked chaincode plus every namespace the tx writes to
+            # is validated against ITS OWN policy (reference
+            # plugindispatcher/dispatcher.go:174-218)
+            wr_ns = [tx.namespace]
+            illegal = False
+            if tx.rwset is not None:
+                seen_ns = set()
+                for ns_rw in tx.rwset.ns_rw_sets:
+                    if ns_rw.namespace in seen_ns:
+                        illegal = True  # dup namespace (dispatcher.go:175-178)
+                        break
+                    seen_ns.add(ns_rw.namespace)
+                    if ns_rw.namespace != tx.namespace and _writes_to_namespace(
+                        ns_rw
+                    ):
+                        wr_ns.append(ns_rw.namespace)
+            if illegal:
+                flags.set_flag(i, TxValidationCode.ILLEGAL_WRITESET)
                 continue
-            groups.setdefault(id(definition), (definition, []))[1].append(i)
+            defs = []
+            for ns in wr_ns:
+                definition = self.registry.get(ns)
+                if definition is None:
+                    flags.set_flag(i, TxValidationCode.INVALID_CHAINCODE)
+                    break
+                defs.append((ns, definition))
+            else:
+                for ns, definition in defs:
+                    # key by policy content, not object identity —
+                    # LifecycleRegistry builds a fresh definition per get()
+                    # and id()-keying would defeat batching entirely
+                    key = (definition.endorsement_policy, definition.plugin)
+                    groups.setdefault(key, (definition, []))[1].append((i, ns))
         return groups
 
     # ------------------------------------------------------------------
@@ -272,7 +319,7 @@ class BlockValidator:
 
     def _evaluate_policies(
         self,
-        groups: Dict[int, Tuple[ChaincodeDefinition, List[int]]],
+        groups: PolicyGroups,
         parsed: Sequence[ParsedTx],
         flags: ValidationFlags,
     ) -> None:
@@ -289,11 +336,15 @@ class BlockValidator:
 
     def _any_vp_on_written_keys(
         self,
-        groups: Dict[int, Tuple[ChaincodeDefinition, List[int]]],
+        groups: PolicyGroups,
         parsed: Sequence[ParsedTx],
     ) -> bool:
-        for _definition, tx_indices in groups.values():
-            for i in tx_indices:
+        seen = set()
+        for _definition, entries in groups.values():
+            for i, _ns in entries:
+                if i in seen:
+                    continue
+                seen.add(i)
                 rwset = parsed[i].rwset
                 if rwset is None:
                     continue
@@ -314,7 +365,7 @@ class BlockValidator:
 
     def _evaluate_policies_sbe(
         self,
-        groups: Dict[int, Tuple[ChaincodeDefinition, List[int]]],
+        groups: PolicyGroups,
         parsed: Sequence[ParsedTx],
         flags: ValidationFlags,
         deps: BlockDependencies,
@@ -322,10 +373,10 @@ class BlockValidator:
         """Sequential key-level pass in tx order. Signature verification
         already happened in the batched device phase; per-policy checks
         reduce to cached circuit walks over satisfaction bits."""
-        def_by_tx: Dict[int, ChaincodeDefinition] = {}
-        for definition, tx_indices in groups.values():
-            for i in tx_indices:
-                def_by_tx[i] = definition
+        pairs_by_tx: Dict[int, List[Tuple[str, ChaincodeDefinition]]] = {}
+        for definition, entries in groups.values():
+            for i, ns in entries:
+                pairs_by_tx.setdefault(i, []).append((ns, definition))
 
         for tx in parsed:
             i = tx.index
@@ -333,27 +384,37 @@ class BlockValidator:
             namespaces = (
                 [ns.namespace for ns in rwset.ns_rw_sets] if rwset else []
             )
-            definition = def_by_tx.get(i)
-            if definition is None or rwset is None:
+            pairs = pairs_by_tx.get(i)
+            if pairs is None or rwset is None:
                 # invalidated earlier / config tx: its metadata writes do
                 # not update validation parameters
                 for ns in namespaces:
                     deps.set_result(i, ns, False)
                 continue
-            evaluator = KeyLevelEvaluator(
-                definition.endorsement_policy,
-                deps,
-                self.get_state_metadata,
-                lambda env, _tx_num, _tx=tx: self._eval_policy_host(_tx, env),
-                self.get_collection_ep,
-            )
-            ok, why = evaluator.evaluate(rwset, tx.namespace, i)
-            if not ok:
+            # each written namespace validates against its OWN policy
+            # (dispatcher.go:190); first failure invalidates the tx and
+            # leaves the remaining namespaces unvalidated (= failed).
+            validated: Dict[str, bool] = {}
+            failed = False
+            for ns, definition in pairs:
+                if failed:
+                    validated[ns] = False
+                    continue
+                evaluator = KeyLevelEvaluator(
+                    definition.endorsement_policy,
+                    deps,
+                    self.get_state_metadata,
+                    lambda env, _tx_num, _tx=tx: self._eval_policy_host(_tx, env),
+                    self.get_collection_ep,
+                )
+                ok, _why = evaluator.evaluate(rwset, ns, i)
+                validated[ns] = ok
+                if not ok:
+                    failed = True
+            if failed:
                 flags.set_flag(i, TxValidationCode.ENDORSEMENT_POLICY_FAILURE)
-            for ns in namespaces:
-                deps.set_result(i, ns, ok)
-            if tx.namespace not in namespaces:
-                deps.set_result(i, tx.namespace, ok)
+            for ns in set(namespaces) | {tx.namespace}:
+                deps.set_result(i, ns, validated.get(ns, False) and not failed)
 
     def _eval_policy_host(
         self, tx: ParsedTx, env: SignaturePolicyEnvelope
@@ -384,13 +445,16 @@ class BlockValidator:
 
     def _evaluate_policies_batched(
         self,
-        groups: Dict[int, Tuple[ChaincodeDefinition, List[int]]],
+        groups: PolicyGroups,
         parsed: Sequence[ParsedTx],
         flags: ValidationFlags,
     ) -> None:
-        """Batched endorsement-policy evaluation per chaincode definition."""
-        for definition, tx_indices in groups.values():
+        """Batched endorsement-policy evaluation per chaincode definition.
+        A tx appears once per written namespace (each namespace's policy
+        must pass, dispatcher.go:190)."""
+        for definition, entries in groups.values():
             env = definition.endorsement_policy
+            tx_indices = [i for i, _ns in entries]
             # SignatureSetToValidIdentities: dedupe by identity, drop
             # non-verifying signers, preserve order (policy.go:365-402)
             per_tx_sat: List[np.ndarray] = [

@@ -1,0 +1,266 @@
+"""Channel config: encoder -> Bundle round-trip, policy manager hierarchy,
+implicit meta evaluation, config update validation (reference
+common/channelconfig + common/configtx + configtxgen encoder)."""
+
+import pytest
+
+from fabric_tpu.channelconfig import (
+    ApplicationProfile,
+    Bundle,
+    ConfigTxError,
+    OrdererProfile,
+    OrganizationProfile,
+    Profile,
+    Validator,
+    bundle_from_genesis_block,
+    genesis_block,
+    new_config,
+)
+from fabric_tpu.channelconfig import configtx as configtx_mod
+from fabric_tpu.msp.cryptogen import generate_org
+from fabric_tpu.msp.signer import SigningIdentity
+from fabric_tpu.policy.manager import SignedData
+from fabric_tpu.protos import configtx_pb2, protoutil
+
+
+@pytest.fixture(scope="module")
+def orgs():
+    return generate_org("org1"), generate_org("org2"), generate_org("orderer-org")
+
+
+@pytest.fixture(scope="module")
+def profile(orgs):
+    org1, org2, oorg = orgs
+    return Profile(
+        consortium="SampleConsortium",
+        application=ApplicationProfile(
+            organizations=[
+                OrganizationProfile("Org1MSP", org1.msp_config()),
+                OrganizationProfile("Org2MSP", org2.msp_config()),
+            ],
+        ),
+        orderer=OrdererProfile(
+            orderer_type="solo",
+            addresses=["127.0.0.1:7050"],
+            organizations=[
+                OrganizationProfile("OrdererMSP", oorg.msp_config()),
+            ],
+        ),
+    )
+
+
+@pytest.fixture(scope="module")
+def bundle(profile):
+    block = genesis_block(profile, "testchannel")
+    return bundle_from_genesis_block(block)
+
+
+def test_genesis_block_shape(profile):
+    block = genesis_block(profile, "testchannel")
+    assert block.header.number == 0
+    assert block.header.data_hash == protoutil.block_data_hash(block.data)
+
+
+def test_bundle_typed_views(bundle):
+    assert bundle.channel_id == "testchannel"
+    assert bundle.hashing_algorithm == "SHA256"
+    assert bundle.orderer_addresses == ["127.0.0.1:7050"]
+    assert bundle.consortium_name == "SampleConsortium"
+    assert bundle.orderer.consensus_type == "solo"
+    assert bundle.orderer.batch_size_max_messages == 500
+    assert {o.msp_id for o in bundle.application.orgs} == {"org1MSP", "org2MSP"}
+    assert bundle.application.capabilities.v20_validation
+    # MSPs from both app orgs + the orderer org are registered
+    ids = {m.msp_id for m in bundle.msp_manager.msps()}
+    assert ids == {"org1MSP", "org2MSP", "orderer-orgMSP"}
+
+
+def test_policy_manager_paths(bundle):
+    pm = bundle.policy_manager
+    for path in (
+        "/Channel/Readers",
+        "/Channel/Writers",
+        "/Channel/Admins",
+        "/Channel/Application/Readers",
+        "/Channel/Application/Writers",
+        "/Channel/Application/Admins",
+        "/Channel/Application/Endorsement",
+        "/Channel/Orderer/BlockValidation",
+    ):
+        _, ok = pm.get_policy(path)
+        assert ok, path
+    _, ok = pm.get_policy("/Channel/Nope")
+    assert not ok
+
+
+def _signed_by(identity_node, msg=b"payload"):
+    signer = SigningIdentity(identity_node)
+    return SignedData(msg, signer.serialize(), signer.sign(msg))
+
+
+def test_implicit_meta_any_writer(bundle, orgs):
+    org1, _, _ = orgs
+    pol, ok = bundle.policy_manager.get_policy("/Channel/Application/Writers")
+    assert ok
+    pol.evaluate_signed_data([_signed_by(org1.peers[0])])
+
+
+def test_implicit_meta_majority_admins(bundle, orgs):
+    org1, org2, _ = orgs
+    pol, ok = bundle.policy_manager.get_policy("/Channel/Application/Admins")
+    assert ok
+    # one org's admin is not a 2-org majority
+    with pytest.raises(Exception):
+        pol.evaluate_signed_data([_signed_by(org1.admin)])
+    pol.evaluate_signed_data([_signed_by(org1.admin), _signed_by(org2.admin)])
+
+
+def test_non_member_rejected(bundle):
+    stranger = generate_org("org1")  # same MSP name, different CA
+    pol, ok = bundle.policy_manager.get_policy("/Channel/Application/Writers")
+    assert ok
+    with pytest.raises(Exception):
+        pol.evaluate_signed_data([_signed_by(stranger.peers[0])])
+
+
+def test_config_update_applies(profile):
+    cfg = new_config(profile)
+    v = Validator("testchannel", cfg)
+
+    # Bump the batch size: write set carries the modified value at version+1.
+    update = configtx_pb2.ConfigUpdate()
+    update.channel_id = "testchannel"
+    cur_orderer = cfg.channel_group.groups["Orderer"]
+    rs = update.read_set.groups["Orderer"]
+    rs.version = cur_orderer.version
+    rs.values["BatchSize"].version = cur_orderer.values["BatchSize"].version
+    ws = update.write_set.groups["Orderer"]
+    ws.version = cur_orderer.version
+    from fabric_tpu.protos import configuration_pb2
+
+    bs = configuration_pb2.BatchSize()
+    bs.max_message_count = 100
+    bs.absolute_max_bytes = 1 << 20
+    bs.preferred_max_bytes = 1 << 19
+    ws.values["BatchSize"].value = bs.SerializeToString()
+    ws.values["BatchSize"].version = cur_orderer.values["BatchSize"].version + 1
+    ws.values["BatchSize"].mod_policy = "Admins"
+
+    cue = configtx_pb2.ConfigUpdateEnvelope()
+    cue.config_update = update.SerializeToString()
+    result = v.propose_config_update_envelope(cue)
+    assert result.config.sequence == 1
+    new_bundle = Bundle("testchannel", result.config)
+    assert new_bundle.orderer.batch_size_max_messages == 100
+    # unmodified elements carried over
+    assert new_bundle.application is not None
+    assert new_bundle.orderer.batch_timeout == "2s"
+
+
+def test_same_version_tampered_content_discarded(profile):
+    """A write-set element at the unchanged version contributes NOTHING:
+    content comes from current config (reference computeUpdateResult
+    overlays only the delta) — tampering can't bypass mod-policy auth."""
+    cfg = new_config(profile)
+    v = Validator("testchannel", cfg)
+    from fabric_tpu.protos import configuration_pb2
+
+    update = configtx_pb2.ConfigUpdate()
+    update.channel_id = "testchannel"
+    cur_orderer = cfg.channel_group.groups["Orderer"]
+    rs = update.read_set.groups["Orderer"]
+    rs.values["BatchSize"].SetInParent()
+    rs.values["BatchTimeout"].SetInParent()
+    ws = update.write_set.groups["Orderer"]
+    # legit delta: BatchSize at version 1
+    bs = configuration_pb2.BatchSize()
+    bs.max_message_count = 42
+    ws.values["BatchSize"].value = bs.SerializeToString()
+    ws.values["BatchSize"].version = 1
+    ws.values["BatchSize"].mod_policy = "Admins"
+    # tamper attempt: BatchTimeout content changed but version NOT bumped
+    bt = configuration_pb2.BatchTimeout()
+    bt.timeout = "666s"
+    ws.values["BatchTimeout"].value = bt.SerializeToString()
+    ws.values["BatchTimeout"].version = 0
+
+    cue = configtx_pb2.ConfigUpdateEnvelope()
+    cue.config_update = update.SerializeToString()
+    result = v.propose_config_update_envelope(cue)
+    new_bundle = Bundle("testchannel", result.config)
+    assert new_bundle.orderer.batch_size_max_messages == 42  # delta applied
+    assert new_bundle.orderer.batch_timeout == "2s"  # tamper discarded
+
+
+def test_config_update_bad_read_version(profile):
+    cfg = new_config(profile)
+    v = Validator("testchannel", cfg)
+    update = configtx_pb2.ConfigUpdate()
+    update.channel_id = "testchannel"
+    update.read_set.groups["Orderer"].values["BatchSize"].version = 7
+    cue = configtx_pb2.ConfigUpdateEnvelope()
+    cue.config_update = update.SerializeToString()
+    with pytest.raises(ConfigTxError):
+        v.propose_config_update_envelope(cue)
+
+
+def test_config_update_version_skip_rejected(profile):
+    cfg = new_config(profile)
+    v = Validator("testchannel", cfg)
+    update = configtx_pb2.ConfigUpdate()
+    update.channel_id = "testchannel"
+    ws = update.write_set.groups["Orderer"]
+    ws.values["BatchSize"].value = b"x"
+    ws.values["BatchSize"].version = 5  # current is 0; must be exactly 1
+    cue = configtx_pb2.ConfigUpdateEnvelope()
+    cue.config_update = update.SerializeToString()
+    with pytest.raises(ConfigTxError):
+        v.propose_config_update_envelope(cue)
+
+
+def test_config_update_wrong_channel(profile):
+    cfg = new_config(profile)
+    v = Validator("testchannel", cfg)
+    update = configtx_pb2.ConfigUpdate()
+    update.channel_id = "other"
+    cue = configtx_pb2.ConfigUpdateEnvelope()
+    cue.config_update = update.SerializeToString()
+    with pytest.raises(ConfigTxError):
+        v.propose_config_update_envelope(cue)
+
+
+def test_config_update_mod_policy_authorization(profile, orgs, bundle):
+    """With a policy manager attached, delta elements need mod-policy
+    authorization: orderer Admins signatures."""
+    org1, org2, oorg = orgs
+    cfg = new_config(profile)
+    v = Validator("testchannel", cfg, policy_manager=bundle.policy_manager)
+
+    update = configtx_pb2.ConfigUpdate()
+    update.channel_id = "testchannel"
+    from fabric_tpu.protos import configuration_pb2
+
+    rs = update.read_set.groups["Orderer"]
+    rs.values["BatchSize"].SetInParent()
+    bs = configuration_pb2.BatchSize()
+    bs.max_message_count = 10
+    ws = update.write_set.groups["Orderer"]
+    ws.values["BatchSize"].value = bs.SerializeToString()
+    ws.values["BatchSize"].version = 1
+    ws.values["BatchSize"].mod_policy = "Admins"
+
+    cue = configtx_pb2.ConfigUpdateEnvelope()
+    cue.config_update = update.SerializeToString()
+    with pytest.raises(ConfigTxError):  # unsigned
+        v.propose_config_update_envelope(cue)
+
+    configtx_mod.sign_config_update(cue, SigningIdentity(oorg.admin))
+    result = v.propose_config_update_envelope(cue)
+    assert result.config.sequence == 1
+
+    # a non-admin signature does not satisfy the orderer Admins policy
+    cue2 = configtx_pb2.ConfigUpdateEnvelope()
+    cue2.config_update = update.SerializeToString()
+    configtx_mod.sign_config_update(cue2, SigningIdentity(org1.peers[0]))
+    with pytest.raises(ConfigTxError):
+        v.propose_config_update_envelope(cue2)

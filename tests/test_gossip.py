@@ -1,0 +1,166 @@
+"""Gossip layer: payload buffer ordering, anti-entropy, membership
+expiry, leader election, privdata coordinator (reference gossip/state,
+gossip/discovery, gossip/election, gossip/privdata)."""
+
+import pytest
+
+from fabric_tpu.gossip.coordinator import (
+    Coordinator,
+    PvtDataRequirement,
+    PvtKey,
+    TransientStore,
+)
+from fabric_tpu.gossip.membership import LeaderElection, Membership
+from fabric_tpu.gossip.state import CommitFailure, PayloadBuffer, StateProvider
+from fabric_tpu.protos import common_pb2, protoutil
+
+
+def make_block(n: int) -> common_pb2.Block:
+    b = protoutil.new_block(n, b"\x00" * 32)
+    b.data.data.append(b"tx")
+    return protoutil.seal_block(b)
+
+
+class TestPayloadBuffer:
+    def test_ordered_drain(self):
+        committed = []
+        sp = StateProvider("ch", committed.append, lambda: 0)
+        sp.add_payload(make_block(2))
+        sp.add_payload(make_block(0))
+        assert sp.deliver_payloads() == 1  # only block 0 is in order
+        sp.add_payload(make_block(1))
+        assert sp.deliver_payloads() == 2  # 1 then 2
+        assert [b.header.number for b in committed] == [0, 1, 2]
+
+    def test_stale_and_duplicate_dropped(self):
+        sp = StateProvider("ch", lambda b: None, lambda: 5)
+        assert not sp.add_payload(make_block(3))  # below height
+        assert sp.add_payload(make_block(7))
+        assert not sp.add_payload(make_block(7))  # duplicate
+        assert sp.buffer.dropped == 2
+
+    def test_gossip_flood_protection(self):
+        sp = StateProvider("ch", lambda b: None, lambda: 0, max_block_dist=10)
+        assert not sp.add_payload(make_block(50))  # too far ahead
+        assert sp.add_payload(make_block(50), from_gossip=False)  # direct ok
+
+    def test_commit_failure_marks_channel(self):
+        def boom(block):
+            raise RuntimeError("vscc failure")
+
+        sp = StateProvider("ch", boom, lambda: 0)
+        sp.add_payload(make_block(0))
+        with pytest.raises(CommitFailure):
+            sp.deliver_payloads()
+        with pytest.raises(CommitFailure):
+            sp.deliver_payloads()
+
+
+class TestAntiEntropy:
+    def test_missing_range_and_response(self):
+        committed = []
+        sp = StateProvider("ch", committed.append, lambda: 0)
+        rng = sp.missing_range([4, 2])
+        assert rng == range(0, 4)
+        blocks = {n: make_block(n) for n in rng}
+        # a taller peer serves the request from its ledger
+        tall = StateProvider("ch", lambda b: None, lambda: 4)
+        served = tall.handle_state_request(0, 4, lambda n: blocks.get(n))
+        assert [b.header.number for b in served] == [0, 1, 2, 3]
+        assert sp.handle_state_response(served) == 4
+        assert sp.missing_range([4]) is None
+
+    def test_request_capped(self):
+        sp = StateProvider("ch", lambda b: None, lambda: 0)
+        served = sp.handle_state_request(
+            0, 1000, lambda n: make_block(n), max_blocks=10
+        )
+        assert len(served) == 10
+
+
+class TestMembership:
+    def test_alive_dead_transitions(self):
+        m = Membership("p0", alive_expiration_ticks=3)
+        m.handle_alive({"id": "p1", "endpoint": "h1:7051", "seq": 1})
+        assert m.alive_peers() == ["p1"]
+        for _ in range(5):
+            m.tick()
+        assert m.alive_peers() == []
+        assert m.dead_peers() == ["p1"]
+        # resurrection needs a FRESHER seq
+        assert not m.handle_alive({"id": "p1", "seq": 1})
+        assert m.handle_alive({"id": "p1", "seq": 2})
+        assert m.alive_peers() == ["p1"]
+
+    def test_stale_seq_not_forwarded(self):
+        m = Membership("p0")
+        assert m.handle_alive({"id": "p1", "seq": 5})
+        assert not m.handle_alive({"id": "p1", "seq": 4})
+
+    def test_own_alive_ignored(self):
+        m = Membership("p0")
+        assert not m.handle_alive({"id": "p0", "seq": 9})
+
+
+class TestElection:
+    def test_smallest_alive_leads(self):
+        m = Membership("p1", alive_expiration_ticks=2)
+        el = LeaderElection(m)
+        changes = []
+        el.on_leadership_change = changes.append
+        assert el.evaluate()  # alone -> leader
+        m.handle_alive({"id": "p0", "seq": 1})
+        assert not el.evaluate()  # p0 takes over
+        for _ in range(4):
+            m.tick()
+        assert el.evaluate()  # p0 expired -> leadership regained
+        assert changes == [True, False, True]
+
+
+class TestCoordinator:
+    def test_pvtdata_from_transient_then_peers(self):
+        store = TransientStore()
+        store.persist("tx0", "cc", "collA", b"pvt-A")
+        key_a = PvtKey(0, "cc", "collA")
+        key_b = PvtKey(0, "cc", "collB")
+        fetched = {key_b: b"pvt-B"}
+        commits = []
+
+        coord = Coordinator(
+            "ch",
+            validate=lambda b: "FLAGS",
+            commit=lambda b, pvt: commits.append(pvt) or "OK",
+            transient=store,
+            fetch_from_peers=lambda keys: {
+                k: fetched[k] for k in keys if k in fetched
+            },
+            pvt_requirements=lambda b, f: [
+                PvtDataRequirement("tx0", [key_a, key_b])
+            ],
+        )
+        result = coord.store_block(make_block(0))
+        assert result == "OK"
+        assert commits[0] == {key_a: b"pvt-A", key_b: b"pvt-B"}
+        assert not coord.missing
+        # transient store purged after commit
+        assert store.get("tx0", "cc", "collA") is None
+
+    def test_missing_pvtdata_goes_to_reconciler(self):
+        key = PvtKey(0, "cc", "collX")
+        coord = Coordinator(
+            "ch",
+            validate=lambda b: "FLAGS",
+            commit=lambda b, pvt: "OK",
+            fetch_from_peers=lambda keys: {},
+            pvt_requirements=lambda b, f: [PvtDataRequirement("t", [key])],
+            pull_retries=2,
+        )
+        coord.store_block(make_block(0))
+        assert key in coord.missing
+
+        # data shows up later: reconciler recovers it
+        coord._fetch = lambda keys: {key: b"late"}
+        recovered = []
+        assert coord.reconcile(lambda k, d: recovered.append((k, d))) == 1
+        assert recovered == [(key, b"late")]
+        assert not coord.missing

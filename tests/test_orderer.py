@@ -1,0 +1,225 @@
+"""Orderer stack: registrar + broadcast handler + msgprocessor over a real
+channel config (reference orderer/common/{broadcast,msgprocessor,
+multichannel})."""
+
+import pytest
+
+from fabric_tpu.channelconfig import (
+    ApplicationProfile,
+    OrdererProfile,
+    OrganizationProfile,
+    Profile,
+    genesis_block,
+)
+from fabric_tpu.channelconfig import encoder
+from fabric_tpu.msp.cryptogen import generate_org
+from fabric_tpu.msp.signer import SigningIdentity
+from fabric_tpu.orderer.broadcast import BroadcastHandler
+from fabric_tpu.orderer.multichannel import Registrar
+from fabric_tpu.protos import common_pb2, configtx_pb2, protoutil
+
+
+@pytest.fixture(scope="module")
+def world():
+    org1 = generate_org("org1")
+    org2 = generate_org("org2")
+    oorg = generate_org("ord")
+    profile = Profile(
+        consortium="SampleConsortium",
+        application=ApplicationProfile(
+            organizations=[
+                OrganizationProfile("org1MSP", org1.msp_config()),
+                OrganizationProfile("org2MSP", org2.msp_config()),
+            ],
+        ),
+        orderer=OrdererProfile(
+            orderer_type="solo",
+            max_message_count=2,
+            organizations=[OrganizationProfile("ordMSP", oorg.msp_config())],
+        ),
+    )
+    return org1, org2, oorg, profile
+
+
+def make_envelope(signer: SigningIdentity, channel_id: str, body: bytes):
+    payload = common_pb2.Payload()
+    chdr = protoutil.make_channel_header(
+        common_pb2.ENDORSER_TRANSACTION, channel_id
+    )
+    payload.header.channel_header = chdr.SerializeToString()
+    shdr = protoutil.make_signature_header(signer.serialize(), signer.new_nonce())
+    payload.header.signature_header = shdr.SerializeToString()
+    payload.data = body
+    env = common_pb2.Envelope()
+    env.payload = payload.SerializeToString()
+    env.signature = signer.sign(env.payload)
+    return env
+
+
+def test_broadcast_orders_signed_envelopes(tmp_path, world):
+    org1, org2, oorg, profile = world
+    reg = Registrar(str(tmp_path), signer=SigningIdentity(oorg.peers[0]))
+    blocks = []
+    reg.on_block(lambda ch, b: blocks.append((ch, b)))
+    reg.join_channel(genesis_block(profile, "mychannel"))
+    h = BroadcastHandler(reg)
+
+    writer = SigningIdentity(org1.peers[0])
+    status, info = h.process_message(make_envelope(writer, "mychannel", b"tx1"))
+    assert status == common_pb2.SUCCESS, info
+    status, _ = h.process_message(make_envelope(writer, "mychannel", b"tx2"))
+    assert status == common_pb2.SUCCESS
+    # max_message_count=2 -> one block cut
+    assert reg.get_chain("mychannel").height == 2  # genesis + 1
+    # genesis + the cut block both hit the deliver sink
+    assert [b.header.number for _, b in blocks] == [0, 1]
+
+
+def test_broadcast_rejects_unsigned_and_unknown(tmp_path, world):
+    org1, org2, oorg, profile = world
+    reg = Registrar(str(tmp_path))
+    reg.join_channel(genesis_block(profile, "mychannel"))
+    h = BroadcastHandler(reg)
+
+    env = common_pb2.Envelope()
+    env.payload = b"garbage"
+    status, _ = h.process_message(env)
+    assert status == common_pb2.BAD_REQUEST
+
+    # unknown channel, normal message
+    writer = SigningIdentity(org1.peers[0])
+    status, _ = h.process_message(make_envelope(writer, "nochannel", b"tx"))
+    assert status == common_pb2.NOT_FOUND
+
+    # forged signature -> FORBIDDEN
+    env = make_envelope(writer, "mychannel", b"tx")
+    env.signature = b"\x30\x06\x02\x01\x01\x02\x01\x01"
+    status, _ = h.process_message(env)
+    assert status == common_pb2.FORBIDDEN
+
+
+def test_stranger_cannot_write(tmp_path, world):
+    _, _, _, profile = world
+    stranger = generate_org("org1")  # same MSP id, different CA
+    reg = Registrar(str(tmp_path))
+    reg.join_channel(genesis_block(profile, "mychannel"))
+    h = BroadcastHandler(reg)
+    env = make_envelope(SigningIdentity(stranger.peers[0]), "mychannel", b"tx")
+    status, _ = h.process_message(env)
+    assert status == common_pb2.FORBIDDEN
+
+
+def test_config_update_via_broadcast(tmp_path, world):
+    org1, org2, oorg, profile = world
+    reg = Registrar(str(tmp_path), signer=SigningIdentity(oorg.peers[0]))
+    reg.join_channel(genesis_block(profile, "mychannel"))
+    h = BroadcastHandler(reg)
+    support = reg.get_chain("mychannel")
+    cur = support.validator.config.channel_group
+
+    # orderer admin bumps BatchSize via CONFIG_UPDATE
+    from fabric_tpu.protos import configuration_pb2
+    from fabric_tpu.channelconfig import configtx as configtx_mod
+
+    update = configtx_pb2.ConfigUpdate()
+    update.channel_id = "mychannel"
+    rs = update.read_set.groups["Orderer"]
+    rs.values["BatchSize"].SetInParent()
+    ws = update.write_set.groups["Orderer"]
+    bs = configuration_pb2.BatchSize()
+    bs.max_message_count = 3
+    bs.absolute_max_bytes = 1 << 20
+    bs.preferred_max_bytes = 1 << 19
+    ws.values["BatchSize"].value = bs.SerializeToString()
+    ws.values["BatchSize"].version = 1
+    ws.values["BatchSize"].mod_policy = "Admins"
+    cue = configtx_pb2.ConfigUpdateEnvelope()
+    cue.config_update = update.SerializeToString()
+    configtx_mod.sign_config_update(cue, SigningIdentity(oorg.admin))
+
+    payload = common_pb2.Payload()
+    chdr = protoutil.make_channel_header(common_pb2.CONFIG_UPDATE, "mychannel")
+    payload.header.channel_header = chdr.SerializeToString()
+    signer = SigningIdentity(oorg.admin)
+    shdr = protoutil.make_signature_header(signer.serialize(), signer.new_nonce())
+    payload.header.signature_header = shdr.SerializeToString()
+    payload.data = cue.SerializeToString()
+    env = common_pb2.Envelope()
+    env.payload = payload.SerializeToString()
+    env.signature = signer.sign(env.payload)
+
+    status, info = h.process_message(env)
+    assert status == common_pb2.SUCCESS, info
+    # config block written alone; processor hot-swapped to the new bundle
+    assert support.height == 2
+    assert support.bundle.orderer.batch_size_max_messages == 3
+    assert support.validator.sequence == 1
+    # the config block carries last_update for peer-side re-validation
+    block = support.get_block(1)
+    env2 = protoutil.get_envelope_from_block_data(block.data.data[0])
+    payload2 = protoutil.unmarshal(common_pb2.Payload, env2.payload)
+    cenv = protoutil.unmarshal(configtx_pb2.ConfigEnvelope, payload2.data)
+    assert cenv.HasField("last_update")
+
+
+def test_system_channel_creates_channel(tmp_path, world):
+    org1, org2, oorg, profile = world
+    sys_profile = Profile(
+        orderer=OrdererProfile(
+            orderer_type="solo",
+            organizations=[OrganizationProfile("ordMSP", oorg.msp_config())],
+        ),
+        consortiums={
+            "SampleConsortium": [
+                OrganizationProfile("org1MSP", org1.msp_config()),
+                OrganizationProfile("org2MSP", org2.msp_config()),
+            ]
+        },
+    )
+    reg = Registrar(
+        str(tmp_path),
+        signer=SigningIdentity(oorg.peers[0]),
+        system_channel_id="syschannel",
+    )
+    reg.join_channel(genesis_block(sys_profile, "syschannel"))
+    h = BroadcastHandler(reg)
+
+    update = encoder.channel_creation_config_update(
+        "appchannel",
+        "SampleConsortium",
+        ApplicationProfile(
+            organizations=[
+                OrganizationProfile("org1MSP", org1.msp_config()),
+                OrganizationProfile("org2MSP", org2.msp_config()),
+            ]
+        ),
+    )
+    cue = configtx_pb2.ConfigUpdateEnvelope()
+    cue.config_update = update.SerializeToString()
+
+    payload = common_pb2.Payload()
+    chdr = protoutil.make_channel_header(common_pb2.CONFIG_UPDATE, "appchannel")
+    payload.header.channel_header = chdr.SerializeToString()
+    signer = SigningIdentity(org1.admin)
+    shdr = protoutil.make_signature_header(signer.serialize(), signer.new_nonce())
+    payload.header.signature_header = shdr.SerializeToString()
+    payload.data = cue.SerializeToString()
+    env = common_pb2.Envelope()
+    env.payload = payload.SerializeToString()
+    env.signature = signer.sign(env.payload)
+
+    status, info = h.process_message(env)
+    assert status == common_pb2.SUCCESS, info
+    assert "appchannel" in reg.channel_list()
+    app_support = reg.get_chain("appchannel")
+    assert app_support.height == 1  # its genesis config block
+    assert {o.msp_id for o in app_support.bundle.application.orgs} == {
+        "org1MSP",
+        "org2MSP",
+    }
+
+    # the new channel accepts writes from consortium members
+    status, info = h.process_message(
+        make_envelope(SigningIdentity(org1.peers[0]), "appchannel", b"tx")
+    )
+    assert status == common_pb2.SUCCESS, info

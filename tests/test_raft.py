@@ -1,0 +1,239 @@
+"""Raft consenter: election, replication, WAL recovery, leader failover,
+snapshot catch-up, membership change/eviction (reference
+orderer/consensus/etcdraft)."""
+
+import struct
+
+import pytest
+
+from fabric_tpu.orderer.blockcutter import BatchConfig
+from fabric_tpu.orderer.raft import WAL, Entry, RaftNode, SnapshotFile
+from fabric_tpu.orderer.raft_chain import NotLeaderError, RaftChain
+from fabric_tpu.protos import common_pb2, protoutil
+
+
+def make_env(payload: bytes) -> common_pb2.Envelope:
+    env = common_pb2.Envelope()
+    env.payload = payload
+    return env
+
+
+class Cluster:
+    """Deterministic in-memory raft cluster."""
+
+    def __init__(self, tmp_path, ids=(1, 2, 3), partitioned=()):
+        self.partitioned = set(partitioned)
+        self.queues = {i: [] for i in ids}
+        self.chains = {}
+        for i in ids:
+            self.chains[i] = RaftChain(
+                "ch",
+                i,
+                ids,
+                wal_dir=str(tmp_path / f"node{i}"),
+                batch_config=BatchConfig(max_message_count=2),
+                snapshot_interval=0,
+                transport=self._make_transport(i),
+            )
+
+    def _make_transport(self, frm):
+        def send(to, msg):
+            if frm in self.partitioned or to in self.partitioned:
+                return
+            if to in self.queues:
+                self.queues[to].append(msg)
+
+        return send
+
+    def run(self, ticks=50):
+        """Advance until quiescent or ticks exhausted."""
+        for _ in range(ticks):
+            for i, chain in self.chains.items():
+                if i in self.partitioned:
+                    continue
+                chain.tick()
+            self.deliver()
+
+    def deliver(self, rounds=20):
+        for _ in range(rounds):
+            moved = False
+            for i, chain in self.chains.items():
+                q, self.queues[i] = self.queues[i], []
+                for m in q:
+                    if i in self.partitioned:
+                        continue
+                    chain.step(m)
+                    moved = True
+            if not moved:
+                return
+
+    @property
+    def leader(self):
+        for i, c in self.chains.items():
+            if c.node.role == "leader" and i not in self.partitioned:
+                return c
+        return None
+
+
+def test_election_and_replication(tmp_path):
+    c = Cluster(tmp_path)
+    c.run(30)
+    leader = c.leader
+    assert leader is not None
+
+    # two envs = one batch (max_message_count=2) -> one block everywhere
+    leader.order(make_env(b"tx1"))
+    leader.order(make_env(b"tx2"))
+    c.run(10)
+    for chain in c.chains.values():
+        assert chain.height == 1, chain.node.id
+    b = leader.get_block(0)
+    assert len(b.data.data) == 2
+
+
+def test_followers_reject_order(tmp_path):
+    c = Cluster(tmp_path)
+    c.run(30)
+    followers = [ch for ch in c.chains.values() if ch.node.role != "leader"]
+    assert followers
+    with pytest.raises(NotLeaderError):
+        followers[0].order(make_env(b"tx"))
+
+
+def test_leader_failover_preserves_chain(tmp_path):
+    c = Cluster(tmp_path)
+    c.run(30)
+    old_leader = c.leader
+    old_leader.order(make_env(b"a"))
+    old_leader.order(make_env(b"b"))
+    c.run(10)
+    assert all(ch.height == 1 for ch in c.chains.values())
+
+    # partition the leader away; remaining two elect a new leader
+    c.partitioned.add(old_leader.node.id)
+    c.run(60)
+    new_leader = c.leader
+    assert new_leader is not None and new_leader is not old_leader
+
+    new_leader.order(make_env(b"c"))
+    new_leader.order(make_env(b"d"))
+    c.run(10)
+    live = [ch for i, ch in c.chains.items() if i not in c.partitioned]
+    assert all(ch.height == 2 for ch in live)
+    # chain continuity on the survivors
+    b1 = live[0].get_block(1)
+    b0 = live[0].get_block(0)
+    assert b1.header.previous_hash == protoutil.block_header_hash(b0.header)
+
+    # heal the partition: old leader catches up
+    c.partitioned.clear()
+    c.run(30)
+    assert c.chains[old_leader.node.id].height == 2
+
+
+def test_wal_recovery(tmp_path):
+    wal = WAL(str(tmp_path / "w" / "wal.log"))
+    wal.save((3, 2), [Entry(1, 1, 0, b"x"), Entry(2, 3, 0, b"y")])
+    wal.save(None, [Entry(3, 3, 0, b"z")])
+    wal.close()
+    hard, entries = wal.replay()
+    assert hard == (3, 2)
+    assert [e.index for e in entries] == [1, 2, 3]
+    assert entries[2].data == b"z"
+
+    # torn tail is dropped
+    with open(str(tmp_path / "w" / "wal.log"), "ab") as f:
+        f.write(b"\x99\x00\x00\x00partial")
+    hard, entries = wal.replay()
+    assert len(entries) == 3
+
+
+def test_wal_conflicting_rewrite_keeps_latest(tmp_path):
+    wal = WAL(str(tmp_path / "w2" / "wal.log"))
+    wal.save(None, [Entry(1, 1, 0, b"old1"), Entry(2, 1, 0, b"old2")])
+    wal.save(None, [Entry(2, 2, 0, b"new2")])  # term-2 leader overwrote idx 2
+    _, entries = wal.replay()
+    assert [(e.index, e.data) for e in entries] == [(1, b"old1"), (2, b"new2")]
+
+
+def test_chain_restart_recovers_from_wal(tmp_path):
+    ids = (1,)
+    chain = RaftChain(
+        "ch", 1, ids, wal_dir=str(tmp_path / "solo"),
+        batch_config=BatchConfig(max_message_count=1), snapshot_interval=0,
+    )
+    chain.run_ticks = None
+    for _ in range(30):
+        chain.tick()
+    assert chain.node.role == "leader"
+    chain.order(make_env(b"tx1"))
+    chain.order(make_env(b"tx2"))
+    chain._pump()
+    assert chain.height == 2
+    chain.wal.close()
+
+    again = RaftChain(
+        "ch", 1, ids, wal_dir=str(tmp_path / "solo"),
+        batch_config=BatchConfig(max_message_count=1), snapshot_interval=0,
+    )
+    # committed entries replay once the node re-commits them after election
+    for _ in range(30):
+        again.tick()
+    assert again.node.role == "leader"
+    again.order(make_env(b"tx3"))
+    again._pump()
+    assert again.height == 3
+    assert again.get_block(2) is not None
+
+
+def test_snapshot_compaction_and_catch_up(tmp_path):
+    snap = SnapshotFile(str(tmp_path / "s" / "snapshot"))
+    snap.save(7, 2, b"state")
+    assert snap.load() == (7, 2, b"state")
+
+    # cluster with snapshots every entry: lagging node gets a raft snapshot
+    c = Cluster(tmp_path / "c")
+    for ch in c.chains.values():
+        ch.snapshot_interval = 2
+    c.run(30)
+    leader = c.leader
+    lagger = next(
+        ch for i, ch in c.chains.items() if ch is not leader
+    )
+    c.partitioned.add(lagger.node.id)
+    for i in range(6):
+        leader.order(make_env(b"x%d" % i))
+    c.run(15)
+    assert leader.height >= 3
+    assert leader.node.snap_index > 0  # compaction happened
+
+    c.partitioned.clear()
+    c.run(40)
+    # lagger's raft log caught up via snapshot; blocks must be pulled
+    target = lagger.needs_catch_up
+    if target is not None:
+        missing = [
+            leader.get_block(n) for n in range(lagger.height, target)
+        ]
+        lagger.catch_up([b for b in missing if b is not None])
+    leader.order(make_env(b"y0"))
+    leader.order(make_env(b"y1"))
+    c.run(10)
+    assert lagger.height == leader.height
+
+
+def test_membership_eviction(tmp_path):
+    c = Cluster(tmp_path)
+    c.run(30)
+    leader = c.leader
+    victim = next(ch for ch in c.chains.values() if ch is not leader)
+    keep = [i for i in c.chains if i != victim.node.id]
+    leader.propose_conf_change(keep)
+    c.run(10)
+    assert victim.node.evicted
+    # remaining cluster still makes progress
+    leader.order(make_env(b"p"))
+    leader.order(make_env(b"q"))
+    c.run(10)
+    live = [c.chains[i] for i in keep]
+    assert all(ch.height >= 1 for ch in live)

@@ -48,10 +48,10 @@ def net():
     }
 
 
-def results_bytes(key="k1", value=b"v1"):
+def results_bytes(key="k1", value=b"v1", ns="mycc"):
     return serialize_tx_rwset(
         rw.TxRwSet(
-            (rw.NsRwSet("mycc", (), (rw.KVWrite(key, False, value),)),)
+            (rw.NsRwSet(ns, (), (rw.KVWrite(key, False, value),)),)
         )
     )
 
@@ -59,7 +59,7 @@ def results_bytes(key="k1", value=b"v1"):
 def make_tx(net, cc="mycc", endorsers=("p1", "p2"), channel=CHANNEL, mangle=None):
     bundle = create_proposal(net["client"], channel, cc, [b"invoke", b"a"])
     responses = [
-        endorse_proposal(bundle, net[e], results_bytes()) for e in endorsers
+        endorse_proposal(bundle, net[e], results_bytes(ns=cc)) for e in endorsers
     ]
     env = create_signed_tx(bundle, net["client"], responses)
     if mangle:
@@ -224,3 +224,70 @@ class TestBlockValidation:
         flags = v.validate(make_block([env]))
         assert flags.flag(0) == V.VALID
         assert applied
+
+
+class TestCrossNamespaceDispatch:
+    """Every written namespace validates against ITS OWN policy
+    (reference plugindispatcher/dispatcher.go:174-218)."""
+
+    def _tx(self, net, endorsers):
+        bundle = create_proposal(net["client"], CHANNEL, "anycc", [b"i"])
+        results = serialize_tx_rwset(
+            rw.TxRwSet(
+                (
+                    rw.NsRwSet("anycc", (), (rw.KVWrite("a", False, b"1"),)),
+                    rw.NsRwSet("mycc", (), (rw.KVWrite("k", False, b"2"),)),
+                )
+            )
+        )
+        responses = [
+            endorse_proposal(bundle, net[e], results) for e in endorsers
+        ]
+        return create_signed_tx(bundle, net["client"], responses)
+
+    def test_foreign_namespace_policy_enforced(self, net):
+        # anycc's OR policy passes with p2 alone, but the write into
+        # mycc (2-of-2) must also satisfy mycc's policy -> failure
+        flags = validator(net).validate(make_block([self._tx(net, ("p2",))]))
+        assert flags.flag(0) == V.ENDORSEMENT_POLICY_FAILURE
+
+    def test_all_policies_satisfied(self, net):
+        flags = validator(net).validate(
+            make_block([self._tx(net, ("p1", "p2"))])
+        )
+        assert flags.flag(0) == V.VALID
+
+    def test_duplicate_namespace_illegal_writeset(self, net):
+        bundle = create_proposal(net["client"], CHANNEL, "mycc", [b"i"])
+        results = serialize_tx_rwset(
+            rw.TxRwSet(
+                (
+                    rw.NsRwSet("mycc", (), (rw.KVWrite("a", False, b"1"),)),
+                    rw.NsRwSet("mycc", (), (rw.KVWrite("b", False, b"2"),)),
+                )
+            )
+        )
+        responses = [
+            endorse_proposal(bundle, net[e], results) for e in ("p1", "p2")
+        ]
+        env = create_signed_tx(bundle, net["client"], responses)
+        flags = validator(net).validate(make_block([env]))
+        assert flags.flag(0) == V.ILLEGAL_WRITESET
+
+    def test_read_only_foreign_namespace_not_policy_checked(self, net):
+        # reads from another namespace don't drag in its policy
+        bundle = create_proposal(net["client"], CHANNEL, "anycc", [b"i"])
+        results = serialize_tx_rwset(
+            rw.TxRwSet(
+                (
+                    rw.NsRwSet("anycc", (), (rw.KVWrite("a", False, b"1"),)),
+                    rw.NsRwSet(
+                        "mycc", (rw.KVRead("k", rw.Version(1, 0)),), ()
+                    ),
+                )
+            )
+        )
+        responses = [endorse_proposal(bundle, net["p2"], results)]
+        env = create_signed_tx(bundle, net["client"], responses)
+        flags = validator(net).validate(make_block([env]))
+        assert flags.flag(0) == V.VALID
