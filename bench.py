@@ -19,13 +19,12 @@ import sys
 import time
 
 os.environ.setdefault("FABRIC_TPU_CIOS_UNROLL", "1")
-os.environ.setdefault(
-    "JAX_COMPILATION_CACHE_DIR",
-    os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
-)
-os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2")
 
 import numpy as np
+
+from fabric_tpu.utils.jaxcache import enable_compile_cache
+
+enable_compile_cache()
 
 
 def gen_triples(n, num_keys=8):

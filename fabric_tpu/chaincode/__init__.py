@@ -1,0 +1,8 @@
+from fabric_tpu.chaincode.shim import (  # noqa: F401
+    Chaincode,
+    ChaincodeStub,
+    Response,
+    error_response,
+    success,
+)
+from fabric_tpu.chaincode.support import ChaincodeSupport  # noqa: F401

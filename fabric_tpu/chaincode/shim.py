@@ -1,0 +1,176 @@
+"""Chaincode programming interface (reference fabric-chaincode-go shim +
+core/chaincode/handler.go message loop).
+
+The reference runs chaincode out-of-process behind a gRPC bidi stream;
+every GetState/PutState is a stream round-trip handled by
+core/chaincode/handler.go (GET_STATE/PUT_STATE/... messages) that calls
+back into the tx's simulator. Here the stub calls the simulator directly
+— same state semantics, no serialization tax — and the out-of-process
+path is provided by the external chaincode server (extcc analog) which
+speaks the same stub API over a socket.
+
+A chaincode is any object with ``init(stub) -> Response`` and
+``invoke(stub) -> Response``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Iterator, List, Optional, Protocol, Tuple
+
+from fabric_tpu.ledger.simulator import (
+    TxSimulator,
+    create_composite_key,
+    split_composite_key,
+)
+from fabric_tpu.protos import peer_pb2
+
+OK = 200
+ERROR = 500
+
+
+@dataclass
+class Response:
+    status: int
+    message: str = ""
+    payload: bytes = b""
+
+
+def success(payload: bytes = b"") -> Response:
+    return Response(OK, "", payload)
+
+
+def error_response(message: str) -> Response:
+    return Response(ERROR, message)
+
+
+class Chaincode(Protocol):
+    def init(self, stub: "ChaincodeStub") -> Response: ...
+
+    def invoke(self, stub: "ChaincodeStub") -> Response: ...
+
+
+class ChaincodeStub:
+    """Per-invocation API surface (shim.ChaincodeStubInterface)."""
+
+    def __init__(
+        self,
+        namespace: str,
+        channel_id: str,
+        tx_id: str,
+        args: List[bytes],
+        simulator: TxSimulator,
+        creator: bytes = b"",
+        transient: Optional[Dict[str, bytes]] = None,
+        support: Optional["object"] = None,  # ChaincodeSupport, for cc2cc
+    ):
+        self._ns = namespace
+        self.channel_id = channel_id
+        self.tx_id = tx_id
+        self._args = args
+        self._sim = simulator
+        self._creator = creator
+        self._transient = dict(transient or {})
+        self._support = support
+        self._event: Optional[peer_pb2.ChaincodeEvent] = None
+
+    # -- invocation context --
+    def get_args(self) -> List[bytes]:
+        return list(self._args)
+
+    def get_function_and_parameters(self) -> Tuple[str, List[str]]:
+        if not self._args:
+            return "", []
+        return self._args[0].decode(), [a.decode() for a in self._args[1:]]
+
+    def get_creator(self) -> bytes:
+        return self._creator
+
+    def get_transient(self) -> Dict[str, bytes]:
+        return dict(self._transient)
+
+    # -- world state --
+    def get_state(self, key: str) -> Optional[bytes]:
+        return self._sim.get_state(self._ns, key)
+
+    def put_state(self, key: str, value: bytes) -> None:
+        self._sim.set_state(self._ns, key, value)
+
+    def del_state(self, key: str) -> None:
+        self._sim.delete_state(self._ns, key)
+
+    def get_state_by_range(
+        self, start_key: str, end_key: str
+    ) -> Iterator[Tuple[str, bytes]]:
+        return self._sim.get_state_range_scan_iterator(
+            self._ns, start_key, end_key
+        )
+
+    def get_state_by_partial_composite_key(
+        self, object_type: str, attributes: List[str]
+    ) -> Iterator[Tuple[str, bytes]]:
+        start = create_composite_key(object_type, attributes)
+        return self._sim.get_state_range_scan_iterator(
+            self._ns, start, start + "\U0010ffff"
+        )
+
+    # -- key-level endorsement (SBE) --
+    def set_state_validation_parameter(self, key: str, policy: bytes) -> None:
+        self._sim.set_state_metadata(
+            self._ns, key, {"VALIDATION_PARAMETER": policy}
+        )
+
+    def get_state_validation_parameter(self, key: str) -> Optional[bytes]:
+        from fabric_tpu.ledger.mvcc import deserialize_metadata
+
+        meta = deserialize_metadata(self._sim.get_state_metadata(self._ns, key))
+        if not meta:
+            return None
+        return meta.get("VALIDATION_PARAMETER")
+
+    # -- private data --
+    def get_private_data(self, collection: str, key: str) -> Optional[bytes]:
+        return self._sim.get_private_data(self._ns, collection, key)
+
+    def get_private_data_hash(self, collection: str, key: str) -> Optional[bytes]:
+        return self._sim.get_private_data_hash(self._ns, collection, key)
+
+    def put_private_data(self, collection: str, key: str, value: bytes) -> None:
+        self._sim.set_private_data(self._ns, collection, key, value)
+
+    def del_private_data(self, collection: str, key: str) -> None:
+        self._sim.delete_private_data(self._ns, collection, key)
+
+    # -- composite keys --
+    def create_composite_key(self, object_type: str, attributes: List[str]) -> str:
+        return create_composite_key(object_type, attributes)
+
+    def split_composite_key(self, key: str) -> Tuple[str, List[str]]:
+        return split_composite_key(key)
+
+    # -- events --
+    def set_event(self, name: str, payload: bytes) -> None:
+        if not name:
+            raise ValueError("event name cannot be empty")
+        ev = peer_pb2.ChaincodeEvent()
+        ev.chaincode_id = self._ns
+        ev.tx_id = self.tx_id
+        ev.event_name = name
+        ev.payload = payload
+        self._event = ev
+
+    @property
+    def chaincode_event(self) -> Optional[peer_pb2.ChaincodeEvent]:
+        return self._event
+
+    # -- chaincode-to-chaincode --
+    def invoke_chaincode(
+        self, chaincode_name: str, args: List[bytes], channel: str = ""
+    ) -> Response:
+        """Same-channel cc2cc shares this tx's simulator (writes merge into
+        one rwset under the callee's namespace); cross-channel calls are
+        read-only against the other channel per the reference's rule
+        (handler.go handleInvokeChaincode)."""
+        if self._support is None:
+            return error_response("chaincode support not wired for cc2cc")
+        return self._support.invoke_cc2cc(self, chaincode_name, args, channel)

@@ -1,0 +1,137 @@
+"""Chaincode execution support (reference core/chaincode/
+chaincode_support.go + handler.go + the launch registry).
+
+The reference launches chaincode containers lazily and multiplexes tx
+executions over each chaincode's gRPC stream; system chaincodes run
+in-process over inprocstream (core/scc/inprocstream.go). Here every
+registered chaincode executes in-process against the tx's simulator, and
+cc2cc calls (handler.go handleInvokeChaincode) share the caller's
+simulator in the same channel or get a read-only snapshot of another
+channel's state.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Tuple
+
+from fabric_tpu.chaincode.shim import (
+    Chaincode,
+    ChaincodeStub,
+    Response,
+    error_response,
+)
+from fabric_tpu.ledger.simulator import TxSimulator
+from fabric_tpu.protos import peer_pb2
+
+
+class LaunchError(Exception):
+    pass
+
+
+@dataclass
+class TxParams:
+    """Per-execution context (reference ccprovider.TxParams)."""
+
+    channel_id: str
+    tx_id: str
+    simulator: TxSimulator
+    creator: bytes = b""
+    transient: Optional[Dict[str, bytes]] = None
+
+
+class ChaincodeSupport:
+    """Registry + executor. ``state_getter(channel_id)`` resolves another
+    channel's committed-state DB for cross-channel cc2cc reads."""
+
+    def __init__(
+        self,
+        state_getter: Optional[Callable[[str], object]] = None,
+    ):
+        self._chaincodes: Dict[str, Chaincode] = {}
+        self._system: Dict[str, bool] = {}
+        self._state_getter = state_getter
+
+    def register(
+        self, name: str, chaincode: Chaincode, system: bool = False
+    ) -> None:
+        """Launch analog: a registered chaincode is a running one."""
+        if name in self._chaincodes:
+            raise LaunchError(f"chaincode {name} already registered")
+        self._chaincodes[name] = chaincode
+        self._system[name] = system
+
+    def is_system_chaincode(self, name: str) -> bool:
+        return self._system.get(name, False)
+
+    def launched(self, name: str) -> bool:
+        return name in self._chaincodes
+
+    def execute(
+        self,
+        tx_params: TxParams,
+        name: str,
+        args: List[bytes],
+        is_init: bool = False,
+    ) -> Tuple[Response, Optional[peer_pb2.ChaincodeEvent]]:
+        """ChaincodeSupport.Execute: run one invocation, return the
+        chaincode Response plus its event (at most one per tx)."""
+        cc = self._chaincodes.get(name)
+        if cc is None:
+            raise LaunchError(f"chaincode {name} is not installed/launched")
+        stub = ChaincodeStub(
+            namespace=name,
+            channel_id=tx_params.channel_id,
+            tx_id=tx_params.tx_id,
+            args=args,
+            simulator=tx_params.simulator,
+            creator=tx_params.creator,
+            transient=tx_params.transient,
+            support=self,
+        )
+        try:
+            resp = cc.init(stub) if is_init else cc.invoke(stub)
+        except Exception as exc:  # noqa: BLE001 - chaincode panic analog
+            return error_response(f"chaincode {name} failed: {exc}"), None
+        if not isinstance(resp, Response):
+            return error_response(f"chaincode {name} returned no Response"), None
+        return resp, stub.chaincode_event
+
+    def invoke_cc2cc(
+        self,
+        caller_stub: ChaincodeStub,
+        name: str,
+        args: List[bytes],
+        channel: str = "",
+    ) -> Response:
+        cc = self._chaincodes.get(name)
+        if cc is None:
+            return error_response(f"chaincode {name} is not installed/launched")
+        same_channel = not channel or channel == caller_stub.channel_id
+        if same_channel:
+            sim = caller_stub._sim
+        else:
+            if self._state_getter is None:
+                return error_response(
+                    "cross-channel invocation requires a state getter"
+                )
+            other_db = self._state_getter(channel)
+            if other_db is None:
+                return error_response(f"channel {channel} not found")
+            # Read-only: a throwaway simulator whose results are discarded
+            # (handler.go: cross-channel cc2cc rwset is not recorded).
+            sim = TxSimulator(other_db, tx_id=caller_stub.tx_id)
+        stub = ChaincodeStub(
+            namespace=name,
+            channel_id=channel or caller_stub.channel_id,
+            tx_id=caller_stub.tx_id,
+            args=args,
+            simulator=sim,
+            creator=caller_stub.get_creator(),
+            transient=caller_stub.get_transient(),
+            support=self,
+        )
+        try:
+            return cc.invoke(stub)
+        except Exception as exc:  # noqa: BLE001
+            return error_response(f"chaincode {name} failed: {exc}")

@@ -1,0 +1,325 @@
+"""Metrics provider SPI (reference common/metrics/provider.go:11-121).
+
+The reference defines Counter/Gauge/Histogram interfaces with a
+``With(labelValues...)`` currying pattern and three providers (prometheus,
+statsd, disabled). This module keeps the same shape:
+
+* ``CounterOpts/GaugeOpts/HistogramOpts`` — namespace/subsystem/name,
+  help, label names, statsd format string.
+* ``PrometheusProvider`` — in-process registry rendering the Prometheus
+  text exposition format (served by the operations server at /metrics).
+* ``StatsdProvider`` — formats ``%{#fqname}.%{label}`` style bucket names
+  and hands values to a sink callable (UDP emitter or test buffer).
+* ``DisabledProvider`` — no-ops.
+
+Thread-safe; histograms keep fixed buckets + sum/count like Prometheus.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+DEFAULT_BUCKETS = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+
+@dataclass(frozen=True)
+class MetricOpts:
+    namespace: str = ""
+    subsystem: str = ""
+    name: str = ""
+    help: str = ""
+    label_names: Tuple[str, ...] = ()
+    statsd_format: str = ""
+
+    def fq_name(self) -> str:
+        parts = [p for p in (self.namespace, self.subsystem, self.name) if p]
+        return "_".join(parts)
+
+
+class CounterOpts(MetricOpts):
+    pass
+
+
+class GaugeOpts(MetricOpts):
+    pass
+
+
+@dataclass(frozen=True)
+class HistogramOpts(MetricOpts):
+    buckets: Tuple[float, ...] = DEFAULT_BUCKETS
+
+
+class _Metric:
+    """One named metric family; label-tuple -> series state."""
+
+    def __init__(self, opts: MetricOpts, kind: str):
+        self.opts = opts
+        self.kind = kind
+        self.lock = threading.Lock()
+        self.series: Dict[Tuple[str, ...], object] = {}
+
+    def _labels_key(self, label_values: Sequence[str]) -> Tuple[str, ...]:
+        if len(label_values) % 2 != 0:
+            raise ValueError("label values must come in name/value pairs")
+        pairs = dict(zip(label_values[::2], label_values[1::2]))
+        missing = [n for n in self.opts.label_names if n not in pairs]
+        if missing:
+            raise ValueError(f"missing label values: {missing}")
+        return tuple(pairs[n] for n in self.opts.label_names)
+
+
+class Counter:
+    def __init__(self, metric: _Metric, labels: Tuple[str, ...] = ()):
+        self._m = metric
+        self._labels = labels
+
+    def with_labels(self, *label_values: str) -> "Counter":
+        return Counter(self._m, self._m._labels_key(label_values))
+
+    def add(self, delta: float = 1.0) -> None:
+        with self._m.lock:
+            self._m.series[self._labels] = (
+                self._m.series.get(self._labels, 0.0) + delta
+            )
+
+
+class Gauge:
+    def __init__(self, metric: _Metric, labels: Tuple[str, ...] = ()):
+        self._m = metric
+        self._labels = labels
+
+    def with_labels(self, *label_values: str) -> "Gauge":
+        return Gauge(self._m, self._m._labels_key(label_values))
+
+    def set(self, value: float) -> None:
+        with self._m.lock:
+            self._m.series[self._labels] = value
+
+    def add(self, delta: float) -> None:
+        with self._m.lock:
+            self._m.series[self._labels] = (
+                self._m.series.get(self._labels, 0.0) + delta
+            )
+
+
+@dataclass
+class _HistState:
+    counts: List[int]
+    total: int = 0
+    sum: float = 0.0
+
+
+class Histogram:
+    def __init__(self, metric: _Metric, labels: Tuple[str, ...] = ()):
+        self._m = metric
+        self._labels = labels
+
+    def with_labels(self, *label_values: str) -> "Histogram":
+        return Histogram(self._m, self._m._labels_key(label_values))
+
+    def observe(self, value: float) -> None:
+        buckets = self._m.opts.buckets  # type: ignore[attr-defined]
+        with self._m.lock:
+            state = self._m.series.get(self._labels)
+            if state is None:
+                state = _HistState(counts=[0] * len(buckets))
+                self._m.series[self._labels] = state
+            idx = bisect.bisect_left(buckets, value)
+            if idx < len(buckets):
+                state.counts[idx] += 1
+            state.total += 1
+            state.sum += value
+
+
+class Provider:
+    """SPI: NewCounter/NewGauge/NewHistogram (provider.go:11-22)."""
+
+    def new_counter(self, opts: MetricOpts) -> Counter:
+        raise NotImplementedError
+
+    def new_gauge(self, opts: MetricOpts) -> Gauge:
+        raise NotImplementedError
+
+    def new_histogram(self, opts: HistogramOpts) -> Histogram:
+        raise NotImplementedError
+
+
+class PrometheusProvider(Provider):
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, _Metric] = {}
+
+    def _register(self, opts: MetricOpts, kind: str) -> _Metric:
+        name = opts.fq_name()
+        if not name:
+            raise ValueError("metric name is required")
+        with self._lock:
+            existing = self._metrics.get(name)
+            if existing is not None:
+                if existing.kind != kind:
+                    raise ValueError(
+                        f"metric {name} already registered as {existing.kind}"
+                    )
+                return existing
+            metric = _Metric(opts, kind)
+            self._metrics[name] = metric
+            return metric
+
+    def new_counter(self, opts: MetricOpts) -> Counter:
+        return Counter(self._register(opts, "counter"))
+
+    def new_gauge(self, opts: MetricOpts) -> Gauge:
+        return Gauge(self._register(opts, "gauge"))
+
+    def new_histogram(self, opts: HistogramOpts) -> Histogram:
+        return Histogram(self._register(opts, "histogram"))
+
+    def gather(self) -> str:
+        """Prometheus text exposition format, sorted for determinism."""
+        out: List[str] = []
+        with self._lock:
+            metrics = sorted(self._metrics.items())
+        for name, metric in metrics:
+            if metric.opts.help:
+                out.append(f"# HELP {name} {metric.opts.help}")
+            out.append(f"# TYPE {name} {metric.kind}")
+            with metric.lock:
+                series = sorted(metric.series.items())
+                for labels, value in series:
+                    label_str = _format_labels(metric.opts.label_names, labels)
+                    if metric.kind == "histogram":
+                        assert isinstance(value, _HistState)
+                        buckets = metric.opts.buckets  # type: ignore
+                        cum = 0
+                        for ub, c in zip(buckets, value.counts):
+                            cum += c
+                            le = _format_labels(
+                                metric.opts.label_names + ("le",),
+                                labels + (_fmt_float(ub),),
+                            )
+                            out.append(f"{name}_bucket{le} {cum}")
+                        inf = _format_labels(
+                            metric.opts.label_names + ("le",),
+                            labels + ("+Inf",),
+                        )
+                        out.append(f"{name}_bucket{inf} {value.total}")
+                        out.append(f"{name}_sum{label_str} {_fmt_float(value.sum)}")
+                        out.append(f"{name}_count{label_str} {value.total}")
+                    else:
+                        out.append(f"{name}{label_str} {_fmt_float(value)}")
+        return "\n".join(out) + ("\n" if out else "")
+
+
+def _fmt_float(v: float) -> str:
+    if v == int(v):
+        return str(int(v))
+    return repr(v)
+
+
+def _format_labels(names: Sequence[str], values: Sequence[str]) -> str:
+    if not names:
+        return ""
+    pairs = ",".join(f'{n}="{v}"' for n, v in zip(names, values))
+    return "{" + pairs + "}"
+
+
+class StatsdProvider(Provider):
+    """Formats per-event statsd lines into ``sink(line)`` (reference
+    common/metrics/statsd). Bucket names come from statsd_format with
+    ``%{#fqname}`` and ``%{label}`` substitutions."""
+
+    def __init__(self, sink: Callable[[str], None], prefix: str = ""):
+        self._sink = sink
+        self._prefix = prefix
+
+    def _bucket(self, opts: MetricOpts, labels: Tuple[str, ...]) -> str:
+        fmt = opts.statsd_format or "%{#fqname}"
+        name = fmt.replace("%{#fqname}", opts.fq_name().replace("_", "."))
+        for label_name, value in zip(opts.label_names, labels):
+            name = name.replace("%{" + label_name + "}", value)
+        return f"{self._prefix}.{name}" if self._prefix else name
+
+    def new_counter(self, opts: MetricOpts) -> Counter:
+        provider = self
+
+        class _C(Counter):
+            def __init__(self, labels: Tuple[str, ...] = ()):
+                self._labels = labels
+
+            def with_labels(self, *label_values: str) -> "Counter":
+                m = _Metric(opts, "counter")
+                return _C(m._labels_key(label_values))
+
+            def add(self, delta: float = 1.0) -> None:
+                provider._sink(
+                    f"{provider._bucket(opts, self._labels)}:{_fmt_float(delta)}|c"
+                )
+
+        return _C()
+
+    def new_gauge(self, opts: MetricOpts) -> Gauge:
+        provider = self
+
+        class _G(Gauge):
+            def __init__(self, labels: Tuple[str, ...] = ()):
+                self._labels = labels
+
+            def with_labels(self, *label_values: str) -> "Gauge":
+                m = _Metric(opts, "gauge")
+                return _G(m._labels_key(label_values))
+
+            def set(self, value: float) -> None:
+                provider._sink(
+                    f"{provider._bucket(opts, self._labels)}:{_fmt_float(value)}|g"
+                )
+
+            def add(self, delta: float) -> None:
+                provider._sink(
+                    f"{provider._bucket(opts, self._labels)}:{_fmt_float(delta)}|g"
+                )
+
+        return _G()
+
+    def new_histogram(self, opts: HistogramOpts) -> Histogram:
+        provider = self
+
+        class _H(Histogram):
+            def __init__(self, labels: Tuple[str, ...] = ()):
+                self._labels = labels
+
+            def with_labels(self, *label_values: str) -> "Histogram":
+                m = _Metric(opts, "histogram")
+                return _H(m._labels_key(label_values))
+
+            def observe(self, value: float) -> None:
+                provider._sink(
+                    f"{provider._bucket(opts, self._labels)}:{_fmt_float(value)}|ms"
+                )
+
+        return _H()
+
+
+class DisabledProvider(Provider):
+    def new_counter(self, opts: MetricOpts) -> Counter:
+        m = _Metric(opts, "counter")
+        c = Counter(m)
+        c.add = lambda delta=1.0: None  # type: ignore[assignment]
+        return c
+
+    def new_gauge(self, opts: MetricOpts) -> Gauge:
+        m = _Metric(opts, "gauge")
+        g = Gauge(m)
+        g.set = lambda value: None  # type: ignore[assignment]
+        g.add = lambda delta: None  # type: ignore[assignment]
+        return g
+
+    def new_histogram(self, opts: HistogramOpts) -> Histogram:
+        m = _Metric(opts, "histogram")
+        h = Histogram(m)
+        h.observe = lambda value: None  # type: ignore[assignment]
+        return h

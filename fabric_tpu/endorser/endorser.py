@@ -1,0 +1,208 @@
+"""Endorser service — ProcessProposal (reference core/endorser/
+endorser.go:296 + preProcess :250-294 + SimulateProposal :178).
+
+Pipeline per proposal:
+1. unpack SignedProposal -> Proposal -> headers (UnpackProposal);
+2. validate: channel header type, TxID recompute, creator deserialize +
+   certificate validation + client signature over proposal_bytes
+   (validateProcessProposal -> checkSignatureFromCreator analog);
+3. ACL check (aclmgmt hook);
+4. duplicate TxID check against the ledger;
+5. simulate: TxSimulator over committed state + ChaincodeSupport.Execute;
+6. endorse: ProposalResponsePayload{proposal_hash, ChaincodeAction} signed
+   as sig(prp || endorser_identity) — the default endorsement plugin
+   (plugin_endorser.go / builtin ESCC).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+from typing import Callable, Dict, Optional
+
+from fabric_tpu.chaincode.support import ChaincodeSupport, TxParams
+from fabric_tpu.ledger.kvledger import KVLedger
+from fabric_tpu.ledger.simulator import TxSimulator
+from fabric_tpu.msp.identity import MSPError, MSPManager
+from fabric_tpu.msp.signer import SigningIdentity
+from fabric_tpu.protos import common_pb2, peer_pb2, protoutil
+
+
+class ProposalError(Exception):
+    """Rejected before/while simulation; maps to a 500 ProposalResponse."""
+
+
+@dataclass
+class UnpackedProposal:
+    signed_proposal: peer_pb2.SignedProposal
+    proposal: peer_pb2.Proposal
+    channel_header: common_pb2.ChannelHeader
+    signature_header: common_pb2.SignatureHeader
+    chaincode_name: str
+    input: peer_pb2.ChaincodeInput
+    transient: Dict[str, bytes]
+
+
+def unpack_proposal(signed: peer_pb2.SignedProposal) -> UnpackedProposal:
+    """protoutil.UnpackProposal + header checks (endorser.go:250-270)."""
+    prop = protoutil.unmarshal(peer_pb2.Proposal, signed.proposal_bytes)
+    header = protoutil.unmarshal(common_pb2.Header, prop.header)
+    chdr = protoutil.unmarshal(
+        common_pb2.ChannelHeader, header.channel_header
+    )
+    shdr = protoutil.unmarshal(
+        common_pb2.SignatureHeader, header.signature_header
+    )
+    if chdr.type != common_pb2.ENDORSER_TRANSACTION:
+        raise ProposalError(
+            f"invalid header type {chdr.type}, expected ENDORSER_TRANSACTION"
+        )
+    ext = protoutil.unmarshal(
+        peer_pb2.ChaincodeHeaderExtension, chdr.extension
+    )
+    if not ext.chaincode_id.name:
+        raise ProposalError("ChaincodeHeaderExtension.ChaincodeId.Name is empty")
+    ccpp = protoutil.unmarshal(
+        peer_pb2.ChaincodeProposalPayload, prop.payload
+    )
+    cis = protoutil.unmarshal(peer_pb2.ChaincodeInvocationSpec, ccpp.input)
+    return UnpackedProposal(
+        signed_proposal=signed,
+        proposal=prop,
+        channel_header=chdr,
+        signature_header=shdr,
+        chaincode_name=ext.chaincode_id.name,
+        input=cis.chaincode_spec.input,
+        transient=dict(ccpp.TransientMap),
+    )
+
+
+class Endorser:
+    def __init__(
+        self,
+        local_signer: SigningIdentity,
+        msp_manager: MSPManager,
+        support: ChaincodeSupport,
+        get_ledger: Callable[[str], Optional[KVLedger]],
+        acl_check: Optional[Callable[[UnpackedProposal], None]] = None,
+    ):
+        self.signer = local_signer
+        self.msp_manager = msp_manager
+        self.support = support
+        self.get_ledger = get_ledger
+        self.acl_check = acl_check
+
+    # -- the gRPC entry point --
+    def process_proposal(
+        self, signed: peer_pb2.SignedProposal
+    ) -> peer_pb2.ProposalResponse:
+        try:
+            unpacked = unpack_proposal(signed)
+            self._validate(unpacked)
+            return self._simulate_and_endorse(unpacked)
+        except (ProposalError, ValueError) as err:
+            resp = peer_pb2.ProposalResponse()
+            resp.response.status = 500
+            resp.response.message = str(err)
+            return resp
+
+    # -- preProcess (endorser.go:250-294) --
+    def _validate(self, up: UnpackedProposal) -> None:
+        shdr = up.signature_header
+        if not shdr.nonce:
+            raise ProposalError("nonce is empty")
+        if not shdr.creator:
+            raise ProposalError("creator is empty")
+        expected = protoutil.compute_tx_id(shdr.nonce, shdr.creator)
+        if up.channel_header.tx_id != expected:
+            raise ProposalError(
+                f"incorrect txid; expected {expected}, got "
+                f"{up.channel_header.tx_id}"
+            )
+        try:
+            identity, msp = self.msp_manager.deserialize_identity(shdr.creator)
+            msp.validate(identity)
+            identity.verify(
+                up.signed_proposal.proposal_bytes, up.signed_proposal.signature
+            )
+        except MSPError as err:
+            raise ProposalError(f"access denied: {err}") from err
+        if self.acl_check is not None:
+            self.acl_check(up)
+
+    # -- SimulateProposal + endorsement --
+    def _simulate_and_endorse(
+        self, up: UnpackedProposal
+    ) -> peer_pb2.ProposalResponse:
+        channel_id = up.channel_header.channel_id
+        ledger = self.get_ledger(channel_id)
+        if ledger is None:
+            raise ProposalError(f"channel {channel_id} not found")
+        tx_id = up.channel_header.tx_id
+        if ledger.tx_exists(tx_id):
+            raise ProposalError(f"duplicate transaction found [{tx_id}]")
+
+        sim = TxSimulator(ledger.state_db, tx_id=tx_id)
+        resp, event = self.support.execute(
+            TxParams(
+                channel_id=channel_id,
+                tx_id=tx_id,
+                simulator=sim,
+                creator=up.signature_header.creator,
+                transient=up.transient,
+            ),
+            up.chaincode_name,
+            list(up.input.args),
+        )
+        if resp.status >= 400:
+            # Chaincode errors return the response unsigned
+            # (endorser.go:347-352: no endorsement on failure).
+            out = peer_pb2.ProposalResponse()
+            out.response.status = resp.status
+            out.response.message = resp.message
+            out.response.payload = resp.payload
+            return out
+
+        results = sim.get_tx_simulation_results()
+
+        action = peer_pb2.ChaincodeAction()
+        action.results = results.public_bytes
+        if event is not None:
+            action.events = event.SerializeToString()
+        action.response.status = resp.status
+        action.response.message = resp.message
+        action.response.payload = resp.payload
+        action.chaincode_id.name = up.chaincode_name
+
+        prp = peer_pb2.ProposalResponsePayload()
+        prp.proposal_hash = self._proposal_hash(up)
+        prp.extension = action.SerializeToString()
+        prp_bytes = prp.SerializeToString()
+
+        endorser_bytes = self.signer.serialize()
+        out = peer_pb2.ProposalResponse()
+        out.version = 1
+        out.response.status = resp.status
+        out.response.message = resp.message
+        out.response.payload = resp.payload
+        out.payload = prp_bytes
+        out.endorsement.endorser = endorser_bytes
+        out.endorsement.signature = self.signer.sign(prp_bytes + endorser_bytes)
+        # Private write-sets ride back to the client/transient store, not
+        # the block (endorser.go distributePrivateData seam).
+        self.last_pvt_results = results
+        return out
+
+    def _proposal_hash(self, up: UnpackedProposal) -> bytes:
+        """GetProposalHash1: headers + sanitized payload (no transient)."""
+        ccpp = protoutil.unmarshal(
+            peer_pb2.ChaincodeProposalPayload, up.proposal.payload
+        )
+        sanitized = peer_pb2.ChaincodeProposalPayload()
+        sanitized.input = ccpp.input
+        header = protoutil.unmarshal(common_pb2.Header, up.proposal.header)
+        h = hashlib.sha256()
+        h.update(header.channel_header)
+        h.update(header.signature_header)
+        h.update(sanitized.SerializeToString())
+        return h.digest()

@@ -70,6 +70,23 @@ def create_proposal(
     )
 
 
+def create_signed_proposal(
+    bundle: ProposalBundle, signer: SigningIdentity
+) -> peer_pb2.SignedProposal:
+    """protoutil.GetSignedProposal: Proposal{header, payload-with-transient}
+    signed by the client over the serialized proposal bytes."""
+    header = common_pb2.Header()
+    header.channel_header = bundle.channel_header
+    header.signature_header = bundle.signature_header
+    prop = peer_pb2.Proposal()
+    prop.header = header.SerializeToString()
+    prop.payload = bundle.cc_proposal_payload
+    out = peer_pb2.SignedProposal()
+    out.proposal_bytes = prop.SerializeToString()
+    out.signature = signer.sign(out.proposal_bytes)
+    return out
+
+
 def proposal_hash(bundle: ProposalBundle) -> bytes:
     """GetProposalHash1: sha256 over channel header || signature header ||
     sanitized chaincode proposal payload."""

@@ -1,0 +1,130 @@
+"""Private-data collection model (reference core/common/privdata/
+collection.go, simplecollection.go, membershipinfo.go).
+
+CollectionAccess wraps a StaticCollectionConfig: membership is a
+signature-policy evaluation over the peer's identity (SimpleCollection
+.AccessFilter), BTL feeds the pvtdata store's purge policy, and
+member_only_read/write gate chaincode access at simulation time
+(core/chaincode/handler.go errorIfCreatorHasNoReadAccess).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence
+
+from fabric_tpu.policy import proto_convert
+from fabric_tpu.policy.ast import SignaturePolicyEnvelope
+from fabric_tpu.protos import collection_pb2, protoutil
+
+
+class NoSuchCollectionError(Exception):
+    pass
+
+
+class CollectionAccess:
+    def __init__(self, cfg: collection_pb2.StaticCollectionConfig):
+        self.name = cfg.name
+        self.required_peer_count = cfg.required_peer_count
+        self.maximum_peer_count = cfg.maximum_peer_count
+        self.block_to_live = cfg.block_to_live
+        self.member_only_read = cfg.member_only_read
+        self.member_only_write = cfg.member_only_write
+        self._policy_env: Optional[SignaturePolicyEnvelope] = None
+        if cfg.member_orgs_policy.HasField("signature_policy"):
+            self._policy_env = proto_convert.unmarshal_envelope(
+                cfg.member_orgs_policy.signature_policy.SerializeToString()
+            )
+
+    def is_member(self, identity, msp) -> bool:
+        """AccessFilter: does the identity satisfy the member-orgs policy?
+        Principal matching only — no signature involved (the reference
+        evaluates the policy over a SignedData with the membership
+        identity; satisfaction is by principal)."""
+        if self._policy_env is None:
+            return False
+        from fabric_tpu.policy.evaluator import evaluate_host
+        from fabric_tpu.validation.validator import principal_for
+
+        import numpy as np
+
+        num_p = len(self._policy_env.identities)
+        sat = np.zeros((1, num_p), dtype=bool)
+        for p, principal_proto in enumerate(self._policy_env.identities):
+            try:
+                msp.satisfies_principal(identity, principal_for(principal_proto))
+                sat[0, p] = True
+            except Exception:
+                pass
+        return evaluate_host(self._policy_env, sat)
+
+
+class CollectionStore:
+    """Per-channel collection registry resolved from lifecycle definitions
+    (reference core/common/privdata/store.go backed by lscc/_lifecycle)."""
+
+    def __init__(
+        self,
+        # ns -> serialized CollectionConfigPackage (lifecycle.collections)
+        get_collections_bytes: Callable[[str], bytes],
+    ):
+        self._get = get_collections_bytes
+
+    def package(self, ns: str) -> collection_pb2.CollectionConfigPackage:
+        raw = self._get(ns) or b""
+        pkg = collection_pb2.CollectionConfigPackage()
+        if raw:
+            pkg.ParseFromString(raw)
+        return pkg
+
+    def collection(self, ns: str, coll: str) -> CollectionAccess:
+        for cfg in self.package(ns).config:
+            static = cfg.static_collection_config
+            if static.name == coll:
+                return CollectionAccess(static)
+        raise NoSuchCollectionError(f"collection {ns}/{coll} not found")
+
+    def has_collection(self, ns: str, coll: str) -> bool:
+        try:
+            self.collection(ns, coll)
+            return True
+        except NoSuchCollectionError:
+            return False
+
+    def btl_policy(self) -> Callable[[str, str], int]:
+        """(ns, coll) -> block_to_live for the pvtdata store (0 = forever)."""
+
+        def btl(ns: str, coll: str) -> int:
+            try:
+                return int(self.collection(ns, coll).block_to_live)
+            except NoSuchCollectionError:
+                return 0
+
+        return btl
+
+
+def build_collection_config_package(
+    collections: Sequence[Dict],
+) -> collection_pb2.CollectionConfigPackage:
+    """Helper for tests/tools: [{name, policy (DSL or env), required/max/
+    btl/member_only_*}] -> proto package."""
+    from fabric_tpu.policy import from_dsl
+    from fabric_tpu.policy.proto_convert import marshal_envelope
+
+    pkg = collection_pb2.CollectionConfigPackage()
+    for c in collections:
+        cfg = pkg.config.add()
+        static = cfg.static_collection_config
+        static.name = c["name"]
+        policy = c.get("policy")
+        if isinstance(policy, str):
+            policy = from_dsl(policy)
+        if policy is not None:
+            static.member_orgs_policy.signature_policy.ParseFromString(
+                marshal_envelope(policy)
+            )
+        static.required_peer_count = c.get("required_peer_count", 0)
+        static.maximum_peer_count = c.get("maximum_peer_count", 1)
+        static.block_to_live = c.get("block_to_live", 0)
+        static.member_only_read = c.get("member_only_read", False)
+        static.member_only_write = c.get("member_only_write", False)
+    return pkg

@@ -24,8 +24,14 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from fabric_tpu.ledger.blockstore import BlockStore
 from fabric_tpu.ledger.mvcc import Validator
+from fabric_tpu.ledger.pvtdatastore import MissingEntry, PvtDataStore, PvtEntry
 from fabric_tpu.ledger.rwset import TxRwSet, Version
-from fabric_tpu.ledger.statedb import HashedUpdateBatch, UpdateBatch, VersionedDB
+from fabric_tpu.ledger.statedb import (
+    HashedUpdateBatch,
+    PvtUpdateBatch,
+    UpdateBatch,
+    VersionedDB,
+)
 from fabric_tpu.protos import common_pb2, protoutil, txmgr_updates_pb2
 from fabric_tpu.validation.msgvalidation import parse_transaction
 from fabric_tpu.validation.txflags import TxValidationCode, ValidationFlags
@@ -109,12 +115,52 @@ def deterministic_update_bytes(
     return msg.SerializeToString()
 
 
+def pvt_data_matches_hashes(
+    rwset: Optional[TxRwSet], ns: str, coll: str, raw: bytes
+) -> bool:
+    """Does a cleartext KVRWSet match the tx's on-block hashed writes for
+    (ns, coll)? Used to screen untrusted (gossip-fetched) private data
+    before commit — a mismatch is treated as missing, never an error
+    (reference gossip/privdata purge of invalid fetched data)."""
+    from fabric_tpu.protos import kv_rwset_pb2
+
+    expected: Dict[bytes, Tuple[bool, bytes]] = {}
+    if rwset is not None:
+        for ns_rw in rwset.ns_rw_sets:
+            if ns_rw.namespace != ns:
+                continue
+            for c in ns_rw.coll_hashed:
+                if c.collection_name == coll:
+                    for hw in c.hashed_writes:
+                        expected[hw.key_hash] = (hw.is_delete, hw.value_hash)
+    kv = kv_rwset_pb2.KVRWSet()
+    try:
+        kv.ParseFromString(raw)
+    except Exception:
+        return False
+    for w in kv.writes:
+        kh = hashlib.sha256(w.key.encode()).digest()
+        exp = expected.get(kh)
+        if exp is None:
+            return False
+        is_del, vh = exp
+        if w.is_delete != is_del:
+            return False
+        if not w.is_delete and hashlib.sha256(w.value).digest() != vh:
+            return False
+    return True
+
+
 class KVLedger:
     """One channel's ledger (block store + state + history)."""
 
-    def __init__(self, ledger_dir: str, channel_id: str):
+    def __init__(self, ledger_dir: str, channel_id: str, btl_policy=None):
         self.channel_id = channel_id
         self.block_store = BlockStore(os.path.join(ledger_dir, f"{channel_id}.chain"))
+        self.pvt_store = PvtDataStore(
+            os.path.join(ledger_dir, f"{channel_id}.pvtdata"),
+            btl_policy=btl_policy,
+        )
         self.state_db = VersionedDB()
         self.history: Dict[Tuple[str, str], List[Version]] = {}
         self.commit_hash = b""
@@ -153,7 +199,15 @@ class KVLedger:
                 validator._apply_write_set(
                     rwset, Version(block.header.number, tx_num), updates, hashed
                 )
-        self._commit_state(block, updates, hashed)
+        # pvt cleartext state is derived from the pvt store on replay
+        pvt_batch = self._pvt_batch(
+            block.header.number,
+            self.pvt_store.get_pvt_data_by_block(block.header.number),
+            codes,
+            rwsets,
+            verify_hashes=False,
+        )
+        self._commit_state(block, updates, hashed, pvt_batch)
 
     def _extract_flags(self, block: common_pb2.Block) -> ValidationFlags:
         raw = bytes(block.metadata.metadata[common_pb2.TRANSACTIONS_FILTER])
@@ -174,12 +228,19 @@ class KVLedger:
         self,
         block: common_pb2.Block,
         rwsets: Optional[List[Optional[TxRwSet]]] = None,
+        pvt_data: Optional[Dict[Tuple[int, str, str], bytes]] = None,
+        missing_pvt: Optional[List[MissingEntry]] = None,
     ) -> ValidationFlags:
         """ValidateAndPrepare + commit (kv_ledger.go commit): assumes the
         block already carries the txvalidator's TRANSACTIONS_FILTER; MVCC
         verdicts are merged in here and the final filter is what gets
         stored. `rwsets` lets the caller share the validator's parse pass
-        (hot path); when absent the block is re-decoded (replay path)."""
+        (hot path); when absent the block is re-decoded (replay path).
+
+        `pvt_data` maps (tx_num, ns, collection) -> serialized cleartext
+        KVRWSet assembled by the coordinator; writes are hash-checked
+        against the tx's on-block hashed rwset before being applied
+        (kv_ledger.go CommitLegacy's pvt data validation)."""
         flags = self._extract_flags(block)
         if rwsets is None:
             rwsets = self._extract_rwsets(block)
@@ -188,6 +249,27 @@ class KVLedger:
         codes, updates, hashed = validator.validate_and_prepare_batch(
             block.header.number, rwsets, incoming
         )
+        # Assemble + hash-check private data FIRST: anything that can raise
+        # must run before commit_hash is chained or any store is touched,
+        # or a failed commit leaves this peer's COMMIT_HASH diverged from
+        # the network on retry.
+        entries = [
+            PvtEntry(tx_num, ns, coll, raw)
+            for (tx_num, ns, coll), raw in sorted((pvt_data or {}).items())
+            if codes[tx_num] == TxValidationCode.VALID
+        ]
+        pvt_batch = self._pvt_batch(
+            block.header.number, entries, codes, rwsets, verify_hashes=True
+        )
+        # A tx that ended up invalid (e.g. MVCC) needs no private data —
+        # a missing marker for it would feed the reconciler forever.
+        missing = [
+            m
+            for m in (missing_pvt or [])
+            if m.tx_num < len(codes)
+            and codes[m.tx_num] == TxValidationCode.VALID
+        ]
+
         for i, code in enumerate(codes):
             flags.set_flag(i, code)
         protoutil.init_block_metadata(block)
@@ -207,16 +289,84 @@ class KVLedger:
         meta.value = self.commit_hash
         block.metadata.metadata[common_pb2.COMMIT_HASH] = meta.SerializeToString()
 
+        # pvtdata store commit precedes the block append (store.go Commit);
+        # if a crash hit between the two last time, the pvt record for this
+        # block is already durable — skip, don't error, so redelivery of
+        # the block can complete the interrupted commit.
+        if self.pvt_store.last_committed_block < block.header.number:
+            self.pvt_store.commit(block.header.number, entries, missing)
+
         self.block_store.add_block(block)
-        self._commit_state(block, updates, hashed)
+        self._commit_state(block, updates, hashed, pvt_batch)
         return flags
 
+    def _pvt_batch(
+        self,
+        block_num: int,
+        entries: List[PvtEntry],
+        codes: List[TxValidationCode],
+        rwsets: List[Optional[TxRwSet]],
+        verify_hashes: bool,
+    ) -> PvtUpdateBatch:
+        """Cleartext private writes -> state batch, checked against the
+        tx's hashed rwset (the on-block source of truth)."""
+        import hashlib as _hashlib
+
+        from fabric_tpu.protos import kv_rwset_pb2
+
+        batch = PvtUpdateBatch()
+        for e in entries:
+            if e.tx_num >= len(codes) or codes[e.tx_num] != TxValidationCode.VALID:
+                continue
+            expected: Dict[bytes, Tuple[bool, bytes]] = {}
+            rwset = rwsets[e.tx_num] if e.tx_num < len(rwsets) else None
+            if rwset is not None:
+                for ns_rw in rwset.ns_rw_sets:
+                    if ns_rw.namespace != e.namespace:
+                        continue
+                    for coll in ns_rw.coll_hashed:
+                        if coll.collection_name == e.collection:
+                            for hw in coll.hashed_writes:
+                                expected[hw.key_hash] = (hw.is_delete, hw.value_hash)
+            kv = kv_rwset_pb2.KVRWSet()
+            kv.ParseFromString(e.rwset)
+            for w in kv.writes:
+                kh = _hashlib.sha256(w.key.encode()).digest()
+                exp = expected.get(kh)
+                if verify_hashes:
+                    if exp is None:
+                        raise ValueError(
+                            f"pvt write {e.namespace}/{e.collection}/{w.key} "
+                            "not present in the hashed rwset"
+                        )
+                    is_del, vh = exp
+                    if w.is_delete != is_del or (
+                        not w.is_delete
+                        and _hashlib.sha256(w.value).digest() != vh
+                    ):
+                        raise ValueError(
+                            f"pvt value hash mismatch for "
+                            f"{e.namespace}/{e.collection}/{w.key}"
+                        )
+                batch.put(
+                    e.namespace,
+                    e.collection,
+                    w.key,
+                    None if w.is_delete else w.value,
+                    Version(block_num, e.tx_num),
+                )
+        return batch
+
     def _commit_state(
-        self, block: common_pb2.Block, updates: UpdateBatch, hashed: HashedUpdateBatch
+        self,
+        block: common_pb2.Block,
+        updates: UpdateBatch,
+        hashed: HashedUpdateBatch,
+        pvt: Optional[PvtUpdateBatch] = None,
     ) -> None:
         for (ns, key), entry in updates.items():
             self.history.setdefault((ns, key), []).append(entry.version)
-        self.state_db.apply_updates(updates, hashed)
+        self.state_db.apply_updates(updates, hashed, pvt)
 
     # -- queries (qscc analog) --------------------------------------------
     @property
@@ -225,6 +375,10 @@ class KVLedger:
 
     def get_state(self, ns: str, key: str) -> Optional[bytes]:
         vv = self.state_db.get_state(ns, key)
+        return vv.value if vv else None
+
+    def get_private_data(self, ns: str, coll: str, key: str) -> Optional[bytes]:
+        vv = self.state_db.get_private_data(ns, coll, key)
         return vv.value if vv else None
 
     def get_history_for_key(self, ns: str, key: str) -> List[Version]:

@@ -99,6 +99,33 @@ class HashedUpdateBatch:
         return len(self._updates)
 
 
+class PvtUpdateBatch:
+    """Cleartext private-data writes keyed (ns, collection, key)
+    (reference privacyenabledstate UpdateBatch.PvtUpdates)."""
+
+    def __init__(self):
+        self._updates: Dict[Tuple[str, str, str], BatchEntry] = {}
+
+    def put(
+        self,
+        ns: str,
+        coll: str,
+        key: str,
+        value: Optional[bytes],
+        version: Version,
+    ) -> None:
+        self._updates[(ns, coll, key)] = BatchEntry(value, version)
+
+    def get(self, ns: str, coll: str, key: str) -> Optional[BatchEntry]:
+        return self._updates.get((ns, coll, key))
+
+    def items(self):
+        return self._updates.items()
+
+    def __len__(self):
+        return len(self._updates)
+
+
 class VersionedDB:
     """Committed state: (ns, key) -> VersionedValue, ordered per namespace."""
 
@@ -106,6 +133,7 @@ class VersionedDB:
         self._data: Dict[str, Dict[str, VersionedValue]] = {}
         self._sorted_keys: Dict[str, List[str]] = {}
         self._hashed: Dict[Tuple[str, str, bytes], VersionedValue] = {}
+        self._pvt: Dict[Tuple[str, str, str], VersionedValue] = {}
 
     # -- reads ------------------------------------------------------------
     def get_state(self, ns: str, key: str) -> Optional[VersionedValue]:
@@ -136,6 +164,13 @@ class VersionedDB:
         entry = self._hashed.get((ns, coll, key_hash))
         return entry.version if entry else None
 
+    def get_private_data(
+        self, ns: str, coll: str, key: str
+    ) -> Optional[VersionedValue]:
+        """Cleartext private read (privacyenabledstate GetPrivateData);
+        returns None when this peer never received the collection data."""
+        return self._pvt.get((ns, coll, key))
+
     def get_state_range(
         self, ns: str, start_key: str, end_key: str, include_end: bool
     ) -> Iterator[Tuple[str, VersionedValue]]:
@@ -156,7 +191,12 @@ class VersionedDB:
             i += 1
 
     # -- writes -----------------------------------------------------------
-    def apply_updates(self, batch: UpdateBatch, hashed: Optional[HashedUpdateBatch] = None) -> None:
+    def apply_updates(
+        self,
+        batch: UpdateBatch,
+        hashed: Optional[HashedUpdateBatch] = None,
+        pvt: Optional[PvtUpdateBatch] = None,
+    ) -> None:
         for (ns, key), entry in batch.items():
             table = self._data.setdefault(ns, {})
             keys = self._sorted_keys.setdefault(ns, [])
@@ -179,6 +219,14 @@ class VersionedDB:
                 else:
                     self._hashed[(ns, coll, key_hash)] = VersionedValue(
                         entry.value, entry.version, entry.metadata
+                    )
+        if pvt is not None:
+            for (ns, coll, key), entry in pvt.items():
+                if entry.value is None:
+                    self._pvt.pop((ns, coll, key), None)
+                else:
+                    self._pvt[(ns, coll, key)] = VersionedValue(
+                        entry.value, entry.version
                     )
 
     def num_keys(self) -> int:

@@ -1,0 +1,1 @@
+from fabric_tpu.operations.system import System, Options  # noqa: F401

@@ -1,0 +1,183 @@
+"""Operations server (reference core/operations/system.go).
+
+Serves the node admin plane over HTTP on a local port:
+
+* ``GET  /metrics``  — Prometheus text format  (system.go:134)
+* ``GET  /healthz``  — runs registered health checkers; 200 {"status":"OK"}
+                       or 503 with the failed checks (system.go:154)
+* ``GET  /logspec``  — active flogging spec     (system.go:149)
+* ``PUT  /logspec``  — activate a new spec from {"spec": "..."}
+* ``GET  /version``  — version payload          (system.go:157-163)
+
+The reference gates mutating endpoints behind TLS client auth; here the
+server binds loopback by default and exposes the same surface. Providers:
+``prometheus`` | ``statsd`` | ``disabled`` (system.go initializeMetrics).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from dataclasses import dataclass
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional
+
+from fabric_tpu.common import flogging
+from fabric_tpu.common.metrics import (
+    DisabledProvider,
+    PrometheusProvider,
+    Provider,
+    StatsdProvider,
+)
+
+VERSION = "0.1.0"
+
+
+@dataclass
+class Options:
+    listen_address: str = "127.0.0.1:0"
+    metrics_provider: str = "prometheus"  # prometheus | statsd | disabled
+    statsd_sink: Optional[Callable[[str], None]] = None
+    statsd_prefix: str = ""
+    version: str = VERSION
+
+
+class System:
+    """Owns the metrics provider, the health checker registry and the
+    admin HTTP server for one node process."""
+
+    def __init__(self, options: Optional[Options] = None):
+        self.options = options or Options()
+        self._checkers: Dict[str, Callable[[], None]] = {}
+        self._lock = threading.Lock()
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+        kind = self.options.metrics_provider
+        if kind == "prometheus":
+            self.provider: Provider = PrometheusProvider()
+        elif kind == "statsd":
+            self.provider = StatsdProvider(
+                self.options.statsd_sink or (lambda line: None),
+                prefix=self.options.statsd_prefix,
+            )
+        elif kind == "disabled":
+            self.provider = DisabledProvider()
+        else:
+            raise ValueError(f"unknown metrics provider: {kind}")
+
+    # -- health checker registry (healthz.HealthHandler analog) --
+    def register_checker(self, component: str, check: Callable[[], None]) -> None:
+        """check() raises to signal failure (healthz lib contract)."""
+        with self._lock:
+            if component in self._checkers:
+                raise ValueError(f"duplicate health checker: {component}")
+            self._checkers[component] = check
+
+    def deregister_checker(self, component: str) -> None:
+        with self._lock:
+            self._checkers.pop(component, None)
+
+    def run_checks(self) -> Dict[str, str]:
+        """component -> failure reason for every failing checker."""
+        with self._lock:
+            checkers = dict(self._checkers)
+        failures = {}
+        for name, check in checkers.items():
+            try:
+                check()
+            except Exception as exc:  # noqa: BLE001 - report any failure
+                failures[name] = str(exc)
+        return failures
+
+    # -- HTTP server --
+    @property
+    def addr(self) -> str:
+        assert self._server is not None, "system not started"
+        host, port = self._server.server_address[:2]
+        return f"{host}:{port}"
+
+    def start(self) -> str:
+        host, _, port = self.options.listen_address.rpartition(":")
+        system = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # quiet
+                pass
+
+            def _reply(self, code: int, body: bytes, ctype: str) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/metrics":
+                    if isinstance(system.provider, PrometheusProvider):
+                        self._reply(
+                            200,
+                            system.provider.gather().encode(),
+                            "text/plain; version=0.0.4",
+                        )
+                    else:
+                        self._reply(404, b"metrics provider is not prometheus",
+                                    "text/plain")
+                elif self.path == "/healthz":
+                    failures = system.run_checks()
+                    if failures:
+                        body = json.dumps(
+                            {
+                                "status": "Service Unavailable",
+                                "failed_checks": [
+                                    {"component": c, "reason": r}
+                                    for c, r in sorted(failures.items())
+                                ],
+                            }
+                        ).encode()
+                        self._reply(503, body, "application/json")
+                    else:
+                        self._reply(
+                            200, b'{"status":"OK"}', "application/json"
+                        )
+                elif self.path == "/logspec":
+                    body = json.dumps({"spec": flogging.spec()}).encode()
+                    self._reply(200, body, "application/json")
+                elif self.path == "/version":
+                    body = json.dumps(
+                        {"Version": system.options.version}
+                    ).encode()
+                    self._reply(200, body, "application/json")
+                else:
+                    self._reply(404, b"not found", "text/plain")
+
+            def do_PUT(self):
+                if self.path != "/logspec":
+                    self._reply(404, b"not found", "text/plain")
+                    return
+                length = int(self.headers.get("Content-Length", "0"))
+                try:
+                    payload = json.loads(self.rfile.read(length) or b"{}")
+                    flogging.activate_spec(payload.get("spec", ""))
+                except (ValueError, flogging.InvalidSpecError) as exc:
+                    body = json.dumps({"error": str(exc)}).encode()
+                    self._reply(400, body, "application/json")
+                    return
+                self._reply(204, b"", "application/json")
+
+            do_POST = do_PUT
+
+        self._server = ThreadingHTTPServer(
+            (host or "127.0.0.1", int(port or 0)), Handler
+        )
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="operations", daemon=True
+        )
+        self._thread.start()
+        return self.addr
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None

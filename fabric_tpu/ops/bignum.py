@@ -177,8 +177,29 @@ def reduce_canonical_l(ctx: MontCtx, xs: Sequence[jax.Array], times: int) -> Lis
 
 
 # ---------------------------------------------------------------------------
-# Core multiply (CIOS Montgomery, lazy carries, fully unrolled)
+# Core multiply (CIOS Montgomery, lazy carries)
+#
+# Two trace shapes for identical math, chosen by FABRIC_TPU_CIOS_UNROLL
+# (default: unrolled off-CPU, looped on CPU):
+# - unrolled: 20 Python iterations -> one flat elementwise DAG XLA fuses
+#   freely; fastest at runtime (the TPU/bench path).
+# - looped: lax.fori_loop whose body is ~10 vector ops on stacked
+#   (NLIMBS, B) arrays. ~40x smaller traced graph; XLA:CPU compiles the
+#   full ECDSA verify kernel in seconds instead of >10 minutes. The
+#   stacked layout costs runtime (dynamic-index breaks fusion), which is
+#   irrelevant for tests/dryrun.
 # ---------------------------------------------------------------------------
+
+
+def _cios_unrolled() -> bool:
+    import os
+
+    forced = os.environ.get("FABRIC_TPU_CIOS_UNROLL", "")
+    if forced == "1":
+        return True
+    if forced == "0":
+        return False
+    return jax.default_backend() != "cpu"
 
 
 def mont_mul_l(
@@ -193,6 +214,8 @@ def mont_mul_l(
     output is < m*(1 + c1*c2*m/2^260), so nreduce=1 suffices for
     c1*c2 <= 16.
     """
+    if not _cios_unrolled():
+        return _mont_mul_l_looped(ctx, a, b, nreduce)
     m = ctx.m_scalars
     m0inv = ctx.m0inv
     zero = jnp.zeros_like(a[0])
@@ -208,6 +231,42 @@ def mont_mul_l(
         nt.append(zero)
         t = nt
     limbs, _ = carry_l(t)  # value < 2m for canonical inputs; carry_out 0
+    return reduce_canonical_l(ctx, limbs, nreduce)
+
+
+def _mont_mul_l_looped(
+    ctx: MontCtx,
+    a: Sequence[jax.Array],
+    b: Sequence[jax.Array],
+    nreduce: int,
+) -> List[jax.Array]:
+    """Same CIOS recurrence with the outer i-loop as lax.fori_loop and the
+    inner j-loop vectorized over a stacked (NLIMBS, B) accumulator."""
+    from jax import lax
+
+    batch = jnp.broadcast_shapes(
+        *(jnp.shape(x) for x in a), *(jnp.shape(y) for y in b)
+    )
+    a_s = jnp.stack(tuple(jnp.broadcast_to(jnp.asarray(x), batch) for x in a))
+    b_s = jnp.stack(tuple(jnp.broadcast_to(jnp.asarray(y), batch) for y in b))
+    m_s = jnp.asarray(ctx.m_limbs, dtype=jnp.uint32).reshape(
+        (NLIMBS,) + (1,) * len(batch)
+    )
+    m0inv = ctx.m0inv
+
+    def body(i, t):
+        ai = a_s[i]
+        t0 = t[0] + ai * b_s[0]
+        q = ((t0 & LIMB_MASK) * m0inv) & LIMB_MASK
+        carry0 = (t0 + q * m_s[0]) >> LIMB_BITS
+        nt = t[1:] + ai * b_s[1:] + q * m_s[1:]
+        nt = nt.at[0].add(carry0)
+        return jnp.concatenate([nt, jnp.zeros_like(t[:1])])
+
+    t = lax.fori_loop(
+        0, NLIMBS, body, jnp.zeros_like(a_s), unroll=False
+    )
+    limbs, _ = carry_l(split(t))
     return reduce_canonical_l(ctx, limbs, nreduce)
 
 

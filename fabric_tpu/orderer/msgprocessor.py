@@ -151,10 +151,22 @@ class StandardChannelProcessor:
         self._filters = [ExpirationFilter(), SizeFilter(bundle), SigFilter(bundle)]
         self.validator.policy_manager = bundle.policy_manager
 
+    def apply_filters(
+        self, env: common_pb2.Envelope, include_sig: bool = True
+    ) -> None:
+        """Run the ingress filter chain alone. include_sig=False is the
+        system channel's channel-creation path (systemchannel.go): the
+        client envelope is authorized by the consortium's
+        ChannelCreationPolicy, not the system channel's Writers — the
+        SigFilter there sees the orderer-signed wrapper instead."""
+        for f in self._filters:
+            if not include_sig and isinstance(f, SigFilter):
+                continue
+            f.apply(env)
+
     def process_normal_msg(self, env: common_pb2.Envelope) -> int:
         """Returns the config sequence the message was validated against."""
-        for f in self._filters:
-            f.apply(env)
+        self.apply_filters(env)
         return self.validator.sequence
 
     def process_config_update_msg(
@@ -162,8 +174,7 @@ class StandardChannelProcessor:
     ) -> Tuple[common_pb2.Envelope, int]:
         """CONFIG_UPDATE -> (CONFIG envelope ready to order, sequence)
         (reference standardchannel.go ProcessConfigUpdateMsg)."""
-        for f in self._filters:
-            f.apply(env)
+        self.apply_filters(env)
         config_env = self.validator.propose_config_update(env)
 
         payload = common_pb2.Payload()

@@ -185,6 +185,11 @@ class Registrar:
                 "no system channel: create channels via join_channel"
             )
         sys_support = self.chains[self.system_channel_id]
+        # Expiration + size filters apply to the client envelope; the
+        # authorization check is the consortium's ChannelCreationPolicy
+        # (below), matching systemchannel.go where the SigFilter only ever
+        # sees the orderer-signed ORDERER_TRANSACTION wrapper.
+        sys_support.processor.apply_filters(env, include_sig=False)
         payload = protoutil.unmarshal(common_pb2.Payload, env.payload)
         cue = protoutil.unmarshal(
             configtx_pb2.ConfigUpdateEnvelope, payload.data
@@ -236,12 +241,78 @@ class Registrar:
         cfg.sequence = 0
         cfg.channel_group.CopyFrom(template)
 
+        bundle = Bundle(channel_id, cfg, self.provider)
+        self._check_creation_policy(cons_group, bundle, payload.data)
+
         cenv = configtx_pb2.ConfigEnvelope()
         cenv.config.CopyFrom(cfg)
         cenv.last_update.CopyFrom(env)
         genesis = _config_block(channel_id, cenv, 0, b"")
-        bundle = Bundle(channel_id, cfg, self.provider)
         return self._start_chain(channel_id, bundle, genesis)
+
+    def _check_creation_policy(
+        self,
+        cons_group: configtx_pb2.ConfigGroup,
+        new_bundle: Bundle,
+        cue_bytes: bytes,
+    ) -> None:
+        """Enforce the consortium's ChannelCreationPolicy over the config
+        update's signatures (reference systemchannel.go NewChannelConfig:
+        the templator pins the Application group's mod_policy to the
+        creation policy, evaluated with the NEW channel's org MSPs)."""
+        from fabric_tpu.channelconfig.bundle import CHANNEL_CREATION_POLICY_KEY
+        from fabric_tpu.channelconfig.configtx import _config_update_signed_data
+        from fabric_tpu.policy.manager import (
+            ImplicitMetaPolicy,
+            PolicyError,
+            SignaturePolicy,
+            SignedData,
+        )
+        from fabric_tpu.policy import proto_convert
+        from fabric_tpu.protos import policies_pb2
+
+        cp_value = cons_group.values.get(CHANNEL_CREATION_POLICY_KEY)
+        if cp_value is None:
+            raise RegistrarError(
+                "consortium has no ChannelCreationPolicy"
+            )
+        pol = protoutil.unmarshal(policies_pb2.Policy, cp_value.value)
+        P = policies_pb2.Policy
+        if pol.type == P.IMPLICIT_META:
+            meta = policies_pb2.ImplicitMetaPolicy()
+            meta.ParseFromString(pol.value)
+            app_mgr = new_bundle.policy_manager.manager(["Application"])
+            children = app_mgr.children if app_mgr is not None else {}
+            subs = [
+                child.get_policy(meta.sub_policy)[0]
+                for child in children.values()
+            ]
+            policy = ImplicitMetaPolicy(meta.rule, meta.sub_policy, subs)
+        elif pol.type == P.SIGNATURE:
+            policy = SignaturePolicy(
+                proto_convert.unmarshal_envelope(pol.value),
+                new_bundle.msp_manager,
+                self.provider,
+            )
+        else:
+            raise RegistrarError(
+                f"unsupported ChannelCreationPolicy type {pol.type}"
+            )
+        cue = protoutil.unmarshal(
+            configtx_pb2.ConfigUpdateEnvelope, cue_bytes
+        )
+        # _config_update_signed_data returns (data, creator); SignedData is
+        # (data, identity, signature).
+        signed = []
+        for s in cue.signatures:
+            data, creator = _config_update_signed_data(cue, s)
+            signed.append(SignedData(data, creator, s.signature))
+        try:
+            policy.evaluate_signed_data(signed)
+        except PolicyError as e:
+            raise RegistrarError(
+                f"channel creation request failed authorization: {e}"
+            ) from e
 
 
 def _config_from_bundle(bundle: Bundle) -> configtx_pb2.Config:

@@ -44,6 +44,25 @@ def _is_config_block(block: common_pb2.Block) -> bool:
     return chdr.type == common_pb2.CONFIG
 
 
+def _last_config_index(block: Optional[common_pb2.Block]) -> int:
+    """Recover LastConfig.index from a stored block's SIGNATURES metadata
+    (blockwriter.go lastConfigBlockNumber on restart)."""
+    if block is None:
+        return 0
+    metas = block.metadata.metadata
+    if len(metas) > common_pb2.SIGNATURES and metas[common_pb2.SIGNATURES]:
+        try:
+            meta = protoutil.unmarshal(
+                common_pb2.Metadata, metas[common_pb2.SIGNATURES]
+            )
+            if meta.value:
+                lc = protoutil.unmarshal(common_pb2.LastConfig, meta.value)
+                return lc.index
+        except ValueError:
+            pass
+    return block.header.number if _is_config_block(block) else 0
+
+
 class NotLeaderError(Exception):
     """Submit must be forwarded to the raft leader (cluster Step RPC)."""
 
@@ -70,15 +89,31 @@ class RaftChain:
         self.channel_id = channel_id
         self.node = RaftNode(node_id, peers)
         self.cutter = BlockCutter(batch_config)
-        self.blocks: List[common_pb2.Block] = []
         self._sink = sink
         self._on_config_block = on_config_block
-        self.writer = BlockWriter(signer=signer, sink=self._store_block)
         self.snapshot_interval = snapshot_interval
         self.transport = transport or (lambda to, msg: None)
         self._applied_index = 0
 
         base = os.path.join(wal_dir, channel_id)
+        # The block ledger is persistent (reference: etcdraft sits on the
+        # multichannel blockledger); a restart must resume from the stored
+        # height or a snapshotted node silently resets to height 0 and
+        # re-mints already-used block numbers.
+        from fabric_tpu.ledger.blockstore import BlockStore
+
+        self.block_store = BlockStore(os.path.join(base, "chain.blocks"))
+        last_block = (
+            self.block_store.get_block_by_number(self.block_store.height - 1)
+            if self.block_store.height
+            else None
+        )
+        self.writer = BlockWriter(
+            signer=signer,
+            sink=self._store_block,
+            last_block=last_block,
+            last_config_index=_last_config_index(last_block),
+        )
         self.wal = WAL(os.path.join(base, "wal.log"))
         self.snap = SnapshotFile(os.path.join(base, "snapshot"))
         self._persisted_snap_index = 0
@@ -108,7 +143,7 @@ class RaftChain:
                 self.node.log.append(e)
 
     def _store_block(self, block: common_pb2.Block) -> None:
-        self.blocks.append(block)
+        self.block_store.add_block(block)
         if self._sink is not None:
             self._sink(block)
 
@@ -117,14 +152,7 @@ class RaftChain:
         return self.writer.height
 
     def get_block(self, number: int) -> Optional[common_pb2.Block]:
-        # account for a snapshot-truncated prefix
-        if not self.blocks:
-            return None
-        first = self.blocks[0].header.number
-        off = number - first
-        if 0 <= off < len(self.blocks):
-            return self.blocks[off]
-        return None
+        return self.block_store.get_block_by_number(number)
 
     # -- consensus.Chain surface -------------------------------------------
     def order(self, env: common_pb2.Envelope) -> None:
@@ -172,11 +200,7 @@ class RaftChain:
         ):
             self._proposed_term = self.node.term
             self._proposed_height = self.writer.height
-            self._proposed_hash = (
-                protoutil.block_header_hash(self.blocks[-1].header)
-                if self.blocks
-                else b""
-            )
+            self._proposed_hash = self.block_store.last_block_hash
         block = protoutil.new_block(self._proposed_height, self._proposed_hash)
         for env in batch:
             block.data.data.append(env.SerializeToString())

@@ -37,11 +37,18 @@ class Channel:
         provider: Optional[Provider] = None,
         verify_orderer_sig: Optional[Callable[[common_pb2.Block], bool]] = None,
         apply_config: Optional[Callable[[bytes], None]] = None,
+        transient_store=None,  # gossip.coordinator.TransientStore
+        fetch_pvt: Optional[Callable] = None,  # (blk, tx, txid, ns, coll) -> bytes|None
+        is_eligible: Optional[Callable[[str, str], bool]] = None,
+        btl_policy: Optional[Callable[[str, str], int]] = None,
     ):
         self.channel_id = channel_id
         self.provider = provider or default_provider()
-        self.ledger = KVLedger(ledger_dir, channel_id)
+        self.ledger = KVLedger(ledger_dir, channel_id, btl_policy=btl_policy)
         self.verify_orderer_sig = verify_orderer_sig
+        self.transient_store = transient_store
+        self.fetch_pvt = fetch_pvt
+        self.is_eligible = is_eligible
 
         def get_state_metadata(ns: str, coll: str, key) -> Optional[bytes]:
             if coll:
@@ -60,13 +67,78 @@ class Channel:
 
     def store_block(self, block: common_pb2.Block) -> ValidationFlags:
         """The full commit pipeline for one delivered block. Envelopes are
-        parsed once and the result shared between validation and commit."""
+        parsed once and the result shared between validation and commit.
+
+        Private data is assembled coordinator-style (gossip/privdata/
+        coordinator.go:149-209): transient store first, then the peer
+        fetcher, with anything still missing recorded for the reconciler."""
         self._verify_block(block)
         parsed = [
             parse_transaction(i, d) for i, d in enumerate(block.data.data)
         ]
-        self.validator.validate(block, parsed=parsed)
-        return self.ledger.commit(block, rwsets=[p.rwset for p in parsed])
+        flags = self.validator.validate(block, parsed=parsed)
+        rwsets = [p.rwset for p in parsed]
+        pvt_data, missing = self._assemble_pvt_data(block, parsed, flags)
+        result = self.ledger.commit(
+            block, rwsets=rwsets, pvt_data=pvt_data, missing_pvt=missing
+        )
+        if self.transient_store is not None:
+            self.transient_store.purge_by_txids(
+                [p.tx_id for p in parsed if p.tx_id]
+            )
+        return result
+
+    def _assemble_pvt_data(self, block, parsed, flags):
+        """(tx_num, ns, coll) -> cleartext KVRWSet bytes for every valid tx
+        whose hashed rwset references a collection this peer is eligible
+        for; plus MissingEntry records for what could not be found."""
+        from fabric_tpu.ledger.pvtdatastore import MissingEntry
+
+        pvt_data = {}
+        missing = []
+        wanted = []  # (tx_num, tx_id, ns, coll)
+        arr = flags.asarray() if flags is not None else None
+        for p in parsed:
+            if arr is not None and arr[p.index] != 0:  # not VALID
+                continue
+            if p.rwset is None:
+                continue
+            for ns_rw in p.rwset.ns_rw_sets:
+                for coll in ns_rw.coll_hashed:
+                    if not coll.hashed_writes:
+                        continue
+                    if self.is_eligible is not None and not self.is_eligible(
+                        ns_rw.namespace, coll.collection_name
+                    ):
+                        continue
+                    wanted.append(
+                        (p.index, p.tx_id, ns_rw.namespace, coll.collection_name)
+                    )
+        from fabric_tpu.ledger.kvledger import pvt_data_matches_hashes
+
+        by_index = {p.index: p for p in parsed}
+        for tx_num, tx_id, ns, coll in wanted:
+            rwset = by_index[tx_num].rwset
+            data = None
+            if self.transient_store is not None and tx_id:
+                data = self.transient_store.get(tx_id, ns, coll)
+                if data is not None and not pvt_data_matches_hashes(
+                    rwset, ns, coll, data
+                ):
+                    data = None
+            if data is None and self.fetch_pvt is not None:
+                data = self.fetch_pvt(block.header.number, tx_num, tx_id, ns, coll)
+                # fetched from untrusted peers: a hash mismatch is treated
+                # as missing, never an error (coordinator.go fetch path)
+                if data is not None and not pvt_data_matches_hashes(
+                    rwset, ns, coll, data
+                ):
+                    data = None
+            if data is not None:
+                pvt_data[(tx_num, ns, coll)] = data
+            else:
+                missing.append(MissingEntry(tx_num, ns, coll))
+        return pvt_data, missing
 
     def _verify_block(self, block: common_pb2.Block) -> None:
         if block.header.number != self.ledger.height:

@@ -234,11 +234,14 @@ def build_manager(
         elif pol.type == P.IMPLICIT_META:
             meta = policies_pb2.ImplicitMetaPolicy()
             meta.ParseFromString(pol.value)
-            subs = []
-            for child in children.values():
-                sub, ok = child.get_policy(meta.sub_policy)
-                if ok:
-                    subs.append(sub)
+            # Every child counts toward the denominator; a child lacking
+            # the sub-policy contributes an always-deny RejectPolicy
+            # (implicitmeta.go counts all children, so MAJORITY/ALL must
+            # not shrink when a child omits the policy).
+            subs = [
+                child.get_policy(meta.sub_policy)[0]
+                for child in children.values()
+            ]
             policies[name] = ImplicitMetaPolicy(meta.rule, meta.sub_policy, subs)
         else:
             policies[name] = RejectPolicy(f"{name} (unsupported type {pol.type})")

@@ -1,0 +1,3 @@
+from fabric_tpu.scc.qscc import QSCC  # noqa: F401
+from fabric_tpu.scc.cscc import CSCC  # noqa: F401
+from fabric_tpu.scc.lscc import LSCC  # noqa: F401

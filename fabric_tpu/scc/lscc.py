@@ -1,0 +1,59 @@
+"""lscc — legacy lifecycle system chaincode (reference core/scc/lscc/
+lscc.go), serving pre-2.0 chaincode queries over the new lifecycle's
+definitions: getchaincodes, getid/getccdata, getdepspec stubs.
+
+Deployment itself goes through _lifecycle (fabric_tpu.lifecycle); lscc
+here is the query-compatibility surface the reference keeps for old SDKs.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Tuple
+
+from fabric_tpu.chaincode.shim import ChaincodeStub, Response, error_response, success
+from fabric_tpu.protos import peer_pb2
+
+GET_CHAINCODES = "getchaincodes"
+GET_CC_INFO = "getid"
+GET_CC_DATA = "getccdata"
+
+
+class LSCC:
+    def __init__(
+        self,
+        # () -> [(name, version)] of committed definitions on this channel
+        list_definitions: Callable[[], List[Tuple[str, str]]],
+    ):
+        self._list_definitions = list_definitions
+
+    def init(self, stub: ChaincodeStub) -> Response:
+        return success()
+
+    def invoke(self, stub: ChaincodeStub) -> Response:
+        args = stub.get_args()
+        if not args:
+            return error_response("Incorrect number of arguments, 0")
+        fname = args[0].decode().lower()
+        if fname in (GET_CHAINCODES, "getchaincodesinfo"):
+            resp = peer_pb2.ChaincodeQueryResponse()
+            for name, version in sorted(self._list_definitions()):
+                info = resp.chaincodes.add()
+                info.name = name
+                info.version = version
+                info.escc = "escc"
+                info.vscc = "vscc"
+            return success(resp.SerializeToString())
+        if fname in (GET_CC_INFO, GET_CC_DATA):
+            if len(args) < 3:
+                return error_response(
+                    f"Incorrect number of arguments, {len(args)}"
+                )
+            name = args[2].decode()
+            for n, version in self._list_definitions():
+                if n == name:
+                    info = peer_pb2.ChaincodeInfo()
+                    info.name = n
+                    info.version = version
+                    return success(info.SerializeToString())
+            return error_response(f"chaincode {name} not found")
+        return error_response(f"invalid function to lscc: {fname}")

@@ -1,0 +1,112 @@
+"""qscc — ledger query system chaincode (reference core/scc/qscc/query.go).
+
+Functions (args[0]=fn, args[1]=channelID, args[2]=param):
+GetChainInfo, GetBlockByNumber, GetBlockByHash, GetTransactionByID,
+GetBlockByTxID. Results are serialized protos, matching the reference's
+payloads (BlockchainInfo / Block / ProcessedTransaction).
+
+ACL checks run in the endorser via aclmgmt before dispatch; qscc itself
+re-checks nothing (the reference checks ACLs inside Invoke — here the
+shared aclmgmt hook covers both entry points).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from fabric_tpu.chaincode.shim import ChaincodeStub, Response, error_response, success
+from fabric_tpu.ledger.kvledger import KVLedger
+from fabric_tpu.protos import common_pb2, peer_pb2, protoutil
+
+GET_CHAIN_INFO = "GetChainInfo"
+GET_BLOCK_BY_NUMBER = "GetBlockByNumber"
+GET_BLOCK_BY_HASH = "GetBlockByHash"
+GET_TRANSACTION_BY_ID = "GetTransactionByID"
+GET_BLOCK_BY_TX_ID = "GetBlockByTxID"
+
+
+class QSCC:
+    def __init__(self, get_ledger: Callable[[str], Optional[KVLedger]]):
+        self._get_ledger = get_ledger
+
+    def init(self, stub: ChaincodeStub) -> Response:
+        return success()
+
+    def invoke(self, stub: ChaincodeStub) -> Response:
+        args = stub.get_args()
+        if len(args) < 2:
+            return error_response(
+                f"Incorrect number of arguments, {len(args)}"
+            )
+        fname = args[0].decode()
+        cid = args[1].decode()
+        ledger = self._get_ledger(cid)
+        if ledger is None:
+            return error_response(f"Invalid chain ID, {cid}")
+        if fname != GET_CHAIN_INFO and len(args) < 3:
+            return error_response(
+                f"missing 3rd argument for operation {fname}"
+            )
+        if fname == GET_CHAIN_INFO:
+            return self._chain_info(ledger)
+        if fname == GET_BLOCK_BY_NUMBER:
+            return self._block_by_number(ledger, args[2])
+        if fname == GET_BLOCK_BY_HASH:
+            return self._block_by_hash(ledger, args[2])
+        if fname == GET_TRANSACTION_BY_ID:
+            return self._tx_by_id(ledger, args[2])
+        if fname == GET_BLOCK_BY_TX_ID:
+            return self._block_by_txid(ledger, args[2])
+        return error_response(f"Requested function {fname} not found.")
+
+    def _chain_info(self, ledger: KVLedger) -> Response:
+        info = common_pb2.BlockchainInfo()
+        info.height = ledger.height
+        store = ledger.block_store
+        if ledger.height > 0:
+            info.currentBlockHash = store.last_block_hash
+            last = store.get_block_by_number(ledger.height - 1)
+            info.previousBlockHash = last.header.previous_hash
+        return success(info.SerializeToString())
+
+    def _block_by_number(self, ledger: KVLedger, arg: bytes) -> Response:
+        try:
+            number = int(arg.decode())
+        except ValueError:
+            return error_response(f"Failed to parse block number: {arg!r}")
+        block = ledger.block_store.get_block_by_number(number)
+        if block is None:
+            return error_response(f"Fail to get block number {number}")
+        return success(block.SerializeToString())
+
+    def _block_by_hash(self, ledger: KVLedger, block_hash: bytes) -> Response:
+        block = ledger.block_store.get_block_by_hash(block_hash)
+        if block is None:
+            return error_response("Fail to get block by hash")
+        return success(block.SerializeToString())
+
+    def _tx_by_id(self, ledger: KVLedger, arg: bytes) -> Response:
+        txid = arg.decode()
+        loc = ledger.block_store.get_tx_loc(txid)
+        if loc is None:
+            return error_response(
+                f"Failed to get transaction with id {txid}"
+            )
+        block_num, tx_num = loc
+        block = ledger.block_store.get_block_by_number(block_num)
+        env = protoutil.get_envelope_from_block_data(block.data.data[tx_num])
+        flags = block.metadata.metadata[common_pb2.TRANSACTIONS_FILTER]
+        pt = peer_pb2.ProcessedTransaction()
+        pt.transactionEnvelope.payload = env.payload
+        pt.transactionEnvelope.signature = env.signature
+        pt.validationCode = flags[tx_num] if tx_num < len(flags) else 0
+        return success(pt.SerializeToString())
+
+    def _block_by_txid(self, ledger: KVLedger, arg: bytes) -> Response:
+        loc = ledger.block_store.get_tx_loc(arg.decode())
+        if loc is None:
+            return error_response(
+                f"Failed to get transaction with id {arg.decode()}"
+            )
+        block = ledger.block_store.get_block_by_number(loc[0])
+        return success(block.SerializeToString())

@@ -1,0 +1,26 @@
+"""Persistent XLA compilation cache setup, shared by bench.py,
+tests/conftest.py and __graft_entry__.py.
+
+The driver environment imports jax at interpreter startup (an axon
+sitecustomize registers the TPU-tunnel PJRT plugin), so cache env vars
+set by our entry points latch too late — jax.config.update is read
+dynamically and is the only reliable path. First-ever compiles of the
+ECDSA verify kernel cost minutes (XLA:CPU and the axon remote-compile
+tunnel alike); cached runs are seconds, and the cache directory survives
+rounds on disk while staying out of git.
+"""
+
+from __future__ import annotations
+
+import os
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+CACHE_DIR = os.path.join(REPO_ROOT, ".jax_cache")
+
+
+def enable_compile_cache(cache_dir: str = CACHE_DIR) -> None:
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)

@@ -2,22 +2,31 @@
 
 Tests run on CPU with 8 virtual XLA devices so multi-chip sharding paths
 (jax.sharding.Mesh over 8 devices) are exercised without TPU hardware.
-Must run before jax is imported anywhere.
+
+The driver environment imports jax at interpreter startup (an axon
+sitecustomize registers the TPU-tunnel PJRT plugin and pins
+JAX_PLATFORMS=axon), so env vars set here are too late for jax's
+config defaults — everything must go through jax.config.update, which is
+read dynamically. XLA_FLAGS is still honored because no backend is
+initialized until the first jax use inside the tests.
+
+The big ECDSA verify kernel costs minutes of XLA:CPU compile time the
+first run; the persistent compilation cache in .jax_cache makes every
+later run fast. Keep that directory out of git but on disk.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-# Keep XLA compiles fast on the CPU test backend (see fabric_tpu.ops.bignum).
 os.environ.setdefault("FABRIC_TPU_CIOS_UNROLL", "0")
-# Persistent compile cache: the ECDSA kernel costs minutes to compile.
-os.environ.setdefault(
-    "JAX_COMPILATION_CACHE_DIR",
-    os.path.join(os.path.dirname(os.path.dirname(__file__)), ".jax_cache"),
-)
-os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2")
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+from fabric_tpu.utils.jaxcache import enable_compile_cache  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+enable_compile_cache()

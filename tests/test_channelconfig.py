@@ -115,6 +115,37 @@ def test_implicit_meta_majority_admins(bundle, orgs):
     pol.evaluate_signed_data([_signed_by(org1.admin), _signed_by(org2.admin)])
 
 
+def test_implicit_meta_counts_children_missing_subpolicy(orgs):
+    """Regression: a child group lacking the named sub-policy still counts
+    in the MAJORITY/ALL denominator as an always-deny (implicitmeta.go
+    counts every child)."""
+    from fabric_tpu.channelconfig.bundle import Bundle
+
+    org1, org2, oorg = orgs
+    profile = Profile(
+        application=ApplicationProfile(
+            organizations=[
+                OrganizationProfile("Org1MSP", org1.msp_config()),
+                OrganizationProfile("Org2MSP", org2.msp_config()),
+            ]
+        ),
+        orderer=OrdererProfile(
+            orderer_type="solo",
+            organizations=[OrganizationProfile("OrdererMSP", oorg.msp_config())],
+        ),
+    )
+    cfg = new_config(profile)
+    app = cfg.channel_group.groups["Application"]
+    # strip org2's Admins policy: MAJORITY Admins over 2 children must
+    # still require 2, making it unsatisfiable by org1 alone
+    del app.groups["Org2MSP"].policies["Admins"]
+    bundle = Bundle("testchannel", cfg)
+    pol, ok = bundle.policy_manager.get_policy("/Channel/Application/Admins")
+    assert ok
+    with pytest.raises(Exception):
+        pol.evaluate_signed_data([_signed_by(org1.admin)])
+
+
 def test_non_member_rejected(bundle):
     stranger = generate_org("org1")  # same MSP name, different CA
     pol, ok = bundle.policy_manager.get_policy("/Channel/Application/Writers")

@@ -1,0 +1,299 @@
+"""Endorser ProcessProposal + chaincode runtime + tx simulator
+(reference core/endorser/endorser.go, core/chaincode, txmgmt/txmgr)."""
+
+import hashlib
+
+import pytest
+
+from fabric_tpu.chaincode import ChaincodeStub, Response, success, error_response
+from fabric_tpu.chaincode.support import ChaincodeSupport, TxParams
+from fabric_tpu.crypto.bccsp import SoftwareProvider
+from fabric_tpu.endorser import create_proposal, create_signed_tx
+from fabric_tpu.endorser.endorser import Endorser, ProposalError, unpack_proposal
+from fabric_tpu.endorser.txbuilder import create_signed_proposal
+from fabric_tpu.ledger.kvledger import KVLedger
+from fabric_tpu.ledger.rwset import KVRead, KVWrite, Version
+from fabric_tpu.ledger.simulator import (
+    TxSimulator,
+    create_composite_key,
+    split_composite_key,
+)
+from fabric_tpu.ledger.statedb import UpdateBatch, VersionedDB
+from fabric_tpu.msp.cryptogen import generate_org
+from fabric_tpu.msp.identity import MSPManager
+from fabric_tpu.msp.signer import SigningIdentity
+from fabric_tpu.protos import peer_pb2, protoutil
+from fabric_tpu.validation.msgvalidation import parse_tx_rwset
+
+PROVIDER = SoftwareProvider()
+
+
+# ---------------- TxSimulator ----------------
+
+
+def seeded_db():
+    db = VersionedDB()
+    batch = UpdateBatch()
+    batch.put("mycc", "a", b"100", Version(1, 0))
+    batch.put("mycc", "b", b"200", Version(1, 1))
+    batch.put("mycc", "c", b"300", Version(2, 0))
+    db.apply_updates(batch)
+    return db
+
+
+def test_simulator_reads_record_versions():
+    sim = TxSimulator(seeded_db())
+    assert sim.get_state("mycc", "a") == b"100"
+    assert sim.get_state("mycc", "missing") is None
+    res = sim.get_tx_simulation_results()
+    ns = res.rwset.ns_rw_sets[0]
+    assert ns.reads == (
+        KVRead("a", Version(1, 0)),
+        KVRead("missing", None),
+    )
+
+
+def test_simulator_writes_last_wins_and_no_read_your_writes():
+    sim = TxSimulator(seeded_db())
+    sim.set_state("mycc", "a", b"1")
+    sim.set_state("mycc", "a", b"2")
+    # Reference lockbased simulator: reads see committed state only.
+    assert sim.get_state("mycc", "a") == b"100"
+    sim.delete_state("mycc", "b")
+    res = sim.get_tx_simulation_results()
+    ns = res.rwset.ns_rw_sets[0]
+    assert ns.writes == (
+        KVWrite("a", False, b"2"),
+        KVWrite("b", True, b""),
+    )
+
+
+def test_simulator_range_query_records_phantom_info():
+    sim = TxSimulator(seeded_db())
+    results = list(sim.get_state_range_scan_iterator("mycc", "a", "c"))
+    assert results == [("a", b"100"), ("b", b"200")]
+    res = sim.get_tx_simulation_results()
+    rq = res.rwset.ns_rw_sets[0].range_queries[0]
+    assert (rq.start_key, rq.end_key, rq.itr_exhausted) == ("a", "c", True)
+    assert [r.key for r in rq.raw_reads] == ["a", "b"]
+
+
+def test_simulator_private_data_hashes():
+    sim = TxSimulator(seeded_db())
+    sim.set_private_data("mycc", "secret", "k1", b"top")
+    res = sim.get_tx_simulation_results()
+    coll = res.rwset.ns_rw_sets[0].coll_hashed[0]
+    assert coll.collection_name == "secret"
+    w = coll.hashed_writes[0]
+    assert w.key_hash == hashlib.sha256(b"k1").digest()
+    assert w.value_hash == hashlib.sha256(b"top").digest()
+    assert res.pvt_writes[("mycc", "secret")][0].value == b"top"
+    assert res.pvt_rwset_bytes()  # serializes
+
+
+def test_simulator_rwset_roundtrips_through_proto():
+    sim = TxSimulator(seeded_db())
+    sim.get_state("mycc", "a")
+    sim.set_state("mycc", "z", b"9")
+    res = sim.get_tx_simulation_results()
+    assert parse_tx_rwset(res.public_bytes) == res.rwset
+
+
+def test_composite_keys_roundtrip():
+    key = create_composite_key("Color~Name", ["red", "car1"])
+    typ, attrs = split_composite_key(key)
+    assert (typ, attrs) == ("Color~Name", ["red", "car1"])
+
+
+# ---------------- chaincode runtime ----------------
+
+
+class AssetCC:
+    """Minimal KV chaincode used across the tests."""
+
+    def init(self, stub):
+        return success()
+
+    def invoke(self, stub: ChaincodeStub) -> Response:
+        fn, params = stub.get_function_and_parameters()
+        if fn == "put":
+            stub.put_state(params[0], params[1].encode())
+            stub.set_event("put", params[0].encode())
+            return success(b"ok")
+        if fn == "get":
+            v = stub.get_state(params[0])
+            return success(v or b"")
+        if fn == "putpvt":
+            stub.put_private_data("secret", params[0], params[1].encode())
+            return success()
+        if fn == "call":
+            return stub.invoke_chaincode("othercc", [b"get", params[0].encode()])
+        if fn == "boom":
+            raise RuntimeError("chaincode panic")
+        return error_response(f"unknown function {fn}")
+
+
+class OtherCC:
+    def init(self, stub):
+        return success()
+
+    def invoke(self, stub):
+        fn, params = stub.get_function_and_parameters()
+        v = stub.get_state(params[0])
+        return success(v or b"")
+
+
+def make_support():
+    support = ChaincodeSupport()
+    support.register("mycc", AssetCC())
+    support.register("othercc", OtherCC())
+    return support
+
+
+def test_support_execute_and_event():
+    support = make_support()
+    sim = TxSimulator(seeded_db(), tx_id="tx1")
+    resp, event = support.execute(
+        TxParams("ch", "tx1", sim), "mycc", [b"put", b"k", b"v"]
+    )
+    assert resp.status == 200
+    assert event.event_name == "put" and event.tx_id == "tx1"
+    res = sim.get_tx_simulation_results()
+    assert KVWrite("k", False, b"v") in res.rwset.ns_rw_sets[0].writes
+
+
+def test_support_chaincode_exception_becomes_error_response():
+    support = make_support()
+    sim = TxSimulator(seeded_db(), tx_id="tx1")
+    resp, _ = support.execute(TxParams("ch", "tx1", sim), "mycc", [b"boom"])
+    assert resp.status == 500 and "panic" in resp.message
+
+
+def test_cc2cc_same_channel_shares_rwset():
+    support = make_support()
+    db = seeded_db()
+    batch = UpdateBatch()
+    batch.put("othercc", "a", b"other-a", Version(3, 0))
+    db.apply_updates(batch)
+    sim = TxSimulator(db, tx_id="tx1")
+    # the callee reads from ITS OWN namespace (handler.go cc2cc semantics)
+    resp, _ = support.execute(TxParams("ch", "tx1", sim), "mycc", [b"call", b"a"])
+    assert resp.status == 200 and resp.payload == b"other-a"
+    res = sim.get_tx_simulation_results()
+    # the callee's read is recorded under its own namespace
+    ns_names = [ns.namespace for ns in res.rwset.ns_rw_sets]
+    assert "othercc" in ns_names
+
+
+# ---------------- Endorser.ProcessProposal ----------------
+
+
+@pytest.fixture(scope="module")
+def org():
+    return generate_org("org1.example.com", "Org1MSP")
+
+
+@pytest.fixture
+def endorser_net(org, tmp_path):
+    msp_mgr = MSPManager([org.msp(provider=PROVIDER)])
+    ledger = KVLedger(str(tmp_path / "ledger"), "ch")
+    support = make_support()
+    peer_signer = SigningIdentity(org.peers[0], PROVIDER)
+    endorser = Endorser(
+        peer_signer,
+        msp_mgr,
+        support,
+        get_ledger=lambda ch: ledger if ch == "ch" else None,
+    )
+    client = SigningIdentity(org.users[0], PROVIDER)
+    return endorser, client, ledger
+
+
+def test_process_proposal_happy_path(endorser_net):
+    endorser, client, _ = endorser_net
+    bundle = create_proposal(client, "ch", "mycc", [b"put", b"k1", b"v1"])
+    signed = create_signed_proposal(bundle, client)
+    resp = endorser.process_proposal(signed)
+    assert resp.response.status == 200, resp.response.message
+    assert resp.endorsement.signature
+    # the endorsement must verify and the rwset must contain the write
+    prp = protoutil.unmarshal(peer_pb2.ProposalResponsePayload, resp.payload)
+    action = protoutil.unmarshal(peer_pb2.ChaincodeAction, prp.extension)
+    rwset = parse_tx_rwset(action.results)
+    assert KVWrite("k1", False, b"v1") in rwset.ns_rw_sets[0].writes
+    # signable by create_signed_tx (client assembles the envelope)
+    env = create_signed_tx(bundle, client, [resp])
+    assert env.signature
+
+
+def test_process_proposal_rejects_bad_signature(endorser_net, org):
+    endorser, client, _ = endorser_net
+    bundle = create_proposal(client, "ch", "mycc", [b"get", b"a"])
+    signed = create_signed_proposal(bundle, client)
+    signed.signature = signed.signature[:-1] + bytes(
+        [signed.signature[-1] ^ 1]
+    )
+    resp = endorser.process_proposal(signed)
+    assert resp.response.status == 500
+    assert "access denied" in resp.response.message
+
+
+def test_process_proposal_rejects_wrong_txid(endorser_net):
+    endorser, client, _ = endorser_net
+    bundle = create_proposal(client, "ch", "mycc", [b"get", b"a"])
+    chdr = protoutil.unmarshal(
+        __import__(
+            "fabric_tpu.protos.common_pb2", fromlist=["ChannelHeader"]
+        ).ChannelHeader,
+        bundle.channel_header,
+    )
+    chdr.tx_id = "beef"
+    bundle.channel_header = chdr.SerializeToString()
+    signed = create_signed_proposal(bundle, client)
+    resp = endorser.process_proposal(signed)
+    assert resp.response.status == 500
+    assert "txid" in resp.response.message
+
+
+def test_process_proposal_unknown_channel(endorser_net):
+    endorser, client, _ = endorser_net
+    bundle = create_proposal(client, "nochannel", "mycc", [b"get", b"a"])
+    signed = create_signed_proposal(bundle, client)
+    resp = endorser.process_proposal(signed)
+    assert resp.response.status == 500
+    assert "not found" in resp.response.message
+
+
+def test_process_proposal_chaincode_error_unsigned(endorser_net):
+    endorser, client, _ = endorser_net
+    bundle = create_proposal(client, "ch", "mycc", [b"nope"])
+    signed = create_signed_proposal(bundle, client)
+    resp = endorser.process_proposal(signed)
+    assert resp.response.status == 500
+    assert not resp.endorsement.signature
+
+
+def test_process_proposal_malformed_bytes_returns_500(endorser_net):
+    endorser, _, _ = endorser_net
+    signed = peer_pb2.SignedProposal()
+    signed.proposal_bytes = b"\xff\xff\xff garbage"
+    resp = endorser.process_proposal(signed)
+    assert resp.response.status == 500
+    assert "unmarshalling" in resp.response.message
+
+
+def test_unpack_proposal_rejects_missing_chaincode(endorser_net):
+    _, client, _ = endorser_net
+    bundle = create_proposal(client, "ch", "mycc", [b"x"])
+    signed = create_signed_proposal(bundle, client)
+    prop = protoutil.unmarshal(peer_pb2.Proposal, signed.proposal_bytes)
+    from fabric_tpu.protos import common_pb2
+
+    header = protoutil.unmarshal(common_pb2.Header, prop.header)
+    chdr = protoutil.unmarshal(common_pb2.ChannelHeader, header.channel_header)
+    chdr.extension = peer_pb2.ChaincodeHeaderExtension().SerializeToString()
+    header.channel_header = chdr.SerializeToString()
+    prop.header = header.SerializeToString()
+    signed.proposal_bytes = prop.SerializeToString()
+    with pytest.raises(ProposalError):
+        unpack_proposal(signed)

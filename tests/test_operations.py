@@ -1,0 +1,186 @@
+"""Operations plane: metrics SPI, flogging level specs, ops HTTP server
+(reference core/operations/system.go, common/flogging, common/metrics)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from fabric_tpu.common import flogging
+from fabric_tpu.common.metrics import (
+    CounterOpts,
+    DisabledProvider,
+    GaugeOpts,
+    HistogramOpts,
+    PrometheusProvider,
+    StatsdProvider,
+)
+from fabric_tpu.operations import Options, System
+
+
+# ---------------- flogging ----------------
+
+
+def test_flogging_spec_roundtrip():
+    flogging.activate_spec("gossip=warn:ledger.state=debug:info")
+    assert flogging.spec() == "gossip=warn:ledger.state=debug:info"
+    flogging.reset()
+    assert flogging.spec() == "info"
+
+
+def test_flogging_levels_apply_to_subtrees():
+    flogging.activate_spec("gossip=error:debug")
+    import logging
+
+    assert flogging.must_get_logger("gossip").level == logging.ERROR
+    assert flogging.must_get_logger("gossip.state").level == logging.ERROR
+    assert flogging.must_get_logger("ledger").level == logging.DEBUG
+    flogging.reset()
+
+
+def test_flogging_invalid_spec_rejected():
+    with pytest.raises(flogging.InvalidSpecError):
+        flogging.activate_spec("gossip=notalevel")
+    with pytest.raises(flogging.InvalidSpecError):
+        flogging.activate_spec("=debug")
+
+
+# ---------------- metrics ----------------
+
+
+def test_prometheus_counter_and_gauge():
+    p = PrometheusProvider()
+    c = p.new_counter(
+        CounterOpts(
+            namespace="ledger",
+            name="transaction_count",
+            help="tx count",
+            label_names=("channel", "validation_code"),
+        )
+    )
+    c.with_labels("channel", "ch1", "validation_code", "VALID").add()
+    c.with_labels("channel", "ch1", "validation_code", "VALID").add(2)
+    c.with_labels("channel", "ch1", "validation_code", "MVCC_READ_CONFLICT").add()
+    g = p.new_gauge(GaugeOpts(namespace="gossip", name="peers_known"))
+    g.set(4)
+    text = p.gather()
+    assert (
+        'ledger_transaction_count{channel="ch1",validation_code="VALID"} 3'
+        in text
+    )
+    assert "# TYPE ledger_transaction_count counter" in text
+    assert "gossip_peers_known 4" in text
+
+
+def test_prometheus_histogram_buckets():
+    p = PrometheusProvider()
+    h = p.new_histogram(
+        HistogramOpts(
+            namespace="ledger",
+            name="block_processing_time",
+            buckets=(0.1, 1.0, 10.0),
+        )
+    )
+    for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+        h.observe(v)
+    text = p.gather()
+    assert 'ledger_block_processing_time_bucket{le="0.1"} 1' in text
+    assert 'ledger_block_processing_time_bucket{le="1"} 3' in text
+    assert 'ledger_block_processing_time_bucket{le="10"} 4' in text
+    assert 'ledger_block_processing_time_bucket{le="+Inf"} 5' in text
+    assert "ledger_block_processing_time_count 5" in text
+
+
+def test_prometheus_rejects_kind_mismatch():
+    p = PrometheusProvider()
+    p.new_counter(CounterOpts(name="x"))
+    with pytest.raises(ValueError):
+        p.new_gauge(GaugeOpts(name="x"))
+
+
+def test_statsd_provider_formats_buckets():
+    lines = []
+    p = StatsdProvider(lines.append, prefix="peer0")
+    c = p.new_counter(
+        CounterOpts(
+            namespace="ledger",
+            name="tx_count",
+            label_names=("channel",),
+            statsd_format="%{#fqname}.%{channel}",
+        )
+    )
+    c.with_labels("channel", "ch1").add()
+    assert lines == ["peer0.ledger.tx.count.ch1:1|c"]
+
+
+def test_disabled_provider_noops():
+    p = DisabledProvider()
+    p.new_counter(CounterOpts(name="c")).add()
+    p.new_gauge(GaugeOpts(name="g")).set(1)
+    p.new_histogram(HistogramOpts(name="h")).observe(1)
+
+
+# ---------------- operations server ----------------
+
+
+@pytest.fixture
+def ops_system():
+    system = System(Options(listen_address="127.0.0.1:0"))
+    system.start()
+    yield system
+    system.stop()
+    flogging.reset()
+
+
+def _get(system, path):
+    return urllib.request.urlopen(f"http://{system.addr}{path}")
+
+
+def test_ops_version_and_metrics(ops_system):
+    with _get(ops_system, "/version") as resp:
+        assert json.load(resp)["Version"]
+    ops_system.provider.new_counter(CounterOpts(name="up")).add()
+    with _get(ops_system, "/metrics") as resp:
+        assert b"up 1" in resp.read()
+
+
+def test_ops_healthz(ops_system):
+    with _get(ops_system, "/healthz") as resp:
+        assert json.load(resp)["status"] == "OK"
+
+    def failing():
+        raise RuntimeError("couchdb down")
+
+    ops_system.register_checker("statedb", failing)
+    try:
+        _get(ops_system, "/healthz")
+        assert False, "expected 503"
+    except urllib.error.HTTPError as err:
+        payload = json.load(err)
+        assert payload["failed_checks"] == [
+            {"component": "statedb", "reason": "couchdb down"}
+        ]
+
+
+def test_ops_logspec_get_and_put(ops_system):
+    req = urllib.request.Request(
+        f"http://{ops_system.addr}/logspec",
+        data=json.dumps({"spec": "gossip=debug:warn"}).encode(),
+        method="PUT",
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req) as resp:
+        assert resp.status == 204
+    with _get(ops_system, "/logspec") as resp:
+        assert json.load(resp)["spec"] == "gossip=debug:warn"
+
+    bad = urllib.request.Request(
+        f"http://{ops_system.addr}/logspec",
+        data=json.dumps({"spec": "nope=nope"}).encode(),
+        method="PUT",
+    )
+    try:
+        urllib.request.urlopen(bad)
+        assert False, "expected 400"
+    except urllib.error.HTTPError as err:
+        assert err.code == 400

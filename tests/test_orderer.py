@@ -12,6 +12,7 @@ from fabric_tpu.channelconfig import (
     genesis_block,
 )
 from fabric_tpu.channelconfig import encoder
+from fabric_tpu.channelconfig.configtx import sign_config_update
 from fabric_tpu.msp.cryptogen import generate_org
 from fabric_tpu.msp.signer import SigningIdentity
 from fabric_tpu.orderer.broadcast import BroadcastHandler
@@ -196,6 +197,9 @@ def test_system_channel_creates_channel(tmp_path, world):
     )
     cue = configtx_pb2.ConfigUpdateEnvelope()
     cue.config_update = update.SerializeToString()
+    # The consortium's ChannelCreationPolicy (ANY Admins) is enforced over
+    # the ConfigUpdateEnvelope signatures — sign as an org admin.
+    sign_config_update(cue, SigningIdentity(org1.admin))
 
     payload = common_pb2.Payload()
     chdr = protoutil.make_channel_header(common_pb2.CONFIG_UPDATE, "appchannel")
@@ -223,3 +227,59 @@ def test_system_channel_creates_channel(tmp_path, world):
         make_envelope(SigningIdentity(org1.peers[0]), "appchannel", b"tx")
     )
     assert status == common_pb2.SUCCESS, info
+
+
+def test_channel_creation_requires_creation_policy_signature(tmp_path, world):
+    """Regression: an UNSIGNED config update must not create a channel —
+    the consortium ChannelCreationPolicy (ANY Admins) is enforced."""
+    org1, org2, oorg, profile = world
+    sys_profile = Profile(
+        orderer=OrdererProfile(
+            orderer_type="solo",
+            organizations=[OrganizationProfile("ordMSP", oorg.msp_config())],
+        ),
+        consortiums={
+            "SampleConsortium": [
+                OrganizationProfile("org1MSP", org1.msp_config()),
+                OrganizationProfile("org2MSP", org2.msp_config()),
+            ]
+        },
+    )
+    reg = Registrar(
+        str(tmp_path),
+        signer=SigningIdentity(oorg.peers[0]),
+        system_channel_id="syschannel",
+    )
+    reg.join_channel(genesis_block(sys_profile, "syschannel"))
+    h = BroadcastHandler(reg)
+
+    update = encoder.channel_creation_config_update(
+        "rogue",
+        "SampleConsortium",
+        ApplicationProfile(
+            organizations=[OrganizationProfile("org1MSP", org1.msp_config())]
+        ),
+    )
+    cue = configtx_pb2.ConfigUpdateEnvelope()
+    cue.config_update = update.SerializeToString()
+    # no sign_config_update: zero ConfigSignatures
+    payload = common_pb2.Payload()
+    chdr = protoutil.make_channel_header(common_pb2.CONFIG_UPDATE, "rogue")
+    payload.header.channel_header = chdr.SerializeToString()
+    payload.header.signature_header = (
+        common_pb2.SignatureHeader().SerializeToString()
+    )
+    payload.data = cue.SerializeToString()
+    env = common_pb2.Envelope()
+    env.payload = payload.SerializeToString()
+
+    status, info = h.process_message(env)
+    assert status != common_pb2.SUCCESS
+    assert "rogue" not in reg.channel_list()
+    # non-admin signature (a peer) is also insufficient for ANY Admins
+    sign_config_update(cue, SigningIdentity(org1.peers[0]))
+    payload.data = cue.SerializeToString()
+    env.payload = payload.SerializeToString()
+    status, info = h.process_message(env)
+    assert status != common_pb2.SUCCESS
+    assert "rogue" not in reg.channel_list()

@@ -1,0 +1,546 @@
+"""Private data: pvtdata store (BTL expiry, missing-data, backfill),
+collection configs/access, and the ledger commit integration with
+hash-checked cleartext writes (reference core/ledger/pvtdatastorage,
+core/common/privdata, gossip/privdata/coordinator.go)."""
+
+import hashlib
+
+import pytest
+
+from fabric_tpu.crypto.bccsp import SoftwareProvider
+from fabric_tpu.ledger import rwset as rw
+from fabric_tpu.ledger.collections import (
+    CollectionStore,
+    NoSuchCollectionError,
+    build_collection_config_package,
+)
+from fabric_tpu.ledger.kvledger import KVLedger
+from fabric_tpu.ledger.pvtdatastore import MissingEntry, PvtDataStore, PvtEntry
+from fabric_tpu.ledger.simulator import TxSimulator
+from fabric_tpu.msp.cryptogen import generate_org
+from fabric_tpu.protos import common_pb2, kv_rwset_pb2, protoutil
+from fabric_tpu.validation.txflags import TxValidationCode, ValidationFlags
+
+PROVIDER = SoftwareProvider()
+
+
+def kvrwset_bytes(writes):
+    kv = kv_rwset_pb2.KVRWSet()
+    for key, value in writes:
+        w = kv.writes.add()
+        w.key = key
+        if value is None:
+            w.is_delete = True
+        else:
+            w.value = value
+    return kv.SerializeToString()
+
+
+# ---------------- PvtDataStore ----------------
+
+
+def test_pvtdata_store_roundtrip_and_recovery(tmp_path):
+    path = str(tmp_path / "pvt")
+    store = PvtDataStore(path)
+    e0 = PvtEntry(0, "mycc", "secret", kvrwset_bytes([("k", b"v")]))
+    store.commit(0, [e0], [MissingEntry(1, "mycc", "other")])
+    store.commit(1, [])
+    assert store.get_pvt_data(0, 0) == [e0]
+    assert store.last_committed_block == 1
+    store.close()
+
+    again = PvtDataStore(path)
+    assert again.get_pvt_data(0, 0) == [e0]
+    assert again.get_missing_pvt_data() == {
+        0: [MissingEntry(1, "mycc", "other")]
+    }
+    assert again.last_committed_block == 1
+
+
+def test_pvtdata_store_rejects_out_of_order(tmp_path):
+    store = PvtDataStore(str(tmp_path / "pvt"))
+    store.commit(0, [])
+    with pytest.raises(ValueError):
+        store.commit(0, [])
+
+
+def test_pvtdata_store_btl_expiry(tmp_path):
+    store = PvtDataStore(
+        str(tmp_path / "pvt"), btl_policy=lambda ns, coll: 2
+    )
+    e = PvtEntry(0, "mycc", "secret", kvrwset_bytes([("k", b"v")]))
+    store.commit(0, [e])
+    store.commit(1, [])
+    store.commit(2, [])
+    assert store.get_pvt_data(0, 0) == [e]  # 0 + 2 >= 2: still alive
+    store.commit(3, [])  # 0 + 2 < 3: expired
+    assert store.get_pvt_data(0, 0) == []
+
+
+def test_pvtdata_store_backfill_clears_missing(tmp_path):
+    store = PvtDataStore(str(tmp_path / "pvt"))
+    store.commit(0, [], [MissingEntry(0, "mycc", "secret")])
+    assert 0 in store.get_missing_pvt_data()
+    late = PvtEntry(0, "mycc", "secret", kvrwset_bytes([("k", b"v")]))
+    store.commit_pvt_data_of_old_blocks(0, [late])
+    assert store.get_missing_pvt_data() == {}
+    assert store.get_pvt_data(0, 0) == [late]
+
+
+# ---------------- collections ----------------
+
+
+@pytest.fixture(scope="module")
+def orgs():
+    return (
+        generate_org("org1.example.com", "Org1MSP"),
+        generate_org("org2.example.com", "Org2MSP"),
+    )
+
+
+def test_collection_store_and_membership(orgs):
+    org1, org2 = orgs
+    pkg = build_collection_config_package(
+        [
+            {
+                "name": "secret",
+                "policy": "OR('Org1MSP.member')",
+                "block_to_live": 5,
+                "member_only_read": True,
+            }
+        ]
+    )
+    store = CollectionStore(
+        lambda ns: pkg.SerializeToString() if ns == "mycc" else b""
+    )
+    access = store.collection("mycc", "secret")
+    assert access.block_to_live == 5
+    assert access.member_only_read
+
+    msp1 = org1.msp(provider=PROVIDER)
+    msp2 = org2.msp(provider=PROVIDER)
+    id1 = msp1.deserialize_identity(
+        protoutil.serialize_identity("Org1MSP", org1.peers[0].cert_pem)
+    )
+    id2 = msp2.deserialize_identity(
+        protoutil.serialize_identity("Org2MSP", org2.peers[0].cert_pem)
+    )
+    assert access.is_member(id1, msp1)
+    assert not access.is_member(id2, msp2)
+
+    assert store.has_collection("mycc", "secret")
+    assert not store.has_collection("mycc", "nope")
+    with pytest.raises(NoSuchCollectionError):
+        store.collection("othercc", "secret")
+    assert store.btl_policy()("mycc", "secret") == 5
+    assert store.btl_policy()("mycc", "unknown") == 0
+
+
+# ---------------- ledger commit integration ----------------
+
+
+def make_block_with_pvt(number, prev_hash, tx_rwset_bytes):
+    """A block with one fake envelope whose rwset the test injects via the
+    rwsets= parameter of commit (parse path is covered by e2e tests)."""
+    block = protoutil.new_block(number, prev_hash)
+    block.data.data.append(b"\x00")  # placeholder envelope
+    protoutil.seal_block(block)
+    protoutil.init_block_metadata(block)
+    flags = ValidationFlags(1, TxValidationCode.VALID)
+    block.metadata.metadata[common_pb2.TRANSACTIONS_FILTER] = flags.tobytes()
+    return block
+
+
+def pvt_rwset_for(key, value):
+    kh = hashlib.sha256(key.encode()).digest()
+    vh = hashlib.sha256(value).digest()
+    rwset = rw.TxRwSet(
+        (
+            rw.NsRwSet(
+                "mycc",
+                coll_hashed=(
+                    rw.CollHashedRwSet(
+                        "secret",
+                        hashed_writes=(rw.KVWriteHash(kh, False, vh),),
+                    ),
+                ),
+            ),
+        )
+    )
+    return rwset
+
+
+def test_ledger_commit_applies_hash_checked_pvt_data(tmp_path):
+    ledger = KVLedger(str(tmp_path), "ch")
+    rwset = pvt_rwset_for("k1", b"top-secret")
+    block = make_block_with_pvt(0, b"", rwset)
+    ledger.commit(
+        block,
+        rwsets=[rwset],
+        pvt_data={(0, "mycc", "secret"): kvrwset_bytes([("k1", b"top-secret")])},
+    )
+    assert ledger.get_private_data("mycc", "secret", "k1") == b"top-secret"
+    # hashed state is on-block as usual
+    kh = hashlib.sha256(b"k1").digest()
+    assert ledger.state_db.get_hashed_state("mycc", "secret", kh) is not None
+    # pvt store has it
+    assert len(ledger.pvt_store.get_pvt_data(0, 0)) == 1
+
+
+def test_ledger_commit_rejects_hash_mismatch(tmp_path):
+    ledger = KVLedger(str(tmp_path), "ch")
+    rwset = pvt_rwset_for("k1", b"real-value")
+    block = make_block_with_pvt(0, b"", rwset)
+    with pytest.raises(ValueError):
+        ledger.commit(
+            block,
+            rwsets=[rwset],
+            pvt_data={(0, "mycc", "secret"): kvrwset_bytes([("k1", b"forged")])},
+        )
+
+
+def test_ledger_recovery_replays_pvt_state(tmp_path):
+    ledger = KVLedger(str(tmp_path), "ch")
+    rwset = pvt_rwset_for("k1", b"persist-me")
+    block = make_block_with_pvt(0, b"", rwset)
+    ledger.commit(
+        block,
+        rwsets=[rwset],
+        pvt_data={(0, "mycc", "secret"): kvrwset_bytes([("k1", b"persist-me")])},
+    )
+    ledger.block_store.close()
+    ledger.pvt_store.close()
+
+    # reopen: pvt cleartext state must be rebuilt from the pvt store.
+    # NB the placeholder envelope is unparsable, so recovery sees rwset
+    # None for the tx — commit with real envelopes is covered in e2e; here
+    # we assert the pvt store itself survives.
+    again = PvtDataStore(str(tmp_path / "ch.pvtdata"))
+    assert len(again.get_pvt_data(0, 0)) == 1
+
+
+def test_channel_pipeline_with_transient_store(tmp_path, orgs):
+    """End-to-end: endorse a tx with private data, stage the cleartext in
+    the transient store, order, and watch the peer channel assemble +
+    commit it (coordinator.go StoreBlock flow)."""
+    from fabric_tpu.endorser import (
+        create_proposal,
+        create_signed_tx,
+        endorse_proposal,
+    )
+    from fabric_tpu.gossip.coordinator import TransientStore
+    from fabric_tpu.ledger.rwset_proto import serialize_tx_rwset
+    from fabric_tpu.msp.identity import MSPManager
+    from fabric_tpu.msp.signer import SigningIdentity
+    from fabric_tpu.orderer import SoloChain
+    from fabric_tpu.orderer.blockcutter import BatchConfig
+    from fabric_tpu.peer import Channel
+    from fabric_tpu.policy import from_dsl
+    from fabric_tpu.validation.validator import (
+        ChaincodeDefinition,
+        ChaincodeRegistry,
+    )
+
+    org1, _ = orgs
+    mgr = MSPManager([org1.msp(provider=PROVIDER)])
+    registry = ChaincodeRegistry(
+        [ChaincodeDefinition("mycc", from_dsl("OR('Org1MSP.member')"))]
+    )
+    transient = TransientStore()
+    peer_channel = Channel(
+        "pvtchannel",
+        str(tmp_path / "peer"),
+        mgr,
+        registry,
+        PROVIDER,
+        transient_store=transient,
+        is_eligible=lambda ns, coll: True,
+    )
+    client = SigningIdentity(org1.users[0], PROVIDER)
+    peer = SigningIdentity(org1.peers[0], PROVIDER)
+
+    key, value = "pk", b"private-value"
+    kh = hashlib.sha256(key.encode()).digest()
+    vh = hashlib.sha256(value).digest()
+    rwset = rw.TxRwSet(
+        (
+            rw.NsRwSet(
+                "mycc",
+                writes=(rw.KVWrite("pub", False, b"public"),),
+                coll_hashed=(
+                    rw.CollHashedRwSet(
+                        "secret", hashed_writes=(rw.KVWriteHash(kh, False, vh),)
+                    ),
+                ),
+            ),
+        )
+    )
+    bundle = create_proposal(client, "pvtchannel", "mycc", [b"putpvt", b"pk"])
+    env = create_signed_tx(
+        bundle,
+        client,
+        [endorse_proposal(bundle, peer, serialize_tx_rwset(rwset))],
+    )
+    # endorser distributed the cleartext to the transient store
+    transient.persist(bundle.tx_id, "mycc", "secret", kvrwset_bytes([(key, value)]))
+
+    blocks = []
+    chain = SoloChain(
+        "pvtchannel",
+        signer=peer,
+        batch_config=BatchConfig(max_message_count=1),
+        deliver=blocks.append,
+    )
+    chain.order(env)
+    flags = peer_channel.store_block(blocks[0])
+    assert flags.is_valid(0)
+    assert (
+        peer_channel.ledger.get_private_data("mycc", "secret", "pk")
+        == value
+    )
+    assert peer_channel.ledger.get_state("mycc", "pub") == b"public"
+    # transient store purged post-commit
+    assert transient.get(bundle.tx_id, "mycc", "secret") is None
+    # nothing missing
+    assert peer_channel.ledger.pvt_store.get_missing_pvt_data() == {}
+
+
+def test_channel_pipeline_records_missing_pvt(tmp_path, orgs):
+    """Without transient data or a fetcher, the commit records the gap for
+    the reconciler instead of failing."""
+    from fabric_tpu.endorser import (
+        create_proposal,
+        create_signed_tx,
+        endorse_proposal,
+    )
+    from fabric_tpu.ledger.rwset_proto import serialize_tx_rwset
+    from fabric_tpu.msp.identity import MSPManager
+    from fabric_tpu.msp.signer import SigningIdentity
+    from fabric_tpu.orderer import SoloChain
+    from fabric_tpu.orderer.blockcutter import BatchConfig
+    from fabric_tpu.peer import Channel
+    from fabric_tpu.policy import from_dsl
+    from fabric_tpu.validation.validator import (
+        ChaincodeDefinition,
+        ChaincodeRegistry,
+    )
+
+    org1, _ = orgs
+    mgr = MSPManager([org1.msp(provider=PROVIDER)])
+    registry = ChaincodeRegistry(
+        [ChaincodeDefinition("mycc", from_dsl("OR('Org1MSP.member')"))]
+    )
+    peer_channel = Channel(
+        "pvtchannel2",
+        str(tmp_path / "peer"),
+        mgr,
+        registry,
+        PROVIDER,
+        is_eligible=lambda ns, coll: True,
+    )
+    client = SigningIdentity(org1.users[0], PROVIDER)
+    peer = SigningIdentity(org1.peers[0], PROVIDER)
+    kh = hashlib.sha256(b"k").digest()
+    rwset = rw.TxRwSet(
+        (
+            rw.NsRwSet(
+                "mycc",
+                coll_hashed=(
+                    rw.CollHashedRwSet(
+                        "secret",
+                        hashed_writes=(
+                            rw.KVWriteHash(kh, False, hashlib.sha256(b"v").digest()),
+                        ),
+                    ),
+                ),
+            ),
+        )
+    )
+    bundle = create_proposal(client, "pvtchannel2", "mycc", [b"x"])
+    env = create_signed_tx(
+        bundle,
+        client,
+        [endorse_proposal(bundle, peer, serialize_tx_rwset(rwset))],
+    )
+    blocks = []
+    chain = SoloChain(
+        "pvtchannel2",
+        signer=peer,
+        batch_config=BatchConfig(max_message_count=1),
+        deliver=blocks.append,
+    )
+    chain.order(env)
+    flags = peer_channel.store_block(blocks[0])
+    assert flags.is_valid(0)
+    missing = peer_channel.ledger.pvt_store.get_missing_pvt_data()
+    assert list(missing) == [0]
+    assert missing[0][0].collection == "secret"
+    # hashed write still applied (the on-block part commits regardless)
+    assert (
+        peer_channel.ledger.state_db.get_hashed_state("mycc", "secret", kh)
+        is not None
+    )
+
+
+def test_commit_survives_crash_between_pvt_and_block(tmp_path):
+    """Regression: pvtdata store commit precedes the block append; a crash
+    in between must not brick the channel on redelivery."""
+    ledger = KVLedger(str(tmp_path), "ch")
+    rwset = pvt_rwset_for("k1", b"v1")
+    block = make_block_with_pvt(0, b"", rwset)
+    pvt = {(0, "mycc", "secret"): kvrwset_bytes([("k1", b"v1")])}
+    # simulate the crash: pvt store committed, block append never happened
+    from fabric_tpu.ledger.pvtdatastore import PvtEntry
+
+    ledger.pvt_store.commit(
+        0, [PvtEntry(0, "mycc", "secret", kvrwset_bytes([("k1", b"v1")]))]
+    )
+    assert ledger.height == 0
+    # redelivery completes the interrupted commit instead of raising
+    flags = ledger.commit(block, rwsets=[rwset], pvt_data=pvt)
+    assert flags.is_valid(0)
+    assert ledger.height == 1
+    assert ledger.get_private_data("mycc", "secret", "k1") == b"v1"
+
+
+def test_commit_hash_not_mutated_by_failed_pvt_commit(tmp_path):
+    """Regression: a hash-mismatch raise must happen before the
+    commit-hash chain advances, so a retry produces the same hash."""
+    ledger = KVLedger(str(tmp_path), "ch")
+    rwset = pvt_rwset_for("k1", b"real")
+    block = make_block_with_pvt(0, b"", rwset)
+    before = ledger.commit_hash
+    with pytest.raises(ValueError):
+        ledger.commit(
+            block,
+            rwsets=[rwset],
+            pvt_data={(0, "mycc", "secret"): kvrwset_bytes([("k1", b"forged")])},
+        )
+    assert ledger.commit_hash == before
+    assert ledger.height == 0
+    # retry with good data commits cleanly
+    block2 = make_block_with_pvt(0, b"", rwset)
+    flags = ledger.commit(
+        block2,
+        rwsets=[rwset],
+        pvt_data={(0, "mycc", "secret"): kvrwset_bytes([("k1", b"real")])},
+    )
+    assert flags.is_valid(0)
+
+
+def test_missing_markers_skip_invalid_txs(tmp_path):
+    """Regression: missing-pvt markers computed pre-MVCC must not persist
+    for txs that ended up invalid."""
+    from fabric_tpu.ledger.pvtdatastore import MissingEntry
+
+    ledger = KVLedger(str(tmp_path), "ch")
+    rwset = pvt_rwset_for("k1", b"v")
+    block = make_block_with_pvt(0, b"", rwset)
+    # mark the tx invalid in the incoming filter (as if sig-check failed)
+    flags = ValidationFlags(1, TxValidationCode.ENDORSEMENT_POLICY_FAILURE)
+    block.metadata.metadata[common_pb2.TRANSACTIONS_FILTER] = flags.tobytes()
+    ledger.commit(
+        block,
+        rwsets=[rwset],
+        missing_pvt=[MissingEntry(0, "mycc", "secret")],
+    )
+    assert ledger.pvt_store.get_missing_pvt_data() == {}
+
+
+def test_channel_treats_forged_fetched_pvt_as_missing(tmp_path, orgs):
+    """Regression: hash-mismatched data from the (untrusted) fetcher must
+    become a missing marker, not a commit failure."""
+    from fabric_tpu.endorser import (
+        create_proposal,
+        create_signed_tx,
+        endorse_proposal,
+    )
+    from fabric_tpu.ledger.rwset_proto import serialize_tx_rwset
+    from fabric_tpu.msp.identity import MSPManager
+    from fabric_tpu.msp.signer import SigningIdentity
+    from fabric_tpu.orderer import SoloChain
+    from fabric_tpu.orderer.blockcutter import BatchConfig
+    from fabric_tpu.peer import Channel
+    from fabric_tpu.policy import from_dsl
+    from fabric_tpu.validation.validator import (
+        ChaincodeDefinition,
+        ChaincodeRegistry,
+    )
+
+    org1, _ = orgs
+    mgr = MSPManager([org1.msp(provider=PROVIDER)])
+    registry = ChaincodeRegistry(
+        [ChaincodeDefinition("mycc", from_dsl("OR('Org1MSP.member')"))]
+    )
+    peer_channel = Channel(
+        "pvtchannel3",
+        str(tmp_path / "peer"),
+        mgr,
+        registry,
+        PROVIDER,
+        fetch_pvt=lambda blk, tx, txid, ns, coll: kvrwset_bytes(
+            [("k", b"FORGED")]
+        ),
+        is_eligible=lambda ns, coll: True,
+    )
+    client = SigningIdentity(org1.users[0], PROVIDER)
+    peer = SigningIdentity(org1.peers[0], PROVIDER)
+    kh = hashlib.sha256(b"k").digest()
+    rwset = rw.TxRwSet(
+        (
+            rw.NsRwSet(
+                "mycc",
+                coll_hashed=(
+                    rw.CollHashedRwSet(
+                        "secret",
+                        hashed_writes=(
+                            rw.KVWriteHash(
+                                kh, False, hashlib.sha256(b"real").digest()
+                            ),
+                        ),
+                    ),
+                ),
+            ),
+        )
+    )
+    bundle = create_proposal(client, "pvtchannel3", "mycc", [b"x"])
+    env = create_signed_tx(
+        bundle,
+        client,
+        [endorse_proposal(bundle, peer, serialize_tx_rwset(rwset))],
+    )
+    blocks = []
+    chain = SoloChain(
+        "pvtchannel3",
+        signer=peer,
+        batch_config=BatchConfig(max_message_count=1),
+        deliver=blocks.append,
+    )
+    chain.order(env)
+    flags = peer_channel.store_block(blocks[0])  # must not raise
+    assert flags.is_valid(0)
+    missing = peer_channel.ledger.pvt_store.get_missing_pvt_data()
+    assert list(missing) == [0]
+    assert (
+        peer_channel.ledger.get_private_data("mycc", "secret", "k") is None
+    )
+
+
+def test_simulator_reads_committed_pvt_data(tmp_path):
+    ledger = KVLedger(str(tmp_path), "ch")
+    rwset = pvt_rwset_for("k1", b"visible")
+    block = make_block_with_pvt(0, b"", rwset)
+    ledger.commit(
+        block,
+        rwsets=[rwset],
+        pvt_data={(0, "mycc", "secret"): kvrwset_bytes([("k1", b"visible")])},
+    )
+    sim = TxSimulator(
+        ledger.state_db,
+        tx_id="t",
+        pvt_reader=lambda ns, coll, key: ledger.get_private_data(ns, coll, key),
+    )
+    assert sim.get_private_data("mycc", "secret", "k1") == b"visible"
+    res = sim.get_tx_simulation_results()
+    hr = res.rwset.ns_rw_sets[0].coll_hashed[0].hashed_reads[0]
+    assert hr.version == rw.Version(0, 0)

@@ -186,6 +186,46 @@ def test_chain_restart_recovers_from_wal(tmp_path):
     assert again.get_block(2) is not None
 
 
+def test_chain_restart_with_snapshot_keeps_height(tmp_path):
+    """Regression: a restart with an on-disk snapshot must resume from the
+    persisted block ledger, not silently reset to height 0 and re-mint
+    already-used block numbers."""
+    ids = (1,)
+    chain = RaftChain(
+        "ch", 1, ids, wal_dir=str(tmp_path / "snapchain"),
+        batch_config=BatchConfig(max_message_count=1), snapshot_interval=2,
+    )
+    for _ in range(30):
+        chain.tick()
+    assert chain.node.role == "leader"
+    for i in range(6):
+        chain.order(make_env(f"tx{i}".encode()))
+    chain._pump()
+    assert chain.height == 6
+    assert chain.node.snap_index > 0
+    chain.wal.close()
+
+    again = RaftChain(
+        "ch", 1, ids, wal_dir=str(tmp_path / "snapchain"),
+        batch_config=BatchConfig(max_message_count=1), snapshot_interval=2,
+    )
+    assert again.height == 6  # restored from the block ledger
+    assert again.needs_catch_up is None
+    for _ in range(30):
+        again.tick()
+    assert again.node.role == "leader"
+    again.order(make_env(b"tx-after-restart"))
+    again._pump()
+    assert again.height == 7
+    blk = again.get_block(6)
+    assert blk is not None and blk.header.number == 6
+    # the chain stays linked across the restart
+    prev = again.get_block(5)
+    from fabric_tpu.protos import protoutil as pu
+
+    assert blk.header.previous_hash == pu.block_header_hash(prev.header)
+
+
 def test_snapshot_compaction_and_catch_up(tmp_path):
     snap = SnapshotFile(str(tmp_path / "s" / "snapshot"))
     snap.save(7, 2, b"state")

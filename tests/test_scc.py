@@ -1,0 +1,250 @@
+"""System chaincodes (qscc/cscc/lscc) + aclmgmt
+(reference core/scc/qscc/query.go, core/scc/cscc/configure.go,
+core/scc/lscc, core/aclmgmt)."""
+
+import pytest
+
+from fabric_tpu.chaincode.shim import ChaincodeStub
+from fabric_tpu.chaincode.support import ChaincodeSupport, TxParams
+from fabric_tpu.crypto.bccsp import SoftwareProvider
+from fabric_tpu.endorser import create_proposal, create_signed_tx, endorse_proposal
+from fabric_tpu.ledger import rwset as rw
+from fabric_tpu.ledger.kvledger import KVLedger
+from fabric_tpu.ledger.rwset_proto import serialize_tx_rwset
+from fabric_tpu.ledger.simulator import TxSimulator
+from fabric_tpu.msp.cryptogen import generate_org
+from fabric_tpu.msp.identity import MSPManager
+from fabric_tpu.msp.signer import SigningIdentity
+from fabric_tpu.peer.aclmgmt import (
+    ACLError,
+    ACLProvider,
+    CSCC_GET_CHANNELS,
+    PEER_PROPOSE,
+    QSCC_GET_CHAIN_INFO,
+)
+from fabric_tpu.peer import Channel
+from fabric_tpu.policy import from_dsl
+from fabric_tpu.policy.manager import SignedData
+from fabric_tpu.protos import common_pb2, peer_pb2, protoutil
+from fabric_tpu.scc import CSCC, LSCC, QSCC
+from fabric_tpu.validation.validator import ChaincodeDefinition, ChaincodeRegistry
+
+PROVIDER = SoftwareProvider()
+CHANNEL = "scchannel"
+
+
+@pytest.fixture(scope="module")
+def net(tmp_path_factory):
+    """A committed chain with one real block so qscc has data to serve."""
+    tmp = tmp_path_factory.mktemp("scc")
+    org1 = generate_org("org1.example.com", "Org1MSP")
+    mgr = MSPManager([org1.msp(provider=PROVIDER)])
+    registry = ChaincodeRegistry(
+        [ChaincodeDefinition("mycc", from_dsl("OR('Org1MSP.member')"))]
+    )
+    channel = Channel(CHANNEL, str(tmp), mgr, registry, PROVIDER)
+    client = SigningIdentity(org1.users[0], PROVIDER)
+    peer = SigningIdentity(org1.peers[0], PROVIDER)
+
+    # genesis-ish block 0 then one endorsed tx in block 1
+    from fabric_tpu.orderer import SoloChain
+    from fabric_tpu.orderer.blockcutter import BatchConfig
+
+    blocks = []
+    chain = SoloChain(
+        CHANNEL,
+        signer=peer,
+        batch_config=BatchConfig(max_message_count=1),
+        deliver=blocks.append,
+    )
+    results = serialize_tx_rwset(
+        rw.TxRwSet(
+            (rw.NsRwSet("mycc", (), (rw.KVWrite("k", False, b"v"),)),)
+        )
+    )
+    bundle = create_proposal(client, CHANNEL, "mycc", [b"put", b"k"])
+    env = create_signed_tx(
+        bundle, client, [endorse_proposal(bundle, peer, results)]
+    )
+    chain.order(env)
+    for b in blocks:
+        channel.store_block(b)
+    return {
+        "channel": channel,
+        "org1": org1,
+        "client": client,
+        "tx_id": bundle.tx_id,
+        "blocks": blocks,
+    }
+
+
+def _run(cc, args, channel):
+    sim = TxSimulator(channel.ledger.state_db, tx_id="q")
+    support = ChaincodeSupport()
+    stub = ChaincodeStub("qscc", CHANNEL, "q", args, sim, support=support)
+    return cc.invoke(stub)
+
+
+def test_qscc_chain_info(net):
+    qscc = QSCC(lambda cid: net["channel"].ledger if cid == CHANNEL else None)
+    resp = _run(qscc, [b"GetChainInfo", CHANNEL.encode()], net["channel"])
+    assert resp.status == 200, resp.message
+    info = protoutil.unmarshal(common_pb2.BlockchainInfo, resp.payload)
+    assert info.height == 1
+    assert info.currentBlockHash
+
+
+def test_qscc_block_by_number_and_hash(net):
+    qscc = QSCC(lambda cid: net["channel"].ledger if cid == CHANNEL else None)
+    resp = _run(qscc, [b"GetBlockByNumber", CHANNEL.encode(), b"0"], net["channel"])
+    assert resp.status == 200
+    block = protoutil.unmarshal(common_pb2.Block, resp.payload)
+    assert block.header.number == 0
+    h = protoutil.block_header_hash(block.header)
+    resp2 = _run(qscc, [b"GetBlockByHash", CHANNEL.encode(), h], net["channel"])
+    assert resp2.status == 200
+    assert protoutil.unmarshal(common_pb2.Block, resp2.payload).header.number == 0
+    resp3 = _run(
+        qscc, [b"GetBlockByNumber", CHANNEL.encode(), b"99"], net["channel"]
+    )
+    assert resp3.status == 500
+
+
+def test_qscc_transaction_by_id(net):
+    qscc = QSCC(lambda cid: net["channel"].ledger if cid == CHANNEL else None)
+    resp = _run(
+        qscc,
+        [b"GetTransactionByID", CHANNEL.encode(), net["tx_id"].encode()],
+        net["channel"],
+    )
+    assert resp.status == 200, resp.message
+    pt = protoutil.unmarshal(peer_pb2.ProcessedTransaction, resp.payload)
+    assert pt.validationCode == 0  # VALID
+    resp2 = _run(
+        qscc, [b"GetTransactionByID", CHANNEL.encode(), b"nope"], net["channel"]
+    )
+    assert resp2.status == 500
+
+
+def test_qscc_rejects_unknown_channel_and_fn(net):
+    qscc = QSCC(lambda cid: None)
+    resp = _run(qscc, [b"GetChainInfo", b"nochannel"], net["channel"])
+    assert resp.status == 500
+    qscc2 = QSCC(lambda cid: net["channel"].ledger)
+    resp2 = _run(qscc2, [b"Bogus", CHANNEL.encode(), b"x"], net["channel"])
+    assert resp2.status == 500
+
+
+def test_cscc_channels_and_join(net):
+    joined = []
+    cscc = CSCC(
+        join_chain=joined.append,
+        channel_list=lambda: [CHANNEL],
+        get_config_block=lambda cid: net["blocks"][0]
+        if cid == CHANNEL
+        else None,
+    )
+    resp = _run(cscc, [b"GetChannels"], net["channel"])
+    assert resp.status == 200
+    channels = protoutil.unmarshal(peer_pb2.ChannelQueryResponse, resp.payload)
+    assert [c.channel_id for c in channels.channels] == [CHANNEL]
+
+    block = net["blocks"][0]
+    resp = _run(cscc, [b"JoinChain", block.SerializeToString()], net["channel"])
+    assert resp.status == 200
+    assert len(joined) == 1 and joined[0].header.number == 0
+
+    resp = _run(cscc, [b"GetConfigBlock", CHANNEL.encode()], net["channel"])
+    assert resp.status == 200
+
+
+def test_lscc_queries(net):
+    lscc = LSCC(lambda: [("mycc", "1.0"), ("asset", "2.1")])
+    resp = _run(lscc, [b"getchaincodes"], net["channel"])
+    assert resp.status == 200
+    q = protoutil.unmarshal(peer_pb2.ChaincodeQueryResponse, resp.payload)
+    assert [(c.name, c.version) for c in q.chaincodes] == [
+        ("asset", "2.1"),
+        ("mycc", "1.0"),
+    ]
+    resp = _run(lscc, [b"getccdata", CHANNEL.encode(), b"mycc"], net["channel"])
+    assert resp.status == 200
+    resp = _run(lscc, [b"getccdata", CHANNEL.encode(), b"nope"], net["channel"])
+    assert resp.status == 500
+
+
+# ---------------- aclmgmt ----------------
+
+
+@pytest.fixture(scope="module")
+def acl_world():
+    org1 = generate_org("org1.example.com", "Org1MSP")
+    org2 = generate_org("org2.example.com", "Org2MSP")
+    from fabric_tpu.channelconfig import (
+        ApplicationProfile,
+        OrganizationProfile,
+        Profile,
+        genesis_block,
+    )
+    from fabric_tpu.channelconfig.bundle import bundle_from_genesis_block
+
+    profile = Profile(
+        application=ApplicationProfile(
+            organizations=[
+                OrganizationProfile("Org1MSP", org1.msp_config()),
+            ]
+        )
+    )
+    bundle = bundle_from_genesis_block(genesis_block(profile, "aclchannel"))
+    return org1, org2, bundle
+
+
+def _sd(node, msg=b"payload"):
+    s = SigningIdentity(node, PROVIDER)
+    return SignedData(msg, s.serialize(), s.sign(msg))
+
+
+def test_acl_default_allows_member_reads(acl_world):
+    org1, _, bundle = acl_world
+    acl = ACLProvider(lambda cid: bundle.policy_manager)
+    acl.check_acl(QSCC_GET_CHAIN_INFO, "aclchannel", [_sd(org1.peers[0])])
+    acl.check_acl(PEER_PROPOSE, "aclchannel", [_sd(org1.users[0])])
+
+
+def test_acl_rejects_non_member(acl_world):
+    _, org2, bundle = acl_world
+    acl = ACLProvider(lambda cid: bundle.policy_manager)
+    with pytest.raises(ACLError):
+        acl.check_acl(QSCC_GET_CHAIN_INFO, "aclchannel", [_sd(org2.peers[0])])
+
+
+def test_acl_unknown_resource_and_channel(acl_world):
+    org1, _, bundle = acl_world
+    acl = ACLProvider(lambda cid: bundle.policy_manager if cid == "aclchannel" else None)
+    with pytest.raises(ACLError):
+        acl.check_acl("no/such/resource", "aclchannel", [_sd(org1.peers[0])])
+    with pytest.raises(ACLError):
+        acl.check_acl(QSCC_GET_CHAIN_INFO, "otherchannel", [_sd(org1.peers[0])])
+
+
+def test_acl_config_override(acl_world):
+    org1, _, bundle = acl_world
+    # override GetChainInfo to require Admins: a peer (member) is rejected
+    acl = ACLProvider(
+        lambda cid: bundle.policy_manager,
+        acl_overrides=lambda cid: {QSCC_GET_CHAIN_INFO: "Admins"},
+    )
+    with pytest.raises(ACLError):
+        acl.check_acl(QSCC_GET_CHAIN_INFO, "aclchannel", [_sd(org1.peers[0])])
+    acl.check_acl(QSCC_GET_CHAIN_INFO, "aclchannel", [_sd(org1.admin)])
+
+
+def test_acl_local_policy_routes_to_local_check(acl_world):
+    org1, _, bundle = acl_world
+    calls = []
+    acl = ACLProvider(
+        lambda cid: bundle.policy_manager,
+        local_check=lambda policy, sd: calls.append(policy),
+    )
+    acl.check_acl(CSCC_GET_CHANNELS, "", [_sd(org1.peers[0])])
+    assert calls == ["Members"]
