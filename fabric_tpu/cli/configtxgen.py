@@ -1,0 +1,163 @@
+"""configtxgen — genesis block / channel-creation tx generator
+(reference cmd/configtxgen + usable-inter-nal/configtxgen).
+
+  python -m fabric_tpu.cli.configtxgen \
+      -profile TwoOrgsChannel -channelID mychannel \
+      -configPath configtx.yaml \
+      [-outputBlock genesis.block | -outputCreateChannelTx ch.tx]
+
+configtx.yaml (reference schema subset):
+
+  Organizations:          # anchors referenced by profiles
+    - &Org1 {Name: Org1MSP, MSPDir: crypto-config/.../msp, MSPID: Org1MSP,
+             AnchorPeers: [{Host: peer0, Port: 7051}]}
+  Profiles:
+    TwoOrgsOrdererGenesis:
+      Orderer: {OrdererType: solo, Addresses: [...], Organizations: [...]}
+      Consortiums: {SampleConsortium: {Organizations: [*Org1, ...]}}
+    TwoOrgsChannel:
+      Consortium: SampleConsortium
+      Application: {Organizations: [*Org1, ...]}
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import Dict, List, Optional
+
+import yaml
+
+from fabric_tpu.channelconfig import encoder
+from fabric_tpu.msp.configbuilder import load_msp_config
+from fabric_tpu.protos import configtx_pb2, protoutil
+
+
+def _org_profile(spec: Dict) -> encoder.OrganizationProfile:
+    msp_id = spec.get("MSPID") or spec["Name"]
+    msp_cfg = load_msp_config(spec["MSPDir"], msp_id)
+    anchors = [
+        (a["Host"], int(a["Port"])) for a in spec.get("AnchorPeers") or []
+    ]
+    endpoints = list(spec.get("OrdererEndpoints") or [])
+    return encoder.OrganizationProfile(
+        name=spec["Name"],
+        msp=msp_cfg,
+        anchor_peers=anchors,
+        orderer_endpoints=endpoints,
+    )
+
+
+def load_profile(config_path: str, profile_name: str) -> encoder.Profile:
+    with open(config_path) as f:
+        cfg = yaml.safe_load(f)
+    profiles = cfg.get("Profiles") or {}
+    if profile_name not in profiles:
+        raise SystemExit(f"profile {profile_name} not found in {config_path}")
+    spec = profiles[profile_name]
+
+    application = None
+    if spec.get("Application"):
+        application = encoder.ApplicationProfile(
+            organizations=[
+                _org_profile(o)
+                for o in spec["Application"].get("Organizations") or []
+            ],
+        )
+    orderer = None
+    if spec.get("Orderer"):
+        o = spec["Orderer"]
+        batch = o.get("BatchSize") or {}
+        orderer = encoder.OrdererProfile(
+            orderer_type=o.get("OrdererType", "solo"),
+            addresses=list(o.get("Addresses") or []),
+            batch_timeout=o.get("BatchTimeout", "2s"),
+            max_message_count=batch.get("MaxMessageCount", 500),
+            absolute_max_bytes=_size(batch.get("AbsoluteMaxBytes", "10 MB")),
+            preferred_max_bytes=_size(batch.get("PreferredMaxBytes", "2 MB")),
+            organizations=[
+                _org_profile(org) for org in o.get("Organizations") or []
+            ],
+        )
+    consortiums = {
+        name: [_org_profile(org) for org in c.get("Organizations") or []]
+        for name, c in (spec.get("Consortiums") or {}).items()
+    }
+    return encoder.Profile(
+        consortium=spec.get("Consortium", ""),
+        application=application,
+        orderer=orderer,
+        consortiums=consortiums,
+    )
+
+
+def _size(v) -> int:
+    if isinstance(v, int):
+        return v
+    text = str(v).strip().upper().replace(" ", "")
+    for suffix, mult in (("KB", 1024), ("MB", 1024**2), ("GB", 1024**3)):
+        if text.endswith(suffix):
+            return int(float(text[: -len(suffix)]) * mult)
+    return int(text)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="configtxgen")
+    parser.add_argument("-profile", required=True)
+    parser.add_argument("-channelID", required=True)
+    parser.add_argument("-configPath", default="configtx.yaml")
+    parser.add_argument("-outputBlock")
+    parser.add_argument("-outputCreateChannelTx")
+    parser.add_argument("-inspectBlock")
+    args = parser.parse_args(argv)
+
+    if args.inspectBlock:
+        from google.protobuf import json_format
+
+        from fabric_tpu.protos import common_pb2
+
+        block = common_pb2.Block()
+        with open(args.inspectBlock, "rb") as f:
+            block.ParseFromString(f.read())
+        print(json_format.MessageToJson(block))
+        return 0
+
+    profile = load_profile(args.configPath, args.profile)
+    if args.outputBlock:
+        block = encoder.genesis_block(profile, args.channelID)
+        with open(args.outputBlock, "wb") as f:
+            f.write(block.SerializeToString())
+        print(f"wrote genesis block {args.outputBlock}")
+        return 0
+    if args.outputCreateChannelTx:
+        if not profile.consortium or profile.application is None:
+            raise SystemExit(
+                "channel creation requires Consortium + Application"
+            )
+        update = encoder.channel_creation_config_update(
+            args.channelID, profile.consortium, profile.application
+        )
+        cue = configtx_pb2.ConfigUpdateEnvelope()
+        cue.config_update = update.SerializeToString()
+        from fabric_tpu.protos import common_pb2
+
+        payload = common_pb2.Payload()
+        chdr = protoutil.make_channel_header(
+            common_pb2.CONFIG_UPDATE, args.channelID
+        )
+        payload.header.channel_header = chdr.SerializeToString()
+        payload.header.signature_header = (
+            common_pb2.SignatureHeader().SerializeToString()
+        )
+        payload.data = cue.SerializeToString()
+        env = common_pb2.Envelope()
+        env.payload = payload.SerializeToString()
+        with open(args.outputCreateChannelTx, "wb") as f:
+            f.write(env.SerializeToString())
+        print(f"wrote channel creation tx {args.outputCreateChannelTx}")
+        return 0
+    raise SystemExit("one of -outputBlock/-outputCreateChannelTx/-inspectBlock required")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,112 @@
+"""configtxlator — proto <-> JSON translation + config update computation
+(reference cmd/configtxlator: proto_encode/proto_decode/compute_update,
+minus the REST server — stdin/stdout like its CLI mode).
+
+  python -m fabric_tpu.cli.configtxlator proto_decode \
+      --type common.Block --input block.pb [--output block.json]
+  python -m fabric_tpu.cli.configtxlator proto_encode \
+      --type common.Config --input config.json --output config.pb
+  python -m fabric_tpu.cli.configtxlator compute_update \
+      --channel_id ch --original orig.pb --updated new.pb --output delta.pb
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from google.protobuf import json_format
+
+from fabric_tpu.protos import ab_pb2, common_pb2, configtx_pb2, peer_pb2
+
+_TYPES = {
+    "common.Block": common_pb2.Block,
+    "common.Envelope": common_pb2.Envelope,
+    "common.Payload": common_pb2.Payload,
+    "common.Config": configtx_pb2.Config,
+    "common.ConfigUpdate": configtx_pb2.ConfigUpdate,
+    "common.ConfigEnvelope": configtx_pb2.ConfigEnvelope,
+    "orderer.SeekInfo": ab_pb2.SeekInfo,
+    "protos.Transaction": peer_pb2.Transaction,
+    "protos.ProposalResponse": peer_pb2.ProposalResponse,
+}
+
+
+def _read(path):
+    if path == "-" or path is None:
+        return sys.stdin.buffer.read()
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def _write(path, data: bytes):
+    if path == "-" or path is None:
+        sys.stdout.buffer.write(data)
+    else:
+        with open(path, "wb") as f:
+            f.write(data)
+
+
+def compute_update(
+    channel_id: str, original: configtx_pb2.Config, updated: configtx_pb2.Config
+) -> configtx_pb2.ConfigUpdate:
+    """Minimal update computation (reference configtxlator/update): write
+    set = changed/new elements with bumped versions; read set = their
+    original versions. Group-level granularity."""
+    update = configtx_pb2.ConfigUpdate()
+    update.channel_id = channel_id
+    update.read_set.CopyFrom(original.channel_group)
+    update.write_set.CopyFrom(updated.channel_group)
+    _bump_changed(original.channel_group, updated.channel_group, update.write_set)
+    return update
+
+
+def _bump_changed(orig, new, out) -> None:
+    """Recursively bump versions of changed values/groups in the write
+    set (simplified: bumps at the site of each changed value)."""
+    for name, value in new.values.items():
+        if name not in orig.values:
+            continue
+        if orig.values[name].value != value.value:
+            out.values[name].version = orig.values[name].version + 1
+    for name, group in new.groups.items():
+        if name in orig.groups:
+            _bump_changed(orig.groups[name], group, out.groups[name])
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="configtxlator")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    for cmd in ("proto_decode", "proto_encode"):
+        p = sub.add_parser(cmd)
+        p.add_argument("--type", required=True, choices=sorted(_TYPES))
+        p.add_argument("--input", default="-")
+        p.add_argument("--output", default="-")
+    cu = sub.add_parser("compute_update")
+    cu.add_argument("--channel_id", required=True)
+    cu.add_argument("--original", required=True)
+    cu.add_argument("--updated", required=True)
+    cu.add_argument("--output", default="-")
+    args = parser.parse_args(argv)
+
+    if args.cmd == "proto_decode":
+        msg = _TYPES[args.type]()
+        msg.ParseFromString(_read(args.input))
+        _write(args.output, json_format.MessageToJson(msg).encode())
+    elif args.cmd == "proto_encode":
+        msg = json_format.Parse(_read(args.input).decode(), _TYPES[args.type]())
+        _write(args.output, msg.SerializeToString())
+    elif args.cmd == "compute_update":
+        orig = configtx_pb2.Config()
+        orig.ParseFromString(_read(args.original))
+        new = configtx_pb2.Config()
+        new.ParseFromString(_read(args.updated))
+        _write(
+            args.output,
+            compute_update(args.channel_id, orig, new).SerializeToString(),
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

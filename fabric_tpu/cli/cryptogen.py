@@ -1,0 +1,62 @@
+"""cryptogen — test crypto material generator (reference cmd/cryptogen +
+usable-inter-nal/cryptogen).
+
+  python -m fabric_tpu.cli.cryptogen generate \
+      --config crypto-config.yaml --output crypto-config
+
+crypto-config.yaml (reference schema subset):
+
+  PeerOrgs:
+    - Name: Org1
+      Domain: org1.example.com
+      MSPID: Org1MSP          # optional, default <Name>MSP
+      Template: {Count: 2}    # peers
+      Users:    {Count: 1}
+  OrdererOrgs:
+    - Name: Orderer
+      Domain: orderer.example.com
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import yaml
+
+from fabric_tpu.msp.configbuilder import write_org_dir
+from fabric_tpu.msp.cryptogen import generate_org
+
+
+def generate(config_path: str, output: str) -> int:
+    with open(config_path) as f:
+        cfg = yaml.safe_load(f) or {}
+    import os
+
+    for section, sub in (("PeerOrgs", "peerOrganizations"), ("OrdererOrgs", "ordererOrganizations")):
+        for spec in cfg.get(section) or []:
+            org = generate_org(
+                spec["Domain"],
+                spec.get("MSPID") or f"{spec['Name']}MSP",
+                num_peers=(spec.get("Template") or {}).get("Count", 1),
+                num_users=(spec.get("Users") or {}).get("Count", 1),
+            )
+            out = write_org_dir(org, os.path.join(output, sub))
+            print(f"generated {out}")
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="cryptogen")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    gen = sub.add_parser("generate")
+    gen.add_argument("--config", required=True)
+    gen.add_argument("--output", default="crypto-config")
+    args = parser.parse_args(argv)
+    if args.cmd == "generate":
+        return generate(args.config, args.output)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,88 @@
+"""orderer — ordering node binary (reference cmd/orderer +
+orderer/common/server/main.go).
+
+  python -m fabric_tpu.cli.orderer start --config orderer.yaml
+
+orderer.yaml (localconfig subset):
+
+  General:
+    ListenAddress: 127.0.0.1
+    ListenPort: 7050
+    LocalMSPID: OrdererMSP
+    LocalMSPDir: crypto-config/.../orderers/orderer.../msp
+    BootstrapFile: genesis.block     # per-channel genesis to serve
+    WorkDir: /var/fabric-tpu/orderer
+  Operations:
+    ListenAddress: 127.0.0.1:9443
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+
+import yaml
+
+from fabric_tpu.common import flogging
+from fabric_tpu.msp.configbuilder import load_signing_identity
+from fabric_tpu.nodes.orderer import OrdererNode
+from fabric_tpu.protos import common_pb2
+
+logger = flogging.must_get_logger("orderer.main")
+
+
+def start(config_path: str, block_until_signal: bool = True) -> OrdererNode:
+    with open(config_path) as f:
+        cfg = yaml.safe_load(f) or {}
+    general = cfg.get("General") or {}
+    signer = None
+    if general.get("LocalMSPDir"):
+        signer = load_signing_identity(
+            general["LocalMSPDir"], general.get("LocalMSPID", "OrdererMSP")
+        )
+    listen = (
+        f"{general.get('ListenAddress', '127.0.0.1')}:"
+        f"{general.get('ListenPort', 7050)}"
+    )
+    ops = (cfg.get("Operations") or {}).get("ListenAddress")
+    node = OrdererNode(
+        general.get("WorkDir", "orderer-data"),
+        signer=signer,
+        listen_address=listen,
+        system_channel_id=general.get("SystemChannel"),
+        ops_address=ops,
+    )
+    bootstrap = general.get("BootstrapFile")
+    if bootstrap:
+        block = common_pb2.Block()
+        with open(bootstrap, "rb") as f:
+            block.ParseFromString(f.read())
+        node.join_channel(block)
+    addr = node.start()
+    logger.info("orderer listening on %s", addr)
+    print(f"orderer listening on {addr}", flush=True)
+    if block_until_signal:
+        stop = threading.Event()
+        signal.signal(signal.SIGTERM, lambda *a: stop.set())
+        signal.signal(signal.SIGINT, lambda *a: stop.set())
+        stop.wait()
+        node.stop()
+    return node
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="orderer")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    st = sub.add_parser("start")
+    st.add_argument("--config", required=True)
+    args = parser.parse_args(argv)
+    if args.cmd == "start":
+        start(args.config)
+        return 0
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

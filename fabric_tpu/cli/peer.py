@@ -1,0 +1,200 @@
+"""peer — peer node binary + channel/chaincode client commands
+(reference cmd/peer: node start, channel join/list, chaincode
+invoke/query over the wire).
+
+  python -m fabric_tpu.cli.peer node start --config core.yaml
+  python -m fabric_tpu.cli.peer channel join --config core.yaml -b genesis.block
+  python -m fabric_tpu.cli.peer chaincode invoke|query \
+      --peerAddresses 127.0.0.1:7051 [...] -o 127.0.0.1:7050 \
+      -C mychannel -n mycc -c '{"Args":["put","k","v"]}' \
+      --mspDir <user msp dir> --mspID Org1MSP
+
+core.yaml (subset of the reference sampleconfig/core.yaml):
+
+  peer:
+    listenAddress: 127.0.0.1:7051
+    localMspId: Org1MSP
+    mspConfigPath: .../peers/peer0.org1/msp
+    fileSystemPath: /var/fabric-tpu/peer0
+    orgMspDirs:               # org-level verifying MSPs of the channel
+      Org1MSP: .../org1.example.com/msp
+      Org2MSP: .../org2.example.com/msp
+    ordererEndpoint: 127.0.0.1:7050
+    genesisBlocks: [mychannel.block]
+    chaincodes:               # endorsement policies (lifecycle analog)
+      mycc: "AND('Org1MSP.member','Org2MSP.member')"
+  operations:
+    listenAddress: 127.0.0.1:9444
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import sys
+import threading
+
+import yaml
+
+from fabric_tpu.common import flogging
+from fabric_tpu.comm.server import channel_to
+from fabric_tpu.comm.services import broadcast_envelope, process_proposal
+from fabric_tpu.endorser import create_proposal, create_signed_tx
+from fabric_tpu.endorser.txbuilder import create_signed_proposal
+from fabric_tpu.msp.configbuilder import load_msp, load_signing_identity
+from fabric_tpu.msp.identity import MSPManager
+from fabric_tpu.nodes.peer import PeerNode
+from fabric_tpu.policy import from_dsl
+from fabric_tpu.protos import common_pb2
+from fabric_tpu.validation.validator import ChaincodeDefinition, ChaincodeRegistry
+
+logger = flogging.must_get_logger("peer.main")
+
+
+def _load_node(config_path: str) -> PeerNode:
+    with open(config_path) as f:
+        cfg = yaml.safe_load(f) or {}
+    pc = cfg.get("peer") or {}
+    msps = [
+        load_msp(path, msp_id)
+        for msp_id, path in (pc.get("orgMspDirs") or {}).items()
+    ]
+    mgr = MSPManager(msps)
+    signer = load_signing_identity(
+        pc["mspConfigPath"], pc.get("localMspId", "DEFAULT")
+    )
+    cc_policies = {
+        name: from_dsl(dsl)
+        for name, dsl in (pc.get("chaincodes") or {}).items()
+    }
+
+    def registry_factory(channel_id: str) -> ChaincodeRegistry:
+        return ChaincodeRegistry(
+            [ChaincodeDefinition(n, p) for n, p in cc_policies.items()]
+        )
+
+    ops = (cfg.get("operations") or {}).get("listenAddress")
+    node = PeerNode(
+        pc.get("fileSystemPath", "peer-data"),
+        mgr,
+        signer,
+        registry_factory,
+        listen_address=pc.get("listenAddress", "127.0.0.1:0"),
+        ops_address=ops,
+    )
+    # External-builder analog (core/container/externalbuilder): user
+    # chaincode loads as python modules, "module.path:ClassName", with
+    # optional extra sys.path roots.
+    import importlib
+
+    for extra in pc.get("chaincodePath") or []:
+        if extra not in sys.path:
+            sys.path.insert(0, extra)
+    for name, ref in (pc.get("chaincodePlugins") or {}).items():
+        mod_name, _, cls_name = ref.partition(":")
+        mod = importlib.import_module(mod_name)
+        node.support.register(name, getattr(mod, cls_name)())
+    for path in pc.get("genesisBlocks") or []:
+        block = common_pb2.Block()
+        with open(path, "rb") as f:
+            block.ParseFromString(f.read())
+        node.join_channel(block)
+    return node, pc
+
+
+def node_start(config_path: str, block_until_signal: bool = True) -> PeerNode:
+    node, pc = _load_node(config_path)
+    addr = node.start()
+    orderer = pc.get("ordererEndpoint")
+    if orderer:
+        for channel_id in list(node.channels):
+            node.start_deliver_for_channel(channel_id, orderer)
+    logger.info("peer listening on %s", addr)
+    print(f"peer listening on {addr}", flush=True)
+    if block_until_signal:
+        stop = threading.Event()
+        signal.signal(signal.SIGTERM, lambda *a: stop.set())
+        signal.signal(signal.SIGINT, lambda *a: stop.set())
+        stop.wait()
+        node.stop()
+    return node
+
+
+def _client_signer(args):
+    return load_signing_identity(args.mspDir, args.mspID)
+
+
+def chaincode_cmd(args) -> int:
+    signer = _client_signer(args)
+    spec = json.loads(args.c)
+    cc_args = [a.encode() for a in spec.get("Args", [])]
+    bundle = create_proposal(signer, args.C, args.n, cc_args)
+    signed = create_signed_proposal(bundle, signer)
+    responses = []
+    for addr in args.peerAddresses:
+        conn = channel_to(addr)
+        resp = process_proposal(conn, signed)
+        conn.close()
+        if resp.response.status != 200:
+            print(
+                f"endorsement failed on {addr}: {resp.response.message}",
+                file=sys.stderr,
+            )
+            return 1
+        responses.append(resp)
+    if args.cmd == "query":
+        payload = responses[0].response.payload
+        if args.b64:
+            import base64
+
+            sys.stdout.write(base64.b64encode(payload).decode() + "\n")
+        else:
+            sys.stdout.buffer.write(payload)
+            sys.stdout.flush()
+        return 0
+    env = create_signed_tx(bundle, signer, responses)
+    conn = channel_to(args.o)
+    ack = broadcast_envelope(conn, env)
+    conn.close()
+    if ack.status != common_pb2.SUCCESS:
+        print(f"broadcast failed: {ack.info}", file=sys.stderr)
+        return 1
+    print(f"txid {bundle.tx_id} submitted")
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="peer")
+    sub = parser.add_subparsers(dest="group", required=True)
+
+    node = sub.add_parser("node")
+    node_sub = node.add_subparsers(dest="cmd", required=True)
+    st = node_sub.add_parser("start")
+    st.add_argument("--config", required=True)
+
+    cc = sub.add_parser("chaincode")
+    cc_sub = cc.add_subparsers(dest="cmd", required=True)
+    for cmd in ("invoke", "query"):
+        p = cc_sub.add_parser(cmd)
+        p.add_argument("--peerAddresses", action="append", required=True)
+        p.add_argument("-o", default="")
+        p.add_argument("-C", required=True)
+        p.add_argument("-n", required=True)
+        p.add_argument("-c", required=True)
+        p.add_argument("--mspDir", required=True)
+        p.add_argument("--mspID", required=True)
+        p.add_argument("--b64", action="store_true",
+                       help="base64-encode query payload output")
+
+    args = parser.parse_args(argv)
+    if args.group == "node" and args.cmd == "start":
+        node_start(args.config)
+        return 0
+    if args.group == "chaincode":
+        return chaincode_cmd(args)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

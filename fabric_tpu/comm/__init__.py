@@ -1,0 +1,1 @@
+from fabric_tpu.comm.server import GRPCServer, tls_server_credentials  # noqa: F401

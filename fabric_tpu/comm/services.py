@@ -1,0 +1,143 @@
+"""gRPC service adapters: AtomicBroadcast (orderer), Endorser + Deliver
+(peer), wired onto the in-process handlers (reference
+orderer/common/server/server.go Broadcast/Deliver,
+core/peer/deliverevents.go, core/endorser as a gRPC service).
+
+Service/method names and message framing match fabric-protos, so stock
+SDK clients interoperate: /orderer.AtomicBroadcast/{Broadcast,Deliver},
+/protos.Endorser/ProcessProposal, /protos.Deliver/{Deliver,
+DeliverFiltered}.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterator, Optional
+
+from fabric_tpu.comm.server import STREAM_STREAM, UNARY, GRPCServer
+from fabric_tpu.deliver.server import DeliverHandler, deliver_filtered
+from fabric_tpu.protos import ab_pb2, common_pb2, peer_pb2
+
+
+def register_atomic_broadcast(
+    server: GRPCServer,
+    broadcast_handler,  # orderer.broadcast.BroadcastHandler
+    deliver_handler: DeliverHandler,
+) -> None:
+    def broadcast(request_iterator, context) -> Iterator[ab_pb2.BroadcastResponse]:
+        for env in request_iterator:
+            status, info = broadcast_handler.process_message(env)
+            resp = ab_pb2.BroadcastResponse()
+            resp.status = status
+            resp.info = info
+            yield resp
+
+    def deliver(request_iterator, context) -> Iterator[ab_pb2.DeliverResponse]:
+        for env in request_iterator:
+            yield from deliver_handler.deliver_blocks(env)
+
+    server.register(
+        "orderer.AtomicBroadcast",
+        {
+            "Broadcast": (
+                STREAM_STREAM,
+                broadcast,
+                common_pb2.Envelope.FromString,
+                ab_pb2.BroadcastResponse.SerializeToString,
+            ),
+            "Deliver": (
+                STREAM_STREAM,
+                deliver,
+                common_pb2.Envelope.FromString,
+                ab_pb2.DeliverResponse.SerializeToString,
+            ),
+        },
+    )
+
+
+def register_endorser(server: GRPCServer, endorser) -> None:
+    def process_proposal(request: peer_pb2.SignedProposal, context):
+        return endorser.process_proposal(request)
+
+    server.register(
+        "protos.Endorser",
+        {
+            "ProcessProposal": (
+                UNARY,
+                process_proposal,
+                peer_pb2.SignedProposal.FromString,
+                peer_pb2.ProposalResponse.SerializeToString,
+            ),
+        },
+    )
+
+
+def register_peer_deliver(
+    server: GRPCServer, deliver_handler: DeliverHandler
+) -> None:
+    """The peer's Deliver service (block + filtered-block events to SDKs,
+    core/peer/deliverevents.go:239)."""
+
+    def deliver(request_iterator, context):
+        for env in request_iterator:
+            yield from deliver_handler.deliver_blocks(env)
+
+    def deliver_filtered_rpc(request_iterator, context):
+        for env in request_iterator:
+            yield from deliver_filtered(deliver_handler, env)
+
+    server.register(
+        "protos.Deliver",
+        {
+            "Deliver": (
+                STREAM_STREAM,
+                deliver,
+                common_pb2.Envelope.FromString,
+                ab_pb2.DeliverResponse.SerializeToString,
+            ),
+            "DeliverFiltered": (
+                STREAM_STREAM,
+                deliver_filtered_rpc,
+                common_pb2.Envelope.FromString,
+                ab_pb2.DeliverResponse.SerializeToString,
+            ),
+        },
+    )
+
+
+# ---------------------------------------------------------------------------
+# Client helpers (SDK-side: broadcast a tx, pull blocks)
+# ---------------------------------------------------------------------------
+
+
+def broadcast_envelope(channel, env: common_pb2.Envelope) -> ab_pb2.BroadcastResponse:
+    """One-shot Broadcast over a grpc.Channel."""
+    stub = channel.stream_stream(
+        "/orderer.AtomicBroadcast/Broadcast",
+        request_serializer=common_pb2.Envelope.SerializeToString,
+        response_deserializer=ab_pb2.BroadcastResponse.FromString,
+    )
+    responses = stub(iter([env]))
+    return next(responses)
+
+
+def deliver_stream(
+    channel,
+    envelope: common_pb2.Envelope,
+    service: str = "orderer.AtomicBroadcast",
+    method: str = "Deliver",
+) -> Iterator[ab_pb2.DeliverResponse]:
+    stub = channel.stream_stream(
+        f"/{service}/{method}",
+        request_serializer=common_pb2.Envelope.SerializeToString,
+        response_deserializer=ab_pb2.DeliverResponse.FromString,
+    )
+    return stub(iter([envelope]))
+
+
+def process_proposal(channel, signed: peer_pb2.SignedProposal) -> peer_pb2.ProposalResponse:
+    stub = channel.unary_unary(
+        "/protos.Endorser/ProcessProposal",
+        request_serializer=peer_pb2.SignedProposal.SerializeToString,
+        response_deserializer=peer_pb2.ProposalResponse.FromString,
+    )
+    return stub(signed)

@@ -63,10 +63,12 @@ class TPUProvider(Provider):
     def __init__(self):
         import jax
 
+        from fabric_tpu.crypto.bccsp import SoftwareProvider
         from fabric_tpu.ops import p256_kernel as pk
 
         self._jax = jax
         self._pk = pk
+        self._software = SoftwareProvider()
         self._key_limb_cache: Dict[bytes, Tuple[np.ndarray, np.ndarray]] = {}
 
     def _key_limbs(self, key: ECDSAPublicKey) -> Tuple[np.ndarray, np.ndarray, bool]:
@@ -85,11 +87,17 @@ class TPUProvider(Provider):
             self._key_limb_cache[ski] = hit
         return hit
 
+    # Below this count the device round-trip (and worse, a first-time XLA
+    # compile) costs more than host verification; interactive paths (MSP
+    # identity checks, orderer SigFilter, CLI clients) hit the single API
+    # and must never wait on a kernel compile. The per-block validator
+    # calls batch_verify with hundreds-to-thousands of lanes.
+    MIN_DEVICE_BATCH = 32
+
     def verify(self, key: ECDSAPublicKey, signature: bytes, digest: bytes) -> bool:
-        # Preserve the reference's (bool, error) split for the single API;
-        # the parsed (r, s) flow straight to the device batch (no re-parse).
-        r, s = parse_and_precheck(signature)  # raises VerifyError
-        return self._batch_verify_parsed([key], [(r, s)], [digest])[0]
+        # SoftwareProvider already does the DER parse + low-S precheck and
+        # raises VerifyError with the reference's (bool, error) semantics.
+        return self._software.verify(key, signature, digest)
 
     def batch_verify(
         self,
@@ -97,6 +105,14 @@ class TPUProvider(Provider):
         signatures: Sequence[bytes],
         digests: Sequence[bytes],
     ) -> List[bool]:
+        if len(signatures) < self.MIN_DEVICE_BATCH:
+            out = []
+            for key, sig, dig in zip(keys, signatures, digests):
+                try:
+                    out.append(self._software.verify(key, sig, dig))
+                except VerifyError:
+                    out.append(False)
+            return out
         parsed: List[Optional[Tuple[int, int]]] = []
         for sig in signatures:
             try:

@@ -1,0 +1,6 @@
+from fabric_tpu.discovery.inquire import satisfied_by  # noqa: F401
+from fabric_tpu.discovery.service import (  # noqa: F401
+    DiscoveryService,
+    EndorsementDescriptor,
+    PeerInfo,
+)

@@ -69,13 +69,34 @@ class BlockStore:
 
     def __init__(self, path: str):
         self.path = path
-        self._offsets: List[int] = []  # block number -> file offset
+        self._offsets: List[int] = []  # (number - base) -> file offset
         self._by_hash: Dict[bytes, int] = {}
         self._by_txid: Dict[str, Tuple[int, int]] = {}
         self._last_hash = b""
+        # Snapshot bootstrap (reference bootstrapFromSnapshotInfo): a store
+        # created from a snapshot starts at a nonzero height with no block
+        # files for the prefix; base.meta records (base_height, last_hash).
+        self._base = 0
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        meta_path = self.path + ".base"
+        if os.path.exists(meta_path):
+            with open(meta_path, "rb") as f:
+                raw = f.read().split(b"\n", 1)
+            self._base = int(raw[0])
+            self._last_hash = bytes.fromhex(raw[1].decode()) if len(raw) > 1 else b""
         self._rebuild_index()
         self._f = open(self.path, "ab")
+
+    @classmethod
+    def bootstrap_from_snapshot(
+        cls, path: str, height: int, last_hash: bytes
+    ) -> "BlockStore":
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        if os.path.exists(path):
+            raise ValueError(f"block store already exists at {path}")
+        with open(path + ".base", "wb") as f:
+            f.write(str(height).encode() + b"\n" + last_hash.hex().encode())
+        return cls(path)
 
     # -- index ------------------------------------------------------------
     def _rebuild_index(self) -> None:
@@ -108,7 +129,7 @@ class BlockStore:
 
     def _index_block(self, block: common_pb2.Block, offset: int) -> None:
         num = block.header.number
-        if num != len(self._offsets):
+        if num != self._base + len(self._offsets):
             raise ValueError(f"out-of-order block {num}")
         self._offsets.append(offset)
         h = protoutil.block_header_hash(block.header)
@@ -137,17 +158,23 @@ class BlockStore:
     # -- reads ------------------------------------------------------------
     @property
     def height(self) -> int:
-        return len(self._offsets)
+        return self._base + len(self._offsets)
+
+    @property
+    def base_height(self) -> int:
+        """First block number actually present (0 unless snapshot-bootstrapped)."""
+        return self._base
 
     @property
     def last_block_hash(self) -> bytes:
         return self._last_hash
 
     def get_block_by_number(self, number: int) -> Optional[common_pb2.Block]:
-        if number >= len(self._offsets):
+        idx = number - self._base
+        if idx < 0 or idx >= len(self._offsets):
             return None
         with open(self.path, "rb") as f:
-            f.seek(self._offsets[number])
+            f.seek(self._offsets[idx])
             ln = _read_varint(f)
             return protoutil.unmarshal(common_pb2.Block, f.read(ln))
 
@@ -162,8 +189,41 @@ class BlockStore:
         return txid in self._by_txid
 
     def iter_blocks(self, start: int = 0) -> Iterator[common_pb2.Block]:
-        for n in range(start, self.height):
+        for n in range(max(start, self._base), self.height):
             yield self.get_block_by_number(n)
+
+    def truncate_to(self, target_height: int) -> None:
+        """Rollback support (reference blkstorage reset.go/rollback.go):
+        drop every block with number >= target_height and rebuild the
+        derived indexes."""
+        if target_height < self._base:
+            raise ValueError(
+                f"cannot roll back below snapshot base {self._base}"
+            )
+        if target_height >= self.height:
+            return
+        keep = target_height - self._base
+        self._f.close()
+        cut = (
+            self._offsets[keep]
+            if keep < len(self._offsets)
+            else os.path.getsize(self.path)
+        )
+        with open(self.path, "ab") as f:
+            f.truncate(cut)
+        self._offsets = []
+        self._by_hash = {}
+        self._by_txid = {}
+        self._last_hash = b""
+        meta_path = self.path + ".base"
+        if os.path.exists(meta_path) and self._base:
+            with open(meta_path, "rb") as f:
+                raw = f.read().split(b"\n", 1)
+            self._last_hash = (
+                bytes.fromhex(raw[1].decode()) if len(raw) > 1 else b""
+            )
+        self._rebuild_index()
+        self._f = open(self.path, "ab")
 
     def close(self) -> None:
         self._f.close()

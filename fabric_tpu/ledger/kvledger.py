@@ -256,7 +256,7 @@ class KVLedger:
         entries = [
             PvtEntry(tx_num, ns, coll, raw)
             for (tx_num, ns, coll), raw in sorted((pvt_data or {}).items())
-            if codes[tx_num] == TxValidationCode.VALID
+            if tx_num < len(codes) and codes[tx_num] == TxValidationCode.VALID
         ]
         pvt_batch = self._pvt_batch(
             block.header.number, entries, codes, rwsets, verify_hashes=True
@@ -367,6 +367,38 @@ class KVLedger:
         for (ns, key), entry in updates.items():
             self.history.setdefault((ns, key), []).append(entry.version)
         self.state_db.apply_updates(updates, hashed, pvt)
+
+    # -- admin ops (reference kvledger reset.go / rollback.go /
+    #    rebuild_dbs.go: state & history are derived caches over the
+    #    block store, so both ops are truncate-then-replay) -------------
+    def rebuild_dbs(self) -> None:
+        """Drop the derived state/history caches and replay the block
+        store (peer node rebuild-dbs / reset). Refused on a
+        snapshot-bootstrapped ledger: pre-snapshot state exists only in
+        the (gone) snapshot, not the block store (the reference refuses
+        reset/rollback/rebuild on bootstrapped ledgers too)."""
+        if self.block_store.base_height > 0:
+            raise ValueError(
+                "cannot rebuild a snapshot-bootstrapped ledger: state "
+                f"below block {self.block_store.base_height} is not in "
+                "the block store"
+            )
+        self.state_db = VersionedDB()
+        self.history = {}
+        self.commit_hash = b""
+        self._recover()
+
+    def rollback(self, target_block: int) -> None:
+        """Roll the channel back so target_block is the last block."""
+        if self.block_store.base_height > 0:
+            raise ValueError(
+                "cannot roll back a snapshot-bootstrapped ledger"
+            )
+        self.block_store.truncate_to(target_block + 1)
+        # the pvt store must rewind too, or re-committed blocks skip pvt
+        # persistence (last_committed guard) and replay stale records
+        self.pvt_store.rollback_to(target_block + 1)
+        self.rebuild_dbs()
 
     # -- queries (qscc analog) --------------------------------------------
     @property

@@ -50,6 +50,8 @@ def _w_bytes(out: bytearray, b: bytes) -> None:
 def _r_bytes(buf: memoryview, off: int) -> Tuple[bytes, int]:
     (ln,) = struct.unpack_from("<I", buf, off)
     off += 4
+    if off + ln > len(buf):
+        raise ValueError("truncated record")
     return bytes(buf[off : off + ln]), off + ln
 
 
@@ -89,6 +91,10 @@ class PvtDataStore:
                 f.truncate(valid_end)
 
     def _load_record(self, rec: bytes) -> None:
+        """Replay one record. Multiple records for the same block are the
+        backfill case (commit_pvt_data_of_old_blocks appends): entries
+        accumulate and clear any matching missing markers, reproducing
+        the in-memory state at the time of the crash."""
         buf = memoryview(rec)
         (block_num, n_entries, n_missing) = struct.unpack_from("<QII", buf, 0)
         off = 16
@@ -109,9 +115,21 @@ class PvtDataStore:
             missing.append(
                 MissingEntry(tx_num, ns.decode(), coll.decode(), bool(eligible))
             )
-        self._by_block[block_num] = entries
-        if missing:
-            self._missing[block_num] = missing
+        self._by_block.setdefault(block_num, []).extend(entries)
+        still = [
+            m
+            for m in self._missing.get(block_num, [])
+            if not any(
+                e.tx_num == m.tx_num
+                and e.namespace == m.namespace
+                and e.collection == m.collection
+                for e in entries
+            )
+        ] + missing
+        if still:
+            self._missing[block_num] = still
+        else:
+            self._missing.pop(block_num, None)
         self._last_committed = max(self._last_committed, block_num)
 
     def _append_record(
@@ -223,6 +241,24 @@ class PvtDataStore:
             self._missing[block_num] = still
         else:
             self._missing.pop(block_num, None)
+
+    def rollback_to(self, height: int) -> None:
+        """Drop every record for block >= height and compact the file
+        (KVLedger.rollback counterpart; the reference's pvtdata store
+        rollback in kvledger rollback.go)."""
+        self._f.close()
+        self._by_block = {b: e for b, e in self._by_block.items() if b < height}
+        self._missing = {b: m for b, m in self._missing.items() if b < height}
+        self._last_committed = max(self._by_block, default=-1)
+        tmp = self.path + ".compact"
+        with open(tmp, "wb") as f:
+            self._f = f
+            for bnum in sorted(self._by_block):
+                self._append_record(
+                    bnum, self._by_block[bnum], self._missing.get(bnum, [])
+                )
+        os.replace(tmp, self.path)
+        self._f = open(self.path, "ab")
 
     def close(self) -> None:
         self._f.close()

@@ -1,0 +1,176 @@
+"""Ledger snapshots (reference core/ledger/kvledger/snapshot.go:
+generateSnapshot :94, CreateFromSnapshot :221).
+
+Export writes a deterministic directory:
+  public_state.data          (ns, key, value, version, metadata) sorted
+  private_state_hashes.data  (ns, coll, key_hash, value_hash, version)
+  txids.data                 sorted committed TxIDs
+  _snapshot_signable_metadata.json
+      channel name, height, last/prev block hash, per-file SHA-256 —
+      the cross-peer comparable fingerprint (the reference signs this).
+
+Import (join-by-snapshot) builds a fresh ledger whose block store starts
+at the snapshot height with no block prefix; state and the txid
+dedup index come from the snapshot files; history before the snapshot is
+unavailable, exactly like the reference.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import struct
+from typing import Dict, List, Tuple
+
+from fabric_tpu.ledger.rwset import Version
+
+SIGNABLE_METADATA = "_snapshot_signable_metadata.json"
+PUBLIC_STATE = "public_state.data"
+PVT_HASHES = "private_state_hashes.data"
+TXIDS = "txids.data"
+
+
+def _w(out, b: bytes) -> None:
+    out.write(struct.pack("<I", len(b)))
+    out.write(b)
+
+
+def _r(f) -> bytes:
+    hdr = f.read(4)
+    if len(hdr) < 4:
+        raise EOFError
+    (ln,) = struct.unpack("<I", hdr)
+    return f.read(ln)
+
+
+def _version_bytes(v: Version) -> bytes:
+    return struct.pack("<QQ", v.block_num, v.tx_num)
+
+
+def _version_from(b: bytes) -> Version:
+    bn, tn = struct.unpack("<QQ", b)
+    return Version(bn, tn)
+
+
+def generate_snapshot(ledger, out_dir: str) -> Dict[str, str]:
+    """Export the ledger at its current height. Returns the signable
+    metadata dict (also written to disk)."""
+    os.makedirs(out_dir, exist_ok=True)
+    if ledger.height == 0:
+        raise ValueError("cannot snapshot an empty ledger")
+
+    with open(os.path.join(out_dir, PUBLIC_STATE), "wb") as f:
+        for ns in sorted(ledger.state_db._data):
+            table = ledger.state_db._data[ns]
+            for key in sorted(table):
+                vv = table[key]
+                _w(f, ns.encode())
+                _w(f, key.encode())
+                _w(f, vv.value)
+                _w(f, _version_bytes(vv.version))
+                _w(f, vv.metadata or b"")
+
+    with open(os.path.join(out_dir, PVT_HASHES), "wb") as f:
+        for (ns, coll, kh) in sorted(ledger.state_db._hashed):
+            vv = ledger.state_db._hashed[(ns, coll, kh)]
+            _w(f, ns.encode())
+            _w(f, coll.encode())
+            _w(f, kh)
+            _w(f, vv.value)
+            _w(f, _version_bytes(vv.version))
+
+    with open(os.path.join(out_dir, TXIDS), "wb") as f:
+        for txid in sorted(ledger.block_store._by_txid):
+            _w(f, txid.encode())
+
+    files = {}
+    for name in (PUBLIC_STATE, PVT_HASHES, TXIDS):
+        with open(os.path.join(out_dir, name), "rb") as f:
+            files[name] = hashlib.sha256(f.read()).hexdigest()
+    last = ledger.block_store.get_block_by_number(ledger.height - 1)
+    from fabric_tpu.protos import protoutil
+
+    meta = {
+        "channel_name": ledger.channel_id,
+        "last_block_number": ledger.height - 1,
+        "last_block_hash": protoutil.block_header_hash(last.header).hex(),
+        "previous_block_hash": last.header.previous_hash.hex(),
+        "snapshot_files_raw_hashes": files,
+        "state_db_type": "embedded",
+    }
+    with open(os.path.join(out_dir, SIGNABLE_METADATA), "w") as f:
+        json.dump(meta, f, indent=2, sort_keys=True)
+    return meta
+
+
+def verify_snapshot(snap_dir: str) -> dict:
+    """Check per-file hashes against the signable metadata; returns the
+    metadata (import-side integrity check)."""
+    with open(os.path.join(snap_dir, SIGNABLE_METADATA)) as f:
+        meta = json.load(f)
+    for name, want in meta["snapshot_files_raw_hashes"].items():
+        with open(os.path.join(snap_dir, name), "rb") as f:
+            got = hashlib.sha256(f.read()).hexdigest()
+        if got != want:
+            raise ValueError(f"snapshot file {name} hash mismatch")
+    return meta
+
+
+def create_from_snapshot(snap_dir: str, ledger_dir: str):
+    """Join-by-snapshot: build a KVLedger for the snapshot's channel at
+    height last_block_number+1 (kvledger CreateFromSnapshot)."""
+    from fabric_tpu.ledger.blockstore import BlockStore
+    from fabric_tpu.ledger.kvledger import KVLedger
+    from fabric_tpu.ledger.statedb import (
+        HashedUpdateBatch,
+        UpdateBatch,
+    )
+
+    meta = verify_snapshot(snap_dir)
+    channel_id = meta["channel_name"]
+    height = meta["last_block_number"] + 1
+    last_hash = bytes.fromhex(meta["last_block_hash"])
+
+    # bootstrap the block store BEFORE the ledger opens it
+    chain_path = os.path.join(ledger_dir, f"{channel_id}.chain")
+    BlockStore.bootstrap_from_snapshot(chain_path, height, last_hash).close()
+
+    ledger = KVLedger(ledger_dir, channel_id)
+
+    updates = UpdateBatch()
+    with open(os.path.join(snap_dir, PUBLIC_STATE), "rb") as f:
+        while True:
+            try:
+                ns = _r(f).decode()
+            except EOFError:
+                break
+            key = _r(f).decode()
+            value = _r(f)
+            version = _version_from(_r(f))
+            md = _r(f)
+            updates.put(ns, key, value, version, md or None)
+    hashed = HashedUpdateBatch()
+    with open(os.path.join(snap_dir, PVT_HASHES), "rb") as f:
+        while True:
+            try:
+                ns = _r(f).decode()
+            except EOFError:
+                break
+            coll = _r(f).decode()
+            kh = _r(f)
+            vh = _r(f)
+            version = _version_from(_r(f))
+            hashed.put(ns, coll, kh, vh, version)
+    ledger.state_db.apply_updates(updates, hashed)
+
+    with open(os.path.join(snap_dir, TXIDS), "rb") as f:
+        while True:
+            try:
+                txid = _r(f).decode()
+            except EOFError:
+                break
+            # index for duplicate-TxID detection; location unknown -> the
+            # sentinel pre-snapshot marker
+            ledger.block_store._by_txid.setdefault(txid, (-1, -1))
+    return ledger

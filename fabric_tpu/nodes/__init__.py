@@ -1,0 +1,2 @@
+from fabric_tpu.nodes.orderer import OrdererNode  # noqa: F401
+from fabric_tpu.nodes.peer import PeerNode  # noqa: F401

@@ -1,0 +1,134 @@
+"""Orderer node composition root (reference orderer/common/server/
+main.go): multichannel registrar + broadcast handler + deliver engine
+behind one gRPC server serving orderer.AtomicBroadcast.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from fabric_tpu.comm.server import GRPCServer
+from fabric_tpu.comm.services import register_atomic_broadcast
+from fabric_tpu.deliver.server import BlockSource, DeliverHandler
+from fabric_tpu.operations import Options as OpsOptions, System
+from fabric_tpu.orderer.broadcast import BroadcastHandler
+from fabric_tpu.orderer.multichannel import Registrar
+from fabric_tpu.protos import common_pb2
+
+
+def parse_duration(text: str, default: float) -> float:
+    """"2s" / "500ms" / "1m" -> seconds (orderer.yaml BatchTimeout)."""
+    if not text:
+        return default
+    text = text.strip().lower()
+    try:
+        if text.endswith("ms"):
+            return float(text[:-2]) / 1000.0
+        if text.endswith("s"):
+            return float(text[:-1])
+        if text.endswith("m"):
+            return float(text[:-1]) * 60.0
+        return float(text)
+    except ValueError:
+        return default
+
+
+class OrdererNode:
+    def __init__(
+        self,
+        work_dir: str,
+        signer=None,
+        listen_address: str = "127.0.0.1:0",
+        system_channel_id: Optional[str] = None,
+        ops_address: Optional[str] = None,
+        provider=None,
+    ):
+        self.registrar = Registrar(
+            work_dir,
+            signer=signer,
+            system_channel_id=system_channel_id,
+            provider=provider,
+        )
+        self.broadcast = BroadcastHandler(self.registrar, signer=signer)
+        self._block_events: dict[str, threading.Condition] = {}
+        self.registrar.on_block(self._notify_block)
+
+        self.deliver = DeliverHandler(self._block_source)
+        self.server = GRPCServer(listen_address)
+        register_atomic_broadcast(self.server, self.broadcast, self.deliver)
+
+        self.ops: Optional[System] = None
+        if ops_address is not None:
+            self.ops = System(OpsOptions(listen_address=ops_address))
+            self.ops.register_checker("registrar", lambda: None)
+
+    # -- block availability signaling (deliver BLOCK_UNTIL_READY) --------
+    def _cond(self, channel_id: str) -> threading.Condition:
+        return self._block_events.setdefault(channel_id, threading.Condition())
+
+    def _notify_block(self, channel_id: str, _block) -> None:
+        cond = self._cond(channel_id)
+        with cond:
+            cond.notify_all()
+
+    def _block_source(self, channel_id: str) -> Optional[BlockSource]:
+        support = self.registrar.get_chain(channel_id)
+        if support is None:
+            return None
+        cond = self._cond(channel_id)
+
+        def wait_for(number: int, timeout: float) -> bool:
+            deadline = threading.TIMEOUT_MAX if timeout is None else timeout
+            with cond:
+                if support.height > number:
+                    return True
+                cond.wait(timeout=deadline)
+            return support.height > number
+
+        return BlockSource(support.get_block, lambda: support.height, wait_for)
+
+    # -- lifecycle -------------------------------------------------------
+    def join_channel(self, genesis_block: common_pb2.Block):
+        return self.registrar.join_channel(genesis_block)
+
+    def _flush_loop(self) -> None:
+        """Batch-timeout ticker (reference blockcutter timer in the
+        consenter run loops): cut pending batches for every channel at
+        each channel's BatchTimeout cadence."""
+        while not self._stopped.wait(self._next_flush_interval()):
+            for support in list(self.registrar.chains.values()):
+                try:
+                    support.chain.flush()
+                except Exception:  # noqa: BLE001 - chain-local failure
+                    pass
+
+    def _next_flush_interval(self) -> float:
+        intervals = [0.5]
+        for support in self.registrar.chains.values():
+            if support.bundle.orderer is not None:
+                intervals.append(
+                    parse_duration(support.bundle.orderer.batch_timeout, 0.5)
+                )
+        return max(0.05, min(intervals))
+
+    def start(self) -> str:
+        if self.ops is not None:
+            self.ops.start()
+        self._stopped = threading.Event()
+        self._flusher = threading.Thread(
+            target=self._flush_loop, name="blockcutter-timeout", daemon=True
+        )
+        self._flusher.start()
+        return self.server.start()
+
+    def stop(self) -> None:
+        if getattr(self, "_stopped", None) is not None:
+            self._stopped.set()
+        self.server.stop()
+        if self.ops is not None:
+            self.ops.stop()
+
+    @property
+    def addr(self) -> str:
+        return self.server.addr

@@ -1,0 +1,252 @@
+"""Peer node composition root (reference usable-inter-nal/peer/node/
+start.go serve()): channels + endorser + chaincode support + system
+chaincodes + deliver services behind one gRPC server, plus a
+deliver-client loop pulling blocks from the orderer into the commit
+pipeline (core/deliverservice).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Callable, Dict, Optional
+
+from fabric_tpu.chaincode.support import ChaincodeSupport
+from fabric_tpu.comm.server import GRPCServer, channel_to
+from fabric_tpu.comm.services import (
+    deliver_stream,
+    register_endorser,
+    register_peer_deliver,
+)
+from fabric_tpu.deliver.client import seek_envelope
+from fabric_tpu.deliver.server import BlockSource, DeliverHandler
+from fabric_tpu.endorser.endorser import Endorser
+from fabric_tpu.gossip.coordinator import TransientStore
+from fabric_tpu.msp.identity import MSPManager
+from fabric_tpu.msp.signer import SigningIdentity
+from fabric_tpu.operations import Options as OpsOptions, System
+from fabric_tpu.peer.channel import Channel
+from fabric_tpu.protos import ab_pb2, common_pb2
+from fabric_tpu.scc import CSCC, LSCC, QSCC
+from fabric_tpu.validation.validator import ChaincodeRegistry
+
+
+class PeerNode:
+    def __init__(
+        self,
+        work_dir: str,
+        msp_manager: MSPManager,
+        signer: SigningIdentity,
+        registry_factory: Callable[[str], ChaincodeRegistry],
+        listen_address: str = "127.0.0.1:0",
+        ops_address: Optional[str] = None,
+        provider=None,
+    ):
+        self.work_dir = work_dir
+        self.msp_manager = msp_manager
+        self.signer = signer
+        self.provider = provider
+        self._registry_factory = registry_factory
+        self.channels: Dict[str, Channel] = {}
+        self.transient = TransientStore()
+        self._commit_conds: Dict[str, threading.Condition] = {}
+        self._stop = threading.Event()
+        self._pull_threads: list[threading.Thread] = []
+        # last deliver-loop failure per channel (blocksprovider logging)
+        self.deliver_errors: Dict[str, str] = {}
+
+        self.support = ChaincodeSupport(
+            state_getter=lambda cid: (
+                self.channels[cid].ledger.state_db
+                if cid in self.channels
+                else None
+            )
+        )
+        self.support.register(
+            "qscc",
+            QSCC(lambda cid: self._ledger(cid)),
+            system=True,
+        )
+        self.support.register(
+            "cscc",
+            CSCC(
+                join_chain=self.join_channel,
+                channel_list=lambda: sorted(self.channels),
+                get_config_block=self._config_block,
+            ),
+            system=True,
+        )
+        self.support.register(
+            "lscc", LSCC(self._list_chaincodes), system=True
+        )
+
+        self.endorser = Endorser(
+            signer,
+            msp_manager,
+            self.support,
+            get_ledger=lambda cid: self._ledger(cid),
+        )
+        self.deliver = DeliverHandler(self._block_source)
+        self.server = GRPCServer(listen_address)
+        register_endorser(self.server, self.endorser)
+        register_peer_deliver(self.server, self.deliver)
+
+        self.ops: Optional[System] = None
+        if ops_address is not None:
+            self.ops = System(OpsOptions(listen_address=ops_address))
+
+    # -- helpers ---------------------------------------------------------
+    def _ledger(self, channel_id: str):
+        ch = self.channels.get(channel_id)
+        return ch.ledger if ch else None
+
+    def _config_block(self, channel_id: str):
+        """Latest config block via the last block's LAST_CONFIG pointer
+        (reference cscc getConfigBlock -> blockledger lastConfig)."""
+        ch = self.channels.get(channel_id)
+        if ch is None:
+            return None
+        store = ch.ledger.block_store
+        last = store.get_block_by_number(store.height - 1)
+        if last is None:
+            return None
+        metas = last.metadata.metadata
+        if len(metas) > common_pb2.SIGNATURES and metas[common_pb2.SIGNATURES]:
+            from fabric_tpu.protos import protoutil
+
+            try:
+                meta = protoutil.unmarshal(
+                    common_pb2.Metadata, metas[common_pb2.SIGNATURES]
+                )
+                if meta.value:
+                    lc = protoutil.unmarshal(common_pb2.LastConfig, meta.value)
+                    pointed = store.get_block_by_number(lc.index)
+                    if pointed is not None:
+                        return pointed
+            except ValueError:
+                pass
+        return store.get_block_by_number(store.base_height)
+
+    def _list_chaincodes(self):
+        out = []
+        for cid, ch in self.channels.items():
+            for name in ch.validator.registry.names():
+                out.append((name, "1.0"))
+        return sorted(set(out))
+
+    def _block_source(self, channel_id: str) -> Optional[BlockSource]:
+        ch = self.channels.get(channel_id)
+        if ch is None:
+            return None
+        cond = self._commit_conds.setdefault(channel_id, threading.Condition())
+
+        def wait_for(number: int, timeout: float) -> bool:
+            with cond:
+                if ch.ledger.height > number:
+                    return True
+                cond.wait(timeout=timeout)
+            return ch.ledger.height > number
+
+        return BlockSource(
+            ch.ledger.block_store.get_block_by_number,
+            lambda: ch.ledger.height,
+            wait_for,
+        )
+
+    # -- channel lifecycle ----------------------------------------------
+    def join_channel(self, genesis_block: common_pb2.Block) -> Channel:
+        """cscc JoinChain: bootstrap the channel from its genesis block
+        (core/peer/peer.go createChannel)."""
+        from fabric_tpu.protos import protoutil
+
+        env = protoutil.get_envelope_from_block_data(genesis_block.data.data[0])
+        payload = protoutil.unmarshal(common_pb2.Payload, env.payload)
+        chdr = protoutil.unmarshal(
+            common_pb2.ChannelHeader, payload.header.channel_header
+        )
+        channel_id = chdr.channel_id
+        if channel_id in self.channels:
+            raise ValueError(f"channel {channel_id} already joined")
+        ch = Channel(
+            channel_id,
+            os.path.join(self.work_dir, channel_id),
+            self.msp_manager,
+            self._registry_factory(channel_id),
+            self.provider,
+            transient_store=self.transient,
+        )
+        if ch.ledger.height == 0:
+            ch.ledger.commit(genesis_block)
+        self.channels[channel_id] = ch
+        return ch
+
+    def commit_block(self, channel_id: str, block: common_pb2.Block):
+        ch = self.channels[channel_id]
+        flags = ch.store_block(block)
+        cond = self._commit_conds.setdefault(channel_id, threading.Condition())
+        with cond:
+            cond.notify_all()
+        return flags
+
+    # -- deliver client (core/deliverservice) ----------------------------
+    def start_deliver_for_channel(
+        self, channel_id: str, orderer_addr: str
+    ) -> threading.Thread:
+        """Pull blocks from the orderer and feed the commit pipeline
+        (blocksprovider.DeliverBlocks). Reconnects with backoff until
+        stop() — each reconnect re-seeks from the current height."""
+
+        def run():
+            backoff = 0.05
+            while not self._stop.is_set():
+                try:
+                    ch = self.channels[channel_id]
+                    env = seek_envelope(
+                        channel_id,
+                        start=ch.ledger.height,
+                        signer=self.signer,
+                    )
+                    conn = channel_to(orderer_addr)
+                    try:
+                        for resp in deliver_stream(conn, env):
+                            if self._stop.is_set():
+                                return
+                            kind = resp.WhichOneof("Type")
+                            if kind == "block":
+                                self.commit_block(channel_id, resp.block)
+                                backoff = 0.05
+                            elif kind == "status":
+                                break
+                    finally:
+                        conn.close()
+                except Exception as exc:  # noqa: BLE001 - retried with backoff
+                    import traceback
+
+                    self.deliver_errors[channel_id] = (
+                        f"{exc}\n{traceback.format_exc()}"
+                    )
+                self._stop.wait(backoff)
+                backoff = min(backoff * 1.2, 2.0)  # reference base 1.2
+
+        t = threading.Thread(
+            target=run, name=f"deliver-{channel_id}", daemon=True
+        )
+        t.start()
+        self._pull_threads.append(t)
+        return t
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> str:
+        if self.ops is not None:
+            self.ops.start()
+        return self.server.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.server.stop()
+        if self.ops is not None:
+            self.ops.stop()
+
+    @property
+    def addr(self) -> str:
+        return self.server.addr

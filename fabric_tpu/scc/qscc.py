@@ -65,8 +65,10 @@ class QSCC:
         store = ledger.block_store
         if ledger.height > 0:
             info.currentBlockHash = store.last_block_hash
+            # absent on a snapshot-bootstrapped store with no blocks yet
             last = store.get_block_by_number(ledger.height - 1)
-            info.previousBlockHash = last.header.previous_hash
+            if last is not None:
+                info.previousBlockHash = last.header.previous_hash
         return success(info.SerializeToString())
 
     def _block_by_number(self, ledger: KVLedger, arg: bytes) -> Response:
@@ -93,7 +95,14 @@ class QSCC:
                 f"Failed to get transaction with id {txid}"
             )
         block_num, tx_num = loc
+        if block_num < 0:
+            # pre-snapshot txid: indexed for dedup only, block not stored
+            return error_response(
+                f"transaction {txid} committed before the ledger snapshot"
+            )
         block = ledger.block_store.get_block_by_number(block_num)
+        if block is None:
+            return error_response(f"Fail to get block {block_num}")
         env = protoutil.get_envelope_from_block_data(block.data.data[tx_num])
         flags = block.metadata.metadata[common_pb2.TRANSACTIONS_FILTER]
         pt = peer_pb2.ProcessedTransaction()
@@ -108,5 +117,11 @@ class QSCC:
             return error_response(
                 f"Failed to get transaction with id {arg.decode()}"
             )
+        if loc[0] < 0:
+            return error_response(
+                f"transaction {arg.decode()} committed before the ledger snapshot"
+            )
         block = ledger.block_store.get_block_by_number(loc[0])
+        if block is None:
+            return error_response(f"Fail to get block {loc[0]}")
         return success(block.SerializeToString())

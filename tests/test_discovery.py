@@ -1,0 +1,162 @@
+"""Service discovery: principal-set inquire, peers/config/endorsers
+queries, auth (reference discovery/, common/policies/inquire)."""
+
+import pytest
+
+from fabric_tpu.channelconfig import (
+    ApplicationProfile,
+    OrdererProfile,
+    OrganizationProfile,
+    Profile,
+    genesis_block,
+)
+from fabric_tpu.channelconfig.bundle import bundle_from_genesis_block
+from fabric_tpu.crypto.bccsp import SoftwareProvider
+from fabric_tpu.discovery import DiscoveryService, PeerInfo, satisfied_by
+from fabric_tpu.discovery.inquire import TooManyCombinationsError
+from fabric_tpu.msp.cryptogen import generate_org
+from fabric_tpu.msp.signer import SigningIdentity
+from fabric_tpu.policy import from_dsl
+from fabric_tpu.policy.ast import MSPRole, Role
+from fabric_tpu.policy.manager import SignedData
+
+PROVIDER = SoftwareProvider()
+
+
+# ---------------- inquire ----------------
+
+
+def test_satisfied_by_and():
+    sets = satisfied_by(from_dsl("AND('A.member','B.member')"))
+    assert sets == [
+        (MSPRole("A", Role.MEMBER), MSPRole("B", Role.MEMBER)),
+    ]
+
+
+def test_satisfied_by_or():
+    sets = satisfied_by(from_dsl("OR('A.member','B.member')"))
+    assert sets == [
+        (MSPRole("A", Role.MEMBER),),
+        (MSPRole("B", Role.MEMBER),),
+    ]
+
+
+def test_satisfied_by_nested_outof():
+    sets = satisfied_by(
+        from_dsl("OutOf(2,'A.member','B.member','C.member')")
+    )
+    assert len(sets) == 3
+    assert (MSPRole("A", Role.MEMBER), MSPRole("B", Role.MEMBER)) in sets
+    assert (MSPRole("A", Role.MEMBER), MSPRole("C", Role.MEMBER)) in sets
+    assert (MSPRole("B", Role.MEMBER), MSPRole("C", Role.MEMBER)) in sets
+
+
+def test_satisfied_by_nested_combination():
+    sets = satisfied_by(
+        from_dsl("AND('A.member', OR('B.member','C.member'))")
+    )
+    assert len(sets) == 2
+    for s in sets:
+        assert MSPRole("A", Role.MEMBER) in s
+
+
+def test_combination_cap():
+    terms = ",".join(f"'O{i}.member'" for i in range(30))
+    with pytest.raises(TooManyCombinationsError):
+        satisfied_by(from_dsl(f"OutOf(15,{terms})"))
+
+
+# ---------------- service ----------------
+
+
+@pytest.fixture(scope="module")
+def world():
+    org1 = generate_org("org1.example.com", "Org1MSP")
+    org2 = generate_org("org2.example.com", "Org2MSP")
+    oorg = generate_org("orderer.example.com", "OrdererMSP")
+    profile = Profile(
+        application=ApplicationProfile(
+            organizations=[
+                OrganizationProfile("Org1MSP", org1.msp_config()),
+                OrganizationProfile("Org2MSP", org2.msp_config()),
+            ]
+        ),
+        orderer=OrdererProfile(
+            orderer_type="solo",
+            addresses=["orderer0:7050"],
+            organizations=[OrganizationProfile("OrdererMSP", oorg.msp_config())],
+        ),
+    )
+    bundle = bundle_from_genesis_block(
+        genesis_block(profile, "dchannel"), provider=PROVIDER
+    )
+    peers = [
+        PeerInfo("Org1MSP", "peer0.org1:7051", 10, ("mycc",)),
+        PeerInfo("Org1MSP", "peer1.org1:7051", 12, ("mycc", "other")),
+        PeerInfo("Org2MSP", "peer0.org2:7051", 11, ("mycc",)),
+    ]
+    policy = from_dsl("AND('Org1MSP.member','Org2MSP.member')")
+    svc = DiscoveryService(
+        peers_provider=lambda ch: peers if ch == "dchannel" else [],
+        bundle_provider=lambda ch: bundle if ch == "dchannel" else None,
+        policy_provider=lambda cc, ch: policy if cc == "mycc" else None,
+    )
+    return {"svc": svc, "org1": org1, "org2": org2, "peers": peers}
+
+
+def _client(org):
+    s = SigningIdentity(org.users[0], PROVIDER)
+    return SignedData(b"req", s.serialize(), s.sign(b"req"))
+
+
+def test_peers_query(world):
+    got = world["svc"].peers("dchannel", _client(world["org1"]))
+    assert [p.endpoint for p in got] == [
+        "peer0.org1:7051",
+        "peer1.org1:7051",
+        "peer0.org2:7051",
+    ]
+
+
+def test_config_query(world):
+    cfg = world["svc"].config("dchannel", _client(world["org1"]))
+    assert cfg["msps"] == ["OrdererMSP", "Org1MSP", "Org2MSP"]
+    assert any("orderer0:7050" in eps for eps in cfg["orderers"].values())
+
+
+def test_endorsers_query(world):
+    desc = world["svc"].endorsers("dchannel", "mycc", _client(world["org1"]))
+    assert len(desc.layouts) == 1
+    layout = desc.layouts[0]
+    assert sorted(layout.values()) == [1, 1]
+    # groups: Org1 group has 2 peers (height-descending), Org2 group 1
+    sizes = sorted(len(v) for v in desc.endorsers_by_groups.values())
+    assert sizes == [1, 2]
+    for members in desc.endorsers_by_groups.values():
+        if len(members) == 2:
+            assert members[0].ledger_height >= members[1].ledger_height
+
+
+def test_endorsers_unknown_chaincode(world):
+    from fabric_tpu.discovery.service import DiscoveryError
+
+    with pytest.raises(DiscoveryError):
+        world["svc"].endorsers("dchannel", "nope", _client(world["org1"]))
+
+
+def test_auth_rejects_stranger(world):
+    from fabric_tpu.discovery.service import DiscoveryError
+
+    stranger = generate_org("rogue.example.com", "Org1MSP")
+    with pytest.raises(DiscoveryError):
+        world["svc"].peers("dchannel", _client(stranger))
+    # cached denial stays denied
+    with pytest.raises(DiscoveryError):
+        world["svc"].peers("dchannel", _client(stranger))
+
+
+def test_unknown_channel(world):
+    from fabric_tpu.discovery.service import DiscoveryError
+
+    with pytest.raises(DiscoveryError):
+        world["svc"].peers("nochannel", _client(world["org1"]))

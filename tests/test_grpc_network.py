@@ -1,0 +1,211 @@
+"""Real-gRPC network e2e: orderer node + two peer nodes on localhost
+ports, SDK-style client flow over the wire (reference integration/e2e
+with NWO, here in-process servers on dynamic ports).
+
+client --gRPC--> peer.ProcessProposal (simulate+endorse)
+client assembles tx --gRPC--> orderer.Broadcast
+peers pull blocks --gRPC--> orderer.Deliver --> commit pipeline
+client observes --gRPC--> peer Deliver/DeliverFiltered
+"""
+
+import time
+
+import pytest
+
+from fabric_tpu.chaincode import ChaincodeStub, Response, success, error_response
+from fabric_tpu.channelconfig import (
+    ApplicationProfile,
+    OrdererProfile,
+    OrganizationProfile,
+    Profile,
+    genesis_block,
+)
+from fabric_tpu.comm.server import channel_to
+from fabric_tpu.comm.services import (
+    broadcast_envelope,
+    deliver_stream,
+    process_proposal,
+)
+from fabric_tpu.crypto.bccsp import SoftwareProvider
+from fabric_tpu.deliver.client import seek_envelope
+from fabric_tpu.endorser import create_proposal, create_signed_tx
+from fabric_tpu.endorser.txbuilder import create_signed_proposal
+from fabric_tpu.msp.cryptogen import generate_org
+from fabric_tpu.msp.identity import MSPManager
+from fabric_tpu.msp.signer import SigningIdentity
+from fabric_tpu.nodes import OrdererNode, PeerNode
+from fabric_tpu.policy import from_dsl
+from fabric_tpu.protos import common_pb2
+from fabric_tpu.validation.validator import ChaincodeDefinition, ChaincodeRegistry
+
+PROVIDER = SoftwareProvider()
+CHANNEL = "grpcchannel"
+
+
+class KVChaincode:
+    def init(self, stub):
+        return success()
+
+    def invoke(self, stub: ChaincodeStub) -> Response:
+        fn, params = stub.get_function_and_parameters()
+        if fn == "put":
+            stub.put_state(params[0], params[1].encode())
+            return success(b"ok")
+        if fn == "get":
+            return success(stub.get_state(params[0]) or b"")
+        return error_response(f"unknown {fn}")
+
+
+@pytest.fixture(scope="module")
+def net(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("grpcnet")
+    org1 = generate_org("org1.example.com", "Org1MSP")
+    org2 = generate_org("org2.example.com", "Org2MSP")
+    oorg = generate_org("orderer.example.com", "OrdererMSP")
+    mgr = MSPManager(
+        [org1.msp(provider=PROVIDER), org2.msp(provider=PROVIDER)]
+    )
+    policy = from_dsl("AND('Org1MSP.member','Org2MSP.member')")
+
+    def registry_factory(channel_id):
+        return ChaincodeRegistry([ChaincodeDefinition("kvcc", policy)])
+
+    profile = Profile(
+        application=ApplicationProfile(
+            organizations=[
+                OrganizationProfile("Org1MSP", org1.msp_config()),
+                OrganizationProfile("Org2MSP", org2.msp_config()),
+            ]
+        ),
+        orderer=OrdererProfile(
+            orderer_type="solo",
+            organizations=[OrganizationProfile("OrdererMSP", oorg.msp_config())],
+        ),
+    )
+    gblock = genesis_block(profile, CHANNEL)
+
+    orderer = OrdererNode(
+        str(tmp / "orderer"), signer=SigningIdentity(oorg.peers[0], PROVIDER)
+    )
+    orderer.join_channel(gblock)
+    orderer.start()
+
+    peers = []
+    for i, org in enumerate((org1, org2)):
+        peer = PeerNode(
+            str(tmp / f"peer{i}"),
+            mgr,
+            SigningIdentity(org.peers[0], PROVIDER),
+            registry_factory,
+            provider=PROVIDER,
+        )
+        peer.support.register("kvcc", KVChaincode())
+        peer.join_channel(gblock)
+        peer.start()
+        peer.start_deliver_for_channel(CHANNEL, orderer.addr)
+        peers.append(peer)
+
+    yield {
+        "orderer": orderer,
+        "peers": peers,
+        "org1": org1,
+        "org2": org2,
+        "client": SigningIdentity(org1.users[0], PROVIDER),
+    }
+    for p in peers:
+        p.stop()
+    orderer.stop()
+
+
+def _wait_height(peers, h, timeout=15.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if all(p.channels[CHANNEL].ledger.height >= h for p in peers):
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_grpc_end_to_end(net):
+    client = net["client"]
+    # 1. endorse on both peers over gRPC
+    bundle = create_proposal(client, CHANNEL, "kvcc", [b"put", b"k1", b"v1"])
+    signed = create_signed_proposal(bundle, client)
+    responses = []
+    for peer in net["peers"]:
+        conn = channel_to(peer.addr)
+        resp = process_proposal(conn, signed)
+        conn.close()
+        assert resp.response.status == 200, resp.response.message
+        responses.append(resp)
+
+    # 2. assemble + broadcast over gRPC
+    env = create_signed_tx(bundle, client, responses)
+    conn = channel_to(net["orderer"].addr)
+    ack = broadcast_envelope(conn, env)
+    conn.close()
+    assert ack.status == common_pb2.SUCCESS, ack.info
+
+    # 3. both peers commit the block via their deliver loops
+    assert _wait_height(net["peers"], 2), (
+        f"peers did not commit in time; deliver errors: "
+        f"{[p.deliver_errors for p in net['peers']]}"
+    )
+    for peer in net["peers"]:
+        ch = peer.channels[CHANNEL]
+        assert ch.ledger.get_state("kvcc", "k1") == b"v1"
+    # cross-peer state fingerprint agreement
+    h0 = net["peers"][0].channels[CHANNEL].ledger.commit_hash
+    h1 = net["peers"][1].channels[CHANNEL].ledger.commit_hash
+    assert h0 == h1 and h0
+
+    # 4. a follow-up query proposal sees the committed value
+    qbundle = create_proposal(client, CHANNEL, "kvcc", [b"get", b"k1"])
+    qsigned = create_signed_proposal(qbundle, client)
+    conn = channel_to(net["peers"][1].addr)
+    qresp = process_proposal(conn, qsigned)
+    conn.close()
+    assert qresp.response.status == 200
+    assert qresp.response.payload == b"v1"
+
+
+def test_grpc_peer_deliver_filtered(net):
+    client = net["client"]
+    peer = net["peers"][0]
+    env = seek_envelope(CHANNEL, start=1, stop=1, signer=client)
+    conn = channel_to(peer.addr)
+    resps = list(
+        deliver_stream(conn, env, service="protos.Deliver", method="DeliverFiltered")
+    )
+    conn.close()
+    fb = [r for r in resps if r.WhichOneof("Type") == "filtered_block"]
+    assert fb, resps
+    assert fb[0].filtered_block.number == 1
+    assert fb[0].filtered_block.filtered_transactions[0].tx_validation_code == 0
+
+
+def test_grpc_qscc_via_endorser(net):
+    client = net["client"]
+    peer = net["peers"][0]
+    bundle = create_proposal(
+        client, CHANNEL, "qscc", [b"GetChainInfo", CHANNEL.encode()]
+    )
+    signed = create_signed_proposal(bundle, client)
+    conn = channel_to(peer.addr)
+    resp = process_proposal(conn, signed)
+    conn.close()
+    assert resp.response.status == 200, resp.response.message
+    info = common_pb2.BlockchainInfo()
+    info.ParseFromString(resp.response.payload)
+    assert info.height >= 2
+
+
+def test_grpc_broadcast_rejects_unknown_channel(net):
+    client = net["client"]
+    bundle = create_proposal(client, "nochannel", "kvcc", [b"put", b"x", b"y"])
+    signed = create_signed_proposal(bundle, client)
+    # endorsement fails on the peer (unknown channel)
+    conn = channel_to(net["peers"][0].addr)
+    resp = process_proposal(conn, signed)
+    conn.close()
+    assert resp.response.status == 500

@@ -4,6 +4,7 @@ hash-checked cleartext writes (reference core/ledger/pvtdatastorage,
 core/common/privdata, gossip/privdata/coordinator.go)."""
 
 import hashlib
+import os
 
 import pytest
 
@@ -85,6 +86,65 @@ def test_pvtdata_store_backfill_clears_missing(tmp_path):
     store.commit_pvt_data_of_old_blocks(0, [late])
     assert store.get_missing_pvt_data() == {}
     assert store.get_pvt_data(0, 0) == [late]
+
+
+def test_pvtdata_backfill_survives_restart(tmp_path):
+    """Regression: backfill records must ACCUMULATE on recovery (not
+    replace the original entries) and cleared missing markers must stay
+    cleared after restart."""
+    path = str(tmp_path / "pvt")
+    store = PvtDataStore(path)
+    a = PvtEntry(0, "mycc", "collA", kvrwset_bytes([("ka", b"va")]))
+    store.commit(0, [a], [MissingEntry(1, "mycc", "collB")])
+    b = PvtEntry(1, "mycc", "collB", kvrwset_bytes([("kb", b"vb")]))
+    store.commit_pvt_data_of_old_blocks(0, [b])
+    assert store.get_missing_pvt_data() == {}
+    assert sorted(e.collection for e in store.get_pvt_data_by_block(0)) == [
+        "collA",
+        "collB",
+    ]
+    store.close()
+
+    again = PvtDataStore(path)
+    assert sorted(e.collection for e in again.get_pvt_data_by_block(0)) == [
+        "collA",
+        "collB",
+    ]
+    assert again.get_missing_pvt_data() == {}
+
+
+def test_pvtdata_recovery_drops_torn_tail(tmp_path):
+    """Regression: a partially-written final record is discarded, not
+    accepted with a truncated field."""
+    path = str(tmp_path / "pvt")
+    store = PvtDataStore(path)
+    good = PvtEntry(0, "mycc", "c", kvrwset_bytes([("k", b"v")]))
+    store.commit(0, [good])
+    store.close()
+    size_after_good = os.path.getsize(path)
+    # simulate a crash mid-append: a frame claiming more bytes than exist
+    with open(path, "ab") as f:
+        f.write((1000).to_bytes(4, "little") + b"partial body")
+    again = PvtDataStore(path)
+    assert again.get_pvt_data(0, 0) == [good]
+    assert os.path.getsize(path) == size_after_good  # tail trimmed
+
+
+def test_pvtdata_rollback_rewinds_store(tmp_path):
+    store = PvtDataStore(str(tmp_path / "pvt"))
+    e0 = PvtEntry(0, "mycc", "c", kvrwset_bytes([("k0", b"v0")]))
+    e1 = PvtEntry(0, "mycc", "c", kvrwset_bytes([("k1", b"v1")]))
+    store.commit(0, [e0])
+    store.commit(1, [e1])
+    store.rollback_to(1)
+    assert store.last_committed_block == 0
+    assert store.get_pvt_data_by_block(1) == []
+    # new commits at the rolled-back height work again
+    store.commit(1, [e1])
+    assert store.last_committed_block == 1
+    store.close()
+    again = PvtDataStore(str(tmp_path / "pvt"))
+    assert again.last_committed_block == 1
 
 
 # ---------------- collections ----------------
