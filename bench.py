@@ -95,7 +95,7 @@ def bench_cpu_baseline(triples, budget_s=2.0):
 
 
 def main():
-    n = int(os.environ.get("BENCH_N", "8192"))
+    n = int(os.environ.get("BENCH_N", "16384"))
     iters = int(os.environ.get("BENCH_ITERS", "5"))
 
     import jax

@@ -62,6 +62,13 @@ class Provider:
     def hash(self, msg: bytes) -> bytes:
         return hashlib.sha256(msg).digest()
 
+    def batch_hash(self, msgs: Sequence[bytes]) -> List[bytes]:
+        """One digest per message; implementations may batch (the native
+        C++ SHA-256 below). Must equal [self.hash(m) for m in msgs]."""
+        from fabric_tpu.utils.native import batch_sha256
+
+        return [bytes(d) for d in batch_sha256(msgs)]
+
     def key_import(self, raw: bytes) -> ECDSAPublicKey:
         x, y = p256.pubkey_from_bytes(raw)
         return ECDSAPublicKey(x, y)

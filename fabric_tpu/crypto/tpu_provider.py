@@ -19,12 +19,11 @@ from typing import Dict, List, Sequence, Tuple
 
 import numpy as np
 
-from fabric_tpu.crypto import der, p256
+from fabric_tpu.crypto import p256
 from fabric_tpu.crypto.bccsp import (
     ECDSAPublicKey,
     Provider,
     VerifyError,
-    parse_and_precheck,
 )
 from fabric_tpu.ops import bignum as bn
 
@@ -51,10 +50,6 @@ def be_bytes_to_limbs(rows: np.ndarray) -> np.ndarray:
     weights = (1 << np.arange(bn.LIMB_BITS, dtype=np.uint32)).astype(np.uint32)
     limbs = (bits.astype(np.uint32) * weights).sum(axis=2, dtype=np.uint32)
     return np.ascontiguousarray(limbs.T)
-
-
-def int_to_be32(x: int) -> bytes:
-    return x.to_bytes(32, "big")
 
 
 class TPUProvider(Provider):
@@ -113,50 +108,53 @@ class TPUProvider(Provider):
                 except VerifyError:
                     out.append(False)
             return out
-        parsed: List[Optional[Tuple[int, int]]] = []
-        for sig in signatures:
-            try:
-                parsed.append(parse_and_precheck(sig))
-            except VerifyError:
-                parsed.append(None)  # becomes False in the mask
-        return self._batch_verify_parsed(keys, parsed, digests)
+        return self._batch_verify_native(keys, signatures, digests)
 
-    def _batch_verify_parsed(
+    def _batch_verify_native(
         self,
         keys: Sequence[ECDSAPublicKey],
-        parsed: Sequence[Optional[Tuple[int, int]]],
+        signatures: Sequence[bytes],
         digests: Sequence[bytes],
     ) -> List[bool]:
-        n = len(parsed)
-        if n == 0:
-            return []
-        assert len(keys) == n and len(digests) == n
+        """Vectorized host path: the C++ batched DER parser (falls back to
+        Python transparently) emits fixed-width (r, s) words + validity
+        masks that feed the device kernel with no per-signature Python."""
+        from fabric_tpu.utils import native
 
-        r_bytes = np.zeros((n, 32), dtype=np.uint8)
-        s_bytes = np.zeros((n, 32), dtype=np.uint8)
-        e_bytes = np.zeros((n, 32), dtype=np.uint8)
+        n = len(signatures)
+        r_bytes, s_bytes, ok_u8, low_s = native.batch_der_parse(signatures)
+        # high-S rejected like utils.IsLowS (bccsp/sw/ecdsa.go:41-57)
+        ok = (ok_u8 & low_s).astype(bool)
+
+        if any(len(d) != 32 for d in digests):
+            raise VerifyError("digests must be 32-byte SHA-256 outputs")
+        e_bytes = np.frombuffer(b"".join(digests), dtype=np.uint8).reshape(
+            n, 32
+        )
         qx = np.zeros((bn.NLIMBS, n), dtype=np.uint32)
         qy = np.zeros((bn.NLIMBS, n), dtype=np.uint32)
-        ok = np.zeros((n,), dtype=bool)
-
-        for i, (key, rs, dig) in enumerate(zip(keys, parsed, digests)):
-            if rs is None:
-                continue
-            r, s = rs
-            if not (1 <= r < p256.N and 1 <= s < p256.N):
+        # keys repeat heavily per block (few orgs); dedupe limb conversion
+        for i, key in enumerate(keys):
+            if not ok[i]:
                 continue
             kx, ky, on_curve = self._key_limbs(key)
             if not on_curve:
-                continue  # stays False, like SoftwareProvider's curve check
-            ok[i] = True
-            r_bytes[i] = np.frombuffer(int_to_be32(r), dtype=np.uint8)
-            s_bytes[i] = np.frombuffer(int_to_be32(s), dtype=np.uint8)
-            e_bytes[i] = np.frombuffer(
-                int_to_be32(p256.hash_to_int(dig)), dtype=np.uint8
-            )
+                ok[i] = False
+                continue
             qx[:, i] = kx
             qy[:, i] = ky
+        return self._run_kernel(e_bytes, r_bytes, s_bytes, qx, qy, ok)
 
+    def _run_kernel(
+        self,
+        e_bytes: np.ndarray,
+        r_bytes: np.ndarray,
+        s_bytes: np.ndarray,
+        qx: np.ndarray,
+        qy: np.ndarray,
+        ok: np.ndarray,
+    ) -> List[bool]:
+        n = ok.shape[0]
         size = _bucket(n)
         pad = size - n
 
@@ -173,6 +171,6 @@ class TPUProvider(Provider):
             padded(be_bytes_to_limbs(s_bytes), 1),
             padded(qx, 1),
             padded(qy, 1),
-            padded(ok, 0),
+            padded(ok.astype(bool), 0),
         )
         return list(np.asarray(out)[:n])

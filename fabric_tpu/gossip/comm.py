@@ -1,0 +1,275 @@
+"""Gossip comm over gRPC (reference gossip/comm/comm_impl.go
+GossipStream + gossip/gossip_impl.go message routing).
+
+One ``GossipNode`` per peer process:
+
+* serves ``gossip.Gossip/GossipStream`` (client pushes a stream of
+  GossipMessages, server replies with its own pending messages — the
+  reference's bidi stream collapsed to push + piggyback);
+* a tick loop broadcasts SWIM alive messages (fabric_tpu.gossip.
+  membership) carrying ledger heights, pushes freshly committed blocks
+  (DataMessage) to other members, and runs anti-entropy: when a taller
+  peer shows up in the membership view, request the missing block range
+  directly (state.go antiEntropy -> StateRequest/StateResponse).
+
+Blocks flow into the per-channel StateProvider buffer and commit in
+order through the peer's commit pipeline.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional, Sequence
+
+from fabric_tpu.comm.server import GRPCServer, STREAM_STREAM, channel_to
+from fabric_tpu.gossip.membership import LeaderElection, Membership
+from fabric_tpu.gossip.state import StateProvider
+from fabric_tpu.protos import common_pb2, gossip_pb2
+
+
+class GossipNode:
+    def __init__(
+        self,
+        self_id: str,
+        channel_id: str,
+        state: StateProvider,
+        get_block: Callable[[int], Optional[common_pb2.Block]],
+        height: Callable[[], int],
+        listen_address: str = "127.0.0.1:0",
+        tick_interval: float = 0.2,
+    ):
+        self.self_id = self_id
+        self.channel_id = channel_id
+        self.state = state
+        self._get_block = get_block
+        self._height = height
+        self.membership = Membership(self_id)
+        self.election = LeaderElection(self.membership)
+        self._endpoints: Dict[str, str] = {}  # peer id -> endpoint
+        self._conns: Dict[str, object] = {}  # endpoint -> grpc channel
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._tick_interval = tick_interval
+
+        self.server = GRPCServer(listen_address)
+        self.server.register(
+            "gossip.Gossip",
+            {
+                "GossipStream": (
+                    STREAM_STREAM,
+                    self._gossip_stream,
+                    gossip_pb2.GossipMessage.FromString,
+                    gossip_pb2.GossipMessage.SerializeToString,
+                ),
+            },
+        )
+        self._thread: Optional[threading.Thread] = None
+
+    # -- server side ------------------------------------------------------
+    def _gossip_stream(self, request_iterator, context):
+        for msg in request_iterator:
+            reply = self._handle(msg)
+            if reply is not None:
+                yield reply
+
+    def _handle(
+        self, msg: gossip_pb2.GossipMessage
+    ) -> Optional[gossip_pb2.GossipMessage]:
+        kind = msg.WhichOneof("content")
+        if kind == "alive_msg":
+            alive = msg.alive_msg
+            pid = alive.membership.pki_id.decode()
+            if pid == self.self_id:
+                return None
+            with self._lock:
+                self._endpoints[pid] = alive.membership.endpoint
+            advanced = self.membership.handle_alive(
+                {
+                    "id": pid,
+                    "endpoint": alive.membership.endpoint,
+                    "seq": alive.seq_num,
+                    "metadata": alive.membership.ledger_height.to_bytes(8, "big"),
+                }
+            )
+            if advanced:
+                # push-forward fresh alive messages so the view spreads
+                # transitively (gossip_impl.go forwards messages that
+                # advanced the local view); seq dedup stops loops
+                for endpoint in self._peer_endpoints():
+                    if endpoint != alive.membership.endpoint:
+                        threading.Thread(
+                            target=self._send,
+                            args=(endpoint, [msg]),
+                            daemon=True,
+                        ).start()
+        elif kind == "data_msg":
+            block = common_pb2.Block()
+            block.ParseFromString(msg.data_msg.block)
+            if self.state.add_payload(block):
+                self._drain()
+        elif kind == "state_request":
+            blocks = self.state.handle_state_request(
+                msg.state_request.start_seq_num,
+                msg.state_request.end_seq_num,
+                self._get_block,
+            )
+            resp = gossip_pb2.GossipMessage()
+            resp.channel = self.channel_id
+            resp.state_response.blocks.extend(
+                b.SerializeToString() for b in blocks
+            )
+            return resp
+        elif kind == "state_response":
+            parsed = []
+            for raw in msg.state_response.blocks:
+                b = common_pb2.Block()
+                b.ParseFromString(raw)
+                parsed.append(b)
+            try:
+                self.state.handle_state_response(parsed)
+            except Exception:
+                pass
+        return None
+
+    def _drain(self) -> None:
+        try:
+            self.state.deliver_payloads()
+        except Exception:
+            pass
+
+    # -- push side --------------------------------------------------------
+    def _alive_message(self) -> gossip_pb2.GossipMessage:
+        tick = self.membership.tick()
+        self.election.evaluate()
+        msg = gossip_pb2.GossipMessage()
+        msg.channel = self.channel_id
+        msg.alive_msg.membership.endpoint = self.server.addr
+        msg.alive_msg.membership.pki_id = self.self_id.encode()
+        msg.alive_msg.membership.ledger_height = self._height()
+        msg.alive_msg.seq_num = tick["seq"]
+        return msg
+
+    def _conn(self, endpoint: str):
+        """One cached channel per peer (reference comm_impl connStore)."""
+        with self._lock:
+            conn = self._conns.get(endpoint)
+            if conn is None:
+                conn = channel_to(endpoint)
+                self._conns[endpoint] = conn
+            return conn
+
+    def _send(self, endpoint: str, messages: Sequence[gossip_pb2.GossipMessage]):
+        try:
+            conn = self._conn(endpoint)
+            stub = conn.stream_stream(
+                "/gossip.Gossip/GossipStream",
+                request_serializer=gossip_pb2.GossipMessage.SerializeToString,
+                response_deserializer=gossip_pb2.GossipMessage.FromString,
+            )
+            for reply in stub(iter(list(messages))):
+                self._handle(reply)
+        except Exception:
+            # dead peer: drop the cached connection; membership expiry
+            # will remove it from the view
+            with self._lock:
+                conn = self._conns.pop(endpoint, None)
+            if conn is not None:
+                try:
+                    conn.close()
+                except Exception:
+                    pass
+
+    def broadcast_block(self, block: common_pb2.Block) -> None:
+        """Leader push after pulling from the orderer (gossip DataMsg)."""
+        msg = gossip_pb2.GossipMessage()
+        msg.channel = self.channel_id
+        msg.data_msg.seq_num = block.header.number
+        msg.data_msg.block = block.SerializeToString()
+        for endpoint in self._peer_endpoints():
+            self._send(endpoint, [msg])
+
+    def _peer_endpoints(self) -> List[str]:
+        with self._lock:
+            return [
+                self._endpoints[pid]
+                for pid in self.membership.alive_peers()
+                if pid in self._endpoints and pid != self.self_id
+            ]
+
+    def _peer_heights(self) -> List[int]:
+        out = []
+        for pid in self.membership.alive_peers():
+            meta = self.membership.metadata_of(pid)
+            if meta and len(meta) == 8:
+                out.append(int.from_bytes(meta, "big"))
+        return out
+
+    def _tick_once(self) -> None:
+        alive = self._alive_message()
+        for endpoint in self._peer_endpoints():
+            self._send(endpoint, [alive])
+        # anti-entropy: ask ONE taller peer for the missing range
+        rng = self.state.missing_range(self._peer_heights())
+        if rng is not None:
+            endpoints = self._taller_peer_endpoints(rng.stop)
+            if endpoints:
+                req = gossip_pb2.GossipMessage()
+                req.channel = self.channel_id
+                req.state_request.start_seq_num = rng.start
+                req.state_request.end_seq_num = rng.stop
+                self._send(endpoints[0], [req])
+        self._drain()
+
+    def _taller_peer_endpoints(self, needed_height: int) -> List[str]:
+        out = []
+        with self._lock:
+            for pid in self.membership.alive_peers():
+                meta = self.membership.metadata_of(pid)
+                if (
+                    meta
+                    and len(meta) == 8
+                    and int.from_bytes(meta, "big") >= needed_height
+                    and pid in self._endpoints
+                    and pid != self.self_id
+                ):
+                    out.append(self._endpoints[pid])
+        return out
+
+    # -- lifecycle --------------------------------------------------------
+    def connect(self, endpoint: str) -> None:
+        """Bootstrap: introduce ourselves to an anchor peer."""
+        self._send(endpoint, [self._alive_message()])
+
+    def start(self) -> str:
+        addr = self.server.start()
+
+        def loop():
+            while not self._stop.wait(self._tick_interval):
+                try:
+                    self._tick_once()
+                except Exception:
+                    pass
+
+        self._thread = threading.Thread(target=loop, name="gossip", daemon=True)
+        self._thread.start()
+        return addr
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._lock:
+            conns = list(self._conns.values())
+            self._conns.clear()
+        for conn in conns:
+            try:
+                conn.close()
+            except Exception:
+                pass
+        self.server.stop()
+
+    @property
+    def addr(self) -> str:
+        return self.server.addr
+
+    @property
+    def is_leader(self) -> bool:
+        return self.election.is_leader

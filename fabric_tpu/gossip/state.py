@@ -39,6 +39,17 @@ class PayloadBuffer:
         self._items[seq] = block
         return True
 
+    def advance_to(self, seq: int) -> None:
+        """Sync with commits that bypassed the buffer (the deliver client
+        commits directly on the gossip leader): drop buffered blocks below
+        seq and move the cursor forward."""
+        if seq <= self._next:
+            return
+        for n in list(self._items):
+            if n < seq:
+                del self._items[n]
+        self._next = seq
+
     def pop(self) -> Optional[common_pb2.Block]:
         blk = self._items.pop(self._next, None)
         if blk is not None:
@@ -79,6 +90,7 @@ class StateProvider:
         """Reference addPayload: gossiped blocks too far ahead of the
         ledger height are dropped (non-blocking ingest); direct/deliver
         payloads are always buffered."""
+        self.buffer.advance_to(self._height())
         if from_gossip and block.header.number >= self._height() + self.max_block_dist:
             self.buffer.dropped += 1
             return False
@@ -90,6 +102,7 @@ class StateProvider:
         committed. Raises CommitFailure on commit error."""
         if self.failed:
             raise CommitFailure(f"channel {self.channel_id} previously failed")
+        self.buffer.advance_to(self._height())
         committed = 0
         while self.buffer.ready():
             block = self.buffer.pop()
@@ -109,6 +122,7 @@ class StateProvider:
         range to request (state.go:586-616)."""
         if not peer_heights:
             return None
+        self.buffer.advance_to(self._height())
         max_h = max(peer_heights)
         ours = self.buffer.next_seq
         if max_h <= ours:

@@ -84,18 +84,36 @@ class BlockStore:
                 raw = f.read().split(b"\n", 1)
             self._base = int(raw[0])
             self._last_hash = bytes.fromhex(raw[1].decode()) if len(raw) > 1 else b""
+        # pre-snapshot TxIDs (duplicate-TxID protection for txs whose
+        # blocks are not stored) persist in a sidecar file, or a restart
+        # would forget them and re-admit replayed transactions
+        pretx_path = self.path + ".pretxids"
+        if os.path.exists(pretx_path):
+            with open(pretx_path) as f:
+                for line in f:
+                    txid = line.strip()
+                    if txid:
+                        self._by_txid.setdefault(txid, (-1, -1))
         self._rebuild_index()
         self._f = open(self.path, "ab")
 
     @classmethod
     def bootstrap_from_snapshot(
-        cls, path: str, height: int, last_hash: bytes
+        cls,
+        path: str,
+        height: int,
+        last_hash: bytes,
+        pre_snapshot_txids: Optional[List[str]] = None,
     ) -> "BlockStore":
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         if os.path.exists(path):
             raise ValueError(f"block store already exists at {path}")
         with open(path + ".base", "wb") as f:
             f.write(str(height).encode() + b"\n" + last_hash.hex().encode())
+        if pre_snapshot_txids:
+            with open(path + ".pretxids", "w") as f:
+                for txid in pre_snapshot_txids:
+                    f.write(txid + "\n")
         return cls(path)
 
     # -- index ------------------------------------------------------------

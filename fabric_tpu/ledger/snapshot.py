@@ -132,9 +132,20 @@ def create_from_snapshot(snap_dir: str, ledger_dir: str):
     height = meta["last_block_number"] + 1
     last_hash = bytes.fromhex(meta["last_block_hash"])
 
-    # bootstrap the block store BEFORE the ledger opens it
+    txids: List[str] = []
+    with open(os.path.join(snap_dir, TXIDS), "rb") as f:
+        while True:
+            try:
+                txids.append(_r(f).decode())
+            except EOFError:
+                break
+
+    # bootstrap the block store BEFORE the ledger opens it; pre-snapshot
+    # txids persist in a sidecar so dedup survives restarts
     chain_path = os.path.join(ledger_dir, f"{channel_id}.chain")
-    BlockStore.bootstrap_from_snapshot(chain_path, height, last_hash).close()
+    BlockStore.bootstrap_from_snapshot(
+        chain_path, height, last_hash, pre_snapshot_txids=txids
+    ).close()
 
     ledger = KVLedger(ledger_dir, channel_id)
 
@@ -164,13 +175,4 @@ def create_from_snapshot(snap_dir: str, ledger_dir: str):
             hashed.put(ns, coll, kh, vh, version)
     ledger.state_db.apply_updates(updates, hashed)
 
-    with open(os.path.join(snap_dir, TXIDS), "rb") as f:
-        while True:
-            try:
-                txid = _r(f).decode()
-            except EOFError:
-                break
-            # index for duplicate-TxID detection; location unknown -> the
-            # sentinel pre-snapshot marker
-            ledger.block_store._by_txid.setdefault(txid, (-1, -1))
     return ledger

@@ -54,6 +54,8 @@ class PeerNode:
         self._pull_threads: list[threading.Thread] = []
         # last deliver-loop failure per channel (blocksprovider logging)
         self.deliver_errors: Dict[str, str] = {}
+        self._commit_listeners: list[Callable] = []
+        self.gossip_nodes: Dict[str, object] = {}
 
         self.support = ChaincodeSupport(
             state_getter=lambda cid: (
@@ -186,19 +188,89 @@ class PeerNode:
         cond = self._commit_conds.setdefault(channel_id, threading.Condition())
         with cond:
             cond.notify_all()
+        for fn in self._commit_listeners:
+            fn(channel_id, block)
         return flags
+
+    def on_commit(self, fn: Callable[[str, common_pb2.Block], None]) -> None:
+        self._commit_listeners.append(fn)
+
+    # -- gossip (gossip/service gossip_service.go InitializeChannel) -----
+    def enable_gossip_for_channel(
+        self,
+        channel_id: str,
+        bootstrap: Sequence[str] = (),
+        orderer_addr: Optional[str] = None,
+        gossip_listen: str = "127.0.0.1:0",
+    ):
+        """Start a gossip node for the channel. With an orderer address,
+        the elected leader runs the deliver client and pushes blocks to
+        followers; followers self-heal via anti-entropy (state.go)."""
+        from fabric_tpu.gossip.comm import GossipNode
+        from fabric_tpu.gossip.state import StateProvider
+
+        ch = self.channels[channel_id]
+        state = StateProvider(
+            channel_id,
+            lambda b: self.commit_block(channel_id, b),
+            lambda: ch.ledger.height,
+        )
+        node = GossipNode(
+            f"{self.signer.msp_id}:{self.addr}",
+            channel_id,
+            state,
+            ch.ledger.block_store.get_block_by_number,
+            lambda: ch.ledger.height,
+            listen_address=gossip_listen,
+        )
+        self.gossip_nodes[channel_id] = node
+
+        if orderer_addr is not None:
+            deliver_state = {"thread": None}
+
+            def on_leadership(am_leader: bool) -> None:
+                # one gated thread: it pulls while leader, idles when
+                # demoted, resumes on re-election (deliveryclient yield)
+                if am_leader and deliver_state["thread"] is None:
+                    deliver_state["thread"] = self.start_deliver_for_channel(
+                        channel_id,
+                        orderer_addr,
+                        should_run=lambda: node.is_leader,
+                    )
+
+            node.election.on_leadership_change = on_leadership
+            self.on_commit(
+                lambda cid, block: (
+                    node.broadcast_block(block)
+                    if cid == channel_id and node.is_leader
+                    else None
+                )
+            )
+        node.start()
+        for endpoint in bootstrap:
+            node.connect(endpoint)
+        return node
 
     # -- deliver client (core/deliverservice) ----------------------------
     def start_deliver_for_channel(
-        self, channel_id: str, orderer_addr: str
+        self,
+        channel_id: str,
+        orderer_addr: str,
+        should_run: Optional[Callable[[], bool]] = None,
     ) -> threading.Thread:
         """Pull blocks from the orderer and feed the commit pipeline
         (blocksprovider.DeliverBlocks). Reconnects with backoff until
-        stop() — each reconnect re-seeks from the current height."""
+        stop() — each reconnect re-seeks from the current height.
+        ``should_run`` gates the loop (gossip leadership: a demoted
+        leader must stop pulling, reference deliveryclient leadership
+        yield)."""
 
         def run():
             backoff = 0.05
             while not self._stop.is_set():
+                if should_run is not None and not should_run():
+                    self._stop.wait(0.2)
+                    continue
                 try:
                     ch = self.channels[channel_id]
                     env = seek_envelope(
@@ -211,6 +283,8 @@ class PeerNode:
                         for resp in deliver_stream(conn, env):
                             if self._stop.is_set():
                                 return
+                            if should_run is not None and not should_run():
+                                break  # demoted: idle in the outer loop
                             kind = resp.WhichOneof("Type")
                             if kind == "block":
                                 self.commit_block(channel_id, resp.block)
@@ -243,6 +317,8 @@ class PeerNode:
 
     def stop(self) -> None:
         self._stop.set()
+        for node in self.gossip_nodes.values():
+            node.stop()
         self.server.stop()
         if self.ops is not None:
             self.ops.stop()

@@ -204,7 +204,7 @@ class BlockValidator:
             if tx.creator_sig_job is not None:
                 jobs.append(tx.creator_sig_job)
             jobs.extend(tx.endorsement_jobs)
-        keys, digests, sigs, mask = [], [], [], []
+        keys, payloads, sigs, mask = [], [], [], []
         job_identity: Dict[int, Optional[Identity]] = {}
         for job in jobs:
             ident: Optional[Identity] = None
@@ -218,7 +218,10 @@ class BlockValidator:
                 continue
             keys.append(ident.public_key)
             sigs.append(job.signature)
-            digests.append(self.provider.hash(job.data))
+            payloads.append(job.data)
+        # one batched digest pass over every signed payload, behind the
+        # provider SPI (the C++ host runtime when built, hashlib otherwise)
+        digests = self.provider.batch_hash(payloads)
         ok_list = self.provider.batch_verify(keys, sigs, digests)
         results: Dict[int, bool] = {}
         it = iter(ok_list)
