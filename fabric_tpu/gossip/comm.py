@@ -180,13 +180,18 @@ class GossipNode:
                     pass
 
     def broadcast_block(self, block: common_pb2.Block) -> None:
-        """Leader push after pulling from the orderer (gossip DataMsg)."""
+        """Leader push after pulling from the orderer (gossip DataMsg).
+        Fan-out runs on worker threads: the caller is the leader's
+        commit path and must not block on a dead follower's connect
+        timeout (comm_impl.go sends are async for the same reason)."""
         msg = gossip_pb2.GossipMessage()
         msg.channel = self.channel_id
         msg.data_msg.seq_num = block.header.number
         msg.data_msg.block = block.SerializeToString()
         for endpoint in self._peer_endpoints():
-            self._send(endpoint, [msg])
+            threading.Thread(
+                target=self._send, args=(endpoint, [msg]), daemon=True
+            ).start()
 
     def _peer_endpoints(self) -> List[str]:
         with self._lock:

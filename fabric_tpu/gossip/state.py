@@ -84,37 +84,45 @@ class StateProvider:
         self.buffer = PayloadBuffer(height())
         self.max_block_dist = max_block_dist
         self.failed = False
+        # The gossip node drives this from the tick thread, gRPC stream
+        # handlers and forward threads concurrently; an unguarded pop
+        # race would double-commit (and poison the channel via `failed`).
+        self._lock = threading.RLock()
 
     # -- ingest (gossip DataMsg / deliver client / state response) ---------
     def add_payload(self, block: common_pb2.Block, from_gossip: bool = True) -> bool:
         """Reference addPayload: gossiped blocks too far ahead of the
         ledger height are dropped (non-blocking ingest); direct/deliver
         payloads are always buffered."""
-        self.buffer.advance_to(self._height())
-        if from_gossip and block.header.number >= self._height() + self.max_block_dist:
-            self.buffer.dropped += 1
-            return False
-        return self.buffer.push(block)
+        with self._lock:
+            self.buffer.advance_to(self._height())
+            if from_gossip and block.header.number >= self._height() + self.max_block_dist:
+                self.buffer.dropped += 1
+                return False
+            return self.buffer.push(block)
 
     # -- commit loop --------------------------------------------------------
     def deliver_payloads(self) -> int:
         """Drain in-order payloads into the committer. Returns number
         committed. Raises CommitFailure on commit error."""
-        if self.failed:
-            raise CommitFailure(f"channel {self.channel_id} previously failed")
-        self.buffer.advance_to(self._height())
-        committed = 0
-        while self.buffer.ready():
-            block = self.buffer.pop()
-            try:
-                self._commit(block)
-            except Exception as e:
-                self.failed = True
+        with self._lock:
+            if self.failed:
                 raise CommitFailure(
-                    f"block {block.header.number} commit failed: {e}"
-                ) from e
-            committed += 1
-        return committed
+                    f"channel {self.channel_id} previously failed"
+                )
+            self.buffer.advance_to(self._height())
+            committed = 0
+            while self.buffer.ready():
+                block = self.buffer.pop()
+                try:
+                    self._commit(block)
+                except Exception as e:
+                    self.failed = True
+                    raise CommitFailure(
+                        f"block {block.header.number} commit failed: {e}"
+                    ) from e
+                committed += 1
+            return committed
 
     # -- anti-entropy -------------------------------------------------------
     def missing_range(self, peer_heights: Sequence[int]) -> Optional[range]:
@@ -122,9 +130,10 @@ class StateProvider:
         range to request (state.go:586-616)."""
         if not peer_heights:
             return None
-        self.buffer.advance_to(self._height())
-        max_h = max(peer_heights)
-        ours = self.buffer.next_seq
+        with self._lock:
+            self.buffer.advance_to(self._height())
+            max_h = max(peer_heights)
+            ours = self.buffer.next_seq
         if max_h <= ours:
             return None
         return range(ours, max_h)

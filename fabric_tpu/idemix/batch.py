@@ -1,0 +1,155 @@
+"""Batched Idemix signature verification (reference idemix/signature.go
+Signature.Ver, SURVEY.md §7 Stage 5 / BASELINE config #3).
+
+Per-block batching splits Signature.Ver into:
+
+* host: proto parse, the Ate-pairing structure check (Miller loop +
+  final exponentiation — still on the host oracle this round; the G1
+  work below is the device half of Stage 5), Fiat–Shamir SHA-256
+  recompute and challenge comparison;
+* device: the t1/t2/t3 commitment recomputations — each is a G1
+  multi-scalar multiplication — evaluated as ONE batched MSM kernel
+  call with 3 lanes per signature (fabric_tpu.ops.bn256_kernel).
+
+Failure semantics per lane mirror verify_signature: every failed check
+maps to False in the result mask, never an exception across lanes.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+from fabric_tpu.crypto import fp256bn as bn
+from fabric_tpu.idemix.scheme import (
+    ALG_NO_REVOCATION,
+    IdemixError,
+    _hidden_indices,
+    _second_challenge,
+    _signature_challenge,
+    ecp_from_proto,
+    ecp2_from_proto,
+)
+from fabric_tpu.protos import idemix_pb2
+
+
+class _Parsed:
+    """Host-parsed signature with its three MSM jobs."""
+
+    def __init__(self, sig, disclosure, ipk, attribute_values, rh_index):
+        hidden = _hidden_indices(disclosure)
+        self.sig = sig
+        self.disclosure = disclosure
+        self.a_prime = ecp_from_proto(sig.a_prime)
+        self.a_bar = ecp_from_proto(sig.a_bar)
+        self.b_prime = ecp_from_proto(sig.b_prime)
+        self.nym = ecp_from_proto(sig.nym)
+        if self.a_prime is None:
+            raise IdemixError("signature invalid: APrime = 1")
+        if len(sig.proof_s_attrs) != len(hidden):
+            raise IdemixError("incorrect amount of s-values")
+        if sig.non_revocation_proof.revocation_alg != ALG_NO_REVOCATION:
+            raise IdemixError("unknown revocation algorithm")
+
+        c = bn.big_from_bytes(sig.proof_c)
+        s_sk = bn.big_from_bytes(sig.proof_s_sk)
+        s_e = bn.big_from_bytes(sig.proof_s_e)
+        s_r2 = bn.big_from_bytes(sig.proof_s_r2)
+        s_r3 = bn.big_from_bytes(sig.proof_s_r3)
+        s_s_prime = bn.big_from_bytes(sig.proof_s_s_prime)
+        s_r_nym = bn.big_from_bytes(sig.proof_s_r_nym)
+        s_attrs = [bn.big_from_bytes(v) for v in sig.proof_s_attrs]
+        self.proof_c = c
+        self.nonce = bn.big_from_bytes(sig.nonce)
+
+        h_rand = ecp_from_proto(ipk.h_rand)
+        h_sk = ecp_from_proto(ipk.h_sk)
+        neg_c = (-c) % bn.R
+
+        # t1 = s_e·A' + s_r2·HRand − c·(ABar − B')
+        self.t1_job = (
+            [self.a_prime, h_rand, bn.g1_add(self.a_bar, bn.g1_neg(self.b_prime))],
+            [s_e, s_r2, neg_c],
+        )
+        # t2 = s_s'·HRand + s_r3·B' + s_sk·HSk + Σ_hidden s_i·HAttr_i
+        #      + c·(G1 + Σ_disclosed a_i·HAttr_i)
+        bases = [h_rand, self.b_prime, h_sk]
+        scalars = [s_s_prime, s_r3, s_sk]
+        for j, idx in enumerate(hidden):
+            bases.append(ecp_from_proto(ipk.h_attrs[idx]))
+            scalars.append(s_attrs[j])
+        bases.append(bn.G1_GEN)
+        scalars.append(c)
+        for idx, disclose in enumerate(disclosure):
+            if disclose != 0:
+                bases.append(ecp_from_proto(ipk.h_attrs[idx]))
+                scalars.append((c * attribute_values[idx]) % bn.R)
+        self.t2_job = (bases, scalars)
+        # t3 = s_sk·HSk + s_r_nym·HRand − c·Nym
+        self.t3_job = ([h_sk, h_rand, self.nym], [s_sk, s_r_nym, neg_c])
+
+
+def verify_signatures_batch(
+    signatures: Sequence[idemix_pb2.Signature],
+    disclosures: Sequence[Sequence[int]],
+    ipk: idemix_pb2.IssuerPublicKey,
+    msgs: Sequence[bytes],
+    attribute_values_list: Sequence[Sequence[Optional[int]]],
+    rh_index: int,
+) -> List[bool]:
+    """One device MSM pass for the whole batch; returns a per-signature
+    validity mask (BASELINE config #3's bit-exact mask contract)."""
+    from fabric_tpu.ops.bn256_kernel import msm_host_batch
+
+    n = len(signatures)
+    parsed: List[Optional[_Parsed]] = []
+    for sig, disclosure, values in zip(
+        signatures, disclosures, attribute_values_list
+    ):
+        try:
+            if rh_index < 0 or rh_index >= len(ipk.attribute_names) or len(
+                disclosure
+            ) != len(ipk.attribute_names):
+                raise IdemixError("invalid input")
+            parsed.append(_Parsed(sig, disclosure, ipk, values, rh_index))
+        except Exception:  # noqa: BLE001 - one bad lane must not abort the batch
+            parsed.append(None)
+
+    # host pairing structure check (the remaining host-side crypto)
+    w = ecp2_from_proto(ipk.w)
+    pairing_ok: List[bool] = []
+    for p in parsed:
+        if p is None:
+            pairing_ok.append(False)
+            continue
+        t = bn.fp12_mul(
+            bn.ate(w, p.a_prime), bn.fp12_inv(bn.ate(bn.G2_GEN, p.a_bar))
+        )
+        pairing_ok.append(bn.gt_is_unity(bn.fexp(t)))
+
+    # device: 3 MSM lanes per live signature, one kernel batch
+    jobs: List[Tuple[list, list]] = []
+    owners: List[int] = []
+    for i, p in enumerate(parsed):
+        if p is None or not pairing_ok[i]:
+            continue
+        for job in (p.t1_job, p.t2_job, p.t3_job):
+            jobs.append(job)
+            owners.append(i)
+    results = [False] * n
+    if jobs:
+        k_max = max(len(b) for b, _ in jobs)
+        bases = [list(b) + [None] * (k_max - len(b)) for b, _ in jobs]
+        scalars = [list(s) + [0] * (k_max - len(s)) for _, s in jobs]
+        points = msm_host_batch(bases, scalars)
+        by_owner = {}
+        for owner, pt in zip(owners, points):
+            by_owner.setdefault(owner, []).append(pt)
+        for i, ts in by_owner.items():
+            p = parsed[i]
+            t1, t2, t3 = ts
+            c = _signature_challenge(
+                t1, t2, t3, p.a_prime, p.a_bar, p.b_prime, p.nym,
+                b"", ipk.hash, p.disclosure, msgs[i],
+            )
+            results[i] = p.proof_c == _second_challenge(c, p.nonce)
+    return results

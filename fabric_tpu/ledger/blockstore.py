@@ -84,9 +84,14 @@ class BlockStore:
                 raw = f.read().split(b"\n", 1)
             self._base = int(raw[0])
             self._last_hash = bytes.fromhex(raw[1].decode()) if len(raw) > 1 else b""
-        # pre-snapshot TxIDs (duplicate-TxID protection for txs whose
-        # blocks are not stored) persist in a sidecar file, or a restart
-        # would forget them and re-admit replayed transactions
+        self._load_pretxids()
+        self._rebuild_index()
+        self._f = open(self.path, "ab")
+
+    def _load_pretxids(self) -> None:
+        """Pre-snapshot TxIDs (duplicate-TxID protection for txs whose
+        blocks are not stored) persist in a sidecar file, or a restart
+        would forget them and re-admit replayed transactions."""
         pretx_path = self.path + ".pretxids"
         if os.path.exists(pretx_path):
             with open(pretx_path) as f:
@@ -94,8 +99,6 @@ class BlockStore:
                     txid = line.strip()
                     if txid:
                         self._by_txid.setdefault(txid, (-1, -1))
-        self._rebuild_index()
-        self._f = open(self.path, "ab")
 
     @classmethod
     def bootstrap_from_snapshot(
@@ -240,6 +243,7 @@ class BlockStore:
             self._last_hash = (
                 bytes.fromhex(raw[1].decode()) if len(raw) > 1 else b""
             )
+        self._load_pretxids()  # the sidecar survives rollbacks
         self._rebuild_index()
         self._f = open(self.path, "ab")
 

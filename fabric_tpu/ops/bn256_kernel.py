@@ -1,0 +1,287 @@
+"""Batched G1 arithmetic on the FP256BN pairing curve (the Idemix curve)
+— the device half of SURVEY.md §7 Stage 5.
+
+Reference semantics: fabric-amcl's FP256BN G1 (idemix/signature.go Ver
+recomputes t1/t2/t3 via ~10 G1 scalar muls per signature). This kernel
+evaluates batched multi-scalar multiplications Σ_k e_k·B_k with complete
+a=0 projective formulas (Renes–Costello–Batina 2016, algorithms 7 and 9;
+FP256BN has a=0, b=3), vmapped over the signature lanes, reusing the
+13-bit-limb Montgomery machinery from fabric_tpu.ops.bignum with the BN
+base-field modulus.
+
+The pairing itself (Miller loop + final exponentiation in Fp12) stays on
+the host oracle (fabric_tpu.crypto.fp256bn) for now; this kernel removes
+the G1 multi-exponentiation bulk of Signature.Ver.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from fabric_tpu.crypto import fp256bn as host
+from fabric_tpu.ops import bignum as bn
+from fabric_tpu.ops import fieldops as fo
+
+CTX_Q = bn.MontCtx(host.P)
+
+_R = 1 << bn.RADIX_BITS
+B3_MONT = bn.int_to_limbs((3 * host.B_COEFF * _R) % host.P)
+ONE_MONT_Q = bn.int_to_limbs(_R % host.P)
+
+WINDOW_BITS = 2
+NUM_WINDOWS = 128  # 256 bits / 2
+
+
+# Shared lazy-reduction machinery bound to the BN base-field modulus.
+FIELD = fo.Field(CTX_Q)
+FE = fo.FE
+fe = fo.Field.fe
+fe_mul = FIELD.mul
+fe_add = FIELD.add
+fe_sub = FIELD.sub
+fe_norm = FIELD.norm
+Point = fo.Point
+point_identity_like = FIELD.identity_like
+
+_B3_FE = FE(bn.const_l(B3_MONT), 1)
+
+
+def point_add(p: Point, q: Point) -> Point:
+    """Complete addition, RCB 2016 algorithm 7 (a = 0)."""
+    x1, y1, z1 = p
+    x2, y2, z2 = q
+    b3 = _B3_FE
+
+    t0 = fe_mul(x1, x2)
+    t1 = fe_mul(y1, y2)
+    t2 = fe_mul(z1, z2)
+    t3 = fe_add(x1, y1)
+    t4 = fe_add(x2, y2)
+    t3 = fe_mul(t3, t4)
+    t4 = fe_add(t0, t1)
+    t3 = fe_sub(t3, t4)
+    t4 = fe_add(y1, z1)
+    x3 = fe_add(y2, z2)
+    t4 = fe_mul(t4, x3)
+    x3 = fe_add(t1, t2)
+    t4 = fe_sub(t4, x3)
+    x3 = fe_add(x1, z1)
+    y3 = fe_add(x2, z2)
+    x3 = fe_mul(x3, y3)
+    y3 = fe_add(t0, t2)
+    y3 = fe_sub(x3, y3)
+    x3 = fe_add(t0, t0)
+    t0 = fe_add(x3, t0)  # bound 3
+    t2 = fe_mul(b3, t2)
+    z3 = fe_add(t1, t2)  # bound 2
+    t1 = fe_sub(t1, t2)
+    y3 = fe_mul(b3, y3)
+    x3 = fe_mul(t4, y3)
+    t2 = fe_mul(t3, t1)
+    x3 = fe_sub(t2, x3)
+    y3 = fe_mul(y3, fe_norm(t0))
+    t1 = fe_mul(t1, fe_norm(z3))
+    y3 = fe_add(t1, y3)
+    t0 = fe_mul(fe_norm(t0), t3)
+    z3 = fe_mul(fe_norm(z3), t4)
+    z3 = fe_add(z3, t0)  # bound 2
+    return Point(x3, fe_norm(y3), fe_norm(z3))
+
+
+def point_double(p: Point) -> Point:
+    """Complete doubling, RCB 2016 algorithm 9 (a = 0)."""
+    x, y, z = p
+    b3 = _B3_FE
+
+    t0 = fe_mul(y, y)
+    z3 = fe_add(t0, t0)
+    z3 = fe_add(z3, z3)
+    z3 = fe_add(z3, z3)  # bound 8
+    z3 = fe_norm(z3)
+    t1 = fe_mul(y, z)
+    t2 = fe_mul(z, z)
+    t2 = fe_mul(b3, t2)
+    x3 = fe_mul(t2, z3)
+    y3 = fe_add(t0, t2)
+    z3 = fe_mul(t1, z3)
+    t1 = fe_add(t2, t2)
+    t2 = fe_add(t1, t2)  # bound 3
+    t0 = fe_sub(t0, t2)
+    y3 = fe_mul(t0, fe_norm(y3))
+    y3 = fe_add(x3, y3)
+    t1 = fe_mul(x, y)
+    x3 = fe_mul(t0, t1)
+    x3 = fe_add(x3, x3)
+    return Point(fe_norm(x3), fe_norm(y3), z3)
+
+
+_pack = fo.pack_point
+
+
+def _unpack(c, bound=4) -> Point:
+    return Point(
+        fe_norm(FE(tuple(c[0]), bound)), fe(c[1]), fe(c[2])
+    )
+
+
+# ---------------------------------------------------------------------------
+# Host <-> device packing
+# ---------------------------------------------------------------------------
+
+
+def to_mont_int(v: int) -> int:
+    return (v * _R) % host.P
+
+
+def pack_points(pts: Sequence[host.G1Point]) -> np.ndarray:
+    """Affine host points (or None = identity) -> (3, NLIMBS, B) uint32
+    Montgomery projective."""
+    xs, ys, zs = [], [], []
+    for pt in pts:
+        if pt is None:
+            xs.append(0)
+            ys.append(to_mont_int(1))
+            zs.append(0)
+        else:
+            xs.append(to_mont_int(pt[0]))
+            ys.append(to_mont_int(pt[1]))
+            zs.append(to_mont_int(1))
+    return np.stack(
+        [bn.ints_to_limbs(xs), bn.ints_to_limbs(ys), bn.ints_to_limbs(zs)]
+    )
+
+
+def unpack_points(arr: np.ndarray):
+    """(3, NLIMBS, B) device output -> list of affine host points/None."""
+    arr = np.asarray(arr)
+    xs = bn.limbs_to_ints(
+        np.asarray(bn.from_mont(CTX_Q, jnp.asarray(arr[0])))
+    )
+    ys = bn.limbs_to_ints(
+        np.asarray(bn.from_mont(CTX_Q, jnp.asarray(arr[1])))
+    )
+    zs = bn.limbs_to_ints(
+        np.asarray(bn.from_mont(CTX_Q, jnp.asarray(arr[2])))
+    )
+    out = []
+    for x, y, z in zip(xs, ys, zs):
+        if z == 0:
+            out.append(None)
+        else:
+            zi = pow(z, -1, host.P)
+            out.append(((x * zi) % host.P, (y * zi) % host.P))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Batched multi-scalar multiplication
+# ---------------------------------------------------------------------------
+
+
+def scalar_digits_msb(scalars: jax.Array) -> jax.Array:
+    """(NLIMBS, B) limb scalars -> (NUM_WINDOWS, B) 2-bit digits MSB-first."""
+    digits = []
+    for w in range(NUM_WINDOWS):
+        bit = 256 - WINDOW_BITS * (w + 1)
+        limb, off = divmod(bit, bn.LIMB_BITS)
+        # a 2-bit window never straddles >2 limbs with 13-bit limbs
+        lo = scalars[limb] >> np.uint32(off)
+        if off + WINDOW_BITS > bn.LIMB_BITS and limb + 1 < bn.NLIMBS:
+            hi = scalars[limb + 1] << np.uint32(bn.LIMB_BITS - off)
+            lo = lo | hi
+        digits.append(lo & np.uint32((1 << WINDOW_BITS) - 1))
+    return jnp.stack(digits)
+
+
+def msm_batch_device(bases: jax.Array, scalars: jax.Array) -> tuple:
+    """bases (K, 3, NLIMBS, B) Montgomery projective; scalars
+    (K, NLIMBS, B) plain integers < R. Returns packed (3, NLIMBS, B)
+    accumulator Σ_k scalars[k]·bases[k] per lane."""
+    k_count, _, _, lanes = bases.shape
+    lanes_like = bases[0, 0, 0]
+
+    # per-base tables {identity, B, 2B, 3B} built as ONE flattened
+    # (K*B)-lane batch (a vmapped build compiles far slower):
+    flat = jnp.moveaxis(bases, 0, 2).reshape(3, bn.NLIMBS, k_count * lanes)
+    p1 = Point(fe(bn.split(flat[0])), fe(bn.split(flat[1])), fe(bn.split(flat[2])))
+    p2 = point_double(p1)
+    p3 = point_add(p2, p1)
+    ident = point_identity_like(flat[0, 0])
+    rows = []
+    for pt in (ident, p1, p2, p3):
+        rows.append(
+            jnp.stack(
+                [
+                    bn.restack(pt.x.limbs),
+                    bn.restack(fe_norm(pt.y).limbs),
+                    bn.restack(fe_norm(pt.z).limbs),
+                ]
+            )
+        )
+    # (4, 3, NLIMBS, K*B) -> (K, 4, 3, NLIMBS, B)
+    tables = jnp.moveaxis(
+        jnp.stack(rows).reshape(4, 3, bn.NLIMBS, k_count, lanes), 3, 0
+    )
+    flat_scalars = jnp.moveaxis(scalars, 0, 1).reshape(
+        bn.NLIMBS, k_count * lanes
+    )
+    digits = scalar_digits_msb(flat_scalars).reshape(
+        NUM_WINDOWS, k_count, lanes
+    )
+
+    def select(table, idx):
+        return fo.one_hot_select(table, idx, 4)
+
+    def window_body(carry, window_digits):
+        acc = _unpack(carry)
+        for _ in range(WINDOW_BITS):
+            acc = point_double(acc)
+
+        def base_body(j, packed):
+            a = _unpack(packed)
+            a = point_add(a, select(tables[j], window_digits[j]))
+            return _pack(a)
+
+        packed = lax.fori_loop(0, k_count, base_body, _pack(acc))
+        return packed, None
+
+    carry, _ = lax.scan(
+        window_body, _pack(point_identity_like(lanes_like)), digits
+    )
+    final = _unpack(carry)
+    return (
+        jnp.stack([bn.restack(final.x.limbs), bn.restack(fe_norm(final.y).limbs), bn.restack(fe_norm(final.z).limbs)])
+    )
+
+
+msm_batch_jit = jax.jit(msm_batch_device)
+
+
+def msm_host_batch(
+    bases_per_lane: Sequence[Sequence], scalars_per_lane: Sequence[Sequence[int]]
+) -> list:
+    """Convenience host API: per-lane lists of (affine point | None) bases
+    and int scalars, all lanes with the same K. Returns affine points."""
+    b_count = len(bases_per_lane)
+    k_count = len(bases_per_lane[0])
+    bases = np.stack(
+        [
+            pack_points([bases_per_lane[i][k] for i in range(b_count)])
+            for k in range(k_count)
+        ]
+    )
+    scalars = np.stack(
+        [
+            bn.ints_to_limbs(
+                [scalars_per_lane[i][k] % host.R for i in range(b_count)]
+            )
+            for k in range(k_count)
+        ]
+    )
+    out = msm_batch_jit(jnp.asarray(bases), jnp.asarray(scalars))
+    return unpack_points(out)

@@ -40,6 +40,7 @@ from jax import lax
 
 from fabric_tpu.crypto import p256
 from fabric_tpu.ops import bignum as bn
+from fabric_tpu.ops import fieldops as fo
 
 CTX_P = bn.MontCtx(p256.P)
 CTX_N = bn.MontCtx(p256.N)
@@ -55,43 +56,19 @@ NUM_WINDOWS = 64  # 256 bits / 4
 LimbVec = bn.LimbVec
 
 
-class FE(NamedTuple):
-    """A mod-p field element (unpacked limbs) with a static value bound
-    (value < bound * p).
-
-    Bounds are tracked at trace time so the lazy-reduction discipline of
-    the RCB formulas is machine-checked: `mul` requires bound products
-    <= 16 (then a single conditional subtract renormalizes), `add`
-    accumulates bounds, `sub` renormalizes to canonical.
-    """
-
-    limbs: tuple
-    bound: int
-
-
-def fe(limbs, bound: int = 1) -> FE:
-    return FE(tuple(limbs), bound)
-
-
-def fe_mul(a: FE, b: FE) -> FE:
-    assert a.bound * b.bound <= 16, (a.bound, b.bound)
-    return FE(tuple(bn.mont_mul_l(CTX_P, a.limbs, b.limbs, nreduce=1)), 1)
-
-
-def fe_add(a: FE, b: FE) -> FE:
-    assert a.bound + b.bound <= 8, (a.bound, b.bound)
-    return FE(tuple(bn.add_raw_l(a.limbs, b.limbs)), a.bound + b.bound)
-
-
-def fe_sub(a: FE, b: FE) -> FE:
-    # a - b + bound(b)*p, then conditional subtracts back to canonical.
-    return FE(
-        tuple(bn.sub_mod_l(CTX_P, a.limbs, b.limbs, b.bound, nreduce=a.bound + b.bound - 1)),
-        1,
-    )
+# Shared lazy-reduction machinery (fabric_tpu.ops.fieldops) bound to the
+# P-256 modulus; local names preserved for the formula bodies below.
+FIELD = fo.Field(CTX_P)
+FE = fo.FE
+fe = fo.Field.fe
+fe_mul = FIELD.mul
+fe_add = FIELD.add
+fe_sub = FIELD.sub
 
 
 def fe_norm(a: FE) -> FE:
+    # (unconditional form: callers rely on bound-1 output even for
+    # bound-1 inputs annotated wider — see _horner_micro's renorm)
     return FE(tuple(bn.reduce_canonical_l(CTX_P, a.limbs, a.bound - 1)), 1)
 
 
@@ -101,20 +78,8 @@ _IDENT_Y = FE(bn.const_l(ONE_MONT_P), 1)
 _IDENT_Z = FE(bn.const_l(bn.int_to_limbs(0)), 1)
 
 
-class Point(NamedTuple):
-    """Projective (X:Y:Z), coordinates in the Montgomery domain."""
-
-    x: FE
-    y: FE
-    z: FE
-
-
-def point_identity_like(like: jax.Array) -> Point:
-    return Point(
-        FE(tuple(bn.bcast_l(bn.int_to_limbs(0), like)), 1),
-        FE(tuple(bn.bcast_l(ONE_MONT_P, like)), 1),
-        FE(tuple(bn.bcast_l(bn.int_to_limbs(0), like)), 1),
-    )
+Point = fo.Point
+point_identity_like = FIELD.identity_like
 
 
 def point_add(p: Point, q: Point) -> Point:
@@ -264,27 +229,14 @@ def scalar_digits_msb(u: Sequence[jax.Array]) -> jax.Array:
 
 
 def _select_point(table: jax.Array, idx: jax.Array) -> Point:
-    """table (16, 3, 20, B) or (16, 3, 20); idx (B,) -> Point (one-hot
-    contraction — gathers on TPU lower poorly, multiply-accumulate over
-    16 rows fuses)."""
-    oh = (jnp.arange(16, dtype=jnp.uint32)[:, None] == idx[None, :]).astype(jnp.uint32)
-    if table.ndim == 4:
-        sel = (table * oh[:, None, None, :]).sum(axis=0)  # (3, 20, B)
-    else:
-        sel = jnp.einsum("kcl,kb->clb", table, oh)
-    return Point(
-        fe(tuple(sel[0, i] for i in range(bn.NLIMBS))),
-        fe(tuple(sel[1, i] for i in range(bn.NLIMBS))),
-        fe(tuple(sel[2, i] for i in range(bn.NLIMBS))),
-    )
+    return fo.one_hot_select(table, idx, 16)
 
 
-def _pack_point(p: Point) -> Tuple[tuple, tuple, tuple]:
-    return (p.x.limbs, p.y.limbs, p.z.limbs)
+_pack_point = fo.pack_point
 
 
 def _unpack_point(c) -> Point:
-    return Point(fe(c[0]), fe(c[1]), fe(c[2]))
+    return fo.unpack_point(c, x_bound=1)
 
 
 # ---------------------------------------------------------------------------
@@ -308,14 +260,17 @@ def _kernel_variant() -> str:
     import os
 
     forced = os.environ.get("FABRIC_TPU_KERNEL_VARIANT", "auto")
-    if forced in ("inline", "micro"):
+    if forced in ("inline", "micro", "microcond"):
         return forced
-    return "micro" if jax.default_backend() not in ("cpu",) else "inline"
+    return "microcond" if jax.default_backend() not in ("cpu",) else "inline"
 
 
 def _horner_loop(d1, d2, q_table, g_table, qx) -> Point:
-    if _kernel_variant() == "micro":
+    variant = _kernel_variant()
+    if variant == "micro":
         return _horner_micro(d1, d2, q_table, g_table, qx)
+    if variant == "microcond":
+        return _horner_microcond(d1, d2, q_table, g_table, qx)
     return _horner_inline(d1, d2, q_table, g_table, qx)
 
 
@@ -368,6 +323,44 @@ def _horner_micro(d1, d2, q_table, g_table, qx) -> Point:
         operand = Point(mix(0), mix(1), mix(2))
         res = point_add(acc, operand)
         return _pack_point(res), None
+
+    carry, _ = lax.scan(
+        micro_body, _pack_point(point_identity_like(qx[0])), (kinds, digits)
+    )
+    return _unpack_point(carry)
+
+
+def _horner_microcond(d1, d2, q_table, g_table, qx) -> Point:
+    """384-step scan like _horner_micro, but the body dispatches through
+    lax.switch on the step kind (a scalar scan input, so XLA's
+    conditional runs ONE branch at runtime): double steps run
+    point_double and skip the 16-entry table contractions entirely —
+    they are 4 of every 6 steps, so most iterations avoid both the
+    q-table one-hot reduction and the 3-way operand mix. Graph size
+    stays scan-body-bounded (~3 point ops), well inside what the remote
+    compile service accepts."""
+    steps = NUM_WINDOWS * 6
+    kinds = jnp.asarray(np.tile([0, 0, 0, 0, 1, 2], NUM_WINDOWS), dtype=jnp.int32)
+    digits = jnp.zeros((steps, d1.shape[1]), dtype=d1.dtype)
+    digits = digits.at[4::6].set(d2).at[5::6].set(d1)
+
+    def micro_body(carry, xs):
+        kind, digit = xs
+        acc = Point(
+            fe_norm(FE(tuple(carry[0]), 4)), fe(carry[1]), fe(carry[2])
+        )
+
+        def do_double(_):
+            return _pack_point(point_double(acc))
+
+        def do_add_q(_):
+            return _pack_point(point_add(acc, _select_point(q_table, digit)))
+
+        def do_add_g(_):
+            return _pack_point(point_add(acc, _select_point(g_table, digit)))
+
+        res = lax.switch(kind, (do_double, do_add_q, do_add_g), None)
+        return res, None
 
     carry, _ = lax.scan(
         micro_body, _pack_point(point_identity_like(qx[0])), (kinds, digits)

@@ -1,0 +1,109 @@
+"""Differential tests: batched FP256BN G1 kernel vs the host oracle
+(fabric_tpu.crypto.fp256bn)."""
+
+import secrets
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fabric_tpu.crypto import fp256bn as host
+from fabric_tpu.ops import bignum as bn
+from fabric_tpu.ops import bn256_kernel as bk
+
+
+def rand_scalar():
+    return secrets.randbelow(host.R - 1) + 1
+
+
+def rand_point():
+    return host.g1_mul(host.G1_GEN, rand_scalar())
+
+
+class TestPointOps:
+    def test_add_matches_host(self):
+        ps = [rand_point() for _ in range(4)] + [None, host.G1_GEN]
+        qs = [rand_point() for _ in range(4)] + [host.G1_GEN, host.G1_GEN]
+        a = bk.pack_points(ps)
+        b = bk.pack_points(qs)
+
+        import jax
+
+        @jax.jit
+        def add(a, b):
+            p = bk.Point(bk.fe(bn.split(a[0])), bk.fe(bn.split(a[1])), bk.fe(bn.split(a[2])))
+            q = bk.Point(bk.fe(bn.split(b[0])), bk.fe(bn.split(b[1])), bk.fe(bn.split(b[2])))
+            r = bk.point_add(p, q)
+            return jnp.stack([bn.restack(r.x.limbs), bn.restack(bk.fe_norm(r.y).limbs), bn.restack(bk.fe_norm(r.z).limbs)])
+
+        got = bk.unpack_points(add(jnp.asarray(a), jnp.asarray(b)))
+        for p, q, g in zip(ps, qs, got):
+            want = host.g1_add(p, q)
+            assert g == want, (p, q)
+
+    def test_double_matches_host_incl_identity(self):
+        ps = [rand_point(), host.G1_GEN, None]
+        a = bk.pack_points(ps)
+
+        import jax
+
+        @jax.jit
+        def dbl(a):
+            p = bk.Point(bk.fe(bn.split(a[0])), bk.fe(bn.split(a[1])), bk.fe(bn.split(a[2])))
+            r = bk.point_double(p)
+            return jnp.stack([bn.restack(bk.fe_norm(r.x).limbs), bn.restack(bk.fe_norm(r.y).limbs), bn.restack(bk.fe_norm(r.z).limbs)])
+
+        got = bk.unpack_points(dbl(jnp.asarray(a)))
+        for p, g in zip(ps, got):
+            assert g == host.g1_add(p, p), p
+
+
+class TestMSM:
+    """All cases share ONE (K=4, B=4) shape — every distinct shape is a
+    multi-minute XLA compile; identity bases with zero scalars pad the
+    smaller cases."""
+
+    K, B = 4, 4
+
+    def _run(self, cases):
+        """cases: list of (bases, scalars) with len <= K; padded to (K,B)."""
+        while len(cases) < self.B:
+            cases.append(([], []))
+        bases, scalars = [], []
+        for bs, es in cases:
+            bs = list(bs) + [None] * (self.K - len(bs))
+            es = list(es) + [0] * (self.K - len(es))
+            bases.append(bs)
+            scalars.append(es)
+        got = bk.msm_host_batch(bases, scalars)
+        want = []
+        for bs, es in zip(bases, scalars):
+            acc = None
+            for b, e in zip(bs, es):
+                acc = host.g1_add(acc, host.g1_mul(b, e % host.R))
+            want.append(acc)
+        assert got == want
+
+    def test_single_base_matches_scalar_mul(self):
+        self._run([([rand_point()], [rand_scalar()]) for _ in range(self.B)])
+
+    def test_multi_base_matches_host_sum(self):
+        self._run(
+            [
+                (
+                    [rand_point() for _ in range(self.K)],
+                    [rand_scalar() for _ in range(self.K)],
+                )
+                for _ in range(self.B)
+            ]
+        )
+
+    def test_edge_scalars_and_identity_base(self):
+        self._run(
+            [
+                ([host.G1_GEN, None], [0, 5]),
+                ([host.G1_GEN, host.G1_GEN], [1, host.R - 1]),  # R·G = O
+                ([None, None], [3, 7]),
+                ([rand_point(), host.G1_GEN], [host.R - 1, 2]),
+            ]
+        )

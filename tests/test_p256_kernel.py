@@ -171,3 +171,38 @@ class TestVerifyBatch:
         digest = hashlib.sha256(b"sample").digest()
         r, s = p256.sign_digest(priv, digest, k=0xA6E3C57DD01ABE90086538398355DD4C3B17AA873382B0F24D6129493D8AAD60)
         assert run_verify([(pub, digest, r, s, True)]) == [True]
+
+
+class TestVariants:
+    """The TPU default (microcond) and the micro fallback must match the
+    oracle too — CI otherwise only exercises the CPU-default inline
+    path while the device runs a different trace."""
+
+    @pytest.mark.parametrize("variant", ["microcond", "micro"])
+    def test_variant_differential(self, variant, monkeypatch):
+        monkeypatch.setenv("FABRIC_TPU_KERNEL_VARIANT", variant)
+        import jax
+
+        fresh_jit = jax.jit(pk.verify_batch_device)  # re-trace with the env var
+        cases = []
+        for i in range(16):
+            kp = p256.generate_keypair()
+            digest = hashlib.sha256(f"variant {i}".encode()).digest()
+            r, s = p256.sign_digest(kp.priv, digest)
+            if i % 4 == 1:
+                digest = hashlib.sha256(b"wrong").digest()
+            if i % 4 == 2:
+                s = (s + 1) % p256.N or 1
+            cases.append((kp.pub, digest, r, s))
+        e = bn.ints_to_limbs([p256.hash_to_int(d) for _, d, _, _ in cases])
+        r_l = bn.ints_to_limbs([c[2] for c in cases])
+        s_l = bn.ints_to_limbs([c[3] for c in cases])
+        qx = bn.ints_to_limbs([c[0][0] for c in cases])
+        qy = bn.ints_to_limbs([c[0][1] for c in cases])
+        ok = jnp.ones((16,), dtype=bool)
+        got = list(np.asarray(fresh_jit(
+            jnp.asarray(e), jnp.asarray(r_l), jnp.asarray(s_l),
+            jnp.asarray(qx), jnp.asarray(qy), ok,
+        )))
+        want = [p256.verify_digest(c[0], c[1], c[2], c[3]) for c in cases]
+        assert got == want
