@@ -24,3 +24,28 @@ def enable_compile_cache(cache_dir: str = CACHE_DIR) -> None:
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+
+def pin_cpu_mesh(n_devices: int = 8) -> None:
+    """Pin jax to the host-CPU platform with >= n_devices virtual devices.
+
+    Must run before ANY backend/array initialisation: the driver/test
+    environment preloads an axon TPU plugin whose AOT client can be
+    version-skewed against the terminal (round-1 MULTICHIP failure:
+    `libtpu version mismatch` raised inside device_put), so sharding
+    checks run on a hermetic CPU mesh and never touch the accelerator
+    client. If XLA_FLAGS already forces a host device count (conftest,
+    driver), that wins; otherwise use the dynamic config key.
+    """
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    if "xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""
+    ):
+        try:
+            jax.config.update("jax_num_cpu_devices", n_devices)
+        except Exception:
+            # Backend already initialised (e.g. called twice in-process):
+            # callers assert on the resulting device count.
+            pass
