@@ -20,8 +20,9 @@ Math layout:
 - scalar recomposition: u1*G + u2*Q with 4-bit fixed windows, MSB-first
   Horner loop (R = 16R + d1*G + d2*Q). G multiples come from a host
   precomputed table; Q multiples are built per lane;
-- scalar inversion s^-1 mod n and the final Z^-1 mod p use branch-free
-  fixed-window Fermat exponentiation.
+- scalar inversion s^-1 mod n uses branch-free fixed-window Fermat
+  exponentiation; the final x-coordinate test is done projectively
+  (X == r*Z), so Z is never inverted.
 
 The per-lane boolean output is bit-exact with the reference's
 `ecdsa.Verify` decision; DER parsing, the low-S rule and r/s range checks
@@ -52,6 +53,8 @@ N_LIMBS = bn.int_to_limbs(p256.N)
 
 WINDOW_BITS = 4
 NUM_WINDOWS = 64  # 256 bits / 4
+
+P_MINUS_N_LIMBS = bn.int_to_limbs(p256.P - p256.N)
 
 LimbVec = bn.LimbVec
 
@@ -429,13 +432,28 @@ def verify_batch_device(
     g_table = jnp.asarray(g_small_table())  # (16, 3, 20)
     acc = _horner_loop(d1, d2, q_table, g_table, qx)
 
-    # --- affine x and the final comparison ---
-    z_inv = bn.mont_pow_l(CTX_P, acc.z.limbs, p256.P - 2)
-    x_aff = bn.from_mont_l(CTX_P, bn.mont_mul_l(CTX_P, acc.x.limbs, z_inv))
+    # --- final comparison, projectively: for Z != 0,
+    #   x_affine == v  <=>  X == v*Z  (mod p, Montgomery domain)
+    # so the candidate v in {r, r+n} is lifted once and multiplied by Z —
+    # 4 field muls instead of the 386-multiply Fermat inversion of Z.
+    x_can = bn.reduce_canonical_l(CTX_P, acc.x.limbs, 3)  # bound 4 -> canonical
     r_plus_n, _ = bn.carry_l(
         [x + np.uint32(nv) for x, nv in zip(r_t, N_LIMBS)]
     )  # value < 2^257, fits in 20 limbs
-    matches = bn.eq_l(x_aff, r_t) | bn.eq_l(x_aff, r_plus_n)
+    r_m_p = bn.to_mont_l(CTX_P, r_t)
+    rpn_m_p = bn.to_mont_l(CTX_P, r_plus_n)  # value < 2p: reduced canonical
+    rz = bn.mont_mul_l(CTX_P, r_m_p, acc.z.limbs)
+    rpnz = bn.mont_mul_l(CTX_P, rpn_m_p, acc.z.limbs)
+    # the r+n candidate only exists as an affine x when r+n < p (Go checks
+    # x mod n == r with x < p; to_mont reduced r+n mod p, so an unsuppressed
+    # wrapped value could falsely match x = r+n-p)
+    diff = [
+        x.astype(jnp.int32) - np.int32(d)
+        for x, d in zip(r_t, P_MINUS_N_LIMBS)
+    ]
+    _, borrow = bn.carry_l(diff)
+    rpn_in_range = borrow < 0  # r < p - n  <=>  r + n < p
+    matches = bn.eq_l(x_can, rz) | (rpn_in_range & bn.eq_l(x_can, rpnz))
     not_inf = ~bn.is_zero_l(acc.z.limbs)
     return valid_in & not_inf & matches
 
