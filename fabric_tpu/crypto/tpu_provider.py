@@ -116,9 +116,19 @@ class TPUProvider(Provider):
         signatures: Sequence[bytes],
         digests: Sequence[bytes],
     ) -> List[bool]:
-        """Vectorized host path: the C++ batched DER parser (falls back to
-        Python transparently) emits fixed-width (r, s) words + validity
-        masks that feed the device kernel with no per-signature Python."""
+        limbs = self.prep_limbs(keys, signatures, digests)
+        return self._run_kernel(limbs)
+
+    def prep_limbs(
+        self,
+        keys: Sequence[ECDSAPublicKey],
+        signatures: Sequence[bytes],
+        digests: Sequence[bytes],
+    ) -> Tuple[np.ndarray, ...]:
+        """Vectorized host prep shared by the single-chip and mesh paths:
+        the C++ batched DER parser (falls back to Python transparently)
+        emits fixed-width (r, s) words + validity masks; returns the
+        kernel-ready (e, r, s, qx, qy) (20, n) limb arrays + (n,) mask."""
         from fabric_tpu.utils import native
 
         n = len(signatures)
@@ -143,34 +153,29 @@ class TPUProvider(Provider):
                 continue
             qx[:, i] = kx
             qy[:, i] = ky
-        return self._run_kernel(e_bytes, r_bytes, s_bytes, qx, qy, ok)
-
-    def _run_kernel(
-        self,
-        e_bytes: np.ndarray,
-        r_bytes: np.ndarray,
-        s_bytes: np.ndarray,
-        qx: np.ndarray,
-        qy: np.ndarray,
-        ok: np.ndarray,
-    ) -> List[bool]:
-        n = ok.shape[0]
-        size = _bucket(n)
-        pad = size - n
-
-        def padded(a, axis):
-            if pad == 0:
-                return a
-            widths = [(0, 0)] * a.ndim
-            widths[axis] = (0, pad)
-            return np.pad(a, widths)
-
-        out = self._pk.verify_batch_jit(
-            padded(be_bytes_to_limbs(e_bytes), 1),
-            padded(be_bytes_to_limbs(r_bytes), 1),
-            padded(be_bytes_to_limbs(s_bytes), 1),
-            padded(qx, 1),
-            padded(qy, 1),
-            padded(ok.astype(bool), 0),
+        return (
+            be_bytes_to_limbs(e_bytes),
+            be_bytes_to_limbs(r_bytes),
+            be_bytes_to_limbs(s_bytes),
+            qx,
+            qy,
+            ok,
         )
+
+    @staticmethod
+    def pad_limbs(
+        limbs: Sequence[np.ndarray], size: int
+    ) -> Tuple[np.ndarray, ...]:
+        """Pad (e, r, s, qx, qy, ok) from n lanes to `size` dead lanes."""
+        *arrays, ok = limbs
+        pad = size - ok.shape[0]
+        if pad == 0:
+            return (*arrays, ok.astype(bool))
+        return tuple(
+            np.pad(a, [(0, 0), (0, pad)]) for a in arrays
+        ) + (np.pad(ok.astype(bool), (0, pad)),)
+
+    def _run_kernel(self, limbs: Sequence[np.ndarray]) -> List[bool]:
+        n = limbs[-1].shape[0]
+        out = self._pk.verify_batch_jit(*self.pad_limbs(limbs, _bucket(n)))
         return list(np.asarray(out)[:n])

@@ -1,0 +1,35 @@
+"""Mesh-sharded execution (SURVEY.md §2.13 P3/P6).
+
+The reference scales by channel-level process parallelism
+(core/peer/peer.go:337-408: independent Channel objects) and per-tx
+goroutines. The TPU-native equivalents here:
+
+- `mesh`: device-mesh construction ("data" and "channel" axes).
+- `sharded.ShardedVerify`: the batched ECDSA kernel jitted over a mesh —
+  batch lanes sharded over "data" (P2/P6), whole channels sharded over
+  "channel" (P3), masks all-gathered over ICI.
+- `provider.MeshTPUProvider`: drop-in BCCSP provider that spreads one
+  channel's (tx x sig) batch over every device.
+- `multichannel.MultiChannelValidator`: validates one block per channel
+  in a single device step (BASELINE config #5: 4 channels x 2k tx).
+"""
+
+from fabric_tpu.parallel.mesh import (
+    CHANNEL_AXIS,
+    DATA_AXIS,
+    flat_mesh,
+    grid_mesh,
+)
+from fabric_tpu.parallel.sharded import ShardedVerify
+from fabric_tpu.parallel.provider import MeshTPUProvider
+from fabric_tpu.parallel.multichannel import MultiChannelValidator
+
+__all__ = [
+    "CHANNEL_AXIS",
+    "DATA_AXIS",
+    "flat_mesh",
+    "grid_mesh",
+    "ShardedVerify",
+    "MeshTPUProvider",
+    "MultiChannelValidator",
+]

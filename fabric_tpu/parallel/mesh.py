@@ -1,0 +1,58 @@
+"""Device-mesh construction for sharded validation.
+
+Axis names mirror the two parallelism axes the reference exposes
+(SURVEY.md §2.13): "data" = the flattened (tx x sig) lane dimension
+(reference P1/P2, goroutine-per-tx + per-endorsement verify loops), and
+"channel" = fully independent per-channel validators (reference P3,
+core/peer/peer.go:337-408).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+DATA_AXIS = "data"
+CHANNEL_AXIS = "channel"
+
+
+def _device_pool(devices):
+    import jax
+
+    return list(devices) if devices is not None else jax.devices()
+
+
+def flat_mesh(devices: Optional[Sequence] = None):
+    """One-dimensional mesh: every device on the "data" axis."""
+    from jax.sharding import Mesh
+
+    pool = _device_pool(devices)
+    return Mesh(np.array(pool), axis_names=(DATA_AXIS,))
+
+
+def grid_mesh(
+    channel: int,
+    data: Optional[int] = None,
+    devices: Optional[Sequence] = None,
+):
+    """Two-dimensional (channel, data) mesh.
+
+    `channel` groups of `data` devices each; defaults to using the whole
+    pool (data = n // channel).
+    """
+    from jax.sharding import Mesh
+
+    pool = _device_pool(devices)
+    if data is None:
+        if len(pool) % channel:
+            raise ValueError(
+                f"{len(pool)} devices not divisible into {channel} channel groups"
+            )
+        data = len(pool) // channel
+    if channel * data > len(pool):
+        raise ValueError(
+            f"mesh {channel}x{data} needs {channel * data} devices, have {len(pool)}"
+        )
+    arr = np.array(pool[: channel * data]).reshape(channel, data)
+    return Mesh(arr, axis_names=(CHANNEL_AXIS, DATA_AXIS))

@@ -1,0 +1,80 @@
+"""Multi-channel validation in one device step (SURVEY.md §2.13 P3;
+BASELINE config #5: 4 channels x 2k-tx blocks sharded over the mesh).
+
+The reference validates channels in fully independent per-channel
+Channel objects (core/peer/peer.go:337-408) — process-level parallelism.
+The TPU-native form: collect one block per channel, host-parse each,
+flatten every channel's signature jobs to fixed-shape lanes, stack on a
+leading channel axis, and run ONE sharded program; per-channel masks
+come back in a single device step, then each channel finishes its
+host-side phases (principal matching, policy circuits, dup-TxID) exactly
+as in the single-channel path.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+from fabric_tpu.crypto.tpu_provider import TPUProvider, _bucket
+from fabric_tpu.parallel.sharded import ShardedVerify, channel_stack, pad_lanes
+from fabric_tpu.protos import common_pb2
+from fabric_tpu.validation.msgvalidation import parse_transaction
+from fabric_tpu.validation.txflags import ValidationFlags
+from fabric_tpu.validation.validator import BlockValidator
+
+
+class MultiChannelValidator:
+    """Validates one block per channel in a single sharded device batch."""
+
+    def __init__(self, mesh, validators: Dict[str, BlockValidator]):
+        self.validators = dict(validators)
+        self.sharded = ShardedVerify(mesh)
+        # host prep (DER parse, key-limb cache) shared across channels
+        self._prep = TPUProvider()
+
+    def validate(
+        self, blocks: Dict[str, common_pb2.Block]
+    ) -> Dict[str, ValidationFlags]:
+        channels = sorted(blocks)
+        unknown = [c for c in channels if c not in self.validators]
+        if unknown:
+            raise KeyError(f"no validator for channels {unknown}")
+
+        # phase 1+2 host prep per channel
+        per_channel = {}
+        lanes = 0
+        for ch in channels:
+            validator = self.validators[ch]
+            block = blocks[ch]
+            parsed = [
+                parse_transaction(i, d) for i, d in enumerate(block.data.data)
+            ]
+            jobs, job_identity, keys, sigs, payloads = (
+                validator.collect_sig_jobs(parsed)
+            )
+            digests = validator.provider.batch_hash(payloads)
+            limbs = self._prep.prep_limbs(keys, sigs, digests)
+            per_channel[ch] = (validator, block, parsed, jobs, job_identity, limbs)
+            lanes = max(lanes, limbs[-1].shape[0])
+
+        # one fixed-shape device step for every channel
+        lanes = pad_lanes(_bucket(max(lanes, 1)), self.sharded.data_size)
+        n_channels = pad_lanes(len(channels), self.sharded.channel_size)
+        stacked = channel_stack(
+            tuple(per_channel[ch][5] for ch in channels), lanes, n_channels
+        )
+        masks = self.sharded.verify_channels(*stacked)
+
+        # per-channel host epilogue
+        out: Dict[str, ValidationFlags] = {}
+        for c, ch in enumerate(channels):
+            validator, block, parsed, jobs, job_identity, limbs = per_channel[ch]
+            n = limbs[-1].shape[0]
+            ok_list = [bool(v) for v in np.asarray(masks[c, :n])]
+            sig_results = validator.finish_sig_results(
+                jobs, job_identity, ok_list
+            )
+            out[ch] = validator.validate(block, parsed, sig_results=sig_results)
+        return out

@@ -1,0 +1,34 @@
+"""Mesh-aware BCCSP provider: one channel's (tx x sig) batch spread over
+every device on the mesh's "data" axis (SURVEY.md §2.13 P2 -> P6)."""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+from fabric_tpu.crypto.tpu_provider import TPUProvider, _bucket
+from fabric_tpu.parallel.sharded import ShardedVerify, pad_lanes
+
+
+class MeshTPUProvider(TPUProvider):
+    """TPUProvider whose device batches run sharded over a mesh.
+
+    Occupies the same bccsp-factory slot as TPUProvider; buckets are
+    additionally aligned to the data-axis size so every shard gets equal
+    fixed-shape work.
+    """
+
+    def __init__(self, mesh=None):
+        super().__init__()
+        if mesh is None:
+            from fabric_tpu.parallel.mesh import flat_mesh
+
+            mesh = flat_mesh()
+        self.sharded = ShardedVerify(mesh)
+
+    def _run_kernel(self, limbs: Sequence[np.ndarray]) -> List[bool]:
+        n = limbs[-1].shape[0]
+        size = pad_lanes(_bucket(n), self.sharded.data_size)
+        out = self.sharded.verify_flat(*self.pad_limbs(limbs, size))
+        return list(out[:n])

@@ -152,17 +152,22 @@ class BlockValidator:
         self,
         block: common_pb2.Block,
         parsed: Optional[Sequence[ParsedTx]] = None,
+        sig_results: Optional[Dict[int, bool]] = None,
     ) -> ValidationFlags:
         """Validate a block; writes TRANSACTIONS_FILTER metadata and
         returns the flags (reference Validate, v20/validator.go:180-265).
 
         `parsed` lets the caller share one parse pass with the commit
-        stage instead of re-decoding every envelope."""
+        stage instead of re-decoding every envelope; `sig_results` lets a
+        multi-channel scheduler run the device batch for several channels
+        at once (fabric_tpu.parallel.multichannel) and hand each
+        validator its pre-computed per-job verdicts."""
         data = list(block.data.data)
         if parsed is None:
             parsed = [parse_transaction(i, d) for i, d in enumerate(data)]
 
-        sig_results = self._batch_verify_sigs(parsed)
+        if sig_results is None:
+            sig_results = self._batch_verify_sigs(parsed)
         flags = ValidationFlags(len(data))
         txid_array: List[str] = [""] * len(data)
 
@@ -194,17 +199,19 @@ class BlockValidator:
         return flags
 
     # ------------------------------------------------------------------
-    def _batch_verify_sigs(self, parsed: Sequence[ParsedTx]) -> Dict[int, bool]:
-        """Verify every deferred signature job in one device batch.
-        Returns {id(job): bool}. Identity deserialization/validation
-        failures mark the job False (the per-code mapping happens during
-        assembly)."""
+    def collect_sig_jobs(
+        self, parsed: Sequence[ParsedTx]
+    ) -> Tuple[List[SigJob], Dict[int, Optional[Identity]], List, List[bytes], List[bytes]]:
+        """Phase-2 host prep: every deferred signature job in the block,
+        identities deserialized + cert-chain/CRL validated (reference
+        identities.go:107), verifiable jobs flattened into (keys, sigs,
+        payloads) device-batch inputs."""
         jobs: List[SigJob] = []
         for tx in parsed:
             if tx.creator_sig_job is not None:
                 jobs.append(tx.creator_sig_job)
             jobs.extend(tx.endorsement_jobs)
-        keys, payloads, sigs, mask = [], [], [], []
+        keys, payloads, sigs = [], [], []
         job_identity: Dict[int, Optional[Identity]] = {}
         for job in jobs:
             ident: Optional[Identity] = None
@@ -219,10 +226,16 @@ class BlockValidator:
             keys.append(ident.public_key)
             sigs.append(job.signature)
             payloads.append(job.data)
-        # one batched digest pass over every signed payload, behind the
-        # provider SPI (the C++ host runtime when built, hashlib otherwise)
-        digests = self.provider.batch_hash(payloads)
-        ok_list = self.provider.batch_verify(keys, sigs, digests)
+        return jobs, job_identity, keys, sigs, payloads
+
+    def finish_sig_results(
+        self,
+        jobs: Sequence[SigJob],
+        job_identity: Dict[int, Optional[Identity]],
+        ok_list: Sequence[bool],
+    ) -> Dict[int, bool]:
+        """Map per-lane device verdicts back to {id(job): bool}; jobs whose
+        identity failed deserialization/validation are False."""
         results: Dict[int, bool] = {}
         it = iter(ok_list)
         for job in jobs:
@@ -233,6 +246,18 @@ class BlockValidator:
         self._job_identity = job_identity
         self._sig_results = results
         return results
+
+    def _batch_verify_sigs(self, parsed: Sequence[ParsedTx]) -> Dict[int, bool]:
+        """Verify every deferred signature job in one device batch.
+        Returns {id(job): bool}. Identity deserialization/validation
+        failures mark the job False (the per-code mapping happens during
+        assembly)."""
+        jobs, job_identity, keys, sigs, payloads = self.collect_sig_jobs(parsed)
+        # one batched digest pass over every signed payload, behind the
+        # provider SPI (the C++ host runtime when built, hashlib otherwise)
+        digests = self.provider.batch_hash(payloads)
+        ok_list = self.provider.batch_verify(keys, sigs, digests)
+        return self.finish_sig_results(jobs, job_identity, ok_list)
 
     # ------------------------------------------------------------------
     def _assemble_codes(

@@ -1,0 +1,206 @@
+"""Mesh-sharded validation (SURVEY.md §2.13 P3/P6, BASELINE config #5):
+the sharded provider and the multi-channel single-step validator must be
+bit-exact with the host SoftwareProvider path."""
+
+import hashlib
+
+import jax
+import numpy as np
+import pytest
+
+from fabric_tpu.crypto import p256
+from fabric_tpu.crypto.bccsp import (
+    ECDSAPublicKey,
+    SoftwareProvider,
+    VerifyError,
+)
+from fabric_tpu.crypto.der import marshal_signature
+from fabric_tpu.endorser import create_proposal, create_signed_tx, endorse_proposal
+from fabric_tpu.ledger import rwset as rw
+from fabric_tpu.ledger.rwset_proto import serialize_tx_rwset
+from fabric_tpu.msp.cryptogen import generate_org
+from fabric_tpu.msp.identity import MSPManager
+from fabric_tpu.msp.signer import SigningIdentity
+from fabric_tpu.parallel import (
+    MeshTPUProvider,
+    MultiChannelValidator,
+    flat_mesh,
+    grid_mesh,
+)
+from fabric_tpu.policy import from_dsl
+from fabric_tpu.protos import common_pb2, protoutil
+from fabric_tpu.validation.txflags import TxValidationCode
+from fabric_tpu.validation.validator import (
+    BlockValidator,
+    ChaincodeDefinition,
+    ChaincodeRegistry,
+)
+
+PROVIDER = SoftwareProvider()
+
+
+@pytest.fixture(scope="module")
+def cpu8():
+    devices = jax.devices("cpu")
+    if len(devices) < 8:
+        pytest.skip("needs 8 virtual CPU devices (XLA_FLAGS in conftest)")
+    return devices[:8]
+
+
+# ----------------------------------------------------------------------
+# flat (data-axis) sharding: MeshTPUProvider vs SoftwareProvider
+# ----------------------------------------------------------------------
+
+
+def _sig_cases(n):
+    """(key, sig, digest, expected) mixing valid, wrong-digest, corrupt-DER
+    and high-S lanes."""
+    cases = []
+    for i in range(n):
+        priv = (i * 0x9E3779B97F4A7C15 + 11) % (p256.N - 1) + 1
+        pub = p256.scalar_mult(priv, p256.GENERATOR)
+        key = ECDSAPublicKey(pub[0], pub[1])
+        digest = hashlib.sha256(f"case {i}".encode()).digest()
+        k = (i * 0xD6E8FEB86659FD93 + 7) % (p256.N - 1) + 1
+        r, s = p256.sign_digest(priv, digest, k=k)
+        sig = marshal_signature(r, s)
+        kind = i % 4
+        if kind == 0:
+            cases.append((key, sig, digest))
+        elif kind == 1:  # wrong digest
+            cases.append((key, sig, hashlib.sha256(b"other").digest()))
+        elif kind == 2:  # corrupt DER
+            cases.append((key, b"\x30\x03\x02\x01\x01", digest))
+        else:  # high-S (rejected by the low-S rule, bccsp/sw/ecdsa.go:41)
+            cases.append((key, marshal_signature(r, p256.N - s), digest))
+    return cases
+
+
+def test_flat_sharded_matches_host(cpu8):
+    cases = _sig_cases(48)
+    expected = []
+    for key, sig, digest in cases:
+        try:
+            expected.append(PROVIDER.verify(key, sig, digest))
+        except VerifyError:
+            expected.append(False)
+
+    provider = MeshTPUProvider(flat_mesh(cpu8))
+    got = provider.batch_verify(
+        [c[0] for c in cases], [c[1] for c in cases], [c[2] for c in cases]
+    )
+    assert got == expected
+    assert any(expected) and not all(expected)
+
+
+# ----------------------------------------------------------------------
+# channel-axis sharding: MultiChannelValidator vs per-channel oracle
+# ----------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def net():
+    org1 = generate_org("org1.example.com", "Org1MSP")
+    org2 = generate_org("org2.example.com", "Org2MSP")
+    mgr = MSPManager([org1.msp(provider=PROVIDER), org2.msp(provider=PROVIDER)])
+    registry = ChaincodeRegistry(
+        [
+            ChaincodeDefinition(
+                "mycc", from_dsl("AND('Org1MSP.member','Org2MSP.member')")
+            )
+        ]
+    )
+    return {
+        "mgr": mgr,
+        "registry": registry,
+        "client": SigningIdentity(org1.users[0], PROVIDER),
+        "p1": SigningIdentity(org1.peers[0], PROVIDER),
+        "p2": SigningIdentity(org2.peers[0], PROVIDER),
+    }
+
+
+def _results_bytes(key):
+    return serialize_tx_rwset(
+        rw.TxRwSet((rw.NsRwSet("mycc", (), (rw.KVWrite(key, False, b"v"),)),))
+    )
+
+
+def _make_tx(net, channel, key, endorsers=("p1", "p2"), mangle=None):
+    bundle = create_proposal(net["client"], channel, "mycc", [b"invoke", key.encode()])
+    responses = [
+        endorse_proposal(bundle, net[e], _results_bytes(key)) for e in endorsers
+    ]
+    env = create_signed_tx(bundle, net["client"], responses)
+    if mangle:
+        env = mangle(env)
+    return env
+
+
+def _make_block(envelopes, number):
+    block = protoutil.new_block(number, b"\x22" * 32)
+    for env in envelopes:
+        block.data.data.append(env.SerializeToString())
+    protoutil.seal_block(block)
+    return block
+
+
+def _bad_creator(env):
+    env.signature = env.signature[:-4] + b"\x00\x00\x00\x00"
+    return env
+
+
+def _channel_block(net, channel, number):
+    """A block mixing VALID, BAD_CREATOR_SIGNATURE and
+    ENDORSEMENT_POLICY_FAILURE txs, unique per channel."""
+    txs = [
+        _make_tx(net, channel, f"{channel}-k0"),
+        _make_tx(net, channel, f"{channel}-k1", mangle=_bad_creator),
+        _make_tx(net, channel, f"{channel}-k2", endorsers=("p1",)),
+        _make_tx(net, channel, f"{channel}-k3"),
+    ]
+    return _make_block(txs, number)
+
+
+def _validator(net, channel):
+    return BlockValidator(
+        channel, net["mgr"], SoftwareProvider(), net["registry"]
+    )
+
+
+def test_multichannel_grid_bit_exact(cpu8, net):
+    channels = [f"ch{i}" for i in range(4)]
+    blocks = {ch: _channel_block(net, ch, 5) for ch in channels}
+
+    # oracle: each channel through the host-only validator
+    expected = {}
+    for ch in channels:
+        block = common_pb2.Block()
+        block.CopyFrom(blocks[ch])
+        expected[ch] = _validator(net, ch).validate(block).tobytes()
+
+    mesh = grid_mesh(4, 2, cpu8)
+    mc = MultiChannelValidator(
+        mesh, {ch: _validator(net, ch) for ch in channels}
+    )
+    flags = mc.validate(blocks)
+
+    for ch in channels:
+        assert flags[ch].tobytes() == expected[ch], ch
+        assert (
+            blocks[ch].metadata.metadata[common_pb2.TRANSACTIONS_FILTER]
+            == expected[ch]
+        )
+    # the scenario mix actually exercised all three codes
+    codes = set(expected["ch0"])
+    assert codes == {
+        TxValidationCode.VALID,
+        TxValidationCode.BAD_CREATOR_SIGNATURE,
+        TxValidationCode.ENDORSEMENT_POLICY_FAILURE,
+    }
+
+
+def test_multichannel_rejects_unknown_channel(cpu8, net):
+    mesh = grid_mesh(4, 2, cpu8)
+    mc = MultiChannelValidator(mesh, {"ch0": _validator(net, "ch0")})
+    with pytest.raises(KeyError):
+        mc.validate({"nope": _channel_block(net, "nope", 1)})
