@@ -114,6 +114,12 @@ class ChaincodeStub:
             self._ns, start, start + "\U0010ffff"
         )
 
+    def get_query_result(self, query) -> Iterator[Tuple[str, bytes]]:
+        """Rich selector query over this namespace's JSON state
+        (reference shim GetQueryResult -> statecouchdb.go:695; not
+        phantom-protected, like the reference)."""
+        return iter(self._sim.execute_query(self._ns, query))
+
     # -- key-level endorsement (SBE) --
     def set_state_validation_parameter(self, key: str, policy: bytes) -> None:
         self._sim.set_state_metadata(

@@ -152,23 +152,50 @@ def pvt_data_matches_hashes(
 
 
 class KVLedger:
-    """One channel's ledger (block store + state + history)."""
+    """One channel's ledger (block store + state + history).
 
-    def __init__(self, ledger_dir: str, channel_id: str, btl_policy=None):
+    `persistent=True` (the default) keeps state + history in an embedded
+    on-disk B-tree (fabric_tpu.ledger.persistent, the stateleveldb
+    analog) with a per-block savepoint, so reopening a tall ledger
+    replays only the blocks committed after the last durable state write
+    instead of the whole chain (kv_ledger.go recoverDBs). In-memory mode
+    remains for simulation/tests and rebuilds everything by replay."""
+
+    def __init__(
+        self,
+        ledger_dir: str,
+        channel_id: str,
+        btl_policy=None,
+        persistent: bool = True,
+    ):
         self.channel_id = channel_id
+        self.persistent = persistent
         self.block_store = BlockStore(os.path.join(ledger_dir, f"{channel_id}.chain"))
         self.pvt_store = PvtDataStore(
             os.path.join(ledger_dir, f"{channel_id}.pvtdata"),
             btl_policy=btl_policy,
         )
-        self.state_db = VersionedDB()
+        if persistent:
+            from fabric_tpu.ledger.persistent import SqliteVersionedDB
+
+            self.state_db = SqliteVersionedDB(
+                os.path.join(ledger_dir, f"{channel_id}.state.db")
+            )
+        else:
+            self.state_db = VersionedDB()
         self.history: Dict[Tuple[str, str], List[Version]] = {}
         self.commit_hash = b""
         self._recover()
 
     # -- recovery: replay the block store into derived state ---------------
     def _recover(self) -> None:
-        for block in self.block_store.iter_blocks():
+        start = 0
+        if self.persistent:
+            savepoint = self.state_db.savepoint()
+            if savepoint is not None:
+                start = savepoint + 1
+                self.commit_hash = self.state_db.commit_hash()
+        for block in self.block_store.iter_blocks(start):
             self._apply_committed_block(block)
 
     def _apply_committed_block(self, block: common_pb2.Block) -> None:
@@ -364,9 +391,19 @@ class KVLedger:
         hashed: HashedUpdateBatch,
         pvt: Optional[PvtUpdateBatch] = None,
     ) -> None:
-        for (ns, key), entry in updates.items():
-            self.history.setdefault((ns, key), []).append(entry.version)
-        self.state_db.apply_updates(updates, hashed, pvt)
+        if self.persistent:
+            # state + history + savepoint + commit hash, one transaction
+            self.state_db.commit_block(
+                updates,
+                hashed,
+                pvt,
+                savepoint=block.header.number,
+                commit_hash=self.commit_hash,
+            )
+        else:
+            for (ns, key), entry in updates.items():
+                self.history.setdefault((ns, key), []).append(entry.version)
+            self.state_db.apply_updates(updates, hashed, pvt)
 
     # -- admin ops (reference kvledger reset.go / rollback.go /
     #    rebuild_dbs.go: state & history are derived caches over the
@@ -383,7 +420,10 @@ class KVLedger:
                 f"below block {self.block_store.base_height} is not in "
                 "the block store"
             )
-        self.state_db = VersionedDB()
+        if self.persistent:
+            self.state_db.clear()
+        else:
+            self.state_db = VersionedDB()
         self.history = {}
         self.commit_hash = b""
         self._recover()
@@ -414,7 +454,13 @@ class KVLedger:
         return vv.value if vv else None
 
     def get_history_for_key(self, ns: str, key: str) -> List[Version]:
+        if self.persistent:
+            return self.state_db.get_history(ns, key)
         return list(self.history.get((ns, key), []))
+
+    def execute_query(self, ns: str, query) -> List[Tuple[str, bytes]]:
+        """Rich selector query over committed state (statecouchdb.go:695)."""
+        return self.state_db.execute_query(ns, query)
 
     def tx_exists(self, txid: str) -> bool:
         return self.block_store.tx_exists(txid)

@@ -160,6 +160,14 @@ class TxSimulator:
         )
         return iter(results)
 
+    def execute_query(self, ns: str, query) -> List[Tuple[str, bytes]]:
+        """Rich selector query (chaincode GetQueryResult; reference
+        statecouchdb.go:695). Like the reference's CouchDB path, results
+        add NO reads to the rwset — rich queries are not phantom-protected
+        (documented Fabric behavior)."""
+        self._check_open()
+        return self._db.execute_query(ns, query)
+
     # -- private data -----------------------------------------------------
     def get_private_data(self, ns: str, coll: str, key: str) -> Optional[bytes]:
         self._check_open()

@@ -61,19 +61,15 @@ def generate_snapshot(ledger, out_dir: str) -> Dict[str, str]:
         raise ValueError("cannot snapshot an empty ledger")
 
     with open(os.path.join(out_dir, PUBLIC_STATE), "wb") as f:
-        for ns in sorted(ledger.state_db._data):
-            table = ledger.state_db._data[ns]
-            for key in sorted(table):
-                vv = table[key]
-                _w(f, ns.encode())
-                _w(f, key.encode())
-                _w(f, vv.value)
-                _w(f, _version_bytes(vv.version))
-                _w(f, vv.metadata or b"")
+        for ns, key, vv in ledger.state_db.iter_all_state():
+            _w(f, ns.encode())
+            _w(f, key.encode())
+            _w(f, vv.value)
+            _w(f, _version_bytes(vv.version))
+            _w(f, vv.metadata or b"")
 
     with open(os.path.join(out_dir, PVT_HASHES), "wb") as f:
-        for (ns, coll, kh) in sorted(ledger.state_db._hashed):
-            vv = ledger.state_db._hashed[(ns, coll, kh)]
+        for ns, coll, kh, vv in ledger.state_db.iter_all_hashed():
             _w(f, ns.encode())
             _w(f, coll.encode())
             _w(f, kh)

@@ -231,3 +231,30 @@ class VersionedDB:
 
     def num_keys(self) -> int:
         return sum(len(t) for t in self._data.values())
+
+    # -- full iteration (snapshot export) ----------------------------------
+    def iter_all_state(self) -> Iterator[Tuple[str, str, VersionedValue]]:
+        """Deterministic (ns, key, value) iteration over all public state."""
+        for ns in sorted(self._data):
+            table = self._data[ns]
+            for key in self._sorted_keys[ns]:
+                yield ns, key, table[key]
+
+    def iter_all_hashed(
+        self,
+    ) -> Iterator[Tuple[str, str, bytes, VersionedValue]]:
+        for ns, coll, kh in sorted(self._hashed):
+            yield ns, coll, kh, self._hashed[(ns, coll, kh)]
+
+    # -- rich queries (statecouchdb.go:695 analog) -------------------------
+    def execute_query(self, ns: str, query):
+        """Selector query over a namespace's JSON values (see
+        fabric_tpu.ledger.queries). Not phantom-protected, like the
+        reference's CouchDB queries."""
+        from fabric_tpu.ledger import queries as rich_queries
+
+        table = self._data.get(ns, {})
+        rows = (
+            (key, table[key].value) for key in self._sorted_keys.get(ns, [])
+        )
+        return rich_queries.execute(rows, query)
