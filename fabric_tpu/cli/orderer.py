@@ -14,6 +14,8 @@ orderer.yaml (localconfig subset):
     WorkDir: /var/fabric-tpu/orderer
   Operations:
     ListenAddress: 127.0.0.1:9443
+  Cluster:                           # raft cluster membership
+    NodeId: 2                        # this orderer's consenter index (1-based)
 """
 
 from __future__ import annotations
@@ -47,12 +49,14 @@ def start(config_path: str, block_until_signal: bool = True) -> OrdererNode:
         f"{general.get('ListenPort', 7050)}"
     )
     ops = (cfg.get("Operations") or {}).get("ListenAddress")
+    cluster = cfg.get("Cluster") or {}
     node = OrdererNode(
         general.get("WorkDir", "orderer-data"),
         signer=signer,
         listen_address=listen,
         system_channel_id=general.get("SystemChannel"),
         ops_address=ops,
+        raft_node_id=int(cluster.get("NodeId", 1)),
     )
     bootstrap = general.get("BootstrapFile")
     if bootstrap:

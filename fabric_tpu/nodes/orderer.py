@@ -14,7 +14,7 @@ from fabric_tpu.deliver.server import BlockSource, DeliverHandler
 from fabric_tpu.operations import Options as OpsOptions, System
 from fabric_tpu.orderer.broadcast import BroadcastHandler
 from fabric_tpu.orderer.multichannel import Registrar
-from fabric_tpu.protos import common_pb2
+from fabric_tpu.protos import common_pb2, protoutil
 
 
 def parse_duration(text: str, default: float) -> float:
@@ -43,20 +43,39 @@ class OrdererNode:
         system_channel_id: Optional[str] = None,
         ops_address: Optional[str] = None,
         provider=None,
+        raft_node_id: int = 1,
+        raft_tick_seconds: float = 0.1,
     ):
+        from fabric_tpu.orderer.cluster import ClusterClient, ClusterService
+
+        # cluster comm (orderer/common/cluster): raft messages between
+        # orderers ride the Step stream on THIS node's gRPC listener;
+        # consenter endpoints come from each channel's consensus metadata
+        # at join time (main.go initializeClusterClientConfig).
+        self.raft_node_id = raft_node_id
+        self.raft_tick_seconds = raft_tick_seconds
+        self.cluster_client = ClusterClient(raft_node_id, {})
         self.registrar = Registrar(
             work_dir,
             signer=signer,
             system_channel_id=system_channel_id,
             provider=provider,
+            raft_node_id=raft_node_id,
+            raft_transport_factory=self.cluster_client.transport_factory,
         )
-        self.broadcast = BroadcastHandler(self.registrar, signer=signer)
+        self.broadcast = BroadcastHandler(
+            self.registrar, signer=signer, cluster_client=self.cluster_client
+        )
         self._block_events: dict[str, threading.Condition] = {}
         self.registrar.on_block(self._notify_block)
+        # keep consenter endpoints current for channels created ANY way
+        # (join, system-channel creation, consenter-set config updates)
+        self.registrar.on_chain(self._refresh_cluster_endpoints)
 
         self.deliver = DeliverHandler(self._block_source)
         self.server = GRPCServer(listen_address)
         register_atomic_broadcast(self.server, self.broadcast, self.deliver)
+        ClusterService(self.registrar, self.broadcast).register(self.server)
 
         self.ops: Optional[System] = None
         if ops_address is not None:
@@ -92,6 +111,39 @@ class OrdererNode:
     def join_channel(self, genesis_block: common_pb2.Block):
         return self.registrar.join_channel(genesis_block)
 
+    def _refresh_cluster_endpoints(self, support) -> None:
+        """Per-channel consenter endpoints from the channel's consensus
+        metadata (reference: cluster endpoints come from the config
+        block; refreshed on chain start and every config block)."""
+        bundle = support.bundle
+        if bundle.orderer is None or bundle.orderer.consensus_type != "etcdraft":
+            return
+        from fabric_tpu.protos import configuration_pb2
+
+        try:
+            meta = protoutil.unmarshal(
+                configuration_pb2.RaftConfigMetadata,
+                bundle.orderer.consensus_metadata,
+            )
+        except ValueError:
+            return
+        self.cluster_client.set_channel_endpoints(
+            support.channel_id,
+            {i + 1: f"{c.host}:{c.port}" for i, c in enumerate(meta.consenters)},
+        )
+
+    def _raft_tick_loop(self) -> None:
+        """Wall-clock ticker driving raft election/heartbeat timers for
+        every raft channel (etcdraft chain.go's clock)."""
+        while not self._stopped.wait(self.raft_tick_seconds):
+            for support in list(self.registrar.chains.values()):
+                chain = support.chain
+                if hasattr(chain, "tick") and hasattr(chain, "node"):
+                    try:
+                        chain.tick()
+                    except Exception:  # noqa: BLE001 - chain-local failure
+                        pass
+
     def _flush_loop(self) -> None:
         """Batch-timeout ticker (reference blockcutter timer in the
         consenter run loops): cut pending batches for every channel at
@@ -120,11 +172,16 @@ class OrdererNode:
             target=self._flush_loop, name="blockcutter-timeout", daemon=True
         )
         self._flusher.start()
+        self._raft_ticker = threading.Thread(
+            target=self._raft_tick_loop, name="raft-ticker", daemon=True
+        )
+        self._raft_ticker.start()
         return self.server.start()
 
     def stop(self) -> None:
         if getattr(self, "_stopped", None) is not None:
             self._stopped.set()
+        self.cluster_client.stop()
         self.server.stop()
         if self.ops is not None:
             self.ops.stop()

@@ -21,14 +21,20 @@ from fabric_tpu.protos import common_pb2, protoutil
 
 
 class BroadcastHandler:
-    def __init__(self, registrar: Registrar, signer=None):
+    def __init__(self, registrar: Registrar, signer=None, cluster_client=None):
         self.registrar = registrar
         self.signer = signer
+        # follower -> leader Submit forwarding (orderer/common/cluster
+        # comm.go Submit path); None on a solo/single orderer
+        self.cluster_client = cluster_client
 
     def process_message(
-        self, env: common_pb2.Envelope
+        self, env: common_pb2.Envelope, forwarded: bool = False
     ) -> Tuple[int, str]:
-        """One Broadcast message -> (common.Status, info)."""
+        """One Broadcast message -> (common.Status, info). `forwarded`
+        marks a Submit that already hopped orderer-to-orderer once: it
+        must not be re-forwarded (redirect loop) even if leadership moved
+        again."""
         try:
             payload = protoutil.unmarshal(common_pb2.Payload, env.payload)
             if not payload.header.channel_header:
@@ -77,6 +83,14 @@ class BroadcastHandler:
         except (MsgProcessorError, RegistrarError) as e:
             return common_pb2.BAD_REQUEST, str(e)
         except NotLeaderError as e:
+            if (
+                not forwarded
+                and self.cluster_client is not None
+                and e.leader_id
+            ):
+                return self.cluster_client.forward_submit(
+                    chdr.channel_id, env, e.leader_id
+                )
             return common_pb2.SERVICE_UNAVAILABLE, str(e)
         except ValueError as e:
             return common_pb2.BAD_REQUEST, str(e)

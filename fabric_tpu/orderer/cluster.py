@@ -1,0 +1,267 @@
+"""Orderer-to-orderer cluster communication (reference orderer/common/
+cluster/comm.go:117,127: per-channel DispatchSubmit / DispatchConsensus
+behind the Step RPC).
+
+Two paths, like the reference:
+
+- Consensus: raft wire messages between cluster members, carried on a
+  long-lived Step stream (fire-and-forget; raft handles loss by
+  retransmission on the next tick/append).
+- Submit: transaction forwarding from a follower to the raft leader, a
+  unary call that returns the leader's Broadcast status (reference
+  SubmitRequest/SubmitResponse on the Step stream).
+
+The client keeps one sender thread + queue per remote node; broken
+connections drop queued messages and reconnect lazily (raft tolerates
+this: lost appends retransmit, lost votes retrigger elections).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Dict, Optional, Tuple
+
+import grpc
+
+from fabric_tpu.comm.server import GRPCServer, STREAM_STREAM, UNARY, channel_to
+from fabric_tpu.orderer.raft import Message, message_from_bytes, message_to_bytes
+from fabric_tpu.protos import cluster_pb2, common_pb2
+
+SERVICE_NAME = "orderer.Cluster"
+
+
+class ClusterService:
+    """Server side: dispatch Step payloads to the local registrar's chains
+    (comm.go DispatchSubmit/DispatchConsensus)."""
+
+    def __init__(self, registrar, broadcast_handler=None):
+        self.registrar = registrar
+        self.broadcast = broadcast_handler
+
+    # Step: bidi stream of consensus messages (no responses)
+    def step(self, request_iterator, context):
+        for req in request_iterator:
+            which = req.WhichOneof("payload")
+            if which == "consensus_request":
+                self._dispatch_consensus(req.consensus_request)
+            elif which == "submit_request":
+                status, info = self._dispatch_submit(req.submit_request)
+                resp = cluster_pb2.ClusterStepResponse()
+                resp.submit_res.channel = req.submit_request.channel
+                resp.submit_res.status = status
+                resp.submit_res.info = info
+                yield resp
+
+    def submit(self, request, context):
+        status, info = self._dispatch_submit(request)
+        resp = cluster_pb2.ClusterSubmitResponse()
+        resp.channel = request.channel
+        resp.status = status
+        resp.info = info
+        return resp
+
+    def _dispatch_consensus(self, req) -> None:
+        support = self.registrar.get_chain(req.channel)
+        if support is None:
+            return  # unknown channel: drop (reference logs + errors the stream)
+        chain = support.chain
+        if hasattr(chain, "step"):
+            try:
+                chain.step(message_from_bytes(req.payload))
+            except Exception:
+                # a malformed/stale message must not kill the stream
+                pass
+
+    def _dispatch_submit(self, req) -> Tuple[int, str]:
+        if self.broadcast is None:
+            return common_pb2.SERVICE_UNAVAILABLE, "no broadcast handler"
+        # leader-side processing of a forwarded envelope: same msgprocessor
+        # + order path as a direct Broadcast (broadcast.go), minus another
+        # forwarding hop (forwarded=True breaks redirect loops).
+        return self.broadcast.process_message(req.payload, forwarded=True)
+
+    def register(self, server: GRPCServer) -> None:
+        server.register(
+            SERVICE_NAME,
+            {
+                "Step": (
+                    STREAM_STREAM,
+                    self.step,
+                    cluster_pb2.ClusterStepRequest.FromString,
+                    cluster_pb2.ClusterStepResponse.SerializeToString,
+                ),
+                "Submit": (
+                    UNARY,
+                    self.submit,
+                    cluster_pb2.ClusterSubmitRequest.FromString,
+                    cluster_pb2.ClusterSubmitResponse.SerializeToString,
+                ),
+            },
+        )
+
+
+class _Remote:
+    """One peer orderer: lazy channel + a sender thread draining a queue
+    into the Step stream (reference cluster.RemoteContext/Remote :168)."""
+
+    def __init__(self, addr: str, root_ca: Optional[bytes] = None):
+        self.addr = addr
+        self.root_ca = root_ca
+        self.q: "queue.Queue[Optional[cluster_pb2.ClusterStepRequest]]" = (
+            queue.Queue(maxsize=4096)
+        )
+        self._channel: Optional[grpc.Channel] = None
+        self._thread = threading.Thread(
+            target=self._run, name=f"cluster-send-{addr}", daemon=True
+        )
+        self._stopped = False
+        self._thread.start()
+
+    def channel(self) -> grpc.Channel:
+        if self._channel is None:
+            self._channel = channel_to(self.addr, self.root_ca)
+        return self._channel
+
+    def enqueue_consensus(self, channel_id: str, msg: Message) -> None:
+        req = cluster_pb2.ClusterStepRequest()
+        req.consensus_request.channel = channel_id
+        req.consensus_request.payload = message_to_bytes(msg)
+        req.consensus_request.from_node = msg.frm
+        try:
+            self.q.put_nowait(req)
+        except queue.Full:
+            pass  # backpressure: drop; raft retransmits
+
+    def submit(
+        self, channel_id: str, env: common_pb2.Envelope, timeout: float = 10.0
+    ) -> Tuple[int, str]:
+        req = cluster_pb2.ClusterSubmitRequest()
+        req.channel = channel_id
+        req.payload.CopyFrom(env)
+        resp_bytes = self.channel().unary_unary(
+            f"/{SERVICE_NAME}/Submit",
+            request_serializer=cluster_pb2.ClusterSubmitRequest.SerializeToString,
+            response_deserializer=cluster_pb2.ClusterSubmitResponse.FromString,
+        )(req, timeout=timeout)
+        return resp_bytes.status, resp_bytes.info
+
+    def _run(self) -> None:
+        while not self._stopped:
+            first = self.q.get()
+            if first is None:
+                return
+
+            def gen(head):
+                yield head
+                while True:
+                    item = self.q.get()
+                    if item is None:
+                        return
+                    yield item
+
+            try:
+                stream = self.channel().stream_stream(
+                    f"/{SERVICE_NAME}/Step",
+                    request_serializer=(
+                        cluster_pb2.ClusterStepRequest.SerializeToString
+                    ),
+                    response_deserializer=(
+                        cluster_pb2.ClusterStepResponse.FromString
+                    ),
+                )(gen(first))
+                for _ in stream:  # drain (submit responses not used here)
+                    pass
+            except grpc.RpcError:
+                # connection lost: reset the channel; messages queued in
+                # the meantime go out on the next stream
+                if self._channel is not None:
+                    self._channel.close()
+                    self._channel = None
+                if self._stopped:
+                    return
+                threading.Event().wait(0.05)
+
+    def stop(self) -> None:
+        self._stopped = True
+        self.q.put(None)
+        if self._channel is not None:
+            self._channel.close()
+
+
+class ClusterClient:
+    """Client side: the raft transport over real sockets. Endpoint maps
+    are PER CHANNEL (each channel's consenter set comes from its own
+    config block and channels may disagree about who node N is); remotes
+    are shared per address. Gives the Registrar a transport factory and
+    the broadcast path a leader-forwarding hook."""
+
+    def __init__(
+        self,
+        node_id: int,
+        endpoints: Optional[Dict[int, str]] = None,
+        root_ca: Optional[bytes] = None,
+    ):
+        self.node_id = node_id
+        self._default: Dict[int, str] = dict(endpoints or {})
+        self._by_channel: Dict[str, Dict[int, str]] = {}
+        self.root_ca = root_ca
+        self._remotes: Dict[str, _Remote] = {}  # keyed by address
+        self._lock = threading.Lock()
+
+    def set_channel_endpoints(
+        self, channel_id: str, endpoints: Dict[int, str]
+    ) -> None:
+        """Install/refresh one channel's consenter map (called on channel
+        start and on every config block — consensus metadata is the
+        source of truth, orderer main.go initializeClusterClientConfig)."""
+        with self._lock:
+            self._by_channel[channel_id] = dict(endpoints)
+
+    def _addr(self, channel_id: str, to: int) -> Optional[str]:
+        with self._lock:
+            chan = self._by_channel.get(channel_id)
+            if chan is not None and to in chan:
+                return chan[to]
+            return self._default.get(to)
+
+    def _remote_for(self, addr: str) -> _Remote:
+        with self._lock:
+            r = self._remotes.get(addr)
+            if r is None:
+                r = _Remote(addr, self.root_ca)
+                self._remotes[addr] = r
+            return r
+
+    def transport_factory(
+        self, channel_id: str, node_id: int
+    ) -> Callable[[int, Message], None]:
+        def send(to: int, msg: Message) -> None:
+            if to == self.node_id:
+                return
+            addr = self._addr(channel_id, to)
+            if addr is not None:
+                self._remote_for(addr).enqueue_consensus(channel_id, msg)
+
+        return send
+
+    def forward_submit(
+        self, channel_id: str, env: common_pb2.Envelope, leader_id: int
+    ) -> Tuple[int, str]:
+        """Follower -> leader transaction forwarding (comm.go Submit)."""
+        addr = self._addr(channel_id, leader_id)
+        if addr is None:
+            return (
+                common_pb2.SERVICE_UNAVAILABLE,
+                f"no endpoint for leader {leader_id}",
+            )
+        try:
+            return self._remote_for(addr).submit(channel_id, env)
+        except grpc.RpcError as e:
+            return common_pb2.SERVICE_UNAVAILABLE, f"leader unreachable: {e.code()}"
+
+    def stop(self) -> None:
+        with self._lock:
+            for r in self._remotes.values():
+                r.stop()
+            self._remotes.clear()

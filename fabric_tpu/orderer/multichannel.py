@@ -69,11 +69,21 @@ class Registrar:
         )
         self.chains: Dict[str, ChainSupport] = {}
         self._block_listeners: List[Callable[[str, common_pb2.Block], None]] = []
+        self._chain_listeners: List[Callable[[ChainSupport], None]] = []
 
     # -- wiring -------------------------------------------------------------
     def on_block(self, fn: Callable[[str, common_pb2.Block], None]) -> None:
         """Deliver-service hook: called for every block written anywhere."""
         self._block_listeners.append(fn)
+
+    def on_chain(self, fn: Callable[[ChainSupport], None]) -> None:
+        """Called when a chain starts AND after every config block it
+        applies — the hook the node uses to keep cluster consenter
+        endpoints current for channels created any way (join, system
+        channel, config update)."""
+        self._chain_listeners.append(fn)
+        for support in self.chains.values():
+            fn(support)
 
     def _sink_for(self, channel_id: str) -> Callable[[common_pb2.Block], None]:
         def sink(block: common_pb2.Block) -> None:
@@ -150,6 +160,8 @@ class Registrar:
         support = ChainSupport(channel_id, bundle, validator, processor, chain)
         support_holder.append(support)
         self.chains[channel_id] = support
+        for fn in self._chain_listeners:
+            fn(support)
         return support
 
     def _apply_config_block(
@@ -164,6 +176,8 @@ class Registrar:
         support.bundle = new_bundle
         support.validator.config = cenv.config
         support.processor.update_bundle(new_bundle)
+        for fn in self._chain_listeners:
+            fn(support)
 
     # -- lookup -------------------------------------------------------------
     def get_chain(self, channel_id: str) -> Optional[ChainSupport]:

@@ -66,6 +66,90 @@ class Message:
 FOLLOWER, CANDIDATE, LEADER = "follower", "candidate", "leader"
 
 
+# -- wire codec (cluster Step RPC payloads) ---------------------------------
+
+_KINDS = ("vote_req", "vote_resp", "append", "append_resp", "snap")
+
+
+def message_to_bytes(m: Message) -> bytes:
+    """Frame a Message for the orderer-to-orderer Consensus stream
+    (reference cluster ConsensusRequest.payload carries etcd raftpb bytes;
+    here the same struct framing style as the WAL)."""
+    head = struct.pack(
+        "<BQQQQQQBBQQQQ",
+        _KINDS.index(m.kind),
+        m.term,
+        m.frm,
+        m.to,
+        m.prev_index,
+        m.prev_term,
+        m.commit,
+        1 if m.granted else 0,
+        1 if m.success else 0,
+        m.match_index,
+        m.last_index,
+        m.last_term,
+        m.snap_index,
+    )
+    out = [head, struct.pack("<QI", m.snap_term, len(m.snap_data)), m.snap_data]
+    out.append(struct.pack("<I", len(m.entries)))
+    for e in m.entries:
+        out.append(struct.pack("<QQBI", e.index, e.term, e.type, len(e.data)))
+        out.append(e.data)
+    return b"".join(out)
+
+
+def message_from_bytes(raw: bytes) -> Message:
+    head_fmt = "<BQQQQQQBBQQQQ"
+    head_len = struct.calcsize(head_fmt)
+    (
+        kind_i,
+        term,
+        frm,
+        to,
+        prev_index,
+        prev_term,
+        commit,
+        granted,
+        success,
+        match_index,
+        last_index,
+        last_term,
+        snap_index,
+    ) = struct.unpack_from(head_fmt, raw, 0)
+    pos = head_len
+    snap_term, snap_len = struct.unpack_from("<QI", raw, pos)
+    pos += struct.calcsize("<QI")
+    snap_data = raw[pos : pos + snap_len]
+    pos += snap_len
+    (n_entries,) = struct.unpack_from("<I", raw, pos)
+    pos += 4
+    entries = []
+    for _ in range(n_entries):
+        index, eterm, etype, dlen = struct.unpack_from("<QQBI", raw, pos)
+        pos += struct.calcsize("<QQBI")
+        entries.append(Entry(index, eterm, etype, raw[pos : pos + dlen]))
+        pos += dlen
+    return Message(
+        kind=_KINDS[kind_i],
+        term=term,
+        frm=frm,
+        to=to,
+        prev_index=prev_index,
+        prev_term=prev_term,
+        entries=tuple(entries),
+        commit=commit,
+        last_index=last_index,
+        last_term=last_term,
+        granted=bool(granted),
+        success=bool(success),
+        match_index=match_index,
+        snap_index=snap_index,
+        snap_term=snap_term,
+        snap_data=snap_data,
+    )
+
+
 class RaftNode:
     """Single raft participant for one channel."""
 

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import os
 import struct
+import threading
 from typing import Callable, Dict, List, Optional, Sequence
 
 from fabric_tpu.orderer.blockcutter import BatchConfig, BlockCutter
@@ -87,6 +88,12 @@ class RaftChain:
         on_config_block: Optional[Callable[[common_pb2.Block], None]] = None,
     ):
         self.channel_id = channel_id
+        # One lock serializes everything that mutates raft/cutter/writer
+        # state: gRPC broadcast threads (order/configure), the cluster
+        # Step dispatcher (step), and the node's tick loop all race here
+        # once the transport is real sockets (the reference serializes the
+        # same way through the etcdraft chain's single run() goroutine).
+        self._lock = threading.RLock()
         self.node = RaftNode(node_id, peers)
         self.cutter = BlockCutter(batch_config)
         self._sink = sink
@@ -156,27 +163,33 @@ class RaftChain:
 
     # -- consensus.Chain surface -------------------------------------------
     def order(self, env: common_pb2.Envelope) -> None:
-        if self.node.role != "leader":
-            raise NotLeaderError(self.node.leader_id)
-        batches, _ = self.cutter.ordered(env)
-        for batch in batches:
-            self._propose_batch(batch)
+        with self._lock:
+            if self.node.role != "leader":
+                raise NotLeaderError(self.node.leader_id)
+            batches, _ = self.cutter.ordered(env)
+            for batch in batches:
+                self._propose_batch(batch)
+            self._pump()
 
     def configure(self, env: common_pb2.Envelope) -> None:
-        if self.node.role != "leader":
-            raise NotLeaderError(self.node.leader_id)
-        pending = self.cutter.cut()
-        if pending:
-            self._propose_batch(pending)
-        self._propose_batch([env], is_config=True)
+        with self._lock:
+            if self.node.role != "leader":
+                raise NotLeaderError(self.node.leader_id)
+            pending = self.cutter.cut()
+            if pending:
+                self._propose_batch(pending)
+            self._propose_batch([env], is_config=True)
+            self._pump()
 
     def flush(self) -> None:
         """Batch timeout expiry."""
-        if self.node.role != "leader":
-            return
-        pending = self.cutter.cut()
-        if pending:
-            self._propose_batch(pending)
+        with self._lock:
+            if self.node.role != "leader":
+                return
+            pending = self.cutter.cut()
+            if pending:
+                self._propose_batch(pending)
+                self._pump()
 
     def _propose_batch(
         self, batch: List[common_pb2.Envelope], is_config: bool = False
@@ -211,12 +224,14 @@ class RaftChain:
 
     # -- raft plumbing ------------------------------------------------------
     def tick(self) -> None:
-        self.node.tick()
-        self._pump()
+        with self._lock:
+            self.node.tick()
+            self._pump()
 
     def step(self, msg: Message) -> None:
-        self.node.step(msg)
-        self._pump()
+        with self._lock:
+            self.node.step(msg)
+            self._pump()
 
     def _pump(self) -> None:
         msgs, hard, new_entries = self.node.ready()
@@ -291,23 +306,26 @@ class RaftChain:
 
     # -- membership ---------------------------------------------------------
     def propose_conf_change(self, new_peers: Sequence[int]) -> None:
-        if self.node.role != "leader":
-            raise NotLeaderError(self.node.leader_id)
-        data = ",".join(str(p) for p in sorted(new_peers)).encode()
-        self.node.propose(data, etype=ENTRY_CONF)
+        with self._lock:
+            if self.node.role != "leader":
+                raise NotLeaderError(self.node.leader_id)
+            data = ",".join(str(p) for p in sorted(new_peers)).encode()
+            self.node.propose(data, etype=ENTRY_CONF)
+            self._pump()
 
     # -- catch-up (blockpuller.go analog) -----------------------------------
     def catch_up(self, blocks: Sequence[common_pb2.Block]) -> None:
         """Feed missing blocks pulled from another orderer after receiving
         a snapshot that outran our log. Config blocks are detected from the
         channel header so last-config tracking and the bundle stay fresh."""
-        for b in sorted(blocks, key=lambda b: b.header.number):
-            if b.header.number != self.writer.height:
-                continue
-            is_config = _is_config_block(b)
-            self.writer.write_block(b, is_config=is_config)
-            if is_config and self._on_config_block is not None:
-                self._on_config_block(b)
+        with self._lock:
+            for b in sorted(blocks, key=lambda b: b.header.number):
+                if b.header.number != self.writer.height:
+                    continue
+                is_config = _is_config_block(b)
+                self.writer.write_block(b, is_config=is_config)
+                if is_config and self._on_config_block is not None:
+                    self._on_config_block(b)
 
     @property
     def needs_catch_up(self) -> Optional[int]:

@@ -1,0 +1,181 @@
+"""Orderer cluster over real sockets: the Step RPC carrying raft messages
+between OrdererNode processes' gRPC servers, follower->leader Submit
+forwarding, and kill-the-leader failover (reference orderer/common/
+cluster/comm.go:117,127 + integration/raft failover suites)."""
+
+import socket
+import time
+
+import pytest
+
+from fabric_tpu.channelconfig import (
+    ApplicationProfile,
+    OrdererProfile,
+    OrganizationProfile,
+    Profile,
+    genesis_block,
+)
+from fabric_tpu.comm.services import broadcast_envelope
+from fabric_tpu.comm.server import channel_to
+from fabric_tpu.msp.cryptogen import generate_org
+from fabric_tpu.msp.signer import SigningIdentity
+from fabric_tpu.nodes.orderer import OrdererNode
+from fabric_tpu.orderer.raft import Entry, Message, message_from_bytes, message_to_bytes
+from fabric_tpu.protos import common_pb2, protoutil
+
+CHANNEL = "clusterchan"
+
+
+def test_message_codec_roundtrip():
+    m = Message(
+        kind="append",
+        term=7,
+        frm=2,
+        to=3,
+        prev_index=11,
+        prev_term=6,
+        entries=(
+            Entry(12, 7, 0, b"block-bytes"),
+            Entry(13, 7, 1, b"1,2,3"),
+        ),
+        commit=11,
+        snap_data=b"",
+    )
+    assert message_from_bytes(message_to_bytes(m)) == m
+    m2 = Message(kind="snap", term=3, frm=1, to=2, snap_index=40, snap_term=2,
+                 snap_data=b"\x00" * 64)
+    assert message_from_bytes(message_to_bytes(m2)) == m2
+
+
+def _free_ports(n):
+    socks = []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _wait(pred, timeout=20.0, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    org1 = generate_org("org1.example.com", "Org1MSP")
+    oorg = generate_org("orderer.example.com", "OrdererMSP")
+    ports = _free_ports(3)
+    profile = Profile(
+        application=ApplicationProfile(
+            organizations=[OrganizationProfile("Org1MSP", org1.msp_config())]
+        ),
+        orderer=OrdererProfile(
+            orderer_type="etcdraft",
+            batch_timeout="100ms",
+            max_message_count=1,
+            organizations=[
+                OrganizationProfile("OrdererMSP", oorg.msp_config())
+            ],
+            raft_consenters=[("127.0.0.1", p, b"", b"") for p in ports],
+        ),
+    )
+    gblock = genesis_block(profile, CHANNEL)
+
+    nodes = []
+    for i, port in enumerate(ports):
+        node = OrdererNode(
+            str(tmp_path / f"orderer{i}"),
+            signer=SigningIdentity(oorg.peers[0]),
+            listen_address=f"127.0.0.1:{port}",
+            raft_node_id=i + 1,
+            raft_tick_seconds=0.05,
+        )
+        node.join_channel(gblock)
+        node.start()
+        nodes.append(node)
+
+    yield {"nodes": nodes, "org1": org1, "gblock": gblock}
+    for node in nodes:
+        try:
+            node.stop()
+        except Exception:
+            pass
+
+
+def _leaders(nodes):
+    return [
+        n
+        for n in nodes
+        if n.registrar.get_chain(CHANNEL) is not None
+        and n.registrar.get_chain(CHANNEL).chain.node.role == "leader"
+    ]
+
+
+def _make_envelope(signer, body):
+    payload = common_pb2.Payload()
+    chdr = protoutil.make_channel_header(
+        common_pb2.ENDORSER_TRANSACTION, CHANNEL
+    )
+    payload.header.channel_header = chdr.SerializeToString()
+    shdr = protoutil.make_signature_header(
+        signer.serialize(), signer.new_nonce()
+    )
+    payload.header.signature_header = shdr.SerializeToString()
+    payload.data = body
+    env = common_pb2.Envelope()
+    env.payload = payload.SerializeToString()
+    env.signature = signer.sign(env.payload)
+    return env
+
+
+def test_cluster_elects_forwards_and_fails_over(cluster):
+    nodes = cluster["nodes"]
+    client = SigningIdentity(cluster["org1"].users[0])
+
+    # a single leader emerges over the socket transport
+    assert _wait(lambda: len(_leaders(nodes)) == 1)
+    leader = _leaders(nodes)[0]
+    followers = [n for n in nodes if n is not leader]
+
+    # submit to a FOLLOWER: forwarded to the leader over the cluster
+    # Submit RPC, ordered, and replicated to every node
+    ch = channel_to(followers[0].addr)
+    resp = broadcast_envelope(ch, _make_envelope(client, b"tx-1"))
+    assert resp.status == common_pb2.SUCCESS
+    assert _wait(
+        lambda: all(
+            n.registrar.get_chain(CHANNEL).chain.height >= 2 for n in nodes
+        )
+    ), [n.registrar.get_chain(CHANNEL).chain.height for n in nodes]
+    ch.close()
+
+    # kill the leader: the survivors re-elect and keep ordering
+    leader.stop()
+    survivors = followers
+    assert _wait(lambda: len(_leaders(survivors)) == 1)
+
+    target = [n for n in survivors if n not in _leaders(survivors)][0]
+    ch = channel_to(target.addr)
+    resp = None
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        resp = broadcast_envelope(ch, _make_envelope(client, b"tx-2"))
+        if resp.status == common_pb2.SUCCESS:
+            break
+        time.sleep(0.2)
+    assert resp is not None and resp.status == common_pb2.SUCCESS
+    assert _wait(
+        lambda: all(
+            n.registrar.get_chain(CHANNEL).chain.height >= 3
+            for n in survivors
+        )
+    ), [n.registrar.get_chain(CHANNEL).chain.height for n in survivors]
+    ch.close()
