@@ -1,0 +1,273 @@
+"""Peer-side external chaincode runtime (reference core/chaincode/
+handler.go message loop + chaincode_support.go Launch/Execute, with the
+chaincode running OUT of process and connecting back over gRPC).
+
+An external chaincode process opens the `protos.ChaincodeSupport/Register`
+bidi stream, REGISTERs with its package-id, and then serves transactions:
+the peer sends INIT/TRANSACTION, the chaincode answers with state-access
+messages (GET_STATE/PUT_STATE/... — each applied to the executing tx's
+simulator, exactly where the reference's handler.go calls back into the
+ledger) and finishes with COMPLETED carrying its Response.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+from typing import Dict, Iterator, Optional
+
+from fabric_tpu.chaincode.shim import ERROR, OK, Response, error_response
+from fabric_tpu.comm.server import GRPCServer, STREAM_STREAM
+from fabric_tpu.protos import peer_pb2
+
+CCM = peer_pb2.ChaincodeMessage
+SERVICE_NAME = "protos.ChaincodeSupport"
+
+
+class ExternalChaincodeError(Exception):
+    pass
+
+
+class _StreamHandler:
+    """One connected chaincode process."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.out_q: "queue.Queue[Optional[CCM]]" = queue.Queue()
+        # one transaction at a time per chaincode stream; the reference
+        # multiplexes by txid, the serialization keeps bookkeeping simple
+        self._tx_lock = threading.Lock()
+        self._stub = None
+        self._done: "queue.Queue[CCM]" = queue.Queue()
+        self.closed = threading.Event()
+
+    # -- peer -> chaincode -----------------------------------------------
+    def execute(self, stub, args, is_init: bool, timeout: float = 60.0) -> Response:
+        if self.closed.is_set():
+            return error_response(f"chaincode {self.name} disconnected")
+        with self._tx_lock:
+            self._stub = stub
+            inp = peer_pb2.ChaincodeInput()
+            for a in args:
+                inp.args.append(a)
+            msg = CCM()
+            msg.type = CCM.INIT if is_init else CCM.TRANSACTION
+            msg.payload = inp.SerializeToString()
+            msg.txid = stub.tx_id
+            msg.channel_id = stub.channel_id
+            self.out_q.put(msg)
+            try:
+                final = self._done.get(timeout=timeout)
+            except queue.Empty:
+                self.closed.set()
+                return error_response(f"chaincode {self.name} timed out")
+            finally:
+                self._stub = None
+            if final.type == CCM.ERROR:
+                return Response(ERROR, final.payload.decode("utf-8", "replace"), b"")
+            resp = peer_pb2.Response()
+            resp.ParseFromString(final.payload)
+            out = Response(resp.status, resp.message, resp.payload)
+            if final.HasField("chaincode_event"):
+                stub.set_event(
+                    final.chaincode_event.event_name,
+                    final.chaincode_event.payload,
+                )
+            return out
+
+    # -- chaincode -> peer (the handler.go message loop) -------------------
+    def on_message(self, msg: CCM) -> None:
+        if msg.type in (CCM.COMPLETED, CCM.ERROR):
+            self._done.put(msg)
+            return
+        if msg.type == CCM.KEEPALIVE:
+            return
+        stub = self._stub
+        reply = CCM()
+        reply.txid = msg.txid
+        reply.channel_id = msg.channel_id
+        if stub is None or msg.txid != stub.tx_id:
+            reply.type = CCM.ERROR
+            reply.payload = b"no transaction in flight"
+            self.out_q.put(reply)
+            return
+        try:
+            reply.type = CCM.RESPONSE
+            reply.payload = self._handle_state_op(stub, msg)
+        except Exception as exc:  # noqa: BLE001 - simulator errors -> shim error
+            reply.type = CCM.ERROR
+            reply.payload = str(exc).encode()
+        self.out_q.put(reply)
+
+    def _handle_state_op(self, stub, msg: CCM) -> bytes:
+        t = msg.type
+        if t == CCM.GET_STATE:
+            req = peer_pb2.GetState()
+            req.ParseFromString(msg.payload)
+            if req.collection:
+                value = stub.get_private_data(req.collection, req.key)
+            else:
+                value = stub.get_state(req.key)
+            return value or b""
+        if t == CCM.GET_PRIVATE_DATA_HASH:
+            req = peer_pb2.GetState()
+            req.ParseFromString(msg.payload)
+            return stub.get_private_data_hash(req.collection, req.key) or b""
+        if t == CCM.PUT_STATE:
+            req = peer_pb2.PutState()
+            req.ParseFromString(msg.payload)
+            if req.collection:
+                stub.put_private_data(req.collection, req.key, req.value)
+            else:
+                stub.put_state(req.key, req.value)
+            return b""
+        if t == CCM.DEL_STATE:
+            req = peer_pb2.DelState()
+            req.ParseFromString(msg.payload)
+            if req.collection:
+                stub.del_private_data(req.collection, req.key)
+            else:
+                stub.del_state(req.key)
+            return b""
+        if t == CCM.GET_STATE_BY_RANGE:
+            req = peer_pb2.GetStateByRange()
+            req.ParseFromString(msg.payload)
+            out = peer_pb2.QueryResponse()
+            for key, value in stub.get_state_by_range(req.startKey, req.endKey):
+                r = out.results.add()
+                r.resultBytes = json.dumps(
+                    {"key": key, "value": value.decode("utf-8", "replace")}
+                ).encode()
+            out.has_more = False
+            return out.SerializeToString()
+        if t == CCM.GET_QUERY_RESULT:
+            req = peer_pb2.GetQueryResult()
+            req.ParseFromString(msg.payload)
+            out = peer_pb2.QueryResponse()
+            for key, value in stub.get_query_result(req.query):
+                r = out.results.add()
+                r.resultBytes = json.dumps(
+                    {"key": key, "value": value.decode("utf-8", "replace")}
+                ).encode()
+            out.has_more = False
+            return out.SerializeToString()
+        if t == CCM.GET_STATE_METADATA:
+            req = peer_pb2.GetStateMetadata()
+            req.ParseFromString(msg.payload)
+            out = peer_pb2.StateMetadataResult()
+            vp = stub.get_state_validation_parameter(req.key)
+            if vp is not None:
+                e = out.entries.add()
+                e.metakey = "VALIDATION_PARAMETER"
+                e.value = vp
+            return out.SerializeToString()
+        if t == CCM.PUT_STATE_METADATA:
+            req = peer_pb2.PutStateMetadata()
+            req.ParseFromString(msg.payload)
+            stub.set_state_validation_parameter(req.key, req.metadata.value)
+            return b""
+        raise ExternalChaincodeError(f"unsupported shim message type {t}")
+
+    def close(self) -> None:
+        self.closed.set()
+        self.out_q.put(None)
+
+
+class ExternalChaincode:
+    """Chaincode-protocol adapter over a connected stream handler, so
+    ChaincodeSupport.execute treats out-of-process chaincodes uniformly."""
+
+    def __init__(self, handler: _StreamHandler):
+        self._handler = handler
+
+    def init(self, stub) -> Response:
+        return self._handler.execute(stub, stub.get_args(), is_init=True)
+
+    def invoke(self, stub) -> Response:
+        return self._handler.execute(stub, stub.get_args(), is_init=False)
+
+
+class ChaincodeListener:
+    """The peer's chaincode-support gRPC service: accepts Register
+    streams from external chaincode processes."""
+
+    def __init__(self):
+        self._handlers: Dict[str, _StreamHandler] = {}
+        self._cv = threading.Condition()
+
+    def register(self, server: GRPCServer) -> None:
+        server.register(
+            SERVICE_NAME,
+            {
+                "Register": (
+                    STREAM_STREAM,
+                    self._serve,
+                    CCM.FromString,
+                    CCM.SerializeToString,
+                )
+            },
+        )
+
+    # -- service -----------------------------------------------------------
+    def _serve(self, request_iterator, context) -> Iterator[CCM]:
+        try:
+            first = next(request_iterator)
+        except StopIteration:
+            return
+        if first.type != CCM.REGISTER:
+            return
+        ccid = peer_pb2.ChaincodeID()
+        ccid.ParseFromString(first.payload)
+        handler = _StreamHandler(ccid.name)
+        with self._cv:
+            self._handlers[ccid.name] = handler
+            self._cv.notify_all()
+
+        reader = threading.Thread(
+            target=self._read_loop,
+            args=(handler, request_iterator),
+            name=f"cc-read-{ccid.name}",
+            daemon=True,
+        )
+        reader.start()
+
+        registered = CCM()
+        registered.type = CCM.REGISTERED
+        yield registered
+        ready = CCM()
+        ready.type = CCM.READY
+        yield ready
+        while True:
+            msg = handler.out_q.get()
+            if msg is None:
+                return
+            yield msg
+
+    def _read_loop(self, handler: _StreamHandler, request_iterator) -> None:
+        try:
+            for msg in request_iterator:
+                handler.on_message(msg)
+        except Exception:
+            pass
+        finally:
+            handler.close()
+            with self._cv:
+                if self._handlers.get(handler.name) is handler:
+                    del self._handlers[handler.name]
+
+    # -- lookups -----------------------------------------------------------
+    def wait_for(self, name: str, timeout: float = 10.0) -> bool:
+        with self._cv:
+            return self._cv.wait_for(lambda: name in self._handlers, timeout)
+
+    def connected(self, name: str) -> bool:
+        with self._cv:
+            return name in self._handlers
+
+    def chaincode(self, name: str) -> ExternalChaincode:
+        with self._cv:
+            handler = self._handlers.get(name)
+        if handler is None:
+            raise ExternalChaincodeError(f"chaincode {name} is not connected")
+        return ExternalChaincode(handler)

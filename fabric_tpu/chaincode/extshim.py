@@ -1,0 +1,223 @@
+"""External chaincode-side shim: connect to the peer, REGISTER, serve
+transactions (reference fabric-chaincode-go shim.Start + the handler's
+chat protocol, run from the chaincode process).
+
+Usage from a packaged chaincode's entry point:
+
+    from fabric_tpu.chaincode import extshim
+    extshim.start(MyChaincode(), peer_address, chaincode_id)
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+from typing import Iterator, List, Optional, Tuple
+
+from fabric_tpu.chaincode.shim import Response, error_response
+from fabric_tpu.comm.server import channel_to
+from fabric_tpu.protos import peer_pb2
+
+CCM = peer_pb2.ChaincodeMessage
+
+
+class ShimError(Exception):
+    pass
+
+
+class ProxyStub:
+    """The chaincode-side stub: every state access is a stream round-trip
+    (GET_STATE -> RESPONSE), mirroring the reference shim handler."""
+
+    def __init__(self, session: "_Session", tx_id: str, channel_id: str, args: List[bytes]):
+        self._session = session
+        self.tx_id = tx_id
+        self.channel_id = channel_id
+        self._args = args
+        self._event: Optional[peer_pb2.ChaincodeEvent] = None
+
+    # -- args ------------------------------------------------------------
+    def get_args(self) -> List[bytes]:
+        return list(self._args)
+
+    def get_function_and_parameters(self) -> Tuple[str, List[str]]:
+        args = self.get_args()
+        if not args:
+            return "", []
+        return args[0].decode(), [a.decode() for a in args[1:]]
+
+    # -- state round-trips -------------------------------------------------
+    def _roundtrip(self, mtype, payload: bytes) -> bytes:
+        return self._session.roundtrip(self, mtype, payload)
+
+    def get_state(self, key: str) -> Optional[bytes]:
+        req = peer_pb2.GetState()
+        req.key = key
+        out = self._roundtrip(CCM.GET_STATE, req.SerializeToString())
+        return out or None
+
+    def put_state(self, key: str, value: bytes) -> None:
+        req = peer_pb2.PutState()
+        req.key = key
+        req.value = value
+        self._roundtrip(CCM.PUT_STATE, req.SerializeToString())
+
+    def del_state(self, key: str) -> None:
+        req = peer_pb2.DelState()
+        req.key = key
+        self._roundtrip(CCM.DEL_STATE, req.SerializeToString())
+
+    def get_private_data(self, collection: str, key: str) -> Optional[bytes]:
+        req = peer_pb2.GetState()
+        req.key = key
+        req.collection = collection
+        out = self._roundtrip(CCM.GET_STATE, req.SerializeToString())
+        return out or None
+
+    def put_private_data(self, collection: str, key: str, value: bytes) -> None:
+        req = peer_pb2.PutState()
+        req.key = key
+        req.value = value
+        req.collection = collection
+        self._roundtrip(CCM.PUT_STATE, req.SerializeToString())
+
+    def get_state_by_range(self, start: str, end: str):
+        req = peer_pb2.GetStateByRange()
+        req.startKey = start
+        req.endKey = end
+        raw = self._roundtrip(CCM.GET_STATE_BY_RANGE, req.SerializeToString())
+        resp = peer_pb2.QueryResponse()
+        resp.ParseFromString(raw)
+        out = []
+        for r in resp.results:
+            doc = json.loads(r.resultBytes)
+            out.append((doc["key"], doc["value"].encode()))
+        return iter(out)
+
+    def get_query_result(self, query) -> Iterator[Tuple[str, bytes]]:
+        req = peer_pb2.GetQueryResult()
+        req.query = query if isinstance(query, str) else json.dumps(query)
+        raw = self._roundtrip(CCM.GET_QUERY_RESULT, req.SerializeToString())
+        resp = peer_pb2.QueryResponse()
+        resp.ParseFromString(raw)
+        return iter(
+            (json.loads(r.resultBytes)["key"], json.loads(r.resultBytes)["value"].encode())
+            for r in resp.results
+        )
+
+    def set_event(self, name: str, payload: bytes) -> None:
+        ev = peer_pb2.ChaincodeEvent()
+        ev.event_name = name
+        ev.payload = payload
+        self._event = ev
+
+
+class _Session:
+    """One Register stream connection."""
+
+    def __init__(self, chaincode, peer_address: str, chaincode_id: str, root_ca=None):
+        self.chaincode = chaincode
+        self.chaincode_id = chaincode_id
+        self.out_q: "queue.Queue[Optional[CCM]]" = queue.Queue()
+        self.resp_q: "queue.Queue[CCM]" = queue.Queue()
+        self.channel = channel_to(peer_address, root_ca)
+        self.ready = threading.Event()
+        self.stopped = threading.Event()
+
+    def _gen(self):
+        reg = CCM()
+        reg.type = CCM.REGISTER
+        ccid = peer_pb2.ChaincodeID()
+        ccid.name = self.chaincode_id
+        reg.payload = ccid.SerializeToString()
+        yield reg
+        while True:
+            msg = self.out_q.get()
+            if msg is None:
+                return
+            yield msg
+
+    def roundtrip(self, stub: ProxyStub, mtype, payload: bytes) -> bytes:
+        msg = CCM()
+        msg.type = mtype
+        msg.payload = payload
+        msg.txid = stub.tx_id
+        msg.channel_id = stub.channel_id
+        self.out_q.put(msg)
+        reply = self.resp_q.get(timeout=30.0)
+        if reply.type == CCM.ERROR:
+            raise ShimError(reply.payload.decode("utf-8", "replace"))
+        return reply.payload
+
+    def _run_tx(self, msg: CCM) -> None:
+        inp = peer_pb2.ChaincodeInput()
+        inp.ParseFromString(msg.payload)
+        stub = ProxyStub(self, msg.txid, msg.channel_id, list(inp.args))
+        try:
+            if msg.type == CCM.INIT:
+                resp = self.chaincode.init(stub)
+            else:
+                resp = self.chaincode.invoke(stub)
+            if not isinstance(resp, Response):
+                resp = error_response("chaincode returned no Response")
+        except Exception as exc:  # noqa: BLE001 - user chaincode panic
+            resp = error_response(f"chaincode failed: {exc}")
+        out = CCM()
+        out.type = CCM.COMPLETED
+        pr = peer_pb2.Response()
+        pr.status = resp.status
+        pr.message = resp.message
+        pr.payload = resp.payload
+        out.payload = pr.SerializeToString()
+        out.txid = msg.txid
+        out.channel_id = msg.channel_id
+        if stub._event is not None:
+            out.chaincode_event.CopyFrom(stub._event)
+        self.out_q.put(out)
+
+    def serve(self) -> None:
+        stream = self.channel.stream_stream(
+            "/protos.ChaincodeSupport/Register",
+            request_serializer=CCM.SerializeToString,
+            response_deserializer=CCM.FromString,
+        )(self._gen())
+        for msg in stream:
+            if msg.type == CCM.REGISTERED:
+                continue
+            if msg.type == CCM.READY:
+                self.ready.set()
+                continue
+            if msg.type in (CCM.INIT, CCM.TRANSACTION):
+                threading.Thread(
+                    target=self._run_tx, args=(msg,), daemon=True
+                ).start()
+            elif msg.type in (CCM.RESPONSE, CCM.ERROR):
+                self.resp_q.put(msg)
+            if self.stopped.is_set():
+                break
+
+    def stop(self) -> None:
+        self.stopped.set()
+        self.out_q.put(None)
+        self.channel.close()
+
+
+def start(
+    chaincode,
+    peer_address: str,
+    chaincode_id: str,
+    block: bool = True,
+    root_ca=None,
+) -> Optional[_Session]:
+    """Connect to the peer's chaincode listener and serve transactions.
+    With block=False, serves on a daemon thread and returns the session."""
+    session = _Session(chaincode, peer_address, chaincode_id, root_ca)
+    if block:
+        session.serve()
+        return None
+    t = threading.Thread(
+        target=session.serve, name=f"ccshim-{chaincode_id}", daemon=True
+    )
+    t.start()
+    return session

@@ -47,10 +47,25 @@ class ChaincodeSupport:
     def __init__(
         self,
         state_getter: Optional[Callable[[str], object]] = None,
+        listener=None,  # extserver.ChaincodeListener (peer's cc endpoint)
+        launcher=None,  # extbuilder.Launcher (subprocess runner)
+        package_store=None,  # package.PackageStore (installed tgz's)
+        source_resolver: Optional[Callable[[str, str], Optional[str]]] = None,
+        chaincode_address: Optional[Callable[[], str]] = None,
     ):
         self._chaincodes: Dict[str, Chaincode] = {}
         self._system: Dict[str, bool] = {}
         self._state_getter = state_getter
+        # out-of-process runtime (reference container.Router +
+        # chaincode_support.go Launch): resolve name -> package-id via
+        # the channel's lifecycle, launch the installed package as a
+        # subprocess if it is not already connected, then execute over
+        # its shim stream.
+        self.listener = listener
+        self.launcher = launcher
+        self.package_store = package_store
+        self._source_resolver = source_resolver
+        self._chaincode_address = chaincode_address
 
     def register(
         self, name: str, chaincode: Chaincode, system: bool = False
@@ -78,6 +93,8 @@ class ChaincodeSupport:
         chaincode Response plus its event (at most one per tx)."""
         cc = self._chaincodes.get(name)
         if cc is None:
+            cc = self._resolve_external(tx_params.channel_id, name)
+        if cc is None:
             raise LaunchError(f"chaincode {name} is not installed/launched")
         stub = ChaincodeStub(
             namespace=name,
@@ -97,6 +114,49 @@ class ChaincodeSupport:
             return error_response(f"chaincode {name} returned no Response"), None
         return resp, stub.chaincode_event
 
+    def _resolve_external(self, channel_id: str, name: str):
+        """Out-of-process path: lifecycle package-id -> ensure launched ->
+        shim-stream adapter (chaincode_support.go Launch)."""
+        if self.listener is None:
+            return None
+        pid = None
+        if self._source_resolver is not None:
+            pid = self._source_resolver(channel_id, name)
+        if pid is None:
+            # a pre-connected chaincode-as-external-service registered
+            # under its plain name (extcc analog)
+            if self.listener.connected(name):
+                return self.listener.chaincode(name)
+            return None
+        if not self.listener.connected(pid):
+            if self.launcher is None or self.package_store is None:
+                return None
+            from fabric_tpu.chaincode.package import PackageError
+
+            try:
+                installed = next(
+                    p
+                    for p in self.package_store.list_installed()
+                    if p.package_id == pid
+                )
+            except (StopIteration, PackageError):
+                raise LaunchError(
+                    f"chaincode {name} package {pid} is not installed"
+                )
+            addr = (
+                self._chaincode_address()
+                if self._chaincode_address is not None
+                else None
+            )
+            if addr is None:
+                raise LaunchError("no chaincode listener address")
+            self.launcher.launch(installed, addr)
+            if not self.listener.wait_for(pid, timeout=20.0):
+                raise LaunchError(
+                    f"chaincode {name} ({pid}) did not register in time"
+                )
+        return self.listener.chaincode(pid)
+
     def invoke_cc2cc(
         self,
         caller_stub: ChaincodeStub,
@@ -105,6 +165,13 @@ class ChaincodeSupport:
         channel: str = "",
     ) -> Response:
         cc = self._chaincodes.get(name)
+        if cc is None:
+            try:
+                cc = self._resolve_external(
+                    channel or caller_stub.channel_id, name
+                )
+            except LaunchError:
+                cc = None
         if cc is None:
             return error_response(f"chaincode {name} is not installed/launched")
         same_channel = not channel or channel == caller_stub.channel_id

@@ -41,6 +41,7 @@ class PeerNode:
         listen_address: str = "127.0.0.1:0",
         ops_address: Optional[str] = None,
         provider=None,
+        external_builders=None,
     ):
         self.work_dir = work_dir
         self.msp_manager = msp_manager
@@ -57,12 +58,40 @@ class PeerNode:
         self._commit_listeners: list[Callable] = []
         self.gossip_nodes: Dict[str, object] = {}
 
+        # out-of-process chaincode runtime (reference core/container
+        # externalbuilder + core/chaincode/persistence): installed
+        # packages on disk, a launcher for subprocesses, and the shim
+        # stream listener on this peer's gRPC server.
+        from fabric_tpu.chaincode.extbuilder import ExternalBuilder, Launcher
+        from fabric_tpu.chaincode.extserver import ChaincodeListener
+        from fabric_tpu.chaincode.package import PackageStore
+
+        self.package_store = PackageStore(
+            os.path.join(work_dir, "lifecycle", "chaincodes")
+        )
+        self.launcher = Launcher(
+            os.path.join(work_dir, "ccbuild"),
+            builders=[
+                ExternalBuilder(p) for p in (external_builders or [])
+            ],
+        )
+        self.cc_listener = ChaincodeListener()
+        self._cc_sources: Dict[tuple, str] = self._load_cc_sources()
+
         self.support = ChaincodeSupport(
             state_getter=lambda cid: (
                 self.channels[cid].ledger.state_db
                 if cid in self.channels
                 else None
+            ),
+            listener=self.cc_listener,
+            launcher=self.launcher,
+            package_store=self.package_store,
+            source_resolver=lambda cid, name: self._cc_sources.get(
+                (cid, name)
             )
+            or self._cc_sources.get(("", name)),
+            chaincode_address=lambda: self.addr,
         )
         self.support.register(
             "qscc",
@@ -92,10 +121,46 @@ class PeerNode:
         self.server = GRPCServer(listen_address)
         register_endorser(self.server, self.endorser)
         register_peer_deliver(self.server, self.deliver)
+        self.cc_listener.register(self.server)
 
         self.ops: Optional[System] = None
         if ops_address is not None:
             self.ops = System(OpsOptions(listen_address=ops_address))
+
+    # -- chaincode lifecycle (install/approve, the org-local half) --------
+    def _sources_path(self) -> str:
+        return os.path.join(self.work_dir, "lifecycle", "local_sources.json")
+
+    def _load_cc_sources(self) -> Dict[tuple, str]:
+        import json
+
+        try:
+            with open(self._sources_path()) as f:
+                raw = json.load(f)
+            return {tuple(k.split("\x00", 1)): v for k, v in raw.items()}
+        except (OSError, ValueError):
+            return {}
+
+    def install_chaincode(self, package_bytes: bytes) -> str:
+        """`peer lifecycle chaincode install` (lifecycle.go InstallChaincode):
+        persist the package, return its package-id."""
+        return self.package_store.install(package_bytes).package_id
+
+    def approve_chaincode(
+        self, channel_id: str, name: str, package_id: str
+    ) -> None:
+        """The org-local half of ApproveChaincodeDefinitionForOrg
+        (lifecycle.go:415): bind this org's installed package-id to the
+        chaincode name — the reference stores this in the org's implicit
+        collection, i.e. per-peer state, which is exactly what this is."""
+        import json
+
+        self._cc_sources[(channel_id, name)] = package_id
+        os.makedirs(os.path.dirname(self._sources_path()), exist_ok=True)
+        with open(self._sources_path(), "w") as f:
+            json.dump(
+                {"\x00".join(k): v for k, v in self._cc_sources.items()}, f
+            )
 
     # -- helpers ---------------------------------------------------------
     def _ledger(self, channel_id: str):
@@ -319,6 +384,7 @@ class PeerNode:
         self._stop.set()
         for node in self.gossip_nodes.values():
             node.stop()
+        self.launcher.stop()
         self.server.stop()
         if self.ops is not None:
             self.ops.stop()

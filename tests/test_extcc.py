@@ -1,0 +1,276 @@
+"""Out-of-process chaincode: packaging/install (lifecycle.go install
+path), the shim stream protocol (handler.go message loop), the subprocess
+launcher, and the external-builder exec contract
+(core/container/externalbuilder)."""
+
+import os
+import stat
+import textwrap
+import time
+
+import pytest
+
+from fabric_tpu.chaincode import shim
+from fabric_tpu.chaincode.extbuilder import ExternalBuilder, Launcher
+from fabric_tpu.chaincode.extserver import ChaincodeListener
+from fabric_tpu.chaincode.extshim import start as shim_start
+from fabric_tpu.chaincode.package import (
+    PackageError,
+    PackageStore,
+    package,
+    package_id,
+    parse_package,
+)
+from fabric_tpu.chaincode.support import ChaincodeSupport, TxParams
+from fabric_tpu.comm.server import GRPCServer
+from fabric_tpu.ledger.simulator import TxSimulator
+from fabric_tpu.ledger.statedb import VersionedDB
+
+CC_SOURCE = textwrap.dedent(
+    '''
+    """Sample asset chaincode run OUT of process by the launcher."""
+    from fabric_tpu.chaincode.shim import Response, success, error_response
+
+
+    class Chaincode:
+        def init(self, stub):
+            return success(b"init-ok")
+
+        def invoke(self, stub):
+            fn, params = stub.get_function_and_parameters()
+            if fn == "put":
+                stub.put_state(params[0], params[1].encode())
+                return success(b"stored")
+            if fn == "get":
+                value = stub.get_state(params[0])
+                if value is None:
+                    return error_response(f"{params[0]} not found")
+                return success(value)
+            if fn == "del":
+                stub.del_state(params[0])
+                return success(b"")
+            return error_response(f"unknown function {fn!r}")
+
+
+    chaincode = Chaincode()
+    '''
+).encode()
+
+
+# ----------------------------------------------------------------------
+# packaging
+# ----------------------------------------------------------------------
+
+
+def test_package_roundtrip_and_id():
+    raw = package("asset", {"chaincode.py": CC_SOURCE})
+    meta, files = parse_package(raw)
+    assert meta == {"label": "asset", "type": "python"}
+    assert files == {"chaincode.py": CC_SOURCE}
+    pid = package_id(raw)
+    label, _, digest = pid.partition(":")
+    assert label == "asset" and len(digest) == 64
+    # deterministic bytes -> stable id
+    assert package_id(package("asset", {"chaincode.py": CC_SOURCE})) == pid
+    with pytest.raises(PackageError):
+        package("bad:label", {})
+    with pytest.raises(PackageError):
+        parse_package(b"not a tarball")
+
+
+def test_package_store_install_and_list(tmp_path):
+    store = PackageStore(str(tmp_path))
+    raw = package("asset", {"chaincode.py": CC_SOURCE})
+    installed = store.install(raw)
+    assert installed.package_id == package_id(raw)
+    assert store.load(installed.package_id) == raw
+    listed = store.list_installed()
+    assert [p.package_id for p in listed] == [installed.package_id]
+    with pytest.raises(PackageError):
+        store.load("ghost:00")
+
+
+# ----------------------------------------------------------------------
+# shim stream protocol (in-process client thread)
+# ----------------------------------------------------------------------
+
+
+class RangeCC:
+    def init(self, stub):
+        return shim.success(b"")
+
+    def invoke(self, stub):
+        fn, params = stub.get_function_and_parameters()
+        if fn == "fill":
+            for k in params:
+                stub.put_state(k, f"v-{k}".encode())
+            return shim.success(b"")
+        if fn == "scan":
+            rows = list(stub.get_state_by_range(params[0], params[1]))
+            return shim.success(
+                ",".join(k for k, _ in rows).encode()
+            )
+        if fn == "event":
+            stub.set_event("my-event", b"event-payload")
+            return shim.success(b"")
+        return shim.error_response("nope")
+
+
+@pytest.fixture
+def listener_server():
+    listener = ChaincodeListener()
+    server = GRPCServer("127.0.0.1:0")
+    listener.register(server)
+    addr = server.start()
+    yield listener, addr
+    server.stop()
+
+
+def _support(listener, db):
+    return ChaincodeSupport(listener=listener)
+
+
+def test_stream_protocol_state_ops(listener_server):
+    listener, addr = listener_server
+    session = shim_start(RangeCC(), addr, "rangecc:aa", block=False)
+    assert listener.wait_for("rangecc:aa", timeout=10)
+
+    db = VersionedDB()
+    support = _support(listener, db)
+    sim = TxSimulator(db, "tx1")
+    params = TxParams(channel_id="ch", tx_id="tx1", simulator=sim)
+    cc = listener.chaincode("rangecc:aa")
+    support._chaincodes["rangecc"] = cc  # direct registration path
+
+    # committed state for the scan (range scans read committed state, not
+    # the tx's own writes — reference simulator semantics)
+    from fabric_tpu.ledger.rwset import Version
+    from fabric_tpu.ledger.statedb import UpdateBatch
+
+    seed = UpdateBatch()
+    for i, k in enumerate(("a", "b", "c")):
+        seed.put("rangecc", k, f"v-{k}".encode(), Version(0, i))
+    db.apply_updates(seed)
+
+    resp, _ = support.execute(params, "rangecc", [b"fill", b"x", b"y"])
+    assert resp.status == shim.OK
+    resp, _ = support.execute(params, "rangecc", [b"scan", b"a", b"z"])
+    assert resp.status == shim.OK and resp.payload == b"a,b,c"
+
+    # events propagate through COMPLETED.chaincode_event
+    resp, event = support.execute(params, "rangecc", [b"event"])
+    assert resp.status == shim.OK
+    assert event is not None and event.event_name == "my-event"
+
+    # writes landed in the simulator's rwset, not anywhere else
+    results = sim.get_tx_simulation_results()
+    ns = [n for n in results.rwset.ns_rw_sets if n.namespace == "rangecc"]
+    assert ns and [w.key for w in ns[0].writes] == ["x", "y"]
+    session.stop()
+
+
+# ----------------------------------------------------------------------
+# subprocess launcher via the built-in python builder
+# ----------------------------------------------------------------------
+
+
+def test_launcher_runs_chaincode_subprocess(tmp_path, listener_server):
+    listener, addr = listener_server
+    store = PackageStore(str(tmp_path / "pkgs"))
+    installed = store.install(package("asset", {"chaincode.py": CC_SOURCE}))
+    launcher = Launcher(str(tmp_path / "build"))
+
+    db = VersionedDB()
+    support = ChaincodeSupport(
+        listener=listener,
+        launcher=launcher,
+        package_store=store,
+        source_resolver=lambda cid, name: (
+            installed.package_id if name == "asset" else None
+        ),
+        chaincode_address=lambda: addr,
+    )
+    try:
+        from fabric_tpu.ledger.rwset import Version
+        from fabric_tpu.ledger.statedb import UpdateBatch
+
+        seed = UpdateBatch()
+        seed.put("asset", "k0", b"seeded", Version(0, 0))
+        db.apply_updates(seed)
+
+        sim = TxSimulator(db, "tx9")
+        params = TxParams(channel_id="ch", tx_id="tx9", simulator=sim)
+        resp, _ = support.execute(params, "asset", [b"put", b"k1", b"hello"])
+        assert resp.status == shim.OK, resp.message
+        # really out of process
+        proc = launcher._procs[installed.package_id]
+        assert proc.pid != os.getpid() and proc.poll() is None
+        # committed state reads round-trip over the stream (reads never
+        # see the tx's own writes — reference simulator semantics)
+        resp, _ = support.execute(params, "asset", [b"get", b"k0"])
+        assert resp.status == shim.OK and resp.payload == b"seeded"
+        # the put above is in the rwset
+        results = sim.get_tx_simulation_results()
+        ns = [n for n in results.rwset.ns_rw_sets if n.namespace == "asset"]
+        assert ns and [w.key for w in ns[0].writes] == ["k1"]
+        # relaunch is a no-op while the process lives
+        assert launcher.launch(installed, addr) is proc
+    finally:
+        launcher.stop()
+
+
+# ----------------------------------------------------------------------
+# external-builder exec contract
+# ----------------------------------------------------------------------
+
+
+def _write_exe(path, body):
+    with open(path, "w") as f:
+        f.write(body)
+    os.chmod(path, os.stat(path).st_mode | stat.S_IEXEC)
+
+
+def test_external_builder_contract(tmp_path, listener_server):
+    listener, addr = listener_server
+    bdir = tmp_path / "mybuilder" / "bin"
+    os.makedirs(bdir)
+    # claims packages whose metadata type is "shellcc"; build copies the
+    # source; run launches the python launcher manually (stand-in for an
+    # arbitrary runtime)
+    _write_exe(
+        bdir / "detect",
+        "#!/bin/sh\ngrep -q '\"type\": \"shellcc\"' \"$2/metadata.json\"\n",
+    )
+    _write_exe(bdir / "build", "#!/bin/sh\ncp -r \"$1\"/. \"$3\"/\n")
+    _write_exe(
+        bdir / "run",
+        "#!/bin/sh\n"
+        'CCID=$(python -c "import json,sys;print(json.load(open(sys.argv[1]))[\'chaincode_id\'])" "$2/chaincode.json")\n'
+        'ADDR=$(python -c "import json,sys;print(json.load(open(sys.argv[1]))[\'peer_address\'])" "$2/chaincode.json")\n'
+        "exec python -m fabric_tpu.chaincode.launcher --source-dir \"$1\" "
+        "--peer-address \"$ADDR\" --chaincode-id \"$CCID\"\n",
+    )
+    builder = ExternalBuilder(str(tmp_path / "mybuilder"))
+    store = PackageStore(str(tmp_path / "pkgs"))
+    raw = package("shellasset", {"chaincode.py": CC_SOURCE}, cc_type="shellcc")
+    installed = store.install(raw)
+    launcher = Launcher(str(tmp_path / "build"), builders=[builder])
+    try:
+        env = dict(os.environ)
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        os.environ["PYTHONPATH"] = (
+            repo + os.pathsep + env.get("PYTHONPATH", "")
+        )
+        launcher.launch(installed, addr)
+        assert listener.wait_for(installed.package_id, timeout=15)
+        db = VersionedDB()
+        sim = TxSimulator(db, "tx1")
+        cc = listener.chaincode(installed.package_id)
+        stub_support = ChaincodeSupport(listener=listener)
+        stub_support._chaincodes["shellasset"] = cc
+        params = TxParams(channel_id="ch", tx_id="tx1", simulator=sim)
+        resp, _ = stub_support.execute(params, "shellasset", [b"put", b"x", b"1"])
+        assert resp.status == shim.OK, resp.message
+    finally:
+        launcher.stop()
+        os.environ.update(env)
