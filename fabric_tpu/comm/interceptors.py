@@ -1,0 +1,200 @@
+"""gRPC server interceptors (reference common/grpclogging zap
+interceptors + common/grpcmetrics): per-RPC logs with durations and
+status, and RPC counters/duration histograms over the metrics SPI.
+Unary and streaming RPCs get separate metric families, mirroring
+grpcmetrics' unary_*/stream_* split; outcomes are recorded in `finally`
+so client-cancelled streams (GeneratorExit) still count."""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import grpc
+
+from fabric_tpu.common import flogging
+from fabric_tpu.common.metrics import CounterOpts, HistogramOpts, Provider
+
+
+def _split_method(full_method: str):
+    # "/orderer.AtomicBroadcast/Broadcast" -> ("orderer.AtomicBroadcast",
+    # "Broadcast")
+    parts = full_method.lstrip("/").split("/", 1)
+    if len(parts) == 2:
+        return parts[0], parts[1]
+    return full_method, ""
+
+
+def _wrap_handler(handler, around):
+    """Wrap whichever of the four handler kinds this is with `around`,
+    which receives (behavior, kind) and returns a new behavior."""
+    if handler is None:
+        return None
+    if handler.unary_unary:
+        return grpc.unary_unary_rpc_method_handler(
+            around(handler.unary_unary, "unary_unary"),
+            request_deserializer=handler.request_deserializer,
+            response_serializer=handler.response_serializer,
+        )
+    if handler.unary_stream:
+        return grpc.unary_stream_rpc_method_handler(
+            around(handler.unary_stream, "unary_stream"),
+            request_deserializer=handler.request_deserializer,
+            response_serializer=handler.response_serializer,
+        )
+    if handler.stream_unary:
+        return grpc.stream_unary_rpc_method_handler(
+            around(handler.stream_unary, "stream_unary"),
+            request_deserializer=handler.request_deserializer,
+            response_serializer=handler.response_serializer,
+        )
+    return grpc.stream_stream_rpc_method_handler(
+        around(handler.stream_stream, "stream_stream"),
+        request_deserializer=handler.request_deserializer,
+        response_serializer=handler.response_serializer,
+    )
+
+
+class LoggingInterceptor(grpc.ServerInterceptor):
+    """grpclogging analog: one log line per completed RPC with service,
+    method, duration and outcome."""
+
+    def __init__(self, logger=None):
+        self.logger = logger or flogging.must_get_logger("comm.grpc")
+
+    def intercept_service(self, continuation, handler_call_details):
+        handler = continuation(handler_call_details)
+        service, method = _split_method(handler_call_details.method)
+        logger = self.logger
+
+        def around(behavior, kind):
+            streaming_resp = kind.endswith("_stream")
+            shape = "streaming" if "stream" in kind else "unary"
+
+            def log(start, outcome):
+                logger.debug(
+                    "%s call %s grpc.service=%s grpc.method=%s "
+                    "grpc.call_duration=%.3fms",
+                    shape,
+                    outcome,
+                    service,
+                    method,
+                    (time.perf_counter() - start) * 1000,
+                )
+
+            def unary(request_or_iterator, context):
+                start = time.perf_counter()
+                outcome = "failed"
+                try:
+                    out = behavior(request_or_iterator, context)
+                    outcome = "completed"
+                    return out
+                finally:
+                    log(start, outcome)
+
+            def streaming(request_or_iterator, context):
+                start = time.perf_counter()
+                outcome = "failed"
+                try:
+                    yield from behavior(request_or_iterator, context)
+                    outcome = "completed"
+                except GeneratorExit:
+                    outcome = "cancelled"
+                    raise
+                finally:
+                    log(start, outcome)
+
+            return streaming if streaming_resp else unary
+
+        return _wrap_handler(handler, around)
+
+
+class MetricsInterceptor(grpc.ServerInterceptor):
+    """grpcmetrics analog: requests_received/requests_completed counters
+    and request_duration histograms, labeled (service, method[, code]),
+    with separate unary_* and stream_* families."""
+
+    def __init__(self, provider: Provider):
+        def families(prefix):
+            return (
+                provider.new_counter(
+                    CounterOpts(
+                        namespace="grpc",
+                        subsystem="server",
+                        name=f"{prefix}_requests_received",
+                        help=f"The number of {prefix} requests received.",
+                        label_names=("service", "method"),
+                    )
+                ),
+                provider.new_counter(
+                    CounterOpts(
+                        namespace="grpc",
+                        subsystem="server",
+                        name=f"{prefix}_requests_completed",
+                        help=f"The number of {prefix} requests completed.",
+                        label_names=("service", "method", "code"),
+                    )
+                ),
+                provider.new_histogram(
+                    HistogramOpts(
+                        namespace="grpc",
+                        subsystem="server",
+                        name=f"{prefix}_request_duration",
+                        help=f"The time to complete a {prefix} request.",
+                        label_names=("service", "method"),
+                    )
+                ),
+            )
+
+        self._unary = families("unary")
+        self._stream = families("stream")
+
+    def intercept_service(self, continuation, handler_call_details):
+        handler = continuation(handler_call_details)
+        service, method = _split_method(handler_call_details.method)
+
+        def around(behavior, kind):
+            streaming_resp = kind.endswith("_stream")
+            received, completed, duration = (
+                self._stream if "stream" in kind else self._unary
+            )
+
+            def observe(start, code):
+                duration.with_labels(
+                    "service", service, "method", method
+                ).observe(time.perf_counter() - start)
+                completed.with_labels(
+                    "service", service, "method", method, "code", code
+                ).add(1)
+
+            def unary(request_or_iterator, context):
+                received.with_labels(
+                    "service", service, "method", method
+                ).add(1)
+                start = time.perf_counter()
+                code = "Unknown"
+                try:
+                    out = behavior(request_or_iterator, context)
+                    code = "OK"
+                    return out
+                finally:
+                    observe(start, code)
+
+            def streaming(request_or_iterator, context):
+                received.with_labels(
+                    "service", service, "method", method
+                ).add(1)
+                start = time.perf_counter()
+                code = "Unknown"
+                try:
+                    yield from behavior(request_or_iterator, context)
+                    code = "OK"
+                except GeneratorExit:
+                    code = "Canceled"
+                    raise
+                finally:
+                    observe(start, code)
+
+            return streaming if streaming_resp else unary
+
+        return _wrap_handler(handler, around)

@@ -48,10 +48,12 @@ class GRPCServer:
         address: str = "127.0.0.1:0",
         credentials: Optional[grpc.ServerCredentials] = None,
         max_workers: int = 32,
+        interceptors=(),  # comm.interceptors logging/metrics
     ):
         self._server = grpc.server(
             futures.ThreadPoolExecutor(max_workers=max_workers),
             options=_options(),
+            interceptors=tuple(interceptors),
         )
         if credentials is not None:
             self._port = self._server.add_secure_port(address, credentials)

@@ -268,6 +268,9 @@ class KVLedger:
         KVRWSet assembled by the coordinator; writes are hash-checked
         against the tx's on-block hashed rwset before being applied
         (kv_ledger.go CommitLegacy's pvt data validation)."""
+        import time as _time
+
+        t0 = _time.perf_counter()
         flags = self._extract_flags(block)
         if rwsets is None:
             rwsets = self._extract_rwsets(block)
@@ -320,11 +323,22 @@ class KVLedger:
         # if a crash hit between the two last time, the pvt record for this
         # block is already durable — skip, don't error, so redelivery of
         # the block can complete the interrupted commit.
+        t1 = _time.perf_counter()
         if self.pvt_store.last_committed_block < block.header.number:
             self.pvt_store.commit(block.header.number, entries, missing)
 
         self.block_store.add_block(block)
+        t2 = _time.perf_counter()
         self._commit_state(block, updates, hashed, pvt_batch)
+        t3 = _time.perf_counter()
+        # per-stage split for the commit log line + committer metrics
+        # (reference kv_ledger.go:663-672 state_validation /
+        # block_and_pvtdata_commit / state_commit)
+        self.last_commit_timings = {
+            "state_validation": t1 - t0,
+            "block_and_pvtdata_commit": t2 - t1,
+            "state_commit": t3 - t2,
+        }
         return flags
 
     def _pvt_batch(

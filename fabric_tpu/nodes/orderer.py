@@ -73,14 +73,25 @@ class OrdererNode:
         self.registrar.on_chain(self._refresh_cluster_endpoints)
 
         self.deliver = DeliverHandler(self._block_source)
-        self.server = GRPCServer(listen_address)
-        register_atomic_broadcast(self.server, self.broadcast, self.deliver)
-        ClusterService(self.registrar, self.broadcast).register(self.server)
 
         self.ops: Optional[System] = None
+        interceptors = []
         if ops_address is not None:
             self.ops = System(OpsOptions(listen_address=ops_address))
             self.ops.register_checker("registrar", lambda: None)
+            from fabric_tpu.comm.interceptors import (
+                LoggingInterceptor,
+                MetricsInterceptor,
+            )
+
+            interceptors = [
+                LoggingInterceptor(),
+                MetricsInterceptor(self.ops.provider),
+            ]
+
+        self.server = GRPCServer(listen_address, interceptors=interceptors)
+        register_atomic_broadcast(self.server, self.broadcast, self.deliver)
+        ClusterService(self.registrar, self.broadcast).register(self.server)
 
     # -- block availability signaling (deliver BLOCK_UNTIL_READY) --------
     def _cond(self, channel_id: str) -> threading.Condition:

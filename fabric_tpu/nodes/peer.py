@@ -118,14 +118,30 @@ class PeerNode:
             get_ledger=lambda cid: self._ledger(cid),
         )
         self.deliver = DeliverHandler(self._block_source)
-        self.server = GRPCServer(listen_address)
+
+        self.ops: Optional[System] = None
+        self.committer_metrics = None
+        interceptors = []
+        if ops_address is not None:
+            self.ops = System(OpsOptions(listen_address=ops_address))
+            from fabric_tpu.comm.interceptors import (
+                LoggingInterceptor,
+                MetricsInterceptor,
+            )
+            from fabric_tpu.ledger.ledgermetrics import CommitterMetrics
+
+            # committer metrics (kvledger/metrics.go) surface on /metrics;
+            # RPC logs + counters (grpclogging/grpcmetrics) wrap the server
+            self.committer_metrics = CommitterMetrics(self.ops.provider)
+            interceptors = [
+                LoggingInterceptor(),
+                MetricsInterceptor(self.ops.provider),
+            ]
+
+        self.server = GRPCServer(listen_address, interceptors=interceptors)
         register_endorser(self.server, self.endorser)
         register_peer_deliver(self.server, self.deliver)
         self.cc_listener.register(self.server)
-
-        self.ops: Optional[System] = None
-        if ops_address is not None:
-            self.ops = System(OpsOptions(listen_address=ops_address))
 
     # -- chaincode lifecycle (install/approve, the org-local half) --------
     def _sources_path(self) -> str:
@@ -241,6 +257,7 @@ class PeerNode:
             self._registry_factory(channel_id),
             self.provider,
             transient_store=self.transient,
+            metrics=self.committer_metrics,
         )
         if ch.ledger.height == 0:
             ch.ledger.commit(genesis_block)

@@ -40,6 +40,11 @@ class Options:
     statsd_sink: Optional[Callable[[str], None]] = None
     statsd_prefix: str = ""
     version: str = VERSION
+    # TLS + optional mutual-TLS client auth (reference core/operations/
+    # system.go TLS.Enabled / ClientCertRequired)
+    tls_cert_file: Optional[str] = None
+    tls_key_file: Optional[str] = None
+    client_ca_file: Optional[str] = None  # set -> client certs REQUIRED
 
 
 class System:
@@ -170,6 +175,34 @@ class System:
         self._server = ThreadingHTTPServer(
             (host or "127.0.0.1", int(port or 0)), Handler
         )
+        tls_bits = (
+            self.options.tls_cert_file,
+            self.options.tls_key_file,
+        )
+        if any(tls_bits) or self.options.client_ca_file:
+            if not all(tls_bits):
+                # never degrade to cleartext on a partial TLS config
+                raise ValueError(
+                    "operations TLS requires both tls_cert_file and "
+                    "tls_key_file"
+                )
+            import ssl
+
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(
+                self.options.tls_cert_file, self.options.tls_key_file
+            )
+            if self.options.client_ca_file:
+                ctx.load_verify_locations(self.options.client_ca_file)
+                ctx.verify_mode = ssl.CERT_REQUIRED
+            # defer the handshake to the per-request handler thread — on
+            # the listening socket it would run in the accept loop, where
+            # one stalled client starves every other ops request
+            self._server.socket = ctx.wrap_socket(
+                self._server.socket,
+                server_side=True,
+                do_handshake_on_connect=False,
+            )
         self._thread = threading.Thread(
             target=self._server.serve_forever, name="operations", daemon=True
         )

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 from typing import Callable, Optional
 
+from fabric_tpu.common import flogging
 from fabric_tpu.crypto.bccsp import Provider, default_provider
 from fabric_tpu.ledger.kvledger import KVLedger
 from fabric_tpu.msp.identity import MSPManager
@@ -21,6 +22,8 @@ from fabric_tpu.protos import common_pb2, protoutil
 from fabric_tpu.validation.msgvalidation import parse_transaction
 from fabric_tpu.validation.txflags import ValidationFlags
 from fabric_tpu.validation.validator import BlockValidator, ChaincodeRegistry
+
+logger = flogging.must_get_logger("committer")
 
 
 class BlockVerificationError(Exception):
@@ -41,7 +44,9 @@ class Channel:
         fetch_pvt: Optional[Callable] = None,  # (blk, tx, txid, ns, coll) -> bytes|None
         is_eligible: Optional[Callable[[str, str], bool]] = None,
         btl_policy: Optional[Callable[[str, str], int]] = None,
+        metrics=None,  # ledger.ledgermetrics.CommitterMetrics
     ):
+        self.metrics = metrics
         self.channel_id = channel_id
         self.provider = provider or default_provider()
         self.ledger = KVLedger(ledger_dir, channel_id, btl_policy=btl_policy)
@@ -72,11 +77,15 @@ class Channel:
         Private data is assembled coordinator-style (gossip/privdata/
         coordinator.go:149-209): transient store first, then the peer
         fetcher, with anything still missing recorded for the reconciler."""
+        import time as _time
+
+        t0 = _time.perf_counter()
         self._verify_block(block)
         parsed = [
             parse_transaction(i, d) for i, d in enumerate(block.data.data)
         ]
         flags = self.validator.validate(block, parsed=parsed)
+        t_validate = _time.perf_counter() - t0
         rwsets = [p.rwset for p in parsed]
         pvt_data, missing = self._assemble_pvt_data(block, parsed, flags)
         result = self.ledger.commit(
@@ -85,6 +94,26 @@ class Channel:
         if self.transient_store is not None:
             self.transient_store.purge_by_txids(
                 [p.tx_id for p in parsed if p.tx_id]
+            )
+        timings = getattr(self.ledger, "last_commit_timings", {})
+        logger.debug(
+            "[%s] committed block [%d] in %dms (state_validation=%dms "
+            "block_and_pvtdata_commit=%dms state_commit=%dms)",
+            self.channel_id,
+            block.header.number,
+            int((t_validate + sum(timings.values())) * 1000),
+            int(timings.get("state_validation", 0) * 1000),
+            int(timings.get("block_and_pvtdata_commit", 0) * 1000),
+            int(timings.get("state_commit", 0) * 1000),
+        )
+        if self.metrics is not None:
+            self.metrics.observe_commit(
+                self.channel_id,
+                result,
+                self.ledger.height,
+                t_validate + timings.get("state_validation", 0.0),
+                timings.get("block_and_pvtdata_commit", 0.0),
+                timings.get("state_commit", 0.0),
             )
         return result
 

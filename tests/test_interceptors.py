@@ -1,0 +1,75 @@
+"""gRPC server interceptors (reference common/grpclogging +
+common/grpcmetrics): RPC logs and counters/durations on the metrics SPI."""
+
+import grpc
+import pytest
+
+from fabric_tpu.common.metrics import PrometheusProvider
+from fabric_tpu.comm.interceptors import LoggingInterceptor, MetricsInterceptor
+from fabric_tpu.comm.server import GRPCServer, STREAM_STREAM, UNARY, channel_to
+
+
+@pytest.fixture
+def echo_server():
+    provider = PrometheusProvider()
+    server = GRPCServer(
+        "127.0.0.1:0",
+        interceptors=[LoggingInterceptor(), MetricsInterceptor(provider)],
+    )
+
+    def echo(request, context):
+        if request == b"boom":
+            raise ValueError("boom")
+        return request
+
+    def echo_stream(request_iterator, context):
+        for req in request_iterator:
+            yield req
+
+    server.register(
+        "test.Echo",
+        {
+            "Call": (UNARY, echo, lambda b: b, lambda b: b),
+            "Stream": (STREAM_STREAM, echo_stream, lambda b: b, lambda b: b),
+        },
+    )
+    addr = server.start()
+    yield provider, addr
+    server.stop()
+
+
+def test_metrics_interceptor_counts_unary_and_stream(echo_server):
+    provider, addr = echo_server
+    ch = channel_to(addr)
+    call = ch.unary_unary("/test.Echo/Call")
+    assert call(b"hello") == b"hello"
+    assert call(b"hello") == b"hello"
+    stream = ch.stream_stream("/test.Echo/Stream")
+    assert list(stream(iter([b"a", b"b"]))) == [b"a", b"b"]
+    with pytest.raises(grpc.RpcError):
+        call(b"boom")
+    ch.close()
+
+    text = provider.gather()
+    assert (
+        'grpc_server_unary_requests_received{service="test.Echo",'
+        'method="Call"} 3' in text
+    )
+    assert (
+        'grpc_server_unary_requests_completed{service="test.Echo",'
+        'method="Call",code="OK"} 2' in text
+    )
+    assert (
+        'grpc_server_unary_requests_completed{service="test.Echo",'
+        'method="Call",code="Unknown"} 1' in text
+    )
+    assert (
+        'grpc_server_stream_requests_received{service="test.Echo",'
+        'method="Stream"} 1' in text
+    )
+    assert (
+        'grpc_server_stream_requests_completed{service="test.Echo",'
+        'method="Stream",code="OK"} 1' in text
+    )
+    assert "grpc_server_unary_request_duration" in text
+    assert "grpc_server_stream_request_duration" in text

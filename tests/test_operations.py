@@ -184,3 +184,123 @@ def test_ops_logspec_get_and_put(ops_system):
         assert False, "expected 400"
     except urllib.error.HTTPError as err:
         assert err.code == 400
+
+
+# ---------------- operations TLS (core/operations/system.go TLS) ----------
+
+
+def _self_signed(tmp_path, name):
+    """Self-signed cert + key PEM files for the TLS tests."""
+    import datetime
+
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.x509.oid import NameOID
+
+    key = ec.generate_private_key(ec.SECP256R1())
+    subject = x509.Name(
+        [x509.NameAttribute(NameOID.COMMON_NAME, "127.0.0.1")]
+    )
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(subject)
+        .issuer_name(subject)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(minutes=5))
+        .not_valid_after(now + datetime.timedelta(days=1))
+        .add_extension(
+            x509.SubjectAlternativeName(
+                [x509.IPAddress(__import__("ipaddress").ip_address("127.0.0.1"))]
+            ),
+            critical=False,
+        )
+        .sign(key, hashes.SHA256())
+    )
+    cert_path = tmp_path / f"{name}.crt"
+    key_path = tmp_path / f"{name}.key"
+    cert_path.write_bytes(cert.public_bytes(serialization.Encoding.PEM))
+    key_path.write_bytes(
+        key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.PKCS8,
+            serialization.NoEncryption(),
+        )
+    )
+    return str(cert_path), str(key_path)
+
+
+def test_ops_tls_serves_https_and_rejects_plain(tmp_path):
+    import ssl
+
+    cert, key = _self_signed(tmp_path, "ops")
+    system = System(
+        Options(listen_address="127.0.0.1:0", tls_cert_file=cert, tls_key_file=key)
+    )
+    addr = system.start()
+    try:
+        ctx = ssl.create_default_context()
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_NONE
+        with urllib.request.urlopen(
+            f"https://{addr}/version", context=ctx, timeout=5
+        ) as resp:
+            assert json.loads(resp.read())["Version"]
+        # plain HTTP against the TLS socket must fail
+        with pytest.raises(Exception):
+            urllib.request.urlopen(f"http://{addr}/version", timeout=2)
+    finally:
+        system.stop()
+
+
+def test_ops_tls_client_auth_required(tmp_path):
+    import ssl
+
+    cert, key = _self_signed(tmp_path, "ops")
+    ca_cert, _ca_key = _self_signed(tmp_path, "clientca")
+    system = System(
+        Options(
+            listen_address="127.0.0.1:0",
+            tls_cert_file=cert,
+            tls_key_file=key,
+            client_ca_file=ca_cert,
+        )
+    )
+    addr = system.start()
+    try:
+        ctx = ssl.create_default_context()
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_NONE
+        with pytest.raises(Exception):
+            urllib.request.urlopen(
+                f"https://{addr}/version", context=ctx, timeout=5
+            )
+    finally:
+        system.stop()
+
+
+# ---------------- committer metrics (kvledger/metrics.go) -----------------
+
+
+def test_committer_metrics_families():
+    from fabric_tpu.ledger.ledgermetrics import CommitterMetrics
+    from fabric_tpu.validation.txflags import TxValidationCode, ValidationFlags
+
+    provider = PrometheusProvider()
+    metrics = CommitterMetrics(provider)
+    flags = ValidationFlags(3, TxValidationCode.VALID)
+    flags.set_flag(1, TxValidationCode.MVCC_READ_CONFLICT)
+    metrics.observe_commit("ch1", flags, 7, 0.010, 0.002, 0.003)
+    text = provider.gather()
+    assert 'ledger_blockchain_height{channel="ch1"} 7' in text
+    assert "ledger_block_processing_time" in text
+    assert (
+        'ledger_transaction_count{channel="ch1",validation_code="VALID"} 2'
+        in text
+    )
+    assert (
+        'ledger_transaction_count{channel="ch1",'
+        'validation_code="MVCC_READ_CONFLICT"} 1' in text
+    )
