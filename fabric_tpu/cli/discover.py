@@ -1,0 +1,52 @@
+"""discover — service discovery CLI (reference discovery/cmd: peers,
+config, endorsers against a peer's discovery service).
+
+  python -m fabric_tpu.cli.discover peers --server 127.0.0.1:7051 \
+      --channel mychannel --mspDir <user msp> --mspID Org1MSP
+  python -m fabric_tpu.cli.discover config --server ... --channel ...
+  python -m fabric_tpu.cli.discover endorsers --server ... --channel ... \
+      --chaincode mycc
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from fabric_tpu.discovery.server import query
+from fabric_tpu.discovery.service import DiscoveryError
+from fabric_tpu.msp.configbuilder import load_signing_identity
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="discover")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    for cmd in ("peers", "config", "endorsers"):
+        p = sub.add_parser(cmd)
+        p.add_argument("--server", required=True)
+        p.add_argument("--channel", required=True)
+        p.add_argument("--mspDir", required=True)
+        p.add_argument("--mspID", required=True)
+        if cmd == "endorsers":
+            p.add_argument("--chaincode", required=True)
+
+    args = parser.parse_args(argv)
+    signer = load_signing_identity(args.mspDir, args.mspID)
+    try:
+        result = query(
+            args.server,
+            signer,
+            args.channel,
+            args.cmd,
+            chaincode=getattr(args, "chaincode", ""),
+        )
+    except DiscoveryError as exc:
+        print(f"discovery failed: {exc}", file=sys.stderr)
+        return 1
+    print(json.dumps(result, indent=2, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,111 @@
+"""idemixgen — Idemix crypto-material generator (reference cmd/idemixgen:
+ca-keygen + signerconfig, directory layout per idemixmsp docs).
+
+  python -m fabric_tpu.cli.idemixgen ca-keygen [--output idemix-dir]
+  python -m fabric_tpu.cli.idemixgen signerconfig [--output idemix-dir] \
+      [-u OU] [-e enrollmentId] [--admin]
+
+Layout written (matching the reference tool):
+
+  <output>/ca/IssuerSecretKey            full issuer key (proto)
+  <output>/ca/RevocationKey              long-term revocation key (PEM)
+  <output>/msp/IssuerPublicKey           issuer public key (proto)
+  <output>/msp/RevocationPublicKey       revocation public key (PEM)
+  <output>/user/SignerConfig             IdemixMSPSignerConfig (proto)
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from fabric_tpu.msp.idemix_msp import (
+    ROLE_ADMIN,
+    ROLE_MEMBER,
+    generate_issuer,
+    generate_signer_config,
+)
+from fabric_tpu.protos import idemix_pb2
+
+
+def _write(path: str, data: bytes) -> None:
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "wb") as f:
+        f.write(data)
+
+
+def ca_keygen(output: str) -> None:
+    from cryptography.hazmat.primitives import serialization
+
+    ikey, rev_key = generate_issuer()
+    _write(os.path.join(output, "ca", "IssuerSecretKey"), ikey.SerializeToString())
+    _write(
+        os.path.join(output, "ca", "RevocationKey"),
+        rev_key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.PKCS8,
+            serialization.NoEncryption(),
+        ),
+    )
+    _write(
+        os.path.join(output, "msp", "IssuerPublicKey"),
+        ikey.ipk.SerializeToString(),
+    )
+    _write(
+        os.path.join(output, "msp", "RevocationPublicKey"),
+        rev_key.public_key().public_bytes(
+            serialization.Encoding.PEM,
+            serialization.PublicFormat.SubjectPublicKeyInfo,
+        ),
+    )
+    print(f"wrote issuer key material under {output}/")
+
+
+def signerconfig(output: str, ou: str, enrollment: str, admin: bool) -> None:
+    from cryptography.hazmat.primitives import serialization
+
+    ikey_path = os.path.join(output, "ca", "IssuerSecretKey")
+    rev_path = os.path.join(output, "ca", "RevocationKey")
+    if not (os.path.exists(ikey_path) and os.path.exists(rev_path)):
+        raise SystemExit(f"run ca-keygen first (no issuer key under {output}/ca)")
+    ikey = idemix_pb2.IssuerKey()
+    with open(ikey_path, "rb") as f:
+        ikey.ParseFromString(f.read())
+    with open(rev_path, "rb") as f:
+        rev_key = serialization.load_pem_private_key(f.read(), password=None)
+
+    signer = generate_signer_config(
+        ikey,
+        rev_key,
+        ou,
+        ROLE_ADMIN if admin else ROLE_MEMBER,
+        enrollment,
+    )
+    _write(
+        os.path.join(output, "user", "SignerConfig"),
+        signer.SerializeToString(),
+    )
+    print(f"wrote {output}/user/SignerConfig")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="idemixgen")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    ca = sub.add_parser("ca-keygen")
+    ca.add_argument("--output", default="idemix-config")
+    sc = sub.add_parser("signerconfig")
+    sc.add_argument("--output", default="idemix-config")
+    sc.add_argument("-u", "--org-unit", default="OU1")
+    sc.add_argument("-e", "--enrollment-id", default="user1")
+    sc.add_argument("--admin", action="store_true")
+    args = parser.parse_args(argv)
+    if args.cmd == "ca-keygen":
+        ca_keygen(args.output)
+    else:
+        signerconfig(args.output, args.org_unit, args.enrollment_id, args.admin)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

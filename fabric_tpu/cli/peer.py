@@ -164,6 +164,180 @@ def chaincode_cmd(args) -> int:
     return 0
 
 
+def _scc_invoke(addr, signer, channel, cc_name, cc_args):
+    """One signed proposal to a (system) chaincode; returns the Response
+    or exits nonzero on endorsement failure."""
+    bundle = create_proposal(signer, channel, cc_name, cc_args)
+    signed = create_signed_proposal(bundle, signer)
+    conn = channel_to(addr)
+    resp = process_proposal(conn, signed)
+    conn.close()
+    if resp.response.status != 200:
+        print(
+            f"{cc_name} call failed on {addr}: {resp.response.message}",
+            file=sys.stderr,
+        )
+        raise SystemExit(1)
+    return resp.response
+
+
+def channel_cmd(args) -> int:
+    """peer channel create/join/list/fetch (reference
+    usable-inter-nal/peer/channel)."""
+    signer = _client_signer(args)
+    if args.cmd == "join":
+        with open(args.blockpath, "rb") as f:
+            block_bytes = f.read()
+        _scc_invoke(
+            args.peerAddress, signer, "", "cscc",
+            [b"JoinChain", block_bytes],
+        )
+        print("channel joined")
+        return 0
+    if args.cmd == "list":
+        resp = _scc_invoke(
+            args.peerAddress, signer, "", "cscc", [b"GetChannels"]
+        )
+        from fabric_tpu.protos import peer_pb2 as _peer_pb2
+
+        out = _peer_pb2.ChannelQueryResponse()
+        out.ParseFromString(resp.payload)
+        print("Channels peers has joined: ")
+        for ch in out.channels:
+            print(ch.channel_id)
+        return 0
+    if args.cmd == "create":
+        from fabric_tpu.channelconfig import configtx as configtx_mod
+        from fabric_tpu.protos import configtx_pb2, protoutil
+
+        env = common_pb2.Envelope()
+        with open(args.file, "rb") as f:
+            env.ParseFromString(f.read())
+        payload = protoutil.unmarshal(common_pb2.Payload, env.payload)
+        cue = protoutil.unmarshal(configtx_pb2.ConfigUpdateEnvelope, payload.data)
+        # sign the config update AND the outer envelope (reference
+        # channel create sanitizes + signs with the client identity)
+        configtx_mod.sign_config_update(cue, signer)
+        payload.data = cue.SerializeToString()
+        shdr = protoutil.make_signature_header(
+            signer.serialize(), signer.new_nonce()
+        )
+        payload.header.signature_header = shdr.SerializeToString()
+        env.payload = payload.SerializeToString()
+        env.signature = signer.sign(env.payload)
+        conn = channel_to(args.orderer)
+        ack = broadcast_envelope(conn, env)
+        if ack.status != common_pb2.SUCCESS:
+            conn.close()
+            print(f"channel create failed: {ack.info}", file=sys.stderr)
+            return 1
+        # fetch the new channel's genesis block (reference: create then
+        # deliver block 0)
+        out_path = args.outputBlock or f"{args.channelID}.block"
+        rc = _fetch_block(conn, signer, args.channelID, 0, out_path)
+        conn.close()
+        if rc == 0:
+            print(f"wrote channel genesis block {out_path}")
+        return rc
+    if args.cmd == "fetch":
+        conn = channel_to(args.orderer)
+        number = 0 if args.block == "oldest" else int(args.block)
+        rc = _fetch_block(conn, signer, args.channelID, number, args.output)
+        conn.close()
+        if rc == 0:
+            print(f"wrote block {args.output}")
+        return rc
+    return 2
+
+
+def _fetch_block(conn, signer, channel_id, number, out_path) -> int:
+    from fabric_tpu.comm.services import deliver_stream
+    from fabric_tpu.deliver.client import seek_envelope
+
+    env = seek_envelope(channel_id, start=number, stop=number, signer=signer)
+    for resp in deliver_stream(conn, env):
+        kind = resp.WhichOneof("Type")
+        if kind == "block":
+            with open(out_path, "wb") as f:
+                f.write(resp.block.SerializeToString())
+            return 0
+        if kind == "status" and resp.status != common_pb2.SUCCESS:
+            print(f"fetch failed: status {resp.status}", file=sys.stderr)
+            return 1
+    print("fetch failed: no block", file=sys.stderr)
+    return 1
+
+
+def lifecycle_cmd(args) -> int:
+    """peer lifecycle chaincode ... (reference
+    usable-inter-nal/peer/lifecycle)."""
+    if args.cmd == "package":
+        from fabric_tpu.chaincode.package import package
+
+        import os
+
+        files = {}
+        src = args.path
+        if os.path.isdir(src):
+            for root, dirs, names in os.walk(src):
+                # keep build junk out of the content-hashed package bytes
+                dirs[:] = [
+                    d
+                    for d in dirs
+                    if d != "__pycache__" and not d.startswith(".")
+                ]
+                for name in names:
+                    if name.endswith(".pyc") or name.startswith("."):
+                        continue
+                    full = os.path.join(root, name)
+                    with open(full, "rb") as f:
+                        files[os.path.relpath(full, src)] = f.read()
+        else:
+            with open(src, "rb") as f:
+                files["chaincode.py"] = f.read()
+        raw = package(args.label, files)
+        with open(args.outputFile, "wb") as f:
+            f.write(raw)
+        print(f"wrote chaincode package {args.outputFile}")
+        return 0
+
+    signer = _client_signer(args)
+    if args.cmd == "install":
+        with open(args.packageFile, "rb") as f:
+            raw = f.read()
+        resp = _scc_invoke(
+            args.peerAddress, signer, "", "_lifecycle",
+            [b"InstallChaincode", raw],
+        )
+        print(f"installed package: {resp.payload.decode()}")
+        return 0
+    if args.cmd == "queryinstalled":
+        resp = _scc_invoke(
+            args.peerAddress, signer, "", "_lifecycle",
+            [b"QueryInstalledChaincodes"],
+        )
+        for entry in json.loads(resp.payload or b"[]"):
+            print(
+                f"Package ID: {entry['package_id']}, Label: {entry['label']}"
+            )
+        return 0
+    if args.cmd == "approveformyorg":
+        req = json.dumps(
+            {
+                "channel": args.channelID,
+                "name": args.name,
+                "package_id": args.package_id,
+            }
+        ).encode()
+        _scc_invoke(
+            args.peerAddress, signer, "", "_lifecycle",
+            [b"ApproveChaincodeDefinitionForOrg", req],
+        )
+        print("chaincode definition approved for org")
+        return 0
+    return 2
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="peer")
     sub = parser.add_subparsers(dest="group", required=True)
@@ -187,12 +361,59 @@ def main(argv=None) -> int:
         p.add_argument("--b64", action="store_true",
                        help="base64-encode query payload output")
 
+    chan = sub.add_parser("channel")
+    chan_sub = chan.add_subparsers(dest="cmd", required=True)
+    cj = chan_sub.add_parser("join")
+    cj.add_argument("-b", "--blockpath", required=True)
+    cl = chan_sub.add_parser("list")
+    ccr = chan_sub.add_parser("create")
+    ccr.add_argument("-o", "--orderer", required=True)
+    ccr.add_argument("-c", "--channelID", required=True)
+    ccr.add_argument("-f", "--file", required=True)
+    ccr.add_argument("--outputBlock", default="")
+    cf = chan_sub.add_parser("fetch")
+    cf.add_argument("block", help="oldest | <number>")
+    cf.add_argument("output")
+    cf.add_argument("-o", "--orderer", required=True)
+    cf.add_argument("-c", "--channelID", required=True)
+    for p in (cj, cl):
+        p.add_argument("--peerAddress", required=True)
+    for p in (ccr, cf):
+        p.add_argument("--peerAddress", default="")
+    for p in (cj, cl, ccr, cf):
+        p.add_argument("--mspDir", required=True)
+        p.add_argument("--mspID", required=True)
+
+    lc = sub.add_parser("lifecycle")
+    lc_sub0 = lc.add_subparsers(dest="noun", required=True)
+    lcc = lc_sub0.add_parser("chaincode")
+    lc_sub = lcc.add_subparsers(dest="cmd", required=True)
+    lp = lc_sub.add_parser("package")
+    lp.add_argument("outputFile")
+    lp.add_argument("--path", required=True)
+    lp.add_argument("--label", required=True)
+    li = lc_sub.add_parser("install")
+    li.add_argument("packageFile")
+    lq = lc_sub.add_parser("queryinstalled")
+    la = lc_sub.add_parser("approveformyorg")
+    la.add_argument("-C", "--channelID", required=True)
+    la.add_argument("-n", "--name", required=True)
+    la.add_argument("--package-id", required=True)
+    for p in (li, lq, la):
+        p.add_argument("--peerAddress", required=True)
+        p.add_argument("--mspDir", required=True)
+        p.add_argument("--mspID", required=True)
+
     args = parser.parse_args(argv)
     if args.group == "node" and args.cmd == "start":
         node_start(args.config)
         return 0
     if args.group == "chaincode":
         return chaincode_cmd(args)
+    if args.group == "channel":
+        return channel_cmd(args)
+    if args.group == "lifecycle":
+        return lifecycle_cmd(args)
     return 2
 
 

@@ -1,0 +1,121 @@
+"""Discovery over gRPC + the client library (reference discovery/support
++ discovery/client: the SDK-facing service answering peers / config /
+endorsers queries with a signed request).
+
+Wire format: one `discovery.Discovery/Process` unary RPC carrying a
+SignedRequest whose payload is a JSON query document signed by the
+client identity — the reference's SignedRequest shape
+(discovery/protocol.proto) with a JSON body standing in for the full
+discovery proto tree:
+
+  payload = {"channel": "...", "query": "peers|config|endorsers",
+             "chaincode": "...", "identity": base64(SerializedIdentity)}
+
+Access control is the channel's Readers policy evaluated over the signed
+payload, exactly like service.go processQuery.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+from dataclasses import asdict
+from typing import Optional
+
+from fabric_tpu.comm.server import GRPCServer, UNARY, channel_to
+from fabric_tpu.discovery.service import DiscoveryError, DiscoveryService
+from fabric_tpu.policy.manager import SignedData
+from fabric_tpu.protos import discovery_pb2
+
+SERVICE_NAME = "discovery.Discovery"
+
+
+class DiscoveryServer:
+    def __init__(self, service: DiscoveryService):
+        self.service = service
+
+    def process(self, request, context):
+        out = discovery_pb2.QueryResponse()
+        try:
+            doc = json.loads(request.payload)
+            client = SignedData(
+                data=request.payload,
+                identity=base64.b64decode(doc["identity"]),
+                signature=request.signature,
+            )
+            channel = doc.get("channel", "")
+            query = doc.get("query")
+            if query == "peers":
+                result = [
+                    asdict(p) for p in self.service.peers(channel, client)
+                ]
+            elif query == "config":
+                result = self.service.config(channel, client)
+            elif query == "endorsers":
+                desc = self.service.endorsers(
+                    channel, doc.get("chaincode", ""), client
+                )
+                result = {
+                    "chaincode": desc.chaincode,
+                    "endorsers_by_groups": {
+                        g: [asdict(p) for p in peers]
+                        for g, peers in desc.endorsers_by_groups.items()
+                    },
+                    "layouts": desc.layouts,
+                }
+            else:
+                raise DiscoveryError(f"unknown query {query!r}")
+            out.status = 200
+            out.result = json.dumps(result, sort_keys=True).encode()
+        except (DiscoveryError, ValueError, KeyError) as exc:
+            out.status = 500
+            out.result = json.dumps({"error": str(exc)}).encode()
+        return out
+
+    def register(self, server: GRPCServer) -> None:
+        server.register(
+            SERVICE_NAME,
+            {
+                "Process": (
+                    UNARY,
+                    self.process,
+                    discovery_pb2.SignedRequest.FromString,
+                    discovery_pb2.QueryResponse.SerializeToString,
+                )
+            },
+        )
+
+
+def query(
+    addr: str,
+    signer,
+    channel: str,
+    what: str,
+    chaincode: str = "",
+    root_ca: Optional[bytes] = None,
+):
+    """Client half (discovery/client): sign + send one query, return the
+    decoded JSON result (raises DiscoveryError on a service error)."""
+    doc = {
+        "channel": channel,
+        "query": what,
+        "chaincode": chaincode,
+        "identity": base64.b64encode(signer.serialize()).decode(),
+    }
+    payload = json.dumps(doc, sort_keys=True).encode()
+    req = discovery_pb2.SignedRequest()
+    req.payload = payload
+    req.signature = signer.sign(payload)
+    conn = channel_to(addr, root_ca)
+    try:
+        resp = conn.unary_unary(
+            f"/{SERVICE_NAME}/Process",
+            request_serializer=discovery_pb2.SignedRequest.SerializeToString,
+            response_deserializer=discovery_pb2.QueryResponse.FromString,
+        )(req, timeout=10.0)
+    finally:
+        conn.close()
+    body = json.loads(resp.result)
+    if resp.status != 200:
+        raise DiscoveryError(body.get("error", "discovery failed"))
+    return body

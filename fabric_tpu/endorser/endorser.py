@@ -135,14 +135,21 @@ class Endorser:
         self, up: UnpackedProposal
     ) -> peer_pb2.ProposalResponse:
         channel_id = up.channel_header.channel_id
-        ledger = self.get_ledger(channel_id)
-        if ledger is None:
-            raise ProposalError(f"channel {channel_id} not found")
         tx_id = up.channel_header.tx_id
-        if ledger.tx_exists(tx_id):
-            raise ProposalError(f"duplicate transaction found [{tx_id}]")
+        if channel_id:
+            ledger = self.get_ledger(channel_id)
+            if ledger is None:
+                raise ProposalError(f"channel {channel_id} not found")
+            if ledger.tx_exists(tx_id):
+                raise ProposalError(f"duplicate transaction found [{tx_id}]")
+            sim = TxSimulator(ledger.state_db, tx_id=tx_id)
+        else:
+            # channel-less proposal (lifecycle install, cscc JoinChain):
+            # no ledger, a throwaway simulator whose rwset is discarded
+            # (endorser.go: acquire a tx simulator only if chainID != "")
+            from fabric_tpu.ledger.statedb import VersionedDB
 
-        sim = TxSimulator(ledger.state_db, tx_id=tx_id)
+            sim = TxSimulator(VersionedDB(), tx_id=tx_id)
         resp, event = self.support.execute(
             TxParams(
                 channel_id=channel_id,

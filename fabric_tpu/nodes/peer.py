@@ -110,6 +110,18 @@ class PeerNode:
         self.support.register(
             "lscc", LSCC(self._list_chaincodes), system=True
         )
+        from fabric_tpu.scc.lifecycle_scc import LifecycleSCC
+
+        self.support.register(
+            "_lifecycle",
+            LifecycleSCC(
+                install=self.install_chaincode,
+                list_installed=self.package_store.list_installed,
+                approve=self.approve_chaincode,
+                load_package=self.package_store.load,
+            ),
+            system=True,
+        )
 
         self.endorser = Endorser(
             signer,
@@ -142,6 +154,18 @@ class PeerNode:
         register_endorser(self.server, self.endorser)
         register_peer_deliver(self.server, self.deliver)
         self.cc_listener.register(self.server)
+
+        # discovery service (discovery/service.go) on the same listener
+        from fabric_tpu.discovery.server import DiscoveryServer
+        from fabric_tpu.discovery.service import DiscoveryService
+
+        self.discovery = DiscoveryService(
+            peers_provider=self._discovery_peers,
+            bundle_provider=self._discovery_bundle,
+            policy_provider=self._discovery_policy,
+        )
+        DiscoveryServer(self.discovery).register(self.server)
+        self._bundle_cache: Dict[str, tuple] = {}
 
     # -- chaincode lifecycle (install/approve, the org-local half) --------
     def _sources_path(self) -> str:
@@ -177,6 +201,58 @@ class PeerNode:
             json.dump(
                 {"\x00".join(k): v for k, v in self._cc_sources.items()}, f
             )
+
+    # -- discovery providers (discovery/support analog) -------------------
+    def _discovery_peers(self, channel_id: str):
+        from fabric_tpu.discovery.service import PeerInfo
+
+        ch = self.channels.get(channel_id)
+        if ch is None:
+            return []
+        chaincodes = tuple(ch.validator.registry.names())
+        peers = [
+            PeerInfo(
+                msp_id=self.signer.msp_id,
+                endpoint=self.addr,
+                ledger_height=ch.ledger.height,
+                chaincodes=chaincodes,
+            )
+        ]
+        node = self.gossip_nodes.get(channel_id)
+        if node is not None:
+            # gossip peer ids are "MSPID:host:port" (see
+            # enable_gossip_for_channel)
+            for member in node.membership.alive_peers():
+                msp_id, _, endpoint = str(member).partition(":")
+                if endpoint and endpoint != self.addr:
+                    peers.append(
+                        PeerInfo(
+                            msp_id=msp_id,
+                            endpoint=endpoint,
+                            chaincodes=chaincodes,
+                        )
+                    )
+        return peers
+
+    def _discovery_bundle(self, channel_id: str):
+        block = self._config_block(channel_id)
+        if block is None:
+            return None
+        cached = self._bundle_cache.get(channel_id)
+        if cached is not None and cached[0] == block.header.number:
+            return cached[1]
+        from fabric_tpu.channelconfig.bundle import bundle_from_genesis_block
+
+        bundle = bundle_from_genesis_block(block, self.provider)
+        self._bundle_cache[channel_id] = (block.header.number, bundle)
+        return bundle
+
+    def _discovery_policy(self, chaincode: str, channel_id: str):
+        ch = self.channels.get(channel_id)
+        if ch is None:
+            return None
+        definition = ch.validator.registry.get(chaincode)
+        return definition.endorsement_policy if definition else None
 
     # -- helpers ---------------------------------------------------------
     def _ledger(self, channel_id: str):
