@@ -85,12 +85,14 @@ class Endorser:
         support: ChaincodeSupport,
         get_ledger: Callable[[str], Optional[KVLedger]],
         acl_check: Optional[Callable[[UnpackedProposal], None]] = None,
+        on_pvt_results=None,  # (channel, tx_id, [(ns, coll, kvrwset)])
     ):
         self.signer = local_signer
         self.msp_manager = msp_manager
         self.support = support
         self.get_ledger = get_ledger
         self.acl_check = acl_check
+        self.on_pvt_results = on_pvt_results
 
     # -- the gRPC entry point --
     def process_proposal(
@@ -195,9 +197,18 @@ class Endorser:
         out.payload = prp_bytes
         out.endorsement.endorser = endorser_bytes
         out.endorsement.signature = self.signer.sign(prp_bytes + endorser_bytes)
-        # Private write-sets ride back to the client/transient store, not
-        # the block (endorser.go distributePrivateData seam).
+        # Private write-sets never ride in the block; they go to the local
+        # transient store and out to eligible peers NOW (endorser.go
+        # distributePrivateData -> gossip/privdata pull.go push).
         self.last_pvt_results = results
+        if results.pvt_writes and self.on_pvt_results is not None:
+            from fabric_tpu.ledger.simulator import collection_kvrwset_bytes
+
+            pvt_writes = [
+                (ns, coll, collection_kvrwset_bytes(writes))
+                for (ns, coll), writes in sorted(results.pvt_writes.items())
+            ]
+            self.on_pvt_results(channel_id, tx_id, pvt_writes)
         return out
 
     def _proposal_hash(self, up: UnpackedProposal) -> bytes:

@@ -37,7 +37,15 @@ class GossipNode:
         height: Callable[[], int],
         listen_address: str = "127.0.0.1:0",
         tick_interval: float = 0.2,
+        identity_bytes: bytes = b"",
+        verify_identity=None,
+        transient_store=None,
+        pvt_reader=None,  # (block, tx, ns, coll) -> bytes|None
+        pvt_serve_policy=None,  # (ns, coll) -> bool
     ):
+        from fabric_tpu.gossip.pull import CertStore, PullMediator
+        from fabric_tpu.gossip.pvtdata import PvtDataHandler
+
         self.self_id = self_id
         self.channel_id = channel_id
         self.state = state
@@ -45,6 +53,20 @@ class GossipNode:
         self._height = height
         self.membership = Membership(self_id)
         self.election = LeaderElection(self.membership)
+        # certstore + pull mediator (identity anti-entropy)
+        self.certstore = CertStore(self_id, identity_bytes, verify_identity)
+        self.pull = PullMediator(channel_id, self.certstore)
+        # private-data push/pull (None transient store -> disabled)
+        self.pvt = (
+            PvtDataHandler(
+                channel_id,
+                transient_store,
+                pvt_reader or (lambda *a: None),
+                serve_policy=pvt_serve_policy,
+            )
+            if transient_store is not None
+            else None
+        )
         self._endpoints: Dict[str, str] = {}  # peer id -> endpoint
         self._conns: Dict[str, object] = {}  # endpoint -> grpc channel
         self._lock = threading.Lock()
@@ -75,6 +97,10 @@ class GossipNode:
     def _handle(
         self, msg: gossip_pb2.GossipMessage
     ) -> Optional[gossip_pb2.GossipMessage]:
+        # per-channel routing: this node serves ONE channel; foreign
+        # channel traffic is dropped (gossip channel.go eligibility)
+        if msg.channel and msg.channel != self.channel_id:
+            return None
         kind = msg.WhichOneof("content")
         if kind == "alive_msg":
             alive = msg.alive_msg
@@ -129,6 +155,27 @@ class GossipNode:
                 self.state.handle_state_response(parsed)
             except Exception:
                 pass
+        elif kind in (
+            "hello",
+            "data_dig",
+            "data_req",
+            "data_update",
+            "peer_identity",
+        ):
+            return self.pull.handle(msg)
+        elif kind in ("private_data", "private_req"):
+            if self.pvt is not None:
+                return self.pvt.handle(msg)
+        elif kind == "private_res":
+            if self.pvt is not None and self._reconcile_commit is not None:
+                from fabric_tpu.gossip.pvtdata import (
+                    reconcile_response_entries,
+                )
+
+                try:
+                    self._reconcile_commit(reconcile_response_entries(msg))
+                except Exception:
+                    pass
         return None
 
     def _drain(self) -> None:
@@ -158,7 +205,12 @@ class GossipNode:
                 self._conns[endpoint] = conn
             return conn
 
-    def _send(self, endpoint: str, messages: Sequence[gossip_pb2.GossipMessage]):
+    def _send(
+        self,
+        endpoint: str,
+        messages: Sequence[gossip_pb2.GossipMessage],
+        _depth: int = 0,
+    ):
         try:
             conn = self._conn(endpoint)
             stub = conn.stream_stream(
@@ -166,8 +218,15 @@ class GossipNode:
                 request_serializer=gossip_pb2.GossipMessage.SerializeToString,
                 response_deserializer=gossip_pb2.GossipMessage.FromString,
             )
+            followups = []
             for reply in stub(iter(list(messages))):
-                self._handle(reply)
+                out = self._handle(reply)
+                if out is not None:
+                    followups.append(out)
+            if followups and _depth < 3:
+                # pull four-step: hello -> digest -> request -> update
+                # needs the requester to answer replies with new sends
+                self._send(endpoint, followups, _depth + 1)
         except Exception:
             # dead peer: drop the cached connection; membership expiry
             # will remove it from the view
@@ -209,7 +268,19 @@ class GossipNode:
                 out.append(int.from_bytes(meta, "big"))
         return out
 
+    _reconcile_commit = None
+    _missing_provider = None
+    _tick_count = 0
+    # pull/reconcile cadence in ticks (the reference pulls on a ~4s
+    # interval vs 5 alive ticks/s — running the 4-step exchange every
+    # tick would open streams constantly for nothing)
+    PULL_EVERY = 5
+    RECONCILE_EVERY = 5
+
     def _tick_once(self) -> None:
+        import random as _random
+
+        self._tick_count += 1
         alive = self._alive_message()
         for endpoint in self._peer_endpoints():
             self._send(endpoint, [alive])
@@ -223,7 +294,42 @@ class GossipNode:
                 req.state_request.start_seq_num = rng.start
                 req.state_request.end_seq_num = rng.stop
                 self._send(endpoints[0], [req])
+        endpoints = self._peer_endpoints()
+        # identity pull round with one random peer (certstore sync)
+        if endpoints and self._tick_count % self.PULL_EVERY == 0:
+            self._send(_random.choice(endpoints), [self.pull.hello()])
+        # pvt-data reconciliation (reconcile.go:104-126): request data the
+        # pvt store recorded as missing from one random peer
+        if (
+            self.pvt is not None
+            and self._missing_provider is not None
+            and endpoints
+            and self._tick_count % self.RECONCILE_EVERY == 0
+        ):
+            req = self.pvt.reconcile_request(self._missing_provider())
+            if req is not None:
+                self._send(_random.choice(endpoints), [req])
         self._drain()
+
+    # -- pvt data push (DistributePrivateData) ----------------------------
+    def disseminate_pvt(self, tx_id: str, pvt_writes) -> None:
+        """Endorsement-time push of [(ns, coll, rwset_bytes)] to every
+        member's transient store."""
+        if self.pvt is None:
+            return
+        messages = self.pvt.dissemination_messages(tx_id, pvt_writes)
+        if not messages:
+            return
+        for endpoint in self._peer_endpoints():
+            threading.Thread(
+                target=self._send, args=(endpoint, messages), daemon=True
+            ).start()
+
+    def enable_reconciliation(self, missing_provider, reconcile_commit) -> None:
+        """missing_provider() -> {block: [MissingEntry]};
+        reconcile_commit([(block, tx, ns, coll, payload)])."""
+        self._missing_provider = missing_provider
+        self._reconcile_commit = reconcile_commit
 
     def _taller_peer_endpoints(self, needed_height: int) -> List[str]:
         out = []

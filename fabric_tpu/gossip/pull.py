@@ -1,0 +1,131 @@
+"""Pull-mediator + certstore identity exchange (reference
+gossip/gossip/pull/pullstore.go and gossip/identity + certstore: the
+Hello -> DataDigest -> DataRequest -> DataUpdate four-step that spreads
+items a push can miss).
+
+Used here for PEER IDENTITIES: each node holds {pki_id: identity bytes}
+(its own MSP serialized identity plus everything pulled), so policies
+and discovery can resolve remote members' certs without a direct
+connection to them. Blocks do not need a pull mediator — the state
+provider's height-driven anti-entropy covers them (state.go:586)."""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import Callable, Dict, List, Optional
+
+from fabric_tpu.protos import gossip_pb2
+
+PULL_IDENTITY = 1
+
+
+class CertStore:
+    """pki_id -> serialized identity (gossip/state certstore analog);
+    thread-safe, verification hook applied before adoption."""
+
+    def __init__(
+        self,
+        self_id: str,
+        self_identity: bytes,
+        verify: Optional[Callable[[bytes, bytes], bool]] = None,
+    ):
+        self._lock = threading.Lock()
+        self._store: Dict[bytes, bytes] = {}
+        self._verify = verify
+        if self_identity:
+            self._store[self_id.encode()] = self_identity
+
+    def put(self, pki_id: bytes, identity: bytes) -> bool:
+        if self._verify is not None and not self._verify(pki_id, identity):
+            return False
+        with self._lock:
+            self._store[pki_id] = identity
+        return True
+
+    def get(self, pki_id: bytes) -> Optional[bytes]:
+        with self._lock:
+            return self._store.get(pki_id)
+
+    def digests(self) -> List[bytes]:
+        with self._lock:
+            return sorted(self._store)
+
+    def missing(self, digests) -> List[bytes]:
+        with self._lock:
+            return [d for d in digests if d not in self._store]
+
+
+class PullMediator:
+    """The requester/responder halves of one pull round. The transport is
+    a callable (endpoint, [GossipMessage]) -> [reply GossipMessages]
+    (the gossip node's stream send)."""
+
+    def __init__(self, channel_id: str, store: CertStore):
+        self.channel_id = channel_id
+        self.store = store
+        self._rng = random.Random()
+
+    # -- responder side (handled from the gossip stream) -------------------
+    def handle(
+        self, msg: gossip_pb2.GossipMessage
+    ) -> Optional[gossip_pb2.GossipMessage]:
+        kind = msg.WhichOneof("content")
+        if kind == "hello" and msg.hello.msg_type == PULL_IDENTITY:
+            out = gossip_pb2.GossipMessage()
+            out.channel = self.channel_id
+            out.data_dig.nonce = msg.hello.nonce
+            out.data_dig.msg_type = PULL_IDENTITY
+            out.data_dig.digests.extend(self.store.digests())
+            return out
+        if kind == "data_req" and msg.data_req.msg_type == PULL_IDENTITY:
+            out = gossip_pb2.GossipMessage()
+            out.channel = self.channel_id
+            out.data_update.nonce = msg.data_req.nonce
+            out.data_update.msg_type = PULL_IDENTITY
+            for digest in msg.data_req.digests:
+                identity = self.store.get(bytes(digest))
+                if identity is None:
+                    continue
+                item = gossip_pb2.GossipMessage()
+                item.channel = self.channel_id
+                item.peer_identity.pki_id = digest
+                item.peer_identity.cert = identity
+                out.data_update.data.append(item.SerializeToString())
+            return out
+        if kind == "data_dig" and msg.data_dig.msg_type == PULL_IDENTITY:
+            want = self.store.missing(
+                [bytes(d) for d in msg.data_dig.digests]
+            )
+            if not want:
+                return None
+            out = gossip_pb2.GossipMessage()
+            out.channel = self.channel_id
+            out.data_req.nonce = msg.data_dig.nonce
+            out.data_req.msg_type = PULL_IDENTITY
+            out.data_req.digests.extend(want)
+            return out
+        if kind == "data_update" and msg.data_update.msg_type == PULL_IDENTITY:
+            for raw in msg.data_update.data:
+                item = gossip_pb2.GossipMessage()
+                item.ParseFromString(raw)
+                if item.WhichOneof("content") == "peer_identity":
+                    self.store.put(
+                        bytes(item.peer_identity.pki_id),
+                        bytes(item.peer_identity.cert),
+                    )
+            return None
+        if kind == "peer_identity":
+            self.store.put(
+                bytes(msg.peer_identity.pki_id), bytes(msg.peer_identity.cert)
+            )
+            return None
+        return None
+
+    # -- requester side (called from the gossip tick) ----------------------
+    def hello(self) -> gossip_pb2.GossipMessage:
+        out = gossip_pb2.GossipMessage()
+        out.channel = self.channel_id
+        out.hello.nonce = self._rng.getrandbits(63)
+        out.hello.msg_type = PULL_IDENTITY
+        return out

@@ -1,0 +1,137 @@
+"""Private-data dissemination + reconciliation over gossip (reference
+gossip/privdata/pull.go endorsement-time push/pull and
+reconcile.go:104-126 the missed-data loop).
+
+Two flows:
+
+* dissemination: at ENDORSEMENT time the endorsing peer pushes each
+  private writeset (PrivatePayload) to other peers' transient stores, so
+  the data is already local when the block commits (dissemination in
+  coordinator.go/pull.go DistributePrivateData).
+* reconciliation: a committed block can still record missing collection
+  data (this peer was offline or ineligible-then-eligible); the
+  reconciler periodically sends RemotePvtDataRequest digests to peers,
+  verifies returned payloads against the on-block hashes, and patches
+  the pvt store + state via commit_pvt_data_of_old_blocks.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Callable, List, Optional, Sequence
+
+from fabric_tpu.protos import gossip_pb2
+
+
+class PvtDataHandler:
+    """Per-channel gossip hooks for private data."""
+
+    def __init__(
+        self,
+        channel_id: str,
+        transient_store,  # coordinator.TransientStore
+        # (block_num, tx_num, ns, coll) -> cleartext rwset bytes or None
+        pvt_reader: Callable[[int, int, str, str], Optional[bytes]],
+        # (ns, coll) -> may this collection be served to channel members?
+        # The reference additionally checks the REQUESTER's org against
+        # the collection policy via the TLS-bound peer identity
+        # (pull.go); this transport has no per-stream identity yet, so
+        # the gate is collection-level (Channel.is_eligible).
+        serve_policy: Optional[Callable[[str, str], bool]] = None,
+    ):
+        self.channel_id = channel_id
+        self.transient = transient_store
+        self._pvt_reader = pvt_reader
+        self._serve_policy = serve_policy or (lambda ns, coll: True)
+
+    # -- message handling (wired into GossipNode._handle) ------------------
+    def handle(
+        self, msg: gossip_pb2.GossipMessage
+    ) -> Optional[gossip_pb2.GossipMessage]:
+        if msg.channel != self.channel_id:
+            return None  # cross-channel pvt traffic is never served
+        kind = msg.WhichOneof("content")
+        if kind == "private_data":
+            p = msg.private_data.payload
+            # endorsement-time push lands in the transient store, exactly
+            # where the commit-time coordinator looks first
+            self.transient.persist(
+                p.tx_id, p.namespace, p.collection_name, bytes(p.private_rwset)
+            )
+            return None
+        if kind == "private_req":
+            resp = gossip_pb2.GossipMessage()
+            resp.channel = self.channel_id
+            for digest in msg.private_req.digests:
+                if not self._serve_policy(digest.namespace, digest.collection):
+                    continue
+                payload = self._pvt_reader(
+                    digest.block_seq,
+                    digest.seq_in_block,
+                    digest.namespace,
+                    digest.collection,
+                )
+                if payload is None:
+                    continue
+                el = resp.private_res.elements.add()
+                el.digest.CopyFrom(digest)
+                el.payload = payload
+            if resp.private_res.elements:
+                return resp
+            return None
+        return None
+
+    # -- endorsement-time push ---------------------------------------------
+    def dissemination_messages(
+        self,
+        tx_id: str,
+        pvt_writes: Sequence,  # [(namespace, collection, rwset_bytes)]
+    ) -> List[gossip_pb2.GossipMessage]:
+        out = []
+        for namespace, collection, raw in pvt_writes:
+            msg = gossip_pb2.GossipMessage()
+            msg.channel = self.channel_id
+            p = msg.private_data.payload
+            p.tx_id = tx_id
+            p.namespace = namespace
+            p.collection_name = collection
+            p.private_rwset = raw
+            out.append(msg)
+        return out
+
+    # -- reconciliation ----------------------------------------------------
+    def reconcile_request(
+        self, missing
+    ) -> Optional[gossip_pb2.GossipMessage]:
+        """{block_num: [MissingEntry]} (pvt store get_missing_pvt_data) ->
+        one RemotePvtDataRequest (reconcile.go batching)."""
+        msg = gossip_pb2.GossipMessage()
+        msg.channel = self.channel_id
+        for block_num in sorted(missing):
+            for m in missing[block_num]:
+                if not m.eligible:
+                    continue
+                d = msg.private_req.digests.add()
+                d.namespace = m.namespace
+                d.collection = m.collection
+                d.block_seq = block_num
+                d.seq_in_block = m.tx_num
+        if not msg.private_req.digests:
+            return None
+        return msg
+
+
+def reconcile_response_entries(msg: gossip_pb2.GossipMessage):
+    """RemotePvtDataResponse -> [(block_num, tx_num, ns, coll, payload)]."""
+    out = []
+    for el in msg.private_res.elements:
+        out.append(
+            (
+                el.digest.block_seq,
+                el.digest.seq_in_block,
+                el.digest.namespace,
+                el.digest.collection,
+                bytes(el.payload),
+            )
+        )
+    return out

@@ -419,6 +419,89 @@ class KVLedger:
                 self.history.setdefault((ns, key), []).append(entry.version)
             self.state_db.apply_updates(updates, hashed, pvt)
 
+    def commit_reconciled_pvt(self, items) -> int:
+        """Reconciler write-back (reference reconcile.go ->
+        CommitPvtDataOfOldBlocks): late-arriving private data for already
+        committed blocks, hash-checked against the on-block hashed rwset;
+        entries that fail verification are dropped, good ones land in the
+        pvt store AND the cleartext pvt state. `items` is
+        [(block_num, tx_num, ns, coll, kvrwset_bytes)]; returns how many
+        entries were accepted."""
+        by_block: Dict[int, List[PvtEntry]] = {}
+        for block_num, tx_num, ns, coll, raw in items:
+            by_block.setdefault(block_num, []).append(
+                PvtEntry(tx_num, ns, coll, raw)
+            )
+        accepted = 0
+        for block_num in sorted(by_block):
+            block = self.block_store.get_block_by_number(block_num)
+            if block is None:
+                continue
+            flags = self._extract_flags(block)
+            rwsets = self._extract_rwsets(block)
+            codes = [TxValidationCode(int(c)) for c in flags.asarray()]
+            good: List[PvtEntry] = []
+            batch = PvtUpdateBatch()
+            for entry in by_block[block_num]:
+                try:
+                    if not self._pvt_entry_complete(entry, rwsets):
+                        continue  # subset/empty payload: an attacker must
+                        # not be able to clear the missing marker
+                    one = self._pvt_batch(
+                        block_num, [entry], codes, rwsets, verify_hashes=True
+                    )
+                except Exception:  # noqa: BLE001 - includes proto DecodeError;
+                    # one forged/mismatched/garbled entry must not abort
+                    # the rest of the batch
+                    continue
+                for (ns, coll, key), e in one.items():
+                    # never regress pvt state a LATER block already wrote
+                    # (reference CommitPvtDataOfOldBlocks version check)
+                    current = self.state_db.get_private_data(ns, coll, key)
+                    if current is not None and not (
+                        current.version.block_num < e.version.block_num
+                        or (
+                            current.version.block_num == e.version.block_num
+                            and current.version.tx_num <= e.version.tx_num
+                        )
+                    ):
+                        continue
+                    batch.put(ns, coll, key, e.value, e.version)
+                good.append(entry)
+            if not good:
+                continue
+            self.pvt_store.commit_pvt_data_of_old_blocks(block_num, good)
+            self.state_db.apply_updates(UpdateBatch(), None, batch)
+            accepted += len(good)
+        return accepted
+
+    def _pvt_entry_complete(self, entry: PvtEntry, rwsets) -> bool:
+        """The payload must cover EVERY key hash the tx's on-block hashed
+        rwset lists for this collection — partial data must not clear the
+        missing marker."""
+        import hashlib as _hashlib
+
+        from fabric_tpu.protos import kv_rwset_pb2
+
+        expected = set()
+        rwset = rwsets[entry.tx_num] if entry.tx_num < len(rwsets) else None
+        if rwset is None:
+            return False
+        for ns_rw in rwset.ns_rw_sets:
+            if ns_rw.namespace != entry.namespace:
+                continue
+            for coll in ns_rw.coll_hashed:
+                if coll.collection_name == entry.collection:
+                    expected = {hw.key_hash for hw in coll.hashed_writes}
+        if not expected:
+            return False
+        kv = kv_rwset_pb2.KVRWSet()
+        kv.ParseFromString(entry.rwset)
+        provided = {
+            _hashlib.sha256(w.key.encode()).digest() for w in kv.writes
+        }
+        return provided == expected
+
     # -- admin ops (reference kvledger reset.go / rollback.go /
     #    rebuild_dbs.go: state & history are derived caches over the
     #    block store, so both ops are truncate-then-replay) -------------

@@ -59,16 +59,24 @@ class TxSimulationResults:
             ns_msg = out.ns_pvt_rwset.add()
             ns_msg.namespace = ns
             for coll in sorted(by_ns[ns]):
-                kv = kv_rwset_pb2.KVRWSet()
-                for w in by_ns[ns][coll]:
-                    kw = kv.writes.add()
-                    kw.key = w.key
-                    kw.is_delete = w.is_delete
-                    kw.value = w.value
                 coll_msg = ns_msg.collection_pvt_rwset.add()
                 coll_msg.collection_name = coll
-                coll_msg.rwset = kv.SerializeToString()
+                coll_msg.rwset = collection_kvrwset_bytes(by_ns[ns][coll])
         return out.SerializeToString()
+
+
+def collection_kvrwset_bytes(writes: List[PvtKVWrite]) -> bytes:
+    """One collection's cleartext writes -> serialized KVRWSet — the ONE
+    encoding shared by the transient store, the pvt store and the gossip
+    dissemination path (divergent copies would make pushed and stored
+    payloads differ byte-for-byte)."""
+    kv = kv_rwset_pb2.KVRWSet()
+    for w in writes:
+        kw = kv.writes.add()
+        kw.key = w.key
+        kw.is_delete = w.is_delete
+        kw.value = w.value
+    return kv.SerializeToString()
 
 
 class SimulationError(Exception):

@@ -128,6 +128,7 @@ class PeerNode:
             msp_manager,
             self.support,
             get_ledger=lambda cid: self._ledger(cid),
+            on_pvt_results=self._distribute_pvt,
         )
         self.deliver = DeliverHandler(self._block_source)
 
@@ -201,6 +202,18 @@ class PeerNode:
             json.dump(
                 {"\x00".join(k): v for k, v in self._cc_sources.items()}, f
             )
+
+    # -- private data distribution (endorser.go distributePrivateData) ----
+    def _distribute_pvt(self, channel_id: str, tx_id: str, pvt_writes) -> None:
+        """Endorsement-time private data: local transient store first,
+        then a gossip push to the channel's members so their transient
+        stores are warm before the block commits (gossip/privdata
+        pull.go DistributePrivateData)."""
+        for ns, coll, raw in pvt_writes:
+            self.transient.persist(tx_id, ns, coll, raw)
+        node = self.gossip_nodes.get(channel_id)
+        if node is not None:
+            node.disseminate_pvt(tx_id, pvt_writes)
 
     # -- discovery providers (discovery/support analog) -------------------
     def _discovery_peers(self, channel_id: str):
@@ -373,6 +386,27 @@ class PeerNode:
             lambda b: self.commit_block(channel_id, b),
             lambda: ch.ledger.height,
         )
+        def pvt_reader(block_num, tx_num, ns, coll):
+            for e in ch.ledger.pvt_store.get_pvt_data(block_num, tx_num):
+                if e.namespace == ns and e.collection == coll:
+                    return e.rwset
+            return None
+
+        def verify_identity(pki_id: bytes, identity: bytes) -> bool:
+            """Certstore adoption gate (reference certstore: identity must
+            hash to the claimed pki_id): the claimed MSP id must match the
+            serialized identity's, and the identity must deserialize +
+            validate (cert chain, CRL) under this channel's MSPs."""
+            try:
+                msp_id = pki_id.decode().split(":", 1)[0]
+                ident, msp = self.msp_manager.deserialize_identity(identity)
+                if ident.msp_id != msp_id:
+                    return False
+                msp.validate(ident)
+                return True
+            except Exception:  # noqa: BLE001 - any failure = reject
+                return False
+
         node = GossipNode(
             f"{self.signer.msp_id}:{self.addr}",
             channel_id,
@@ -380,6 +414,17 @@ class PeerNode:
             ch.ledger.block_store.get_block_by_number,
             lambda: ch.ledger.height,
             listen_address=gossip_listen,
+            identity_bytes=self.signer.serialize(),
+            verify_identity=verify_identity,
+            transient_store=self.transient,
+            pvt_reader=pvt_reader,
+            pvt_serve_policy=ch.is_eligible,
+        )
+        # reconciler loop (reconcile.go:104-126): patch missing pvt data
+        # recorded at commit from peers, hash-checked on arrival
+        node.enable_reconciliation(
+            ch.ledger.pvt_store.get_missing_pvt_data,
+            ch.ledger.commit_reconciled_pvt,
         )
         self.gossip_nodes[channel_id] = node
 
