@@ -75,6 +75,11 @@ def _load_node(config_path: str) -> PeerNode:
         )
 
     ops = (cfg.get("operations") or {}).get("listenAddress")
+    provider = None
+    if cfg.get("BCCSP") or pc.get("BCCSP"):
+        from fabric_tpu.crypto.factory import provider_from_config
+
+        provider = provider_from_config(cfg.get("BCCSP") or pc.get("BCCSP"))
     node = PeerNode(
         pc.get("fileSystemPath", "peer-data"),
         mgr,
@@ -82,6 +87,7 @@ def _load_node(config_path: str) -> PeerNode:
         registry_factory,
         listen_address=pc.get("listenAddress", "127.0.0.1:0"),
         ops_address=ops,
+        provider=provider,
     )
     # External-builder analog (core/container/externalbuilder): user
     # chaincode loads as python modules, "module.path:ClassName", with

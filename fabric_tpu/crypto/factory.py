@@ -1,0 +1,66 @@
+"""BCCSP factory: config-driven provider selection (reference
+bccsp/factory/factory.go:64 GetBCCSPFromOpts + swfactory/pkcs11factory;
+sampleconfig/core.yaml:295-319 BCCSP section).
+
+Config shape (the core.yaml BCCSP block):
+
+  BCCSP:
+    Default: TPU          # TPU | SW  (TPU occupies the PKCS11 slot,
+                          #  SURVEY.md §2.12: the accelerator provider
+                          #  IS the out-of-process crypto module analog)
+    SW:
+      Hash: SHA2
+      Security: 256
+    TPU:
+      MinDeviceBatch: 32  # below this, verification stays on host
+
+Unknown defaults fall back to SW with a warning, like the reference's
+factory error path.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from fabric_tpu.common import flogging
+from fabric_tpu.crypto.bccsp import Provider, SoftwareProvider
+
+logger = flogging.must_get_logger("bccsp.factory")
+
+
+class FactoryError(Exception):
+    pass
+
+
+def provider_from_config(cfg: Optional[dict]) -> Provider:
+    """BCCSP config dict -> Provider instance."""
+    cfg = cfg or {}
+    default = str(cfg.get("Default", "TPU")).upper()
+
+    sw_cfg = cfg.get("SW") or {}
+    hash_family = str(sw_cfg.get("Hash", "SHA2")).upper()
+    security = int(sw_cfg.get("Security", 256))
+    if hash_family != "SHA2" or security != 256:
+        # the reference factory rejects unsupported suites outright
+        raise FactoryError(
+            f"unsupported BCCSP suite {hash_family}-{security} "
+            "(only SHA2-256 is implemented)"
+        )
+
+    if default == "SW":
+        return SoftwareProvider()
+    if default == "TPU":
+        try:
+            from fabric_tpu.crypto.tpu_provider import TPUProvider
+
+            provider = TPUProvider()
+            tpu_cfg = cfg.get("TPU") or {}
+            if "MinDeviceBatch" in tpu_cfg:
+                provider.MIN_DEVICE_BATCH = int(tpu_cfg["MinDeviceBatch"])
+            return provider
+        except Exception as exc:  # noqa: BLE001 - no device: degrade to SW
+            logger.warning(
+                "TPU BCCSP unavailable (%s); falling back to SW", exc
+            )
+            return SoftwareProvider()
+    raise FactoryError(f"unknown BCCSP default {default!r}")

@@ -183,6 +183,11 @@ class KVLedger:
             )
         else:
             self.state_db = VersionedDB()
+        from fabric_tpu.ledger.confighistory import ConfigHistoryMgr
+
+        self.config_history = ConfigHistoryMgr(
+            self.state_db if persistent else None
+        )
         self.history: Dict[Tuple[str, str], List[Version]] = {}
         self.commit_hash = b""
         self._recover()
@@ -418,6 +423,8 @@ class KVLedger:
             for (ns, key), entry in updates.items():
                 self.history.setdefault((ns, key), []).append(entry.version)
             self.state_db.apply_updates(updates, hashed, pvt)
+        # collection-config history (confighistory/mgr.go commit hook)
+        self.config_history.record_from_updates(block.header.number, updates)
 
     def commit_reconciled_pvt(self, items) -> int:
         """Reconciler write-back (reference reconcile.go ->
@@ -521,6 +528,11 @@ class KVLedger:
             self.state_db.clear()
         else:
             self.state_db = VersionedDB()
+        from fabric_tpu.ledger.confighistory import ConfigHistoryMgr
+
+        self.config_history = ConfigHistoryMgr(
+            self.state_db if self.persistent else None
+        )
         self.history = {}
         self.commit_hash = b""
         self._recover()

@@ -328,5 +328,8 @@ class SqliteVersionedDB:
     def clear(self) -> None:
         """Drop all derived data (peer node rebuild-dbs)."""
         with self._lock, self._db as db:
-            for table in ("state", "hashed", "pvt", "history", "meta"):
-                db.execute(f"DELETE FROM {table}")
+            for table in ("state", "hashed", "pvt", "history", "meta", "confighistory"):
+                try:
+                    db.execute(f"DELETE FROM {table}")
+                except sqlite3.OperationalError:
+                    pass  # optional table (confighistory) not created yet

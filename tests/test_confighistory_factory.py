@@ -1,0 +1,92 @@
+"""Collection-config history (core/ledger/confighistory/mgr.go) and the
+config-driven BCCSP factory (bccsp/factory/factory.go:64)."""
+
+import pytest
+
+from fabric_tpu.crypto.bccsp import SoftwareProvider
+from fabric_tpu.crypto.factory import FactoryError, provider_from_config
+from fabric_tpu.ledger import rwset as rw
+from fabric_tpu.ledger.confighistory import ConfigHistoryMgr
+from fabric_tpu.ledger.kvledger import KVLedger
+from fabric_tpu.ledger.persistent import SqliteVersionedDB
+from fabric_tpu.ledger.statedb import UpdateBatch
+from fabric_tpu.protos import protoutil
+
+
+@pytest.mark.parametrize("persistent", [False, True])
+def test_confighistory_records_and_queries(tmp_path, persistent):
+    db = (
+        SqliteVersionedDB(str(tmp_path / "s.db")) if persistent else None
+    )
+    mgr = ConfigHistoryMgr(db)
+    for block, cfg in ((3, b"cfg-a"), (7, b"cfg-b"), (12, b"cfg-c")):
+        updates = UpdateBatch()
+        updates.put(
+            "_lifecycle",
+            "namespaces/fields/mycc/Collections",
+            cfg,
+            rw.Version(block, 0),
+        )
+        updates.put("othercc", "unrelated", b"x", rw.Version(block, 1))
+        mgr.record_from_updates(block, updates)
+
+    assert mgr.most_recent_below("mycc", 3) is None
+    assert mgr.most_recent_below("mycc", 4) == (3, b"cfg-a")
+    assert mgr.most_recent_below("mycc", 12) == (7, b"cfg-b")
+    assert mgr.most_recent_below("mycc", 100) == (12, b"cfg-c")
+    assert mgr.most_recent_below("othercc", 100) is None
+
+
+def test_confighistory_wired_into_commit(tmp_path):
+    ledger = KVLedger(str(tmp_path), "ch")
+    rwset = rw.TxRwSet(
+        (
+            rw.NsRwSet(
+                "_lifecycle",
+                (),
+                (
+                    rw.KVWrite(
+                        "namespaces/fields/asset/Collections",
+                        False,
+                        b"coll-config-v1",
+                    ),
+                ),
+            ),
+        )
+    )
+    block = protoutil.new_block(0, b"")
+    block.data.data.append(b"\x00")
+    protoutil.seal_block(block)
+    ledger.commit(block, rwsets=[rwset])
+    assert ledger.config_history.most_recent_below("asset", 99) == (
+        0,
+        b"coll-config-v1",
+    )
+    # history survives reopen (persistent ledger)
+    ledger.block_store.close()
+    ledger.pvt_store.close()
+    ledger.state_db.close()
+    again = KVLedger(str(tmp_path), "ch")
+    assert again.config_history.most_recent_below("asset", 99) == (
+        0,
+        b"coll-config-v1",
+    )
+
+
+def test_bccsp_factory_selection():
+    assert isinstance(
+        provider_from_config({"Default": "SW"}), SoftwareProvider
+    )
+    # default config prefers the device provider but must degrade
+    # gracefully when no accelerator exists — either type is a Provider
+    p = provider_from_config(None)
+    assert hasattr(p, "batch_verify")
+    with pytest.raises(FactoryError):
+        provider_from_config({"Default": "HSM9000"})
+    with pytest.raises(FactoryError):
+        provider_from_config({"SW": {"Hash": "SHA3"}})
+    tpu = provider_from_config(
+        {"Default": "TPU", "TPU": {"MinDeviceBatch": 7}}
+    )
+    if type(tpu).__name__ == "TPUProvider":
+        assert tpu.MIN_DEVICE_BATCH == 7
