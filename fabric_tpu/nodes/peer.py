@@ -57,6 +57,7 @@ class PeerNode:
         self.deliver_errors: Dict[str, str] = {}
         self._commit_listeners: list[Callable] = []
         self.gossip_nodes: Dict[str, object] = {}
+        self._pipelines: Dict[str, object] = {}
 
         # out-of-process chaincode runtime (reference core/container
         # externalbuilder + core/chaincode/persistence): installed
@@ -356,12 +357,36 @@ class PeerNode:
     def commit_block(self, channel_id: str, block: common_pb2.Block):
         ch = self.channels[channel_id]
         flags = ch.store_block(block)
+        self._after_commit(channel_id, block)
+        return flags
+
+    def _after_commit(self, channel_id: str, block: common_pb2.Block) -> None:
         cond = self._commit_conds.setdefault(channel_id, threading.Condition())
         with cond:
             cond.notify_all()
         for fn in self._commit_listeners:
             fn(channel_id, block)
-        return flags
+
+    def commit_pipeline(self, channel_id: str):
+        """Per-channel two-stage commit pipeline (SURVEY §2.13 P4): the
+        deliver loop prepares block N (parse + device sig batch) while
+        the committer thread finishes block N-1."""
+        from fabric_tpu.peer.pipeline import CommitPipeline
+
+        pipe = self._pipelines.get(channel_id)
+        if pipe is None:
+            ch = self.channels[channel_id]
+            pipe = CommitPipeline(
+                ch,
+                on_commit=lambda block, _flags: self._after_commit(
+                    channel_id, block
+                ),
+                on_error=lambda block, exc: self.deliver_errors.__setitem__(
+                    channel_id, f"pipeline commit failed: {exc}"
+                ),
+            )
+            self._pipelines[channel_id] = pipe
+        return pipe
 
     def on_commit(self, fn: Callable[[str, common_pb2.Block], None]) -> None:
         self._commit_listeners.append(fn)
@@ -460,22 +485,27 @@ class PeerNode:
         channel_id: str,
         orderer_addr: str,
         should_run: Optional[Callable[[], bool]] = None,
+        pipelined: bool = False,
     ) -> threading.Thread:
         """Pull blocks from the orderer and feed the commit pipeline
         (blocksprovider.DeliverBlocks). Reconnects with backoff until
         stop() — each reconnect re-seeks from the current height.
         ``should_run`` gates the loop (gossip leadership: a demoted
         leader must stop pulling, reference deliveryclient leadership
-        yield)."""
+        yield). ``pipelined`` overlaps block N's parse + device sig
+        batch with block N-1's commit (SURVEY §2.13 P4)."""
 
         def run():
             backoff = 0.05
+            pipe = self.commit_pipeline(channel_id) if pipelined else None
             while not self._stop.is_set():
                 if should_run is not None and not should_run():
                     self._stop.wait(0.2)
                     continue
                 try:
                     ch = self.channels[channel_id]
+                    if pipe is not None:
+                        pipe.drain()  # reseek only from a settled height
                     env = seek_envelope(
                         channel_id,
                         start=ch.ledger.height,
@@ -490,7 +520,10 @@ class PeerNode:
                                 break  # demoted: idle in the outer loop
                             kind = resp.WhichOneof("Type")
                             if kind == "block":
-                                self.commit_block(channel_id, resp.block)
+                                if pipe is not None:
+                                    pipe.submit(resp.block)
+                                else:
+                                    self.commit_block(channel_id, resp.block)
                                 backoff = 0.05
                             elif kind == "status":
                                 break

@@ -70,9 +70,30 @@ class Channel:
             get_state_metadata=get_state_metadata,
         )
 
-    def store_block(self, block: common_pb2.Block) -> ValidationFlags:
+    def prepare_block(self, block: common_pb2.Block):
+        """Stage A of the commit pipeline (SURVEY.md §2.13 P4): orderer
+        signature check, host parse, and the DEVICE signature batch —
+        everything that may overlap the previous block's sequential
+        MVCC/commit epilogue. Returns the opaque tuple store_block takes
+        as `prepared`."""
+        self._verify_block_content(block)
+        parsed = [
+            parse_transaction(i, d) for i, d in enumerate(block.data.data)
+        ]
+        jobs, job_identity, keys, sigs, payloads = (
+            self.validator.collect_sig_jobs(parsed)
+        )
+        digests = self.provider.batch_hash(payloads)
+        ok_list = self.provider.batch_verify(keys, sigs, digests)
+        return parsed, jobs, job_identity, ok_list
+
+    def store_block(
+        self, block: common_pb2.Block, prepared=None
+    ) -> ValidationFlags:
         """The full commit pipeline for one delivered block. Envelopes are
-        parsed once and the result shared between validation and commit.
+        parsed once and the result shared between validation and commit;
+        a pipelined deliver loop passes `prepared` from prepare_block run
+        on another thread (P4 overlap).
 
         Private data is assembled coordinator-style (gossip/privdata/
         coordinator.go:149-209): transient store first, then the peer
@@ -80,11 +101,16 @@ class Channel:
         import time as _time
 
         t0 = _time.perf_counter()
-        self._verify_block(block)
-        parsed = [
-            parse_transaction(i, d) for i, d in enumerate(block.data.data)
-        ]
-        flags = self.validator.validate(block, parsed=parsed)
+        self._verify_block_position(block)
+        if prepared is None:
+            prepared = self.prepare_block(block)
+        parsed, jobs, job_identity, ok_list = prepared
+        sig_results = self.validator.finish_sig_results(
+            jobs, job_identity, ok_list
+        )
+        flags = self.validator.validate(
+            block, parsed=parsed, sig_results=sig_results
+        )
         t_validate = _time.perf_counter() - t0
         rwsets = [p.rwset for p in parsed]
         pvt_data, missing = self._assemble_pvt_data(block, parsed, flags)
@@ -169,22 +195,32 @@ class Channel:
                 missing.append(MissingEntry(tx_num, ns, coll))
         return pvt_data, missing
 
-    def _verify_block(self, block: common_pb2.Block) -> None:
-        if block.header.number != self.ledger.height:
-            raise BlockVerificationError(
-                f"expected block {self.ledger.height}, got {block.header.number}"
-            )
+    def _verify_block_content(self, block: common_pb2.Block) -> None:
+        """Position-independent checks (MCS VerifyBlock: DataHash +
+        orderer signature) — safe in pipeline stage A, before the
+        preceding block committed."""
         if protoutil.block_data_hash(block.data) != block.header.data_hash:
             raise BlockVerificationError(
                 "Header.DataHash is different from Hash(block.Data)"
+            )
+        if self.verify_orderer_sig is not None and not self.verify_orderer_sig(block):
+            raise BlockVerificationError("orderer block signature invalid")
+
+    def _verify_block_position(self, block: common_pb2.Block) -> None:
+        """Chain-position checks — must run in commit order (stage B)."""
+        if block.header.number != self.ledger.height:
+            raise BlockVerificationError(
+                f"expected block {self.ledger.height}, got {block.header.number}"
             )
         if (
             self.ledger.height > 0
             and block.header.previous_hash != self.ledger.block_store.last_block_hash
         ):
             raise BlockVerificationError("previous-hash mismatch")
-        if self.verify_orderer_sig is not None and not self.verify_orderer_sig(block):
-            raise BlockVerificationError("orderer block signature invalid")
+
+    def _verify_block(self, block: common_pb2.Block) -> None:
+        self._verify_block_position(block)
+        self._verify_block_content(block)
 
     @property
     def height(self) -> int:

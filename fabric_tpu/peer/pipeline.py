@@ -1,0 +1,100 @@
+"""Two-stage commit pipeline (SURVEY.md §2.13 P4: deliver -> payload
+buffer -> validate -> commit stages overlap across blocks; reference
+gossip/state.go:542 + kv_ledger.go:596 run block N's delivery while
+block N-1 commits).
+
+Stage A (prepare): orderer-sig check + host parse + the DEVICE signature
+batch for block N — runs while stage B finishes block N-1.
+Stage B (commit): policy circuits, MVCC, stores — inherently sequential
+per channel, one worker, in order.
+
+The bounded queue between the stages is the backpressure discipline of
+SURVEY §2.13 P7 (orderer WaitReady analog): a slow commit stage stalls
+`submit`, which stalls the deliver client, which stops pulling."""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Optional
+
+from fabric_tpu.protos import common_pb2
+
+
+class PipelineError(Exception):
+    pass
+
+
+class CommitPipeline:
+    def __init__(
+        self,
+        channel,  # peer.channel.Channel
+        on_commit: Optional[Callable[[common_pb2.Block, object], None]] = None,
+        on_error: Optional[Callable[[common_pb2.Block, Exception], None]] = None,
+        depth: int = 2,
+    ):
+        self.channel = channel
+        self.on_commit = on_commit
+        self.on_error = on_error
+        self._prepared: "queue.Queue" = queue.Queue(maxsize=depth)
+        self._stopped = threading.Event()
+        self._idle = threading.Event()
+        self._idle.set()
+        self._pending = 0
+        self._pending_lock = threading.Lock()
+        self._committer = threading.Thread(
+            target=self._commit_loop,
+            name=f"commit-{channel.channel_id}",
+            daemon=True,
+        )
+        self._committer.start()
+
+    # -- producer side (the deliver loop) ----------------------------------
+    def submit(self, block: common_pb2.Block) -> None:
+        """Prepare block and hand it to the committer. Runs stage A on
+        the CALLING thread (the deliver loop), so while the committer
+        drains block N-1 this thread already parses + device-verifies
+        block N. Blocks when the queue is full (P7 backpressure)."""
+        if self._stopped.is_set():
+            raise PipelineError("pipeline stopped")
+        with self._pending_lock:
+            self._pending += 1
+            self._idle.clear()
+        try:
+            prepared = self.channel.prepare_block(block)
+        except Exception:
+            with self._pending_lock:
+                self._pending -= 1
+                if self._pending == 0:
+                    self._idle.set()
+            raise
+        self._prepared.put((block, prepared))
+
+    # -- consumer side -----------------------------------------------------
+    def _commit_loop(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                item = self._prepared.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            block, prepared = item
+            try:
+                flags = self.channel.store_block(block, prepared=prepared)
+                if self.on_commit is not None:
+                    self.on_commit(block, flags)
+            except Exception as exc:  # noqa: BLE001 - surfaced to the owner
+                if self.on_error is not None:
+                    self.on_error(block, exc)
+            finally:
+                with self._pending_lock:
+                    self._pending -= 1
+                    if self._pending == 0:
+                        self._idle.set()
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Wait until every submitted block has committed."""
+        return self._idle.wait(timeout)
+
+    def stop(self) -> None:
+        self._stopped.set()
+        self._committer.join(timeout=5)

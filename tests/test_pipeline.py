@@ -1,0 +1,140 @@
+"""Two-stage commit pipeline (SURVEY §2.13 P4): prepared blocks commit
+in order with device verification overlapped on the submitter thread."""
+
+import threading
+import time
+
+import pytest
+
+from fabric_tpu.crypto.bccsp import SoftwareProvider
+from fabric_tpu.endorser import create_proposal, create_signed_tx, endorse_proposal
+from fabric_tpu.ledger import rwset as rw
+from fabric_tpu.ledger.rwset_proto import serialize_tx_rwset
+from fabric_tpu.msp.cryptogen import generate_org
+from fabric_tpu.msp.identity import MSPManager
+from fabric_tpu.msp.signer import SigningIdentity
+from fabric_tpu.peer.channel import Channel
+from fabric_tpu.peer.pipeline import CommitPipeline
+from fabric_tpu.policy import from_dsl
+from fabric_tpu.protos import protoutil
+from fabric_tpu.validation.validator import (
+    ChaincodeDefinition,
+    ChaincodeRegistry,
+)
+
+PROVIDER = SoftwareProvider()
+CHANNEL = "pipechan"
+
+
+@pytest.fixture(scope="module")
+def world():
+    org = generate_org("org1.example.com", "Org1MSP")
+    mgr = MSPManager([org.msp(provider=PROVIDER)])
+    registry = ChaincodeRegistry(
+        [ChaincodeDefinition("cc", from_dsl("OR('Org1MSP.member')"))]
+    )
+    return {
+        "mgr": mgr,
+        "registry": registry,
+        "client": SigningIdentity(org.users[0], PROVIDER),
+        "peer": SigningIdentity(org.peers[0], PROVIDER),
+    }
+
+
+def _tx(world, key):
+    bundle = create_proposal(world["client"], CHANNEL, "cc", [b"put", key])
+    results = serialize_tx_rwset(
+        rw.TxRwSet(
+            (rw.NsRwSet("cc", (), (rw.KVWrite(key.decode(), False, b"v"),)),)
+        )
+    )
+    responses = [endorse_proposal(bundle, world["peer"], results)]
+    return create_signed_tx(bundle, world["client"], responses)
+
+
+def _chain(world, n_blocks, txs_per_block=3):
+    blocks = []
+    prev = b""
+    for num in range(n_blocks):
+        block = protoutil.new_block(num, prev)
+        for i in range(txs_per_block):
+            block.data.data.append(
+                _tx(world, f"b{num}k{i}".encode()).SerializeToString()
+            )
+        protoutil.seal_block(block)
+        prev = protoutil.block_header_hash(block.header)
+        blocks.append(block)
+    return blocks
+
+
+def test_pipeline_commits_in_order_with_overlap(tmp_path, world):
+    ch = Channel(
+        CHANNEL,
+        str(tmp_path),
+        world["mgr"],
+        world["registry"],
+        PROVIDER,
+    )
+    blocks = _chain(world, 4)
+
+    events = []
+    commits = []
+    orig_store = ch.store_block
+
+    def slow_store(block, prepared=None):
+        events.append(("commit_start", block.header.number, time.monotonic()))
+        time.sleep(0.15)  # make the sequential stage visibly slow
+        out = orig_store(block, prepared=prepared)
+        events.append(("commit_end", block.header.number, time.monotonic()))
+        return out
+
+    ch.store_block = slow_store
+    orig_prepare = ch.prepare_block
+
+    def traced_prepare(block):
+        events.append(("prepare_start", block.header.number, time.monotonic()))
+        return orig_prepare(block)
+
+    ch.prepare_block = traced_prepare
+
+    pipe = CommitPipeline(
+        ch, on_commit=lambda b, f: commits.append(b.header.number)
+    )
+    try:
+        for b in blocks:
+            pipe.submit(b)
+        assert pipe.drain(timeout=60)
+    finally:
+        pipe.stop()
+
+    assert commits == [0, 1, 2, 3]
+    assert ch.ledger.height == 4
+    assert ch.ledger.get_state("cc", "b3k2") == b"v"
+    # overlap: block 2's prepare started before block 1's commit finished
+    t_prep2 = next(t for k, n, t in events if k == "prepare_start" and n == 2)
+    t_end1 = next(t for k, n, t in events if k == "commit_end" and n == 1)
+    assert t_prep2 < t_end1, events
+
+
+def test_pipeline_surfaces_commit_errors(tmp_path, world):
+    ch = Channel(
+        CHANNEL,
+        str(tmp_path),
+        world["mgr"],
+        world["registry"],
+        PROVIDER,
+    )
+    blocks = _chain(world, 2)
+    errors = []
+    pipe = CommitPipeline(
+        ch, on_error=lambda b, exc: errors.append((b.header.number, str(exc)))
+    )
+    try:
+        pipe.submit(blocks[0])
+        # out-of-order submission: block 0 again -> block store rejects
+        pipe.submit(blocks[0])
+        assert pipe.drain(timeout=30)
+    finally:
+        pipe.stop()
+    assert ch.ledger.height == 1
+    assert errors and errors[0][0] == 0
