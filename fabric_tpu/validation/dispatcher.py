@@ -32,6 +32,21 @@ class PluginRegistry:
     def register(self, name: str, plugin: object) -> None:
         self._plugins[name] = plugin
 
+    def load(self, name: str, ref: str) -> object:
+        """Dynamic plugin loading, the Go `plugin.Open` analog
+        (core/handlers/library/registry.go:134): `ref` is
+        "module.path:attribute"; the attribute (or module) becomes the
+        registered plugin object."""
+        import importlib
+
+        mod_name, _, attr = ref.partition(":")
+        mod = importlib.import_module(mod_name)
+        plugin = getattr(mod, attr) if attr else mod
+        if callable(plugin) and attr and attr[0].isupper():
+            plugin = plugin()  # class reference: instantiate
+        self.register(name, plugin)
+        return plugin
+
     def get(self, name: str) -> Optional[object]:
         return self._plugins.get(name)
 

@@ -1,0 +1,110 @@
+"""Legacy (pre-2.0) validation: the v12-era LSCC-backed policy source,
+write-set guards and the capability router (reference
+core/handlers/validation/builtin/v12/validation_logic.go,
+core/committer/txvalidator/v14 + router.go:34-50).
+
+Pre-V2_0 channels resolve a chaincode's endorsement policy from LSCC's
+ChaincodeData record in state — not from the _lifecycle namespace — and
+apply the v12 write-set rules: a normal transaction must not write to
+the LSCC namespace or any system chaincode namespace, and an LSCC
+deploy/upgrade must be shaped as one (validation_logic.go
+validateDeployRWSetAndCollection / checkInstantiationPolicy lineage).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+from fabric_tpu.policy.proto_convert import (
+    PolicyConversionError,
+    unmarshal_envelope,
+)
+from fabric_tpu.protos import peer_pb2
+
+SYSTEM_NAMESPACES = ("lscc", "cscc", "qscc", "escc", "vscc", "_lifecycle")
+
+
+class LSCCRegistry:
+    """ChaincodeRegistry drop-in resolving definitions from LSCC state
+    (v12 validation_logic.go getVSCCInfo path: ChaincodeData.policy)."""
+
+    def __init__(self, state_get: Callable[[str, str], Optional[bytes]]):
+        """state_get(ns, key) -> committed bytes; definitions live at
+        ("lscc", <chaincode name>)."""
+        from fabric_tpu.validation.validator import ChaincodeDefinition
+
+        self._cd_cls = ChaincodeDefinition
+        self._state_get = state_get
+
+    def get(self, name: str):
+        raw = self._state_get("lscc", name)
+        if raw is None:
+            return None
+        data = peer_pb2.ChaincodeData()
+        try:
+            data.ParseFromString(raw)
+        except Exception:  # noqa: BLE001 - malformed record = undefined
+            return None
+        try:
+            policy = unmarshal_envelope(data.policy)
+        except PolicyConversionError:
+            return None
+        return self._cd_cls(name, policy, plugin=data.vscc or "vscc")
+
+    def names(self) -> List[str]:
+        return []  # enumeration needs a range scan; unused by validation
+
+
+def check_v12_writeset(rwset, invoked_namespace: str) -> Optional[str]:
+    """The v12 write-set guards. Returns an error string (maps to
+    ILLEGAL_WRITESET) or None.
+
+    - writes to LSCC are only legal when the tx INVOKES lscc (deploy /
+      upgrade), and then only to the deployed chaincode's own key
+      (validation_logic.go:  "LSCC can only issue a single putState");
+    - writes to any other system chaincode namespace are always illegal.
+    """
+    if rwset is None:
+        return None
+    for ns_rw in rwset.ns_rw_sets:
+        ns = ns_rw.namespace
+        if ns == "lscc":
+            if invoked_namespace != "lscc":
+                if ns_rw.writes:
+                    return (
+                        "chaincode is not lscc but writes to the lscc "
+                        "namespace"
+                    )
+            elif len(ns_rw.writes) > 1:
+                return "lscc deploy must write exactly one key"
+        elif ns in SYSTEM_NAMESPACES and ns != invoked_namespace:
+            if ns_rw.writes or ns_rw.metadata_writes:
+                return f"writes to system namespace {ns} are not allowed"
+    return None
+
+
+class ValidationRouter:
+    """router.go:34-50: pick the v20 (_lifecycle) or legacy (LSCC)
+    definition source by the channel's application capabilities."""
+
+    def __init__(
+        self,
+        lifecycle_registry,
+        lscc_registry: LSCCRegistry,
+        capabilities: Callable[[], Sequence[str]],
+    ):
+        self._v20 = lifecycle_registry
+        self._legacy = lscc_registry
+        self._capabilities = capabilities
+
+    @property
+    def v20_active(self) -> bool:
+        return "V2_0" in tuple(self._capabilities())
+
+    def get(self, name: str):
+        if self.v20_active:
+            return self._v20.get(name)
+        return self._legacy.get(name)
+
+    def names(self) -> List[str]:
+        return self._v20.names() if self.v20_active else self._legacy.names()

@@ -128,7 +128,11 @@ class BlockValidator:
         get_collection_ep: Optional[
             Callable[[str, str], Optional[SignaturePolicyEnvelope]]
         ] = None,
+        writeset_check: Optional[Callable] = None,
     ):
+        # optional extra write-set rule, e.g. the v12 system-namespace
+        # guards on legacy channels (validation/legacy.check_v12_writeset)
+        self.writeset_check = writeset_check
         self.channel_id = channel_id
         self.msp_manager = msp_manager
         self.provider = provider
@@ -314,6 +318,11 @@ class BlockValidator:
             if illegal:
                 flags.set_flag(i, TxValidationCode.ILLEGAL_WRITESET)
                 continue
+            if self.writeset_check is not None:
+                why = self.writeset_check(tx.rwset, tx.namespace)
+                if why is not None:
+                    flags.set_flag(i, TxValidationCode.ILLEGAL_WRITESET)
+                    continue
             defs = []
             for ns in wr_ns:
                 definition = self.registry.get(ns)
