@@ -1,16 +1,23 @@
 #!/usr/bin/env python
-"""Headline benchmark: batched ECDSA-P256 verification throughput.
+"""Benchmarks for every BASELINE.md config, printed as ONE JSON line.
 
-Prints ONE JSON line:
-  {"metric": "ecdsa_p256_verify_throughput", "value": <verifies/s on the
-   accelerator>, "unit": "verifies/s", "vs_baseline": <x over the
-   single-core CPU software path>}
+Headline metric (BASELINE config #1): batched ECDSA-P256 verification
+throughput on the accelerator vs the single-core OpenSSL software path.
+The `detail.configs` object carries the measured numbers for configs
+#2-#5:
 
-Baseline config #1 (BASELINE.md): SW BCCSP ECDSA-P256 verify over 10k
-pre-generated (msg, sig, pubkey) triples. The CPU baseline is measured
-here with the `cryptography` package (OpenSSL) — the same order as Go
-crypto/ecdsa (~1e4/s/core), i.e. an honest stand-in for the reference's
-bccsp/sw hot loop. North-star target: >= 50k verifies/s per host.
+  block_1k   — 1k-tx 2-of-3 endorsement block through the full
+               BlockValidator: TPU provider vs SW provider ms/block,
+               bit-exact TRANSACTIONS_FILTER asserted (config #2).
+  idemix     — batched Idemix verify: device Ate2 pairing kernel vs the
+               host oracle pairing, ms/sig (config #3).
+  mvcc_5k    — 5k-tx MVCC validate-and-prepare, ms/block (config #4).
+  multi_4ch  — 4 channels x 2k-tx blocks in one channel-axis device
+               step, aggregate tx/s (config #5; sharding across chips is
+               validated on the virtual CPU mesh by dryrun_multichip —
+               the bench machine has one chip).
+
+Heavy configs can be skipped with BENCH_HEADLINE_ONLY=1.
 """
 
 import json
@@ -94,12 +101,7 @@ def bench_cpu_baseline(triples, budget_s=2.0):
     return count / (time.perf_counter() - start)
 
 
-def main():
-    n = int(os.environ.get("BENCH_N", "16384"))
-    iters = int(os.environ.get("BENCH_ITERS", "5"))
-
-    import jax
-
+def bench_headline(n, iters):
     from fabric_tpu.crypto.tpu_provider import TPUProvider
 
     triples = gen_triples(n)
@@ -108,17 +110,313 @@ def main():
     digests = [t[2] for t in triples]
 
     prov = TPUProvider()
-    # warmup / compile
     out = prov.batch_verify(keys, sigs, digests)
     if not all(out):
         raise RuntimeError("verification failed in warmup — kernel bug")
 
+    # depth-2 software pipeline, same discipline as the peer's P4
+    # CommitPipeline: host-prep batch i+1 while the device runs batch i
     start = time.perf_counter()
+    pending = None
     for _ in range(iters):
-        prov.batch_verify(keys, sigs, digests)
+        resolver = prov.batch_verify_async(keys, sigs, digests)
+        if pending is not None:
+            if not all(pending()):
+                raise RuntimeError("verification failed mid-bench")
+        pending = resolver
+    if not all(pending()):
+        raise RuntimeError("verification failed mid-bench")
     device_rate = n * iters / (time.perf_counter() - start)
-
     cpu_rate = bench_cpu_baseline(triples)
+    return device_rate, cpu_rate
+
+
+# ----------------------------------------------------------------------
+# shared network fixture for configs #2 and #5
+# ----------------------------------------------------------------------
+
+
+class _Net:
+    def __init__(self):
+        from fabric_tpu.crypto.bccsp import SoftwareProvider
+        from fabric_tpu.msp.cryptogen import generate_org
+        from fabric_tpu.msp.identity import MSPManager
+        from fabric_tpu.msp.signer import SigningIdentity
+        from fabric_tpu.policy import from_dsl
+        from fabric_tpu.validation.validator import (
+            ChaincodeDefinition,
+            ChaincodeRegistry,
+        )
+
+        self.sw = SoftwareProvider()
+        org1 = generate_org("org1.bench", "Org1MSP")
+        org2 = generate_org("org2.bench", "Org2MSP")
+        org3 = generate_org("org3.bench", "Org3MSP")
+        self.mgr = MSPManager(
+            [o.msp(provider=self.sw) for o in (org1, org2, org3)]
+        )
+        # 2-of-3 endorsement policy (BASELINE config #2)
+        self.registry = ChaincodeRegistry(
+            [
+                ChaincodeDefinition(
+                    "benchcc",
+                    from_dsl(
+                        "OutOf(2,'Org1MSP.member','Org2MSP.member',"
+                        "'Org3MSP.member')"
+                    ),
+                )
+            ]
+        )
+        self.client = SigningIdentity(org1.users[0], self.sw)
+        self.endorsers = [
+            SigningIdentity(o.peers[0], self.sw) for o in (org1, org2)
+        ]
+
+    def make_block(self, channel, n_txs, number=1):
+        from fabric_tpu.endorser import (
+            create_proposal,
+            create_signed_tx,
+            endorse_proposal,
+        )
+        from fabric_tpu.ledger import rwset as rw
+        from fabric_tpu.ledger.rwset_proto import serialize_tx_rwset
+        from fabric_tpu.protos import protoutil
+
+        block = protoutil.new_block(number, b"\x33" * 32)
+        for i in range(n_txs):
+            results = serialize_tx_rwset(
+                rw.TxRwSet(
+                    (
+                        rw.NsRwSet(
+                            "benchcc",
+                            (),
+                            (rw.KVWrite(f"k{i}", False, b"v"),),
+                        ),
+                    )
+                )
+            )
+            bundle = create_proposal(
+                self.client, channel, "benchcc", [b"invoke", b"%d" % i]
+            )
+            responses = [
+                endorse_proposal(bundle, e, results) for e in self.endorsers
+            ]
+            env = create_signed_tx(bundle, self.client, responses)
+            block.data.data.append(env.SerializeToString())
+        protoutil.seal_block(block)
+        return block
+
+    def validator(self, channel, provider):
+        from fabric_tpu.validation.validator import BlockValidator
+
+        return BlockValidator(channel, self.mgr, provider, self.registry)
+
+
+def bench_block_1k(net, n_txs=1000):
+    """Config #2: full validator ms/block, TPU vs SW provider, bit-exact
+    masks (reference timers v20/validator.go:261-262)."""
+    from fabric_tpu.crypto.tpu_provider import TPUProvider
+    from fabric_tpu.protos import common_pb2
+
+    block = net.make_block("benchchan", n_txs)
+
+    def run(provider):
+        b = common_pb2.Block()
+        b.CopyFrom(block)
+        v = net.validator("benchchan", provider)
+        start = time.perf_counter()
+        flags = v.validate(b)
+        return (time.perf_counter() - start) * 1000.0, flags.tobytes()
+
+    tpu_prov = TPUProvider()
+    run(tpu_prov)  # compile warmup
+    tpu_ms, tpu_mask = run(tpu_prov)
+    sw_ms, sw_mask = run(net.sw)
+    if tpu_mask != sw_mask:
+        raise RuntimeError("config #2 mask mismatch TPU vs SW")
+    if set(tpu_mask) != {0}:
+        raise RuntimeError("config #2 expected all-VALID block")
+    return {
+        "txs": n_txs,
+        "tpu_ms_per_block": round(tpu_ms, 1),
+        "cpu_ms_per_block": round(sw_ms, 1),
+        "speedup": round(sw_ms / tpu_ms, 2),
+        "mask_bit_exact": True,
+    }
+
+
+def bench_idemix(n_sigs=8):
+    """Config #3: batched Idemix verify, device Ate2 pairing kernel vs
+    the host oracle pairing (idemix/signature.go:243-296)."""
+    import random
+
+    from fabric_tpu import idemix
+    from fabric_tpu.crypto import fp256bn as bncurve
+    from fabric_tpu.idemix.batch import verify_signatures_batch
+
+    rng = random.Random(1234)
+    attrs = ["OU", "Role", "EnrollmentID", "RevocationHandle"]
+    rh_index = 3
+    ik = idemix.new_issuer_key(attrs, rng)
+    sk = bncurve.rand_mod_order(rng)
+    nonce = bncurve.big_to_bytes(bncurve.rand_mod_order(rng))
+    req = idemix.new_cred_request(sk, nonce, ik.ipk, rng)
+    cred = idemix.new_credential(ik, req, [11, 22, 33, 44], rng)
+    rev_key = idemix.generate_long_term_revocation_key()
+    cri = idemix.create_cri(rev_key, [], 0, idemix.ALG_NO_REVOCATION, rng)
+    disclosure = [0, 0, 0, 0]
+    msg = b"idemix bench message"
+    sigs = []
+    for _ in range(n_sigs):
+        nym, r_nym = idemix.make_nym(sk, ik.ipk, rng)
+        sigs.append(
+            idemix.new_signature(
+                cred, sk, nym, r_nym, ik.ipk, disclosure, msg, rh_index, cri, rng
+            )
+        )
+    values = [[None, None, None, None]] * n_sigs
+
+    def run(device):
+        start = time.perf_counter()
+        out = verify_signatures_batch(
+            sigs,
+            [disclosure] * n_sigs,
+            ik.ipk,
+            [msg] * n_sigs,
+            values,
+            rh_index,
+            device_pairing=device,
+        )
+        return (time.perf_counter() - start) * 1000.0, out
+
+    run(True)  # compile warmup
+    dev_ms, dev_out = run(True)
+    host_ms, host_out = run(False)
+    if dev_out != host_out or not all(dev_out):
+        raise RuntimeError("config #3 device/host mismatch")
+    return {
+        "sigs": n_sigs,
+        "device_ms_per_sig": round(dev_ms / n_sigs, 1),
+        "host_ms_per_sig": round(host_ms / n_sigs, 1),
+        "speedup": round(host_ms / dev_ms, 1),
+        "mask_bit_exact": True,
+    }
+
+
+def bench_mvcc(n_txs=5000):
+    """Config #4: MVCC validate-and-prepare over a 5k-tx block
+    (reference validateAndPrepareBatch, validation/validator.go:82)."""
+    from fabric_tpu.ledger import rwset as rw
+    from fabric_tpu.ledger.mvcc import Validator
+    from fabric_tpu.ledger.statedb import UpdateBatch, VersionedDB
+    from fabric_tpu.validation.txflags import TxValidationCode
+
+    db = VersionedDB()
+    seed = UpdateBatch()
+    for i in range(n_txs):
+        seed.put("cc", f"k{i}", b"v0", rw.Version(0, i))
+    db.apply_updates(seed)
+
+    # every tx reads its own key at the committed version and writes it;
+    # every 10th tx reads a key another in-block tx already wrote ->
+    # MVCC_READ_CONFLICT, so the run exercises both outcomes
+    rwsets = []
+    for i in range(n_txs):
+        read_key = f"k{i - 1}" if i % 10 == 5 else f"k{i}"
+        read_ver = rw.Version(0, i - 1 if i % 10 == 5 else i)
+        rwsets.append(
+            rw.TxRwSet(
+                (
+                    rw.NsRwSet(
+                        "cc",
+                        (rw.KVRead(read_key, read_ver),),
+                        (rw.KVWrite(f"k{i}", False, b"v1"),),
+                    ),
+                )
+            )
+        )
+    incoming = [TxValidationCode.VALID] * n_txs
+    start = time.perf_counter()
+    codes, _updates, _hashed = Validator(db).validate_and_prepare_batch(
+        1, rwsets, incoming
+    )
+    ms = (time.perf_counter() - start) * 1000.0
+    n_conflicts = sum(
+        1 for c in codes if c == TxValidationCode.MVCC_READ_CONFLICT
+    )
+    if n_conflicts != n_txs // 10:
+        raise RuntimeError(
+            f"config #4 expected {n_txs // 10} conflicts, got {n_conflicts}"
+        )
+    return {"txs": n_txs, "host_ms_per_block": round(ms, 1)}
+
+
+def bench_multichannel(net, n_channels=4, txs_per_channel=2000):
+    """Config #5: one channel-axis device step validating one block per
+    channel (sharding over real chips is exercised by dryrun_multichip
+    on the virtual mesh; this machine has a single chip)."""
+    import jax
+
+    from fabric_tpu.parallel import MultiChannelValidator
+    from fabric_tpu.parallel.mesh import grid_mesh
+    from fabric_tpu.protos import common_pb2
+
+    channels = [f"bench{i}" for i in range(n_channels)]
+    blocks = {
+        ch: net.make_block(ch, txs_per_channel) for ch in channels
+    }
+    devices = jax.devices()
+    mesh = grid_mesh(1, 1, devices[:1])
+    mc = MultiChannelValidator(
+        mesh, {ch: net.validator(ch, net.sw) for ch in channels}
+    )
+
+    def copy_blocks():
+        out = {}
+        for ch, b in blocks.items():
+            c = common_pb2.Block()
+            c.CopyFrom(b)
+            out[ch] = c
+        return out
+
+    mc.validate(copy_blocks())  # compile warmup
+    start = time.perf_counter()
+    flags = mc.validate(copy_blocks())
+    elapsed = time.perf_counter() - start
+    total = n_channels * txs_per_channel
+    for ch in channels:
+        if set(flags[ch].tobytes()) != {0}:
+            raise RuntimeError(f"config #5 invalid txs in {ch}")
+    return {
+        "channels": n_channels,
+        "txs_per_channel": txs_per_channel,
+        "aggregate_tx_per_s": round(total / elapsed, 1),
+        "ms_total": round(elapsed * 1000.0, 1),
+    }
+
+
+def main():
+    n = int(os.environ.get("BENCH_N", "16384"))
+    iters = int(os.environ.get("BENCH_ITERS", "5"))
+    headline_only = os.environ.get("BENCH_HEADLINE_ONLY", "") == "1"
+
+    import jax
+
+    device_rate, cpu_rate = bench_headline(n, iters)
+
+    configs = {}
+    if not headline_only:
+        net = _Net()
+        for name, fn in (
+            ("block_1k", lambda: bench_block_1k(net)),
+            ("idemix", bench_idemix),
+            ("mvcc_5k", bench_mvcc),
+            ("multi_4ch", lambda: bench_multichannel(net)),
+        ):
+            try:
+                configs[name] = fn()
+            except Exception as exc:  # noqa: BLE001 - emit partial results
+                configs[name] = {"error": str(exc)[:300]}
 
     print(
         json.dumps(
@@ -133,6 +431,7 @@ def main():
                     "cpu_baseline_verifies_per_s": round(cpu_rate, 1),
                     "device": str(jax.devices()[0]),
                     "target_verifies_per_s": 50000,
+                    "configs": configs,
                 },
             }
         )

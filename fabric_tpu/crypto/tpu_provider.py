@@ -94,6 +94,11 @@ class TPUProvider(Provider):
         # raises VerifyError with the reference's (bool, error) semantics.
         return self._software.verify(key, signature, digest)
 
+    # distinct keys are padded to a fixed column bucket so the jitted
+    # program's K dimension does not recompile per block (few orgs in
+    # practice; overflow falls back to full limb matrices)
+    KEY_BUCKET = 32
+
     def batch_verify(
         self,
         keys: Sequence[ECDSAPublicKey],
@@ -108,16 +113,145 @@ class TPUProvider(Provider):
                 except VerifyError:
                     out.append(False)
             return out
-        return self._batch_verify_native(keys, signatures, digests)
+        return self.batch_verify_async(keys, signatures, digests)()
 
-    def _batch_verify_native(
+    def batch_verify_async(
         self,
         keys: Sequence[ECDSAPublicKey],
         signatures: Sequence[bytes],
         digests: Sequence[bytes],
-    ) -> List[bool]:
-        limbs = self.prep_limbs(keys, signatures, digests)
-        return self._run_kernel(limbs)
+    ):
+        """Dispatch the device batch WITHOUT waiting: returns a resolver
+        () -> List[bool]. Lets a pipelined caller (peer CommitPipeline,
+        bench double-buffering) prep block N+1 on the single host core
+        while the accelerator chews block N."""
+        n = len(signatures)
+        prep, limbs = self.prep_bytes(keys, signatures, digests)
+        if prep is None:  # key-bucket overflow: limb-matrix path
+            out = self._dispatch_limbs(limbs)
+        else:
+            out = self._dispatch_bytes_or_fallback(prep)
+        return lambda: [bool(v) for v in np.asarray(out)[:n]]
+
+    _bytes_path_broken = False
+
+    def _dispatch_bytes_or_fallback(self, prep):
+        """The bytes kernel is the fast path but its compile can be
+        refused by the remote compile service; the limb-matrix kernel is
+        the always-works fallback (its cache entry ships with the repo's
+        .jax_cache). One hard failure disables the bytes path for the
+        process."""
+        if not self._bytes_path_broken:
+            try:
+                return self._dispatch_bytes(prep)
+            except Exception:  # noqa: BLE001 - compile/dispatch failure
+                type(self)._bytes_path_broken = True
+        e_bytes, r_bytes, s_bytes, kx, ky, idx, ok = prep
+        qx = np.ascontiguousarray(kx[:, idx])
+        qy = np.ascontiguousarray(ky[:, idx])
+        return self._dispatch_limbs(
+            (
+                be_bytes_to_limbs(e_bytes),
+                be_bytes_to_limbs(r_bytes),
+                be_bytes_to_limbs(s_bytes),
+                qx,
+                qy,
+                ok,
+            )
+        )
+
+    def _dedup_key_columns(self, keys: Sequence[ECDSAPublicKey]):
+        """One limb conversion + curve check per DISTINCT key object (the
+        MSP cache reuses key objects for repeated identities), plus the
+        per-lane column index. Shared by the bytes and limb paths."""
+        columns: Dict[int, int] = {}
+        kx_cols: List[np.ndarray] = []
+        ky_cols: List[np.ndarray] = []
+        on_curve_flags: List[bool] = []
+        idx = np.zeros(len(keys), dtype=np.int32)
+        for i, key in enumerate(keys):
+            col = columns.get(id(key))
+            if col is None:
+                kx, ky, on_curve = self._key_limbs(key)
+                col = len(kx_cols)
+                columns[id(key)] = col
+                kx_cols.append(kx)
+                ky_cols.append(ky)
+                on_curve_flags.append(on_curve)
+            idx[i] = col
+        return kx_cols, ky_cols, np.asarray(on_curve_flags, dtype=bool), idx
+
+    def prep_bytes(
+        self,
+        keys: Sequence[ECDSAPublicKey],
+        signatures: Sequence[bytes],
+        digests: Sequence[bytes],
+    ):
+        """Bytes-path host prep: DER parse + key-column dedup only; the
+        byte->limb unpack and the per-lane key gather happen on device
+        (p256_kernel.verify_batch_bytes_device). Returns None when the
+        distinct-key count exceeds KEY_BUCKET (caller pivots to the
+        limb-matrix path WITHOUT repeating this prep — see
+        batch_verify_async)."""
+        from fabric_tpu.utils import native
+
+        n = len(signatures)
+        r_bytes, s_bytes, ok_u8, low_s = native.batch_der_parse(signatures)
+        ok = (ok_u8 & low_s).astype(bool)
+        if any(len(d) != 32 for d in digests):
+            raise VerifyError("digests must be 32-byte SHA-256 outputs")
+        e_bytes = np.frombuffer(b"".join(digests), dtype=np.uint8).reshape(
+            n, 32
+        )
+        kx_cols, ky_cols, on_curve, idx = self._dedup_key_columns(keys)
+        if kx_cols:
+            ok &= on_curve[idx]
+        if len(kx_cols) > self.KEY_BUCKET:
+            # too many distinct keys for the fixed column bucket: hand the
+            # already-built columns to the limb-matrix path
+            qx = np.stack(kx_cols, axis=1)[:, idx]
+            qy = np.stack(ky_cols, axis=1)[:, idx]
+            return None, (
+                be_bytes_to_limbs(e_bytes),
+                be_bytes_to_limbs(r_bytes),
+                be_bytes_to_limbs(s_bytes),
+                qx,
+                qy,
+                ok,
+            )
+        k = self.KEY_BUCKET
+        kx_mat = np.zeros((bn.NLIMBS, k), dtype=np.uint32)
+        ky_mat = np.zeros((bn.NLIMBS, k), dtype=np.uint32)
+        if kx_cols:
+            kx_mat[:, : len(kx_cols)] = np.stack(kx_cols, axis=1)
+            ky_mat[:, : len(ky_cols)] = np.stack(ky_cols, axis=1)
+        return (e_bytes, r_bytes, s_bytes, kx_mat, ky_mat, idx, ok), None
+
+    def _dispatch_bytes(self, prep):
+        e_bytes, r_bytes, s_bytes, kx, ky, idx, ok = prep
+        n = ok.shape[0]
+        size = _bucket(n)
+        pad = size - n
+
+        def padded(a):
+            if pad == 0:
+                return a
+            widths = [(0, pad)] + [(0, 0)] * (a.ndim - 1)
+            return np.pad(a, widths)
+
+        return self._pk.verify_batch_bytes_jit(
+            padded(e_bytes),
+            padded(r_bytes),
+            padded(s_bytes),
+            kx,
+            ky,
+            padded(idx),
+            padded(ok.astype(bool)),
+        )
+
+    def _dispatch_limbs(self, limbs: Sequence[np.ndarray]):
+        n = limbs[-1].shape[0]
+        return self._pk.verify_batch_jit(*self.pad_limbs(limbs, _bucket(n)))
 
     def prep_limbs(
         self,
@@ -125,10 +259,10 @@ class TPUProvider(Provider):
         signatures: Sequence[bytes],
         digests: Sequence[bytes],
     ) -> Tuple[np.ndarray, ...]:
-        """Vectorized host prep shared by the single-chip and mesh paths:
-        the C++ batched DER parser (falls back to Python transparently)
-        emits fixed-width (r, s) words + validity masks; returns the
-        kernel-ready (e, r, s, qx, qy) (20, n) limb arrays + (n,) mask."""
+        """Vectorized host prep for the limb-matrix kernel (mesh and
+        multi-channel paths): DER parse, byte->limb conversion and the
+        deduped key-column gather, all on host. Returns the kernel-ready
+        (e, r, s, qx, qy) (20, n) limb arrays + (n,) mask."""
         from fabric_tpu.utils import native
 
         n = len(signatures)
@@ -141,18 +275,14 @@ class TPUProvider(Provider):
         e_bytes = np.frombuffer(b"".join(digests), dtype=np.uint8).reshape(
             n, 32
         )
-        qx = np.zeros((bn.NLIMBS, n), dtype=np.uint32)
-        qy = np.zeros((bn.NLIMBS, n), dtype=np.uint32)
-        # keys repeat heavily per block (few orgs); dedupe limb conversion
-        for i, key in enumerate(keys):
-            if not ok[i]:
-                continue
-            kx, ky, on_curve = self._key_limbs(key)
-            if not on_curve:
-                ok[i] = False
-                continue
-            qx[:, i] = kx
-            qy[:, i] = ky
+        kx_cols, ky_cols, on_curve, idx = self._dedup_key_columns(keys)
+        if kx_cols:
+            qx = np.stack(kx_cols, axis=1)[:, idx]
+            qy = np.stack(ky_cols, axis=1)[:, idx]
+            ok &= on_curve[idx]
+        else:
+            qx = np.zeros((bn.NLIMBS, n), dtype=np.uint32)
+            qy = np.zeros((bn.NLIMBS, n), dtype=np.uint32)
         return (
             be_bytes_to_limbs(e_bytes),
             be_bytes_to_limbs(r_bytes),
@@ -177,5 +307,5 @@ class TPUProvider(Provider):
 
     def _run_kernel(self, limbs: Sequence[np.ndarray]) -> List[bool]:
         n = limbs[-1].shape[0]
-        out = self._pk.verify_batch_jit(*self.pad_limbs(limbs, _bucket(n)))
+        out = self._dispatch_limbs(limbs)
         return list(np.asarray(out)[:n])

@@ -38,11 +38,12 @@ class PluginRegistry:
         "module.path:attribute"; the attribute (or module) becomes the
         registered plugin object."""
         import importlib
+        import inspect
 
         mod_name, _, attr = ref.partition(":")
         mod = importlib.import_module(mod_name)
         plugin = getattr(mod, attr) if attr else mod
-        if callable(plugin) and attr and attr[0].isupper():
+        if inspect.isclass(plugin):
             plugin = plugin()  # class reference: instantiate
         self.register(name, plugin)
         return plugin

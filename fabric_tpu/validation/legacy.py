@@ -70,13 +70,24 @@ def check_v12_writeset(rwset, invoked_namespace: str) -> Optional[str]:
         ns = ns_rw.namespace
         if ns == "lscc":
             if invoked_namespace != "lscc":
-                if ns_rw.writes:
+                if ns_rw.writes or ns_rw.metadata_writes:
                     return (
                         "chaincode is not lscc but writes to the lscc "
                         "namespace"
                     )
-            elif len(ns_rw.writes) > 1:
-                return "lscc deploy must write exactly one key"
+            else:
+                if len(ns_rw.writes) > 1:
+                    return "lscc deploy must write exactly one key"
+                # the reference additionally pins the single key to the
+                # deployed chaincode's name (validateDeployRWSetAndCollection);
+                # the invoke args are not threaded here, so pin what we
+                # can: the key must not shadow a system chaincode record
+                for w in ns_rw.writes:
+                    if w.key in SYSTEM_NAMESPACES:
+                        return (
+                            f"lscc deploy may not overwrite system "
+                            f"chaincode {w.key}"
+                        )
         elif ns in SYSTEM_NAMESPACES and ns != invoked_namespace:
             if ns_rw.writes or ns_rw.metadata_writes:
                 return f"writes to system namespace {ns} are not allowed"

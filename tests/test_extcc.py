@@ -262,7 +262,7 @@ def test_external_builder_contract(tmp_path, listener_server):
             repo + os.pathsep + env.get("PYTHONPATH", "")
         )
         launcher.launch(installed, addr)
-        assert listener.wait_for(installed.package_id, timeout=15)
+        assert listener.wait_for(installed.package_id, timeout=90)
         db = VersionedDB()
         sim = TxSimulator(db, "tx1")
         cc = listener.chaincode(installed.package_id)

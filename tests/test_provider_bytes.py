@@ -1,0 +1,67 @@
+"""TPUProvider bytes-path (device-side unpack + key gather) differential
+vs the software oracle, including the distinct-key-bucket fallback."""
+
+import hashlib
+
+import pytest
+
+from fabric_tpu.crypto import p256
+from fabric_tpu.crypto.bccsp import ECDSAPublicKey, SoftwareProvider, VerifyError
+from fabric_tpu.crypto.der import marshal_signature
+from fabric_tpu.crypto.tpu_provider import TPUProvider
+
+SW = SoftwareProvider()
+
+
+def _cases(n, num_keys):
+    keys = []
+    for k in range(num_keys):
+        priv = (k * 0x9E3779B97F4A7C15 + 77) % (p256.N - 1) + 1
+        pub = p256.scalar_mult(priv, p256.GENERATOR)
+        keys.append((priv, ECDSAPublicKey(pub[0], pub[1])))
+    out = []
+    for i in range(n):
+        priv, key = keys[i % num_keys]
+        digest = hashlib.sha256(f"bytes {i}".encode()).digest()
+        kk = (i * 0xD6E8FEB86659FD93 + 3) % (p256.N - 1) + 1
+        r, s = p256.sign_digest(priv, digest, k=kk)
+        kind = i % 4
+        if kind == 1:
+            digest = hashlib.sha256(b"other").digest()
+        elif kind == 2:
+            sig = b"\x30\x01\x00"
+            out.append((key, sig, digest))
+            continue
+        elif kind == 3:
+            s = p256.N - s  # high-S
+        out.append((key, marshal_signature(r, s), digest))
+    return out
+
+
+@pytest.mark.parametrize("num_keys", [5, 40])  # 40 > KEY_BUCKET: fallback
+def test_bytes_path_matches_software(num_keys):
+    cases = _cases(48, num_keys)
+    expected = []
+    for key, sig, dig in cases:
+        try:
+            expected.append(SW.verify(key, sig, dig))
+        except VerifyError:
+            expected.append(False)
+    prov = TPUProvider()
+    got = prov.batch_verify(
+        [c[0] for c in cases], [c[1] for c in cases], [c[2] for c in cases]
+    )
+    assert got == expected
+    assert any(expected) and not all(expected)
+
+
+def test_async_resolver_order():
+    cases = _cases(40, 4)
+    prov = TPUProvider()
+    r1 = prov.batch_verify_async(
+        [c[0] for c in cases], [c[1] for c in cases], [c[2] for c in cases]
+    )
+    r2 = prov.batch_verify_async(
+        [c[0] for c in cases], [c[1] for c in cases], [c[2] for c in cases]
+    )
+    assert r1() == r2()
