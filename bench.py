@@ -304,10 +304,12 @@ def bench_idemix(n_sigs=8):
 
 
 def bench_mvcc(n_txs=5000):
-    """Config #4: MVCC validate-and-prepare over a 5k-tx block
-    (reference validateAndPrepareBatch, validation/validator.go:82)."""
+    """Config #4: MVCC validate-and-prepare over a 5k-tx block, host
+    sequential scan vs the device fixpoint resolver (reference
+    validateAndPrepareBatch, validation/validator.go:82; SURVEY P5)."""
     from fabric_tpu.ledger import rwset as rw
     from fabric_tpu.ledger.mvcc import Validator
+    from fabric_tpu.ledger.mvcc_device import DeviceValidator
     from fabric_tpu.ledger.statedb import UpdateBatch, VersionedDB
     from fabric_tpu.validation.txflags import TxValidationCode
 
@@ -336,19 +338,34 @@ def bench_mvcc(n_txs=5000):
             )
         )
     incoming = [TxValidationCode.VALID] * n_txs
-    start = time.perf_counter()
-    codes, _updates, _hashed = Validator(db).validate_and_prepare_batch(
-        1, rwsets, incoming
-    )
-    ms = (time.perf_counter() - start) * 1000.0
-    n_conflicts = sum(
-        1 for c in codes if c == TxValidationCode.MVCC_READ_CONFLICT
-    )
-    if n_conflicts != n_txs // 10:
-        raise RuntimeError(
-            f"config #4 expected {n_txs // 10} conflicts, got {n_conflicts}"
+
+    def run(validator):
+        start = time.perf_counter()
+        codes, _updates, _hashed = validator.validate_and_prepare_batch(
+            1, rwsets, list(incoming)
         )
-    return {"txs": n_txs, "host_ms_per_block": round(ms, 1)}
+        ms = (time.perf_counter() - start) * 1000.0
+        n_conflicts = sum(
+            1 for c in codes if c == TxValidationCode.MVCC_READ_CONFLICT
+        )
+        if n_conflicts != n_txs // 10:
+            raise RuntimeError(
+                f"config #4 expected {n_txs // 10} conflicts, got {n_conflicts}"
+            )
+        return ms, codes
+
+    host_ms, host_codes = run(Validator(db))
+    dev = DeviceValidator(db)
+    run(dev)  # compile warmup
+    dev_ms, dev_codes = run(dev)
+    if dev.last_path != "device" or dev_codes != host_codes:
+        raise RuntimeError("config #4 device path mismatch")
+    return {
+        "txs": n_txs,
+        "host_ms_per_block": round(host_ms, 1),
+        "device_ms_per_block": round(dev_ms, 1),
+        "speedup": round(host_ms / dev_ms, 2),
+    }
 
 
 def bench_multichannel(net, n_channels=4, txs_per_channel=2000):

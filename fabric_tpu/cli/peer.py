@@ -88,6 +88,8 @@ def _load_node(config_path: str) -> PeerNode:
         listen_address=pc.get("listenAddress", "127.0.0.1:0"),
         ops_address=ops,
         provider=provider,
+        # ledger.deviceMVCC: resolve MVCC on device (SURVEY P5)
+        device_mvcc=bool((cfg.get("ledger") or {}).get("deviceMVCC")),
     )
     # External-builder analog (core/container/externalbuilder): user
     # chaincode loads as python modules, "module.path:ClassName", with
@@ -105,7 +107,15 @@ def _load_node(config_path: str) -> PeerNode:
         block = common_pb2.Block()
         with open(path, "rb") as f:
             block.ParseFromString(f.read())
-        node.join_channel(block)
+        try:
+            node.join_channel(block)
+        except ValueError as exc:
+            if "paused" in str(exc):
+                # pause semantics (kvledger pause_resume.go): the peer
+                # starts with the paused channel skipped, not down
+                logger.warning("skipping paused channel: %s", exc)
+                continue
+            raise
     return node, pc
 
 
@@ -246,9 +256,20 @@ def channel_cmd(args) -> int:
             print(f"wrote channel genesis block {out_path}")
         return rc
     if args.cmd == "fetch":
-        conn = channel_to(args.orderer)
+        # like the reference: with -o fetch from the orderer, otherwise
+        # from the peer's own deliver service (CORE_PEER_ADDRESS,
+        # usable-inter-nal/peer/channel/fetch.go)
+        if args.orderer:
+            conn, service = channel_to(args.orderer), "orderer.AtomicBroadcast"
+        elif args.peerAddress:
+            conn, service = channel_to(args.peerAddress), "protos.Deliver"
+        else:
+            print("fetch needs --orderer or --peerAddress", file=sys.stderr)
+            return 2
         number = 0 if args.block == "oldest" else int(args.block)
-        rc = _fetch_block(conn, signer, args.channelID, number, args.output)
+        rc = _fetch_block(
+            conn, signer, args.channelID, number, args.output, service
+        )
         conn.close()
         if rc == 0:
             print(f"wrote block {args.output}")
@@ -256,12 +277,15 @@ def channel_cmd(args) -> int:
     return 2
 
 
-def _fetch_block(conn, signer, channel_id, number, out_path) -> int:
+def _fetch_block(
+    conn, signer, channel_id, number, out_path,
+    service: str = "orderer.AtomicBroadcast",
+) -> int:
     from fabric_tpu.comm.services import deliver_stream
     from fabric_tpu.deliver.client import seek_envelope
 
     env = seek_envelope(channel_id, start=number, stop=number, signer=signer)
-    for resp in deliver_stream(conn, env):
+    for resp in deliver_stream(conn, env, service=service):
         kind = resp.WhichOneof("Type")
         if kind == "block":
             with open(out_path, "wb") as f:
@@ -344,6 +368,88 @@ def lifecycle_cmd(args) -> int:
     return 2
 
 
+def node_admin_cmd(args) -> int:
+    """Offline ledger administration (reference usable-inter-nal/peer/
+    node pause/resume/rollback/reset/rebuild-dbs): run while the peer
+    process is DOWN; the reference enforces that with a file lock, here
+    it is the operator's contract."""
+    import os
+
+    import yaml as _yaml
+
+    from fabric_tpu.ledger.kvledger import KVLedger
+
+    with open(args.config) as f:
+        cfg = _yaml.safe_load(f) or {}
+    fs_path = (cfg.get("peer") or {}).get("fileSystemPath", "peer-data")
+
+    def channel_dirs():
+        if not os.path.isdir(fs_path):
+            return []
+        return sorted(
+            name
+            for name in os.listdir(fs_path)
+            if os.path.exists(os.path.join(fs_path, name, f"{name}.chain"))
+        )
+
+    if args.cmd == "pause":
+        chan_dir = os.path.join(fs_path, args.channelID)
+        os.makedirs(chan_dir, exist_ok=True)
+        with open(os.path.join(chan_dir, "PAUSED"), "w") as f:
+            f.write("paused\n")
+        print(f"channel {args.channelID} paused")
+        return 0
+    if args.cmd == "resume":
+        marker = os.path.join(fs_path, args.channelID, "PAUSED")
+        if os.path.exists(marker):
+            os.remove(marker)
+        print(f"channel {args.channelID} resumed")
+        return 0
+    if args.cmd == "rollback":
+        ledger = KVLedger(
+            os.path.join(fs_path, args.channelID), args.channelID
+        )
+        try:
+            ledger.rollback(args.blockNumber)
+        except ValueError as exc:
+            print(f"rollback failed: {exc}", file=sys.stderr)
+            return 1
+        finally:
+            ledger.close()
+        print(
+            f"channel {args.channelID} rolled back to block "
+            f"{args.blockNumber}"
+        )
+        return 0
+    if args.cmd == "rebuild-dbs":
+        ledger = KVLedger(
+            os.path.join(fs_path, args.channelID), args.channelID
+        )
+        try:
+            ledger.rebuild_dbs()
+        except ValueError as exc:
+            print(f"rebuild-dbs failed: {exc}", file=sys.stderr)
+            return 1
+        finally:
+            ledger.close()
+        print(f"channel {args.channelID} state/history rebuilt")
+        return 0
+    if args.cmd == "reset":
+        # reset.go: every channel back to its genesis block
+        for channel_id in channel_dirs():
+            ledger = KVLedger(os.path.join(fs_path, channel_id), channel_id)
+            try:
+                ledger.rollback(0)
+            except ValueError as exc:
+                print(f"reset {channel_id} failed: {exc}", file=sys.stderr)
+                return 1
+            finally:
+                ledger.close()
+            print(f"channel {channel_id} reset to genesis")
+        return 0
+    return 2
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="peer")
     sub = parser.add_subparsers(dest="group", required=True)
@@ -352,6 +458,16 @@ def main(argv=None) -> int:
     node_sub = node.add_subparsers(dest="cmd", required=True)
     st = node_sub.add_parser("start")
     st.add_argument("--config", required=True)
+    # offline ledger admin (reference usable-inter-nal/peer/node:
+    # pause.go resume.go rollback.go reset.go rebuilddbs.go)
+    for name in ("pause", "resume", "rollback", "rebuild-dbs"):
+        p = node_sub.add_parser(name)
+        p.add_argument("--config", required=True)
+        p.add_argument("-c", "--channelID", required=True)
+        if name == "rollback":
+            p.add_argument("-b", "--blockNumber", type=int, required=True)
+    rs = node_sub.add_parser("reset")
+    rs.add_argument("--config", required=True)
 
     cc = sub.add_parser("chaincode")
     cc_sub = cc.add_subparsers(dest="cmd", required=True)
@@ -380,7 +496,7 @@ def main(argv=None) -> int:
     cf = chan_sub.add_parser("fetch")
     cf.add_argument("block", help="oldest | <number>")
     cf.add_argument("output")
-    cf.add_argument("-o", "--orderer", required=True)
+    cf.add_argument("-o", "--orderer", default="")
     cf.add_argument("-c", "--channelID", required=True)
     for p in (cj, cl):
         p.add_argument("--peerAddress", required=True)
@@ -414,6 +530,8 @@ def main(argv=None) -> int:
     if args.group == "node" and args.cmd == "start":
         node_start(args.config)
         return 0
+    if args.group == "node":
+        return node_admin_cmd(args)
     if args.group == "chaincode":
         return chaincode_cmd(args)
     if args.group == "channel":

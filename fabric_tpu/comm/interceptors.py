@@ -57,18 +57,53 @@ def _wrap_handler(handler, around):
 
 class LoggingInterceptor(grpc.ServerInterceptor):
     """grpclogging analog: one log line per completed RPC with service,
-    method, duration and outcome."""
+    method, duration and outcome.
 
-    def __init__(self, logger=None):
+    Payload logging (grpclogging/server.go payloadLogger): when the
+    `comm.grpc.payload` logger is at DEBUG — via the /logspec flogging
+    spec, like the reference's `grpc.payload=debug` — every request and
+    response message is logged with its type and serialized size."""
+
+    PAYLOAD_LOGGER = "comm.grpc.payload"
+
+    def __init__(self, logger=None, payload_logger=None):
         self.logger = logger or flogging.must_get_logger("comm.grpc")
+        self.payload_logger = payload_logger or flogging.must_get_logger(
+            self.PAYLOAD_LOGGER
+        )
+
+    def _log_payload(self, service, method, direction, msg) -> None:
+        plog = self.payload_logger
+        if not plog.isEnabledFor(10):  # logging.DEBUG
+            return
+        try:
+            size = len(msg.SerializeToString())
+        except Exception:  # noqa: BLE001 - non-proto payloads
+            size = -1
+        plog.debug(
+            "payload %s grpc.service=%s grpc.method=%s type=%s bytes=%d",
+            direction,
+            service,
+            method,
+            type(msg).__name__,
+            size,
+        )
+
+    def _tap(self, service, method, direction, iterator):
+        for msg in iterator:
+            self._log_payload(service, method, direction, msg)
+            yield msg
 
     def intercept_service(self, continuation, handler_call_details):
         handler = continuation(handler_call_details)
         service, method = _split_method(handler_call_details.method)
         logger = self.logger
+        log_payload = self._log_payload
+        tap = self._tap
 
         def around(behavior, kind):
             streaming_resp = kind.endswith("_stream")
+            streaming_req = kind.startswith("stream")
             shape = "streaming" if "stream" in kind else "unary"
 
             def log(start, outcome):
@@ -82,11 +117,18 @@ class LoggingInterceptor(grpc.ServerInterceptor):
                     (time.perf_counter() - start) * 1000,
                 )
 
+            def observe_request(request_or_iterator):
+                if streaming_req:
+                    return tap(service, method, "recv", request_or_iterator)
+                log_payload(service, method, "recv", request_or_iterator)
+                return request_or_iterator
+
             def unary(request_or_iterator, context):
                 start = time.perf_counter()
                 outcome = "failed"
                 try:
-                    out = behavior(request_or_iterator, context)
+                    out = behavior(observe_request(request_or_iterator), context)
+                    log_payload(service, method, "send", out)
                     outcome = "completed"
                     return out
                 finally:
@@ -96,7 +138,12 @@ class LoggingInterceptor(grpc.ServerInterceptor):
                 start = time.perf_counter()
                 outcome = "failed"
                 try:
-                    yield from behavior(request_or_iterator, context)
+                    yield from tap(
+                        service,
+                        method,
+                        "send",
+                        behavior(observe_request(request_or_iterator), context),
+                    )
                     outcome = "completed"
                 except GeneratorExit:
                     outcome = "cancelled"

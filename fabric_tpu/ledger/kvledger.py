@@ -167,9 +167,13 @@ class KVLedger:
         channel_id: str,
         btl_policy=None,
         persistent: bool = True,
+        device_mvcc: bool = False,
     ):
         self.channel_id = channel_id
         self.persistent = persistent
+        # SURVEY P5: resolve block-internal MVCC invalidation chains on
+        # device (mvcc_device.DeviceValidator) instead of the Python scan
+        self.device_mvcc = device_mvcc
         self.block_store = BlockStore(os.path.join(ledger_dir, f"{channel_id}.chain"))
         self.pvt_store = PvtDataStore(
             os.path.join(ledger_dir, f"{channel_id}.pvtdata"),
@@ -280,7 +284,12 @@ class KVLedger:
         if rwsets is None:
             rwsets = self._extract_rwsets(block)
         incoming = [TxValidationCode(int(c)) for c in flags.asarray()]
-        validator = Validator(self.state_db)
+        if self.device_mvcc:
+            from fabric_tpu.ledger.mvcc_device import DeviceValidator
+
+            validator = DeviceValidator(self.state_db)
+        else:
+            validator = Validator(self.state_db)
         codes, updates, hashed = validator.validate_and_prepare_batch(
             block.header.number, rwsets, incoming
         )
@@ -548,6 +557,15 @@ class KVLedger:
         # persistence (last_committed guard) and replay stale records
         self.pvt_store.rollback_to(target_block + 1)
         self.rebuild_dbs()
+
+    def close(self) -> None:
+        """Release file handles/connections (ledgermgmt.Close): required
+        before another process (or the offline admin CLI) opens the same
+        ledger directory."""
+        self.block_store.close()
+        self.pvt_store.close()
+        if self.persistent:
+            self.state_db.close()
 
     # -- queries (qscc analog) --------------------------------------------
     @property

@@ -14,11 +14,11 @@ core/ledger/kvledger/txmgmt/validation/validator.go:82-281 exactly:
 - hashed (private-collection) reads check like public reads ->
   MVCC_READ_CONFLICT.
 
-This module is the oracle and the fallback; a device-accelerated probe
-path for the no-range-query common case is planned (SURVEY.md §7 Stage 3).
-Merkle-summarized range queries (rangequery_validator.go hash variant) are
-not implemented yet: they raise UnsupportedRangeQueryError loudly instead
-of mis-validating.
+This module is the oracle and the fallback; the device fixpoint path for
+the no-range-query common case lives in mvcc_device.py (SURVEY P5).
+Merkle-summarized range queries (rangequery_validator.go hash variant)
+re-execute through the same results helper as simulation and compare
+summaries incrementally (_validate_merkle_range_query below).
 """
 
 from __future__ import annotations
@@ -103,8 +103,8 @@ def _combined_range_iter(
 
 
 class UnsupportedRangeQueryError(NotImplementedError):
-    """Raised for merkle-summarized range queries (not yet supported) so the
-    caller halts instead of emitting a wrong validation code."""
+    """Kept for API compatibility; no longer raised (the Merkle variant is
+    implemented below)."""
 
 
 class Validator:
@@ -173,21 +173,48 @@ class Validator:
     def _validate_range_query(
         self, ns: str, rqi: RangeQueryInfo, updates: UpdateBatch
     ) -> bool:
-        if rqi.reads_merkle_hashes is not None:
-            raise UnsupportedRangeQueryError(
-                "merkle-summarized range query validation not implemented"
-            )
         # ItrExhausted=false: EndKey is the last key actually seen, so the
         # re-execution must include it (validator.go validateRangeQuery).
         include_end = not rqi.itr_exhausted
         actual = _combined_range_iter(
             self.db, updates, ns, rqi.start_key, rqi.end_key, include_end
         )
+        if rqi.reads_merkle_hashes is not None:
+            return self._validate_merkle_range_query(rqi, actual)
         for expected in rqi.raw_reads:
             got = next(actual, None)
             if got is None or got[0] != expected.key or not versions_same(got[1], expected.version):
                 return False
         return next(actual, None) is None
+
+    @staticmethod
+    def _validate_merkle_range_query(rqi: RangeQueryInfo, actual) -> bool:
+        """Re-execute the range and rebuild the Merkle summary with the
+        recorded max_degree, comparing max-level hashes as they finalize
+        so a mismatch in the early results exits before hashing the rest
+        (rangequery_validator.go rangeQueryHashValidator.validate)."""
+        from fabric_tpu.ledger.merkle import RangeQueryResultsHelper
+
+        in_degree, in_level, in_hashes = rqi.reads_merkle_hashes
+        helper = RangeQueryResultsHelper(True, in_degree)
+        last_matched = -1
+        for key, version in actual:
+            helper.add_result(KVRead(key, version))
+            _deg, level, hashes = helper.merkle_summary()
+            if level < in_level:
+                continue  # still under construction, nothing to compare
+            # >= (not ==): a level spill can shrink the in-construction
+            # list below entries we already matched; defer to the final
+            # post-done() comparison instead of indexing past it
+            if last_matched >= len(hashes) - 1:
+                continue
+            if len(hashes) > len(in_hashes):
+                return False  # more entries than simulation recorded
+            last_matched += 1
+            if hashes[last_matched] != in_hashes[last_matched]:
+                return False
+        _raw, summary = helper.done()
+        return summary == rqi.reads_merkle_hashes
 
     # -- write application (tx_ops.go prepareTxOps + applyWriteSet) -------
     # keyOps flags mirroring tx_ops.go:160-167

@@ -42,14 +42,16 @@ class KVWrite:
 @dataclass(frozen=True)
 class RangeQueryInfo:
     """Phantom-read check payload. raw_reads is the observed result list;
-    reads_merkle_hashes (level, hashes) is the space-saving alternative the
-    reference uses for big result sets."""
+    reads_merkle_hashes (max_degree, max_level, max_level_hashes) is the
+    space-saving Merkle summary the reference uses for big result sets
+    (kvrwset.QueryReadsMerkleSummary, built by
+    rwsetutil/query_results_helper.go)."""
 
     start_key: str
     end_key: str
     itr_exhausted: bool
     raw_reads: Tuple[KVRead, ...] = ()
-    reads_merkle_hashes: Optional[Tuple[int, Tuple[bytes, ...]]] = None
+    reads_merkle_hashes: Optional[Tuple[int, int, Tuple[bytes, ...]]] = None
 
 
 @dataclass(frozen=True)

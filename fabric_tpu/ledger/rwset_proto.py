@@ -29,9 +29,10 @@ def serialize_tx_rwset(txrw: rw.TxRwSet) -> bytes:
             rq.end_key = q.end_key
             rq.itr_exhausted = q.itr_exhausted
             if q.reads_merkle_hashes is not None:
-                rq.reads_merkle_hashes.max_level = q.reads_merkle_hashes[0]
+                rq.reads_merkle_hashes.max_degree = q.reads_merkle_hashes[0]
+                rq.reads_merkle_hashes.max_level = q.reads_merkle_hashes[1]
                 rq.reads_merkle_hashes.max_level_hashes.extend(
-                    q.reads_merkle_hashes[1]
+                    q.reads_merkle_hashes[2]
                 )
             else:
                 rq.raw_reads.SetInParent()

@@ -92,10 +92,13 @@ class TxSimulator:
         state_db: VersionedDB,
         tx_id: str = "",
         pvt_reader=None,  # callable (ns, coll, key) -> Optional[bytes]
+        range_query_hashing_max_degree: int = 50,  # ledger config
+        # MaxDegreeQueryReadsHashing default; 0 disables summarization
     ):
         self._db = state_db
         self.tx_id = tx_id
         self._pvt_reader = pvt_reader
+        self._rq_max_degree = range_query_hashing_max_degree
         self._done = False
         # ns -> key -> KVRead (first read wins, like the reference builder)
         self._reads: Dict[str, Dict[str, rw.KVRead]] = {}
@@ -153,17 +156,23 @@ class TxSimulator:
         itr_exhausted=True, matching a chaincode that drains the iterator;
         partial consumption would need the lazy form."""
         self._check_open()
-        raw_reads: List[rw.KVRead] = []
+        from fabric_tpu.ledger.merkle import RangeQueryResultsHelper
+
+        helper = RangeQueryResultsHelper(
+            self._rq_max_degree > 0, max(self._rq_max_degree, 2)
+        )
         results: List[Tuple[str, bytes]] = []
         for key, vv in self._db.get_state_range(ns, start_key, end_key, False):
-            raw_reads.append(rw.KVRead(key, vv.version))
+            helper.add_result(rw.KVRead(key, vv.version))
             results.append((key, vv.value))
+        raw_reads, summary = helper.done()
         self._range_queries.setdefault(ns, []).append(
             rw.RangeQueryInfo(
                 start_key=start_key,
                 end_key=end_key,
                 itr_exhausted=True,
-                raw_reads=tuple(raw_reads),
+                raw_reads=raw_reads,
+                reads_merkle_hashes=summary,
             )
         )
         return iter(results)

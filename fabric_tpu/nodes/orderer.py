@@ -62,6 +62,7 @@ class OrdererNode:
             provider=provider,
             raft_node_id=raft_node_id,
             raft_transport_factory=self.cluster_client.transport_factory,
+            follower_endpoint_factory=self._follower_endpoints,
         )
         self.broadcast = BroadcastHandler(
             self.registrar, signer=signer, cluster_client=self.cluster_client
@@ -102,10 +103,49 @@ class OrdererNode:
         with cond:
             cond.notify_all()
 
+    def _follower_endpoints(self, addresses):
+        """addresses -> deliver-endpoint callables for FollowerChain block
+        pulling (cluster.BlockPuller analog over fellow orderers'
+        AtomicBroadcast/Deliver)."""
+        from fabric_tpu.comm.server import channel_to
+        from fabric_tpu.comm.services import deliver_stream
+
+        import grpc
+
+        def make(addr):
+            def endpoint(env):
+                conn = channel_to(addr)
+                try:
+                    yield from deliver_stream(conn, env)
+                except grpc.RpcError as e:
+                    # surface as the deliver client's retryable error
+                    # class so backoff/failover applies (and a server
+                    # shutdown doesn't kill the follower thread)
+                    raise ConnectionError(f"deliver rpc failed: {e.code()}")
+                finally:
+                    conn.close()
+
+            return endpoint
+
+        return [make(a) for a in addresses]
+
     def _block_source(self, channel_id: str) -> Optional[BlockSource]:
         support = self.registrar.get_chain(channel_id)
         if support is None:
-            return None
+            # followers serve deliver too (participation-API semantics):
+            # readers can tail a replicating channel
+            follower = self.registrar.followers.get(channel_id)
+            if follower is None:
+                return None
+
+            def wait_poll(number: int, timeout: float) -> bool:
+                deadline = 0.2 if timeout is None else min(timeout, 0.2)
+                threading.Event().wait(deadline)
+                return follower.height > number
+
+            return BlockSource(
+                follower.get_block, lambda: follower.height, wait_poll
+            )
         cond = self._cond(channel_id)
 
         def wait_for(number: int, timeout: float) -> bool:
@@ -192,6 +232,8 @@ class OrdererNode:
     def stop(self) -> None:
         if getattr(self, "_stopped", None) is not None:
             self._stopped.set()
+        for follower in list(self.registrar.followers.values()):
+            follower.stop()
         self.cluster_client.stop()
         self.server.stop()
         if self.ops is not None:

@@ -42,11 +42,13 @@ class PeerNode:
         ops_address: Optional[str] = None,
         provider=None,
         external_builders=None,
+        device_mvcc: bool = False,
     ):
         self.work_dir = work_dir
         self.msp_manager = msp_manager
         self.signer = signer
         self.provider = provider
+        self.device_mvcc = device_mvcc
         self._registry_factory = registry_factory
         self.channels: Dict[str, Channel] = {}
         self.transient = TransientStore()
@@ -340,6 +342,10 @@ class PeerNode:
         channel_id = chdr.channel_id
         if channel_id in self.channels:
             raise ValueError(f"channel {channel_id} already joined")
+        if os.path.exists(os.path.join(self.work_dir, channel_id, "PAUSED")):
+            # peer node pause marker (reference kvledger pause_resume.go:
+            # a paused channel's ledger is not opened and no deliver runs)
+            raise ValueError(f"channel {channel_id} is paused")
         ch = Channel(
             channel_id,
             os.path.join(self.work_dir, channel_id),
@@ -348,6 +354,7 @@ class PeerNode:
             self.provider,
             transient_store=self.transient,
             metrics=self.committer_metrics,
+            device_mvcc=self.device_mvcc,
         )
         if ch.ledger.height == 0:
             ch.ledger.commit(genesis_block)

@@ -1,0 +1,93 @@
+"""Process profiling for the operations server — the Python analog of
+the reference's Go pprof service (orderer/common/server/main.go:458,
+peer `node start` profile listener).
+
+Go pprof's value is (a) sampled CPU profiles and (b) goroutine dumps.
+The analogs here:
+
+- cpu_profile(seconds): statistical sampler over sys._current_frames()
+  across ALL threads, reported as collapsed stacks ("frame;frame;... N")
+  — the flamegraph input format, aggregated by identical stack.
+- thread_dump(): every live thread's current stack (goroutine profile).
+- heap_profile(): tracemalloc top allocation sites; tracing starts on
+  first request (like pprof heap profiling being opt-in) so the first
+  call returns a short note and subsequent calls return data.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+import traceback
+from collections import Counter
+
+
+def thread_dump() -> str:
+    frames = sys._current_frames()
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = []
+    for ident, frame in sorted(frames.items()):
+        out.append(f"thread {ident} [{names.get(ident, '?')}]:")
+        out.extend(
+            line.rstrip()
+            for line in traceback.format_stack(frame)
+        )
+        out.append("")
+    return "\n".join(out)
+
+
+def _collapse(frame) -> str:
+    parts = []
+    stack = traceback.extract_stack(frame)
+    for fs in stack:
+        parts.append(f"{fs.name}@{fs.filename.rsplit('/', 1)[-1]}:{fs.lineno}")
+    return ";".join(parts)
+
+
+def cpu_profile(seconds: float = 2.0, hz: int = 100) -> str:
+    """Sample all threads for `seconds`, emit collapsed-stack lines
+    sorted by sample count (flamegraph.pl / speedscope compatible)."""
+    seconds = max(0.1, min(seconds, 30.0))
+    interval = 1.0 / max(1, min(hz, 1000))
+    me = threading.get_ident()
+    counts: Counter = Counter()
+    samples = 0
+    deadline = time.monotonic() + seconds
+    while time.monotonic() < deadline:
+        for ident, frame in sys._current_frames().items():
+            if ident == me:
+                continue
+            counts[_collapse(frame)] += 1
+        samples += 1
+        time.sleep(interval)
+    lines = [
+        f"# cpu profile: {samples} sampling passes over {seconds:.1f}s "
+        f"({len(counts)} distinct stacks)"
+    ]
+    for stack, n in counts.most_common():
+        lines.append(f"{stack} {n}")
+    return "\n".join(lines) + "\n"
+
+
+_heap_started = False
+
+
+def heap_profile(top: int = 40) -> str:
+    global _heap_started
+    import tracemalloc
+
+    if not tracemalloc.is_tracing():
+        tracemalloc.start()
+        _heap_started = True
+        return (
+            "# tracemalloc started; allocations are now being traced — "
+            "re-request this endpoint to see a snapshot\n"
+        )
+    snap = tracemalloc.take_snapshot()
+    stats = snap.statistics("lineno")[:top]
+    total = sum(s.size for s in snap.statistics("filename"))
+    lines = [f"# heap: {total / 1024:.1f} KiB traced, top {len(stats)} sites"]
+    for s in stats:
+        lines.append(f"{s.traceback} size={s.size} count={s.count}")
+    return "\n".join(lines) + "\n"

@@ -45,6 +45,9 @@ class Options:
     tls_cert_file: Optional[str] = None
     tls_key_file: Optional[str] = None
     client_ca_file: Optional[str] = None  # set -> client certs REQUIRED
+    # profiling endpoints (reference General.Profile.Enabled pprof
+    # service, orderer/common/server/main.go:458; gated off by default)
+    profile_enabled: bool = False
 
 
 class System:
@@ -153,8 +156,49 @@ class System:
                         {"Version": system.options.version}
                     ).encode()
                     self._reply(200, body, "application/json")
+                elif self.path.startswith("/debug/pprof"):
+                    self._pprof()
                 else:
                     self._reply(404, b"not found", "text/plain")
+
+            def _pprof(self):
+                """Go-pprof analog endpoints (main.go:458 Profile service):
+                profile (sampled CPU), goroutine (thread dump), heap."""
+                if not system.options.profile_enabled:
+                    self._reply(
+                        404, b"profiling is not enabled", "text/plain"
+                    )
+                    return
+                from urllib.parse import parse_qs, urlparse
+
+                from fabric_tpu.operations import pprof
+
+                parsed = urlparse(self.path)
+                name = parsed.path[len("/debug/pprof") :].strip("/")
+                if name == "profile":
+                    q = parse_qs(parsed.query)
+                    try:
+                        seconds = float(q.get("seconds", ["2"])[0])
+                    except ValueError:
+                        self._reply(
+                            400, b"seconds must be a number", "text/plain"
+                        )
+                        return
+                    self._reply(
+                        200, pprof.cpu_profile(seconds).encode(), "text/plain"
+                    )
+                elif name in ("goroutine", "threads"):
+                    self._reply(200, pprof.thread_dump().encode(), "text/plain")
+                elif name == "heap":
+                    self._reply(200, pprof.heap_profile().encode(), "text/plain")
+                elif name == "":
+                    self._reply(
+                        200,
+                        b"profiles: profile?seconds=N goroutine heap\n",
+                        "text/plain",
+                    )
+                else:
+                    self._reply(404, b"unknown profile", "text/plain")
 
             def do_PUT(self):
                 if self.path != "/logspec":

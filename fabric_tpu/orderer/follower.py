@@ -1,0 +1,208 @@
+"""Follower chain: onboarding an orderer into a channel it does not yet
+consent on (reference orderer/common/follower/follower_chain.go +
+orderer/common/onboarding).
+
+A follower runs when this node joins a channel where it is NOT in the
+consenter set, or joins with a non-genesis join block (so the local
+ledger must first be replicated from the cluster).  It:
+
+- pulls blocks from the channel's consenters with the deliver-client
+  failure discipline (backoff + endpoint failover), verifying hash-chain
+  linkage as it appends;
+- re-derives the channel bundle at every config block and watches the
+  consenter set;
+- once this node IS a consenter and the ledger has reached the join
+  block, halts pulling and invokes the promotion callback so the
+  registrar restarts the channel as a full raft member
+  (follower_chain.go run -> checkMembership -> halt + chain re-create).
+
+The block store path is the one RaftChain would use, so promotion is a
+pure restart: the raft chain opens the same ledger at the same height.
+
+Node identity follows this codebase's convention: raft node id == the
+1-based index into the consensus-metadata consenter list (see
+nodes/orderer.py _refresh_cluster_endpoints); membership is therefore
+node_id <= len(consenters).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Callable, List, Optional, Sequence
+
+from fabric_tpu.deliver.client import BlockDeliverer
+from fabric_tpu.ledger.blockstore import BlockStore
+from fabric_tpu.orderer.raft_chain import _is_config_block
+from fabric_tpu.protos import common_pb2, configuration_pb2, protoutil
+
+# status / consensus-relation strings mirror the channel-participation
+# API (orderer/common/types/channel_info.go)
+STATUS_ONBOARDING = "onboarding"
+STATUS_ACTIVE = "active"
+RELATION_FOLLOWER = "follower"
+RELATION_CONSENTER = "consenter"
+
+
+def consenter_addresses(bundle) -> List[str]:
+    """host:port list from the bundle's etcdraft consensus metadata."""
+    if bundle.orderer is None or bundle.orderer.consensus_type != "etcdraft":
+        return []
+    try:
+        meta = protoutil.unmarshal(
+            configuration_pb2.RaftConfigMetadata,
+            bundle.orderer.consensus_metadata,
+        )
+    except ValueError:
+        return []
+    return [f"{c.host}:{c.port}" for c in meta.consenters]
+
+
+def is_member(bundle, node_id: int) -> bool:
+    return 1 <= node_id <= len(consenter_addresses(bundle))
+
+
+class FollowerChain:
+    def __init__(
+        self,
+        channel_id: str,
+        join_block: common_pb2.Block,
+        bundle,
+        node_id: int,
+        wal_dir: str,
+        endpoint_factory: Callable[[Sequence[str]], List[Callable]],
+        on_become_member: Callable[["FollowerChain"], None],
+        provider=None,
+    ):
+        self.channel_id = channel_id
+        self.join_block = join_block
+        self.join_number = join_block.header.number
+        self.bundle = bundle
+        self.node_id = node_id
+        self.provider = provider
+        self._endpoint_factory = endpoint_factory
+        self._on_become_member = on_become_member
+        base = os.path.join(wal_dir, channel_id)
+        os.makedirs(base, exist_ok=True)
+        self.block_store = BlockStore(os.path.join(base, "chain.blocks"))
+        if self.join_number == 0 and self.block_store.height == 0:
+            self.block_store.add_block(join_block)
+        self._member = threading.Event()
+        self._stop = threading.Event()
+        self._deliverer: Optional[BlockDeliverer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- participation-API style introspection ---------------------------
+    @property
+    def height(self) -> int:
+        return self.block_store.height
+
+    def get_block(self, number: int) -> Optional[common_pb2.Block]:
+        return self.block_store.get_block_by_number(number)
+
+    @property
+    def status(self) -> str:
+        """onboarding until the ledger reaches the join block, then an
+        active follower (channel_info.go Status)."""
+        return (
+            STATUS_ONBOARDING
+            if self.height <= self.join_number
+            else STATUS_ACTIVE
+        )
+
+    consensus_relation = RELATION_FOLLOWER
+
+    # -- pull loop -------------------------------------------------------
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, name=f"follower-{self.channel_id}", daemon=True
+        )
+        self._thread.start()
+
+    def _exclude_self(self, addrs: Sequence[str]) -> List[str]:
+        out = list(addrs)
+        if 1 <= self.node_id <= len(out):
+            out.pop(self.node_id - 1)
+        return out
+
+    def _run(self) -> None:
+        while not self._stop.is_set() and not self._member.is_set():
+            endpoints = self._endpoint_factory(
+                self._exclude_self(consenter_addresses(self.bundle))
+            )
+            self._deliverer = BlockDeliverer(
+                self.channel_id,
+                endpoints,
+                on_block=self._append,
+                next_block=lambda: self.block_store.height,
+                max_total_delay=5.0,  # re-derive endpoints periodically
+            )
+            self._deliverer.run()
+            if not self._member.is_set():
+                self._stop.wait(0.1)
+        if self._member.is_set() and not self._stop.is_set():
+            self.block_store.close()
+            self._on_become_member(self)
+
+    def _append(self, block: common_pb2.Block) -> None:
+        h = self.block_store.height
+        if block.header.number != h:
+            raise ConnectionError(
+                f"follower expected block {h}, got {block.header.number}"
+            )
+        if h > 0:
+            prev = self.block_store.last_block_hash
+            if block.header.previous_hash != prev:
+                raise ConnectionError(
+                    f"block {h} breaks the hash chain"
+                )
+        if (
+            protoutil.block_data_hash(block.data)
+            != block.header.data_hash
+        ):
+            raise ConnectionError(f"block {h} DataHash mismatch")
+        self.block_store.add_block(block)
+        if _is_config_block(block):
+            self._on_config_block(block)
+
+    def _on_config_block(self, block: common_pb2.Block) -> None:
+        from fabric_tpu.channelconfig.bundle import bundle_from_genesis_block
+
+        try:
+            self.bundle = bundle_from_genesis_block(block, self.provider)
+        except Exception:  # noqa: BLE001 - keep following on a bad bundle
+            return
+        if is_member(self.bundle, self.node_id) and self.height > self.join_number:
+            self._member.set()
+            if self._deliverer is not None:
+                self._deliverer.stop()
+
+    def check_join_block_membership(self) -> None:
+        """Joining with a non-genesis block where we're already a member:
+        onboarding mode — replicate up to the join block, then promote
+        (onboarding.go ReplicateChains)."""
+        if is_member(self.bundle, self.node_id):
+            # promotion happens when the pull reaches the join block; the
+            # per-block hook below watches plain blocks too in this mode
+            orig_append = self._append
+
+            def append_and_check(block):
+                orig_append(block)
+                if (
+                    not self._member.is_set()
+                    and self.height > self.join_number
+                ):
+                    self._member.set()
+                    if self._deliverer is not None:
+                        self._deliverer.stop()
+
+            self._append = append_and_check  # type: ignore[method-assign]
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._deliverer is not None:
+            self._deliverer.stop()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        if not self._member.is_set():
+            self.block_store.close()

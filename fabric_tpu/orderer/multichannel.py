@@ -58,6 +58,7 @@ class Registrar:
         raft_node_id: int = 1,
         raft_transport_factory: Optional[Callable[[str, int], Callable]] = None,
         provider=None,
+        follower_endpoint_factory: Optional[Callable] = None,
     ):
         self.work_dir = work_dir
         self.signer = signer
@@ -67,7 +68,12 @@ class Registrar:
         self.raft_transport_factory = raft_transport_factory or (
             lambda channel_id, node_id: (lambda to, msg: None)
         )
+        # addresses -> deliver endpoints; enables follower/onboarding mode
+        # (orderer/common/follower) for joins where this node is not (yet)
+        # a consenter or joins from a non-genesis block
+        self.follower_endpoint_factory = follower_endpoint_factory
         self.chains: Dict[str, ChainSupport] = {}
+        self.followers: Dict[str, object] = {}  # channel -> FollowerChain
         self._block_listeners: List[Callable[[str, common_pb2.Block], None]] = []
         self._chain_listeners: List[Callable[[ChainSupport], None]] = []
 
@@ -93,14 +99,83 @@ class Registrar:
         return sink
 
     # -- channel lifecycle --------------------------------------------------
-    def join_channel(self, genesis_block: common_pb2.Block) -> ChainSupport:
+    def join_channel(self, genesis_block: common_pb2.Block):
         """Channel-participation join (registrar.go JoinChannel): bootstrap
-        a chain from its genesis (or latest config) block."""
+        a chain from its genesis (or latest config) block.
+
+        With a follower endpoint factory configured, a join where this
+        node is not in the consenter set — or a join from a non-genesis
+        config block — starts a FollowerChain that replicates the ledger
+        from the cluster and promotes itself to a consenter when the
+        config says so (orderer/common/follower + onboarding)."""
         bundle = bundle_from_genesis_block(genesis_block, self.provider)
         channel_id = bundle.channel_id
-        if channel_id in self.chains:
+        if channel_id in self.chains or channel_id in self.followers:
             raise RegistrarError(f"channel {channel_id} already exists")
+        if (
+            self.follower_endpoint_factory is not None
+            and bundle.orderer is not None
+            and bundle.orderer.consensus_type == "etcdraft"
+        ):
+            from fabric_tpu.orderer.follower import is_member
+
+            member = is_member(bundle, self.raft_node_id)
+            if not member or genesis_block.header.number > 0:
+                return self._start_follower(channel_id, bundle, genesis_block)
         return self._start_chain(channel_id, bundle, genesis_block)
+
+    def _start_follower(
+        self,
+        channel_id: str,
+        bundle: Bundle,
+        join_block: common_pb2.Block,
+    ):
+        from fabric_tpu.orderer.follower import FollowerChain
+
+        follower = FollowerChain(
+            channel_id,
+            join_block,
+            bundle,
+            node_id=self.raft_node_id,
+            wal_dir=os.path.join(self.work_dir, "etcdraft"),
+            endpoint_factory=self.follower_endpoint_factory,
+            on_become_member=self._promote_follower,
+            provider=self.provider,
+        )
+        follower.check_join_block_membership()
+        self.followers[channel_id] = follower
+        follower.start()
+        return follower
+
+    def _promote_follower(self, follower) -> ChainSupport:
+        """The follower reached a config where this node is a consenter:
+        restart the channel as a raft member on the same ledger
+        (follower_chain.go halt + registrar SwitchFollowerToChain)."""
+        self.followers.pop(follower.channel_id, None)
+        return self._start_chain(follower.channel_id, follower.bundle, None)
+
+    def channel_info(self, channel_id: str) -> Optional[Dict[str, object]]:
+        """Channel-participation style status
+        (orderer/common/types/channel_info.go)."""
+        support = self.chains.get(channel_id)
+        if support is not None:
+            return {
+                "name": channel_id,
+                "height": support.height,
+                "status": "active",
+                "consensusRelation": "consenter"
+                if hasattr(support.chain, "node")
+                else "none",
+            }
+        follower = self.followers.get(channel_id)
+        if follower is not None:
+            return {
+                "name": channel_id,
+                "height": follower.height,
+                "status": follower.status,
+                "consensusRelation": follower.consensus_relation,
+            }
+        return None
 
     def _start_chain(
         self,
@@ -184,7 +259,7 @@ class Registrar:
         return self.chains.get(channel_id)
 
     def channel_list(self) -> List[str]:
-        return sorted(self.chains)
+        return sorted(set(self.chains) | set(self.followers))
 
     # -- system-channel channel creation ------------------------------------
     def new_channel_from_update(

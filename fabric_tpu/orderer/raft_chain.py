@@ -258,8 +258,11 @@ class RaftChain:
     def _apply_committed(self) -> None:
         while self._applied_index < self.node.commit_index:
             idx = self._applied_index + 1
-            term = self.node._term_at(idx)
-            if term is None:
+            # idx <= snap_index covers idx == snap_index too: _term_at
+            # answers with snap_term there, but the entry itself is NOT
+            # in the log (log starts at snap_index+1) — indexing would
+            # silently grab log[-1] (found by tests/test_raft_fuzz.py)
+            if idx <= self.node.snap_index or self.node._term_at(idx) is None:
                 # below our log start: state arrives via snapshot instead
                 self._applied_index = self.node.snap_index
                 continue

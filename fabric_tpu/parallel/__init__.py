@@ -12,6 +12,8 @@ goroutines. The TPU-native equivalents here:
   channel's (tx x sig) batch over every device.
 - `multichannel.MultiChannelValidator`: validates one block per channel
   in a single device step (BASELINE config #5: 4 channels x 2k tx).
+- `batcher.VerifyBatcher`: cross-channel verify coalescing with bounded
+  backpressure (P7) — few large launches instead of many small ones.
 """
 
 from fabric_tpu.parallel.mesh import (
@@ -23,6 +25,7 @@ from fabric_tpu.parallel.mesh import (
 from fabric_tpu.parallel.sharded import ShardedVerify
 from fabric_tpu.parallel.provider import MeshTPUProvider
 from fabric_tpu.parallel.multichannel import MultiChannelValidator
+from fabric_tpu.parallel.batcher import VerifyBatcher
 
 __all__ = [
     "CHANNEL_AXIS",
@@ -32,4 +35,5 @@ __all__ = [
     "ShardedVerify",
     "MeshTPUProvider",
     "MultiChannelValidator",
+    "VerifyBatcher",
 ]

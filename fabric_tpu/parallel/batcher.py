@@ -1,0 +1,194 @@
+"""Cross-channel verify coalescing with bounded-queue backpressure
+(SURVEY §2.13 P7).
+
+The device wants few, large, fixed-shape launches; the peer produces
+many small, bursty verify requests (one per block, per channel, plus
+endorsement-path singles).  This batcher sits between them:
+
+- requests enqueue onto ONE bounded queue (backpressure: submitters
+  block when the device is behind — the reference achieves the same
+  with its validator semaphore, core/ledger/kvledger/kv_ledger.go
+  commit throttling);
+- a dispatcher thread drains the queue into bucketed batches: it takes
+  whatever is queued, lingers a few ms for stragglers while the bucket
+  is small, then launches ONE device program for the whole batch via
+  the provider's async path (overlapping host prep of the next batch
+  with device execution of the current one, like the P4 pipeline);
+- each request gets a resolver (future) for exactly its lanes.
+
+Coalescing across channels keeps lanes/launch high even when individual
+blocks are small — the multi-channel aggregate (BASELINE config #5)
+benefits most.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, List, Optional, Sequence, Tuple
+
+
+class _Request:
+    __slots__ = (
+        "keys", "sigs", "digests", "event", "result", "error", "permits",
+    )
+
+    def __init__(self, keys, sigs, digests):
+        self.keys = keys
+        self.sigs = sigs
+        self.digests = digests
+        self.event = threading.Event()
+        self.result: Optional[List[bool]] = None
+        self.error: Optional[BaseException] = None
+        self.permits = 0
+
+    def resolve(self) -> List[bool]:
+        self.event.wait()
+        if self.error is not None:
+            raise self.error
+        assert self.result is not None
+        return self.result
+
+
+class VerifyBatcher:
+    """submit() returns a resolver; call it to block for the verdicts of
+    exactly the submitted lanes."""
+
+    def __init__(
+        self,
+        provider,
+        max_batch: int = 16384,
+        linger_s: float = 0.002,
+        max_pending_lanes: int = 65536,
+    ):
+        self.provider = provider
+        self.max_batch = max_batch
+        self.linger_s = linger_s
+        self._q: "queue.Queue[Optional[_Request]]" = queue.Queue()
+        self._max_pending_lanes = max_pending_lanes
+        # all-or-nothing admission under one condition variable: a
+        # per-lane semaphore loop would let two concurrent large submits
+        # each grab a partial allocation and deadlock
+        self._lanes_cv = threading.Condition()
+        self._lanes_free = max_pending_lanes
+        self._stopped = False
+        self.launches = 0  # introspection: device programs dispatched
+        self.lanes = 0  # total lanes verified
+        self._thread = threading.Thread(
+            target=self._run, name="verify-batcher", daemon=True
+        )
+        self._thread.start()
+
+    def submit(
+        self,
+        keys: Sequence,
+        signatures: Sequence[bytes],
+        digests: Sequence[bytes],
+    ) -> Callable[[], List[bool]]:
+        if self._stopped:
+            raise RuntimeError("batcher stopped")
+        n = len(keys)
+        if n == 0:
+            return list
+        # bounded admission: lanes are taken atomically (all or nothing)
+        # and released at dispatch. An oversized request is capped so it
+        # can't demand more lanes than exist.
+        req = _Request(list(keys), list(signatures), list(digests))
+        req.permits = min(n, self._max_pending_lanes)
+        with self._lanes_cv:
+            while self._lanes_free < req.permits:
+                self._lanes_cv.wait()
+            self._lanes_free -= req.permits
+        self._q.put(req)
+        return req.resolve
+
+    def verify_batch(self, keys, signatures, digests) -> List[bool]:
+        return self.submit(keys, signatures, digests)()
+
+    # -- dispatcher ------------------------------------------------------
+    def _take_batch(self) -> Optional[List[_Request]]:
+        first = self._q.get()
+        if first is None:
+            return None
+        batch = [first]
+        lanes = len(first.keys)
+        deadline = (
+            threading.Event()
+        )  # fresh event as a precise, interruptible sleep
+        while lanes < self.max_batch:
+            try:
+                nxt = self._q.get_nowait()
+            except queue.Empty:
+                if lanes >= self.max_batch // 2:
+                    break  # big enough: don't trade latency for lanes
+                deadline.wait(self.linger_s)
+                try:
+                    nxt = self._q.get_nowait()
+                except queue.Empty:
+                    break
+            if nxt is None:
+                self._q.put(None)  # re-post the stop token
+                break
+            batch.append(nxt)
+            lanes += len(nxt.keys)
+        return batch
+
+    def _run(self) -> None:
+        pending: List[Tuple[List[_Request], Callable]] = []
+        while True:
+            batch = self._take_batch()
+            if batch is None:
+                for reqs, resolver in pending:
+                    self._settle(reqs, resolver)
+                return
+            keys: List = []
+            sigs: List[bytes] = []
+            digests: List[bytes] = []
+            for r in batch:
+                keys.extend(r.keys)
+                sigs.extend(r.sigs)
+                digests.extend(r.digests)
+            with self._lanes_cv:
+                self._lanes_free += sum(r.permits for r in batch)
+                self._lanes_cv.notify_all()
+            try:
+                resolver = self.provider.batch_verify_async(keys, sigs, digests)
+            except BaseException as exc:  # noqa: BLE001 - propagate to callers
+                for r in batch:
+                    r.error = exc
+                    r.event.set()
+                continue
+            self.launches += 1
+            self.lanes += len(keys)
+            pending.append((batch, resolver))
+            # depth-2 pipeline: settle the previous launch only after the
+            # next is in flight
+            while len(pending) > 1:
+                reqs, res = pending.pop(0)
+                self._settle(reqs, res)
+            if self._q.empty():
+                # idle: drain so callers aren't left waiting on us
+                while pending:
+                    reqs, res = pending.pop(0)
+                    self._settle(reqs, res)
+
+    @staticmethod
+    def _settle(reqs: List[_Request], resolver: Callable) -> None:
+        try:
+            out = list(resolver())
+        except BaseException as exc:  # noqa: BLE001 - propagate to callers
+            for r in reqs:
+                r.error = exc
+                r.event.set()
+            return
+        off = 0
+        for r in reqs:
+            n = len(r.keys)
+            r.result = out[off : off + n]
+            off += n
+            r.event.set()
+
+    def stop(self) -> None:
+        self._stopped = True
+        self._q.put(None)
+        self._thread.join(timeout=10.0)

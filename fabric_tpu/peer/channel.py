@@ -45,11 +45,15 @@ class Channel:
         is_eligible: Optional[Callable[[str, str], bool]] = None,
         btl_policy: Optional[Callable[[str, str], int]] = None,
         metrics=None,  # ledger.ledgermetrics.CommitterMetrics
+        device_mvcc: bool = False,  # SURVEY P5 device fixpoint resolver
     ):
         self.metrics = metrics
         self.channel_id = channel_id
         self.provider = provider or default_provider()
-        self.ledger = KVLedger(ledger_dir, channel_id, btl_policy=btl_policy)
+        self.ledger = KVLedger(
+            ledger_dir, channel_id, btl_policy=btl_policy,
+            device_mvcc=device_mvcc,
+        )
         self.verify_orderer_sig = verify_orderer_sig
         self.transient_store = transient_store
         self.fetch_pvt = fetch_pvt

@@ -111,6 +111,7 @@ def parse_tx_rwset(results: bytes) -> rw.TxRwSet:
                 )
             if q.HasField("reads_merkle_hashes"):
                 merkle = (
+                    q.reads_merkle_hashes.max_degree,
                     q.reads_merkle_hashes.max_level,
                     tuple(q.reads_merkle_hashes.max_level_hashes),
                 )

@@ -1,0 +1,222 @@
+"""Orderer follower/onboarding (reference orderer/common/follower
+follower_chain.go + onboarding): a non-consenter orderer replicates a
+channel from the cluster, serves deliver while doing so, and promotes
+itself to a raft member when the channel config adds it."""
+
+import socket
+import time
+
+import pytest
+
+from fabric_tpu.channelconfig import (
+    ApplicationProfile,
+    OrdererProfile,
+    OrganizationProfile,
+    Profile,
+    genesis_block,
+)
+from fabric_tpu.comm.server import channel_to
+from fabric_tpu.comm.services import broadcast_envelope, deliver_stream
+from fabric_tpu.deliver.client import seek_envelope
+from fabric_tpu.msp.cryptogen import generate_org
+from fabric_tpu.msp.signer import SigningIdentity
+from fabric_tpu.nodes.orderer import OrdererNode
+from fabric_tpu.orderer.follower import FollowerChain, is_member
+from fabric_tpu.channelconfig.bundle import bundle_from_genesis_block
+from fabric_tpu.protos import ab_pb2, common_pb2, protoutil
+
+CHANNEL = "followchan"
+
+
+def _free_ports(n):
+    socks = []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _wait(pred, timeout=20.0, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def _profile(org1, oorg, consenter_ports):
+    return Profile(
+        application=ApplicationProfile(
+            organizations=[OrganizationProfile("Org1MSP", org1.msp_config())]
+        ),
+        orderer=OrdererProfile(
+            orderer_type="etcdraft",
+            batch_timeout="100ms",
+            max_message_count=1,
+            organizations=[
+                OrganizationProfile("OrdererMSP", oorg.msp_config())
+            ],
+            raft_consenters=[
+                ("127.0.0.1", p, b"", b"") for p in consenter_ports
+            ],
+        ),
+    )
+
+
+def _renumber_config_block(config_block, number, prev_hash):
+    """Re-chain a config block's envelope at a later height (stand-in for
+    a committed config UPDATE block in unit tests)."""
+    block = protoutil.new_block(number, prev_hash)
+    for d in config_block.data.data:
+        block.data.data.append(d)
+    protoutil.seal_block(block)
+    return block
+
+
+def test_follower_unit_promotion(tmp_path):
+    """Fake deliver endpoints: the follower replicates, rejects nothing,
+    and promotes itself when a config block adds it to the consenter
+    set."""
+    org1 = generate_org("org1.follow", "Org1MSP")
+    oorg = generate_org("orderer.follow", "OrdererMSP")
+    p1, p2 = _free_ports(2)
+    gblock = genesis_block(_profile(org1, oorg, [p1]), CHANNEL)
+    grown = genesis_block(_profile(org1, oorg, [p1, p2]), CHANNEL)
+    block1 = _renumber_config_block(
+        grown, 1, protoutil.block_header_hash(gblock.header)
+    )
+    chain_blocks = [gblock, block1]
+
+    def endpoint_factory(addrs):
+        def endpoint(env):
+            payload = protoutil.unmarshal(common_pb2.Payload, env.payload)
+            seek = ab_pb2.SeekInfo()
+            seek.ParseFromString(payload.data)
+            for b in chain_blocks[seek.start.specified.number :]:
+                resp = ab_pb2.DeliverResponse()
+                resp.block.CopyFrom(b)
+                yield resp
+
+        return [endpoint]
+
+    bundle = bundle_from_genesis_block(gblock)
+    assert not is_member(bundle, 2)
+    promoted = []
+    follower = FollowerChain(
+        CHANNEL,
+        gblock,
+        bundle,
+        node_id=2,
+        wal_dir=str(tmp_path / "etcdraft"),
+        endpoint_factory=endpoint_factory,
+        on_become_member=promoted.append,
+    )
+    # a genesis join block seeds the ledger immediately: height 1 > join
+    # number 0, so the follower reports active (not onboarding)
+    assert follower.status == "active"
+    assert follower.height == 1
+    follower.start()
+    assert _wait(lambda: bool(promoted), timeout=10.0)
+    assert promoted[0].height == 2
+    assert is_member(promoted[0].bundle, 2)
+    follower.stop()
+
+
+def test_follower_replicates_and_serves_deliver(tmp_path):
+    """Socket-level: a 2-consenter cluster orders txs; a third orderer
+    joins as a non-member follower, replicates over real deliver
+    streams, reports participation status, and serves deliver itself."""
+    org1 = generate_org("org1.follow2", "Org1MSP")
+    oorg = generate_org("orderer.follow2", "OrdererMSP")
+    ports = _free_ports(3)
+    gblock = genesis_block(_profile(org1, oorg, ports[:2]), CHANNEL)
+
+    nodes = []
+    try:
+        for i, port in enumerate(ports[:2]):
+            node = OrdererNode(
+                str(tmp_path / f"orderer{i}"),
+                signer=SigningIdentity(oorg.peers[0]),
+                listen_address=f"127.0.0.1:{port}",
+                raft_node_id=i + 1,
+                raft_tick_seconds=0.05,
+            )
+            node.join_channel(gblock)
+            node.start()
+            nodes.append(node)
+
+        def leaders():
+            return [
+                n
+                for n in nodes
+                if n.registrar.get_chain(CHANNEL) is not None
+                and n.registrar.get_chain(CHANNEL).chain.node.role == "leader"
+            ]
+
+        assert _wait(lambda: len(leaders()) == 1)
+
+        follower_node = OrdererNode(
+            str(tmp_path / "orderer-follower"),
+            signer=SigningIdentity(oorg.peers[0]),
+            listen_address=f"127.0.0.1:{ports[2]}",
+            raft_node_id=3,
+            raft_tick_seconds=0.05,
+        )
+        chain = follower_node.join_channel(gblock)
+        assert isinstance(chain, FollowerChain)
+        follower_node.start()
+        nodes.append(follower_node)
+
+        # order a tx through the leader; the follower replicates it
+        client = SigningIdentity(org1.users[0])
+        payload = common_pb2.Payload()
+        chdr = protoutil.make_channel_header(
+            common_pb2.ENDORSER_TRANSACTION, CHANNEL
+        )
+        payload.header.channel_header = chdr.SerializeToString()
+        shdr = protoutil.make_signature_header(
+            client.serialize(), client.new_nonce()
+        )
+        payload.header.signature_header = shdr.SerializeToString()
+        payload.data = b"tx-1"
+        env = common_pb2.Envelope()
+        env.payload = payload.SerializeToString()
+        env.signature = client.sign(env.payload)
+        ch = channel_to(leaders()[0].addr)
+        resp = broadcast_envelope(ch, env)
+        ch.close()
+        assert resp.status == common_pb2.SUCCESS
+
+        assert _wait(lambda: chain.height >= 2), chain.height
+        info = follower_node.registrar.channel_info(CHANNEL)
+        assert info == {
+            "name": CHANNEL,
+            "height": chain.height,
+            "status": "active",
+            "consensusRelation": "follower",
+        }
+        assert CHANNEL in follower_node.registrar.channel_list()
+
+        # the follower serves deliver for its replicated range
+        conn = channel_to(follower_node.addr)
+        got = []
+        for resp in deliver_stream(
+            conn, seek_envelope(CHANNEL, 0, stop=1)
+        ):
+            if resp.WhichOneof("Type") == "block":
+                got.append(resp.block.header.number)
+            else:
+                break
+        conn.close()
+        assert got == [0, 1]
+    finally:
+        for node in nodes:
+            try:
+                node.stop()
+            except Exception:
+                pass

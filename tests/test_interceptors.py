@@ -73,3 +73,74 @@ def test_metrics_interceptor_counts_unary_and_stream(echo_server):
     )
     assert "grpc_server_unary_request_duration" in text
     assert "grpc_server_stream_request_duration" in text
+
+
+def test_payload_logging_at_debug_level():
+    """grpclogging payload logger: DEBUG level => every request/response
+    message logged with direction and size (grpclogging/server.go)."""
+    import logging
+
+    records = []
+
+    class Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    plog = logging.getLogger("test.grpc.payload")
+    plog.setLevel(logging.DEBUG)
+    plog.addHandler(Capture())
+    plog.propagate = False
+
+    server = GRPCServer(
+        "127.0.0.1:0",
+        interceptors=[LoggingInterceptor(payload_logger=plog)],
+    )
+    server.register(
+        "test.Echo2",
+        {
+            "Call": (UNARY, lambda req, ctx: req, lambda b: b, lambda b: b),
+            "Stream": (
+                STREAM_STREAM,
+                lambda it, ctx: (x for x in it),
+                lambda b: b,
+                lambda b: b,
+            ),
+        },
+    )
+    addr = server.start()
+    try:
+        ch = channel_to(addr)
+        assert ch.unary_unary("/test.Echo2/Call")(b"ping") == b"ping"
+        assert list(ch.stream_stream("/test.Echo2/Stream")(iter([b"a", b"b"]))) == [
+            b"a",
+            b"b",
+        ]
+        ch.close()
+    finally:
+        server.stop()
+
+    recv = [r for r in records if "payload recv" in r]
+    send = [r for r in records if "payload send" in r]
+    assert len(recv) == 3  # 1 unary + 2 streamed requests
+    assert len(send) == 3  # 1 unary + 2 streamed responses
+    assert all("grpc.service=test.Echo2" in r for r in records)
+
+    # silent when the payload logger is above DEBUG
+    records.clear()
+    plog.setLevel(logging.INFO)
+    server2 = GRPCServer(
+        "127.0.0.1:0",
+        interceptors=[LoggingInterceptor(payload_logger=plog)],
+    )
+    server2.register(
+        "test.Echo3",
+        {"Call": (UNARY, lambda req, ctx: req, lambda b: b, lambda b: b)},
+    )
+    addr2 = server2.start()
+    try:
+        ch = channel_to(addr2)
+        assert ch.unary_unary("/test.Echo3/Call")(b"ping") == b"ping"
+        ch.close()
+    finally:
+        server2.stop()
+    assert records == []

@@ -1,0 +1,148 @@
+"""Merkle-summarized range-query simulation + phantom-read validation
+(reference rwsetutil/query_results_helper.go and
+validation/rangequery_validator.go rangeQueryHashValidator)."""
+
+import hashlib
+
+from fabric_tpu.ledger import rwset as rw
+from fabric_tpu.ledger.merkle import RangeQueryResultsHelper, serialize_kv_reads
+from fabric_tpu.ledger.mvcc import Validator
+from fabric_tpu.ledger.simulator import TxSimulator
+from fabric_tpu.ledger.statedb import UpdateBatch, VersionedDB
+from fabric_tpu.validation.txflags import TxValidationCode
+
+
+def seeded_db(n=100):
+    db = VersionedDB()
+    seed = UpdateBatch()
+    for i in range(n):
+        seed.put("cc", f"k{i:04d}", b"v%d" % i, rw.Version(0, i))
+    db.apply_updates(seed)
+    return db
+
+
+def reads(n, start=0):
+    return [rw.KVRead(f"k{i:04d}", rw.Version(0, i)) for i in range(start, start + n)]
+
+
+def test_small_result_set_stays_raw():
+    h = RangeQueryResultsHelper(True, 3)
+    for r in reads(3):
+        h.add_result(r)
+    raw, summary = h.done()
+    assert summary is None
+    assert raw == tuple(reads(3))
+
+
+def test_summary_structure_pinned():
+    """maxDegree=2: leaves are batches of 3 reads (pending spills when it
+    EXCEEDS maxDegree). done() hashes the 1-read tail into a third
+    level-1 node, which overflows maxDegree and collapses the level into
+    one level-2 node — the exact shape query_results_helper.go produces."""
+    h = RangeQueryResultsHelper(True, 2)
+    rs = reads(7)
+    for r in rs:
+        h.add_result(r)
+    raw, summary = h.done()
+    assert raw == ()
+    sha = lambda b: hashlib.sha256(b).digest()  # noqa: E731
+    leaf1 = sha(serialize_kv_reads(rs[0:3]))
+    leaf2 = sha(serialize_kv_reads(rs[3:6]))
+    tail = sha(serialize_kv_reads(rs[6:7]))  # done() processes pending
+    assert summary == (2, 2, (sha(leaf1 + leaf2 + tail),))
+
+
+def test_deep_tree_spills_levels():
+    h = RangeQueryResultsHelper(True, 2)
+    for r in reads(40):
+        h.add_result(r)
+    _raw, (deg, level, hashes) = h.done()
+    assert deg == 2
+    assert level >= 2
+    assert 1 <= len(hashes) <= 2
+
+
+def sim_range(db, max_degree):
+    sim = TxSimulator(db, "t1", range_query_hashing_max_degree=max_degree)
+    list(sim.get_state_range_scan_iterator("cc", "k0000", "k0090"))
+    sim.set_state("cc", "k0000", b"new")
+    return sim.get_tx_simulation_results().rwset
+
+
+def test_simulate_validate_roundtrip_clean():
+    db = seeded_db()
+    txrw = sim_range(db, max_degree=4)
+    rqi = txrw.ns_rw_sets[0].range_queries[0]
+    assert rqi.reads_merkle_hashes is not None  # 90 results >> degree 4
+    assert rqi.raw_reads == ()
+    codes, *_ = Validator(db).validate_and_prepare_batch(
+        1, [txrw], [TxValidationCode.VALID]
+    )
+    assert codes == [TxValidationCode.VALID]
+
+
+def test_phantom_insert_detected():
+    db = seeded_db()
+    txrw = sim_range(db, max_degree=4)
+    extra = UpdateBatch()
+    extra.put("cc", "k0050a", b"phantom", rw.Version(1, 0))
+    db.apply_updates(extra)
+    codes, *_ = Validator(db).validate_and_prepare_batch(
+        2, [txrw], [TxValidationCode.VALID]
+    )
+    assert codes == [TxValidationCode.PHANTOM_READ_CONFLICT]
+
+
+def test_phantom_delete_detected():
+    db = seeded_db()
+    txrw = sim_range(db, max_degree=4)
+    extra = UpdateBatch()
+    extra.delete("cc", "k0030", rw.Version(1, 0))
+    db.apply_updates(extra)
+    codes, *_ = Validator(db).validate_and_prepare_batch(
+        2, [txrw], [TxValidationCode.VALID]
+    )
+    assert codes == [TxValidationCode.PHANTOM_READ_CONFLICT]
+
+
+def test_early_version_change_detected():
+    """Mismatch in the first leaf batch exits via the incremental
+    comparison (not only the final summary equality)."""
+    db = seeded_db()
+    txrw = sim_range(db, max_degree=4)
+    extra = UpdateBatch()
+    extra.put("cc", "k0001", b"bumped", rw.Version(1, 0))
+    db.apply_updates(extra)
+    codes, *_ = Validator(db).validate_and_prepare_batch(
+        2, [txrw], [TxValidationCode.VALID]
+    )
+    assert codes == [TxValidationCode.PHANTOM_READ_CONFLICT]
+
+
+def test_in_block_shadow_write_conflicts():
+    """An earlier in-block valid tx writing inside the scanned range
+    changes the re-executed result set (combined iterator)."""
+    db = seeded_db()
+    txrw = sim_range(db, max_degree=4)
+    writer = rw.TxRwSet(
+        (rw.NsRwSet("cc", (), (rw.KVWrite("k0042", False, b"w"),)),)
+    )
+    codes, *_ = Validator(db).validate_and_prepare_batch(
+        1, [writer, txrw], [TxValidationCode.VALID, TxValidationCode.VALID]
+    )
+    assert codes == [
+        TxValidationCode.VALID,
+        TxValidationCode.PHANTOM_READ_CONFLICT,
+    ]
+
+
+def test_proto_roundtrip_preserves_summary():
+    from fabric_tpu.ledger.rwset_proto import serialize_tx_rwset
+    from fabric_tpu.validation.msgvalidation import parse_tx_rwset
+
+    db = seeded_db()
+    txrw = sim_range(db, max_degree=4)
+    parsed = parse_tx_rwset(serialize_tx_rwset(txrw))
+    got = parsed.ns_rw_sets[0].range_queries[0]
+    want = txrw.ns_rw_sets[0].range_queries[0]
+    assert got.reads_merkle_hashes == want.reads_merkle_hashes

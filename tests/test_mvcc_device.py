@@ -1,0 +1,342 @@
+"""Differential tests: device MVCC fixpoint vs the host-sequential oracle.
+
+The oracle (fabric_tpu.ledger.mvcc.Validator) mirrors reference
+validator.go:82-281; the device path must produce identical codes and
+identical update batches for every block shape it accepts, and must fall
+back to the oracle for shapes outside its scope (range queries, metadata
+writes).
+"""
+
+import random
+
+import pytest
+
+from fabric_tpu.ledger import rwset as rw
+from fabric_tpu.ledger.mvcc import Validator
+from fabric_tpu.ledger.mvcc_device import DeviceValidator
+from fabric_tpu.ledger.statedb import UpdateBatch, VersionedDB
+from fabric_tpu.validation.txflags import TxValidationCode
+
+VALID = TxValidationCode.VALID
+
+
+def seeded_db(n_keys=40, n_colls=2):
+    db = VersionedDB()
+    seed = UpdateBatch()
+    for i in range(n_keys):
+        seed.put("cc", f"k{i}", b"v0", rw.Version(0, i))
+    from fabric_tpu.ledger.statedb import HashedUpdateBatch
+
+    hseed = HashedUpdateBatch()
+    for c in range(n_colls):
+        for i in range(n_keys // 2):
+            hseed.put(
+                "cc", f"coll{c}", f"h{i}".encode(), b"\x01" * 32, rw.Version(0, i)
+            )
+    db.apply_updates(seed, hashed=hseed)
+    return db
+
+
+def batches_equal(a, b):
+    return dict(a.items()) == dict(b.items())
+
+
+def assert_same(db, block_num, rwsets, incoming):
+    host_codes, host_up, host_hup = Validator(db).validate_and_prepare_batch(
+        block_num, rwsets, list(incoming)
+    )
+    dev = DeviceValidator(db)
+    dev_codes, dev_up, dev_hup = dev.validate_and_prepare_batch(
+        block_num, rwsets, list(incoming)
+    )
+    assert dev_codes == host_codes
+    assert batches_equal(dev_up, host_up)
+    assert batches_equal(dev_hup, host_hup)
+    return dev
+
+
+def test_basic_conflicts_match_oracle():
+    db = seeded_db()
+    rwsets = [
+        # valid: reads own key at committed version, writes it
+        rw.TxRwSet(
+            (
+                rw.NsRwSet(
+                    "cc",
+                    (rw.KVRead("k0", rw.Version(0, 0)),),
+                    (rw.KVWrite("k0", False, b"v1"),),
+                ),
+            )
+        ),
+        # conflict: reads k0 which tx0 (valid) already wrote
+        rw.TxRwSet(
+            (
+                rw.NsRwSet(
+                    "cc",
+                    (rw.KVRead("k0", rw.Version(0, 0)),),
+                    (rw.KVWrite("k5", False, b"v1"),),
+                ),
+            )
+        ),
+        # stale committed version -> conflict regardless of block
+        rw.TxRwSet(
+            (
+                rw.NsRwSet(
+                    "cc",
+                    (rw.KVRead("k9", rw.Version(0, 3)),),
+                    (rw.KVWrite("k9", False, b"v1"),),
+                ),
+            )
+        ),
+        # blind write only -> valid
+        rw.TxRwSet(
+            (rw.NsRwSet("cc", (), (rw.KVWrite("k30", False, b"v1"),)),)
+        ),
+        # reads k5: tx1 wrote k5 but tx1 is INVALID -> no conflict
+        rw.TxRwSet(
+            (
+                rw.NsRwSet(
+                    "cc",
+                    (rw.KVRead("k5", rw.Version(0, 5)),),
+                    (),
+                ),
+            )
+        ),
+    ]
+    dev = assert_same(db, 7, rwsets, [VALID] * len(rwsets))
+    assert dev.last_path == "device"
+
+
+def test_alternating_chain_needs_multiple_sweeps():
+    """tx_i reads the key tx_{i-1} writes (at the committed version), so
+    sequential validity alternates valid/invalid/valid/... — the Jacobi
+    sweep must iterate chain-depth times to agree with the oracle."""
+    db = seeded_db(n_keys=64)
+    n = 24
+    rwsets = []
+    for i in range(n):
+        reads = ()
+        if i > 0:
+            reads = (rw.KVRead(f"k{i - 1}", rw.Version(0, i - 1)),)
+        rwsets.append(
+            rw.TxRwSet(
+                (rw.NsRwSet("cc", reads, (rw.KVWrite(f"k{i}", False, b"n"),)),)
+            )
+        )
+    dev = assert_same(db, 3, rwsets, [VALID] * n)
+    assert dev.last_path == "device"
+
+
+def test_deletes_block_later_reads():
+    db = seeded_db()
+    rwsets = [
+        rw.TxRwSet((rw.NsRwSet("cc", (), (rw.KVWrite("k2", True),)),)),
+        rw.TxRwSet(
+            (rw.NsRwSet("cc", (rw.KVRead("k2", rw.Version(0, 2)),), ()),)
+        ),
+    ]
+    assert_same(db, 2, rwsets, [VALID, VALID])
+
+
+def test_hashed_reads_and_writes():
+    db = seeded_db()
+    rwsets = [
+        rw.TxRwSet(
+            (
+                rw.NsRwSet(
+                    "cc",
+                    (),
+                    (),
+                    coll_hashed=(
+                        rw.CollHashedRwSet(
+                            "coll0",
+                            (rw.KVReadHash(b"h0", rw.Version(0, 0)),),
+                            (rw.KVWriteHash(b"h1", False, b"\x02" * 32),),
+                        ),
+                    ),
+                ),
+            )
+        ),
+        # conflicts: tx0 wrote h1 in coll0
+        rw.TxRwSet(
+            (
+                rw.NsRwSet(
+                    "cc",
+                    (),
+                    (),
+                    coll_hashed=(
+                        rw.CollHashedRwSet(
+                            "coll0",
+                            (rw.KVReadHash(b"h1", rw.Version(0, 1)),),
+                            (),
+                        ),
+                    ),
+                ),
+            )
+        ),
+        # same key-hash in a DIFFERENT collection: no conflict
+        rw.TxRwSet(
+            (
+                rw.NsRwSet(
+                    "cc",
+                    (),
+                    (),
+                    coll_hashed=(
+                        rw.CollHashedRwSet(
+                            "coll1",
+                            (rw.KVReadHash(b"h1", rw.Version(0, 1)),),
+                            (),
+                        ),
+                    ),
+                ),
+            )
+        ),
+    ]
+    assert_same(db, 4, rwsets, [VALID] * 3)
+
+
+def test_incoming_invalid_and_none_rwsets_pass_through():
+    db = seeded_db()
+    rwsets = [
+        rw.TxRwSet(
+            (rw.NsRwSet("cc", (), (rw.KVWrite("k0", False, b"x"),)),)
+        ),
+        None,
+        rw.TxRwSet(
+            (rw.NsRwSet("cc", (rw.KVRead("k0", rw.Version(0, 0)),), ()),)
+        ),
+    ]
+    incoming = [
+        TxValidationCode.BAD_CREATOR_SIGNATURE,  # excluded: its write must not count
+        VALID,
+        VALID,
+    ]
+    host_codes, *_ = Validator(db).validate_and_prepare_batch(
+        1, rwsets, list(incoming)
+    )
+    assert_same(db, 1, rwsets, incoming)
+    # tx0 invalid upstream, so tx2's read of k0 must NOT conflict
+    assert host_codes[2] == VALID
+
+
+def test_range_query_falls_back_to_host():
+    db = seeded_db()
+    rwsets = [
+        rw.TxRwSet(
+            (
+                rw.NsRwSet(
+                    "cc",
+                    (),
+                    (rw.KVWrite("k0", False, b"x"),),
+                    range_queries=(
+                        rw.RangeQueryInfo(
+                            "k0",
+                            "k3",
+                            True,
+                            raw_reads=(
+                                rw.KVRead("k0", rw.Version(0, 0)),
+                                rw.KVRead("k1", rw.Version(0, 1)),
+                                rw.KVRead("k2", rw.Version(0, 2)),
+                            ),
+                        ),
+                    ),
+                ),
+            )
+        ),
+    ]
+    dev = assert_same(db, 1, rwsets, [VALID])
+    assert dev.last_path == "host"
+
+
+def test_metadata_write_falls_back_to_host():
+    db = seeded_db()
+    rwsets = [
+        rw.TxRwSet(
+            (
+                rw.NsRwSet(
+                    "cc",
+                    (),
+                    (rw.KVWrite("k0", False, b"x"),),
+                    metadata_writes=(
+                        rw.KVMetadataWrite("k0", (("owner", b"org1"),)),
+                    ),
+                ),
+            )
+        ),
+    ]
+    dev = assert_same(db, 1, rwsets, [VALID])
+    assert dev.last_path == "host"
+
+
+def test_randomized_blocks_match_oracle():
+    rng = random.Random(20260731)
+    for trial in range(8):
+        db = seeded_db(n_keys=30)
+        n = rng.randrange(1, 60)
+        rwsets = []
+        incoming = []
+        for t in range(n):
+            if rng.random() < 0.05:
+                rwsets.append(None)
+                incoming.append(VALID)
+                continue
+            incoming.append(
+                VALID
+                if rng.random() < 0.9
+                else TxValidationCode.ENDORSEMENT_POLICY_FAILURE
+            )
+            reads = []
+            for _ in range(rng.randrange(0, 4)):
+                i = rng.randrange(30)
+                # mostly correct committed version, sometimes stale/absent
+                roll = rng.random()
+                if roll < 0.7:
+                    ver = rw.Version(0, i)
+                elif roll < 0.85:
+                    ver = rw.Version(0, i + 1)
+                else:
+                    ver = None
+                reads.append(rw.KVRead(f"k{i}", ver))
+            writes = []
+            for _ in range(rng.randrange(0, 4)):
+                i = rng.randrange(35)
+                writes.append(
+                    rw.KVWrite(f"k{i}", rng.random() < 0.2, b"w%d" % t)
+                )
+            colls = []
+            if rng.random() < 0.3:
+                hreads = []
+                for _ in range(rng.randrange(0, 3)):
+                    i = rng.randrange(15)
+                    hreads.append(
+                        rw.KVReadHash(
+                            f"h{i}".encode(),
+                            rw.Version(0, i) if rng.random() < 0.8 else None,
+                        )
+                    )
+                hwrites = []
+                for _ in range(rng.randrange(0, 3)):
+                    i = rng.randrange(18)
+                    hwrites.append(
+                        rw.KVWriteHash(
+                            f"h{i}".encode(), rng.random() < 0.2, b"\x03" * 32
+                        )
+                    )
+                colls.append(
+                    rw.CollHashedRwSet(
+                        f"coll{rng.randrange(2)}", tuple(hreads), tuple(hwrites)
+                    )
+                )
+            rwsets.append(
+                rw.TxRwSet(
+                    (
+                        rw.NsRwSet(
+                            "cc",
+                            tuple(reads),
+                            tuple(writes),
+                            coll_hashed=tuple(colls),
+                        ),
+                    )
+                )
+            )
+        assert_same(db, trial + 1, rwsets, incoming)

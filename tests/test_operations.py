@@ -304,3 +304,33 @@ def test_committer_metrics_families():
         'ledger_transaction_count{channel="ch1",'
         'validation_code="MVCC_READ_CONFLICT"} 1' in text
     )
+
+
+def test_ops_pprof_disabled_by_default(ops_system):
+    import urllib.error
+
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _get(ops_system, "/debug/pprof/goroutine")
+    assert exc.value.code == 404
+
+
+def test_ops_pprof_endpoints(tmp_path):
+    """Go-pprof analogs (orderer main.go:458 Profile service): thread
+    dump, sampled CPU profile, heap snapshot."""
+    system = System(
+        Options(listen_address="127.0.0.1:0", profile_enabled=True)
+    )
+    system.start()
+    try:
+        with _get(system, "/debug/pprof/") as resp:
+            assert b"profile" in resp.read()
+        with _get(system, "/debug/pprof/goroutine") as resp:
+            body = resp.read().decode()
+        assert "thread" in body and "operations" in body
+        with _get(system, "/debug/pprof/profile?seconds=0.2") as resp:
+            assert b"cpu profile" in resp.read()
+        with _get(system, "/debug/pprof/heap") as resp:
+            assert resp.status == 200
+    finally:
+        system.stop()
+        flogging.reset()
