@@ -289,18 +289,26 @@ def bench_idemix(n_sigs=8):
         )
         return (time.perf_counter() - start) * 1000.0, out
 
-    run(True)  # compile warmup
-    dev_ms, dev_out = run(True)
     host_ms, host_out = run(False)
-    if dev_out != host_out or not all(dev_out):
-        raise RuntimeError("config #3 device/host mismatch")
-    return {
+    if not all(host_out):
+        raise RuntimeError("config #3 host verification failed")
+    result = {
         "sigs": n_sigs,
-        "device_ms_per_sig": round(dev_ms / n_sigs, 1),
         "host_ms_per_sig": round(host_ms / n_sigs, 1),
-        "speedup": round(host_ms / dev_ms, 1),
-        "mask_bit_exact": True,
     }
+    # The device Ate2 kernel's FIRST compile is long (tens of minutes on
+    # a cold cache); opt in so an unattended bench run can't stall on it.
+    if os.environ.get("BENCH_IDEMIX_DEVICE", "") == "1":
+        run(True)  # compile warmup
+        dev_ms, dev_out = run(True)
+        if dev_out != host_out:
+            raise RuntimeError("config #3 device/host mismatch")
+        result["device_ms_per_sig"] = round(dev_ms / n_sigs, 1)
+        result["speedup"] = round(host_ms / dev_ms, 1)
+        result["mask_bit_exact"] = True
+    else:
+        result["device"] = "skipped (set BENCH_IDEMIX_DEVICE=1)"
+    return result
 
 
 def bench_mvcc(n_txs=5000):

@@ -266,9 +266,16 @@ def channel_cmd(args) -> int:
         else:
             print("fetch needs --orderer or --peerAddress", file=sys.stderr)
             return 2
-        number = 0 if args.block == "oldest" else int(args.block)
+        # oldest | newest | config | <number> (fetch.go selectors)
+        if args.block == "oldest":
+            number = 0
+        elif args.block in ("newest", "config"):
+            number = "newest"
+        else:
+            number = int(args.block)
         rc = _fetch_block(
-            conn, signer, args.channelID, number, args.output, service
+            conn, signer, args.channelID, number, args.output, service,
+            want_config=args.block == "config",
         )
         conn.close()
         if rc == 0:
@@ -277,9 +284,26 @@ def channel_cmd(args) -> int:
     return 2
 
 
+def _last_config_number(block) -> int:
+    """LastConfig.index from the SIGNATURES metadata (fetch.go `config`
+    selector: newest block points at the latest config block)."""
+    from fabric_tpu.protos import protoutil
+
+    metas = block.metadata.metadata
+    if len(metas) > common_pb2.SIGNATURES and metas[common_pb2.SIGNATURES]:
+        meta = protoutil.unmarshal(
+            common_pb2.Metadata, metas[common_pb2.SIGNATURES]
+        )
+        if meta.value:
+            lc = protoutil.unmarshal(common_pb2.LastConfig, meta.value)
+            return lc.index
+    return 0
+
+
 def _fetch_block(
     conn, signer, channel_id, number, out_path,
     service: str = "orderer.AtomicBroadcast",
+    want_config: bool = False,
 ) -> int:
     from fabric_tpu.comm.services import deliver_stream
     from fabric_tpu.deliver.client import seek_envelope
@@ -288,6 +312,16 @@ def _fetch_block(
     for resp in deliver_stream(conn, env, service=service):
         kind = resp.WhichOneof("Type")
         if kind == "block":
+            if want_config:
+                # hop from the newest block to the config block it cites
+                return _fetch_block(
+                    conn,
+                    signer,
+                    channel_id,
+                    _last_config_number(resp.block),
+                    out_path,
+                    service,
+                )
             with open(out_path, "wb") as f:
                 f.write(resp.block.SerializeToString())
             return 0
@@ -494,7 +528,7 @@ def main(argv=None) -> int:
     ccr.add_argument("-f", "--file", required=True)
     ccr.add_argument("--outputBlock", default="")
     cf = chan_sub.add_parser("fetch")
-    cf.add_argument("block", help="oldest | <number>")
+    cf.add_argument("block", help="oldest | newest | config | <number>")
     cf.add_argument("output")
     cf.add_argument("-o", "--orderer", default="")
     cf.add_argument("-c", "--channelID", required=True)

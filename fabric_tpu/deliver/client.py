@@ -27,14 +27,21 @@ MAX_TOTAL_DELAY = 60.0 * 60
 
 def seek_envelope(
     channel_id: str,
-    start: int,
+    start,
     signer=None,
-    stop: int = 2**64 - 1,
+    stop=2**64 - 1,
 ) -> common_pb2.Envelope:
-    """SeekInfo [start, stop] envelope, signed when a signer is given."""
+    """SeekInfo [start, stop] envelope, signed when a signer is given.
+    start/stop are block numbers or the strings "oldest"/"newest"
+    (ab.SeekPosition oneof)."""
     seek = ab_pb2.SeekInfo()
-    seek.start.specified.number = start
-    seek.stop.specified.number = stop
+    for pos, value in ((seek.start, start), (seek.stop, stop)):
+        if value == "oldest":
+            pos.oldest.SetInParent()
+        elif value == "newest":
+            pos.newest.SetInParent()
+        else:
+            pos.specified.number = value
     seek.behavior = ab_pb2.SeekInfo.BLOCK_UNTIL_READY
     payload = common_pb2.Payload()
     chdr = protoutil.make_channel_header(

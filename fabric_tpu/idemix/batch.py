@@ -95,9 +95,15 @@ def verify_signatures_batch(
     msgs: Sequence[bytes],
     attribute_values_list: Sequence[Sequence[Optional[int]]],
     rh_index: int,
+    device_pairing: bool = False,
 ) -> List[bool]:
     """One device MSM pass for the whole batch; returns a per-signature
-    validity mask (BASELINE config #3's bit-exact mask contract)."""
+    validity mask (BASELINE config #3's bit-exact mask contract).
+
+    device_pairing=True runs the Ate2 structure check on the
+    accelerator too (ops/pairing_kernel.py: precomputed-line Miller
+    loop, batched over the signatures); False keeps the host oracle
+    pairing (idemix/signature.go:288-296 semantics either way)."""
     from fabric_tpu.ops.bn256_kernel import msm_host_batch
 
     n = len(signatures)
@@ -114,17 +120,28 @@ def verify_signatures_batch(
         except Exception:  # noqa: BLE001 - one bad lane must not abort the batch
             parsed.append(None)
 
-    # host pairing structure check (the remaining host-side crypto)
+    # pairing structure check: e(W, A') * e(g2, ABar)^-1 == 1
     w = ecp2_from_proto(ipk.w)
-    pairing_ok: List[bool] = []
-    for p in parsed:
-        if p is None:
-            pairing_ok.append(False)
-            continue
-        t = bn.fp12_mul(
-            bn.ate(w, p.a_prime), bn.fp12_inv(bn.ate(bn.G2_GEN, p.a_bar))
+    if device_pairing:
+        from fabric_tpu.ops.pairing_kernel import kernel_for_issuer
+
+        kernel = kernel_for_issuer(bn.g2_to_bytes(w))
+        pairing_ok = kernel.check(
+            [
+                (p.a_prime, p.a_bar) if p is not None else None
+                for p in parsed
+            ]
         )
-        pairing_ok.append(bn.gt_is_unity(bn.fexp(t)))
+    else:
+        pairing_ok = []
+        for p in parsed:
+            if p is None:
+                pairing_ok.append(False)
+                continue
+            t = bn.fp12_mul(
+                bn.ate(w, p.a_prime), bn.fp12_inv(bn.ate(bn.G2_GEN, p.a_bar))
+            )
+            pairing_ok.append(bn.gt_is_unity(bn.fexp(t)))
 
     # device: 3 MSM lanes per live signature, one kernel batch
     jobs: List[Tuple[list, list]] = []

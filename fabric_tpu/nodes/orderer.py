@@ -6,6 +6,7 @@ behind one gRPC server serving orderer.AtomicBroadcast.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Optional
 
 from fabric_tpu.comm.server import GRPCServer
@@ -139,9 +140,19 @@ class OrdererNode:
                 return None
 
             def wait_poll(number: int, timeout: float) -> bool:
-                deadline = 0.2 if timeout is None else min(timeout, 0.2)
-                threading.Event().wait(deadline)
-                return follower.height > number
+                # poll the replicating ledger for the FULL timeout (the
+                # deliver engine calls this once and errors on False)
+                budget = (
+                    threading.TIMEOUT_MAX if timeout is None else timeout
+                )
+                deadline = time.monotonic() + budget
+                while True:
+                    if follower.height > number:
+                        return True
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return False
+                    threading.Event().wait(min(remaining, 0.1))
 
             return BlockSource(
                 follower.get_block, lambda: follower.height, wait_poll

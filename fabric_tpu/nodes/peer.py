@@ -43,11 +43,20 @@ class PeerNode:
         provider=None,
         external_builders=None,
         device_mvcc: bool = False,
+        shared_verify_batcher: bool = False,
     ):
         self.work_dir = work_dir
         self.msp_manager = msp_manager
         self.signer = signer
         self.provider = provider
+        if shared_verify_batcher:
+            # one device-launch queue for every channel validator on the
+            # node (SURVEY P7): small per-channel batches coalesce into
+            # large fixed-shape launches with bounded backpressure
+            from fabric_tpu.crypto.bccsp import default_provider
+            from fabric_tpu.parallel.batcher import BatchingProvider
+
+            self.provider = BatchingProvider(provider or default_provider())
         self.device_mvcc = device_mvcc
         self._registry_factory = registry_factory
         self.channels: Dict[str, Channel] = {}
@@ -562,6 +571,10 @@ class PeerNode:
         self._stop.set()
         for node in self.gossip_nodes.values():
             node.stop()
+        from fabric_tpu.parallel.batcher import BatchingProvider
+
+        if isinstance(self.provider, BatchingProvider):
+            self.provider.stop()
         self.launcher.stop()
         self.server.stop()
         if self.ops is not None:

@@ -70,16 +70,11 @@ def cpu_profile(seconds: float = 2.0, hz: int = 100) -> str:
     return "\n".join(lines) + "\n"
 
 
-_heap_started = False
-
-
 def heap_profile(top: int = 40) -> str:
-    global _heap_started
     import tracemalloc
 
     if not tracemalloc.is_tracing():
         tracemalloc.start()
-        _heap_started = True
         return (
             "# tracemalloc started; allocations are now being traced — "
             "re-request this endpoint to see a snapshot\n"

@@ -230,9 +230,31 @@ def reduce_canonical_l(ctx: MontCtx, xs: Sequence[jax.Array], times: int) -> Lis
 # ---------------------------------------------------------------------------
 
 
+import contextlib as _contextlib
+import threading as _threading
+
+_cios_override = _threading.local()
+
+
+@_contextlib.contextmanager
+def force_looped_cios():
+    """Trace-time override: use the looped CIOS inside this context even
+    off-CPU. The pairing kernel traces hundreds of stacked multiplies
+    inside scan bodies; unrolled CIOS there produces graphs big enough
+    that the remote compile service drops them."""
+    prev = getattr(_cios_override, "looped", False)
+    _cios_override.looped = True
+    try:
+        yield
+    finally:
+        _cios_override.looped = prev
+
+
 def _cios_unrolled() -> bool:
     import os
 
+    if getattr(_cios_override, "looped", False):
+        return False
     forced = os.environ.get("FABRIC_TPU_CIOS_UNROLL", "")
     if forced == "1":
         return True

@@ -1,0 +1,427 @@
+"""Device Fp2/Fp6/Fp12 tower for the FP256BN pairing (Idemix).
+
+Mirrors the host oracle's representation EXACTLY
+(fabric_tpu/crypto/fp256bn.py): Fp2 = Fp[i]/(i^2+1) as (re, im);
+Fp12 = Fp2[w]/(w^6 - xi) as 6 Fp2 coefficients, xi = 1 + i.  Every
+device value is bit-comparable to the oracle after Montgomery decode,
+which is what the differential tests pin.
+
+The trace/compile discipline (the whole reason this module exists
+instead of naive per-Fp mont_mul calls): every tower operation gathers
+ALL of its independent Fp products and runs them as ONE stacked
+`mont_mul_l` over a (K, *batch) axis — an Fp12 multiply is one 108-lane
+Montgomery multiply, not 108 sequential ones.  Keep that invariant when
+extending: one mont_mul_l per tower op.
+
+Elements are FE tuples (fabric_tpu.ops.fieldops) in Montgomery form
+with tracked lazy-reduction bounds; batch shape is uniform across all
+limbs (constants are broadcast on entry).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from fabric_tpu.crypto import fp256bn as host
+from fabric_tpu.ops import bignum as bn
+from fabric_tpu.ops.fieldops import FE
+
+CTX = bn.MontCtx(host.P)
+_R = 1 << bn.RADIX_BITS
+
+Fp2 = Tuple[FE, FE]
+Fp12 = Tuple[Fp2, Fp2, Fp2, Fp2, Fp2, Fp2]
+
+
+# ---------------------------------------------------------------------------
+# Fp helpers (stacked-multiply core)
+# ---------------------------------------------------------------------------
+
+
+def to_mont_int(v: int) -> np.ndarray:
+    return bn.int_to_limbs((v * _R) % host.P)
+
+
+def fe_const(v: int, like) -> FE:
+    """Host integer -> broadcast Montgomery FE."""
+    return FE(tuple(bn.bcast_l(to_mont_int(v), like)), 1)
+
+
+def fe_zero(like) -> FE:
+    return FE(tuple(bn.bcast_l(bn.int_to_limbs(0), like)), 1)
+
+
+def mul_many(pairs: Sequence[Tuple[FE, FE]]) -> List[FE]:
+    """K independent Fp products in ONE Montgomery multiply."""
+    if not pairs:
+        return []
+    for a, b in pairs:
+        assert a.bound * b.bound <= 16, (a.bound, b.bound)
+    a_st = tuple(
+        jnp.stack([p[0].limbs[i] for p in pairs]) for i in range(bn.NLIMBS)
+    )
+    b_st = tuple(
+        jnp.stack([p[1].limbs[i] for p in pairs]) for i in range(bn.NLIMBS)
+    )
+    out = bn.mont_mul_l(CTX, a_st, b_st, nreduce=1)
+    return [
+        FE(tuple(out[i][k] for i in range(bn.NLIMBS)), 1)
+        for k in range(len(pairs))
+    ]
+
+
+def fe_add(a: FE, b: FE) -> FE:
+    assert a.bound + b.bound <= 8, (a.bound, b.bound)
+    return FE(tuple(bn.add_raw_l(a.limbs, b.limbs)), a.bound + b.bound)
+
+
+def fe_sub(a: FE, b: FE) -> FE:
+    return FE(
+        tuple(
+            bn.sub_mod_l(CTX, a.limbs, b.limbs, b.bound, nreduce=a.bound + b.bound - 1)
+        ),
+        1,
+    )
+
+
+def fe_norm(a: FE) -> FE:
+    if a.bound == 1:
+        return a
+    return FE(tuple(bn.reduce_canonical_l(CTX, a.limbs, a.bound - 1)), 1)
+
+
+def fe_neg(a: FE, like) -> FE:
+    return fe_sub(fe_zero(like), a)
+
+
+def fe_select(cond, a: FE, b: FE) -> FE:
+    """Per-lane select between two canonical FEs."""
+    a, b = fe_norm(a), fe_norm(b)
+    return FE(
+        tuple(jnp.where(cond, x, y) for x, y in zip(a.limbs, b.limbs)), 1
+    )
+
+
+def fe_equal(a: FE, b: FE):
+    """Canonical equality mask. Inputs are reduced to the unique
+    representative (< p) before comparison."""
+    a = FE(tuple(bn.reduce_canonical_l(CTX, fe_norm(a).limbs, 1)), 1)
+    b = FE(tuple(bn.reduce_canonical_l(CTX, fe_norm(b).limbs, 1)), 1)
+    eq = None
+    for x, y in zip(a.limbs, b.limbs):
+        e = x == y
+        eq = e if eq is None else (eq & e)
+    return eq
+
+
+# ---------------------------------------------------------------------------
+# Fp2 (operand collection: most Fp2 ops defer their products to the
+# caller's stacked multiply via *_pairs/*_fold helpers)
+# ---------------------------------------------------------------------------
+
+
+def fp2_add(x: Fp2, y: Fp2) -> Fp2:
+    return (fe_add(x[0], y[0]), fe_add(x[1], y[1]))
+
+
+def fp2_sub(x: Fp2, y: Fp2) -> Fp2:
+    return (fe_sub(x[0], y[0]), fe_sub(x[1], y[1]))
+
+
+def fp2_neg(x: Fp2, like) -> Fp2:
+    return (fe_neg(x[0], like), fe_neg(x[1], like))
+
+
+def fp2_norm(x: Fp2) -> Fp2:
+    return (fe_norm(x[0]), fe_norm(x[1]))
+
+
+def fp2_mul_xi(x: Fp2) -> Fp2:
+    """x * (1 + i) = (re - im) + (re + im) i."""
+    re, im = x
+    return (fe_sub(re, im), fe_norm(fe_add(re, im)))
+
+
+def _karatsuba_pairs(x: Fp2, y: Fp2):
+    """The 3 Fp products of one Fp2 multiply (Karatsuba)."""
+    return [
+        (x[0], y[0]),
+        (x[1], y[1]),
+        (fe_norm(fe_add(x[0], x[1])), fe_norm(fe_add(y[0], y[1]))),
+    ]
+
+
+def _karatsuba_fold(p0: FE, p1: FE, p2: FE) -> Fp2:
+    """(re, im) from the 3 products: re = p0 - p1, im = p2 - p0 - p1."""
+    return (fe_sub(p0, p1), fe_sub(fe_sub(p2, p0), p1))
+
+
+def fp2_mul(x: Fp2, y: Fp2) -> Fp2:
+    out = mul_many(_karatsuba_pairs(x, y))
+    return _karatsuba_fold(*out)
+
+
+def fp2_conj(x: Fp2, like) -> Fp2:
+    return (x[0], fe_neg(x[1], like))
+
+
+def fp2_select(cond, x: Fp2, y: Fp2) -> Fp2:
+    return (fe_select(cond, x[0], y[0]), fe_select(cond, x[1], y[1]))
+
+
+# ---------------------------------------------------------------------------
+# Fp12
+# ---------------------------------------------------------------------------
+
+
+def fp12_zero(like) -> Fp12:
+    z = (fe_zero(like), fe_zero(like))
+    return (z,) * 6
+
+
+def fp12_one(like) -> Fp12:
+    one = (fe_const(1, like), fe_zero(like))
+    z = (fe_zero(like), fe_zero(like))
+    return (one, z, z, z, z, z)
+
+
+def fp12_from_host(v: host.Fp12, like) -> Fp12:
+    return tuple(
+        (fe_const(c[0], like), fe_const(c[1], like)) for c in v
+    )
+
+
+def fp12_add(x: Fp12, y: Fp12) -> Fp12:
+    return tuple(fp2_add(a, b) for a, b in zip(x, y))
+
+
+def fp12_norm(x: Fp12) -> Fp12:
+    return tuple(fp2_norm(c) for c in x)
+
+
+def fp12_conj(x: Fp12, like) -> Fp12:
+    return (
+        x[0],
+        fp2_neg(x[1], like),
+        x[2],
+        fp2_neg(x[3], like),
+        x[4],
+        fp2_neg(x[5], like),
+    )
+
+
+def fp12_select(cond, x: Fp12, y: Fp12) -> Fp12:
+    return tuple(fp2_select(cond, a, b) for a, b in zip(x, y))
+
+
+def fp12_mul(x: Fp12, y: Fp12) -> Fp12:
+    """Schoolbook 6x6 over Fp2 with the w^6 = xi fold — 36 Fp2 products
+    = 108 Fp products in ONE stacked multiply (mirrors host fp12_mul's
+    accumulation order so values match bit-for-bit)."""
+    pairs = []
+    for i in range(6):
+        for j in range(6):
+            pairs.extend(_karatsuba_pairs(x[i], y[j]))
+    prods = mul_many(pairs)
+    acc: List = [None] * 11
+    k = 0
+    for i in range(6):
+        for j in range(6):
+            p = _karatsuba_fold(prods[k], prods[k + 1], prods[k + 2])
+            k += 3
+            idx = i + j
+            acc[idx] = p if acc[idx] is None else fp2_add(acc[idx], p)
+    out = []
+    for k in range(6):
+        c = acc[k]
+        if k + 6 <= 10 and acc[k + 6] is not None:
+            c = fp2_add(c, fp2_mul_xi(fp2_norm(acc[k + 6])))
+        out.append(fp2_norm(c))
+    return tuple(out)
+
+
+def fp12_sqr(x: Fp12) -> Fp12:
+    return fp12_mul(x, x)
+
+
+# frobenius constants (host _FROB_GAMMA), Montgomery-encoded lazily
+def _frob_gamma(n: int):
+    return host._FROB_GAMMA[n % 12]
+
+
+def fp12_frobenius(x: Fp12, n: int, like) -> Fp12:
+    """Mirrors host fp12_frobenius: conjugate n%2 times, then multiply
+    coefficient k by gamma_{n,k}."""
+    gammas = _frob_gamma(n)
+    coeffs = []
+    pairs = []
+    for k in range(6):
+        c = x[k]
+        if n % 2 == 1:
+            c = fp2_conj(c, like)
+        g = (fe_const(gammas[k][0], like), fe_const(gammas[k][1], like))
+        pairs.extend(_karatsuba_pairs(c, g))
+        coeffs.append(None)
+    prods = mul_many(pairs)
+    out = []
+    for k in range(6):
+        out.append(_karatsuba_fold(*prods[3 * k : 3 * k + 3]))
+    return tuple(out)
+
+
+# ---------------------------------------------------------------------------
+# Inversion (norm chain, mirrors host fp12_inv/_fp6_inv/fp2_inv)
+# ---------------------------------------------------------------------------
+
+_P_MINUS_2_BITS = np.array(
+    [int(b) for b in bin(host.P - 2)[2:]], dtype=np.uint32
+)
+
+
+def fe_inv(a: FE, like) -> FE:
+    """a^(p-2) by square-and-multiply over the fixed exponent bits
+    (lax.scan; MSB-first like the host's pow)."""
+    from jax import lax
+
+    a = fe_norm(a)
+    out = fe_const(1, like)
+
+    a_st = bn.restack(list(a.limbs))
+
+    def body(carry, bit):
+        o = FE(tuple(carry), 1)
+        o2 = mul_many([(o, o)])[0]
+        a_fe = FE(tuple(a_st[i] for i in range(bn.NLIMBS)), 1)
+        o2a = mul_many([(o2, a_fe)])[0]
+        nxt = fe_select(bit.astype(bool), o2a, o2)
+        return tuple(nxt.limbs), None
+
+    bits = jnp.asarray(_P_MINUS_2_BITS)
+    carry, _ = lax.scan(body, tuple(out.limbs), bits)
+    return FE(tuple(carry), 1)
+
+
+def fp2_inv(x: Fp2, like) -> Fp2:
+    """conj(x) / (re^2 + im^2)."""
+    p = mul_many([(x[0], x[0]), (x[1], x[1])])
+    norm = fe_norm(fe_add(p[0], p[1]))
+    ninv = fe_inv(norm, like)
+    out = mul_many([(x[0], ninv), (fe_neg(x[1], like), ninv)])
+    return (out[0], out[1])
+
+
+def _fp6_mul(x, y) -> Tuple[Fp2, Fp2, Fp2]:
+    """Mirror of host _fp6_mul over v = w^2 (v^3 = xi)."""
+    a0, a1, a2 = x
+    b0, b1, b2 = y
+    t0 = fp2_mul(a0, b0)
+    t1 = fp2_add(fp2_mul(a0, b1), fp2_mul(a1, b0))
+    t2 = fp2_add(
+        fp2_add(fp2_mul(a0, b2), fp2_mul(a1, b1)), fp2_mul(a2, b0)
+    )
+    t3 = fp2_add(fp2_mul(a1, b2), fp2_mul(a2, b1))
+    t4 = fp2_mul(a2, b2)
+    return (
+        fp2_norm(fp2_add(t0, fp2_mul_xi(fp2_norm(t3)))),
+        fp2_norm(fp2_add(t1, fp2_mul_xi(t4))),
+        fp2_norm(t2),
+    )
+
+
+def _fp6_inv(x, like) -> Tuple[Fp2, Fp2, Fp2]:
+    a0, a1, a2 = x
+    c0 = fp2_sub(fp2_mul(a0, a0), fp2_mul_xi(fp2_mul(a1, a2)))
+    c1 = fp2_sub(fp2_mul_xi(fp2_mul(a2, a2)), fp2_mul(a0, a1))
+    c2 = fp2_sub(fp2_mul(a1, a1), fp2_mul(a0, a2))
+    t = fp2_add(
+        fp2_mul_xi(
+            fp2_norm(fp2_add(fp2_mul(a2, c1), fp2_mul(a1, c2)))
+        ),
+        fp2_mul(a0, c0),
+    )
+    ti = fp2_inv(fp2_norm(t), like)
+    return (fp2_mul(c0, ti), fp2_mul(c1, ti), fp2_mul(c2, ti))
+
+
+def fp12_inv(x: Fp12, like) -> Fp12:
+    """conj(x) * (x * conj(x))^{-1}, x*conj(x) living in the even
+    subalgebra (host fp12_inv)."""
+    xc = fp12_conj(x, like)
+    ac = fp12_mul(x, xc)
+    inv6 = _fp6_inv((ac[0], ac[2], ac[4]), like)
+    z = (fe_zero(like), fe_zero(like))
+    inv12: Fp12 = (inv6[0], z, inv6[1], z, inv6[2], z)
+    return fp12_mul(xc, inv12)
+
+
+# ---------------------------------------------------------------------------
+# Fixed-exponent power (final-exponentiation hard part)
+# ---------------------------------------------------------------------------
+
+
+def _stack12(x: Fp12) -> jnp.ndarray:
+    """(12, NLIMBS, *batch) canonical stack for scan carries."""
+    rows = []
+    for c in x:
+        rows.append(bn.restack(list(fe_norm(c[0]).limbs)))
+        rows.append(bn.restack(list(fe_norm(c[1]).limbs)))
+    return jnp.stack(rows)
+
+
+def _unstack12(a) -> Fp12:
+    out = []
+    for k in range(6):
+        re = FE(tuple(a[2 * k][i] for i in range(bn.NLIMBS)), 1)
+        im = FE(tuple(a[2 * k + 1][i] for i in range(bn.NLIMBS)), 1)
+        out.append((re, im))
+    return tuple(out)
+
+
+def fp12_pow_const(x: Fp12, e: int, like) -> Fp12:
+    """x^e for a compile-time exponent, MSB-first square-and-multiply in
+    a lax.scan (bit-exact mirror of host fp12_pow)."""
+    from jax import lax
+
+    assert e > 0
+    bits = jnp.asarray(
+        np.array([int(b) for b in bin(e)[2:]], dtype=np.uint32)
+    )
+    x_st = _stack12(x)
+
+    def body(carry, bit):
+        o = _unstack12(carry)
+        o2 = fp12_sqr(o)
+        o2x = fp12_mul(o2, _unstack12(x_st))
+        nxt = fp12_select(bit.astype(bool), o2x, o2)
+        return _stack12(nxt), None
+
+    carry, _ = lax.scan(body, _stack12(fp12_one(like)), bits)
+    return _unstack12(carry)
+
+
+def fp12_equal(x: Fp12, y: Fp12):
+    eq = None
+    for cx, cy in zip(x, y):
+        for fx, fy in zip(cx, cy):
+            e = fe_equal(fx, fy)
+            eq = e if eq is None else (eq & e)
+    return eq
+
+
+def fp12_to_host(x: Fp12) -> host.Fp12:
+    """Device -> host value (decodes Montgomery form; for tests)."""
+    out = []
+    for c in x:
+        pair = []
+        for f in c:
+            limbs = bn.from_mont_l(CTX, fe_norm(f).limbs)
+            limbs = bn.reduce_canonical_l(CTX, limbs, 1)
+            v = 0
+            for i in reversed(range(bn.NLIMBS)):
+                v = (v << bn.LIMB_BITS) | int(np.asarray(limbs[i]).reshape(-1)[0])
+            pair.append(v % host.P)
+        out.append((pair[0], pair[1]))
+    return tuple(out)

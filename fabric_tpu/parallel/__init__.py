@@ -25,9 +25,10 @@ from fabric_tpu.parallel.mesh import (
 from fabric_tpu.parallel.sharded import ShardedVerify
 from fabric_tpu.parallel.provider import MeshTPUProvider
 from fabric_tpu.parallel.multichannel import MultiChannelValidator
-from fabric_tpu.parallel.batcher import VerifyBatcher
+from fabric_tpu.parallel.batcher import BatchingProvider, VerifyBatcher
 
 __all__ = [
+    "BatchingProvider",
     "CHANNEL_AXIS",
     "DATA_AXIS",
     "flat_mesh",

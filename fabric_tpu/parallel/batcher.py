@@ -65,6 +65,7 @@ class VerifyBatcher:
         self.max_batch = max_batch
         self.linger_s = linger_s
         self._q: "queue.Queue[Optional[_Request]]" = queue.Queue()
+        self._stop_lock = threading.Lock()
         self._max_pending_lanes = max_pending_lanes
         # all-or-nothing admission under one condition variable: a
         # per-lane semaphore loop would let two concurrent large submits
@@ -85,8 +86,6 @@ class VerifyBatcher:
         signatures: Sequence[bytes],
         digests: Sequence[bytes],
     ) -> Callable[[], List[bool]]:
-        if self._stopped:
-            raise RuntimeError("batcher stopped")
         n = len(keys)
         if n == 0:
             return list
@@ -99,7 +98,15 @@ class VerifyBatcher:
             while self._lanes_free < req.permits:
                 self._lanes_cv.wait()
             self._lanes_free -= req.permits
-        self._q.put(req)
+        # the stop lock orders every put against the stop sentinel: no
+        # request can land behind the None the dispatcher exits on
+        with self._stop_lock:
+            if self._stopped:
+                with self._lanes_cv:
+                    self._lanes_free += req.permits
+                    self._lanes_cv.notify_all()
+                raise RuntimeError("batcher stopped")
+            self._q.put(req)
         return req.resolve
 
     def verify_batch(self, keys, signatures, digests) -> List[bool]:
@@ -152,7 +159,14 @@ class VerifyBatcher:
                 self._lanes_free += sum(r.permits for r in batch)
                 self._lanes_cv.notify_all()
             try:
-                resolver = self.provider.batch_verify_async(keys, sigs, digests)
+                dispatch = getattr(self.provider, "batch_verify_async", None)
+                if dispatch is None:
+                    # sync-only provider (e.g. SoftwareProvider): compute
+                    # now, hand back a trivial resolver
+                    verdicts = self.provider.batch_verify(keys, sigs, digests)
+                    resolver = lambda v=verdicts: v  # noqa: E731
+                else:
+                    resolver = dispatch(keys, sigs, digests)
             except BaseException as exc:  # noqa: BLE001 - propagate to callers
                 for r in batch:
                     r.error = exc
@@ -189,6 +203,30 @@ class VerifyBatcher:
             r.event.set()
 
     def stop(self) -> None:
-        self._stopped = True
-        self._q.put(None)
+        with self._stop_lock:
+            self._stopped = True
+            self._q.put(None)
         self._thread.join(timeout=10.0)
+
+
+class BatchingProvider:
+    """BCCSP-provider adapter over a shared VerifyBatcher: every channel
+    validator on the node funnels its batch_verify through ONE batcher
+    (and thus one device-launch queue), while single verify/sign/hash
+    calls pass straight through to the wrapped provider."""
+
+    def __init__(self, provider, **batcher_kwargs):
+        self._provider = provider
+        self.batcher = VerifyBatcher(provider, **batcher_kwargs)
+
+    def batch_verify(self, keys, signatures, digests):
+        return self.batcher.verify_batch(keys, signatures, digests)
+
+    def batch_verify_async(self, keys, signatures, digests):
+        return self.batcher.submit(keys, signatures, digests)
+
+    def stop(self) -> None:
+        self.batcher.stop()
+
+    def __getattr__(self, name):
+        return getattr(self._provider, name)

@@ -163,3 +163,24 @@ def test_with_real_tpu_provider():
         assert bad() == [False]
     finally:
         b.stop()
+
+
+def test_batching_provider_adapter():
+    """BatchingProvider: batch paths route through the shared batcher,
+    everything else passes through to the wrapped provider."""
+    from fabric_tpu.parallel.batcher import BatchingProvider
+
+    prov = FakeProvider()
+    bp = BatchingProvider(prov, linger_s=0.001)
+    try:
+        assert bp.batch_verify([b"ok", b"no"], [b"s"] * 2, [b"d"] * 2) == [
+            True,
+            False,
+        ]
+        resolver = bp.batch_verify_async([b"ok"], [b"s"], [b"d"])
+        assert resolver() == [True]
+        # passthrough of non-batch attributes
+        assert bp.launch_sizes == prov.launch_sizes
+        assert bp.batcher.lanes == 3
+    finally:
+        bp.stop()
