@@ -296,9 +296,10 @@ def bench_idemix(n_sigs=8):
         "sigs": n_sigs,
         "host_ms_per_sig": round(host_ms / n_sigs, 1),
     }
-    # The device Ate2 kernel's FIRST compile is long (tens of minutes on
-    # a cold cache); opt in so an unattended bench run can't stall on it.
-    if os.environ.get("BENCH_IDEMIX_DEVICE", "") == "1":
+    # The device Ate2 kernel's first compile is ~3.5 min on the TPU
+    # (then cached; this bench's issuer key is seed-fixed so the program
+    # caches across runs). BENCH_IDEMIX_DEVICE=0 opts out.
+    if os.environ.get("BENCH_IDEMIX_DEVICE", "1") == "1":
         run(True)  # compile warmup
         dev_ms, dev_out = run(True)
         if dev_out != host_out:
@@ -307,7 +308,7 @@ def bench_idemix(n_sigs=8):
         result["speedup"] = round(host_ms / dev_ms, 1)
         result["mask_bit_exact"] = True
     else:
-        result["device"] = "skipped (set BENCH_IDEMIX_DEVICE=1)"
+        result["device"] = "skipped (BENCH_IDEMIX_DEVICE=0)"
     return result
 
 

@@ -286,18 +286,12 @@ def channel_cmd(args) -> int:
 
 def _last_config_number(block) -> int:
     """LastConfig.index from the SIGNATURES metadata (fetch.go `config`
-    selector: newest block points at the latest config block)."""
-    from fabric_tpu.protos import protoutil
+    selector: newest block points at the latest config block).
+    Malformed metadata falls back to 0, like the block writer's own
+    recovery parse."""
+    from fabric_tpu.orderer.raft_chain import _last_config_index
 
-    metas = block.metadata.metadata
-    if len(metas) > common_pb2.SIGNATURES and metas[common_pb2.SIGNATURES]:
-        meta = protoutil.unmarshal(
-            common_pb2.Metadata, metas[common_pb2.SIGNATURES]
-        )
-        if meta.value:
-            lc = protoutil.unmarshal(common_pb2.LastConfig, meta.value)
-            return lc.index
-    return 0
+    return _last_config_index(block)
 
 
 def _fetch_block(

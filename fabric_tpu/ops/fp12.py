@@ -1,43 +1,46 @@
 """Device Fp2/Fp6/Fp12 tower for the FP256BN pairing (Idemix).
 
-Mirrors the host oracle's representation EXACTLY
-(fabric_tpu/crypto/fp256bn.py): Fp2 = Fp[i]/(i^2+1) as (re, im);
-Fp12 = Fp2[w]/(w^6 - xi) as 6 Fp2 coefficients, xi = 1 + i.  Every
-device value is bit-comparable to the oracle after Montgomery decode,
-which is what the differential tests pin.
+Mirrors the host oracle's value representation EXACTLY
+(fabric_tpu/crypto/fp256bn.py): Fp2 = Fp[i]/(i^2+1); Fp12 =
+Fp2[w]/(w^6 - xi) as 6 Fp2 coefficients, xi = 1 + i.  Every device
+value decodes (Montgomery) to the oracle's integers — pinned by the
+differential tests.
 
-The trace/compile discipline (the whole reason this module exists
-instead of naive per-Fp mont_mul calls): every tower operation gathers
-ALL of its independent Fp products and runs them as ONE stacked
-`mont_mul_l` over a (K, *batch) axis — an Fp12 multiply is one 108-lane
-Montgomery multiply, not 108 sequential ones.  Keep that invariant when
-extending: one mont_mul_l per tower op.
+Layout (the whole point of this module): an Fp12 is a ROW-STACKED limb
+tuple — NLIMBS arrays of shape (12, *batch), row order
+[c0.re, c0.im, c1.re, c1.im, ...].  Tower ops act on whole row groups:
+an Fp12 multiply is ONE row gather (the 108 Karatsuba operands), ONE
+stacked Montgomery multiply, and a handful of vectorized fold ops —
+not hundreds of per-coefficient calls.  That keeps the traced graph
+small enough for the remote TPU compiler (the per-element FE version
+of this module was SIGKILLed there) and maps the work onto wide
+batched ops the MXU/VPU like.
 
-Elements are FE tuples (fabric_tpu.ops.fieldops) in Montgomery form
-with tracked lazy-reduction bounds; batch shape is uniform across all
-limbs (constants are broadcast on entry).
+Lazy-reduction bounds are static per row group and tracked by hand in
+the code below (value < bound·p; limb arrays stay 13-bit canonical via
+carries). bound 1 == canonical (< p).
 """
 
 from __future__ import annotations
 
 from typing import List, Sequence, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
 from fabric_tpu.crypto import fp256bn as host
 from fabric_tpu.ops import bignum as bn
-from fabric_tpu.ops.fieldops import FE
 
 CTX = bn.MontCtx(host.P)
 _R = 1 << bn.RADIX_BITS
 
-Fp2 = Tuple[FE, FE]
-Fp12 = Tuple[Fp2, Fp2, Fp2, Fp2, Fp2, Fp2]
+# A row-stacked value: tuple of NLIMBS arrays, each (R, *batch).
+Rows = Tuple[jax.Array, ...]
 
 
 # ---------------------------------------------------------------------------
-# Fp helpers (stacked-multiply core)
+# row-group primitives
 # ---------------------------------------------------------------------------
 
 
@@ -45,235 +48,288 @@ def to_mont_int(v: int) -> np.ndarray:
     return bn.int_to_limbs((v * _R) % host.P)
 
 
-def fe_const(v: int, like) -> FE:
-    """Host integer -> broadcast Montgomery FE."""
-    return FE(tuple(bn.bcast_l(to_mont_int(v), like)), 1)
-
-
-def fe_zero(like) -> FE:
-    return FE(tuple(bn.bcast_l(bn.int_to_limbs(0), like)), 1)
-
-
-def mul_many(pairs: Sequence[Tuple[FE, FE]]) -> List[FE]:
-    """K independent Fp products in ONE Montgomery multiply."""
-    if not pairs:
-        return []
-    for a, b in pairs:
-        assert a.bound * b.bound <= 16, (a.bound, b.bound)
-    a_st = tuple(
-        jnp.stack([p[0].limbs[i] for p in pairs]) for i in range(bn.NLIMBS)
-    )
-    b_st = tuple(
-        jnp.stack([p[1].limbs[i] for p in pairs]) for i in range(bn.NLIMBS)
-    )
-    out = bn.mont_mul_l(CTX, a_st, b_st, nreduce=1)
-    return [
-        FE(tuple(out[i][k] for i in range(bn.NLIMBS)), 1)
-        for k in range(len(pairs))
-    ]
-
-
-def fe_add(a: FE, b: FE) -> FE:
-    assert a.bound + b.bound <= 8, (a.bound, b.bound)
-    return FE(tuple(bn.add_raw_l(a.limbs, b.limbs)), a.bound + b.bound)
-
-
-def fe_sub(a: FE, b: FE) -> FE:
-    return FE(
-        tuple(
-            bn.sub_mod_l(CTX, a.limbs, b.limbs, b.bound, nreduce=a.bound + b.bound - 1)
-        ),
-        1,
+def const_rows(values: Sequence[int], like) -> Rows:
+    """Host integers -> (len(values), *batch) Montgomery rows."""
+    mat = np.stack([to_mont_int(v) for v in values])  # (R, NLIMBS)
+    return tuple(
+        jnp.broadcast_to(
+            jnp.asarray(mat[:, i])[(...,) + (None,) * like.ndim],
+            (mat.shape[0],) + like.shape,
+        )
+        for i in range(bn.NLIMBS)
     )
 
 
-def fe_norm(a: FE) -> FE:
-    if a.bound == 1:
-        return a
-    return FE(tuple(bn.reduce_canonical_l(CTX, a.limbs, a.bound - 1)), 1)
-
-
-def fe_neg(a: FE, like) -> FE:
-    return fe_sub(fe_zero(like), a)
-
-
-def fe_select(cond, a: FE, b: FE) -> FE:
-    """Per-lane select between two canonical FEs."""
-    a, b = fe_norm(a), fe_norm(b)
-    return FE(
-        tuple(jnp.where(cond, x, y) for x, y in zip(a.limbs, b.limbs)), 1
+def rows_of(mat, like) -> Rows:
+    """(R, NLIMBS) traced/const array -> broadcast Rows."""
+    r = mat.shape[0]
+    return tuple(
+        jnp.broadcast_to(
+            mat[:, i][(...,) + (None,) * like.ndim], (r,) + like.shape
+        )
+        for i in range(bn.NLIMBS)
     )
 
 
-def fe_equal(a: FE, b: FE):
-    """Canonical equality mask. Inputs are reduced to the unique
-    representative (< p) before comparison."""
-    a = FE(tuple(bn.reduce_canonical_l(CTX, fe_norm(a).limbs, 1)), 1)
-    b = FE(tuple(bn.reduce_canonical_l(CTX, fe_norm(b).limbs, 1)), 1)
+def rslice(x: Rows, sl) -> Rows:
+    return tuple(l[sl] for l in x)
+
+
+def rcat(*xs: Rows) -> Rows:
+    return tuple(
+        jnp.concatenate(parts, axis=0) for parts in zip(*xs)
+    )
+
+
+def rgather(x: Rows, idx: np.ndarray) -> Rows:
+    return tuple(l[idx] for l in x)
+
+
+def rcarry(x: Sequence[jax.Array]) -> Rows:
+    limbs, top = bn.carry_l(list(x))
+    # top carry must be zero for in-range values; it is by the bound
+    # bookkeeping (values < 16p < 2^260)
+    return tuple(limbs)
+
+
+def radd(a: Rows, b: Rows) -> Rows:
+    """a + b, limb-canonical (bounds add)."""
+    return rcarry([x + y for x, y in zip(a, b)])
+
+
+def rsub(a: Rows, b: Rows, b_bound: int, nreduce: int) -> Rows:
+    """a - b (+ b_bound·p), reduced to canonical."""
+    return tuple(bn.sub_mod_l(CTX, a, b, b_bound, nreduce=nreduce))
+
+
+def rreduce(x: Rows, times: int) -> Rows:
+    return tuple(bn.reduce_canonical_l(CTX, x, times))
+
+
+def rmul(a: Rows, b: Rows) -> Rows:
+    """Stacked Montgomery product, canonical output."""
+    return tuple(bn.mont_mul_l(CTX, a, b, nreduce=1))
+
+
+def rzero(r: int, like) -> Rows:
+    return tuple(
+        jnp.zeros((r,) + like.shape, dtype=jnp.uint32)
+        for _ in range(bn.NLIMBS)
+    )
+
+
+def rselect(cond, a: Rows, b: Rows) -> Rows:
+    """Per-lane select (cond broadcasts against (R, *batch))."""
+    return tuple(jnp.where(cond, x, y) for x, y in zip(a, b))
+
+
+def requal_all(a: Rows, b: Rows):
+    """All rows, all limbs equal -> per-lane mask (inputs canonical)."""
     eq = None
-    for x, y in zip(a.limbs, b.limbs):
-        e = x == y
+    for x, y in zip(a, b):
+        e = (x == y).all(axis=0)
         eq = e if eq is None else (eq & e)
     return eq
 
 
 # ---------------------------------------------------------------------------
-# Fp2 (operand collection: most Fp2 ops defer their products to the
-# caller's stacked multiply via *_pairs/*_fold helpers)
+# Fp12 = 12 rows
 # ---------------------------------------------------------------------------
 
+# Karatsuba operand gather: x_ext rows = [12 coeff rows] + [6 sum rows]
+# (sum row k = c_k.re + c_k.im). Product triple for (i, j):
+#   p0 = x[2i]·y[2j], p1 = x[2i+1]·y[2j+1], p2 = xs[12+i]·ys[12+j]
+_IA = np.array(
+    [k for i in range(6) for j in range(6) for k in (2 * i, 2 * i + 1, 12 + i)],
+    dtype=np.int32,
+)
+_IB = np.array(
+    [k for i in range(6) for j in range(6) for k in (2 * j, 2 * j + 1, 12 + j)],
+    dtype=np.int32,
+)
 
-def fp2_add(x: Fp2, y: Fp2) -> Fp2:
-    return (fe_add(x[0], y[0]), fe_add(x[1], y[1]))
-
-
-def fp2_sub(x: Fp2, y: Fp2) -> Fp2:
-    return (fe_sub(x[0], y[0]), fe_sub(x[1], y[1]))
-
-
-def fp2_neg(x: Fp2, like) -> Fp2:
-    return (fe_neg(x[0], like), fe_neg(x[1], like))
-
-
-def fp2_norm(x: Fp2) -> Fp2:
-    return (fe_norm(x[0]), fe_norm(x[1]))
-
-
-def fp2_mul_xi(x: Fp2) -> Fp2:
-    """x * (1 + i) = (re - im) + (re + im) i."""
-    re, im = x
-    return (fe_sub(re, im), fe_norm(fe_add(re, im)))
-
-
-def _karatsuba_pairs(x: Fp2, y: Fp2):
-    """The 3 Fp products of one Fp2 multiply (Karatsuba)."""
-    return [
-        (x[0], y[0]),
-        (x[1], y[1]),
-        (fe_norm(fe_add(x[0], x[1])), fe_norm(fe_add(y[0], y[1]))),
+# accumulation: Fp2 product (i,j) lands on coefficient i+j (0..10);
+# pad each coefficient's term list to 6 with a zero row (index 36)
+_ACC_IDX = np.full((11, 6), 36, dtype=np.int32)
+for _l in range(11):
+    _terms = [
+        _i * 6 + _j
+        for _i in range(6)
+        for _j in range(6)
+        if _i + _j == _l
     ]
+    _ACC_IDX[_l, : len(_terms)] = _terms
 
 
-def _karatsuba_fold(p0: FE, p1: FE, p2: FE) -> Fp2:
-    """(re, im) from the 3 products: re = p0 - p1, im = p2 - p0 - p1."""
-    return (fe_sub(p0, p1), fe_sub(fe_sub(p2, p0), p1))
+def fp12_one(like) -> Rows:
+    return const_rows([1] + [0] * 11, like)
 
 
-def fp2_mul(x: Fp2, y: Fp2) -> Fp2:
-    out = mul_many(_karatsuba_pairs(x, y))
-    return _karatsuba_fold(*out)
+def fp12_from_host(v: host.Fp12, like) -> Rows:
+    vals: List[int] = []
+    for c in v:
+        vals.extend([c[0], c[1]])
+    return const_rows(vals, like)
 
 
-def fp2_conj(x: Fp2, like) -> Fp2:
-    return (x[0], fe_neg(x[1], like))
+def _ext(v: Rows) -> Rows:
+    """Append the 6 Karatsuba sum rows (c_k.re + c_k.im, bound 2)."""
+    sums = rcarry([l[0::2] + l[1::2] for l in v])
+    return rcat(v, sums)
 
 
-def fp2_select(cond, x: Fp2, y: Fp2) -> Fp2:
-    return (fe_select(cond, x[0], y[0]), fe_select(cond, x[1], y[1]))
+def _karatsuba_fold(prods: Rows) -> Tuple[Rows, Rows]:
+    """(3K, B) product triples -> (K, B) canonical (re, im) rows."""
+    p0 = rslice(prods, np.s_[0::3])
+    p1 = rslice(prods, np.s_[1::3])
+    p2 = rslice(prods, np.s_[2::3])
+    re = rsub(p0, p1, 1, 1)
+    im = rsub(p2, radd(p0, p1), 2, 2)
+    return re, im
 
 
-# ---------------------------------------------------------------------------
-# Fp12
-# ---------------------------------------------------------------------------
-
-
-def fp12_zero(like) -> Fp12:
-    z = (fe_zero(like), fe_zero(like))
-    return (z,) * 6
-
-
-def fp12_one(like) -> Fp12:
-    one = (fe_const(1, like), fe_zero(like))
-    z = (fe_zero(like), fe_zero(like))
-    return (one, z, z, z, z, z)
-
-
-def fp12_from_host(v: host.Fp12, like) -> Fp12:
+def _combine(are: Rows, aim: Rows) -> Rows:
+    """(11, B) canonical Fp2 accumulators -> 12-row Fp12 with the
+    w^6 = xi fold: out[k] = acc[k] + xi·acc[k+6] (xi = 1+i)."""
+    lo_re, hi_re = rslice(are, np.s_[:5]), rslice(are, np.s_[6:])
+    lo_im, hi_im = rslice(aim, np.s_[:5]), rslice(aim, np.s_[6:])
+    xi_re = rsub(hi_re, hi_im, 1, 1)
+    xi_im = radd(hi_re, hi_im)  # bound 2
+    out_re = rreduce(radd(lo_re, xi_re), 1)  # (5, B)
+    out_im = rreduce(radd(lo_im, xi_im), 2)
+    full_re = rcat(out_re, rslice(are, np.s_[5:6]))  # (6, B)
+    full_im = rcat(out_im, rslice(aim, np.s_[5:6]))
+    # interleave re/im rows back to [c0.re, c0.im, ...]
     return tuple(
-        (fe_const(c[0], like), fe_const(c[1], like)) for c in v
+        jnp.stack([r, i], axis=1).reshape((12,) + r.shape[1:])
+        for r, i in zip(full_re, full_im)
     )
 
 
-def fp12_add(x: Fp12, y: Fp12) -> Fp12:
-    return tuple(fp2_add(a, b) for a, b in zip(x, y))
+def fp12_mul(x: Rows, y: Rows) -> Rows:
+    """One gather + one stacked Montgomery multiply + vectorized folds.
+    x, y canonical (bound 1)."""
+    lhs = rgather(_ext(x), _IA)  # (108, B); sum rows bound 2
+    rhs = rgather(_ext(y), _IB)
+    re, im = _karatsuba_fold(rmul(lhs, rhs))  # (36, B)
+
+    def acc(v: Rows) -> Rows:
+        ve = rcat(v, rzero(1, v[0][0]))  # zero pad row 36
+        gathered = rgather(ve, _ACC_IDX)  # (11, 6, B)
+        summed = rcarry([g.sum(axis=1) for g in gathered])  # bound 6
+        return rreduce(summed, 5)  # canonical (11, B)
+
+    return _combine(acc(re), acc(im))
 
 
-def fp12_norm(x: Fp12) -> Fp12:
-    return tuple(fp2_norm(c) for c in x)
+# squaring: (i,j) and (j,i) products coincide, so only the 21 pairs
+# with i <= j are multiplied (63 rows instead of 108); off-diagonal
+# terms enter the accumulation doubled
+_PAIRS_SQ = [(i, j) for i in range(6) for j in range(i, 6)]
+_IA_SQ = np.array(
+    [k for i, _ in _PAIRS_SQ for k in (2 * i, 2 * i + 1, 12 + i)],
+    dtype=np.int32,
+)
+_IB_SQ = np.array(
+    [k for _, j in _PAIRS_SQ for k in (2 * j, 2 * j + 1, 12 + j)],
+    dtype=np.int32,
+)
+# gather into [plain (21) | doubled (21) | zero]: diagonal pairs use
+# their plain row, off-diagonal pairs their doubled row
+_ACC_SQ = np.full((11, 6), 42, dtype=np.int32)
+for _l in range(11):
+    _terms = [
+        (k if i == j else 21 + k)
+        for k, (i, j) in enumerate(_PAIRS_SQ)
+        if i + j == _l
+    ]
+    _ACC_SQ[_l, : len(_terms)] = _terms
 
 
-def fp12_conj(x: Fp12, like) -> Fp12:
-    return (
-        x[0],
-        fp2_neg(x[1], like),
-        x[2],
-        fp2_neg(x[3], like),
-        x[4],
-        fp2_neg(x[5], like),
+def fp12_sqr(x: Rows) -> Rows:
+    xe = _ext(x)
+    lhs = rgather(xe, _IA_SQ)  # (63, B)
+    rhs = rgather(xe, _IB_SQ)
+    re, im = _karatsuba_fold(rmul(lhs, rhs))  # (21, B)
+
+    def acc(v: Rows) -> Rows:
+        doubled = radd(v, v)  # bound 2
+        ve = rcat(v, doubled, rzero(1, v[0][0]))
+        gathered = rgather(ve, _ACC_SQ)  # (11, 6, B)
+        summed = rcarry(
+            [g.sum(axis=1) for g in gathered]
+        )  # bound <= 6 (≤3 terms of bound ≤2)
+        return rreduce(summed, 5)
+
+    return _combine(acc(re), acc(im))
+
+
+_NEG_ODD = np.array([2, 3, 6, 7, 10, 11])  # rows of odd-w coefficients
+_IM_ROWS = np.array([1, 3, 5, 7, 9, 11])
+
+
+def _negate_rows(x: Rows, rows: np.ndarray) -> Rows:
+    neg = rsub(rzero(len(rows), x[0][0]), rgather(x, rows), 1, 1)
+    # reassemble: gather from [original(12) | negated(len)] with a
+    # static index map
+    idx = np.arange(12)
+    for pos, r in enumerate(rows):
+        idx[r] = 12 + pos
+    return rgather(rcat(x, neg), idx)
+
+
+def fp12_conj(x: Rows) -> Rows:
+    """Negate the odd-w coefficients (= x^(p^6))."""
+    return _negate_rows(x, _NEG_ODD)
+
+
+def fp12_select(cond, x: Rows, y: Rows) -> Rows:
+    return rselect(cond, x, y)
+
+
+def fp12_equal(x: Rows, y: Rows):
+    return requal_all(x, y)
+
+
+def _gamma_rows(n: int) -> np.ndarray:
+    """(24, NLIMBS) rows: per coefficient k the 4 Montgomery constants
+    [g_re, g_im] interleaved for the Fp2 multiply below."""
+    out = []
+    for k in range(6):
+        g = host._FROB_GAMMA[n % 12][k]
+        out.extend([g[0], g[1]])
+    return np.stack([to_mont_int(v) for v in out])  # (12, NLIMBS)
+
+
+def fp12_frobenius(x: Rows, n: int) -> Rows:
+    """x -> x^(p^n): conjugate each Fp2 coefficient n%2 times, then
+    multiply coefficient k by gamma_{n,k} (host fp12_frobenius)."""
+    if n % 2 == 1:
+        x = _negate_rows(x, _IM_ROWS)
+    g = rows_of(jnp.asarray(_gamma_rows(n)), x[0][0])  # (12, B)
+    # Fp2 mul by constants, schoolbook (4 products per coefficient):
+    # re' = re·g_re − im·g_im ; im' = re·g_im + im·g_re
+    re = rgather(x, np.arange(0, 12, 2))
+    im = rgather(x, np.arange(1, 12, 2))
+    gre = rgather(g, np.arange(0, 12, 2))
+    gim = rgather(g, np.arange(1, 12, 2))
+    lhs = rcat(re, im, re, im)  # (24, B)
+    rhs = rcat(gre, gim, gim, gre)
+    p = rmul(lhs, rhs)
+    a = rslice(p, np.s_[0:6])  # re·g_re
+    b = rslice(p, np.s_[6:12])  # im·g_im
+    c = rslice(p, np.s_[12:18])  # re·g_im
+    d = rslice(p, np.s_[18:24])  # im·g_re
+    out_re = rsub(a, b, 1, 1)
+    out_im = rreduce(radd(c, d), 1)
+    return tuple(
+        jnp.stack([r, i], axis=1).reshape((12,) + r.shape[1:])
+        for r, i in zip(out_re, out_im)
     )
-
-
-def fp12_select(cond, x: Fp12, y: Fp12) -> Fp12:
-    return tuple(fp2_select(cond, a, b) for a, b in zip(x, y))
-
-
-def fp12_mul(x: Fp12, y: Fp12) -> Fp12:
-    """Schoolbook 6x6 over Fp2 with the w^6 = xi fold — 36 Fp2 products
-    = 108 Fp products in ONE stacked multiply (mirrors host fp12_mul's
-    accumulation order so values match bit-for-bit)."""
-    pairs = []
-    for i in range(6):
-        for j in range(6):
-            pairs.extend(_karatsuba_pairs(x[i], y[j]))
-    prods = mul_many(pairs)
-    acc: List = [None] * 11
-    k = 0
-    for i in range(6):
-        for j in range(6):
-            p = _karatsuba_fold(prods[k], prods[k + 1], prods[k + 2])
-            k += 3
-            idx = i + j
-            acc[idx] = p if acc[idx] is None else fp2_add(acc[idx], p)
-    out = []
-    for k in range(6):
-        c = acc[k]
-        if k + 6 <= 10 and acc[k + 6] is not None:
-            c = fp2_add(c, fp2_mul_xi(fp2_norm(acc[k + 6])))
-        out.append(fp2_norm(c))
-    return tuple(out)
-
-
-def fp12_sqr(x: Fp12) -> Fp12:
-    return fp12_mul(x, x)
-
-
-# frobenius constants (host _FROB_GAMMA), Montgomery-encoded lazily
-def _frob_gamma(n: int):
-    return host._FROB_GAMMA[n % 12]
-
-
-def fp12_frobenius(x: Fp12, n: int, like) -> Fp12:
-    """Mirrors host fp12_frobenius: conjugate n%2 times, then multiply
-    coefficient k by gamma_{n,k}."""
-    gammas = _frob_gamma(n)
-    coeffs = []
-    pairs = []
-    for k in range(6):
-        c = x[k]
-        if n % 2 == 1:
-            c = fp2_conj(c, like)
-        g = (fe_const(gammas[k][0], like), fe_const(gammas[k][1], like))
-        pairs.extend(_karatsuba_pairs(c, g))
-        coeffs.append(None)
-    prods = mul_many(pairs)
-    out = []
-    for k in range(6):
-        out.append(_karatsuba_fold(*prods[3 * k : 3 * k + 3]))
-    return tuple(out)
 
 
 # ---------------------------------------------------------------------------
-# Inversion (norm chain, mirrors host fp12_inv/_fp6_inv/fp2_inv)
+# Inversion (norm chain, mirrors host fp12_inv / _fp6_inv / fp2_inv)
 # ---------------------------------------------------------------------------
 
 _P_MINUS_2_BITS = np.array(
@@ -281,79 +337,112 @@ _P_MINUS_2_BITS = np.array(
 )
 
 
-def fe_inv(a: FE, like) -> FE:
-    """a^(p-2) by square-and-multiply over the fixed exponent bits
-    (lax.scan; MSB-first like the host's pow)."""
+def _inv1(a: Rows) -> Rows:
+    """Row-wise Fp inverse a^(p-2) (a: (R, B) canonical) via a
+    square-and-multiply scan over the fixed exponent bits."""
     from jax import lax
 
-    a = fe_norm(a)
-    out = fe_const(1, like)
-
-    a_st = bn.restack(list(a.limbs))
+    one = const_rows([1], a[0][0])
+    one = tuple(jnp.broadcast_to(l, a[0].shape) for l in one)
 
     def body(carry, bit):
-        o = FE(tuple(carry), 1)
-        o2 = mul_many([(o, o)])[0]
-        a_fe = FE(tuple(a_st[i] for i in range(bn.NLIMBS)), 1)
-        o2a = mul_many([(o2, a_fe)])[0]
-        nxt = fe_select(bit.astype(bool), o2a, o2)
-        return tuple(nxt.limbs), None
+        o = tuple(carry)
+        o2 = rmul(o, o)
+        o2a = rmul(o2, a)
+        nxt = rselect(bit.astype(bool), o2a, o2)
+        return tuple(nxt), None
 
-    bits = jnp.asarray(_P_MINUS_2_BITS)
-    carry, _ = lax.scan(body, tuple(out.limbs), bits)
-    return FE(tuple(carry), 1)
+    carry, _ = lax.scan(body, one, jnp.asarray(_P_MINUS_2_BITS))
+    return tuple(carry)
 
 
-def fp2_inv(x: Fp2, like) -> Fp2:
-    """conj(x) / (re^2 + im^2)."""
-    p = mul_many([(x[0], x[0]), (x[1], x[1])])
-    norm = fe_norm(fe_add(p[0], p[1]))
-    ninv = fe_inv(norm, like)
-    out = mul_many([(x[0], ninv), (fe_neg(x[1], like), ninv)])
-    return (out[0], out[1])
-
-
-def _fp6_mul(x, y) -> Tuple[Fp2, Fp2, Fp2]:
-    """Mirror of host _fp6_mul over v = w^2 (v^3 = xi)."""
-    a0, a1, a2 = x
-    b0, b1, b2 = y
-    t0 = fp2_mul(a0, b0)
-    t1 = fp2_add(fp2_mul(a0, b1), fp2_mul(a1, b0))
-    t2 = fp2_add(
-        fp2_add(fp2_mul(a0, b2), fp2_mul(a1, b1)), fp2_mul(a2, b0)
+def _fp2_mul_rows(x: Rows, y: Rows) -> Rows:
+    """K parallel Fp2 products: x, y are (2K, B) rows [re, im]...,
+    schoolbook 4-product form."""
+    re_x = rslice(x, np.s_[0::2])
+    im_x = rslice(x, np.s_[1::2])
+    re_y = rslice(y, np.s_[0::2])
+    im_y = rslice(y, np.s_[1::2])
+    p = rmul(
+        rcat(re_x, im_x, re_x, im_x), rcat(re_y, im_y, im_y, re_y)
     )
-    t3 = fp2_add(fp2_mul(a1, b2), fp2_mul(a2, b1))
-    t4 = fp2_mul(a2, b2)
-    return (
-        fp2_norm(fp2_add(t0, fp2_mul_xi(fp2_norm(t3)))),
-        fp2_norm(fp2_add(t1, fp2_mul_xi(t4))),
-        fp2_norm(t2),
+    k = x[0].shape[0] // 2
+    a = rslice(p, np.s_[0 * k : 1 * k])
+    b = rslice(p, np.s_[1 * k : 2 * k])
+    c = rslice(p, np.s_[2 * k : 3 * k])
+    d = rslice(p, np.s_[3 * k : 4 * k])
+    out_re = rsub(a, b, 1, 1)
+    out_im = rreduce(radd(c, d), 1)
+    return tuple(
+        jnp.stack([r, i], axis=1).reshape((2 * k,) + r.shape[1:])
+        for r, i in zip(out_re, out_im)
     )
 
 
-def _fp6_inv(x, like) -> Tuple[Fp2, Fp2, Fp2]:
-    a0, a1, a2 = x
-    c0 = fp2_sub(fp2_mul(a0, a0), fp2_mul_xi(fp2_mul(a1, a2)))
-    c1 = fp2_sub(fp2_mul_xi(fp2_mul(a2, a2)), fp2_mul(a0, a1))
-    c2 = fp2_sub(fp2_mul(a1, a1), fp2_mul(a0, a2))
-    t = fp2_add(
-        fp2_mul_xi(
-            fp2_norm(fp2_add(fp2_mul(a2, c1), fp2_mul(a1, c2)))
-        ),
-        fp2_mul(a0, c0),
+def _fp2_mul_xi(x: Rows) -> Rows:
+    """K parallel multiplies by xi = 1+i: (re−im, re+im)."""
+    re = rslice(x, np.s_[0::2])
+    im = rslice(x, np.s_[1::2])
+    out_re = rsub(re, im, 1, 1)
+    out_im = rreduce(radd(re, im), 1)
+    k = x[0].shape[0] // 2
+    return tuple(
+        jnp.stack([r, i], axis=1).reshape((2 * k,) + r.shape[1:])
+        for r, i in zip(out_re, out_im)
     )
-    ti = fp2_inv(fp2_norm(t), like)
-    return (fp2_mul(c0, ti), fp2_mul(c1, ti), fp2_mul(c2, ti))
 
 
-def fp12_inv(x: Fp12, like) -> Fp12:
-    """conj(x) * (x * conj(x))^{-1}, x*conj(x) living in the even
-    subalgebra (host fp12_inv)."""
-    xc = fp12_conj(x, like)
+def _fp2_inv_rows(x: Rows) -> Rows:
+    """One Fp2 inverse (x: (2, B)): conj(x) / (re² + im²)."""
+    sq = rmul(x, x)  # re², im²
+    norm = rreduce(rcarry([l[0:1] + l[1:2] for l in sq]), 1)  # (1,B)
+    ninv = _inv1(norm)
+    re = rslice(x, np.s_[0:1])
+    im_neg = rsub(rzero(1, x[0][0]), rslice(x, np.s_[1:2]), 1, 1)
+    return rmul(rcat(re, im_neg), rcat(ninv, ninv))
+
+
+def fp12_inv(x: Rows) -> Rows:
+    """conj(x)·(x·conj(x))^{-1}; x·conj(x) lives in the even
+    subalgebra -> one Fp6 inverse -> one Fp2 inverse -> one Fp inverse
+    (host fp12_inv / _fp6_inv)."""
+    xc = fp12_conj(x)
     ac = fp12_mul(x, xc)
-    inv6 = _fp6_inv((ac[0], ac[2], ac[4]), like)
-    z = (fe_zero(like), fe_zero(like))
-    inv12: Fp12 = (inv6[0], z, inv6[1], z, inv6[2], z)
+    # Fp6 over v = w²: a = (ac[0], ac[2], ac[4]) as Fp2 rows
+    a0 = rgather(ac, np.array([0, 1]))
+    a1 = rgather(ac, np.array([4, 5]))
+    a2 = rgather(ac, np.array([8, 9]))
+    # c0 = a0² − xi·a1·a2 ; c1 = xi·a2² − a0·a1 ; c2 = a1² − a0·a2
+    sq = _fp2_mul_rows(rcat(a0, a2, a1), rcat(a0, a2, a1))
+    a0sq = rslice(sq, np.s_[0:2])
+    a2sq = rslice(sq, np.s_[2:4])
+    a1sq = rslice(sq, np.s_[4:6])
+    cross = _fp2_mul_rows(rcat(a1, a0, a0), rcat(a2, a1, a2))
+    a1a2 = rslice(cross, np.s_[0:2])
+    a0a1 = rslice(cross, np.s_[2:4])
+    a0a2 = rslice(cross, np.s_[4:6])
+    c0 = rsub(a0sq, _fp2_mul_xi(a1a2), 1, 1)
+    c1 = rsub(_fp2_mul_xi(a2sq), a0a1, 1, 1)
+    c2 = rsub(a1sq, a0a2, 1, 1)
+    # t = xi·(a2·c1 + a1·c2) + a0·c0
+    tc = _fp2_mul_rows(rcat(a2, a1, a0), rcat(c1, c2, c0))
+    s = rreduce(
+        rcarry([l[0:2] + l[2:4] for l in tc]), 1
+    )  # a2c1 + a1c2
+    t = rreduce(
+        radd(_fp2_mul_xi(s), rslice(tc, np.s_[4:6])), 1
+    )
+    ti = _fp2_inv_rows(t)
+    inv6 = _fp2_mul_rows(
+        rcat(c0, c1, c2), rcat(ti, ti, ti)
+    )  # (6, B)
+    # inv12 = (inv6[0], 0, inv6[1], 0, inv6[2], 0) over w²-coefficients
+    z2 = rzero(2, x[0][0])
+    inv12 = rcat(
+        rslice(inv6, np.s_[0:2]), z2,
+        rslice(inv6, np.s_[2:4]), z2,
+        rslice(inv6, np.s_[4:6]), z2,
+    )
     return fp12_mul(xc, inv12)
 
 
@@ -362,66 +451,53 @@ def fp12_inv(x: Fp12, like) -> Fp12:
 # ---------------------------------------------------------------------------
 
 
-def _stack12(x: Fp12) -> jnp.ndarray:
-    """(12, NLIMBS, *batch) canonical stack for scan carries."""
-    rows = []
-    for c in x:
-        rows.append(bn.restack(list(fe_norm(c[0]).limbs)))
-        rows.append(bn.restack(list(fe_norm(c[1]).limbs)))
-    return jnp.stack(rows)
-
-
-def _unstack12(a) -> Fp12:
-    out = []
-    for k in range(6):
-        re = FE(tuple(a[2 * k][i] for i in range(bn.NLIMBS)), 1)
-        im = FE(tuple(a[2 * k + 1][i] for i in range(bn.NLIMBS)), 1)
-        out.append((re, im))
-    return tuple(out)
-
-
-def fp12_pow_const(x: Fp12, e: int, like) -> Fp12:
-    """x^e for a compile-time exponent, MSB-first square-and-multiply in
-    a lax.scan (bit-exact mirror of host fp12_pow)."""
+def fp12_pow_const(x: Rows, e: int) -> Rows:
+    """x^e, MSB-first square-and-multiply scan (host fp12_pow order)."""
     from jax import lax
 
     assert e > 0
     bits = jnp.asarray(
         np.array([int(b) for b in bin(e)[2:]], dtype=np.uint32)
     )
-    x_st = _stack12(x)
+    one = fp12_one(x[0][0])
+    one = tuple(jnp.broadcast_to(l, x[0].shape) for l in one)
 
     def body(carry, bit):
-        o = _unstack12(carry)
+        o = tuple(carry)
         o2 = fp12_sqr(o)
-        o2x = fp12_mul(o2, _unstack12(x_st))
-        nxt = fp12_select(bit.astype(bool), o2x, o2)
-        return _stack12(nxt), None
+        o2x = fp12_mul(o2, x)
+        nxt = rselect(bit.astype(bool), o2x, o2)
+        return tuple(nxt), None
 
-    carry, _ = lax.scan(body, _stack12(fp12_one(like)), bits)
-    return _unstack12(carry)
-
-
-def fp12_equal(x: Fp12, y: Fp12):
-    eq = None
-    for cx, cy in zip(x, y):
-        for fx, fy in zip(cx, cy):
-            e = fe_equal(fx, fy)
-            eq = e if eq is None else (eq & e)
-    return eq
+    carry, _ = lax.scan(body, one, bits)
+    return tuple(carry)
 
 
-def fp12_to_host(x: Fp12) -> host.Fp12:
-    """Device -> host value (decodes Montgomery form; for tests)."""
-    out = []
-    for c in x:
-        pair = []
-        for f in c:
-            limbs = bn.from_mont_l(CTX, fe_norm(f).limbs)
-            limbs = bn.reduce_canonical_l(CTX, limbs, 1)
-            v = 0
-            for i in reversed(range(bn.NLIMBS)):
-                v = (v << bn.LIMB_BITS) | int(np.asarray(limbs[i]).reshape(-1)[0])
-            pair.append(v % host.P)
-        out.append((pair[0], pair[1]))
-    return tuple(out)
+# ---------------------------------------------------------------------------
+# host <-> device conversion (tests / kernel boundaries)
+# ---------------------------------------------------------------------------
+
+
+def pack(x: Rows) -> jax.Array:
+    """Rows -> one (NLIMBS, R, *batch) array (scan carries, transport)."""
+    return jnp.stack(list(x))
+
+
+def unpack(a: jax.Array) -> Rows:
+    return tuple(a[i] for i in range(bn.NLIMBS))
+
+
+def fp12_to_host(x: Rows) -> host.Fp12:
+    """Decode lane 0 to host integers (differential tests)."""
+    std = tuple(bn.from_mont_l(CTX, x))
+    std = tuple(bn.reduce_canonical_l(CTX, std, 1))
+    mat = np.stack([np.asarray(l) for l in std])  # (NLIMBS, 12, ...)
+    vals = []
+    for r in range(12):
+        v = 0
+        for i in reversed(range(bn.NLIMBS)):
+            v = (v << bn.LIMB_BITS) | int(mat[i, r].reshape(-1)[0])
+        vals.append(v % host.P)
+    return tuple(
+        (vals[2 * k], vals[2 * k + 1]) for k in range(6)
+    )

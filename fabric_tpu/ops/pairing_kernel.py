@@ -7,24 +7,24 @@ with W (issuer key) and GenG2 FIXED G2 points; only the G1 arguments
 
 Device design (NOT a port of amcl's pairing):
 
-- Because both G2 points are fixed, the entire Miller-loop point chain
-  runs ON THE HOST once per issuer key, emitting per-step LINE
-  COEFFICIENTS: l(P) = A + B·px + py with A = λ·x_T − y_T, B = −λ
-  (Fp12 constants; fabric_tpu/crypto/fp256bn.py `_line`).  The device
-  never touches G2/Fp12 point arithmetic — each Miller step is one
-  Fp12 squaring plus a line evaluation (a 12-lane scalar multiply) and
-  an Fp12 multiply, batched over signatures.
+- Both G2 points are fixed, so the entire Miller-loop point chain runs
+  ON THE HOST once per issuer key, emitting per-step LINE COEFFICIENTS:
+  l(P) = A + B·px + py with A = λ·x_T − y_T, B = −λ (Fp12 constants;
+  host `_line`).  The device never touches G2/Fp12 point arithmetic —
+  each Miller step is one Fp12 squaring, a 12-row scalar multiply (the
+  line evaluated at P), and an Fp12 multiply, batched over signatures.
 - Both pairings run in ONE lax.scan (they share the |6u+2| bit
   schedule); add-steps are selected per step by a static mask.
 - The final exponentiation mirrors the host oracle op-for-op
-  (conj·inv easy part, frobenius², then the ~1020-bit hard-part power
-  as a scan), so every intermediate is differential-testable.
-- Everything traces under bn.force_looped_cios: scan bodies stay small
-  enough for the remote TPU compile service.
+  (conj·inv easy part, frobenius², ~1020-bit hard-part power as a
+  scan), so every intermediate is differential-testable.
+- The Fp12 layer is the row-stacked fabric_tpu.ops.fp12: one gather +
+  one stacked Montgomery multiply per tower op, keeping the graph
+  small enough for the remote TPU compiler.
 
-The differential contract (tests/test_pairing_kernel.py): device Miller
-values equal host `miller_loop` bit-for-bit; the unity verdict equals
-the host oracle's for valid, corrupted, and swapped signatures.
+Differential contract (tests/test_pairing_kernel.py): device Miller
+values equal host `miller_loop` bit-for-bit; unity verdicts equal the
+host oracle's on valid, corrupted, and absent inputs.
 """
 
 from __future__ import annotations
@@ -40,7 +40,6 @@ from jax import lax
 from fabric_tpu.crypto import fp256bn as host
 from fabric_tpu.ops import bignum as bn
 from fabric_tpu.ops import fp12 as f12
-from fabric_tpu.ops.fp12 import CTX, FE
 
 # ---------------------------------------------------------------------------
 # Host-side line precomputation (per fixed G2 point)
@@ -52,8 +51,8 @@ _N_BITS = bin(abs(_SIX_U_TWO))[3:]  # loop bits after the implicit MSB
 
 def _line_coeffs(t, q) -> Tuple[host.Fp12, host.Fp12]:
     """(A, B) with l(P) = A + B·px + py, mirroring host _line for the
-    tangent (t==q) and chord cases. Vertical lines (x_t == x_q, y
-    differs) cannot occur for the order-r points used here — asserted."""
+    tangent (t==q) and chord cases.  Vertical lines cannot occur for
+    the order-r points used here — asserted."""
     x1, y1 = t
     x2, y2 = q
     if x1 == x2 and y1 == y2:
@@ -84,13 +83,9 @@ def _fp12_to_mont_rows(v: host.Fp12) -> np.ndarray:
 
 
 class LineSchedule:
-    """Per-G2-point precomputed Miller lines.
-
-    main_*: arrays over the scan steps (one per loop bit): the doubling
-    line, plus (for '1' bits) the addition line with has_add=1.
-    corr_*: the two frobenius correction lines applied after the u<0
-    conjugation (host miller_loop tail).
-    """
+    """Per-G2-point precomputed Miller lines: arrays over the scan
+    steps (doubling line always; addition line + has_add for '1' bits),
+    plus the two post-conjugation frobenius correction lines."""
 
     def __init__(self, q: host.G2Point):
         qe = host._untwist(q)
@@ -142,57 +137,40 @@ class LineSchedule:
 # ---------------------------------------------------------------------------
 
 
-def _rows_to_fp12(rows, like) -> f12.Fp12:
-    """(12, NLIMBS) traced/const rows -> broadcast Fp12."""
-    out = []
-    for k in range(6):
-        re = FE(
-            tuple(
-                jnp.broadcast_to(rows[2 * k, i], like.shape)
-                for i in range(bn.NLIMBS)
-            ),
-            1,
-        )
-        im = FE(
-            tuple(
-                jnp.broadcast_to(rows[2 * k + 1, i], like.shape)
-                for i in range(bn.NLIMBS)
-            ),
-            1,
-        )
-        out.append((re, im))
-    return tuple(out)
-
-
-def _line_eval(a_rows, b_rows, px: FE, py: FE, like) -> f12.Fp12:
-    """A + B·px + py  (py lands in the (w^0, re) slot)."""
-    a = _rows_to_fp12(a_rows, like)
-    b = _rows_to_fp12(b_rows, like)
-    prods = f12.mul_many(
-        [(b[k][0], px) for k in range(6)]
-        + [(b[k][1], px) for k in range(6)]
+def _bcast12(p: f12.Rows) -> f12.Rows:
+    """(1-row or (B,)) G1 coordinate -> (12, B) rows."""
+    return tuple(
+        jnp.broadcast_to(l, (12,) + l.shape[-1:]) for l in p
     )
-    out = []
-    for k in range(6):
-        re = f12.fe_add(a[k][0], prods[k])
-        im = f12.fe_add(a[k][1], prods[6 + k])
-        if k == 0:
-            re = f12.fe_add(re, py)
-        out.append((f12.fe_norm(re), f12.fe_norm(im)))
-    return tuple(out)
+
+
+def _line_eval(a_mat, b_mat, px12: f12.Rows, py_rows: f12.Rows, like):
+    """A + B·px + py, canonical output.  a_mat/b_mat are (12, NLIMBS)
+    constants (traced scan slices); px12 is the G1 x broadcast to 12
+    rows; py_rows has py at row 0 and zeros elsewhere."""
+    a = f12.rows_of(a_mat, like)
+    b = f12.rows_of(b_mat, like)
+    bp = f12.rmul(b, px12)
+    out = f12.radd(f12.radd(a, bp), py_rows)  # bound 3
+    return f12.rreduce(out, 2)
 
 
 def _miller2(
     sched_w: LineSchedule,
     sched_g: LineSchedule,
-    p1x: FE,
-    p1y: FE,
-    p2x: FE,
-    p2y: FE,
+    p1x: f12.Rows,
+    p1y: f12.Rows,
+    p2x: f12.Rows,
+    p2y: f12.Rows,
     like,
-) -> Tuple[f12.Fp12, f12.Fp12]:
+):
     """Both Miller loops in one scan (shared bit schedule); returns the
     host-bit-exact Miller values for (W,P1) and (g2,P2)."""
+    p1x12, p2x12 = _bcast12(p1x), _bcast12(p2x)
+    z11 = f12.rzero(11, like)
+    p1y_rows = f12.rcat(tuple(l[None] for l in p1y), z11)
+    p2y_rows = f12.rcat(tuple(l[None] for l in p2y), z11)
+
     xs = (
         jnp.asarray(sched_w.dbl_a),
         jnp.asarray(sched_w.dbl_b),
@@ -208,65 +186,73 @@ def _miller2(
     def body(carry, step):
         f1_st, f2_st = carry
         (wda, wdb, waa, wab, gda, gdb, gaa, gab, has_add) = step
-        f1 = f12._unstack12(f1_st)
-        f2 = f12._unstack12(f2_st)
-        # f <- f^2 * l_dbl
+        f1 = f12.unpack(f1_st)
+        f2 = f12.unpack(f2_st)
         f1 = f12.fp12_mul(
-            f12.fp12_sqr(f1), _line_eval(wda, wdb, p1x, p1y, like)
+            f12.fp12_sqr(f1),
+            _line_eval(wda, wdb, p1x12, p1y_rows, like),
         )
         f2 = f12.fp12_mul(
-            f12.fp12_sqr(f2), _line_eval(gda, gdb, p2x, p2y, like)
+            f12.fp12_sqr(f2),
+            _line_eval(gda, gdb, p2x12, p2y_rows, like),
         )
-        # conditional add-step: f <- f * l_add
-        f1a = f12.fp12_mul(f1, _line_eval(waa, wab, p1x, p1y, like))
-        f2a = f12.fp12_mul(f2, _line_eval(gaa, gab, p2x, p2y, like))
+        f1a = f12.fp12_mul(
+            f1, _line_eval(waa, wab, p1x12, p1y_rows, like)
+        )
+        f2a = f12.fp12_mul(
+            f2, _line_eval(gaa, gab, p2x12, p2y_rows, like)
+        )
         cond = has_add.astype(bool)
         f1 = f12.fp12_select(cond, f1a, f1)
         f2 = f12.fp12_select(cond, f2a, f2)
-        return (f12._stack12(f1), f12._stack12(f2)), None
+        return (f12.pack(f1), f12.pack(f2)), None
 
-    init = (
-        f12._stack12(f12.fp12_one(like)),
-        f12._stack12(f12.fp12_one(like)),
+    one = f12.fp12_one(like)
+    one = tuple(
+        jnp.broadcast_to(l, (12,) + like.shape) for l in one
     )
-    (f1_st, f2_st), _ = lax.scan(body, init, xs)
-    f1 = f12.fp12_conj(f12._unstack12(f1_st), like)
-    f2 = f12.fp12_conj(f12._unstack12(f2_st), like)
+    (f1_st, f2_st), _ = lax.scan(
+        body, (f12.pack(one), f12.pack(one)), xs
+    )
+    f1 = f12.fp12_conj(f12.unpack(f1_st))
+    f2 = f12.fp12_conj(f12.unpack(f2_st))
     for (wa, wb), (ga, gb) in zip(sched_w.corr, sched_g.corr):
         f1 = f12.fp12_mul(
-            f1, _line_eval(jnp.asarray(wa), jnp.asarray(wb), p1x, p1y, like)
+            f1,
+            _line_eval(jnp.asarray(wa), jnp.asarray(wb), p1x12, p1y_rows, like),
         )
         f2 = f12.fp12_mul(
-            f2, _line_eval(jnp.asarray(ga), jnp.asarray(gb), p2x, p2y, like)
+            f2,
+            _line_eval(jnp.asarray(ga), jnp.asarray(gb), p2x12, p2y_rows, like),
         )
     return f1, f2
 
 
-def _final_exp(f: f12.Fp12, like) -> f12.Fp12:
+def _final_exp(f: f12.Rows) -> f12.Rows:
     """Bit-exact mirror of host final_exp."""
-    easy = f12.fp12_mul(f12.fp12_conj(f, like), f12.fp12_inv(f, like))
-    easy = f12.fp12_mul(f12.fp12_frobenius(easy, 2, like), easy)
-    return f12.fp12_pow_const(easy, host._HARD_EXP, like)
+    easy = f12.fp12_mul(f12.fp12_conj(f), f12.fp12_inv(f))
+    easy = f12.fp12_mul(f12.fp12_frobenius(easy, 2), easy)
+    return f12.fp12_pow_const(easy, host._HARD_EXP)
 
 
-def _unity_check(
-    sched_w, sched_g, p1x_st, p1y_st, p2x_st, p2y_st, ok
-):
-    """The jitted core: stacked (NLIMBS, B) coords -> per-lane unity
-    mask of Fexp(m1 * inv(m2))."""
-    like = p1x_st[0]
+def _unity_check(sched_w, sched_g, p1x, p1y, p2x, p2y, ok):
+    """The jitted core: (NLIMBS, B) stacked coords -> per-lane unity
+    mask of Fexp(m1 · inv(m2))."""
+    like = p1x[0]
 
-    def fe_of(st):
-        return FE(tuple(st[i] for i in range(bn.NLIMBS)), 1)
+    def tup(st):
+        return tuple(st[i] for i in range(bn.NLIMBS))
 
     f1, f2 = _miller2(
-        sched_w, sched_g, fe_of(p1x_st), fe_of(p1y_st),
-        fe_of(p2x_st), fe_of(p2y_st), like,
+        sched_w, sched_g, tup(p1x), tup(p1y), tup(p2x), tup(p2y), like
     )
-    m = f12.fp12_mul(f1, f12.fp12_inv(f2, like))
-    out = _final_exp(m, like)
-    unity = f12.fp12_equal(out, f12.fp12_one(like))
-    return unity & ok
+    m = f12.fp12_mul(f1, f12.fp12_inv(f2))
+    out = _final_exp(m)
+    one = f12.fp12_one(like)
+    one = tuple(
+        jnp.broadcast_to(l, (12,) + like.shape) for l in one
+    )
+    return f12.fp12_equal(out, one) & ok
 
 
 class Ate2Kernel:
@@ -276,21 +262,14 @@ class Ate2Kernel:
     def __init__(self, w: host.G2Point):
         self.sched_w = LineSchedule(w)
         self.sched_g = _g2_schedule()
-        self._jit = {}
+        sched_w, sched_g = self.sched_w, self.sched_g
 
-    def _fn(self, bucket: int):
-        fn = self._jit.get(bucket)
-        if fn is None:
-            sched_w, sched_g = self.sched_w, self.sched_g
+        def run(p1x, p1y, p2x, p2y, ok):
+            return _unity_check(sched_w, sched_g, p1x, p1y, p2x, p2y, ok)
 
-            def run(p1x, p1y, p2x, p2y, ok):
-                return _unity_check(
-                    sched_w, sched_g, p1x, p1y, p2x, p2y, ok
-                )
-
-            fn = jax.jit(run)
-            self._jit[bucket] = fn
-        return fn
+        # one jitted callable; jax caches a compiled executable per
+        # input bucket shape automatically
+        self._fn = jax.jit(run)
 
     def check(
         self,
@@ -324,7 +303,7 @@ class Ate2Kernel:
             ).astype(np.uint32)  # (NLIMBS, B)
 
         with bn.force_looped_cios():
-            mask = self._fn(bucket)(
+            mask = self._fn(
                 jnp.asarray(mont_cols(cols["p1x"])),
                 jnp.asarray(mont_cols(cols["p1y"])),
                 jnp.asarray(mont_cols(cols["p2x"])),
@@ -354,17 +333,26 @@ def miller2_host_values(
     like = jnp.zeros((1,), dtype=jnp.uint32)
 
     def col(v):
-        return FE(
-            tuple(
-                jnp.asarray(np.full((1,), x, dtype=np.uint32))
-                for x in f12.to_mont_int(v)
-            ),
-            1,
+        return tuple(
+            jnp.asarray(np.full((1,), x, dtype=np.uint32))
+            for x in f12.to_mont_int(v)
         )
 
     with bn.force_looped_cios():
-        f1, f2 = _miller2(
-            k.sched_w, k.sched_g,
-            col(p1[0]), col(p1[1]), col(p2[0]), col(p2[1]), like,
-        )
-    return f12.fp12_to_host(f1), f12.fp12_to_host(f2)
+
+        @jax.jit
+        def run():
+            return tuple(
+                f12.pack(f)
+                for f in _miller2(
+                    k.sched_w, k.sched_g,
+                    col(p1[0]), col(p1[1]), col(p2[0]), col(p2[1]),
+                    like,
+                )
+            )
+
+        f1_st, f2_st = run()
+    return (
+        f12.fp12_to_host(f12.unpack(f1_st)),
+        f12.fp12_to_host(f12.unpack(f2_st)),
+    )
