@@ -425,3 +425,44 @@ def test_cli_idemixgen_roundtrip(tmp_path):
     parsed = msp.deserialize_identity(ident.serialize())
     msp.validate(parsed)
     msp.verify(parsed, b"hello idemix", sig)
+
+
+def test_cli_channel_fetch_selectors(network, tmp_path):
+    """peer channel fetch oldest|newest|config|<n>, from the orderer
+    and from the peer's own deliver service (fetch.go selectors)."""
+    from fabric_tpu.protos import common_pb2
+
+    def fetch(selector, out_name, source_args):
+        out_path = str(tmp_path / out_name)
+        run_cli(
+            "fabric_tpu.cli.peer",
+            "channel",
+            "fetch",
+            selector,
+            out_path,
+            "-c",
+            "mychannel",
+            *source_args,
+            "--mspDir",
+            network["user_msp"],
+            "--mspID",
+            "Org1MSP",
+        )
+        block = common_pb2.Block()
+        with open(out_path, "rb") as f:
+            block.ParseFromString(f.read())
+        return block
+
+    orderer = ["-o", network["orderer_addr"]]
+    peer = ["--peerAddress", network["peer_addr"]]
+
+    genesis = fetch("oldest", "g.block", orderer)
+    assert genesis.header.number == 0
+    newest = fetch("newest", "n.block", orderer)
+    assert newest.header.number >= genesis.header.number
+    config = fetch("config", "c.block", orderer)
+    assert config.header.number == 0  # only config block is the genesis
+    by_number = fetch("0", "z.block", peer)  # peer-side fetch
+    assert by_number.header.number == 0
+    peer_newest = fetch("newest", "pn.block", peer)
+    assert peer_newest.header.number >= 0
