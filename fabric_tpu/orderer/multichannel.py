@@ -243,7 +243,13 @@ class Registrar:
         self, support: ChainSupport, block: common_pb2.Block
     ) -> None:
         """Hot-swap the bundle when a config block commits (reference
-        bundlesource.go + registrar's config-block callback)."""
+        bundlesource.go + registrar's config-block callback). A change
+        to the etcdraft consenter set additionally bridges into a raft
+        membership change (etcdraft chain.go detectConfChange →
+        ProposeConfChange): the leader proposes the new peer set; the
+        replicated ENTRY_CONF applies it on every member."""
+        from fabric_tpu.orderer.follower import consenter_addresses
+
         env = protoutil.get_envelope_from_block_data(block.data.data[0])
         payload = protoutil.unmarshal(common_pb2.Payload, env.payload)
         cenv = protoutil.unmarshal(configtx_pb2.ConfigEnvelope, payload.data)
@@ -251,6 +257,28 @@ class Registrar:
         support.bundle = new_bundle
         support.validator.config = cenv.config
         support.processor.update_bundle(new_bundle)
+        new_consenters = len(consenter_addresses(new_bundle))
+        chain = support.chain
+        desired = set(range(1, new_consenters + 1))
+        if (
+            new_consenters > 0
+            and isinstance(chain, RaftChain)
+            # compare against the chain's LIVE peer set, not the old
+            # bundle: if a previous leader died between committing the
+            # config block and committing its ENTRY_CONF, any later
+            # config apply on the new leader re-proposes and repairs
+            and desired != chain.node.peers
+        ):
+            from fabric_tpu.orderer.raft_chain import NotLeaderError
+
+            # Called from inside the chain's own apply loop; the nested
+            # propose->pump->apply re-entry is benign because
+            # _apply_entry's writer-height guard skips the already
+            # written block (raft_chain.py _apply_entry).
+            try:
+                chain.propose_conf_change(sorted(desired))
+            except NotLeaderError:
+                pass  # the leader's own apply proposes; replication covers us
         for fn in self._chain_listeners:
             fn(support)
 

@@ -220,3 +220,45 @@ def test_follower_replicates_and_serves_deliver(tmp_path):
                 node.stop()
             except Exception:
                 pass
+
+
+def test_consenter_set_config_update_bridges_to_raft(tmp_path):
+    """A committed config block that grows the etcdraft consenter set
+    becomes a raft membership change on the chain (etcdraft
+    detectConfChange analog): peers expand so an onboarded follower can
+    actually join the consensus."""
+    from fabric_tpu.orderer.multichannel import Registrar
+
+    org1 = generate_org("org1.confchg", "Org1MSP")
+    oorg = generate_org("orderer.confchg", "OrdererMSP")
+    p1, p2 = _free_ports(2)
+    gblock = genesis_block(_profile(org1, oorg, [p1]), CHANNEL)
+    grown = genesis_block(_profile(org1, oorg, [p1, p2]), CHANNEL)
+
+    registrar = Registrar(
+        str(tmp_path / "orderer"),
+        signer=SigningIdentity(oorg.peers[0]),
+        raft_node_id=1,
+    )
+    support = registrar.join_channel(gblock)
+    chain = support.chain
+    # single-node raft: becomes leader on first tick
+    deadline = time.time() + 5
+    while chain.node.role != "leader" and time.time() < deadline:
+        chain.tick()
+    assert chain.node.role == "leader"
+    assert chain.node.peers == {1}
+
+    # drive the REAL path: configure() -> raft commit -> _apply_entry
+    # -> on_config_block -> bridge (including the re-entrant
+    # propose->pump->apply the writer-height guard must absorb)
+    env = protoutil.get_envelope_from_block_data(grown.data.data[0])
+    chain.configure(env)
+    deadline = time.time() + 5
+    while chain.node.peers != {1, 2} and time.time() < deadline:
+        chain.tick()
+    assert chain.node.peers == {1, 2}
+    assert chain.height == 2  # genesis + the committed config block
+    from fabric_tpu.orderer.follower import consenter_addresses
+
+    assert len(consenter_addresses(support.bundle)) == 2
