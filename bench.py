@@ -8,7 +8,9 @@ The `detail.configs` object carries the measured numbers for configs
 
   block_1k   — 1k-tx 2-of-3 endorsement block through the full
                BlockValidator: TPU provider vs SW provider ms/block,
-               bit-exact TRANSACTIONS_FILTER asserted (config #2).
+               bit-exact TRANSACTIONS_FILTER asserted (config #2).  The
+               SW column is the OpenSSL-backed provider (the reference
+               SW BCCSP's speed class), NOT the pure-Python oracle.
   idemix     — batched Idemix verify: device Ate2 pairing kernel vs the
                host oracle pairing, ms/sig (config #3).
   mvcc_5k    — 5k-tx MVCC validate-and-prepare, ms/block (config #4).
@@ -17,7 +19,14 @@ The `detail.configs` object carries the measured numbers for configs
                validated on the virtual CPU mesh by dryrun_multichip —
                the bench machine has one chip).
 
-Heavy configs can be skipped with BENCH_HEADLINE_ONLY=1.
+Output discipline: a COMPLETE JSON line is printed and flushed as soon as
+the headline (config #1) finishes, then re-emitted after every config
+completes or fails — so a driver that kills the process mid-run still
+captures the latest complete line (round 2's bench recorded nothing
+because the single line printed only at the very end).  The last line is
+the most complete.  BENCH_BUDGET_S (default 1500) is a wall-clock budget:
+configs that would start after the deadline are recorded as skipped.
+Heavy configs can be skipped entirely with BENCH_HEADLINE_ONLY=1.
 """
 
 import json
@@ -35,67 +44,39 @@ enable_compile_cache()
 
 
 def gen_triples(n, num_keys=8):
-    """(key, der_sig, digest) triples signed with the fast OpenSSL path,
-    normalized to low-S like the reference signer."""
+    """(key, der_sig, digest) triples signed through the SW provider's own
+    fast path (fastec), normalized to low-S like the reference signer."""
     import hashlib
 
-    from cryptography.hazmat.primitives import hashes
-    from cryptography.hazmat.primitives.asymmetric import ec
-    from cryptography.hazmat.primitives.asymmetric.utils import (
-        decode_dss_signature,
-    )
-
-    from fabric_tpu.crypto import der, p256
+    from fabric_tpu.crypto import der, fastec
     from fabric_tpu.crypto.bccsp import ECDSAPublicKey
 
-    keys = []
-    for _ in range(num_keys):
-        sk = ec.generate_private_key(ec.SECP256R1())
-        nums = sk.public_key().public_numbers()
-        keys.append((sk, ECDSAPublicKey(nums.x, nums.y)))
-
+    keys = [fastec.generate_keypair() for _ in range(num_keys)]
     triples = []
     for i in range(n):
-        sk, pub = keys[i % num_keys]
+        kp = keys[i % num_keys]
         msg = f"benchmark tx payload {i}".encode() * 8
         digest = hashlib.sha256(msg).digest()
-        r, s = decode_dss_signature(sk.sign(msg, ec.ECDSA(hashes.SHA256())))
-        if not p256.is_low_s(s):
-            s = p256.N - s
-        triples.append((pub, der.marshal_signature(r, s), digest))
+        r, s = fastec.sign_digest(kp.priv, digest)
+        triples.append(
+            (ECDSAPublicKey(*kp.pub), der.marshal_signature(r, s), digest)
+        )
     return triples
 
 
 def bench_cpu_baseline(triples, budget_s=2.0):
-    from cryptography.exceptions import InvalidSignature
-    from cryptography.hazmat.primitives import hashes
-    from cryptography.hazmat.primitives.asymmetric import ec
-    from cryptography.hazmat.primitives.asymmetric.utils import (
-        Prehashed,
-        encode_dss_signature,
-    )
+    """Single-core CPU column: the ACTUAL SoftwareProvider verify path
+    (DER parse + low-S gate + OpenSSL curve math), i.e. the same code the
+    validator runs when no accelerator is present — so detail.sw_ec_backend
+    labels exactly what was measured."""
+    from fabric_tpu.crypto.bccsp import SoftwareProvider
 
-    from fabric_tpu.crypto import der as der_mod
-
-    pubkeys = {}
+    sw = SoftwareProvider()
     count = 0
     start = time.perf_counter()
     while time.perf_counter() - start < budget_s:
         pub, sig, digest = triples[count % len(triples)]
-        key = pubkeys.get(id(pub))
-        if key is None:
-            key = ec.EllipticCurvePublicNumbers(
-                pub.x, pub.y, ec.SECP256R1()
-            ).public_key()
-            pubkeys[id(pub)] = key
-        r, s = der_mod.unmarshal_signature(sig)
-        try:
-            key.verify(
-                encode_dss_signature(r, s),
-                digest,
-                ec.ECDSA(Prehashed(hashes.SHA256())),
-            )
-        except InvalidSignature:
+        if not sw.verify(pub, sig, digest):
             raise RuntimeError("benchmark signature should verify")
         count += 1
     return count / (time.perf_counter() - start)
@@ -424,47 +405,72 @@ def bench_multichannel(net, n_channels=4, txs_per_channel=2000):
     }
 
 
+def _ec_backend_name():
+    """Which scalar-EC module the SW provider actually runs (guards against
+    a silent fallback to the ~5 verifies/s oracle mislabeling CPU columns)."""
+    from fabric_tpu.crypto.bccsp import ec_backend
+
+    return ec_backend().__name__
+
+
 def main():
     n = int(os.environ.get("BENCH_N", "16384"))
     iters = int(os.environ.get("BENCH_ITERS", "5"))
     headline_only = os.environ.get("BENCH_HEADLINE_ONLY", "") == "1"
+    budget_s = float(os.environ.get("BENCH_BUDGET_S", "1500"))
+    t0 = time.monotonic()
+    deadline = t0 + budget_s
 
     import jax
 
     device_rate, cpu_rate = bench_headline(n, iters)
 
     configs = {}
+    result = {
+        "metric": "ecdsa_p256_verify_throughput",
+        "value": round(device_rate, 1),
+        "unit": "verifies/s",
+        "vs_baseline": round(device_rate / cpu_rate, 2),
+        "detail": {
+            "batch": n,
+            "iters": iters,
+            "cpu_baseline_verifies_per_s": round(cpu_rate, 1),
+            "device": str(jax.devices()[0]),
+            "target_verifies_per_s": 50000,
+            "sw_ec_backend": _ec_backend_name(),
+            "budget_s": budget_s,
+            "elapsed_s": 0.0,
+            "configs": configs,
+        },
+    }
+
+    def emit():
+        result["detail"]["elapsed_s"] = round(time.monotonic() - t0, 1)
+        print(json.dumps(result), flush=True)
+
+    emit()  # the headline lands even if a later config hangs or is killed
+
     if not headline_only:
-        net = _Net()
-        for name, fn in (
-            ("block_1k", lambda: bench_block_1k(net)),
-            ("idemix", bench_idemix),
-            ("mvcc_5k", bench_mvcc),
-            ("multi_4ch", lambda: bench_multichannel(net)),
+        net = None
+        for name, fn, needs_net in (
+            ("block_1k", bench_block_1k, True),
+            ("idemix", bench_idemix, False),
+            ("mvcc_5k", bench_mvcc, False),
+            ("multi_4ch", bench_multichannel, True),
         ):
+            if time.monotonic() > deadline:
+                configs[name] = {
+                    "skipped": f"wall-clock budget ({budget_s:.0f}s) exhausted"
+                }
+                emit()
+                continue
             try:
-                configs[name] = fn()
+                if needs_net and net is None:
+                    net = _Net()
+                configs[name] = fn(net) if needs_net else fn()
             except Exception as exc:  # noqa: BLE001 - emit partial results
                 configs[name] = {"error": str(exc)[:300]}
-
-    print(
-        json.dumps(
-            {
-                "metric": "ecdsa_p256_verify_throughput",
-                "value": round(device_rate, 1),
-                "unit": "verifies/s",
-                "vs_baseline": round(device_rate / cpu_rate, 2),
-                "detail": {
-                    "batch": n,
-                    "iters": iters,
-                    "cpu_baseline_verifies_per_s": round(cpu_rate, 1),
-                    "device": str(jax.devices()[0]),
-                    "target_verifies_per_s": 50000,
-                    "configs": configs,
-                },
-            }
-        )
-    )
+            emit()
 
 
 if __name__ == "__main__":

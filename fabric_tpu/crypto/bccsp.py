@@ -21,6 +21,20 @@ from typing import List, Optional, Sequence, Tuple
 
 from fabric_tpu.crypto import der, p256
 
+try:  # OpenSSL-backed fast path (reference SW BCCSP speed class); the
+    # pure-Python module stays as the differential oracle.
+    from fabric_tpu.crypto import fastec as _ec
+except ImportError:  # pragma: no cover - cryptography missing
+    _ec = p256  # type: ignore[assignment]
+
+
+def ec_backend():
+    """The active scalar-EC module: ``fastec`` (OpenSSL) normally, the
+    ``p256`` oracle only when the cryptography package is absent.  Exposed
+    so callers (msp.signer, bench) share one seam and can report which
+    backend actually ran."""
+    return _ec
+
 
 @dataclass(frozen=True)
 class ECDSAPublicKey:
@@ -74,11 +88,11 @@ class Provider:
         return ECDSAPublicKey(x, y)
 
     def key_gen(self) -> ECDSAPrivateKey:
-        kp = p256.generate_keypair()
+        kp = _ec.generate_keypair()
         return ECDSAPrivateKey(kp.priv, ECDSAPublicKey(*kp.pub))
 
     def sign(self, key: ECDSAPrivateKey, digest: bytes) -> bytes:
-        r, s = p256.sign_digest(key.d, digest)
+        r, s = _ec.sign_digest(key.d, digest)
         return der.marshal_signature(r, s)
 
     def verify(self, key: ECDSAPublicKey, signature: bytes, digest: bytes) -> bool:
@@ -116,11 +130,30 @@ def parse_and_precheck(signature: bytes) -> Tuple[int, int]:
 
 
 class SoftwareProvider(Provider):
-    """Pure-host provider; the differential oracle for the TPU provider."""
+    """Host provider at the reference SW BCCSP's speed class: DER parse +
+    low-S gate in Python, the curve math on OpenSSL (~11k verifies/s/core,
+    the same ballpark as Go's P-256 assembly the reference rides)."""
+
+    def verify(self, key: ECDSAPublicKey, signature: bytes, digest: bytes) -> bool:
+        r, s = parse_and_precheck(signature)
+        return _ec.verify_digest(key.point, digest, r, s)
+
+
+class PurePythonProvider(SoftwareProvider):
+    """The clarity-first big-int oracle (~5 verifies/s).  Differential tests
+    ONLY — never a benchmark baseline or a default path."""
 
     def verify(self, key: ECDSAPublicKey, signature: bytes, digest: bytes) -> bool:
         r, s = parse_and_precheck(signature)
         return p256.verify_digest(key.point, digest, r, s)
+
+    def sign(self, key: ECDSAPrivateKey, digest: bytes) -> bytes:
+        r, s = p256.sign_digest(key.d, digest)
+        return der.marshal_signature(r, s)
+
+    def key_gen(self) -> ECDSAPrivateKey:
+        kp = p256.generate_keypair()
+        return ECDSAPrivateKey(kp.priv, ECDSAPublicKey(*kp.pub))
 
 
 _default: Optional[Provider] = None

@@ -5,8 +5,8 @@ from __future__ import annotations
 import secrets
 from typing import Optional
 
-from fabric_tpu.crypto import der, p256
-from fabric_tpu.crypto.bccsp import Provider, default_provider
+from fabric_tpu.crypto import der
+from fabric_tpu.crypto.bccsp import Provider, default_provider, ec_backend
 from fabric_tpu.msp.cryptogen import NodeIdentity
 from fabric_tpu.protos import protoutil
 
@@ -27,7 +27,7 @@ class SigningIdentity:
         """SHA-256 digest then low-S ECDSA, DER-encoded (the reference
         signer path: bccsp Hash + Sign, msp/identities.go Sign)."""
         digest = self._provider.hash(msg)
-        r, s = p256.sign_digest(self.node.priv_scalar, digest)
+        r, s = ec_backend().sign_digest(self.node.priv_scalar, digest)
         return der.marshal_signature(r, s)
 
     def new_nonce(self) -> bytes:

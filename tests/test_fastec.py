@@ -1,0 +1,135 @@
+"""Differential tests: the OpenSSL-backed fast EC path vs the pure-Python
+oracle.
+
+fastec is the default host execution path for every provider (reference
+analog: bccsp/sw/ecdsa.go:41-57 riding Go's P-256 assembly); p256 stays the
+clarity-first oracle.  These tests pin the two to identical semantics,
+including the error-lane behaviors the reference's (bool, error) split
+mandates.
+"""
+
+import hashlib
+
+import pytest
+
+from fabric_tpu.crypto import der, fastec, p256
+from fabric_tpu.crypto.bccsp import (
+    PurePythonProvider,
+    SoftwareProvider,
+    VerifyError,
+    ec_backend,
+)
+
+
+def _digest(i: int) -> bytes:
+    return hashlib.sha256(b"fastec differential %d" % i).digest()
+
+
+def test_backend_is_fastec():
+    # cryptography is baked into this environment; a silent fallback to the
+    # oracle would be a ~2000x perf regression masquerading as green tests.
+    assert ec_backend() is fastec
+
+
+def test_sign_verify_roundtrip_vs_oracle():
+    kp = fastec.generate_keypair()
+    for i in range(4):
+        d = _digest(i)
+        r, s = fastec.sign_digest(kp.priv, d)
+        assert p256.is_low_s(s)
+        assert fastec.verify_digest(kp.pub, d, r, s)
+        assert p256.verify_digest(kp.pub, d, r, s)
+        # wrong digest fails on both
+        assert not fastec.verify_digest(kp.pub, _digest(i + 100), r, s)
+        assert not p256.verify_digest(kp.pub, _digest(i + 100), r, s)
+
+
+def test_oracle_signatures_verify_under_fastec():
+    kp = p256.generate_keypair()
+    d = _digest(7)
+    r, s = p256.sign_digest(kp.priv, d, k=0x1234567DEADBEEF)
+    assert fastec.verify_digest(kp.pub, d, r, s)
+    assert p256.verify_digest(kp.pub, d, r, s)
+
+
+def test_high_s_accepted_at_raw_layer_by_both():
+    # The low-S rule lives in parse_and_precheck, NOT in verify_digest
+    # (Go crypto/ecdsa accepts both nonce images).
+    kp = fastec.generate_keypair()
+    d = _digest(8)
+    r, s = fastec.sign_digest(kp.priv, d)
+    assert fastec.verify_digest(kp.pub, d, r, p256.N - s)
+    assert p256.verify_digest(kp.pub, d, r, p256.N - s)
+
+
+def test_out_of_range_and_off_curve_match_oracle():
+    kp = fastec.generate_keypair()
+    d = _digest(9)
+    for r, s in [(0, 1), (1, 0), (p256.N, 1), (1, p256.N), (-1, 1)]:
+        assert fastec.verify_digest(kp.pub, d, r, s) is False
+        assert p256.verify_digest(kp.pub, d, r, s) is False
+    off_curve = (5, 7)
+    assert fastec.verify_digest(off_curve, d, 3, 3) is False
+    assert p256.verify_digest(off_curve, d, 3, 3) is False
+
+
+def test_non_sha256_digest_falls_back_to_oracle_semantics():
+    # hashToInt truncation: leftmost 32 bytes of a longer digest.
+    kp = fastec.generate_keypair()
+    long_digest = hashlib.sha512(b"long").digest()
+    r, s = fastec.sign_digest(kp.priv, long_digest)
+    assert fastec.verify_digest(kp.pub, long_digest, r, s)
+    assert p256.verify_digest(kp.pub, long_digest, r, s)
+
+
+def test_pub_cache_eviction_keeps_answers_right(monkeypatch):
+    monkeypatch.setattr(fastec, "_CACHE_CAP", 2)
+    monkeypatch.setattr(fastec, "_PUB_CACHE", {})
+    kps = [fastec.generate_keypair() for _ in range(5)]
+    d = _digest(10)
+    sigs = [fastec.sign_digest(kp.priv, d) for kp in kps]
+    for _ in range(2):  # second pass re-materializes evicted keys
+        for kp, (r, s) in zip(kps, sigs):
+            assert fastec.verify_digest(kp.pub, d, r, s)
+
+
+class TestProviderDifferential:
+    """SoftwareProvider (OpenSSL) vs PurePythonProvider (oracle): identical
+    verdicts AND identical error lanes through the full BCCSP contract."""
+
+    def test_verdicts_and_error_lanes_agree(self):
+        fast, oracle = SoftwareProvider(), PurePythonProvider()
+        key = fast.key_gen()
+        d = fast.hash(b"provider differential")
+        sig = fast.sign(key, d)
+        r, s = der.unmarshal_signature(sig)
+        cases = [
+            sig,  # valid
+            der.marshal_signature(r, p256.N - s),  # high-S -> VerifyError
+            b"\x30\x02\x02\x00",  # malformed DER -> VerifyError
+            der.marshal_signature(r, (s + 1) % p256.N),  # clean False
+        ]
+        for c in cases:
+            outcomes = []
+            for prov in (fast, oracle):
+                try:
+                    outcomes.append(prov.verify(key.public, c, d))
+                except VerifyError:
+                    outcomes.append("error")
+            assert outcomes[0] == outcomes[1], c.hex()
+        assert fast.batch_verify(
+            [key.public] * 4, cases, [d] * 4
+        ) == oracle.batch_verify([key.public] * 4, cases, [d] * 4) == [
+            True,
+            False,
+            False,
+            False,
+        ]
+
+    def test_oracle_sign_verifies_under_fast_provider(self):
+        oracle = PurePythonProvider()
+        fast = SoftwareProvider()
+        key = oracle.key_gen()
+        d = oracle.hash(b"cross sign")
+        sig = oracle.sign(key, d)
+        assert fast.verify(key.public, sig, d)
