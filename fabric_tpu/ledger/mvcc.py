@@ -196,6 +196,11 @@ class Validator:
         from fabric_tpu.ledger.merkle import RangeQueryResultsHelper
 
         in_degree, in_level, in_hashes = rqi.reads_merkle_hashes
+        if in_degree < 2:
+            # a crafted/zero-default summary must invalidate THIS tx as a
+            # phantom read, not raise out of the whole block commit (the
+            # _MerkleTree constructor rejects max_degree < 2)
+            return False
         helper = RangeQueryResultsHelper(True, in_degree)
         last_matched = -1
         for key, version in actual:

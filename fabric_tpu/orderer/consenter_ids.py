@@ -1,0 +1,134 @@
+"""Stable consenter -> raft-id tracking for the etcdraft consenter.
+
+The reference keeps per-consenter raft IDs in the etcdraft BlockMetadata
+stamped into every block's ORDERER metadata slot
+(orderer/consensus/etcdraft/etcdraft.proto BlockMetadata;
+chain.go writeBlock + util.go MembershipChanges): a consenter keeps its id
+for the channel's lifetime, removed consenters retire their id forever, and
+new consenters draw fresh ids from a monotonic counter.  Positional ids
+(list index) break on any non-tail removal or reorder — the departing node
+would keep consenting while an innocent one is evicted.
+
+This module mirrors that design.  The mapping is keyed by the consenter's
+host:port endpoint (our transport identity); the serialized form carries
+the endpoints explicitly so a node joining mid-life reads the authoritative
+mapping straight from any replicated block instead of re-deriving it
+positionally from the config.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+from fabric_tpu.protos import common_pb2, configtx_pb2, configuration_pb2, protoutil
+
+
+def consenters_from_config_block(
+    block: common_pb2.Block,
+) -> Optional[List[str]]:
+    """host:port consenter endpoints from a CONFIG block's etcdraft
+    metadata; None for non-config blocks, non-raft channels, or parse
+    failures (callers then leave the mapping untouched)."""
+    from google.protobuf.message import DecodeError
+
+    try:
+        env = protoutil.get_envelope_from_block_data(block.data.data[0])
+        payload = protoutil.unmarshal(common_pb2.Payload, env.payload)
+        cenv = protoutil.unmarshal(configtx_pb2.ConfigEnvelope, payload.data)
+        og = cenv.config.channel_group.groups.get("Orderer")
+        if og is None:
+            return None
+        ct_value = og.values.get("ConsensusType")
+        if ct_value is None:
+            return None
+        ct = protoutil.unmarshal(
+            configuration_pb2.ConsensusType, ct_value.value
+        )
+        if ct.type != "etcdraft":
+            return None
+        meta = protoutil.unmarshal(
+            configuration_pb2.RaftConfigMetadata, ct.metadata
+        )
+    except (ValueError, IndexError, DecodeError):
+        # get_envelope_from_block_data parses raw bytes and can raise the
+        # protobuf DecodeError directly (a leader-flagged "config" entry
+        # whose payload is not a valid Envelope must not kill the channel's
+        # apply loop); the other steps wrap parse errors in ValueError.
+        return None
+    return [f"{c.host}:{c.port}" for c in meta.consenters]
+
+
+class ConsenterIdTracker:
+    """The (endpoint -> raft id, next id) state machine.
+
+    Deterministic: every node that applies the same sequence of consenter
+    sets reaches the same mapping, so each node stamping its own blocks
+    (like the reference's per-node writeBlock) yields identical bytes.
+    """
+
+    def __init__(self, ids: Dict[str, int], next_id: int):
+        self.ids = dict(ids)
+        self.next_id = next_id
+
+    @classmethod
+    def bootstrap(cls, addresses: Sequence[str]) -> "ConsenterIdTracker":
+        """Genesis rule: ids 1..n in config order (etcdraft chain start)."""
+        ids = {a: i + 1 for i, a in enumerate(addresses)}
+        return cls(ids, len(addresses) + 1)
+
+    def apply(self, new_addresses: Sequence[str]) -> None:
+        """Consenter-set change: removed endpoints retire their ids, added
+        endpoints draw fresh ones (util.go MembershipChanges semantics)."""
+        new_set = set(new_addresses)
+        for addr in [a for a in self.ids if a not in new_set]:
+            del self.ids[addr]
+        for addr in new_addresses:
+            if addr not in self.ids:
+                self.ids[addr] = self.next_id
+                self.next_id += 1
+
+    def peer_ids(self) -> List[int]:
+        return sorted(self.ids.values())
+
+    def id_for(self, address: str) -> Optional[int]:
+        return self.ids.get(address)
+
+    def is_member(self, node_id: int) -> bool:
+        return node_id in self.ids.values()
+
+    # -- block metadata (ORDERER slot) --------------------------------------
+    def to_bytes(self) -> bytes:
+        meta = configuration_pb2.RaftBlockMetadata()
+        for addr in sorted(self.ids, key=self.ids.__getitem__):
+            meta.consenter_addresses.append(addr)
+            meta.consenter_ids.append(self.ids[addr])
+        meta.next_consenter_id = self.next_id
+        return meta.SerializeToString()
+
+    def stamp(self, block: common_pb2.Block) -> None:
+        """Write the mapping into the block's ORDERER metadata slot (the
+        reference stamps etcdraft BlockMetadata the same way)."""
+        protoutil.init_block_metadata(block)
+        block.metadata.metadata[common_pb2.ORDERER] = self.to_bytes()
+
+    @classmethod
+    def from_block(cls, block: Optional[common_pb2.Block]) -> Optional["ConsenterIdTracker"]:
+        """Recover the mapping from a stored/replicated block; None when the
+        block predates id tracking (then callers fall back to bootstrap)."""
+        if block is None:
+            return None
+        metas = block.metadata.metadata
+        if len(metas) <= common_pb2.ORDERER or not metas[common_pb2.ORDERER]:
+            return None
+        try:
+            meta = protoutil.unmarshal(
+                configuration_pb2.RaftBlockMetadata, metas[common_pb2.ORDERER]
+            )
+        except ValueError:
+            return None
+        if not meta.consenter_ids or len(meta.consenter_ids) != len(
+            meta.consenter_addresses
+        ):
+            return None
+        ids = dict(zip(meta.consenter_addresses, meta.consenter_ids))
+        return cls(ids, meta.next_consenter_id or max(ids.values()) + 1)

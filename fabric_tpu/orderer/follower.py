@@ -19,10 +19,14 @@ ledger must first be replicated from the cluster).  It:
 The block store path is the one RaftChain would use, so promotion is a
 pure restart: the raft chain opens the same ledger at the same height.
 
-Node identity follows this codebase's convention: raft node id == the
-1-based index into the consensus-metadata consenter list (see
-nodes/orderer.py _refresh_cluster_endpoints); membership is therefore
-node_id <= len(consenters).
+Node identity: raft ids are STABLE per consenter (consenter_ids.py mirrors
+the reference's etcdraft BlockMetadata) — a node's configured raft_node_id
+must be the id the cluster assigned when its endpoint entered the
+consenter set.  Membership checks read the mapping from replicated blocks'
+ORDERER metadata; the positional convention (node_id == 1-based list
+index) remains only as the fallback for ledgers written before id
+tracking existed (there the two coincide, since ids start positional and
+those ledgers never saw a non-tail removal).
 """
 
 from __future__ import annotations
@@ -33,6 +37,7 @@ from typing import Callable, List, Optional, Sequence
 
 from fabric_tpu.deliver.client import BlockDeliverer
 from fabric_tpu.ledger.blockstore import BlockStore
+from fabric_tpu.orderer.consenter_ids import ConsenterIdTracker
 from fabric_tpu.orderer.raft_chain import _is_config_block
 from fabric_tpu.protos import common_pb2, configuration_pb2, protoutil
 
@@ -87,6 +92,19 @@ class FollowerChain:
         self.block_store = BlockStore(os.path.join(base, "chain.blocks"))
         if self.join_number == 0 and self.block_store.height == 0:
             self.block_store.add_block(join_block)
+        # Stable raft-id mapping read from replicated blocks' ORDERER
+        # metadata (consenter_ids.py); positional fallback for blocks
+        # written before id tracking existed.  A restarted follower
+        # prefers its LAST stored block — the join block's mapping goes
+        # stale as soon as a replicated config block changes the set.
+        last = (
+            self.block_store.get_block_by_number(self.block_store.height - 1)
+            if self.block_store.height
+            else None
+        )
+        self.tracker = ConsenterIdTracker.from_block(
+            last
+        ) or ConsenterIdTracker.from_block(join_block)
         self._member = threading.Event()
         self._stop = threading.Event()
         self._deliverer: Optional[BlockDeliverer] = None
@@ -119,7 +137,18 @@ class FollowerChain:
         )
         self._thread.start()
 
+    def _is_member(self) -> bool:
+        """Membership by stable raft id when the mapping is known, else the
+        positional convention (pre-tracking blocks)."""
+        if self.tracker is not None:
+            return self.tracker.is_member(self.node_id)
+        return is_member(self.bundle, self.node_id)
+
     def _exclude_self(self, addrs: Sequence[str]) -> List[str]:
+        if self.tracker is not None:
+            return [
+                a for a in addrs if self.tracker.id_for(a) != self.node_id
+            ]
         out = list(addrs)
         if 1 <= self.node_id <= len(out):
             out.pop(self.node_id - 1)
@@ -162,6 +191,9 @@ class FollowerChain:
         ):
             raise ConnectionError(f"block {h} DataHash mismatch")
         self.block_store.add_block(block)
+        pulled = ConsenterIdTracker.from_block(block)
+        if pulled is not None:
+            self.tracker = pulled
         if _is_config_block(block):
             self._on_config_block(block)
 
@@ -172,7 +204,7 @@ class FollowerChain:
             self.bundle = bundle_from_genesis_block(block, self.provider)
         except Exception:  # noqa: BLE001 - keep following on a bad bundle
             return
-        if is_member(self.bundle, self.node_id) and self.height > self.join_number:
+        if self._is_member() and self.height > self.join_number:
             self._member.set()
             if self._deliverer is not None:
                 self._deliverer.stop()
@@ -181,7 +213,7 @@ class FollowerChain:
         """Joining with a non-genesis block where we're already a member:
         onboarding mode — replicate up to the join block, then promote
         (onboarding.go ReplicateChains)."""
-        if is_member(self.bundle, self.node_id):
+        if self._is_member():
             # promotion happens when the pull reaches the join block; the
             # per-block hook below watches plain blocks too in this mode
             orig_append = self._append

@@ -12,6 +12,7 @@ registrar.go JoinChannel).
 from __future__ import annotations
 
 import os
+import threading
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Sequence
 
@@ -74,6 +75,9 @@ class Registrar:
         self.follower_endpoint_factory = follower_endpoint_factory
         self.chains: Dict[str, ChainSupport] = {}
         self.followers: Dict[str, object] = {}  # channel -> FollowerChain
+        # serializes chains/followers mutations: join_channel (gRPC
+        # threads) races _promote_follower (the follower's pull thread)
+        self._registry_lock = threading.RLock()
         self._block_listeners: List[Callable[[str, common_pb2.Block], None]] = []
         self._chain_listeners: List[Callable[[ChainSupport], None]] = []
 
@@ -110,19 +114,30 @@ class Registrar:
         config says so (orderer/common/follower + onboarding)."""
         bundle = bundle_from_genesis_block(genesis_block, self.provider)
         channel_id = bundle.channel_id
-        if channel_id in self.chains or channel_id in self.followers:
-            raise RegistrarError(f"channel {channel_id} already exists")
-        if (
-            self.follower_endpoint_factory is not None
-            and bundle.orderer is not None
-            and bundle.orderer.consensus_type == "etcdraft"
-        ):
-            from fabric_tpu.orderer.follower import is_member
+        with self._registry_lock:
+            if channel_id in self.chains or channel_id in self.followers:
+                raise RegistrarError(f"channel {channel_id} already exists")
+            if (
+                self.follower_endpoint_factory is not None
+                and bundle.orderer is not None
+                and bundle.orderer.consensus_type == "etcdraft"
+            ):
+                from fabric_tpu.orderer.consenter_ids import ConsenterIdTracker
+                from fabric_tpu.orderer.follower import is_member
 
-            member = is_member(bundle, self.raft_node_id)
-            if not member or genesis_block.header.number > 0:
-                return self._start_follower(channel_id, bundle, genesis_block)
-        return self._start_chain(channel_id, bundle, genesis_block)
+                # a join block carrying the cluster's id mapping decides
+                # membership by stable id; genesis joins are positional
+                tracker = ConsenterIdTracker.from_block(genesis_block)
+                member = (
+                    tracker.is_member(self.raft_node_id)
+                    if tracker is not None
+                    else is_member(bundle, self.raft_node_id)
+                )
+                if not member or genesis_block.header.number > 0:
+                    return self._start_follower(
+                        channel_id, bundle, genesis_block
+                    )
+            return self._start_chain(channel_id, bundle, genesis_block)
 
     def _start_follower(
         self,
@@ -151,8 +166,15 @@ class Registrar:
         """The follower reached a config where this node is a consenter:
         restart the channel as a raft member on the same ledger
         (follower_chain.go halt + registrar SwitchFollowerToChain)."""
-        self.followers.pop(follower.channel_id, None)
-        return self._start_chain(follower.channel_id, follower.bundle, None)
+        with self._registry_lock:
+            # start the chain BEFORE dropping the follower entry so deliver
+            # lookups never see the channel in neither map; _start_chain
+            # inserting into chains also blocks a racing join_channel
+            support = self._start_chain(
+                follower.channel_id, follower.bundle, None
+            )
+            self.followers.pop(follower.channel_id, None)
+            return support
 
     def channel_info(self, channel_id: str) -> Optional[Dict[str, object]]:
         """Channel-participation style status
@@ -202,17 +224,17 @@ class Registrar:
 
         consensus = bundle.orderer.consensus_type if bundle.orderer else "solo"
         if consensus == "etcdraft":
-            from fabric_tpu.protos import configuration_pb2
+            from fabric_tpu.orderer.follower import consenter_addresses
 
-            meta = protoutil.unmarshal(
-                configuration_pb2.RaftConfigMetadata,
-                bundle.orderer.consensus_metadata,
-            )
-            peer_ids = list(range(1, len(meta.consenters) + 1)) or [1]
+            addresses = consenter_addresses(bundle)
+            # positional fallback only; RaftChain prefers the stable id
+            # mapping recovered from the ledger's ORDERER block metadata
+            peer_ids = list(range(1, len(addresses) + 1)) or [1]
             chain = RaftChain(
                 channel_id,
                 self.raft_node_id,
                 peer_ids,
+                initial_consenters=addresses,
                 wal_dir=os.path.join(self.work_dir, "etcdraft"),
                 signer=self.signer,
                 batch_config=batch_config,
@@ -259,7 +281,15 @@ class Registrar:
         support.processor.update_bundle(new_bundle)
         new_consenters = len(consenter_addresses(new_bundle))
         chain = support.chain
-        desired = set(range(1, new_consenters + 1))
+        # Stable per-consenter raft ids come from the chain's tracker
+        # (updated when the config block was written — raft_chain
+        # _apply_entry), NOT from list positions: removing or reordering
+        # a non-tail consenter must evict exactly the departed node.
+        desired = (
+            set(chain.tracker.peer_ids())
+            if isinstance(chain, RaftChain) and chain.tracker is not None
+            else set(range(1, new_consenters + 1))
+        )
         if (
             new_consenters > 0
             and isinstance(chain, RaftChain)
@@ -297,6 +327,15 @@ class Registrar:
         the system channel (reference systemchannel.go
         NewChannelConfig): instantiate the channel from the consortium
         definition + the update's Application write set."""
+        with self._registry_lock:
+            return self._new_channel_from_update_locked(env)
+
+    def _new_channel_from_update_locked(
+        self, env: common_pb2.Envelope
+    ) -> ChainSupport:
+        # under _registry_lock: the exists-check and the _start_chain
+        # insert must be atomic vs concurrent creations and promotions,
+        # or two chains end up appending to one wal_dir ledger
         if self.system_channel_id is None:
             raise RegistrarError(
                 "no system channel: create channels via join_channel"
@@ -315,7 +354,7 @@ class Registrar:
             configtx_pb2.ConfigUpdate, cue.config_update
         )
         channel_id = update.channel_id
-        if channel_id in self.chains:
+        if channel_id in self.chains or channel_id in self.followers:
             raise RegistrarError(f"channel {channel_id} already exists")
 
         cons_value = update.write_set.values.get("Consortium")

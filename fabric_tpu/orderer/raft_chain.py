@@ -19,6 +19,10 @@ from typing import Callable, Dict, List, Optional, Sequence
 
 from fabric_tpu.orderer.blockcutter import BatchConfig, BlockCutter
 from fabric_tpu.orderer.blockwriter import BlockWriter
+from fabric_tpu.orderer.consenter_ids import (
+    ConsenterIdTracker,
+    consenters_from_config_block,
+)
 from fabric_tpu.orderer.raft import (
     ENTRY_CONF,
     ENTRY_NORMAL,
@@ -86,6 +90,7 @@ class RaftChain:
         snapshot_interval: int = 100,
         transport: Optional[Callable[[int, Message], None]] = None,
         on_config_block: Optional[Callable[[common_pb2.Block], None]] = None,
+        initial_consenters: Optional[Sequence[str]] = None,
     ):
         self.channel_id = channel_id
         # One lock serializes everything that mutates raft/cutter/writer
@@ -94,7 +99,6 @@ class RaftChain:
         # once the transport is real sockets (the reference serializes the
         # same way through the etcdraft chain's single run() goroutine).
         self._lock = threading.RLock()
-        self.node = RaftNode(node_id, peers)
         self.cutter = BlockCutter(batch_config)
         self._sink = sink
         self._on_config_block = on_config_block
@@ -115,6 +119,19 @@ class RaftChain:
             if self.block_store.height
             else None
         )
+        # Stable consenter->raft-id mapping (reference etcdraft
+        # BlockMetadata): authoritative source is the last stored block's
+        # ORDERER metadata (survives restarts AND mid-life joins, where a
+        # replicated join block carries the cluster's mapping); a fresh
+        # genesis falls back to the positional bootstrap rule.
+        self.tracker = ConsenterIdTracker.from_block(
+            last_block
+        ) or ConsenterIdTracker.from_block(genesis_block)
+        if self.tracker is None and initial_consenters:
+            self.tracker = ConsenterIdTracker.bootstrap(initial_consenters)
+        if self.tracker is not None and self.tracker.peer_ids():
+            peers = self.tracker.peer_ids()
+        self.node = RaftNode(node_id, peers)
         self.writer = BlockWriter(
             signer=signer,
             sink=self._store_block,
@@ -128,6 +145,17 @@ class RaftChain:
         self._persisted_snap_index = self.node.snap_index
 
         if genesis_block is not None and self.writer.height == 0:
+            if (
+                self.tracker is not None
+                and ConsenterIdTracker.from_block(genesis_block) is None
+            ):
+                # stamp a COPY so followers joining later read the mapping
+                # from block 0 — the caller's genesis object stays
+                # byte-identical to the configtx artifact
+                stamped = common_pb2.Block()
+                stamped.CopyFrom(genesis_block)
+                self.tracker.stamp(stamped)
+                genesis_block = stamped
             self.writer.append_bootstrap(genesis_block)
 
     # -- persistence --------------------------------------------------------
@@ -295,6 +323,14 @@ class RaftChain:
         block.ParseFromString(entry.data[1:])
         if block.header.number != self.writer.height:
             return  # stale re-proposal from a deposed leader
+        if self.tracker is not None:
+            if is_config:
+                # a consenter-set change takes effect in the mapping at the
+                # config block that carries it (chain.go writeConfigBlock)
+                addrs = consenters_from_config_block(block)
+                if addrs is not None:
+                    self.tracker.apply(addrs)
+            self.tracker.stamp(block)
         self.writer.write_block(block, is_config=is_config)
         if is_config and self._on_config_block is not None:
             self._on_config_block(block)
@@ -326,6 +362,17 @@ class RaftChain:
                 if b.header.number != self.writer.height:
                     continue
                 is_config = _is_config_block(b)
+                # replicated blocks carry the cluster's authoritative
+                # consenter-id mapping; adopt it (else derive + stamp)
+                pulled = ConsenterIdTracker.from_block(b)
+                if pulled is not None:
+                    self.tracker = pulled
+                elif self.tracker is not None:
+                    if is_config:
+                        addrs = consenters_from_config_block(b)
+                        if addrs is not None:
+                            self.tracker.apply(addrs)
+                    self.tracker.stamp(b)
                 self.writer.write_block(b, is_config=is_config)
                 if is_config and self._on_config_block is not None:
                     self._on_config_block(b)

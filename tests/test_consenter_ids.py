@@ -1,0 +1,158 @@
+"""Stable consenter -> raft-id tracking (reference etcdraft BlockMetadata,
+orderer/consensus/etcdraft/etcdraft.proto + util.go MembershipChanges).
+
+The positional rule (id == list index) breaks on non-tail removals: the
+highest id is evicted instead of the departed node.  These tests pin the
+stable-id semantics through the tracker, the chain's block stamping, and
+restart recovery from block metadata.
+"""
+
+import time
+
+from fabric_tpu.channelconfig import (
+    ApplicationProfile,
+    OrdererProfile,
+    OrganizationProfile,
+    Profile,
+    genesis_block,
+)
+from fabric_tpu.msp.cryptogen import generate_org
+from fabric_tpu.msp.signer import SigningIdentity
+from fabric_tpu.orderer.consenter_ids import (
+    ConsenterIdTracker,
+    consenters_from_config_block,
+)
+from fabric_tpu.orderer.raft import ENTRY_NORMAL, Entry
+from fabric_tpu.protos import common_pb2, protoutil
+
+CHANNEL = "idtrackchan"
+
+
+class TestTracker:
+    def test_bootstrap_is_positional(self):
+        t = ConsenterIdTracker.bootstrap(["a:1", "b:2", "c:3"])
+        assert t.ids == {"a:1": 1, "b:2": 2, "c:3": 3}
+        assert t.next_id == 4
+
+    def test_non_tail_removal_keeps_survivor_ids(self):
+        t = ConsenterIdTracker.bootstrap(["a:1", "b:2", "c:3"])
+        t.apply(["b:2", "c:3"])  # remove the FIRST consenter
+        assert t.peer_ids() == [2, 3]  # NOT {1, 2}
+        assert not t.is_member(1)
+
+    def test_reorder_changes_nothing(self):
+        t = ConsenterIdTracker.bootstrap(["a:1", "b:2", "c:3"])
+        t.apply(["c:3", "a:1", "b:2"])
+        assert t.ids == {"a:1": 1, "b:2": 2, "c:3": 3}
+
+    def test_readd_draws_a_fresh_id(self):
+        t = ConsenterIdTracker.bootstrap(["a:1", "b:2"])
+        t.apply(["b:2"])
+        t.apply(["b:2", "a:1"])  # a returns: retired id 1 is NOT reused
+        assert t.ids == {"b:2": 2, "a:1": 3}
+        assert t.next_id == 4
+
+    def test_block_metadata_roundtrip(self):
+        t = ConsenterIdTracker.bootstrap(["a:1", "b:2", "c:3"])
+        t.apply(["b:2", "c:3", "d:4"])
+        block = protoutil.new_block(5, b"\x00" * 32)
+        protoutil.seal_block(block)
+        t.stamp(block)
+        back = ConsenterIdTracker.from_block(block)
+        assert back is not None
+        assert back.ids == t.ids
+        assert back.next_id == t.next_id
+
+    def test_from_block_without_metadata_is_none(self):
+        block = protoutil.new_block(0, b"")
+        protoutil.seal_block(block)
+        assert ConsenterIdTracker.from_block(block) is None
+        assert ConsenterIdTracker.from_block(None) is None
+
+
+def _profile(org1, oorg, consenter_ports):
+    return Profile(
+        application=ApplicationProfile(
+            organizations=[OrganizationProfile("Org1MSP", org1.msp_config())]
+        ),
+        orderer=OrdererProfile(
+            orderer_type="etcdraft",
+            batch_timeout="100ms",
+            max_message_count=1,
+            organizations=[
+                OrganizationProfile("OrdererMSP", oorg.msp_config())
+            ],
+            raft_consenters=[
+                ("127.0.0.1", p, b"", b"") for p in consenter_ports
+            ],
+        ),
+    )
+
+
+def test_chain_applies_and_stamps_stable_ids(tmp_path):
+    """Write a non-tail-removal config block through the chain's apply
+    path: the survivor keeps its id, the block is stamped with the new
+    mapping, and a restarted chain recovers peers from the metadata (not
+    positionally)."""
+    from fabric_tpu.orderer.multichannel import Registrar
+    from fabric_tpu.orderer.raft_chain import RaftChain
+
+    org1 = generate_org("org1.idtrack", "Org1MSP")
+    oorg = generate_org("orderer.idtrack", "OrdererMSP")
+    pa, pb, pc = 7101, 7102, 7103
+    gblock = genesis_block(_profile(org1, oorg, [pa, pb, pc]), CHANNEL)
+
+    registrar = Registrar(
+        str(tmp_path / "orderer"),
+        signer=SigningIdentity(oorg.peers[0]),
+        raft_node_id=1,
+    )
+    support = registrar.join_channel(gblock)
+    chain = support.chain
+    assert chain.node.peers == {1, 2, 3}
+    assert chain.tracker.peer_ids() == [1, 2, 3]
+    # genesis got stamped so later joiners read the mapping from block 0
+    stored = chain.get_block(0)
+    assert ConsenterIdTracker.from_block(stored).ids == chain.tracker.ids
+
+    # config block dropping the FIRST consenter (pa): b and c keep 2, 3
+    shrunk = genesis_block(_profile(org1, oorg, [pb, pc]), CHANNEL)
+    assert consenters_from_config_block(shrunk) == [
+        f"127.0.0.1:{pb}",
+        f"127.0.0.1:{pc}",
+    ]
+    config_block = protoutil.new_block(1, chain.block_store.last_block_hash)
+    for d in shrunk.data.data:
+        config_block.data.data.append(d)
+    protoutil.seal_block(config_block)
+
+    # drive the committed-entry apply path directly (the raft commit
+    # itself is covered by test_follower's grow test; a 3-peer quorum
+    # cannot form in-process here)
+    chain._apply_entry(
+        Entry(
+            index=1,
+            term=1,
+            type=ENTRY_NORMAL,
+            data=b"\x01" + config_block.SerializeToString(),
+        )
+    )
+    assert chain.height == 2
+    assert chain.tracker.peer_ids() == [2, 3]  # positional would say [1, 2]
+    assert not chain.tracker.is_member(1)
+    stamped = ConsenterIdTracker.from_block(chain.get_block(1))
+    assert stamped.ids == {f"127.0.0.1:{pb}": 2, f"127.0.0.1:{pc}": 3}
+    # the registrar's bridge derived its desired set from the tracker
+    # (propose_conf_change was skipped only because we are not leader)
+    assert support.bundle.orderer is not None
+
+    # restart: peers recovered from the last block's ORDERER metadata
+    chain2 = RaftChain(
+        CHANNEL,
+        2,
+        [1, 2],  # wrong positional fallback on purpose
+        wal_dir=str(tmp_path / "orderer" / "etcdraft"),
+        initial_consenters=[f"127.0.0.1:{pb}", f"127.0.0.1:{pc}"],
+    )
+    assert chain2.node.peers == {2, 3}
+    assert chain2.tracker.ids == stamped.ids
