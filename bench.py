@@ -257,36 +257,44 @@ def bench_idemix(n_sigs=8):
         )
     values = [[None, None, None, None]] * n_sigs
 
-    def run(device):
+    def run(device, count):
         start = time.perf_counter()
         out = verify_signatures_batch(
-            sigs,
-            [disclosure] * n_sigs,
+            sigs[:count],
+            [disclosure] * count,
             ik.ipk,
-            [msg] * n_sigs,
-            values,
+            [msg] * count,
+            values[:count],
             rh_index,
             device_pairing=device,
         )
         return (time.perf_counter() - start) * 1000.0, out
 
-    host_ms, host_out = run(False)
+    # the host oracle pairing is seconds/sig — time it over a 2-sig
+    # sample so the whole config fits the bench budget; device/host
+    # verdict parity over full batches is pinned by the kernel's
+    # differential tests (tests/test_pairing_kernel.py)
+    n_host = min(2, n_sigs)
+    host_ms, host_out = run(False, n_host)
     if not all(host_out):
         raise RuntimeError("config #3 host verification failed")
     result = {
         "sigs": n_sigs,
-        "host_ms_per_sig": round(host_ms / n_sigs, 1),
+        "host_ms_per_sig": round(host_ms / n_host, 1),
+        "host_sample_sigs": n_host,
     }
     # The device Ate2 kernel's first compile is ~3.5 min on the TPU
     # (then cached; this bench's issuer key is seed-fixed so the program
     # caches across runs). BENCH_IDEMIX_DEVICE=0 opts out.
     if os.environ.get("BENCH_IDEMIX_DEVICE", "1") == "1":
-        run(True)  # compile warmup
-        dev_ms, dev_out = run(True)
-        if dev_out != host_out:
+        run(True, n_sigs)  # compile warmup
+        dev_ms, dev_out = run(True, n_sigs)
+        if dev_out[:n_host] != host_out or not all(dev_out):
             raise RuntimeError("config #3 device/host mismatch")
         result["device_ms_per_sig"] = round(dev_ms / n_sigs, 1)
-        result["speedup"] = round(host_ms / dev_ms, 1)
+        result["speedup"] = round(
+            (host_ms / n_host) / (dev_ms / n_sigs), 1
+        )
         result["mask_bit_exact"] = True
     else:
         result["device"] = "skipped (BENCH_IDEMIX_DEVICE=0)"

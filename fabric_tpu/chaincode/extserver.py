@@ -134,7 +134,19 @@ class _StreamHandler:
             req = peer_pb2.GetStateByRange()
             req.ParseFromString(msg.payload)
             out = peer_pb2.QueryResponse()
-            for key, value in stub.get_state_by_range(req.startKey, req.endKey):
+            if req.metadata:  # paginated form (QueryMetadata present)
+                qm = peer_pb2.QueryMetadata()
+                qm.ParseFromString(req.metadata)
+                rows, bookmark = stub.get_state_by_range_with_pagination(
+                    req.startKey, req.endKey, qm.pageSize, qm.bookmark
+                )
+                rm = peer_pb2.QueryResponseMetadata(
+                    fetched_records_count=len(rows), bookmark=bookmark
+                )
+                out.metadata = rm.SerializeToString()
+            else:
+                rows = stub.get_state_by_range(req.startKey, req.endKey)
+            for key, value in rows:
                 r = out.results.add()
                 r.resultBytes = json.dumps(
                     {"key": key, "value": value.decode("utf-8", "replace")}
@@ -145,7 +157,19 @@ class _StreamHandler:
             req = peer_pb2.GetQueryResult()
             req.ParseFromString(msg.payload)
             out = peer_pb2.QueryResponse()
-            for key, value in stub.get_query_result(req.query):
+            if req.metadata:  # paginated form
+                qm = peer_pb2.QueryMetadata()
+                qm.ParseFromString(req.metadata)
+                rows, bookmark = stub.get_query_result_with_pagination(
+                    req.query, qm.pageSize, qm.bookmark
+                )
+                rm = peer_pb2.QueryResponseMetadata(
+                    fetched_records_count=len(rows), bookmark=bookmark
+                )
+                out.metadata = rm.SerializeToString()
+            else:
+                rows = stub.get_query_result(req.query)
+            for key, value in rows:
                 r = out.results.add()
                 r.resultBytes = json.dumps(
                     {"key": key, "value": value.decode("utf-8", "replace")}

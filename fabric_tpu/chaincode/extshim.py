@@ -106,6 +106,42 @@ class ProxyStub:
             for r in resp.results
         )
 
+    def _paginated(self, msg_type, req, page_size: int, bookmark: str):
+        if page_size <= 0:
+            # QueryMetadata(0, "") serializes to zero bytes, which the
+            # server would read as "not paginated" — reject here so both
+            # deployment modes behave like the in-process shim
+            raise ValueError("pageSize must be a positive integer")
+        req.metadata = peer_pb2.QueryMetadata(
+            pageSize=page_size, bookmark=bookmark
+        ).SerializeToString()
+        raw = self._roundtrip(msg_type, req.SerializeToString())
+        resp = peer_pb2.QueryResponse()
+        resp.ParseFromString(raw)
+        rm = peer_pb2.QueryResponseMetadata()
+        rm.ParseFromString(resp.metadata)
+        rows = [
+            (json.loads(r.resultBytes)["key"],
+             json.loads(r.resultBytes)["value"].encode())
+            for r in resp.results
+        ]
+        return rows, rm.bookmark
+
+    def get_state_by_range_with_pagination(
+        self, start: str, end: str, page_size: int, bookmark: str = ""
+    ):
+        req = peer_pb2.GetStateByRange()
+        req.startKey = start
+        req.endKey = end
+        return self._paginated(CCM.GET_STATE_BY_RANGE, req, page_size, bookmark)
+
+    def get_query_result_with_pagination(
+        self, query, page_size: int, bookmark: str = ""
+    ):
+        req = peer_pb2.GetQueryResult()
+        req.query = query if isinstance(query, str) else json.dumps(query)
+        return self._paginated(CCM.GET_QUERY_RESULT, req, page_size, bookmark)
+
     def set_event(self, name: str, payload: bytes) -> None:
         ev = peer_pb2.ChaincodeEvent()
         ev.event_name = name

@@ -120,6 +120,23 @@ class ChaincodeStub:
         phantom-protected, like the reference)."""
         return iter(self._sim.execute_query(self._ns, query))
 
+    def get_query_result_with_pagination(
+        self, query, page_size: int, bookmark: str = ""
+    ) -> Tuple[List[Tuple[str, bytes]], str]:
+        """Shim GetQueryResultWithPagination: (page, next bookmark);
+        read-only transactions only (simulator enforces)."""
+        return self._sim.execute_query_with_pagination(
+            self._ns, query, page_size, bookmark
+        )
+
+    def get_state_by_range_with_pagination(
+        self, start_key: str, end_key: str, page_size: int, bookmark: str = ""
+    ) -> Tuple[List[Tuple[str, bytes]], str]:
+        """Shim GetStateByRangeWithPagination: bookmark = next key."""
+        return self._sim.get_state_range_with_pagination(
+            self._ns, start_key, end_key, page_size, bookmark
+        )
+
     # -- key-level endorsement (SBE) --
     def set_state_validation_parameter(self, key: str, policy: bytes) -> None:
         self._sim.set_state_metadata(

@@ -180,6 +180,63 @@ def chaincode_cmd(args) -> int:
     return 0
 
 
+def snapshot_cmd(args) -> int:
+    """peer snapshot submitrequest/cancelrequest/listpending (reference
+    cmd/peer snapshot + snapshotgrpc client): signed requests to the
+    peer's /protos.Snapshot service."""
+    from fabric_tpu.protos import peer_pb2
+
+    signer = _client_signer(args)
+    shdr = common_pb2.SignatureHeader()
+    shdr.creator = signer.serialize()
+    shdr.nonce = signer.new_nonce()
+    if args.cmd == "listpending":
+        req = peer_pb2.SnapshotQuery(
+            signature_header=shdr.SerializeToString(),
+            channel_id=args.channelID,
+        )
+    else:
+        req = peer_pb2.SnapshotRequest(
+            signature_header=shdr.SerializeToString(),
+            channel_id=args.channelID,
+            block_number=args.blockNumber,
+        )
+    raw = req.SerializeToString()
+    signed = peer_pb2.SignedSnapshotRequest(
+        request=raw, signature=signer.sign(raw)
+    )
+    from google.protobuf import empty_pb2
+
+    method, deser = {
+        "submitrequest": ("Generate", empty_pb2.Empty.FromString),
+        "cancelrequest": ("Cancel", empty_pb2.Empty.FromString),
+        "listpending": (
+            "QueryPendings",
+            peer_pb2.QueryPendingSnapshotsResponse.FromString,
+        ),
+    }[args.cmd]
+    conn = channel_to(args.peerAddress)
+    try:
+        stub = conn.unary_unary(
+            f"/protos.Snapshot/{method}",
+            request_serializer=peer_pb2.SignedSnapshotRequest.SerializeToString,
+            response_deserializer=deser,
+        )
+        resp = stub(signed)
+    finally:
+        conn.close()
+    if args.cmd == "listpending":
+        print(
+            "Successfully got pending snapshot requests: "
+            + json.dumps(sorted(resp.block_numbers))
+        )
+    elif args.cmd == "submitrequest":
+        print("Snapshot request submitted successfully")
+    else:
+        print("Snapshot request cancelled successfully")
+    return 0
+
+
 def _scc_invoke(addr, signer, channel, cc_name, cc_args):
     """One signed proposal to a (system) chaincode; returns the Response
     or exits nonzero on endorsement failure."""
@@ -534,6 +591,20 @@ def main(argv=None) -> int:
         p.add_argument("--mspDir", required=True)
         p.add_argument("--mspID", required=True)
 
+    snap = sub.add_parser("snapshot")
+    snap_sub = snap.add_subparsers(dest="cmd", required=True)
+    ss = snap_sub.add_parser("submitrequest")
+    ss.add_argument("-b", "--blockNumber", type=int, default=0,
+                    help="0 = next committed block")
+    sc = snap_sub.add_parser("cancelrequest")
+    sc.add_argument("-b", "--blockNumber", type=int, required=True)
+    sl = snap_sub.add_parser("listpending")
+    for p in (ss, sc, sl):
+        p.add_argument("-C", "--channelID", required=True)
+        p.add_argument("--peerAddress", required=True)
+        p.add_argument("--mspDir", required=True)
+        p.add_argument("--mspID", required=True)
+
     lc = sub.add_parser("lifecycle")
     lc_sub0 = lc.add_subparsers(dest="noun", required=True)
     lcc = lc_sub0.add_parser("chaincode")
@@ -564,6 +635,8 @@ def main(argv=None) -> int:
         return chaincode_cmd(args)
     if args.group == "channel":
         return channel_cmd(args)
+    if args.group == "snapshot":
+        return snapshot_cmd(args)
     if args.group == "lifecycle":
         return lifecycle_cmd(args)
     return 2

@@ -72,10 +72,18 @@ def register_endorser(server: GRPCServer, endorser) -> None:
 
 
 def register_peer_deliver(
-    server: GRPCServer, deliver_handler: DeliverHandler
+    server: GRPCServer,
+    deliver_handler: DeliverHandler,
+    pvt_entries=None,
+    pvt_policy_checker=None,
 ) -> None:
-    """The peer's Deliver service (block + filtered-block events to SDKs,
-    core/peer/deliverevents.go:239)."""
+    """The peer's Deliver service (block + filtered-block +
+    block-and-private-data events to SDKs, core/peer/deliverevents.go:239
+    and :270).  `pvt_entries(channel_id, block_num) -> [PvtEntry]` backs
+    DeliverWithPrivateData; when absent that method serves empty maps.
+    `pvt_policy_checker(channel_id, SignedData)` raises to deny access to
+    the private-data stream (required signed requests)."""
+    from fabric_tpu.deliver.server import deliver_with_pvtdata
 
     def deliver(request_iterator, context):
         for env in request_iterator:
@@ -84,6 +92,13 @@ def register_peer_deliver(
     def deliver_filtered_rpc(request_iterator, context):
         for env in request_iterator:
             yield from deliver_filtered(deliver_handler, env)
+
+    def deliver_pvt_rpc(request_iterator, context):
+        source = pvt_entries or (lambda ch, num: [])
+        for env in request_iterator:
+            yield from deliver_with_pvtdata(
+                deliver_handler, env, source, pvt_policy_checker
+            )
 
     server.register(
         "protos.Deliver",
@@ -97,6 +112,12 @@ def register_peer_deliver(
             "DeliverFiltered": (
                 STREAM_STREAM,
                 deliver_filtered_rpc,
+                common_pb2.Envelope.FromString,
+                ab_pb2.DeliverResponse.SerializeToString,
+            ),
+            "DeliverWithPrivateData": (
+                STREAM_STREAM,
+                deliver_pvt_rpc,
                 common_pb2.Envelope.FromString,
                 ab_pb2.DeliverResponse.SerializeToString,
             ),
@@ -141,3 +162,76 @@ def process_proposal(channel, signed: peer_pb2.SignedProposal) -> peer_pb2.Propo
         response_deserializer=peer_pb2.ProposalResponse.FromString,
     )
     return stub(signed)
+
+
+def register_snapshot_service(
+    server: GRPCServer,
+    managers,
+    policy_checker=None,
+) -> None:
+    """The peer's Snapshot admin service (reference
+    core/ledger/snapshotgrpc/snapshot_service.go:25-87: Generate, Cancel,
+    QueryPendings over SignedSnapshotRequest).
+
+    ``managers(channel_id)`` resolves the channel's
+    SnapshotRequestManager; ``policy_checker(channel_id, SignedData)``
+    raises to deny (the reference checks the snapshot/* ACL resources
+    against the channel admins)."""
+    from google.protobuf import empty_pb2
+
+    from fabric_tpu.policy.manager import SignedData
+
+    def _open(signed: peer_pb2.SignedSnapshotRequest, msg_cls):
+        req = msg_cls()
+        req.ParseFromString(signed.request)
+        if policy_checker is not None:
+            shdr = common_pb2.SignatureHeader()
+            shdr.ParseFromString(req.signature_header)
+            policy_checker(
+                req.channel_id,
+                SignedData(signed.request, shdr.creator, signed.signature),
+            )
+        mgr = managers(req.channel_id)
+        if mgr is None:
+            raise KeyError(f"channel {req.channel_id} not found")
+        return req, mgr
+
+    def generate(signed, context):
+        req, mgr = _open(signed, peer_pb2.SnapshotRequest)
+        mgr.submit(req.block_number)
+        return empty_pb2.Empty()
+
+    def cancel(signed, context):
+        req, mgr = _open(signed, peer_pb2.SnapshotRequest)
+        mgr.cancel(req.block_number)
+        return empty_pb2.Empty()
+
+    def query_pendings(signed, context):
+        _req, mgr = _open(signed, peer_pb2.SnapshotQuery)
+        return peer_pb2.QueryPendingSnapshotsResponse(
+            block_numbers=mgr.pending()
+        )
+
+    server.register(
+        "protos.Snapshot",
+        {
+            "Generate": (
+                UNARY,
+                generate,
+                peer_pb2.SignedSnapshotRequest.FromString,
+                empty_pb2.Empty.SerializeToString,
+            ),
+            "Cancel": (
+                UNARY,
+                cancel,
+                peer_pb2.SignedSnapshotRequest.FromString,
+                empty_pb2.Empty.SerializeToString,
+            ),
+            "QueryPendings": (
+                UNARY,
+                query_pendings,
+                peer_pb2.SignedSnapshotRequest.FromString,
+                peer_pb2.QueryPendingSnapshotsResponse.SerializeToString,
+            ),
+        },
+    )

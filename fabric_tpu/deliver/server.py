@@ -213,3 +213,83 @@ def deliver_filtered(
             yield out
         else:
             yield resp
+
+
+def pvt_data_map(entries) -> dict:
+    """Stored PvtEntry rows for one block -> {tx_num: TxPvtReadWriteSet}
+    (the wire shape of core/ledger TxPvtData in BlockAndPvtData)."""
+    from fabric_tpu.protos import rwset_pb2
+
+    by_tx: dict = {}
+    for e in sorted(entries, key=lambda e: (e.tx_num, e.namespace, e.collection)):
+        tx = by_tx.setdefault(e.tx_num, rwset_pb2.TxPvtReadWriteSet())
+        ns = None
+        for cand in tx.ns_pvt_rwset:
+            if cand.namespace == e.namespace:
+                ns = cand
+                break
+        if ns is None:
+            ns = tx.ns_pvt_rwset.add()
+            ns.namespace = e.namespace
+        coll = ns.collection_pvt_rwset.add()
+        coll.collection_name = e.collection
+        coll.rwset = e.rwset
+    return by_tx
+
+
+def deliver_with_pvtdata(
+    handler: DeliverHandler,
+    envelope: common_pb2.Envelope,
+    pvt_entries: Callable[[str, int], list],
+    policy_checker: Optional[Callable] = None,
+) -> Iterator[ab_pb2.DeliverResponse]:
+    """DeliverWithPrivateData stream (reference
+    core/peer/deliverevents.go:270 blockResponseSenderWithPrivateData):
+    each block response carries the peer's stored cleartext private
+    rwsets for that block, keyed by tx index.  Blocks whose private data
+    the peer never held (ineligible / purged by BTL) simply have no map
+    entry, exactly like the reference's DeliverWithPrivateData.
+
+    Unlike plain Deliver (public data), this stream exposes private
+    collection cleartext, so when a ``policy_checker(channel_id,
+    SignedData)`` is configured the request MUST be signed and satisfy it
+    (the reference gates the event ACL the same way); violations get a
+    FORBIDDEN status and no blocks."""
+    try:
+        payload = protoutil.unmarshal(common_pb2.Payload, envelope.payload)
+        chdr = protoutil.unmarshal(
+            common_pb2.ChannelHeader, payload.header.channel_header
+        )
+    except ValueError:
+        resp = ab_pb2.DeliverResponse()
+        resp.status = common_pb2.BAD_REQUEST
+        yield resp
+        return
+    if policy_checker is not None:
+        forbidden = ab_pb2.DeliverResponse()
+        forbidden.status = common_pb2.FORBIDDEN
+        if not payload.header.signature_header:
+            yield forbidden
+            return
+        shdr = protoutil.unmarshal(
+            common_pb2.SignatureHeader, payload.header.signature_header
+        )
+        try:
+            policy_checker(
+                chdr.channel_id,
+                SignedData(envelope.payload, shdr.creator, envelope.signature),
+            )
+        except Exception:  # noqa: BLE001 - any policy failure is FORBIDDEN
+            yield forbidden
+            return
+    for resp in handler.deliver_blocks(envelope):
+        if resp.WhichOneof("Type") == "block":
+            out = ab_pb2.DeliverResponse()
+            bpd = out.block_and_private_data
+            bpd.block.CopyFrom(resp.block)
+            entries = pvt_entries(chdr.channel_id, resp.block.header.number)
+            for tx_num, tx_pvt in pvt_data_map(entries).items():
+                bpd.private_data_map[tx_num].CopyFrom(tx_pvt)
+            yield out
+        else:
+            yield resp

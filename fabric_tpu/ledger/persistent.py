@@ -215,13 +215,23 @@ class SqliteVersionedDB:
 
     # -- rich queries (statecouchdb ExecuteQuery analog) --------------------
     def execute_query(self, ns: str, query) -> List[Tuple[str, bytes]]:
-        rows = (
+        return rich_queries.execute(self._query_rows(ns), query)
+
+    def execute_query_paginated(
+        self, ns: str, query, page_size: int, bookmark: str = ""
+    ):
+        """One page + next bookmark (statecouchdb.go:653)."""
+        return rich_queries.execute_paginated(
+            self._query_rows(ns), query, page_size, bookmark
+        )
+
+    def _query_rows(self, ns: str):
+        return (
             (key, bytes(value))
             for key, value in self._all(
                 "SELECT key, value FROM state WHERE ns=? ORDER BY key", (ns,)
             )
         )
-        return rich_queries.execute(rows, query)
 
     # -- history ------------------------------------------------------------
     def get_history(self, ns: str, key: str) -> List[Version]:

@@ -218,3 +218,67 @@ def _sort_key(doc, field):
     if isinstance(v, str):
         return (4, v)
     return (5, json.dumps(v))
+
+
+# ---------------------------------------------------------------------------
+# bookmark pagination (reference statecouchdb.go:567 range pagination /
+# :653 ExecuteQueryWithPagination; chaincode GetQueryResultWithPagination)
+# ---------------------------------------------------------------------------
+
+
+def encode_bookmark(offset: int) -> str:
+    """Opaque resumption token (CouchDB bookmarks are opaque strings; here
+    the payload is the count of result rows already consumed)."""
+    import base64
+
+    return base64.urlsafe_b64encode(
+        json.dumps({"o": offset}).encode()
+    ).decode()
+
+
+def decode_bookmark(bookmark: str) -> int:
+    import base64
+
+    if not bookmark:
+        return 0
+    try:
+        doc = json.loads(base64.urlsafe_b64decode(bookmark.encode()))
+        offset = doc["o"]
+        if not isinstance(offset, int) or offset < 0:
+            raise ValueError
+        return offset
+    except Exception as e:  # noqa: BLE001
+        raise QueryError(f"invalid bookmark {bookmark!r}") from e
+
+
+def execute_paginated(
+    rows: Iterable[Tuple[str, bytes]],
+    query,
+    page_size: int,
+    bookmark: str = "",
+) -> Tuple[List[Tuple[str, bytes]], str]:
+    """One page of rich-query results plus the next bookmark.
+
+    The page size overrides any `limit`/`skip` in the query document
+    (the reference rejects limit+pagination together,
+    statecouchdb.go:700 validateQueryMetadata; skip is ignored in favor
+    of the bookmark).  The returned bookmark resumes after the last
+    returned row; passing it back with the same query and a stable
+    snapshot yields the next page.  An exhausted result set returns the
+    bookmark pointing past the end (fetched count < page_size tells the
+    caller to stop, as with CouchDB)."""
+    if page_size <= 0:
+        raise QueryError("pageSize must be a positive integer")
+    q = parse_query(query)
+    if q["limit"] is not None or q["skip"]:
+        raise QueryError(
+            "limit/skip cannot be combined with pagination (use the "
+            "bookmark + pageSize contract)"
+        )
+    offset = decode_bookmark(bookmark)
+    all_hits = execute(
+        rows,
+        {"selector": q["selector"], "sort": q["sort"], "fields": q["fields"]},
+    )
+    page = all_hits[offset : offset + page_size]
+    return page, encode_bookmark(offset + len(page))

@@ -108,6 +108,10 @@ class TxSimulator:
         self._hashed_reads: Dict[Tuple[str, str], Dict[bytes, rw.KVReadHash]] = {}
         self._hashed_writes: Dict[Tuple[str, str], Dict[bytes, rw.KVWriteHash]] = {}
         self._pvt_writes: Dict[Tuple[str, str], Dict[str, PvtKVWrite]] = {}
+        # paginated queries restrict the tx to read-only (reference
+        # lockbased_tx_simulator.go: checkBeforePaginatedQueries /
+        # checkPaginatedQueryPerformed reject the mixed case)
+        self._paginated_queries_performed = False
 
     def _check_open(self) -> None:
         if self._done:
@@ -124,13 +128,22 @@ class TxSimulator:
 
     def set_state(self, ns: str, key: str, value: bytes) -> None:
         self._check_open()
+        self._check_no_paginated_queries()
         if not key:
             raise SimulationError("empty key is not supported")
         self._writes.setdefault(ns, {})[key] = rw.KVWrite(key, False, value)
 
     def delete_state(self, ns: str, key: str) -> None:
         self._check_open()
+        self._check_no_paginated_queries()
         self._writes.setdefault(ns, {})[key] = rw.KVWrite(key, True, b"")
+
+    def _check_no_paginated_queries(self) -> None:
+        if self._paginated_queries_performed:
+            raise SimulationError(
+                "writes are not allowed in a transaction that has "
+                "performed paginated queries (read-only contract)"
+            )
 
     def get_state_metadata(self, ns: str, key: str) -> Optional[bytes]:
         self._check_open()
@@ -184,6 +197,44 @@ class TxSimulator:
         (documented Fabric behavior)."""
         self._check_open()
         return self._db.execute_query(ns, query)
+
+    # -- pagination (bookmark contract) -----------------------------------
+    def execute_query_with_pagination(
+        self, ns: str, query, page_size: int, bookmark: str = ""
+    ) -> Tuple[List[Tuple[str, bytes]], str]:
+        """GetQueryResultWithPagination (statecouchdb.go:653): one page +
+        the resumption bookmark.  Like the reference
+        (lockbased_tx_simulator.go checkBeforePaginatedQueries), paginated
+        queries are for read-only transactions: performing one marks the
+        simulation and later writes are rejected."""
+        self._check_open()
+        self._paginated_queries_performed = True
+        return self._db.execute_query_paginated(ns, query, page_size, bookmark)
+
+    def get_state_range_with_pagination(
+        self, ns: str, start_key: str, end_key: str, page_size: int,
+        bookmark: str = "",
+    ) -> Tuple[List[Tuple[str, bytes]], str]:
+        """GetStateByRangeWithPagination (statecouchdb.go:567): the
+        bookmark is the next key to resume from; returned keys record
+        plain reads (MVCC-protected) but no phantom-protecting range
+        record, matching the reference's paginated range contract."""
+        self._check_open()
+        if page_size <= 0:
+            raise ValueError("pageSize must be a positive integer")
+        self._paginated_queries_performed = True
+        start = bookmark or start_key
+        results: List[Tuple[str, bytes]] = []
+        next_bookmark = ""
+        for key, vv in self._db.get_state_range(ns, start, end_key, False):
+            if len(results) == page_size:
+                next_bookmark = key
+                break
+            self._reads.setdefault(ns, {}).setdefault(
+                key, rw.KVRead(key, vv.version)
+            )
+            results.append((key, vv.value))
+        return results, next_bookmark
 
     # -- private data -----------------------------------------------------
     def get_private_data(self, ns: str, coll: str, key: str) -> Optional[bytes]:

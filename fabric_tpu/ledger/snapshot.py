@@ -172,3 +172,83 @@ def create_from_snapshot(snap_dir: str, ledger_dir: str):
     ledger.state_db.apply_updates(updates, hashed)
 
     return ledger
+
+
+class SnapshotRequestManager:
+    """Pending snapshot requests for one channel (reference
+    core/ledger/kvledger/snapshot_mgr.go: SubmitSnapshotRequest :60,
+    CancelSnapshotRequest :78, PendingSnapshotRequests :91).
+
+    Height 0 means "the next committed block".  When the committer
+    reaches a requested height (on_block_committed), the snapshot is
+    generated into  <snapshots_root>/<channel>/<height>/  and the request
+    retires.  Requests at or below the current height are rejected, as
+    the reference does."""
+
+    def __init__(self, ledger, snapshots_root: str):
+        import threading
+
+        self._ledger = ledger
+        self._root = snapshots_root
+        self._pending: set = set()
+        self._lock = threading.Lock()
+        self.generated: Dict[int, str] = {}
+
+    def submit(self, height: int = 0) -> int:
+        with self._lock:
+            current = self._ledger.height
+            if height == 0:
+                height = current  # next block to commit has this number
+            elif height < current:
+                raise ValueError(
+                    f"requested snapshot height {height} cannot be less "
+                    f"than the current height {current}"
+                )
+            if height in self._pending:
+                raise ValueError(
+                    f"duplicate snapshot request for height {height}"
+                )
+            self._pending.add(height)
+            return height
+
+    def cancel(self, height: int) -> None:
+        with self._lock:
+            if height not in self._pending:
+                raise ValueError(
+                    f"no snapshot request exists for height {height}"
+                )
+            self._pending.discard(height)
+
+    def pending(self) -> List[int]:
+        with self._lock:
+            return sorted(self._pending)
+
+    def on_block_committed(self, wait: bool = False) -> None:
+        """Commit hook: ledger.height-1 is the block just committed.
+
+        Generation runs on a worker thread so a large state export never
+        stalls the commit path (the reference generates snapshots after
+        commit, outside the critical section).  ``wait=True`` blocks
+        until the export finishes (tests/synchronous callers)."""
+        import threading
+
+        committed = self._ledger.height - 1
+        with self._lock:
+            if committed not in self._pending:
+                return
+            self._pending.discard(committed)
+        out_dir = os.path.join(
+            self._root, self._ledger.channel_id, str(committed)
+        )
+
+        def work():
+            generate_snapshot(self._ledger, out_dir)
+            with self._lock:
+                self.generated[committed] = out_dir
+
+        if wait:
+            work()
+        else:
+            threading.Thread(
+                target=work, name=f"snapshot-{committed}", daemon=True
+            ).start()

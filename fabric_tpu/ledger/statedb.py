@@ -258,3 +258,16 @@ class VersionedDB:
             (key, table[key].value) for key in self._sorted_keys.get(ns, [])
         )
         return rich_queries.execute(rows, query)
+
+    def execute_query_paginated(
+        self, ns: str, query, page_size: int, bookmark: str = ""
+    ):
+        """One page + next bookmark (statecouchdb.go:653
+        ExecuteQueryWithPagination)."""
+        from fabric_tpu.ledger import queries as rich_queries
+
+        table = self._data.get(ns, {})
+        rows = (
+            (key, table[key].value) for key in self._sorted_keys.get(ns, [])
+        )
+        return rich_queries.execute_paginated(rows, query, page_size, bookmark)

@@ -11,6 +11,7 @@ CRL, principal matching) stay host-side, with a deserialization cache
 from __future__ import annotations
 
 import datetime
+import hashlib
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -25,6 +26,11 @@ from fabric_tpu.protos import identities_pb2, msp_principal_pb2, protoutil
 
 class MSPError(Exception):
     pass
+
+
+# sentinel: "chain validation not yet succeeded" (None means validated OK;
+# failures are never cached — they may be time-dependent)
+_UNVALIDATED = object()
 
 
 @dataclass(frozen=True)
@@ -56,6 +62,14 @@ class Identity:
         self.msp_id = msp_id
         self.cert = cert
         self._provider = provider
+        # memoized derived forms: identities are deserialized once per
+        # distinct cert (MSP._deser_cache) but consulted per signature job
+        # — a 1k-tx block touches the same few identities thousands of
+        # times (reference msp/cache rationale)
+        self._serialized: Optional[bytes] = None
+        self._fingerprint: Optional[bytes] = None
+        self._ou_values: Optional[List[str]] = None
+        self._validation_err: object = _UNVALIDATED
         pub = cert.public_key()
         if not isinstance(pub, ec.EllipticCurvePublicKey) or not isinstance(
             pub.curve, ec.SECP256R1
@@ -66,14 +80,25 @@ class Identity:
 
     @property
     def ou_values(self) -> List[str]:
-        attrs = self.cert.subject.get_attributes_for_oid(
-            x509.NameOID.ORGANIZATIONAL_UNIT_NAME
-        )
-        return [a.value for a in attrs]
+        if self._ou_values is None:
+            attrs = self.cert.subject.get_attributes_for_oid(
+                x509.NameOID.ORGANIZATIONAL_UNIT_NAME
+            )
+            self._ou_values = [a.value for a in attrs]
+        return self._ou_values
 
     def serialize(self) -> bytes:
-        pem = self.cert.public_bytes(serialization.Encoding.PEM)
-        return protoutil.serialize_identity(self.msp_id, pem)
+        if self._serialized is None:
+            pem = self.cert.public_bytes(serialization.Encoding.PEM)
+            self._serialized = protoutil.serialize_identity(self.msp_id, pem)
+        return self._serialized
+
+    def fingerprint(self) -> bytes:
+        """SHA-256 of the serialized identity (cache keys in the validator
+        and policy layers)."""
+        if self._fingerprint is None:
+            self._fingerprint = hashlib.sha256(self.serialize()).digest()
+        return self._fingerprint
 
     def verify(self, msg: bytes, sig: bytes) -> None:
         """Raises MSPError on failure (reference Identity.Verify returns
@@ -135,6 +160,20 @@ class MSP:
 
     # -- validation (msp/mspimplvalidate.go) -------------------------------
     def validate(self, identity: Identity) -> None:
+        """Chain walk + expiry + CRL.  SUCCESS is memoized on the identity
+        for the process lifetime — the trade the reference makes in
+        msp/cache (a block consults the same few identities thousands of
+        times; chain building does an ECDSA verify per hop and dominated
+        block validation before memoization).  FAILURES are NOT cached:
+        'not yet valid' and expiry are time-dependent, and freezing a
+        pre-validity verdict forever would diverge this peer's
+        TRANSACTIONS_FILTER from peers that first saw the cert later."""
+        if identity._validation_err is None:
+            return
+        self._validate_uncached(identity)
+        identity._validation_err = None
+
+    def _validate_uncached(self, identity: Identity) -> None:
         cert = identity.cert
         chain = self._build_chain(cert)
         now = datetime.datetime.now(datetime.timezone.utc)

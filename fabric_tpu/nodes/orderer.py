@@ -189,9 +189,21 @@ class OrdererNode:
             )
         except ValueError:
             return
+        # raft ids are STABLE per consenter (orderer/consenter_ids.py) —
+        # route by the chain's tracker, never by list position: after a
+        # non-tail removal the positions shift but the ids must not
+        tracker = getattr(support.chain, "tracker", None)
+        if tracker is not None:
+            endpoints = {
+                node_id: addr for addr, node_id in tracker.ids.items()
+            }
+        else:
+            endpoints = {
+                i + 1: f"{c.host}:{c.port}"
+                for i, c in enumerate(meta.consenters)
+            }
         self.cluster_client.set_channel_endpoints(
-            support.channel_id,
-            {i + 1: f"{c.host}:{c.port}" for i, c in enumerate(meta.consenters)},
+            support.channel_id, endpoints
         )
 
     def _raft_tick_loop(self) -> None:

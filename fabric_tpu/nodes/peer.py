@@ -67,6 +67,7 @@ class PeerNode:
         # last deliver-loop failure per channel (blocksprovider logging)
         self.deliver_errors: Dict[str, str] = {}
         self._commit_listeners: list[Callable] = []
+        self.snapshot_managers: Dict[str, object] = {}
         self.gossip_nodes: Dict[str, object] = {}
         self._pipelines: Dict[str, object] = {}
 
@@ -165,7 +166,28 @@ class PeerNode:
 
         self.server = GRPCServer(listen_address, interceptors=interceptors)
         register_endorser(self.server, self.endorser)
-        register_peer_deliver(self.server, self.deliver)
+        register_peer_deliver(
+            self.server,
+            self.deliver,
+            pvt_entries=self._pvt_entries_for,
+            # private-collection cleartext leaves the peer only for
+            # clients satisfying the channel Readers policy (the event
+            # ACL the reference checks on this stream)
+            pvt_policy_checker=lambda cid, sd: self._channel_policy_check(
+                cid, "/Channel/Application/Readers", sd
+            ),
+        )
+        from fabric_tpu.comm.services import register_snapshot_service
+
+        register_snapshot_service(
+            self.server,
+            lambda cid: self.snapshot_managers.get(cid),
+            # snapshot admin ops need channel admins (reference
+            # snapshot/* ACL defaults)
+            policy_checker=lambda cid, sd: self._channel_policy_check(
+                cid, "/Channel/Application/Admins", sd
+            ),
+        )
         self.cc_listener.register(self.server)
 
         # discovery service (discovery/service.go) on the same listener
@@ -337,6 +359,26 @@ class PeerNode:
             wait_for,
         )
 
+    def _channel_policy_check(self, channel_id: str, path: str, sd) -> None:
+        """Evaluate one SignedData against a channel policy path (raises
+        on failure; signature verification happens inside the policy
+        evaluation, policies/policy.go SignatureSetToValidIdentities)."""
+        bundle = self._discovery_bundle(channel_id)
+        if bundle is None:
+            raise ValueError(f"channel {channel_id} not found")
+        policy, ok = bundle.policy_manager.get_policy(path)
+        if not ok:
+            raise ValueError(f"policy {path} not found on {channel_id}")
+        policy.evaluate_signed_data([sd])
+
+    def _pvt_entries_for(self, channel_id: str, block_num: int):
+        """DeliverWithPrivateData source: this peer's stored cleartext
+        private rwsets for one block (deliverevents.go:270)."""
+        ch = self.channels.get(channel_id)
+        if ch is None:
+            return []
+        return ch.ledger.pvt_store.get_pvt_data_by_block(block_num)
+
     # -- channel lifecycle ----------------------------------------------
     def join_channel(self, genesis_block: common_pb2.Block) -> Channel:
         """cscc JoinChain: bootstrap the channel from its genesis block
@@ -368,6 +410,12 @@ class PeerNode:
         if ch.ledger.height == 0:
             ch.ledger.commit(genesis_block)
         self.channels[channel_id] = ch
+        # snapshot request bookkeeping (snapshot_mgr.go) + commit hook
+        from fabric_tpu.ledger.snapshot import SnapshotRequestManager
+
+        self.snapshot_managers[channel_id] = SnapshotRequestManager(
+            ch.ledger, os.path.join(self.work_dir, "snapshots")
+        )
         return ch
 
     def commit_block(self, channel_id: str, block: common_pb2.Block):
@@ -380,6 +428,9 @@ class PeerNode:
         cond = self._commit_conds.setdefault(channel_id, threading.Condition())
         with cond:
             cond.notify_all()
+        mgr = self.snapshot_managers.get(channel_id)
+        if mgr is not None:
+            mgr.on_block_committed()
         for fn in self._commit_listeners:
             fn(channel_id, block)
 

@@ -13,6 +13,13 @@ Device design (NOT a port of amcl's pairing):
   host `_line`).  The device never touches G2/Fp12 point arithmetic —
   each Miller step is one Fp12 squaring, a 12-row scalar multiply (the
   line evaluated at P), and an Fp12 multiply, batched over signatures.
+- The ISSUER key's line schedule enters the program as RUNTIME INPUTS
+  (a few hundred KB of (steps, 12, NLIMBS) arrays), so ONE compiled
+  program serves every issuer key per lane bucket — a fresh issuer
+  costs a ~1s host schedule build, not a ~230s TPU recompile.  Only
+  the generator-G2 schedule and the add-step bit mask (properties of
+  the curve, not the key) stay baked as constants.  Lane buckets are
+  capped (8 or 16); larger batches chunk over the cached program.
 - Both pairings run in ONE lax.scan (they share the |6u+2| bit
   schedule); add-steps are selected per step by a static mask.
 - The final exponentiation mirrors the host oracle op-for-op
@@ -156,7 +163,7 @@ def _line_eval(a_mat, b_mat, px12: f12.Rows, py_rows: f12.Rows, like):
 
 
 def _miller2(
-    sched_w: LineSchedule,
+    w_arrs,
     sched_g: LineSchedule,
     p1x: f12.Rows,
     p1y: f12.Rows,
@@ -165,22 +172,29 @@ def _miller2(
     like,
 ):
     """Both Miller loops in one scan (shared bit schedule); returns the
-    host-bit-exact Miller values for (W,P1) and (g2,P2)."""
+    host-bit-exact Miller values for (W,P1) and (g2,P2).
+
+    `w_arrs` is the issuer schedule as TRACED arrays (dbl_a, dbl_b,
+    add_a, add_b, corr_a, corr_b) so one program serves every issuer;
+    the generator schedule and the add-step mask are compile-time
+    constants (the mask is a property of |6u+2|'s bits, identical for
+    every schedule)."""
+    w_dbl_a, w_dbl_b, w_add_a, w_add_b, w_corr_a, w_corr_b = w_arrs
     p1x12, p2x12 = _bcast12(p1x), _bcast12(p2x)
     z11 = f12.rzero(11, like)
     p1y_rows = f12.rcat(tuple(l[None] for l in p1y), z11)
     p2y_rows = f12.rcat(tuple(l[None] for l in p2y), z11)
 
     xs = (
-        jnp.asarray(sched_w.dbl_a),
-        jnp.asarray(sched_w.dbl_b),
-        jnp.asarray(sched_w.add_a),
-        jnp.asarray(sched_w.add_b),
+        w_dbl_a,
+        w_dbl_b,
+        w_add_a,
+        w_add_b,
         jnp.asarray(sched_g.dbl_a),
         jnp.asarray(sched_g.dbl_b),
         jnp.asarray(sched_g.add_a),
         jnp.asarray(sched_g.add_b),
-        jnp.asarray(sched_w.has_add),
+        jnp.asarray(sched_g.has_add),
     )
 
     def body(carry, step):
@@ -216,10 +230,10 @@ def _miller2(
     )
     f1 = f12.fp12_conj(f12.unpack(f1_st))
     f2 = f12.fp12_conj(f12.unpack(f2_st))
-    for (wa, wb), (ga, gb) in zip(sched_w.corr, sched_g.corr):
+    for step, (ga, gb) in enumerate(sched_g.corr):
         f1 = f12.fp12_mul(
             f1,
-            _line_eval(jnp.asarray(wa), jnp.asarray(wb), p1x12, p1y_rows, like),
+            _line_eval(w_corr_a[step], w_corr_b[step], p1x12, p1y_rows, like),
         )
         f2 = f12.fp12_mul(
             f2,
@@ -235,7 +249,7 @@ def _final_exp(f: f12.Rows) -> f12.Rows:
     return f12.fp12_pow_const(easy, host._HARD_EXP)
 
 
-def _unity_check(sched_w, sched_g, p1x, p1y, p2x, p2y, ok):
+def _unity_check(w_arrs, sched_g, p1x, p1y, p2x, p2y, ok):
     """The jitted core: (NLIMBS, B) stacked coords -> per-lane unity
     mask of Fexp(m1 · inv(m2))."""
     like = p1x[0]
@@ -244,7 +258,7 @@ def _unity_check(sched_w, sched_g, p1x, p1y, p2x, p2y, ok):
         return tuple(st[i] for i in range(bn.NLIMBS))
 
     f1, f2 = _miller2(
-        sched_w, sched_g, tup(p1x), tup(p1y), tup(p2x), tup(p2y), like
+        w_arrs, sched_g, tup(p1x), tup(p1y), tup(p2x), tup(p2y), like
     )
     m = f12.fp12_mul(f1, f12.fp12_inv(f2))
     out = _final_exp(m)
@@ -255,21 +269,48 @@ def _unity_check(sched_w, sched_g, p1x, p1y, p2x, p2y, ok):
     return f12.fp12_equal(out, one) & ok
 
 
+# lane buckets: 8 for small batches, 16 beyond; bigger batches CHUNK over
+# the cached 16-lane program instead of compiling ever-larger programs
+# (each fresh bucket shape is a multi-minute TPU compile)
+_BUCKET_SMALL = 8
+_BUCKET_MAX = 16
+
+
+@lru_cache(maxsize=1)
+def _shared_fn():
+    """THE pairing program (per lane-bucket shape, cached by jax): issuer
+    schedule arrays are runtime inputs, so every issuer key shares it."""
+    sched_g = _g2_schedule()
+
+    def run(w_arrs, p1x, p1y, p2x, p2y, ok):
+        return _unity_check(w_arrs, sched_g, p1x, p1y, p2x, p2y, ok)
+
+    return jax.jit(run)
+
+
 class Ate2Kernel:
     """Batched device evaluator of the Idemix pairing structure check
-    for one issuer key W."""
+    for one issuer key W.  Construction costs one host schedule build
+    (~1s of host Fp12 arithmetic); the compiled program is shared across
+    ALL issuer keys per lane bucket."""
 
     def __init__(self, w: host.G2Point):
         self.sched_w = LineSchedule(w)
         self.sched_g = _g2_schedule()
-        sched_w, sched_g = self.sched_w, self.sched_g
-
-        def run(p1x, p1y, p2x, p2y, ok):
-            return _unity_check(sched_w, sched_g, p1x, p1y, p2x, p2y, ok)
-
-        # one jitted callable; jax caches a compiled executable per
-        # input bucket shape automatically
-        self._fn = jax.jit(run)
+        sw = self.sched_w
+        # device-resident schedule inputs, shipped once per kernel
+        self._w_arrs = tuple(
+            jax.device_put(np.asarray(a))
+            for a in (
+                sw.dbl_a,
+                sw.dbl_b,
+                sw.add_a,
+                sw.add_b,
+                np.stack([c[0] for c in sw.corr]),
+                np.stack([c[1] for c in sw.corr]),
+            )
+        )
+        self._fn = _shared_fn()
 
     def check(
         self,
@@ -280,9 +321,14 @@ class Ate2Kernel:
         n = len(pairs)
         if n == 0:
             return []
-        bucket = 8
-        while bucket < n:
-            bucket <<= 1
+        out: List[bool] = []
+        for start in range(0, n, _BUCKET_MAX):
+            out.extend(self._check_chunk(pairs[start : start + _BUCKET_MAX]))
+        return out
+
+    def _check_chunk(self, pairs) -> List[bool]:
+        n = len(pairs)
+        bucket = _BUCKET_SMALL if n <= _BUCKET_SMALL else _BUCKET_MAX
         cols = {"p1x": [], "p1y": [], "p2x": [], "p2y": [], "ok": []}
         gx, gy = host.G1_GEN
         for i in range(bucket):
@@ -304,6 +350,7 @@ class Ate2Kernel:
 
         with bn.force_looped_cios():
             mask = self._fn(
+                self._w_arrs,
                 jnp.asarray(mont_cols(cols["p1x"])),
                 jnp.asarray(mont_cols(cols["p1y"])),
                 jnp.asarray(mont_cols(cols["p2x"])),
@@ -345,7 +392,7 @@ def miller2_host_values(
             return tuple(
                 f12.pack(f)
                 for f in _miller2(
-                    k.sched_w, k.sched_g,
+                    k._w_arrs, k.sched_g,
                     col(p1[0]), col(p1[1]), col(p2[0]), col(p2[1]),
                     like,
                 )

@@ -95,6 +95,43 @@ def compile_batched(
     return run
 
 
+def compile_batched_numpy(
+    env: SignaturePolicyEnvelope,
+) -> Callable[[np.ndarray], np.ndarray]:
+    """The batched greedy walk in vectorized NumPy: sat (B, S, P) bool ->
+    (B,) bool, bit-identical to `compile_batched` / `evaluate_host`.
+
+    This is the validator's default epilogue: policy circuits are a few
+    dozen mask updates over small bool tensors — microseconds on host,
+    whereas eager jnp dispatch pays a device (tunnel) roundtrip per op.
+    The jax form remains for fused multi-channel device steps where the
+    satisfaction tensor already lives on the device."""
+
+    def walk(rule, sat, used):
+        if isinstance(rule, SignedBy):
+            elig = sat[:, :, rule.index] & ~used  # (B, S)
+            ok = elig.any(axis=1)
+            first = elig.argmax(axis=1)  # first True (argmax on bool)
+            claim = np.zeros_like(used)
+            claim[np.arange(used.shape[0]), first] = ok
+            return ok, used | claim
+        assert isinstance(rule, NOutOf)
+        verified = np.zeros(used.shape[0], dtype=np.int32)
+        for child in rule.rules:
+            ok, used_child = walk(child, sat, used)
+            verified = verified + ok.astype(np.int32)
+            used = np.where(ok[:, None], used_child, used)
+        return verified >= rule.n, used
+
+    def run(sat: np.ndarray) -> np.ndarray:
+        sat = np.asarray(sat, dtype=bool)
+        used0 = np.zeros(sat.shape[:2], dtype=bool)
+        ok, _ = walk(env.rule, sat, used0)
+        return ok
+
+    return run
+
+
 def build_satisfaction_tensor(
     env: SignaturePolicyEnvelope,
     signer_principals: Sequence[Sequence[bool]],

@@ -21,7 +21,6 @@ metadata, bit-exact with the reference for every supported scenario.
 
 from __future__ import annotations
 
-import hashlib
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -30,7 +29,7 @@ import numpy as np
 from fabric_tpu.crypto.bccsp import Provider
 from fabric_tpu.msp.identity import Identity, MSPError, MSPManager
 from fabric_tpu.policy.ast import SignaturePolicyEnvelope
-from fabric_tpu.policy.evaluator import compile_batched, evaluate_host
+from fabric_tpu.policy.evaluator import compile_batched_numpy, evaluate_host
 from fabric_tpu.protos import common_pb2, msp_principal_pb2, protoutil
 from fabric_tpu.validation.msgvalidation import ParsedTx, SigJob, parse_transaction
 from fabric_tpu.validation.statebased import (
@@ -149,7 +148,10 @@ class BlockValidator:
         self._principal_cache: Dict[Tuple[bytes, bytes], bool] = {}
         # keyed by the (hashable, frozen) envelope itself — id() would
         # alias freed envelopes after a policy upgrade
-        self._policy_fn_cache: Dict[Tuple[SignaturePolicyEnvelope, int], Callable] = {}
+        self._policy_fn_cache: Dict[SignaturePolicyEnvelope, Callable] = {}
+        self._principals_cache: Dict[
+            SignaturePolicyEnvelope, List[msp_principal_pb2.MSPPrincipal]
+        ] = {}
 
     # ------------------------------------------------------------------
     def validate(
@@ -341,8 +343,7 @@ class BlockValidator:
 
     # ------------------------------------------------------------------
     def _satisfies(self, ident: Identity, principal: msp_principal_pb2.MSPPrincipal) -> bool:
-        fp = hashlib.sha256(ident.serialize()).digest()
-        key = (fp, principal.SerializeToString())
+        key = (ident.fingerprint(), principal.SerializeToString())
         hit = self._principal_cache.get(key)
         if hit is None:
             try:
@@ -467,14 +468,14 @@ class BlockValidator:
     ) -> np.ndarray:
         """(valid deduped signers x principals) satisfaction matrix for
         one tx (SignatureSetToValidIdentities + principal matching)."""
-        principals = [principal_for(p) for p in env.identities]
+        principals = self._principals_for(env)
         rows = []
         seen_ids = set()
         for job in tx.endorsement_jobs:
             ident = self._job_identity.get(id(job))
             if ident is None:
                 continue
-            fp = (ident.msp_id, hashlib.sha256(ident.serialize()).digest())
+            fp = (ident.msp_id, ident.fingerprint())
             if fp in seen_ids:
                 continue
             seen_ids.add(fp)
@@ -511,7 +512,7 @@ class BlockValidator:
             )
             for j, sat in enumerate(per_tx_sat):
                 batch[j, : sat.shape[0]] = sat
-            fn = self._policy_fn(env, max_signers)
+            fn = self._policy_fn(env)
             ok = np.asarray(fn(batch))
             for j, i in enumerate(tx_indices):
                 if not ok[j]:
@@ -520,10 +521,21 @@ class BlockValidator:
     def _sig_ok(self, job: SigJob) -> bool:
         return self._sig_results.get(id(job), False)
 
-    def _policy_fn(self, env: SignaturePolicyEnvelope, num_signers: int):
-        key = (env, num_signers)
-        fn = self._policy_fn_cache.get(key)
+    def _policy_fn(self, env: SignaturePolicyEnvelope):
+        fn = self._policy_fn_cache.get(env)
         if fn is None:
-            fn = compile_batched(env, num_signers)
-            self._policy_fn_cache[key] = fn
+            # host NumPy epilogue: the circuit is tiny and the signature
+            # work already ran on the device — eager jnp here would pay a
+            # device roundtrip per mask update (policy/evaluator.py)
+            fn = compile_batched_numpy(env)
+            self._policy_fn_cache[env] = fn
         return fn
+
+    def _principals_for(
+        self, env: SignaturePolicyEnvelope
+    ) -> List[msp_principal_pb2.MSPPrincipal]:
+        ps = self._principals_cache.get(env)
+        if ps is None:
+            ps = [principal_for(p) for p in env.identities]
+            self._principals_cache[env] = ps
+        return ps

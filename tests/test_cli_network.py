@@ -466,3 +466,76 @@ def test_cli_channel_fetch_selectors(network, tmp_path):
     assert by_number.header.number == 0
     peer_newest = fetch("newest", "pn.block", peer)
     assert peer_newest.header.number >= 0
+
+
+def test_cli_snapshot_lifecycle(network):
+    """peer snapshot submitrequest/listpending/cancelrequest against the
+    live peer's /protos.Snapshot service (snapshot_service.go:25-87),
+    then an invoke commits the requested height and the snapshot
+    directory appears under the peer's workdir."""
+    # snapshot admin ops require the channel Admins policy; sign as the
+    # org admin, not User0
+    admin_msp = network["user_msp"].replace(
+        "User0@org1.example.com", "Admin@org1.example.com"
+    )
+    common = [
+        "-C",
+        "mychannel",
+        "--peerAddress",
+        network["peer_addr"],
+        "--mspDir",
+        admin_msp,
+        "--mspID",
+        "Org1MSP",
+    ]
+
+    # a far-future request: submitted, listed, cancelled
+    run_cli(
+        "fabric_tpu.cli.peer", "snapshot", "submitrequest", "-b", "999", *common
+    )
+    out = run_cli("fabric_tpu.cli.peer", "snapshot", "listpending", *common)
+    assert "[999]" in out
+    run_cli(
+        "fabric_tpu.cli.peer", "snapshot", "cancelrequest", "-b", "999", *common
+    )
+    out = run_cli("fabric_tpu.cli.peer", "snapshot", "listpending", *common)
+    assert "[]" in out
+
+    # height-0 request = next committed block; the invoke commits it
+    run_cli(
+        "fabric_tpu.cli.peer", "snapshot", "submitrequest", "-b", "0", *common
+    )
+    run_cli(
+        "fabric_tpu.cli.peer",
+        "chaincode",
+        "invoke",
+        "--peerAddresses",
+        network["peer_addr"],
+        "-o",
+        network["orderer_addr"],
+        "-C",
+        "mychannel",
+        "-n",
+        "kvcc",
+        "-c",
+        json.dumps({"Args": ["put", "snap-key", "snap-value"]}),
+        "--mspDir",
+        network["user_msp"],
+        "--mspID",
+        "Org1MSP",
+    )
+    snap_root = network["tmp"] / "peer0-data" / "snapshots" / "mychannel"
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        pending = run_cli(
+            "fabric_tpu.cli.peer", "snapshot", "listpending", *common
+        )
+        if "[]" in pending and snap_root.exists() and any(snap_root.iterdir()):
+            break
+        time.sleep(0.3)
+    assert snap_root.exists() and any(snap_root.iterdir())
+    from fabric_tpu.ledger.snapshot import verify_snapshot
+
+    snap_dir = sorted(snap_root.iterdir())[0]
+    meta = verify_snapshot(str(snap_dir))
+    assert meta["channel_name"] == "mychannel"

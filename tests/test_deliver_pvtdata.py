@@ -1,0 +1,109 @@
+"""DeliverWithPrivateData (reference core/peer/deliverevents.go:270):
+block responses carry the peer's stored cleartext private rwsets keyed by
+tx index; blocks without stored private data have empty maps."""
+
+from fabric_tpu.deliver.server import (
+    BlockSource,
+    DeliverHandler,
+    deliver_with_pvtdata,
+    pvt_data_map,
+)
+from fabric_tpu.ledger.pvtdatastore import PvtEntry
+from fabric_tpu.protos import ab_pb2, common_pb2, protoutil
+
+
+def _seek_env(channel: str, start: int, stop: int) -> common_pb2.Envelope:
+    seek = ab_pb2.SeekInfo()
+    seek.start.specified.number = start
+    seek.stop.specified.number = stop
+    payload = common_pb2.Payload()
+    chdr = protoutil.make_channel_header(common_pb2.DELIVER_SEEK_INFO, channel)
+    payload.header.channel_header = chdr.SerializeToString()
+    payload.data = seek.SerializeToString()
+    env = common_pb2.Envelope()
+    env.payload = payload.SerializeToString()
+    return env
+
+
+def _blocks(n):
+    out = []
+    prev = b""
+    for i in range(n):
+        b = protoutil.new_block(i, prev)
+        b.data.data.append(b"tx-bytes-%d" % i)
+        protoutil.seal_block(b)
+        prev = protoutil.block_header_hash(b.header)
+        out.append(b)
+    return out
+
+
+def test_pvt_data_map_groups_by_tx_and_namespace():
+    entries = [
+        PvtEntry(0, "cc", "collB", b"rw-b"),
+        PvtEntry(0, "cc", "collA", b"rw-a"),
+        PvtEntry(2, "other", "c", b"rw-c"),
+    ]
+    m = pvt_data_map(entries)
+    assert set(m) == {0, 2}
+    tx0 = m[0]
+    assert len(tx0.ns_pvt_rwset) == 1
+    assert tx0.ns_pvt_rwset[0].namespace == "cc"
+    colls = [c.collection_name for c in tx0.ns_pvt_rwset[0].collection_pvt_rwset]
+    assert colls == ["collA", "collB"]  # deterministic order
+    assert m[2].ns_pvt_rwset[0].namespace == "other"
+
+
+def test_deliver_with_pvtdata_attaches_maps():
+    blocks = _blocks(3)
+    handler = DeliverHandler(
+        lambda cid: BlockSource(
+            lambda n: blocks[n] if n < len(blocks) else None,
+            lambda: len(blocks),
+        )
+        if cid == "ch"
+        else None
+    )
+    stored = {
+        1: [PvtEntry(0, "cc", "secret", b"pvt-rwset-bytes")],
+    }
+
+    def pvt_entries(channel_id, block_num):
+        assert channel_id == "ch"
+        return stored.get(block_num, [])
+
+    resps = list(
+        deliver_with_pvtdata(handler, _seek_env("ch", 0, 2), pvt_entries)
+    )
+    # 3 blocks + SUCCESS status
+    assert len(resps) == 4
+    assert resps[3].status == common_pb2.SUCCESS
+    kinds = [r.WhichOneof("Type") for r in resps[:3]]
+    assert kinds == ["block_and_private_data"] * 3
+    b1 = resps[1].block_and_private_data
+    assert b1.block.header.number == 1
+    assert list(b1.private_data_map) == [0]
+    coll = b1.private_data_map[0].ns_pvt_rwset[0].collection_pvt_rwset[0]
+    assert coll.collection_name == "secret"
+    assert coll.rwset == b"pvt-rwset-bytes"
+    # blocks without stored pvtdata: empty map, like the reference
+    assert not resps[0].block_and_private_data.private_data_map
+    assert not resps[2].block_and_private_data.private_data_map
+
+
+def test_policy_checker_gates_the_stream():
+    """With a policy checker configured, unsigned requests and rejected
+    identities get FORBIDDEN and zero blocks (the stream exposes private
+    cleartext, unlike plain Deliver)."""
+    blocks = _blocks(1)
+    handler = DeliverHandler(
+        lambda cid: BlockSource(lambda n: blocks[n], lambda: 1)
+    )
+
+    def deny(channel_id, sd):
+        raise PermissionError("not a reader")
+
+    resps = list(
+        deliver_with_pvtdata(handler, _seek_env("ch", 0, 0), lambda c, n: [], deny)
+    )
+    assert [r.WhichOneof("Type") for r in resps] == ["status"]
+    assert resps[0].status == common_pb2.FORBIDDEN

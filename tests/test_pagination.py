@@ -1,0 +1,112 @@
+"""Bookmark pagination for rich queries and range scans (reference
+statecouchdb.go:567 GetStateRangeScanIteratorWithPagination, :653
+ExecuteQueryWithPagination; chaincode QueryMetadata/QueryResponseMetadata
+contract)."""
+
+import json
+
+import pytest
+
+from fabric_tpu.ledger import queries
+from fabric_tpu.ledger.rwset import Version
+from fabric_tpu.ledger.simulator import SimulationError, TxSimulator
+from fabric_tpu.ledger.statedb import UpdateBatch, VersionedDB
+
+
+def _db(n=10):
+    db = VersionedDB()
+    batch = UpdateBatch()
+    for i in range(n):
+        batch.put(
+            "cc", f"k{i:02d}", json.dumps({"v": i}).encode(), Version(1, i)
+        )
+    db.apply_updates(batch)
+    return db
+
+
+QUERY = {"selector": {"v": {"$gte": 2}}}
+
+
+class TestQueryEngine:
+    def test_pages_and_final_short_page(self):
+        db = _db()
+        p1, bm1 = db.execute_query_paginated("cc", QUERY, 3)
+        p2, bm2 = db.execute_query_paginated("cc", QUERY, 3, bm1)
+        p3, bm3 = db.execute_query_paginated("cc", QUERY, 3, bm2)
+        assert [k for k, _ in p1] == ["k02", "k03", "k04"]
+        assert [k for k, _ in p2] == ["k05", "k06", "k07"]
+        assert [k for k, _ in p3] == ["k08", "k09"]  # short page: caller stops
+        tail, _ = db.execute_query_paginated("cc", QUERY, 3, bm3)
+        assert tail == []
+
+    def test_pagination_is_stable_across_calls(self):
+        # same query + same bookmark -> same page (CouchDB bookmark
+        # semantics over a stable snapshot)
+        db = _db()
+        _, bm = db.execute_query_paginated("cc", QUERY, 2)
+        again, _ = db.execute_query_paginated("cc", QUERY, 2, bm)
+        repeat, _ = db.execute_query_paginated("cc", QUERY, 2, bm)
+        assert again == repeat
+
+    def test_limit_plus_pagination_rejected(self):
+        with pytest.raises(queries.QueryError):
+            queries.execute_paginated(
+                [], {"selector": {}, "limit": 5}, 2
+            )
+
+    def test_bad_bookmark_rejected(self):
+        with pytest.raises(queries.QueryError):
+            queries.execute_paginated([], {"selector": {}}, 2, "not-a-bookmark")
+
+    def test_bad_page_size_rejected(self):
+        with pytest.raises(queries.QueryError):
+            queries.execute_paginated([], {"selector": {}}, 0)
+
+
+class TestSimulator:
+    def test_range_pagination_bookmark_is_next_key(self):
+        sim = TxSimulator(_db(), "tx1")
+        rows, bm = sim.get_state_range_with_pagination("cc", "k00", "k08", 3)
+        assert [k for k, _ in rows] == ["k00", "k01", "k02"]
+        assert bm == "k03"
+        rows2, bm2 = sim.get_state_range_with_pagination(
+            "cc", "k00", "k08", 3, bm
+        )
+        assert [k for k, _ in rows2] == ["k03", "k04", "k05"]
+        rows3, bm3 = sim.get_state_range_with_pagination(
+            "cc", "k00", "k08", 3, bm2
+        )
+        assert [k for k, _ in rows3] == ["k06", "k07"]
+        assert bm3 == ""  # exhausted
+
+    def test_paginated_reads_are_mvcc_recorded(self):
+        sim = TxSimulator(_db(), "tx1")
+        sim.get_state_range_with_pagination("cc", "k00", "k03", 2)
+        rwset = sim.get_tx_simulation_results().rwset
+        ns = {n.namespace: n for n in rwset.ns_rw_sets}["cc"]
+        read_keys = {r.key for r in ns.reads}
+        assert read_keys == {"k00", "k01"}
+        # but NO phantom-protecting range record (reference paginated
+        # contract)
+        assert not ns.range_queries
+
+    def test_writes_after_paginated_query_rejected(self):
+        sim = TxSimulator(_db(), "tx1")
+        sim.execute_query_with_pagination("cc", QUERY, 2)
+        with pytest.raises(SimulationError):
+            sim.set_state("cc", "k00", b"nope")
+
+    def test_sqlite_backend_paginates_too(self, tmp_path):
+        from fabric_tpu.ledger.persistent import SqliteVersionedDB
+
+        db = SqliteVersionedDB(str(tmp_path / "state.sqlite"))
+        batch = UpdateBatch()
+        for i in range(6):
+            batch.put(
+                "cc", f"k{i}", json.dumps({"v": i}).encode(), Version(1, i)
+            )
+        db.apply_updates(batch)
+        p1, bm = db.execute_query_paginated("cc", QUERY, 3)
+        p2, _ = db.execute_query_paginated("cc", QUERY, 3, bm)
+        assert [k for k, _ in p1] == ["k2", "k3", "k4"]
+        assert [k for k, _ in p2] == ["k5"]

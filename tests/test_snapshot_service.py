@@ -1,0 +1,176 @@
+"""Snapshot request manager + gRPC service (reference
+core/ledger/kvledger/snapshot_mgr.go and
+core/ledger/snapshotgrpc/snapshot_service.go:25-87)."""
+
+import os
+
+import pytest
+
+from fabric_tpu.comm.server import GRPCServer, channel_to
+from fabric_tpu.comm.services import register_snapshot_service
+from fabric_tpu.crypto.bccsp import SoftwareProvider
+from fabric_tpu.ledger import rwset as rw
+from fabric_tpu.ledger.rwset_proto import serialize_tx_rwset
+from fabric_tpu.ledger.snapshot import (
+    SnapshotRequestManager,
+    verify_snapshot,
+)
+from fabric_tpu.msp.cryptogen import generate_org
+from fabric_tpu.msp.identity import MSPManager
+from fabric_tpu.msp.signer import SigningIdentity
+from fabric_tpu.peer import Channel
+from fabric_tpu.policy import from_dsl
+from fabric_tpu.protos import common_pb2, peer_pb2, protoutil
+from fabric_tpu.validation.validator import (
+    ChaincodeDefinition,
+    ChaincodeRegistry,
+)
+
+PROVIDER = SoftwareProvider()
+CHANNEL = "snapsvc"
+
+
+@pytest.fixture()
+def world(tmp_path):
+    from fabric_tpu.endorser import (
+        create_proposal,
+        create_signed_tx,
+        endorse_proposal,
+    )
+
+    org = generate_org("org1.snapsvc", "Org1MSP")
+    mgr = MSPManager([org.msp(provider=PROVIDER)])
+    registry = ChaincodeRegistry(
+        [ChaincodeDefinition("mycc", from_dsl("OR('Org1MSP.member')"))]
+    )
+    channel = Channel(CHANNEL, str(tmp_path / "ledger"), mgr, registry, PROVIDER)
+    client = SigningIdentity(org.users[0], PROVIDER)
+    endorser = SigningIdentity(org.peers[0], PROVIDER)
+
+    prev = b"\x11" * 32
+
+    def commit(i):
+        nonlocal prev
+        results = serialize_tx_rwset(
+            rw.TxRwSet(
+                (
+                    rw.NsRwSet(
+                        "mycc", (), (rw.KVWrite(f"k{i}", False, b"v"),)
+                    ),
+                )
+            )
+        )
+        bundle = create_proposal(client, CHANNEL, "mycc", [b"put", b"%d" % i])
+        resp = endorse_proposal(bundle, endorser, results)
+        env = create_signed_tx(bundle, client, [resp])
+        block = protoutil.new_block(channel.ledger.height, prev)
+        block.data.data.append(env.SerializeToString())
+        protoutil.seal_block(block)
+        prev = protoutil.block_header_hash(block.header)
+        channel.store_block(block)
+
+    return {"channel": channel, "commit": commit, "tmp": tmp_path, "org": org}
+
+
+def test_manager_lifecycle_and_generation(world):
+    ch = world["channel"]
+    world["commit"](0)  # height 1
+    mgr = SnapshotRequestManager(ch.ledger, str(world["tmp"] / "snaps"))
+
+    # height 0 = next committed block (current height)
+    h = mgr.submit(0)
+    assert h == ch.ledger.height
+    with pytest.raises(ValueError):
+        mgr.submit(h)  # duplicate
+    mgr.submit(h + 2)
+    assert mgr.pending() == [h, h + 2]
+    mgr.cancel(h + 2)
+    assert mgr.pending() == [h]
+    with pytest.raises(ValueError):
+        mgr.cancel(99)
+
+    world["commit"](1)  # commits block number h
+    mgr.on_block_committed(wait=True)
+    assert mgr.pending() == []
+    out_dir = mgr.generated[h]
+    meta = verify_snapshot(out_dir)
+    assert meta["channel_name"] == CHANNEL
+    assert meta["last_block_number"] == h
+    assert os.path.basename(out_dir) == str(h)
+    with pytest.raises(ValueError):
+        mgr.submit(h)  # below the current height now
+
+
+def test_grpc_service_roundtrip(world):
+    ch = world["channel"]
+    world["commit"](0)
+    mgr = SnapshotRequestManager(ch.ledger, str(world["tmp"] / "snaps"))
+    server = GRPCServer("127.0.0.1:0")
+    register_snapshot_service(server, lambda cid: mgr if cid == CHANNEL else None)
+    addr = server.start()
+
+    signer = SigningIdentity(world["org"].users[0], PROVIDER)
+
+    def signed_req(msg):
+        raw = msg.SerializeToString()
+        return peer_pb2.SignedSnapshotRequest(
+            request=raw, signature=signer.sign(raw)
+        )
+
+    def shdr():
+        h = common_pb2.SignatureHeader()
+        h.creator = signer.serialize()
+        return h.SerializeToString()
+
+    conn = channel_to(addr)
+    try:
+        from google.protobuf import empty_pb2
+
+        gen = conn.unary_unary(
+            "/protos.Snapshot/Generate",
+            request_serializer=peer_pb2.SignedSnapshotRequest.SerializeToString,
+            response_deserializer=empty_pb2.Empty.FromString,
+        )
+        pend = conn.unary_unary(
+            "/protos.Snapshot/QueryPendings",
+            request_serializer=peer_pb2.SignedSnapshotRequest.SerializeToString,
+            response_deserializer=peer_pb2.QueryPendingSnapshotsResponse.FromString,
+        )
+        cancel = conn.unary_unary(
+            "/protos.Snapshot/Cancel",
+            request_serializer=peer_pb2.SignedSnapshotRequest.SerializeToString,
+            response_deserializer=empty_pb2.Empty.FromString,
+        )
+        gen(
+            signed_req(
+                peer_pb2.SnapshotRequest(
+                    signature_header=shdr(), channel_id=CHANNEL, block_number=5
+                )
+            )
+        )
+        out = pend(
+            signed_req(
+                peer_pb2.SnapshotQuery(
+                    signature_header=shdr(), channel_id=CHANNEL
+                )
+            )
+        )
+        assert list(out.block_numbers) == [5]
+        cancel(
+            signed_req(
+                peer_pb2.SnapshotRequest(
+                    signature_header=shdr(), channel_id=CHANNEL, block_number=5
+                )
+            )
+        )
+        out = pend(
+            signed_req(
+                peer_pb2.SnapshotQuery(
+                    signature_header=shdr(), channel_id=CHANNEL
+                )
+            )
+        )
+        assert list(out.block_numbers) == []
+    finally:
+        conn.close()
+        server.stop()
