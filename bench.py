@@ -18,6 +18,9 @@ The `detail.configs` object carries the measured numbers for configs
                step, aggregate tx/s (config #5; sharding across chips is
                validated on the virtual CPU mesh by dryrun_multichip —
                the bench machine has one chip).
+  batcher_4ch_small — P7 coalescing: concurrent SMALL blocks across
+               channels, direct per-channel launches vs the shared
+               VerifyBatcher (launches + lanes/launch reported).
 
 Output discipline: a COMPLETE JSON line is printed and flushed as soon as
 the headline (config #1) finishes, then re-emitted after every config
@@ -363,9 +366,13 @@ def bench_mvcc(n_txs=5000):
         "host_ms_per_block": round(host_ms, 1),
         "device_ms_per_block": round(dev_ms, 1),
         "speedup": round(host_ms / dev_ms, 2),
-        "note": "device fixpoint is transfer/latency-bound at this "
-        "scale over the TPU tunnel; codes are bit-identical and the "
-        "host scan remains the default (ledger.deviceMVCC opts in)",
+        "note": "codes bit-identical; host scan stays the default "
+        "(ledger.deviceMVCC opts in). Measured r3: no crossover exists "
+        "on this topology (5k: 71 vs 164ms; 20k: 305 vs 527ms) — the "
+        "Python encode pass costs what the host scan costs, so the "
+        "remote-chip dispatch latency can never amortize; the win "
+        "condition is device-resident rwsets on an attached chip (see "
+        "ledger/mvcc_device.py docstring)",
     }
 
 
@@ -421,6 +428,75 @@ def _ec_backend_name():
     return ec_backend().__name__
 
 
+def bench_batcher(net, n_channels=4, txs_per_channel=128):
+    """P7 coalescing: four channels deliver SMALL blocks concurrently.
+    Direct mode launches one small device program per channel; the shared
+    VerifyBatcher coalesces them into few large launches (reference
+    analog: broadcast.go:163 backpressure discipline + the validator
+    semaphore's batching effect)."""
+    import threading
+
+    from fabric_tpu.crypto.tpu_provider import TPUProvider
+    from fabric_tpu.parallel.batcher import BatchingProvider
+    from fabric_tpu.protos import common_pb2
+
+    channels = [f"small{i}" for i in range(n_channels)]
+    blocks = {ch: net.make_block(ch, txs_per_channel) for ch in channels}
+
+    def run(provider):
+        validators = {ch: net.validator(ch, provider) for ch in channels}
+        copies = {}
+        for ch, b in blocks.items():
+            c = common_pb2.Block()
+            c.CopyFrom(b)
+            copies[ch] = c
+        errs = []
+
+        def work(ch):
+            try:
+                flags = validators[ch].validate(copies[ch])
+                if set(flags.tobytes()) != {0}:
+                    errs.append(f"{ch}: invalid txs")
+            except Exception as e:  # noqa: BLE001
+                errs.append(f"{ch}: {e}")
+
+        threads = [
+            threading.Thread(target=work, args=(ch,)) for ch in channels
+        ]
+        start = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errs:
+            raise RuntimeError("; ".join(errs))
+        return (time.perf_counter() - start) * 1000.0
+
+    tpu = TPUProvider()
+    run(tpu)  # compile warmup (per-channel bucket)
+    direct_ms = run(tpu)
+    shared = BatchingProvider(tpu)
+    try:
+        run(shared)  # compile warmup (coalesced bucket)
+        launches0, lanes0 = shared.batcher.launches, shared.batcher.lanes
+        batched_ms = run(shared)
+        launches = shared.batcher.launches - launches0
+        lanes = shared.batcher.lanes - lanes0
+    finally:
+        shared.stop()
+    total = n_channels * txs_per_channel
+    return {
+        "channels": n_channels,
+        "txs_per_channel": txs_per_channel,
+        "direct_ms": round(direct_ms, 1),
+        "batched_ms": round(batched_ms, 1),
+        "launches": launches,
+        "lanes_per_launch": round(lanes / max(launches, 1), 1),
+        "batched_tx_per_s": round(total / (batched_ms / 1000.0), 1),
+        "speedup": round(direct_ms / batched_ms, 2),
+    }
+
+
 def main():
     n = int(os.environ.get("BENCH_N", "16384"))
     iters = int(os.environ.get("BENCH_ITERS", "5"))
@@ -465,6 +541,7 @@ def main():
             ("idemix", bench_idemix, False),
             ("mvcc_5k", bench_mvcc, False),
             ("multi_4ch", bench_multichannel, True),
+            ("batcher_4ch_small", bench_batcher, True),
         ):
             if time.monotonic() > deadline:
                 configs[name] = {

@@ -42,6 +42,12 @@ class GossipNode:
         transient_store=None,
         pvt_reader=None,  # (block, tx, ns, coll) -> bytes|None
         pvt_serve_policy=None,  # (ns, coll) -> bool
+        # per-requester pvtdata eligibility (pull.go:614,662): requests
+        # are authenticated against the certstore and each digest checked
+        # against the collection's member-orgs policy for THAT identity
+        pvt_verify_member_sig=None,  # (identity, data, sig) -> bool
+        pvt_requester_eligible=None,  # (ns, coll, identity) -> bool
+        pvt_sign_request=None,  # (data) -> sig, for our reconcile pulls
     ):
         from fabric_tpu.gossip.pull import CertStore, PullMediator
         from fabric_tpu.gossip.pvtdata import PvtDataHandler
@@ -63,6 +69,11 @@ class GossipNode:
                 transient_store,
                 pvt_reader or (lambda *a: None),
                 serve_policy=pvt_serve_policy,
+                resolve_identity=self.certstore.get,
+                verify_member_sig=pvt_verify_member_sig,
+                requester_eligible=pvt_requester_eligible,
+                self_pki_id=self_id.encode(),
+                sign_request=pvt_sign_request,
             )
             if transient_store is not None
             else None

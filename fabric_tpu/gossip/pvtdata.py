@@ -32,17 +32,66 @@ class PvtDataHandler:
         transient_store,  # coordinator.TransientStore
         # (block_num, tx_num, ns, coll) -> cleartext rwset bytes or None
         pvt_reader: Callable[[int, int, str, str], Optional[bytes]],
-        # (ns, coll) -> may this collection be served to channel members?
-        # The reference additionally checks the REQUESTER's org against
-        # the collection policy via the TLS-bound peer identity
-        # (pull.go); this transport has no per-stream identity yet, so
-        # the gate is collection-level (Channel.is_eligible).
+        # (ns, coll) -> may this collection be served at all (e.g. this
+        # peer's own eligibility / BTL); collection-level gate.
         serve_policy: Optional[Callable[[str, str], bool]] = None,
+        # pki_id -> serialized identity (certstore lookup); with
+        # requester_eligible set, requests from unknown pki_ids are denied
+        resolve_identity: Optional[Callable[[bytes], Optional[bytes]]] = None,
+        # (identity_bytes, data, signature) -> bool: verify the request
+        # signature under the channel's MSPs
+        verify_member_sig: Optional[Callable[[bytes, bytes, bytes], bool]] = None,
+        # (ns, coll, identity_bytes) -> does the REQUESTER satisfy the
+        # collection's member-orgs policy (pull.go:614,662
+        # filterNotEligible / isEligibleByLatestConfig)?  When set,
+        # private_req must carry an authenticated identity; unsigned or
+        # unknown requesters are served NOTHING.
+        requester_eligible: Optional[Callable[[str, str, bytes], bool]] = None,
+        # signer hooks for OUR outgoing reconcile requests
+        self_pki_id: bytes = b"",
+        sign_request: Optional[Callable[[bytes], bytes]] = None,
     ):
         self.channel_id = channel_id
         self.transient = transient_store
         self._pvt_reader = pvt_reader
         self._serve_policy = serve_policy or (lambda ns, coll: True)
+        self._resolve_identity = resolve_identity
+        self._verify_member_sig = verify_member_sig
+        self._requester_eligible = requester_eligible
+        self._self_pki_id = self_pki_id
+        self._sign_request = sign_request
+        self._seen_nonces: set = set()
+
+    def _authenticated_requester(self, req) -> Optional[bytes]:
+        """Resolve + signature-check the requester; None when the request
+        cannot be tied to a channel identity, or when its nonce was
+        already consumed (replay)."""
+        if (
+            self._resolve_identity is None
+            or self._verify_member_sig is None
+            or not req.pki_id
+            or not req.signature
+            or not req.nonce
+        ):
+            return None
+        identity = self._resolve_identity(bytes(req.pki_id))
+        if identity is None:
+            return None
+        if not self._verify_member_sig(
+            identity,
+            _request_signing_bytes(req, self.channel_id),
+            bytes(req.signature),
+        ):
+            return None
+        # replay gate AFTER signature verification so unauthenticated
+        # garbage cannot consume nonces
+        nonce = bytes(req.nonce)
+        if nonce in self._seen_nonces:
+            return None
+        if len(self._seen_nonces) >= 65536:
+            self._seen_nonces.clear()
+        self._seen_nonces.add(nonce)
+        return identity
 
     # -- message handling (wired into GossipNode._handle) ------------------
     def handle(
@@ -60,10 +109,34 @@ class PvtDataHandler:
             )
             return None
         if kind == "private_req":
+            requester: Optional[bytes] = None
+            if self._requester_eligible is not None:
+                # per-requester eligibility mode: the request must be
+                # signed by a resolvable channel identity, and each digest
+                # is filtered by the collection's member-orgs policy
+                requester = self._authenticated_requester(msg.private_req)
+                if requester is None:
+                    return None
             resp = gossip_pb2.GossipMessage()
             resp.channel = self.channel_id
+            # one eligibility decision per (ns, coll) per request — a
+            # reconcile batch repeats the same collection across digests
+            elig_memo: dict = {}
+
+            def eligible(ns: str, coll: str) -> bool:
+                key = (ns, coll)
+                hit = elig_memo.get(key)
+                if hit is None:
+                    hit = self._requester_eligible(ns, coll, requester)
+                    elig_memo[key] = hit
+                return hit
+
             for digest in msg.private_req.digests:
                 if not self._serve_policy(digest.namespace, digest.collection):
+                    continue
+                if self._requester_eligible is not None and not eligible(
+                    digest.namespace, digest.collection
+                ):
                     continue
                 payload = self._pvt_reader(
                     digest.block_seq,
@@ -118,7 +191,29 @@ class PvtDataHandler:
                 d.seq_in_block = m.tx_num
         if not msg.private_req.digests:
             return None
+        if self._sign_request is not None and self._self_pki_id:
+            import secrets
+
+            msg.private_req.pki_id = self._self_pki_id
+            msg.private_req.nonce = secrets.token_bytes(24)
+            msg.private_req.signature = self._sign_request(
+                _request_signing_bytes(msg.private_req, self.channel_id)
+            )
         return msg
+
+
+def _request_signing_bytes(req, channel_id: str) -> bytes:
+    """Deterministic serialization both sides sign/verify.  Binds the
+    CHANNEL, the requester's pki_id, and a fresh nonce alongside the
+    digest list (signature field excluded) — without those bindings a
+    captured request could be replayed verbatim to any serving peer
+    forever and the eligibility gate would be worthless."""
+    bare = gossip_pb2.RemotePvtDataRequest()
+    for d in req.digests:
+        bare.digests.add().CopyFrom(d)
+    bare.pki_id = req.pki_id
+    bare.nonce = req.nonce
+    return channel_id.encode() + b"\x00" + bare.SerializeToString()
 
 
 def reconcile_response_entries(msg: gossip_pb2.GossipMessage):

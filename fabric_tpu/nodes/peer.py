@@ -43,7 +43,10 @@ class PeerNode:
         provider=None,
         external_builders=None,
         device_mvcc: bool = False,
-        shared_verify_batcher: bool = False,
+        # DEFAULT-ON (SURVEY P7): every channel validator funnels its
+        # device batches through one coalescing launch queue; pass False
+        # to route batch_verify straight at the provider
+        shared_verify_batcher: bool = True,
     ):
         self.work_dir = work_dir
         self.msp_manager = msp_manager
@@ -359,6 +362,74 @@ class PeerNode:
             wait_for,
         )
 
+    def _legacy_writeset_check(self, channel_id, rwset, invoked_ns):
+        """Capability-routed legacy write-set guards (txvalidator v14
+        router analog): V2_0 channels use the lifecycle rules only;
+        V1_2+ channels get the v13 guards incl. collection validation
+        against the committed LSCC record; older channels get v12."""
+        from fabric_tpu.validation.legacy import (
+            check_v12_writeset,
+            check_v13_writeset,
+            collection_key,
+        )
+
+        bundle = self._discovery_bundle(channel_id)
+        app = bundle.application if bundle is not None else None
+        caps = app.capabilities if app is not None else None
+        if caps is None or caps.v20_validation:
+            return None  # _lifecycle governs deploys on V2_0 channels
+        ch = self.channels.get(channel_id)
+
+        def committed_collections(cc: str):
+            if ch is None:
+                return None
+            vv = ch.ledger.state_db.get_state("lscc", collection_key(cc))
+            return vv.value if vv is not None else None
+
+        if caps.v12_validation:
+            return check_v13_writeset(rwset, invoked_ns, committed_collections)
+        return check_v12_writeset(rwset, invoked_ns)
+
+    def _collection_access(self, channel_id: str, ns: str, coll: str):
+        """CollectionAccess for a committed chaincode's collection
+        (reference core/common/privdata/store.go: _lifecycle definitions
+        first, then the legacy LSCC '<cc>~collection' record — legacy
+        channels deployed their collections through LSCC and must keep
+        reconciling).  None when undefined."""
+        from fabric_tpu.ledger.collections import (
+            CollectionStore,
+            NoSuchCollectionError,
+        )
+        from fabric_tpu.lifecycle import NAMESPACE as LIFECYCLE_NS
+        from fabric_tpu.lifecycle.lifecycle import LifecycleResources
+        from fabric_tpu.validation.legacy import collection_key
+
+        ch = self.channels.get(channel_id)
+        if ch is None:
+            return None
+
+        def state_get(state_ns: str, key: str):
+            vv = ch.ledger.state_db.get_state(state_ns, key)
+            return vv.value if vv is not None else None
+
+        def collections_bytes(cc: str) -> bytes:
+            resources = LifecycleResources(
+                public_get=lambda key: state_get(LIFECYCLE_NS, key),
+                public_put=lambda *a: None,
+                org_get=lambda org, key: None,
+                org_put=lambda *a: None,
+                org_names=[],
+            )
+            cd = resources.query_chaincode_definition(cc)
+            if cd is not None and cd.collections:
+                return cd.collections
+            return state_get("lscc", collection_key(cc)) or b""
+
+        try:
+            return CollectionStore(collections_bytes).collection(ns, coll)
+        except NoSuchCollectionError:
+            return None
+
     def _channel_policy_check(self, channel_id: str, path: str, sd) -> None:
         """Evaluate one SignedData against a channel policy path (raises
         on failure; signature verification happens inside the policy
@@ -406,6 +477,9 @@ class PeerNode:
             transient_store=self.transient,
             metrics=self.committer_metrics,
             device_mvcc=self.device_mvcc,
+            writeset_check=lambda rwset, ns, cid=channel_id: (
+                self._legacy_writeset_check(cid, rwset, ns)
+            ),
         )
         if ch.ledger.height == 0:
             ch.ledger.commit(genesis_block)
@@ -499,6 +573,28 @@ class PeerNode:
             except Exception:  # noqa: BLE001 - any failure = reject
                 return False
 
+        def verify_member_sig(identity: bytes, data: bytes, sig: bytes) -> bool:
+            try:
+                ident, msp = self.msp_manager.deserialize_identity(identity)
+                msp.validate(ident)
+                ident.verify(data, sig)
+                return True
+            except Exception:  # noqa: BLE001 - any failure = reject
+                return False
+
+        def requester_eligible(ns: str, coll: str, identity: bytes) -> bool:
+            """pull.go:614,662: serve a digest only when the REQUESTER's
+            identity satisfies that collection's member-orgs policy (read
+            from the channel's committed lifecycle definition)."""
+            try:
+                access = self._collection_access(channel_id, ns, coll)
+                if access is None:
+                    return False
+                ident, msp = self.msp_manager.deserialize_identity(identity)
+                return access.is_member(ident, msp)
+            except Exception:  # noqa: BLE001 - any failure = ineligible
+                return False
+
         node = GossipNode(
             f"{self.signer.msp_id}:{self.addr}",
             channel_id,
@@ -511,6 +607,9 @@ class PeerNode:
             transient_store=self.transient,
             pvt_reader=pvt_reader,
             pvt_serve_policy=ch.is_eligible,
+            pvt_verify_member_sig=verify_member_sig,
+            pvt_requester_eligible=requester_eligible,
+            pvt_sign_request=self.signer.sign,
         )
         # reconciler loop (reconcile.go:104-126): patch missing pvt data
         # recorded at commit from peers, hash-checked on arrival

@@ -46,6 +46,7 @@ class Channel:
         btl_policy: Optional[Callable[[str, str], int]] = None,
         metrics=None,  # ledger.ledgermetrics.CommitterMetrics
         device_mvcc: bool = False,  # SURVEY P5 device fixpoint resolver
+        writeset_check=None,  # legacy v12/v13 write-set guards
     ):
         self.metrics = metrics
         self.channel_id = channel_id
@@ -72,6 +73,7 @@ class Channel:
             tx_exists=self.ledger.tx_exists,
             apply_config=apply_config,
             get_state_metadata=get_state_metadata,
+            writeset_check=writeset_check,
         )
 
     def prepare_block(self, block: common_pb2.Block):

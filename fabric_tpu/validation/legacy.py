@@ -1,14 +1,22 @@
-"""Legacy (pre-2.0) validation: the v12-era LSCC-backed policy source,
-write-set guards and the capability router (reference
+"""Legacy (pre-2.0) validation: the v12/v13-era LSCC-backed policy
+source, write-set guards, collection-config validation and the
+capability router (reference
 core/handlers/validation/builtin/v12/validation_logic.go,
+core/handlers/validation/builtin/v13/validation_logic.go
+validateRWSetAndCollection / validateNewCollectionConfigsAgainstCommitted,
 core/committer/txvalidator/v14 + router.go:34-50).
 
 Pre-V2_0 channels resolve a chaincode's endorsement policy from LSCC's
 ChaincodeData record in state — not from the _lifecycle namespace — and
 apply the v12 write-set rules: a normal transaction must not write to
 the LSCC namespace or any system chaincode namespace, and an LSCC
-deploy/upgrade must be shaped as one (validation_logic.go
-validateDeployRWSetAndCollection / checkInstantiationPolicy lineage).
+deploy/upgrade must be shaped as one.  v13 adds private-collection
+support at deploy time: the deploy may write a SECOND key,
+"<chaincode>~collection", holding a CollectionConfigPackage that must
+validate structurally, and an upgrade may only EXPAND the committed
+package — existing collections cannot be dropped or modified
+(v13 validation_logic.go:  validateNewCollectionConfigs +
+validateNewCollectionConfigsAgainstCommitted).
 """
 
 from __future__ import annotations
@@ -19,9 +27,16 @@ from fabric_tpu.policy.proto_convert import (
     PolicyConversionError,
     unmarshal_envelope,
 )
-from fabric_tpu.protos import peer_pb2
+from fabric_tpu.protos import collection_pb2, msp_principal_pb2, peer_pb2
 
 SYSTEM_NAMESPACES = ("lscc", "cscc", "qscc", "escc", "vscc", "_lifecycle")
+
+# privdata.BuildCollectionKVSKey separator (core/common/privdata/store.go)
+COLLECTION_SEPARATOR = "~"
+
+
+def collection_key(chaincode: str) -> str:
+    return chaincode + COLLECTION_SEPARATOR + "collection"
 
 
 class LSCCRegistry:
@@ -64,6 +79,33 @@ def check_v12_writeset(rwset, invoked_namespace: str) -> Optional[str]:
       (validation_logic.go:  "LSCC can only issue a single putState");
     - writes to any other system chaincode namespace are always illegal.
     """
+    return _check_legacy_writeset(rwset, invoked_namespace, v13=False)
+
+
+def check_v13_writeset(
+    rwset,
+    invoked_namespace: str,
+    committed_collections_get: Optional[Callable[[str], Optional[bytes]]] = None,
+) -> Optional[str]:
+    """v13 guards: v12 rules plus collection support on deploy/upgrade
+    (v13 validation_logic.go validateRWSetAndCollection).  The deploy may
+    write "<cc>~collection" alongside the ChaincodeData key; the package
+    must validate, and against `committed_collections_get(cc)` an upgrade
+    may only expand (existing collections immutable)."""
+    return _check_legacy_writeset(
+        rwset,
+        invoked_namespace,
+        v13=True,
+        committed_collections_get=committed_collections_get,
+    )
+
+
+def _check_legacy_writeset(
+    rwset,
+    invoked_namespace: str,
+    v13: bool,
+    committed_collections_get=None,
+) -> Optional[str]:
     if rwset is None:
         return None
     for ns_rw in rwset.ns_rw_sets:
@@ -75,22 +117,137 @@ def check_v12_writeset(rwset, invoked_namespace: str) -> Optional[str]:
                         "chaincode is not lscc but writes to the lscc "
                         "namespace"
                     )
-            else:
-                if len(ns_rw.writes) > 1:
-                    return "lscc deploy must write exactly one key"
-                # the reference additionally pins the single key to the
-                # deployed chaincode's name (validateDeployRWSetAndCollection);
-                # the invoke args are not threaded here, so pin what we
-                # can: the key must not shadow a system chaincode record
-                for w in ns_rw.writes:
-                    if w.key in SYSTEM_NAMESPACES:
-                        return (
-                            f"lscc deploy may not overwrite system "
-                            f"chaincode {w.key}"
-                        )
+                continue
+            cc_writes = [
+                w for w in ns_rw.writes
+                if COLLECTION_SEPARATOR not in w.key
+            ]
+            coll_writes = [
+                w for w in ns_rw.writes
+                if COLLECTION_SEPARATOR in w.key
+            ]
+            if len(cc_writes) > 1:
+                return "lscc deploy must write exactly one chaincode key"
+            if coll_writes and not v13:
+                return (
+                    "collection configurations require the V1_2 "
+                    "application capability (v13 validator)"
+                )
+            if len(coll_writes) > 1:
+                return "lscc deploy may write at most one collection key"
+            # the reference additionally pins the single key to the
+            # deployed chaincode's name (validateDeployRWSetAndCollection);
+            # the invoke args are not threaded here, so pin what we
+            # can: the key must not shadow a system chaincode record
+            for w in cc_writes:
+                if w.key in SYSTEM_NAMESPACES:
+                    return (
+                        f"lscc deploy may not overwrite system "
+                        f"chaincode {w.key}"
+                    )
+            if coll_writes:
+                w = coll_writes[0]
+                if not cc_writes:
+                    return "collection write without a chaincode deploy"
+                cc = cc_writes[0].key
+                if w.key != collection_key(cc):
+                    return (
+                        f"collection key {w.key!r} must be "
+                        f"{collection_key(cc)!r}"
+                    )
+                committed = (
+                    committed_collections_get(cc)
+                    if committed_collections_get is not None
+                    else None
+                )
+                why = validate_collection_config_package(w.value, committed)
+                if why is not None:
+                    return why
         elif ns in SYSTEM_NAMESPACES and ns != invoked_namespace:
             if ns_rw.writes or ns_rw.metadata_writes:
                 return f"writes to system namespace {ns} are not allowed"
+    return None
+
+
+_ALLOWED_PRINCIPAL_TYPES = (
+    msp_principal_pb2.MSPPrincipal.ROLE,
+    msp_principal_pb2.MSPPrincipal.ORGANIZATION_UNIT,
+    msp_principal_pb2.MSPPrincipal.IDENTITY,
+)
+
+
+def validate_collection_config_package(
+    raw: bytes, committed_raw: Optional[bytes] = None
+) -> Optional[str]:
+    """Structural validation of a CollectionConfigPackage, plus the
+    expand-only rule against the committed package (v13
+    validateNewCollectionConfigs +
+    validateNewCollectionConfigsAgainstCommitted).  Returns an error
+    string or None."""
+    pkg = collection_pb2.CollectionConfigPackage()
+    try:
+        pkg.ParseFromString(raw)
+    except Exception:  # noqa: BLE001 - malformed proto
+        return "invalid collection configuration supplied"
+    seen = set()
+    for cfg in pkg.config:
+        if cfg.WhichOneof("payload") != "static_collection_config":
+            return "unknown collection configuration type"
+        static = cfg.static_collection_config
+        if not static.name:
+            return "collection-name cannot be empty"
+        if static.name in seen:
+            return (
+                f"collection-name: {static.name} -- found duplicate "
+                f"collection configuration"
+            )
+        seen.add(static.name)
+        if static.maximum_peer_count < static.required_peer_count:
+            return (
+                f"collection-name: {static.name} -- maximum peer count "
+                f"({static.maximum_peer_count}) cannot be less than the "
+                f"required peer count ({static.required_peer_count})"
+            )
+        if not static.member_orgs_policy.HasField("signature_policy"):
+            return (
+                f"collection-name: {static.name} -- collection member "
+                f"policy is not set"
+            )
+        env = static.member_orgs_policy.signature_policy
+        if not env.identities:
+            return (
+                f"collection-name: {static.name} -- collection member "
+                f"policy has no identities"
+            )
+        for principal in env.identities:
+            if principal.principal_classification not in _ALLOWED_PRINCIPAL_TYPES:
+                return (
+                    f"collection-name: {static.name} -- collection "
+                    f"member policy contains an unsupported principal "
+                    f"type {principal.principal_classification}"
+                )
+    if committed_raw:
+        old = collection_pb2.CollectionConfigPackage()
+        try:
+            old.ParseFromString(committed_raw)
+        except Exception:  # noqa: BLE001 - corrupt committed record
+            return "committed collection configuration is unreadable"
+        new_by_name = {
+            c.static_collection_config.name: c.SerializeToString()
+            for c in pkg.config
+        }
+        for c in old.config:
+            name = c.static_collection_config.name
+            if name not in new_by_name:
+                return (
+                    f"the following existing collections are missing in "
+                    f"the new collection configuration package: [{name}]"
+                )
+            if new_by_name[name] != c.SerializeToString():
+                return (
+                    f"the collection configuration for collection "
+                    f"{name!r} cannot be modified on upgrade"
+                )
     return None
 
 

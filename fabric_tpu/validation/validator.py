@@ -301,6 +301,13 @@ class BlockValidator:
             if tx.header_type != common_pb2.ENDORSER_TRANSACTION:
                 flags.set_flag(i, TxValidationCode.UNKNOWN_TX_TYPE)
                 continue
+            # ledger-duplicate check BEFORE policy dispatch: a replayed
+            # txid is DUPLICATE_TXID even when its policy would also fail
+            # (v20/validator.go:349 checkTxIdDupsLedger runs before the
+            # plugin dispatch; same order in the v14 driver)
+            if tx.tx_id and self.tx_exists(tx.tx_id):
+                flags.set_flag(i, TxValidationCode.DUPLICATE_TXID)
+                continue
             # the invoked chaincode plus every namespace the tx writes to
             # is validated against ITS OWN policy (reference
             # plugindispatcher/dispatcher.go:174-218)

@@ -88,3 +88,108 @@ def test_plugin_registry_dynamic_load():
     assert reg.exists("jsonplugin") and plugin is not None
     with pytest.raises(ModuleNotFoundError):
         reg.load("nope", "no_such_module_xyz:thing")
+
+
+# -- v13 collection-config validation (v13 validation_logic.go) ---------
+
+
+def _pkg_bytes(collections):
+    from fabric_tpu.ledger.collections import build_collection_config_package
+
+    return build_collection_config_package(collections).SerializeToString()
+
+
+def _deploy_ws(cc, coll_value=None, coll_key=None):
+    """LSCC deploy write-set: ChaincodeData key + optional collection key."""
+    from fabric_tpu.ledger import rwset as rw
+
+    writes = [rw.KVWrite(cc, False, b"ccdata")]
+    if coll_value is not None:
+        writes.append(
+            rw.KVWrite(coll_key or legacy.collection_key(cc), False, coll_value)
+        )
+    return rw.TxRwSet((rw.NsRwSet("lscc", (), tuple(writes)),))
+
+
+from fabric_tpu.validation import legacy  # noqa: E402
+
+
+class TestV13Collections:
+    def test_valid_collection_deploy(self):
+        raw = _pkg_bytes([{"name": "secret", "policy": "OR('Org1MSP.member')"}])
+        assert legacy.check_v13_writeset(_deploy_ws("mycc", raw), "lscc") is None
+
+    def test_v12_rejects_collection_writes(self):
+        raw = _pkg_bytes([{"name": "secret", "policy": "OR('Org1MSP.member')"}])
+        why = legacy.check_v12_writeset(_deploy_ws("mycc", raw), "lscc")
+        assert why is not None and "V1_2" in why
+
+    def test_wrong_collection_key_rejected(self):
+        raw = _pkg_bytes([{"name": "c", "policy": "OR('Org1MSP.member')"}])
+        why = legacy.check_v13_writeset(
+            _deploy_ws("mycc", raw, coll_key="othercc~collection"), "lscc"
+        )
+        assert why is not None and "othercc~collection" in why
+
+    def test_malformed_package_rejected(self):
+        why = legacy.check_v13_writeset(
+            _deploy_ws("mycc", b"\xff\xfe\xfd"), "lscc"
+        )
+        assert why is not None and "invalid collection" in why
+
+    def test_duplicate_collection_names_rejected(self):
+        raw = _pkg_bytes(
+            [
+                {"name": "c1", "policy": "OR('Org1MSP.member')"},
+                {"name": "c1", "policy": "OR('Org1MSP.member')"},
+            ]
+        )
+        why = legacy.check_v13_writeset(_deploy_ws("mycc", raw), "lscc")
+        assert why is not None and "duplicate" in why
+
+    def test_peer_count_bounds(self):
+        raw = _pkg_bytes(
+            [
+                {
+                    "name": "c",
+                    "policy": "OR('Org1MSP.member')",
+                    "required_peer_count": 3,
+                    "maximum_peer_count": 1,
+                }
+            ]
+        )
+        why = legacy.check_v13_writeset(_deploy_ws("mycc", raw), "lscc")
+        assert why is not None and "maximum peer count" in why
+
+    def test_missing_member_policy_rejected(self):
+        raw = _pkg_bytes([{"name": "c"}])
+        why = legacy.check_v13_writeset(_deploy_ws("mycc", raw), "lscc")
+        assert why is not None and "member policy is not set" in why
+
+    def test_upgrade_may_only_expand(self):
+        old = _pkg_bytes([{"name": "c1", "policy": "OR('Org1MSP.member')"}])
+        grown = _pkg_bytes(
+            [
+                {"name": "c1", "policy": "OR('Org1MSP.member')"},
+                {"name": "c2", "policy": "OR('Org1MSP.member')"},
+            ]
+        )
+        dropped = _pkg_bytes([{"name": "c2", "policy": "OR('Org1MSP.member')"}])
+        modified = _pkg_bytes(
+            [{"name": "c1", "policy": "OR('Org2MSP.member')"}]
+        )
+        get_old = lambda cc: old  # noqa: E731
+        assert (
+            legacy.check_v13_writeset(
+                _deploy_ws("mycc", grown), "lscc", get_old
+            )
+            is None
+        )
+        why = legacy.check_v13_writeset(
+            _deploy_ws("mycc", dropped), "lscc", get_old
+        )
+        assert why is not None and "missing" in why
+        why = legacy.check_v13_writeset(
+            _deploy_ws("mycc", modified), "lscc", get_old
+        )
+        assert why is not None and "cannot be modified" in why

@@ -1,0 +1,161 @@
+"""Per-requester private-data eligibility on the gossip pull path
+(reference gossip/privdata/pull.go:614 filterNotEligible / :662
+isEligibleByLatestConfig): a served digest requires the REQUESTER's
+authenticated identity to satisfy that collection's member-orgs policy.
+An ineligible org's pull is refused."""
+
+import pytest
+
+from fabric_tpu.crypto.bccsp import SoftwareProvider
+from fabric_tpu.gossip.pvtdata import PvtDataHandler, _request_signing_bytes
+from fabric_tpu.ledger.collections import (
+    CollectionAccess,
+    build_collection_config_package,
+)
+from fabric_tpu.msp.cryptogen import generate_org
+from fabric_tpu.msp.identity import MSPManager
+from fabric_tpu.msp.signer import SigningIdentity
+from fabric_tpu.protos import gossip_pb2
+
+PROVIDER = SoftwareProvider()
+CHANNEL = "pvtelig"
+
+
+class _Transient:
+    def persist(self, *a):
+        pass
+
+
+@pytest.fixture(scope="module")
+def world():
+    org1 = generate_org("org1.pvtelig", "Org1MSP")
+    org2 = generate_org("org2.pvtelig", "Org2MSP")
+    mgr = MSPManager(
+        [org1.msp(provider=PROVIDER), org2.msp(provider=PROVIDER)]
+    )
+    pkg = build_collection_config_package(
+        [{"name": "secret", "policy": "OR('Org1MSP.member')"}]
+    )
+    access = CollectionAccess(pkg.config[0].static_collection_config)
+
+    signers = {
+        "org1": SigningIdentity(org1.peers[0], PROVIDER),
+        "org2": SigningIdentity(org2.peers[0], PROVIDER),
+    }
+    certstore = {
+        b"org1-peer": signers["org1"].serialize(),
+        b"org2-peer": signers["org2"].serialize(),
+    }
+
+    def verify_member_sig(identity, data, sig):
+        try:
+            ident, msp = mgr.deserialize_identity(identity)
+            msp.validate(ident)
+            ident.verify(data, sig)
+            return True
+        except Exception:  # noqa: BLE001
+            return False
+
+    def requester_eligible(ns, coll, identity):
+        if (ns, coll) != ("mycc", "secret"):
+            return False
+        ident, msp = mgr.deserialize_identity(identity)
+        return access.is_member(ident, msp)
+
+    handler = PvtDataHandler(
+        CHANNEL,
+        _Transient(),
+        lambda blk, tx, ns, coll: b"the-private-rwset",
+        resolve_identity=certstore.get,
+        verify_member_sig=verify_member_sig,
+        requester_eligible=requester_eligible,
+    )
+    return {"handler": handler, "signers": signers}
+
+
+def _request(pki_id=b"", signer=None, tamper=False, channel=CHANNEL, nonce=None):
+    import secrets
+
+    msg = gossip_pb2.GossipMessage()
+    msg.channel = CHANNEL
+    d = msg.private_req.digests.add()
+    d.namespace = "mycc"
+    d.collection = "secret"
+    d.block_seq = 3
+    d.seq_in_block = 0
+    if pki_id:
+        msg.private_req.pki_id = pki_id
+    if signer is not None:
+        msg.private_req.nonce = nonce or secrets.token_bytes(24)
+        msg.private_req.signature = signer.sign(
+            _request_signing_bytes(msg.private_req, channel)
+        )
+        if tamper:
+            d2 = msg.private_req.digests.add()
+            d2.namespace = "mycc"
+            d2.collection = "secret"
+            d2.block_seq = 4
+            d2.seq_in_block = 0
+    return msg
+
+
+def test_eligible_org_is_served(world):
+    resp = world["handler"].handle(
+        _request(b"org1-peer", world["signers"]["org1"])
+    )
+    assert resp is not None
+    assert len(resp.private_res.elements) == 1
+    assert bytes(resp.private_res.elements[0].payload) == b"the-private-rwset"
+
+
+def test_ineligible_org_pull_is_refused(world):
+    # Org2 authenticates fine but fails the collection's member-orgs
+    # policy (OR Org1MSP.member) -> nothing served
+    resp = world["handler"].handle(
+        _request(b"org2-peer", world["signers"]["org2"])
+    )
+    assert resp is None
+
+
+def test_unsigned_request_refused(world):
+    assert world["handler"].handle(_request(b"org1-peer")) is None
+    assert world["handler"].handle(_request()) is None
+
+
+def test_unknown_pki_id_refused(world):
+    resp = world["handler"].handle(
+        _request(b"nobody", world["signers"]["org1"])
+    )
+    assert resp is None
+
+
+def test_tampered_digests_refused(world):
+    # signature covers the digest list; adding a digest after signing
+    # must invalidate the request
+    resp = world["handler"].handle(
+        _request(b"org1-peer", world["signers"]["org1"], tamper=True)
+    )
+    assert resp is None
+
+
+def test_wrong_org_signature_refused(world):
+    # org2's signature presented under org1's pki_id
+    msg = _request(b"org1-peer", world["signers"]["org2"])
+    assert world["handler"].handle(msg) is None
+
+
+def test_replayed_request_refused(world):
+    # the identical signed request served once is never served again
+    # (nonce consumed); a fresh nonce from the same org works
+    msg = _request(b"org1-peer", world["signers"]["org1"])
+    assert world["handler"].handle(msg) is not None
+    assert world["handler"].handle(msg) is None
+    again = _request(b"org1-peer", world["signers"]["org1"])
+    assert world["handler"].handle(again) is not None
+
+
+def test_cross_channel_signature_refused(world):
+    # a request signed for another channel's handler must not validate
+    # here (channel id is bound into the signed bytes)
+    msg = _request(b"org1-peer", world["signers"]["org1"], channel="otherchan")
+    assert world["handler"].handle(msg) is None
