@@ -60,7 +60,12 @@ class TPUProvider(Provider):
 
         from fabric_tpu.crypto.bccsp import SoftwareProvider
         from fabric_tpu.ops import p256_kernel as pk
+        from fabric_tpu.utils.jaxcache import enable_compile_cache
 
+        # every consumer of the device provider (peer/orderer processes
+        # included) must hit the persistent XLA cache — a subprocess peer
+        # without it recompiles the verify kernel for minutes
+        enable_compile_cache()
         self._jax = jax
         self._pk = pk
         self._software = SoftwareProvider()

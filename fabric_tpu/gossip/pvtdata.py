@@ -60,7 +60,16 @@ class PvtDataHandler:
         self._requester_eligible = requester_eligible
         self._self_pki_id = self_pki_id
         self._sign_request = sign_request
-        self._seen_nonces: set = set()
+        # replay window: ordered so eviction drops the OLDEST nonces
+        # (a wholesale clear would re-admit every previously consumed
+        # request); lock guards check+insert across gossip stream threads
+        import collections
+        import threading
+
+        self._seen_nonces: "collections.OrderedDict[bytes, None]" = (
+            collections.OrderedDict()
+        )
+        self._nonce_lock = threading.Lock()
 
     def _authenticated_requester(self, req) -> Optional[bytes]:
         """Resolve + signature-check the requester; None when the request
@@ -84,13 +93,15 @@ class PvtDataHandler:
         ):
             return None
         # replay gate AFTER signature verification so unauthenticated
-        # garbage cannot consume nonces
+        # garbage cannot consume nonces; atomic check+insert (concurrent
+        # streams must not both pass the membership test)
         nonce = bytes(req.nonce)
-        if nonce in self._seen_nonces:
-            return None
-        if len(self._seen_nonces) >= 65536:
-            self._seen_nonces.clear()
-        self._seen_nonces.add(nonce)
+        with self._nonce_lock:
+            if nonce in self._seen_nonces:
+                return None
+            self._seen_nonces[nonce] = None
+            while len(self._seen_nonces) > 65536:
+                self._seen_nonces.popitem(last=False)  # evict oldest
         return identity
 
     # -- message handling (wired into GossipNode._handle) ------------------

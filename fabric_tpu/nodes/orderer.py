@@ -220,23 +220,36 @@ class OrdererNode:
 
     def _flush_loop(self) -> None:
         """Batch-timeout ticker (reference blockcutter timer in the
-        consenter run loops): cut pending batches for every channel at
-        each channel's BatchTimeout cadence."""
+        consenter run loops): a channel's pending batch is cut only once
+        its OLDEST message has waited the channel's BatchTimeout — a
+        fixed global cadence would force-cut partial blocks and make any
+        BatchTimeout above the cadence meaningless."""
         while not self._stopped.wait(self._next_flush_interval()):
             for support in list(self.registrar.chains.values()):
+                timeout = (
+                    parse_duration(support.bundle.orderer.batch_timeout, 0.5)
+                    if support.bundle.orderer is not None
+                    else 0.5
+                )
+                cutter = getattr(support.chain, "cutter", None)
+                age = cutter.pending_age() if cutter is not None else None
+                if age is None or age < timeout:
+                    continue
                 try:
                     support.chain.flush()
                 except Exception:  # noqa: BLE001 - chain-local failure
                     pass
 
     def _next_flush_interval(self) -> float:
+        """Poll at a fraction of the smallest BatchTimeout so expiry is
+        detected promptly without busy-spinning."""
         intervals = [0.5]
         for support in self.registrar.chains.values():
             if support.bundle.orderer is not None:
                 intervals.append(
                     parse_duration(support.bundle.orderer.batch_timeout, 0.5)
                 )
-        return max(0.05, min(intervals))
+        return min(0.5, max(0.02, min(intervals) / 4.0))
 
     def start(self) -> str:
         if self.ops is not None:

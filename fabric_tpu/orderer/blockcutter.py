@@ -29,6 +29,19 @@ class BlockCutter:
         self.config = config if config is not None else BatchConfig()
         self._pending: List[common_pb2.Envelope] = []
         self._pending_bytes = 0
+        self._pending_since: Optional[float] = None
+
+    def pending_age(self) -> Optional[float]:
+        """Seconds since the oldest pending message arrived, or None for
+        an empty batch — the reference's batch timer starts at the FIRST
+        message of a batch (chain run loops: timer = time.After(...) when
+        pending becomes non-empty), so BatchTimeout means 'oldest message
+        waits at most this long', not a global flush cadence."""
+        if self._pending_since is None:
+            return None
+        import time
+
+        return time.monotonic() - self._pending_since
 
     @staticmethod
     def _size(env: common_pb2.Envelope) -> int:
@@ -49,6 +62,10 @@ class BlockCutter:
         if self._pending_bytes + size > self.config.preferred_max_bytes and self._pending:
             batches.append(self._cut())
 
+        if not self._pending:
+            import time
+
+            self._pending_since = time.monotonic()
         self._pending.append(env)
         self._pending_bytes += size
 
@@ -64,4 +81,5 @@ class BlockCutter:
         batch = self._pending
         self._pending = []
         self._pending_bytes = 0
+        self._pending_since = None
         return batch

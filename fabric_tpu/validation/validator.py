@@ -191,7 +191,12 @@ class BlockValidator:
         for i, txid in enumerate(txid_array):
             if not txid:
                 continue
-            if self.tx_exists(txid):
+            # endorser txs already paid the ledger probe in
+            # _assemble_codes (pre-dispatch DUPLICATE_TXID priority);
+            # only non-endorser txids still need the ledger check here
+            if parsed[i].header_type != common_pb2.ENDORSER_TRANSACTION and (
+                self.tx_exists(txid)
+            ):
                 flags.set_flag(i, TxValidationCode.DUPLICATE_TXID)
                 txid_array[i] = ""
                 continue

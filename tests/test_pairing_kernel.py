@@ -17,14 +17,24 @@ from fabric_tpu.ops import fp12 as f12
 
 RNG = random.Random(20260731)
 
-# The full Miller+final-exp kernel costs a LONG first XLA:CPU compile
-# (tens of minutes uncached; the per-round cache starts cold). The
-# tower-op differentials below always run; the full-kernel differentials
-# run when explicitly requested (set FABRIC_TPU_PAIRING_TESTS=1) or when
-# a warm compile cache makes them cheap.
+# The pairing program is compiled ONCE for all issuer keys (line
+# schedules are runtime inputs), so the default suite now runs the
+# end-to-end unity differential ungated: it costs one program compile
+# (minutes cold on XLA:CPU, seconds against the warm cache) and checks
+# device/host verdict parity on valid/corrupt/absent lanes for a FRESH
+# issuer key every run.  FABRIC_TPU_PAIRING_TESTS=0 opts out entirely.
+# The two deep-debug differentials (per-step Miller values, which jits a
+# separate single-lane program, and the full idemix batch e2e, which
+# spends minutes in host-oracle signing/verification) stay behind
+# FABRIC_TPU_PAIRING_TESTS=1.
+_mode = os.environ.get("FABRIC_TPU_PAIRING_TESTS", "")
 full_kernel = pytest.mark.skipif(
-    os.environ.get("FABRIC_TPU_PAIRING_TESTS", "") != "1",
-    reason="full pairing kernel compile is expensive; "
+    _mode == "0",
+    reason="pairing kernel tests disabled (FABRIC_TPU_PAIRING_TESTS=0)",
+)
+deep_kernel = pytest.mark.skipif(
+    _mode != "1",
+    reason="deep pairing differentials are slow; "
     "set FABRIC_TPU_PAIRING_TESTS=1",
 )
 
@@ -96,7 +106,7 @@ def _rand_g2():
     return host.g2_mul(host.G2_GEN, RNG.randrange(1, host.R))
 
 
-@full_kernel
+@deep_kernel
 def test_miller_values_bit_exact():
     from fabric_tpu.ops.pairing_kernel import miller2_host_values
 
@@ -136,7 +146,7 @@ def test_ate2_unity_matches_oracle():
     assert got == [True, False, False]
 
 
-@full_kernel
+@deep_kernel
 def test_idemix_batch_device_pairing_matches_host():
     from fabric_tpu import idemix
     from fabric_tpu.crypto import fp256bn as bncurve
@@ -166,10 +176,12 @@ def test_idemix_batch_device_pairing_matches_host():
     from fabric_tpu.protos import idemix_pb2
 
     # corrupt one signature's ABar so the pairing check fails that lane
+    from fabric_tpu.idemix.scheme import ecp_from_proto, ecp_to_proto
+
     bad = idemix_pb2.Signature()
     bad.CopyFrom(sigs[1])
-    a_bar = bncurve.g1_from_bytes(bytes(bad.a_bar))
-    bad.a_bar = bncurve.g1_to_bytes(bncurve.g1_mul(a_bar, 2))
+    a_bar = ecp_from_proto(bad.a_bar)
+    bad.a_bar.CopyFrom(ecp_to_proto(bncurve.g1_mul(a_bar, 2)))
     sigs[1] = bad
 
     values = [[None] * 4] * 3
