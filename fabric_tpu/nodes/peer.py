@@ -124,7 +124,9 @@ class PeerNode:
             system=True,
         )
         self.support.register(
-            "lscc", LSCC(self._list_chaincodes), system=True
+            "lscc",
+            LSCC(self._list_chaincodes, v20_active=self._v20_active),
+            system=True,
         )
         from fabric_tpu.scc.lifecycle_scc import LifecycleSCC
 
@@ -362,6 +364,19 @@ class PeerNode:
             wait_for,
         )
 
+    def _v20_active(self, channel_id: str) -> bool:
+        """ONE definition of 'this channel runs the v2.0 lifecycle' shared
+        by lscc (deploy refusal) and the validator's write-set routing —
+        a missing bundle/capabilities section counts as V2_0, so the two
+        can never disagree about which regime governs the channel."""
+        caps = self._app_capabilities(channel_id)
+        return caps is None or caps.v20_validation
+
+    def _app_capabilities(self, channel_id: str):
+        bundle = self._discovery_bundle(channel_id)
+        app = bundle.application if bundle is not None else None
+        return app.capabilities if app is not None else None
+
     def _legacy_writeset_check(self, channel_id, rwset, invoked_ns):
         """Capability-routed legacy write-set guards (txvalidator v14
         router analog): V2_0 channels use the lifecycle rules only;
@@ -373,11 +388,9 @@ class PeerNode:
             collection_key,
         )
 
-        bundle = self._discovery_bundle(channel_id)
-        app = bundle.application if bundle is not None else None
-        caps = app.capabilities if app is not None else None
-        if caps is None or caps.v20_validation:
+        if self._v20_active(channel_id):
             return None  # _lifecycle governs deploys on V2_0 channels
+        caps = self._app_capabilities(channel_id)
         ch = self.channels.get(channel_id)
 
         def committed_collections(cc: str):

@@ -37,7 +37,7 @@ class BlockCutter:
         message of a batch (chain run loops: timer = time.After(...) when
         pending becomes non-empty), so BatchTimeout means 'oldest message
         waits at most this long', not a global flush cadence."""
-        if self._pending_since is None:
+        if not self._pending or self._pending_since is None:
             return None
         import time
 
@@ -62,12 +62,16 @@ class BlockCutter:
         if self._pending_bytes + size > self.config.preferred_max_bytes and self._pending:
             batches.append(self._cut())
 
-        if not self._pending:
+        self._pending.append(env)
+        self._pending_bytes += size
+        if self._pending_since is None:
+            # set AFTER the append: a concurrent timeout flush (solo
+            # chains take no lock) may steal the batch between the two
+            # statements, and a message must never sit with no timestamp
+            # or the age-gated flush loop would skip it forever
             import time
 
             self._pending_since = time.monotonic()
-        self._pending.append(env)
-        self._pending_bytes += size
 
         if len(self._pending) >= self.config.max_message_count:
             batches.append(self._cut())

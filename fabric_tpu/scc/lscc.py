@@ -1,13 +1,29 @@
 """lscc — legacy lifecycle system chaincode (reference core/scc/lscc/
-lscc.go), serving pre-2.0 chaincode queries over the new lifecycle's
-definitions: getchaincodes, getid/getccdata, getdepspec stubs.
+lscc.go: Invoke :797, executeDeployOrUpgrade :580, putChaincodeData
+lineage, plus the query surface old SDKs keep using).
 
-Deployment itself goes through _lifecycle (fabric_tpu.lifecycle); lscc
-here is the query-compatibility surface the reference keeps for old SDKs.
+Two roles:
+
+* **Legacy deploy/upgrade** for pre-V2_0 channels: writes the
+  ChaincodeData record at ("lscc", <name>) and the collection package at
+  ("lscc", "<name>~collection") through the invoking tx's simulator, so
+  the v12/v13 write-set guards validate the exact shapes this module
+  produces and `validation.legacy.LSCCRegistry` resolves policies from
+  the committed records.  Name/version syntax rules mirror lscc.go
+  (isValidCCNameOrVersion: name `[A-Za-z0-9]+([-_][A-Za-z0-9]+)*`,
+  version also allows ``.+-_``).
+* **Query surface**: getchaincodes, getid, getccdata (ChaincodeData
+  bytes, as the reference returns), getcollectionsconfig.
+
+V2_0 channels deploy through _lifecycle (fabric_tpu.lifecycle); deploy /
+upgrade here errors on them, like the reference does once the channel
+has migrated.
 """
 
 from __future__ import annotations
 
+import hashlib
+import re
 from typing import Callable, List, Optional, Tuple
 
 from fabric_tpu.chaincode.shim import ChaincodeStub, Response, error_response, success
@@ -16,6 +32,18 @@ from fabric_tpu.protos import peer_pb2
 GET_CHAINCODES = "getchaincodes"
 GET_CC_INFO = "getid"
 GET_CC_DATA = "getccdata"
+GET_COLLECTIONS_CONFIG = "getcollectionsconfig"
+DEPLOY = "deploy"
+UPGRADE = "upgrade"
+
+_NAME_RE = re.compile(r"^[A-Za-z0-9]+([-_][A-Za-z0-9]+)*$")
+_VERSION_RE = re.compile(r"^[A-Za-z0-9_.+-]+$")
+
+COLLECTION_SUFFIX = "~collection"
+
+
+def _collection_key(name: str) -> str:
+    return name + COLLECTION_SUFFIX
 
 
 class LSCC:
@@ -23,8 +51,13 @@ class LSCC:
         self,
         # () -> [(name, version)] of committed definitions on this channel
         list_definitions: Callable[[], List[Tuple[str, str]]],
+        # (channel_id) -> bool: True when the channel has the V2_0
+        # capability and legacy deploys must be refused
+        # (lscc.go InvalidCCOnFabricVersionError)
+        v20_active: Optional[Callable[[str], bool]] = None,
     ):
         self._list_definitions = list_definitions
+        self._v20_active = v20_active or (lambda cid: False)
 
     def init(self, stub: ChaincodeStub) -> Response:
         return success()
@@ -34,26 +67,158 @@ class LSCC:
         if not args:
             return error_response("Incorrect number of arguments, 0")
         fname = args[0].decode().lower()
+        if fname in (DEPLOY, UPGRADE):
+            return self._deploy_or_upgrade(stub, fname, args)
         if fname in (GET_CHAINCODES, "getchaincodesinfo"):
-            resp = peer_pb2.ChaincodeQueryResponse()
-            for name, version in sorted(self._list_definitions()):
-                info = resp.chaincodes.add()
-                info.name = name
-                info.version = version
-                info.escc = "escc"
-                info.vscc = "vscc"
-            return success(resp.SerializeToString())
+            return self._get_chaincodes(stub)
         if fname in (GET_CC_INFO, GET_CC_DATA):
-            if len(args) < 3:
+            return self._get_cc(stub, fname, args)
+        if fname == GET_COLLECTIONS_CONFIG:
+            if len(args) < 2:
+                return error_response("Incorrect number of arguments, 1")
+            raw = stub.get_state(_collection_key(args[1].decode()))
+            if raw is None:
                 return error_response(
-                    f"Incorrect number of arguments, {len(args)}"
+                    f"collections config not defined for chaincode "
+                    f"{args[1].decode()}"
                 )
-            name = args[2].decode()
-            for n, version in self._list_definitions():
-                if n == name:
-                    info = peer_pb2.ChaincodeInfo()
-                    info.name = n
-                    info.version = version
-                    return success(info.SerializeToString())
-            return error_response(f"chaincode {name} not found")
+            return success(raw)
         return error_response(f"invalid function to lscc: {fname}")
+
+    # -- legacy deploy/upgrade (executeDeployOrUpgrade :580) -------------
+    def _deploy_or_upgrade(
+        self, stub: ChaincodeStub, fname: str, args
+    ) -> Response:
+        if self._v20_active(stub.channel_id):
+            return error_response(
+                "Channel has been migrated to the new lifecycle, "
+                "LSCC is no longer supported for deploy/upgrade"
+            )
+        # args: [fn, channel, depspec, policy?, escc?, vscc?, collections?]
+        if len(args) < 3:
+            return error_response(
+                f"Incorrect number of arguments, {len(args)}"
+            )
+        spec = peer_pb2.ChaincodeDeploymentSpec()
+        try:
+            spec.ParseFromString(args[2])
+        except Exception:  # noqa: BLE001 - malformed proto
+            return error_response("error unmarshalling ChaincodeDeploymentSpec")
+        ccid = spec.chaincode_spec.chaincode_id
+        name, version = ccid.name, ccid.version
+        if not _NAME_RE.match(name or ""):
+            return error_response(f"invalid chaincode name '{name}'")
+        if not _VERSION_RE.match(version or ""):
+            return error_response(f"invalid chaincode version '{version}'")
+
+        existing_raw = stub.get_state(name)
+        if fname == DEPLOY and existing_raw is not None:
+            return error_response(f"chaincode with name '{name}' already exists")
+        if fname == UPGRADE:
+            if existing_raw is None:
+                return error_response(f"cannot get chaincode data for '{name}'")
+            old = peer_pb2.ChaincodeData()
+            old.ParseFromString(existing_raw)
+            if old.version == version:
+                return error_response(
+                    f"chaincode '{name}' is already instantiated at "
+                    f"version '{version}'"
+                )
+
+        # the endorsement policy is REQUIRED and must parse: committing a
+        # ChaincodeData with empty/garbage policy bytes would make
+        # LSCCRegistry.get() fail forever and brick the chaincode with
+        # INVALID_CHAINCODE on every tx (the reference validates/defaults
+        # the policy at deploy; lacking the channel-org context its
+        # default needs, we require it explicitly)
+        if len(args) < 4 or not args[3]:
+            return error_response(
+                "endorsement policy is required for deploy/upgrade"
+            )
+        try:
+            from fabric_tpu.policy.proto_convert import unmarshal_envelope
+
+            unmarshal_envelope(bytes(args[3]))
+        except Exception as e:  # noqa: BLE001 - any parse failure
+            return error_response(f"invalid endorsement policy: {e}")
+
+        cd = peer_pb2.ChaincodeData()
+        cd.name = name
+        cd.version = version
+        cd.escc = args[4].decode() if len(args) > 4 and args[4] else "escc"
+        cd.vscc = args[5].decode() if len(args) > 5 and args[5] else "vscc"
+        cd.policy = bytes(args[3])  # serialized SignaturePolicyEnvelope
+        # id: fingerprint of the code package (ccprovider hash lineage)
+        cd.id = hashlib.sha256(
+            bytes(spec.code_package) + name.encode() + version.encode()
+        ).digest()
+        stub.put_state(name, cd.SerializeToString())
+
+        if len(args) > 6 and args[6]:
+            # collection package: written beside the chaincode record;
+            # structural validation is the v13 validator's job on commit
+            # (validation.legacy.check_v13_writeset), matching the
+            # reference split between lscc and the validation plugin
+            stub.put_state(_collection_key(name), bytes(args[6]))
+        return success(cd.SerializeToString())
+
+    # -- queries ----------------------------------------------------------
+    def _get_chaincodes(self, stub: ChaincodeStub) -> Response:
+        resp = peer_pb2.ChaincodeQueryResponse()
+        listed = set()
+        # committed legacy records first (state), then lifecycle
+        # definitions (old SDKs expect one merged view)
+        for key, raw in stub.get_state_by_range("", ""):
+            if COLLECTION_SUFFIX in key:
+                continue
+            cd = peer_pb2.ChaincodeData()
+            try:
+                cd.ParseFromString(raw)
+            except Exception:  # noqa: BLE001 - foreign record
+                continue
+            info = resp.chaincodes.add()
+            info.name = cd.name or key
+            info.version = cd.version
+            info.escc = cd.escc or "escc"
+            info.vscc = cd.vscc or "vscc"
+            info.id = cd.id
+            listed.add(info.name)
+        for name, version in sorted(self._list_definitions()):
+            if name in listed:
+                continue
+            info = resp.chaincodes.add()
+            info.name = name
+            info.version = version
+            info.escc = "escc"
+            info.vscc = "vscc"
+        return success(resp.SerializeToString())
+
+    def _get_cc(self, stub: ChaincodeStub, fname: str, args) -> Response:
+        if len(args) < 3:
+            return error_response(f"Incorrect number of arguments, {len(args)}")
+        name = args[2].decode()
+        raw = stub.get_state(name)
+        if raw is not None:
+            if fname == GET_CC_DATA:
+                return success(raw)  # ChaincodeData bytes, as lscc.go returns
+            cd = peer_pb2.ChaincodeData()
+            cd.ParseFromString(raw)
+            info = peer_pb2.ChaincodeInfo()
+            info.name = cd.name or name
+            info.version = cd.version
+            info.id = cd.id
+            return success(info.SerializeToString())
+        for n, version in self._list_definitions():
+            if n == name:
+                if fname == GET_CC_DATA:
+                    cd = peer_pb2.ChaincodeData()
+                    cd.name = n
+                    cd.version = version
+                    cd.escc = "escc"
+                    cd.vscc = "vscc"
+                    return success(cd.SerializeToString())
+                info = peer_pb2.ChaincodeInfo()
+                info.name = n
+                info.version = version
+                return success(info.SerializeToString())
+        return error_response(f"chaincode {name} not found")

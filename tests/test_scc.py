@@ -248,3 +248,140 @@ def test_acl_local_policy_routes_to_local_check(acl_world):
     )
     acl.check_acl(CSCC_GET_CHANNELS, "", [_sd(org1.peers[0])])
     assert calls == ["Members"]
+
+
+# ---------------- lscc legacy deploy/upgrade (lscc.go :580) ----------------
+
+
+def _lscc_stub(net, args, sim=None):
+    from fabric_tpu.ledger.simulator import TxSimulator
+
+    sim = sim or TxSimulator(net["channel"].ledger.state_db, tx_id="d")
+    support = ChaincodeSupport()
+    return ChaincodeStub("lscc", CHANNEL, "d", args, sim, support=support), sim
+
+
+def _depspec(name, version, pkg=b"code"):
+    spec = peer_pb2.ChaincodeDeploymentSpec()
+    spec.chaincode_spec.chaincode_id.name = name
+    spec.chaincode_spec.chaincode_id.version = version
+    spec.code_package = pkg
+    return spec.SerializeToString()
+
+
+def test_lscc_deploy_writes_chaincode_data_and_collections(net):
+    from fabric_tpu.ledger.collections import build_collection_config_package
+    from fabric_tpu.validation.legacy import check_v13_writeset
+
+    lscc = LSCC(lambda: [])
+    from fabric_tpu.policy import from_dsl
+    from fabric_tpu.policy.proto_convert import marshal_envelope
+
+    colls = build_collection_config_package(
+        [{"name": "secret", "policy": "OR('Org1MSP.member')"}]
+    ).SerializeToString()
+    policy = marshal_envelope(from_dsl("OR('Org1MSP.member')"))
+    stub, sim = _lscc_stub(
+        net,
+        [b"deploy", CHANNEL.encode(), _depspec("legacycc", "1.0"),
+         policy, b"escc", b"vscc", colls],
+    )
+    resp = lscc.invoke(stub)
+    assert resp.status == 200, resp.message
+    cd = peer_pb2.ChaincodeData()
+    cd.ParseFromString(resp.payload)
+    assert (cd.name, cd.version, cd.escc) == ("legacycc", "1.0", "escc")
+    # the produced write-set is exactly what the v13 guard accepts
+    rwset = sim.get_tx_simulation_results().rwset
+    assert check_v13_writeset(rwset, "lscc") is None
+    writes = {
+        w.key for ns in rwset.ns_rw_sets if ns.namespace == "lscc"
+        for w in ns.writes
+    }
+    assert writes == {"legacycc", "legacycc~collection"}
+
+
+def _policy_bytes():
+    from fabric_tpu.policy import from_dsl
+    from fabric_tpu.policy.proto_convert import marshal_envelope
+
+    return marshal_envelope(from_dsl("OR('Org1MSP.member')"))
+
+
+def test_lscc_deploy_validation_errors(net):
+    lscc = LSCC(lambda: [])
+    stub, _ = _lscc_stub(
+        net,
+        [b"deploy", CHANNEL.encode(), _depspec("bad name!", "1.0"),
+         _policy_bytes()],
+    )
+    assert lscc.invoke(stub).status == 500
+    # policy REQUIRED and must parse (an empty/garbage policy would
+    # brick the chaincode at validation time)
+    stub, _ = _lscc_stub(
+        net, [b"deploy", CHANNEL.encode(), _depspec("okcc", "1.0")]
+    )
+    assert lscc.invoke(stub).status == 500
+    stub, _ = _lscc_stub(
+        net,
+        [b"deploy", CHANNEL.encode(), _depspec("okcc", "1.0"), b"\xff\x01"],
+    )
+    assert lscc.invoke(stub).status == 500
+    stub, _ = _lscc_stub(
+        net,
+        [b"deploy", CHANNEL.encode(), _depspec("cc", "bad version!"),
+         _policy_bytes()],
+    )
+    assert lscc.invoke(stub).status == 500
+    stub, _ = _lscc_stub(net, [b"deploy", CHANNEL.encode(), b"\xff\xfe"])
+    assert lscc.invoke(stub).status == 500
+    # V2_0 channels refuse legacy deploys
+    lscc_v2 = LSCC(lambda: [], v20_active=lambda cid: True)
+    stub, _ = _lscc_stub(
+        net,
+        [b"deploy", CHANNEL.encode(), _depspec("cc", "1.0"), _policy_bytes()],
+    )
+    resp = lscc_v2.invoke(stub)
+    assert resp.status == 500 and "lifecycle" in resp.message
+
+
+def test_lscc_upgrade_rules(net):
+    from fabric_tpu.ledger.rwset import Version
+    from fabric_tpu.ledger.statedb import UpdateBatch
+
+    lscc = LSCC(lambda: [])
+    # commit a deployed record directly into state
+    cd = peer_pb2.ChaincodeData(name="upcc", version="1.0")
+    batch = UpdateBatch()
+    batch.put("lscc", "upcc", cd.SerializeToString(), Version(9, 0))
+    net["channel"].ledger.state_db.apply_updates(batch)
+
+    # same-version upgrade refused
+    stub, _ = _lscc_stub(
+        net,
+        [b"upgrade", CHANNEL.encode(), _depspec("upcc", "1.0"),
+         _policy_bytes()],
+    )
+    assert lscc.invoke(stub).status == 500
+    # upgrade of a non-existent chaincode refused
+    stub, _ = _lscc_stub(
+        net,
+        [b"upgrade", CHANNEL.encode(), _depspec("ghost", "2.0"),
+         _policy_bytes()],
+    )
+    assert lscc.invoke(stub).status == 500
+    # proper upgrade succeeds and get queries see committed records
+    stub, sim = _lscc_stub(
+        net,
+        [b"upgrade", CHANNEL.encode(), _depspec("upcc", "2.0"),
+         _policy_bytes()],
+    )
+    resp = lscc.invoke(stub)
+    assert resp.status == 200, resp.message
+    # getccdata returns the committed ChaincodeData bytes
+    stub2, _ = _lscc_stub(net, [b"getccdata", CHANNEL.encode(), b"upcc"])
+    resp = lscc.invoke(stub2)
+    assert resp.status == 200
+    got = peer_pb2.ChaincodeData()
+    got.ParseFromString(resp.payload)
+    assert (got.name, got.version) == ("upcc", "1.0")  # still the committed one
