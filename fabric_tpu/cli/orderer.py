@@ -36,8 +36,13 @@ logger = flogging.must_get_logger("orderer.main")
 
 
 def start(config_path: str, block_until_signal: bool = True) -> OrdererNode:
+    from fabric_tpu.utils.config import apply_env_overrides
+
     with open(config_path) as f:
         cfg = yaml.safe_load(f) or {}
+    # ORDERER_GENERAL_LISTENPORT=... style overrides (viper behavior,
+    # orderer/common/localconfig)
+    apply_env_overrides(cfg, "ORDERER")
     general = cfg.get("General") or {}
     signer = None
     if general.get("LocalMSPDir"):

@@ -53,8 +53,13 @@ logger = flogging.must_get_logger("peer.main")
 
 
 def _load_node(config_path: str) -> PeerNode:
+    from fabric_tpu.utils.config import apply_env_overrides
+
     with open(config_path) as f:
         cfg = yaml.safe_load(f) or {}
+    # CORE_PEER_LISTENADDRESS=... style overrides (viper behavior,
+    # core/peer/config.go)
+    apply_env_overrides(cfg, "CORE")
     pc = cfg.get("peer") or {}
     msps = [
         load_msp(path, msp_id)

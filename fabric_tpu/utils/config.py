@@ -1,0 +1,65 @@
+"""Node-config environment overrides (reference viper behavior: the
+sampleconfig YAMLs are overridable with CORE_* / ORDERER_* variables,
+core/peer/config.go + orderer/common/localconfig — e.g.
+CORE_PEER_LISTENADDRESS=0.0.0.0:7051 overrides peer.listenAddress).
+
+Mapping rule (viper's EnvKeyReplacer): strip the prefix, split on "_",
+walk the config tree matching segments case-insensitively against
+existing keys.  Only EXISTING scalar leaves are overridden — unknown
+paths are ignored (viper would create them, but silently materializing
+typo'd keys into live config is the part of viper nobody wants).
+Values parse as YAML scalars so booleans/ints come through typed.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+import yaml
+
+
+def apply_env_overrides(
+    cfg: Dict, prefix: str, env: Optional[Dict[str, str]] = None
+) -> Dict:
+    """Mutates and returns ``cfg`` with ``<prefix>_SECTION_KEY=value``
+    overrides applied (case-insensitive key matching, nested via '_')."""
+    env = os.environ if env is None else env
+    want = prefix.upper() + "_"
+    for name, value in env.items():
+        if not name.upper().startswith(want):
+            continue
+        segments = name[len(want):].split("_")
+        if not segments:
+            continue
+        _apply_one(cfg, segments, value)
+    return cfg
+
+
+def _apply_one(node: Dict, segments, value: str) -> None:
+    # keys themselves may contain no underscores in our YAMLs, so each
+    # env segment matches exactly one key level; a segment that matches
+    # nothing aborts the override (unknown path)
+    for i, seg in enumerate(segments):
+        if not isinstance(node, dict):
+            return
+        key = _match_key(node, seg)
+        if key is None:
+            return
+        if i == len(segments) - 1:
+            if isinstance(node[key], dict):
+                return  # refuse to replace a whole section with a scalar
+            try:
+                node[key] = yaml.safe_load(value)
+            except yaml.YAMLError:
+                node[key] = value
+            return
+        node = node[key]
+
+
+def _match_key(node: Dict, segment: str) -> Optional[str]:
+    seg = segment.lower()
+    for key in node:
+        if isinstance(key, str) and key.lower() == seg:
+            return key
+    return None

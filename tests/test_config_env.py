@@ -1,0 +1,54 @@
+"""CORE_*/ORDERER_* env overrides over node YAML config (reference viper
+behavior, core/peer/config.go + orderer/common/localconfig)."""
+
+from fabric_tpu.utils.config import apply_env_overrides
+
+
+def _cfg():
+    return {
+        "peer": {
+            "listenAddress": "127.0.0.1:7051",
+            "localMspId": "Org1MSP",
+            "gossip": {"bootstrap": "a:1"},
+        },
+        "ledger": {"deviceMVCC": False},
+    }
+
+
+def test_scalar_override_case_insensitive():
+    cfg = apply_env_overrides(
+        _cfg(), "CORE", {"CORE_PEER_LISTENADDRESS": "0.0.0.0:9999"}
+    )
+    assert cfg["peer"]["listenAddress"] == "0.0.0.0:9999"
+
+
+def test_nested_and_typed_values():
+    cfg = apply_env_overrides(
+        _cfg(),
+        "CORE",
+        {
+            "CORE_PEER_GOSSIP_BOOTSTRAP": "b:2",
+            "CORE_LEDGER_DEVICEMVCC": "true",
+        },
+    )
+    assert cfg["peer"]["gossip"]["bootstrap"] == "b:2"
+    assert cfg["ledger"]["deviceMVCC"] is True  # YAML-typed
+
+
+def test_unknown_paths_and_foreign_prefixes_ignored():
+    cfg = apply_env_overrides(
+        _cfg(),
+        "CORE",
+        {
+            "CORE_PEER_NOSUCHKEY": "x",
+            "CORE_NOPE_LISTENADDRESS": "y",
+            "ORDERER_GENERAL_LISTENPORT": "7050",
+            "PATH": "/usr/bin",
+        },
+    )
+    assert cfg == _cfg()  # untouched
+
+
+def test_section_cannot_be_replaced_by_scalar():
+    cfg = apply_env_overrides(_cfg(), "CORE", {"CORE_PEER_GOSSIP": "zap"})
+    assert cfg["peer"]["gossip"] == {"bootstrap": "a:1"}
