@@ -164,6 +164,11 @@ class KVChaincode:
     )
     (tmp / "core.yaml").write_text(
         f"""
+# these tests exercise CLI/node WIRING, not kernels (the device path is
+# covered end-to-end by test_scale_e2e): the SW provider keeps commits
+# instant instead of paying the fresh-process device-program load
+BCCSP:
+  Default: SW
 peer:
   listenAddress: 127.0.0.1:0
   localMspId: Org1MSP
