@@ -103,7 +103,7 @@ def bench_headline(n, iters):
     # hides behind device compute of the neighbours
     from collections import deque
 
-    in_flight = int(os.environ.get("BENCH_DEPTH", "3")) - 1
+    in_flight = max(int(os.environ.get("BENCH_DEPTH", "3")) - 1, 0)
 
     def timed_pass() -> float:
         start = time.perf_counter()

@@ -48,6 +48,13 @@ class GossipNode:
         pvt_verify_member_sig=None,  # (identity, data, sig) -> bool
         pvt_requester_eligible=None,  # (ns, coll, identity) -> bool
         pvt_sign_request=None,  # (data) -> sig, for our reconcile pulls
+        # signed membership (reference SignedGossipMessage): we sign our
+        # alive messages with sign_message; with require_signed_alive the
+        # server drops alives whose signature does not verify against the
+        # certstore identity for the claimed pki_id (forged liveness /
+        # endpoint / ledger-height claims)
+        sign_message=None,  # (data) -> sig
+        require_signed_alive: bool = False,
     ):
         from fabric_tpu.gossip.pull import CertStore, PullMediator
         from fabric_tpu.gossip.pvtdata import PvtDataHandler
@@ -78,6 +85,9 @@ class GossipNode:
             if transient_store is not None
             else None
         )
+        self._sign_message = sign_message
+        self._require_signed_alive = require_signed_alive
+        self._verify_member_sig = pvt_verify_member_sig
         self._endpoints: Dict[str, str] = {}  # peer id -> endpoint
         self._conns: Dict[str, object] = {}  # endpoint -> grpc channel
         self._lock = threading.Lock()
@@ -118,8 +128,8 @@ class GossipNode:
             pid = alive.membership.pki_id.decode()
             if pid == self.self_id:
                 return None
-            with self._lock:
-                self._endpoints[pid] = alive.membership.endpoint
+            if not self._alive_signature_ok(alive):
+                return None
             advanced = self.membership.handle_alive(
                 {
                     "id": pid,
@@ -129,14 +139,28 @@ class GossipNode:
                 }
             )
             if advanced:
+                # endpoint map follows only FRESH alives — a replayed old
+                # (validly signed) alive must not roll the endpoint back
+                with self._lock:
+                    self._endpoints[pid] = alive.membership.endpoint
                 # push-forward fresh alive messages so the view spreads
                 # transitively (gossip_impl.go forwards messages that
-                # advanced the local view); seq dedup stops loops
+                # advanced the local view); seq dedup stops loops.  The
+                # originator's identity rides along so strict-mode third
+                # parties can verify the forwarded signature.
+                fwd = [msg]
+                origin_identity = self.certstore.get(bytes(alive.membership.pki_id))
+                if origin_identity:
+                    intro = gossip_pb2.GossipMessage()
+                    intro.channel = self.channel_id
+                    intro.peer_identity.pki_id = alive.membership.pki_id
+                    intro.peer_identity.cert = origin_identity
+                    fwd = [intro, msg]
                 for endpoint in self._peer_endpoints():
                     if endpoint != alive.membership.endpoint:
                         threading.Thread(
                             target=self._send,
-                            args=(endpoint, [msg]),
+                            args=(endpoint, fwd),
                             daemon=True,
                         ).start()
         elif kind == "data_msg":
@@ -195,6 +219,27 @@ class GossipNode:
         except Exception:
             pass
 
+    def _alive_signature_ok(self, alive) -> bool:
+        """Membership authentication (reference aliveMsgStore validation):
+        verify the signature over the alive content against the certstore
+        identity for the claimed pki_id.  Unsigned alives pass only in
+        permissive mode (unit-test topologies without signers); a PRESENT
+        signature is always checked when a verifier is configured."""
+        if not alive.signature:
+            return not self._require_signed_alive
+        if self._verify_member_sig is None:
+            return True  # no verifier configured: nothing to check against
+        identity = self.certstore.get(bytes(alive.membership.pki_id))
+        if identity is None:
+            # identity not yet learned (certstore anti-entropy catches up);
+            # strict mode refuses rather than trusting the claim
+            return not self._require_signed_alive
+        return self._verify_member_sig(
+            identity,
+            _alive_signing_bytes(alive, self.channel_id),
+            bytes(alive.signature),
+        )
+
     # -- push side --------------------------------------------------------
     def _alive_message(self) -> gossip_pb2.GossipMessage:
         tick = self.membership.tick()
@@ -205,6 +250,10 @@ class GossipNode:
         msg.alive_msg.membership.pki_id = self.self_id.encode()
         msg.alive_msg.membership.ledger_height = self._height()
         msg.alive_msg.seq_num = tick["seq"]
+        if self._sign_message is not None:
+            msg.alive_msg.signature = self._sign_message(
+                _alive_signing_bytes(msg.alive_msg, self.channel_id)
+            )
         return msg
 
     def _conn(self, endpoint: str):
@@ -292,9 +341,9 @@ class GossipNode:
         import random as _random
 
         self._tick_count += 1
-        alive = self._alive_message()
+        batch = self._intro_messages()
         for endpoint in self._peer_endpoints():
-            self._send(endpoint, [alive])
+            self._send(endpoint, batch)
         # anti-entropy: ask ONE taller peer for the missing range
         rng = self.state.missing_range(self._peer_heights())
         if rng is not None:
@@ -357,10 +406,30 @@ class GossipNode:
                     out.append(self._endpoints[pid])
         return out
 
+    def _intro_messages(self) -> List[gossip_pb2.GossipMessage]:
+        """Identity + alive, in that order: with signed membership the
+        receiver must know our certstore identity BEFORE the alive or the
+        strict gate drops it (the reference disseminates identities with
+        connection establishment; this is the push-stream equivalent,
+        avoiding the learn-endpoint-needs-alive bootstrap deadlock)."""
+        batch: List[gossip_pb2.GossipMessage] = []
+        identity = self.certstore.get(self.self_id.encode())
+        if identity and self._tick_count % self.PULL_EVERY in (0, 1):
+            # identity rides along on bootstrap and then periodically —
+            # resending a ~1KB cert to every peer 5x/s would make every
+            # receiver re-run cert-chain validation for nothing
+            intro = gossip_pb2.GossipMessage()
+            intro.channel = self.channel_id
+            intro.peer_identity.pki_id = self.self_id.encode()
+            intro.peer_identity.cert = identity
+            batch.append(intro)
+        batch.append(self._alive_message())
+        return batch
+
     # -- lifecycle --------------------------------------------------------
     def connect(self, endpoint: str) -> None:
         """Bootstrap: introduce ourselves to an anchor peer."""
-        self._send(endpoint, [self._alive_message()])
+        self._send(endpoint, self._intro_messages())
 
     def start(self) -> str:
         addr = self.server.start()
@@ -395,3 +464,16 @@ class GossipNode:
     @property
     def is_leader(self) -> bool:
         return self.election.is_leader
+
+
+def _alive_signing_bytes(alive, channel_id: str) -> bytes:
+    """Deterministic alive content for sign/verify: CHANNEL + (membership,
+    seq_num, inc_num) with the signature field excluded.  Binding the
+    channel stops cross-channel replay of a validly signed alive (each
+    channel has its own GossipNode with independent seq counters and
+    ledger heights)."""
+    bare = gossip_pb2.AliveMessage()
+    bare.membership.CopyFrom(alive.membership)
+    bare.seq_num = alive.seq_num
+    bare.inc_num = alive.inc_num
+    return channel_id.encode() + b"\x00" + bare.SerializeToString()

@@ -40,6 +40,16 @@ class CertStore:
         if self._verify is not None and not self._verify(pki_id, identity):
             return False
         with self._lock:
+            existing = self._store.get(pki_id)
+            if existing is not None and existing != identity:
+                # FIRST BIND WINS: a pki_id, once bound, cannot be
+                # re-bound to a different identity — otherwise any valid
+                # same-MSP member could swap a victim's binding and then
+                # sign "the victim's" membership messages with its own
+                # key (the reference avoids this by deriving pki_id from
+                # the cert itself). Rotation requires a restart/expiry,
+                # the trade the reference's certstore also makes.
+                return False
             self._store[pki_id] = identity
         return True
 

@@ -623,6 +623,8 @@ class PeerNode:
             pvt_verify_member_sig=verify_member_sig,
             pvt_requester_eligible=requester_eligible,
             pvt_sign_request=self.signer.sign,
+            sign_message=self.signer.sign,
+            require_signed_alive=True,
         )
         # reconciler loop (reconcile.go:104-126): patch missing pvt data
         # recorded at commit from peers, hash-checked on arrival

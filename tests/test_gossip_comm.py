@@ -355,3 +355,99 @@ def test_pvt_dissemination_and_reconciliation():
     finally:
         n1.stop()
         n2.stop()
+
+
+def test_signed_alive_membership(tmp_path):
+    """Signed membership (reference SignedGossipMessage): in strict mode a
+    node adopts alives only when the signature verifies against the
+    certstore identity for the claimed pki_id; forged and unsigned alives
+    are dropped."""
+    from fabric_tpu.crypto.bccsp import SoftwareProvider
+    from fabric_tpu.gossip.comm import GossipNode, _alive_signing_bytes
+    from fabric_tpu.gossip.state import StateProvider
+    from fabric_tpu.msp.cryptogen import generate_org
+    from fabric_tpu.msp.identity import MSPManager
+    from fabric_tpu.msp.signer import SigningIdentity
+    from fabric_tpu.protos import gossip_pb2
+
+    provider = SoftwareProvider()
+    org = generate_org("org1.signedalive", "Org1MSP")
+    mgr = MSPManager([org.msp(provider=provider)])
+    honest = SigningIdentity(org.peers[0], provider)
+    rogue = SigningIdentity(org.users[0], provider)
+
+    def verify_member_sig(identity, data, sig):
+        try:
+            ident, msp = mgr.deserialize_identity(identity)
+            msp.validate(ident)
+            ident.verify(data, sig)
+            return True
+        except Exception:  # noqa: BLE001
+            return False
+
+    node = GossipNode(
+        "Org1MSP:server",
+        "alivechan",
+        StateProvider("alivechan", lambda b: None, lambda: 1),
+        lambda n: None,
+        lambda: 1,
+        identity_bytes=honest.serialize(),
+        pvt_verify_member_sig=verify_member_sig,
+        sign_message=honest.sign,
+        require_signed_alive=True,
+    )
+    # the server knows the honest member's identity (certstore)
+    node.certstore.put(b"Org1MSP:peerA", honest.serialize())
+
+    def alive(pki, endpoint, seq, signer=None, tamper=False):
+        msg = gossip_pb2.GossipMessage()
+        msg.channel = "alivechan"
+        msg.alive_msg.membership.pki_id = pki
+        msg.alive_msg.membership.endpoint = endpoint
+        msg.alive_msg.membership.ledger_height = 5
+        msg.alive_msg.seq_num = seq
+        if signer is not None:
+            msg.alive_msg.signature = signer.sign(
+                _alive_signing_bytes(msg.alive_msg, "alivechan")
+            )
+        if tamper:
+            msg.alive_msg.membership.endpoint = "evil:1"
+        return msg
+
+    members = lambda: set(node.membership.alive_peers())  # noqa: E731
+
+    node._handle(alive(b"Org1MSP:peerA", "good:1", 1, signer=honest))
+    assert "Org1MSP:peerA" in members()
+    # unsigned alive dropped in strict mode
+    node._handle(alive(b"Org1MSP:peerB", "b:1", 1))
+    assert "Org1MSP:peerB" not in members()
+    # signature by the WRONG identity (rogue signs, claims peerA) dropped
+    node._handle(alive(b"Org1MSP:peerA", "hijack:1", 2, signer=rogue))
+    assert node._endpoints.get("Org1MSP:peerA") == "good:1"
+    # tampered-after-signing endpoint dropped
+    node._handle(alive(b"Org1MSP:peerA", "good:1", 3, signer=honest, tamper=True))
+    assert node._endpoints.get("Org1MSP:peerA") == "good:1"
+    # unknown pki_id (no certstore identity) refused in strict mode
+    node._handle(alive(b"Org1MSP:ghost", "g:1", 1, signer=honest))
+    assert "Org1MSP:ghost" not in members()
+    # an alive validly signed for ANOTHER channel does not verify here
+    # (the channel id is bound into the signed bytes)
+    cross = gossip_pb2.GossipMessage()
+    cross.channel = "alivechan"
+    cross.alive_msg.membership.pki_id = b"Org1MSP:peerA"
+    cross.alive_msg.membership.endpoint = "cross:1"
+    cross.alive_msg.seq_num = 9
+    cross.alive_msg.signature = honest.sign(
+        _alive_signing_bytes(cross.alive_msg, "otherchan")
+    )
+    node._handle(cross)
+    assert node._endpoints.get("Org1MSP:peerA") == "good:1"
+    # a replayed OLD signed alive cannot roll the endpoint back
+    node._handle(alive(b"Org1MSP:peerA", "moved:1", 10, signer=honest))
+    assert node._endpoints.get("Org1MSP:peerA") == "moved:1"
+    node._handle(alive(b"Org1MSP:peerA", "good:1", 3, signer=honest))
+    assert node._endpoints.get("Org1MSP:peerA") == "moved:1"
+    # certstore bindings are first-bind-wins: the same-MSP rogue cannot
+    # re-bind peerA's pki_id to its own cert
+    assert node.certstore.put(b"Org1MSP:peerA", rogue.serialize()) is False
+    node.server.stop()
