@@ -283,18 +283,43 @@ def bench_idemix(n_sigs=8):
         )
         return (time.perf_counter() - start) * 1000.0, out
 
-    # the host oracle pairing is seconds/sig — time it over a 2-sig
-    # sample so the whole config fits the bench budget; device/host
-    # verdict parity over full batches is pinned by the kernel's
-    # differential tests (tests/test_pairing_kernel.py)
+    # the host column is the PURE-HOST oracle (scheme.verify_signature —
+    # the reference's signature.go Ver path, no device anywhere), timed
+    # over a 2-sig sample so the config fits the bench budget; the
+    # batch path's `device_pairing=False` mode still runs its MSM on the
+    # device, which would time the TUNNEL, not the CPU.  One warm-up
+    # verify first amortizes one-time table builds (the device column
+    # gets the same warm-up below); full-batch device/host verdict
+    # parity is pinned by tests/test_pairing_kernel.py.
+    from fabric_tpu.idemix.scheme import verify_signature
+
+    def host_verify(count):
+        start = time.perf_counter()
+        outs = []
+        for i in range(count):
+            try:
+                verify_signature(
+                    sigs[i], disclosure, ik.ipk, msg,
+                    values[i], rh_index, None, 0,
+                )
+                outs.append(True)
+            except Exception:  # noqa: BLE001 - invalid signature
+                outs.append(False)
+        return (time.perf_counter() - start) * 1000.0, outs
+
     n_host = min(2, n_sigs)
-    host_ms, host_out = run(False, n_host)
+    host_verify(1)  # warm-up (one-time table builds)
+    host_ms, host_out = host_verify(n_host)
     if not all(host_out):
         raise RuntimeError("config #3 host verification failed")
     result = {
         "sigs": n_sigs,
         "host_ms_per_sig": round(host_ms / n_host, 1),
         "host_sample_sigs": n_host,
+        "note": "host column is the PURE-host oracle "
+        "(scheme.verify_signature); earlier rounds' 7-52 s/sig 'host' "
+        "figures timed the batch path's device-MSM hybrid through the "
+        "tunnel and measured network weather, not CPU",
     }
     # The device Ate2 kernel's first compile is ~3.5 min on the TPU
     # (then cached; this bench's issuer key is seed-fixed so the program

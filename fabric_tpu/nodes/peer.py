@@ -666,15 +666,17 @@ class PeerNode:
         channel_id: str,
         orderer_addr: str,
         should_run: Optional[Callable[[], bool]] = None,
-        pipelined: bool = False,
+        pipelined: bool = True,
     ) -> threading.Thread:
         """Pull blocks from the orderer and feed the commit pipeline
         (blocksprovider.DeliverBlocks). Reconnects with backoff until
         stop() — each reconnect re-seeks from the current height.
         ``should_run`` gates the loop (gossip leadership: a demoted
         leader must stop pulling, reference deliveryclient leadership
-        yield). ``pipelined`` overlaps block N's parse + device sig
-        batch with block N-1's commit (SURVEY §2.13 P4)."""
+        yield). ``pipelined`` (DEFAULT-ON, SURVEY §2.13 P4) overlaps
+        block N's parse + device sig batch with block N-1's commit —
+        sustained multi-block streams hide the host parse under device
+        time; pass False for strictly sequential commits."""
 
         def run():
             backoff = 0.05
