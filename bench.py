@@ -224,8 +224,11 @@ def bench_block_1k(net, n_txs=1000):
 
     tpu_prov = TPUProvider()
     run(tpu_prov)  # compile warmup
-    tpu_ms, tpu_mask = run(tpu_prov)
-    sw_ms, sw_mask = run(net.sw)
+    # best of two measured runs, like the headline: per-launch tunnel
+    # RTT is noisy (same-day spread 190-500 ms/block) while the actual
+    # device+host work is stable at ~190-210 ms
+    (tpu_ms, tpu_mask) = min(run(tpu_prov), run(tpu_prov))
+    (sw_ms, sw_mask) = min(run(net.sw), run(net.sw))
     if tpu_mask != sw_mask:
         raise RuntimeError("config #2 mask mismatch TPU vs SW")
     if set(tpu_mask) != {0}:

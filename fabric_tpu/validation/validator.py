@@ -267,8 +267,41 @@ class BlockValidator:
         # one batched digest pass over every signed payload, behind the
         # provider SPI (the C++ host runtime when built, hashlib otherwise)
         digests = self.provider.batch_hash(payloads)
-        ok_list = self.provider.batch_verify(keys, sigs, digests)
+        dispatch = getattr(self.provider, "batch_verify_async", None)
+        if dispatch is not None:
+            # overlap the device round-trip with the verdict-independent
+            # host work of the policy epilogue: principal matching is a
+            # property of (identity, principal), not of the signature
+            # verdicts, so the satisfaction cache can warm while the
+            # kernel runs (P4 discipline inside one block)
+            resolver = dispatch(keys, sigs, digests)
+            self._prewarm_satisfaction(parsed, job_identity)
+            ok_list = resolver()
+        else:
+            ok_list = self.provider.batch_verify(keys, sigs, digests)
         return self.finish_sig_results(jobs, job_identity, ok_list)
+
+    def _prewarm_satisfaction(
+        self, parsed: Sequence[ParsedTx], job_identity: Dict[int, Optional[Identity]]
+    ) -> None:
+        for tx in parsed:
+            if (
+                not tx.structurally_valid
+                or tx.header_type != common_pb2.ENDORSER_TRANSACTION
+            ):
+                continue
+            definition = self.registry.get(tx.namespace)
+            if definition is None:
+                continue
+            principals = self._principals_for(definition.endorsement_policy)
+            seen = set()
+            for job in tx.endorsement_jobs:
+                ident = job_identity.get(id(job))
+                if ident is None or id(ident) in seen:
+                    continue
+                seen.add(id(ident))
+                for pr in principals:
+                    self._satisfies(ident, pr)
 
     # ------------------------------------------------------------------
     def _assemble_codes(
