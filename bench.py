@@ -118,9 +118,10 @@ def bench_headline(n, iters):
                 raise RuntimeError("verification failed mid-bench")
         return n * iters / (time.perf_counter() - start)
 
-    # best of two passes: the device rate is stable but the tunnel's RTT
-    # is not — a transient stall mid-pass would misreport the kernel
-    device_rate = max(timed_pass(), timed_pass())
+    # best of three passes (~2.5s each): the device rate is stable but
+    # the tunnel's RTT is not — transient stalls mid-pass would
+    # misreport the kernel (same-day spread without this: 43-90k)
+    device_rate = max(timed_pass() for _ in range(3))
     cpu_rate = bench_cpu_baseline(triples)
     return device_rate, cpu_rate
 
@@ -512,14 +513,14 @@ def bench_batcher(net, n_channels=4, txs_per_channel=128):
 
     tpu = TPUProvider()
     run(tpu)  # compile warmup (per-channel bucket)
-    direct_ms = run(tpu)
+    direct_ms = min(run(tpu), run(tpu))  # tunnel-stall robustness
     shared = BatchingProvider(tpu)
     try:
         run(shared)  # compile warmup (coalesced bucket)
         launches0, lanes0 = shared.batcher.launches, shared.batcher.lanes
-        batched_ms = run(shared)
-        launches = shared.batcher.launches - launches0
-        lanes = shared.batcher.lanes - lanes0
+        batched_ms = min(run(shared), run(shared))
+        launches = (shared.batcher.launches - launches0) // 2
+        lanes = (shared.batcher.lanes - lanes0) // 2
     finally:
         shared.stop()
     total = n_channels * txs_per_channel
@@ -532,6 +533,12 @@ def bench_batcher(net, n_channels=4, txs_per_channel=128):
         "lanes_per_launch": round(lanes / max(launches, 1), 1),
         "batched_tx_per_s": round(total / (batched_ms / 1000.0), 1),
         "speedup": round(direct_ms / batched_ms, 2),
+        "note": "transport-regime dependent: coalescing wins when "
+        "launches are compute-bound (attached chip / low RTT; measured "
+        "1.1x) and loses to independent concurrent RPCs when per-launch "
+        "tunnel RTT dominates (measured 0.45-0.87 on stall-y days) — "
+        "the batcher's standing value is the bounded-queue backpressure "
+        "discipline (SURVEY P7)",
     }
 
 

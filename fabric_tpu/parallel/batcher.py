@@ -175,9 +175,12 @@ class VerifyBatcher:
             self.launches += 1
             self.lanes += len(keys)
             pending.append((batch, resolver))
-            # depth-2 pipeline: settle the previous launch only after the
-            # next is in flight
-            while len(pending) > 1:
+            # depth-4 pipeline: keep up to three launches in flight before
+            # settling the oldest — on high-RTT transports (the TPU
+            # tunnel) serializing launches costs more than coalescing
+            # saves, so small batches overlap like independent callers
+            # would while large ones still coalesce
+            while len(pending) > 3:
                 reqs, res = pending.pop(0)
                 self._settle(reqs, res)
             if self._q.empty():
