@@ -272,6 +272,13 @@ def channel_cmd(args) -> int:
         )
         print("channel joined")
         return 0
+    if args.cmd == "joinbysnapshot":
+        resp = _scc_invoke(
+            args.peerAddress, signer, "", "cscc",
+            [b"JoinChainBySnapshot", args.snapshotpath.encode()],
+        )
+        print(f"channel {resp.payload.decode()} joined from snapshot")
+        return 0
     if args.cmd == "list":
         resp = _scc_invoke(
             args.peerAddress, signer, "", "cscc", [b"GetChannels"]
@@ -577,6 +584,8 @@ def main(argv=None) -> int:
     chan_sub = chan.add_subparsers(dest="cmd", required=True)
     cj = chan_sub.add_parser("join")
     cj.add_argument("-b", "--blockpath", required=True)
+    cjs = chan_sub.add_parser("joinbysnapshot")
+    cjs.add_argument("--snapshotpath", required=True)
     cl = chan_sub.add_parser("list")
     ccr = chan_sub.add_parser("create")
     ccr.add_argument("-o", "--orderer", required=True)
@@ -588,11 +597,11 @@ def main(argv=None) -> int:
     cf.add_argument("output")
     cf.add_argument("-o", "--orderer", default="")
     cf.add_argument("-c", "--channelID", required=True)
-    for p in (cj, cl):
+    for p in (cj, cjs, cl):
         p.add_argument("--peerAddress", required=True)
     for p in (ccr, cf):
         p.add_argument("--peerAddress", default="")
-    for p in (cj, cl, ccr, cf):
+    for p in (cj, cjs, cl, ccr, cf):
         p.add_argument("--mspDir", required=True)
         p.add_argument("--mspID", required=True)
 

@@ -120,6 +120,7 @@ class PeerNode:
                 join_chain=self.join_channel,
                 channel_list=lambda: sorted(self.channels),
                 get_config_block=self._config_block,
+                join_by_snapshot=self.join_channel_by_snapshot,
             ),
             system=True,
         )
@@ -504,6 +505,42 @@ class PeerNode:
             ch.ledger, os.path.join(self.work_dir, "snapshots")
         )
         return ch
+
+    def join_channel_by_snapshot(self, snap_dir: str) -> str:
+        """cscc JoinChainBySnapshot (reference core/peer
+        CreateChannelFromSnapshot): build the channel's ledger from an
+        exported snapshot (ledger/snapshot.py create_from_snapshot), then
+        wire the Channel around it.  The ledger starts at the snapshot
+        height with no block prefix; deliver loops resume from there."""
+        from fabric_tpu.ledger.snapshot import SnapshotRequestManager, verify_snapshot
+
+        meta = verify_snapshot(snap_dir)
+        channel_id = meta["channel_name"]
+        if channel_id in self.channels:
+            raise ValueError(f"channel {channel_id} already joined")
+        ledger_dir = os.path.join(self.work_dir, channel_id)
+        from fabric_tpu.ledger.snapshot import create_from_snapshot
+
+        # build the persistent stores, then let the Channel reopen them
+        create_from_snapshot(snap_dir, ledger_dir).close()
+        ch = Channel(
+            channel_id,
+            ledger_dir,
+            self.msp_manager,
+            self._registry_factory(channel_id),
+            self.provider,
+            transient_store=self.transient,
+            metrics=self.committer_metrics,
+            device_mvcc=self.device_mvcc,
+            writeset_check=lambda rwset, ns, cid=channel_id: (
+                self._legacy_writeset_check(cid, rwset, ns)
+            ),
+        )
+        self.channels[channel_id] = ch
+        self.snapshot_managers[channel_id] = SnapshotRequestManager(
+            ch.ledger, os.path.join(self.work_dir, "snapshots")
+        )
+        return channel_id
 
     def commit_block(self, channel_id: str, block: common_pb2.Block):
         ch = self.channels[channel_id]

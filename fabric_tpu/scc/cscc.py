@@ -2,9 +2,12 @@
 configure.go).
 
 Functions: JoinChain (bootstrap a channel from its genesis block),
-GetChannels (ChannelQueryResponse), GetConfigBlock (latest config block
-bytes), JoinBySnapshot status stubs. The peer node wires `join_chain` to
-its channel-creation routine (core/peer createChannel).
+JoinChainBySnapshot (build the channel from an exported ledger snapshot,
+configure.go joinChainBySnapshot), GetChannels (ChannelQueryResponse),
+GetConfigBlock (latest config block bytes), GetChannelConfig (the
+current channel Config proto). The peer node wires `join_chain` /
+`join_by_snapshot` to its channel-creation routines (core/peer
+createChannel / CreateChannelFromSnapshot).
 """
 
 from __future__ import annotations
@@ -15,8 +18,10 @@ from fabric_tpu.chaincode.shim import ChaincodeStub, Response, error_response, s
 from fabric_tpu.protos import common_pb2, peer_pb2, protoutil
 
 JOIN_CHAIN = "JoinChain"
+JOIN_CHAIN_BY_SNAPSHOT = "JoinChainBySnapshot"
 GET_CHANNELS = "GetChannels"
 GET_CONFIG_BLOCK = "GetConfigBlock"
+GET_CHANNEL_CONFIG = "GetChannelConfig"
 
 
 class CSCC:
@@ -25,10 +30,12 @@ class CSCC:
         join_chain: Callable[[common_pb2.Block], None],
         channel_list: Callable[[], List[str]],
         get_config_block: Callable[[str], Optional[common_pb2.Block]],
+        join_by_snapshot: Optional[Callable[[str], str]] = None,
     ):
         self._join_chain = join_chain
         self._channel_list = channel_list
         self._get_config_block = get_config_block
+        self._join_by_snapshot = join_by_snapshot
 
     def init(self, stub: ChaincodeStub) -> Response:
         return success()
@@ -61,4 +68,41 @@ class CSCC:
                     f"Unknown chain ID, {args[1].decode()}"
                 )
             return success(block.SerializeToString())
+        if fname == GET_CHANNEL_CONFIG:
+            # the current channel Config proto (configure.go
+            # getChannelConfig), extracted from the latest config block
+            if len(args) < 2:
+                return error_response("missing channel ID")
+            block = self._get_config_block(args[1].decode())
+            if block is None:
+                return error_response(
+                    f"Unknown chain ID, {args[1].decode()}"
+                )
+            try:
+                from fabric_tpu.protos import configtx_pb2
+
+                env = protoutil.get_envelope_from_block_data(
+                    block.data.data[0]
+                )
+                payload = protoutil.unmarshal(common_pb2.Payload, env.payload)
+                cenv = protoutil.unmarshal(
+                    configtx_pb2.ConfigEnvelope, payload.data
+                )
+                return success(cenv.config.SerializeToString())
+            except Exception as e:  # noqa: BLE001 - malformed config block
+                return error_response(f"failed to extract config: {e}")
+        if fname == JOIN_CHAIN_BY_SNAPSHOT:
+            if self._join_by_snapshot is None:
+                return error_response(
+                    "JoinChainBySnapshot is not enabled on this peer"
+                )
+            if len(args) < 2 or not args[1]:
+                return error_response("missing snapshot directory")
+            try:
+                channel_id = self._join_by_snapshot(args[1].decode())
+            except Exception as e:  # noqa: BLE001 - report join failure
+                return error_response(
+                    f'"JoinChainBySnapshot" request failed: {e}'
+                )
+            return success(channel_id.encode())
         return error_response(f"Requested function {fname} not found.")
