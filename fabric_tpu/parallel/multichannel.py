@@ -20,7 +20,7 @@ import numpy as np
 from fabric_tpu.crypto.tpu_provider import TPUProvider, _bucket
 from fabric_tpu.parallel.sharded import ShardedVerify, channel_stack, pad_lanes
 from fabric_tpu.protos import common_pb2
-from fabric_tpu.validation.msgvalidation import parse_transaction
+from fabric_tpu.validation.blockparse import parse_block
 from fabric_tpu.validation.txflags import ValidationFlags
 from fabric_tpu.validation.validator import BlockValidator
 
@@ -48,13 +48,10 @@ class MultiChannelValidator:
         for ch in channels:
             validator = self.validators[ch]
             block = blocks[ch]
-            parsed = [
-                parse_transaction(i, d) for i, d in enumerate(block.data.data)
-            ]
-            jobs, job_identity, keys, sigs, payloads = (
+            parsed = parse_block(list(block.data.data))
+            jobs, job_identity, keys, sigs, digests = (
                 validator.collect_sig_jobs(parsed)
             )
-            digests = validator.provider.batch_hash(payloads)
             limbs = self._prep.prep_limbs(keys, sigs, digests)
             per_channel[ch] = (validator, block, parsed, jobs, job_identity, limbs)
             lanes = max(lanes, limbs[-1].shape[0])

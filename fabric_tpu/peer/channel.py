@@ -19,7 +19,7 @@ from fabric_tpu.crypto.bccsp import Provider, default_provider
 from fabric_tpu.ledger.kvledger import KVLedger
 from fabric_tpu.msp.identity import MSPManager
 from fabric_tpu.protos import common_pb2, protoutil
-from fabric_tpu.validation.msgvalidation import parse_transaction
+from fabric_tpu.validation.blockparse import parse_block
 from fabric_tpu.validation.txflags import ValidationFlags
 from fabric_tpu.validation.validator import BlockValidator, ChaincodeRegistry
 
@@ -83,13 +83,10 @@ class Channel:
         MVCC/commit epilogue. Returns the opaque tuple store_block takes
         as `prepared`."""
         self._verify_block_content(block)
-        parsed = [
-            parse_transaction(i, d) for i, d in enumerate(block.data.data)
-        ]
-        jobs, job_identity, keys, sigs, payloads = (
+        parsed = parse_block(list(block.data.data))
+        jobs, job_identity, keys, sigs, digests = (
             self.validator.collect_sig_jobs(parsed)
         )
-        digests = self.provider.batch_hash(payloads)
         ok_list = self.provider.batch_verify(keys, sigs, digests)
         return parsed, jobs, job_identity, ok_list
 

@@ -49,6 +49,18 @@ def _load() -> Optional[ctypes.CDLL]:
             lib = ctypes.CDLL(_SO_PATH)
         except OSError:
             return None
+        if not hasattr(lib, "fn_block_parse"):
+            # stale build predating the block parser — rebuild once
+            try:
+                subprocess.run(
+                    ["make", "-C", os.path.dirname(_SO_PATH), "-B"],
+                    capture_output=True,
+                    timeout=120,
+                    check=True,
+                )
+                lib = ctypes.CDLL(_SO_PATH)
+            except Exception:
+                pass
         u8p = ctypes.POINTER(ctypes.c_uint8)
         u64p = ctypes.POINTER(ctypes.c_uint64)
         lib.fn_batch_sha256.argtypes = [u8p, u64p, u64p, ctypes.c_int64, u8p]
@@ -57,6 +69,35 @@ def _load() -> Optional[ctypes.CDLL]:
             u8p, u64p, u64p, ctypes.c_int64, u8p, u8p, u8p, u8p,
         ]
         lib.fn_batch_der_parse.restype = None
+        try:
+            i32p = ctypes.POINTER(ctypes.c_int32)
+            i64p = ctypes.POINTER(ctypes.c_int64)
+            lib.fn_block_parse.argtypes = [u8p, u64p, u64p, ctypes.c_int64]
+            lib.fn_block_parse.restype = ctypes.c_void_p
+            lib.fn_block_counts.argtypes = [ctypes.c_void_p, i64p]
+            lib.fn_block_counts.restype = None
+            lib.fn_block_pertx.argtypes = [
+                ctypes.c_void_p, i32p, i32p, u8p, u64p,
+            ]
+            lib.fn_block_pertx.restype = None
+            lib.fn_block_jobs.argtypes = [
+                ctypes.c_void_p, i64p, i64p, u8p, u64p, u64p, u8p,
+            ]
+            lib.fn_block_jobs.restype = None
+            lib.fn_block_uniq.argtypes = [ctypes.c_void_p, u64p]
+            lib.fn_block_uniq.restype = None
+            lib.fn_block_ns.argtypes = [ctypes.c_void_p, i64p, u8p, u64p]
+            lib.fn_block_ns.restype = None
+            lib.fn_block_wkeys.argtypes = [
+                ctypes.c_void_p, i64p, i64p, u8p, u64p, u64p,
+            ]
+            lib.fn_block_wkeys.restype = None
+            lib.fn_block_free.argtypes = [ctypes.c_void_p]
+            lib.fn_block_free.restype = None
+            lib.fn_sha256_backend.restype = ctypes.c_int
+        except AttributeError:
+            # stale .so predating the block parser: rebuild on next run
+            pass
         _lib = lib
         return _lib
 

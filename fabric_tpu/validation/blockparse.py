@@ -1,0 +1,264 @@
+"""Block-level structural parse: one native C++ pass over every
+envelope (native/blockparse.cc), falling back to the per-tx Python
+parser when the shared object is unavailable.
+
+Reference hot spots this replaces on the host path (SURVEY §3.1):
+core/common/validation/msgvalidation.go:248-330 (ValidateTransaction)
+and core/handlers/validation/builtin/v20/validation_logic.go:109-177
+(extractValidationArtifacts) — the per-tx proto unwrap that dominated
+the Python block pipeline (~55% of host ms/block measured round 4).
+
+The native pass returns columnar arrays; this module materializes the
+compatibility `ParsedTx` objects (with lazy rwsets — the native walk
+already validated rwset structure) and keeps the columnar written-keys
+table on the returned `ParsedBlock` for the state-based endorsement
+gate, so the common no-SBE block never builds a Python rwset tree at
+validation time at all.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from fabric_tpu.utils import native as _native
+from fabric_tpu.validation.msgvalidation import (
+    ParsedTx,
+    SigJob,
+    parse_transaction,
+)
+from fabric_tpu.validation.txflags import TxValidationCode
+
+
+class ParsedBlock(list):
+    """List of ParsedTx, plus the columnar written-keys table from the
+    native pass (consumed by BlockValidator._any_vp_on_written_keys
+    without materializing rwsets)."""
+
+    __slots__ = ("_buf", "_wk_tx", "_wk_ns", "_wk_hashed", "_wk_coll",
+                 "_wk_key", "_ns_tx", "_ns_str", "native")
+
+    def __init__(self, txs: Sequence[ParsedTx]):
+        super().__init__(txs)
+        self.native = False
+        self._buf = b""
+        self._wk_tx = self._wk_ns = self._wk_hashed = None
+        self._wk_coll = self._wk_key = None
+        self._ns_tx = self._ns_str = None
+
+    def iter_written_keys(self) -> Iterator[Tuple[int, str, str, object]]:
+        """(tx_index, namespace, collection, key) for every written key
+        of every structurally-valid endorser tx. Public keys are str,
+        collection-hashed keys are bytes (statebased KeyPolicyRequest)."""
+        if not self.native:
+            for tx in self:
+                rwset = tx.rwset
+                if rwset is None:
+                    continue
+                for ns_rw in rwset.ns_rw_sets:
+                    for w in ns_rw.writes:
+                        yield tx.index, ns_rw.namespace, "", w.key
+                    for coll in ns_rw.coll_hashed:
+                        for hw in coll.hashed_writes:
+                            yield (
+                                tx.index,
+                                ns_rw.namespace,
+                                coll.collection_name,
+                                hw.key_hash,
+                            )
+            return
+        buf = self._buf
+        ns_names = {}
+        for k in range(len(self._wk_tx)):
+            ns_idx = int(self._wk_ns[k])
+            name = ns_names.get(ns_idx)
+            if name is None:
+                o, l = self._ns_str[2 * ns_idx], self._ns_str[2 * ns_idx + 1]
+                name = buf[o:o + l].decode("utf-8")
+                ns_names[ns_idx] = name
+            co, cl = self._wk_coll[2 * k], self._wk_coll[2 * k + 1]
+            ko, kl = self._wk_key[2 * k], self._wk_key[2 * k + 1]
+            key_bytes = buf[ko:ko + kl]
+            if self._wk_hashed[k]:
+                yield int(self._wk_tx[k]), name, buf[co:co + cl].decode(
+                    "utf-8"
+                ), key_bytes
+            else:
+                yield int(self._wk_tx[k]), name, "", key_bytes.decode("utf-8")
+
+
+class ChainedParsedBlock(list):
+    """Concatenation of per-chunk ParsedBlocks (the chunked-pipelined
+    validator path): behaves as one flat ParsedTx list; written-key
+    iteration chains the chunks with their tx-index offsets."""
+
+    __slots__ = ("_chunks",)
+
+    def __init__(self):
+        super().__init__()
+        self._chunks: List[Tuple[int, ParsedBlock]] = []
+
+    def add_chunk(self, offset: int, chunk: ParsedBlock) -> None:
+        self._chunks.append((offset, chunk))
+        self.extend(chunk)
+
+    def iter_written_keys(self) -> Iterator[Tuple[int, str, str, object]]:
+        for off, chunk in self._chunks:
+            for i, ns, coll, key in chunk.iter_written_keys():
+                yield i + off, ns, coll, key
+
+
+def _i64(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+def _i32(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+def available() -> bool:
+    lib = _native._load()
+    return lib is not None and hasattr(lib, "fn_block_parse")
+
+
+def parse_block(datas: Sequence[bytes]) -> ParsedBlock:
+    """Parse every envelope of a block (reference: the per-goroutine
+    validateTx fan-out in v20/validator.go:180-265, collapsed into one
+    columnar host pass)."""
+    lib = _native._load()
+    if lib is None or not hasattr(lib, "fn_block_parse"):
+        return ParsedBlock([parse_transaction(i, d) for i, d in enumerate(datas)])
+
+    n = len(datas)
+    if n == 0:
+        return ParsedBlock([])
+    buf = b"".join(datas)
+    lens = np.array([len(d) for d in datas], dtype=np.uint64)
+    offs = np.zeros(n, dtype=np.uint64)
+    if n > 1:
+        offs[1:] = np.cumsum(lens[:-1])
+    blob = np.frombuffer(buf, dtype=np.uint8)
+    if blob.size == 0:
+        blob = np.zeros(1, dtype=np.uint8)
+
+    h = lib.fn_block_parse(
+        _native._u8(blob), _native._u64(offs), _native._u64(lens), n
+    )
+    try:
+        counts = np.zeros(4, dtype=np.int64)
+        lib.fn_block_counts(h, _i64(counts))
+        n_jobs, n_uniq, n_ns, n_wk = (int(x) for x in counts)
+
+        code = np.zeros(n, dtype=np.int32)
+        header_type = np.zeros(n, dtype=np.int32)
+        has_md = np.zeros(n, dtype=np.uint8)
+        strs = np.zeros(n * 12, dtype=np.uint64)
+        lib.fn_block_pertx(h, _i32(code), _i32(header_type),
+                           _native._u8(has_md), _native._u64(strs))
+
+        job_tx = np.zeros(max(n_jobs, 1), dtype=np.int64)
+        job_ident = np.zeros(max(n_jobs, 1), dtype=np.int64)
+        job_is_creator = np.zeros(max(n_jobs, 1), dtype=np.uint8)
+        job_sig = np.zeros(max(n_jobs, 1) * 2, dtype=np.uint64)
+        job_data = np.zeros(max(n_jobs, 1) * 2, dtype=np.uint64)
+        job_digest = np.zeros(max(n_jobs, 1) * 32, dtype=np.uint8)
+        if n_jobs:
+            lib.fn_block_jobs(h, _i64(job_tx), _i64(job_ident),
+                              _native._u8(job_is_creator),
+                              _native._u64(job_sig), _native._u64(job_data),
+                              _native._u8(job_digest))
+
+        uniq = np.zeros(max(n_uniq, 1) * 2, dtype=np.uint64)
+        if n_uniq:
+            lib.fn_block_uniq(h, _native._u64(uniq))
+
+        ns_tx = np.zeros(max(n_ns, 1), dtype=np.int64)
+        ns_writes = np.zeros(max(n_ns, 1), dtype=np.uint8)
+        ns_str = np.zeros(max(n_ns, 1) * 2, dtype=np.uint64)
+        if n_ns:
+            lib.fn_block_ns(h, _i64(ns_tx), _native._u8(ns_writes),
+                            _native._u64(ns_str))
+
+        wk_tx = np.zeros(max(n_wk, 1), dtype=np.int64)
+        wk_ns = np.zeros(max(n_wk, 1), dtype=np.int64)
+        wk_hashed = np.zeros(max(n_wk, 1), dtype=np.uint8)
+        wk_coll = np.zeros(max(n_wk, 1) * 2, dtype=np.uint64)
+        wk_key = np.zeros(max(n_wk, 1) * 2, dtype=np.uint64)
+        if n_wk:
+            lib.fn_block_wkeys(h, _i64(wk_tx), _i64(wk_ns),
+                               _native._u8(wk_hashed), _native._u64(wk_coll),
+                               _native._u64(wk_key))
+    finally:
+        lib.fn_block_free(h)
+
+    # unique serialized identities: ONE bytes object per distinct
+    # identity — downstream caches key on the object, so every job of
+    # the same signer shares one dict entry and one hash computation
+    uniq_bytes: List[bytes] = []
+    for u in range(n_uniq):
+        o, l = uniq[2 * u], uniq[2 * u + 1]
+        uniq_bytes.append(buf[o:o + l])
+
+    digest_blob = job_digest.tobytes()
+
+    ENDORSER = 3
+    CONFIG = 1
+    txs: List[ParsedTx] = []
+    for i in range(n):
+        tx = ParsedTx(i)
+        c = int(code[i])
+        tx.code = TxValidationCode(c) if c != 254 else TxValidationCode.NOT_VALIDATED
+        ht = int(header_type[i])
+        tx.header_type = ht
+        if ht >= 0:
+            base = i * 12
+            o, l = strs[base], strs[base + 1]
+            tx.channel_id = buf[o:o + l].decode("utf-8")
+            o, l = strs[base + 2], strs[base + 3]
+            tx.tx_id = buf[o:o + l].decode("utf-8")
+            o, l = strs[base + 4], strs[base + 5]
+            tx.creator = buf[o:o + l]
+            if ht == CONFIG:
+                o, l = strs[base + 6], strs[base + 7]
+                tx.config_data = buf[o:o + l]
+            elif ht == ENDORSER and c == 254:
+                o, l = strs[base + 8], strs[base + 9]
+                tx.namespace = buf[o:o + l].decode("utf-8")
+                o, l = strs[base + 10], strs[base + 11]
+                tx._rwset_raw = buf[o:o + l]
+                tx._has_md_writes = bool(has_md[i])
+                tx._ns_entries = []
+        txs.append(tx)
+
+    # namespace entries per tx (rwset order preserved)
+    for e in range(n_ns):
+        i = int(ns_tx[e])
+        o, l = ns_str[2 * e], ns_str[2 * e + 1]
+        txs[i]._ns_entries.append(
+            (buf[o:o + l].decode("utf-8"), bool(ns_writes[e]))
+        )
+
+    # signature jobs
+    for k in range(n_jobs):
+        i = int(job_tx[k])
+        so, sl = job_sig[2 * k], job_sig[2 * k + 1]
+        job = SigJob(
+            uniq_bytes[int(job_ident[k])],
+            buf[so:so + sl],
+            b"",
+            digest_blob[32 * k:32 * k + 32],
+        )
+        if job_is_creator[k]:
+            txs[i].creator_sig_job = job
+        else:
+            txs[i].endorsement_jobs.append(job)
+
+    out = ParsedBlock(txs)
+    out.native = True
+    out._buf = buf
+    out._wk_tx, out._wk_ns, out._wk_hashed = wk_tx[:n_wk], wk_ns, wk_hashed
+    out._wk_coll, out._wk_key = wk_coll, wk_key
+    out._ns_tx, out._ns_str = ns_tx, ns_str
+    return out

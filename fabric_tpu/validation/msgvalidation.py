@@ -24,7 +24,6 @@ UNSUPPORTED_TX_PAYLOAD.
 from __future__ import annotations
 
 import hashlib
-from dataclasses import dataclass, field
 from typing import List, Optional, Tuple
 
 from fabric_tpu.protos import common_pb2, kv_rwset_pb2, peer_pb2, protoutil, rwset_pb2
@@ -38,33 +37,122 @@ SUPPORTED_HEADER_TYPES = {
 }
 
 
-@dataclass
 class SigJob:
     """One deferred signature check: verify `signature` by the identity
-    serialized in `identity_bytes` over `data`."""
+    serialized in `identity_bytes` over `data`.
 
-    identity_bytes: bytes
-    signature: bytes
-    data: bytes
+    When the native block parser produced the job, `digest` carries the
+    precomputed SHA-256 of the signed bytes and `data` is b"" (the
+    payload is never materialized — endorsement jobs sign
+    prp_bytes||endorser, which would otherwise need a copy per job)."""
+
+    __slots__ = ("identity_bytes", "signature", "data", "digest")
+
+    def __init__(
+        self,
+        identity_bytes: bytes,
+        signature: bytes,
+        data: bytes,
+        digest: Optional[bytes] = None,
+    ):
+        self.identity_bytes = identity_bytes
+        self.signature = signature
+        self.data = data
+        self.digest = digest
 
 
-@dataclass
+def writes_to_namespace(ns_rw) -> bool:
+    """Reference dispatcher.txWritesToNamespace: public writes, metadata
+    writes, or per-collection hashed (metadata) writes."""
+    if ns_rw.writes or ns_rw.metadata_writes:
+        return True
+    for coll in ns_rw.coll_hashed:
+        if coll.hashed_writes or coll.metadata_writes:
+            return True
+    return False
+
+
 class ParsedTx:
-    """Host-parse result for one block position."""
+    """Host-parse result for one block position.
 
-    index: int
-    code: TxValidationCode = TxValidationCode.NOT_VALIDATED
-    header_type: int = -1
-    channel_id: str = ""
-    tx_id: str = ""
-    creator: bytes = b""
-    # deferred signature checks
-    creator_sig_job: Optional[SigJob] = None
-    endorsement_jobs: List[SigJob] = field(default_factory=list)
-    # endorser-tx artifacts (builtin v20 VSCC inputs)
-    namespace: str = ""
-    rwset: Optional[rw.TxRwSet] = None
-    config_data: bytes = b""
+    The rwset is materialized lazily: the native block parser has
+    already validated the rwset's structure (walk_tx_rwset in
+    native/blockparse.cc mirrors parse_tx_rwset's acceptance), so the
+    Python object tree is only built when a consumer (MVCC, commit,
+    legacy writeset checks) actually needs it."""
+
+    __slots__ = (
+        "index",
+        "code",
+        "header_type",
+        "channel_id",
+        "tx_id",
+        "creator",
+        "creator_sig_job",
+        "endorsement_jobs",
+        "namespace",
+        "config_data",
+        "_rwset",
+        "_rwset_raw",
+        "_ns_entries",
+        "_has_md_writes",
+    )
+
+    def __init__(self, index: int):
+        self.index = index
+        self.code: TxValidationCode = TxValidationCode.NOT_VALIDATED
+        self.header_type: int = -1
+        self.channel_id: str = ""
+        self.tx_id: str = ""
+        self.creator: bytes = b""
+        # deferred signature checks
+        self.creator_sig_job: Optional[SigJob] = None
+        self.endorsement_jobs: List[SigJob] = []
+        # endorser-tx artifacts (builtin v20 VSCC inputs)
+        self.namespace: str = ""
+        self.config_data: bytes = b""
+        self._rwset: Optional[rw.TxRwSet] = None
+        self._rwset_raw: Optional[bytes] = None
+        # (namespace, writes_to_namespace) per ns_rw_set, order-preserving
+        self._ns_entries: Optional[List[Tuple[str, bool]]] = None
+        self._has_md_writes: Optional[bool] = None
+
+    @property
+    def rwset(self) -> Optional[rw.TxRwSet]:
+        if self._rwset is None and self._rwset_raw is not None:
+            self._rwset = parse_tx_rwset(self._rwset_raw)
+            self._rwset_raw = None
+        return self._rwset
+
+    @rwset.setter
+    def rwset(self, value: Optional[rw.TxRwSet]) -> None:
+        self._rwset = value
+        self._rwset_raw = None
+
+    @property
+    def ns_entries(self) -> Optional[List[Tuple[str, bool]]]:
+        """[(namespace, writes_to_namespace)] in rwset order, or None
+        for non-endorser / failed txs — what _assemble_codes needs
+        without materializing the rwset object tree."""
+        if self._ns_entries is None and self.rwset is not None:
+            self._ns_entries = [
+                (ns.namespace, writes_to_namespace(ns))
+                for ns in self.rwset.ns_rw_sets
+            ]
+        return self._ns_entries
+
+    @property
+    def has_md_writes(self) -> bool:
+        """Any public or collection-hashed metadata write — the trigger
+        for the sequential SBE pass (statebased.BlockDependencies)."""
+        if self._has_md_writes is None:
+            rwset = self.rwset
+            self._has_md_writes = rwset is not None and any(
+                ns.metadata_writes
+                or any(c.metadata_writes for c in ns.coll_hashed)
+                for ns in rwset.ns_rw_sets
+            )
+        return self._has_md_writes
 
     @property
     def structurally_valid(self) -> bool:

@@ -31,7 +31,8 @@ from fabric_tpu.msp.identity import Identity, MSPError, MSPManager
 from fabric_tpu.policy.ast import SignaturePolicyEnvelope
 from fabric_tpu.policy.evaluator import compile_batched_numpy, evaluate_host
 from fabric_tpu.protos import common_pb2, msp_principal_pb2, protoutil
-from fabric_tpu.validation.msgvalidation import ParsedTx, SigJob, parse_transaction
+from fabric_tpu.validation.blockparse import ParsedBlock, parse_block
+from fabric_tpu.validation.msgvalidation import ParsedTx, SigJob
 from fabric_tpu.validation.statebased import (
     VALIDATION_PARAMETER,
     BlockDependencies,
@@ -80,15 +81,10 @@ PolicyGroups = Dict[
 ]
 
 
-def _writes_to_namespace(ns_rw) -> bool:
-    """Reference dispatcher.txWritesToNamespace: public writes, metadata
-    writes, or per-collection hashed (metadata) writes."""
-    if ns_rw.writes or ns_rw.metadata_writes:
-        return True
-    for coll in ns_rw.coll_hashed:
-        if coll.hashed_writes or coll.metadata_writes:
-            return True
-    return False
+# re-export: moved to msgvalidation so the parse layer can share it
+from fabric_tpu.validation.msgvalidation import (  # noqa: E402
+    writes_to_namespace as _writes_to_namespace,
+)
 
 
 def principal_for(ast_principal) -> msp_principal_pb2.MSPPrincipal:
@@ -152,6 +148,18 @@ class BlockValidator:
         self._principals_cache: Dict[
             SignaturePolicyEnvelope, List[msp_principal_pb2.MSPPrincipal]
         ] = {}
+        # serialized identity bytes -> validated Identity (or None when
+        # deserialization / cert-chain validation failed). The native
+        # parser interns identity bytes so every job of the same signer
+        # hits ONE entry here instead of re-walking the MSP caches
+        # (reference msp/cache/cache.go DeserializeIdentity memoization).
+        self._ident_cache: Dict[bytes, Optional[Identity]] = {}
+        # per-policy memo of circuit verdicts keyed by the tx's signer
+        # pattern (tuple of (Identity, sig_ok)); the dict holds strong
+        # refs to the Identity objects so keys can never alias.
+        self._pattern_memo: Dict[
+            SignaturePolicyEnvelope, Dict[tuple, bool]
+        ] = {}
 
     # ------------------------------------------------------------------
     def validate(
@@ -170,7 +178,7 @@ class BlockValidator:
         validator its pre-computed per-job verdicts."""
         data = list(block.data.data)
         if parsed is None:
-            parsed = [parse_transaction(i, d) for i, d in enumerate(data)]
+            parsed = parse_block(data)
 
         if sig_results is None:
             sig_results = self._batch_verify_sigs(parsed)
@@ -216,7 +224,9 @@ class BlockValidator:
         """Phase-2 host prep: every deferred signature job in the block,
         identities deserialized + cert-chain/CRL validated (reference
         identities.go:107), verifiable jobs flattened into (keys, sigs,
-        payloads) device-batch inputs."""
+        digests) device-batch inputs. Digests precomputed by the native
+        parser are used as-is; Python-parsed jobs are hashed here in one
+        provider batch."""
         jobs: List[SigJob] = []
         for tx in parsed:
             if tx.creator_sig_job is not None:
@@ -224,19 +234,35 @@ class BlockValidator:
             jobs.extend(tx.endorsement_jobs)
         keys, payloads, sigs = [], [], []
         job_identity: Dict[int, Optional[Identity]] = {}
+        ident_cache = self._ident_cache
+        if len(ident_cache) > 8192:
+            ident_cache.clear()
+        _MISS = object()
         for job in jobs:
-            ident: Optional[Identity] = None
-            try:
-                ident, msp = self.msp_manager.deserialize_identity(job.identity_bytes)
-                msp.validate(ident)  # cert chain + CRL (identities.go:107)
-            except MSPError:
-                ident = None
+            ibytes = job.identity_bytes
+            ident = ident_cache.get(ibytes, _MISS)
+            if ident is _MISS:
+                try:
+                    ident, msp = self.msp_manager.deserialize_identity(ibytes)
+                    msp.validate(ident)  # cert chain + CRL (identities.go:107)
+                except MSPError:
+                    ident = None
+                ident_cache[ibytes] = ident
             job_identity[id(job)] = ident
             if ident is None:
                 continue
             keys.append(ident.public_key)
             sigs.append(job.signature)
-            payloads.append(job.data)
+            payloads.append(job.digest if job.digest is not None else job)
+        # one batched digest pass over the payloads that still need
+        # hashing (pure-Python parse path), behind the provider SPI
+        raw_idx = [k for k, p in enumerate(payloads) if isinstance(p, SigJob)]
+        if raw_idx:
+            hashed = self.provider.batch_hash(
+                [payloads[k].data for k in raw_idx]
+            )
+            for k, d in zip(raw_idx, hashed):
+                payloads[k] = d
         return jobs, job_identity, keys, sigs, payloads
 
     def finish_sig_results(
@@ -263,10 +289,7 @@ class BlockValidator:
         Returns {id(job): bool}. Identity deserialization/validation
         failures mark the job False (the per-code mapping happens during
         assembly)."""
-        jobs, job_identity, keys, sigs, payloads = self.collect_sig_jobs(parsed)
-        # one batched digest pass over every signed payload, behind the
-        # provider SPI (the C++ host runtime when built, hashlib otherwise)
-        digests = self.provider.batch_hash(payloads)
+        jobs, job_identity, keys, sigs, digests = self.collect_sig_jobs(parsed)
         dispatch = getattr(self.provider, "batch_verify_async", None)
         if dispatch is not None:
             # overlap the device round-trip with the verdict-independent
@@ -331,6 +354,12 @@ class BlockValidator:
                 try:
                     if self.apply_config is not None:
                         self.apply_config(tx.config_data)
+                        # config change can rotate MSPs/CRLs/policies:
+                        # drop every derived cache (reference: channel
+                        # resources bundle hot-swap invalidates them)
+                        self._ident_cache.clear()
+                        self._principal_cache.clear()
+                        self._pattern_memo.clear()
                 except Exception as e:
                     raise ValidationError(
                         f"error validating config tx: {e}"
@@ -348,20 +377,20 @@ class BlockValidator:
                 continue
             # the invoked chaincode plus every namespace the tx writes to
             # is validated against ITS OWN policy (reference
-            # plugindispatcher/dispatcher.go:174-218)
+            # plugindispatcher/dispatcher.go:174-218); ns_entries avoids
+            # materializing the rwset tree on the native parse path
             wr_ns = [tx.namespace]
             illegal = False
-            if tx.rwset is not None:
+            entries = tx.ns_entries
+            if entries is not None:
                 seen_ns = set()
-                for ns_rw in tx.rwset.ns_rw_sets:
-                    if ns_rw.namespace in seen_ns:
+                for ns_name, ns_writes in entries:
+                    if ns_name in seen_ns:
                         illegal = True  # dup namespace (dispatcher.go:175-178)
                         break
-                    seen_ns.add(ns_rw.namespace)
-                    if ns_rw.namespace != tx.namespace and _writes_to_namespace(
-                        ns_rw
-                    ):
-                        wr_ns.append(ns_rw.namespace)
+                    seen_ns.add(ns_name)
+                    if ns_name != tx.namespace and ns_writes:
+                        wr_ns.append(ns_name)
             if illegal:
                 flags.set_flag(i, TxValidationCode.ILLEGAL_WRITESET)
                 continue
@@ -414,8 +443,13 @@ class BlockValidator:
         device path; blocks touching state-based endorsement fall back
         to the exact sequential key-level pass (reference
         validator_keylevel.go semantics)."""
-        deps = BlockDependencies([tx.rwset for tx in parsed])
-        if deps.has_writers() or self._any_vp_on_written_keys(groups, parsed):
+        # SBE gate: the cheap per-tx md-write flag first (no rwset
+        # materialization on the native path), then the metadata probe
+        # over written keys; both false -> the batched path is exact
+        if any(tx.has_md_writes for tx in parsed) or (
+            self._any_vp_on_written_keys(groups, parsed)
+        ):
+            deps = BlockDependencies([tx.rwset for tx in parsed])
             self._evaluate_policies_sbe(groups, parsed, flags, deps)
         else:
             self._evaluate_policies_batched(groups, parsed, flags)
@@ -425,6 +459,15 @@ class BlockValidator:
         groups: PolicyGroups,
         parsed: Sequence[ParsedTx],
     ) -> bool:
+        wk_iter = getattr(parsed, "iter_written_keys", None)
+        if wk_iter is not None:
+            # columnar written-keys table from the native parse; it may
+            # include txs invalidated before dispatch — extra keys only
+            # route to the exact sequential path, never skip it
+            for _i, ns, coll, key in wk_iter():
+                if self._has_vp(ns, coll, key):
+                    return True
+            return False
         seen = set()
         for _definition, entries in groups.values():
             for i, _ns in entries:
@@ -529,6 +572,21 @@ class BlockValidator:
             rows.append([self._satisfies(ident, pr) for pr in principals])
         return np.array(rows, dtype=bool).reshape(len(rows), len(principals))
 
+    def _pattern_key(self, tx: ParsedTx) -> tuple:
+        """The tx's signer pattern: (Identity, sig_ok) per endorsement
+        job with a resolvable identity, in job order. Two txs with equal
+        patterns produce identical satisfaction rows for any policy, so
+        the circuit verdict is memoizable per (policy, pattern). Keys
+        hold the Identity objects themselves (strong refs) — id() reuse
+        after GC can never alias entries."""
+        parts = []
+        for job in tx.endorsement_jobs:
+            ident = self._job_identity.get(id(job))
+            if ident is None:
+                continue
+            parts.append((ident, self._sig_ok(job)))
+        return tuple(parts)
+
     def _evaluate_policies_batched(
         self,
         groups: PolicyGroups,
@@ -537,31 +595,58 @@ class BlockValidator:
     ) -> None:
         """Batched endorsement-policy evaluation per chaincode definition.
         A tx appears once per written namespace (each namespace's policy
-        must pass, dispatcher.go:190)."""
+        must pass, dispatcher.go:190). Typical blocks contain few
+        distinct signer patterns (the same orgs endorse every tx), so
+        the circuit runs once per unique (policy, pattern) and the
+        verdict fans out."""
+        if len(self._pattern_memo) > 64:
+            self._pattern_memo.clear()
         for definition, entries in groups.values():
             env = definition.endorsement_policy
             tx_indices = [i for i, _ns in entries]
+            memo = self._pattern_memo.setdefault(env, {})
+            if len(memo) > 4096:
+                memo.clear()
+            fresh: Dict[tuple, List[int]] = {}
+            for i in tx_indices:
+                key = self._pattern_key(parsed[i])
+                verdict = memo.get(key)
+                if verdict is None:
+                    fresh.setdefault(key, []).append(i)
+                elif verdict is False:
+                    flags.set_flag(i, TxValidationCode.ENDORSEMENT_POLICY_FAILURE)
+            if not fresh:
+                continue
+            # evaluate one representative per unique pattern
+            reps = [txs[0] for txs in fresh.values()]
             # SignatureSetToValidIdentities: dedupe by identity, drop
             # non-verifying signers, preserve order (policy.go:365-402)
-            per_tx_sat: List[np.ndarray] = [
-                self._signer_sat_rows(parsed[i], env) for i in tx_indices
+            per_rep_sat: List[np.ndarray] = [
+                self._signer_sat_rows(parsed[i], env) for i in reps
             ]
-
-            max_signers = max((s.shape[0] for s in per_tx_sat), default=0)
+            max_signers = max((s.shape[0] for s in per_rep_sat), default=0)
             if max_signers == 0:
-                for i in tx_indices:
-                    flags.set_flag(i, TxValidationCode.ENDORSEMENT_POLICY_FAILURE)
-                continue
-            batch = np.zeros(
-                (len(tx_indices), max_signers, len(env.identities)), dtype=bool
-            )
-            for j, sat in enumerate(per_tx_sat):
-                batch[j, : sat.shape[0]] = sat
-            fn = self._policy_fn(env)
-            ok = np.asarray(fn(batch))
-            for j, i in enumerate(tx_indices):
+                ok = np.zeros(len(reps), dtype=bool)
+            else:
+                batch = np.zeros(
+                    (len(reps), max_signers, len(env.identities)), dtype=bool
+                )
+                for j, sat in enumerate(per_rep_sat):
+                    batch[j, : sat.shape[0]] = sat
+                fn = self._policy_fn(env)
+                ok = np.asarray(fn(batch))
+                # a rep with zero valid signers can never satisfy the
+                # policy regardless of the circuit's padding behavior
+                for j, sat in enumerate(per_rep_sat):
+                    if sat.shape[0] == 0:
+                        ok[j] = False
+            for j, (key, txs) in enumerate(fresh.items()):
+                memo[key] = bool(ok[j])
                 if not ok[j]:
-                    flags.set_flag(i, TxValidationCode.ENDORSEMENT_POLICY_FAILURE)
+                    for i in txs:
+                        flags.set_flag(
+                            i, TxValidationCode.ENDORSEMENT_POLICY_FAILURE
+                        )
 
     def _sig_ok(self, job: SigJob) -> bool:
         return self._sig_results.get(id(job), False)

@@ -13,10 +13,14 @@
 #include <cstdint>
 #include <cstring>
 
+#include "sha256c.h"
+
 extern "C" {
 
 // ---------------------------------------------------------------------------
 // SHA-256 (FIPS 180-4), straightforward portable implementation.
+// (Retained as documentation/fallback; fn_batch_sha256 routes through
+// sha256c, which picks up libcrypto's assembly paths when present.)
 // ---------------------------------------------------------------------------
 
 static const uint32_t K[64] = {
@@ -99,8 +103,13 @@ static void sha256_one(const uint8_t* msg, uint64_t len, uint8_t out[32]) {
 // out: n * 32 bytes.
 void fn_batch_sha256(const uint8_t* msgs, const uint64_t* offsets,
                      const uint64_t* lens, int64_t n, uint8_t* out) {
-  for (int64_t i = 0; i < n; i++)
-    sha256_one(msgs + offsets[i], lens[i], out + 32 * i);
+  if (sha256c_backend()) {
+    for (int64_t i = 0; i < n; i++)
+      sha256c_oneshot(msgs + offsets[i], lens[i], out + 32 * i);
+  } else {
+    for (int64_t i = 0; i < n; i++)
+      sha256_one(msgs + offsets[i], lens[i], out + 32 * i);
+  }
 }
 
 // ---------------------------------------------------------------------------
