@@ -22,14 +22,24 @@ The `detail.configs` object carries the measured numbers for configs
                channels, direct per-channel launches vs the shared
                VerifyBatcher (launches + lanes/launch reported).
 
-Output discipline: a COMPLETE JSON line is printed and flushed as soon as
-the headline (config #1) finishes, then re-emitted after every config
-completes or fails — so a driver that kills the process mid-run still
-captures the latest complete line (round 2's bench recorded nothing
-because the single line printed only at the very end).  The last line is
-the most complete.  BENCH_BUDGET_S (default 1500) is a wall-clock budget:
-configs that would start after the deadline are recorded as skipped.
-Heavy configs can be skipped entirely with BENCH_HEADLINE_ONLY=1.
+Output discipline (hardened after round 4, where one UNAVAILABLE raise at
+first device dispatch produced rc=1 and zero data):
+- the CPU columns are measured FIRST and a complete JSON line is emitted
+  before the device is touched at all;
+- the device is reached only through the bounded probe
+  (utils/deviceprobe) — a dead tunnel records device="unavailable" plus
+  an error field and every config still reports its CPU column;
+- device dispatches retry with backoff and degrade to the software path
+  inside TPUProvider (degraded runs are labeled, never mistaken for
+  device numbers);
+- a watchdog thread re-emits the latest line and exits 0 if anything
+  hangs past BENCH_BUDGET_S + BENCH_WATCHDOG_GRACE_S;
+- the line is re-emitted after every config completes or fails, so a
+  driver that kills the process mid-run still captures the latest
+  complete line.  The last line is the most complete.
+BENCH_BUDGET_S (default 1500) is the wall-clock budget: configs that
+would start after the deadline are recorded as skipped.  Heavy configs
+can be skipped entirely with BENCH_HEADLINE_ONLY=1.
 """
 
 import json
@@ -85,10 +95,13 @@ def bench_cpu_baseline(triples, budget_s=2.0):
     return count / (time.perf_counter() - start)
 
 
-def bench_headline(n, iters):
+def bench_headline_device(triples, iters):
+    """Device half of config #1. Returns (device_rate, degraded) — the
+    caller already owns the CPU column. Any raise is caught by main()
+    and recorded as an error field, never rc=1 (round-4 postmortem)."""
     from fabric_tpu.crypto.tpu_provider import TPUProvider
 
-    triples = gen_triples(n)
+    n = len(triples)
     keys = [t[0] for t in triples]
     sigs = [t[1] for t in triples]
     digests = [t[2] for t in triples]
@@ -97,6 +110,10 @@ def bench_headline(n, iters):
     out = prov.batch_verify(keys, sigs, digests)
     if not all(out):
         raise RuntimeError("verification failed in warmup — kernel bug")
+    if TPUProvider.degraded:
+        # the warmup batch was actually served by the software fallback:
+        # there is no device column to measure
+        return 0.0, True
 
     # depth-3 software pipeline (the peer's P4 discipline, one deeper):
     # keep up to two launches in flight so the tunnel's per-launch RTT
@@ -122,8 +139,7 @@ def bench_headline(n, iters):
     # the tunnel's RTT is not — transient stalls mid-pass would
     # misreport the kernel (same-day spread without this: 43-90k)
     device_rate = max(timed_pass() for _ in range(3))
-    cpu_rate = bench_cpu_baseline(triples)
-    return device_rate, cpu_rate
+    return device_rate, TPUProvider.degraded
 
 
 # ----------------------------------------------------------------------
@@ -207,10 +223,9 @@ class _Net:
         return BlockValidator(channel, self.mgr, provider, self.registry)
 
 
-def bench_block_1k(net, n_txs=1000):
+def bench_block_1k(net, device_ok=True, n_txs=1000):
     """Config #2: full validator ms/block, TPU vs SW provider, bit-exact
     masks (reference timers v20/validator.go:261-262)."""
-    from fabric_tpu.crypto.tpu_provider import TPUProvider
     from fabric_tpu.protos import common_pb2
 
     block = net.make_block("benchchan", n_txs)
@@ -223,27 +238,41 @@ def bench_block_1k(net, n_txs=1000):
         flags = v.validate(b)
         return (time.perf_counter() - start) * 1000.0, flags.tobytes()
 
+    (sw_ms, sw_mask) = min(run(net.sw), run(net.sw))
+    if set(sw_mask) != {0}:
+        raise RuntimeError("config #2 expected all-VALID block")
+    if not device_ok:
+        return {
+            "txs": n_txs,
+            "cpu_ms_per_block": round(sw_ms, 1),
+            "error": "device unavailable — CPU column only",
+        }
+    from fabric_tpu.crypto.tpu_provider import TPUProvider
+
     tpu_prov = TPUProvider()
     run(tpu_prov)  # compile warmup
     # best of two measured runs, like the headline: per-launch tunnel
     # RTT is noisy (same-day spread 190-500 ms/block) while the actual
     # device+host work is stable at ~190-210 ms
     (tpu_ms, tpu_mask) = min(run(tpu_prov), run(tpu_prov))
-    (sw_ms, sw_mask) = min(run(net.sw), run(net.sw))
     if tpu_mask != sw_mask:
         raise RuntimeError("config #2 mask mismatch TPU vs SW")
-    if set(tpu_mask) != {0}:
-        raise RuntimeError("config #2 expected all-VALID block")
-    return {
+    out = {
         "txs": n_txs,
         "tpu_ms_per_block": round(tpu_ms, 1),
         "cpu_ms_per_block": round(sw_ms, 1),
         "speedup": round(sw_ms / tpu_ms, 2),
         "mask_bit_exact": True,
     }
+    if TPUProvider.degraded:
+        out["error"] = (
+            "device degraded mid-config: some lanes fell back to the "
+            "software path; tpu_ms is not a pure device number"
+        )
+    return out
 
 
-def bench_idemix(n_sigs=8):
+def bench_idemix(device_ok=True, n_sigs=8):
     """Config #3: batched Idemix verify, device Ate2 pairing kernel vs
     the host oracle pairing (idemix/signature.go:243-296)."""
     import random
@@ -328,7 +357,7 @@ def bench_idemix(n_sigs=8):
     # The device Ate2 kernel's first compile is ~3.5 min on the TPU
     # (then cached; this bench's issuer key is seed-fixed so the program
     # caches across runs). BENCH_IDEMIX_DEVICE=0 opts out.
-    if os.environ.get("BENCH_IDEMIX_DEVICE", "1") == "1":
+    if device_ok and os.environ.get("BENCH_IDEMIX_DEVICE", "1") == "1":
         run(True, n_sigs)  # compile warmup
         dev_ms, dev_out = run(True, n_sigs)
         if dev_out[:n_host] != host_out or not all(dev_out):
@@ -338,12 +367,14 @@ def bench_idemix(n_sigs=8):
             (host_ms / n_host) / (dev_ms / n_sigs), 1
         )
         result["mask_bit_exact"] = True
+    elif not device_ok:
+        result["device"] = "skipped (device unavailable)"
     else:
         result["device"] = "skipped (BENCH_IDEMIX_DEVICE=0)"
     return result
 
 
-def bench_mvcc(n_txs=5000):
+def bench_mvcc(device_ok=True, n_txs=5000):
     """Config #4: MVCC validate-and-prepare over a 5k-tx block, host
     sequential scan vs the device fixpoint resolver (reference
     validateAndPrepareBatch, validation/validator.go:82; SURVEY P5)."""
@@ -395,6 +426,12 @@ def bench_mvcc(n_txs=5000):
         return ms, codes
 
     host_ms, host_codes = run(Validator(db))
+    if not device_ok:
+        return {
+            "txs": n_txs,
+            "host_ms_per_block": round(host_ms, 1),
+            "error": "device unavailable — host column only",
+        }
     dev = DeviceValidator(db)
     run(dev)  # compile warmup
     dev_ms, dev_codes = run(dev)
@@ -415,25 +452,21 @@ def bench_mvcc(n_txs=5000):
     }
 
 
-def bench_multichannel(net, n_channels=4, txs_per_channel=2000):
+def bench_multichannel(net, device_ok=True, n_channels=4, txs_per_channel=2000):
     """Config #5: one channel-axis device step validating one block per
     channel (sharding over real chips is exercised by dryrun_multichip
-    on the virtual mesh; this machine has a single chip)."""
-    import jax
-
-    from fabric_tpu.parallel import MultiChannelValidator
-    from fabric_tpu.parallel.mesh import grid_mesh
+    on the virtual mesh; this machine has a single chip). The CPU
+    aggregate column (BASELINE config #5 "CPU aggregate tx/s") runs the
+    same four blocks through plain per-channel SW-provider validators —
+    the reference's process-parallel shape collapsed onto this host's
+    single core."""
     from fabric_tpu.protos import common_pb2
 
     channels = [f"bench{i}" for i in range(n_channels)]
     blocks = {
         ch: net.make_block(ch, txs_per_channel) for ch in channels
     }
-    devices = jax.devices()
-    mesh = grid_mesh(1, 1, devices[:1])
-    mc = MultiChannelValidator(
-        mesh, {ch: net.validator(ch, net.sw) for ch in channels}
-    )
+    total = n_channels * txs_per_channel
 
     def copy_blocks():
         out = {}
@@ -443,20 +476,55 @@ def bench_multichannel(net, n_channels=4, txs_per_channel=2000):
             out[ch] = c
         return out
 
+    # CPU aggregate: per-channel sequential validation, software provider
+    cpu_copies = copy_blocks()
+    start = time.perf_counter()
+    for ch in channels:
+        flags = net.validator(ch, net.sw).validate(cpu_copies[ch])
+        if set(flags.tobytes()) != {0}:
+            raise RuntimeError(f"config #5 invalid txs in {ch} (cpu)")
+    cpu_elapsed = time.perf_counter() - start
+    result = {
+        "channels": n_channels,
+        "txs_per_channel": txs_per_channel,
+        "cpu_aggregate_tx_per_s": round(total / cpu_elapsed, 1),
+        "cpu_ms_total": round(cpu_elapsed * 1000.0, 1),
+    }
+    if not device_ok:
+        result["error"] = "device unavailable — CPU column only"
+        return result
+
+    import jax
+
+    from fabric_tpu.parallel import MultiChannelValidator
+    from fabric_tpu.parallel.mesh import grid_mesh
+
+    devices = jax.devices()
+    mesh = grid_mesh(1, 1, devices[:1])
+    mc = MultiChannelValidator(
+        mesh, {ch: net.validator(ch, net.sw) for ch in channels}
+    )
     mc.validate(copy_blocks())  # compile warmup
     start = time.perf_counter()
     flags = mc.validate(copy_blocks())
     elapsed = time.perf_counter() - start
-    total = n_channels * txs_per_channel
     for ch in channels:
         if set(flags[ch].tobytes()) != {0}:
             raise RuntimeError(f"config #5 invalid txs in {ch}")
-    return {
-        "channels": n_channels,
-        "txs_per_channel": txs_per_channel,
-        "aggregate_tx_per_s": round(total / elapsed, 1),
-        "ms_total": round(elapsed * 1000.0, 1),
-    }
+    result.update(
+        {
+            "aggregate_tx_per_s": round(total / elapsed, 1),
+            "ms_total": round(elapsed * 1000.0, 1),
+            "speedup": round(cpu_elapsed / elapsed, 2),
+            # duty cycle: share of the wall clock the sharded device step
+            # (launch -> masks back) occupied; the rest is host phases
+            "device_busy_ms": round(mc.last_device_ms, 1),
+            "device_duty_cycle": round(
+                mc.last_device_ms / (elapsed * 1000.0), 3
+            ),
+        }
+    )
+    return result
 
 
 def _ec_backend_name():
@@ -467,7 +535,7 @@ def _ec_backend_name():
     return ec_backend().__name__
 
 
-def bench_batcher(net, n_channels=4, txs_per_channel=128):
+def bench_batcher(net, device_ok=True, n_channels=4, txs_per_channel=128):
     """P7 coalescing: four channels deliver SMALL blocks concurrently.
     Direct mode launches one small device program per channel; the shared
     VerifyBatcher coalesces them into few large launches (reference
@@ -547,6 +615,8 @@ def main():
     # bigger batch halves its share of the rate (measured on a slow-tunnel
     # day: 43.4k verifies/s at 16384 vs 57.5k at 32768; both programs are
     # cached)
+    import threading
+
     n = int(os.environ.get("BENCH_N", "32768"))
     iters = int(os.environ.get("BENCH_ITERS", "5"))
     headline_only = os.environ.get("BENCH_HEADLINE_ONLY", "") == "1"
@@ -554,21 +624,23 @@ def main():
     t0 = time.monotonic()
     deadline = t0 + budget_s
 
-    import jax
-
-    device_rate, cpu_rate = bench_headline(n, iters)
-
+    # ---- CPU columns FIRST: a complete JSON line exists before the
+    # ---- device is touched at all (round-4 postmortem: UNAVAILABLE at
+    # ---- first dispatch produced rc=1 and zero data)
     configs = {}
+    triples = gen_triples(n)
+    cpu_rate = bench_cpu_baseline(triples)
     result = {
         "metric": "ecdsa_p256_verify_throughput",
-        "value": round(device_rate, 1),
+        "value": round(cpu_rate, 1),
         "unit": "verifies/s",
-        "vs_baseline": round(device_rate / cpu_rate, 2),
+        "vs_baseline": 1.0,
         "detail": {
             "batch": n,
             "iters": iters,
             "cpu_baseline_verifies_per_s": round(cpu_rate, 1),
-            "device": str(jax.devices()[0]),
+            "device": "pending",
+            "error": "device not yet attempted",
             "target_verifies_per_s": 50000,
             "sw_ec_backend": _ec_backend_name(),
             "budget_s": budget_s,
@@ -581,7 +653,64 @@ def main():
         result["detail"]["elapsed_s"] = round(time.monotonic() - t0, 1)
         print(json.dumps(result), flush=True)
 
-    emit()  # the headline lands even if a later config hangs or is killed
+    emit()  # valid line on disk before any device call can hang
+
+    # ---- watchdog: if anything (usually a first device dispatch through
+    # ---- a dead tunnel) hangs past the budget + grace, emit what we have
+    # ---- and exit 0 — the driver still gets the latest complete line
+    grace_s = float(os.environ.get("BENCH_WATCHDOG_GRACE_S", "120"))
+
+    def _watchdog():
+        while True:
+            left = (deadline + grace_s) - time.monotonic()
+            if left <= 0:
+                break
+            time.sleep(min(left, 10.0))
+        # os._exit must run even if emit() races the main thread's dict
+        # mutations (json.dumps over a changing dict raises) — a dead
+        # watchdog would reintroduce the round-4 infinite hang
+        try:
+            result["detail"]["watchdog"] = (
+                "budget+grace exhausted; a hung call was preempted"
+            )
+            emit()
+        except Exception:  # noqa: BLE001
+            pass
+        finally:
+            os._exit(0)
+
+    threading.Thread(target=_watchdog, name="bench-watchdog", daemon=True).start()
+
+    # ---- bounded device probe, then the device headline
+    from fabric_tpu.utils.deviceprobe import accelerator_present, probe_error
+
+    probe_s = min(float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "300")),
+                  max(budget_s * 0.3, 60.0))
+    device_ok = accelerator_present(probe_s)
+    if not device_ok:
+        result["detail"]["device"] = "unavailable"
+        result["detail"]["error"] = probe_error() or "no accelerator device"
+        emit()
+    else:
+        import jax
+
+        result["detail"]["device"] = str(jax.devices()[0])
+        try:
+            device_rate, degraded = bench_headline_device(triples, iters)
+            if degraded or device_rate <= 0.0:
+                device_ok = False
+                result["detail"]["error"] = (
+                    "device dispatch degraded to the software fallback — "
+                    "no valid device column"
+                )
+            else:
+                result["value"] = round(device_rate, 1)
+                result["vs_baseline"] = round(device_rate / cpu_rate, 2)
+                result["detail"].pop("error", None)
+        except Exception as exc:  # noqa: BLE001 - keep the CPU line
+            device_ok = False
+            result["detail"]["error"] = f"headline device error: {exc}"[:300]
+        emit()
 
     if not headline_only:
         net = None
@@ -598,10 +727,19 @@ def main():
                 }
                 emit()
                 continue
+            if name == "batcher_4ch_small" and not device_ok:
+                configs[name] = {
+                    "skipped": "device unavailable (coalescing is a "
+                    "device-launch experiment)"
+                }
+                emit()
+                continue
             try:
                 if needs_net and net is None:
                     net = _Net()
-                configs[name] = fn(net) if needs_net else fn()
+                configs[name] = (
+                    fn(net, device_ok) if needs_net else fn(device_ok)
+                )
             except Exception as exc:  # noqa: BLE001 - emit partial results
                 configs[name] = {"error": str(exc)[:300]}
             emit()

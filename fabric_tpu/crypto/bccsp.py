@@ -167,9 +167,12 @@ def default_provider() -> Provider:
     global _default
     if _default is None:
         try:
-            import jax
+            # BOUNDED probe: a dead accelerator tunnel makes the naive
+            # jax.devices() call hang forever (observed round 4) — a
+            # node start must degrade to the software provider instead
+            from fabric_tpu.utils.deviceprobe import accelerator_present
 
-            if any(d.platform != "cpu" for d in jax.devices()):
+            if accelerator_present():
                 from fabric_tpu.crypto.tpu_provider import TPUProvider
 
                 _default = TPUProvider()

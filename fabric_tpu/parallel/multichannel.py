@@ -33,6 +33,9 @@ class MultiChannelValidator:
         self.sharded = ShardedVerify(mesh)
         # host prep (DER parse, key-limb cache) shared across channels
         self._prep = TPUProvider()
+        # device-busy wall time of the last validate() call's sharded
+        # step (launch -> masks materialized), for duty-cycle reporting
+        self.last_device_ms = 0.0
 
     def validate(
         self, blocks: Dict[str, common_pb2.Block]
@@ -62,7 +65,11 @@ class MultiChannelValidator:
         stacked = channel_stack(
             tuple(per_channel[ch][5] for ch in channels), lanes, n_channels
         )
-        masks = self.sharded.verify_channels(*stacked)
+        import time as _time
+
+        t_dev = _time.perf_counter()
+        masks = np.asarray(self.sharded.verify_channels(*stacked))
+        self.last_device_ms = (_time.perf_counter() - t_dev) * 1000.0
 
         # per-channel host epilogue
         out: Dict[str, ValidationFlags] = {}

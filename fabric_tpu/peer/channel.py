@@ -20,7 +20,7 @@ from fabric_tpu.ledger.kvledger import KVLedger
 from fabric_tpu.msp.identity import MSPManager
 from fabric_tpu.protos import common_pb2, protoutil
 from fabric_tpu.validation.blockparse import parse_block
-from fabric_tpu.validation.txflags import ValidationFlags
+from fabric_tpu.validation.txflags import TxValidationCode, ValidationFlags
 from fabric_tpu.validation.validator import BlockValidator, ChaincodeRegistry
 
 logger = flogging.must_get_logger("committer")
@@ -47,6 +47,7 @@ class Channel:
         metrics=None,  # ledger.ledgermetrics.CommitterMetrics
         device_mvcc: bool = False,  # SURVEY P5 device fixpoint resolver
         writeset_check=None,  # legacy v12/v13 write-set guards
+        plugin_registry=None,  # dispatcher.PluginRegistry (custom plugins)
     ):
         self.metrics = metrics
         self.channel_id = channel_id
@@ -74,6 +75,7 @@ class Channel:
             apply_config=apply_config,
             get_state_metadata=get_state_metadata,
             writeset_check=writeset_check,
+            plugin_registry=plugin_registry,
         )
 
     def prepare_block(self, block: common_pb2.Block):
@@ -116,6 +118,22 @@ class Channel:
         )
         t_validate = _time.perf_counter() - t0
         rwsets = [p.rwset for p in parsed]
+        # materializing rwsets may demote txs the native walker accepted
+        # but the Python parser rejects (ParsedTx.rwset divergence guard);
+        # fold that into the filter BEFORE it is persisted so native and
+        # pure-Python peers commit the same TRANSACTIONS_FILTER
+        refilter = False
+        for p in parsed:
+            if p.code == TxValidationCode.BAD_RWSET and (
+                flags.flag(p.index) == TxValidationCode.VALID
+            ):
+                flags.set_flag(p.index, TxValidationCode.BAD_RWSET)
+                rwsets[p.index] = None
+                refilter = True
+        if refilter:
+            block.metadata.metadata[common_pb2.TRANSACTIONS_FILTER] = (
+                flags.tobytes()
+            )
         pvt_data, missing = self._assemble_pvt_data(block, parsed, flags)
         result = self.ledger.commit(
             block, rwsets=rwsets, pvt_data=pvt_data, missing_pvt=missing

@@ -50,7 +50,14 @@ def _load() -> Optional[ctypes.CDLL]:
         except OSError:
             return None
         if not hasattr(lib, "fn_block_parse"):
-            # stale build predating the block parser — rebuild once
+            # stale prebuilt .so predating the block parser: rebuild and
+            # reload. Safe because the Makefile compiles to a temp file
+            # and renames — the inode the stale handle has mapped is
+            # never rewritten (no SIGBUS), and the renamed path is a NEW
+            # inode, so dlopen (which dedups by dev:ino) returns a fresh
+            # handle rather than the stale one. On any failure the stale
+            # handle keeps serving der/sha and block parsing falls back
+            # to the Python parser (consumers gate on hasattr).
             try:
                 subprocess.run(
                     ["make", "-C", os.path.dirname(_SO_PATH), "-B"],
@@ -96,7 +103,8 @@ def _load() -> Optional[ctypes.CDLL]:
             lib.fn_block_free.restype = None
             lib.fn_sha256_backend.restype = ctypes.c_int
         except AttributeError:
-            # stale .so predating the block parser: rebuild on next run
+            # still missing after the rebuild attempt above: serve
+            # der/sha only; block parsing uses the Python fallback
             pass
         _lib = lib
         return _lib

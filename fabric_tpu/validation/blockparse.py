@@ -89,27 +89,6 @@ class ParsedBlock(list):
                 yield int(self._wk_tx[k]), name, "", key_bytes.decode("utf-8")
 
 
-class ChainedParsedBlock(list):
-    """Concatenation of per-chunk ParsedBlocks (the chunked-pipelined
-    validator path): behaves as one flat ParsedTx list; written-key
-    iteration chains the chunks with their tx-index offsets."""
-
-    __slots__ = ("_chunks",)
-
-    def __init__(self):
-        super().__init__()
-        self._chunks: List[Tuple[int, ParsedBlock]] = []
-
-    def add_chunk(self, offset: int, chunk: ParsedBlock) -> None:
-        self._chunks.append((offset, chunk))
-        self.extend(chunk)
-
-    def iter_written_keys(self) -> Iterator[Tuple[int, str, str, object]]:
-        for off, chunk in self._chunks:
-            for i, ns, coll, key in chunk.iter_written_keys():
-                yield i + off, ns, coll, key
-
-
 def _i64(a: np.ndarray):
     return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
 

@@ -120,8 +120,22 @@ class ParsedTx:
     @property
     def rwset(self) -> Optional[rw.TxRwSet]:
         if self._rwset is None and self._rwset_raw is not None:
-            self._rwset = parse_tx_rwset(self._rwset_raw)
-            self._rwset_raw = None
+            raw, self._rwset_raw = self._rwset_raw, None
+            try:
+                self._rwset = parse_tx_rwset(raw)
+            except ValueError:
+                # acceptance divergence between the native wire walker
+                # (walk_tx_rwset) and the Python parser over untrusted tx
+                # bytes: degrade to BAD_RWSET for THIS tx instead of
+                # letting the exception abort the whole block commit
+                from fabric_tpu.common import flogging
+
+                flogging.must_get_logger("validation").warning(
+                    "native/Python rwset parse divergence on tx %d "
+                    "(len=%d) — marking BAD_RWSET; add to fuzzer corpus",
+                    self.index, len(raw),
+                )
+                self.code = TxValidationCode.BAD_RWSET
         return self._rwset
 
     @rwset.setter

@@ -124,10 +124,15 @@ class BlockValidator:
             Callable[[str, str], Optional[SignaturePolicyEnvelope]]
         ] = None,
         writeset_check: Optional[Callable] = None,
+        plugin_registry=None,
     ):
         # optional extra write-set rule, e.g. the v12 system-namespace
         # guards on legacy channels (validation/legacy.check_v12_writeset)
         self.writeset_check = writeset_check
+        # named custom validation plugins (dispatcher.PluginRegistry);
+        # definitions whose plugin resolves to an object with a
+        # `validate` callable dispatch there instead of the builtin path
+        self.plugin_registry = plugin_registry
         self.channel_id = channel_id
         self.msp_manager = msp_manager
         self.provider = provider
@@ -186,13 +191,22 @@ class BlockValidator:
         txid_array: List[str] = [""] * len(data)
 
         policy_groups = self._assemble_codes(parsed, sig_results, flags, txid_array)
-        self._evaluate_policies(policy_groups, parsed, flags)
+        policy_groups, plugin_results = self._dispatch_custom_plugins(
+            policy_groups, parsed, flags, block
+        )
+        self._evaluate_policies(policy_groups, parsed, flags, plugin_results)
 
         # duplicate TxIDs: vs ledger first (checkTxIdDupsLedger), then
         # in-block (markTXIdDuplicates) — first occurrence wins.
         for tx in parsed:
             i = tx.index
             if flags.flag(i) == TxValidationCode.NOT_VALIDATED:
+                # a lazy rwset materialization during the policy phase may
+                # have demoted the tx (native/Python parse divergence —
+                # see ParsedTx.rwset); honor it before declaring VALID
+                if tx.code == TxValidationCode.BAD_RWSET:
+                    flags.set_flag(i, TxValidationCode.BAD_RWSET)
+                    continue
                 flags.set_flag(i, TxValidationCode.VALID)
                 txid_array[i] = tx.tx_id
         seen: Dict[str, int] = {}
@@ -432,11 +446,97 @@ class BlockValidator:
             self._principal_cache[key] = hit
         return hit
 
+    def _dispatch_custom_plugins(
+        self,
+        groups: PolicyGroups,
+        parsed: Sequence[ParsedTx],
+        flags: ValidationFlags,
+        block: common_pb2.Block,
+    ):
+        """Route policy groups bound to a CUSTOM validation plugin
+        (reference plugindispatcher: plugin.Validate per written
+        namespace); groups on the builtin plugin pass through to the
+        batched/SBE evaluation. Outcome mapping per plugin_api.
+
+        Returns (remaining_groups, plugin_results) where plugin_results
+        is {tx_index: {namespace: ok}} — the SBE pass needs the per-
+        namespace verdicts so a VALID plugin-validated tx's key-metadata
+        writes register as APPLIED in BlockDependencies (a later tx must
+        validate against the updated key policy, not the stale one)."""
+        from fabric_tpu.validation.plugin_api import (
+            EndorsementInvalid,
+            SignerInfo,
+            ValidationContext,
+        )
+
+        remaining: PolicyGroups = {}
+        plugin_results: Dict[int, Dict[str, bool]] = {}
+        for key, (definition, entries) in groups.items():
+            plugin = None
+            if self.plugin_registry is not None:
+                plugin = self.plugin_registry.get(definition.plugin)
+            if not callable(getattr(plugin, "validate", None)):
+                if definition.plugin not in ("builtin", "vscc"):
+                    # named plugin missing from the registry: the
+                    # definition is unusable (reference
+                    # plugin_validator.go getOrCreatePlugin error)
+                    for i, _ns in entries:
+                        flags.set_flag(i, TxValidationCode.INVALID_CHAINCODE)
+                    continue
+                remaining[key] = (definition, entries)
+                continue
+            for i, ns in entries:
+                if flags.flag(i) != TxValidationCode.NOT_VALIDATED:
+                    continue
+                tx = parsed[i]
+                signers = []
+                for job in tx.endorsement_jobs:
+                    ident = self._job_identity.get(id(job))
+                    signers.append(
+                        SignerInfo(
+                            msp_id=ident.msp_id if ident else "",
+                            identity_bytes=job.identity_bytes,
+                            sig_valid=self._sig_ok(job),
+                        )
+                    )
+                ctx = ValidationContext(
+                    channel_id=self.channel_id,
+                    block_num=block.header.number,
+                    tx_index=i,
+                    namespace=ns,
+                    tx_id=tx.tx_id,
+                    envelope_bytes=bytes(block.data.data[i]),
+                    policy=definition.endorsement_policy,
+                    signers=signers,
+                    default_check=lambda _tx=tx, _env=definition.endorsement_policy: (
+                        self._eval_policy_host(_tx, _env)
+                    ),
+                    get_state_metadata=self.get_state_metadata,
+                    ns_entries=tuple(tx.ns_entries or ()),
+                )
+                try:
+                    plugin.validate(ctx)
+                    plugin_results.setdefault(i, {})[ns] = True
+                except EndorsementInvalid:
+                    flags.set_flag(
+                        i, TxValidationCode.ENDORSEMENT_POLICY_FAILURE
+                    )
+                    plugin_results.setdefault(i, {})[ns] = False
+                except Exception as exc:  # noqa: BLE001
+                    # reference VSCCExecutionFailureError: an infra
+                    # fault must halt the block, never mark the tx
+                    raise ValidationError(
+                        f"validation plugin {definition.plugin!r} failed "
+                        f"on tx {i} ns {ns}: {exc}"
+                    ) from exc
+        return remaining, plugin_results
+
     def _evaluate_policies(
         self,
         groups: PolicyGroups,
         parsed: Sequence[ParsedTx],
         flags: ValidationFlags,
+        plugin_results: Optional[Dict[int, Dict[str, bool]]] = None,
     ) -> None:
         """Endorsement-policy evaluation. The common case — no key-level
         validation parameters anywhere in sight — takes the batched
@@ -450,7 +550,9 @@ class BlockValidator:
             self._any_vp_on_written_keys(groups, parsed)
         ):
             deps = BlockDependencies([tx.rwset for tx in parsed])
-            self._evaluate_policies_sbe(groups, parsed, flags, deps)
+            self._evaluate_policies_sbe(
+                groups, parsed, flags, deps, plugin_results or {}
+            )
         else:
             self._evaluate_policies_batched(groups, parsed, flags)
 
@@ -461,11 +563,17 @@ class BlockValidator:
     ) -> bool:
         wk_iter = getattr(parsed, "iter_written_keys", None)
         if wk_iter is not None:
-            # columnar written-keys table from the native parse; it may
-            # include txs invalidated before dispatch — extra keys only
-            # route to the exact sequential path, never skip it
-            for _i, ns, coll, key in wk_iter():
-                if self._has_vp(ns, coll, key):
+            # columnar written-keys table from the native parse; it also
+            # covers txs invalidated before dispatch (bad creator sig,
+            # dup txid, ...) whose metadata probes would both cost state
+            # reads and let invalid txs force the sequential SBE path —
+            # restrict to tx indices actually dispatched, matching the
+            # fallback scan below
+            dispatched = {
+                i for _d, entries in groups.values() for i, _ns in entries
+            }
+            for i, ns, coll, key in wk_iter():
+                if i in dispatched and self._has_vp(ns, coll, key):
                     return True
             return False
         seen = set()
@@ -498,6 +606,7 @@ class BlockValidator:
         parsed: Sequence[ParsedTx],
         flags: ValidationFlags,
         deps: BlockDependencies,
+        plugin_results: Dict[int, Dict[str, bool]],
     ) -> None:
         """Sequential key-level pass in tx order. Signature verification
         already happened in the batched device phase; per-policy checks
@@ -515,6 +624,20 @@ class BlockValidator:
             )
             pairs = pairs_by_tx.get(i)
             if pairs is None or rwset is None:
+                # custom-plugin-validated tx: its per-namespace verdicts
+                # were decided in _dispatch_custom_plugins — a VALID
+                # tx's key-metadata writes must register as APPLIED so
+                # later txs validate against the updated key policies
+                plug = plugin_results.get(i)
+                if plug is not None and rwset is not None:
+                    still_valid = (
+                        flags.flag(i) == TxValidationCode.NOT_VALIDATED
+                    )
+                    for ns in namespaces:
+                        deps.set_result(
+                            i, ns, still_valid and plug.get(ns, True)
+                        )
+                    continue
                 # invalidated earlier / config tx: its metadata writes do
                 # not update validation parameters
                 for ns in namespaces:
@@ -523,8 +646,12 @@ class BlockValidator:
             # each written namespace validates against its OWN policy
             # (dispatcher.go:190); first failure invalidates the tx and
             # leaves the remaining namespaces unvalidated (= failed).
-            validated: Dict[str, bool] = {}
-            failed = False
+            # A tx spanning plugin-bound AND builtin namespaces carries
+            # its plugin verdicts in (they count toward `failed` too —
+            # the plugin may already have set the failure flag).
+            plug = plugin_results.get(i) or {}
+            validated: Dict[str, bool] = dict(plug)
+            failed = not all(plug.values()) if plug else False
             for ns, definition in pairs:
                 if failed:
                     validated[ns] = False

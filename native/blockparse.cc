@@ -116,7 +116,11 @@ bool skip_field(Rd& r, uint32_t fn, uint32_t wt, int depth) {
       r.pos += 4;
       return true;
     case 3: {  // group: skip until matching end-group tag
-      if (depth > 90) return false;
+      // a group at nesting level d enters here with depth == d-1; reject
+      // at level 101 exactly like python-protobuf (upb recursion limit
+      // 100: 100-deep balanced groups parse, 101 raise DecodeError) so
+      // native and fallback deployments accept identical envelopes
+      if (depth > 99) return false;
       for (;;) {
         uint32_t f2, w2;
         if (!rd_tag(r, &f2, &w2)) return false;
@@ -213,6 +217,7 @@ struct Header {
   Slice channel_header, signature_header;
 };
 
+// Header sits one level below the Payload ParseFromString root
 bool parse_header(const uint8_t* base, Slice s, Header* out) {
   Rd r{base, s.off, s.off + s.len};
   while (r.pos < r.end) {
@@ -223,7 +228,7 @@ bool parse_header(const uint8_t* base, Slice s, Header* out) {
       if (!rd_len_delim(r, &out->channel_header)) return false;
     } else if (f == 2 && w == 2) {
       if (!rd_len_delim(r, &out->signature_header)) return false;
-    } else if (!skip_field(r, f, w, 0)) {
+    } else if (!skip_field(r, f, w, 1)) {
       return false;
     }
   }
@@ -275,7 +280,7 @@ bool parse_channel_header(const uint8_t* base, Slice s, ChannelHeader* out) {
     } else if (f == 3 && w == 2) {  // Timestamp: eager submessage check
       Slice ts;
       if (!rd_len_delim(r, &ts)) return false;
-      if (!validate_wire(base, ts, 0)) return false;
+      if (!validate_wire(base, ts, 1)) return false;
     } else if (f == 4 && w == 2) {
       if (!rd_len_delim(r, &out->channel_id)) return false;
       if (!utf8_slice(base, out->channel_id)) return false;
@@ -328,7 +333,7 @@ bool parse_transaction_action(const uint8_t* base, Slice s,
       if (!rd_len_delim(r, &out->header)) return false;
     } else if (f == 2 && w == 2) {
       if (!rd_len_delim(r, &out->payload)) return false;
-    } else if (!skip_field(r, f, w, 0)) {
+    } else if (!skip_field(r, f, w, 1)) {
       return false;
     }
   }
@@ -389,12 +394,12 @@ bool parse_endorsed_action(const uint8_t* base, Slice s,
           if (!rd_len_delim(r2, &e.endorser)) return false;
         } else if (f2 == 2 && w2 == 2) {
           if (!rd_len_delim(r2, &e.signature)) return false;
-        } else if (!skip_field(r2, f2, w2, 0)) {
+        } else if (!skip_field(r2, f2, w2, 2)) {
           return false;
         }
       }
       out->endorsements.push_back(e);
-    } else if (!skip_field(r, f, w, 0)) {
+    } else if (!skip_field(r, f, w, 1)) {
       return false;
     }
   }
@@ -457,7 +462,7 @@ bool validate_response(const uint8_t* base, Slice s) {
       Slice m;
       if (!rd_len_delim(r, &m)) return false;
       if (!utf8_slice(base, m)) return false;
-    } else if (!skip_field(r, f, w, 0)) {
+    } else if (!skip_field(r, f, w, 1)) {
       return false;
     }
   }
@@ -481,7 +486,7 @@ bool parse_chaincode_id(const uint8_t* base, Slice s, ChaincodeID* out) {
     } else if (f == 2 && w == 2) {
       if (!rd_len_delim(r, &out->name)) return false;
       if (!utf8_slice(base, out->name)) return false;
-    } else if (!skip_field(r, f, w, 0)) {
+    } else if (!skip_field(r, f, w, 1)) {
       return false;
     }
   }
@@ -539,8 +544,12 @@ struct NsEntry {
   bool has_md = false;
 };
 
-// KVRead { string key = 1; Version version = 2; }
-bool validate_kvread(const uint8_t* base, Slice s) {
+// KVRead { string key = 1; Version version = 2; }  `depth` = this
+// message's nesting level below the enclosing python ParseFromString
+// root (upb's recursion limit counts message levels AND group levels
+// from that root, budget 100 — parity demands the native walker track
+// the same accumulated depth, not restart at 0 per submessage).
+bool validate_kvread(const uint8_t* base, Slice s, int depth) {
   Rd r{base, s.off, s.off + s.len};
   while (r.pos < r.end) {
     uint32_t f, w;
@@ -553,8 +562,8 @@ bool validate_kvread(const uint8_t* base, Slice s) {
     } else if (f == 2 && w == 2) {
       Slice v;
       if (!rd_len_delim(r, &v)) return false;
-      if (!validate_wire(base, v, 0)) return false;
-    } else if (!skip_field(r, f, w, 0)) {
+      if (!validate_wire(base, v, depth + 1)) return false;
+    } else if (!skip_field(r, f, w, depth)) {
       return false;
     }
   }
@@ -564,7 +573,8 @@ bool validate_kvread(const uint8_t* base, Slice s) {
 // KVMetadataWrite / KVMetadataWriteHash share shape:
 // { key(1: string|bytes); repeated KVMetadataEntry entries = 2 }
 // KVMetadataEntry { string name = 1; bytes value = 2; }
-bool validate_md_write(const uint8_t* base, Slice s, bool key_is_string) {
+bool validate_md_write(const uint8_t* base, Slice s, bool key_is_string,
+                       int depth) {
   Rd r{base, s.off, s.off + s.len};
   while (r.pos < r.end) {
     uint32_t f, w;
@@ -586,11 +596,11 @@ bool validate_md_write(const uint8_t* base, Slice s, bool key_is_string) {
           Slice nm;
           if (!rd_len_delim(r2, &nm)) return false;
           if (!utf8_slice(base, nm)) return false;
-        } else if (!skip_field(r2, f2, w2, 0)) {
+        } else if (!skip_field(r2, f2, w2, depth + 1)) {
           return false;
         }
       }
-    } else if (!skip_field(r, f, w, 0)) {
+    } else if (!skip_field(r, f, w, depth)) {
       return false;
     }
   }
@@ -599,7 +609,7 @@ bool validate_md_write(const uint8_t* base, Slice s, bool key_is_string) {
 
 // RangeQueryInfo { start/end(1,2: string); itr(3); raw_reads(4);
 // reads_merkle_hashes(5) }
-bool validate_rqi(const uint8_t* base, Slice s) {
+bool validate_rqi(const uint8_t* base, Slice s, int depth) {
   Rd r{base, s.off, s.off + s.len};
   while (r.pos < r.end) {
     uint32_t f, w;
@@ -620,16 +630,16 @@ bool validate_rqi(const uint8_t* base, Slice s) {
         if (f2 == 1 && w2 == 2) {
           Slice kr;
           if (!rd_len_delim(r2, &kr)) return false;
-          if (!validate_kvread(base, kr)) return false;
-        } else if (!skip_field(r2, f2, w2, 0)) {
+          if (!validate_kvread(base, kr, depth + 2)) return false;
+        } else if (!skip_field(r2, f2, w2, depth + 1)) {
           return false;
         }
       }
     } else if (f == 5 && w == 2) {  // merkle summary: no strings
       Slice m;
       if (!rd_len_delim(r, &m)) return false;
-      if (!validate_wire(base, m, 0)) return false;
-    } else if (!skip_field(r, f, w, 0)) {
+      if (!validate_wire(base, m, depth + 1)) return false;
+    } else if (!skip_field(r, f, w, depth)) {
       return false;
     }
   }
@@ -646,11 +656,11 @@ bool walk_kvrwset(const uint8_t* base, Slice s, NsEntry* ns) {
     if (f == 1 && w == 2) {
       Slice kr;
       if (!rd_len_delim(r, &kr)) return false;
-      if (!validate_kvread(base, kr)) return false;
+      if (!validate_kvread(base, kr, 1)) return false;
     } else if (f == 2 && w == 2) {
       Slice q;
       if (!rd_len_delim(r, &q)) return false;
-      if (!validate_rqi(base, q)) return false;
+      if (!validate_rqi(base, q, 1)) return false;
     } else if (f == 3 && w == 2) {  // KVWrite { key=1; is_delete=2; value=3 }
       Slice ws;
       if (!rd_len_delim(r, &ws)) return false;
@@ -663,7 +673,7 @@ bool walk_kvrwset(const uint8_t* base, Slice s, NsEntry* ns) {
         if (f2 == 1 && w2 == 2) {
           if (!rd_len_delim(r2, &key)) return false;
           if (!utf8_slice(base, key)) return false;
-        } else if (!skip_field(r2, f2, w2, 0)) {
+        } else if (!skip_field(r2, f2, w2, 1)) {
           return false;
         }
       }
@@ -672,7 +682,7 @@ bool walk_kvrwset(const uint8_t* base, Slice s, NsEntry* ns) {
     } else if (f == 4 && w == 2) {
       Slice mw;
       if (!rd_len_delim(r, &mw)) return false;
-      if (!validate_md_write(base, mw, true)) return false;
+      if (!validate_md_write(base, mw, true, 1)) return false;
       ns->writes = 1;
       ns->has_md = true;
     } else if (!skip_field(r, f, w, 0)) {
@@ -701,8 +711,8 @@ bool walk_hashed_rwset(const uint8_t* base, Slice s, Slice coll_name,
         if (f2 == 2 && w2 == 2) {
           Slice v;
           if (!rd_len_delim(r2, &v)) return false;
-          if (!validate_wire(base, v, 0)) return false;
-        } else if (!skip_field(r2, f2, w2, 0)) {
+          if (!validate_wire(base, v, 2)) return false;
+        } else if (!skip_field(r2, f2, w2, 1)) {
           return false;
         }
       }
@@ -717,7 +727,7 @@ bool walk_hashed_rwset(const uint8_t* base, Slice s, Slice coll_name,
         if (w2 == 4) return false;
         if (f2 == 1 && w2 == 2) {
           if (!rd_len_delim(r2, &key)) return false;
-        } else if (!skip_field(r2, f2, w2, 0)) {
+        } else if (!skip_field(r2, f2, w2, 1)) {
           return false;
         }
       }
@@ -726,7 +736,7 @@ bool walk_hashed_rwset(const uint8_t* base, Slice s, Slice coll_name,
     } else if (f == 3 && w == 2) {
       Slice mw;
       if (!rd_len_delim(r, &mw)) return false;
-      if (!validate_md_write(base, mw, false)) return false;
+      if (!validate_md_write(base, mw, false, 1)) return false;
       ns->writes = 1;
       ns->has_md = true;
     } else if (!skip_field(r, f, w, 0)) {
@@ -779,12 +789,12 @@ bool walk_tx_rwset(const uint8_t* base, Slice s, std::vector<NsEntry>* out,
               if (!utf8_slice(base, c.name)) return false;
             } else if (f3 == 2 && w3 == 2) {
               if (!rd_len_delim(r3, &c.hashed)) return false;
-            } else if (!skip_field(r3, f3, w3, 0)) {
+            } else if (!skip_field(r3, f3, w3, 2)) {
               return false;
             }
           }
           colls.push_back(c);
-        } else if (!skip_field(r2, f2, w2, 0)) {
+        } else if (!skip_field(r2, f2, w2, 1)) {
           return false;
         }
       }

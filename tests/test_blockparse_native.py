@@ -255,6 +255,14 @@ def test_structured_edge_cases():
     cases.append(grp + base)
     # unbalanced group -> envelope decode error
     cases.append(bytes([15 << 3 | 3]) + base)
+    # balanced-group nesting at the python-protobuf recursion boundary
+    # (upb accepts 100-deep, rejects 101): native must agree lane-exact
+    for depth in (89, 90, 91, 99, 100, 101, 105):
+        cases.append(
+            bytes([15 << 3 | 3]) * depth
+            + bytes([15 << 3 | 4]) * depth
+            + base
+        )
     # overlong varint (11 bytes)
     cases.append(bytes([0x08]) + b"\x80" * 10 + b"\x01")
     # wrong wire type on Envelope.payload (varint) -> field skipped
@@ -288,6 +296,58 @@ def test_structured_edge_cases():
         ).SerializeToString()
     )
     assert_parse_equal(cases)
+
+
+def test_group_depth_parity_nested():
+    """upb's recursion budget (100) accumulates across message levels
+    below each ParseFromString root — groups inside SUBMESSAGES must hit
+    the limit earlier than groups at the root, and the native walker
+    must agree lane-exact at every boundary (review r5 counterexample:
+    100-deep groups inside Header diverged)."""
+    rng = random.Random(11)
+    base = make_endorser_tx(rng, rwset=make_rwset(rng))
+    env = common_pb2.Envelope()
+    env.ParseFromString(base)
+    payload = common_pb2.Payload()
+    payload.ParseFromString(env.payload)
+
+    def grp(depth):
+        return bytes([15 << 3 | 3]) * depth + bytes([15 << 3 | 4]) * depth
+
+    cases = []
+    # Header sits at depth 1 under the Payload root: budget 99
+    for d in (98, 99, 100, 101):
+        hdr = payload.header.SerializeToString() + grp(d)
+        p = _ld(1, hdr) + _ld(2, payload.data)
+        cases.append(_ld(1, p) + _ld(2, b"s"))
+    # Timestamp sits at depth 1 under the ChannelHeader root: budget 99
+    chdr_bytes = payload.header.channel_header
+    for d in (98, 99, 100):
+        ch2 = chdr_bytes + _ld(3, grp(d))
+        hdr = _ld(1, ch2) + _ld(2, payload.header.signature_header)
+        p = _ld(1, hdr) + _ld(2, payload.data)
+        cases.append(_ld(1, p) + _ld(2, b"s"))
+    # KVRead.Version sits at depth 2 under the KVRWSet root: budget 98
+    for d in (97, 98, 99):
+        kvread = _ld(1, b"k") + _ld(2, grp(d))
+        kvrwset = _ld(1, kvread)
+        ns = _ld(1, b"mycc") + _ld(2, kvrwset)
+        cases.append(make_endorser_tx(rng, rwset=_ld(2, ns)))
+    assert_parse_equal(cases)
+
+
+def test_lazy_rwset_divergence_degrades_to_bad_rwset():
+    """If the native walker accepted rwset bytes the Python parser later
+    rejects (acceptance divergence), the lazy materialization must mark
+    THAT tx BAD_RWSET — never raise into the commit path (ADVICE r4)."""
+    from fabric_tpu.validation.msgvalidation import ParsedTx
+    from fabric_tpu.validation.txflags import TxValidationCode
+
+    tx = ParsedTx(3)
+    tx._rwset_raw = b"\xff\xff\xff\xff"  # not a TxReadWriteSet
+    assert tx.rwset is None
+    assert tx.code == TxValidationCode.BAD_RWSET
+    assert tx.rwset is None  # cached; no re-parse attempt
 
 
 def test_fuzz_mutations():
