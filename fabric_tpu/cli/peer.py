@@ -69,15 +69,40 @@ def _load_node(config_path: str) -> PeerNode:
     signer = load_signing_identity(
         pc["mspConfigPath"], pc.get("localMspId", "DEFAULT")
     )
-    cc_policies = {
-        name: from_dsl(dsl)
-        for name, dsl in (pc.get("chaincodes") or {}).items()
-    }
+    # chaincode entries are either "name: <policy dsl>" or
+    # "name: {policy: <dsl>, plugin: <handler name>}" — the latter binds
+    # the namespace to a custom validation plugin from peer.handlers
+    cc_defs = {}
+    for name, spec in (pc.get("chaincodes") or {}).items():
+        if isinstance(spec, dict):
+            cc_defs[name] = (
+                from_dsl(spec["policy"]),
+                spec.get("plugin", "builtin"),
+            )
+        else:
+            cc_defs[name] = (from_dsl(spec), "builtin")
 
     def registry_factory(channel_id: str) -> ChaincodeRegistry:
         return ChaincodeRegistry(
-            [ChaincodeDefinition(n, p) for n, p in cc_policies.items()]
+            [
+                ChaincodeDefinition(n, p, plugin=pl)
+                for n, (p, pl) in cc_defs.items()
+            ]
         )
+
+    # custom validation handlers by module path (reference
+    # core/handlers/library/registry.go:134 plugin.Open; here
+    # "module.path:Attribute" via dispatcher.PluginRegistry.load)
+    from fabric_tpu.validation.dispatcher import PluginRegistry
+
+    plugin_registry = PluginRegistry()
+    for extra in pc.get("handlersPath") or []:
+        if extra not in sys.path:
+            sys.path.insert(0, extra)
+    for name, ref in (
+        (pc.get("handlers") or {}).get("validation") or {}
+    ).items():
+        plugin_registry.load(name, ref)
 
     ops = (cfg.get("operations") or {}).get("listenAddress")
     provider = None
@@ -95,6 +120,7 @@ def _load_node(config_path: str) -> PeerNode:
         provider=provider,
         # ledger.deviceMVCC: resolve MVCC on device (SURVEY P5)
         device_mvcc=bool((cfg.get("ledger") or {}).get("deviceMVCC")),
+        plugin_registry=plugin_registry,
     )
     # External-builder analog (core/container/externalbuilder): user
     # chaincode loads as python modules, "module.path:ClassName", with

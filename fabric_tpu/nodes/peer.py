@@ -47,6 +47,9 @@ class PeerNode:
         # device batches through one coalescing launch queue; pass False
         # to route batch_verify straight at the provider
         shared_verify_batcher: bool = True,
+        # dispatcher.PluginRegistry with custom validation plugins loaded
+        # from node config (reference core/handlers/library registry)
+        plugin_registry=None,
     ):
         self.work_dir = work_dir
         self.msp_manager = msp_manager
@@ -61,6 +64,7 @@ class PeerNode:
 
             self.provider = BatchingProvider(provider or default_provider())
         self.device_mvcc = device_mvcc
+        self.plugin_registry = plugin_registry
         self._registry_factory = registry_factory
         self.channels: Dict[str, Channel] = {}
         self.transient = TransientStore()
@@ -494,6 +498,7 @@ class PeerNode:
             writeset_check=lambda rwset, ns, cid=channel_id: (
                 self._legacy_writeset_check(cid, rwset, ns)
             ),
+            plugin_registry=self.plugin_registry,
         )
         if ch.ledger.height == 0:
             ch.ledger.commit(genesis_block)
@@ -535,6 +540,7 @@ class PeerNode:
             writeset_check=lambda rwset, ns, cid=channel_id: (
                 self._legacy_writeset_check(cid, rwset, ns)
             ),
+            plugin_registry=self.plugin_registry,
         )
         self.channels[channel_id] = ch
         self.snapshot_managers[channel_id] = SnapshotRequestManager(

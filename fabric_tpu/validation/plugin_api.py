@@ -1,0 +1,87 @@
+"""Pluggable validation SPI (reference core/handlers/validation/api/
+validation.go Plugin + plugin_validator.go dispatch semantics).
+
+A validation plugin decides, per (transaction, written namespace),
+whether the endorsement is acceptable. The reference loads Go shared
+objects (core/handlers/library/registry.go:134 plugin.Open) and calls
+`Validate(block, namespace, position, 0, policyBytes...)`; the TPU-
+native form loads Python modules by "module.path:Attribute" reference
+(dispatcher.PluginRegistry.load) and calls
+`validate(ValidationContext)`.
+
+Outcome mapping (plugin_validator.go:100-118):
+- return normally            -> the namespace validates
+- raise EndorsementInvalid   -> tx marked ENDORSEMENT_POLICY_FAILURE
+  (the reference's *commonerrors.VSCCEndorsementPolicyError)
+- raise anything else        -> ValidationError halts the whole block
+  (the reference's VSCCExecutionFailureError: retriable infra fault,
+  never silently invalidates a tx)
+
+Unlike the reference — where each plugin re-verifies endorsement
+signatures itself — signature verification has ALREADY run in the
+batched device phase by the time a plugin is consulted; the context
+exposes the per-endorser verdicts (`signers`) plus a `default_check()`
+escape hatch running the builtin policy circuit, so a plugin composes
+with the TPU batch instead of paying per-tx host crypto.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, List, Optional, Tuple
+
+
+class EndorsementInvalid(Exception):
+    """The tx's endorsement does not satisfy the plugin's rules."""
+
+
+class PluginExecutionError(Exception):
+    """Infrastructure failure inside a plugin — halts block processing."""
+
+
+@dataclass
+class SignerInfo:
+    """One endorsement signature, post device batch."""
+
+    msp_id: str
+    identity_bytes: bytes
+    sig_valid: bool
+
+
+@dataclass
+class ValidationContext:
+    """Everything a validation plugin may consult for one (tx, ns)."""
+
+    channel_id: str
+    block_num: int
+    tx_index: int
+    namespace: str
+    tx_id: str
+    envelope_bytes: bytes
+    # the namespace's endorsement policy (policy.ast envelope), as the
+    # reference passes serialized policy bytes to plugin.Validate
+    policy: object
+    # post-device-batch endorsement verdicts for this tx
+    signers: List[SignerInfo]
+    # runs the builtin policy circuit for this tx against `policy`
+    # (plugins that only ADD rules on top of the default check call this
+    # first, like the reference builtin wrapped by custom plugins)
+    default_check: Callable[[], bool]
+    # committed state metadata probe: (ns, coll, key) -> bytes | None
+    get_state_metadata: Callable[[str, str, object], Optional[bytes]] = (
+        lambda ns, coll, key: None
+    )
+    # (namespace, writes?) pairs of the tx's rwset, rwset order
+    ns_entries: Tuple = ()
+
+
+class ValidationPlugin:
+    """Base class for custom validation plugins. Subclasses override
+    `validate`; `init` receives nothing today but reserves the
+    reference's dependency-injection slot (validation.go Init)."""
+
+    def init(self, **deps) -> None:  # noqa: D401 - SPI hook
+        pass
+
+    def validate(self, ctx: ValidationContext) -> None:
+        raise NotImplementedError

@@ -1,0 +1,510 @@
+"""Pluggable validation: custom plugins dispatched by namespace binding
+(reference core/handlers/validation SPI + core/handlers/library/
+registry.go module loading + integration/pluggable/pluggable_test.go).
+
+Unit layer: BlockValidator routes policy groups bound to a custom
+plugin through plugin.validate(ctx) with the documented outcome mapping.
+E2E layer: a REAL subprocess orderer+peer network loads a plugin by
+module path from node config; the plugin both records its invocations
+and rejects writes to a guarded key, and the committed chain reflects
+its verdicts.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from fabric_tpu.crypto.bccsp import SoftwareProvider
+from fabric_tpu.endorser import create_proposal, create_signed_tx, endorse_proposal
+from fabric_tpu.ledger import rwset as rw
+from fabric_tpu.ledger.rwset_proto import serialize_tx_rwset
+from fabric_tpu.msp.cryptogen import generate_org
+from fabric_tpu.msp.identity import MSPManager
+from fabric_tpu.msp.signer import SigningIdentity
+from fabric_tpu.policy import from_dsl
+from fabric_tpu.protos import protoutil
+from fabric_tpu.validation.dispatcher import PluginRegistry
+from fabric_tpu.validation.plugin_api import (
+    EndorsementInvalid,
+    ValidationContext,
+    ValidationPlugin,
+)
+from fabric_tpu.validation.txflags import TxValidationCode
+from fabric_tpu.validation.validator import (
+    BlockValidator,
+    ChaincodeDefinition,
+    ChaincodeRegistry,
+    ValidationError,
+)
+
+CHANNEL = "plugchannel"
+PROVIDER = SoftwareProvider()
+V = TxValidationCode
+
+
+@pytest.fixture(scope="module")
+def net():
+    org1 = generate_org("org1.plug", "Org1MSP")
+    org2 = generate_org("org2.plug", "Org2MSP")
+    mgr = MSPManager([org1.msp(provider=PROVIDER), org2.msp(provider=PROVIDER)])
+    return {
+        "mgr": mgr,
+        "client": SigningIdentity(org1.users[0], PROVIDER),
+        "p1": SigningIdentity(org1.peers[0], PROVIDER),
+        "p2": SigningIdentity(org2.peers[0], PROVIDER),
+    }
+
+
+def make_block(net, cc="plugcc", key="k1", number=7):
+    results = serialize_tx_rwset(
+        rw.TxRwSet((rw.NsRwSet(cc, (), (rw.KVWrite(key, False, b"v"),)),))
+    )
+    bundle = create_proposal(net["client"], CHANNEL, cc, [b"invoke", b"a"])
+    responses = [
+        endorse_proposal(bundle, net[e], results) for e in ("p1", "p2")
+    ]
+    env = create_signed_tx(bundle, net["client"], responses)
+    block = protoutil.new_block(number, b"\x11" * 32)
+    block.data.data.append(env.SerializeToString())
+    protoutil.seal_block(block)
+    return block
+
+
+def validator(net, plugin_name, plugin=None):
+    registry = ChaincodeRegistry(
+        [
+            ChaincodeDefinition(
+                "plugcc",
+                from_dsl("AND('Org1MSP.member','Org2MSP.member')"),
+                plugin=plugin_name,
+            )
+        ]
+    )
+    plugins = PluginRegistry()
+    if plugin is not None:
+        plugins.register(plugin_name, plugin)
+    return BlockValidator(
+        CHANNEL, net["mgr"], PROVIDER, registry, plugin_registry=plugins
+    )
+
+
+class RecordingPlugin(ValidationPlugin):
+    def __init__(self):
+        self.contexts = []
+
+    def validate(self, ctx: ValidationContext) -> None:
+        self.contexts.append(ctx)
+        if not ctx.default_check():
+            raise EndorsementInvalid("default policy failed")
+
+
+class TestUnitDispatch:
+    def test_plugin_accepts_and_sees_context(self, net):
+        plugin = RecordingPlugin()
+        v = validator(net, "recorder", plugin)
+        flags = v.validate(make_block(net))
+        assert flags.flag(0) == V.VALID
+        (ctx,) = plugin.contexts
+        assert ctx.channel_id == CHANNEL
+        assert ctx.namespace == "plugcc"
+        assert ctx.block_num == 7
+        assert ctx.tx_id
+        assert ctx.envelope_bytes
+        assert len(ctx.signers) == 2
+        assert all(s.sig_valid for s in ctx.signers)
+        assert {s.msp_id for s in ctx.signers} == {"Org1MSP", "Org2MSP"}
+
+    def test_plugin_rejects(self, net):
+        class Reject(ValidationPlugin):
+            def validate(self, ctx):
+                raise EndorsementInvalid("nope")
+
+        v = validator(net, "reject", Reject())
+        flags = v.validate(make_block(net))
+        assert flags.flag(0) == V.ENDORSEMENT_POLICY_FAILURE
+
+    def test_plugin_execution_failure_halts_block(self, net):
+        class Boom(ValidationPlugin):
+            def validate(self, ctx):
+                raise RuntimeError("infra down")
+
+        v = validator(net, "boom", Boom())
+        with pytest.raises(ValidationError):
+            v.validate(make_block(net))
+
+    def test_unresolvable_plugin_invalidates(self, net):
+        v = validator(net, "ghost", plugin=None)
+        flags = v.validate(make_block(net))
+        assert flags.flag(0) == V.INVALID_CHAINCODE
+
+    def test_registry_load_by_module_path(self, tmp_path):
+        (tmp_path / "ext_plug.py").write_text(
+            "from fabric_tpu.validation.plugin_api import ValidationPlugin\n"
+            "class MyPlugin(ValidationPlugin):\n"
+            "    def validate(self, ctx):\n"
+            "        pass\n"
+        )
+        sys.path.insert(0, str(tmp_path))
+        try:
+            reg = PluginRegistry()
+            plugin = reg.load("mine", "ext_plug:MyPlugin")
+            assert callable(plugin.validate)
+            assert reg.get("mine") is plugin
+        finally:
+            sys.path.remove(str(tmp_path))
+
+
+class TestPluginSBEInterplay:
+    """A VALID plugin-validated tx's key-metadata writes must register
+    as APPLIED in BlockDependencies: a later builtin tx writing the same
+    key inside the block is invalidated because its endorsements predate
+    the new key policy (validator_keylevel.go semantics)."""
+
+    def _mixed_tx(self, net, with_vp):
+        from fabric_tpu.policy.proto_convert import marshal_application_policy
+        from fabric_tpu.validation.statebased import VALIDATION_PARAMETER
+
+        ns_sets = [
+            rw.NsRwSet("plugcc", (), (rw.KVWrite("p", False, b"v"),)),
+        ]
+        if with_vp:
+            vp = (
+                (
+                    VALIDATION_PARAMETER,
+                    marshal_application_policy(from_dsl("OR('Org1MSP.member')")),
+                ),
+            )
+            ns_sets.append(
+                rw.NsRwSet(
+                    "bincc",
+                    (),
+                    (rw.KVWrite("k", False, b"v0"),),
+                    metadata_writes=(rw.KVMetadataWrite("k", vp),),
+                )
+            )
+        results = serialize_tx_rwset(rw.TxRwSet(tuple(ns_sets)))
+        bundle = create_proposal(net["client"], CHANNEL, "plugcc", [b"put"])
+        responses = [
+            endorse_proposal(bundle, net[e], results) for e in ("p1", "p2")
+        ]
+        return create_signed_tx(bundle, net["client"], responses)
+
+    def _bin_tx(self, net):
+        results = serialize_tx_rwset(
+            rw.TxRwSet(
+                (rw.NsRwSet("bincc", (), (rw.KVWrite("k", False, b"v1"),)),)
+            )
+        )
+        bundle = create_proposal(net["client"], CHANNEL, "bincc", [b"put"])
+        responses = [
+            endorse_proposal(bundle, net[e], results) for e in ("p1", "p2")
+        ]
+        return create_signed_tx(bundle, net["client"], responses)
+
+    def _validate(self, net, envs):
+        registry = ChaincodeRegistry(
+            [
+                ChaincodeDefinition(
+                    "plugcc",
+                    from_dsl("AND('Org1MSP.member','Org2MSP.member')"),
+                    plugin="recorder",
+                ),
+                ChaincodeDefinition(
+                    "bincc",
+                    from_dsl("OR('Org1MSP.member','Org2MSP.member')"),
+                ),
+            ]
+        )
+        plugins = PluginRegistry()
+        plugins.register("recorder", RecordingPlugin())
+        v = BlockValidator(
+            CHANNEL, net["mgr"], PROVIDER, registry, plugin_registry=plugins
+        )
+        block = protoutil.new_block(3, b"\x22" * 32)
+        for env in envs:
+            block.data.data.append(env.SerializeToString())
+        protoutil.seal_block(block)
+        return v.validate(block)
+
+    def test_plugin_md_write_applies_to_later_builtin_tx(self, net):
+        flags = self._validate(
+            net, [self._mixed_tx(net, with_vp=True), self._bin_tx(net)]
+        )
+        assert flags.flag(0) == V.VALID
+        # tx1's endorsements predate tx0's in-block VP update -> failure
+        assert flags.flag(1) == V.ENDORSEMENT_POLICY_FAILURE
+
+    def test_no_vp_write_leaves_later_tx_valid(self, net):
+        flags = self._validate(
+            net, [self._mixed_tx(net, with_vp=False), self._bin_tx(net)]
+        )
+        assert flags.flag(0) == V.VALID
+        assert flags.flag(1) == V.VALID
+
+
+# ----------------------------------------------------------------------
+# subprocess e2e (integration/pluggable/pluggable_test.go analog)
+# ----------------------------------------------------------------------
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_cli(mod, *args, timeout=90):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, "-m", mod, *args],
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+        env=env,
+        cwd=REPO,
+    )
+    assert out.returncode == 0, f"{mod} {args} failed:\n{out.stdout}\n{out.stderr}"
+    return out.stdout
+
+
+def spawn(mod, *args):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.Popen(
+        [sys.executable, "-m", mod, *args],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        env=env,
+        cwd=REPO,
+    )
+
+
+def wait_listening(proc, needle, timeout=60):
+    deadline = time.time() + timeout
+    lines = []
+    while time.time() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            if proc.poll() is not None:
+                raise AssertionError(
+                    f"process exited {proc.returncode}: {''.join(lines)}"
+                )
+            continue
+        lines.append(line)
+        if needle in line:
+            return line.rsplit(" ", 1)[-1].strip()
+    raise AssertionError(f"never saw {needle!r}: {''.join(lines)}")
+
+
+@pytest.fixture(scope="module")
+def plug_network(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("pluggable")
+    crypto = tmp / "crypto-config"
+    (tmp / "crypto-config.yaml").write_text(
+        """
+PeerOrgs:
+  - Name: Org1
+    Domain: org1.example.com
+    MSPID: Org1MSP
+    Template: {Count: 1}
+    Users: {Count: 1}
+OrdererOrgs:
+  - Name: Orderer
+    Domain: orderer.example.com
+    MSPID: OrdererMSP
+"""
+    )
+    run_cli(
+        "fabric_tpu.cli.cryptogen", "generate",
+        "--config", str(tmp / "crypto-config.yaml"),
+        "--output", str(crypto),
+    )
+    org1 = crypto / "peerOrganizations" / "org1.example.com"
+    oorg = crypto / "ordererOrganizations" / "orderer.example.com"
+
+    (tmp / "configtx.yaml").write_text(
+        f"""
+Profiles:
+  OneOrgChannel:
+    Orderer:
+      OrdererType: solo
+      BatchTimeout: 100ms
+      BatchSize: {{MaxMessageCount: 10}}
+      Organizations:
+        - Name: OrdererMSP
+          MSPID: OrdererMSP
+          MSPDir: {oorg}/msp
+    Application:
+      Organizations:
+        - Name: Org1MSP
+          MSPID: Org1MSP
+          MSPDir: {org1}/msp
+"""
+    )
+    gblock = tmp / "plugchan.block"
+    run_cli(
+        "fabric_tpu.cli.configtxgen",
+        "-profile", "OneOrgChannel",
+        "-channelID", "plugchan",
+        "-configPath", str(tmp / "configtx.yaml"),
+        "-outputBlock", str(gblock),
+    )
+
+    (tmp / "orderer.yaml").write_text(
+        f"""
+General:
+  ListenAddress: 127.0.0.1
+  ListenPort: 0
+  LocalMSPID: OrdererMSP
+  LocalMSPDir: {oorg}/users/Admin@orderer.example.com/msp
+  BootstrapFile: {gblock}
+  WorkDir: {tmp}/orderer-data
+"""
+    )
+    orderer_proc = spawn(
+        "fabric_tpu.cli.orderer", "start", "--config", str(tmp / "orderer.yaml")
+    )
+    orderer_addr = wait_listening(orderer_proc, "orderer listening on")
+
+    marker = tmp / "plugin-invocations.log"
+    # the custom validation plugin, loaded by module path from node
+    # config: records every consultation and guards key "forbidden"
+    (tmp / "guard_plugin.py").write_text(
+        f'''
+from fabric_tpu.validation.plugin_api import (
+    EndorsementInvalid, ValidationPlugin,
+)
+from fabric_tpu.validation.msgvalidation import parse_transaction
+
+MARKER = {str(marker)!r}
+
+class GuardPlugin(ValidationPlugin):
+    def validate(self, ctx):
+        with open(MARKER, "a") as f:
+            f.write(ctx.namespace + " " + ctx.tx_id + "\\n")
+        if not ctx.default_check():
+            raise EndorsementInvalid("endorsement policy not satisfied")
+        tx = parse_transaction(ctx.tx_index, ctx.envelope_bytes)
+        rwset = tx.rwset
+        for ns_rw in (rwset.ns_rw_sets if rwset else ()):
+            for w in ns_rw.writes:
+                if w.key.startswith("forbidden"):
+                    raise EndorsementInvalid("write to guarded key")
+'''
+    )
+    (tmp / "kvcc_chaincode.py").write_text(
+        '''
+from fabric_tpu.chaincode import success, error_response
+
+class KVChaincode:
+    def init(self, stub):
+        return success()
+
+    def invoke(self, stub):
+        fn, params = stub.get_function_and_parameters()
+        if fn == "put":
+            stub.put_state(params[0], params[1].encode())
+            return success(b"ok")
+        if fn == "get":
+            return success(stub.get_state(params[0]) or b"")
+        return error_response("unknown " + fn)
+'''
+    )
+    (tmp / "core.yaml").write_text(
+        f"""
+BCCSP:
+  Default: SW
+peer:
+  listenAddress: 127.0.0.1:0
+  localMspId: Org1MSP
+  mspConfigPath: {org1}/peers/peer0.org1.example.com/msp
+  fileSystemPath: {tmp}/peer0-data
+  orgMspDirs:
+    Org1MSP: {org1}/msp
+  ordererEndpoint: {orderer_addr}
+  genesisBlocks: [{gblock}]
+  handlersPath: [{tmp}]
+  handlers:
+    validation:
+      guard: "guard_plugin:GuardPlugin"
+  chaincodes:
+    guardcc:
+      policy: "OR('Org1MSP.member')"
+      plugin: guard
+  chaincodePath: [{tmp}]
+  chaincodePlugins:
+    guardcc: "kvcc_chaincode:KVChaincode"
+"""
+    )
+    peer_proc = spawn(
+        "fabric_tpu.cli.peer", "node", "start", "--config", str(tmp / "core.yaml")
+    )
+    peer_addr = wait_listening(peer_proc, "peer listening on")
+
+    yield {
+        "tmp": tmp,
+        "marker": marker,
+        "orderer_addr": orderer_addr,
+        "peer_addr": peer_addr,
+        "user_msp": str(org1 / "users" / "User0@org1.example.com" / "msp"),
+    }
+    for proc in (orderer_proc, peer_proc):
+        proc.send_signal(signal.SIGTERM)
+    for proc in (orderer_proc, peer_proc):
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
+def _invoke(nw, *fn_args):
+    return run_cli(
+        "fabric_tpu.cli.peer", "chaincode", "invoke",
+        "--peerAddresses", nw["peer_addr"],
+        "-o", nw["orderer_addr"],
+        "-C", "plugchan", "-n", "guardcc",
+        "-c", json.dumps({"Args": list(fn_args)}),
+        "--mspDir", nw["user_msp"], "--mspID", "Org1MSP",
+    )
+
+
+def _query(nw, *fn_args):
+    import base64
+
+    out = run_cli(
+        "fabric_tpu.cli.peer", "chaincode", "query",
+        "--peerAddresses", nw["peer_addr"],
+        "-C", "plugchan", "-n", "guardcc",
+        "-c", json.dumps({"Args": list(fn_args)}),
+        "--mspDir", nw["user_msp"], "--mspID", "Org1MSP",
+        "--b64",
+    )
+    return base64.b64decode(out.strip())
+
+
+def test_pluggable_e2e(plug_network):
+    nw = plug_network
+    # 1. allowed write commits through the custom plugin
+    _invoke(nw, "put", "open-key", "open-value")
+    deadline = time.time() + 30
+    value = b""
+    while time.time() < deadline:
+        value = _query(nw, "get", "open-key")
+        if value == b"open-value":
+            break
+        time.sleep(0.3)
+    assert value == b"open-value"
+
+    # 2. guarded write is endorsed and ordered, but the plugin
+    # invalidates it at commit time: state never changes
+    _invoke(nw, "put", "forbidden-key", "evil")
+    time.sleep(3.0)  # > BatchTimeout + commit
+    assert _query(nw, "get", "forbidden-key") == b""
+
+    # 3. the plugin ran inside the subprocess peer for both txs
+    invocations = nw["marker"].read_text().splitlines()
+    assert len(invocations) >= 2
+    assert all(line.startswith("guardcc ") for line in invocations)
