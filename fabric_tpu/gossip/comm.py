@@ -55,7 +55,22 @@ class GossipNode:
         # endpoint / ledger-height claims)
         sign_message=None,  # (data) -> sig
         require_signed_alive: bool = False,
+        # mutual-TLS transport + TLS-bound stream handshake (reference
+        # comm_impl.go:563 authenticateRemotePeer): tls_server_creds is a
+        # grpc.ServerCredentials built with a client CA (mTLS);
+        # tls_client = (root_ca_pem, (key_pem, cert_pem)) for outbound;
+        # self_tls_cert_der feeds our ConnEstablish tls_cert_hash. With
+        # require_handshake the server refuses streams whose first
+        # message is not a ConnEstablish whose signature verifies AND
+        # whose tls_cert_hash matches the cert actually presented on the
+        # mTLS transport — a stolen MSP identity over an attacker's TLS
+        # session, or a spliced stream, is rejected.
+        tls_server_creds=None,
+        tls_client=None,
+        self_tls_cert_der: bytes = b"",
+        require_handshake: bool = False,
     ):
+        from fabric_tpu.gossip.msgstore import MessageStore
         from fabric_tpu.gossip.pull import CertStore, PullMediator
         from fabric_tpu.gossip.pvtdata import PvtDataHandler
 
@@ -66,9 +81,21 @@ class GossipNode:
         self._height = height
         self.membership = Membership(self_id)
         self.election = LeaderElection(self.membership)
-        # certstore + pull mediator (identity anti-entropy)
+        # certstore + pull mediator (identity + block anti-entropy)
         self.certstore = CertStore(self_id, identity_bytes, verify_identity)
-        self.pull = PullMediator(channel_id, self.certstore)
+        self.pull = PullMediator(
+            channel_id,
+            self.certstore,
+            get_block=get_block,
+            height=height,
+            add_block=self._pull_block_in,
+        )
+        # dedup-expiry store (gossip/msgstore/msgs.go): stops data-msg
+        # forward loops and re-processing in a mesh
+        self._msgstore = MessageStore(ttl_s=30.0)
+        self._tls_client = tls_client
+        self._self_tls_cert_der = self_tls_cert_der
+        self._require_handshake = require_handshake
         # private-data push/pull (None transient store -> disabled)
         self.pvt = (
             PvtDataHandler(
@@ -94,7 +121,7 @@ class GossipNode:
         self._stop = threading.Event()
         self._tick_interval = tick_interval
 
-        self.server = GRPCServer(listen_address)
+        self.server = GRPCServer(listen_address, credentials=tls_server_creds)
         self.server.register(
             "gossip.Gossip",
             {
@@ -108,12 +135,94 @@ class GossipNode:
         )
         self._thread: Optional[threading.Thread] = None
 
+    def _pull_block_in(self, block: common_pb2.Block) -> None:
+        """Pulled blocks enter through the same ordered payload buffer
+        as pushed DataMessages — and mark the msgstore so a later pushed
+        copy of the same block is neither re-buffered nor re-forwarded."""
+        self._msgstore.add(("data", block.header.number))
+        if self.state.add_payload(block):
+            self._drain()
+
     # -- server side ------------------------------------------------------
     def _gossip_stream(self, request_iterator, context):
+        first = True
         for msg in request_iterator:
+            if first:
+                first = False
+                if msg.WhichOneof("content") == "conn":
+                    if not self._handshake_ok(msg.conn, context):
+                        if self._require_handshake:
+                            return  # refuse the stream (comm_impl.go:563)
+                        # permissive mode: an unverifiable handshake is
+                        # ignored, the piggybacked messages still flow
+                        # (silently killing the stream would blackhole a
+                        # mixed-config mesh with no error on either side)
+                    continue
+                if self._require_handshake:
+                    return  # strict mode: no handshake, no service
             reply = self._handle(msg)
             if reply is not None:
                 yield reply
+
+    def _handshake_ok(self, conn, context) -> bool:
+        """Verify a ConnEstablish: signature over (channel, pki_id,
+        tls_cert_hash) against the carried identity, and the hash against
+        the TLS cert the client ACTUALLY presented on this connection."""
+        import hashlib
+
+        identity = bytes(conn.identity) or self.certstore.get(bytes(conn.pki_id))
+        if not identity:
+            return False
+        if self._verify_member_sig is not None:
+            signed = _conn_signing_bytes(
+                self.channel_id, bytes(conn.pki_id), bytes(conn.tls_cert_hash)
+            )
+            if not self._verify_member_sig(
+                identity, signed, bytes(conn.signature)
+            ):
+                return False
+        # TLS binding: only checkable when the transport is mTLS (the
+        # auth context then carries the verified client cert)
+        actual = self._peer_tls_cert_der(context)
+        if actual is not None:
+            if hashlib.sha256(actual).digest() != bytes(conn.tls_cert_hash):
+                return False
+        elif self._require_handshake and self._self_tls_cert_der:
+            # we are TLS-configured but the client came in without a
+            # client cert: refuse rather than accept an unbindable claim
+            return False
+        # pki_id <-> identity binding: the signature above only proves
+        # possession of the key for the identity the CLIENT supplied —
+        # nothing yet ties that identity to the claimed pki_id. The
+        # certstore's verify hook is the binding authority (the
+        # reference derives pki_id from the identity bytes themselves);
+        # a rejected or conflicting bind refuses the stream, so a valid
+        # member cannot authenticate under another peer's pki_id or
+        # pre-poison the first-bind-wins store.
+        if not self.certstore.put(bytes(conn.pki_id), identity):
+            existing = self.certstore.get(bytes(conn.pki_id))
+            if existing != identity:
+                return False
+        return True
+
+    @staticmethod
+    def _peer_tls_cert_der(context):
+        try:
+            auth = context.auth_context()
+        except Exception:  # noqa: BLE001 - non-grpc test contexts
+            return None
+        pems = auth.get("x509_pem_cert") if auth else None
+        if not pems:
+            return None
+        try:
+            from cryptography import x509
+            from cryptography.hazmat.primitives.serialization import Encoding
+
+            return x509.load_pem_x509_certificate(pems[0]).public_bytes(
+                Encoding.DER
+            )
+        except Exception:  # noqa: BLE001
+            return None
 
     def _handle(
         self, msg: gossip_pb2.GossipMessage
@@ -164,10 +273,24 @@ class GossipNode:
                             daemon=True,
                         ).start()
         elif kind == "data_msg":
+            # msgstore dedup: a block seen within the TTL is neither
+            # re-buffered nor re-forwarded (msgstore stops forward loops
+            # in a mesh; gossip_impl.go handleMessage -> Forward gate)
+            if not self._msgstore.add(("data", msg.data_msg.seq_num)):
+                return None
             block = common_pb2.Block()
             block.ParseFromString(msg.data_msg.block)
             if self.state.add_payload(block):
                 self._drain()
+            # push-forward to a bounded random subset (PropagatePeerNum)
+            import random as _random
+
+            peers = self._peer_endpoints()
+            _random.shuffle(peers)
+            for endpoint in peers[:3]:
+                threading.Thread(
+                    target=self._send, args=(endpoint, [msg]), daemon=True
+                ).start()
         elif kind == "state_request":
             blocks = self.state.handle_state_request(
                 msg.state_request.start_seq_num,
@@ -261,9 +384,47 @@ class GossipNode:
         with self._lock:
             conn = self._conns.get(endpoint)
             if conn is None:
-                conn = channel_to(endpoint)
+                if self._tls_client is not None:
+                    root_ca, client_pair = self._tls_client
+                    conn = channel_to(
+                        endpoint, root_ca_pem=root_ca, client_cert=client_pair
+                    )
+                else:
+                    conn = channel_to(endpoint)
                 self._conns[endpoint] = conn
             return conn
+
+    _conn_msg_cache = None
+
+    def _conn_establish(self) -> Optional[gossip_pb2.GossipMessage]:
+        """Our ConnEstablish for stream openings (None when handshaking
+        is not configured). Built once — its inputs (channel, pki_id,
+        static TLS cert) never change, and re-signing on every send
+        would add one ECDSA op per peer per tick on the hot path."""
+        if not (self._require_handshake or self._self_tls_cert_der):
+            return None
+        if self._conn_msg_cache is not None:
+            return self._conn_msg_cache
+        import hashlib
+
+        msg = gossip_pb2.GossipMessage()
+        msg.channel = self.channel_id
+        msg.conn.pki_id = self.self_id.encode()
+        msg.conn.identity = self.certstore.get(self.self_id.encode()) or b""
+        if self._self_tls_cert_der:
+            msg.conn.tls_cert_hash = hashlib.sha256(
+                self._self_tls_cert_der
+            ).digest()
+        if self._sign_message is not None:
+            msg.conn.signature = self._sign_message(
+                _conn_signing_bytes(
+                    self.channel_id,
+                    bytes(msg.conn.pki_id),
+                    bytes(msg.conn.tls_cert_hash),
+                )
+            )
+        self._conn_msg_cache = msg
+        return msg
 
     def _send(
         self,
@@ -278,8 +439,15 @@ class GossipNode:
                 request_serializer=gossip_pb2.GossipMessage.SerializeToString,
                 response_deserializer=gossip_pb2.GossipMessage.FromString,
             )
+            outbound = list(messages)
+            hello = self._conn_establish()
+            if hello is not None:
+                # every stream opening re-authenticates (the reference
+                # handshakes per connection; our sends are one stream
+                # each, so prepend on every send)
+                outbound.insert(0, hello)
             followups = []
-            for reply in stub(iter(list(messages))):
+            for reply in stub(iter(outbound)):
                 out = self._handle(reply)
                 if out is not None:
                     followups.append(out)
@@ -307,6 +475,9 @@ class GossipNode:
         msg.channel = self.channel_id
         msg.data_msg.seq_num = block.header.number
         msg.data_msg.block = block.SerializeToString()
+        # mark our own broadcast seen so a forwarded copy is not
+        # re-buffered or re-forwarded by us
+        self._msgstore.add(("data", block.header.number))
         for endpoint in self._peer_endpoints():
             threading.Thread(
                 target=self._send, args=(endpoint, [msg]), daemon=True
@@ -358,6 +529,12 @@ class GossipNode:
         # identity pull round with one random peer (certstore sync)
         if endpoints and self._tick_count % self.PULL_EVERY == 0:
             self._send(_random.choice(endpoints), [self.pull.hello()])
+        # block pull round (phase-shifted from the identity round): the
+        # digest/request/response path converges peers the push missed
+        # even when height metadata never spread (pullstore.go)
+        if endpoints and self._tick_count % self.PULL_EVERY == 2:
+            self._send(_random.choice(endpoints), [self.pull.hello_blocks()])
+            self._msgstore.expire_old()
         # pvt-data reconciliation (reconcile.go:104-126): request data the
         # pvt store recorded as missing from one random peer
         if (
@@ -464,6 +641,12 @@ class GossipNode:
     @property
     def is_leader(self) -> bool:
         return self.election.is_leader
+
+
+def _conn_signing_bytes(channel_id: str, pki_id: bytes, tls_hash: bytes) -> bytes:
+    """ConnEstablish signed content: channel + pki_id + tls cert hash
+    (comm_impl.go createConnectionMsg signs pkiID + certHash)."""
+    return b"conn\x00" + channel_id.encode() + b"\x00" + pki_id + b"\x00" + tls_hash
 
 
 def _alive_signing_bytes(alive, channel_id: str) -> bytes:

@@ -3,11 +3,17 @@ gossip/gossip/pull/pullstore.go and gossip/identity + certstore: the
 Hello -> DataDigest -> DataRequest -> DataUpdate four-step that spreads
 items a push can miss).
 
-Used here for PEER IDENTITIES: each node holds {pki_id: identity bytes}
-(its own MSP serialized identity plus everything pulled), so policies
-and discovery can resolve remote members' certs without a direct
-connection to them. Blocks do not need a pull mediator — the state
-provider's height-driven anti-entropy covers them (state.go:586)."""
+Two item types ride the same four-step:
+
+* PEER IDENTITIES: each node holds {pki_id: identity bytes} (its own
+  MSP serialized identity plus everything pulled), so policies and
+  discovery can resolve remote members' certs without a direct
+  connection to them.
+* BLOCKS (reference pull.BlockPullPolicy / gossip_impl.go:443): digests
+  are recent block sequence numbers; a peer that missed a push — or a
+  late joiner whose height metadata never spread — converges through
+  pull alone, independent of the height-driven anti-entropy
+  (state.go:586) which needs working membership metadata first."""
 
 from __future__ import annotations
 
@@ -15,9 +21,15 @@ import random
 import threading
 from typing import Callable, Dict, List, Optional
 
-from fabric_tpu.protos import gossip_pb2
+from fabric_tpu.protos import common_pb2, gossip_pb2
 
 PULL_IDENTITY = 1
+PULL_BLOCK = 2
+
+# how many trailing blocks a responder advertises in a block digest
+# (the reference bounds its block pull store the same way; older blocks
+# flow through the state-transfer range protocol instead)
+BLOCK_DIGEST_WINDOW = 10
 
 
 class CertStore:
@@ -71,9 +83,19 @@ class PullMediator:
     a callable (endpoint, [GossipMessage]) -> [reply GossipMessages]
     (the gossip node's stream send)."""
 
-    def __init__(self, channel_id: str, store: CertStore):
+    def __init__(
+        self,
+        channel_id: str,
+        store: CertStore,
+        get_block: Optional[Callable[[int], Optional[common_pb2.Block]]] = None,
+        height: Optional[Callable[[], int]] = None,
+        add_block: Optional[Callable[[common_pb2.Block], None]] = None,
+    ):
         self.channel_id = channel_id
         self.store = store
+        self._get_block = get_block
+        self._height = height
+        self._add_block = add_block
         self._rng = random.Random()
 
     # -- responder side (handled from the gossip stream) -------------------
@@ -81,6 +103,66 @@ class PullMediator:
         self, msg: gossip_pb2.GossipMessage
     ) -> Optional[gossip_pb2.GossipMessage]:
         kind = msg.WhichOneof("content")
+        if kind == "hello" and msg.hello.msg_type == PULL_BLOCK:
+            if self._height is None:
+                return None
+            h = self._height()
+            out = gossip_pb2.GossipMessage()
+            out.channel = self.channel_id
+            out.data_dig.nonce = msg.hello.nonce
+            out.data_dig.msg_type = PULL_BLOCK
+            out.data_dig.digests.extend(
+                str(seq).encode()
+                for seq in range(max(0, h - BLOCK_DIGEST_WINDOW), h)
+            )
+            return out
+        if kind == "data_dig" and msg.data_dig.msg_type == PULL_BLOCK:
+            if self._height is None:
+                return None
+            mine = self._height()
+            want = sorted(
+                int(d)
+                for d in msg.data_dig.digests
+                if d.isdigit() and int(d) >= mine
+            )
+            if not want:
+                return None
+            out = gossip_pb2.GossipMessage()
+            out.channel = self.channel_id
+            out.data_req.nonce = msg.data_dig.nonce
+            out.data_req.msg_type = PULL_BLOCK
+            out.data_req.digests.extend(str(s).encode() for s in want)
+            return out
+        if kind == "data_req" and msg.data_req.msg_type == PULL_BLOCK:
+            if self._get_block is None:
+                return None
+            out = gossip_pb2.GossipMessage()
+            out.channel = self.channel_id
+            out.data_update.nonce = msg.data_req.nonce
+            out.data_update.msg_type = PULL_BLOCK
+            for d in msg.data_req.digests:
+                if not d.isdigit():
+                    continue
+                block = self._get_block(int(d))
+                if block is None:
+                    continue
+                item = gossip_pb2.GossipMessage()
+                item.channel = self.channel_id
+                item.data_msg.seq_num = block.header.number
+                item.data_msg.block = block.SerializeToString()
+                out.data_update.data.append(item.SerializeToString())
+            return out if out.data_update.data else None
+        if kind == "data_update" and msg.data_update.msg_type == PULL_BLOCK:
+            if self._add_block is not None:
+                for raw in msg.data_update.data:
+                    item = gossip_pb2.GossipMessage()
+                    item.ParseFromString(raw)
+                    if item.WhichOneof("content") != "data_msg":
+                        continue
+                    block = common_pb2.Block()
+                    block.ParseFromString(item.data_msg.block)
+                    self._add_block(block)
+            return None
         if kind == "hello" and msg.hello.msg_type == PULL_IDENTITY:
             out = gossip_pb2.GossipMessage()
             out.channel = self.channel_id
@@ -133,9 +215,12 @@ class PullMediator:
         return None
 
     # -- requester side (called from the gossip tick) ----------------------
-    def hello(self) -> gossip_pb2.GossipMessage:
+    def hello(self, msg_type: int = PULL_IDENTITY) -> gossip_pb2.GossipMessage:
         out = gossip_pb2.GossipMessage()
         out.channel = self.channel_id
         out.hello.nonce = self._rng.getrandbits(63)
-        out.hello.msg_type = PULL_IDENTITY
+        out.hello.msg_type = msg_type
         return out
+
+    def hello_blocks(self) -> gossip_pb2.GossipMessage:
+        return self.hello(PULL_BLOCK)

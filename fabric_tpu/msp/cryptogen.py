@@ -33,6 +33,17 @@ def _pem_cert(cert: x509.Certificate) -> bytes:
 
 
 @dataclass
+class TLSPair:
+    """One node's TLS material (cert/key PEM for grpc, DER for the
+    gossip handshake's tls_cert_hash binding, issuing CA PEM)."""
+
+    cert_pem: bytes
+    key_pem: bytes
+    cert_der: bytes
+    ca_pem: bytes
+
+
+@dataclass
 class NodeIdentity:
     name: str
     cert_pem: bytes
@@ -96,6 +107,60 @@ class OrgCA:
             .sign(self.key, hashes.SHA256())
         )
         return NodeIdentity(name, _pem_cert(cert), key, self.msp_id)
+
+    def enroll_tls(self, name: str) -> "TLSPair":
+        """TLS server/client pair for a node (reference cryptogen's
+        tls/ folder; here the org CA doubles as the TLS CA). SANs cover
+        localhost + 127.0.0.1 so grpc hostname verification passes on
+        loopback topologies; extended key usage allows both server and
+        client auth (one pair per node, like Fabric's tls/server.crt)."""
+        import ipaddress
+
+        key = ec.generate_private_key(ec.SECP256R1())
+        now = datetime.datetime.now(datetime.timezone.utc)
+        cert = (
+            x509.CertificateBuilder()
+            .subject_name(_name(name, self.org_name, ou="tls"))
+            .issuer_name(self.cert.subject)
+            .public_key(key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - datetime.timedelta(days=1))
+            .not_valid_after(now + datetime.timedelta(days=365))
+            .add_extension(
+                x509.BasicConstraints(ca=False, path_length=None), critical=True
+            )
+            .add_extension(
+                x509.SubjectAlternativeName(
+                    [
+                        x509.DNSName("localhost"),
+                        x509.DNSName(name),
+                        x509.IPAddress(ipaddress.IPv4Address("127.0.0.1")),
+                    ]
+                ),
+                critical=False,
+            )
+            .add_extension(
+                x509.ExtendedKeyUsage(
+                    [
+                        x509.oid.ExtendedKeyUsageOID.SERVER_AUTH,
+                        x509.oid.ExtendedKeyUsageOID.CLIENT_AUTH,
+                    ]
+                ),
+                critical=False,
+            )
+            .sign(self.key, hashes.SHA256())
+        )
+        key_pem = key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.PKCS8,
+            serialization.NoEncryption(),
+        )
+        return TLSPair(
+            cert_pem=_pem_cert(cert),
+            key_pem=key_pem,
+            cert_der=cert.public_bytes(serialization.Encoding.DER),
+            ca_pem=self.cert_pem,
+        )
 
     def revoke(self, identity: NodeIdentity) -> None:
         self._revoked.append(x509.load_pem_x509_certificate(identity.cert_pem))
