@@ -280,6 +280,100 @@ class ChaincodeListener:
                 if self._handlers.get(handler.name) is handler:
                     del self._handlers[handler.name]
 
+    # -- chaincode-as-a-service (peer dials the chaincode) -----------------
+    def connect_ccaas(
+        self,
+        address: str,
+        timeout: float = 10.0,
+        root_ca=None,
+        expected_name: Optional[str] = None,
+    ) -> str:
+        """Dial a chaincode server's `protos.Chaincode/Connect` stream
+        (reference ccaas external builder / chaincode_server.go): the
+        chaincode sends REGISTER as its first response, then the normal
+        chat runs with roles unchanged — only the transport direction is
+        reversed.
+
+        `timeout` bounds BOTH channel readiness and the REGISTER
+        handshake (a service that accepts the connection but never
+        registers must not hang the invoking transaction thread). With
+        `expected_name`, the handler registers under that name — the
+        lifecycle package-id — regardless of what the server called
+        itself (reference convention CORE_CHAINCODE_ID_NAME=package-id),
+        so disconnect cleanup removes the right registry entry. The
+        channel closes on handshake failure and when the stream dies."""
+        import grpc as _grpc
+
+        from fabric_tpu.comm.server import channel_to
+
+        conn = channel_to(address, root_ca)
+        out_q: "queue.Queue[Optional[CCM]]" = queue.Queue()
+
+        def outgoing():
+            while True:
+                m = out_q.get()
+                if m is None:
+                    return
+                yield m
+
+        try:
+            _grpc.channel_ready_future(conn).result(timeout=timeout)
+            responses = conn.stream_stream(
+                "/protos.Chaincode/Connect",
+                request_serializer=CCM.SerializeToString,
+                response_deserializer=CCM.FromString,
+            )(outgoing())
+            # bounded REGISTER wait: next() has no deadline of its own
+            first_q: "queue.Queue" = queue.Queue()
+            threading.Thread(
+                target=lambda: first_q.put(
+                    next(iter(responses), None)
+                ),
+                daemon=True,
+            ).start()
+            try:
+                first = first_q.get(timeout=timeout)
+            except queue.Empty:
+                responses.cancel()
+                raise ExternalChaincodeError(
+                    f"ccaas server at {address}: no REGISTER in {timeout}s"
+                )
+            if first is None or first.type != CCM.REGISTER:
+                raise ExternalChaincodeError(
+                    f"ccaas server at {address} did not REGISTER"
+                )
+        except Exception:
+            out_q.put(None)
+            conn.close()
+            raise
+        ccid = peer_pb2.ChaincodeID()
+        ccid.ParseFromString(first.payload)
+        name = expected_name or ccid.name
+        handler = _StreamHandler(name)
+        handler.out_q = out_q  # peer->cc messages ride the request stream
+        with self._cv:
+            self._handlers[name] = handler
+            self._cv.notify_all()
+        registered = CCM()
+        registered.type = CCM.REGISTERED
+        out_q.put(registered)
+        ready = CCM()
+        ready.type = CCM.READY
+        out_q.put(ready)
+
+        def read_then_close():
+            try:
+                self._read_loop(handler, responses)
+            finally:
+                conn.close()
+
+        threading.Thread(
+            target=read_then_close,
+            name=f"ccaas-read-{name}",
+            daemon=True,
+        ).start()
+        return name
+
     # -- lookups -----------------------------------------------------------
     def wait_for(self, name: str, timeout: float = 10.0) -> bool:
         with self._cv:

@@ -152,12 +152,21 @@ class ProxyStub:
 class _Session:
     """One Register stream connection."""
 
-    def __init__(self, chaincode, peer_address: str, chaincode_id: str, root_ca=None):
+    def __init__(
+        self,
+        chaincode,
+        peer_address: Optional[str],
+        chaincode_id: str,
+        root_ca=None,
+    ):
         self.chaincode = chaincode
         self.chaincode_id = chaincode_id
         self.out_q: "queue.Queue[Optional[CCM]]" = queue.Queue()
         self.resp_q: "queue.Queue[CCM]" = queue.Queue()
-        self.channel = channel_to(peer_address, root_ca)
+        # ccaas mode serves instead of dialing: no peer channel
+        self.channel = (
+            channel_to(peer_address, root_ca) if peer_address else None
+        )
         self.ready = threading.Event()
         self.stopped = threading.Event()
 
@@ -212,6 +221,22 @@ class _Session:
             out.chaincode_event.CopyFrom(stub._event)
         self.out_q.put(out)
 
+    def _dispatch(self, msg: CCM) -> None:
+        """One peer->chaincode message (shared by the dial-out Register
+        stream and the chaincode-as-a-service Connect stream — the
+        protocol is identical, only the transport direction flips)."""
+        if msg.type == CCM.REGISTERED:
+            return
+        if msg.type == CCM.READY:
+            self.ready.set()
+            return
+        if msg.type in (CCM.INIT, CCM.TRANSACTION):
+            threading.Thread(
+                target=self._run_tx, args=(msg,), daemon=True
+            ).start()
+        elif msg.type in (CCM.RESPONSE, CCM.ERROR):
+            self.resp_q.put(msg)
+
     def serve(self) -> None:
         stream = self.channel.stream_stream(
             "/protos.ChaincodeSupport/Register",
@@ -219,24 +244,81 @@ class _Session:
             response_deserializer=CCM.FromString,
         )(self._gen())
         for msg in stream:
-            if msg.type == CCM.REGISTERED:
-                continue
-            if msg.type == CCM.READY:
-                self.ready.set()
-                continue
-            if msg.type in (CCM.INIT, CCM.TRANSACTION):
-                threading.Thread(
-                    target=self._run_tx, args=(msg,), daemon=True
-                ).start()
-            elif msg.type in (CCM.RESPONSE, CCM.ERROR):
-                self.resp_q.put(msg)
+            self._dispatch(msg)
             if self.stopped.is_set():
                 break
 
     def stop(self) -> None:
         self.stopped.set()
         self.out_q.put(None)
-        self.channel.close()
+        if self.channel is not None:
+            self.channel.close()
+
+
+class CcaasServer:
+    """Chaincode-as-a-service: the chaincode HOSTS `protos.Chaincode/
+    Connect` and the PEER dials in (reference fabric-chaincode-go
+    shim.ChaincodeServer; ccaas external builder). The message protocol
+    is byte-identical to the Register stream — REGISTER first from the
+    chaincode side, then the normal chat — only who dials whom flips."""
+
+    def __init__(self, chaincode, chaincode_id: str, listen_address: str = "127.0.0.1:0"):
+        from fabric_tpu.comm.server import GRPCServer, STREAM_STREAM
+
+        self.chaincode = chaincode
+        self.chaincode_id = chaincode_id
+        self._sessions: List[_Session] = []
+        self.server = GRPCServer(listen_address)
+        self.server.register(
+            "protos.Chaincode",
+            {
+                "Connect": (
+                    STREAM_STREAM,
+                    self._connect,
+                    CCM.FromString,
+                    CCM.SerializeToString,
+                )
+            },
+        )
+
+    def _connect(self, request_iterator, context):
+        session = _Session(self.chaincode, None, self.chaincode_id)
+        self._sessions.append(session)
+
+        def read_loop():
+            try:
+                for msg in request_iterator:
+                    session._dispatch(msg)
+            except Exception:  # noqa: BLE001 - peer went away
+                pass
+            finally:
+                session.stopped.set()
+                session.out_q.put(None)
+                # finished sessions leave the registry (a reconnecting
+                # peer must not accumulate dead queues for the process
+                # lifetime)
+                try:
+                    self._sessions.remove(session)
+                except ValueError:
+                    pass
+
+        threading.Thread(
+            target=read_loop, name=f"ccaas-read-{self.chaincode_id}", daemon=True
+        ).start()
+        # response stream: REGISTER first, then the session's replies
+        yield from session._gen()
+
+    def start(self) -> str:
+        return self.server.start()
+
+    def stop(self) -> None:
+        for s in self._sessions:
+            s.stop()
+        self.server.stop()
+
+    @property
+    def addr(self) -> str:
+        return self.server.addr
 
 
 def start(

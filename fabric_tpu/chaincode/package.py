@@ -27,8 +27,15 @@ class PackageError(ValueError):
     pass
 
 
-def package(label: str, code_files: Dict[str, bytes], cc_type: str = "python") -> bytes:
-    """Build a chaincode package from {relative path: bytes}."""
+def package(
+    label: str,
+    code_files: Dict[str, bytes],
+    cc_type: str = "python",
+    path: str = "",
+) -> bytes:
+    """Build a chaincode package from {relative path: bytes}. `path`
+    lands in metadata.json like the reference's platform path field
+    (persistence/chaincode_package.go ChaincodePackageMetadata)."""
     if not label or any(c in label for c in ":/\\"):
         raise PackageError(f"invalid label {label!r}")
     code_buf = io.BytesIO()
@@ -39,9 +46,10 @@ def package(label: str, code_files: Dict[str, bytes], cc_type: str = "python") -
             info.size = len(data)
             info.mtime = 0  # deterministic package bytes
             tar.addfile(info, io.BytesIO(data))
-    meta = json.dumps(
-        {"type": cc_type, "label": label}, sort_keys=True
-    ).encode()
+    meta_dict = {"type": cc_type, "label": label}
+    if path:
+        meta_dict["path"] = path
+    meta = json.dumps(meta_dict, sort_keys=True).encode()
 
     out = io.BytesIO()
     with tarfile.open(fileobj=out, mode="w:gz") as tar:

@@ -25,6 +25,24 @@ from fabric_tpu.ledger.simulator import TxSimulator
 from fabric_tpu.protos import peer_pb2
 
 
+def _parse_go_duration(value, default: float) -> float:
+    """Go duration string ("10s", "500ms", "1m30s") -> seconds; the
+    reference ccaas builder's connection.json uses this format. Falls
+    back to `default` only for absent/empty values; a malformed string
+    also defaults (matching the builder's lenient parse) but never
+    silently truncates a valid unit."""
+    if not value or not isinstance(value, str):
+        return default
+    import re
+
+    units = {"h": 3600.0, "m": 60.0, "s": 1.0, "ms": 0.001, "us": 1e-6}
+    # longest units first: "m" before "ms" would split "500ms" wrong
+    parts = re.findall(r"(\d+(?:\.\d+)?)(ms|us|h|m|s)", value)
+    if not parts or "".join(n + u for n, u in parts) != value:
+        return default
+    return sum(float(n) * units[u] for n, u in parts)
+
+
 class LaunchError(Exception):
     pass
 
@@ -143,19 +161,63 @@ class ChaincodeSupport:
                 raise LaunchError(
                     f"chaincode {name} package {pid} is not installed"
                 )
-            addr = (
-                self._chaincode_address()
-                if self._chaincode_address is not None
-                else None
-            )
-            if addr is None:
-                raise LaunchError("no chaincode listener address")
-            self.launcher.launch(installed, addr)
+            if installed.cc_type == "ccaas":
+                # chaincode-as-a-service (reference ccaas builder): the
+                # package carries connection.json and the PEER dials the
+                # already-running chaincode server
+                self._connect_ccaas(installed, pid)
+            else:
+                addr = (
+                    self._chaincode_address()
+                    if self._chaincode_address is not None
+                    else None
+                )
+                if addr is None:
+                    raise LaunchError("no chaincode listener address")
+                self.launcher.launch(installed, addr)
             if not self.listener.wait_for(pid, timeout=20.0):
                 raise LaunchError(
                     f"chaincode {name} ({pid}) did not register in time"
                 )
         return self.listener.chaincode(pid)
+
+    def _connect_ccaas(self, installed, pid: str) -> None:
+        import json as _json
+
+        from fabric_tpu.chaincode.package import parse_package
+
+        with open(installed.path, "rb") as f:
+            _meta, files = parse_package(f.read())
+        raw = files.get("connection.json") or files.get("src/connection.json")
+        if raw is None:
+            raise LaunchError(
+                f"ccaas package {pid} has no connection.json"
+            )
+        try:
+            conn_cfg = _json.loads(raw)
+            address = conn_cfg["address"]
+        except (ValueError, KeyError) as exc:
+            raise LaunchError(
+                f"ccaas package {pid}: bad connection.json: {exc}"
+            ) from exc
+        timeout = _parse_go_duration(conn_cfg.get("dial_timeout"), 10.0)
+        # reference ccaas schema: tls_required + PEM root_cert
+        root_ca = None
+        if conn_cfg.get("tls_required"):
+            pem = conn_cfg.get("root_cert", "")
+            if not pem:
+                raise LaunchError(
+                    f"ccaas {pid}: tls_required without root_cert"
+                )
+            root_ca = pem.encode() if isinstance(pem, str) else pem
+        try:
+            self.listener.connect_ccaas(
+                address, timeout=timeout, root_ca=root_ca, expected_name=pid
+            )
+        except Exception as exc:  # noqa: BLE001 - dial/handshake failure
+            raise LaunchError(
+                f"ccaas {pid}: cannot connect to {address}: {exc}"
+            ) from exc
 
     def invoke_cc2cc(
         self,

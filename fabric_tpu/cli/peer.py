@@ -447,8 +447,19 @@ def lifecycle_cmd(args) -> int:
                         files[os.path.relpath(full, src)] = f.read()
         else:
             with open(src, "rb") as f:
-                files["chaincode.py"] = f.read()
-        raw = package(args.label, files)
+                default_name = (
+                    "connection.json"
+                    if getattr(args, "lang", "python") == "ccaas"
+                    else "chaincode.py"
+                )
+                files[default_name] = f.read()
+        lang = getattr(args, "lang", "python") or "python"
+        if lang in ("golang", "node", "java"):
+            # reference lifecycle layout (core/chaincode/platforms):
+            # source rooted under src/ inside code.tar.gz, metadata.json
+            # carries the platform path
+            files = {f"src/{rel}": data for rel, data in files.items()}
+        raw = package(args.label, files, cc_type=lang, path=src)
         with open(args.outputFile, "wb") as f:
             f.write(raw)
         print(f"wrote chaincode package {args.outputFile}")
@@ -653,6 +664,14 @@ def main(argv=None) -> int:
     lp.add_argument("outputFile")
     lp.add_argument("--path", required=True)
     lp.add_argument("--label", required=True)
+    lp.add_argument(
+        "--lang",
+        default="python",
+        choices=["python", "golang", "node", "java", "ccaas"],
+        help="platform type written to metadata.json (golang/node/java "
+        "source roots under src/, the reference lifecycle layout; ccaas "
+        "packages connection.json for chaincode-as-a-service)",
+    )
     li = lc_sub.add_parser("install")
     li.add_argument("packageFile")
     lq = lc_sub.add_parser("queryinstalled")
