@@ -323,14 +323,19 @@ class ChaincodeListener:
                 request_serializer=CCM.SerializeToString,
                 response_deserializer=CCM.FromString,
             )(outgoing())
-            # bounded REGISTER wait: next() has no deadline of its own
+            # bounded REGISTER wait: next() has no deadline of its own;
+            # stream errors (UNIMPLEMENTED target, reset) surface through
+            # the queue too — a fast failure must not become a full-
+            # timeout hang with a misleading message
             first_q: "queue.Queue" = queue.Queue()
-            threading.Thread(
-                target=lambda: first_q.put(
-                    next(iter(responses), None)
-                ),
-                daemon=True,
-            ).start()
+
+            def _take_first():
+                try:
+                    first_q.put(next(iter(responses), None))
+                except Exception as exc:  # noqa: BLE001 - RpcError et al.
+                    first_q.put(exc)
+
+            threading.Thread(target=_take_first, daemon=True).start()
             try:
                 first = first_q.get(timeout=timeout)
             except queue.Empty:
@@ -338,16 +343,20 @@ class ChaincodeListener:
                 raise ExternalChaincodeError(
                     f"ccaas server at {address}: no REGISTER in {timeout}s"
                 )
+            if isinstance(first, BaseException):
+                raise ExternalChaincodeError(
+                    f"ccaas server at {address}: {first}"
+                ) from first
             if first is None or first.type != CCM.REGISTER:
                 raise ExternalChaincodeError(
                     f"ccaas server at {address} did not REGISTER"
                 )
+            ccid = peer_pb2.ChaincodeID()
+            ccid.ParseFromString(first.payload)
         except Exception:
             out_q.put(None)
             conn.close()
             raise
-        ccid = peer_pb2.ChaincodeID()
-        ccid.ParseFromString(first.payload)
         name = expected_name or ccid.name
         handler = _StreamHandler(name)
         handler.out_q = out_q  # peer->cc messages ride the request stream

@@ -431,6 +431,7 @@ def lifecycle_cmd(args) -> int:
 
         files = {}
         src = args.path
+        lang = getattr(args, "lang", None) or "python"
         if os.path.isdir(src):
             for root, dirs, names in os.walk(src):
                 # keep build junk out of the content-hashed package bytes
@@ -447,13 +448,9 @@ def lifecycle_cmd(args) -> int:
                         files[os.path.relpath(full, src)] = f.read()
         else:
             with open(src, "rb") as f:
-                default_name = (
-                    "connection.json"
-                    if getattr(args, "lang", "python") == "ccaas"
-                    else "chaincode.py"
-                )
-                files[default_name] = f.read()
-        lang = getattr(args, "lang", "python") or "python"
+                files[
+                    "connection.json" if lang == "ccaas" else "chaincode.py"
+                ] = f.read()
         if lang in ("golang", "node", "java"):
             # reference lifecycle layout (core/chaincode/platforms):
             # source rooted under src/ inside code.tar.gz, metadata.json

@@ -19,12 +19,33 @@ endorsement-path singles).  This batcher sits between them:
 Coalescing across channels keeps lanes/launch high even when individual
 blocks are small — the multi-channel aggregate (BASELINE config #5)
 benefits most.
+
+Transport-regime auto-detection (round-5, from round-3 measurements):
+coalescing WINS when launches are compute-bound (attached chip, ~1.1x)
+and LOSES when a fixed per-launch RTT dominates (the TPU tunnel:
+0.45-0.87x — serializing small requests behind one queue costs more
+than the lane-count gain). The batcher therefore measures the RTT of
+its own small launches (dispatch -> verdicts, lanes <= RTT_PROBE_LANES
+so device compute is negligible) and switches itself between:
+
+- "coalesce": linger + merge (low-RTT regime);
+- "passthrough": every request launches immediately as its own async
+  program, overlapping in flight exactly like independent callers —
+  while the bounded-lane admission (the P7 backpressure contract)
+  stays in force in both modes.
+
+FABRIC_TPU_BATCHER_MODE=coalesce|passthrough|auto (default auto)
+forces a mode; FABRIC_TPU_BATCHER_RTT_MS (default 25) is the auto
+threshold, chosen between attached-chip RTTs (<10ms) and tunnel RTTs
+(100-300ms) with hysteresis against flapping.
 """
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
+import time
 from typing import Callable, List, Optional, Sequence, Tuple
 
 
@@ -75,10 +96,48 @@ class VerifyBatcher:
         self._stopped = False
         self.launches = 0  # introspection: device programs dispatched
         self.lanes = 0  # total lanes verified
+        # transport-regime detection (see module docstring)
+        self._forced_mode = os.environ.get("FABRIC_TPU_BATCHER_MODE", "auto")
+        self._rtt_threshold_ms = float(
+            os.environ.get("FABRIC_TPU_BATCHER_RTT_MS", "25")
+        )
+        self.rtt_ema_ms: Optional[float] = None
+        # probe only launches small enough that device compute is
+        # negligible next to transport RTT even on an attached chip
+        # (64 lanes at ~65k verifies/s is ~1ms of compute; a 2048-lane
+        # coalesced launch is ~30ms of COMPUTE and would mis-flip a
+        # low-RTT chip into passthrough)
+        self.RTT_PROBE_LANES = 64
         self._thread = threading.Thread(
             target=self._run, name="verify-batcher", daemon=True
         )
         self._thread.start()
+
+    @property
+    def mode(self) -> str:
+        if self._forced_mode in ("coalesce", "passthrough"):
+            return self._forced_mode
+        if self.rtt_ema_ms is None:
+            return "coalesce"  # no signal yet: original default
+        # hysteresis band around the threshold stops mode flapping
+        if self.rtt_ema_ms > self._rtt_threshold_ms * 1.2:
+            return "passthrough"
+        if self.rtt_ema_ms < self._rtt_threshold_ms * 0.8:
+            return "coalesce"
+        return self._last_mode
+
+    _last_mode = "coalesce"
+
+    def _observe_rtt(self, lanes: int, elapsed_s: float) -> None:
+        if lanes > self.RTT_PROBE_LANES:
+            return
+        ms = elapsed_s * 1000.0
+        self.rtt_ema_ms = (
+            ms
+            if self.rtt_ema_ms is None
+            else 0.8 * self.rtt_ema_ms + 0.2 * ms
+        )
+        self._last_mode = self.mode
 
     def submit(
         self,
@@ -119,6 +178,11 @@ class VerifyBatcher:
             return None
         batch = [first]
         lanes = len(first.keys)
+        if self.mode == "passthrough":
+            # high-RTT regime: dispatch immediately, one launch per
+            # request, overlapping in flight (admission control already
+            # happened at submit)
+            return batch
         deadline = (
             threading.Event()
         )  # fresh event as a precise, interruptible sleep
@@ -141,12 +205,13 @@ class VerifyBatcher:
         return batch
 
     def _run(self) -> None:
-        pending: List[Tuple[List[_Request], Callable]] = []
+        # entries: (requests, resolver, dispatch_time, lanes)
+        pending: List[Tuple] = []
         while True:
             batch = self._take_batch()
             if batch is None:
-                for reqs, resolver in pending:
-                    self._settle(reqs, resolver)
+                for entry in pending:
+                    self._settle(*entry)
                 return
             keys: List = []
             sigs: List[bytes] = []
@@ -174,25 +239,30 @@ class VerifyBatcher:
                 continue
             self.launches += 1
             self.lanes += len(keys)
-            pending.append((batch, resolver))
+            pending.append((batch, resolver, time.perf_counter(), len(keys)))
             # depth-4 pipeline: keep up to three launches in flight before
             # settling the oldest — on high-RTT transports (the TPU
             # tunnel) serializing launches costs more than coalescing
             # saves, so small batches overlap like independent callers
             # would while large ones still coalesce
             while len(pending) > 3:
-                reqs, res = pending.pop(0)
-                self._settle(reqs, res)
+                self._settle(*pending.pop(0))
             if self._q.empty():
                 # idle: drain so callers aren't left waiting on us
                 while pending:
-                    reqs, res = pending.pop(0)
-                    self._settle(reqs, res)
+                    self._settle(*pending.pop(0))
 
-    @staticmethod
-    def _settle(reqs: List[_Request], resolver: Callable) -> None:
+    def _settle(
+        self,
+        reqs: List[_Request],
+        resolver: Callable,
+        t0: float = 0.0,
+        lanes: int = 0,
+    ) -> None:
         try:
             out = list(resolver())
+            if t0:
+                self._observe_rtt(lanes, time.perf_counter() - t0)
         except BaseException as exc:  # noqa: BLE001 - propagate to callers
             for r in reqs:
                 r.error = exc

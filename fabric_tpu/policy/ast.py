@@ -65,6 +65,17 @@ class SignaturePolicyEnvelope:
         object.__setattr__(self, "identities", tuple(identities))
         object.__setattr__(self, "version", version)
 
+    def __hash__(self):
+        # envelopes key every validator cache (policy fn, principals,
+        # pattern memo, policy groups) and the recursive dataclass hash
+        # walks the whole rule tree — at 1k-tx blocks that recomputation
+        # showed up as ~10% of the host path. Frozen => cache it.
+        h = self.__dict__.get("_hash")
+        if h is None:
+            h = hash((self.rule, self.identities, self.version))
+            object.__setattr__(self, "_hash", h)
+        return h
+
 
 # ---------------------------------------------------------------------------
 # DSL: AND / OR / OutOf over 'Msp.role' terms (reference common/policydsl)

@@ -151,7 +151,8 @@ class BlockValidator:
         # alias freed envelopes after a policy upgrade
         self._policy_fn_cache: Dict[SignaturePolicyEnvelope, Callable] = {}
         self._principals_cache: Dict[
-            SignaturePolicyEnvelope, List[msp_principal_pb2.MSPPrincipal]
+            SignaturePolicyEnvelope,
+            List[Tuple[msp_principal_pb2.MSPPrincipal, bytes]],
         ] = {}
         # serialized identity bytes -> validated Identity (or None when
         # deserialization / cert-chain validation failed). The native
@@ -321,24 +322,36 @@ class BlockValidator:
     def _prewarm_satisfaction(
         self, parsed: Sequence[ParsedTx], job_identity: Dict[int, Optional[Identity]]
     ) -> None:
+        # per-namespace memo: blocks usually invoke a handful of
+        # chaincodes, so resolve definition + principal list once per
+        # namespace, not once per tx (LifecycleRegistry.get builds a
+        # fresh definition object per call)
+        by_ns: Dict[str, Optional[List]] = {}
+        seen = set()
         for tx in parsed:
             if (
                 not tx.structurally_valid
                 or tx.header_type != common_pb2.ENDORSER_TRANSACTION
             ):
                 continue
-            definition = self.registry.get(tx.namespace)
-            if definition is None:
+            pairs = by_ns.get(tx.namespace, False)
+            if pairs is False:
+                definition = self.registry.get(tx.namespace)
+                pairs = (
+                    None
+                    if definition is None
+                    else self._principal_pairs(definition.endorsement_policy)
+                )
+                by_ns[tx.namespace] = pairs
+            if pairs is None:
                 continue
-            principals = self._principals_for(definition.endorsement_policy)
-            seen = set()
             for job in tx.endorsement_jobs:
                 ident = job_identity.get(id(job))
-                if ident is None or id(ident) in seen:
+                if ident is None or (id(ident), tx.namespace) in seen:
                     continue
-                seen.add(id(ident))
-                for pr in principals:
-                    self._satisfies(ident, pr)
+                seen.add((id(ident), tx.namespace))
+                for pr, pr_bytes in pairs:
+                    self._satisfies(ident, pr, pr_bytes)
 
     # ------------------------------------------------------------------
     def _assemble_codes(
@@ -430,8 +443,18 @@ class BlockValidator:
         return groups
 
     # ------------------------------------------------------------------
-    def _satisfies(self, ident: Identity, principal: msp_principal_pb2.MSPPrincipal) -> bool:
-        key = (ident.fingerprint(), principal.SerializeToString())
+    def _satisfies(
+        self,
+        ident: Identity,
+        principal: msp_principal_pb2.MSPPrincipal,
+        principal_bytes: Optional[bytes] = None,
+    ) -> bool:
+        key = (
+            ident.fingerprint(),
+            principal_bytes
+            if principal_bytes is not None
+            else principal.SerializeToString(),
+        )
         hit = self._principal_cache.get(key)
         if hit is None:
             try:
@@ -683,7 +706,7 @@ class BlockValidator:
     ) -> np.ndarray:
         """(valid deduped signers x principals) satisfaction matrix for
         one tx (SignatureSetToValidIdentities + principal matching)."""
-        principals = self._principals_for(env)
+        pairs = self._principal_pairs(env)
         rows = []
         seen_ids = set()
         for job in tx.endorsement_jobs:
@@ -696,8 +719,10 @@ class BlockValidator:
             seen_ids.add(fp)
             if not self._sig_ok(job):
                 continue
-            rows.append([self._satisfies(ident, pr) for pr in principals])
-        return np.array(rows, dtype=bool).reshape(len(rows), len(principals))
+            rows.append(
+                [self._satisfies(ident, pr, b) for pr, b in pairs]
+            )
+        return np.array(rows, dtype=bool).reshape(len(rows), len(pairs))
 
     def _pattern_key(self, tx: ParsedTx) -> tuple:
         """The tx's signer pattern: (Identity, sig_ok) per endorsement
@@ -788,11 +813,17 @@ class BlockValidator:
             self._policy_fn_cache[env] = fn
         return fn
 
-    def _principals_for(
+    def _principal_pairs(
         self, env: SignaturePolicyEnvelope
-    ) -> List[msp_principal_pb2.MSPPrincipal]:
+    ) -> List[Tuple[msp_principal_pb2.MSPPrincipal, bytes]]:
+        """[(principal, serialized)] — the bytes key the satisfaction
+        cache, and serializing once per policy instead of once per
+        (signer, principal) probe keeps the hot loop allocation-free."""
         ps = self._principals_cache.get(env)
         if ps is None:
-            ps = [principal_for(p) for p in env.identities]
+            ps = [
+                (pr, pr.SerializeToString())
+                for pr in (principal_for(p) for p in env.identities)
+            ]
             self._principals_cache[env] = ps
         return ps

@@ -184,3 +184,64 @@ def test_batching_provider_adapter():
         assert bp.batcher.lanes == 3
     finally:
         bp.stop()
+
+
+class SlowResolveProvider:
+    """Fixed per-launch 'RTT' in the resolver (tunnel simulation)."""
+
+    def __init__(self, rtt_s):
+        self.rtt_s = rtt_s
+        self.launch_sizes = []
+
+    def batch_verify_async(self, keys, sigs, digests):
+        self.launch_sizes.append(len(keys))
+        out = [k == b"ok" for k in keys]
+
+        def resolve():
+            time.sleep(self.rtt_s)
+            return out
+
+        return resolve
+
+
+def test_rtt_autodetect_switches_to_passthrough():
+    """High per-launch RTT flips the batcher to passthrough: each small
+    request becomes its own launch instead of coalescing."""
+    prov = SlowResolveProvider(rtt_s=0.08)  # 80ms >> 25ms threshold
+    b = VerifyBatcher(prov, linger_s=0.005)
+    try:
+        assert b.mode == "coalesce"  # no signal yet: default
+        for _ in range(4):
+            b.submit([b"ok"] * 8, [b""] * 8, [b""] * 8)()
+        assert b.rtt_ema_ms is not None and b.rtt_ema_ms > 30
+        assert b.mode == "passthrough"
+        # in passthrough, concurrent submissions do NOT merge
+        prov.launch_sizes.clear()
+        rs = [b.submit([b"ok"] * 8, [b""] * 8, [b""] * 8) for _ in range(3)]
+        for r in rs:
+            r()
+        assert all(s == 8 for s in prov.launch_sizes)
+    finally:
+        b.stop()
+
+
+def test_rtt_autodetect_stays_coalescing_when_fast():
+    prov = SlowResolveProvider(rtt_s=0.0)
+    b = VerifyBatcher(prov, linger_s=0.005)
+    try:
+        for _ in range(6):
+            b.submit([b"ok"] * 8, [b""] * 8, [b""] * 8)()
+        assert b.rtt_ema_ms is not None and b.rtt_ema_ms < 20
+        assert b.mode == "coalesce"
+    finally:
+        b.stop()
+
+
+def test_forced_mode_env(monkeypatch):
+    monkeypatch.setenv("FABRIC_TPU_BATCHER_MODE", "passthrough")
+    prov = SlowResolveProvider(rtt_s=0.0)
+    b = VerifyBatcher(prov, linger_s=0.005)
+    try:
+        assert b.mode == "passthrough"
+    finally:
+        b.stop()
