@@ -437,18 +437,65 @@ def bench_mvcc(device_ok=True, n_txs=5000):
     dev_ms, dev_codes = run(dev)
     if dev.last_path != "device" or dev_codes != host_codes:
         raise RuntimeError("config #4 device path mismatch")
+    # RESIDENT variant (VERDICT r4 #4): the table persists across
+    # blocks, so the measurement is a real multi-block sequence — block
+    # 1 pays one-time slot seeding + compile; steady state (block >= 2)
+    # runs committed checks + fixpoint + table update in ONE launch
+    # with no per-read host probes. Timed section is the validate call.
+    from fabric_tpu.ledger.mvcc_device import ResidentDeviceValidator
+
+    res = ResidentDeviceValidator(db)
+    ver = {i: (0, i) for i in range(n_txs)}
+
+    def resident_block(j):
+        rwsets2 = []
+        for i in range(n_txs):
+            rk = i - 1 if i % 10 == 5 else i  # in-block conflict pattern
+            rwsets2.append(
+                rw.TxRwSet(
+                    (
+                        rw.NsRwSet(
+                            "cc",
+                            (rw.KVRead(f"k{rk}", rw.Version(*ver[rk])),),
+                            (rw.KVWrite(f"k{i}", False, b"v1"),),
+                        ),
+                    )
+                )
+            )
+        start = time.perf_counter()
+        codes, _u, _h = res.validate_and_prepare_batch(
+            j, rwsets2, [TxValidationCode.VALID] * n_txs
+        )
+        ms = (time.perf_counter() - start) * 1000.0
+        n_conf = sum(
+            1 for c in codes if c == TxValidationCode.MVCC_READ_CONFLICT
+        )
+        if n_conf != n_txs // 10 or res.last_path != "device":
+            raise RuntimeError(
+                f"config #4 resident block {j}: {n_conf} conflicts, "
+                f"path {res.last_path}"
+            )
+        for i in range(n_txs):
+            if i % 10 != 5:
+                ver[i] = (j, i)
+        return ms
+
+    resident_block(1)  # seeding + compile
+    res_ms = min(resident_block(2), resident_block(3))
     return {
         "txs": n_txs,
         "host_ms_per_block": round(host_ms, 1),
         "device_ms_per_block": round(dev_ms, 1),
         "speedup": round(host_ms / dev_ms, 2),
+        "resident_ms_per_block": round(res_ms, 1),
+        "resident_speedup": round(host_ms / res_ms, 2),
         "note": "codes bit-identical; host scan stays the default "
-        "(ledger.deviceMVCC opts in). Measured r3: no crossover exists "
-        "on this topology (5k: 71 vs 164ms; 20k: 305 vs 527ms) — the "
-        "Python encode pass costs what the host scan costs, so the "
-        "remote-chip dispatch latency can never amortize; the win "
-        "condition is device-resident rwsets on an attached chip (see "
-        "ledger/mvcc_device.py docstring)",
+        "(ledger.deviceMVCC opts in). resident_* is the round-5 "
+        "device-RESIDENT version table (steady-state block: committed "
+        "checks + fixpoint + table update in ONE launch, no per-read "
+        "host get_version probes — the win condition round 3 named); "
+        "crossover still requires an attached chip if the launch RTT "
+        "exceeds the host scan",
     }
 
 

@@ -71,6 +71,12 @@ def _next_pow2(n: int, floor: int = 8) -> int:
     return p
 
 
+def _col(vals, pad_to, pad_val, dtype=np.int32):
+    a = np.full(pad_to, pad_val, dtype=dtype)
+    a[: len(vals)] = vals
+    return a
+
+
 @partial(jax.jit, static_argnames=("num_txs", "num_keys"))
 def _resolve(
     r_tx,
@@ -210,22 +216,24 @@ class DeviceValidator:
         Tb = _next_pow2(T)
         Kb = _next_pow2(K)
 
-        def col(vals, pad_to, pad_val, dtype=np.int32):
-            a = np.full(pad_to, pad_val, dtype=dtype)
-            a[: len(vals)] = vals
-            return a
-
         valid = _resolve(
-            col(r_tx, R, Tb),
-            col(r_key, R, Kb),
-            col(r_bad, R, 0, dtype=np.bool_),
-            col(w_tx, W, Tb),
-            col(w_key, W, Kb),
+            _col(r_tx, R, Tb),
+            _col(r_key, R, Kb),
+            _col(r_bad, R, 0, dtype=np.bool_),
+            _col(w_tx, W, Tb),
+            _col(w_key, W, Kb),
             num_txs=Tb,
             num_keys=Kb,
         )
-        valid = np.asarray(valid)
+        return self._emit(
+            np.asarray(valid), tx_rwsets, incoming_codes, block_num
+        )
 
+    def _emit(
+        self, valid, tx_rwsets, incoming_codes, block_num
+    ) -> Tuple[List[TxValidationCode], UpdateBatch, HashedUpdateBatch]:
+        """Device verdicts -> (codes, update batches); shared with the
+        resident variant so code-mapping fixes cannot diverge."""
         updates = UpdateBatch()
         hashed_updates = HashedUpdateBatch()
         out: List[TxValidationCode] = []
@@ -241,3 +249,312 @@ class DeviceValidator:
             else:
                 out.append(TxValidationCode.MVCC_READ_CONFLICT)
         return out, updates, hashed_updates
+
+
+# ---------------------------------------------------------------------------
+# Device-RESIDENT version table (round-5 experiment, VERDICT r4 #4)
+# ---------------------------------------------------------------------------
+
+
+@partial(
+    jax.jit,
+    static_argnames=("num_txs", "num_keys", "cap"),
+    donate_argnums=(0,),
+)
+def _resolve_resident(
+    versions,      # (cap, 2) int32 device-resident committed versions
+    init_idx,      # (I,) slots to initialize this launch (new keys +
+    init_ver,      # (I, 2)  host-fallback refresh), sentinel cap = no-op
+    r_gid,         # (R,) global slot per read (committed lookup)
+    r_ver,         # (R, 2) version the read claims
+    r_tx,
+    r_key,         # (R,) block-local dense key id (fixpoint segments)
+    w_tx,
+    w_key,         # (W,) block-local dense key id
+    w_gid,         # (W,) global slot per write (commit scatter)
+    w_ver,         # (W, 2) version the write commits ((-1,-1) = delete)
+    *,
+    num_txs: int,
+    num_keys: int,
+    cap: int,
+):
+    """One launch per block: initialize fresh slots, check every read
+    against the RESIDENT committed table (no host get_version probes),
+    run the validity fixpoint, and scatter the valid writes' versions
+    back into the table — which never leaves the device."""
+    versions = versions.at[init_idx].set(init_ver, mode="drop")
+    committed = versions[jnp.clip(r_gid, 0, cap - 1)]
+    r_static_bad = jnp.any(committed != r_ver, axis=1)
+
+    valid = _resolve(
+        r_tx, r_key, r_static_bad, w_tx, w_key,
+        num_txs=num_txs, num_keys=num_keys,
+    )
+
+    # commit: LAST valid writer per key wins (tx order = index order)
+    T1 = num_txs + 1
+    K1 = num_keys + 1
+    live = valid[w_tx]
+    writer = jnp.where(live, w_tx.astype(jnp.int32), jnp.int32(-1))
+    last_writer = jax.ops.segment_max(writer, w_key, num_segments=K1)
+    is_last = live & (w_tx.astype(jnp.int32) == last_writer[w_key])
+    scatter_idx = jnp.where(is_last, w_gid, jnp.int32(cap))
+    versions = versions.at[scatter_idx].set(w_ver, mode="drop")
+    return valid, versions
+
+
+class ResidentDeviceValidator(DeviceValidator):
+    """DeviceValidator variant that keeps the (ns, coll, key) -> version
+    table RESIDENT in device memory across blocks (the win condition
+    named in round 3's measurements: the per-block host encode pass no
+    longer probes db.get_version per read — committed-version checks,
+    the fixpoint, and the version-table update are one device launch).
+
+    Coherence contract: all commits for the tracked namespaces flow
+    through validate_and_prepare_batch (the kvledger path). Blocks that
+    fall back to the host oracle (range queries / metadata writes)
+    refresh the resident entries of the keys they wrote via the pending
+    init queue; state mutated behind the validator's back requires
+    `invalidate()`.
+
+    A key's slot is assigned on first sight and its committed version
+    seeded from the host db ONCE (one probe per key lifetime, not one
+    per block per read)."""
+
+    def __init__(self, db: VersionedDB, capacity: int = 1 << 17):
+        super().__init__(db)
+        self._cap = capacity
+        self._index: dict = {}  # (ns, coll, key) -> slot
+        self._dev_versions = None  # lazily created on first device block
+        self._pending_init: List[Tuple[int, Tuple[int, int]]] = []
+
+    # -- coherence ---------------------------------------------------------
+    def invalidate(self) -> None:
+        """Drop the resident table (state changed behind our back)."""
+        self._index.clear()
+        self._dev_versions = None
+        self._pending_init.clear()
+
+    def _note_batches(self, updates: UpdateBatch, hashed: HashedUpdateBatch):
+        """Queue refreshes for host-committed writes of tracked keys."""
+        for (ns, key), entry in updates.items():
+            slot = self._index.get((ns, "", key))
+            if slot is not None:
+                ver = (
+                    _NO_VERSION
+                    if entry.value is None
+                    else (entry.version.block_num, entry.version.tx_num)
+                )
+                self._pending_init.append((slot, ver))
+        for (ns, coll, key_hash), entry in hashed.items():
+            slot = self._index.get((ns, coll, key_hash))
+            if slot is not None:
+                ver = (
+                    _NO_VERSION
+                    if entry.value is None
+                    else (entry.version.block_num, entry.version.tx_num)
+                )
+                self._pending_init.append((slot, ver))
+
+    def _slot(self, k, inits: List[Tuple[int, Tuple[int, int]]]) -> int:
+        slot = self._index.get(k)
+        if slot is None:
+            slot = len(self._index)
+            self._index[k] = slot
+            ns, coll, key = k
+            committed = (
+                self.db.get_key_hash_version(ns, coll, key)
+                if coll
+                else self.db.get_version(ns, key)
+            )
+            inits.append(
+                (
+                    slot,
+                    (committed.block_num, committed.tx_num)
+                    if committed is not None
+                    else _NO_VERSION,
+                )
+            )
+        return slot
+
+    # -- public API --------------------------------------------------------
+    def validate_and_prepare_batch(
+        self,
+        block_num: int,
+        tx_rwsets: Sequence[Optional[TxRwSet]],
+        incoming_codes: Sequence[TxValidationCode],
+        do_mvcc: bool = True,
+    ) -> Tuple[List[TxValidationCode], UpdateBatch, HashedUpdateBatch]:
+        if not do_mvcc:
+            out = self._host.validate_and_prepare_batch(
+                block_num, tx_rwsets, incoming_codes, do_mvcc=False
+            )
+            # commits still flow: tracked resident entries must refresh
+            self._note_batches(out[1], out[2])
+            return out
+        enc = self._encode_resident(tx_rwsets, incoming_codes, block_num)
+        if enc is None:
+            self.last_path = "host"
+            out = self._host.validate_and_prepare_batch(
+                block_num, tx_rwsets, incoming_codes
+            )
+            self._note_batches(out[1], out[2])
+            return out
+        self.last_path = "device"
+        (r_tx, r_key, r_gid, r_ver, w_tx, w_key, w_gid, w_ver,
+         n_keys, inits) = enc
+        # dedupe by slot, LATEST entry wins: XLA scatter order for
+        # duplicate indices is undefined, and two queued refreshes of
+        # the same key must not let the stale one survive
+        merged = {}
+        for slot, v in self._pending_init + inits:
+            merged[slot] = v
+        inits = list(merged.items())
+        self._pending_init = []
+
+        # capacity growth (doubling) before the launch that needs it
+        while len(self._index) > self._cap:
+            if self._dev_versions is not None:
+                self._dev_versions = jnp.concatenate(
+                    [
+                        self._dev_versions,
+                        jnp.full((self._cap, 2), -1, dtype=jnp.int32),
+                    ]
+                )
+            self._cap *= 2
+        if self._dev_versions is None:
+            self._dev_versions = jnp.full(
+                (self._cap, 2), -1, dtype=jnp.int32
+            )
+
+        T = len(tx_rwsets)
+        K = max(n_keys, 1)
+        R = _next_pow2(max(len(r_tx), 1))
+        W = _next_pow2(max(len(w_tx), 1))
+        Ib = _next_pow2(max(len(inits), 1))
+        Tb = _next_pow2(T)
+        Kb = _next_pow2(K)
+
+        def col2(pairs, pad_to):
+            a = np.full((pad_to, 2), -1, dtype=np.int32)
+            if pairs:
+                a[: len(pairs)] = pairs
+            return a
+
+        init_idx = _col([i for i, _v in inits], Ib, self._cap)
+        init_ver = col2([v for _i, v in inits], Ib)
+        try:
+            valid, self._dev_versions = _resolve_resident(
+                self._dev_versions,
+                init_idx,
+                init_ver,
+                _col(r_gid, R, self._cap),
+                col2(r_ver, R),
+                _col(r_tx, R, Tb),
+                _col(r_key, R, Kb),
+                _col(w_tx, W, Tb),
+                _col(w_key, W, Kb),
+                _col(w_gid, W, self._cap),
+                col2(w_ver, W),
+                num_txs=Tb,
+                num_keys=Kb,
+                cap=self._cap,
+            )
+        except Exception:
+            # the table buffer is DONATED into the launch: after any
+            # dispatch failure its contents are unreliable — drop the
+            # residency and serve this block from the host oracle
+            self.invalidate()
+            self.last_path = "host"
+            out = self._host.validate_and_prepare_batch(
+                block_num, tx_rwsets, incoming_codes
+            )
+            self._note_batches(out[1], out[2])
+            return out
+
+        return self._emit(
+            np.asarray(valid), tx_rwsets, incoming_codes, block_num
+        )
+
+    # -- encoding ----------------------------------------------------------
+    def _encode_resident(self, tx_rwsets, incoming_codes, block_num):
+        """Like DeviceValidator._encode but WITHOUT per-read host
+        get_version probes: reads carry their claimed version and a
+        global resident slot; the committed comparison happens on
+        device. Writes carry the version they would commit."""
+        inits: List[Tuple[int, Tuple[int, int]]] = []
+        local_ids: dict = {}
+        r_tx: List[int] = []
+        r_key: List[int] = []
+        r_gid: List[int] = []
+        r_ver: List[Tuple[int, int]] = []
+        w_tx: List[int] = []
+        w_key: List[int] = []
+        w_gid: List[int] = []
+        w_ver: List[Tuple[int, int]] = []
+
+        def lid(k) -> int:
+            i = local_ids.get(k)
+            if i is None:
+                i = len(local_ids)
+                local_ids[k] = i
+            return i
+
+        def abort():
+            # slots assigned during this walk stay in the index; their
+            # seeds must not be lost or the slots would sit at the
+            # uninitialized sentinel forever (false conflicts later)
+            self._pending_init.extend(inits)
+            return None
+
+        for t, (rwset, code) in enumerate(zip(tx_rwsets, incoming_codes)):
+            if code != TxValidationCode.VALID or rwset is None:
+                continue
+            for ns_rw in rwset.ns_rw_sets:
+                if ns_rw.range_queries or ns_rw.metadata_writes:
+                    return abort()
+                ns = ns_rw.namespace
+                for read in ns_rw.reads:
+                    k = (ns, "", read.key)
+                    r_tx.append(t)
+                    r_key.append(lid(k))
+                    r_gid.append(self._slot(k, inits))
+                    v = read.version
+                    r_ver.append(
+                        (v.block_num, v.tx_num) if v is not None else _NO_VERSION
+                    )
+                for w in ns_rw.writes:
+                    k = (ns, "", w.key)
+                    w_tx.append(t)
+                    w_key.append(lid(k))
+                    w_gid.append(self._slot(k, inits))
+                    w_ver.append(
+                        _NO_VERSION if w.is_delete else (block_num, t)
+                    )
+                for coll in ns_rw.coll_hashed:
+                    if coll.metadata_writes:
+                        return abort()
+                    cn = coll.collection_name
+                    for hread in coll.hashed_reads:
+                        k = (ns, cn, hread.key_hash)
+                        r_tx.append(t)
+                        r_key.append(lid(k))
+                        r_gid.append(self._slot(k, inits))
+                        v = hread.version
+                        r_ver.append(
+                            (v.block_num, v.tx_num)
+                            if v is not None
+                            else _NO_VERSION
+                        )
+                    for hw in coll.hashed_writes:
+                        k = (ns, cn, hw.key_hash)
+                        w_tx.append(t)
+                        w_key.append(lid(k))
+                        w_gid.append(self._slot(k, inits))
+                        w_ver.append(
+                            _NO_VERSION if hw.is_delete else (block_num, t)
+                        )
+        return (
+            r_tx, r_key, r_gid, r_ver, w_tx, w_key, w_gid, w_ver,
+            len(local_ids), inits,
+        )

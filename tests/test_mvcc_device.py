@@ -340,3 +340,177 @@ def test_randomized_blocks_match_oracle():
                 )
             )
         assert_same(db, trial + 1, rwsets, incoming)
+
+
+# ----------------------------------------------------------------------
+# device-RESIDENT version table (round 5): multi-block sequences through
+# ONE validator must match a fresh host oracle per block
+# ----------------------------------------------------------------------
+
+
+def test_resident_multi_block_sequence_matches_oracle():
+    from fabric_tpu.ledger.mvcc_device import ResidentDeviceValidator
+
+    db = seeded_db()
+    res = ResidentDeviceValidator(db, capacity=64)  # force growth too
+    rng = random.Random(42)
+
+    for block_num in range(1, 8):
+        rwsets = []
+        for t in range(12):
+            reads = []
+            writes = []
+            for _ in range(rng.randrange(3)):
+                i = rng.randrange(50)  # some keys beyond the seed -> absent
+                committed = db.get_version("cc", f"k{i}")
+                claim = (
+                    committed
+                    if rng.random() < 0.7
+                    else rw.Version(9, 9)  # stale claim -> conflict
+                )
+                reads.append(rw.KVRead(f"k{i}", claim))
+            for _ in range(rng.randrange(3)):
+                i = rng.randrange(50)
+                writes.append(
+                    rw.KVWrite(f"k{i}", rng.random() < 0.15, b"v")
+                )
+            # occasional hashed activity
+            colls = ()
+            if rng.random() < 0.3:
+                hi = rng.randrange(25)
+                colls = (
+                    rw.CollHashedRwSet(
+                        "coll0",
+                        (
+                            rw.KVReadHash(
+                                f"h{hi}".encode(),
+                                db.get_key_hash_version(
+                                    "cc", "coll0", f"h{hi}".encode()
+                                ),
+                            ),
+                        ),
+                        (
+                            rw.KVWriteHash(
+                                f"h{hi}".encode(), False, b"\x02" * 32
+                            ),
+                        ),
+                        (),
+                    ),
+                )
+            rwsets.append(
+                rw.TxRwSet(
+                    (rw.NsRwSet("cc", tuple(reads), tuple(writes), (), colls),)
+                )
+            )
+        incoming = [VALID] * len(rwsets)
+        host_codes, host_up, host_hup = Validator(db).validate_and_prepare_batch(
+            block_num, rwsets, list(incoming)
+        )
+        res_codes, res_up, res_hup = res.validate_and_prepare_batch(
+            block_num, rwsets, list(incoming)
+        )
+        assert res.last_path == "device"
+        assert res_codes == host_codes, f"block {block_num}"
+        assert batches_equal(res_up, host_up)
+        assert batches_equal(res_hup, host_hup)
+        db.apply_updates(host_up, hashed=host_hup)
+
+
+def test_resident_host_fallback_refreshes_table():
+    """A range-query block takes the host path; the resident table must
+    refresh the keys it wrote, so the NEXT device block still agrees."""
+    from fabric_tpu.ledger.mvcc_device import ResidentDeviceValidator
+
+    db = seeded_db()
+    res = ResidentDeviceValidator(db)
+
+    # block 1 (device): touch k0 so it becomes resident
+    b1 = [
+        rw.TxRwSet(
+            (
+                rw.NsRwSet(
+                    "cc",
+                    (rw.KVRead("k0", rw.Version(0, 0)),),
+                    (rw.KVWrite("k0", False, b"v1"),),
+                ),
+            )
+        )
+    ]
+    codes, up, hup = res.validate_and_prepare_batch(1, b1, [VALID])
+    assert res.last_path == "device" and codes == [VALID]
+    db.apply_updates(up, hashed=hup)
+
+    # block 2 (host fallback: metadata write present) ALSO writes k0
+    b2 = [
+        rw.TxRwSet(
+            (
+                rw.NsRwSet(
+                    "cc",
+                    (),
+                    (rw.KVWrite("k0", False, b"v2"),),
+                    (),
+                    (),
+                    (rw.KVMetadataWrite("k30", (("p", b"x"),)),),
+                ),
+            )
+        )
+    ]
+    codes, up, hup = res.validate_and_prepare_batch(2, b2, [VALID])
+    assert res.last_path == "host" and codes == [VALID]
+    db.apply_updates(up, hashed=hup)
+
+    # block 3 (device): a read claiming k0@(2,0) must be VALID; one
+    # claiming the stale (1,0) must conflict — both against the
+    # REFRESHED resident entry
+    b3 = [
+        rw.TxRwSet(
+            (rw.NsRwSet("cc", (rw.KVRead("k0", rw.Version(2, 0)),), ()),)
+        ),
+        rw.TxRwSet(
+            (rw.NsRwSet("cc", (rw.KVRead("k0", rw.Version(1, 0)),), ()),)
+        ),
+    ]
+    codes, _up, _hup = res.validate_and_prepare_batch(3, b3, [VALID, VALID])
+    assert res.last_path == "device"
+    assert codes == [VALID, TxValidationCode.MVCC_READ_CONFLICT]
+
+
+def test_resident_aborted_encode_keeps_slots_seeded():
+    """An encode that aborts midway (metadata write later in the block)
+    has already assigned slots; their seeds must survive via the pending
+    queue or later device blocks see uninitialized sentinels (review r5
+    finding)."""
+    from fabric_tpu.ledger.mvcc_device import ResidentDeviceValidator
+
+    db = seeded_db()
+    res = ResidentDeviceValidator(db)
+    # tx0 reads k5 (slot assigned + seed collected), tx1 forces abort
+    b1 = [
+        rw.TxRwSet(
+            (rw.NsRwSet("cc", (rw.KVRead("k5", rw.Version(0, 5)),), ()),)
+        ),
+        rw.TxRwSet(
+            (
+                rw.NsRwSet(
+                    "cc", (), (), (), (),
+                    (rw.KVMetadataWrite("k9", (("p", b"x"),)),),
+                ),
+            )
+        ),
+    ]
+    codes, up, hup = res.validate_and_prepare_batch(1, b1, [VALID, VALID])
+    assert res.last_path == "host" and codes == [VALID, VALID]
+    db.apply_updates(up, hashed=hup)
+
+    # device block: k5's read at its TRUE committed version must pass
+    b2 = [
+        rw.TxRwSet(
+            (rw.NsRwSet("cc", (rw.KVRead("k5", rw.Version(0, 5)),), ()),)
+        ),
+        rw.TxRwSet(
+            (rw.NsRwSet("cc", (rw.KVRead("k5", rw.Version(7, 7)),), ()),)
+        ),
+    ]
+    codes, _u, _h = res.validate_and_prepare_batch(2, b2, [VALID, VALID])
+    assert res.last_path == "device"
+    assert codes == [VALID, TxValidationCode.MVCC_READ_CONFLICT]
