@@ -61,6 +61,10 @@ def _load_node(config_path: str) -> PeerNode:
     # core/peer/config.go)
     apply_env_overrides(cfg, "CORE")
     pc = cfg.get("peer") or {}
+    # MSPs + signer default to the software provider (configbuilder)
+    # so their setup never probes for an accelerator; the node's BATCH
+    # provider below (BCCSP config / default_provider) still does, with
+    # the bounded probe + software fallback.
     msps = [
         load_msp(path, msp_id)
         for msp_id, path in (pc.get("orgMspDirs") or {}).items()

@@ -92,8 +92,24 @@ def load_msp_config(org_msp_dir: str, msp_id: str) -> MSPConfig:
     )
 
 
+def _default_msp_provider():
+    """MSP cert-chain checks and local signing are single-op host
+    crypto — work TPUProvider delegates to the software path anyway —
+    so config-loaded MSPs/signers default to the SOFTWARE provider
+    rather than default_provider(): the latter probes for an
+    accelerator, and a hung tunnel must never stall a CLI client or a
+    node's MSP setup (observed as 60s client hangs). Callers that
+    really want a device-backed provider pass it explicitly."""
+    from fabric_tpu.crypto.bccsp import SoftwareProvider
+
+    return SoftwareProvider()
+
+
 def load_msp(org_msp_dir: str, msp_id: str, provider=None) -> MSP:
-    return MSP(load_msp_config(org_msp_dir, msp_id), provider)
+    return MSP(
+        load_msp_config(org_msp_dir, msp_id),
+        provider or _default_msp_provider(),
+    )
 
 
 def load_signing_identity(
@@ -119,4 +135,4 @@ def load_signing_identity(
     node = NodeIdentity(
         name=name, cert_pem=cert_pem, key=key, msp_id=msp_id
     )
-    return SigningIdentity(node, provider)
+    return SigningIdentity(node, provider or _default_msp_provider())
