@@ -52,6 +52,22 @@ from fabric_tpu.validation.validator import ChaincodeDefinition, ChaincodeRegist
 logger = flogging.must_get_logger("peer.main")
 
 
+def _tls_from_config(tls_cfg):
+    """peer.tls: {cert, key, clientRootCAs?} -> hot-reloading server
+    credentials (comm.server.CertReloader; rotation = file swap)."""
+    if not tls_cfg or not tls_cfg.get("enabled", True):
+        return None
+    cert = tls_cfg.get("cert")
+    key = tls_cfg.get("key")
+    if not cert or not key:
+        return None
+    from fabric_tpu.comm.server import CertReloader
+
+    return CertReloader(
+        cert, key, tls_cfg.get("clientRootCAs")
+    ).credentials()
+
+
 def _load_node(config_path: str) -> PeerNode:
     from fabric_tpu.utils.config import apply_env_overrides
 
@@ -125,6 +141,10 @@ def _load_node(config_path: str) -> PeerNode:
         # ledger.deviceMVCC: resolve MVCC on device (SURVEY P5)
         device_mvcc=bool((cfg.get("ledger") or {}).get("deviceMVCC")),
         plugin_registry=plugin_registry,
+        tls_credentials=_tls_from_config(pc.get("tls")),
+        # per-service concurrent-RPC caps (grpc_limiters.go), e.g.
+        #   limits: {"protos.Endorser": 50, "protos.Deliver": 25}
+        rpc_limits=pc.get("limits"),
     )
     # External-builder analog (core/container/externalbuilder): user
     # chaincode loads as python modules, "module.path:ClassName", with

@@ -42,6 +42,149 @@ def tls_server_credentials(
     )
 
 
+class CertReloader:
+    """File-backed server cert source with hot reload (reference
+    usable-inter-nal/pkg/comm/server.go:44 SetServerCertificate: certs
+    rotate without restarting the server). gRPC asks the fetcher for a
+    fresh certificate configuration on every new TLS handshake; this
+    one re-reads the PEMs only when an mtime changed, so rotation is a
+    file swap (the k8s secret-mount pattern)."""
+
+    def __init__(
+        self,
+        cert_path: str,
+        key_path: str,
+        client_ca_path: Optional[str] = None,
+    ):
+        import os as _os
+
+        self._os = _os
+        self._cert_path = cert_path
+        self._key_path = key_path
+        self._ca_path = client_ca_path
+        self._mtimes = None
+        self._config = None
+        self.reloads = 0  # introspection for tests/ops
+        self._fetch(strict=True)  # misconfigured paths fail at startup
+
+    def _stat(self):
+        paths = [self._cert_path, self._key_path]
+        if self._ca_path:
+            paths.append(self._ca_path)
+        return tuple(self._os.stat(p).st_mtime_ns for p in paths)
+
+    def _fetch(self, strict: bool = False):
+        try:
+            mtimes = self._stat()
+            if self._config is None or mtimes != self._mtimes:
+                with open(self._key_path, "rb") as f:
+                    key = f.read()
+                with open(self._cert_path, "rb") as f:
+                    cert = f.read()
+                ca = None
+                if self._ca_path:
+                    with open(self._ca_path, "rb") as f:
+                        ca = f.read()
+                self._config = grpc.ssl_server_certificate_configuration(
+                    [(key, cert)], root_certificates=ca
+                )
+                self._mtimes = mtimes
+                self.reloads += 1
+        except OSError:
+            if strict:
+                raise  # startup: surface the misconfiguration now
+            # rotation in progress (file momentarily absent): keep
+            # serving the last good configuration
+        return self._config
+
+    def credentials(self) -> grpc.ServerCredentials:
+        return grpc.dynamic_ssl_server_credentials(
+            self._config,
+            self._fetch,
+            require_client_authentication=self._ca_path is not None,
+        )
+
+
+class ConcurrencyLimiter(grpc.ServerInterceptor):
+    """Per-service concurrent-RPC limits (reference
+    usable-inter-nal/peer/node/grpc_limiters.go: the endorser and
+    deliver services get independent caps so one flooded service cannot
+    starve the node). Over-limit RPCs are refused with
+    RESOURCE_EXHAUSTED rather than queued — backpressure the client can
+    see, like the reference's limiter returning ErrLimitExceeded."""
+
+    def __init__(self, limits: Dict[str, int]):
+        import threading
+
+        self._sems = {
+            svc: threading.BoundedSemaphore(n) for svc, n in limits.items()
+        }
+
+    def intercept_service(self, continuation, handler_call_details):
+        # method: "/service.Name/Method"
+        parts = handler_call_details.method.split("/")
+        svc = parts[1] if len(parts) > 1 else ""
+        sem = self._sems.get(svc)
+        handler = continuation(handler_call_details)
+        if sem is None or handler is None:
+            return handler
+        return _limited_handler(handler, sem, svc)
+
+
+def _limited_handler(handler, sem, svc: str):
+    def wrap_unary(behavior):
+        def limited(request, context):
+            if not sem.acquire(blocking=False):
+                context.abort(
+                    grpc.StatusCode.RESOURCE_EXHAUSTED,
+                    f"concurrency limit reached for {svc}",
+                )
+            try:
+                return behavior(request, context)
+            finally:
+                sem.release()
+
+        return limited
+
+    def wrap_stream(behavior):
+        def limited(request_or_iterator, context):
+            if not sem.acquire(blocking=False):
+                context.abort(
+                    grpc.StatusCode.RESOURCE_EXHAUSTED,
+                    f"concurrency limit reached for {svc}",
+                )
+            try:
+                yield from behavior(request_or_iterator, context)
+            finally:
+                sem.release()
+
+        return limited
+
+    if handler.unary_unary:
+        return grpc.unary_unary_rpc_method_handler(
+            wrap_unary(handler.unary_unary),
+            request_deserializer=handler.request_deserializer,
+            response_serializer=handler.response_serializer,
+        )
+    if handler.unary_stream:
+        return grpc.unary_stream_rpc_method_handler(
+            wrap_stream(handler.unary_stream),
+            request_deserializer=handler.request_deserializer,
+            response_serializer=handler.response_serializer,
+        )
+    if handler.stream_unary:
+        return grpc.stream_unary_rpc_method_handler(
+            wrap_unary(handler.stream_unary),
+            request_deserializer=handler.request_deserializer,
+            response_serializer=handler.response_serializer,
+        )
+    return grpc.stream_stream_rpc_method_handler(
+        wrap_stream(handler.stream_stream),
+        request_deserializer=handler.request_deserializer,
+        response_serializer=handler.response_serializer,
+    )
+
+
 class GRPCServer:
     def __init__(
         self,

@@ -5,17 +5,24 @@ sampleconfig/core.yaml:295-319 BCCSP section).
 Config shape (the core.yaml BCCSP block):
 
   BCCSP:
-    Default: TPU          # TPU | SW  (TPU occupies the PKCS11 slot,
-                          #  SURVEY.md §2.12: the accelerator provider
-                          #  IS the out-of-process crypto module analog)
+    Default: TPU          # TPU | SW | PKCS11
+                          #  TPU is the accelerator provider (SURVEY
+                          #  §2.12: architecturally the out-of-process
+                          #  crypto-module slot); PKCS11 is a REAL
+                          #  Cryptoki HSM binding (crypto/pkcs11.py)
     SW:
       Hash: SHA2
       Security: 256
     TPU:
       MinDeviceBatch: 32  # below this, verification stays on host
+    PKCS11:
+      Library: /usr/lib/softhsm/libsofthsm2.so
+      Pin: "98765432"
+      Slot: 0             # optional; first token slot when omitted
 
-Unknown defaults fall back to SW with a warning, like the reference's
-factory error path.
+TPU degrades to SW when no device answers; PKCS11 errors HARD on a
+missing library (an operator who configured an HSM must not silently
+run on software keys), like the reference's pkcs11factory.
 """
 
 from __future__ import annotations
@@ -49,6 +56,21 @@ def provider_from_config(cfg: Optional[dict]) -> Provider:
 
     if default == "SW":
         return SoftwareProvider()
+    if default == "PKCS11":
+        # HSM slot (bccsp/factory/pkcs11factory.go): a missing or
+        # unloadable library is a hard error, exactly like the
+        # reference — an operator who configured an HSM must not be
+        # silently downgraded to software keys
+        from fabric_tpu.crypto.pkcs11 import Cryptoki, PKCS11Provider
+
+        p11 = cfg.get("PKCS11") or {}
+        library = p11.get("Library")
+        if not library:
+            raise FactoryError("BCCSP.PKCS11.Library is required")
+        token = Cryptoki(
+            library, str(p11.get("Pin", "")), p11.get("Slot")
+        )
+        return PKCS11Provider(token)
     if default == "TPU":
         try:
             from fabric_tpu.crypto.tpu_provider import TPUProvider

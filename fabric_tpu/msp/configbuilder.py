@@ -121,18 +121,37 @@ def load_signing_identity(
     if not certs:
         raise ValueError(f"no signcerts in {node_msp_dir}")
     cert_pem = open(os.path.join(sign_dir, certs[0]), "rb").read()
-    key_dir = os.path.join(node_msp_dir, "keystore")
-    keys = sorted(os.listdir(key_dir))
-    if not keys:
-        raise ValueError(f"no keystore entries in {node_msp_dir}")
-    key = serialization.load_pem_private_key(
-        open(os.path.join(key_dir, keys[0]), "rb").read(), password=None
-    )
     cert = x509.load_pem_x509_certificate(cert_pem)
     name = cert.subject.get_attributes_for_oid(
         x509.NameOID.COMMON_NAME
     )[0].value
+    key_dir = os.path.join(node_msp_dir, "keystore")
+    keys = sorted(os.listdir(key_dir)) if os.path.isdir(key_dir) else []
+    key = None
+    token_ski = b""
+    if keys:
+        key = serialization.load_pem_private_key(
+            open(os.path.join(key_dir, keys[0]), "rb").read(), password=None
+        )
+    elif provider is not None and hasattr(provider, "sign_by_ski"):
+        # HSM deployment (reference msp + bccsp/pkcs11): no keystore on
+        # disk — the private key lives on the token, addressed by the
+        # SKI derived from the cert's public key (sha256 over the
+        # uncompressed EC point, pkcs11.go's ski convention)
+        import hashlib
+
+        point = cert.public_key().public_bytes(
+            serialization.Encoding.X962,
+            serialization.PublicFormat.UncompressedPoint,
+        )
+        token_ski = hashlib.sha256(point).digest()
+    else:
+        raise ValueError(f"no keystore entries in {node_msp_dir}")
     node = NodeIdentity(
-        name=name, cert_pem=cert_pem, key=key, msp_id=msp_id
+        name=name,
+        cert_pem=cert_pem,
+        key=key,
+        msp_id=msp_id,
+        token_ski=token_ski,
     )
     return SigningIdentity(node, provider or _default_msp_provider())

@@ -47,11 +47,19 @@ class TLSPair:
 class NodeIdentity:
     name: str
     cert_pem: bytes
-    key: ec.EllipticCurvePrivateKey
+    # None for HSM deployments: the private key lives on a PKCS#11
+    # token, addressed by token_ski (bccsp/pkcs11 getECKey by SKI)
+    key: Optional[ec.EllipticCurvePrivateKey]
     msp_id: str
+    token_ski: bytes = b""
 
     @property
     def priv_scalar(self) -> int:
+        if self.key is None:
+            raise ValueError(
+                f"identity {self.name} is token-resident (SKI "
+                f"{self.token_ski.hex()}); no in-process private scalar"
+            )
         return self.key.private_numbers().private_value
 
 

@@ -25,8 +25,20 @@ class SigningIdentity:
 
     def sign(self, msg: bytes) -> bytes:
         """SHA-256 digest then low-S ECDSA, DER-encoded (the reference
-        signer path: bccsp Hash + Sign, msp/identities.go Sign)."""
+        signer path: bccsp Hash + Sign, msp/identities.go Sign). A
+        token-resident key (NodeIdentity.token_ski set, HSM deployment)
+        signs THROUGH the provider's PKCS#11 session — the scalar never
+        exists in process memory (bccsp/pkcs11 signECDSA)."""
         digest = self._provider.hash(msg)
+        token_ski = getattr(self.node, "token_ski", b"")
+        if token_ski:
+            sign_by_ski = getattr(self._provider, "sign_by_ski", None)
+            if sign_by_ski is None:
+                raise ValueError(
+                    "identity key is token-resident but the provider "
+                    "has no PKCS#11 session (configure BCCSP PKCS11)"
+                )
+            return sign_by_ski(token_ski, digest)
         r, s = ec_backend().sign_digest(self.node.priv_scalar, digest)
         return der.marshal_signature(r, s)
 

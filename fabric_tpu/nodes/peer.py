@@ -50,6 +50,12 @@ class PeerNode:
         # dispatcher.PluginRegistry with custom validation plugins loaded
         # from node config (reference core/handlers/library registry)
         plugin_registry=None,
+        # grpc.ServerCredentials (e.g. comm.server.CertReloader
+        # .credentials() for hot-rotating TLS) — None = plaintext
+        tls_credentials=None,
+        # per-service concurrent-RPC caps, e.g. {"protos.Endorser": 50}
+        # (reference usable-inter-nal/peer/node/grpc_limiters.go)
+        rpc_limits=None,
     ):
         self.work_dir = work_dir
         self.msp_manager = msp_manager
@@ -174,7 +180,17 @@ class PeerNode:
                 MetricsInterceptor(self.ops.provider),
             ]
 
-        self.server = GRPCServer(listen_address, interceptors=interceptors)
+        if rpc_limits:
+            from fabric_tpu.comm.server import ConcurrencyLimiter
+
+            interceptors = [ConcurrencyLimiter(dict(rpc_limits))] + list(
+                interceptors
+            )
+        self.server = GRPCServer(
+            listen_address,
+            credentials=tls_credentials,
+            interceptors=interceptors,
+        )
         register_endorser(self.server, self.endorser)
         register_peer_deliver(
             self.server,

@@ -144,3 +144,88 @@ def test_payload_logging_at_debug_level():
     finally:
         server2.stop()
     assert records == []
+
+
+# ----------------------------------------------------------------------
+# per-service concurrency limits + cert hot reload (round 5;
+# usable-inter-nal/peer/node/grpc_limiters.go + pkg/comm server.go:44)
+# ----------------------------------------------------------------------
+
+
+def test_concurrency_limiter_rejects_over_limit():
+    import threading
+    import time
+
+    import grpc
+
+    from fabric_tpu.comm.server import (
+        ConcurrencyLimiter,
+        GRPCServer,
+        UNARY,
+        channel_to,
+    )
+
+    gate = threading.Event()
+    started = threading.Event()
+
+    def slow_echo(request, context):
+        started.set()
+        gate.wait(5.0)
+        return request
+
+    server = GRPCServer(
+        "127.0.0.1:0",
+        interceptors=[ConcurrencyLimiter({"test.Slow": 1})],
+    )
+    server.register(
+        "test.Slow", {"Go": (UNARY, slow_echo, bytes, bytes)}
+    )
+    addr = server.start()
+    try:
+        conn = channel_to(addr)
+        call = conn.unary_unary("/test.Slow/Go")
+        fut = call.future(b"a")  # occupies the single slot
+        assert started.wait(5.0)
+        with pytest.raises(grpc.RpcError) as err:
+            call(b"b", timeout=5.0)  # second concurrent -> refused
+        assert err.value.code() == grpc.StatusCode.RESOURCE_EXHAUSTED
+        gate.set()
+        assert fut.result(timeout=5.0) == b"a"
+        # slot released: next call passes
+        assert call(b"c", timeout=5.0) == b"c"
+        conn.close()
+    finally:
+        server.stop()
+
+
+def test_cert_reloader_tracks_file_changes(tmp_path):
+    from fabric_tpu.comm.server import CertReloader
+    from fabric_tpu.msp.cryptogen import OrgCA
+
+    ca = OrgCA("reload.test", "Org1MSP")
+    pair1 = ca.enroll_tls("node1")
+    pair2 = ca.enroll_tls("node1")  # rotated material, same CA
+
+    cert = tmp_path / "server.crt"
+    key = tmp_path / "server.key"
+    cert.write_bytes(pair1.cert_pem)
+    key.write_bytes(pair1.key_pem)
+
+    reloader = CertReloader(str(cert), str(key))
+    assert reloader.reloads == 1
+    reloader._fetch()
+    assert reloader.reloads == 1  # unchanged files: no re-read
+
+    import os
+
+    cert.write_bytes(pair2.cert_pem)
+    key.write_bytes(pair2.key_pem)
+    os.utime(cert)  # ensure fresh mtime even on coarse clocks
+    reloader._fetch()
+    assert reloader.reloads == 2  # rotation picked up
+
+    # rotation-in-progress: a missing file keeps the last good config
+    key.unlink()
+    cfg = reloader._fetch()
+    assert cfg is not None and reloader.reloads == 2
+    assert reloader.credentials() is not None

@@ -1,0 +1,151 @@
+"""PKCS#11 provider (reference bccsp/pkcs11): provider logic tested
+against a FAKED Cryptoki token (the image ships no HSM): token
+signatures get low-S normalization + DER wrap identical to the software
+path, SKI-located keys are cached, verify semantics match the SW
+contract, and the factory errors hard on a missing library."""
+
+import hashlib
+
+import pytest
+
+from fabric_tpu.crypto import der, fastec, p256
+from fabric_tpu.crypto.bccsp import ECDSAPublicKey, SoftwareProvider
+from fabric_tpu.crypto.factory import FactoryError, provider_from_config
+from fabric_tpu.crypto.pkcs11 import PKCS11Error, PKCS11Provider
+
+
+class FakeToken:
+    """Cryptoki stand-in: one resident P-256 keypair addressed by SKI.
+    sign_raw deliberately returns HIGH-S half the time so the
+    provider's toLowS normalization is exercised (pkcs11.go:486)."""
+
+    def __init__(self):
+        self.kp = fastec.generate_keypair()
+        self.ski = hashlib.sha256(b"token-key").digest()[:20]
+        self.find_calls = 0
+        self._flip = False
+
+    def find_key(self, ski, private):
+        self.find_calls += 1
+        if ski != self.ski:
+            raise PKCS11Error(f"no key with SKI {ski.hex()} on token")
+        return 7 if private else 8
+
+    def sign_raw(self, handle, digest):
+        assert handle == 7
+        r, s = fastec.sign_digest(self.kp.priv, digest)
+        self._flip = not self._flip
+        if self._flip and p256.is_low_s(s):
+            s = p256.N - s  # produce the high-S form like a raw HSM
+        return r.to_bytes(32, "big") + s.to_bytes(32, "big")
+
+
+@pytest.fixture
+def provider():
+    return PKCS11Provider(FakeToken()), FakeToken
+
+
+def test_token_signatures_match_software_contract():
+    token = FakeToken()
+    prov = PKCS11Provider(token)
+    pub = ECDSAPublicKey(*token.kp.pub)
+    for i in range(4):  # both high-S and low-S raw forms
+        digest = prov.hash(b"msg-%d" % i)
+        sig = prov.sign_by_ski(token.ski, digest)
+        # the DER signature must verify through the SOFTWARE provider
+        # (low-S enforced): token-signed and host-signed bytes are
+        # indistinguishable to every verifier in the system
+        assert SoftwareProvider().verify(pub, sig, digest)
+        assert prov.verify(pub, sig, digest)
+        _r, s = der.unmarshal_signature(sig)
+        assert p256.is_low_s(s)
+
+
+def test_handle_cache_and_unknown_ski():
+    token = FakeToken()
+    prov = PKCS11Provider(token)
+    digest = prov.hash(b"x")
+    prov.sign_by_ski(token.ski, digest)
+    prov.sign_by_ski(token.ski, digest)
+    assert token.find_calls == 1  # handle cached per SKI
+    with pytest.raises(PKCS11Error):
+        prov.sign_by_ski(b"\x00" * 20, digest)
+
+
+def test_batch_verify_masks_failures():
+    token = FakeToken()
+    prov = PKCS11Provider(token)
+    pub = ECDSAPublicKey(*token.kp.pub)
+    digest = prov.hash(b"m")
+    good = prov.sign_by_ski(token.ski, digest)
+    out = prov.batch_verify(
+        [pub, pub, pub],
+        [good, b"\x30\x02\x01\x01", good],
+        [digest, digest, prov.hash(b"other")],
+    )
+    assert out == [True, False, False]
+
+
+def test_factory_pkcs11_errors_hard():
+    with pytest.raises(FactoryError):
+        provider_from_config({"Default": "PKCS11", "PKCS11": {}})
+    with pytest.raises(PKCS11Error):
+        provider_from_config(
+            {
+                "Default": "PKCS11",
+                "PKCS11": {"Library": "/nonexistent/libsofthsm2.so"},
+            }
+        )
+
+
+def test_signing_identity_routes_through_token(tmp_path):
+    """HSM deployment: keystore-less MSP dir + PKCS11 provider ->
+    SigningIdentity signs THROUGH the token session; the scalar never
+    exists in process (msp/identities.go Sign via bccsp/pkcs11)."""
+    import os
+
+    from cryptography.hazmat.primitives import serialization
+
+    from fabric_tpu.msp.configbuilder import load_signing_identity
+    from fabric_tpu.msp.cryptogen import OrgCA
+
+    token = FakeToken()
+    prov = PKCS11Provider(token)
+
+    # enroll a cert whose PUBLIC key is the token key, then write an
+    # MSP dir with signcerts but NO keystore (the HSM layout)
+    ca = OrgCA("hsm.test", "Org1MSP")
+    ident = ca.enroll("peer0.hsm.test")
+    # graft the token's public key into the SKI derivation by signing
+    # over the real enrolled cert: the token addresses its key by the
+    # cert-derived SKI, so point FakeToken at that SKI
+    cert = __import__("cryptography").x509.load_pem_x509_certificate(
+        ident.cert_pem
+    )
+    point = cert.public_key().public_bytes(
+        serialization.Encoding.X962,
+        serialization.PublicFormat.UncompressedPoint,
+    )
+    token.ski = hashlib.sha256(point).digest()
+    # the fake token must sign with the key MATCHING the cert
+    token.kp = type(token.kp)(
+        priv=ident.key.private_numbers().private_value,
+        pub=(
+            cert.public_key().public_numbers().x,
+            cert.public_key().public_numbers().y,
+        ),
+    )
+
+    msp_dir = tmp_path / "msp"
+    os.makedirs(msp_dir / "signcerts")
+    (msp_dir / "signcerts" / "cert.pem").write_bytes(ident.cert_pem)
+
+    signer = load_signing_identity(str(msp_dir), "Org1MSP", provider=prov)
+    assert signer.node.key is None and signer.node.token_ski == token.ski
+    sig = signer.sign(b"hello hsm")
+    pub = ECDSAPublicKey(*token.kp.pub)
+    assert SoftwareProvider().verify(pub, sig, prov.hash(b"hello hsm"))
+
+    # without a PKCS11 provider, the keystore-less dir is still an error
+    with pytest.raises(ValueError):
+        load_signing_identity(str(msp_dir), "Org1MSP")
