@@ -55,6 +55,16 @@ def start(config_path: str, block_until_signal: bool = True) -> OrdererNode:
     )
     ops = (cfg.get("Operations") or {}).get("ListenAddress")
     cluster = cfg.get("Cluster") or {}
+    tls_creds = None
+    tls_cfg = general.get("TLS") or {}
+    if tls_cfg.get("Enabled") and tls_cfg.get("Certificate") and tls_cfg.get("PrivateKey"):
+        from fabric_tpu.comm.server import CertReloader
+
+        tls_creds = CertReloader(
+            tls_cfg["Certificate"],
+            tls_cfg["PrivateKey"],
+            tls_cfg.get("ClientRootCAs"),
+        ).credentials()
     node = OrdererNode(
         general.get("WorkDir", "orderer-data"),
         signer=signer,
@@ -62,6 +72,8 @@ def start(config_path: str, block_until_signal: bool = True) -> OrdererNode:
         system_channel_id=general.get("SystemChannel"),
         ops_address=ops,
         raft_node_id=int(cluster.get("NodeId", 1)),
+        tls_credentials=tls_creds,
+        rpc_limits=general.get("Limits"),
     )
     bootstrap = general.get("BootstrapFile")
     if bootstrap:

@@ -46,6 +46,11 @@ class OrdererNode:
         provider=None,
         raft_node_id: int = 1,
         raft_tick_seconds: float = 0.1,
+        # grpc.ServerCredentials (comm.server.CertReloader.credentials()
+        # for hot rotation) + per-service concurrent-RPC caps, matching
+        # the peer node's surface (General.TLS / General.Limits)
+        tls_credentials=None,
+        rpc_limits=None,
     ):
         from fabric_tpu.orderer.cluster import ClusterClient, ClusterService
 
@@ -91,7 +96,17 @@ class OrdererNode:
                 MetricsInterceptor(self.ops.provider),
             ]
 
-        self.server = GRPCServer(listen_address, interceptors=interceptors)
+        if rpc_limits:
+            from fabric_tpu.comm.server import ConcurrencyLimiter
+
+            interceptors = [ConcurrencyLimiter(dict(rpc_limits))] + list(
+                interceptors
+            )
+        self.server = GRPCServer(
+            listen_address,
+            credentials=tls_credentials,
+            interceptors=interceptors,
+        )
         register_atomic_broadcast(self.server, self.broadcast, self.deliver)
         ClusterService(self.registrar, self.broadcast).register(self.server)
 

@@ -180,6 +180,17 @@ class PeerNode:
                 MetricsInterceptor(self.ops.provider),
             ]
 
+            def _device_check():
+                # surfaces TPUProvider's degraded flag on /healthz: the
+                # node KEEPS committing through the software fallback,
+                # but operators see the accelerator outage
+                if getattr(self.provider, "degraded", False):
+                    raise RuntimeError(
+                        "accelerator dispatch degraded to software path"
+                    )
+
+            self.ops.register_checker("bccsp-device", _device_check)
+
         if rpc_limits:
             from fabric_tpu.comm.server import ConcurrencyLimiter
 
