@@ -55,16 +55,35 @@ def start(config_path: str, block_until_signal: bool = True) -> OrdererNode:
     )
     ops = (cfg.get("Operations") or {}).get("ListenAddress")
     cluster = cfg.get("Cluster") or {}
-    tls_creds = None
-    tls_cfg = general.get("TLS") or {}
-    if tls_cfg.get("Enabled") and tls_cfg.get("Certificate") and tls_cfg.get("PrivateKey"):
-        from fabric_tpu.comm.server import CertReloader
+    from fabric_tpu.comm.server import tls_credentials_from_config
 
-        tls_creds = CertReloader(
-            tls_cfg["Certificate"],
-            tls_cfg["PrivateKey"],
-            tls_cfg.get("ClientRootCAs"),
-        ).credentials()
+    tls_creds = tls_credentials_from_config(general.get("TLS"))
+    # Cluster.RootCAs (reference localconfig): CA PEMs the INTRA-cluster
+    # dials (raft Step + follower block pulls) verify fellow orderers
+    # against — without this, enabling server TLS would break consensus
+    cluster_root_ca = b""
+    ca_paths = cluster.get("RootCAs") or []
+    if isinstance(ca_paths, str):
+        ca_paths = [ca_paths]
+    for p in ca_paths:
+        with open(p, "rb") as f:
+            cluster_root_ca += f.read()
+    if tls_creds is not None and not cluster_root_ca:
+        tls_cfg = general.get("TLS") or {}
+        # sensible default: trust our own serving CA chain for dials
+        cert_path = tls_cfg.get("Certificate") or tls_cfg.get("cert")
+        root = tls_cfg.get("RootCAs")
+        if isinstance(root, str):
+            root = [root]
+        for p in root or []:
+            with open(p, "rb") as f:
+                cluster_root_ca += f.read()
+        if not cluster_root_ca and cert_path:
+            logger.warning(
+                "TLS enabled without Cluster.RootCAs/TLS.RootCAs: "
+                "intra-cluster dials stay plaintext and a multi-orderer "
+                "raft cluster will not form"
+            )
     node = OrdererNode(
         general.get("WorkDir", "orderer-data"),
         signer=signer,
@@ -74,6 +93,7 @@ def start(config_path: str, block_until_signal: bool = True) -> OrdererNode:
         raft_node_id=int(cluster.get("NodeId", 1)),
         tls_credentials=tls_creds,
         rpc_limits=general.get("Limits"),
+        cluster_root_ca=cluster_root_ca,
     )
     bootstrap = general.get("BootstrapFile")
     if bootstrap:

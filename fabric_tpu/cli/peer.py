@@ -42,6 +42,9 @@ from fabric_tpu.comm.server import channel_to
 from fabric_tpu.comm.services import broadcast_envelope, process_proposal
 from fabric_tpu.endorser import create_proposal, create_signed_tx
 from fabric_tpu.endorser.txbuilder import create_signed_proposal
+from fabric_tpu.comm.server import (
+    tls_credentials_from_config as _tls_creds,
+)
 from fabric_tpu.msp.configbuilder import load_msp, load_signing_identity
 from fabric_tpu.msp.identity import MSPManager
 from fabric_tpu.nodes.peer import PeerNode
@@ -50,22 +53,6 @@ from fabric_tpu.protos import common_pb2
 from fabric_tpu.validation.validator import ChaincodeDefinition, ChaincodeRegistry
 
 logger = flogging.must_get_logger("peer.main")
-
-
-def _tls_from_config(tls_cfg):
-    """peer.tls: {cert, key, clientRootCAs?} -> hot-reloading server
-    credentials (comm.server.CertReloader; rotation = file swap)."""
-    if not tls_cfg or not tls_cfg.get("enabled", True):
-        return None
-    cert = tls_cfg.get("cert")
-    key = tls_cfg.get("key")
-    if not cert or not key:
-        return None
-    from fabric_tpu.comm.server import CertReloader
-
-    return CertReloader(
-        cert, key, tls_cfg.get("clientRootCAs")
-    ).credentials()
 
 
 def _load_node(config_path: str) -> PeerNode:
@@ -141,7 +128,7 @@ def _load_node(config_path: str) -> PeerNode:
         # ledger.deviceMVCC: resolve MVCC on device (SURVEY P5)
         device_mvcc=bool((cfg.get("ledger") or {}).get("deviceMVCC")),
         plugin_registry=plugin_registry,
-        tls_credentials=_tls_from_config(pc.get("tls")),
+        tls_credentials=_tls_creds(pc.get("tls")),
         # per-service concurrent-RPC caps (grpc_limiters.go), e.g.
         #   limits: {"protos.Endorser": 50, "protos.Deliver": 25}
         rpc_limits=pc.get("limits"),

@@ -54,23 +54,29 @@ class CertReloader:
         self,
         cert_path: str,
         key_path: str,
-        client_ca_path: Optional[str] = None,
+        client_ca_path=None,  # str | Sequence[str] (reference dialect:
+        # ClientRootCAs is a LIST; multiple PEMs concatenate)
     ):
         import os as _os
 
         self._os = _os
         self._cert_path = cert_path
         self._key_path = key_path
-        self._ca_path = client_ca_path
+        if isinstance(client_ca_path, (str, bytes)) or client_ca_path is None:
+            self._ca_paths = [client_ca_path] if client_ca_path else []
+        else:
+            self._ca_paths = list(client_ca_path)
         self._mtimes = None
         self._config = None
         self.reloads = 0  # introspection for tests/ops
         self._fetch(strict=True)  # misconfigured paths fail at startup
 
+    @property
+    def requires_client_auth(self) -> bool:
+        return bool(self._ca_paths)
+
     def _stat(self):
-        paths = [self._cert_path, self._key_path]
-        if self._ca_path:
-            paths.append(self._ca_path)
+        paths = [self._cert_path, self._key_path, *self._ca_paths]
         return tuple(self._os.stat(p).st_mtime_ns for p in paths)
 
     def _fetch(self, strict: bool = False):
@@ -82,9 +88,12 @@ class CertReloader:
                 with open(self._cert_path, "rb") as f:
                     cert = f.read()
                 ca = None
-                if self._ca_path:
-                    with open(self._ca_path, "rb") as f:
-                        ca = f.read()
+                if self._ca_paths:
+                    parts = []
+                    for p in self._ca_paths:
+                        with open(p, "rb") as f:
+                            parts.append(f.read())
+                    ca = b"".join(parts)
                 self._config = grpc.ssl_server_certificate_configuration(
                     [(key, cert)], root_certificates=ca
                 )
@@ -101,8 +110,32 @@ class CertReloader:
         return grpc.dynamic_ssl_server_credentials(
             self._config,
             self._fetch,
-            require_client_authentication=self._ca_path is not None,
+            require_client_authentication=self.requires_client_auth,
         )
+
+
+def tls_credentials_from_config(tls_cfg) -> Optional[grpc.ServerCredentials]:
+    """One TLS-config dialect for BOTH node CLIs (accepts the peer's
+    cert/key/clientRootCAs and the orderer's Certificate/PrivateKey/
+    ClientRootCAs spellings). Enabled-but-incomplete is a HARD error —
+    the reference refuses to start rather than silently serving
+    plaintext when the operator asked for TLS."""
+    if not tls_cfg:
+        return None
+    enabled = tls_cfg.get("enabled", tls_cfg.get("Enabled"))
+    cert = tls_cfg.get("cert") or tls_cfg.get("Certificate")
+    key = tls_cfg.get("key") or tls_cfg.get("PrivateKey")
+    if enabled is False:
+        return None
+    if enabled is None and not (cert or key):
+        return None
+    if not cert or not key:
+        raise ValueError(
+            "TLS is enabled but cert/key paths are incomplete "
+            f"(cert={cert!r}, key={key!r})"
+        )
+    cas = tls_cfg.get("clientRootCAs") or tls_cfg.get("ClientRootCAs")
+    return CertReloader(cert, key, cas).credentials()
 
 
 class ConcurrencyLimiter(grpc.ServerInterceptor):

@@ -51,6 +51,10 @@ class OrdererNode:
         # the peer node's surface (General.TLS / General.Limits)
         tls_credentials=None,
         rpc_limits=None,
+        # root CA PEM for OUTBOUND intra-cluster dials (Step/raft + the
+        # follower block puller): when this node serves TLS, its peers
+        # do too, so the cluster client must dial TLS as well
+        cluster_root_ca: bytes = b"",
     ):
         from fabric_tpu.orderer.cluster import ClusterClient, ClusterService
 
@@ -60,7 +64,10 @@ class OrdererNode:
         # at join time (main.go initializeClusterClientConfig).
         self.raft_node_id = raft_node_id
         self.raft_tick_seconds = raft_tick_seconds
-        self.cluster_client = ClusterClient(raft_node_id, {})
+        self._cluster_root_ca = cluster_root_ca or None
+        self.cluster_client = ClusterClient(
+            raft_node_id, {}, root_ca=self._cluster_root_ca
+        )
         self.registrar = Registrar(
             work_dir,
             signer=signer,
@@ -130,7 +137,7 @@ class OrdererNode:
 
         def make(addr):
             def endpoint(env):
-                conn = channel_to(addr)
+                conn = channel_to(addr, self._cluster_root_ca)
                 try:
                     yield from deliver_stream(conn, env)
                 except grpc.RpcError as e:

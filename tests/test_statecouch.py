@@ -1,0 +1,306 @@
+"""CouchDB REST state adapter vs an in-process fake CouchDB (the image
+has no external service): doc shape round-trip (JSON fields vs binary
+attachment), bulk commit with revision-cache preload + conflict retry,
+range scans, /_find selector pass-through with CouchDB-opaque bookmarks
+(reference statecouchdb.go)."""
+
+import base64
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, unquote, urlparse
+
+import pytest
+
+from fabric_tpu.ledger import rwset as rw
+from fabric_tpu.ledger.statecouch import (
+    CouchClient,
+    CouchError,
+    CouchStateAdapter,
+    couch_db_name,
+)
+from fabric_tpu.ledger.statedb import UpdateBatch
+
+
+class FakeCouch(BaseHTTPRequestHandler):
+    """Enough of CouchDB's dialect for the adapter: per-db doc stores
+    with MVCC _rev checking, _bulk_docs, _all_docs, _find."""
+
+    dbs: dict = {}
+    revs: dict = {}
+    find_calls: list = []
+    bulk_get_counter: list = []
+
+    def log_message(self, *a):  # quiet
+        pass
+
+    @staticmethod
+    def _maybe_stub(doc, inline):
+        """Real CouchDB returns attachment STUBS unless asked to
+        inline (and /_find can never inline) — the adapter must cope."""
+        if inline or not doc.get("_attachments"):
+            return doc
+        out = dict(doc)
+        out["_attachments"] = {
+            name: {k: v for k, v in att.items() if k != "data"}
+            | {"stub": True, "length": 1}
+            for name, att in doc["_attachments"].items()
+        }
+        return out
+
+    def _json(self, code, obj):
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _body(self):
+        n = int(self.headers.get("Content-Length", 0))
+        return json.loads(self.rfile.read(n)) if n else {}
+
+    def do_PUT(self):
+        db = self.path.strip("/")
+        cls = type(self)
+        if db in cls.dbs:
+            self._json(412, {"error": "file_exists"})
+        else:
+            cls.dbs[db] = {}
+            cls.revs[db] = {}
+            self._json(201, {"ok": True})
+
+    def do_GET(self):
+        cls = type(self)
+        parsed = urlparse(self.path)
+        parts = parsed.path.strip("/").split("/")
+        if len(parts) == 2 and parts[1] == "_all_docs":
+            qs = parse_qs(parsed.query)
+            docs = cls.dbs.get(parts[0], {})
+            keys = sorted(docs)
+            start = json.loads(qs["startkey"][0]) if "startkey" in qs else None
+            end = json.loads(qs["endkey"][0]) if "endkey" in qs else None
+            rows = []
+            for k in keys:
+                if start is not None and k < start:
+                    continue
+                if end is not None and k >= end:
+                    continue
+                row = {
+                    "id": k,
+                    "value": {"rev": cls.revs[parts[0]][k]},
+                }
+                if qs.get("include_docs") == ["true"]:
+                    row["doc"] = self._maybe_stub(
+                        docs[k], qs.get("attachments") == ["true"]
+                    )
+                rows.append(row)
+            if "limit" in qs:
+                rows = rows[: int(qs["limit"][0])]
+            self._json(200, {"rows": rows})
+            return
+        if len(parts) == 2:
+            db, key = parts[0], unquote(parts[1])
+            doc = cls.dbs.get(db, {}).get(key)
+            if doc is None:
+                self._json(404, {"error": "not_found"})
+            else:
+                self._json(200, doc)
+            return
+        self._json(404, {"error": "not_found"})
+
+    def do_POST(self):
+        cls = type(self)
+        parts = self.path.strip("/").split("/")
+        db = parts[0]
+        body = self._body()
+        if parts[1] == "_bulk_docs":
+            cls.bulk_get_counter.append(len(body.get("docs", [])))
+            out = []
+            for doc in body["docs"]:
+                key = doc["_id"]
+                current_rev = cls.revs[db].get(key)
+                given = doc.get("_rev")
+                if current_rev is not None and given != current_rev:
+                    out.append({"id": key, "error": "conflict"})
+                    continue
+                n = int((current_rev or "0-x").split("-")[0]) + 1
+                rev = f"{n}-{'%08x' % abs(hash(key)) }"[:14]
+                if doc.get("_deleted"):
+                    cls.dbs[db].pop(key, None)
+                    cls.revs[db].pop(key, None)
+                    out.append({"id": key, "ok": True, "rev": rev})
+                    continue
+                stored = {
+                    k: v for k, v in doc.items() if k not in ("_rev",)
+                }
+                stored["_rev"] = rev
+                cls.dbs[db][key] = stored
+                cls.revs[db][key] = rev
+                out.append({"id": key, "ok": True, "rev": rev})
+            self._json(201, out)
+            return
+        if parts[1] == "_all_docs":
+            rows = []
+            for k in body.get("keys", []):
+                rev = cls.revs.get(db, {}).get(k)
+                if rev is None:
+                    rows.append({"key": k, "error": "not_found"})
+                else:
+                    rows.append({"id": k, "value": {"rev": rev}})
+            self._json(200, {"rows": rows})
+            return
+        if parts[1] == "_find":
+            cls.find_calls.append(body)
+            selector = body.get("selector", {})
+            docs = []
+            for k in sorted(cls.dbs.get(db, {})):
+                doc = cls.dbs[db][k]
+                ok = True
+                for field, cond in selector.items():
+                    val = doc.get(field)
+                    if isinstance(cond, dict):
+                        for op, ref in cond.items():
+                            if op == "$gt" and not (
+                                val is not None and val > ref
+                            ):
+                                ok = False
+                            if op == "$lt" and not (
+                                val is not None and val < ref
+                            ):
+                                ok = False
+                    elif val != cond:
+                        ok = False
+                if ok:
+                    docs.append(doc)
+            docs = [self._maybe_stub(d, False) for d in docs]
+            offset = 0
+            if body.get("bookmark"):
+                offset = int(
+                    base64.b64decode(body["bookmark"]).decode()
+                )
+            limit = body.get("limit", 25)  # CouchDB's silent default
+            page = docs[offset : offset + limit]
+            bookmark = base64.b64encode(
+                str(offset + len(page)).encode()
+            ).decode()
+            self._json(200, {"docs": page, "bookmark": bookmark})
+            return
+        self._json(404, {"error": "not_found"})
+
+
+@pytest.fixture
+def couch():
+    FakeCouch.dbs = {}
+    FakeCouch.revs = {}
+    FakeCouch.find_calls = []
+    FakeCouch.bulk_get_counter = []
+    server = ThreadingHTTPServer(("127.0.0.1", 0), FakeCouch)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    yield CouchClient(f"http://127.0.0.1:{server.server_port}")
+    server.shutdown()
+
+
+def _commit(adapter, block, entries):
+    batch = UpdateBatch()
+    for t, (key, value) in enumerate(entries):
+        if value is None:
+            batch.delete("cc", key, rw.Version(block, t))
+        else:
+            batch.put("cc", key, value, rw.Version(block, t))
+    adapter.apply_updates(batch)
+
+
+def test_doc_shape_roundtrip_and_versions(couch):
+    a = CouchStateAdapter(couch, "mychannel")
+    _commit(a, 1, [
+        ("json1", json.dumps({"owner": "alice", "qty": 3}).encode()),
+        ("bin1", b"\x00\x01binary"),
+    ])
+    vv = a.get_state("cc", "json1")
+    assert json.loads(vv.value) == {"owner": "alice", "qty": 3}
+    assert vv.version == rw.Version(1, 0)
+    # JSON docs store their fields INLINE (reference doc shape): couch
+    # tooling sees queryable fields, not a blob
+    raw = FakeCouch.dbs[couch_db_name("mychannel", "cc")]["json1"]
+    assert raw["owner"] == "alice" and raw["~version"] == "1:0"
+    # binary rides the valueBytes attachment
+    vv = a.get_state("cc", "bin1")
+    assert vv.value == b"\x00\x01binary" and vv.version == rw.Version(1, 1)
+    assert a.get_state("cc", "ghost") is None
+    assert a.get_version("cc", "bin1") == rw.Version(1, 1)
+
+
+def test_bulk_update_with_revision_preload_and_delete(couch):
+    a = CouchStateAdapter(couch, "ch")
+    _commit(a, 1, [(f"k{i}", b"v1") for i in range(5)])
+    # fresh adapter (restart): revisions must come from ONE bulk preload
+    b = CouchStateAdapter(couch, "ch")
+    _commit(b, 2, [(f"k{i}", b"v2") for i in range(5)] + [("k0", None)])
+    assert b.get_state("cc", "k0") is None  # delete won
+    assert b.get_state("cc", "k3").value == b"v2"
+    assert b.get_state("cc", "k3").version == rw.Version(2, 3)
+
+
+def test_conflict_refreshes_and_retries(couch):
+    a = CouchStateAdapter(couch, "ch")
+    b = CouchStateAdapter(couch, "ch")
+    _commit(a, 1, [("k", b"from-a")])
+    # b's cache is stale (never saw a's rev): its commit conflicts once,
+    # refreshes the rev, retries, and lands
+    _commit(b, 2, [("k", b"from-b")])
+    assert a.get_state("cc", "k").value == b"from-b"
+
+
+def test_range_scan_excludes_end(couch):
+    a = CouchStateAdapter(couch, "ch")
+    _commit(a, 1, [(f"k{i}", b"v") for i in range(6)])
+    rows = list(a.get_state_range("cc", "k1", "k4"))
+    assert [k for k, _vv in rows] == ["k1", "k2", "k3"]
+
+
+def test_find_passthrough_with_opaque_bookmark(couch):
+    a = CouchStateAdapter(couch, "ch")
+    _commit(a, 1, [
+        (f"asset{i}", json.dumps({"owner": "alice", "qty": i}).encode())
+        for i in range(7)
+    ] + [("other", json.dumps({"owner": "bob"}).encode())])
+    sel = {"owner": "alice", "qty": {"$gt": 1}}
+    page1, bm1 = a.execute_query("cc", sel, page_size=3)
+    assert len(page1) == 3 and bm1
+    page2, bm2 = a.execute_query("cc", sel, page_size=3, bookmark=bm1)
+    assert len(page2) == 2  # qty in 2..6 -> 5 total
+    assert {k for k, _v in page1} | {k for k, _v in page2} == {
+        "asset2", "asset3", "asset4", "asset5", "asset6"
+    }
+    # the selector reached /_find VERBATIM (pass-through contract)
+    assert FakeCouch.find_calls[0]["selector"] == sel
+    # restarted iterator: the bookmark is CouchDB's, so a FRESH adapter
+    # resumes exactly where the old one stopped
+    fresh = CouchStateAdapter(couch, "ch")
+    page2b, _ = fresh.execute_query("cc", sel, page_size=3, bookmark=bm1)
+    assert [k for k, _v in page2b] == [k for k, _v in page2]
+
+
+def test_db_name_mangling():
+    assert couch_db_name("MyChannel", "MyCC") == "mychannel_mycc"
+    assert couch_db_name("ch", "cc.v2") == "ch_cc$v2"
+
+
+def test_binary_values_survive_scans_and_queries(couch):
+    """Real CouchDB returns attachment STUBS from scans (/_find always,
+    _all_docs unless attachments=true): binary values must still
+    round-trip, via inline attachments or the point re-fetch."""
+    a = CouchStateAdapter(couch, "ch")
+    _commit(a, 1, [
+        ("binkey", b"\x00\x01raw"),
+        ("j", json.dumps({"owner": "alice"}).encode()),
+    ])
+    rows = dict(a.get_state_range("cc", "", ""))
+    assert rows["binkey"].value == b"\x00\x01raw"
+    # selector matching the binary doc (no JSON fields): match-all on a
+    # field it lacks won't hit it, so query by _id via owner-less doc —
+    # use an empty selector page and look for the binary key
+    page, _bm = a.execute_query("cc", {}, page_size=10)
+    assert (("binkey", b"\x00\x01raw")) in page
