@@ -55,6 +55,21 @@ from fabric_tpu.validation.validator import ChaincodeDefinition, ChaincodeRegist
 logger = flogging.must_get_logger("peer.main")
 
 
+def _couch_mirror_factory(couch_cfg):
+    """ledger.stateCouch: {url} -> per-channel CouchStateAdapter
+    factory (None when unconfigured)."""
+    if not couch_cfg or not couch_cfg.get("url"):
+        return None
+    from fabric_tpu.ledger.statecouch import CouchClient, CouchStateAdapter
+
+    client = CouchClient(couch_cfg["url"])
+
+    def factory(channel_id: str):
+        return CouchStateAdapter(client, channel_id)
+
+    return factory
+
+
 def _load_node(config_path: str) -> PeerNode:
     from fabric_tpu.utils.config import apply_env_overrides
 
@@ -132,6 +147,11 @@ def _load_node(config_path: str) -> PeerNode:
         # per-service concurrent-RPC caps (grpc_limiters.go), e.g.
         #   limits: {"protos.Endorser": 50, "protos.Deliver": 25}
         rpc_limits=pc.get("limits"),
+        # ledger.stateCouch.url: mirror public state into an external
+        # CouchDB in the reference's own doc dialect (statecouchdb)
+        state_mirror_factory=_couch_mirror_factory(
+            (cfg.get("ledger") or {}).get("stateCouch")
+        ),
     )
     # External-builder analog (core/container/externalbuilder): user
     # chaincode loads as python modules, "module.path:ClassName", with

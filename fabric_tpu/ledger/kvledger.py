@@ -22,6 +22,7 @@ import hashlib
 import os
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from fabric_tpu.common import flogging
 from fabric_tpu.ledger.blockstore import BlockStore
 from fabric_tpu.ledger.mvcc import Validator
 from fabric_tpu.ledger.pvtdatastore import MissingEntry, PvtDataStore, PvtEntry
@@ -35,6 +36,8 @@ from fabric_tpu.ledger.statedb import (
 from fabric_tpu.protos import common_pb2, protoutil, txmgr_updates_pb2
 from fabric_tpu.validation.msgvalidation import parse_transaction
 from fabric_tpu.validation.txflags import TxValidationCode, ValidationFlags
+
+logger = flogging.must_get_logger("kvledger")
 
 
 def encode_order_preserving_varuint64(n: int) -> bytes:
@@ -168,7 +171,12 @@ class KVLedger:
         btl_policy=None,
         persistent: bool = True,
         device_mvcc: bool = False,
+        # optional public-state mirror (ledger/statecouch.CouchStateAdapter):
+        # receives each block's public UpdateBatch after the embedded
+        # commit — best-effort, a mirror outage never blocks consensus
+        state_mirror=None,
     ):
+        self.state_mirror = state_mirror
         self.channel_id = channel_id
         self.persistent = persistent
         # SURVEY P5: resolve block-internal MVCC invalidation chains on
@@ -434,6 +442,17 @@ class KVLedger:
             self.state_db.apply_updates(updates, hashed, pvt)
         # collection-config history (confighistory/mgr.go commit hook)
         self.config_history.record_from_updates(block.header.number, updates)
+        if self.state_mirror is not None and len(updates):
+            # operational mirror (statecouch): best-effort, post-commit —
+            # the embedded store is authoritative and a mirror outage
+            # must never block the commit path
+            try:
+                self.state_mirror.apply_updates(updates)
+            except Exception as exc:  # noqa: BLE001
+                logger.warning(
+                    "[%s] state mirror update failed at block %d: %s",
+                    self.channel_id, block.header.number, exc,
+                )
 
     def commit_reconciled_pvt(self, items) -> int:
         """Reconciler write-back (reference reconcile.go ->

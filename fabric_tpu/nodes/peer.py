@@ -56,6 +56,9 @@ class PeerNode:
         # per-service concurrent-RPC caps, e.g. {"protos.Endorser": 50}
         # (reference usable-inter-nal/peer/node/grpc_limiters.go)
         rpc_limits=None,
+        # channel_id -> statecouch.CouchStateAdapter (public-state
+        # operational mirror; reference statecouchdb's deployment role)
+        state_mirror_factory=None,
     ):
         self.work_dir = work_dir
         self.msp_manager = msp_manager
@@ -71,6 +74,7 @@ class PeerNode:
             self.provider = BatchingProvider(provider or default_provider())
         self.device_mvcc = device_mvcc
         self.plugin_registry = plugin_registry
+        self._state_mirror_factory = state_mirror_factory
         self._registry_factory = registry_factory
         self.channels: Dict[str, Channel] = {}
         self.transient = TransientStore()
@@ -526,6 +530,11 @@ class PeerNode:
                 self._legacy_writeset_check(cid, rwset, ns)
             ),
             plugin_registry=self.plugin_registry,
+            state_mirror=(
+                self._state_mirror_factory(channel_id)
+                if self._state_mirror_factory is not None
+                else None
+            ),
         )
         if ch.ledger.height == 0:
             ch.ledger.commit(genesis_block)
@@ -568,6 +577,11 @@ class PeerNode:
                 self._legacy_writeset_check(cid, rwset, ns)
             ),
             plugin_registry=self.plugin_registry,
+            state_mirror=(
+                self._state_mirror_factory(channel_id)
+                if self._state_mirror_factory is not None
+                else None
+            ),
         )
         self.channels[channel_id] = ch
         self.snapshot_managers[channel_id] = SnapshotRequestManager(

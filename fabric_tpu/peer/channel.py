@@ -48,13 +48,14 @@ class Channel:
         device_mvcc: bool = False,  # SURVEY P5 device fixpoint resolver
         writeset_check=None,  # legacy v12/v13 write-set guards
         plugin_registry=None,  # dispatcher.PluginRegistry (custom plugins)
+        state_mirror=None,  # statecouch.CouchStateAdapter (public mirror)
     ):
         self.metrics = metrics
         self.channel_id = channel_id
         self.provider = provider or default_provider()
         self.ledger = KVLedger(
             ledger_dir, channel_id, btl_policy=btl_policy,
-            device_mvcc=device_mvcc,
+            device_mvcc=device_mvcc, state_mirror=state_mirror,
         )
         self.verify_orderer_sig = verify_orderer_sig
         self.transient_store = transient_store

@@ -304,3 +304,54 @@ def test_binary_values_survive_scans_and_queries(couch):
     # use an empty selector page and look for the binary key
     page, _bm = a.execute_query("cc", {}, page_size=10)
     assert (("binkey", b"\x00\x01raw")) in page
+
+
+def test_kvledger_mirror_commit_and_outage(couch, tmp_path):
+    """KVLedger with a state_mirror: each committed block's public
+    updates land in CouchDB; a mirror outage never blocks the commit
+    path (best-effort, logged)."""
+    from fabric_tpu.ledger.kvledger import KVLedger
+    from fabric_tpu.ledger.statecouch import CouchStateAdapter
+    from fabric_tpu.protos import common_pb2, protoutil
+
+    mirror = CouchStateAdapter(couch, "mych")
+    ledger = KVLedger(
+        str(tmp_path), "mych", persistent=False, state_mirror=mirror
+    )
+    genesis = protoutil.new_block(0, b"")
+    protoutil.seal_block(genesis)
+    ledger.commit(genesis)
+
+    block = protoutil.new_block(1, protoutil.block_header_hash(genesis.header))
+    protoutil.seal_block(block)
+    rwsets = [
+        rw.TxRwSet(
+            (rw.NsRwSet("cc", (), (rw.KVWrite("mk", False, b"mv"),)),)
+        )
+    ]
+    block.data.data.append(b"\x00")  # placeholder envelope for 1 tx
+    from fabric_tpu.validation.txflags import ValidationFlags
+
+    flags = ValidationFlags(1)
+    flags.set_flag(0, 0)  # VALID
+    protoutil.init_block_metadata(block)
+    block.metadata.metadata[common_pb2.TRANSACTIONS_FILTER] = flags.tobytes()
+    ledger.commit(block, rwsets=rwsets)
+    assert mirror.get_state("cc", "mk").value == b"mv"
+
+    # outage: break the client; the NEXT commit still succeeds
+    mirror.client.base = "http://127.0.0.1:1"
+    block2 = protoutil.new_block(2, protoutil.block_header_hash(block.header))
+    block2.data.data.append(b"\x00")
+    protoutil.seal_block(block2)
+    protoutil.init_block_metadata(block2)
+    block2.metadata.metadata[common_pb2.TRANSACTIONS_FILTER] = flags.tobytes()
+    ledger.commit(
+        block2,
+        rwsets=[
+            rw.TxRwSet(
+                (rw.NsRwSet("cc", (), (rw.KVWrite("k2", False, b"v"),)),)
+            )
+        ],
+    )
+    assert ledger.get_state("cc", "k2") == b"v"  # commit unaffected
