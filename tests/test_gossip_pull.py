@@ -280,3 +280,61 @@ def test_no_handshake_rejected_in_strict_mode():
     finally:
         tall.stop()
         joiner.stop()
+
+
+def test_handshake_fuzz_mutations_never_authenticate():
+    """Random mutations of a valid ConnEstablish (flipped pki, wrong
+    channel, truncated/garbled signature, swapped cert hash) must never
+    pass _handshake_ok on a strict server."""
+    import random
+
+    from fabric_tpu.gossip.comm import _conn_signing_bytes
+    from fabric_tpu.protos import gossip_pb2
+
+    pair_a, pair_b = _org_tls()
+    tall, joiner, _jl = _tls_nodes(pair_a, pair_b)
+    # binding authority: pki must match identity suffix
+    tall.certstore._verify = lambda pki, ident: (
+        ident == b"identity-" + pki.decode().encode()
+    )
+
+    class Ctx:  # mTLS context presenting the joiner's real client cert
+        def auth_context(self):
+            return {"x509_pem_cert": [pair_b.cert_pem]}
+
+    valid = gossip_pb2.ConnEstablish()
+    valid.pki_id = b"join"
+    valid.identity = b"identity-join"
+    valid.tls_cert_hash = hashlib.sha256(pair_b.cert_der).digest()
+    sign, _v = _sig_hooks(b"identity-join")
+    valid.signature = sign(
+        _conn_signing_bytes("gchannel", b"join", valid.tls_cert_hash)
+    )
+    assert tall._handshake_ok(valid, Ctx())  # baseline sanity
+
+    rng = random.Random(99)
+    for _ in range(200):
+        m = gossip_pb2.ConnEstablish()
+        m.CopyFrom(valid)
+        field = rng.choice(["pki", "ident", "sig", "hash", "chan"])
+        if field == "pki":
+            m.pki_id = bytes(rng.randrange(256) for _ in range(4))
+        elif field == "ident":
+            m.identity = bytes(rng.randrange(256) for _ in range(8))
+        elif field == "sig":
+            sig = bytearray(m.signature)
+            if sig:
+                sig[rng.randrange(len(sig))] ^= 1 << rng.randrange(8)
+            m.signature = bytes(sig)
+        elif field == "hash":
+            h = bytearray(m.tls_cert_hash)
+            h[rng.randrange(len(h))] ^= 1 << rng.randrange(8)
+            m.tls_cert_hash = bytes(h)
+        else:
+            # signature computed over a DIFFERENT channel must fail here
+            m.signature = sign(
+                _conn_signing_bytes("otherchan", b"join", m.tls_cert_hash)
+            )
+        assert not tall._handshake_ok(m, Ctx()), field
+    tall.stop()
+    joiner.stop()
