@@ -55,6 +55,13 @@ from fabric_tpu.validation.validator import ChaincodeDefinition, ChaincodeRegist
 logger = flogging.must_get_logger("peer.main")
 
 
+def _read_pem(path) -> bytes:
+    if not path:
+        return b""
+    with open(path, "rb") as f:
+        return f.read()
+
+
 def _couch_mirror_factory(couch_cfg):
     """ledger.stateCouch: {url} -> per-channel CouchStateAdapter
     factory (None when unconfigured)."""
@@ -152,6 +159,7 @@ def _load_node(config_path: str) -> PeerNode:
         state_mirror_factory=_couch_mirror_factory(
             (cfg.get("ledger") or {}).get("stateCouch")
         ),
+        orderer_root_ca=_read_pem(pc.get("ordererTLSRootCA")),
     )
     # External-builder analog (core/container/externalbuilder): user
     # chaincode loads as python modules, "module.path:ClassName", with

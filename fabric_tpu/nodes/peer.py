@@ -59,6 +59,9 @@ class PeerNode:
         # channel_id -> statecouch.CouchStateAdapter (public-state
         # operational mirror; reference statecouchdb's deployment role)
         state_mirror_factory=None,
+        # root CA PEM for the deliver client's orderer dials (the
+        # reference's peer.tls.rootcert for deliveryclient connections)
+        orderer_root_ca: bytes = b"",
     ):
         self.work_dir = work_dir
         self.msp_manager = msp_manager
@@ -75,6 +78,7 @@ class PeerNode:
         self.device_mvcc = device_mvcc
         self.plugin_registry = plugin_registry
         self._state_mirror_factory = state_mirror_factory
+        self._orderer_root_ca = orderer_root_ca or None
         self._registry_factory = registry_factory
         self.channels: Dict[str, Channel] = {}
         self.transient = TransientStore()
@@ -778,7 +782,7 @@ class PeerNode:
                         start=ch.ledger.height,
                         signer=self.signer,
                     )
-                    conn = channel_to(orderer_addr)
+                    conn = channel_to(orderer_addr, self._orderer_root_ca)
                     try:
                         for resp in deliver_stream(conn, env):
                             if self._stop.is_set():

@@ -1,0 +1,153 @@
+"""End-to-end over TLS: orderer + peer both serve TLS (hot-reloading
+CertReloader creds), the peer's deliver client dials the orderer with
+the root CA, and a client endorses/broadcasts over TLS — a block
+commits through the full wire path (reference e2e with TLS enabled,
+usable-inter-nal/pkg/comm creds + deliveryclient tls.rootcert)."""
+
+import time
+
+import pytest
+
+from fabric_tpu.channelconfig import (
+    ApplicationProfile,
+    OrdererProfile,
+    OrganizationProfile,
+    Profile,
+    genesis_block,
+)
+from fabric_tpu.chaincode import success
+from fabric_tpu.comm.server import CertReloader, channel_to
+from fabric_tpu.comm.services import (
+    broadcast_envelope,
+    process_proposal,
+)
+from fabric_tpu.crypto.bccsp import SoftwareProvider
+from fabric_tpu.endorser import create_proposal, create_signed_tx
+from fabric_tpu.endorser.txbuilder import create_signed_proposal
+from fabric_tpu.msp.cryptogen import generate_org
+from fabric_tpu.msp.identity import MSPManager
+from fabric_tpu.msp.signer import SigningIdentity
+from fabric_tpu.nodes import OrdererNode, PeerNode
+from fabric_tpu.policy import from_dsl
+from fabric_tpu.validation.validator import ChaincodeDefinition, ChaincodeRegistry
+
+PROVIDER = SoftwareProvider()
+CHANNEL = "tlschannel"
+
+
+class KV:
+    def init(self, stub):
+        return success()
+
+    def invoke(self, stub):
+        fn, params = stub.get_function_and_parameters()
+        if fn == "put":
+            stub.put_state(params[0], params[1].encode())
+            return success(b"ok")
+        return success(b"")
+
+
+def _creds(tmp_path, pair, name):
+    cert = tmp_path / f"{name}.crt"
+    key = tmp_path / f"{name}.key"
+    cert.write_bytes(pair.cert_pem)
+    key.write_bytes(pair.key_pem)
+    return CertReloader(str(cert), str(key)).credentials()
+
+
+@pytest.fixture(scope="module")
+def tls_net(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("tlsnet")
+    org1 = generate_org("org1.example.com", "Org1MSP")
+    oorg = generate_org("orderer.example.com", "OrdererMSP")
+    mgr = MSPManager([org1.msp(provider=PROVIDER)])
+    tls_pair_o = org1.ca.enroll_tls("orderer.tls")
+    tls_pair_p = org1.ca.enroll_tls("peer0.tls")
+    root_ca = org1.ca.cert_pem
+
+    def registry_factory(channel_id):
+        return ChaincodeRegistry(
+            [ChaincodeDefinition("kvcc", from_dsl("OR('Org1MSP.member')"))]
+        )
+
+    profile = Profile(
+        application=ApplicationProfile(
+            organizations=[OrganizationProfile("Org1MSP", org1.msp_config())]
+        ),
+        orderer=OrdererProfile(
+            orderer_type="solo",
+            organizations=[
+                OrganizationProfile("OrdererMSP", oorg.msp_config())
+            ],
+        ),
+    )
+    gblock = genesis_block(profile, CHANNEL)
+
+    orderer = OrdererNode(
+        str(tmp / "orderer"),
+        signer=SigningIdentity(oorg.peers[0], PROVIDER),
+        tls_credentials=_creds(tmp, tls_pair_o, "orderer"),
+    )
+    orderer.join_channel(gblock)
+    orderer.start()
+
+    peer = PeerNode(
+        str(tmp / "peer0"),
+        mgr,
+        SigningIdentity(org1.peers[0], PROVIDER),
+        registry_factory,
+        provider=PROVIDER,
+        tls_credentials=_creds(tmp, tls_pair_p, "peer"),
+        orderer_root_ca=root_ca,
+    )
+    peer.support.register("kvcc", KV())
+    peer.join_channel(gblock)
+    peer.start()
+    peer.start_deliver_for_channel(CHANNEL, orderer.addr)
+
+    yield {
+        "orderer": orderer,
+        "peer": peer,
+        "root_ca": root_ca,
+        "client": SigningIdentity(org1.users[0], PROVIDER),
+    }
+    peer.stop()
+    orderer.stop()
+
+
+def test_tls_end_to_end(tls_net):
+    client = tls_net["client"]
+    root_ca = tls_net["root_ca"]
+    peer = tls_net["peer"]
+
+    # plaintext dial against the TLS peer must FAIL (no silent fallback)
+    import grpc
+
+    bundle = create_proposal(client, CHANNEL, "kvcc", [b"put", b"k", b"v"])
+    signed = create_signed_proposal(bundle, client)
+    conn = channel_to(peer.addr)  # insecure
+    with pytest.raises(grpc.RpcError):
+        process_proposal(conn, signed)
+    conn.close()
+
+    # TLS endorse + TLS broadcast
+    conn = channel_to(peer.addr, root_ca)
+    resp = process_proposal(conn, signed)
+    conn.close()
+    assert resp.response.status == 200, resp.response.message
+    env = create_signed_tx(bundle, client, [resp])
+    conn = channel_to(tls_net["orderer"].addr, root_ca)
+    ack = broadcast_envelope(conn, env)
+    conn.close()
+    from fabric_tpu.protos import common_pb2
+
+    assert ack.status == common_pb2.SUCCESS, ack.info
+
+    # the peer's deliver loop (TLS dial to the orderer) commits it
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        if peer.channels[CHANNEL].ledger.get_state("kvcc", "k") == b"v":
+            break
+        time.sleep(0.1)
+    assert peer.channels[CHANNEL].ledger.get_state("kvcc", "k") == b"v"
+    assert not peer.deliver_errors.get(CHANNEL)
