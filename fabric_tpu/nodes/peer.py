@@ -640,6 +640,11 @@ class PeerNode:
         bootstrap: Sequence[str] = (),
         orderer_addr: Optional[str] = None,
         gossip_listen: str = "127.0.0.1:0",
+        # mTLS + TLS-bound ConnEstablish handshake (gossip/comm):
+        # {"server_creds": grpc.ServerCredentials,
+        #  "client": (root_ca_pem, (key_pem, cert_pem)),
+        #  "self_cert_der": bytes, "require_handshake": bool}
+        tls: Optional[dict] = None,
     ):
         """Start a gossip node for the channel. With an orderer address,
         the elected leader runs the deliver client and pushes blocks to
@@ -713,6 +718,10 @@ class PeerNode:
             pvt_sign_request=self.signer.sign,
             sign_message=self.signer.sign,
             require_signed_alive=True,
+            tls_server_creds=(tls or {}).get("server_creds"),
+            tls_client=(tls or {}).get("client"),
+            self_tls_cert_der=(tls or {}).get("self_cert_der", b""),
+            require_handshake=bool((tls or {}).get("require_handshake")),
         )
         # reconciler loop (reconcile.go:104-126): patch missing pvt data
         # recorded at commit from peers, hash-checked on arrival

@@ -179,3 +179,75 @@ def test_cluster_elects_forwards_and_fails_over(cluster):
         )
     ), [n.registrar.get_chain(CHANNEL).chain.height for n in survivors]
     ch.close()
+
+
+def test_raft_cluster_over_tls(tmp_path):
+    """3-node etcdraft cluster with every listener serving TLS and
+    cluster_root_ca on the intra-cluster dials (Step + follower pulls):
+    a leader elects and a broadcast commits on all nodes — enabling
+    server TLS must not break consensus (review r5 finding)."""
+    from fabric_tpu.comm.server import CertReloader, channel_to
+    from fabric_tpu.comm.services import broadcast_envelope
+    from fabric_tpu.msp.cryptogen import OrgCA
+
+    org1 = generate_org("org1.example.com", "Org1MSP")
+    oorg = generate_org("orderer.example.com", "OrdererMSP")
+    tls_ca = OrgCA("tls.example.com", "TLSCA")
+    ports = _free_ports(3)
+    profile = Profile(
+        application=ApplicationProfile(
+            organizations=[OrganizationProfile("Org1MSP", org1.msp_config())]
+        ),
+        orderer=OrdererProfile(
+            orderer_type="etcdraft",
+            batch_timeout="100ms",
+            max_message_count=1,
+            organizations=[
+                OrganizationProfile("OrdererMSP", oorg.msp_config())
+            ],
+            raft_consenters=[("127.0.0.1", p, b"", b"") for p in ports],
+        ),
+    )
+    gblock = genesis_block(profile, CHANNEL)
+
+    nodes = []
+    for i, port in enumerate(ports):
+        pair = tls_ca.enroll_tls(f"orderer{i}.tls")
+        cert = tmp_path / f"o{i}.crt"
+        key = tmp_path / f"o{i}.key"
+        cert.write_bytes(pair.cert_pem)
+        key.write_bytes(pair.key_pem)
+        node = OrdererNode(
+            str(tmp_path / f"orderer{i}"),
+            signer=SigningIdentity(oorg.peers[0]),
+            listen_address=f"127.0.0.1:{port}",
+            raft_node_id=i + 1,
+            raft_tick_seconds=0.05,
+            tls_credentials=CertReloader(str(cert), str(key)).credentials(),
+            cluster_root_ca=tls_ca.cert_pem,
+        )
+        node.join_channel(gblock)
+        node.start()
+        nodes.append(node)
+    try:
+        assert _wait(lambda: len(_leaders(nodes)) == 1, timeout=30)
+        signer = SigningIdentity(org1.users[0])
+        env = _make_envelope(signer, b"tls-cluster-payload")
+        leader = _leaders(nodes)[0]
+        conn = channel_to(leader.addr, tls_ca.cert_pem)
+        ack = broadcast_envelope(conn, env)
+        conn.close()
+        assert ack.status == common_pb2.SUCCESS, ack.info
+        assert _wait(
+            lambda: all(
+                n.registrar.get_chain(CHANNEL).chain.height >= 2
+                for n in nodes
+            ),
+            timeout=30,
+        )
+    finally:
+        for node in nodes:
+            try:
+                node.stop()
+            except Exception:
+                pass
