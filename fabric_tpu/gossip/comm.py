@@ -23,6 +23,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 
 from fabric_tpu.comm.server import GRPCServer, STREAM_STREAM, channel_to
 from fabric_tpu.gossip.membership import LeaderElection, Membership
+from fabric_tpu.gossip.pull import PULL_MEMBERSHIP
 from fabric_tpu.gossip.state import StateProvider
 from fabric_tpu.protos import common_pb2, gossip_pb2
 
@@ -320,6 +321,14 @@ class GossipNode:
             "data_update",
             "peer_identity",
         ):
+            if (
+                kind == "hello"
+                and msg.hello.msg_type == PULL_MEMBERSHIP
+            ):
+                # direct membership probe of a suspect (discovery
+                # MembershipRequest): answer with OUR fresh alive so the
+                # prober refutes the suspicion
+                return self._alive_message(probe_reply=True)
             return self.pull.handle(msg)
         elif kind in ("private_data", "private_req"):
             if self.pvt is not None:
@@ -364,9 +373,14 @@ class GossipNode:
         )
 
     # -- push side --------------------------------------------------------
-    def _alive_message(self) -> gossip_pb2.GossipMessage:
-        tick = self.membership.tick()
-        self.election.evaluate()
+    def _alive_message(self, probe_reply: bool = False) -> gossip_pb2.GossipMessage:
+        if probe_reply:
+            # a probe answer needs a FRESH seq (the prober dedups by
+            # seq) but must not advance our own membership clock
+            tick = self.membership.bump_seq()
+        else:
+            tick = self.membership.tick()
+            self.election.evaluate()
         msg = gossip_pb2.GossipMessage()
         msg.channel = self.channel_id
         msg.alive_msg.membership.endpoint = self.server.addr
@@ -515,6 +529,17 @@ class GossipNode:
         batch = self._intro_messages()
         for endpoint in self._peer_endpoints():
             self._send(endpoint, batch)
+        # SWIM suspicion: direct-probe peers whose heartbeats stopped
+        # reaching us BEFORE expiring them (push loss != death); their
+        # reply is a fresh alive that refutes the suspicion
+        for pid in self.membership.newly_suspect():
+            with self._lock:
+                ep = self._endpoints.get(pid)
+            if ep:
+                probe = self.pull.hello(PULL_MEMBERSHIP)
+                threading.Thread(
+                    target=self._send, args=(ep, [probe]), daemon=True
+                ).start()
         # anti-entropy: ask ONE taller peer for the missing range
         rng = self.state.missing_range(self._peer_heights())
         if rng is not None:

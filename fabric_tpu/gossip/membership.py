@@ -10,6 +10,7 @@ the message bytes.
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
 
@@ -20,6 +21,7 @@ class PeerState:
     seq: int
     last_seen_tick: int
     metadata: bytes = b""
+    probed: bool = False  # direct probe sent this suspicion episode
 
 
 class Membership:
@@ -31,6 +33,7 @@ class Membership:
         endpoint: str = "",
         alive_expiration_ticks: int = 25,
         metadata: bytes = b"",
+        suspect_ticks: Optional[int] = None,
     ):
         self.self_id = self_id
         self.endpoint = endpoint
@@ -40,6 +43,22 @@ class Membership:
         self._alive: Dict[str, PeerState] = {}
         self._dead: Dict[str, PeerState] = {}
         self.expiration = alive_expiration_ticks
+        # SWIM suspicion (reference discovery: a silent peer is PROBED
+        # before it is declared dead — push loss must not kill a live
+        # member): silent for > suspect_ticks -> suspect (probe it);
+        # silent past expiration -> dead. A fresh alive refutes.
+        self.suspect_ticks = (
+            suspect_ticks
+            if suspect_ticks is not None
+            else max(alive_expiration_ticks // 2, 1)
+        )
+        self._suspected: Set[str] = set()
+        # seq + suspicion state see TWO writers (the ticker thread and
+        # gRPC handler threads answering probes / refuting suspicion);
+        # unsynchronized `_seq += 1` can duplicate a sequence number,
+        # which a receiver dedups as stale — losing the very refutation
+        # the probe was for
+        self._lock = threading.Lock()
 
     # -- outgoing -----------------------------------------------------------
     def tick(self) -> dict:
@@ -47,11 +66,20 @@ class Membership:
         (reference periodicalSendAlive)."""
         self._now += 1
         self._expire()
-        self._seq += 1
+        return self.bump_seq()
+
+    def bump_seq(self) -> dict:
+        """A fresh alive WITHOUT advancing local time — membership-probe
+        replies need a new sequence number (the prober dedups by seq) but
+        must not accelerate this node's expiry clock. The single shared
+        alive-dict shape for broadcasts AND probe replies."""
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
         return {
             "id": self.self_id,
             "endpoint": self.endpoint,
-            "seq": self._seq,
+            "seq": seq,
             "metadata": self.metadata,
         }
 
@@ -73,18 +101,44 @@ class Membership:
             metadata=msg.get("metadata", b""),
         )
         self._dead.pop(pid, None)
+        with self._lock:
+            self._suspected.discard(pid)  # fresh alive refutes suspicion
         self._alive[pid] = state
         return True
 
     def _expire(self) -> None:
         for pid in list(self._alive):
             st = self._alive[pid]
-            if self._now - st.last_seen_tick > self.expiration:
+            silent = self._now - st.last_seen_tick
+            if silent > self.expiration:
+                with self._lock:
+                    self._suspected.discard(pid)
                 self._dead[pid] = self._alive.pop(pid)
+            elif silent > self.suspect_ticks:
+                with self._lock:
+                    self._suspected.add(pid)
+
+    def newly_suspect(self) -> List[str]:
+        """Suspects not yet probed this suspicion episode — callers probe
+        each ONCE per episode (a refuting alive clears the episode, so a
+        peer that goes silent again gets probed again)."""
+        with self._lock:
+            suspects = sorted(self._suspected)
+        out = []
+        for pid in suspects:
+            st = self._alive.get(pid)
+            if st is not None and not st.probed:
+                st.probed = True
+                out.append(pid)
+        return out
 
     # -- views --------------------------------------------------------------
     def alive_peers(self) -> List[str]:
         return sorted(self._alive)
+
+    def suspect_peers(self) -> List[str]:
+        with self._lock:
+            return sorted(self._suspected)
 
     def dead_peers(self) -> List[str]:
         return sorted(self._dead)

@@ -25,6 +25,9 @@ from fabric_tpu.protos import common_pb2, gossip_pb2
 
 PULL_IDENTITY = 1
 PULL_BLOCK = 2
+# direct membership probe (reference discovery MembershipRequest: sent
+# to a SUSPECT peer; the response is the target's own fresh alive)
+PULL_MEMBERSHIP = 3
 
 # how many trailing blocks a responder advertises in a block digest
 # (the reference bounds its block pull store the same way; older blocks

@@ -338,3 +338,78 @@ def test_handshake_fuzz_mutations_never_authenticate():
         assert not tall._handshake_ok(m, Ctx()), field
     tall.stop()
     joiner.stop()
+
+
+# ----------------------------------------------------------------------
+# SWIM suspicion (reference discovery: probe-before-declare-dead)
+# ----------------------------------------------------------------------
+
+
+class TestSuspicion:
+    def test_alive_suspect_dead_transitions(self):
+        from fabric_tpu.gossip.membership import Membership
+
+        m = Membership("me", alive_expiration_ticks=10, suspect_ticks=4)
+        m.handle_alive({"id": "p1", "endpoint": "e1", "seq": 1})
+        for _ in range(5):
+            m.tick()
+        assert m.suspect_peers() == ["p1"]
+        assert m.alive_peers() == ["p1"]  # suspect is still routable
+        assert m.newly_suspect() == ["p1"]
+        assert m.newly_suspect() == []  # probed once per episode
+        # refutation: a FRESH alive clears suspicion and re-arms probing
+        m.handle_alive({"id": "p1", "endpoint": "e1", "seq": 2})
+        assert m.suspect_peers() == []
+        for _ in range(5):
+            m.tick()
+        assert m.newly_suspect() == ["p1"]  # new episode, new probe
+        # silence past expiration -> dead
+        for _ in range(7):
+            m.tick()
+        assert m.alive_peers() == [] and m.dead_peers() == ["p1"]
+        assert m.suspect_peers() == []
+
+    def test_stale_alive_does_not_refute(self):
+        from fabric_tpu.gossip.membership import Membership
+
+        m = Membership("me", alive_expiration_ticks=10, suspect_ticks=2)
+        m.handle_alive({"id": "p1", "endpoint": "e1", "seq": 5})
+        for _ in range(3):
+            m.tick()
+        assert m.suspect_peers() == ["p1"]
+        assert not m.handle_alive({"id": "p1", "endpoint": "e1", "seq": 5})
+        assert m.suspect_peers() == ["p1"]  # replayed seq changes nothing
+
+    def test_probe_refutes_suspicion_when_pushes_stop(self):
+        """Node A stops BROADCASTING alives (push loss) but still
+        answers probes: B must keep A alive via the direct membership
+        probe instead of expiring it (SWIM's core property)."""
+        a_ledger, b_ledger = FakeLedger(), FakeLedger()
+        a = make_node("peerA", a_ledger, tick=0.05)
+        b = make_node("peerB", b_ledger, tick=0.05)
+        # tighten B's suspicion window so the test runs fast
+        b.membership.suspect_ticks = 5
+        b.membership.expiration = 60
+        a.start()
+        b.start()
+        try:
+            a.connect(b.addr)
+            assert wait_until(
+                lambda: "peerA" in b.membership.alive_peers()
+            )
+            # A goes push-silent (its ticker no longer broadcasts) but
+            # its server still answers membership probes
+            a._intro_messages = lambda: []
+            assert wait_until(
+                lambda: b.membership._alive.get("peerA") is not None
+                and b.membership._alive["peerA"].probed,
+                timeout=15,
+            ), "B never probed the silent peer"
+            # the probe reply refreshed A: it stays alive well past the
+            # suspicion window
+            time.sleep(1.0)
+            assert "peerA" in b.membership.alive_peers()
+            assert "peerA" not in b.membership.dead_peers()
+        finally:
+            a.stop()
+            b.stop()
