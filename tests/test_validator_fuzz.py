@@ -127,3 +127,71 @@ def test_filter_parity_under_fuzz(world, round_num):
     # sanity: the fuzz actually produced a mix, not all-valid blocks
     if round_num == 0:
         assert len(set(masks[0])) >= 2
+
+
+# ----------------------------------------------------------------------
+# plugin dispatch under fuzz (round 5): a block mixing plugin-bound and
+# builtin namespaces with the same mutation corpus must produce
+# identical filters across providers, and the plugin's verdicts must
+# deterministically shape the mask
+# ----------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("round_num", range(4))
+def test_filter_parity_with_plugin_dispatch(world, round_num):
+    from fabric_tpu.validation.dispatcher import PluginRegistry
+    from fabric_tpu.validation.plugin_api import (
+        EndorsementInvalid,
+        ValidationPlugin,
+    )
+
+    class ParityPlugin(ValidationPlugin):
+        """Deterministic rules only (provider-independent): default
+        policy must hold AND the tx_id's last hex digit must be even —
+        an arbitrary but stable extra rule so the plugin actually
+        invalidates a subset."""
+
+        def validate(self, ctx):
+            if not ctx.default_check():
+                raise EndorsementInvalid("policy")
+            if ctx.tx_id and int(ctx.tx_id[-1], 16) % 2 == 1:
+                raise EndorsementInvalid("odd txid")
+
+    registry = ChaincodeRegistry(
+        [
+            ChaincodeDefinition(
+                "fuzzcc",
+                from_dsl(
+                    "OutOf(2,'Org1MSP.member','Org2MSP.member',"
+                    "'Org3MSP.member')"
+                ),
+                plugin="parity",
+            )
+        ]
+    )
+    block = _block(world, n_txs=RNG.randrange(6, 14), number=round_num + 20)
+
+    masks = []
+    for provider in (SoftwareProvider(), PurePythonProvider()):
+        plugins = PluginRegistry()
+        plugins.register("parity", ParityPlugin())
+        b = common_pb2.Block()
+        b.CopyFrom(block)
+        validator = BlockValidator(
+            CHANNEL, world["mgr"], provider, registry,
+            plugin_registry=plugins,
+        )
+        masks.append(validator.validate(b).tobytes())
+    assert masks[0] == masks[1]
+
+    # cross-check against the builtin path: any tx the BUILTIN validator
+    # rejects must also be rejected under the plugin (it only ADDS a
+    # rule on top of default_check)
+    b = common_pb2.Block()
+    b.CopyFrom(block)
+    builtin_mask = BlockValidator(
+        CHANNEL, world["mgr"], SoftwareProvider(), world["registry"]
+    ).validate(b).tobytes()
+    for plugin_code, builtin_code in zip(masks[0], builtin_mask):
+        if builtin_code != 0:
+            assert plugin_code != 0, (plugin_code, builtin_code)
