@@ -314,3 +314,70 @@ def test_cli_package_golang_layout(tmp_path):
     assert meta["label"] == "asset_1"
     assert meta["path"] == str(src)
     assert set(files) == {"src/go.mod", "src/main.go"}
+
+
+def test_ccaas_reconnects_after_server_restart(tmp_path, listener_server):
+    """Stream death (chaincode server restart) must not wedge the name:
+    the handler leaves the registry and the NEXT invoke re-dials the
+    (re-started) server at the same address."""
+    import socket
+    import time as _time
+
+    listener, _addr = listener_server
+    raw_probe = package("rcc", {"connection.json": b"{}"}, cc_type="ccaas")
+    pid = package_id(raw_probe)
+
+    # pin a port so the restarted server reuses the address
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    cc_addr = f"127.0.0.1:{port}"
+
+    server = CcaasServer(KV(), pid, listen_address=cc_addr)
+    server.start()
+    raw = package(
+        "rcc",
+        {
+            "connection.json": json.dumps(
+                {"address": cc_addr, "dial_timeout": "5s"}
+            ).encode()
+        },
+        cc_type="ccaas",
+    )
+    store = PackageStore(str(tmp_path / "pkgs"))
+    installed = store.install(raw)
+    support = ChaincodeSupport(
+        listener=listener,
+        launcher=Launcher(str(tmp_path / "build")),
+        package_store=store,
+        source_resolver=lambda cid, name: installed.package_id,
+        chaincode_address=lambda: None,
+    )
+    resp, _ = _exec(support, "rcc", [b"put", b"a", b"1"])
+    assert resp.status == shim.OK, resp.message
+
+    # restart the chaincode server (stream dies server-side)
+    server.stop()
+    deadline = _time.time() + 10
+    while listener.connected(installed.package_id) and _time.time() < deadline:
+        _time.sleep(0.05)
+    assert not listener.connected(installed.package_id), "stale handler"
+
+    server2 = CcaasServer(KV(), pid, listen_address=cc_addr)
+    server2.start()
+    try:
+        # the re-dial happens per invoke; retry briefly while the OS
+        # releases the old port / the fresh server finishes binding
+        deadline = _time.time() + 10
+        while True:
+            try:
+                resp, _ = _exec(support, "rcc", [b"put", b"b", b"2"])
+                break
+            except Exception:
+                if _time.time() > deadline:
+                    raise
+                _time.sleep(0.2)
+        assert resp.status == shim.OK, resp.message  # re-dialed
+    finally:
+        server2.stop()
