@@ -211,6 +211,16 @@ def _client_signer(args):
     return load_signing_identity(args.mspDir, args.mspID)
 
 
+def _dial(args, addr):
+    """Client dial honoring --cafile (reference CLI --tls --cafile):
+    TLS with the CA when given, plaintext otherwise."""
+    ca = getattr(args, "cafile", None)
+    if ca:
+        with open(ca, "rb") as f:
+            return channel_to(addr, f.read())
+    return channel_to(addr)
+
+
 def chaincode_cmd(args) -> int:
     signer = _client_signer(args)
     spec = json.loads(args.c)
@@ -219,7 +229,7 @@ def chaincode_cmd(args) -> int:
     signed = create_signed_proposal(bundle, signer)
     responses = []
     for addr in args.peerAddresses:
-        conn = channel_to(addr)
+        conn = _dial(args, addr)
         resp = process_proposal(conn, signed)
         conn.close()
         if resp.response.status != 200:
@@ -240,7 +250,7 @@ def chaincode_cmd(args) -> int:
             sys.stdout.flush()
         return 0
     env = create_signed_tx(bundle, signer, responses)
-    conn = channel_to(args.o)
+    conn = _dial(args, args.o)
     ack = broadcast_envelope(conn, env)
     conn.close()
     if ack.status != common_pb2.SUCCESS:
@@ -285,7 +295,7 @@ def snapshot_cmd(args) -> int:
             peer_pb2.QueryPendingSnapshotsResponse.FromString,
         ),
     }[args.cmd]
-    conn = channel_to(args.peerAddress)
+    conn = _dial(args, args.peerAddress)
     try:
         stub = conn.unary_unary(
             f"/protos.Snapshot/{method}",
@@ -307,12 +317,12 @@ def snapshot_cmd(args) -> int:
     return 0
 
 
-def _scc_invoke(addr, signer, channel, cc_name, cc_args):
+def _scc_invoke(addr, signer, channel, cc_name, cc_args, root_ca=b""):
     """One signed proposal to a (system) chaincode; returns the Response
     or exits nonzero on endorsement failure."""
     bundle = create_proposal(signer, channel, cc_name, cc_args)
     signed = create_signed_proposal(bundle, signer)
-    conn = channel_to(addr)
+    conn = channel_to(addr, root_ca or None)
     resp = process_proposal(conn, signed)
     conn.close()
     if resp.response.status != 200:
@@ -334,6 +344,7 @@ def channel_cmd(args) -> int:
         _scc_invoke(
             args.peerAddress, signer, "", "cscc",
             [b"JoinChain", block_bytes],
+            root_ca=_read_pem(getattr(args, "cafile", None)),
         )
         print("channel joined")
         return 0
@@ -341,12 +352,14 @@ def channel_cmd(args) -> int:
         resp = _scc_invoke(
             args.peerAddress, signer, "", "cscc",
             [b"JoinChainBySnapshot", args.snapshotpath.encode()],
+            root_ca=_read_pem(getattr(args, "cafile", None)),
         )
         print(f"channel {resp.payload.decode()} joined from snapshot")
         return 0
     if args.cmd == "list":
         resp = _scc_invoke(
-            args.peerAddress, signer, "", "cscc", [b"GetChannels"]
+            args.peerAddress, signer, "", "cscc", [b"GetChannels"],
+            root_ca=_read_pem(getattr(args, "cafile", None)),
         )
         from fabric_tpu.protos import peer_pb2 as _peer_pb2
 
@@ -375,7 +388,7 @@ def channel_cmd(args) -> int:
         payload.header.signature_header = shdr.SerializeToString()
         env.payload = payload.SerializeToString()
         env.signature = signer.sign(env.payload)
-        conn = channel_to(args.orderer)
+        conn = _dial(args, args.orderer)
         ack = broadcast_envelope(conn, env)
         if ack.status != common_pb2.SUCCESS:
             conn.close()
@@ -394,9 +407,9 @@ def channel_cmd(args) -> int:
         # from the peer's own deliver service (CORE_PEER_ADDRESS,
         # usable-inter-nal/peer/channel/fetch.go)
         if args.orderer:
-            conn, service = channel_to(args.orderer), "orderer.AtomicBroadcast"
+            conn, service = _dial(args, args.orderer), "orderer.AtomicBroadcast"
         elif args.peerAddress:
-            conn, service = channel_to(args.peerAddress), "protos.Deliver"
+            conn, service = _dial(args, args.peerAddress), "protos.Deliver"
         else:
             print("fetch needs --orderer or --peerAddress", file=sys.stderr)
             return 2
@@ -508,6 +521,7 @@ def lifecycle_cmd(args) -> int:
         resp = _scc_invoke(
             args.peerAddress, signer, "", "_lifecycle",
             [b"InstallChaincode", raw],
+            root_ca=_read_pem(getattr(args, "cafile", None)),
         )
         print(f"installed package: {resp.payload.decode()}")
         return 0
@@ -515,6 +529,7 @@ def lifecycle_cmd(args) -> int:
         resp = _scc_invoke(
             args.peerAddress, signer, "", "_lifecycle",
             [b"QueryInstalledChaincodes"],
+            root_ca=_read_pem(getattr(args, "cafile", None)),
         )
         for entry in json.loads(resp.payload or b"[]"):
             print(
@@ -532,6 +547,7 @@ def lifecycle_cmd(args) -> int:
         _scc_invoke(
             args.peerAddress, signer, "", "_lifecycle",
             [b"ApproveChaincodeDefinitionForOrg", req],
+            root_ca=_read_pem(getattr(args, "cafile", None)),
         )
         print("chaincode definition approved for org")
         return 0
@@ -652,6 +668,8 @@ def main(argv=None) -> int:
         p.add_argument("--mspID", required=True)
         p.add_argument("--b64", action="store_true",
                        help="base64-encode query payload output")
+        p.add_argument("--cafile", default="",
+                       help="TLS root CA PEM for peer/orderer dials")
 
     chan = sub.add_parser("channel")
     chan_sub = chan.add_subparsers(dest="cmd", required=True)
@@ -677,6 +695,7 @@ def main(argv=None) -> int:
     for p in (cj, cjs, cl, ccr, cf):
         p.add_argument("--mspDir", required=True)
         p.add_argument("--mspID", required=True)
+        p.add_argument("--cafile", default="")
 
     snap = sub.add_parser("snapshot")
     snap_sub = snap.add_subparsers(dest="cmd", required=True)
@@ -691,6 +710,7 @@ def main(argv=None) -> int:
         p.add_argument("--peerAddress", required=True)
         p.add_argument("--mspDir", required=True)
         p.add_argument("--mspID", required=True)
+        p.add_argument("--cafile", default="")
 
     lc = sub.add_parser("lifecycle")
     lc_sub0 = lc.add_subparsers(dest="noun", required=True)
@@ -719,6 +739,7 @@ def main(argv=None) -> int:
         p.add_argument("--peerAddress", required=True)
         p.add_argument("--mspDir", required=True)
         p.add_argument("--mspID", required=True)
+        p.add_argument("--cafile", default="")
 
     args = parser.parse_args(argv)
     if args.group == "node" and args.cmd == "start":
