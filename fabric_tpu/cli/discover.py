@@ -28,18 +28,25 @@ def main(argv=None) -> int:
         p.add_argument("--channel", required=True)
         p.add_argument("--mspDir", required=True)
         p.add_argument("--mspID", required=True)
+        p.add_argument("--cafile", default="",
+                       help="TLS root CA PEM for the peer dial")
         if cmd == "endorsers":
             p.add_argument("--chaincode", required=True)
 
     args = parser.parse_args(argv)
     signer = load_signing_identity(args.mspDir, args.mspID)
     try:
+        root_ca = None
+        if args.cafile:
+            with open(args.cafile, "rb") as f:
+                root_ca = f.read()
         result = query(
             args.server,
             signer,
             args.channel,
             args.cmd,
             chaincode=getattr(args, "chaincode", ""),
+            root_ca=root_ca,
         )
     except DiscoveryError as exc:
         print(f"discovery failed: {exc}", file=sys.stderr)
