@@ -193,7 +193,30 @@ def node_start(config_path: str, block_until_signal: bool = True) -> PeerNode:
     node, pc = _load_node(config_path)
     addr = node.start()
     orderer = pc.get("ordererEndpoint")
-    if orderer:
+    gossip_cfg = pc.get("gossip") or {}
+    if gossip_cfg.get("enabled"):
+        # reference peers always run gossip; here it is opt-in config:
+        #   gossip:
+        #     enabled: true
+        #     listenAddress: 127.0.0.1:0     # per-channel port +i
+        #     bootstrap: [host:port, ...]    # anchor peers
+        # the elected LEADER runs the orderer deliver client and pushes
+        # blocks; followers converge via push + pull + anti-entropy
+        for channel_id in list(node.channels):
+            node.enable_gossip_for_channel(
+                channel_id,
+                bootstrap=gossip_cfg.get("bootstrap") or [],
+                orderer_addr=orderer,
+                gossip_listen=gossip_cfg.get(
+                    "listenAddress", "127.0.0.1:0"
+                ),
+            )
+            g = node.gossip_nodes[channel_id]
+            logger.info(
+                "gossip for %s on %s", channel_id, g.addr
+            )
+            print(f"gossip {channel_id} on {g.addr}", flush=True)
+    elif orderer:
         for channel_id in list(node.channels):
             node.start_deliver_for_channel(channel_id, orderer)
     logger.info("peer listening on %s", addr)

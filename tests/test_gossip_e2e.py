@@ -1,0 +1,230 @@
+"""Gossip-enabled subprocess network: two REAL peer processes on one
+channel; the elected leader pulls from the orderer and the follower —
+which has NO deliver client of its own — converges via gossip push/pull
+(reference: gossip service + deliveryclient leader election, the
+default peer deployment shape)."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_cli(mod, *args, timeout=90):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, "-m", mod, *args],
+        capture_output=True, text=True, timeout=timeout, env=env, cwd=REPO,
+    )
+    assert out.returncode == 0, f"{mod} {args}:\n{out.stdout}\n{out.stderr}"
+    return out.stdout
+
+
+def spawn(mod, *args):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.Popen(
+        [sys.executable, "-m", mod, *args],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd=REPO,
+    )
+
+
+def wait_line(proc, needle, timeout=60):
+    deadline = time.time() + timeout
+    lines = []
+    while time.time() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            if proc.poll() is not None:
+                raise AssertionError(
+                    f"process exited {proc.returncode}: {''.join(lines)}"
+                )
+            continue
+        lines.append(line)
+        if needle in line:
+            return line.rsplit(" ", 1)[-1].strip()
+    raise AssertionError(f"never saw {needle!r}: {''.join(lines)}")
+
+
+@pytest.fixture(scope="module")
+def gossip_net(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("gossipnet")
+    crypto = tmp / "crypto-config"
+    (tmp / "crypto-config.yaml").write_text(
+        """
+PeerOrgs:
+  - Name: Org1
+    Domain: org1.example.com
+    MSPID: Org1MSP
+    Template: {Count: 2}
+    Users: {Count: 1}
+OrdererOrgs:
+  - Name: Orderer
+    Domain: orderer.example.com
+    MSPID: OrdererMSP
+"""
+    )
+    run_cli(
+        "fabric_tpu.cli.cryptogen", "generate",
+        "--config", str(tmp / "crypto-config.yaml"),
+        "--output", str(crypto),
+    )
+    org1 = crypto / "peerOrganizations" / "org1.example.com"
+    oorg = crypto / "ordererOrganizations" / "orderer.example.com"
+
+    (tmp / "configtx.yaml").write_text(
+        f"""
+Profiles:
+  OneOrgChannel:
+    Orderer:
+      OrdererType: solo
+      BatchTimeout: 100ms
+      BatchSize: {{MaxMessageCount: 10}}
+      Organizations:
+        - Name: OrdererMSP
+          MSPID: OrdererMSP
+          MSPDir: {oorg}/msp
+    Application:
+      Organizations:
+        - Name: Org1MSP
+          MSPID: Org1MSP
+          MSPDir: {org1}/msp
+"""
+    )
+    gblock = tmp / "gchan.block"
+    run_cli(
+        "fabric_tpu.cli.configtxgen",
+        "-profile", "OneOrgChannel", "-channelID", "gchan",
+        "-configPath", str(tmp / "configtx.yaml"),
+        "-outputBlock", str(gblock),
+    )
+
+    (tmp / "orderer.yaml").write_text(
+        f"""
+General:
+  ListenAddress: 127.0.0.1
+  ListenPort: 0
+  LocalMSPID: OrdererMSP
+  LocalMSPDir: {oorg}/users/Admin@orderer.example.com/msp
+  BootstrapFile: {gblock}
+  WorkDir: {tmp}/orderer-data
+"""
+    )
+    orderer_proc = spawn(
+        "fabric_tpu.cli.orderer", "start", "--config", str(tmp / "orderer.yaml")
+    )
+    orderer_addr = wait_line(orderer_proc, "orderer listening on")
+
+    (tmp / "kvcc_chaincode.py").write_text(
+        "from fabric_tpu.chaincode import success, error_response\n"
+        "class KVChaincode:\n"
+        "    def init(self, stub):\n"
+        "        return success()\n"
+        "    def invoke(self, stub):\n"
+        "        fn, params = stub.get_function_and_parameters()\n"
+        "        if fn == 'put':\n"
+        "            stub.put_state(params[0], params[1].encode())\n"
+        "            return success(b'ok')\n"
+        "        if fn == 'get':\n"
+        "            return success(stub.get_state(params[0]) or b'')\n"
+        "        return error_response('unknown ' + fn)\n"
+    )
+
+    def core_yaml(i, bootstrap):
+        boot = f"[{bootstrap}]" if bootstrap else "[]"
+        return f"""
+BCCSP:
+  Default: SW
+peer:
+  listenAddress: 127.0.0.1:0
+  localMspId: Org1MSP
+  mspConfigPath: {org1}/peers/peer{i}.org1.example.com/msp
+  fileSystemPath: {tmp}/peer{i}-data
+  orgMspDirs:
+    Org1MSP: {org1}/msp
+  ordererEndpoint: {orderer_addr}
+  genesisBlocks: [{gblock}]
+  gossip:
+    enabled: true
+    bootstrap: {boot}
+  chaincodes:
+    kvcc: "OR('Org1MSP.member')"
+  chaincodePath: [{tmp}]
+  chaincodePlugins:
+    kvcc: "kvcc_chaincode:KVChaincode"
+"""
+
+    (tmp / "core0.yaml").write_text(core_yaml(0, ""))
+    peer0 = spawn(
+        "fabric_tpu.cli.peer", "node", "start", "--config", str(tmp / "core0.yaml")
+    )
+    gossip0 = wait_line(peer0, "gossip gchan on")
+    peer0_addr = wait_line(peer0, "peer listening on")
+
+    (tmp / "core1.yaml").write_text(core_yaml(1, gossip0))
+    peer1 = spawn(
+        "fabric_tpu.cli.peer", "node", "start", "--config", str(tmp / "core1.yaml")
+    )
+    wait_line(peer1, "gossip gchan on")
+    peer1_addr = wait_line(peer1, "peer listening on")
+
+    yield {
+        "tmp": tmp,
+        "orderer_addr": orderer_addr,
+        "peer0_addr": peer0_addr,
+        "peer1_addr": peer1_addr,
+        "user_msp": str(org1 / "users" / "User0@org1.example.com" / "msp"),
+    }
+    for proc in (orderer_proc, peer0, peer1):
+        proc.send_signal(signal.SIGTERM)
+    for proc in (orderer_proc, peer0, peer1):
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
+def _query(nw, peer_addr, *fn_args):
+    import base64
+
+    out = run_cli(
+        "fabric_tpu.cli.peer", "chaincode", "query",
+        "--peerAddresses", peer_addr,
+        "-C", "gchan", "-n", "kvcc",
+        "-c", json.dumps({"Args": list(fn_args)}),
+        "--mspDir", nw["user_msp"], "--mspID", "Org1MSP", "--b64",
+    )
+    return base64.b64decode(out.strip())
+
+
+def test_gossip_network_converges_both_peers(gossip_net):
+    nw = gossip_net
+    run_cli(
+        "fabric_tpu.cli.peer", "chaincode", "invoke",
+        "--peerAddresses", nw["peer0_addr"],
+        "-o", nw["orderer_addr"],
+        "-C", "gchan", "-n", "kvcc",
+        "-c", json.dumps({"Args": ["put", "gk", "gv"]}),
+        "--mspDir", nw["user_msp"], "--mspID", "Org1MSP",
+    )
+    # BOTH peers converge: one pulled from the orderer as gossip
+    # leader, the other received the block via gossip only
+    deadline = time.time() + 45
+    vals = {}
+    while time.time() < deadline:
+        vals = {
+            p: _query(nw, nw[p], "get", "gk")
+            for p in ("peer0_addr", "peer1_addr")
+        }
+        if all(v == b"gv" for v in vals.values()):
+            break
+        time.sleep(0.5)
+    assert all(v == b"gv" for v in vals.values()), vals
