@@ -62,6 +62,38 @@ def _read_pem(path) -> bytes:
         return f.read()
 
 
+def _gossip_tls_from_config(tls_cfg):
+    """peer.gossip.tls: {cert, key, rootCAs} -> the GossipNode TLS dict
+    (mTLS server creds + client pair + own cert DER for the
+    ConnEstablish hash binding; require_handshake defaults true when
+    TLS is configured)."""
+    if not tls_cfg or not tls_cfg.get("cert") or not tls_cfg.get("key"):
+        return None
+    from cryptography import x509
+    from cryptography.hazmat.primitives.serialization import Encoding
+
+    from fabric_tpu.comm.server import tls_server_credentials
+
+    cert_pem = _read_pem(tls_cfg["cert"])
+    key_pem = _read_pem(tls_cfg["key"])
+    cas = tls_cfg.get("rootCAs") or tls_cfg.get("clientRootCAs")
+    if isinstance(cas, str):
+        cas = [cas]
+    ca_pem = b"".join(_read_pem(p) for p in cas or []) or cert_pem
+    return {
+        "server_creds": tls_server_credentials(
+            cert_pem, key_pem, client_ca_pem=ca_pem
+        ),
+        "client": (ca_pem, (key_pem, cert_pem)),
+        "self_cert_der": x509.load_pem_x509_certificate(
+            cert_pem
+        ).public_bytes(Encoding.DER),
+        "require_handshake": bool(
+            tls_cfg.get("requireHandshake", True)
+        ),
+    }
+
+
 def _couch_mirror_factory(couch_cfg):
     """ledger.stateCouch: {url} -> per-channel CouchStateAdapter
     factory (None when unconfigured)."""
@@ -194,6 +226,7 @@ def node_start(config_path: str, block_until_signal: bool = True) -> PeerNode:
     addr = node.start()
     orderer = pc.get("ordererEndpoint")
     gossip_cfg = pc.get("gossip") or {}
+    gossip_tls = _gossip_tls_from_config(gossip_cfg.get("tls"))
     if gossip_cfg.get("enabled"):
         # reference peers always run gossip; here it is opt-in config:
         #   gossip:
@@ -210,6 +243,7 @@ def node_start(config_path: str, block_until_signal: bool = True) -> PeerNode:
                 gossip_listen=gossip_cfg.get(
                     "listenAddress", "127.0.0.1:0"
                 ),
+                tls=gossip_tls,
             )
             g = node.gossip_nodes[channel_id]
             logger.info(

@@ -64,6 +64,15 @@ def write_org_dir(org: Org, root: str) -> str:
                 os.path.join(base, "cacerts", f"ca.{org.ca.org_name}-cert.pem"),
                 org.ca.cert_pem,
             )
+            if kind == "peers":
+                # TLS material alongside the MSP (reference cryptogen's
+                # tls/ folder: server.crt/server.key/ca.crt) so TLS
+                # configs have files to point at out of the box
+                pair = org.ca.enroll_tls(node.name)
+                tls_dir = os.path.join(org_dir, kind, node.name, "tls")
+                _write(os.path.join(tls_dir, "server.crt"), pair.cert_pem)
+                _write(os.path.join(tls_dir, "server.key"), pair.key_pem)
+                _write(os.path.join(tls_dir, "ca.crt"), pair.ca_pem)
     return org_dir
 
 

@@ -2,7 +2,9 @@
 channel; the elected leader pulls from the orderer and the follower —
 which has NO deliver client of its own — converges via gossip push/pull
 (reference: gossip service + deliveryclient leader election, the
-default peer deployment shape)."""
+default peer deployment shape). Gossip runs over mTLS with the
+ConnEstablish cert-hash handshake, using the tls/ material cryptogen
+now emits per peer."""
 
 import json
 import os
@@ -155,6 +157,10 @@ peer:
   gossip:
     enabled: true
     bootstrap: {boot}
+    tls:
+      cert: {org1}/peers/peer{i}.org1.example.com/tls/server.crt
+      key: {org1}/peers/peer{i}.org1.example.com/tls/server.key
+      rootCAs: [{org1}/peers/peer{i}.org1.example.com/tls/ca.crt]
   chaincodes:
     kvcc: "OR('Org1MSP.member')"
   chaincodePath: [{tmp}]
