@@ -140,8 +140,11 @@ General:
         "        return error_response('unknown ' + fn)\n"
     )
 
-    def core_yaml(i, bootstrap):
+    def core_yaml(i, bootstrap, with_orderer=True):
         boot = f"[{bootstrap}]" if bootstrap else "[]"
+        orderer_line = (
+            f"ordererEndpoint: {orderer_addr}" if with_orderer else ""
+        )
         return f"""
 BCCSP:
   Default: SW
@@ -152,7 +155,7 @@ peer:
   fileSystemPath: {tmp}/peer{i}-data
   orgMspDirs:
     Org1MSP: {org1}/msp
-  ordererEndpoint: {orderer_addr}
+  {orderer_line}
   genesisBlocks: [{gblock}]
   gossip:
     enabled: true
@@ -182,16 +185,20 @@ peer:
     wait_line(peer1, "gossip gchan on")
     peer1_addr = wait_line(peer1, "peer listening on")
 
+    late_procs = []
     yield {
         "tmp": tmp,
         "orderer_addr": orderer_addr,
         "peer0_addr": peer0_addr,
         "peer1_addr": peer1_addr,
+        "gossip0": gossip0,
+        "core_yaml": core_yaml,
+        "spawn_late": late_procs.append,
         "user_msp": str(org1 / "users" / "User0@org1.example.com" / "msp"),
     }
-    for proc in (orderer_proc, peer0, peer1):
+    for proc in (orderer_proc, peer0, peer1, *late_procs):
         proc.send_signal(signal.SIGTERM)
-    for proc in (orderer_proc, peer0, peer1):
+    for proc in (orderer_proc, peer0, peer1, *late_procs):
         try:
             proc.wait(timeout=10)
         except subprocess.TimeoutExpired:
@@ -234,3 +241,35 @@ def test_gossip_network_converges_both_peers(gossip_net):
             break
         time.sleep(0.5)
     assert all(v == b"gv" for v in vals.values()), vals
+
+
+def test_late_joiner_catches_up_via_gossip_only(gossip_net):
+    """A peer started AFTER blocks committed, with NO ordererEndpoint at
+    all: its ledger can only come from gossip (push + block pull +
+    anti-entropy) — the reference's peer-joins-running-channel shape."""
+    nw = gossip_net
+    # peer0/peer1 already committed "gk" in the previous test; reuse
+    # peer0's (Count=2 crypto) msp for the late joiner under a fresh
+    # fileSystemPath by reusing index 1's identity with its own data dir
+    tmp = nw["tmp"]
+    late_yaml = nw["core_yaml"](1, nw["gossip0"], with_orderer=False)
+    late_yaml = late_yaml.replace("peer1-data", "late-data")
+    (tmp / "late.yaml").write_text(late_yaml)
+    late = spawn(
+        "fabric_tpu.cli.peer", "node", "start", "--config", str(tmp / "late.yaml")
+    )
+    nw["spawn_late"](late)
+    wait_line(late, "gossip gchan on")
+    late_addr = wait_line(late, "peer listening on")
+
+    deadline = time.time() + 60
+    val = b""
+    while time.time() < deadline:
+        try:
+            val = _query(nw, late_addr, "get", "gk")
+        except AssertionError:
+            val = b""
+        if val == b"gv":
+            break
+        time.sleep(0.5)
+    assert val == b"gv"
