@@ -316,6 +316,7 @@ class Ate2Kernel:
             )
         )
         self._fn = _shared_fn()
+        self._sharded_fns = {}
 
     def check(
         self,
@@ -345,13 +346,54 @@ class Ate2Kernel:
             out.extend(bool(v) for v in np.asarray(mask)[:chunk_n])
         return out
 
-    def _dispatch_chunk(self, pairs, force_bucket=None):
+    def check_sharded(self, pairs, mesh, axis: str = "data") -> List[bool]:
+        """Lane-sharded pairing over a jax.sharding.Mesh (SURVEY P6):
+        the per-lane Miller loop + final exponentiation have no cross-
+        lane ops, so GSPMD splits the batch across the mesh's data axis
+        — the multi-chip scale-out of the idemix verify column. Line
+        schedules replicate (they are per-ISSUER, tiny next to the lane
+        tensors); lanes pad to a bucket divisible by the axis size."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
         n = len(pairs)
-        bucket = force_bucket or next(b for b in _BUCKETS if n <= b)
-        cols = {"p1x": [], "p1y": [], "p2x": [], "p2y": [], "ok": []}
+        if n == 0:
+            return []
+        ndev = mesh.shape[axis]
+        bucket = next(
+            (b for b in _BUCKETS if b >= n and b % ndev == 0),
+            ((n + ndev - 1) // ndev) * ndev,
+        )
+        fn = self._sharded_fns.get((id(mesh), axis, bucket))
+        if fn is None:
+            sched_g = self.sched_g
+            rep = NamedSharding(mesh, P())
+            lane = NamedSharding(mesh, P(None, axis))  # (NLIMBS, B)
+            mask = NamedSharding(mesh, P(axis))  # (B,)
+            w_spec = tuple(rep for _ in self._w_arrs)
+
+            def run(w_arrs, p1x, p1y, p2x, p2y, ok):
+                return _unity_check(
+                    w_arrs, sched_g, p1x, p1y, p2x, p2y, ok
+                )
+
+            fn = jax.jit(
+                run,
+                in_shardings=(w_spec, lane, lane, lane, lane, mask),
+                out_shardings=rep,  # all-gather the per-shard verdicts
+            )
+            self._sharded_fns[(id(mesh), axis, bucket)] = fn
+        cols = self._mont_cols(list(pairs), bucket)
+        with bn.force_looped_cios():
+            mask_out = fn(self._w_arrs, *cols)
+        return [bool(v) for v in np.asarray(mask_out)[:n]]
+
+    def _mont_cols(self, pairs, bucket):
+        """(p1x, p1y, p2x, p2y, ok) kernel columns for `bucket` lanes."""
         gx, gy = host.G1_GEN
+        cols = {"p1x": [], "p1y": [], "p2x": [], "p2y": [], "ok": []}
         for i in range(bucket):
-            pair = pairs[i] if i < n else None
+            pair = pairs[i] if i < len(pairs) else None
             if pair is None or pair[0] is None or pair[1] is None:
                 p1, p2, ok = (gx, gy), (gx, gy), False
             else:
@@ -362,21 +404,28 @@ class Ate2Kernel:
             cols["p2y"].append(p2[1])
             cols["ok"].append(ok)
 
-        def mont_cols(vals):
-            return np.stack(
-                [f12.to_mont_int(v) for v in vals], axis=1
-            ).astype(np.uint32)  # (NLIMBS, B)
+        def mont(vals):
+            return jnp.asarray(
+                np.stack(
+                    [f12.to_mont_int(v) for v in vals], axis=1
+                ).astype(np.uint32)
+            )
 
+        return (
+            mont(cols["p1x"]),
+            mont(cols["p1y"]),
+            mont(cols["p2x"]),
+            mont(cols["p2y"]),
+            jnp.asarray(np.array(cols["ok"], dtype=bool)),
+        )
+
+    def _dispatch_chunk(self, pairs, force_bucket=None):
+        n = len(pairs)
+        bucket = force_bucket or next(b for b in _BUCKETS if n <= b)
+        cols = self._mont_cols(pairs, bucket)
         with bn.force_looped_cios():
             # async dispatch: the mask materializes in check()'s drain
-            return self._fn(
-                self._w_arrs,
-                jnp.asarray(mont_cols(cols["p1x"])),
-                jnp.asarray(mont_cols(cols["p1y"])),
-                jnp.asarray(mont_cols(cols["p2x"])),
-                jnp.asarray(mont_cols(cols["p2y"])),
-                jnp.asarray(np.array(cols["ok"], dtype=bool)),
-            )
+            return self._fn(self._w_arrs, *cols)
 
 
 @lru_cache(maxsize=1)

@@ -196,3 +196,34 @@ def test_idemix_batch_device_pairing_matches_host():
     assert host_out == dev_out
     assert dev_out[0] is True or dev_out[0] == True  # noqa: E712
     assert not dev_out[1]
+
+
+@full_kernel
+def test_ate2_sharded_matches_single_device():
+    """Lane-sharded pairing over an 8-device mesh (SURVEY P6: the
+    multi-chip scale-out of the idemix verify column) agrees lane-exact
+    with the single-device program."""
+    import jax
+
+    from fabric_tpu.ops.pairing_kernel import Ate2Kernel
+    from fabric_tpu.parallel.mesh import flat_mesh
+
+    gamma = RNG.randrange(1, host.R)
+    w = host.g2_mul(host.G2_GEN, gamma)
+    kernel = Ate2Kernel(w)
+
+    pairs = []
+    for i in range(11):  # odd count: exercises padding to 16 lanes
+        a = _rand_g1()
+        if i % 3 == 2:
+            pairs.append((a, host.g1_mul(a, (gamma + 1) % host.R)))
+        elif i % 5 == 4:
+            pairs.append(None)
+        else:
+            pairs.append((a, host.g1_mul(a, gamma)))
+
+    single = kernel.check(list(pairs))
+    mesh = flat_mesh(jax.devices("cpu")[:8])
+    sharded = kernel.check_sharded(list(pairs), mesh)
+    assert sharded == single
+    assert True in single and False in single  # mixed verdicts
