@@ -697,12 +697,23 @@ def main():
     configs = {}
     triples = gen_triples(n)
     cpu_rate = bench_cpu_baseline(triples)
+    try:
+        import subprocess
+
+        rev = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=5,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        ).stdout.strip()
+    except Exception:  # noqa: BLE001 - no git: omit
+        rev = ""
     result = {
         "metric": "ecdsa_p256_verify_throughput",
         "value": round(cpu_rate, 1),
         "unit": "verifies/s",
         "vs_baseline": 1.0,
         "detail": {
+            "rev": rev,
             "batch": n,
             "iters": iters,
             "cpu_baseline_verifies_per_s": round(cpu_rate, 1),
