@@ -6,23 +6,33 @@ Fabric v2.x: a permissioned blockchain whose commit-time validation pipeline
 read-set conflict checks) is the performance-critical core. This package
 rebuilds that system TPU-first:
 
-- ``fabric_tpu.crypto``     -- BCCSP-style pluggable crypto providers
-                               (host software provider + batched TPU provider).
-- ``fabric_tpu.ops``        -- JAX/XLA device kernels: limb bignum arithmetic,
-                               batched P-256 ECDSA verification.
+- ``fabric_tpu.crypto``     -- BCCSP providers: OpenSSL software, batched TPU,
+                               PKCS#11 HSM (Cryptoki ctypes); config factory.
+- ``fabric_tpu.ops``        -- JAX/XLA kernels: limb bignum, batched P-256
+                               ECDSA, FP256BN G1 MSM, Fp12 tower + Ate2 pairing
+                               (mesh-shardable).
+- ``fabric_tpu.parallel``   -- jax.sharding mesh layer: data/channel-axis
+                               sharded verification, RTT-adaptive batcher.
 - ``fabric_tpu.policy``     -- signature-policy (cauthdsl) compile + eval.
-- ``fabric_tpu.msp``        -- X.509 identity layer (deserialize/validate/
-                               principal matching) + test-crypto generator.
-- ``fabric_tpu.ledger``     -- rwsets, versioned state DB, MVCC validation.
-- ``fabric_tpu.validation`` -- txflags bitmask + block validator pipeline.
+- ``fabric_tpu.msp``        -- X.509 + Idemix MSPs, cryptogen (MSP + TLS).
+- ``fabric_tpu.idemix``     -- BBS+-style scheme, batched verification.
+- ``fabric_tpu.validation`` -- batched block validator, native columnar
+                               parse, SBE, pluggable validation SPI.
+- ``fabric_tpu.ledger``     -- kvledger commit, MVCC (host/device/resident),
+                               block+pvtdata stores, snapshots, queries,
+                               CouchDB REST mirror.
+- ``fabric_tpu.peer`` / ``orderer`` / ``nodes`` / ``cli`` -- channel commit
+                               pipeline, solo+raft ordering, composition
+                               roots, the seven reference CLIs.
+- ``fabric_tpu.gossip``     -- SWIM membership + suspicion probes, push +
+                               pull mediators, TLS-bound handshake, pvtdata.
+- ``fabric_tpu.comm``       -- gRPC + mTLS (hot cert rotation, per-service
+                               limits), interceptors.
 - ``fabric_tpu.protos``     -- Fabric-wire-compatible datamodel (protobuf).
-
-Planned next (SURVEY.md §7 stages 3-6): block store/kvledger commit,
-ordering service, device MVCC probes, gossip/state transfer, Idemix.
 
 Parity contract: per-transaction VALID/INVALID bitmask (uint8
 TxValidationCode, reference usable-inter-nal/pkg/txflags/validation_flags.go)
 is bit-exact with the reference software path.
 """
 
-__version__ = "0.1.0"
+__version__ = "0.5.0"
