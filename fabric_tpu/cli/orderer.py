@@ -113,12 +113,21 @@ def start(config_path: str, block_until_signal: bool = True) -> OrdererNode:
     return node
 
 
+def _version_cmd() -> int:
+    from fabric_tpu.cli.peer import _version_cmd as _v
+
+    return _v("orderer")
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="orderer")
     sub = parser.add_subparsers(dest="cmd", required=True)
     st = sub.add_parser("start")
     st.add_argument("--config", required=True)
+    sub.add_parser("version")
     args = parser.parse_args(argv)
+    if args.cmd == "version":
+        return _version_cmd()
     if args.cmd == "start":
         start(args.config)
         return 0

@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import signal
 import sys
 import threading
@@ -262,6 +263,32 @@ def node_start(config_path: str, block_until_signal: bool = True) -> PeerNode:
         stop.wait()
         node.stop()
     return node
+
+
+def _version_cmd(binary: str) -> int:
+    """reference `peer version` (cmd/peer/version): tool, framework
+    version, commit, runtime."""
+    import platform
+    import subprocess
+
+    import fabric_tpu
+
+    try:
+        commit = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=5,
+            cwd=os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)
+            ))),
+        ).stdout.strip()
+    except Exception:  # noqa: BLE001 - no git in deployment
+        commit = "unknown"
+    print(f"{binary}:")
+    print(f" Version: {fabric_tpu.__version__}")
+    print(f" Commit SHA: {commit or 'unknown'}")
+    print(f" Go version: n/a (python {platform.python_version()})")
+    print(f" OS/Arch: {platform.system().lower()}/{platform.machine()}")
+    return 0
 
 
 def _client_signer(args):
@@ -798,7 +825,11 @@ def main(argv=None) -> int:
         p.add_argument("--mspID", required=True)
         p.add_argument("--cafile", default="")
 
+    ver = sub.add_parser("version")
+
     args = parser.parse_args(argv)
+    if args.group == "version":
+        return _version_cmd("peer")
     if args.group == "node" and args.cmd == "start":
         node_start(args.config)
         return 0

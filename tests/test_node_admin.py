@@ -174,3 +174,22 @@ def test_rebuild_dbs_rebuilds_state(tmp_path):
     ledger = KVLedger(os.path.join(fs, "ch3"), "ch3")
     assert ledger.get_state("cc", "k2") == b"v2"
     ledger.close()
+
+
+def test_version_commands():
+    """reference `peer version` / `osnadmin`-era `orderer version`."""
+    import io
+    from contextlib import redirect_stdout
+
+    import fabric_tpu
+    from fabric_tpu.cli.orderer import main as orderer_main
+    from fabric_tpu.cli.peer import main as peer_main
+
+    for main_fn, binary in ((peer_main, "peer"), (orderer_main, "orderer")):
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            rc = main_fn(["version"])
+        out = buf.getvalue()
+        assert rc == 0
+        assert out.startswith(f"{binary}:")
+        assert fabric_tpu.__version__ in out
