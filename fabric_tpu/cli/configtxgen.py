@@ -102,6 +102,14 @@ def _size(v) -> int:
 
 
 def main(argv=None) -> int:
+    if argv is None:
+        import sys as _sys
+
+        argv = _sys.argv[1:]
+    if list(argv) == ["--version"]:
+        from fabric_tpu.cli.peer import _version_cmd
+
+        return _version_cmd("configtxgen")
     parser = argparse.ArgumentParser(prog="configtxgen")
     parser.add_argument("-profile", required=True)
     parser.add_argument("-channelID", required=True)

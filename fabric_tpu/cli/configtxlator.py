@@ -77,6 +77,7 @@ def _bump_changed(orig, new, out) -> None:
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="configtxlator")
     sub = parser.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("version")
     for cmd in ("proto_decode", "proto_encode"):
         p = sub.add_parser(cmd)
         p.add_argument("--type", required=True, choices=sorted(_TYPES))
@@ -88,6 +89,10 @@ def main(argv=None) -> int:
     cu.add_argument("--updated", required=True)
     cu.add_argument("--output", default="-")
     args = parser.parse_args(argv)
+    if args.cmd == "version":
+        from fabric_tpu.cli.peer import _version_cmd
+
+        return _version_cmd("configtxlator")
 
     if args.cmd == "proto_decode":
         msg = _TYPES[args.type]()

@@ -49,10 +49,15 @@ def generate(config_path: str, output: str) -> int:
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="cryptogen")
     sub = parser.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("version")
     gen = sub.add_parser("generate")
     gen.add_argument("--config", required=True)
     gen.add_argument("--output", default="crypto-config")
     args = parser.parse_args(argv)
+    if args.cmd == "version":
+        from fabric_tpu.cli.peer import _version_cmd
+
+        return _version_cmd("cryptogen")
     if args.cmd == "generate":
         return generate(args.config, args.output)
     return 2

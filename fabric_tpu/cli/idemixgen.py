@@ -92,6 +92,7 @@ def signerconfig(output: str, ou: str, enrollment: str, admin: bool) -> None:
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="idemixgen")
     sub = parser.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("version")
     ca = sub.add_parser("ca-keygen")
     ca.add_argument("--output", default="idemix-config")
     sc = sub.add_parser("signerconfig")
@@ -100,6 +101,10 @@ def main(argv=None) -> int:
     sc.add_argument("-e", "--enrollment-id", default="user1")
     sc.add_argument("--admin", action="store_true")
     args = parser.parse_args(argv)
+    if args.cmd == "version":
+        from fabric_tpu.cli.peer import _version_cmd
+
+        return _version_cmd("idemixgen")
     if args.cmd == "ca-keygen":
         ca_keygen(args.output)
     else:

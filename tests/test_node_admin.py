@@ -185,7 +185,17 @@ def test_version_commands():
     from fabric_tpu.cli.orderer import main as orderer_main
     from fabric_tpu.cli.peer import main as peer_main
 
-    for main_fn, binary in ((peer_main, "peer"), (orderer_main, "orderer")):
+    from fabric_tpu.cli.configtxlator import main as lator_main
+    from fabric_tpu.cli.cryptogen import main as cryptogen_main
+    from fabric_tpu.cli.idemixgen import main as idemixgen_main
+
+    for main_fn, binary in (
+        (peer_main, "peer"),
+        (orderer_main, "orderer"),
+        (lator_main, "configtxlator"),
+        (cryptogen_main, "cryptogen"),
+        (idemixgen_main, "idemixgen"),
+    ):
         buf = io.StringIO()
         with redirect_stdout(buf):
             rc = main_fn(["version"])
@@ -193,3 +203,10 @@ def test_version_commands():
         assert rc == 0
         assert out.startswith(f"{binary}:")
         assert fabric_tpu.__version__ in out
+
+    from fabric_tpu.cli.configtxgen import main as configtxgen_main
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = configtxgen_main(["--version"])
+    assert rc == 0 and fabric_tpu.__version__ in buf.getvalue()
