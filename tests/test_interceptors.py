@@ -229,3 +229,43 @@ def test_cert_reloader_tracks_file_changes(tmp_path):
     cfg = reloader._fetch()
     assert cfg is not None and reloader.reloads == 2
     assert reloader.credentials() is not None
+
+
+def test_tls_credentials_from_config_dialects(tmp_path):
+    """Both node config spellings resolve; enabled-but-incomplete is a
+    hard error; absent/disabled sections mean plaintext."""
+    import pytest as _pytest
+
+    from fabric_tpu.comm.server import tls_credentials_from_config
+    from fabric_tpu.msp.cryptogen import OrgCA
+
+    pair = OrgCA("cfg.test", "Org1MSP").enroll_tls("node")
+    cert = tmp_path / "c.pem"
+    key = tmp_path / "k.pem"
+    ca = tmp_path / "ca.pem"
+    cert.write_bytes(pair.cert_pem)
+    key.write_bytes(pair.key_pem)
+    ca.write_bytes(pair.ca_pem)
+
+    # peer spelling
+    assert tls_credentials_from_config(
+        {"enabled": True, "cert": str(cert), "key": str(key)}
+    ) is not None
+    # orderer spelling + list-valued ClientRootCAs
+    assert tls_credentials_from_config(
+        {
+            "Enabled": True,
+            "Certificate": str(cert),
+            "PrivateKey": str(key),
+            "ClientRootCAs": [str(ca)],
+        }
+    ) is not None
+    # plaintext cases
+    assert tls_credentials_from_config(None) is None
+    assert tls_credentials_from_config({}) is None
+    assert tls_credentials_from_config({"enabled": False, "cert": str(cert)}) is None
+    # enabled but incomplete: refuse to start rather than silent plaintext
+    with _pytest.raises(ValueError):
+        tls_credentials_from_config({"Enabled": True, "Certificate": str(cert)})
+    with _pytest.raises(ValueError):
+        tls_credentials_from_config({"enabled": True})
