@@ -9,6 +9,13 @@ Stage 1: the sidecar collects per-block batches under the hood).
 Providers:
 - SoftwareProvider: host-only, mirrors bccsp/sw (verifyECDSA:
   DER unmarshal -> low-S check -> ecdsa.Verify, bccsp/sw/ecdsa.go:41-57).
+  Its curve math rides a three-tier backend ladder: fastec (OpenSSL via
+  the cryptography package) -> hostec (dependency-free vectorized pure
+  Python, batches sharded across CPU cores) -> p256 (the clarity-first
+  oracle; explicit selection only, never an automatic fallback).
+  Select with BCCSP.SW.ECBackend config / FABRIC_TPU_EC_BACKEND /
+  select_ec_backend(); introspect with ec_backend_name() and each
+  provider's describe_backend().
 - TPUProvider (fabric_tpu.crypto.tpu_provider): same decision function,
   ECDSA math executed as a batched JAX kernel.
 """
@@ -19,21 +26,103 @@ import hashlib
 from dataclasses import dataclass
 from typing import List, Optional, Sequence, Tuple
 
-from fabric_tpu.crypto import der, p256
+import os
 
-try:  # OpenSSL-backed fast path (reference SW BCCSP speed class); the
-    # pure-Python module stays as the differential oracle.
-    from fabric_tpu.crypto import fastec as _ec
-except ImportError:  # pragma: no cover - cryptography missing
-    _ec = p256  # type: ignore[assignment]
+from fabric_tpu.crypto import der, hostec, p256
+
+# ---------------------------------------------------------------------------
+# Host EC backend ladder: fastec (OpenSSL) -> hostec (vectorized pure
+# Python) -> p256 (clarity-first oracle).  All three share one semantics
+# contract (Go crypto/ecdsa.Verify decision, low-S pre-checked by callers
+# via parse_and_precheck) and are differentially tested against each other.
+# The oracle is never auto-selected — it exists for tests and explicit
+# opt-in only.
+# ---------------------------------------------------------------------------
+
+EC_TIERS = ("fastec", "hostec", "p256")
+
+
+def _load_ec_backend(name: str):
+    """Backend module by tier name; raises ImportError/ValueError."""
+    if name == "fastec":
+        from fabric_tpu.crypto import fastec
+
+        return fastec
+    if name == "hostec":
+        return hostec
+    if name == "p256":
+        return p256
+    raise ValueError(
+        f"unknown EC backend {name!r} (expected one of {EC_TIERS})"
+    )
+
+
+def available_ec_backends():
+    """Tier name -> importable right now. hostec and p256 are pure Python
+    and always available; fastec needs the ``cryptography`` package."""
+    out = {}
+    for name in EC_TIERS:
+        try:
+            _load_ec_backend(name)
+            out[name] = True
+        except ImportError:
+            out[name] = False
+    return out
+
+
+def select_ec_backend(name: str = "auto"):
+    """Select the process-wide scalar/batch EC backend and return it.
+
+    ``auto`` honors FABRIC_TPU_EC_BACKEND when it names a usable tier,
+    else warns and walks the ladder fastec -> hostec (the oracle is
+    never an auto choice) — asking for ``auto`` NEVER raises, so a
+    malformed env var cannot poison imports or a valid config.  An
+    explicitly named unavailable tier raises ImportError so a configured
+    expectation is never silently downgraded."""
+    global _ec
+    name = str(name or "auto").lower()
+    if name != "auto":
+        _ec = _load_ec_backend(name)
+        return _ec
+    env = os.environ.get("FABRIC_TPU_EC_BACKEND", "").lower()
+    if env and env != "auto":
+        try:
+            _ec = _load_ec_backend(env)
+            return _ec
+        except (ImportError, ValueError) as exc:
+            import warnings
+
+            warnings.warn(
+                f"FABRIC_TPU_EC_BACKEND: {exc}; using the "
+                "fastec->hostec auto ladder",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+    try:
+        _ec = _load_ec_backend("fastec")
+    except ImportError:
+        _ec = hostec
+    return _ec
 
 
 def ec_backend():
-    """The active scalar-EC module: ``fastec`` (OpenSSL) normally, the
-    ``p256`` oracle only when the cryptography package is absent.  Exposed
-    so callers (msp.signer, bench) share one seam and can report which
-    backend actually ran."""
+    """The active scalar-EC module: ``fastec`` (OpenSSL) when available,
+    else the vectorized pure-Python ``hostec`` tier; the ``p256`` oracle
+    only on explicit selection.  Exposed so callers (msp.signer, bench,
+    the validator) share one seam and can report which backend actually
+    ran."""
     return _ec
+
+
+def ec_backend_name() -> str:
+    """Short tier name of the active backend (``fastec``/``hostec``/``p256``)."""
+    return _ec.__name__.rsplit(".", 1)[-1]
+
+
+# Import-time init: select_ec_backend("auto") never raises (see above),
+# so a bad env var can't fail every `import bccsp` and re-poison test
+# collection wholesale.
+_ec = select_ec_backend("auto")
 
 
 @dataclass(frozen=True)
@@ -114,6 +203,12 @@ class Provider:
                 out.append(False)
         return out
 
+    def describe_backend(self) -> str:
+        """Short runtime label of the execution path batches actually take
+        (surfaced by the validator and bench so an oracle-tier fallback can
+        never masquerade as a fast-tier number)."""
+        return type(self).__name__
+
 
 def parse_and_precheck(signature: bytes) -> Tuple[int, int]:
     """Host-side DER unmarshal + low-S gate shared by all providers.
@@ -130,22 +225,75 @@ def parse_and_precheck(signature: bytes) -> Tuple[int, int]:
 
 
 class SoftwareProvider(Provider):
-    """Host provider at the reference SW BCCSP's speed class: DER parse +
-    low-S gate in Python, the curve math on OpenSSL (~11k verifies/s/core,
-    the same ballpark as Go's P-256 assembly the reference rides)."""
+    """Host provider riding the active EC backend tier: DER parse + low-S
+    gate in Python, then the curve math on OpenSSL (fastec, ~11k
+    verifies/s/core) or the vectorized pure-Python hostec engine
+    (~50-100x the oracle, batches sharded across CPU cores)."""
 
     def verify(self, key: ECDSAPublicKey, signature: bytes, digest: bytes) -> bool:
         r, s = parse_and_precheck(signature)
         return _ec.verify_digest(key.point, digest, r, s)
 
+    def describe_backend(self) -> str:
+        return f"sw:{ec_backend_name()}"
+
+    def _parse_lanes(self, keys, signatures, digests):
+        """(pub, digest, r, s) lanes for hostec's vectorized engine; parse
+        and low-S failures become r = s = 0 (an always-False lane)."""
+        lanes = []
+        for k, sig, d in zip(keys, signatures, digests, strict=True):
+            try:
+                r, s = parse_and_precheck(sig)
+            except VerifyError:
+                r, s = 0, 0
+            lanes.append((k.point if k is not None else None, d, r, s))
+        return lanes
+
+    def batch_verify(
+        self,
+        keys: Sequence[ECDSAPublicKey],
+        signatures: Sequence[bytes],
+        digests: Sequence[bytes],
+    ) -> List[bool]:
+        if _ec is not hostec:
+            return super().batch_verify(keys, signatures, digests)
+        return hostec.verify_parsed_batch_sharded(
+            self._parse_lanes(keys, signatures, digests)
+        )()
+
+    def batch_verify_async(self, keys, signatures, digests):
+        """Resolver-style dispatch (the VerifyBatcher/validator seam): on
+        the hostec tier the batch is sharded across the process pool and
+        the resolver joins the shards (order-preserving), overlapping any
+        host work the caller does before resolving.  Other tiers compute
+        synchronously and hand back a trivial resolver."""
+        if _ec is not hostec:
+            out = Provider.batch_verify(self, keys, signatures, digests)
+            return lambda: out
+        return hostec.verify_parsed_batch_sharded(
+            self._parse_lanes(keys, signatures, digests)
+        )
+
 
 class PurePythonProvider(SoftwareProvider):
     """The clarity-first big-int oracle (~5 verifies/s).  Differential tests
-    ONLY — never a benchmark baseline or a default path."""
+    ONLY — never a benchmark baseline or a default path.  Pins the p256
+    module regardless of the active backend tier (it IS the oracle the
+    other tiers are tested against)."""
 
     def verify(self, key: ECDSAPublicKey, signature: bytes, digest: bytes) -> bool:
         r, s = parse_and_precheck(signature)
         return p256.verify_digest(key.point, digest, r, s)
+
+    def describe_backend(self) -> str:
+        return "sw:p256"
+
+    def batch_verify(self, keys, signatures, digests) -> List[bool]:
+        return Provider.batch_verify(self, keys, signatures, digests)
+
+    def batch_verify_async(self, keys, signatures, digests):
+        out = Provider.batch_verify(self, keys, signatures, digests)
+        return lambda: out
 
     def sign(self, key: ECDSAPrivateKey, digest: bytes) -> bytes:
         r, s = p256.sign_digest(key.d, digest)

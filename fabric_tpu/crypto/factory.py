@@ -54,6 +54,28 @@ def provider_from_config(cfg: Optional[dict]) -> Provider:
             "(only SHA2-256 is implemented)"
         )
 
+    # Host EC tier (fastec -> hostec -> p256 ladder, crypto/bccsp.py):
+    # process-wide, since every provider's host path shares the seam.  An
+    # explicitly configured tier that can't load is a hard error — an
+    # operator who pinned the OpenSSL tier must not silently run the
+    # slower ladder, mirroring the PKCS11 discipline below.  An ABSENT
+    # key leaves the current process-wide selection alone, so building a
+    # provider from a plain config cannot reset an earlier explicit pin.
+    if "ECBackend" in sw_cfg:
+        ec_backend = str(sw_cfg["ECBackend"]).lower()
+        try:
+            from fabric_tpu.crypto.bccsp import (
+                ec_backend_name,
+                select_ec_backend,
+            )
+
+            select_ec_backend(ec_backend)
+        except (ImportError, ValueError) as exc:
+            raise FactoryError(
+                f"BCCSP.SW.ECBackend {ec_backend!r} unavailable: {exc}"
+            ) from exc
+        logger.info("host EC backend: %s", ec_backend_name())
+
     if default == "SW":
         return SoftwareProvider()
     if default == "PKCS11":

@@ -101,6 +101,14 @@ class TPUProvider(Provider):
         # raises VerifyError with the reference's (bool, error) semantics.
         return self._software.verify(key, signature, digest)
 
+    def describe_backend(self) -> str:
+        """"tpu", or "tpu-degraded(<host tier>)" once any dispatch has been
+        served by the software fallback — so a degraded run can never be
+        mistaken for a device number downstream."""
+        if type(self).degraded:
+            return f"tpu-degraded({self._software.describe_backend()})"
+        return "tpu"
+
     # distinct keys are padded to a fixed column bucket so the jitted
     # program's K dimension does not recompile per block (few orgs in
     # practice; overflow falls back to full limb matrices)

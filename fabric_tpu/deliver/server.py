@@ -14,7 +14,11 @@ import datetime
 import threading
 from typing import Callable, Iterator, Optional
 
-from cryptography import x509
+try:  # guarded: only identity_expiration needs X.509 parsing; its
+    # caller already treats any failure as "no expiry known"
+    from cryptography import x509
+except ImportError:  # pragma: no cover - exercised in minimal envs
+    x509 = None  # type: ignore
 
 from fabric_tpu.policy.manager import PolicyError, SignedData
 from fabric_tpu.protos import ab_pb2, common_pb2, identities_pb2, protoutil

@@ -17,8 +17,13 @@ from __future__ import annotations
 import os
 from typing import List, Optional
 
-from cryptography import x509
-from cryptography.hazmat.primitives import serialization
+try:  # guarded: the PEM/X.509 material here needs the cryptography
+    # package, but the module must import in minimal environments so
+    # tier-1 collection stays clean (ladder: crypto/bccsp.py)
+    from cryptography import x509
+    from cryptography.hazmat.primitives import serialization
+except ImportError:  # pragma: no cover - exercised in minimal envs
+    x509 = serialization = None  # type: ignore
 
 from fabric_tpu.msp.cryptogen import NodeIdentity, Org
 from fabric_tpu.msp.identity import MSP, MSPConfig, NodeOUs
@@ -125,6 +130,11 @@ def load_signing_identity(
     node_msp_dir: str, msp_id: str, provider=None
 ) -> SigningIdentity:
     """msp/configbuilder.go GetLocalMspConfig: signcerts + keystore."""
+    if x509 is None:
+        raise RuntimeError(
+            "the 'cryptography' package is required to load X.509 "
+            "signing material (configbuilder)"
+        )
     sign_dir = os.path.join(node_msp_dir, "signcerts")
     certs = sorted(os.listdir(sign_dir))
     if not certs:

@@ -9,12 +9,24 @@ import datetime
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
-from cryptography import x509
-from cryptography.hazmat.primitives import hashes, serialization
-from cryptography.hazmat.primitives.asymmetric import ec
-from cryptography.x509.oid import NameOID
+try:  # guarded: cert generation needs the cryptography package, but the
+    # module must import (for type references) in minimal environments
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.x509.oid import NameOID
+except ImportError:  # pragma: no cover - exercised in minimal envs
+    x509 = hashes = serialization = ec = NameOID = None  # type: ignore
 
 from fabric_tpu.msp.identity import MSP, MSPConfig, NodeOUs
+
+
+def _require_crypto() -> None:
+    if x509 is None:
+        raise RuntimeError(
+            "the 'cryptography' package is required to generate X.509 "
+            "org material (cryptogen)"
+        )
 
 
 def _name(common_name: str, org: str, ou: Optional[str] = None) -> x509.Name:
@@ -67,6 +79,7 @@ class OrgCA:
     """A self-signed org root CA that can enroll node/user identities."""
 
     def __init__(self, org_name: str, msp_id: str):
+        _require_crypto()
         self.org_name = org_name
         self.msp_id = msp_id
         self.key = ec.generate_private_key(ec.SECP256R1())

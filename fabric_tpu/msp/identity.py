@@ -15,10 +15,14 @@ import hashlib
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
-from cryptography import x509
-from cryptography.exceptions import InvalidSignature
-from cryptography.hazmat.primitives import hashes, serialization
-from cryptography.hazmat.primitives.asymmetric import ec
+try:  # guarded: X.509 mechanics need the cryptography package, but the
+    # crypto/validation core (hostec tier) must import without it
+    from cryptography import x509
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import ec
+except ImportError:  # pragma: no cover - exercised in minimal envs
+    x509 = InvalidSignature = hashes = serialization = ec = None  # type: ignore
 
 from fabric_tpu.crypto.bccsp import ECDSAPublicKey, Provider, default_provider
 from fabric_tpu.protos import identities_pb2, msp_principal_pb2, protoutil
@@ -26,6 +30,14 @@ from fabric_tpu.protos import identities_pb2, msp_principal_pb2, protoutil
 
 class MSPError(Exception):
     pass
+
+
+def _require_crypto() -> None:
+    if x509 is None:
+        raise MSPError(
+            "the 'cryptography' package is required for X.509 MSP "
+            "operations (identity deserialization, chain validation)"
+        )
 
 
 # sentinel: "chain validation not yet succeeded" (None means validated OK;
@@ -59,6 +71,7 @@ class Identity:
     """A deserialized (MSPID, x509 cert) pair."""
 
     def __init__(self, msp_id: str, cert: x509.Certificate, provider: Provider):
+        _require_crypto()
         self.msp_id = msp_id
         self.cert = cert
         self._provider = provider
@@ -116,6 +129,7 @@ class MSP:
     """bccspmsp analog: one organization's verification context."""
 
     def __init__(self, config: MSPConfig, provider: Optional[Provider] = None):
+        _require_crypto()
         self.config = config
         self.msp_id = config.msp_id
         self._provider = provider or default_provider()

@@ -14,7 +14,11 @@ import datetime
 from dataclasses import dataclass
 from typing import Optional, Tuple
 
-from cryptography import x509
+try:  # guarded: only the expiration filter needs X.509 parsing; its
+    # except-Exception already treats parse failure as "cannot judge"
+    from cryptography import x509
+except ImportError:  # pragma: no cover - exercised in minimal envs
+    x509 = None  # type: ignore
 
 from fabric_tpu.channelconfig.bundle import Bundle
 from fabric_tpu.channelconfig.configtx import Validator

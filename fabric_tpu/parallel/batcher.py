@@ -226,8 +226,10 @@ class VerifyBatcher:
             try:
                 dispatch = getattr(self.provider, "batch_verify_async", None)
                 if dispatch is None:
-                    # sync-only provider (e.g. SoftwareProvider): compute
-                    # now, hand back a trivial resolver
+                    # provider without an async seam: compute now, hand
+                    # back a trivial resolver (SoftwareProvider now HAS
+                    # batch_verify_async — on the hostec tier it shards
+                    # across the process pool and resolves later)
                     verdicts = self.provider.batch_verify(keys, sigs, digests)
                     resolver = lambda v=verdicts: v  # noqa: E731
                 else:

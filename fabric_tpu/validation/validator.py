@@ -136,6 +136,9 @@ class BlockValidator:
         self.channel_id = channel_id
         self.msp_manager = msp_manager
         self.provider = provider
+        # backend label of the most recent signature batch (see
+        # _batch_verify_sigs); None until a block has been validated
+        self.last_sig_backend: Optional[str] = None
         self.registry = registry
         self.tx_exists = tx_exists or (lambda txid: False)
         self.apply_config = apply_config
@@ -317,6 +320,15 @@ class BlockValidator:
             ok_list = resolver()
         else:
             ok_list = self.provider.batch_verify(keys, sigs, digests)
+        # record which execution path this batch ACTUALLY took (device /
+        # sw:fastec / sw:hostec / sw:p256 / degraded) — snapshot AFTER the
+        # verdicts resolve so the batch that first trips the provider into
+        # degraded mode is labeled degraded, not "tpu"; bench and ops
+        # surfaces read it so a silent-fallback run is always labeled
+        describe = getattr(self.provider, "describe_backend", None)
+        self.last_sig_backend = (
+            describe() if describe else type(self.provider).__name__
+        )
         return self.finish_sig_results(jobs, job_identity, ok_list)
 
     def _prewarm_satisfaction(

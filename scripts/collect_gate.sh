@@ -1,0 +1,26 @@
+#!/usr/bin/env bash
+# Fast collection gate: every test module must IMPORT cleanly (module-scope
+# dependency regressions fail here in seconds, instead of poisoning a
+# 15-minute tier-1 run with dozens of collection errors).
+#
+# Run before tier-1. Exit 0 iff pytest reports zero collection errors.
+set -uo pipefail
+
+cd "$(dirname "$0")/.."
+
+log="$(mktemp)"
+trap 'rm -f "$log"' EXIT
+
+JAX_PLATFORMS=cpu timeout -k 10 240 python -m pytest tests/ --collect-only -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly >"$log" 2>&1
+rc=$?
+
+errors=$(grep -acE '^ERROR ' "$log" || true)
+tail -n 3 "$log"
+
+if [ "$rc" -ne 0 ] || [ "${errors:-0}" -ne 0 ]; then
+    echo "collect_gate: FAIL (${errors:-?} collection errors, pytest rc=$rc)" >&2
+    grep -aE '^ERROR ' "$log" >&2 || true
+    exit 1
+fi
+echo "collect_gate: OK (0 collection errors)"

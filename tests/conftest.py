@@ -15,7 +15,17 @@ first run; the persistent compilation cache in .jax_cache makes every
 later run fast. Keep that directory out of git but on disk.
 """
 
+import importlib.util
 import os
+
+import pytest
+
+# Shared marker: tests needing X.509 / TLS material skip cleanly in
+# minimal environments (test modules `from conftest import requires_crypto`).
+requires_crypto = pytest.mark.skipif(
+    importlib.util.find_spec("cryptography") is None,
+    reason="needs the cryptography package (X.509 / TLS material)",
+)
 
 os.environ.setdefault("FABRIC_TPU_CIOS_UNROLL", "0")
 xla_flags = os.environ.get("XLA_FLAGS", "")
@@ -30,3 +40,10 @@ from fabric_tpu.utils.jaxcache import enable_compile_cache  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 enable_compile_cache()
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running tests excluded from tier-1 (-m 'not slow')",
+    )

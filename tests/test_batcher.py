@@ -4,7 +4,8 @@ device launches with bounded-queue backpressure."""
 import threading
 import time
 
-import pytest
+
+from conftest import requires_crypto
 
 from fabric_tpu.parallel.batcher import VerifyBatcher
 
@@ -123,6 +124,7 @@ def test_stop_settles_outstanding_requests():
     assert r() == [True]
 
 
+@requires_crypto
 def test_with_real_tpu_provider():
     """End-to-end through the device kernel: mixed-size concurrent
     requests, one verdict per lane, bit-exact vs expectations."""

@@ -4,6 +4,10 @@ common/channelconfig + common/configtx + configtxgen encoder)."""
 
 import pytest
 
+pytest.importorskip(
+    "cryptography", reason="channel config trees are built from real X.509 org material"
+)
+
 from fabric_tpu.channelconfig import (
     ApplicationProfile,
     Bundle,

@@ -12,6 +12,10 @@ import time
 
 import pytest
 
+pytest.importorskip(
+    "cryptography", reason="CLI network bootstrap generates X.509 crypto-config"
+)
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 

@@ -8,6 +8,8 @@ import time
 
 import pytest
 
+from conftest import requires_crypto
+
 from fabric_tpu.channelconfig import (
     ApplicationProfile,
     OrdererProfile,
@@ -136,6 +138,7 @@ def _make_envelope(signer, body):
     return env
 
 
+@requires_crypto
 def test_cluster_elects_forwards_and_fails_over(cluster):
     nodes = cluster["nodes"]
     client = SigningIdentity(cluster["org1"].users[0])
@@ -181,6 +184,7 @@ def test_cluster_elects_forwards_and_fails_over(cluster):
     ch.close()
 
 
+@requires_crypto
 def test_raft_cluster_over_tls(tmp_path):
     """3-node etcdraft cluster with every listener serving TLS and
     cluster_root_ca on the intra-cluster dials (Step + follower pulls):

@@ -7,6 +7,9 @@ stable-id semantics through the tracker, the chain's block stamping, and
 restart recovery from block metadata.
 """
 
+
+from conftest import requires_crypto
+
 import time
 
 from fabric_tpu.channelconfig import (
@@ -89,6 +92,7 @@ def _profile(org1, oorg, consenter_ports):
     )
 
 
+@requires_crypto
 def test_chain_applies_and_stamps_stable_ids(tmp_path):
     """Write a non-tail-removal config block through the chain's apply
     path: the survivor keeps its id, the block is stamped with the new

@@ -3,6 +3,8 @@ queries, auth (reference discovery/, common/policies/inquire)."""
 
 import pytest
 
+from conftest import requires_crypto
+
 from fabric_tpu.channelconfig import (
     ApplicationProfile,
     OrdererProfile,
@@ -109,6 +111,7 @@ def _client(org):
     return SignedData(b"req", s.serialize(), s.sign(b"req"))
 
 
+@requires_crypto
 def test_peers_query(world):
     got = world["svc"].peers("dchannel", _client(world["org1"]))
     assert [p.endpoint for p in got] == [
@@ -118,12 +121,14 @@ def test_peers_query(world):
     ]
 
 
+@requires_crypto
 def test_config_query(world):
     cfg = world["svc"].config("dchannel", _client(world["org1"]))
     assert cfg["msps"] == ["OrdererMSP", "Org1MSP", "Org2MSP"]
     assert any("orderer0:7050" in eps for eps in cfg["orderers"].values())
 
 
+@requires_crypto
 def test_endorsers_query(world):
     desc = world["svc"].endorsers("dchannel", "mycc", _client(world["org1"]))
     assert len(desc.layouts) == 1
@@ -137,6 +142,7 @@ def test_endorsers_query(world):
             assert members[0].ledger_height >= members[1].ledger_height
 
 
+@requires_crypto
 def test_endorsers_unknown_chaincode(world):
     from fabric_tpu.discovery.service import DiscoveryError
 
@@ -144,6 +150,7 @@ def test_endorsers_unknown_chaincode(world):
         world["svc"].endorsers("dchannel", "nope", _client(world["org1"]))
 
 
+@requires_crypto
 def test_auth_rejects_stranger(world):
     from fabric_tpu.discovery.service import DiscoveryError
 
@@ -155,6 +162,7 @@ def test_auth_rejects_stranger(world):
         world["svc"].peers("dchannel", _client(stranger))
 
 
+@requires_crypto
 def test_unknown_channel(world):
     from fabric_tpu.discovery.service import DiscoveryError
 

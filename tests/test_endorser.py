@@ -5,6 +5,8 @@ import hashlib
 
 import pytest
 
+from conftest import requires_crypto
+
 from fabric_tpu.chaincode import ChaincodeStub, Response, success, error_response
 from fabric_tpu.chaincode.support import ChaincodeSupport, TxParams
 from fabric_tpu.crypto.bccsp import SoftwareProvider
@@ -209,6 +211,7 @@ def endorser_net(org, tmp_path):
     return endorser, client, ledger
 
 
+@requires_crypto
 def test_process_proposal_happy_path(endorser_net):
     endorser, client, _ = endorser_net
     bundle = create_proposal(client, "ch", "mycc", [b"put", b"k1", b"v1"])
@@ -226,6 +229,7 @@ def test_process_proposal_happy_path(endorser_net):
     assert env.signature
 
 
+@requires_crypto
 def test_process_proposal_rejects_bad_signature(endorser_net, org):
     endorser, client, _ = endorser_net
     bundle = create_proposal(client, "ch", "mycc", [b"get", b"a"])
@@ -238,6 +242,7 @@ def test_process_proposal_rejects_bad_signature(endorser_net, org):
     assert "access denied" in resp.response.message
 
 
+@requires_crypto
 def test_process_proposal_rejects_wrong_txid(endorser_net):
     endorser, client, _ = endorser_net
     bundle = create_proposal(client, "ch", "mycc", [b"get", b"a"])
@@ -255,6 +260,7 @@ def test_process_proposal_rejects_wrong_txid(endorser_net):
     assert "txid" in resp.response.message
 
 
+@requires_crypto
 def test_process_proposal_unknown_channel(endorser_net):
     endorser, client, _ = endorser_net
     bundle = create_proposal(client, "nochannel", "mycc", [b"get", b"a"])
@@ -264,6 +270,7 @@ def test_process_proposal_unknown_channel(endorser_net):
     assert "not found" in resp.response.message
 
 
+@requires_crypto
 def test_process_proposal_chaincode_error_unsigned(endorser_net):
     endorser, client, _ = endorser_net
     bundle = create_proposal(client, "ch", "mycc", [b"nope"])
@@ -273,6 +280,7 @@ def test_process_proposal_chaincode_error_unsigned(endorser_net):
     assert not resp.endorsement.signature
 
 
+@requires_crypto
 def test_process_proposal_malformed_bytes_returns_500(endorser_net):
     endorser, _, _ = endorser_net
     signed = peer_pb2.SignedProposal()
@@ -282,6 +290,7 @@ def test_process_proposal_malformed_bytes_returns_500(endorser_net):
     assert "unmarshalling" in resp.response.message
 
 
+@requires_crypto
 def test_unpack_proposal_rejects_missing_chaincode(endorser_net):
     _, client, _ = endorser_net
     bundle = create_proposal(client, "ch", "mycc", [b"x"])

@@ -12,7 +12,11 @@ import hashlib
 
 import pytest
 
-from fabric_tpu.crypto import der, fastec, p256
+pytest.importorskip(
+    "cryptography", reason="fastec tier needs the cryptography package"
+)
+
+from fabric_tpu.crypto import der, fastec, p256  # noqa: E402
 from fabric_tpu.crypto.bccsp import (
     PurePythonProvider,
     SoftwareProvider,

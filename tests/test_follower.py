@@ -8,6 +8,10 @@ import time
 
 import pytest
 
+pytest.importorskip(
+    "cryptography", reason="follower chains join via cryptogen-built orgs"
+)
+
 from fabric_tpu.channelconfig import (
     ApplicationProfile,
     OrdererProfile,

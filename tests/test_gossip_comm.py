@@ -4,7 +4,8 @@ gossip/state anti-entropy)."""
 
 import time
 
-import pytest
+
+from conftest import requires_crypto
 
 from fabric_tpu.gossip.comm import GossipNode
 from fabric_tpu.gossip.state import StateProvider
@@ -108,6 +109,7 @@ def test_anti_entropy_catches_up_lagging_peer():
         n2.stop()
 
 
+@requires_crypto
 def test_peer_nodes_gossip_network(tmp_path):
     """Three PeerNodes, one orderer: only the elected leader pulls from
     the orderer; followers receive blocks via gossip push/anti-entropy
@@ -357,6 +359,7 @@ def test_pvt_dissemination_and_reconciliation():
         n2.stop()
 
 
+@requires_crypto
 def test_signed_alive_membership(tmp_path):
     """Signed membership (reference SignedGossipMessage): in strict mode a
     node adopts alives only when the signature verifies against the

@@ -15,6 +15,10 @@ import time
 
 import pytest
 
+pytest.importorskip(
+    "cryptography", reason="gossip e2e rides TLS + X.509 identities"
+)
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 

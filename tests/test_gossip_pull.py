@@ -11,6 +11,9 @@ gossip/comm/comm_impl.go:563 authenticateRemotePeer).
   session — is refused; the correctly-bound peer is served.
 """
 
+
+from conftest import requires_crypto
+
 import hashlib
 import time
 
@@ -205,6 +208,7 @@ def _org_tls():
     )
 
 
+@requires_crypto
 def test_handshake_right_cert_served():
     pair_a, pair_b = _org_tls()
     tall, joiner, jl = _tls_nodes(pair_a, pair_b)
@@ -218,6 +222,7 @@ def test_handshake_right_cert_served():
         joiner.stop()
 
 
+@requires_crypto
 def test_handshake_wrong_cert_rejected():
     """The joiner presents pair_b on the wire but its signed handshake
     binds pair_a's cert hash (stolen-claim splice): server refuses the
@@ -237,6 +242,7 @@ def test_handshake_wrong_cert_rejected():
         joiner.stop()
 
 
+@requires_crypto
 def test_handshake_spoofed_pki_id_rejected():
     """A valid member handshaking under ANOTHER peer's pki_id is
     refused: the certstore verify hook is the pki<->identity binding
@@ -264,6 +270,7 @@ def test_handshake_spoofed_pki_id_rejected():
         joiner.stop()
 
 
+@requires_crypto
 def test_no_handshake_rejected_in_strict_mode():
     """A client that skips ConnEstablish entirely gets no service."""
     pair_a, pair_b = _org_tls()
@@ -282,6 +289,7 @@ def test_no_handshake_rejected_in_strict_mode():
         joiner.stop()
 
 
+@requires_crypto
 def test_handshake_fuzz_mutations_never_authenticate():
     """Random mutations of a valid ConnEstablish (flipped pki, wrong
     channel, truncated/garbled signature, swapped cert hash) must never

@@ -12,6 +12,10 @@ import time
 
 import pytest
 
+pytest.importorskip(
+    "cryptography", reason="gRPC network tests generate X.509 material"
+)
+
 from fabric_tpu.chaincode import ChaincodeStub, Response, success, error_response
 from fabric_tpu.channelconfig import (
     ApplicationProfile,

@@ -1,0 +1,306 @@
+"""Differential tests: the dependency-free vectorized host EC tier
+(crypto/hostec) vs the pure-Python oracle (crypto/p256).
+
+hostec is the middle tier of the host EC backend ladder (fastec ->
+hostec -> p256) and the default host execution path wherever the
+`cryptography` package is absent — these tests pin its valid/invalid
+mask bit-exactly to the oracle across adversarial lanes (bit-flipped
+signatures, high-S, boundary r/s, off-curve and identity keys) and
+prove the process-pool sharding is order-preserving.
+
+The oracle runs ~0.13s per verify, so oracle-compared lanes are kept to
+a few dozen per test; large batches assert against constructed ground
+truth (we signed them, we know the mask) and the full 1024-lane
+differential rides the `slow` marker.
+"""
+
+import hashlib
+import random
+
+import pytest
+
+from fabric_tpu.crypto import der, hostec, p256
+from fabric_tpu.crypto.bccsp import (
+    ECDSAPublicKey,
+    SoftwareProvider,
+    ec_backend_name,
+    select_ec_backend,
+)
+
+N = p256.N
+P = p256.P
+
+
+def _digest(tag, i):
+    return hashlib.sha256(b"%s %d" % (tag, i)).digest()
+
+
+@pytest.fixture(scope="module")
+def keypairs():
+    return [hostec.generate_keypair() for _ in range(4)]
+
+
+def _signed_lane(keypairs, tag, i):
+    kp = keypairs[i % len(keypairs)]
+    d = _digest(tag, i)
+    r, s = hostec.sign_digest(kp.priv, d)
+    return kp.pub, d, r, s
+
+
+def _oracle_mask(lanes):
+    return [p256.verify_digest(pub, d, r, s) for pub, d, r, s in lanes]
+
+
+# ---------------------------------------------------------------------------
+# Differential fuzz vs the oracle
+# ---------------------------------------------------------------------------
+
+
+def test_fuzz_mask_matches_oracle(keypairs):
+    """Mixed batch: valid, bit-flipped r, bit-flipped s, wrong digest,
+    high-S — one vectorized pass, bit-exact with the per-lane oracle."""
+    rng = random.Random(0xEC)
+    lanes = []
+    for i in range(24):
+        pub, d, r, s = _signed_lane(keypairs, b"fuzz", i)
+        kind = i % 4
+        if kind == 1:
+            r ^= 1 << rng.randrange(256)
+        elif kind == 2:
+            s ^= 1 << rng.randrange(256)
+        elif kind == 3:
+            d = _digest(b"other", i)
+        lanes.append((pub, d, r, s))
+    assert hostec.verify_parsed_batch(lanes) == _oracle_mask(lanes)
+
+
+def test_high_s_accepted_like_oracle(keypairs):
+    """No low-S rule at this layer (Go crypto/ecdsa.Verify semantics):
+    s and n-s are both valid. Callers gate low-S via parse_and_precheck."""
+    lanes = []
+    for i in range(4):
+        pub, d, r, s = _signed_lane(keypairs, b"highs", i)
+        lanes.append((pub, d, r, N - s))
+    mask = hostec.verify_parsed_batch(lanes)
+    assert mask == [True] * 4
+    assert mask == _oracle_mask(lanes)
+
+
+def test_rs_boundary_values(keypairs):
+    """r/s in {0, 1, n-1, n, n+1}: out-of-range returns False without
+    raising; in-range boundary values run the full math. Bit-exact with
+    the oracle either way."""
+    pub, d, r, s = _signed_lane(keypairs, b"edge", 0)
+    edges = [0, 1, N - 1, N, N + 1]
+    lanes = [(pub, d, e, s) for e in edges]
+    lanes += [(pub, d, r, e) for e in edges]
+    lanes.append((pub, d, r, s))  # control lane stays valid
+    got = hostec.verify_parsed_batch(lanes)
+    assert got == _oracle_mask(lanes)
+    assert got[-1] is True
+    assert not any(got[:-1])
+
+
+def test_bad_public_keys(keypairs):
+    """Off-curve, out-of-range and identity (None) keys verify False and
+    never raise — even mixed into a batch with healthy lanes."""
+    pub, d, r, s = _signed_lane(keypairs, b"badkey", 0)
+    x, y = pub
+    lanes = [
+        ((x, (y + 1) % P), d, r, s),  # off curve
+        ((P, y), d, r, s),  # x out of range
+        ((x, P + y), d, r, s),  # y out of range
+        (None, d, r, s),  # identity / unparseable
+        (pub, d, r, s),  # healthy control
+    ]
+    got = hostec.verify_parsed_batch(lanes)
+    assert got == [False, False, False, False, True]
+    assert got == _oracle_mask(lanes)
+
+
+def test_batch_sizes(keypairs):
+    """Sizes around the window/shard seams: every 3rd lane corrupted;
+    the mask must match the construction exactly at each size."""
+    for size in (1, 2, 31, 32, 33):
+        lanes = []
+        expect = []
+        for i in range(size):
+            pub, d, r, s = _signed_lane(keypairs, b"size%d" % size, i)
+            if i % 3 == 1:
+                s ^= 2
+                expect.append(False)
+            else:
+                expect.append(True)
+            lanes.append((pub, d, r, s))
+        assert hostec.verify_parsed_batch(lanes) == expect, size
+
+
+def test_batch_1024_ground_truth(keypairs):
+    """The acceptance-size batch (1024) against constructed truth; the
+    per-lane oracle differential for this size is the slow variant."""
+    lanes = []
+    expect = []
+    for i in range(1024):
+        pub, d, r, s = _signed_lane(keypairs, b"kilo", i)
+        if i % 5 == 2:
+            r ^= 1 << (i % 250)
+            expect.append(False)
+        else:
+            expect.append(True)
+        lanes.append((pub, d, r, s))
+    assert hostec.verify_parsed_batch_sharded(lanes)() == expect
+
+
+@pytest.mark.slow
+def test_batch_1024_differential_slow(keypairs):
+    lanes = []
+    for i in range(1024):
+        pub, d, r, s = _signed_lane(keypairs, b"kiloslow", i)
+        if i % 4 == 3:
+            s ^= 1 << (i % 250)
+        lanes.append((pub, d, r, s))
+    assert hostec.verify_parsed_batch_sharded(lanes)() == _oracle_mask(lanes)
+
+
+# ---------------------------------------------------------------------------
+# Scalar API parity + sign/verify round trips
+# ---------------------------------------------------------------------------
+
+
+def test_sign_verify_cross_backend(keypairs):
+    """hostec-signed verifies under the oracle and vice versa; low-S
+    normalization matches the reference signer on both."""
+    kp = keypairs[0]
+    d = _digest(b"cross", 0)
+    r, s = hostec.sign_digest(kp.priv, d)
+    assert s <= p256.HALF_N
+    assert p256.verify_digest(kp.pub, d, r, s)
+    r2, s2 = p256.sign_digest(kp.priv, d)
+    assert s2 <= p256.HALF_N
+    assert hostec.verify_digest(kp.pub, d, r2, s2)
+
+
+def test_scalar_base_mult_matches_oracle():
+    for k in (1, 2, 15, 16, 0xDEADBEEF, N - 1, N, N + 7):
+        assert hostec.scalar_base_mult(k) == p256.scalar_mult(
+            k, p256.GENERATOR
+        ), k
+
+
+# ---------------------------------------------------------------------------
+# Process-pool sharding
+# ---------------------------------------------------------------------------
+
+
+def test_sharded_is_order_preserving(keypairs, monkeypatch):
+    """A pool-sized batch (>= MIN_POOL_LANES) sharded across 2 workers
+    returns the same mask, in the same order, as the in-process pass."""
+    monkeypatch.setenv("FABRIC_TPU_HOSTEC_PROCS", "2")
+    hostec.shutdown_pool()  # force re-read of the env on next use
+    lanes = []
+    for i in range(hostec.MIN_POOL_LANES + 7):
+        pub, d, r, s = _signed_lane(keypairs, b"shard", i)
+        if i % 7 == 3:
+            r ^= 4
+        lanes.append((pub, d, r, s))
+    try:
+        sharded = hostec.verify_parsed_batch_sharded(lanes)()
+    finally:
+        hostec.shutdown_pool()
+    assert sharded == hostec.verify_parsed_batch(lanes)
+
+
+# ---------------------------------------------------------------------------
+# Provider + VerifyBatcher integration (the validator's path)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def hostec_backend():
+    """Pin the ladder to hostec for the duration, restoring after."""
+    before = ec_backend_name()
+    select_ec_backend("hostec")
+    yield
+    select_ec_backend(before)
+
+
+def _provider_triples(keypairs, tag, n):
+    keys, sigs, digests, expect = [], [], [], []
+    for i in range(n):
+        kp = keypairs[i % len(keypairs)]
+        d = _digest(tag, i)
+        r, s = hostec.sign_digest(kp.priv, d)
+        if i % 3 == 2:
+            d = _digest(tag + b"!", i)
+            expect.append(False)
+        else:
+            expect.append(True)
+        keys.append(ECDSAPublicKey(*kp.pub))
+        sigs.append(der.marshal_signature(r, s))
+        digests.append(d)
+    return keys, sigs, digests, expect
+
+
+def test_software_provider_batch_on_hostec(hostec_backend, keypairs):
+    sw = SoftwareProvider()
+    assert sw.describe_backend() == "sw:hostec"
+    keys, sigs, digests, expect = _provider_triples(keypairs, b"prov", 12)
+    # a DER-garbage lane and a high-S lane must fail the precheck and
+    # come back False (not raise) on the batch path
+    keys.append(keys[0])
+    sigs.append(b"\x30\x03\x02\x01\x01")
+    digests.append(digests[0])
+    expect.append(False)
+    assert sw.batch_verify(keys, sigs, digests) == expect
+
+
+def test_auto_ladder_lands_on_hostec_without_cryptography():
+    """In an environment without the cryptography package, `auto` must
+    select hostec (never the oracle) — the silent-fallback cliff this
+    ladder exists to remove."""
+    try:
+        import cryptography  # noqa: F401
+
+        pytest.skip("cryptography installed: auto selects fastec here")
+    except ImportError:
+        pass
+    before = ec_backend_name()
+    try:
+        mod = select_ec_backend("auto")
+        assert mod is hostec
+        assert ec_backend_name() == "hostec"
+        # an explicitly pinned fastec must raise, not downgrade
+        with pytest.raises(ImportError):
+            select_ec_backend("fastec")
+    finally:
+        select_ec_backend(before)
+
+
+def test_verify_batcher_routes_through_hostec(hostec_backend, keypairs):
+    """VerifyBatcher -> SoftwareProvider.batch_verify_async -> hostec
+    sharded engine: per-request slices come back order-preserving even
+    when requests coalesce into one sharded launch."""
+    from fabric_tpu.parallel.batcher import VerifyBatcher
+
+    calls = []
+    orig = hostec.verify_parsed_batch_sharded
+
+    def spy(lanes):
+        calls.append(len(lanes))
+        return orig(lanes)
+
+    sw = SoftwareProvider()
+    b = VerifyBatcher(sw, linger_s=0.02)
+    try:
+        hostec.verify_parsed_batch_sharded = spy
+        reqs = [
+            (_provider_triples(keypairs, b"vb%d" % i, 3 + i)) for i in range(4)
+        ]
+        resolvers = [b.submit(k, s, d) for k, s, d, _ in reqs]
+        for resolver, (_k, _s, _d, expect) in zip(resolvers, reqs):
+            assert resolver() == expect
+    finally:
+        hostec.verify_parsed_batch_sharded = orig
+        b.stop()
+    # every submitted lane went through the hostec engine
+    assert sum(calls) == sum(3 + i for i in range(4))

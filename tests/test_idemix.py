@@ -6,6 +6,8 @@ import random
 
 import pytest
 
+from conftest import requires_crypto
+
 from fabric_tpu.crypto import fp256bn as bn
 from fabric_tpu import idemix
 from fabric_tpu.protos import idemix_pb2
@@ -127,6 +129,7 @@ def test_credential_tampered_attr_fails(issuer_key, user):
         idemix.verify_credential(bad, sk, issuer_key.ipk)
 
 
+@requires_crypto
 def test_signature_roundtrip_no_disclosure(issuer_key, user, cri):
     sk, cred = user
     nym, r_nym = idemix.make_nym(sk, issuer_key.ipk, RNG)
@@ -142,6 +145,7 @@ def test_signature_roundtrip_no_disclosure(issuer_key, user, cri):
     )
 
 
+@requires_crypto
 def test_signature_roundtrip_selective_disclosure(issuer_key, user, cri):
     sk, cred = user
     nym, r_nym = idemix.make_nym(sk, issuer_key.ipk, RNG)
@@ -164,6 +168,7 @@ def test_signature_roundtrip_selective_disclosure(issuer_key, user, cri):
         )
 
 
+@requires_crypto
 def test_signature_wrong_message_fails(issuer_key, user, cri):
     sk, cred = user
     nym, r_nym = idemix.make_nym(sk, issuer_key.ipk, RNG)
@@ -179,6 +184,7 @@ def test_signature_wrong_message_fails(issuer_key, user, cri):
         )
 
 
+@requires_crypto
 def test_signature_tampered_aprime_fails(issuer_key, user, cri):
     sk, cred = user
     nym, r_nym = idemix.make_nym(sk, issuer_key.ipk, RNG)
@@ -217,6 +223,7 @@ def test_wbb_roundtrip():
         idemix.wbb_verify(pk, sig, (m + 1) % bn.R)
 
 
+@requires_crypto
 def test_cri_epoch_pk(rev_key, cri):
     idemix.verify_epoch_pk(
         rev_key.public_key(), cri.epoch_pk, cri.epoch_pk_sig, 0,

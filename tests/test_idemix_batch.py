@@ -5,6 +5,10 @@ import random
 
 import pytest
 
+pytest.importorskip(
+    "cryptography", reason="idemix issuance needs the cryptography package"
+)
+
 from fabric_tpu.crypto import fp256bn as bn
 from fabric_tpu import idemix
 from fabric_tpu.idemix.batch import verify_signatures_batch

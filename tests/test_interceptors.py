@@ -4,6 +4,8 @@ common/grpcmetrics): RPC logs and counters/durations on the metrics SPI."""
 import grpc
 import pytest
 
+from conftest import requires_crypto
+
 from fabric_tpu.common.metrics import PrometheusProvider
 from fabric_tpu.comm.interceptors import LoggingInterceptor, MetricsInterceptor
 from fabric_tpu.comm.server import GRPCServer, STREAM_STREAM, UNARY, channel_to
@@ -198,6 +200,7 @@ def test_concurrency_limiter_rejects_over_limit():
         server.stop()
 
 
+@requires_crypto
 def test_cert_reloader_tracks_file_changes(tmp_path):
     from fabric_tpu.comm.server import CertReloader
     from fabric_tpu.msp.cryptogen import OrgCA
@@ -231,6 +234,7 @@ def test_cert_reloader_tracks_file_changes(tmp_path):
     assert reloader.credentials() is not None
 
 
+@requires_crypto
 def test_tls_credentials_from_config_dialects(tmp_path):
     """Both node config spellings resolve; enabled-but-incomplete is a
     hard error; absent/disabled sections mean plaintext."""

@@ -9,6 +9,10 @@ TRANSACTIONS_FILTER and the chained COMMIT_HASH agree byte-for-byte
 
 import pytest
 
+pytest.importorskip(
+    "cryptography", reason="network e2e generates X.509 crypto-config"
+)
+
 from fabric_tpu.crypto.bccsp import SoftwareProvider
 from fabric_tpu.endorser import create_proposal, create_signed_tx, endorse_proposal
 from fabric_tpu.ledger import rwset as rw

@@ -4,6 +4,8 @@ pause/resume/rollback/reset/rebuild-dbs + kvledger pause_resume.go)."""
 import os
 
 import pytest
+
+from conftest import requires_crypto
 import yaml
 
 from fabric_tpu.cli import peer as peer_cli
@@ -79,6 +81,7 @@ def run(argv):
     return peer_cli.main(argv)
 
 
+@requires_crypto
 def test_pause_resume_marker_and_join_refusal(tmp_path):
     fs = str(tmp_path / "peer-data")
     build_chain(fs, "ch1")
@@ -133,6 +136,7 @@ def test_pause_resume_marker_and_join_refusal(tmp_path):
     assert not os.path.exists(marker)
 
 
+@requires_crypto
 def test_rollback_truncates_and_replays(tmp_path):
     fs = str(tmp_path / "peer-data")
     build_chain(fs, "ch2", n_blocks=4)
@@ -148,6 +152,7 @@ def test_rollback_truncates_and_replays(tmp_path):
     ledger.close()
 
 
+@requires_crypto
 def test_reset_rolls_every_channel_to_genesis(tmp_path):
     fs = str(tmp_path / "peer-data")
     build_chain(fs, "cha", n_blocks=3)
@@ -161,6 +166,7 @@ def test_reset_rolls_every_channel_to_genesis(tmp_path):
         ledger.close()
 
 
+@requires_crypto
 def test_rebuild_dbs_rebuilds_state(tmp_path):
     fs = str(tmp_path / "peer-data")
     build_chain(fs, "ch3", n_blocks=3)

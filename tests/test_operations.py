@@ -6,6 +6,8 @@ import urllib.request
 
 import pytest
 
+from conftest import requires_crypto
+
 from fabric_tpu.common import flogging
 from fabric_tpu.common.metrics import (
     CounterOpts,
@@ -232,6 +234,7 @@ def _self_signed(tmp_path, name):
     return str(cert_path), str(key_path)
 
 
+@requires_crypto
 def test_ops_tls_serves_https_and_rejects_plain(tmp_path):
     import ssl
 
@@ -255,6 +258,7 @@ def test_ops_tls_serves_https_and_rejects_plain(tmp_path):
         system.stop()
 
 
+@requires_crypto
 def test_ops_tls_client_auth_required(tmp_path):
     import ssl
 

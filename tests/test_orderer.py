@@ -4,6 +4,10 @@ multichannel})."""
 
 import pytest
 
+pytest.importorskip(
+    "cryptography", reason="orderer processors verify X.509 org identities"
+)
+
 from fabric_tpu.channelconfig import (
     ApplicationProfile,
     OrdererProfile,

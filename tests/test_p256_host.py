@@ -4,7 +4,12 @@ import hashlib
 import secrets
 
 import pytest
-from cryptography.exceptions import InvalidSignature
+
+pytest.importorskip(
+    "cryptography", reason="oracle-vs-OpenSSL tests need cryptography"
+)
+
+from cryptography.exceptions import InvalidSignature  # noqa: E402
 from cryptography.hazmat.primitives import hashes
 from cryptography.hazmat.primitives.asymmetric import ec
 from cryptography.hazmat.primitives.asymmetric.utils import (

@@ -8,6 +8,7 @@ import jax
 import numpy as np
 import pytest
 
+from conftest import requires_crypto
 from fabric_tpu.crypto import p256
 from fabric_tpu.crypto.bccsp import (
     ECDSAPublicKey,
@@ -167,6 +168,7 @@ def _validator(net, channel):
     )
 
 
+@requires_crypto
 def test_multichannel_grid_bit_exact(cpu8, net):
     channels = [f"ch{i}" for i in range(4)]
     blocks = {ch: _channel_block(net, ch, 5) for ch in channels}
@@ -199,6 +201,7 @@ def test_multichannel_grid_bit_exact(cpu8, net):
     }
 
 
+@requires_crypto
 def test_multichannel_rejects_unknown_channel(cpu8, net):
     mesh = grid_mesh(4, 2, cpu8)
     mc = MultiChannelValidator(mesh, {"ch0": _validator(net, "ch0")})

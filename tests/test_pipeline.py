@@ -6,6 +6,10 @@ import time
 
 import pytest
 
+pytest.importorskip(
+    "cryptography", reason="MSP material needs the cryptography package"
+)
+
 from fabric_tpu.crypto.bccsp import SoftwareProvider
 from fabric_tpu.endorser import create_proposal, create_signed_tx, endorse_proposal
 from fabric_tpu.ledger import rwset as rw

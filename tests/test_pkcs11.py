@@ -8,7 +8,11 @@ import hashlib
 
 import pytest
 
-from fabric_tpu.crypto import der, fastec, p256
+pytest.importorskip(
+    "cryptography", reason="PKCS11 fake-token tests sign via fastec"
+)
+
+from fabric_tpu.crypto import der, fastec, p256  # noqa: E402
 from fabric_tpu.crypto.bccsp import ECDSAPublicKey, SoftwareProvider
 from fabric_tpu.crypto.factory import FactoryError, provider_from_config
 from fabric_tpu.crypto.pkcs11 import PKCS11Error, PKCS11Provider

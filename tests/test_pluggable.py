@@ -19,6 +19,7 @@ import time
 
 import pytest
 
+from conftest import requires_crypto
 from fabric_tpu.crypto.bccsp import SoftwareProvider
 from fabric_tpu.endorser import create_proposal, create_signed_tx, endorse_proposal
 from fabric_tpu.ledger import rwset as rw
@@ -104,6 +105,7 @@ class RecordingPlugin(ValidationPlugin):
 
 
 class TestUnitDispatch:
+    @requires_crypto
     def test_plugin_accepts_and_sees_context(self, net):
         plugin = RecordingPlugin()
         v = validator(net, "recorder", plugin)
@@ -119,6 +121,7 @@ class TestUnitDispatch:
         assert all(s.sig_valid for s in ctx.signers)
         assert {s.msp_id for s in ctx.signers} == {"Org1MSP", "Org2MSP"}
 
+    @requires_crypto
     def test_plugin_rejects(self, net):
         class Reject(ValidationPlugin):
             def validate(self, ctx):
@@ -128,6 +131,7 @@ class TestUnitDispatch:
         flags = v.validate(make_block(net))
         assert flags.flag(0) == V.ENDORSEMENT_POLICY_FAILURE
 
+    @requires_crypto
     def test_plugin_execution_failure_halts_block(self, net):
         class Boom(ValidationPlugin):
             def validate(self, ctx):
@@ -137,6 +141,7 @@ class TestUnitDispatch:
         with pytest.raises(ValidationError):
             v.validate(make_block(net))
 
+    @requires_crypto
     def test_unresolvable_plugin_invalidates(self, net):
         v = validator(net, "ghost", plugin=None)
         flags = v.validate(make_block(net))
@@ -231,6 +236,7 @@ class TestPluginSBEInterplay:
         protoutil.seal_block(block)
         return v.validate(block)
 
+    @requires_crypto
     def test_plugin_md_write_applies_to_later_builtin_tx(self, net):
         flags = self._validate(
             net, [self._mixed_tx(net, with_vp=True), self._bin_tx(net)]
@@ -239,6 +245,7 @@ class TestPluginSBEInterplay:
         # tx1's endorsements predate tx0's in-block VP update -> failure
         assert flags.flag(1) == V.ENDORSEMENT_POLICY_FAILURE
 
+    @requires_crypto
     def test_no_vp_write_leaves_later_tx_valid(self, net):
         flags = self._validate(
             net, [self._mixed_tx(net, with_vp=False), self._bin_tx(net)]
@@ -485,6 +492,7 @@ def _query(nw, *fn_args):
     return base64.b64decode(out.strip())
 
 
+@requires_crypto
 def test_pluggable_e2e(plug_network):
     nw = plug_network
     # 1. allowed write commits through the custom plugin

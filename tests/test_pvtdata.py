@@ -8,6 +8,7 @@ import os
 
 import pytest
 
+from conftest import requires_crypto
 from fabric_tpu.crypto.bccsp import SoftwareProvider
 from fabric_tpu.ledger import rwset as rw
 from fabric_tpu.ledger.collections import (
@@ -158,6 +159,7 @@ def orgs():
     )
 
 
+@requires_crypto
 def test_collection_store_and_membership(orgs):
     org1, org2 = orgs
     pkg = build_collection_config_package(
@@ -279,6 +281,7 @@ def test_ledger_recovery_replays_pvt_state(tmp_path):
     assert len(again.get_pvt_data(0, 0)) == 1
 
 
+@requires_crypto
 def test_channel_pipeline_with_transient_store(tmp_path, orgs):
     """End-to-end: endorse a tx with private data, stage the cleartext in
     the transient store, order, and watch the peer channel assemble +
@@ -365,6 +368,7 @@ def test_channel_pipeline_with_transient_store(tmp_path, orgs):
     assert peer_channel.ledger.pvt_store.get_missing_pvt_data() == {}
 
 
+@requires_crypto
 def test_channel_pipeline_records_missing_pvt(tmp_path, orgs):
     """Without transient data or a fetcher, the commit records the gap for
     the reconciler instead of failing."""
@@ -507,6 +511,7 @@ def test_missing_markers_skip_invalid_txs(tmp_path):
     assert ledger.pvt_store.get_missing_pvt_data() == {}
 
 
+@requires_crypto
 def test_channel_treats_forged_fetched_pvt_as_missing(tmp_path, orgs):
     """Regression: hash-mismatched data from the (untrusted) fetcher must
     become a missing marker, not a commit failure."""

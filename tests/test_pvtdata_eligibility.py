@@ -6,6 +6,10 @@ An ineligible org's pull is refused."""
 
 import pytest
 
+pytest.importorskip(
+    "cryptography", reason="MSP material needs the cryptography package"
+)
+
 from fabric_tpu.crypto.bccsp import SoftwareProvider
 from fabric_tpu.gossip.pvtdata import PvtDataHandler, _request_signing_bytes
 from fabric_tpu.ledger.collections import (

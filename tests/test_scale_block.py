@@ -5,6 +5,7 @@ config #2 (bench.py measures the same shape on the accelerator)."""
 
 import pytest
 
+from conftest import requires_crypto
 from fabric_tpu.crypto.bccsp import SoftwareProvider
 from fabric_tpu.endorser import create_proposal, create_signed_tx, endorse_proposal
 from fabric_tpu.ledger import rwset as rw
@@ -26,6 +27,7 @@ CHANNEL = "scalechan"
 N_TXS = 1000
 
 
+@requires_crypto
 @pytest.mark.slow
 def test_thousand_tx_block_commits(tmp_path):
     org1 = generate_org("org1.example.com", "Org1MSP")

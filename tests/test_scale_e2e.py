@@ -12,6 +12,10 @@ import time
 
 import pytest
 
+pytest.importorskip(
+    "cryptography", reason="MSP material needs the cryptography package"
+)
+
 from tests.test_cli_network import run_cli, spawn, wait_listening
 
 CHANNEL = "scalechan"

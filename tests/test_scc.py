@@ -4,6 +4,10 @@ core/scc/lscc, core/aclmgmt)."""
 
 import pytest
 
+pytest.importorskip(
+    "cryptography", reason="MSP material needs the cryptography package"
+)
+
 from fabric_tpu.chaincode.shim import ChaincodeStub
 from fabric_tpu.chaincode.support import ChaincodeSupport, TxParams
 from fabric_tpu.crypto.bccsp import SoftwareProvider

@@ -6,6 +6,10 @@ import os
 
 import pytest
 
+pytest.importorskip(
+    "cryptography", reason="MSP material needs the cryptography package"
+)
+
 from fabric_tpu.comm.server import GRPCServer, channel_to
 from fabric_tpu.comm.services import register_snapshot_service
 from fabric_tpu.crypto.bccsp import SoftwareProvider

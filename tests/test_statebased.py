@@ -12,6 +12,7 @@ core/common/validation/statebased/validator_keylevel_test.go:
 
 import pytest
 
+from conftest import requires_crypto
 from fabric_tpu.crypto.bccsp import SoftwareProvider
 from fabric_tpu.endorser import create_proposal, create_signed_tx, endorse_proposal
 from fabric_tpu.ledger import rwset as rw
@@ -99,6 +100,7 @@ def run_block(net, tmp_path, name, envs_per_block):
     return peer, flags_out
 
 
+@requires_crypto
 def test_vp_metadata_persisted_and_enforced(net, tmp_path):
     """Block 1 sets a key-level policy requiring Org2; block 2's tx
     endorsed only by Org1 on that key is invalidated."""
@@ -125,6 +127,7 @@ def test_vp_metadata_persisted_and_enforced(net, tmp_path):
     assert [int(c) for c in flags[2].asarray()] == [int(V.VALID)]
 
 
+@requires_crypto
 def test_in_block_vp_update_invalidates_later_tx(net, tmp_path):
     """tx0 updates k's validation parameter; tx1 (same block) writes k ->
     invalidated because its endorsements predate the new policy."""
@@ -143,6 +146,7 @@ def test_in_block_vp_update_invalidates_later_tx(net, tmp_path):
     ]
 
 
+@requires_crypto
 def test_invalid_metadata_writer_does_not_block(net, tmp_path):
     """If the metadata-writing tx is itself invalid (policy failure), a
     later tx in the same block validates against the committed state."""
@@ -170,6 +174,7 @@ def test_invalid_metadata_writer_does_not_block(net, tmp_path):
     ]
 
 
+@requires_crypto
 def test_metadata_only_write_merges_value(net, tmp_path):
     """A metadata-only write keeps the committed value (tx_ops merge) and
     a metadata write on a missing key is a no-op."""

@@ -8,6 +8,10 @@ import time
 
 import pytest
 
+pytest.importorskip(
+    "cryptography", reason="MSP material needs the cryptography package"
+)
+
 from fabric_tpu.channelconfig import (
     ApplicationProfile,
     OrdererProfile,

@@ -14,6 +14,10 @@ import random
 
 import pytest
 
+pytest.importorskip(
+    "cryptography", reason="MSP material needs the cryptography package"
+)
+
 from fabric_tpu.crypto.bccsp import PurePythonProvider, SoftwareProvider
 from fabric_tpu.endorser import (
     create_proposal,
