@@ -18,8 +18,11 @@ import threading
 from typing import Dict, Iterator, Optional
 
 from fabric_tpu.chaincode.shim import ERROR, OK, Response, error_response
+from fabric_tpu.common.flogging import must_get_logger
 from fabric_tpu.comm.server import GRPCServer, STREAM_STREAM
 from fabric_tpu.protos import peer_pb2
+
+logger = must_get_logger("chaincode.extserver")
 
 CCM = peer_pb2.ChaincodeMessage
 SERVICE_NAME = "protos.ChaincodeSupport"
@@ -272,8 +275,8 @@ class ChaincodeListener:
         try:
             for msg in request_iterator:
                 handler.on_message(msg)
-        except Exception:
-            pass
+        except Exception as exc:
+            logger.debug("chaincode stream ended: %s", exc)
         finally:
             handler.close()
             with self._cv:

@@ -28,7 +28,10 @@ from typing import List, Optional, Sequence, Tuple
 
 import os
 
+from fabric_tpu.common.flogging import must_get_logger
 from fabric_tpu.crypto import der, hostec, p256
+
+logger = must_get_logger("bccsp")
 
 # ---------------------------------------------------------------------------
 # Host EC backend ladder: fastec (OpenSSL) -> hostec (vectorized pure
@@ -326,6 +329,9 @@ def default_provider() -> Provider:
                 _default = TPUProvider()
             else:
                 _default = SoftwareProvider()
-        except Exception:
+        except Exception as exc:
+            logger.warning(
+                "device probe failed (%s); using the software provider", exc
+            )
             _default = SoftwareProvider()
     return _default

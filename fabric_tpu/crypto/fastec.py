@@ -23,10 +23,13 @@ from __future__ import annotations
 
 from typing import Dict, Optional, Tuple
 
-from cryptography.exceptions import InvalidSignature
-from cryptography.hazmat.primitives import hashes
-from cryptography.hazmat.primitives.asymmetric import ec
-from cryptography.hazmat.primitives.asymmetric.utils import (
+# fastec IS the optional OpenSSL tier: the module must raise ImportError
+# when `cryptography` is absent so the backend ladder (bccsp
+# select_ec_backend) falls through to hostec — every importer guards it.
+from cryptography.exceptions import InvalidSignature  # fablint: disable=module-import
+from cryptography.hazmat.primitives import hashes  # fablint: disable=module-import
+from cryptography.hazmat.primitives.asymmetric import ec  # fablint: disable=module-import
+from cryptography.hazmat.primitives.asymmetric.utils import (  # fablint: disable=module-import
     Prehashed,
     decode_dss_signature,
     encode_dss_signature,

@@ -171,7 +171,8 @@ def fp12_inv(x: Fp12) -> Fp12:
     # even subalgebra (an Fp6 image). We reduce twice down to Fp2.
     # a * conj(a) has only even coefficients -> element of Fp6 over w^2.
     ac = fp12_mul(x, fp12_conj(x))
-    assert ac[1] == FP2_ZERO and ac[3] == FP2_ZERO and ac[5] == FP2_ZERO
+    if ac[1] != FP2_ZERO or ac[3] != FP2_ZERO or ac[5] != FP2_ZERO:
+        raise ArithmeticError("a*conj(a) left the even Fp6 subalgebra")
     # Fp6 = Fp2[v]/(v^3 - xi) with v = w^2: coefficients (ac[0], ac[2], ac[4])
     inv6 = _fp6_inv((ac[0], ac[2], ac[4]))
     inv12 = (inv6[0], FP2_ZERO, inv6[1], FP2_ZERO, inv6[2], FP2_ZERO)
@@ -414,7 +415,8 @@ def _line(t: E12Point, q: E12Point, p_g1: Tuple[int, int]) -> Fp12:
     point P embedded in Fp12."""
     px = fp12_from_fp2((p_g1[0], 0), 0)
     py = fp12_from_fp2((p_g1[1], 0), 0)
-    assert t is not None and q is not None
+    if t is None or q is None:
+        raise ArithmeticError("line evaluation through the point at infinity")
     x1, y1 = t
     x2, y2 = q
     if x1 == x2 and y1 == y2:

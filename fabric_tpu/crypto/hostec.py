@@ -54,8 +54,11 @@ import secrets
 import threading
 from typing import Callable, List, Optional, Sequence, Tuple
 
+from fabric_tpu.common.flogging import must_get_logger
 from fabric_tpu.crypto import p256
 from fabric_tpu.crypto.p256 import A, B, GX, GY, HALF_N, N, P, hash_to_int
+
+logger = must_get_logger("hostec")
 
 KeyPair = p256.KeyPair
 
@@ -409,7 +412,10 @@ def _pool():
                     max_workers=procs,
                     mp_context=multiprocessing.get_context(start),
                 )
-            except Exception:  # pragma: no cover - restricted sandboxes
+            except Exception as exc:  # pragma: no cover - restricted sandboxes
+                logger.warning(
+                    "process pool unavailable (%s); verifying inline", exc
+                )
                 _POOL = False
     return _POOL or None
 
@@ -446,7 +452,8 @@ def verify_parsed_batch_sharded(
             pool.submit(verify_parsed_batch, lanes[off : off + step])
             for off in range(0, len(lanes), step)
         ]
-    except Exception:  # BrokenProcessPool / shutdown race
+    except Exception as exc:  # BrokenProcessPool / shutdown race
+        logger.warning("pool submit failed (%s); recomputing inline", exc)
         shutdown_pool()
         out = verify_parsed_batch(lanes)
         return lambda: out
@@ -456,7 +463,10 @@ def verify_parsed_batch_sharded(
         try:
             for f in futures:
                 out.extend(f.result())
-        except Exception:  # worker died mid-run: inline fallback
+        except Exception as exc:  # worker died mid-run: inline fallback
+            logger.warning(
+                "pool worker died mid-batch (%s); recomputing inline", exc
+            )
             shutdown_pool()
             return verify_parsed_batch(lanes)
         return out
@@ -498,7 +508,8 @@ def sign_digest(priv: int, digest: bytes) -> Tuple[int, int]:
     while True:
         k = secrets.randbelow(N - 1) + 1
         pt = scalar_base_mult(k)
-        assert pt is not None
+        if pt is None:
+            raise ArithmeticError("k*G is infinity for k in [1, N-1]")
         r = pt[0] % N
         if r == 0:
             continue
@@ -513,5 +524,6 @@ def sign_digest(priv: int, digest: bytes) -> Tuple[int, int]:
 def generate_keypair() -> KeyPair:
     d = secrets.randbelow(N - 1) + 1
     q = scalar_base_mult(d)
-    assert q is not None
+    if q is None:
+        raise ArithmeticError("d*G is infinity for d in [1, N-1]")
     return KeyPair(d, q)

@@ -139,7 +139,8 @@ def sign_digest(
     while True:
         kk = k if k is not None else (secrets.randbelow(N - 1) + 1)
         pt = scalar_mult(kk, GENERATOR)
-        assert pt is not None
+        if pt is None:
+            raise ArithmeticError("k*G is infinity for k in [1, N-1]")
         r = pt[0] % N
         if r == 0:
             if k is not None:
@@ -163,7 +164,8 @@ class KeyPair(NamedTuple):
 def generate_keypair() -> KeyPair:
     d = secrets.randbelow(N - 1) + 1
     q = scalar_mult(d, GENERATOR)
-    assert q is not None
+    if q is None:
+        raise ArithmeticError("d*G is infinity for d in [1, N-1]")
     return KeyPair(d, q)
 
 

@@ -21,6 +21,7 @@ from typing import Dict, List, Sequence, Tuple
 
 import numpy as np
 
+from fabric_tpu.common.flogging import must_get_logger
 from fabric_tpu.crypto import p256
 from fabric_tpu.crypto.bccsp import (
     ECDSAPublicKey,
@@ -28,6 +29,8 @@ from fabric_tpu.crypto.bccsp import (
     VerifyError,
 )
 from fabric_tpu.ops import bignum as bn
+
+logger = must_get_logger("tpu_provider")
 
 _BUCKETS = [128, 256, 512, 1024, 2048, 4096, 8192, 16384]
 
@@ -180,8 +183,12 @@ class TPUProvider(Provider):
                 else:
                     out = self._dispatch_bytes_or_fallback(prep)
                 break
-            except Exception:  # noqa: BLE001 - backend init/dispatch flake
+            except Exception as exc:  # noqa: BLE001 - backend init/dispatch flake
                 if attempt == attempts - 1:
+                    logger.warning(
+                        "device dispatch failed %d time(s) (%s); "
+                        "falling back to software verify", attempts, exc,
+                    )
                     return lambda: self._sw_verify_all(keys, signatures, digests)
                 time.sleep(delay)
                 delay *= 3.0
@@ -189,7 +196,11 @@ class TPUProvider(Provider):
         def resolve() -> List[bool]:
             try:
                 return [bool(v) for v in np.asarray(out)[:n]]
-            except Exception:  # noqa: BLE001 - async error surfaces here
+            except Exception as exc:  # noqa: BLE001 - async error surfaces here
+                logger.warning(
+                    "async device result failed (%s); "
+                    "falling back to software verify", exc,
+                )
                 return self._sw_verify_all(keys, signatures, digests)
 
         return resolve
@@ -206,7 +217,11 @@ class TPUProvider(Provider):
         if not self._bytes_path_broken:
             try:
                 return self._dispatch_bytes(prep)
-            except Exception:  # noqa: BLE001 - compile/dispatch failure
+            except Exception as exc:  # noqa: BLE001 - compile/dispatch failure
+                logger.warning(
+                    "bytes kernel failed (%s); trying the limb-matrix "
+                    "fallback", exc,
+                )
                 bytes_failed = True
         e_bytes, r_bytes, s_bytes, kx, ky, idx, ok = prep
         qx = np.ascontiguousarray(kx[:, idx])

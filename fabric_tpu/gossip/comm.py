@@ -22,10 +22,13 @@ import threading
 from typing import Callable, Dict, List, Optional, Sequence
 
 from fabric_tpu.comm.server import GRPCServer, STREAM_STREAM, channel_to
+from fabric_tpu.common.flogging import must_get_logger
 from fabric_tpu.gossip.membership import LeaderElection, Membership
 from fabric_tpu.gossip.pull import PULL_MEMBERSHIP
 from fabric_tpu.gossip.state import StateProvider
 from fabric_tpu.protos import common_pb2, gossip_pb2
+
+logger = must_get_logger("gossip.comm")
 
 
 class GossipNode:
@@ -312,8 +315,8 @@ class GossipNode:
                 parsed.append(b)
             try:
                 self.state.handle_state_response(parsed)
-            except Exception:
-                pass
+            except Exception as exc:
+                logger.debug("state response rejected: %s", exc)
         elif kind in (
             "hello",
             "data_dig",
@@ -341,15 +344,15 @@ class GossipNode:
 
                 try:
                     self._reconcile_commit(reconcile_response_entries(msg))
-                except Exception:
-                    pass
+                except Exception as exc:
+                    logger.debug("pvtdata reconcile commit failed: %s", exc)
         return None
 
     def _drain(self) -> None:
         try:
             self.state.deliver_payloads()
-        except Exception:
-            pass
+        except Exception as exc:
+            logger.debug("payload delivery failed: %s", exc)
 
     def _alive_signature_ok(self, alive) -> bool:
         """Membership authentication (reference aliveMsgStore validation):
@@ -640,8 +643,8 @@ class GossipNode:
             while not self._stop.wait(self._tick_interval):
                 try:
                     self._tick_once()
-                except Exception:
-                    pass
+                except Exception as exc:
+                    logger.debug("gossip tick failed: %s", exc)
 
         self._thread = threading.Thread(target=loop, name="gossip", daemon=True)
         self._thread.start()

@@ -139,7 +139,7 @@ def pvt_data_matches_hashes(
     kv = kv_rwset_pb2.KVRWSet()
     try:
         kv.ParseFromString(raw)
-    except Exception:
+    except Exception:  # fablint: disable=broad-except  # malformed pvt payload = explicit False (lane invalid)
         return False
     for w in kv.writes:
         kh = hashlib.sha256(w.key.encode()).digest()
@@ -485,7 +485,7 @@ class KVLedger:
                     one = self._pvt_batch(
                         block_num, [entry], codes, rwsets, verify_hashes=True
                     )
-                except Exception:  # noqa: BLE001 - includes proto DecodeError;
+                except Exception:  # fablint: disable=broad-except  # includes proto DecodeError;
                     # one forged/mismatched/garbled entry must not abort
                     # the rest of the batch
                     continue

@@ -59,7 +59,10 @@ from fabric_tpu.ledger.statedb import (
     UpdateBatch,
     VersionedDB,
 )
+from fabric_tpu.common.flogging import must_get_logger
 from fabric_tpu.validation.txflags import TxValidationCode
+
+logger = must_get_logger("mvcc_device")
 
 _NO_VERSION = (-1, -1)  # sentinel for "key absent" (None version)
 
@@ -460,10 +463,14 @@ class ResidentDeviceValidator(DeviceValidator):
                 num_keys=Kb,
                 cap=self._cap,
             )
-        except Exception:
+        except Exception as exc:
             # the table buffer is DONATED into the launch: after any
             # dispatch failure its contents are unreliable — drop the
             # residency and serve this block from the host oracle
+            logger.warning(
+                "device MVCC dispatch failed (%s); dropping residency and "
+                "validating this block on the host", exc,
+            )
             self.invalidate()
             self.last_path = "host"
             out = self._host.validate_and_prepare_batch(

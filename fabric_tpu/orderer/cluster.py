@@ -22,7 +22,7 @@ import queue
 import threading
 from typing import Callable, Dict, Optional, Tuple
 
-import grpc
+import grpc  # fablint: disable=module-import  # raft transport is grpc-only; comm.server below pulls grpc regardless
 
 from fabric_tpu.comm.server import GRPCServer, STREAM_STREAM, UNARY, channel_to
 from fabric_tpu.orderer.raft import Message, message_from_bytes, message_to_bytes

@@ -234,7 +234,7 @@ class VerifyBatcher:
                     resolver = lambda v=verdicts: v  # noqa: E731
                 else:
                     resolver = dispatch(keys, sigs, digests)
-            except BaseException as exc:  # noqa: BLE001 - propagate to callers
+            except BaseException as exc:  # fablint: disable=broad-except  # error propagated to every waiting caller via r.error
                 for r in batch:
                     r.error = exc
                     r.event.set()
@@ -265,7 +265,7 @@ class VerifyBatcher:
             out = list(resolver())
             if t0:
                 self._observe_rtt(lanes, time.perf_counter() - t0)
-        except BaseException as exc:  # noqa: BLE001 - propagate to callers
+        except BaseException as exc:  # fablint: disable=broad-except  # error propagated to every waiting caller via r.error
             for r in reqs:
                 r.error = exc
                 r.event.set()

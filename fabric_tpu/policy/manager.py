@@ -87,7 +87,7 @@ class SignaturePolicy(Policy):
             try:
                 identity, msp = self._msp_manager.deserialize_identity(sd.identity)
                 identity.verify(sd.data, sd.signature)
-            except Exception:
+            except Exception:  # fablint: disable=broad-except  # bad signature = lane dropped; PolicyError raised below if none valid
                 continue
             valid.append((identity, msp))
         if not valid:
@@ -103,7 +103,7 @@ class SignaturePolicy(Policy):
                 try:
                     msp.satisfies_principal(identity, principal)
                     sat[s, p] = True
-                except Exception:
+                except Exception:  # fablint: disable=broad-except  # mismatch = sat stays False, the explicit mask write
                     pass
         if not evaluate_host(self.envelope, sat):
             raise PolicyError("signature set did not satisfy policy")
@@ -136,7 +136,7 @@ class ImplicitMetaPolicy(Policy):
         for sub in self._subs:
             try:
                 sub.evaluate_signed_data(signature_set)
-            except Exception as e:
+            except Exception as e:  # fablint: disable=broad-except  # failure recorded; aggregated PolicyError raised after the loop
                 failures.append(str(e))
                 continue
             remaining -= 1

@@ -1,0 +1,6 @@
+"""Developer tooling: static analysis (fablint) and repo gates.
+
+Everything in this package is dependency-free stdlib so the gates run in
+minimal environments (no ``cryptography``, no ``jax``) without importing
+any of the code they inspect.
+"""

@@ -1,0 +1,920 @@
+"""fablint — AST-based invariant linter for the fabric-tpu codebase.
+
+The pipeline's correctness contract is bit-exactness of the VALID/INVALID
+mask across backend tiers.  The bug classes that silently break that
+contract — swallowed exceptions in verify paths, impure host code inside
+jitted kernels, module-scope imports of optional packages that kill test
+collection — are exactly what static analysis catches before a bench run
+ever does.  fablint walks the AST of every source file (it never imports
+the code it inspects, so it runs in minimal environments without
+``cryptography``/``jax``) and enforces ~10 project-specific rules.
+
+Rules
+-----
+module-import    module-scope import of a heavy/optional third-party
+                 package (cryptography, grpc, jax) outside the allowlist
+                 and not guarded by try/except ImportError.  Generalizes
+                 the collect-gate: one unguarded import poisons
+                 ``pytest --collect-only`` in minimal environments.
+broad-except     bare ``except:`` anywhere, or ``except Exception`` in
+                 the mask-critical paths (crypto/, validation/, ledger/,
+                 ops/, msp/, policy/, idemix/, parallel/) whose handler
+                 neither re-raises nor logs: a silently swallowed
+                 exception in a verify path flips lanes VALID.
+mutable-default  ``def f(x=[])`` — the default is shared across calls.
+jit-impure       host/impure calls (time.*, random.*, np.random.*,
+                 print, np.asarray/np.array, .block_until_ready()) inside
+                 a jitted function: they run at trace time, bake one
+                 value into the compiled kernel, or force a host sync.
+limb-dtype       integer literal > 2**32 fed to an array constructor
+                 without an explicit ``dtype=``: platform-default int
+                 truncates limbs and corrupts the bignum pipeline.
+assert-security  ``assert`` in crypto/, validation/, msp/, idemix/ —
+                 asserts vanish under ``python -O``; a validation
+                 decision must be an explicit raise or mask write.
+digest-compare   ``==``/``!=`` on digest/mac/checksum values; use
+                 ``hmac.compare_digest`` for constant-time comparison.
+                 (deliberately NOT ``signature``: ECDSA r/s are public
+                 values here, and the token matches policy-type enums
+                 like ``P.SIGNATURE`` all over the codebase.)
+shell-injection  ``subprocess`` with ``shell=True``, ``os.system``,
+                 ``os.popen``.
+fork-start       multiprocessing ``"fork"`` start method — fork with
+                 live threads (gRPC, XLA) wedges workers; the repo
+                 invariant is forkserver/spawn (crypto/hostec.py).
+all-drift        a name exported in a package ``__init__``'s ``__all__``
+                 that is not actually defined/imported in the module.
+
+Suppression
+-----------
+Per line: ``# fablint: disable=rule-id[,rule-id...]  # <reason>`` on the
+line the finding is reported at (for an except clause: the ``except``
+line; for a def: the ``def`` line).  ``disable=all`` silences every rule
+for that line.  Suppressions should carry a justification comment.
+
+Exclusions
+----------
+Generated and non-Python artifacts are skipped: ``*_pb2.py``,
+``__pycache__``, ``native/``, ``protos/src/``.
+
+Usage
+-----
+    python -m fabric_tpu.tools.fablint [--json] [--list-rules]
+                                       [--rules a,b] PATH...
+
+Exit status: 0 = clean, 1 = findings, 2 = usage/IO error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import fnmatch
+import json
+import re
+import sys
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+__version__ = "1.0"
+
+# --------------------------------------------------------------------------
+# Configuration
+# --------------------------------------------------------------------------
+
+#: Heavy / optional third-party roots whose module-scope import breaks
+#: collection in minimal environments (or costs seconds at import time).
+HEAVY_PACKAGES = {"cryptography", "grpc", "jax", "jaxlib"}
+
+#: Files allowed to import a heavy package at module scope: the device
+#: kernel layer imports jax unconditionally by design (nothing imports it
+#: in a CPU-only test run without wanting jax), and comm/ IS the gRPC
+#: layer.  Patterns are fnmatch globs against the posix path.
+MODULE_IMPORT_ALLOW: Dict[str, Tuple[str, ...]] = {
+    "jax": (
+        "*fabric_tpu/ops/*",
+        "*fabric_tpu/ledger/mvcc_device.py",
+        "*fabric_tpu/policy/evaluator.py",
+    ),
+    "jaxlib": ("*fabric_tpu/ops/*",),
+    "grpc": ("*fabric_tpu/comm/*",),
+}
+
+#: Directories whose exception discipline is load-bearing for the
+#: VALID/INVALID mask: a swallowed exception here flips lanes silently.
+MASK_CRITICAL_DIRS = (
+    "*fabric_tpu/crypto/*",
+    "*fabric_tpu/validation/*",
+    "*fabric_tpu/ledger/*",
+    "*fabric_tpu/ops/*",
+    "*fabric_tpu/msp/*",
+    "*fabric_tpu/policy/*",
+    "*fabric_tpu/idemix/*",
+    "*fabric_tpu/parallel/*",
+)
+
+#: Directories where ``assert`` must not guard validation decisions.
+ASSERT_SECURITY_DIRS = (
+    "*fabric_tpu/crypto/*",
+    "*fabric_tpu/validation/*",
+    "*fabric_tpu/msp/*",
+    "*fabric_tpu/idemix/*",
+)
+
+#: Generated / non-source artifacts fablint never parses.
+DEFAULT_EXCLUDES = (
+    "*_pb2.py",
+    "*/__pycache__/*",
+    "*/native/*",
+    "*/protos/src/*",
+    "*/.git/*",
+)
+
+_LOG_METHODS = {
+    "debug", "info", "warning", "warn", "error", "exception", "critical",
+    "log",
+}
+
+_SECRET_TOKENS = {"digest", "hmac", "mac", "checksum"}
+
+_ARRAY_CTORS = {
+    "array", "asarray", "full", "full_like", "arange", "constant",
+}
+_ARRAY_ROOTS = {"np", "jnp", "numpy", "jax"}
+
+_IMPURE_ROOTS = {"time", "random"}
+_IMPURE_DOTTED = {
+    "np.asarray", "numpy.asarray", "np.array", "numpy.array",
+    "np.random", "numpy.random",
+}
+
+_LIMB_LIMIT = 2 ** 32
+
+
+# --------------------------------------------------------------------------
+# Core machinery
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+
+    def key(self) -> Tuple[str, int, int, str]:
+        return (self.path, self.line, self.col, self.rule)
+
+    def to_dict(self) -> Dict[str, object]:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+        }
+
+
+RuleFn = Callable[[ast.Module, str, "FileContext"], List[Finding]]
+
+#: rule-id -> (one-line doc, checker)
+RULES: Dict[str, Tuple[str, RuleFn]] = {}
+
+
+def rule(rule_id: str, doc: str) -> Callable[[RuleFn], RuleFn]:
+    def deco(fn: RuleFn) -> RuleFn:
+        RULES[rule_id] = (doc, fn)
+        return fn
+
+    return deco
+
+
+class FileContext:
+    """Per-file info shared by rules: posix path + path predicates."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self.posix = Path(path).as_posix()
+
+    def matches(self, patterns: Iterable[str]) -> bool:
+        return any(fnmatch.fnmatch(self.posix, pat) for pat in patterns)
+
+
+_DISABLE_RE = re.compile(r"#\s*fablint:\s*disable=([A-Za-z0-9_\-, ]+)")
+
+
+def parse_suppressions(source: str) -> Dict[int, Set[str]]:
+    """Map 1-based line number -> set of rule ids disabled on that line."""
+    out: Dict[int, Set[str]] = {}
+    for lineno, line in enumerate(source.splitlines(), start=1):
+        m = _DISABLE_RE.search(line)
+        if m:
+            out[lineno] = {r.strip() for r in m.group(1).split(",") if r.strip()}
+    return out
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _ident_tokens(node: ast.AST) -> Set[str]:
+    """Lower-cased underscore-split tokens of a Name/Attribute identifier."""
+    name: Optional[str] = None
+    if isinstance(node, ast.Name):
+        name = node.id
+    elif isinstance(node, ast.Attribute):
+        name = node.attr
+    elif isinstance(node, ast.Call):
+        name = _dotted(node.func)
+        if name is not None:
+            name = name.rsplit(".", 1)[-1]
+    if not name:
+        return set()
+    return {tok for tok in name.lower().split("_") if tok}
+
+
+# --------------------------------------------------------------------------
+# Rules
+# --------------------------------------------------------------------------
+
+
+def _catches_import_error(handler: ast.ExceptHandler) -> bool:
+    if handler.type is None:
+        return True
+    names = []
+    if isinstance(handler.type, ast.Tuple):
+        names = [_dotted(e) for e in handler.type.elts]
+    else:
+        names = [_dotted(handler.type)]
+    return any(
+        n in ("ImportError", "ModuleNotFoundError", "Exception", "BaseException")
+        for n in names
+        if n
+    )
+
+
+@rule(
+    "module-import",
+    "module-scope import of a heavy/optional package (cryptography, grpc, "
+    "jax) outside the allowlist and not guarded by try/except ImportError",
+)
+def check_module_import(tree: ast.Module, source: str, ctx: FileContext) -> List[Finding]:
+    findings: List[Finding] = []
+
+    def heavy_roots(node: ast.stmt) -> List[Tuple[str, int, int]]:
+        out = []
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                root = alias.name.split(".")[0]
+                if root in HEAVY_PACKAGES:
+                    out.append((root, node.lineno, node.col_offset))
+        elif isinstance(node, ast.ImportFrom) and node.level == 0 and node.module:
+            root = node.module.split(".")[0]
+            if root in HEAVY_PACKAGES:
+                out.append((root, node.lineno, node.col_offset))
+        return out
+
+    def scan(body: Sequence[ast.stmt], guarded: bool) -> None:
+        for node in body:
+            for root, line, col in heavy_roots(node):
+                if guarded:
+                    continue
+                allow = MODULE_IMPORT_ALLOW.get(root, ())
+                if ctx.matches(allow):
+                    continue
+                findings.append(
+                    Finding(
+                        "module-import", ctx.path, line, col,
+                        f"module-scope import of {root!r} is unguarded: wrap "
+                        f"in try/except ImportError or move into the "
+                        f"function that needs it (breaks collection in "
+                        f"minimal environments)",
+                    )
+                )
+            if isinstance(node, ast.Try):
+                has_guard = any(_catches_import_error(h) for h in node.handlers)
+                scan(node.body, guarded or has_guard)
+                scan(node.orelse, guarded)
+                scan(node.finalbody, guarded)
+                for h in node.handlers:
+                    scan(h.body, guarded)
+            elif isinstance(node, ast.If):
+                test = _dotted(node.test)
+                type_checking = test in ("TYPE_CHECKING", "typing.TYPE_CHECKING")
+                scan(node.body, guarded or type_checking)
+                scan(node.orelse, guarded)
+            elif isinstance(node, ast.With):
+                scan(node.body, guarded)
+
+    scan(tree.body, guarded=False)
+    return findings
+
+
+def _is_logging_call(call: ast.Call) -> bool:
+    """A log-method call on a logger-ish receiver: ``logger.warning(...)``,
+    ``warnings.warn(...)``, ``self._log.debug(...)``,
+    ``must_get_logger(...).error(...)`` — but NOT ``math.log(2)`` or
+    ``obj.error()`` (an unrelated leaf-name match must not silence the
+    broad-except rule)."""
+    func = call.func
+    if not isinstance(func, ast.Attribute) or func.attr not in _LOG_METHODS:
+        return False
+    recv = func.value
+    if isinstance(recv, ast.Call):
+        return True  # logger factory: must_get_logger(...)/getLogger(...)
+    if isinstance(recv, ast.Name):
+        name = recv.id
+    elif isinstance(recv, ast.Attribute):
+        name = recv.attr
+    else:
+        return False
+    name = name.lower()
+    return "log" in name or name == "warnings"
+
+
+def _handler_handles(handler: ast.ExceptHandler) -> bool:
+    """True if the handler re-raises or logs (incl. warnings.warn)."""
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call) and _is_logging_call(node):
+            return True
+    return False
+
+
+@rule(
+    "broad-except",
+    "bare 'except:' anywhere, or 'except Exception' in mask-critical paths "
+    "(crypto/, validation/, ledger/, ops/, msp/, policy/, idemix/, "
+    "parallel/) that neither re-raises nor logs",
+)
+def check_broad_except(tree: ast.Module, source: str, ctx: FileContext) -> List[Finding]:
+    findings: List[Finding] = []
+    mask_critical = ctx.matches(MASK_CRITICAL_DIRS)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if node.type is None:
+            findings.append(
+                Finding(
+                    "broad-except", ctx.path, node.lineno, node.col_offset,
+                    "bare 'except:' also swallows KeyboardInterrupt/"
+                    "SystemExit; catch Exception (or narrower) and handle it",
+                )
+            )
+            continue
+        types = (
+            [_dotted(e) for e in node.type.elts]
+            if isinstance(node.type, ast.Tuple)
+            else [_dotted(node.type)]
+        )
+        broad = any(t in ("Exception", "BaseException") for t in types if t)
+        if broad and mask_critical and not _handler_handles(node):
+            findings.append(
+                Finding(
+                    "broad-except", ctx.path, node.lineno, node.col_offset,
+                    "broad except in a mask-critical path must re-raise, "
+                    "log, or explicitly mark the affected lane INVALID "
+                    "(suppress with a justification if the catch is "
+                    "deliberate)",
+                )
+            )
+    return findings
+
+
+_MUTABLE_CTORS = {"list", "dict", "set", "bytearray", "defaultdict", "deque", "Counter"}
+
+
+@rule(
+    "mutable-default",
+    "mutable default argument (list/dict/set literal or constructor) is "
+    "shared across calls",
+)
+def check_mutable_default(tree: ast.Module, source: str, ctx: FileContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        defaults = list(node.args.defaults) + [
+            d for d in node.args.kw_defaults if d is not None
+        ]
+        for d in defaults:
+            bad = isinstance(
+                d, (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp, ast.SetComp)
+            )
+            if isinstance(d, ast.Call):
+                dn = _dotted(d.func)
+                if dn and dn.rsplit(".", 1)[-1] in _MUTABLE_CTORS:
+                    bad = True
+            if bad:
+                findings.append(
+                    Finding(
+                        "mutable-default", ctx.path, node.lineno, node.col_offset,
+                        f"function {node.name!r} has a mutable default "
+                        f"argument; use None and create it in the body",
+                    )
+                )
+    return findings
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """True for `jax.jit` / `jit` / `partial(jax.jit, ...)` expressions."""
+    dn = _dotted(node)
+    if dn in ("jax.jit", "jit"):
+        return True
+    if isinstance(node, ast.Call):
+        fn = _dotted(node.func)
+        if fn in ("partial", "functools.partial") and node.args:
+            return _is_jit_expr(node.args[0])
+        # jax.jit(...) used as a decorator factory
+        return _is_jit_expr(node.func)
+    return False
+
+
+@rule(
+    "jit-impure",
+    "impure/host call (time.*, random.*, np.random.*, print, np.asarray/"
+    "np.array, .block_until_ready()) inside a jitted function",
+)
+def check_jit_impure(tree: ast.Module, source: str, ctx: FileContext) -> List[Finding]:
+    jitted: List[ast.AST] = []
+    jitted_names: Set[str] = set()
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(_is_jit_expr(dec) for dec in node.decorator_list):
+                jitted.append(node)
+        elif isinstance(node, ast.Call) and _is_jit_expr(node.func):
+            # fn_jit = jax.jit(fn) / jax.jit(run, ...) / partial(jax.jit)(fn)
+            if node.args and isinstance(node.args[0], ast.Name):
+                jitted_names.add(node.args[0].id)
+
+    if jitted_names:
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and node.name in jitted_names
+                and node not in jitted
+            ):
+                jitted.append(node)
+
+    findings: List[Finding] = []
+    for fn in jitted:
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            dn = _dotted(node.func)
+            bad: Optional[str] = None
+            if dn == "print":
+                bad = "print"
+            elif dn is not None:
+                root = dn.split(".")[0]
+                if root in _IMPURE_ROOTS:
+                    bad = dn
+                elif any(dn == d or dn.startswith(d + ".") for d in _IMPURE_DOTTED):
+                    bad = dn
+            if (
+                bad is None
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "block_until_ready"
+            ):
+                bad = ".block_until_ready()"
+            if bad is not None:
+                findings.append(
+                    Finding(
+                        "jit-impure", ctx.path, node.lineno, node.col_offset,
+                        f"{bad} inside jitted function "
+                        f"{getattr(fn, 'name', '<lambda>')!r}: runs at trace "
+                        f"time / forces a host sync, not per call",
+                    )
+                )
+    return findings
+
+
+def _looks_like_dtype(node: ast.AST) -> bool:
+    """A positional arg that is itself a dtype: np.uint64, jnp.uint32,
+    object, np.dtype(...) — dtype is the documented second positional
+    arg of array/asarray (third of full)."""
+    if isinstance(node, ast.Call):
+        node = node.func
+    dn = _dotted(node)
+    if dn is None:
+        return False
+    leaf = dn.rsplit(".", 1)[-1].lower()
+    return any(
+        t in leaf for t in ("int", "float", "bool", "complex", "object", "dtype")
+    )
+
+
+@rule(
+    "limb-dtype",
+    "integer literal > 2**32 passed to an array constructor without an "
+    "explicit dtype= (platform-default int truncates limbs)",
+)
+def check_limb_dtype(tree: ast.Module, source: str, ctx: FileContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        dn = _dotted(node.func)
+        if dn is None or "." not in dn:
+            continue
+        root, leaf = dn.split(".", 1)[0], dn.rsplit(".", 1)[-1]
+        if root not in _ARRAY_ROOTS or leaf not in _ARRAY_CTORS:
+            continue
+        if any(kw.arg == "dtype" for kw in node.keywords):
+            continue
+        if any(_looks_like_dtype(a) for a in node.args[1:]):
+            continue
+        for arg in node.args:
+            for sub in ast.walk(arg):
+                if (
+                    isinstance(sub, ast.Constant)
+                    and isinstance(sub.value, int)
+                    and not isinstance(sub.value, bool)
+                    and abs(sub.value) >= _LIMB_LIMIT
+                ):
+                    findings.append(
+                        Finding(
+                            "limb-dtype", ctx.path, node.lineno, node.col_offset,
+                            f"integer literal {sub.value:#x} fed to {dn} "
+                            f"without dtype=: pass an explicit uint32/uint64 "
+                            f"(or object) dtype",
+                        )
+                    )
+                    break
+            else:
+                continue
+            break
+    return findings
+
+
+@rule(
+    "assert-security",
+    "'assert' in crypto/, validation/, msp/, idemix/ — asserts vanish "
+    "under python -O; use an explicit raise",
+)
+def check_assert_security(tree: ast.Module, source: str, ctx: FileContext) -> List[Finding]:
+    if not ctx.matches(ASSERT_SECURITY_DIRS):
+        return []
+    return [
+        Finding(
+            "assert-security", ctx.path, node.lineno, node.col_offset,
+            "assert is compiled out under python -O; validation/crypto "
+            "decisions must use an explicit raise or mask write",
+        )
+        for node in ast.walk(tree)
+        if isinstance(node, ast.Assert)
+    ]
+
+
+@rule(
+    "digest-compare",
+    "==/!= on digest/mac/checksum values; use hmac.compare_digest for "
+    "constant-time comparison",
+)
+def check_digest_compare(tree: ast.Module, source: str, ctx: FileContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Compare):
+            continue
+        if not any(isinstance(op, (ast.Eq, ast.NotEq)) for op in node.ops):
+            continue
+        sides = [node.left] + list(node.comparators)
+        # Comparing against None/sentinel literals is not a timing oracle.
+        if any(isinstance(s, ast.Constant) and s.value is None for s in sides):
+            continue
+        if any(_ident_tokens(s) & _SECRET_TOKENS for s in sides):
+            findings.append(
+                Finding(
+                    "digest-compare", ctx.path, node.lineno, node.col_offset,
+                    "digest/mac compared with ==: use hmac.compare_digest "
+                    "to avoid a timing side channel",
+                )
+            )
+    return findings
+
+
+@rule(
+    "shell-injection",
+    "subprocess with shell=True, os.system, or os.popen",
+)
+def check_shell_injection(tree: ast.Module, source: str, ctx: FileContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        dn = _dotted(node.func)
+        if dn in ("os.system", "os.popen"):
+            findings.append(
+                Finding(
+                    "shell-injection", ctx.path, node.lineno, node.col_offset,
+                    f"{dn} runs through the shell; use subprocess with an "
+                    f"argv list",
+                )
+            )
+            continue
+        is_subprocess = bool(dn) and (
+            dn.startswith("subprocess.") or dn in ("Popen", "run", "check_output", "check_call", "call")
+        )
+        if not is_subprocess:
+            continue
+        for kw in node.keywords:
+            if (
+                kw.arg == "shell"
+                and isinstance(kw.value, ast.Constant)
+                and kw.value.value is True
+            ):
+                findings.append(
+                    Finding(
+                        "shell-injection", ctx.path, node.lineno, node.col_offset,
+                        "shell=True interpolates arguments through the "
+                        "shell; pass an argv list instead",
+                    )
+                )
+    return findings
+
+
+@rule(
+    "fork-start",
+    "multiprocessing 'fork' start method; the repo invariant is "
+    "forkserver/spawn (fork with live gRPC/XLA threads wedges workers)",
+)
+def check_fork_start(tree: ast.Module, source: str, ctx: FileContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        dn = _dotted(node.func)
+        if dn is None:
+            continue
+        leaf = dn.rsplit(".", 1)[-1]
+        if leaf not in ("get_context", "set_start_method"):
+            continue
+        values = [a for a in node.args] + [kw.value for kw in node.keywords]
+        if any(
+            isinstance(v, ast.Constant) and v.value == "fork" for v in values
+        ):
+            findings.append(
+                Finding(
+                    "fork-start", ctx.path, node.lineno, node.col_offset,
+                    f"{leaf}('fork') is unsafe with live threads "
+                    f"(gRPC/XLA); use 'forkserver' or 'spawn'",
+                )
+            )
+    return findings
+
+
+def _module_scope_names(body: Sequence[ast.stmt]) -> Tuple[Set[str], bool]:
+    """Names bound at module scope (recursing into try/if/with).
+
+    Returns (names, has_star_import).
+    """
+    names: Set[str] = set()
+    star = False
+    for node in body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            names.add(node.name)
+        elif isinstance(node, ast.Import):
+            for alias in node.names:
+                names.add(alias.asname or alias.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom):
+            for alias in node.names:
+                if alias.name == "*":
+                    star = True
+                else:
+                    names.add(alias.asname or alias.name)
+        elif isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = (
+                node.targets
+                if isinstance(node, ast.Assign)
+                else [node.target]
+            )
+            for t in targets:
+                for sub in ast.walk(t):
+                    if isinstance(sub, ast.Name):
+                        names.add(sub.id)
+        elif isinstance(node, ast.Try):
+            for sub_body in (node.body, node.orelse, node.finalbody):
+                n, s = _module_scope_names(sub_body)
+                names |= n
+                star |= s
+            for h in node.handlers:
+                n, s = _module_scope_names(h.body)
+                names |= n
+                star |= s
+        elif isinstance(node, (ast.If, ast.For, ast.While)):
+            n, s = _module_scope_names(node.body)
+            names |= n
+            star |= s
+            n, s = _module_scope_names(node.orelse)
+            names |= n
+            star |= s
+        elif isinstance(node, ast.With):
+            n, s = _module_scope_names(node.body)
+            names |= n
+            star |= s
+    return names, star
+
+
+@rule(
+    "all-drift",
+    "__all__ exports a name the package __init__ never defines or imports",
+)
+def check_all_drift(tree: ast.Module, source: str, ctx: FileContext) -> List[Finding]:
+    if Path(ctx.path).name != "__init__.py":
+        return []
+    exported: List[Tuple[str, int, int]] = []
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == "__all__" for t in node.targets
+        ):
+            if isinstance(node.value, (ast.List, ast.Tuple)):
+                for elt in node.value.elts:
+                    if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                        exported.append((elt.value, elt.lineno, elt.col_offset))
+    if not exported:
+        return []
+    defined, star = _module_scope_names(tree.body)
+    if star:
+        return []  # can't resolve star imports statically
+    return [
+        Finding(
+            "all-drift", ctx.path, line, col,
+            f"__all__ exports {name!r} but the module never defines or "
+            f"imports it",
+        )
+        for name, line, col in exported
+        if name not in defined
+    ]
+
+
+# --------------------------------------------------------------------------
+# Driver
+# --------------------------------------------------------------------------
+
+
+def iter_py_files(paths: Sequence[str], excludes: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    for raw in paths:
+        p = Path(raw)
+        candidates = (
+            sorted(p.rglob("*.py")) if p.is_dir() else [p]
+        )
+        for f in candidates:
+            posix = f.as_posix()
+            if any(fnmatch.fnmatch(posix, pat) for pat in excludes):
+                continue
+            out.append(str(f))
+    return out
+
+
+def lint_source(
+    source: str,
+    path: str,
+    rule_ids: Optional[Iterable[str]] = None,
+) -> Tuple[List[Finding], int]:
+    """Lint one source blob.  Returns (findings, suppressed_count)."""
+    ctx = FileContext(path)
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as exc:
+        return (
+            [
+                Finding(
+                    "syntax-error", path, exc.lineno or 1, exc.offset or 0,
+                    f"cannot parse: {exc.msg}",
+                )
+            ],
+            0,
+        )
+    suppressions = parse_suppressions(source)
+    active = set(rule_ids) if rule_ids is not None else set(RULES)
+    findings: List[Finding] = []
+    suppressed = 0
+    for rid in sorted(active):
+        if rid not in RULES:
+            raise ValueError(f"unknown rule id {rid!r}")
+        _, fn = RULES[rid]
+        for finding in fn(tree, source, ctx):
+            disabled = suppressions.get(finding.line, set())
+            if finding.rule in disabled or "all" in disabled:
+                suppressed += 1
+            else:
+                findings.append(finding)
+    findings.sort(key=Finding.key)
+    return findings, suppressed
+
+
+def lint_paths(
+    paths: Sequence[str],
+    rule_ids: Optional[Iterable[str]] = None,
+    excludes: Sequence[str] = DEFAULT_EXCLUDES,
+) -> Tuple[List[Finding], Dict[str, int]]:
+    """Lint files/directories.  Returns (findings, stats)."""
+    files = iter_py_files(paths, excludes)
+    findings: List[Finding] = []
+    suppressed = 0
+    for f in files:
+        try:
+            source = Path(f).read_text(encoding="utf-8")
+        except (OSError, UnicodeDecodeError) as exc:
+            findings.append(Finding("io-error", f, 1, 0, str(exc)))
+            continue
+        file_findings, file_suppressed = lint_source(source, f, rule_ids)
+        findings.extend(file_findings)
+        suppressed += file_suppressed
+    findings.sort(key=Finding.key)
+    stats = {"files": len(files), "suppressed": suppressed}
+    return findings, stats
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="fablint",
+        description="AST-based invariant linter for fabric-tpu "
+        "(dependency-free; never imports the linted code)",
+    )
+    parser.add_argument("paths", nargs="*", help="files or directories to lint")
+    parser.add_argument("--json", action="store_true", help="machine-readable output")
+    parser.add_argument(
+        "--list-rules", action="store_true", help="print rule ids and exit"
+    )
+    parser.add_argument(
+        "--rules",
+        metavar="ID[,ID...]",
+        help="run only these rule ids (default: all)",
+    )
+    parser.add_argument(
+        "--exclude",
+        action="append",
+        default=[],
+        metavar="GLOB",
+        help="extra exclusion globs (added to the built-in generated-code list)",
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rid in sorted(RULES):
+            print(f"{rid:18s} {RULES[rid][0]}")
+        return 0
+
+    if not args.paths:
+        parser.print_usage(sys.stderr)
+        print("fablint: error: no paths given", file=sys.stderr)
+        return 2
+
+    missing = [p for p in args.paths if not Path(p).exists()]
+    if missing:
+        print(
+            f"fablint: error: no such file or directory: "
+            f"{', '.join(missing)}", file=sys.stderr,
+        )
+        return 2
+
+    rule_ids: Optional[List[str]] = None
+    if args.rules:
+        rule_ids = [r.strip() for r in args.rules.split(",") if r.strip()]
+        unknown = [r for r in rule_ids if r not in RULES]
+        if unknown:
+            print(f"fablint: error: unknown rule(s): {', '.join(unknown)}", file=sys.stderr)
+            return 2
+
+    excludes = tuple(DEFAULT_EXCLUDES) + tuple(args.exclude)
+    findings, stats = lint_paths(args.paths, rule_ids, excludes)
+
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "version": __version__,
+                    "files": stats["files"],
+                    "suppressed": stats["suppressed"],
+                    "findings": [f.to_dict() for f in findings],
+                },
+                indent=2,
+            )
+        )
+    else:
+        for f in findings:
+            print(f"{f.path}:{f.line}:{f.col}: {f.rule}: {f.message}")
+        print(
+            f"fablint: {len(findings)} finding(s) in {stats['files']} file(s)"
+            f" ({stats['suppressed']} suppressed)"
+        )
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -19,6 +19,10 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from fabric_tpu.common.flogging import must_get_logger
+
+logger = must_get_logger("native")
+
 _REPO = os.path.dirname(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 )
@@ -43,7 +47,11 @@ def _load() -> Optional[ctypes.CDLL]:
                     timeout=120,
                     check=True,
                 )
-            except Exception:
+            except Exception as exc:
+                logger.warning(
+                    "native library build failed (%s); using the Python "
+                    "parsers", exc,
+                )
                 return None
         try:
             lib = ctypes.CDLL(_SO_PATH)
@@ -66,8 +74,11 @@ def _load() -> Optional[ctypes.CDLL]:
                     check=True,
                 )
                 lib = ctypes.CDLL(_SO_PATH)
-            except Exception:
-                pass
+            except Exception as exc:
+                logger.warning(
+                    "stale native library rebuild failed (%s); block "
+                    "parsing falls back to the Python parser", exc,
+                )
         u8p = ctypes.POINTER(ctypes.c_uint8)
         u64p = ctypes.POINTER(ctypes.c_uint64)
         lib.fn_batch_sha256.argtypes = [u8p, u64p, u64p, ctypes.c_int64, u8p]

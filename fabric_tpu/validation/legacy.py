@@ -58,7 +58,7 @@ class LSCCRegistry:
         data = peer_pb2.ChaincodeData()
         try:
             data.ParseFromString(raw)
-        except Exception:  # noqa: BLE001 - malformed record = undefined
+        except Exception:  # fablint: disable=broad-except  # malformed record = chaincode undefined (explicit None)
             return None
         try:
             policy = unmarshal_envelope(data.policy)
@@ -187,7 +187,7 @@ def validate_collection_config_package(
     pkg = collection_pb2.CollectionConfigPackage()
     try:
         pkg.ParseFromString(raw)
-    except Exception:  # noqa: BLE001 - malformed proto
+    except Exception:  # fablint: disable=broad-except  # malformed proto = explicit error string (tx invalid)
         return "invalid collection configuration supplied"
     seen = set()
     for cfg in pkg.config:
@@ -230,7 +230,7 @@ def validate_collection_config_package(
         old = collection_pb2.CollectionConfigPackage()
         try:
             old.ParseFromString(committed_raw)
-        except Exception:  # noqa: BLE001 - corrupt committed record
+        except Exception:  # fablint: disable=broad-except  # corrupt committed record = explicit error string (tx invalid)
             return "committed collection configuration is unreadable"
         new_by_name = {
             c.static_collection_config.name: c.SerializeToString()

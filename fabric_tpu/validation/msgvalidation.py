@@ -24,6 +24,7 @@ UNSUPPORTED_TX_PAYLOAD.
 from __future__ import annotations
 
 import hashlib
+import hmac
 from typing import List, Optional, Tuple
 
 from fabric_tpu.protos import common_pb2, kv_rwset_pb2, peer_pb2, protoutil, rwset_pb2
@@ -354,7 +355,7 @@ def _parse_endorser_tx(out: ParsedTx, payload: common_pb2.Payload) -> Optional[T
     h.update(payload.header.channel_header)
     h.update(action.header)
     h.update(cap.chaincode_proposal_payload)
-    if h.digest() != prp.proposal_hash:
+    if not hmac.compare_digest(h.digest(), prp.proposal_hash):
         return TxValidationCode.INVALID_ENDORSER_TRANSACTION
 
     # --- builtin v20 artifact extraction (runs later in the reference,

@@ -92,7 +92,10 @@ def principal_for(ast_principal) -> msp_principal_pb2.MSPPrincipal:
     from fabric_tpu.policy.ast import MSPRole as AstRole
     from fabric_tpu.policy.ast import Role
 
-    assert isinstance(ast_principal, AstRole)
+    if not isinstance(ast_principal, AstRole):
+        raise TypeError(
+            f"unsupported policy principal {type(ast_principal).__name__!r}"
+        )
     role = msp_principal_pb2.MSPRole()
     role.msp_identifier = ast_principal.msp_id
     role.role = {

@@ -11,7 +11,11 @@ cd "$(dirname "$0")/.."
 log="$(mktemp)"
 trap 'rm -f "$log"' EXIT
 
+# -o norecursedirs REPLACES pytest's defaults, so restate them (.* build
+# dist venv node_modules *.egg ...) and add __pycache__ + native/: a
+# stray artifact .py there must not poison collection
 JAX_PLATFORMS=cpu timeout -k 10 240 python -m pytest tests/ --collect-only -q \
+    -o 'norecursedirs=*.egg .* _darcs build CVS dist node_modules venv {arch} __pycache__ native' \
     -p no:cacheprovider -p no:xdist -p no:randomly >"$log" 2>&1
 rc=$?
 

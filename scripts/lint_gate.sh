@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# fablint gate: AST-walk fabric_tpu/ and fail on any rule violation.
+#
+# Dependency-free and import-free: fablint parses source with ast, it
+# never imports the linted modules, so this gate passes/fails identically
+# in minimal environments (no cryptography, no jax).  Runs in ~3s.
+set -uo pipefail
+
+cd "$(dirname "$0")/.."
+
+timeout -k 5 60 python -m fabric_tpu.tools.fablint fabric_tpu/
+rc=$?
+
+if [ "$rc" -ne 0 ]; then
+    echo "lint_gate: FAIL (fablint rc=$rc)" >&2
+    exit 1
+fi
+echo "lint_gate: OK"
