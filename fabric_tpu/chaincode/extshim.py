@@ -268,6 +268,11 @@ class CcaasServer:
         self.chaincode = chaincode
         self.chaincode_id = chaincode_id
         self._sessions: List[_Session] = []
+        # appended by gRPC handler threads, pruned by per-session read
+        # threads, iterated by stop() (fabdep unguarded-shared-write):
+        # an unlocked remove during stop()'s iteration silently skips a
+        # session, leaving its reader thread alive after shutdown
+        self._sessions_lock = threading.Lock()
         self.server = GRPCServer(listen_address)
         self.server.register(
             "protos.Chaincode",
@@ -283,7 +288,8 @@ class CcaasServer:
 
     def _connect(self, request_iterator, context):
         session = _Session(self.chaincode, None, self.chaincode_id)
-        self._sessions.append(session)
+        with self._sessions_lock:
+            self._sessions.append(session)
 
         def read_loop():
             try:
@@ -297,10 +303,11 @@ class CcaasServer:
                 # finished sessions leave the registry (a reconnecting
                 # peer must not accumulate dead queues for the process
                 # lifetime)
-                try:
-                    self._sessions.remove(session)
-                except ValueError:
-                    pass
+                with self._sessions_lock:
+                    try:
+                        self._sessions.remove(session)
+                    except ValueError:
+                        pass
 
         threading.Thread(
             target=read_loop, name=f"ccaas-read-{self.chaincode_id}", daemon=True
@@ -312,7 +319,9 @@ class CcaasServer:
         return self.server.start()
 
     def stop(self) -> None:
-        for s in self._sessions:
+        with self._sessions_lock:
+            sessions = list(self._sessions)
+        for s in sessions:
             s.stop()
         self.server.stop()
 

@@ -18,18 +18,18 @@ from fabric_tpu.channelconfig.encoder import (
     new_config,
 )
 
+# ConfigError/bundle_from_envelope/new_channel_group are reachable as
+# module attributes but no longer claimed in __all__: nothing outside
+# this package references them (fabdep dead-export)
 __all__ = [
     "ApplicationProfile",
     "Bundle",
-    "ConfigError",
     "ConfigTxError",
     "OrdererProfile",
     "OrganizationProfile",
     "Profile",
     "Validator",
-    "bundle_from_envelope",
     "bundle_from_genesis_block",
     "genesis_block",
-    "new_channel_group",
     "new_config",
 ]

@@ -1,0 +1,189 @@
+"""Pure-Python NIST P-256 (secp256r1) arithmetic and ECDSA.
+
+This module is the *oracle*: a small, dependency-free, big-int implementation
+of exactly the verification semantics the reference software crypto provider
+has (reference: bccsp/sw/ecdsa.go:41-57 -> Go crypto/ecdsa + the low-S rule in
+bccsp/utils/ecdsa.go). The batched TPU kernel in fabric_tpu.ops.p256_kernel is
+differentially tested against this module, and this module in turn is tested
+against the `cryptography` package.
+
+It is intentionally written for clarity, not speed, and is also used as the
+host-side fallback provider on machines without an accelerator.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import secrets
+from typing import NamedTuple, Optional, Tuple
+
+# Curve parameters (FIPS 186-4 / SEC2 secp256r1). Public constants.
+P = 0xFFFFFFFF00000001000000000000000000000000FFFFFFFFFFFFFFFFFFFFFFFF
+A = P - 3
+B = 0x5AC635D8AA3A93E7B3EBBD55769886BC651D06B0CC53B0F63BCE3C3E27D2604B
+GX = 0x6B17D1F2E12C4247F8BCE6E563A440F277037D812DEB33A0F4A13945D898C296
+GY = 0x4FE342E2FE1A7F9B8EE7EB4A7C0F9E162BCE33576B315ECECBB6406837BF51F5
+N = 0xFFFFFFFF00000000FFFFFFFFFFFFFFFFBCE6FAADA7179E84F3B9CAC2FC632551
+# The reference accepts only low-S signatures: s <= N >> 1
+# (bccsp/utils/ecdsa.go curveHalfOrders / IsLowS).
+HALF_N = N >> 1
+
+# Affine points are (x, y) tuples; None is the point at infinity.
+AffinePoint = Optional[Tuple[int, int]]
+
+
+def is_on_curve(pt: AffinePoint) -> bool:
+    if pt is None:
+        return True
+    x, y = pt
+    if not (0 <= x < P and 0 <= y < P):
+        return False
+    return (y * y - (x * x * x + A * x + B)) % P == 0
+
+
+def point_neg(pt: AffinePoint) -> AffinePoint:
+    if pt is None:
+        return None
+    x, y = pt
+    return (x, (-y) % P)
+
+
+def point_add(p1: AffinePoint, p2: AffinePoint) -> AffinePoint:
+    """Affine group law (slow; oracle only)."""
+    if p1 is None:
+        return p2
+    if p2 is None:
+        return p1
+    x1, y1 = p1
+    x2, y2 = p2
+    if x1 == x2:
+        if (y1 + y2) % P == 0:
+            return None  # p1 == -p2
+        # doubling
+        lam = (3 * x1 * x1 + A) * pow(2 * y1, P - 2, P) % P
+    else:
+        lam = (y2 - y1) * pow(x2 - x1, P - 2, P) % P
+    x3 = (lam * lam - x1 - x2) % P
+    y3 = (lam * (x1 - x3) - y1) % P
+    return (x3, y3)
+
+
+def scalar_mult(k: int, pt: AffinePoint) -> AffinePoint:
+    """k * pt by double-and-add (oracle only)."""
+    k %= N
+    result: AffinePoint = None
+    addend = pt
+    while k:
+        if k & 1:
+            result = point_add(result, addend)
+        addend = point_add(addend, addend)
+        k >>= 1
+    return result
+
+
+GENERATOR: Tuple[int, int] = (GX, GY)
+
+
+def hash_to_int(digest: bytes) -> int:
+    """Leftmost-bits digest truncation, matching Go crypto/ecdsa hashToInt.
+
+    For P-256 orderBits = 256: take the leftmost 32 bytes, then shift right
+    by any excess bits (none when len(digest) is a whole number of bytes
+    covering >= 256 bits).
+    """
+    order_bits = 256
+    order_bytes = (order_bits + 7) // 8
+    if len(digest) > order_bytes:
+        digest = digest[:order_bytes]
+    e = int.from_bytes(digest, "big")
+    excess = len(digest) * 8 - order_bits
+    if excess > 0:
+        e >>= excess
+    return e
+
+
+def is_low_s(s: int) -> bool:
+    """Reference low-S rule: s <= N>>1 (bccsp/utils/ecdsa.go IsLowS)."""
+    return s <= HALF_N
+
+
+def verify_digest(pub: Tuple[int, int], digest: bytes, r: int, s: int) -> bool:
+    """Raw ECDSA verification (Go crypto/ecdsa.Verify semantics).
+
+    Does NOT apply the low-S rule; callers replicating the reference
+    verifyECDSA (bccsp/sw/ecdsa.go:41) must check is_low_s first.
+    """
+    if not (1 <= r < N and 1 <= s < N):
+        return False
+    if not is_on_curve(pub) or pub is None:
+        return False
+    e = hash_to_int(digest)
+    w = pow(s, N - 2, N)
+    u1 = (e * w) % N
+    u2 = (r * w) % N
+    pt = point_add(scalar_mult(u1, GENERATOR), scalar_mult(u2, pub))
+    if pt is None:
+        return False
+    return pt[0] % N == r
+
+
+def sign_digest(
+    priv: int, digest: bytes, k: Optional[int] = None, low_s: bool = True
+) -> Tuple[int, int]:
+    """ECDSA signing (for vector generation / the SW provider).
+
+    Matches the reference signer, which normalizes to low-S
+    (bccsp/sw/ecdsa.go signECDSA -> utils.ToLowS).
+    """
+    e = hash_to_int(digest)
+    while True:
+        kk = k if k is not None else (secrets.randbelow(N - 1) + 1)
+        pt = scalar_mult(kk, GENERATOR)
+        if pt is None:
+            raise ArithmeticError("k*G is infinity for k in [1, N-1]")
+        r = pt[0] % N
+        if r == 0:
+            if k is not None:
+                raise ValueError("bad fixed nonce: r == 0")
+            continue
+        s = (pow(kk, N - 2, N) * (e + r * priv)) % N
+        if s == 0:
+            if k is not None:
+                raise ValueError("bad fixed nonce: s == 0")
+            continue
+        if low_s and not is_low_s(s):
+            s = N - s
+        return r, s
+
+
+class KeyPair(NamedTuple):
+    priv: int
+    pub: Tuple[int, int]
+
+
+def generate_keypair() -> KeyPair:
+    d = secrets.randbelow(N - 1) + 1
+    q = scalar_mult(d, GENERATOR)
+    if q is None:
+        raise ArithmeticError("d*G is infinity for d in [1, N-1]")
+    return KeyPair(d, q)
+
+
+def pubkey_from_bytes(data: bytes) -> Tuple[int, int]:
+    """Parse an uncompressed SEC1 point (0x04 || X || Y) and validate it."""
+    if len(data) != 65 or data[0] != 0x04:
+        raise ValueError("expected 65-byte uncompressed SEC1 point")
+    x = int.from_bytes(data[1:33], "big")
+    y = int.from_bytes(data[33:65], "big")
+    pt = (x, y)
+    if not is_on_curve(pt):
+        raise ValueError("point not on curve")
+    return pt
+
+
+def pubkey_to_bytes(pub: Tuple[int, int]) -> bytes:
+    return b"\x04" + pub[0].to_bytes(32, "big") + pub[1].to_bytes(32, "big")
+
+
+def sha256(data: bytes) -> bytes:
+    return hashlib.sha256(data).digest()

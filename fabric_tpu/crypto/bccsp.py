@@ -23,13 +23,15 @@ Providers:
 from __future__ import annotations
 
 import hashlib
+import threading
 from dataclasses import dataclass
 from typing import List, Optional, Sequence, Tuple
 
 import os
 
 from fabric_tpu.common.flogging import must_get_logger
-from fabric_tpu.crypto import der, hostec, p256
+from fabric_tpu.common import der, p256
+from fabric_tpu.crypto import hostec
 
 logger = must_get_logger("bccsp")
 
@@ -308,6 +310,11 @@ class PurePythonProvider(SoftwareProvider):
 
 
 _default: Optional[Provider] = None
+# two channels starting concurrently (one Channel.__init__ per deliver
+# thread) must not both construct a provider: a TPUProvider holds the
+# device executor, and the loser's instance would keep compiling kernels
+# nothing ever reads
+_default_lock = threading.Lock()
 
 
 def default_provider() -> Provider:
@@ -315,6 +322,11 @@ def default_provider() -> Provider:
     actual accelerator device is present, else the software provider.
     (A CPU-only jax install must NOT route single verifies through the
     XLA kernel — its compile cost alone is minutes.)"""
+    with _default_lock:
+        return _default_provider_locked()
+
+
+def _default_provider_locked() -> Provider:
     global _default
     if _default is None:
         try:

@@ -35,7 +35,7 @@ from cryptography.hazmat.primitives.asymmetric.utils import (  # fablint: disabl
     encode_dss_signature,
 )
 
-from fabric_tpu.crypto import p256
+from fabric_tpu.common import p256
 
 _CURVE = ec.SECP256R1()
 _PREHASHED_SHA256 = ec.ECDSA(Prehashed(hashes.SHA256()))

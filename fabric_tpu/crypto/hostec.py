@@ -55,8 +55,8 @@ import threading
 from typing import Callable, List, Optional, Sequence, Tuple
 
 from fabric_tpu.common.flogging import must_get_logger
-from fabric_tpu.crypto import p256
-from fabric_tpu.crypto.p256 import A, B, GX, GY, HALF_N, N, P, hash_to_int
+from fabric_tpu.common import p256
+from fabric_tpu.common.p256 import A, B, GX, GY, HALF_N, N, P, hash_to_int
 
 logger = must_get_logger("hostec")
 
@@ -192,6 +192,11 @@ def _batch_inv(vals: Sequence[int], m: int) -> List[int]:
 
 _G_HORNER: Optional[Tuple[List[int], List[int]]] = None  # d*G, d in 1..255
 _G_COMB: Optional[List[List[Tuple[int, int]]]] = None  # [w][d-1] = d*16^w*G
+# lazy-build guard: the verify path runs on the TPU dispatch thread, the
+# commit thread, AND inline fallbacks concurrently — an unlocked first
+# build is merely idempotent-but-wasted work (hundreds of field
+# inversions per extra builder), but fabdep rightly flags the write
+_TABLE_LOCK = threading.Lock()
 
 
 def _normalize_jacobians(
@@ -209,12 +214,14 @@ def _g_horner_table() -> Tuple[List[int], List[int]]:
     """Affine d*G for d in 1..255 (index d-1), one batch inversion total."""
     global _G_HORNER
     if _G_HORNER is None:
-        jac = [(GX, GY, 1)]
-        for _ in range(254):
-            X, Y, Z = jac[-1]
-            jac.append(_madd1(X, Y, Z, GX, GY))
-        aff = _normalize_jacobians(jac)
-        _G_HORNER = ([x for x, _ in aff], [y for _, y in aff])
+        with _TABLE_LOCK:
+            if _G_HORNER is None:
+                jac = [(GX, GY, 1)]
+                for _ in range(254):
+                    X, Y, Z = jac[-1]
+                    jac.append(_madd1(X, Y, Z, GX, GY))
+                aff = _normalize_jacobians(jac)
+                _G_HORNER = ([x for x, _ in aff], [y for _, y in aff])
     return _G_HORNER
 
 
@@ -223,22 +230,28 @@ def _g_comb_table() -> List[List[Tuple[int, int]]]:
     for signing/keygen: a base mult is 64 mixed adds, zero doublings."""
     global _G_COMB
     if _G_COMB is None:
-        rows_jac: List[List[Tuple[int, int, int]]] = []
-        base = (GX, GY, 1)
-        for _w in range(NUM_WINDOWS):
-            bz = pow(base[2], P - 2, P)
-            bz2 = bz * bz % P
-            bx, by = base[0] * bz2 % P, base[1] * bz2 * bz % P
-            row = [(bx, by, 1)]
-            for _d in range(14):
-                X, Y, Z = row[-1]
-                row.append(_madd1(X, Y, Z, bx, by))
-            rows_jac.append(row)
-            base = (bx, by, 1)
-            for _ in range(WINDOW_BITS):
-                base = _dbl1(*base)
-        flat = _normalize_jacobians([p for row in rows_jac for p in row])
-        _G_COMB = [flat[w * 15 : (w + 1) * 15] for w in range(NUM_WINDOWS)]
+        with _TABLE_LOCK:
+            if _G_COMB is None:
+                rows_jac: List[List[Tuple[int, int, int]]] = []
+                base = (GX, GY, 1)
+                for _w in range(NUM_WINDOWS):
+                    bz = pow(base[2], P - 2, P)
+                    bz2 = bz * bz % P
+                    bx, by = base[0] * bz2 % P, base[1] * bz2 * bz % P
+                    row = [(bx, by, 1)]
+                    for _d in range(14):
+                        X, Y, Z = row[-1]
+                        row.append(_madd1(X, Y, Z, bx, by))
+                    rows_jac.append(row)
+                    base = (bx, by, 1)
+                    for _ in range(WINDOW_BITS):
+                        base = _dbl1(*base)
+                flat = _normalize_jacobians(
+                    [p for row in rows_jac for p in row]
+                )
+                _G_COMB = [
+                    flat[w * 15 : (w + 1) * 15] for w in range(NUM_WINDOWS)
+                ]
     return _G_COMB
 
 

@@ -25,7 +25,7 @@ import hashlib
 import threading
 from typing import Dict, List, Optional, Sequence
 
-from fabric_tpu.crypto import der, p256
+from fabric_tpu.common import der, p256
 from fabric_tpu.crypto.bccsp import (
     ECDSAPublicKey,
     Provider,

@@ -22,7 +22,7 @@ from typing import Dict, List, Sequence, Tuple
 import numpy as np
 
 from fabric_tpu.common.flogging import must_get_logger
-from fabric_tpu.crypto import p256
+from fabric_tpu.common import p256
 from fabric_tpu.crypto.bccsp import (
     ECDSAPublicKey,
     Provider,

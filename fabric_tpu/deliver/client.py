@@ -99,12 +99,29 @@ class BlockDeliverer:
         self.stats = DelivererStats()
         self._stop = threading.Event()
         self._endpoint_idx = 0
+        # the pull thread (failover bump in run) and the config-update
+        # path (update_endpoints, called from the commit thread) both
+        # write _endpoints/_endpoint_idx (fabdep unguarded-shared-write):
+        # unsynchronized, a refresh can land between the list swap and
+        # the index reset and the next pull indexes the OLD list
+        self._ep_lock = threading.Lock()
 
     def update_endpoints(self, endpoints: Sequence[Callable]) -> None:
         """Channel-config change handed us fresh orderer endpoints
         (reference deliveryclient endpoint refresh)."""
-        self._endpoints = list(endpoints)
-        self._endpoint_idx = 0
+        with self._ep_lock:
+            self._endpoints = list(endpoints)
+            self._endpoint_idx = 0
+
+    def _current_endpoint(self) -> Optional[Callable]:
+        with self._ep_lock:
+            if not self._endpoints:
+                return None
+            return self._endpoints[self._endpoint_idx % len(self._endpoints)]
+
+    def _failover(self) -> None:
+        with self._ep_lock:
+            self._endpoint_idx += 1
 
     def stop(self) -> None:
         self._stop.set()
@@ -116,9 +133,9 @@ class BlockDeliverer:
         failures = 0
         total_sleep = 0.0
         while not self._stop.is_set():
-            if not self._endpoints:
+            endpoint = self._current_endpoint()
+            if endpoint is None:
                 return received
-            endpoint = self._endpoints[self._endpoint_idx % len(self._endpoints)]
             self.stats.connect_attempts += 1
             try:
                 env = seek_envelope(
@@ -153,7 +170,7 @@ class BlockDeliverer:
             except (ConnectionError, OSError, StopIteration) as e:
                 self.stats.failures += 1
                 failures += 1
-                self._endpoint_idx += 1  # failover
+                self._failover()
                 delay = min(
                     BACKOFF_BASE**failures * 0.05, self._max_retry_delay
                 )

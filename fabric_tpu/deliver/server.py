@@ -22,7 +22,7 @@ except ImportError:  # pragma: no cover - exercised in minimal envs
 
 from fabric_tpu.policy.manager import PolicyError, SignedData
 from fabric_tpu.protos import ab_pb2, common_pb2, identities_pb2, protoutil
-from fabric_tpu.validation.txflags import TxValidationCode, ValidationFlags
+from fabric_tpu.common.txflags import TxValidationCode, ValidationFlags
 
 
 class DeliverError(Exception):

@@ -8,8 +8,9 @@ from fabric_tpu.endorser.txbuilder import (
     endorse_proposal,
 )
 
+# ProposalBundle stays importable but is no longer claimed in __all__:
+# nothing outside this package references it (fabdep dead-export)
 __all__ = [
-    "ProposalBundle",
     "create_proposal",
     "create_signed_tx",
     "endorse_proposal",

@@ -440,8 +440,14 @@ class GossipNode:
                     bytes(msg.conn.tls_cert_hash),
                 )
             )
-        self._conn_msg_cache = msg
-        return msg
+        # _send worker threads race to build the first handshake (fabdep
+        # unguarded-shared-write): sign outside the lock (ECDSA is the
+        # expensive part and the inputs are static), publish under it so
+        # exactly one message wins and every stream sends the same bytes
+        with self._lock:
+            if self._conn_msg_cache is None:
+                self._conn_msg_cache = msg
+            return self._conn_msg_cache
 
     def _send(
         self,

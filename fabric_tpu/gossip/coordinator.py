@@ -11,6 +11,7 @@ batch per block.
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
 
@@ -31,20 +32,30 @@ class TransientStore:
 
     def __init__(self):
         self._by_txid: Dict[str, Dict[Tuple[str, str], bytes]] = {}
+        # persist() runs on endorsement (gRPC handler) threads while the
+        # commit thread purges and the pvt-assembly path reads (fabdep
+        # unguarded-shared-write): the nested per-txid dict makes the
+        # setdefault-then-setitem sequence non-atomic even under the GIL
+        self._lock = threading.Lock()
 
     def persist(
         self, txid: str, namespace: str, collection: str, pvt_writeset: bytes
     ) -> None:
-        self._by_txid.setdefault(txid, {})[(namespace, collection)] = pvt_writeset
+        with self._lock:
+            self._by_txid.setdefault(txid, {})[
+                (namespace, collection)
+            ] = pvt_writeset
 
     def get(
         self, txid: str, namespace: str, collection: str
     ) -> Optional[bytes]:
-        return self._by_txid.get(txid, {}).get((namespace, collection))
+        with self._lock:
+            return self._by_txid.get(txid, {}).get((namespace, collection))
 
     def purge_by_txids(self, txids: Sequence[str]) -> None:
-        for t in txids:
-            self._by_txid.pop(t, None)
+        with self._lock:
+            for t in txids:
+                self._by_txid.pop(t, None)
 
     def purge_below_height(self, height: int) -> None:
         # height-based purge hook (reference PurgeBelowHeight); txid map

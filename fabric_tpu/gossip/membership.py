@@ -53,18 +53,23 @@ class Membership:
             else max(alive_expiration_ticks // 2, 1)
         )
         self._suspected: Set[str] = set()
-        # seq + suspicion state see TWO writers (the ticker thread and
-        # gRPC handler threads answering probes / refuting suspicion);
-        # unsynchronized `_seq += 1` can duplicate a sequence number,
-        # which a receiver dedups as stale — losing the very refutation
-        # the probe was for
+        # the WHOLE view (_seq, _now, _alive, _dead, _suspected) sees TWO
+        # writers: the ticker thread (tick/_expire) and gRPC handler
+        # threads (handle_alive answering probes / refuting suspicion).
+        # An unsynchronized `_seq += 1` can duplicate a sequence number
+        # (a receiver dedups the refutation as stale), and an _expire
+        # sweep racing handle_alive can move a peer to _dead while a
+        # fresh alive re-inserts it — losing the refutation entirely.
+        # fabdep unguarded-shared-write confirmed the _alive/_dead/_now
+        # writes; every mutation now holds _lock.
         self._lock = threading.Lock()
 
     # -- outgoing -----------------------------------------------------------
     def tick(self) -> dict:
         """Advance time; returns this node's alive message to broadcast
         (reference periodicalSendAlive)."""
-        self._now += 1
+        with self._lock:
+            self._now += 1
         self._expire()
         return self.bump_seq()
 
@@ -91,65 +96,67 @@ class Membership:
         if pid == self.self_id:
             return False
         seq = msg["seq"]
-        known = self._alive.get(pid) or self._dead.get(pid)
-        if known is not None and seq <= known.seq:
-            return False
-        state = PeerState(
-            endpoint=msg.get("endpoint", ""),
-            seq=seq,
-            last_seen_tick=self._now,
-            metadata=msg.get("metadata", b""),
-        )
-        self._dead.pop(pid, None)
         with self._lock:
+            known = self._alive.get(pid) or self._dead.get(pid)
+            if known is not None and seq <= known.seq:
+                return False
+            state = PeerState(
+                endpoint=msg.get("endpoint", ""),
+                seq=seq,
+                last_seen_tick=self._now,
+                metadata=msg.get("metadata", b""),
+            )
+            self._dead.pop(pid, None)
             self._suspected.discard(pid)  # fresh alive refutes suspicion
-        self._alive[pid] = state
+            self._alive[pid] = state
         return True
 
     def _expire(self) -> None:
-        for pid in list(self._alive):
-            st = self._alive[pid]
-            silent = self._now - st.last_seen_tick
-            if silent > self.expiration:
-                with self._lock:
+        with self._lock:
+            for pid in list(self._alive):
+                st = self._alive[pid]
+                silent = self._now - st.last_seen_tick
+                if silent > self.expiration:
                     self._suspected.discard(pid)
-                self._dead[pid] = self._alive.pop(pid)
-            elif silent > self.suspect_ticks:
-                with self._lock:
+                    self._dead[pid] = self._alive.pop(pid)
+                elif silent > self.suspect_ticks:
                     self._suspected.add(pid)
 
     def newly_suspect(self) -> List[str]:
         """Suspects not yet probed this suspicion episode — callers probe
         each ONCE per episode (a refuting alive clears the episode, so a
         peer that goes silent again gets probed again)."""
-        with self._lock:
-            suspects = sorted(self._suspected)
         out = []
-        for pid in suspects:
-            st = self._alive.get(pid)
-            if st is not None and not st.probed:
-                st.probed = True
-                out.append(pid)
+        with self._lock:
+            for pid in sorted(self._suspected):
+                st = self._alive.get(pid)
+                if st is not None and not st.probed:
+                    st.probed = True
+                    out.append(pid)
         return out
 
     # -- views --------------------------------------------------------------
     def alive_peers(self) -> List[str]:
-        return sorted(self._alive)
+        with self._lock:
+            return sorted(self._alive)
 
     def suspect_peers(self) -> List[str]:
         with self._lock:
             return sorted(self._suspected)
 
     def dead_peers(self) -> List[str]:
-        return sorted(self._dead)
+        with self._lock:
+            return sorted(self._dead)
 
     def endpoint_of(self, pid: str) -> Optional[str]:
-        st = self._alive.get(pid)
-        return st.endpoint if st else None
+        with self._lock:
+            st = self._alive.get(pid)
+            return st.endpoint if st else None
 
     def metadata_of(self, pid: str) -> Optional[bytes]:
-        st = self._alive.get(pid)
-        return st.metadata if st else None
+        with self._lock:
+            st = self._alive.get(pid)
+            return st.metadata if st else None
 
 
 class LeaderElection:
@@ -162,6 +169,15 @@ class LeaderElection:
         self.membership = membership
         self.on_leadership_change: Optional[Callable[[bool], None]] = None
         self._is_leader = False
+        # evaluate() runs from the ticker thread AND from gRPC handler
+        # threads on membership change; an unguarded test-and-set can
+        # fire the transition callback twice (fabdep finding).  The
+        # reentrant delivery lock spans compute + callback so two racing
+        # transitions cannot deliver their callbacks in inverted order
+        # (last callback must match final _is_leader); reentrant because
+        # a callback that re-enters gossip may evaluate again.
+        self._lock = threading.Lock()
+        self._cb_lock = threading.RLock()
 
     @property
     def is_leader(self) -> bool:
@@ -176,9 +192,12 @@ class LeaderElection:
         """Recompute leadership after membership changes; fires the
         callback on transitions (reference leaderElection beLeader /
         stopBeingLeader)."""
-        now_leader = self.leader == self.membership.self_id
-        if now_leader != self._is_leader:
-            self._is_leader = now_leader
-            if self.on_leadership_change is not None:
+        with self._cb_lock:
+            now_leader = self.leader == self.membership.self_id
+            with self._lock:
+                changed = now_leader != self._is_leader
+                if changed:
+                    self._is_leader = now_leader
+            if changed and self.on_leadership_change is not None:
                 self.on_leadership_change(now_leader)
         return now_leader

@@ -35,7 +35,8 @@ from fabric_tpu.idemix.scheme import (
 __all__ = [
     "ALG_NO_REVOCATION",
     "IdemixError",
-    "ecp2_from_proto",
+    # ecp2_from_proto dropped from __all__: intra-package only
+    # (fabdep dead-export); still importable as a module attribute
     "ecp2_to_proto",
     "ecp_from_proto",
     "ecp_to_proto",

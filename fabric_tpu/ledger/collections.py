@@ -43,7 +43,7 @@ class CollectionAccess:
         if self._policy_env is None:
             return False
         from fabric_tpu.policy.evaluator import evaluate_host
-        from fabric_tpu.validation.validator import principal_for
+        from fabric_tpu.policy.proto_convert import principal_for
 
         import numpy as np
 

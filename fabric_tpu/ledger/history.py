@@ -30,7 +30,7 @@ def get_history_for_key(ledger, ns: str, key: str) -> List[KeyModification]:
     """Newest-first history of committed writes to (ns, key), resolved
     from the block store (history/query_executer.go getKeyModification)."""
     from fabric_tpu.protos import protoutil
-    from fabric_tpu.validation.msgvalidation import parse_transaction
+    from fabric_tpu.ledger.txparse import parse_transaction
 
     out: List[KeyModification] = []
     for version in reversed(ledger.get_history_for_key(ns, key)):

@@ -34,8 +34,8 @@ from fabric_tpu.ledger.statedb import (
     VersionedDB,
 )
 from fabric_tpu.protos import common_pb2, protoutil, txmgr_updates_pb2
-from fabric_tpu.validation.msgvalidation import parse_transaction
-from fabric_tpu.validation.txflags import TxValidationCode, ValidationFlags
+from fabric_tpu.ledger.txparse import parse_transaction
+from fabric_tpu.common.txflags import TxValidationCode, ValidationFlags
 
 logger = flogging.must_get_logger("kvledger")
 

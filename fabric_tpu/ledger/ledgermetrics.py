@@ -94,7 +94,7 @@ class CommitterMetrics:
         self.statedb_commit_time.with_labels("channel", channel_id).observe(
             state_seconds
         )
-        from fabric_tpu.validation.txflags import TxValidationCode
+        from fabric_tpu.common.txflags import TxValidationCode
 
         for code in flags.asarray():
             self.transaction_count.with_labels(

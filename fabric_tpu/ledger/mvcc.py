@@ -60,7 +60,7 @@ from fabric_tpu.ledger.statedb import (
     VersionedDB,
     VersionedValue,
 )
-from fabric_tpu.validation.txflags import TxValidationCode
+from fabric_tpu.common.txflags import TxValidationCode
 
 
 def _combined_range_iter(

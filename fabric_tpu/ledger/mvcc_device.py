@@ -60,7 +60,7 @@ from fabric_tpu.ledger.statedb import (
     VersionedDB,
 )
 from fabric_tpu.common.flogging import must_get_logger
-from fabric_tpu.validation.txflags import TxValidationCode
+from fabric_tpu.common.txflags import TxValidationCode
 
 logger = must_get_logger("mvcc_device")
 

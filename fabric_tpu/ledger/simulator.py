@@ -157,7 +157,7 @@ class TxSimulator:
         tup = (
             tuple(sorted(entries.items())) if entries is not None else None
         )
-        self._metadata_writes.setdefault(ns, {})[key] = rw.KVMetadataWrite(
+        self._metadata_writes.setdefault(ns, {})[key] = rw.KVMetadataWrite(  # fabdep: disable=unguarded-shared-write  # TxSimulator is tx-scoped: the chaincode shim drives it from exactly one thread at a time
             key, tup
         )
 
@@ -261,20 +261,20 @@ class TxSimulator:
         if not key:
             raise SimulationError("empty key is not supported")
         key_hash = hashlib.sha256(key.encode()).digest()
-        self._hashed_writes.setdefault((ns, coll), {})[key_hash] = rw.KVWriteHash(
+        self._hashed_writes.setdefault((ns, coll), {})[key_hash] = rw.KVWriteHash(  # fabdep: disable=unguarded-shared-write  # TxSimulator is tx-scoped: the chaincode shim drives it from exactly one thread at a time
             key_hash, False, hashlib.sha256(value).digest()
         )
-        self._pvt_writes.setdefault((ns, coll), {})[key] = PvtKVWrite(
+        self._pvt_writes.setdefault((ns, coll), {})[key] = PvtKVWrite(  # fabdep: disable=unguarded-shared-write  # TxSimulator is tx-scoped: the chaincode shim drives it from exactly one thread at a time
             key, False, value
         )
 
     def delete_private_data(self, ns: str, coll: str, key: str) -> None:
         self._check_open()
         key_hash = hashlib.sha256(key.encode()).digest()
-        self._hashed_writes.setdefault((ns, coll), {})[key_hash] = rw.KVWriteHash(
+        self._hashed_writes.setdefault((ns, coll), {})[key_hash] = rw.KVWriteHash(  # fabdep: disable=unguarded-shared-write  # TxSimulator is tx-scoped: the chaincode shim drives it from exactly one thread at a time
             key_hash, True, b""
         )
-        self._pvt_writes.setdefault((ns, coll), {})[key] = PvtKVWrite(key, True, b"")
+        self._pvt_writes.setdefault((ns, coll), {})[key] = PvtKVWrite(key, True, b"")  # fabdep: disable=unguarded-shared-write  # TxSimulator is tx-scoped: the chaincode shim drives it from exactly one thread at a time
 
     # -- results ----------------------------------------------------------
     def get_tx_simulation_results(self) -> TxSimulationResults:

@@ -1,0 +1,391 @@
+"""Per-transaction structural validation (reference
+core/common/validation/msgvalidation.go) — host-side parsing phase.
+
+Lives in the ledger layer (historically validation.msgvalidation, which
+still re-exports everything here): the parser builds ledger.rwset
+objects and is consumed from below the validation pipeline — kvledger's
+commit path and the history store re-parse committed transactions — so
+keeping it above the ledger created an import cycle.
+
+The reference validates each tx in its own goroutine, verifying the
+creator signature inline (ValidateTransaction :248-330). The TPU pipeline
+splits that into:
+
+  parse phase (this module, host): all structural checks; emits
+      *signature jobs* instead of verifying inline;
+  batch phase (device): every signature in the block — creator sigs and
+      endorsement sigs — verified in ONE batched kernel call;
+  assembly phase (validation.validator): reference-ordered code priority
+      consuming the boolean results.
+
+Check order replicated exactly (msgvalidation.go ValidateTransaction):
+nil envelope -> NIL_ENVELOPE; payload unmarshal -> BAD_PAYLOAD; header/
+channel-header/signature-header problems -> BAD_COMMON_HEADER; creator
+deserialize/cert-validate/signature -> BAD_CREATOR_SIGNATURE; TxID
+recompute -> BAD_PROPOSAL_TXID; endorser-tx structure (single action,
+proposal-hash binding) -> INVALID_ENDORSER_TRANSACTION; unknown type ->
+UNSUPPORTED_TX_PAYLOAD.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+from typing import List, Optional, Tuple
+
+from fabric_tpu.protos import common_pb2, kv_rwset_pb2, peer_pb2, protoutil, rwset_pb2
+from fabric_tpu.ledger import rwset as rw
+from fabric_tpu.common.txflags import TxValidationCode
+
+SUPPORTED_HEADER_TYPES = {
+    common_pb2.ENDORSER_TRANSACTION,
+    common_pb2.CONFIG_UPDATE,
+    common_pb2.CONFIG,
+}
+
+
+class SigJob:
+    """One deferred signature check: verify `signature` by the identity
+    serialized in `identity_bytes` over `data`.
+
+    When the native block parser produced the job, `digest` carries the
+    precomputed SHA-256 of the signed bytes and `data` is b"" (the
+    payload is never materialized — endorsement jobs sign
+    prp_bytes||endorser, which would otherwise need a copy per job)."""
+
+    __slots__ = ("identity_bytes", "signature", "data", "digest")
+
+    def __init__(
+        self,
+        identity_bytes: bytes,
+        signature: bytes,
+        data: bytes,
+        digest: Optional[bytes] = None,
+    ):
+        self.identity_bytes = identity_bytes
+        self.signature = signature
+        self.data = data
+        self.digest = digest
+
+
+def writes_to_namespace(ns_rw) -> bool:
+    """Reference dispatcher.txWritesToNamespace: public writes, metadata
+    writes, or per-collection hashed (metadata) writes."""
+    if ns_rw.writes or ns_rw.metadata_writes:
+        return True
+    for coll in ns_rw.coll_hashed:
+        if coll.hashed_writes or coll.metadata_writes:
+            return True
+    return False
+
+
+class ParsedTx:
+    """Host-parse result for one block position.
+
+    The rwset is materialized lazily: the native block parser has
+    already validated the rwset's structure (walk_tx_rwset in
+    native/blockparse.cc mirrors parse_tx_rwset's acceptance), so the
+    Python object tree is only built when a consumer (MVCC, commit,
+    legacy writeset checks) actually needs it."""
+
+    __slots__ = (
+        "index",
+        "code",
+        "header_type",
+        "channel_id",
+        "tx_id",
+        "creator",
+        "creator_sig_job",
+        "endorsement_jobs",
+        "namespace",
+        "config_data",
+        "_rwset",
+        "_rwset_raw",
+        "_ns_entries",
+        "_has_md_writes",
+    )
+
+    def __init__(self, index: int):
+        self.index = index
+        self.code: TxValidationCode = TxValidationCode.NOT_VALIDATED
+        self.header_type: int = -1
+        self.channel_id: str = ""
+        self.tx_id: str = ""
+        self.creator: bytes = b""
+        # deferred signature checks
+        self.creator_sig_job: Optional[SigJob] = None
+        self.endorsement_jobs: List[SigJob] = []
+        # endorser-tx artifacts (builtin v20 VSCC inputs)
+        self.namespace: str = ""
+        self.config_data: bytes = b""
+        self._rwset: Optional[rw.TxRwSet] = None
+        self._rwset_raw: Optional[bytes] = None
+        # (namespace, writes_to_namespace) per ns_rw_set, order-preserving
+        self._ns_entries: Optional[List[Tuple[str, bool]]] = None
+        self._has_md_writes: Optional[bool] = None
+
+    @property
+    def rwset(self) -> Optional[rw.TxRwSet]:
+        if self._rwset is None and self._rwset_raw is not None:
+            raw, self._rwset_raw = self._rwset_raw, None
+            try:
+                self._rwset = parse_tx_rwset(raw)
+            except ValueError:
+                # acceptance divergence between the native wire walker
+                # (walk_tx_rwset) and the Python parser over untrusted tx
+                # bytes: degrade to BAD_RWSET for THIS tx instead of
+                # letting the exception abort the whole block commit
+                from fabric_tpu.common import flogging
+
+                flogging.must_get_logger("validation").warning(
+                    "native/Python rwset parse divergence on tx %d "
+                    "(len=%d) — marking BAD_RWSET; add to fuzzer corpus",
+                    self.index, len(raw),
+                )
+                self.code = TxValidationCode.BAD_RWSET
+        return self._rwset
+
+    @rwset.setter
+    def rwset(self, value: Optional[rw.TxRwSet]) -> None:
+        self._rwset = value
+        self._rwset_raw = None
+
+    @property
+    def ns_entries(self) -> Optional[List[Tuple[str, bool]]]:
+        """[(namespace, writes_to_namespace)] in rwset order, or None
+        for non-endorser / failed txs — what _assemble_codes needs
+        without materializing the rwset object tree."""
+        if self._ns_entries is None and self.rwset is not None:
+            self._ns_entries = [
+                (ns.namespace, writes_to_namespace(ns))
+                for ns in self.rwset.ns_rw_sets
+            ]
+        return self._ns_entries
+
+    @property
+    def has_md_writes(self) -> bool:
+        """Any public or collection-hashed metadata write — the trigger
+        for the sequential SBE pass (statebased.BlockDependencies)."""
+        if self._has_md_writes is None:
+            rwset = self.rwset
+            self._has_md_writes = rwset is not None and any(
+                ns.metadata_writes
+                or any(c.metadata_writes for c in ns.coll_hashed)
+                for ns in rwset.ns_rw_sets
+            )
+        return self._has_md_writes
+
+    @property
+    def structurally_valid(self) -> bool:
+        return self.code == TxValidationCode.NOT_VALIDATED
+
+
+def _parse_version(v: kv_rwset_pb2.Version, present: bool) -> Optional[rw.Version]:
+    if not present:
+        return None
+    return rw.Version(v.block_num, v.tx_num)
+
+
+def parse_tx_rwset(results: bytes) -> rw.TxRwSet:
+    """proto TxReadWriteSet bytes -> internal TxRwSet
+    (reference rwsetutil.TxRwSetFromProtoMsg)."""
+    txrw = protoutil.unmarshal(rwset_pb2.TxReadWriteSet, results)
+    ns_sets = []
+    for ns in txrw.ns_rwset:
+        kv = protoutil.unmarshal(kv_rwset_pb2.KVRWSet, ns.rwset)
+        reads = tuple(
+            rw.KVRead(r.key, _parse_version(r.version, r.HasField("version")))
+            for r in kv.reads
+        )
+        writes = tuple(
+            rw.KVWrite(w.key, w.is_delete, w.value) for w in kv.writes
+        )
+        # proto3 cannot distinguish nil from empty entries; like the
+        # reference, empty means metadata delete (None here)
+        md_writes = tuple(
+            rw.KVMetadataWrite(
+                m.key,
+                tuple((e.name, e.value) for e in m.entries) or None,
+            )
+            for m in kv.metadata_writes
+        )
+        rqs = []
+        for q in kv.range_queries_info:
+            raw_reads: Tuple[rw.KVRead, ...] = ()
+            merkle = None
+            if q.HasField("raw_reads"):
+                raw_reads = tuple(
+                    rw.KVRead(r.key, _parse_version(r.version, r.HasField("version")))
+                    for r in q.raw_reads.kv_reads
+                )
+            if q.HasField("reads_merkle_hashes"):
+                merkle = (
+                    q.reads_merkle_hashes.max_degree,
+                    q.reads_merkle_hashes.max_level,
+                    tuple(q.reads_merkle_hashes.max_level_hashes),
+                )
+            rqs.append(
+                rw.RangeQueryInfo(
+                    q.start_key, q.end_key, q.itr_exhausted, raw_reads, merkle
+                )
+            )
+        colls = []
+        for coll in ns.collection_hashed_rwset:
+            h = protoutil.unmarshal(kv_rwset_pb2.HashedRWSet, coll.hashed_rwset)
+            colls.append(
+                rw.CollHashedRwSet(
+                    coll.collection_name,
+                    tuple(
+                        rw.KVReadHash(
+                            r.key_hash,
+                            _parse_version(r.version, r.HasField("version")),
+                        )
+                        for r in h.hashed_reads
+                    ),
+                    tuple(
+                        rw.KVWriteHash(w.key_hash, w.is_delete, w.value_hash)
+                        for w in h.hashed_writes
+                    ),
+                    tuple(
+                        rw.KVMetadataWriteHash(
+                            m.key_hash,
+                            tuple((e.name, e.value) for e in m.entries)
+                            or None,
+                        )
+                        for m in h.metadata_writes
+                    ),
+                )
+            )
+        ns_sets.append(
+            rw.NsRwSet(
+                ns.namespace, reads, writes, tuple(rqs), tuple(colls), md_writes
+            )
+        )
+    return rw.TxRwSet(tuple(ns_sets))
+
+
+def parse_transaction(index: int, data: bytes) -> ParsedTx:
+    """Structural validation of one block entry; fills early codes and
+    deferred signature jobs. Never verifies a signature."""
+    out = ParsedTx(index)
+    if not data:
+        out.code = TxValidationCode.NIL_ENVELOPE
+        return out
+    try:
+        env = protoutil.unmarshal(common_pb2.Envelope, data)
+    except ValueError:
+        out.code = TxValidationCode.INVALID_OTHER_REASON
+        return out
+
+    if not env.payload:
+        out.code = TxValidationCode.BAD_PAYLOAD
+        return out
+    try:
+        payload = protoutil.unmarshal(common_pb2.Payload, env.payload)
+    except ValueError:
+        out.code = TxValidationCode.BAD_PAYLOAD
+        return out
+
+    # validateCommonHeader
+    if not payload.HasField("header"):
+        out.code = TxValidationCode.BAD_COMMON_HEADER
+        return out
+    try:
+        chdr = protoutil.unmarshal(
+            common_pb2.ChannelHeader, payload.header.channel_header
+        )
+        shdr = protoutil.unmarshal(
+            common_pb2.SignatureHeader, payload.header.signature_header
+        )
+    except ValueError:
+        out.code = TxValidationCode.BAD_COMMON_HEADER
+        return out
+    if chdr.type not in SUPPORTED_HEADER_TYPES or chdr.epoch != 0:
+        out.code = TxValidationCode.BAD_COMMON_HEADER
+        return out
+    if not shdr.nonce or not shdr.creator:
+        out.code = TxValidationCode.BAD_COMMON_HEADER
+        return out
+
+    out.header_type = chdr.type
+    out.channel_id = chdr.channel_id
+    out.tx_id = chdr.tx_id
+    out.creator = shdr.creator
+    # checkSignatureFromCreator, deferred: signature over the full payload
+    # bytes (msgvalidation.go:284 verifies env.Signature over env.Payload).
+    out.creator_sig_job = SigJob(shdr.creator, env.signature, env.payload)
+
+    if chdr.type == common_pb2.ENDORSER_TRANSACTION:
+        if not protoutil.check_tx_id(chdr.tx_id, shdr.nonce, shdr.creator):
+            out.code = TxValidationCode.BAD_PROPOSAL_TXID
+            return out
+        code = _parse_endorser_tx(out, payload)
+        if code is not None:
+            out.code = code
+        return out
+    if chdr.type == common_pb2.CONFIG:
+        out.config_data = payload.data
+        return out
+    # CONFIG_UPDATE passes header validation but is not expected inside
+    # blocks; the reference codes it UNKNOWN_TX_TYPE at the validator level.
+    return out
+
+
+def _parse_endorser_tx(out: ParsedTx, payload: common_pb2.Payload) -> Optional[TxValidationCode]:
+    """validateEndorserTransaction + the artifact extraction the builtin
+    v20 plugin performs (validation_logic.go extractValidationArtifacts)."""
+    try:
+        tx = protoutil.unmarshal(peer_pb2.Transaction, payload.data)
+    except ValueError:
+        return TxValidationCode.INVALID_ENDORSER_TRANSACTION
+    if len(tx.actions) != 1:
+        return TxValidationCode.INVALID_ENDORSER_TRANSACTION
+    action = tx.actions[0]
+    try:
+        act_shdr = protoutil.unmarshal(common_pb2.SignatureHeader, action.header)
+    except ValueError:
+        return TxValidationCode.INVALID_ENDORSER_TRANSACTION
+    if not act_shdr.nonce or not act_shdr.creator:
+        return TxValidationCode.INVALID_ENDORSER_TRANSACTION
+    try:
+        cap = protoutil.unmarshal(peer_pb2.ChaincodeActionPayload, action.payload)
+        prp_bytes = cap.action.proposal_response_payload
+        prp = protoutil.unmarshal(peer_pb2.ProposalResponsePayload, prp_bytes)
+    except ValueError:
+        return TxValidationCode.INVALID_ENDORSER_TRANSACTION
+
+    # proposal-hash binding: sha256(channel_header || action sig header ||
+    # chaincode proposal payload) must equal prp.proposal_hash
+    # (GetProposalHash2, protoutil/txutils.go:431).
+    h = hashlib.sha256()
+    h.update(payload.header.channel_header)
+    h.update(action.header)
+    h.update(cap.chaincode_proposal_payload)
+    if not hmac.compare_digest(h.digest(), prp.proposal_hash):
+        return TxValidationCode.INVALID_ENDORSER_TRANSACTION
+
+    # --- builtin v20 artifact extraction (runs later in the reference,
+    # inside the plugin; failure codes preserved) ---
+    try:
+        cc_action = protoutil.unmarshal(peer_pb2.ChaincodeAction, prp.extension)
+    except ValueError:
+        return TxValidationCode.BAD_RESPONSE_PAYLOAD
+    if not cc_action.HasField("chaincode_id") or not cc_action.chaincode_id.name:
+        return TxValidationCode.INVALID_OTHER_REASON
+    try:
+        out.rwset = parse_tx_rwset(cc_action.results)
+    except ValueError:
+        return TxValidationCode.BAD_RWSET
+    out.namespace = cc_action.chaincode_id.name
+
+    # endorsement signature jobs: data = prp_bytes || endorser identity
+    # (statebased/validator_keylevel.go:243-251)
+    for endorsement in cap.action.endorsements:
+        out.endorsement_jobs.append(
+            SigJob(
+                endorsement.endorser,
+                endorsement.signature,
+                prp_bytes + endorsement.endorser,
+            )
+        )
+    return None

@@ -389,7 +389,7 @@ class PeerNode:
         ch = self.channels.get(channel_id)
         if ch is None:
             return None
-        cond = self._commit_conds.setdefault(channel_id, threading.Condition())
+        cond = self._commit_conds.setdefault(channel_id, threading.Condition())  # fabdep: disable=unguarded-shared-write  # dict.setdefault is atomic under the GIL; one Condition per channel wins
 
         def wait_for(number: int, timeout: float) -> bool:
             with cond:
@@ -600,7 +600,7 @@ class PeerNode:
         return flags
 
     def _after_commit(self, channel_id: str, block: common_pb2.Block) -> None:
-        cond = self._commit_conds.setdefault(channel_id, threading.Condition())
+        cond = self._commit_conds.setdefault(channel_id, threading.Condition())  # fabdep: disable=unguarded-shared-write  # dict.setdefault is atomic under the GIL; one Condition per channel wins
         with cond:
             cond.notify_all()
         mgr = self.snapshot_managers.get(channel_id)

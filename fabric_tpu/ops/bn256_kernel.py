@@ -23,7 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from fabric_tpu.crypto import fp256bn as host
+from fabric_tpu.common import fp256bn as host
 from fabric_tpu.ops import bignum as bn
 from fabric_tpu.ops import fieldops as fo
 

@@ -29,7 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from fabric_tpu.crypto import fp256bn as host
+from fabric_tpu.common import fp256bn as host
 from fabric_tpu.ops import bignum as bn
 
 CTX = bn.MontCtx(host.P)

@@ -39,7 +39,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from fabric_tpu.crypto import p256
+from fabric_tpu.common import p256
 from fabric_tpu.ops import bignum as bn
 from fabric_tpu.ops import fieldops as fo
 

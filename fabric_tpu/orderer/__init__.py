@@ -3,4 +3,6 @@
 from fabric_tpu.orderer.blockcutter import BlockCutter
 from fabric_tpu.orderer.solo import SoloChain
 
-__all__ = ["BlockCutter", "SoloChain"]
+# BlockCutter dropped from __all__: consumed only inside the orderer
+# package (fabdep dead-export); still importable as a module attribute
+__all__ = ["SoloChain"]

@@ -112,6 +112,10 @@ class _Remote:
             queue.Queue(maxsize=4096)
         )
         self._channel: Optional[grpc.Channel] = None
+        # the sender thread (reconnect path) and submit() callers both
+        # create/reset _channel (fabdep unguarded-shared-write): without
+        # the lock two channels can be created and one leaks unclosed
+        self._ch_lock = threading.Lock()
         self._thread = threading.Thread(
             target=self._run, name=f"cluster-send-{addr}", daemon=True
         )
@@ -119,9 +123,16 @@ class _Remote:
         self._thread.start()
 
     def channel(self) -> grpc.Channel:
-        if self._channel is None:
-            self._channel = channel_to(self.addr, self.root_ca)
-        return self._channel
+        with self._ch_lock:
+            if self._channel is None:
+                self._channel = channel_to(self.addr, self.root_ca)
+            return self._channel
+
+    def _reset_channel(self) -> None:
+        with self._ch_lock:
+            ch, self._channel = self._channel, None
+        if ch is not None:
+            ch.close()
 
     def enqueue_consensus(self, channel_id: str, msg: Message) -> None:
         req = cluster_pb2.ClusterStepRequest()
@@ -175,9 +186,7 @@ class _Remote:
             except grpc.RpcError:
                 # connection lost: reset the channel; messages queued in
                 # the meantime go out on the next stream
-                if self._channel is not None:
-                    self._channel.close()
-                    self._channel = None
+                self._reset_channel()
                 if self._stopped:
                     return
                 threading.Event().wait(0.05)
@@ -185,8 +194,7 @@ class _Remote:
     def stop(self) -> None:
         self._stopped = True
         self.q.put(None)
-        if self._channel is not None:
-            self._channel.close()
+        self._reset_channel()
 
 
 class ClusterClient:

@@ -27,10 +27,10 @@ from fabric_tpu.parallel.provider import MeshTPUProvider
 from fabric_tpu.parallel.multichannel import MultiChannelValidator
 from fabric_tpu.parallel.batcher import BatchingProvider, VerifyBatcher
 
+# CHANNEL_AXIS/DATA_AXIS dropped from __all__: mesh-internal axis
+# names nothing outside this package references (fabdep dead-export)
 __all__ = [
     "BatchingProvider",
-    "CHANNEL_AXIS",
-    "DATA_AXIS",
     "flat_mesh",
     "grid_mesh",
     "ShardedVerify",

@@ -21,7 +21,7 @@ from fabric_tpu.crypto.tpu_provider import TPUProvider, _bucket
 from fabric_tpu.parallel.sharded import ShardedVerify, channel_stack, pad_lanes
 from fabric_tpu.protos import common_pb2
 from fabric_tpu.validation.blockparse import parse_block
-from fabric_tpu.validation.txflags import ValidationFlags
+from fabric_tpu.common.txflags import ValidationFlags
 from fabric_tpu.validation.validator import BlockValidator
 
 

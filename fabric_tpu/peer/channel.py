@@ -20,7 +20,7 @@ from fabric_tpu.ledger.kvledger import KVLedger
 from fabric_tpu.msp.identity import MSPManager
 from fabric_tpu.protos import common_pb2, protoutil
 from fabric_tpu.validation.blockparse import parse_block
-from fabric_tpu.validation.txflags import TxValidationCode, ValidationFlags
+from fabric_tpu.common.txflags import TxValidationCode, ValidationFlags
 from fabric_tpu.validation.validator import BlockValidator, ChaincodeRegistry
 
 logger = flogging.must_get_logger("committer")

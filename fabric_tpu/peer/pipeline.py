@@ -62,13 +62,34 @@ class CommitPipeline:
             self._idle.clear()
         try:
             prepared = self.channel.prepare_block(block)
+            # bounded put that watches _stopped: a plain blocking put on
+            # a full queue after stop() would wait forever — the
+            # committer has exited and will never drain it (pipeline
+            # audit, PR 3)
+            while True:
+                if self._stopped.is_set():
+                    raise PipelineError("pipeline stopped")
+                try:
+                    self._prepared.put((block, prepared), timeout=0.2)
+                except queue.Full:
+                    continue
+                if self._stopped.is_set() and not self._committer.is_alive():
+                    # stop() landed between our check and the put: the
+                    # committer will never consume this item. Reclaim it
+                    # (one submitter per pipeline, so the reclaimed item
+                    # is ours) so _pending/_idle stay balanced.
+                    try:
+                        self._prepared.get_nowait()
+                    except queue.Empty:
+                        return  # consumed before the committer exited
+                    raise PipelineError("pipeline stopped")
+                return
         except Exception:
             with self._pending_lock:
                 self._pending -= 1
                 if self._pending == 0:
                     self._idle.set()
             raise
-        self._prepared.put((block, prepared))
 
     # -- consumer side -----------------------------------------------------
     def _commit_loop(self) -> None:
@@ -98,3 +119,14 @@ class CommitPipeline:
     def stop(self) -> None:
         self._stopped.set()
         self._committer.join(timeout=5)
+        # release the pending counts of any items the committer never
+        # consumed, so a post-stop drain() returns instead of hanging
+        while True:
+            try:
+                self._prepared.get_nowait()
+            except queue.Empty:
+                break
+            with self._pending_lock:
+                self._pending -= 1
+                if self._pending == 0:
+                    self._idle.set()

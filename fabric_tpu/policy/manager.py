@@ -70,7 +70,7 @@ class SignaturePolicy(Policy):
         self._provider = provider
 
     def evaluate_signed_data(self, signature_set: Sequence[SignedData]) -> None:
-        from fabric_tpu.validation.validator import principal_for
+        from fabric_tpu.policy.proto_convert import principal_for
 
         # Dedupe by raw identity bytes BEFORE verifying (anti-DoS,
         # policies/policy.go:383-388).

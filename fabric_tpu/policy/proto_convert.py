@@ -33,6 +33,25 @@ class PolicyConversionError(ValueError):
     pass
 
 
+def principal_for(ast_principal) -> msp_principal_pb2.MSPPrincipal:
+    """fabric_tpu.policy.ast principal -> proto MSPPrincipal.
+
+    Lives here (historically validation.validator, which still re-exports
+    it) so the policy manager and ledger collections never import the
+    validation layer — that edge was the policy<->validation cycle."""
+    if not isinstance(ast_principal, MSPRole):
+        raise TypeError(
+            f"unsupported policy principal {type(ast_principal).__name__!r}"
+        )
+    role = msp_principal_pb2.MSPRole()
+    role.msp_identifier = ast_principal.msp_id
+    role.role = _ROLE_TO_PROTO[ast_principal.role]
+    out = msp_principal_pb2.MSPPrincipal()
+    out.principal_classification = msp_principal_pb2.MSPPrincipal.ROLE
+    out.principal = role.SerializeToString()
+    return out
+
+
 def envelope_to_proto(env: SignaturePolicyEnvelope) -> policies_pb2.SignaturePolicyEnvelope:
     out = policies_pb2.SignaturePolicyEnvelope()
     out.version = env.version

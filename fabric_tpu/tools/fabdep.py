@@ -1,0 +1,1720 @@
+"""fabdep — whole-program import-layering + concurrency analyzer.
+
+fablint (the sibling tool) checks invariants one file at a time; fabdep
+parses the WHOLE package tree into one symbol table and checks the
+properties that only exist between files: the shape of the import graph,
+and which threads touch which state.  Both matter for the same reason —
+the pipeline's correctness contract is bit-exactness of the
+VALID/INVALID mask, and parallel validation (thread-pipelined block
+commit, sharded hostec, async TPU dispatch) is exactly where that
+contract dies silently when dependency structure or locking drifts.
+
+Like fablint, fabdep is dependency-free and import-free: it never
+imports the analyzed code (pure ``ast`` + a symbol table), so it runs
+identically in minimal environments without ``cryptography``/``jax``.
+
+Passes / rules
+--------------
+Layering (pass 1):
+
+import-cycle     a cycle in the package import graph (any import depth,
+                 deferred imports included — an architectural cycle is a
+                 cycle even when hidden inside a function), or a cycle
+                 between MODULES at import time (module-scope imports
+                 only).  Reported with the full cycle path and the
+                 contributing import sites.
+layer-skip       an upward import: a package imports from a package the
+                 declared layer map places ABOVE it.  Downward imports
+                 may skip any number of layers; upward is never allowed.
+layer-unknown    a package missing from the declared layer map (keeps
+                 the map from silently rotting as packages are added).
+
+Concurrency (pass 2):
+
+unguarded-shared-write  module-global or ``self.*`` mutable state
+                 written from two different execution contexts (two
+                 distinct thread entry points, or a thread and
+                 non-thread code) with no common ``with <lock>:`` guard
+                 across the write sites.  Thread entry points are
+                 ``threading.Thread(target=...)``/``Timer``/
+                 ``executor.submit(...)``/``apply_async`` call sites,
+                 resolved through the symbol table and closed over the
+                 call graph.  Heuristic by design — suppress confirmed
+                 benign sites with a reason.
+lock-order-cycle a cycle in the lock-acquisition-order graph (lock B
+                 taken while holding A, and A while holding B —
+                 potential deadlock).  Nested ``with`` blocks plus one
+                 level of call resolution.
+blocking-under-lock  a blocking call — ``.join()``, ``.result()``,
+                 ``.recv()``, ``time.sleep()``, ``Event.wait()`` — made
+                 while holding a lock: stalls every competing acquirer
+                 (``Condition.wait`` is fine: it releases the lock).
+
+API surface (pass 3):
+
+dead-export      a name a module declares in ``__all__`` that nothing
+                 outside its package (including the reference roots:
+                 ``tests/``, the repo-root scripts) ever references.
+
+Layer map
+---------
+Declared in ``tools/layers.toml`` next to the analyzed package (or
+``--layers FILE``): a ``[layers]`` table of ``package = level`` (higher
+level may import lower or equal), and an optional ``[allow]`` table of
+``"src -> dst" = "reason"`` edge suppressions that exempt a package edge
+from both the cycle and the layer checks.  A tiny TOML subset is parsed
+in-process — no tomllib dependency, works on any Python.
+
+Suppression
+-----------
+Per line: ``# fabdep: disable=rule-id[,rule-id...]  # <reason>`` on the
+reported line, same idiom as fablint.  ``disable=all`` silences every
+rule for that line.  Per edge: the ``[allow]`` table above.
+
+Usage
+-----
+    python -m fabric_tpu.tools.fabdep [--json] [--dot] [--graph-json]
+        [--layers FILE] [--refs PATH] [--rules a,b] [--list-rules] PATH
+
+Exit status: 0 = clean, 1 = findings, 2 = usage/IO error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import fnmatch
+import json
+import re
+import sys
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, FrozenSet, Iterable, List, Optional, Sequence, Set, Tuple
+
+__version__ = "1.0"
+
+# --------------------------------------------------------------------------
+# Configuration
+# --------------------------------------------------------------------------
+
+#: Generated / non-source artifacts fabdep never parses (same as fablint).
+DEFAULT_EXCLUDES = (
+    "*_pb2.py",
+    "*/__pycache__/*",
+    "*/native/*",
+    "*/protos/src/*",
+    "*/.git/*",
+)
+
+#: rule-id -> one-line doc (the registry; passes emit by id).
+RULES: Dict[str, str] = {
+    "import-cycle": "cycle in the package import graph, or an "
+    "import-time cycle between modules",
+    "layer-skip": "upward import: a package imports from a higher "
+    "declared layer",
+    "layer-unknown": "package missing from the declared layer map",
+    "unguarded-shared-write": "shared mutable state written from two "
+    "execution contexts with no common lock",
+    "lock-order-cycle": "cyclic lock acquisition order (potential "
+    "deadlock)",
+    "blocking-under-lock": "blocking call (.join/.result/.recv/sleep) "
+    "while holding a lock",
+    "dead-export": "__all__ name never referenced outside its package",
+}
+
+#: Constructors whose instances are thread-safe to CALL METHODS ON —
+#: mutations through them are synchronization, not shared-state writes.
+THREADSAFE_CTORS = {
+    "Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore",
+    "Event", "Barrier", "local", "Queue", "SimpleQueue", "LifoQueue",
+    "PriorityQueue", "deque",
+}
+
+#: Constructors that mark an executor attribute as PROCESS-based: its
+#: submitted callables run in another process and share no memory.
+PROCESS_CTORS = {"ProcessPoolExecutor", "Pool", "get_context"}
+
+#: Builtin container constructors: a mutator-method call on a receiver
+#: of this type (or of unknown type) is a raw shared-state write.  A
+#: receiver hinted as a USER class is not — that class's own methods
+#: are analyzed for its own state, with its own locks.
+CONTAINER_CTORS = {
+    "dict", "list", "set", "defaultdict", "OrderedDict", "Counter",
+    "frozenset", "bytearray",
+}
+
+#: Method names that mutate their receiver in place.
+MUTATOR_METHODS = {
+    "append", "add", "update", "clear", "pop", "popitem", "remove",
+    "discard", "extend", "insert", "setdefault", "sort", "reverse",
+    "appendleft", "popleft", "extendleft",
+}
+
+#: Identifier tokens that mark a ``with`` context manager as a lock.
+LOCKISH_TOKENS = {
+    "lock", "rlock", "mutex", "mu", "sem", "semaphore", "cv", "cond",
+    "condition",
+}
+
+#: Methods treated as constructor-like: writes there are object setup,
+#: ordered before any thread can see the instance.
+INIT_METHODS = {"__init__", "__post_init__", "__new__", "__set_name__"}
+
+_DISABLE_RE = re.compile(r"#\s*fabdep:\s*disable=([A-Za-z0-9_\-, ]+)")
+
+
+# --------------------------------------------------------------------------
+# Core data model
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+
+    def key(self) -> Tuple[str, int, int, str]:
+        return (self.path, self.line, self.col, self.rule)
+
+    def to_dict(self) -> Dict[str, object]:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+        }
+
+
+@dataclass
+class ImportSite:
+    line: int
+    col: int
+    target: str  # dotted module-ish name as written (maybe module.attr)
+    deferred: bool  # not at module scope
+
+
+@dataclass
+class WriteSite:
+    key: str  # canonical state key ("mod:GLOBAL" / "mod:Class.attr")
+    line: int
+    col: int
+    locks: FrozenSet[str]
+    desc: str  # human description of the write
+
+
+@dataclass
+class FuncInfo:
+    qualname: str  # "mod:func" or "mod:Class.meth"
+    module: str
+    cls: Optional[str]
+    name: str
+    line: int
+    calls: List[Tuple[str, int, FrozenSet[str]]] = field(
+        default_factory=list
+    )  # (callee qualname-ish, line, locks held at the call site)
+    unresolved_methods: List[Tuple[str, int, FrozenSet[str]]] = field(
+        default_factory=list
+    )  # (.method name, line, locks held)
+    thread_targets: List[Tuple[str, int, int]] = field(default_factory=list)
+    writes: List[WriteSite] = field(default_factory=list)
+    acquires: List[Tuple[str, int, int]] = field(default_factory=list)
+    lock_pairs: List[Tuple[str, str, int, int]] = field(default_factory=list)
+    calls_under_lock: List[Tuple[str, FrozenSet[str], int]] = field(
+        default_factory=list
+    )
+    blocking: List[Tuple[str, str, int, int]] = field(default_factory=list)
+
+
+@dataclass
+class ModuleInfo:
+    path: str
+    modname: str  # dotted, e.g. fabric_tpu.crypto.bccsp
+    package: str  # first component below the root package
+    imports: List[ImportSite] = field(default_factory=list)
+    functions: Dict[str, FuncInfo] = field(default_factory=dict)
+    classes: Dict[str, List[str]] = field(default_factory=dict)  # cls -> bases
+    global_types: Dict[str, str] = field(default_factory=dict)
+    attr_types: Dict[Tuple[str, str], str] = field(default_factory=dict)
+    return_hints: Dict[str, str] = field(default_factory=dict)  # fn -> ctor
+    all_names: List[Tuple[str, int, int]] = field(default_factory=list)
+    defined: Set[str] = field(default_factory=set)  # top-level def/class/assign
+    refs: Set[Tuple[str, str]] = field(default_factory=set)  # (module, name)
+    star_imports: Set[str] = field(default_factory=set)
+    strings: Set[str] = field(default_factory=set)
+    suppressions: Dict[int, Set[str]] = field(default_factory=dict)
+
+
+def parse_suppressions(source: str) -> Dict[int, Set[str]]:
+    out: Dict[int, Set[str]] = {}
+    for lineno, line in enumerate(source.splitlines(), start=1):
+        m = _DISABLE_RE.search(line)
+        if m:
+            out[lineno] = {r.strip() for r in m.group(1).split(",") if r.strip()}
+    return out
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _tokens(name: str) -> Set[str]:
+    return {t for t in name.lower().replace(".", "_").split("_") if t}
+
+
+def _unwrap_value(value: ast.AST) -> ast.AST:
+    """Peel ``X if cond else None`` / ``X or Y`` to the lead candidate."""
+    if isinstance(value, ast.IfExp):
+        return _unwrap_value(value.body)
+    if isinstance(value, ast.BoolOp) and value.values:
+        return _unwrap_value(value.values[0])
+    return value
+
+
+def _ctor_hint(value: ast.AST) -> Optional[str]:
+    """'Lock' for ``threading.Lock()``, 'deque' for ``deque()``, etc."""
+    value = _unwrap_value(value)
+    if isinstance(value, ast.Call):
+        name = _dotted(value.func)
+        if name:
+            return name.rsplit(".", 1)[-1]
+    return None
+
+
+# --------------------------------------------------------------------------
+# Layer map (mini-TOML)
+# --------------------------------------------------------------------------
+
+
+class LayerMap:
+    def __init__(
+        self,
+        layers: Optional[Dict[str, int]] = None,
+        allow: Optional[Dict[Tuple[str, str], str]] = None,
+    ):
+        self.layers = layers or {}
+        self.allow = allow or {}
+
+    def allowed(self, src: str, dst: str) -> bool:
+        return (src, dst) in self.allow
+
+    @classmethod
+    def parse(cls, text: str, path: str = "<layers>") -> "LayerMap":
+        """Parse the tiny TOML subset fabdep uses: ``[section]`` headers,
+        ``key = value`` lines, ``#`` comments, quoted keys/values."""
+        layers: Dict[str, int] = {}
+        allow: Dict[Tuple[str, str], str] = {}
+        section = ""
+        for n, raw in enumerate(text.splitlines(), start=1):
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            if line.startswith("[") and line.endswith("]"):
+                section = line[1:-1].strip()
+                continue
+            if "=" not in line:
+                raise ValueError(f"{path}:{n}: expected 'key = value'")
+            key, _, value = line.partition("=")
+            key = key.strip().strip('"').strip("'")
+            value = value.strip()
+            if "#" in value and not (
+                value.startswith('"') or value.startswith("'")
+            ):
+                value = value.split("#", 1)[0].strip()
+            if section == "layers":
+                try:
+                    layers[key] = int(value)
+                except ValueError as exc:
+                    raise ValueError(
+                        f"{path}:{n}: layer level must be an int"
+                    ) from exc
+            elif section == "allow":
+                m = re.match(r"^(\S+)\s*->\s*(\S+)$", key)
+                if not m:
+                    raise ValueError(
+                        f"{path}:{n}: allow key must be 'src -> dst'"
+                    )
+                allow[(m.group(1), m.group(2))] = value.strip('"').strip("'")
+            # unknown sections are ignored (forward compatibility)
+        return cls(layers, allow)
+
+
+# --------------------------------------------------------------------------
+# Per-module collection
+# --------------------------------------------------------------------------
+
+
+class _ModuleCollector(ast.NodeVisitor):
+    """One pass over a module AST filling a ModuleInfo: imports, the
+    function/class symbol table, write sites with held-lock sets, thread
+    spawn sites, lock nesting, and name references."""
+
+    def __init__(self, info: ModuleInfo):
+        self.info = info
+        self.cls_stack: List[str] = []
+        self.fn_stack: List[FuncInfo] = []
+        self.lock_stack: List[str] = []
+        # import alias -> dotted module (or module.attr for from-imports)
+        self.aliases: Dict[str, str] = {}
+        # per-function local alias: name -> ("attr", cls, attr) | ("global", g)
+        self.local_alias: Dict[str, Tuple[str, ...]] = {}
+        # per-function local var -> constructor hint
+        self.local_types: Dict[str, str] = {}
+        # per-function locally-defined (nested) functions: name -> qualname
+        self.local_funcs: Dict[str, str] = {}
+        self.module_globals: Set[str] = set()
+        self.declared_global: Set[str] = set()
+
+    def prescan(self, tree: ast.Module) -> None:
+        """Fill global/return type hints BEFORE the main walk, so e.g.
+        ``pool = _pool()`` resolves to the ProcessPoolExecutor the
+        function returns even when ``_pool`` is defined later."""
+        global_names: Set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Global):
+                global_names.update(node.names)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and len(node.targets) >= 1:
+                hint = _ctor_hint(node.value)
+                if not hint:
+                    continue
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and t.id in global_names:
+                        self.info.global_types.setdefault(t.id, hint)
+        for node in tree.body:
+            if isinstance(node, (ast.Assign,)) and len(node.targets) == 1:
+                t = node.targets[0]
+                hint = _ctor_hint(node.value)
+                if isinstance(t, ast.Name) and hint:
+                    self.info.global_types.setdefault(t.id, hint)
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for ret in ast.walk(node):
+                if not isinstance(ret, ast.Return) or ret.value is None:
+                    continue
+                val = ret.value
+                if isinstance(val, ast.BoolOp) and val.values:
+                    val = val.values[0]
+                hint = _ctor_hint(val)
+                if hint is None and isinstance(val, ast.Name):
+                    hint = self.info.global_types.get(val.id)
+                if hint:
+                    self.info.return_hints.setdefault(node.name, hint)
+                    break
+
+    # -- helpers ----------------------------------------------------------
+
+    def _fn(self) -> Optional[FuncInfo]:
+        return self.fn_stack[-1] if self.fn_stack else None
+
+    def _held(self) -> FrozenSet[str]:
+        return frozenset(self.lock_stack)
+
+    def _self_attr_type(self, attr: str) -> Optional[str]:
+        cls = self.cls_stack[-1] if self.cls_stack else None
+        if cls is None:
+            return None
+        return self.info.attr_types.get((cls, attr))
+
+    def _canon_lock(self, node: ast.AST) -> Optional[str]:
+        """Canonical name for a lock-ish with-context, else None."""
+        hint = None
+        name = None
+        if isinstance(node, ast.Attribute) and isinstance(
+            node.value, ast.Name
+        ):
+            if node.value.id == "self":
+                name = f"{self.info.modname}:{self.cls_stack[-1] if self.cls_stack else '?'}.{node.attr}"
+                hint = self._self_attr_type(node.attr)
+            else:
+                name = f"{self.info.modname}:<{node.value.id}>.{node.attr}"
+            leaf = node.attr
+        elif isinstance(node, ast.Name):
+            if node.id in self.module_globals:
+                name = f"{self.info.modname}:{node.id}"
+                hint = self.info.global_types.get(node.id)
+            else:
+                fn = self._fn()
+                scope = fn.name if fn else "?"
+                name = f"{self.info.modname}:{scope}.<local>.{node.id}"
+            leaf = node.id
+        else:
+            return None
+        if hint in ("Lock", "RLock", "Condition", "Semaphore",
+                    "BoundedSemaphore"):
+            return name
+        if _tokens(leaf) & LOCKISH_TOKENS:
+            return name
+        return None
+
+    def _state_key(self, node: ast.AST) -> Optional[Tuple[str, str]]:
+        """(key, desc) when `node` is shared mutable state: a module
+        global or a self attribute (directly or through a local alias)."""
+        if isinstance(node, ast.Name):
+            alias = self.local_alias.get(node.id)
+            if alias is not None:
+                if alias[0] == "attr":
+                    return (
+                        f"{self.info.modname}:{alias[1]}.{alias[2]}",
+                        f"self.{alias[2]} (via local alias {node.id!r})",
+                    )
+                if alias[0] == "global":
+                    return (
+                        f"{self.info.modname}:{alias[1]}",
+                        f"module global {alias[1]!r} (via alias {node.id!r})",
+                    )
+            if node.id in self.declared_global or (
+                not self.fn_stack and node.id in self.module_globals
+            ) or (node.id in self.module_globals and self.fn_stack):
+                return (
+                    f"{self.info.modname}:{node.id}",
+                    f"module global {node.id!r}",
+                )
+            return None
+        if isinstance(node, ast.Attribute) and isinstance(
+            node.value, ast.Name
+        ):
+            if node.value.id == "self" and self.cls_stack:
+                return (
+                    f"{self.info.modname}:{self.cls_stack[-1]}.{node.attr}",
+                    f"self.{node.attr}",
+                )
+        return None
+
+    def _recv_hint(self, node: ast.AST) -> Optional[str]:
+        """Best-effort type hint for a method-call receiver."""
+        if isinstance(node, ast.Attribute) and isinstance(
+            node.value, ast.Name
+        ) and node.value.id == "self":
+            return self._self_attr_type(node.attr)
+        if isinstance(node, ast.Name):
+            alias = self.local_alias.get(node.id)
+            if alias is not None and alias[0] == "attr":
+                return self.info.attr_types.get((alias[1], alias[2]))
+            if alias is not None and alias[0] == "global":
+                return self.info.global_types.get(alias[1])
+            return self.local_types.get(node.id) or self.info.global_types.get(
+                node.id
+            )
+        return None
+
+    def _exempt_state(self, node: ast.AST) -> bool:
+        """Thread-safe-typed receivers are synchronization, not state."""
+        return self._recv_hint(node) in THREADSAFE_CTORS
+
+    def _record_write(
+        self, target: ast.AST, line: int, col: int, mutator: bool = False
+    ) -> None:
+        fn = self._fn()
+        if fn is None or fn.name in INIT_METHODS:
+            return
+        if self._exempt_state(target):
+            return
+        if mutator:
+            # a mutator-method call on a USER-class receiver is that
+            # class's business: its own methods (and locks) are analyzed
+            hint = self._recv_hint(target)
+            if hint is not None and hint not in CONTAINER_CTORS:
+                return
+        keyed = self._state_key(target)
+        if keyed is None:
+            return
+        key, desc = keyed
+        fn.writes.append(WriteSite(key, line, col, self._held(), desc))
+
+    # -- imports ----------------------------------------------------------
+
+    def visit_Import(self, node: ast.Import) -> None:
+        deferred = bool(self.fn_stack)
+        for a in node.names:
+            self.info.imports.append(
+                ImportSite(node.lineno, node.col_offset, a.name, deferred)
+            )
+            self.aliases[a.asname or a.name.split(".")[0]] = (
+                a.name if a.asname else a.name.split(".")[0]
+            )
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        deferred = bool(self.fn_stack)
+        base = node.module or ""
+        if node.level > 0:
+            parts = self.info.modname.split(".")
+            # from . import x at level 1 inside pkg.mod -> base pkg
+            anchor = parts[: len(parts) - node.level]
+            base = ".".join(anchor + ([node.module] if node.module else []))
+        for a in node.names:
+            if a.name == "*":
+                self.info.star_imports.add(base)
+                self.info.imports.append(
+                    ImportSite(node.lineno, node.col_offset, base, deferred)
+                )
+                continue
+            self.info.imports.append(
+                ImportSite(
+                    node.lineno, node.col_offset, f"{base}.{a.name}", deferred
+                )
+            )
+            self.info.refs.add((base, a.name))
+            self.aliases[a.asname or a.name] = f"{base}.{a.name}"
+        self.generic_visit(node)
+
+    # -- scopes -----------------------------------------------------------
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        if not self.fn_stack and not self.cls_stack:
+            self.info.defined.add(node.name)
+            self.info.classes[node.name] = [
+                _dotted(b) or "" for b in node.bases
+            ]
+            self.module_globals.add(node.name)
+        self.cls_stack.append(node.name)
+        # collect self.<attr> = CTOR() hints from every method first, so
+        # methods earlier in the file see hints from __init__ anywhere
+        for meth in node.body:
+            if isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for sub in ast.walk(meth):
+                    if isinstance(sub, ast.Assign) and len(sub.targets) == 1:
+                        t = sub.targets[0]
+                        if (
+                            isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"
+                        ):
+                            hint = _ctor_hint(sub.value)
+                            if hint:
+                                self.info.attr_types.setdefault(
+                                    (node.name, t.attr), hint
+                                )
+        self.generic_visit(node)
+        self.cls_stack.pop()
+
+    def _visit_func(self, node) -> None:
+        cls = self.cls_stack[-1] if self.cls_stack else None
+        if self.fn_stack:  # nested function: own FuncInfo, local name
+            outer = self.fn_stack[-1]
+            qual = f"{outer.qualname}.<locals>.{node.name}"
+            self.local_funcs[node.name] = qual
+        elif cls and len(self.cls_stack) == 1:
+            qual = f"{self.info.modname}:{cls}.{node.name}"
+        elif not cls:
+            qual = f"{self.info.modname}:{node.name}"
+            self.info.defined.add(node.name)
+            self.module_globals.add(node.name)
+        else:  # class nested in class: rare, attribute to inner class
+            qual = f"{self.info.modname}:{'.'.join(self.cls_stack)}.{node.name}"
+        fn = FuncInfo(
+            qualname=qual,
+            module=self.info.modname,
+            cls=cls,
+            name=node.name,
+            line=node.lineno,
+        )
+        self.info.functions[qual] = fn
+        self.fn_stack.append(fn)
+        saved_alias, self.local_alias = self.local_alias, {}
+        saved_types, self.local_types = self.local_types, {}
+        saved_funcs, self.local_funcs = self.local_funcs, dict(self.local_funcs)
+        saved_global, self.declared_global = self.declared_global, set()
+        saved_locks, self.lock_stack = self.lock_stack, []
+        for stmt in node.body:
+            self.visit(stmt)
+        self.fn_stack.pop()
+        self.local_alias = saved_alias
+        self.local_types = saved_types
+        self.local_funcs = saved_funcs
+        self.declared_global = saved_global
+        self.lock_stack = saved_locks
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._visit_func(node)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._visit_func(node)
+
+    def visit_Global(self, node: ast.Global) -> None:
+        self.declared_global.update(node.names)
+        self.module_globals.update(node.names)
+
+    # -- with / locks ------------------------------------------------------
+
+    def visit_With(self, node: ast.With) -> None:
+        fn = self._fn()
+        acquired: List[str] = []
+        for item in node.items:
+            expr = item.context_expr
+            # `with lock:` or `with lock.acquire_timeout(..)`-ish
+            lock = self._canon_lock(expr)
+            if lock is None and isinstance(expr, ast.Call):
+                lock = self._canon_lock(expr.func) if isinstance(
+                    expr.func, (ast.Name, ast.Attribute)
+                ) else None
+            if lock is not None and fn is not None:
+                for outer in self.lock_stack:
+                    if outer != lock:
+                        fn.lock_pairs.append(
+                            (outer, lock, node.lineno, node.col_offset)
+                        )
+                fn.acquires.append((lock, node.lineno, node.col_offset))
+                acquired.append(lock)
+            self.visit(expr)
+        self.lock_stack.extend(acquired)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in acquired:
+            self.lock_stack.pop()
+
+    # -- assignments / writes ---------------------------------------------
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if not self.fn_stack and not self.cls_stack:
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    self.info.defined.add(t.id)
+                    self.module_globals.add(t.id)
+                    hint = _ctor_hint(node.value)
+                    if hint:
+                        self.info.global_types[t.id] = hint
+                    if t.id == "__all__" and isinstance(
+                        node.value, (ast.List, ast.Tuple)
+                    ):
+                        for elt in node.value.elts:
+                            if isinstance(elt, ast.Constant) and isinstance(
+                                elt.value, str
+                            ):
+                                self.info.all_names.append(
+                                    (elt.value, node.lineno, node.col_offset)
+                                )
+        fn = self._fn()
+        if fn is not None:
+            hint = _ctor_hint(node.value)
+            # `x = f()` where f is a module function with a return hint
+            call = _unwrap_value(node.value)
+            if (
+                hint is not None
+                and isinstance(call, ast.Call)
+                and isinstance(call.func, ast.Name)
+                and call.func.id in self.info.return_hints
+            ):
+                hint = self.info.return_hints[call.func.id]
+            for t in node.targets:
+                # track `x = self._attr` / `x = GLOBAL` aliases
+                if isinstance(t, ast.Name):
+                    if hint is not None:
+                        self.local_types[t.id] = hint
+                    elif t.id in self.local_types:
+                        del self.local_types[t.id]
+                    if (
+                        isinstance(node.value, ast.Attribute)
+                        and isinstance(node.value.value, ast.Name)
+                        and node.value.value.id == "self"
+                        and self.cls_stack
+                    ):
+                        self.local_alias[t.id] = (
+                            "attr", self.cls_stack[-1], node.value.attr,
+                        )
+                    elif (
+                        isinstance(node.value, ast.Name)
+                        and node.value.id in self.module_globals
+                    ):
+                        self.local_alias[t.id] = ("global", node.value.id)
+                    elif t.id in self.local_alias:
+                        del self.local_alias[t.id]
+                # writes: global rebinds, self.attr rebinds, subscripts
+                if isinstance(t, ast.Name):
+                    if t.id in self.declared_global:
+                        if hint in THREADSAFE_CTORS:
+                            continue
+                        self._record_write(t, node.lineno, node.col_offset)
+                elif isinstance(t, ast.Attribute):
+                    if hint in THREADSAFE_CTORS:
+                        continue
+                    self._record_write(t, node.lineno, node.col_offset)
+                elif isinstance(t, ast.Subscript):
+                    self._record_write(
+                        t.value, node.lineno, node.col_offset
+                    )
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        fn = self._fn()
+        if fn is not None:
+            t = node.target
+            if isinstance(t, ast.Name) and t.id in self.declared_global:
+                self._record_write(t, node.lineno, node.col_offset)
+            elif isinstance(t, ast.Attribute):
+                self._record_write(t, node.lineno, node.col_offset)
+            elif isinstance(t, ast.Subscript):
+                self._record_write(t.value, node.lineno, node.col_offset)
+        self.generic_visit(node)
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        for t in node.targets:
+            if isinstance(t, ast.Subscript):
+                self._record_write(t.value, node.lineno, node.col_offset)
+        self.generic_visit(node)
+
+    # -- calls ------------------------------------------------------------
+
+    def _resolve_callable(self, node: ast.AST) -> Optional[str]:
+        """Best-effort: AST callable reference -> qualified name key."""
+        if isinstance(node, ast.Name):
+            if node.id in self.local_funcs:
+                return self.local_funcs[node.id]
+            alias = self.aliases.get(node.id)
+            if alias:
+                return f"@{alias}"  # imported name, resolved program-wide
+            if node.id in self.module_globals:
+                return f"{self.info.modname}:{node.id}"
+            return None
+        if isinstance(node, ast.Attribute) and isinstance(
+            node.value, ast.Name
+        ):
+            base = node.value.id
+            if base == "self" and self.cls_stack:
+                return f"{self.info.modname}:{self.cls_stack[-1]}.{node.attr}"
+            alias = self.aliases.get(base)
+            if alias:
+                return f"@{alias}.{node.attr}"
+            # typed receiver: self.attr hint / local var ctor hint
+            return None
+        return None
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = self._fn()
+        callee = _dotted(node.func) or ""
+        leaf = callee.rsplit(".", 1)[-1]
+
+        # --- thread spawn sites ---
+        target_expr: Optional[ast.AST] = None
+        if leaf in ("Thread", "Timer"):
+            for kw in node.keywords:
+                if kw.arg in ("target", "function"):
+                    target_expr = kw.value
+            if target_expr is None and leaf == "Timer" and len(node.args) >= 2:
+                target_expr = node.args[1]
+        elif leaf in ("submit", "apply_async") and isinstance(
+            node.func, ast.Attribute
+        ):
+            recv_hint = self._recv_hint(node.func.value)
+            if recv_hint not in PROCESS_CTORS and node.args:
+                target_expr = node.args[0]
+        elif callee in ("start_new_thread", "_thread.start_new_thread"):
+            if node.args:
+                target_expr = node.args[0]
+        if target_expr is not None and fn is not None:
+            ref = self._resolve_callable(target_expr)
+            if ref:
+                fn.thread_targets.append((ref, node.lineno, node.col_offset))
+
+        # --- call graph edges ---
+        if fn is not None:
+            ref = self._resolve_callable(node.func)
+            if ref:
+                fn.calls.append((ref, node.lineno, self._held()))
+            elif isinstance(node.func, ast.Attribute):
+                hint = self._recv_hint(node.func.value)
+                if hint:
+                    fn.calls.append(
+                        (f"#{hint}.{node.func.attr}", node.lineno,
+                         self._held())
+                    )
+                elif node.func.attr not in MUTATOR_METHODS:
+                    fn.unresolved_methods.append(
+                        (node.func.attr, node.lineno, self._held())
+                    )
+
+        # --- mutation method calls on shared state ---
+        if (
+            fn is not None
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in MUTATOR_METHODS
+        ):
+            self._record_write(
+                node.func.value, node.lineno, node.col_offset, mutator=True
+            )
+
+        # --- blocking calls under a held lock ---
+        if fn is not None and self.lock_stack and isinstance(
+            node.func, ast.Attribute
+        ):
+            self._check_blocking(node, fn)
+
+        self.generic_visit(node)
+
+    def _check_blocking(self, node: ast.Call, fn: FuncInfo) -> None:
+        attr = node.func.attr
+        recv = node.func.value
+        recv_dotted = _dotted(recv) or ""
+        if attr == "sleep" and recv_dotted == "time":
+            fn.blocking.append(
+                ("time.sleep", self.lock_stack[-1], node.lineno,
+                 node.col_offset)
+            )
+            return
+        if attr not in ("join", "result", "recv", "wait"):
+            return
+        # the held lock's own .wait/.acquire is Condition discipline
+        canon = self._canon_lock(recv)
+        if canon is not None and canon in self.lock_stack:
+            return
+        if attr == "wait":
+            # only flag Event-typed receivers: lock.wait/cond.wait differ
+            hint = None
+            if isinstance(recv, ast.Attribute) and isinstance(
+                recv.value, ast.Name
+            ) and recv.value.id == "self":
+                hint = self._self_attr_type(recv.attr)
+            elif isinstance(recv, ast.Name):
+                hint = self.info.global_types.get(recv.id)
+            if hint != "Event":
+                return
+        if attr == "join":
+            # exclude the overwhelming str.join / os.path.join shapes
+            if isinstance(recv, ast.Constant):
+                return
+            if "path" in recv_dotted.lower().split("."):
+                return
+            if any(
+                isinstance(
+                    a,
+                    (ast.List, ast.Tuple, ast.GeneratorExp, ast.ListComp,
+                     ast.Call, ast.JoinedStr, ast.BinOp),
+                )
+                or (isinstance(a, ast.Constant) and isinstance(a.value, str))
+                for a in node.args
+            ):
+                return
+        fn.blocking.append(
+            (f".{attr}()", self.lock_stack[-1], node.lineno, node.col_offset)
+        )
+
+    # -- references (dead-export pass) ------------------------------------
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if isinstance(node.value, ast.Name):
+            alias = self.aliases.get(node.value.id)
+            if alias:
+                self.info.refs.add((alias, node.attr))
+        self.generic_visit(node)
+
+    def visit_Name(self, node: ast.Name) -> None:
+        alias = self.aliases.get(node.id)
+        if alias and "." in alias:
+            mod, _, name = alias.rpartition(".")
+            self.info.refs.add((mod, name))
+
+    def visit_Constant(self, node: ast.Constant) -> None:
+        if isinstance(node.value, str) and node.value.isidentifier():
+            self.info.strings.add(node.value)
+
+
+# --------------------------------------------------------------------------
+# Program-level analysis
+# --------------------------------------------------------------------------
+
+
+class Program:
+    def __init__(self, root: Path, excludes: Sequence[str]):
+        self.root = root
+        self.root_pkg = root.name
+        self.excludes = tuple(excludes)
+        self.modules: Dict[str, ModuleInfo] = {}
+        self.findings: List[Finding] = []
+        self.suppressed = 0
+        # program-wide symbol tables (built in link())
+        self.functions: Dict[str, FuncInfo] = {}
+        self.class_methods: Dict[str, Dict[str, str]] = {}
+        self.method_owner_count: Dict[str, int] = {}
+        self.thread_classes: Set[str] = set()
+
+    # -- loading ----------------------------------------------------------
+
+    def load(self) -> None:
+        files = sorted(self.root.rglob("*.py"))
+        for f in files:
+            posix = f.as_posix()
+            if any(fnmatch.fnmatch(posix, pat) for pat in self.excludes):
+                continue
+            rel = f.relative_to(self.root.parent)
+            modname = ".".join(rel.with_suffix("").parts)
+            if modname.endswith(".__init__"):
+                modname = modname[: -len(".__init__")]
+            parts = modname.split(".")
+            package = parts[1] if len(parts) > 1 else ""
+            try:
+                source = f.read_text(encoding="utf-8")
+                tree = ast.parse(source, filename=str(f))
+            except (OSError, UnicodeDecodeError, SyntaxError) as exc:
+                self.findings.append(
+                    Finding("io-error", str(f), 1, 0, f"cannot parse: {exc}")
+                )
+                continue
+            info = ModuleInfo(path=str(f), modname=modname, package=package)
+            info.suppressions = parse_suppressions(source)
+            collector = _ModuleCollector(info)
+            collector.prescan(tree)
+            collector.visit(tree)
+            self.modules[modname] = info
+
+    def link(self) -> None:
+        """Build the program-wide symbol tables used for resolution."""
+        for info in self.modules.values():
+            for qual, fn in info.functions.items():
+                self.functions[qual] = fn
+            for cls, bases in info.classes.items():
+                methods = self.class_methods.setdefault(cls, {})
+                for qual, fn in info.functions.items():
+                    if fn.cls == cls:
+                        methods[fn.name] = qual
+                if any(
+                    b.rsplit(".", 1)[-1] == "Thread" for b in bases if b
+                ):
+                    self.thread_classes.add(cls)
+        for cls, methods in self.class_methods.items():
+            for name in methods:
+                self.method_owner_count[name] = (
+                    self.method_owner_count.get(name, 0) + 1
+                )
+
+    # -- shared helpers ----------------------------------------------------
+
+    def _emit(
+        self, rule: str, info: ModuleInfo, line: int, col: int, msg: str
+    ) -> None:
+        disabled = info.suppressions.get(line, set())
+        if rule in disabled or "all" in disabled:
+            self.suppressed += 1
+            return
+        self.findings.append(Finding(rule, info.path, line, col, msg))
+
+    def resolve_module(self, target: str) -> Optional[str]:
+        """Dotted import target -> analyzed module name (or None)."""
+        if target in self.modules:
+            return target
+        head, _, _ = target.rpartition(".")
+        if head in self.modules:
+            return head
+        return None
+
+    def resolve_func(self, ref: str) -> List[str]:
+        """Call/target reference -> candidate FuncInfo qualnames."""
+        if ref.startswith("#"):  # typed receiver: ClassName.method
+            cls_meth = ref[1:]
+            cls, _, meth = cls_meth.partition(".")
+            qual = self.class_methods.get(cls, {}).get(meth)
+            return [qual] if qual else []
+        if ref.startswith("@"):  # imported dotted name
+            dotted = ref[1:]
+            mod, _, name = dotted.rpartition(".")
+            if mod in self.modules:
+                qual = f"{mod}:{name}"
+                if qual in self.functions:
+                    return [qual]
+            # imported class / deeper attribute chain: not a call edge
+            return []
+        if ref in self.functions:
+            return [ref]
+        return []
+
+    # -- pass 1: layering --------------------------------------------------
+
+    def layering_pass(self, layer_map: LayerMap) -> Dict[str, object]:
+        pkg_edges: Dict[Tuple[str, str], List[Tuple[ModuleInfo, ImportSite]]] = {}
+        mod_edges: Dict[Tuple[str, str], Tuple[ModuleInfo, ImportSite]] = {}
+        for info in self.modules.values():
+            for site in info.imports:
+                if not site.target.startswith(self.root_pkg):
+                    continue
+                target_mod = self.resolve_module(site.target)
+                if target_mod is None or target_mod == info.modname:
+                    continue
+                tparts = target_mod.split(".")
+                tpkg = tparts[1] if len(tparts) > 1 else ""
+                if tpkg and info.package and tpkg != info.package:
+                    if not layer_map.allowed(info.package, tpkg):
+                        pkg_edges.setdefault(
+                            (info.package, tpkg), []
+                        ).append((info, site))
+                if not site.deferred:
+                    key = (info.modname, target_mod)
+                    if key not in mod_edges:
+                        mod_edges[key] = (info, site)
+
+        # package cycles (all imports, deferred included)
+        pkg_graph: Dict[str, Set[str]] = {}
+        for (src, dst) in pkg_edges:
+            pkg_graph.setdefault(src, set()).add(dst)
+            pkg_graph.setdefault(dst, set())
+        for cycle in _find_cycles(pkg_graph):
+            path = " -> ".join(cycle + [cycle[0]])
+            sites: List[str] = []
+            for a, b in zip(cycle, cycle[1:] + [cycle[0]]):
+                # consecutive pairs follow real edges, but in an SCC that
+                # is not one simple cycle the CLOSING pair may not be an
+                # import edge — report the sites that exist
+                hit = pkg_edges.get((a, b))
+                if hit:
+                    info, site = hit[0]
+                    sites.append(f"{info.path}:{site.line}")
+            info, site = pkg_edges[(cycle[0], cycle[1])][0]
+            self._emit(
+                "import-cycle", info, site.line, site.col,
+                f"package import cycle: {path} (edge sites: "
+                f"{', '.join(sites)}); break it by moving the shared "
+                f"leaf types into the lower layer",
+            )
+
+        # module-level import-time cycles (module-scope imports only)
+        mod_graph: Dict[str, Set[str]] = {}
+        for (src, dst) in mod_edges:
+            mod_graph.setdefault(src, set()).add(dst)
+            mod_graph.setdefault(dst, set())
+        for cycle in _find_cycles(mod_graph):
+            path = " -> ".join(cycle + [cycle[0]])
+            info, site = mod_edges[(cycle[0], cycle[1])]
+            self._emit(
+                "import-cycle", info, site.line, site.col,
+                f"import-time module cycle: {path} (these imports run "
+                f"at module scope; one direction must become deferred "
+                f"or the shared names must move down)",
+            )
+
+        # layer-skip + layer-unknown
+        if layer_map.layers:
+            unknown_seen: Set[str] = set()
+            for (src, dst), sites in sorted(pkg_edges.items()):
+                src_l = layer_map.layers.get(src)
+                dst_l = layer_map.layers.get(dst)
+                for pkg, lvl in ((src, src_l), (dst, dst_l)):
+                    if lvl is None and pkg not in unknown_seen:
+                        unknown_seen.add(pkg)
+                        info, site = sites[0]
+                        self._emit(
+                            "layer-unknown", info, site.line, site.col,
+                            f"package {pkg!r} is not in the declared "
+                            f"layer map (tools/layers.toml) — add it at "
+                            f"the right level",
+                        )
+                if src_l is None or dst_l is None:
+                    continue
+                if src_l < dst_l:
+                    for info, site in sites:
+                        self._emit(
+                            "layer-skip", info, site.line, site.col,
+                            f"upward import: {src} (layer {src_l}) "
+                            f"imports {dst} (layer {dst_l}); only same "
+                            f"or lower layers may be imported",
+                        )
+
+        return {
+            "packages": sorted(
+                {m.package for m in self.modules.values() if m.package}
+            ),
+            "edges": sorted(
+                {
+                    (s, d): len(v) for (s, d), v in pkg_edges.items()
+                }.items()
+            ),
+        }
+
+    # -- pass 2: concurrency ----------------------------------------------
+
+    def _call_edges(
+        self,
+    ) -> Dict[str, List[Tuple[str, FrozenSet[str]]]]:
+        """callee qualname -> [(caller qualname, locks held at site)] over
+        every resolvable call (typed, imported, local, unique-method)."""
+        incoming: Dict[str, List[Tuple[str, FrozenSet[str]]]] = {}
+        for qual, fn in self.functions.items():
+            resolved: List[Tuple[str, FrozenSet[str]]] = []
+            for ref, _line, locks in fn.calls:
+                for callee in self.resolve_func(ref):
+                    resolved.append((callee, locks))
+            for meth, _line, locks in fn.unresolved_methods:
+                # unique-method fallback: only when exactly one class in
+                # the whole program defines this method name
+                if self.method_owner_count.get(meth) == 1:
+                    for methods in self.class_methods.values():
+                        if meth in methods:
+                            resolved.append((methods[meth], locks))
+            for callee, locks in resolved:
+                incoming.setdefault(callee, []).append((qual, locks))
+        return incoming
+
+    def concurrency_pass(self) -> None:
+        # 1. thread entries: explicit targets + Thread-subclass run()
+        entries: Dict[str, str] = {}  # entry qualname -> spawn description
+        for qual, fn in self.functions.items():
+            for ref, line, _col in fn.thread_targets:
+                for target in self.resolve_func(ref):
+                    entries.setdefault(
+                        target, f"{fn.qualname} line {line}"
+                    )
+        for cls in self.thread_classes:
+            run_qual = self.class_methods.get(cls, {}).get("run")
+            if run_qual:
+                entries.setdefault(run_qual, f"{cls}.run (Thread subclass)")
+
+        incoming = self._call_edges()
+        outgoing: Dict[str, List[str]] = {}
+        for callee, callers in incoming.items():
+            for caller, _locks in callers:
+                outgoing.setdefault(caller, []).append(callee)
+
+        # 2. closure per entry over the resolved call graph
+        def closure(start: str) -> Set[str]:
+            seen = {start}
+            work = [start]
+            while work:
+                cur = work.pop()
+                for c in outgoing.get(cur, ()):
+                    if c not in seen:
+                        seen.add(c)
+                        work.append(c)
+            return seen
+
+        context_of: Dict[str, Set[str]] = {q: set() for q in self.functions}
+        for entry in entries:
+            for q in closure(entry):
+                if q in context_of:
+                    context_of[q].add(entry)
+
+        # main context: reachable from any function no resolved call
+        # feeds into (API roots / CLI mains / module-level code)
+        roots = [
+            q for q in self.functions
+            if q not in incoming and q not in entries
+        ]
+        main_reach: Set[str] = set()
+        for r in roots:
+            main_reach |= closure(r)
+        for q in main_reach:
+            if q in context_of:
+                context_of[q].add("<main>")
+
+        # 2b. caller-held lock inheritance: a write lexically outside a
+        # ``with lock:`` is still guarded when EVERY call path into its
+        # function holds the lock (``_expire_locked`` style helpers).
+        # Must-analysis fixpoint: inherited(f) = intersection over call
+        # sites of (locks at site | inherited(caller)); thread entries
+        # and call-graph roots inherit nothing (spawn drops locks).
+        TOP = None  # lattice top: no call path seen yet
+        inherited: Dict[str, Optional[FrozenSet[str]]] = {
+            q: TOP for q in self.functions
+        }
+        for q in self.functions:
+            if q in entries or q not in incoming:
+                inherited[q] = frozenset()
+        changed = True
+        iters = 0
+        while changed and iters < 50:
+            changed = False
+            iters += 1
+            for q, callers in incoming.items():
+                if q in entries:
+                    continue
+                acc: Optional[FrozenSet[str]] = TOP
+                for caller, locks in callers:
+                    up = inherited.get(caller, TOP)
+                    if up is TOP:
+                        continue  # optimistic: unresolved caller path
+                    contrib = locks | up
+                    acc = contrib if acc is TOP else (acc & contrib)
+                if acc is not TOP and acc != inherited.get(q):
+                    inherited[q] = acc
+                    changed = True
+
+        def effective(fn_qual: str, locks: FrozenSet[str]) -> FrozenSet[str]:
+            extra = inherited.get(fn_qual)
+            return locks if extra in (None, frozenset()) else (locks | extra)
+
+        # 3. group write sites by state key with their context sets
+        by_key: Dict[str, List[Tuple[FuncInfo, WriteSite, Set[str], FrozenSet[str]]]] = {}
+        for qual, fn in self.functions.items():
+            ctxs = context_of.get(qual) or {"<main>"}
+            for w in fn.writes:
+                by_key.setdefault(w.key, []).append(
+                    (fn, w, ctxs, effective(qual, w.locks))
+                )
+
+        for key, sites in sorted(by_key.items()):
+            all_ctxs: Set[str] = set()
+            for _fn, _w, ctxs, _locks in sites:
+                all_ctxs |= ctxs
+            thread_ctxs = all_ctxs - {"<main>"}
+            if not thread_ctxs:
+                continue  # never written from a thread
+            if len(all_ctxs) < 2:
+                continue  # single context: no concurrent writers
+            common = None
+            for _fn, _w, _ctxs, locks in sites:
+                common = locks if common is None else (common & locks)
+            if common:
+                continue  # a shared lock guards every write site
+            # report at each UNLOCKED write site (usually 1-2)
+            entry_desc = sorted(
+                entries.get(c, c) for c in thread_ctxs
+            )[0]
+            others = {
+                f"{Path(f.module.replace('.', '/')).name}.py:{w.line}"
+                for f, w, _c, _l in sites
+            }
+            reported = False
+            for fn, w, _ctxs, locks in sites:
+                if locks:
+                    continue
+                info = self.modules.get(fn.module)
+                if info is None:
+                    continue
+                self._emit(
+                    "unguarded-shared-write", info, w.line, w.col,
+                    f"{w.desc} is written here without a lock, and the "
+                    f"same state is written from a thread context "
+                    f"(spawned at {entry_desc}; write sites: "
+                    f"{', '.join(sorted(others))}); guard every write "
+                    f"with one lock or make the state thread-local",
+                )
+                reported = True
+            if not reported:
+                # every site locked, but by DIFFERENT locks
+                fn, w, _ctxs, _locks = sites[0]
+                info = self.modules.get(fn.module)
+                if info is not None:
+                    held = sorted(set().union(*(s[3] for s in sites)))
+                    self._emit(
+                        "unguarded-shared-write", info, w.line, w.col,
+                        f"{w.desc} write sites are guarded by DIFFERENT "
+                        f"locks ({', '.join(held)}) — they do not "
+                        f"exclude each other",
+                    )
+
+        # 4. lock-order graph + cycles: lexical nesting plus inherited
+        # caller-held locks over callee acquisitions
+        order_edges: Dict[Tuple[str, str], Tuple[FuncInfo, int, int]] = {}
+        for qual, fn in self.functions.items():
+            for outer, inner, line, col in fn.lock_pairs:
+                order_edges.setdefault((outer, inner), (fn, line, col))
+            for inner, line, col in fn.acquires:
+                extra = inherited.get(qual)
+                if extra:
+                    for outer in extra:
+                        if outer != inner:
+                            order_edges.setdefault(
+                                (outer, inner), (fn, line, col)
+                            )
+        lock_graph: Dict[str, Set[str]] = {}
+        for (a, b) in order_edges:
+            lock_graph.setdefault(a, set()).add(b)
+            lock_graph.setdefault(b, set())
+        for cycle in _find_cycles(lock_graph):
+            path = " -> ".join(cycle + [cycle[0]])
+            fn, line, col = order_edges[(cycle[0], cycle[1])]
+            info = self.modules.get(fn.module)
+            if info is not None:
+                self._emit(
+                    "lock-order-cycle", info, line, col,
+                    f"lock acquisition order cycle: {path} — two "
+                    f"threads taking these locks in opposite order "
+                    f"deadlock; pick one global order",
+                )
+
+        # 5. blocking calls under a lock
+        for qual, fn in self.functions.items():
+            info = self.modules.get(fn.module)
+            if info is None:
+                continue
+            for desc, lock, line, col in fn.blocking:
+                self._emit(
+                    "blocking-under-lock", info, line, col,
+                    f"blocking call {desc} while holding {lock}: every "
+                    f"competing acquirer stalls (and a cycle through "
+                    f"the blocked resource deadlocks); move the wait "
+                    f"outside the lock",
+                )
+
+    # -- pass 3: dead exports ---------------------------------------------
+
+    def export_pass(self, ref_infos: Sequence[ModuleInfo]) -> None:
+        # build the program-wide reference index
+        refs: Set[Tuple[str, str]] = set()
+        star: Set[str] = set()
+        strings: Set[str] = set()
+        for info in list(self.modules.values()) + list(ref_infos):
+            refs |= info.refs
+            star |= info.star_imports
+            strings |= info.strings
+        for modname, info in sorted(self.modules.items()):
+            if not info.all_names:
+                continue
+            pkg_init = f"{self.root_pkg}.{info.package}"
+            is_init = modname == pkg_init
+            # a package __init__ re-exports names that live in its
+            # submodules; external imports of the SAME name straight
+            # from the submodule keep the API surface live
+            accept_mods = {modname}
+            if is_init:
+                accept_mods |= {
+                    m for m in self.modules
+                    if m.startswith(pkg_init + ".")
+                }
+            for name, line, col in info.all_names:
+                live = False
+                for other in list(self.modules.values()) + list(ref_infos):
+                    if other is info:
+                        continue
+                    same_pkg = (
+                        other.package == info.package
+                        and other.modname.startswith(self.root_pkg + ".")
+                    )
+                    if same_pkg and other.modname != pkg_init:
+                        continue  # intra-package use doesn't count
+                    if any((m, name) in other.refs for m in accept_mods):
+                        live = True
+                        break
+                    if accept_mods & other.star_imports:
+                        live = True
+                        break
+                    if name in other.strings:
+                        live = True
+                        break
+                if not live:
+                    self._emit(
+                        "dead-export", info, line, col,
+                        f"{name!r} is exported in __all__ but never "
+                        f"referenced outside package {info.package!r} "
+                        f"(reference roots included) — drop it from the "
+                        f"public API or add the missing consumer",
+                    )
+
+
+def _find_cycles(graph: Dict[str, Set[str]]) -> List[List[str]]:
+    """Cycles in a digraph: one representative cycle per SCC (Tarjan),
+    as a node path [a, b, ..] meaning a -> b -> .. -> a."""
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    counter = [0]
+    sccs: List[List[str]] = []
+
+    def strongconnect(v: str) -> None:
+        # iterative Tarjan (analyzed trees can nest deeply)
+        work = [(v, iter(sorted(graph.get(v, ()))))]
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on_stack.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(sorted(graph.get(w, ())))))
+                    advanced = True
+                    break
+                elif w in on_stack:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                if len(comp) > 1:
+                    sccs.append(list(reversed(comp)))
+                elif node in graph.get(node, ()):
+                    sccs.append([node])
+
+    for v in sorted(graph):
+        if v not in index:
+            strongconnect(v)
+
+    # order each SCC as an actual cycle path via DFS inside the SCC
+    cycles: List[List[str]] = []
+    for comp in sccs:
+        if len(comp) == 1:
+            cycles.append(comp)
+            continue
+        comp_set = set(comp)
+        start = comp[0]
+        path = [start]
+        seen = {start}
+        cur = start
+        while True:
+            nxt = next(
+                (n for n in sorted(graph.get(cur, ())) if n in comp_set
+                 and n not in seen), None,
+            )
+            if nxt is None:
+                break
+            path.append(nxt)
+            seen.add(nxt)
+            cur = nxt
+        cycles.append(path)
+    return cycles
+
+
+# --------------------------------------------------------------------------
+# Reference roots (dead-export consumers outside the analyzed tree)
+# --------------------------------------------------------------------------
+
+
+def load_ref_roots(paths: Sequence[Path], excludes: Sequence[str]) -> List[ModuleInfo]:
+    out: List[ModuleInfo] = []
+    for p in paths:
+        files = sorted(p.rglob("*.py")) if p.is_dir() else [p]
+        for f in files:
+            posix = f.as_posix()
+            if any(fnmatch.fnmatch(posix, pat) for pat in excludes):
+                continue
+            try:
+                tree = ast.parse(f.read_text(encoding="utf-8"))
+            except (OSError, UnicodeDecodeError, SyntaxError):
+                continue
+            info = ModuleInfo(path=str(f), modname=f"<ref>{f}", package="<ref>")
+            _ModuleCollector(info).visit(tree)
+            out.append(info)
+    return out
+
+
+# --------------------------------------------------------------------------
+# Graph output
+# --------------------------------------------------------------------------
+
+
+def graph_dict(program: Program, layer_map: LayerMap) -> Dict[str, object]:
+    edges: Dict[Tuple[str, str], int] = {}
+    deferred: Dict[Tuple[str, str], int] = {}
+    for info in program.modules.values():
+        for site in info.imports:
+            if not site.target.startswith(program.root_pkg):
+                continue
+            target = program.resolve_module(site.target)
+            if target is None:
+                continue
+            tparts = target.split(".")
+            tpkg = tparts[1] if len(tparts) > 1 else ""
+            if not tpkg or not info.package or tpkg == info.package:
+                continue
+            key = (info.package, tpkg)
+            edges[key] = edges.get(key, 0) + 1
+            if site.deferred:
+                deferred[key] = deferred.get(key, 0) + 1
+    packages = sorted({m.package for m in program.modules.values() if m.package})
+    return {
+        "root": program.root_pkg,
+        "packages": [
+            {"name": p, "layer": layer_map.layers.get(p)} for p in packages
+        ],
+        "edges": [
+            {
+                "src": s,
+                "dst": d,
+                "imports": n,
+                "deferred": deferred.get((s, d), 0),
+            }
+            for (s, d), n in sorted(edges.items())
+        ],
+    }
+
+
+def graph_dot(program: Program, layer_map: LayerMap) -> str:
+    g = graph_dict(program, layer_map)
+    lines = [
+        "digraph fabric_tpu_imports {",
+        "  rankdir=BT;",
+        '  node [shape=box, fontname="Helvetica"];',
+    ]
+    by_layer: Dict[object, List[str]] = {}
+    for pkg in g["packages"]:  # type: ignore[index]
+        by_layer.setdefault(pkg["layer"], []).append(pkg["name"])
+    for layer, pkgs in sorted(
+        by_layer.items(), key=lambda kv: (kv[0] is None, kv[0])
+    ):
+        lines.append(f"  {{ rank=same; // layer {layer}")
+        for p in pkgs:
+            label = f"{p}\\n[layer {layer}]" if layer is not None else p
+            lines.append(f'    "{p}" [label="{label}"];')
+        lines.append("  }")
+    for e in g["edges"]:  # type: ignore[index]
+        lines.append(
+            f'  "{e["src"]}" -> "{e["dst"]}" [label="{e["imports"]}"];'
+        )
+    lines.append("}")
+    return "\n".join(lines)
+
+
+# --------------------------------------------------------------------------
+# Driver
+# --------------------------------------------------------------------------
+
+
+def analyze(
+    root: Path,
+    layer_map: Optional[LayerMap] = None,
+    ref_paths: Sequence[Path] = (),
+    rule_ids: Optional[Iterable[str]] = None,
+    excludes: Sequence[str] = DEFAULT_EXCLUDES,
+) -> Tuple[Program, List[Finding]]:
+    """Run all passes over the package at `root`.  Returns the Program
+    (for graph output / tests) and the unsuppressed findings."""
+    program = Program(root, excludes)
+    program.load()
+    program.link()
+    lm = layer_map or LayerMap()
+    program.layering_pass(lm)
+    program.concurrency_pass()
+    refs = load_ref_roots(ref_paths, excludes)
+    program.export_pass(refs)
+    active = set(rule_ids) if rule_ids is not None else set(RULES)
+    findings = [
+        f for f in program.findings
+        if f.rule in active or f.rule == "io-error"
+    ]
+    findings.sort(key=Finding.key)
+    return program, findings
+
+
+def default_layer_file(root: Path) -> Optional[Path]:
+    cand = root / "tools" / "layers.toml"
+    return cand if cand.is_file() else None
+
+
+def default_ref_paths(root: Path) -> List[Path]:
+    out: List[Path] = []
+    parent = root.resolve().parent
+    tests = parent / "tests"
+    if tests.is_dir():
+        out.append(tests)
+    for f in sorted(parent.glob("*.py")):
+        out.append(f)
+    return out
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="fabdep",
+        description="whole-program import-layering + concurrency analyzer "
+        "for fabric-tpu (dependency-free; never imports the analyzed code)",
+    )
+    parser.add_argument("paths", nargs="*", help="package root to analyze")
+    parser.add_argument("--json", action="store_true", help="machine-readable findings")
+    parser.add_argument("--dot", action="store_true", help="print the package import graph as DOT and exit")
+    parser.add_argument("--graph-json", action="store_true", help="print the package import graph as JSON and exit")
+    parser.add_argument("--layers", metavar="FILE", help="layer map file (default: <root>/tools/layers.toml)")
+    parser.add_argument("--refs", action="append", default=[], metavar="PATH", help="extra reference roots for the dead-export pass (default: sibling tests/ + repo-root *.py)")
+    parser.add_argument("--no-default-refs", action="store_true", help="do not auto-add sibling tests/ and repo-root *.py as reference roots")
+    parser.add_argument("--rules", metavar="ID[,ID...]", help="run only these rule ids (default: all)")
+    parser.add_argument("--list-rules", action="store_true", help="print rule ids and exit")
+    parser.add_argument("--exclude", action="append", default=[], metavar="GLOB", help="extra exclusion globs")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rid in sorted(RULES):
+            print(f"{rid:24s} {RULES[rid]}")
+        return 0
+
+    if len(args.paths) != 1:
+        parser.print_usage(sys.stderr)
+        print("fabdep: error: exactly one package root required", file=sys.stderr)
+        return 2
+    root = Path(args.paths[0]).resolve()
+    if not root.is_dir():
+        print(f"fabdep: error: not a directory: {root}", file=sys.stderr)
+        return 2
+
+    rule_ids: Optional[List[str]] = None
+    if args.rules:
+        rule_ids = [r.strip() for r in args.rules.split(",") if r.strip()]
+        unknown = [r for r in rule_ids if r not in RULES]
+        if unknown:
+            print(
+                f"fabdep: error: unknown rule(s): {', '.join(unknown)}",
+                file=sys.stderr,
+            )
+            return 2
+
+    layer_map = LayerMap()
+    layer_file = Path(args.layers) if args.layers else default_layer_file(root)
+    if layer_file is not None:
+        try:
+            layer_map = LayerMap.parse(
+                layer_file.read_text(encoding="utf-8"), str(layer_file)
+            )
+        except (OSError, ValueError) as exc:
+            print(f"fabdep: error: bad layer map: {exc}", file=sys.stderr)
+            return 2
+
+    ref_paths = [Path(p) for p in args.refs]
+    if not args.no_default_refs:
+        ref_paths.extend(default_ref_paths(root))
+
+    excludes = tuple(DEFAULT_EXCLUDES) + tuple(args.exclude)
+
+    if args.dot or args.graph_json:
+        # graph dumps only need the parsed import table — skip the
+        # concurrency fixpoint and export scan
+        program = Program(root, excludes)
+        program.load()
+        program.link()
+        if args.dot:
+            print(graph_dot(program, layer_map))
+        if args.graph_json:
+            print(json.dumps(graph_dict(program, layer_map), indent=2))
+        return 0
+
+    program, findings = analyze(
+        root, layer_map, ref_paths, rule_ids, excludes
+    )
+
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "version": __version__,
+                    "findings": [f.to_dict() for f in findings],
+                    "stats": {
+                        "modules": len(program.modules),
+                        "suppressed": program.suppressed,
+                    },
+                },
+                indent=2,
+            )
+        )
+    else:
+        for f in findings:
+            print(f"{f.path}:{f.line}:{f.col}: {f.rule}: {f.message}")
+        print(
+            f"fabdep: {len(findings)} finding(s), "
+            f"{program.suppressed} suppressed, "
+            f"{len(program.modules)} modules",
+            file=sys.stderr,
+        )
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -178,7 +178,7 @@ def batch_der_parse(
         return r, s, ok, low_s
     lib = _load()
     if lib is None:
-        from fabric_tpu.crypto import der, p256
+        from fabric_tpu.common import der, p256
 
         for i, sig in enumerate(sigs):
             try:

@@ -1,5 +1,5 @@
 """Commit-time validation pipeline (reference core/committer/txvalidator)."""
 
-from fabric_tpu.validation.txflags import TxValidationCode, ValidationFlags
+from fabric_tpu.common.txflags import TxValidationCode, ValidationFlags
 
 __all__ = ["TxValidationCode", "ValidationFlags"]

@@ -24,12 +24,12 @@ from typing import Iterator, List, Optional, Sequence, Tuple
 import numpy as np
 
 from fabric_tpu.utils import native as _native
-from fabric_tpu.validation.msgvalidation import (
+from fabric_tpu.ledger.txparse import (
     ParsedTx,
     SigJob,
     parse_transaction,
 )
-from fabric_tpu.validation.txflags import TxValidationCode
+from fabric_tpu.common.txflags import TxValidationCode
 
 
 class ParsedBlock(list):

@@ -21,6 +21,7 @@ metadata, bit-exact with the reference for every supported scenario.
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -32,14 +33,14 @@ from fabric_tpu.policy.ast import SignaturePolicyEnvelope
 from fabric_tpu.policy.evaluator import compile_batched_numpy, evaluate_host
 from fabric_tpu.protos import common_pb2, msp_principal_pb2, protoutil
 from fabric_tpu.validation.blockparse import ParsedBlock, parse_block
-from fabric_tpu.validation.msgvalidation import ParsedTx, SigJob
+from fabric_tpu.ledger.txparse import ParsedTx, SigJob
 from fabric_tpu.validation.statebased import (
     VALIDATION_PARAMETER,
     BlockDependencies,
     KeyLevelEvaluator,
 )
 from fabric_tpu.ledger.mvcc import deserialize_metadata
-from fabric_tpu.validation.txflags import TxValidationCode, ValidationFlags
+from fabric_tpu.common.txflags import TxValidationCode, ValidationFlags
 
 
 class ValidationError(Exception):
@@ -81,34 +82,15 @@ PolicyGroups = Dict[
 ]
 
 
-# re-export: moved to msgvalidation so the parse layer can share it
-from fabric_tpu.validation.msgvalidation import (  # noqa: E402
+# re-export: moved to ledger.txparse so the parse layer can share it
+from fabric_tpu.ledger.txparse import (  # noqa: E402
     writes_to_namespace as _writes_to_namespace,
 )
 
 
-def principal_for(ast_principal) -> msp_principal_pb2.MSPPrincipal:
-    """fabric_tpu.policy.ast principal -> proto MSPPrincipal."""
-    from fabric_tpu.policy.ast import MSPRole as AstRole
-    from fabric_tpu.policy.ast import Role
-
-    if not isinstance(ast_principal, AstRole):
-        raise TypeError(
-            f"unsupported policy principal {type(ast_principal).__name__!r}"
-        )
-    role = msp_principal_pb2.MSPRole()
-    role.msp_identifier = ast_principal.msp_id
-    role.role = {
-        Role.MEMBER: msp_principal_pb2.MSPRole.MEMBER,
-        Role.ADMIN: msp_principal_pb2.MSPRole.ADMIN,
-        Role.CLIENT: msp_principal_pb2.MSPRole.CLIENT,
-        Role.PEER: msp_principal_pb2.MSPRole.PEER,
-        Role.ORDERER: msp_principal_pb2.MSPRole.ORDERER,
-    }[ast_principal.role]
-    out = msp_principal_pb2.MSPPrincipal()
-    out.principal_classification = msp_principal_pb2.MSPPrincipal.ROLE
-    out.principal = role.SerializeToString()
-    return out
+# re-export: moved to policy.proto_convert so the policy manager and
+# ledger collections can use it without importing the validation layer
+from fabric_tpu.policy.proto_convert import principal_for  # noqa: E402,F401
 
 
 class BlockValidator:
@@ -165,7 +147,23 @@ class BlockValidator:
         # parser interns identity bytes so every job of the same signer
         # hits ONE entry here instead of re-walking the MSP caches
         # (reference msp/cache/cache.go DeserializeIdentity memoization).
+        #
+        # THE cross-stage shared state of the commit pipeline: stage A
+        # (collect_sig_jobs, on the deliver thread preparing block N+1)
+        # reads/fills it while stage B (validate, on the committer
+        # thread finishing block N) clears it on a config tx — the
+        # pipeline audit driven by fabdep's unguarded-shared-write rule
+        # found the unlocked clear could drop entries mid-fill and, far
+        # worse, a stage-A size-check clear racing a stage-B CRL-rotation
+        # clear could resurrect a pre-rotation identity from a stale
+        # local reference. Every access now holds _ident_lock.
         self._ident_cache: Dict[bytes, Optional[Identity]] = {}
+        self._ident_lock = threading.Lock()
+        # generation counter, bumped on every CRL-rotation clear: a
+        # stage-A fill that started BEFORE the clear must not land
+        # AFTER it (it would resurrect an identity validated against
+        # the pre-rotation CRL); fills compare generations and drop
+        self._ident_gen = 0
         # per-policy memo of circuit verdicts keyed by the tx's signer
         # pattern (tuple of (Identity, sig_ok)); the dict holds strong
         # refs to the Identity objects so keys can never alias.
@@ -256,19 +254,29 @@ class BlockValidator:
         keys, payloads, sigs = [], [], []
         job_identity: Dict[int, Optional[Identity]] = {}
         ident_cache = self._ident_cache
-        if len(ident_cache) > 8192:
-            ident_cache.clear()
+        with self._ident_lock:
+            if len(ident_cache) > 8192:
+                ident_cache.clear()
         _MISS = object()
         for job in jobs:
             ibytes = job.identity_bytes
-            ident = ident_cache.get(ibytes, _MISS)
+            with self._ident_lock:
+                ident = ident_cache.get(ibytes, _MISS)
+                gen = self._ident_gen
             if ident is _MISS:
+                # cert-chain walk + CRL check run OUTSIDE the lock (the
+                # expensive part; a racing duplicate fill is idempotent)
                 try:
                     ident, msp = self.msp_manager.deserialize_identity(ibytes)
                     msp.validate(ident)  # cert chain + CRL (identities.go:107)
                 except MSPError:
                     ident = None
-                ident_cache[ibytes] = ident
+                with self._ident_lock:
+                    if self._ident_gen == gen:
+                        ident_cache[ibytes] = ident
+                    # else: a config tx rotated MSPs/CRLs while we were
+                    # validating — the result reflects the OLD CRL, so
+                    # it must not enter the post-rotation cache
             job_identity[id(job)] = ident
             if ident is None:
                 continue
@@ -399,7 +407,15 @@ class BlockValidator:
                         # config change can rotate MSPs/CRLs/policies:
                         # drop every derived cache (reference: channel
                         # resources bundle hot-swap invalidates them)
-                        self._ident_cache.clear()
+                        with self._ident_lock:
+                            self._ident_cache.clear()
+                            self._ident_gen += 1
+                        # _principal_cache/_pattern_memo need no lock:
+                        # unlike _ident_cache (filled by stage A on the
+                        # deliver thread), they are read and written
+                        # only inside validate()/_batch_verify_sigs —
+                        # this very thread — so this clear cannot race
+                        # their fills
                         self._principal_cache.clear()
                         self._pattern_memo.clear()
                 except Exception as e:

@@ -3,7 +3,10 @@
 #
 #   1. python -m compileall    -- every file byte-compiles
 #   2. collect_gate.sh         -- every test module imports cleanly
-#   3. fablint --json          -- every invariant rule passes
+#   3. fablint                 -- every per-file invariant rule passes
+#   4. fabdep                  -- whole-program gates: the package import
+#                                 graph is a layered DAG (tools/layers.toml)
+#                                 and the concurrency/API-surface rules pass
 #
 # Each stage runs even if an earlier one failed (one run reports ALL
 # broken gates); the exit code is nonzero if ANY stage failed.
@@ -11,31 +14,31 @@ set -uo pipefail
 
 cd "$(dirname "$0")/.."
 
-report="$(mktemp)"
-trap 'rm -f "$report"' EXIT
 fail=0
 
-echo "== ci_gate 1/3: compileall =="
+echo "== ci_gate 1/4: compileall =="
 if ! timeout -k 5 120 python -m compileall -q fabric_tpu; then
     echo "ci_gate: compileall FAIL" >&2
     fail=1
 fi
 
-echo "== ci_gate 2/3: collect_gate =="
+echo "== ci_gate 2/4: collect_gate =="
 if ! bash scripts/collect_gate.sh; then
     echo "ci_gate: collect_gate FAIL" >&2
     fail=1
 fi
 
-echo "== ci_gate 3/3: fablint =="
-if ! timeout -k 5 60 python -m fabric_tpu.tools.fablint --json fabric_tpu/ \
-        > "$report"; then
+# both linters' human output already prints findings as
+# path:line:col: rule: message — no JSON round-trip needed
+echo "== ci_gate 3/4: fablint =="
+if ! timeout -k 5 60 python -m fabric_tpu.tools.fablint fabric_tpu/; then
     echo "ci_gate: fablint FAIL" >&2
-    REPORT="$report" python - <<'EOF' >&2 || true
-import json, os
-for f in json.load(open(os.environ["REPORT"]))["findings"]:
-    print(f"{f['path']}:{f['line']}:{f['col']}: {f['rule']}: {f['message']}")
-EOF
+    fail=1
+fi
+
+echo "== ci_gate 4/4: fabdep =="
+if ! timeout -k 5 60 python -m fabric_tpu.tools.fabdep fabric_tpu/; then
+    echo "ci_gate: fabdep FAIL" >&2
     fail=1
 fi
 
@@ -43,4 +46,4 @@ if [ "$fail" -ne 0 ]; then
     echo "ci_gate: FAIL" >&2
     exit 1
 fi
-echo "ci_gate: OK (compileall + collect + fablint)"
+echo "ci_gate: OK (compileall + collect + fablint + fabdep)"

@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# Dump the fabric_tpu package import graph for the bench artifacts:
+#
+#   scripts/depgraph.sh            -> depgraph.dot + depgraph.json in CWD
+#   scripts/depgraph.sh out/prefix -> out/prefix.dot + out/prefix.json
+#
+# Nodes are packages annotated with their declared layer
+# (fabric_tpu/tools/layers.toml); edges carry the import-site count.
+# Render with `dot -Tsvg depgraph.dot -o depgraph.svg` where graphviz
+# is available — the dump itself is dependency-free (pure fabdep).
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+
+prefix="${1:-depgraph}"
+
+timeout -k 5 60 python -m fabric_tpu.tools.fabdep --dot fabric_tpu/ \
+    > "${prefix}.dot"
+timeout -k 5 60 python -m fabric_tpu.tools.fabdep --graph-json fabric_tpu/ \
+    > "${prefix}.json"
+
+echo "depgraph: wrote ${prefix}.dot and ${prefix}.json" >&2

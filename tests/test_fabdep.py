@@ -1,0 +1,652 @@
+"""fabdep — whole-program layering + concurrency analyzer.
+
+One firing fixture per rule (import-cycle, layer-skip, layer-unknown,
+unguarded-shared-write, lock-order-cycle, blocking-under-lock,
+dead-export), the negative control next to each, suppression semantics
+(per-line and per-edge), the mini-TOML layer map parser, CLI surfaces,
+and the repo self-check: fabric_tpu/ analyzed with the shipped
+tools/layers.toml must produce ZERO unsuppressed findings and a package
+graph consistent with the declared layers.
+"""
+
+import json
+from pathlib import Path
+
+import pytest
+
+from fabric_tpu.tools import fabdep
+from fabric_tpu.tools.fabdep import LayerMap, analyze
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def write_tree(root: Path, files: dict) -> Path:
+    for rel, body in files.items():
+        p = root / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(body, encoding="utf-8")
+    return root
+
+
+def run(root: Path, layers: LayerMap = None, refs=(), rules=None):
+    _program, findings = analyze(root, layers, refs, rules)
+    return findings
+
+
+def rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+# ---------------------------------------------------------------------------
+# pass 1: layering
+# ---------------------------------------------------------------------------
+
+
+def test_package_import_cycle_fires(tmp_path):
+    root = write_tree(
+        tmp_path / "proj",
+        {
+            "__init__.py": "",
+            "alpha/__init__.py": "from proj.beta import core\n",
+            "alpha/core.py": "",
+            "beta/__init__.py": "from proj.alpha import core\n",
+            "beta/core.py": "",
+        },
+    )
+    findings = run(root)
+    assert "import-cycle" in rules_of(findings)
+    msg = next(f for f in findings if f.rule == "import-cycle").message
+    assert "alpha" in msg and "beta" in msg  # full cycle path reported
+
+
+def test_deferred_import_still_counts_for_package_cycle(tmp_path):
+    # architectural cycles hide inside functions; the package pass sees them
+    root = write_tree(
+        tmp_path / "proj",
+        {
+            "__init__.py": "",
+            "alpha/__init__.py": "from proj.beta import core\n",
+            "alpha/core.py": "",
+            "beta/__init__.py": "",
+            "beta/core.py": (
+                "def f():\n    from proj.alpha import core\n    return core\n"
+            ),
+        },
+    )
+    assert "import-cycle" in rules_of(run(root))
+
+
+def test_scc_that_is_not_one_simple_cycle_reports_without_crash(tmp_path):
+    # A <-> B plus B <-> C: one SCC {A,B,C} whose representative path
+    # has a closing pair that is NOT an import edge — the report must
+    # list the sites that exist instead of raising KeyError
+    root = write_tree(
+        tmp_path / "proj",
+        {
+            "__init__.py": "",
+            "aaa/__init__.py": "from proj.bbb import x\n",
+            "aaa/x.py": "",
+            "bbb/__init__.py": (
+                "from proj.aaa import x\nfrom proj.ccc import x as y\n"
+            ),
+            "bbb/x.py": "",
+            "ccc/__init__.py": "from proj.bbb import x\n",
+            "ccc/x.py": "",
+        },
+    )
+    findings = run(root)
+    assert "import-cycle" in rules_of(findings)
+
+
+def test_no_cycle_no_finding(tmp_path):
+    root = write_tree(
+        tmp_path / "proj",
+        {
+            "__init__.py": "",
+            "alpha/__init__.py": "from proj.beta import core\n",
+            "alpha/core.py": "",
+            "beta/__init__.py": "",
+            "beta/core.py": "",
+        },
+    )
+    assert run(root) == []
+
+
+def test_layer_skip_fires_upward_only(tmp_path):
+    root = write_tree(
+        tmp_path / "proj",
+        {
+            "__init__.py": "",
+            "low/__init__.py": "from proj.high import api\n",  # upward: bad
+            "low/api.py": "",
+            "high/__init__.py": "",
+            "high/api.py": "",
+            # downward import, skipping a layer: allowed
+            "top/__init__.py": "from proj.low import api\n",
+            "top/api.py": "",
+        },
+    )
+    layers = LayerMap({"low": 0, "high": 1, "top": 3})
+    findings = run(root, layers)
+    assert rules_of(findings) == ["layer-skip"]
+    assert all(f.rule != "layer-skip" or "low" in f.message for f in findings)
+
+
+def test_layer_unknown_fires(tmp_path):
+    root = write_tree(
+        tmp_path / "proj",
+        {
+            "__init__.py": "",
+            "low/__init__.py": "from proj.mystery import api\n",
+            "low/api.py": "",
+            "mystery/__init__.py": "",
+            "mystery/api.py": "",
+        },
+    )
+    findings = run(root, LayerMap({"low": 0}))
+    assert "layer-unknown" in rules_of(findings)
+
+
+def test_allow_edge_suppresses_layer_and_cycle(tmp_path):
+    root = write_tree(
+        tmp_path / "proj",
+        {
+            "__init__.py": "",
+            "alpha/__init__.py": "from proj.beta import core\n",
+            "alpha/core.py": "",
+            "beta/__init__.py": "from proj.alpha import core\n",
+            "beta/core.py": "",
+        },
+    )
+    layers = LayerMap(
+        {"alpha": 0, "beta": 1},
+        allow={("alpha", "beta"): "historical edge, tracked in #123"},
+    )
+    findings = run(root, layers)
+    # the allowed edge is exempt from BOTH checks; the cycle dissolves
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# pass 2: concurrency
+# ---------------------------------------------------------------------------
+
+RACE_SRC = """
+import threading
+
+class Worker:
+    def __init__(self):
+        self.count = 0
+        self._t = threading.Thread(target=self._run, daemon=True)
+        self._t.start()
+
+    def _run(self):
+        while True:
+            self.count += 1{thread_guard}
+
+    def poke(self):
+        self.count = 0{main_guard}
+"""
+
+
+def _race_tree(tmp_path, thread_guard="", main_guard="", extra=""):
+    src = RACE_SRC.format(thread_guard=thread_guard, main_guard=main_guard)
+    return write_tree(
+        tmp_path / "proj",
+        {"__init__.py": "", "pkg/__init__.py": "", "pkg/mod.py": src + extra},
+    )
+
+
+def test_unguarded_shared_write_fires(tmp_path):
+    findings = run(_race_tree(tmp_path))
+    assert rules_of(findings) == ["unguarded-shared-write"]
+    assert any("self.count" in f.message for f in findings)
+
+
+def test_guarded_shared_write_is_clean(tmp_path):
+    src = """
+import threading
+
+class Worker:
+    def __init__(self):
+        self.count = 0
+        self._lock = threading.Lock()
+        self._t = threading.Thread(target=self._run, daemon=True)
+        self._t.start()
+
+    def _run(self):
+        while True:
+            with self._lock:
+                self.count += 1
+
+    def poke(self):
+        with self._lock:
+            self.count = 0
+"""
+    root = write_tree(
+        tmp_path / "proj",
+        {"__init__.py": "", "pkg/__init__.py": "", "pkg/mod.py": src},
+    )
+    assert run(root) == []
+
+
+def test_caller_held_lock_is_inherited(tmp_path):
+    # the write sits in a helper whose every caller holds the lock
+    src = """
+import threading
+
+class Worker:
+    def __init__(self):
+        self.count = 0
+        self._lock = threading.Lock()
+        self._t = threading.Thread(target=self._run, daemon=True)
+        self._t.start()
+
+    def _bump_locked(self):
+        self.count += 1
+
+    def _run(self):
+        while True:
+            with self._lock:
+                self._bump_locked()
+
+    def poke(self):
+        with self._lock:
+            self._bump_locked()
+"""
+    root = write_tree(
+        tmp_path / "proj",
+        {"__init__.py": "", "pkg/__init__.py": "", "pkg/mod.py": src},
+    )
+    assert run(root) == []
+
+
+def test_queue_typed_state_is_exempt(tmp_path):
+    src = """
+import queue
+import threading
+
+class Worker:
+    def __init__(self):
+        self.q = queue.Queue()
+        self._t = threading.Thread(target=self._run, daemon=True)
+        self._t.start()
+
+    def _run(self):
+        while True:
+            self.q.put(1)
+
+    def poke(self):
+        self.q.put(2)
+"""
+    root = write_tree(
+        tmp_path / "proj",
+        {"__init__.py": "", "pkg/__init__.py": "", "pkg/mod.py": src},
+    )
+    assert run(root) == []
+
+
+def test_process_pool_submit_is_not_a_thread_entry(tmp_path):
+    src = """
+from concurrent.futures import ProcessPoolExecutor
+
+_POOL = None
+_COUNTER = 0
+
+def _pool():
+    global _POOL
+    if _POOL is None:
+        _POOL = ProcessPoolExecutor(2)
+    return _POOL
+
+def work():
+    global _COUNTER
+    _COUNTER += 1  # worker process: shares no memory with the parent
+    return _COUNTER
+
+def dispatch(items):
+    pool = _pool()
+    return [pool.submit(work, i) for i in items]
+"""
+    root = write_tree(
+        tmp_path / "proj",
+        {"__init__.py": "", "pkg/__init__.py": "", "pkg/mod.py": src},
+    )
+    assert run(root) == []
+
+
+def test_lock_order_cycle_fires(tmp_path):
+    src = """
+import threading
+
+lock_a = threading.Lock()
+lock_b = threading.Lock()
+
+def forward():
+    with lock_a:
+        with lock_b:
+            pass
+
+def backward():
+    with lock_b:
+        with lock_a:
+            pass
+"""
+    root = write_tree(
+        tmp_path / "proj",
+        {"__init__.py": "", "pkg/__init__.py": "", "pkg/mod.py": src},
+    )
+    findings = run(root)
+    assert rules_of(findings) == ["lock-order-cycle"]
+    assert "lock_a" in findings[0].message and "lock_b" in findings[0].message
+
+
+def test_consistent_lock_order_is_clean(tmp_path):
+    src = """
+import threading
+
+lock_a = threading.Lock()
+lock_b = threading.Lock()
+
+def one():
+    with lock_a:
+        with lock_b:
+            pass
+
+def two():
+    with lock_a:
+        with lock_b:
+            pass
+"""
+    root = write_tree(
+        tmp_path / "proj",
+        {"__init__.py": "", "pkg/__init__.py": "", "pkg/mod.py": src},
+    )
+    assert run(root) == []
+
+
+def test_blocking_under_lock_fires(tmp_path):
+    src = """
+import threading
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._t = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        pass
+
+    def stop(self):
+        with self._lock:
+            self._t.join()
+"""
+    root = write_tree(
+        tmp_path / "proj",
+        {"__init__.py": "", "pkg/__init__.py": "", "pkg/mod.py": src},
+    )
+    findings = run(root)
+    assert rules_of(findings) == ["blocking-under-lock"]
+
+
+def test_str_join_under_lock_is_not_blocking(tmp_path):
+    src = """
+import threading
+
+_lock = threading.Lock()
+
+def render(parts):
+    with _lock:
+        return ", ".join(sorted(parts))
+"""
+    root = write_tree(
+        tmp_path / "proj",
+        {"__init__.py": "", "pkg/__init__.py": "", "pkg/mod.py": src},
+    )
+    assert run(root) == []
+
+
+# ---------------------------------------------------------------------------
+# pass 3: dead exports
+# ---------------------------------------------------------------------------
+
+
+def test_dead_export_fires_and_external_use_is_live(tmp_path):
+    root = write_tree(
+        tmp_path / "proj",
+        {
+            "__init__.py": "",
+            "pkg/__init__.py": "",
+            "pkg/mod.py": (
+                '__all__ = ["live_api", "dead_api"]\n'
+                "def live_api():\n    pass\n"
+                "def dead_api():\n    pass\n"
+            ),
+            "other/__init__.py": "",
+            "other/consumer.py": "from proj.pkg.mod import live_api\n",
+        },
+    )
+    findings = run(root)
+    assert rules_of(findings) == ["dead-export"]
+    assert "dead_api" in findings[0].message
+    assert all("'live_api'" not in f.message for f in findings)
+
+
+def test_dead_export_counts_reference_roots(tmp_path):
+    root = write_tree(
+        tmp_path / "proj",
+        {
+            "__init__.py": "",
+            "pkg/__init__.py": "",
+            "pkg/mod.py": '__all__ = ["api"]\ndef api():\n    pass\n',
+        },
+    )
+    tests_dir = tmp_path / "exttests"
+    tests_dir.mkdir()
+    (tests_dir / "test_x.py").write_text("from proj.pkg.mod import api\n")
+    assert run(root) != []  # dead without the ref root
+    assert run(root, refs=[tests_dir]) == []  # alive with it
+
+
+def test_dead_export_init_reexport_live_via_submodule(tmp_path):
+    # pkg/__init__ re-exports a name; an external module imports it from
+    # the SUBMODULE — the __init__ claim is still a live API surface
+    root = write_tree(
+        tmp_path / "proj",
+        {
+            "__init__.py": "",
+            "pkg/__init__.py": (
+                'from proj.pkg.mod import api\n__all__ = ["api"]\n'
+            ),
+            "pkg/mod.py": "def api():\n    pass\n",
+            "other/__init__.py": "",
+            "other/consumer.py": "from proj.pkg.mod import api\n",
+        },
+    )
+    assert run(root) == []
+
+
+# ---------------------------------------------------------------------------
+# suppressions
+# ---------------------------------------------------------------------------
+
+
+def test_per_line_suppression_with_reason(tmp_path):
+    src = RACE_SRC.format(
+        thread_guard="  # fabdep: disable=unguarded-shared-write  # stats only",
+        main_guard="  # fabdep: disable=unguarded-shared-write  # stats only",
+    )
+    root = write_tree(
+        tmp_path / "proj",
+        {"__init__.py": "", "pkg/__init__.py": "", "pkg/mod.py": src},
+    )
+    program, findings = analyze(root)
+    assert findings == []
+    assert program.suppressed >= 1
+
+
+def test_disable_all_suppresses_everything_on_the_line(tmp_path):
+    src = RACE_SRC.format(
+        thread_guard="  # fabdep: disable=all  # measured, benign",
+        main_guard="  # fabdep: disable=all  # measured, benign",
+    )
+    root = write_tree(
+        tmp_path / "proj",
+        {"__init__.py": "", "pkg/__init__.py": "", "pkg/mod.py": src},
+    )
+    assert run(root) == []
+
+
+def test_suppressing_the_wrong_rule_does_not_silence(tmp_path):
+    src = RACE_SRC.format(
+        thread_guard="  # fabdep: disable=layer-skip  # wrong rule id",
+        main_guard="",
+    )
+    root = write_tree(
+        tmp_path / "proj",
+        {"__init__.py": "", "pkg/__init__.py": "", "pkg/mod.py": src},
+    )
+    assert "unguarded-shared-write" in rules_of(run(root))
+
+
+# ---------------------------------------------------------------------------
+# layer map parsing
+# ---------------------------------------------------------------------------
+
+
+def test_layermap_parses_toml_subset():
+    text = """
+# comment
+[layers]
+protos = 0
+"crypto" = 2
+
+[allow]
+"a -> b" = "grandfathered; tracked in ROADMAP"
+"""
+    lm = LayerMap.parse(text)
+    assert lm.layers == {"protos": 0, "crypto": 2}
+    assert lm.allow[("a", "b")].startswith("grandfathered")
+    assert lm.allowed("a", "b") and not lm.allowed("b", "a")
+
+
+def test_layermap_rejects_bad_level():
+    with pytest.raises(ValueError):
+        LayerMap.parse("[layers]\nprotos = zero\n")
+
+
+def test_layermap_rejects_bad_allow_key():
+    with pytest.raises(ValueError):
+        LayerMap.parse("[allow]\nnodash = why\n")
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def test_cli_list_rules(capsys):
+    assert fabdep.main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rid in fabdep.RULES:
+        assert rid in out
+
+
+def test_cli_json_and_exit_codes(tmp_path, capsys):
+    root = write_tree(
+        tmp_path / "proj",
+        {
+            "__init__.py": "",
+            "alpha/__init__.py": "from proj.beta import x\n",
+            "alpha/x.py": "",
+            "beta/__init__.py": "from proj.alpha import x\n",
+            "beta/x.py": "",
+        },
+    )
+    assert fabdep.main(["--json", "--no-default-refs", str(root)]) == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["findings"] and payload["stats"]["modules"] == 5
+    assert {"rule", "path", "line", "col", "message"} <= set(
+        payload["findings"][0]
+    )
+
+
+def test_cli_dot_and_graph_json(tmp_path, capsys):
+    root = write_tree(
+        tmp_path / "proj",
+        {
+            "__init__.py": "",
+            "alpha/__init__.py": "from proj.beta import x\n",
+            "alpha/x.py": "",
+            "beta/__init__.py": "",
+            "beta/x.py": "",
+        },
+    )
+    assert fabdep.main(["--dot", "--no-default-refs", str(root)]) == 0
+    dot = capsys.readouterr().out
+    assert "digraph" in dot and '"alpha" -> "beta"' in dot
+    assert fabdep.main(["--graph-json", "--no-default-refs", str(root)]) == 0
+    graph = json.loads(capsys.readouterr().out)
+    assert {
+        "src": "alpha", "dst": "beta", "imports": 1, "deferred": 0
+    } in graph["edges"]
+
+
+def test_cli_usage_errors(tmp_path):
+    assert fabdep.main([]) == 2
+    assert fabdep.main([str(tmp_path / "missing")]) == 2
+    assert fabdep.main(["--rules", "no-such-rule", str(tmp_path)]) == 2
+
+
+# ---------------------------------------------------------------------------
+# repo self-check
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def repo_analysis():
+    root = REPO / "fabric_tpu"
+    layer_file = fabdep.default_layer_file(root)
+    assert layer_file is not None, "tools/layers.toml must ship with the repo"
+    layer_map = LayerMap.parse(layer_file.read_text(), str(layer_file))
+    refs = fabdep.default_ref_paths(root)
+    program, findings = analyze(root, layer_map, refs)
+    return program, findings, layer_map
+
+
+def test_repo_has_zero_unsuppressed_findings(repo_analysis):
+    _program, findings, _lm = repo_analysis
+    pretty = "\n".join(
+        f"{f.path}:{f.line}: {f.rule}: {f.message}" for f in findings
+    )
+    assert findings == [], f"fabdep must stay clean:\n{pretty}"
+
+
+def test_repo_package_graph_is_a_layered_dag(repo_analysis):
+    program, _findings, layer_map = repo_analysis
+    graph = fabdep.graph_dict(program, layer_map)
+    # every package placed, every edge flows downward or level
+    by_name = {p["name"]: p["layer"] for p in graph["packages"]}
+    assert all(layer is not None for layer in by_name.values()), by_name
+    for e in graph["edges"]:
+        assert by_name[e["src"]] >= by_name[e["dst"]], e
+    # and the seed's four cycles stay gone: acyclic edge set
+    adj = {}
+    for e in graph["edges"]:
+        adj.setdefault(e["src"], set()).add(e["dst"])
+    assert fabdep._find_cycles(adj) == []
+
+
+def test_repo_suppressions_all_carry_reasons():
+    # every in-tree fabdep suppression must justify itself with a
+    # trailing comment, same discipline as fablint
+    offenders = []
+    for path in (REPO / "fabric_tpu").rglob("*.py"):
+        if "__pycache__" in path.parts:
+            continue
+        for n, line in enumerate(path.read_text().splitlines(), start=1):
+            if "# fabdep: disable=" in line:
+                after = line.split("# fabdep: disable=", 1)[1]
+                if "#" not in after:
+                    offenders.append(f"{path}:{n}")
+    assert offenders == [], offenders

@@ -120,6 +120,144 @@ def test_pipeline_commits_in_order_with_overlap(tmp_path, world):
     assert t_prep2 < t_end1, events
 
 
+def _chain_for_channel(world, channel_id, n_blocks, txs_per_block=3):
+    """Like _chain but for an arbitrary channel id, with one corrupted
+    creator signature per block so the expected mask is NOT all-VALID —
+    a race that flips a lane must show up as a byte difference."""
+    blocks = []
+    prev = b""
+    for num in range(n_blocks):
+        block = protoutil.new_block(num, prev)
+        for i in range(txs_per_block):
+            bundle = create_proposal(
+                world["client"], channel_id, "cc", [b"put", f"b{num}k{i}".encode()]
+            )
+            results = serialize_tx_rwset(
+                rw.TxRwSet(
+                    (
+                        rw.NsRwSet(
+                            "cc",
+                            (),
+                            (rw.KVWrite(f"{channel_id}b{num}k{i}", False, b"v"),),
+                        ),
+                    )
+                )
+            )
+            responses = [endorse_proposal(bundle, world["peer"], results)]
+            env = create_signed_tx(bundle, world["client"], responses)
+            if i == txs_per_block - 1:
+                # corrupt the creator signature -> BAD_CREATOR_SIGNATURE
+                env.signature = bytes(env.signature[:-1]) + bytes(
+                    [env.signature[-1] ^ 0xFF]
+                )
+            block.data.data.append(env.SerializeToString())
+        protoutil.seal_block(block)
+        prev = protoutil.block_header_hash(block.header)
+        blocks.append(block)
+    return blocks
+
+
+def test_pipeline_8_threads_mask_bitexact_vs_serial(tmp_path, world):
+    """Hammer the commit machinery from 8 pipelines on 8 threads at once
+    (shared provider, shared MSP manager, shared hostec tables/pool) and
+    require every channel's TRANSACTIONS_FILTER to match a single-threaded
+    reference byte for byte.  This is the regression test for the
+    stage-A/stage-B shared state audited in PR 3 (validator ident-cache
+    lock, provider factory lock, hostec table lock): any cross-thread
+    interference that flips a lane breaks the mask equality."""
+    n_threads, n_blocks = 8, 5
+    chains = {
+        f"hammer{t}": _chain_for_channel(world, f"hammer{t}", n_blocks)
+        for t in range(n_threads)
+    }
+
+    def fresh_channel(channel_id, root):
+        return Channel(
+            channel_id,
+            str(root),
+            world["mgr"],
+            world["registry"],
+            PROVIDER,
+        )
+
+    # serial reference: one channel at a time, direct store_block
+    reference = {}
+    for cid, blocks in chains.items():
+        ch = fresh_channel(cid, tmp_path / f"serial-{cid}")
+        flags = []
+        for b in blocks:
+            # store_block mutates block metadata; keep the originals
+            # pristine for the parallel run
+            copy = protoutil.new_block(0, b"")
+            copy.CopyFrom(b)
+            flags.append(ch.store_block(copy).tobytes())
+        reference[cid] = flags
+
+    # parallel run: 8 pipelines, one submitter thread per channel, all
+    # released together
+    results = {cid: [] for cid in chains}
+    errors = []
+    barrier = threading.Barrier(n_threads)
+
+    def drive(cid, blocks, pipe):
+        try:
+            barrier.wait(timeout=30)
+            for b in blocks:
+                pipe.submit(b)
+        except Exception as exc:  # noqa: BLE001 - surfaced via errors
+            errors.append((cid, repr(exc)))
+
+    pipes = {}
+    threads = []
+    try:
+        for cid, blocks in chains.items():
+            ch = fresh_channel(cid, tmp_path / f"par-{cid}")
+            pipes[cid] = CommitPipeline(
+                ch,
+                on_commit=lambda b, f, cid=cid: results[cid].append(
+                    f.tobytes()
+                ),
+                on_error=lambda b, exc, cid=cid: errors.append(
+                    (cid, repr(exc))
+                ),
+            )
+        for cid, blocks in chains.items():
+            t = threading.Thread(
+                target=drive, args=(cid, blocks, pipes[cid]), daemon=True
+            )
+            threads.append(t)
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        for pipe in pipes.values():
+            assert pipe.drain(timeout=120)
+    finally:
+        for pipe in pipes.values():
+            pipe.stop()
+
+    assert not errors, errors
+    for cid in chains:
+        assert len(results[cid]) == n_blocks, (cid, len(results[cid]))
+        assert results[cid] == reference[cid], (
+            f"{cid}: pipelined mask diverged from the serial reference"
+        )
+        # the corrupted lane really is invalid in the reference
+        assert any(bytes(f) != b"\x00" * 3 for f in reference[cid])
+
+
+def test_pipeline_submit_after_stop_raises_fast(tmp_path, world):
+    """A full queue + a stopped committer must not deadlock submit
+    (the bounded-put fix in pipeline.submit)."""
+    ch = Channel(
+        CHANNEL, str(tmp_path), world["mgr"], world["registry"], PROVIDER
+    )
+    blocks = _chain(world, 1)
+    pipe = CommitPipeline(ch)
+    pipe.stop()
+    with pytest.raises(Exception, match="stopped"):
+        pipe.submit(blocks[0])
+
+
 def test_pipeline_surfaces_commit_errors(tmp_path, world):
     ch = Channel(
         CHANNEL,
