@@ -1,0 +1,6 @@
+"""Shared leaf types (lowest layer above protos).
+
+`limbparams` is the canonical, jax-free home of the limb-radix
+constants (LIMB_BITS / NLIMBS / LIMB_MASK / RADIX_BITS);
+`fabric_tpu.ops.bignum` re-exports them for the device tier.
+"""

@@ -1,0 +1,20 @@
+"""The canonical limb-radix parameters — single source of truth.
+
+Radix-2^13, 20-limb representation (260 bits for 256-bit fields): the
+one headroom bet the whole device ops layer rests on, proven
+overflow-free by the fabflow gate (see ops/bignum.py for the CIOS
+accumulator bound it mechanizes: worst case < 0.625 * 2^32 < 2^32).
+
+This module is dependency-free so HOST-tier code (crypto/hostec,
+common/fp256bn, tools) can reference the constants without importing
+jax; fabric_tpu.ops.bignum re-exports them under the historical names.
+Hardcoding 13 / 20 / 0x1fff / 8192 / 260 anywhere in the limb tier is a
+fabflow `const-drift` finding.
+"""
+
+from __future__ import annotations
+
+LIMB_BITS = 13
+NLIMBS = 20
+LIMB_MASK = (1 << LIMB_BITS) - 1
+RADIX_BITS = LIMB_BITS * NLIMBS  # 260

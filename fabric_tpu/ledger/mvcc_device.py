@@ -74,7 +74,7 @@ def _next_pow2(n: int, floor: int = 8) -> int:
     return p
 
 
-def _col(vals, pad_to, pad_val, dtype=np.int32):
+def _col(vals: Sequence[int], pad_to: int, pad_val: int, dtype=np.int32):
     a = np.full(pad_to, pad_val, dtype=dtype)
     a[: len(vals)] = vals
     return a

@@ -36,10 +36,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-LIMB_BITS = 13
-NLIMBS = 20
-LIMB_MASK = (1 << LIMB_BITS) - 1
-RADIX_BITS = LIMB_BITS * NLIMBS  # 260
+# Canonical limb parameters live in the jax-free common tier
+# (fabric_tpu/common/limbparams.py) so host code and tools can use them
+# without pulling in jax; re-exported here under the historical names.
+from fabric_tpu.common.limbparams import (  # noqa: F401
+    LIMB_BITS,
+    LIMB_MASK,
+    NLIMBS,
+    RADIX_BITS,
+)
 
 # A big number inside a kernel: tuple of NLIMBS arrays, each (*batch).
 LimbVec = Tuple[jax.Array, ...]
@@ -197,7 +202,9 @@ class MontCtx:
         hi, lo = d
         if lo < 0:
             return q << np.uint32(hi)
-        return (q << np.uint32(hi)) - (q << np.uint32(lo))
+        # interval domain sees [-(8191<<12), 8191<<13]; hi > lo makes the
+        # subtraction non-negative, bounded by q*m_j <= 8191*8192 < 2^26
+        return (q << np.uint32(hi)) - (q << np.uint32(lo))  # fabflow: disable=limb-overflow  # hi>lo => result in [0, 8191<<13 = 67100672 < 2**27]; relational fact outside the interval domain
 
 
 def cond_sub_l(ctx: MontCtx, xs: Sequence[jax.Array]) -> List[jax.Array]:
@@ -280,6 +287,15 @@ def mont_mul_l(
     m0inv = ctx.m0inv
     zero = jnp.zeros_like(a[0])
     t: List[jax.Array] = [zero] * NLIMBS
+    # Static headroom proof (mechanized by tools/fabflow over this very
+    # loop): with canonical 13-bit limbs, each iteration adds at most
+    # ai*b[j] + q*m_j <= 8191^2 + 8191*2^13 = 134193153 < 2^27 per limb,
+    # plus the shifted-down carry (<= 327657).  The abstractly-unrolled
+    # 20-iteration worst case is 2684174334 < 0.625 * 2^32 < 2^32 - 1,
+    # so the uint32 lazy-carry accumulator can never wrap.  Adding ONE
+    # more accumulation term per iteration (e.g. a third product) would
+    # push the bound to ~0.94 * 2^32 and an extra limb (NLIMBS=21) to
+    # ~0.66 * 2^32 — the gate recomputes this on every change.
     for i in range(NLIMBS):
         ai = a[i]
         t0 = t[0] + ai * b[0]
@@ -327,6 +343,9 @@ def _mont_mul_l_looped(
         t0 = t[0] + ai * b_s[0]
         q = ((t0 & LIMB_MASK) * m0inv) & LIMB_MASK
         carry0 = (t0 + q * m_s[0]) >> LIMB_BITS
+        # same accumulator recurrence as the unrolled form: per-limb
+        # growth < 2^27 per step, 20-step worst case < 0.625 * 2^32
+        # (fabflow unrolls lax.fori_loop(0, NLIMBS) and re-proves it)
         nt = t[1:] + ai * b_s[1:] + q * m_s[1:]
         nt = nt.at[0].add(carry0)
         return jnp.concatenate([nt, jnp.zeros_like(t[:1])])

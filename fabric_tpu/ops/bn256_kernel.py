@@ -123,7 +123,7 @@ def point_double(p: Point) -> Point:
 _pack = fo.pack_point
 
 
-def _unpack(c, bound=4) -> Point:
+def _unpack(c: Sequence[Sequence[jax.Array]], bound: int = 4) -> Point:
     return Point(
         fe_norm(FE(tuple(c[0]), bound)), fe(c[1]), fe(c[2])
     )

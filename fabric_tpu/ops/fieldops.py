@@ -10,7 +10,7 @@ and the point pack/unpack helpers exist exactly once
 
 from __future__ import annotations
 
-from typing import NamedTuple
+from typing import NamedTuple, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -39,7 +39,7 @@ class Field:
         self.one_mont = bn.int_to_limbs((1 << bn.RADIX_BITS) % ctx.m)
 
     @staticmethod
-    def fe(limbs, bound: int = 1) -> FE:
+    def fe(limbs: Sequence[jax.Array], bound: int = 1) -> FE:
         return FE(tuple(limbs), bound)
 
     def mul(self, a: FE, b: FE) -> FE:
@@ -80,7 +80,11 @@ def pack_point(p: Point):
     return (p.x.limbs, p.y.limbs, p.z.limbs)
 
 
-def unpack_point(c, x_bound: int = 4) -> Point:
+def unpack_point(
+    c: Sequence[Sequence[jax.Array]], x_bound: int = 4
+) -> Point:
+    # c carries canonical 13-bit limbs (the pack_point contract fabflow
+    # assumes and re-proves per kernel)
     return Point(FE(tuple(c[0]), x_bound), FE(tuple(c[1]), 1), FE(tuple(c[2]), 1))
 
 

@@ -238,7 +238,7 @@ def _select_point(table: jax.Array, idx: jax.Array) -> Point:
 _pack_point = fo.pack_point
 
 
-def _unpack_point(c) -> Point:
+def _unpack_point(c: Sequence[Sequence[jax.Array]]) -> Point:
     return fo.unpack_point(c, x_bound=1)
 
 

@@ -56,7 +56,9 @@ _SIX_U_TWO = 6 * host.U + 2
 _N_BITS = bin(abs(_SIX_U_TWO))[3:]  # loop bits after the implicit MSB
 
 
-def _line_coeffs(t, q) -> Tuple[host.Fp12, host.Fp12]:
+def _line_coeffs(
+    t: Tuple[host.Fp12, host.Fp12], q: Tuple[host.Fp12, host.Fp12]
+) -> Tuple[host.Fp12, host.Fp12]:
     """(A, B) with l(P) = A + B·px + py, mirroring host _line for the
     tangent (t==q) and chord cases.  Vertical lines cannot occur for
     the order-r points used here — asserted."""

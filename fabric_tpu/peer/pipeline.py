@@ -18,6 +18,7 @@ import queue
 import threading
 from typing import Callable, Optional
 
+from fabric_tpu.common.flogging import must_get_logger
 from fabric_tpu.protos import common_pb2
 
 
@@ -106,6 +107,15 @@ class CommitPipeline:
             except Exception as exc:  # noqa: BLE001 - surfaced to the owner
                 if self.on_error is not None:
                     self.on_error(block, exc)
+                else:
+                    # no owner callback installed: a silently dropped
+                    # block would stall the channel with no trace
+                    # (fabflow mask-fail-open audit) — log loudly
+                    must_get_logger("pipeline").error(
+                        "commit of block %s failed with no on_error "
+                        "handler installed: %s",
+                        getattr(block.header, "number", "?"), exc,
+                    )
             finally:
                 with self._pending_lock:
                     self._pending -= 1

@@ -1,4 +1,9 @@
-"""Developer tooling: static analysis (fablint) and repo gates.
+"""Developer tooling: the static-analysis gates.
+
+- ``fablint``  — per-file AST invariants (imports, excepts, asserts...)
+- ``fabdep``   — whole-program import layering + concurrency analysis
+- ``fabflow``  — value-range/dtype abstract interpreter (the limb
+  headroom proof) + mask-soundness pass
 
 Everything in this package is dependency-free stdlib so the gates run in
 minimal environments (no ``cryptography``, no ``jax``) without importing

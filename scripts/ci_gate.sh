@@ -7,43 +7,45 @@
 #   4. fabdep                  -- whole-program gates: the package import
 #                                 graph is a layered DAG (tools/layers.toml)
 #                                 and the concurrency/API-surface rules pass
+#   5. fabflow                 -- value-range/dtype abstract interpreter:
+#                                 the limb kernels are overflow-free under
+#                                 the canonical-limb contract and the mask
+#                                 paths fail closed
 #
 # Each stage runs even if an earlier one failed (one run reports ALL
-# broken gates); the exit code is nonzero if ANY stage failed.
+# broken gates) and prints its wall-clock time; the exit code is nonzero
+# if ANY stage failed.
 set -uo pipefail
 
 cd "$(dirname "$0")/.."
 
 fail=0
+failed_stages=""
 
-echo "== ci_gate 1/4: compileall =="
-if ! timeout -k 5 120 python -m compileall -q fabric_tpu; then
-    echo "ci_gate: compileall FAIL" >&2
-    fail=1
-fi
+run_stage() {
+    # run_stage <label> <command...>
+    local label="$1"
+    shift
+    echo "== ci_gate ${label} =="
+    local t0=$SECONDS
+    if ! "$@"; then
+        echo "ci_gate: ${label} FAIL" >&2
+        fail=1
+        failed_stages="${failed_stages} ${label}"
+    fi
+    echo "-- ${label}: $((SECONDS - t0))s"
+}
 
-echo "== ci_gate 2/4: collect_gate =="
-if ! bash scripts/collect_gate.sh; then
-    echo "ci_gate: collect_gate FAIL" >&2
-    fail=1
-fi
-
-# both linters' human output already prints findings as
+run_stage "1/5 compileall" timeout -k 5 120 python -m compileall -q fabric_tpu
+run_stage "2/5 collect_gate" bash scripts/collect_gate.sh
+# the linters' human output already prints findings as
 # path:line:col: rule: message — no JSON round-trip needed
-echo "== ci_gate 3/4: fablint =="
-if ! timeout -k 5 60 python -m fabric_tpu.tools.fablint fabric_tpu/; then
-    echo "ci_gate: fablint FAIL" >&2
-    fail=1
-fi
-
-echo "== ci_gate 4/4: fabdep =="
-if ! timeout -k 5 60 python -m fabric_tpu.tools.fabdep fabric_tpu/; then
-    echo "ci_gate: fabdep FAIL" >&2
-    fail=1
-fi
+run_stage "3/5 fablint" timeout -k 5 60 python -m fabric_tpu.tools.fablint fabric_tpu/
+run_stage "4/5 fabdep" timeout -k 5 60 python -m fabric_tpu.tools.fabdep fabric_tpu/
+run_stage "5/5 fabflow" timeout -k 5 120 python -m fabric_tpu.tools.fabflow fabric_tpu/
 
 if [ "$fail" -ne 0 ]; then
-    echo "ci_gate: FAIL" >&2
+    echo "ci_gate: FAIL (stages:${failed_stages})" >&2
     exit 1
 fi
-echo "ci_gate: OK (compileall + collect + fablint + fabdep)"
+echo "ci_gate: OK (compileall + collect + fablint + fabdep + fabflow)"

@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# fabflow gate: abstract-interpret fabric_tpu/ and fail on any
+# value-range / dtype / mask-soundness violation.
+#
+# Dependency-free and import-free: fabflow parses source with ast and
+# interprets it over an interval domain — it never imports the analyzed
+# modules, so this gate passes/fails identically in minimal environments
+# (no cryptography, no jax).  Runs in ~6s.
+set -uo pipefail
+
+cd "$(dirname "$0")/.."
+
+timeout -k 5 120 python -m fabric_tpu.tools.fabflow fabric_tpu/
+rc=$?
+
+if [ "$rc" -ne 0 ]; then
+    echo "flow_gate: FAIL (fabflow rc=$rc)" >&2
+    exit 1
+fi
+echo "flow_gate: OK"

@@ -117,3 +117,42 @@ def test_mont_pow_zero(ctx):
     arr = bn.ints_to_limbs([0, 0])
     got = bn.limbs_to_ints(np.asarray(bn.mont_pow(ctx, arr, ctx.m - 2)))
     assert got == [0, 0]
+
+
+def test_mont_mul_near_overflow_boundary(ctx):
+    """Deliberate near-overflow regression at the proven worst-case
+    interval boundary (fabflow's mechanized CIOS bound: the uint32
+    lazy-carry accumulator peaks at 2684174334 (< 0.625 * 2^32) when every
+    limb sits at 0x1fff).  Dense-limb operands — 19 limbs of 0x1fff —
+    and operands at the documented 4m input edge (c1*c2 = 16, the
+    nreduce=1 limit) are squared and chained; every step must stay
+    bit-exact with the Python-int oracle.  If someone widens the radix,
+    adds an accumulation term, or drops a carry, this chain wraps and
+    diverges."""
+    m = ctx.m
+    rinv = pow(1 << bn.RADIX_BITS, -1, m)
+    dense = (1 << 255) - 1  # 13-bit limbs: nineteen 0x1fff + 0xff top
+    edge = 4 * m - 1        # laxest documented mont_mul input bound
+    ops = [dense, edge, m - 1, dense % m]
+    a = bn.ints_to_limbs(ops)
+    assert (np.asarray(a)[:19, 0] == bn.LIMB_MASK).all()
+
+    # chained squarings keep the accumulator at its densest: the oracle
+    # tracks x -> x*x*R^-1 mod m exactly
+    want = list(ops)
+    got = a
+    for _ in range(8):
+        got = bn.mont_mul(ctx, got, got)
+        want = [(x * x * rinv) % m for x in want]
+        assert bn.limbs_to_ints(np.asarray(got)) == want
+
+    # cross-products of the boundary operands (including 4m-edge pairs)
+    pairs = [(x, y) for x in ops for y in ops]
+    pa = bn.ints_to_limbs([x for x, _ in pairs])
+    pb = bn.ints_to_limbs([y for _, y in pairs])
+    got_p = bn.limbs_to_ints(np.asarray(bn.mont_mul(ctx, pa, pb)))
+    assert got_p == [(x * y * rinv) % m for x, y in pairs]
+
+    # the carry chain on a dense add_raw result (value = sum, no mod)
+    s = bn.add_raw(bn.ints_to_limbs([dense]), bn.ints_to_limbs([dense]))
+    assert bn.limbs_to_ints(np.asarray(s)) == [2 * dense]
