@@ -104,6 +104,112 @@ def bench_cpu_baseline(triples, budget_s=2.0):
     return count / (time.perf_counter() - start)
 
 
+# The bounded OUT-OF-PROCESS device probe lives in utils/deviceprobe
+# (round-5 postmortem: the in-process daemon-thread probe timed out but
+# left the thread wedged inside backend init, and the verdict was
+# re-derived per call; the subprocess probe gets a HARD kernel-enforced
+# timeout and a per-run cached verdict).  bench.py is its batch-entry
+# consumer — the library path keeps the cheap in-process probe.
+
+
+def bench_host_ladder(triples, budget_s=None):
+    """hostec vs hostec_np verifies/s at 1k/4k/16k lanes — the host
+    backend-ladder column the numpy tier is judged by.  Both engines
+    run their production sharded entrypoints (process pools warm, one
+    timed pass per size) on the SAME parsed batch; the 4096-lane ratio
+    is the acceptance number."""
+    from fabric_tpu.crypto import hostec
+    from fabric_tpu.crypto.bccsp import SoftwareProvider
+
+    if budget_s is None:
+        budget_s = float(os.environ.get("BENCH_LADDER_BUDGET_S", "150"))
+    try:
+        from fabric_tpu.crypto import hostec_np
+    except Exception:  # pragma: no cover - broken partial install
+        hostec_np = None
+    have_np = hostec_np is not None and hostec_np.HAVE_NUMPY
+
+    sw = SoftwareProvider()
+    out = {"engines": ["hostec"] + (["hostec_np"] if have_np else [])}
+    if not have_np:
+        out["hostec_np"] = {"skipped": "numpy not installed"}
+    else:
+        hostec_np.warm_tables()  # one-time comb build out of the timing
+    start = time.monotonic()
+    sizes = [n for n in (1024, 4096, 16384) if n <= len(triples)]
+    if not sizes:
+        out["skipped"] = (
+            f"BENCH_N={len(triples)} below the smallest ladder size"
+        )
+        return out
+    # one DER parse of the largest batch; the smaller sizes are strict
+    # prefixes of it
+    sub = triples[: sizes[-1]]
+    parsed = sw._parse_lanes(
+        [t[0] for t in sub], [t[1] for t in sub], [t[2] for t in sub]
+    )
+    for lanes_n in sizes:
+        out[str(lanes_n)] = {}
+    engines = [("hostec", hostec)]
+    if have_np:
+        engines.append(("hostec_np", hostec_np))
+    # engine-major: exactly ONE engine's process pool is alive at a
+    # time (on a 2-vCPU box two pools' workers thrash each other), and
+    # each engine pays its pool boot once, untimed.  The warm pass uses
+    # the LARGEST size: hostec_np only touches its pool from
+    # MIN_POOL_LANES lanes up, so a small warm batch would leave the
+    # spawn cost inside the first big timed pass.
+    for name, mod in engines:
+        if time.monotonic() - start > budget_s:
+            # don't pay an engine's warm pass (pool boot + a full
+            # largest-size verify) when every timed pass would be
+            # skipped anyway
+            for lanes_n in sizes:
+                out[str(lanes_n)][name] = "skipped: ladder budget exhausted"
+            continue
+        try:
+            mod.verify_parsed_batch_sharded(parsed)()
+            for lanes_n in sizes:
+                if time.monotonic() - start > budget_s:
+                    out[str(lanes_n)][name] = (
+                        "skipped: ladder budget exhausted"
+                    )
+                    continue
+                # best of two passes: this box's wall clock is noisy
+                # enough (shared gVisor host) that one pass swings 1.5x
+                best = None
+                for _pass in range(2):
+                    t0 = time.perf_counter()
+                    verdicts = mod.verify_parsed_batch_sharded(
+                        parsed[:lanes_n]
+                    )()
+                    dt = time.perf_counter() - t0
+                    if not all(verdicts):
+                        raise RuntimeError(
+                            f"{name}: benchmark sig rejected"
+                        )
+                    best = dt if best is None else min(best, dt)
+                    if time.monotonic() - start > budget_s:
+                        break
+                out[str(lanes_n)][name] = round(lanes_n / best, 1)
+        finally:
+            # a raise mid-pass must not leave this engine's workers
+            # alive to compete with every later bench config
+            mod.shutdown_pool()
+    for lanes_n in sizes:
+        row = out[str(lanes_n)]
+        if (
+            have_np
+            and isinstance(row.get("hostec"), float)
+            and isinstance(row.get("hostec_np"), float)
+        ):
+            row["np_speedup"] = round(row["hostec_np"] / row["hostec"], 2)
+    r4096 = out.get("4096", {})
+    if isinstance(r4096, dict) and "np_speedup" in r4096:
+        out["acceptance_ratio_4096"] = r4096["np_speedup"]
+    return out
+
+
 def bench_headline_device(triples, iters):
     """Device half of config #1. Returns (device_rate, degraded) — the
     caller already owns the CPU column. Any raise is caught by main()
@@ -771,6 +877,10 @@ def main():
     except Exception as exc:  # noqa: BLE001 - ladder column is best-effort
         configs["host_ec_tiers"] = {"error": str(exc)[:300]}
     try:
+        configs["host_ladder"] = bench_host_ladder(triples)
+    except Exception as exc:  # noqa: BLE001 - ladder column is best-effort
+        configs["host_ladder"] = {"error": str(exc)[:300]}
+    try:
         import subprocess
 
         rev = subprocess.run(
@@ -846,15 +956,17 @@ def main():
 
     threading.Thread(target=_watchdog, name="bench-watchdog", daemon=True).start()
 
-    # ---- bounded device probe, then the device headline
-    from fabric_tpu.utils.deviceprobe import accelerator_present, probe_error
-
+    # ---- bounded device probe (subprocess: a hung backend init is
+    # ---- KILLED by the kernel, and the verdict is cached for the run)
     probe_s = min(float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "300")),
                   max(budget_s * 0.3, 60.0))
-    device_ok = accelerator_present(probe_s)
+    from fabric_tpu.utils.deviceprobe import probe_subprocess
+
+    device_ok, probe_err = probe_subprocess(probe_s)
+    result["detail"]["probe"] = "subprocess"
     if not device_ok:
         result["detail"]["device"] = "unavailable"
-        result["detail"]["error"] = probe_error() or "no accelerator device"
+        result["detail"]["error"] = probe_err or "no accelerator device"
         emit()
     else:
         import jax

@@ -6,8 +6,10 @@ overflow-free by the fabflow gate (see ops/bignum.py for the CIOS
 accumulator bound it mechanizes: worst case < 0.625 * 2^32 < 2^32).
 
 This module is dependency-free so HOST-tier code (crypto/hostec,
-common/fp256bn, tools) can reference the constants without importing
-jax; fabric_tpu.ops.bignum re-exports them under the historical names.
+crypto/hostec_np — which condenses adjacent limbs into 2^(2*LIMB_BITS)
+pair rows for its numpy kernels — common/fp256bn, tools) can reference
+the constants without importing jax; fabric_tpu.ops.bignum re-exports
+them under the historical names.
 Hardcoding 13 / 20 / 0x1fff / 8192 / 260 anywhere in the limb tier is a
 fabflow `const-drift` finding.
 """

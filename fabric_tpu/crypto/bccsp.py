@@ -9,8 +9,9 @@ Stage 1: the sidecar collects per-block batches under the hood).
 Providers:
 - SoftwareProvider: host-only, mirrors bccsp/sw (verifyECDSA:
   DER unmarshal -> low-S check -> ecdsa.Verify, bccsp/sw/ecdsa.go:41-57).
-  Its curve math rides a three-tier backend ladder: fastec (OpenSSL via
-  the cryptography package) -> hostec (dependency-free vectorized pure
+  Its curve math rides a four-tier backend ladder: fastec (OpenSSL via
+  the cryptography package) -> hostec_np (numpy limb-matrix lanes with
+  shared-memory shards) -> hostec (dependency-free vectorized pure
   Python, batches sharded across CPU cores) -> p256 (the clarity-first
   oracle; explicit selection only, never an automatic fallback).
   Select with BCCSP.SW.ECBackend config / FABRIC_TPU_EC_BACKEND /
@@ -36,15 +37,16 @@ from fabric_tpu.crypto import hostec
 logger = must_get_logger("bccsp")
 
 # ---------------------------------------------------------------------------
-# Host EC backend ladder: fastec (OpenSSL) -> hostec (vectorized pure
-# Python) -> p256 (clarity-first oracle).  All three share one semantics
-# contract (Go crypto/ecdsa.Verify decision, low-S pre-checked by callers
-# via parse_and_precheck) and are differentially tested against each other.
+# Host EC backend ladder: fastec (OpenSSL) -> hostec_np (numpy
+# limb-matrix lanes) -> hostec (vectorized pure Python) -> p256
+# (clarity-first oracle).  All tiers share one semantics contract (Go
+# crypto/ecdsa.Verify decision, low-S pre-checked by callers via
+# parse_and_precheck) and are differentially tested against each other.
 # The oracle is never auto-selected — it exists for tests and explicit
 # opt-in only.
 # ---------------------------------------------------------------------------
 
-EC_TIERS = ("fastec", "hostec", "p256")
+EC_TIERS = ("fastec", "hostec_np", "hostec", "p256")
 
 
 def _load_ec_backend(name: str):
@@ -53,6 +55,16 @@ def _load_ec_backend(name: str):
         from fabric_tpu.crypto import fastec
 
         return fastec
+    if name == "hostec_np":
+        from fabric_tpu.crypto import hostec_np
+
+        if not hostec_np.HAVE_NUMPY:
+            # the module itself imports fine without numpy (guarded
+            # import), but the TIER is unavailable; callers decide what
+            # that means (the auto walk logs the skip, an explicit pin
+            # propagates this as a hard error)
+            raise ImportError("hostec_np requires numpy")
+        return hostec_np
     if name == "hostec":
         return hostec
     if name == "p256":
@@ -64,7 +76,8 @@ def _load_ec_backend(name: str):
 
 def available_ec_backends():
     """Tier name -> importable right now. hostec and p256 are pure Python
-    and always available; fastec needs the ``cryptography`` package."""
+    and always available; fastec needs the ``cryptography`` package and
+    hostec_np needs numpy."""
     out = {}
     for name in EC_TIERS:
         try:
@@ -79,9 +92,9 @@ def select_ec_backend(name: str = "auto"):
     """Select the process-wide scalar/batch EC backend and return it.
 
     ``auto`` honors FABRIC_TPU_EC_BACKEND when it names a usable tier,
-    else warns and walks the ladder fastec -> hostec (the oracle is
-    never an auto choice) — asking for ``auto`` NEVER raises, so a
-    malformed env var cannot poison imports or a valid config.  An
+    else warns and walks the ladder fastec -> hostec_np -> hostec (the
+    oracle is never an auto choice) — asking for ``auto`` NEVER raises,
+    so a malformed env var cannot poison imports or a valid config.  An
     explicitly named unavailable tier raises ImportError so a configured
     expectation is never silently downgraded."""
     global _ec
@@ -99,28 +112,39 @@ def select_ec_backend(name: str = "auto"):
 
             warnings.warn(
                 f"FABRIC_TPU_EC_BACKEND: {exc}; using the "
-                "fastec->hostec auto ladder",
+                "fastec->hostec_np->hostec auto ladder",
                 RuntimeWarning,
                 stacklevel=2,
             )
-    try:
-        _ec = _load_ec_backend("fastec")
-    except ImportError:
-        _ec = hostec
+    for tier in ("fastec", "hostec_np"):
+        try:
+            _ec = _load_ec_backend(tier)
+            return _ec
+        except ImportError:
+            if tier == "hostec_np":
+                # loudly-in-the-log, silently-for-callers: the numpy
+                # rung is skipped only here, on the auto walk
+                logger.warning(
+                    "hostec_np tier skipped (numpy not installed); "
+                    "walking down to hostec"
+                )
+            continue
+    _ec = hostec
     return _ec
 
 
 def ec_backend():
     """The active scalar-EC module: ``fastec`` (OpenSSL) when available,
-    else the vectorized pure-Python ``hostec`` tier; the ``p256`` oracle
-    only on explicit selection.  Exposed so callers (msp.signer, bench,
-    the validator) share one seam and can report which backend actually
-    ran."""
+    else the numpy ``hostec_np`` tier, else the vectorized pure-Python
+    ``hostec`` tier; the ``p256`` oracle only on explicit selection.
+    Exposed so callers (msp.signer, bench, the validator) share one
+    seam and can report which backend actually ran."""
     return _ec
 
 
 def ec_backend_name() -> str:
-    """Short tier name of the active backend (``fastec``/``hostec``/``p256``)."""
+    """Short tier name of the active backend
+    (``fastec``/``hostec_np``/``hostec``/``p256``)."""
     return _ec.__name__.rsplit(".", 1)[-1]
 
 
@@ -260,24 +284,23 @@ class SoftwareProvider(Provider):
         signatures: Sequence[bytes],
         digests: Sequence[bytes],
     ) -> List[bool]:
-        if _ec is not hostec:
+        sharded = getattr(_ec, "verify_parsed_batch_sharded", None)
+        if sharded is None:
             return super().batch_verify(keys, signatures, digests)
-        return hostec.verify_parsed_batch_sharded(
-            self._parse_lanes(keys, signatures, digests)
-        )()
+        return sharded(self._parse_lanes(keys, signatures, digests))()
 
     def batch_verify_async(self, keys, signatures, digests):
         """Resolver-style dispatch (the VerifyBatcher/validator seam): on
-        the hostec tier the batch is sharded across the process pool and
-        the resolver joins the shards (order-preserving), overlapping any
-        host work the caller does before resolving.  Other tiers compute
-        synchronously and hand back a trivial resolver."""
-        if _ec is not hostec:
+        the hostec/hostec_np tiers the batch is sharded across the
+        process pool and the resolver joins the shards
+        (order-preserving), overlapping any host work the caller does
+        before resolving.  Other tiers compute synchronously and hand
+        back a trivial resolver."""
+        sharded = getattr(_ec, "verify_parsed_batch_sharded", None)
+        if sharded is None:
             out = Provider.batch_verify(self, keys, signatures, digests)
             return lambda: out
-        return hostec.verify_parsed_batch_sharded(
-            self._parse_lanes(keys, signatures, digests)
-        )
+        return sharded(self._parse_lanes(keys, signatures, digests))
 
 
 class PurePythonProvider(SoftwareProvider):

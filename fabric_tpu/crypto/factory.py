@@ -54,23 +54,38 @@ def provider_from_config(cfg: Optional[dict]) -> Provider:
             "(only SHA2-256 is implemented)"
         )
 
-    # Host EC tier (fastec -> hostec -> p256 ladder, crypto/bccsp.py):
-    # process-wide, since every provider's host path shares the seam.  An
-    # explicitly configured tier that can't load is a hard error — an
-    # operator who pinned the OpenSSL tier must not silently run the
-    # slower ladder, mirroring the PKCS11 discipline below.  An ABSENT
-    # key leaves the current process-wide selection alone, so building a
-    # provider from a plain config cannot reset an earlier explicit pin.
+    # Host EC tier (fastec -> hostec_np -> hostec -> p256 ladder,
+    # crypto/bccsp.py): process-wide, since every provider's host path
+    # shares the seam.  A KNOWN tier that can't load is a hard error —
+    # an operator who pinned the OpenSSL tier must not silently run the
+    # slower ladder, mirroring the PKCS11 discipline below.  An UNKNOWN
+    # value warns and leaves the current selection alone (a config
+    # written for a newer ladder must not brick an older node), exactly
+    # like the FABRIC_TPU_EC_BACKEND env-var semantics from PR 1.  An
+    # ABSENT key also leaves the selection alone, so building a provider
+    # from a plain config cannot reset an earlier explicit pin.
     if "ECBackend" in sw_cfg:
         ec_backend = str(sw_cfg["ECBackend"]).lower()
-        try:
-            from fabric_tpu.crypto.bccsp import (
-                ec_backend_name,
-                select_ec_backend,
-            )
+        from fabric_tpu.crypto.bccsp import (
+            ec_backend_name,
+            select_ec_backend,
+        )
 
+        try:
             select_ec_backend(ec_backend)
-        except (ImportError, ValueError) as exc:
+        except ValueError:
+            # error-level: this may be a typo'd pin running a slower
+            # tier than the operator intended — but per the ladder's
+            # forward-compat contract an unknown NAME never bricks an
+            # older node (a KNOWN-but-unavailable tier still raises)
+            logger.error(
+                "BCCSP.SW.ECBackend %r is not a known tier "
+                "(fastec/hostec_np/hostec/p256); keeping the current "
+                "%s backend",
+                ec_backend,
+                ec_backend_name(),
+            )
+        except ImportError as exc:
             raise FactoryError(
                 f"BCCSP.SW.ECBackend {ec_backend!r} unavailable: {exc}"
             ) from exc

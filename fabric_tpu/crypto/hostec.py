@@ -266,20 +266,15 @@ def warm_tables() -> None:
 # ---------------------------------------------------------------------------
 
 
-def verify_parsed_batch(
-    lanes: Sequence[Tuple[PubKey, bytes, int, int]],
-) -> List[bool]:
-    """One vectorized pass over (pub, digest, r, s) lanes, all in THIS
-    process. Bit-exact with ``p256.verify_digest`` per lane; the low-S rule
-    is NOT applied here (same contract as the oracle and fastec)."""
+def _precheck_lanes(lanes):
+    """Per-lane prechecks mirroring the oracle exactly: r/s range, key
+    present, coordinates in range, curve equation.  Bad lanes get
+    benign substitutes (r = s = 1, Q = G, e = 0) so vector math stays
+    defined, and must be forced False at the end.  Shared by this
+    engine and crypto/hostec_np — the tiers' accept/reject sets are a
+    load-bearing bit-exactness contract, so there is exactly ONE copy
+    of it."""
     nlanes = len(lanes)
-    if nlanes == 0:
-        return []
-
-    # Per-lane prechecks mirror the oracle exactly: r/s range, key present,
-    # coordinates in range, curve equation. Bad lanes get benign
-    # substitutes (r = s = 1, Q = G) so the vector math stays defined, and
-    # are forced False at the end.
     valid = [True] * nlanes
     rr = [1] * nlanes
     ss = [1] * nlanes
@@ -299,6 +294,20 @@ def verify_parsed_batch(
         rr[i], ss[i] = r, s
         qx[i], qy[i] = x, y
         ee[i] = hash_to_int(digest)
+    return valid, rr, ss, qx, qy, ee
+
+
+def verify_parsed_batch(
+    lanes: Sequence[Tuple[PubKey, bytes, int, int]],
+) -> List[bool]:
+    """One vectorized pass over (pub, digest, r, s) lanes, all in THIS
+    process. Bit-exact with ``p256.verify_digest`` per lane; the low-S rule
+    is NOT applied here (same contract as the oracle and fastec)."""
+    nlanes = len(lanes)
+    if nlanes == 0:
+        return []
+
+    valid, rr, ss, qx, qy, ee = _precheck_lanes(lanes)
 
     # u1 = e/s, u2 = r/s mod n — one batch inversion for every lane's s.
     w = _batch_inv(ss, N)

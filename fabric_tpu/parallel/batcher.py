@@ -228,8 +228,10 @@ class VerifyBatcher:
                 if dispatch is None:
                     # provider without an async seam: compute now, hand
                     # back a trivial resolver (SoftwareProvider now HAS
-                    # batch_verify_async — on the hostec tier it shards
-                    # across the process pool and resolves later)
+                    # batch_verify_async — on the hostec_np/hostec
+                    # tiers it shards across the process pool — through
+                    # one shared-memory block on the numpy tier — and
+                    # resolves later)
                     verdicts = self.provider.batch_verify(keys, sigs, digests)
                     resolver = lambda v=verdicts: v  # noqa: E731
                 else:

@@ -99,12 +99,24 @@ NLIMBS = 20
 LIMB_MASK = (1 << LIMB_BITS) - 1
 RADIX_BITS = LIMB_BITS * NLIMBS
 
+#: hostec_np's pair-condensed compute form (crypto/hostec_np.py):
+#: adjacent radix-2^13 limbs packed two-per-uint64 at radix 2^26, with
+#: one spare pair-limb of Montgomery headroom.  The L4/L32 bounds are
+#: the proven `_mul_kernel` input contracts (lazy limbs carried by the
+#: _FE wrapper before they exceed these).
+PAIR_BITS = 2 * LIMB_BITS
+PAIR_MASK = (1 << PAIR_BITS) - 1
+NPAIRS = NLIMBS // 2 + 1
+PAIR_L4 = 4 * (PAIR_MASK + 1) - 1
+PAIR_L32 = 32 * (PAIR_MASK + 1) - 1
+
 #: Files whose lane arithmetic carries the limb headroom contract.
 LIMB_TIER = (
     "*fabric_tpu/ops/*.py",
     "*fabric_tpu/common/p256.py",
     "*fabric_tpu/common/fp256bn.py",
     "*fabric_tpu/crypto/hostec.py",
+    "*fabric_tpu/crypto/hostec_np.py",
     "*fabric_tpu/ledger/mvcc_device.py",
 )
 
@@ -746,6 +758,15 @@ def join(a: AbsVal, b: AbsVal) -> AbsVal:
         return SeqVal(items=None, elem=join(a.summary(), b.summary()))
     if isinstance(a, NoneVal) and isinstance(b, NoneVal):
         return NONE
+    # a guarded optional import (`try: import numpy as np / except
+    # ImportError: np = None`) joins the module with None at module
+    # scope; keep the module binding — the limb kernels only execute in
+    # the dependency-present world, and that is the world whose value
+    # ranges the gate must prove (joining to ⊤ would silence them).
+    if isinstance(a, ModVal) and isinstance(b, NoneVal):
+        return a
+    if isinstance(b, ModVal) and isinstance(a, NoneVal):
+        return b
     if (
         isinstance(a, ConstVal)
         and isinstance(b, ConstVal)
@@ -1136,6 +1157,21 @@ class Analyzer:
             return SeqVal(items=[limb_num() for _ in range(NLIMBS)])
         if leaf in ("Array", "ndarray"):
             return limb_num()
+        # hostec_np pair-limb contracts (string annotations on the numpy
+        # kernels; bounds enforced at runtime by the _FE wrapper)
+        if leaf == "PairMat":
+            return Num(Interval(0, PAIR_MASK), "uint64")
+        if leaf == "PairMatL4":
+            return Num(Interval(0, PAIR_L4), "uint64")
+        if leaf == "PairMatL32":
+            return Num(Interval(0, PAIR_L32), "uint64")
+        if leaf == "AccMat":
+            # the REDC sweep's accumulator: the MAC phase's proven bound
+            return Num(Interval(0, NPAIRS * (PAIR_L32 + 1) * (PAIR_L4 + 1)), "uint64")
+        if leaf == "BiasMat":
+            # the REDC complement-fold bias (K*m minus the constant
+            # over-add, < m): canonical pair limbs
+            return Num(Interval(0, PAIR_MASK), "uint64")
         if leaf in ("Lanes",):
             return SeqVal(items=None, elem=Num(TOP_IVL, "pyint"))
         if leaf == "MontCtx":

@@ -85,12 +85,18 @@ __version__ = "1.0"
 
 #: Heavy / optional third-party roots whose module-scope import breaks
 #: collection in minimal environments (or costs seconds at import time).
-HEAVY_PACKAGES = {"cryptography", "grpc", "jax", "jaxlib"}
+HEAVY_PACKAGES = {"cryptography", "grpc", "jax", "jaxlib", "numpy"}
 
 #: Files allowed to import a heavy package at module scope: the device
 #: kernel layer imports jax unconditionally by design (nothing imports it
 #: in a CPU-only test run without wanting jax), and comm/ IS the gRPC
-#: layer.  Patterns are fnmatch globs against the posix path.
+#: layer.  numpy is the data plane's array substrate — the flags
+#: bitmask, device kernels, validators and shard plumbing are
+#: numpy-native by design — but everywhere else (the host crypto
+#: ladder, tools, msp, common/p256) it must stay out of module scope or
+#: ride a guarded import, so the hostec_np tier degrades instead of
+#: breaking imports when numpy is absent.  Patterns are fnmatch globs
+#: against the posix path.
 MODULE_IMPORT_ALLOW: Dict[str, Tuple[str, ...]] = {
     "jax": (
         "*fabric_tpu/ops/*",
@@ -99,6 +105,18 @@ MODULE_IMPORT_ALLOW: Dict[str, Tuple[str, ...]] = {
     ),
     "jaxlib": ("*fabric_tpu/ops/*",),
     "grpc": ("*fabric_tpu/comm/*",),
+    "numpy": (
+        "*fabric_tpu/ops/*",
+        "*fabric_tpu/common/txflags.py",
+        "*fabric_tpu/crypto/tpu_provider.py",
+        "*fabric_tpu/ledger/mvcc_device.py",
+        "*fabric_tpu/parallel/*",
+        "*fabric_tpu/policy/evaluator.py",
+        "*fabric_tpu/policy/manager.py",
+        "*fabric_tpu/utils/native.py",
+        "*fabric_tpu/validation/blockparse.py",
+        "*fabric_tpu/validation/validator.py",
+    ),
 }
 
 #: Directories whose exception discipline is load-bearing for the

@@ -1,0 +1,115 @@
+#!/usr/bin/env bash
+# Host EC ladder micro-bench: prints verifies/s for every host tier that
+# can load — p256 oracle (extrapolated from a few lanes), hostec, and
+# hostec_np — WITHOUT importing jax or requiring the cryptography
+# package (fastec is reported as skipped when absent).  The full bench
+# (bench.py) owns the device columns and the JSON artifact; this script
+# answers "what does the host ladder do on THIS box" in ~30s.
+#
+#   HOSTEC_BENCH_LANES  batch size per timed pass   (default 2048 —
+#                       the smallest size where hostec_np actually
+#                       exercises its shared-memory pool path)
+#   HOSTEC_BENCH_POOL   1 = also time the sharded/pooled entrypoints
+#
+# The payload runs from a real file (not a heredoc on stdin): the
+# process pools' spawn/forkserver workers re-import __main__, which
+# must therefore be importable.
+set -uo pipefail
+
+cd "$(dirname "$0")/.."
+
+payload="$(mktemp --suffix=.py)"
+trap 'rm -f "$payload"' EXIT
+
+cat >"$payload" <<'PY'
+import hashlib
+import os
+import time
+
+
+def main():
+    lanes_n = int(os.environ.get("HOSTEC_BENCH_LANES", "2048"))
+    do_pool = os.environ.get("HOSTEC_BENCH_POOL", "1") == "1"
+
+    from fabric_tpu.common import p256
+    from fabric_tpu.crypto import hostec
+
+    try:
+        from fabric_tpu.crypto import hostec_np
+        have_np = hostec_np.HAVE_NUMPY
+    except Exception:
+        have_np = False
+
+    try:
+        import fabric_tpu.crypto.fastec  # noqa: F401
+        have_fastec = True
+    except ImportError:
+        have_fastec = False
+
+    kp = hostec.generate_keypair()
+    lanes = []
+    for i in range(lanes_n):
+        d = hashlib.sha256(b"hostec_bench %d" % i).digest()
+        r, s = hostec.sign_digest(kp.priv, d)
+        lanes.append((kp.pub, d, r, s))
+
+    rows = []
+
+    # oracle: a few lanes, extrapolated (a full batch would eat minutes)
+    t0 = time.perf_counter()
+    for lane in lanes[:3]:
+        assert p256.verify_digest(*lane)
+    rows.append(
+        ("p256 (oracle, extrapolated)", 3 / (time.perf_counter() - t0))
+    )
+
+    t0 = time.perf_counter()
+    assert all(hostec.verify_parsed_batch(lanes))
+    rows.append(("hostec (inline)", lanes_n / (time.perf_counter() - t0)))
+
+    if have_np:
+        hostec_np.warm_tables()
+        t0 = time.perf_counter()
+        assert all(hostec_np.verify_parsed_batch(lanes))
+        rows.append(
+            ("hostec_np (inline)", lanes_n / (time.perf_counter() - t0))
+        )
+
+    if do_pool:
+        hostec.verify_parsed_batch_sharded(lanes)()  # pool boot untimed
+        t0 = time.perf_counter()
+        assert all(hostec.verify_parsed_batch_sharded(lanes)())
+        rows.append(
+            ("hostec (sharded pool)", lanes_n / (time.perf_counter() - t0))
+        )
+        hostec.shutdown_pool()
+        if have_np:
+            hostec_np.verify_parsed_batch_sharded(lanes)()
+            t0 = time.perf_counter()
+            assert all(hostec_np.verify_parsed_batch_sharded(lanes)())
+            pooled = lanes_n >= hostec_np.MIN_POOL_LANES
+            label = (
+                "hostec_np (shm-sharded pool)"
+                if pooled
+                else "hostec_np (sharded entry, ran inline)"
+            )
+            rows.append((label, lanes_n / (time.perf_counter() - t0)))
+            hostec_np.shutdown_pool()
+
+    print()
+    print(f"host EC backend ladder @ {lanes_n} lanes")
+    print(f"{'tier':32s} {'verifies/s':>12s}")
+    for name, rate in rows:
+        print(f"{name:32s} {rate:12.1f}")
+    if not have_fastec:
+        print(f"{'fastec':32s} {'(cryptography not installed)':>28s}")
+    if not have_np:
+        print(f"{'hostec_np':32s} {'(numpy not installed)':>21s}")
+
+
+if __name__ == "__main__":
+    main()
+PY
+
+PYTHONPATH="$(pwd)${PYTHONPATH:+:$PYTHONPATH}" \
+    timeout -k 10 600 python "$payload"

@@ -254,21 +254,28 @@ def test_software_provider_batch_on_hostec(hostec_backend, keypairs):
     assert sw.batch_verify(keys, sigs, digests) == expect
 
 
-def test_auto_ladder_lands_on_hostec_without_cryptography():
+def test_auto_ladder_lands_on_host_tier_without_cryptography():
     """In an environment without the cryptography package, `auto` must
-    select hostec (never the oracle) — the silent-fallback cliff this
-    ladder exists to remove."""
+    select hostec_np (numpy present) or hostec — never the oracle —
+    the silent-fallback cliff this ladder exists to remove."""
     try:
         import cryptography  # noqa: F401
 
         pytest.skip("cryptography installed: auto selects fastec here")
     except ImportError:
         pass
+    try:
+        import numpy  # noqa: F401
+
+        expect = "hostec_np"
+    except ImportError:
+        expect = "hostec"
     before = ec_backend_name()
     try:
         mod = select_ec_backend("auto")
-        assert mod is hostec
-        assert ec_backend_name() == "hostec"
+        assert ec_backend_name() == expect
+        if expect == "hostec":
+            assert mod is hostec
         # an explicitly pinned fastec must raise, not downgrade
         with pytest.raises(ImportError):
             select_ec_backend("fastec")

@@ -1,0 +1,541 @@
+"""Differential tests: the numpy limb-matrix EC tier (crypto/hostec_np)
+vs the CPython hostec engine and the Python-int oracle.
+
+hostec_np is the second rung of the host EC backend ladder (fastec ->
+hostec_np -> hostec -> p256).  These tests pin its VALID/INVALID mask
+bit-exactly to hostec (which is itself pinned to the oracle by
+test_hostec.py) across adversarial lanes, drive the exceptional-lane
+machinery (P = +-Q, infinity results) both end-to-end and at the
+kernel level, prove the shared-memory sharding is order-preserving,
+and chain dense-limb / 4m-edge operands through the Montgomery kernels
+against the Python-int oracle exactly like test_bignum.py does for the
+device kernels.  The whole module skips cleanly when numpy is absent
+(the ladder itself must degrade, not break — covered below via a
+monkeypatched HAVE_NUMPY).
+"""
+
+import hashlib
+import secrets
+import subprocess
+import sys
+
+import pytest
+
+np = pytest.importorskip("numpy")
+
+from fabric_tpu.common import p256
+from fabric_tpu.crypto import der, hostec
+from fabric_tpu.crypto import hostec_np as hn
+from fabric_tpu.crypto.bccsp import (
+    ECDSAPublicKey,
+    SoftwareProvider,
+    ec_backend_name,
+    select_ec_backend,
+)
+
+N = p256.N
+P = p256.P
+G = p256.GENERATOR
+
+
+def _digest(tag, i):
+    return hashlib.sha256(b"%s %d" % (tag, i)).digest()
+
+
+@pytest.fixture(scope="module")
+def keypairs():
+    return [hostec.generate_keypair() for _ in range(4)]
+
+
+def _signed_lane(keypairs, tag, i):
+    kp = keypairs[i % len(keypairs)]
+    d = _digest(tag, i)
+    r, s = hostec.sign_digest(kp.priv, d)
+    return kp.pub, d, r, s
+
+
+def _hostec_mask(lanes):
+    return hostec.verify_parsed_batch(lanes)
+
+
+# ---------------------------------------------------------------------------
+# Montgomery kernel units: oracle differential + near-overflow regression
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("modulus", [p256.P, p256.N], ids=["P", "N"])
+def test_mont_kernels_match_int_oracle(modulus):
+    ctx = hn._ctx(modulus)
+    field = hn._Field(ctx)
+    rinv = pow(hn.R_MONT, -1, modulus)
+    rng = secrets.SystemRandom()
+    xs = [rng.randrange(2 * modulus) for _ in range(29)] + [0, 1, modulus]
+    ys = [rng.randrange(2 * modulus) for _ in range(29)] + [modulus, 0, 1]
+    a = hn.limbs13_to_pairs(hn.ints_to_limbs13(xs))
+    b = hn.limbs13_to_pairs(hn.ints_to_limbs13(ys))
+    out = field.kmul(a.copy(), b.copy())
+    for i, (x, y) in enumerate(zip(xs, ys)):
+        v = hn._pairs_to_int(out[:, i])
+        assert v < 2 * modulus
+        assert v % modulus == x * y * rinv % modulus
+    sq = field.sqr(field.fe(a.copy(), 2, hn.PAIR_MASK))
+    for i, x in enumerate(xs):
+        assert (
+            hn._pairs_to_int(sq.limbs[:, i]) % modulus
+            == x * x * rinv % modulus
+        )
+
+
+@pytest.mark.parametrize("modulus", [p256.P, p256.N], ids=["P", "N"])
+def test_reference_kernels_bit_exact_with_optimized(modulus):
+    """The fabflow limb-tier proof runs over the plain-operator
+    reference kernels; this pins the workspace-optimized kernels (whose
+    out=/buffer plumbing the interval domain cannot track) bit-exact
+    against them, so the mechanized bound transfers."""
+    ctx = hn._ctx(modulus)
+    field = hn._Field(ctx)
+    rng = secrets.SystemRandom()
+    xs = [rng.randrange(2 * modulus) for _ in range(23)]
+    ys = [rng.randrange(2 * modulus) for _ in range(23)]
+    a = hn.limbs13_to_pairs(hn.ints_to_limbs13(xs))
+    b = hn.limbs13_to_pairs(hn.ints_to_limbs13(ys))
+    opt = field.kmul(a.copy(), b.copy())
+    if ctx.p256_bias is not None:
+        ref = hn._mul_kernel_ref_p256(a.copy(), b.copy(), ctx.p256_bias)
+    else:
+        ref = hn._mul_kernel_ref(a.copy(), b.copy(), ctx.m_col, ctx.m0inv)
+    assert (ref == opt).all()
+
+
+def test_mont_mul_near_overflow_boundary():
+    """test_bignum.py's dense-limb regression, at the pair radix: dense
+    0x1fff-limb operands and 2m-edge values chained through 8 squarings
+    stay bit-exact with the Python-int oracle.  If someone widens the
+    L32/L4 contracts, drops the complement-fold bias, or breaks a
+    carry, this chain wraps and diverges."""
+    for modulus in (p256.P, p256.N):
+        ctx = hn._ctx(modulus)
+        field = hn._Field(ctx)
+        rinv = pow(hn.R_MONT, -1, modulus)
+        dense = (1 << 255) - 1  # nineteen 0x1fff limbs + 0xff top
+        edge = 2 * modulus - 1  # the laxest canonical-value input
+        ops = [dense, edge, modulus - 1, dense % modulus]
+        arr = hn.limbs13_to_pairs(hn.ints_to_limbs13(ops))
+        want = list(ops)
+        got = arr
+        for _ in range(8):
+            got = field.sqr(field.fe(got.copy(), 2, hn.PAIR_MASK)).limbs
+            want = [(x * x * rinv) % modulus for x in want]
+            vals = [
+                hn._pairs_to_int(got[:, i]) % modulus
+                for i in range(len(ops))
+            ]
+            assert vals == want
+
+
+def test_p256_redc_terms_reconstruct_p():
+    """The hardcoded shift decomposition in _redc_rows_p256 IS p."""
+    recon = -1
+    for coff, sh, sign in hn._P256_REDC_TERMS:
+        recon += sign << (hn.PAIR_BITS * coff + sh)
+    assert recon == p256.P
+    ctx = hn._ctx(p256.P)
+    assert ctx.p256_bias is not None
+    assert int(ctx.p256_bias.max()) <= hn.PAIR_MASK  # canonical bias
+
+
+def test_tree_batch_inversion():
+    """Per-lane inverses via the lane-pairing tree, zero lanes masked
+    to zero — including widths that exercise odd tails at every level."""
+    ctx = hn._ctx(p256.P)
+    field = hn._Field(ctx)
+    rinv = ctx.rinv
+    for lanes_n in (1, 2, 3, 7, 16, 33):
+        xs = [secrets.randbelow(p256.P) for _ in range(lanes_n)]
+        if lanes_n > 2:
+            xs[1] = 0  # a zero lane must not poison the tree
+        arr = hn.limbs13_to_pairs(hn.ints_to_limbs13(xs))
+        inv = hn._invert_lanes(field, field.fe(arr, 2, hn.PAIR_MASK))
+        for i, x in enumerate(xs):
+            got = (hn._pairs_to_int(inv.limbs[:, i]) * rinv) % p256.P
+            want = 0 if x == 0 else pow(
+                (x * rinv) % p256.P, -1, p256.P
+            )
+            assert got == want, (lanes_n, i)
+
+
+# ---------------------------------------------------------------------------
+# Differential fuzz vs the hostec mask
+# ---------------------------------------------------------------------------
+
+
+def test_fuzz_mask_matches_hostec(keypairs):
+    """Mixed batch: valid, bit-flipped r, bit-flipped s, wrong digest,
+    high-S — one matrix pass, bit-exact with hostec (itself pinned to
+    the oracle)."""
+    import random
+
+    rng = random.Random(0x417)
+    lanes = []
+    for i in range(48):
+        pub, d, r, s = _signed_lane(keypairs, b"fuzznp", i)
+        kind = i % 5
+        if kind == 1:
+            r ^= 1 << rng.randrange(256)
+        elif kind == 2:
+            s ^= 1 << rng.randrange(256)
+        elif kind == 3:
+            d = _digest(b"other", i)
+        elif kind == 4:
+            s = N - s  # high-S is valid at this layer
+        lanes.append((pub, d, r, s))
+    assert hn.verify_parsed_batch(lanes) == _hostec_mask(lanes)
+
+
+def test_rs_boundary_values(keypairs):
+    pub, d, r, s = _signed_lane(keypairs, b"edgenp", 0)
+    edges = [0, 1, N - 1, N, N + 1]
+    lanes = [(pub, d, e, s) for e in edges]
+    lanes += [(pub, d, r, e) for e in edges]
+    lanes.append((pub, d, r, s))
+    got = hn.verify_parsed_batch(lanes)
+    assert got == _hostec_mask(lanes)
+    assert got[-1] is True
+    assert not any(got[:-1])
+
+
+def test_bad_public_keys(keypairs):
+    """Off-curve, out-of-range and identity (None) keys verify False
+    and never raise — mixed into a batch with healthy lanes."""
+    pub, d, r, s = _signed_lane(keypairs, b"badkeynp", 0)
+    x, y = pub
+    lanes = [
+        ((x, (y + 1) % P), d, r, s),
+        ((P, y), d, r, s),
+        ((x, P + y), d, r, s),
+        (None, d, r, s),
+        (pub, d, r, s),
+    ]
+    got = hn.verify_parsed_batch(lanes)
+    assert got == [False, False, False, False, True]
+    assert got == _hostec_mask(lanes)
+
+
+def test_batch_sizes(keypairs):
+    """Sizes around window/shard seams; every 3rd lane corrupted."""
+    for size in (1, 2, 31, 33, 97):
+        lanes = []
+        expect = []
+        for i in range(size):
+            pub, d, r, s = _signed_lane(keypairs, b"sz%d" % size, i)
+            if i % 3 == 1:
+                s ^= 2
+                expect.append(False)
+            else:
+                expect.append(True)
+            lanes.append((pub, d, r, s))
+        assert hn.verify_parsed_batch(lanes) == expect, size
+
+
+# ---------------------------------------------------------------------------
+# Exceptional lanes: P = +-Q, infinity
+# ---------------------------------------------------------------------------
+
+
+def test_exceptional_madd_paths_kernel_level():
+    """_madd_vec on crafted equal/negated/infinity operands takes the
+    wholesale-detect + scalar-patch path and matches hostec._madd1."""
+    field = hn._Field(hn._ctx(P))
+    kp = hostec.generate_keypair()
+    five = p256.scalar_mult(5, kp.pub)
+    lanes_n = 3
+    rinv = field.ctx.rinv
+
+    def mk(v):
+        arr = hn.limbs13_to_pairs(
+            hn.ints_to_limbs13([(v * hn.R_MONT) % P] * lanes_n)
+        )
+        return field.fe(arr, 1, hn.PAIR_MASK)
+
+    X, Y, Z = mk(five[0]), mk(five[1]), mk(1)
+    # P == Q: doubles through the patch
+    ax, ay = mk(five[0]), mk(five[1])
+    X3, Y3, Z3, exc = hn._madd_vec(field, X, Y, Z, ax, ay)
+    assert exc.all()
+    X3, Y3, Z3 = hn._patch_exceptional(
+        field, exc, (X, Y, Z), X3, Y3, Z3, ax, ay
+    )
+    want = hostec._dbl1(five[0], five[1], 1)
+    got = tuple(
+        (hn._pairs_to_int(field.carried(v).limbs[:, 0]) * rinv) % P
+        for v in (X3, Y3, Z3)
+    )
+    zi = pow(want[2], -1, P)
+    gzi = pow(got[2], -1, P)
+    assert (
+        got[0] * gzi * gzi % P == want[0] * zi * zi % P
+    )
+    # P == -Q: collapses to infinity, recorded via inf_out
+    ay_neg = mk(P - five[1])
+    inf = np.zeros(lanes_n, dtype=bool)
+    X3, Y3, Z3, exc = hn._madd_vec(field, X, Y, Z, ax, ay_neg)
+    assert exc.all()
+    hn._patch_exceptional(
+        field, exc, (X, Y, Z), X3, Y3, Z3, ax, ay_neg, inf_out=inf
+    )
+    assert inf.all()
+
+
+def test_exceptional_lanes_end_to_end():
+    """Crafted signatures drive the Horner loop through P = +-Q and an
+    infinity result with pub = G (priv = 1): u2 = 16 places 16*Q as the
+    final Q-add from infinity, u1 = 17 then collides the final G-add
+    with the 17*Q accumulator (P = Q since Q = G); u1 = n - u2 makes
+    u1*G + u2*Q the identity.  The masks must still match hostec lane
+    for lane."""
+    lanes = []
+    # u1 = e/s, u2 = r/s; with s = 1: e = u1, r = u2 (r must be in
+    # [1, n), e rides the digest bytes directly)
+    crafts = [
+        (17, 16),          # final G-add hits P == Q
+        (N - 5, 5),        # result is the identity (infinity)
+        (N - 16, 16),      # identity again, different window pattern
+        (1, 1),            # plain tiny scalars
+    ]
+    for u1, u2 in crafts:
+        digest = int(u1 % N).to_bytes(32, "big")
+        lanes.append((G, digest, u2, 1))
+    got = hn.verify_parsed_batch(lanes)
+    want = _hostec_mask(lanes)
+    assert got == want
+
+
+def test_signed_digit_negative_windows(keypairs):
+    """Scalars dense in 0x1f windows exercise the negated-table path
+    (wNAF digits < 0) — craft u2 ≡ pattern via r = u2 * s mod n."""
+    kp = keypairs[0]
+    lanes = []
+    for pat in (
+        int("11111" * 51, 2),  # alternating small digits
+        (1 << 256) % N,
+        N - 1,
+        int("1" * 255, 2) % N,  # all-ones: every digit recodes signed
+    ):
+        d = _digest(b"negwin", pat & 0xFFFF)
+        r, s = hostec.sign_digest(kp.priv, d)
+        # replace r so u2 = r/s becomes the pattern: r' = pat * s mod n
+        r2 = (pat * s) % N
+        if r2 == 0:
+            continue
+        lanes.append((kp.pub, d, r2, s))
+    assert hn.verify_parsed_batch(lanes) == _hostec_mask(lanes)
+
+
+# ---------------------------------------------------------------------------
+# Shared-memory sharding
+# ---------------------------------------------------------------------------
+
+
+def test_sharded_is_order_preserving(keypairs, monkeypatch):
+    """A pool-sized batch sharded across 2 workers through ONE
+    shared-memory block returns the same mask, in the same order, as
+    the in-process pass."""
+    monkeypatch.setenv("FABRIC_TPU_HOSTEC_NP_PROCS", "2")
+    monkeypatch.setenv("FABRIC_TPU_HOSTEC_NP_MIN_LANES", "64")
+    monkeypatch.setattr(hn, "MIN_POOL_LANES", 128)
+    monkeypatch.setattr(hn, "MIN_SHARD_LANES", 64)
+    hn.shutdown_pool()
+    lanes = []
+    for i in range(131):
+        pub, d, r, s = _signed_lane(keypairs, b"shardnp", i)
+        if i % 7 == 3:
+            r ^= 4
+        lanes.append((pub, d, r, s))
+    try:
+        resolver = hn.verify_parsed_batch_sharded(lanes)
+        sharded = resolver()
+        # double resolve must return the memoized verdicts — the shm
+        # mapping is gone after the first call, and re-reading the
+        # verdict view over it would crash the process
+        assert resolver() == sharded
+    finally:
+        hn.shutdown_pool()
+    assert sharded == hn.verify_parsed_batch(lanes)
+
+
+def test_small_batches_delegate_to_hostec(keypairs, monkeypatch):
+    """Below NP_MIN_LANES the sharded entrypoint rides hostec (the
+    matrix engine's fixed cost loses on small batches)."""
+    calls = []
+    orig = hostec.verify_parsed_batch_sharded
+
+    def spy(lanes):
+        calls.append(len(lanes))
+        return orig(lanes)
+
+    monkeypatch.setattr(hostec, "verify_parsed_batch_sharded", spy)
+    lanes = [_signed_lane(keypairs, b"tiny", i) for i in range(8)]
+    assert hn.verify_parsed_batch_sharded(lanes)() == [True] * 8
+    assert calls == [8]
+
+
+# ---------------------------------------------------------------------------
+# Ladder / provider integration + numpy-absent degradation
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def np_backend():
+    before = ec_backend_name()
+    select_ec_backend("hostec_np")
+    yield
+    select_ec_backend(before)
+
+
+def test_software_provider_batch_on_hostec_np(np_backend, keypairs):
+    sw = SoftwareProvider()
+    assert sw.describe_backend() == "sw:hostec_np"
+    keys, sigs, digests, expect = [], [], [], []
+    for i in range(12):
+        kp = keypairs[i % len(keypairs)]
+        d = _digest(b"provnp", i)
+        r, s = hostec.sign_digest(kp.priv, d)
+        if i % 3 == 2:
+            d = _digest(b"provnp!", i)
+            expect.append(False)
+        else:
+            expect.append(True)
+        keys.append(ECDSAPublicKey(*kp.pub))
+        sigs.append(der.marshal_signature(r, s))
+        digests.append(d)
+    # DER-garbage lane fails the precheck and comes back False
+    keys.append(keys[0])
+    sigs.append(b"\x30\x03\x02\x01\x01")
+    digests.append(digests[0])
+    expect.append(False)
+    assert sw.batch_verify(keys, sigs, digests) == expect
+
+
+def test_scalar_api_delegates_to_hostec(keypairs):
+    """verify_digest/sign_digest/scalar_base_mult ride hostec's scalar
+    paths (bit-identical semantics, no matrix overhead per lane)."""
+    kp = keypairs[0]
+    d = _digest(b"scalarnp", 0)
+    r, s = hn.sign_digest(kp.priv, d)
+    assert s <= p256.HALF_N
+    assert hn.verify_digest(kp.pub, d, r, s)
+    assert hn.scalar_base_mult(7) == p256.scalar_mult(7, G)
+
+
+def test_auto_ladder_skips_np_tier_without_numpy(monkeypatch):
+    """With numpy 'absent' (HAVE_NUMPY False), auto lands on hostec and
+    an explicit hostec_np pin raises ImportError — degrade loudly in
+    the log, never silently for a pinned config."""
+    before = ec_backend_name()
+    monkeypatch.setattr(hn, "HAVE_NUMPY", False)
+    try:
+        import fabric_tpu.crypto.fastec  # noqa: F401
+
+        pytest.skip("cryptography installed: auto selects fastec here")
+    except ImportError:
+        pass
+    try:
+        mod = select_ec_backend("auto")
+        assert mod is hostec
+        with pytest.raises(ImportError):
+            select_ec_backend("hostec_np")
+    finally:
+        monkeypatch.setattr(hn, "HAVE_NUMPY", True)
+        select_ec_backend(before)
+
+
+def test_module_imports_without_numpy_subprocess():
+    """The module itself (and the ladder around it) must import with
+    numpy genuinely blocked — the guarded-import discipline the
+    collect gate relies on."""
+    code = (
+        "import sys\n"
+        "sys.modules['numpy'] = None\n"  # import numpy -> ImportError
+        "import fabric_tpu.crypto.hostec_np as hn\n"
+        "assert not hn.HAVE_NUMPY\n"
+        "from fabric_tpu.crypto.bccsp import select_ec_backend\n"
+        "mod = select_ec_backend('auto')\n"
+        "assert mod.__name__.rsplit('.', 1)[-1] in "
+        "('fastec', 'hostec'), mod.__name__\n"
+        "print('ok')\n"
+    )
+    res = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert res.returncode == 0, res.stderr
+    assert "ok" in res.stdout
+
+
+def test_factory_accepts_and_warns(monkeypatch):
+    """BCCSP.SW.ECBackend: hostec_np accepted; unknown values warn and
+    leave the pinned backend alone (never raise)."""
+    from fabric_tpu.crypto import factory
+
+    before = ec_backend_name()
+    try:
+        factory.provider_from_config(
+            {"Default": "SW", "SW": {"ECBackend": "hostec_np"}}
+        )
+        assert ec_backend_name() == "hostec_np"
+        factory.provider_from_config(
+            {"Default": "SW", "SW": {"ECBackend": "no-such-tier"}}
+        )
+        assert ec_backend_name() == "hostec_np"  # pin left alone
+    finally:
+        select_ec_backend(before)
+
+
+def test_verify_batcher_routes_through_hostec_np(np_backend, keypairs):
+    """VerifyBatcher -> SoftwareProvider.batch_verify_async ->
+    hostec_np sharded engine, order-preserving per request."""
+    from fabric_tpu.parallel.batcher import VerifyBatcher
+
+    calls = []
+    orig = hn.verify_parsed_batch_sharded
+
+    def spy(lanes):
+        calls.append(len(lanes))
+        return orig(lanes)
+
+    sw = SoftwareProvider()
+    b = VerifyBatcher(sw, linger_s=0.02)
+    try:
+        hn.verify_parsed_batch_sharded = spy
+        reqs = []
+        for i in range(3):
+            keys, sigs, digests, expect = [], [], [], []
+            for j in range(3 + i):
+                kp = keypairs[j % len(keypairs)]
+                d = _digest(b"vbnp%d" % i, j)
+                r, s = hostec.sign_digest(kp.priv, d)
+                keys.append(ECDSAPublicKey(*kp.pub))
+                sigs.append(der.marshal_signature(r, s))
+                digests.append(d)
+                expect.append(True)
+            reqs.append((keys, sigs, digests, expect))
+        resolvers = [b.submit(k, s, d) for k, s, d, _ in reqs]
+        for resolver, (_k, _s, _d, expect) in zip(resolvers, reqs):
+            assert resolver() == expect
+    finally:
+        hn.verify_parsed_batch_sharded = orig
+        b.stop()
+    assert sum(calls) == sum(3 + i for i in range(3))
+
+
+@pytest.mark.slow
+def test_batch_1024_differential_slow(keypairs):
+    lanes = []
+    for i in range(1024):
+        pub, d, r, s = _signed_lane(keypairs, b"kilonp", i)
+        if i % 4 == 3:
+            s ^= 1 << (i % 250)
+        lanes.append((pub, d, r, s))
+    assert hn.verify_parsed_batch(lanes) == _hostec_mask(lanes)
