@@ -766,6 +766,19 @@ def bench_host_tiers(triples, budget_s=6.0):
     return out
 
 
+def bench_chaos(device_ok=True, seed=None):
+    """fabchaos smoke scorecard: seeded fault-injection scenarios with
+    per-stage p50/p99 latency — the trajectory files capture scenario
+    coverage and SLO shape, not just a clean-batch headline.  Device
+    availability is irrelevant (the harness drives the host planes);
+    BENCH_CHAOS_SEED overrides the seed."""
+    from fabric_tpu.tools.fabchaos import scorecard_for_bench
+
+    if seed is None:
+        seed = int(os.environ.get("BENCH_CHAOS_SEED", "7"))
+    return scorecard_for_bench(seed=seed)
+
+
 def bench_batcher(net, device_ok=True, n_channels=4, txs_per_channel=128):
     """P7 coalescing: four channels deliver SMALL blocks concurrently.
     Direct mode launches one small device program per channel; the shared
@@ -997,6 +1010,7 @@ def main():
             ("mvcc_5k", bench_mvcc, False),
             ("multi_4ch", bench_multichannel, True),
             ("batcher_4ch_small", bench_batcher, True),
+            ("chaos", bench_chaos, False),
         ):
             if time.monotonic() > deadline:
                 configs[name] = {

@@ -30,6 +30,7 @@ from typing import List, Optional, Sequence, Tuple
 
 import os
 
+from fabric_tpu.common.faults import corrupt_verdicts, fault_point
 from fabric_tpu.common.flogging import must_get_logger
 from fabric_tpu.common import der, p256
 from fabric_tpu.crypto import hostec
@@ -278,16 +279,33 @@ class SoftwareProvider(Provider):
             lanes.append((k.point if k is not None else None, d, r, s))
         return lanes
 
+    @staticmethod
+    def _chaos_verdicts(out: List[bool]) -> List[bool]:
+        """``bccsp.verdict`` corrupt seam: only an installed fault plan
+        can reach the flip — it exists so the fabchaos oracle gate can
+        prove its bit-exact mask assertion CATCHES a corrupted mask
+        (corrupt_detect scenario), the empirical twin of the fabflow
+        fail-closed proof."""
+        spec = fault_point("bccsp.verdict", interprets=("corrupt",))
+        if spec is not None and spec.action == "corrupt":
+            return corrupt_verdicts(out, spec)
+        return out
+
     def batch_verify(
         self,
         keys: Sequence[ECDSAPublicKey],
         signatures: Sequence[bytes],
         digests: Sequence[bytes],
     ) -> List[bool]:
+        # unkeyed: batch sizes are static in steady state, so a content
+        # key would turn a probabilistic plan into all-or-nothing
+        fault_point("bccsp.dispatch")
         sharded = getattr(_ec, "verify_parsed_batch_sharded", None)
         if sharded is None:
-            return super().batch_verify(keys, signatures, digests)
-        return sharded(self._parse_lanes(keys, signatures, digests))()
+            out = super().batch_verify(keys, signatures, digests)
+        else:
+            out = sharded(self._parse_lanes(keys, signatures, digests))()
+        return self._chaos_verdicts(list(out))
 
     def batch_verify_async(self, keys, signatures, digests):
         """Resolver-style dispatch (the VerifyBatcher/validator seam): on
@@ -296,11 +314,14 @@ class SoftwareProvider(Provider):
         (order-preserving), overlapping any host work the caller does
         before resolving.  Other tiers compute synchronously and hand
         back a trivial resolver."""
+        fault_point("bccsp.dispatch")
         sharded = getattr(_ec, "verify_parsed_batch_sharded", None)
         if sharded is None:
             out = Provider.batch_verify(self, keys, signatures, digests)
-            return lambda: out
-        return sharded(self._parse_lanes(keys, signatures, digests))
+            inner = lambda v=out: v  # noqa: E731
+        else:
+            inner = sharded(self._parse_lanes(keys, signatures, digests))
+        return lambda: self._chaos_verdicts(list(inner()))
 
 
 class PurePythonProvider(SoftwareProvider):

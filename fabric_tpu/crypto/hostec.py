@@ -54,7 +54,9 @@ import secrets
 import threading
 from typing import Callable, List, Optional, Sequence, Tuple
 
+from fabric_tpu.common.faults import fault_point
 from fabric_tpu.common.flogging import must_get_logger
+from fabric_tpu.common.retry import CooldownGate
 from fabric_tpu.common import p256
 from fabric_tpu.common.p256 import A, B, GX, GY, HALF_N, N, P, hash_to_int
 
@@ -384,6 +386,10 @@ def verify_parsed_batch(
 _POOL = None
 _POOL_PROCS = 1
 _POOL_LOCK = threading.Lock()
+# a pool that just broke must not be rebuilt in a hot loop: each
+# breakage opens an exponentially longer cooldown during which big
+# batches stay inline (mutated only under _POOL_LOCK)
+_POOL_GATE = CooldownGate()
 
 
 def pool_procs() -> int:
@@ -413,6 +419,10 @@ def _pool():
     global _POOL, _POOL_PROCS
     with _POOL_LOCK:
         if _POOL is None:
+            if not _POOL_GATE.ready():
+                # recently broken: stay inline for the cooldown instead
+                # of paying a worker-boot stall per batch in a hot loop
+                return None
             procs = pool_procs()
             _POOL_PROCS = procs
             if procs <= 1:
@@ -442,12 +452,17 @@ def _pool():
     return _POOL or None
 
 
-def shutdown_pool() -> None:
+def shutdown_pool(broken: bool = False) -> None:
+    """Tear the pool down.  ``broken=True`` (the degrade paths) also
+    arms the rebuild cooldown so a flapping pool can't thrash; a clean
+    shutdown (tests, bench teardown) leaves the gate closed."""
     global _POOL
     with _POOL_LOCK:
         if _POOL:
             _POOL.shutdown(wait=False, cancel_futures=True)
         _POOL = None
+        if broken:
+            _POOL_GATE.record_failure()
 
 
 def verify_parsed_batch_sharded(
@@ -470,27 +485,34 @@ def verify_parsed_batch_sharded(
     nshards = min(_POOL_PROCS, max(len(lanes) // (MIN_POOL_LANES // 2), 1))
     step = (len(lanes) + nshards - 1) // nshards
     try:
+        fault_point("hostec.pool.submit")
         futures = [
             pool.submit(verify_parsed_batch, lanes[off : off + step])
             for off in range(0, len(lanes), step)
         ]
     except Exception as exc:  # BrokenProcessPool / shutdown race
         logger.warning("pool submit failed (%s); recomputing inline", exc)
-        shutdown_pool()
+        shutdown_pool(broken=True)
         out = verify_parsed_batch(lanes)
         return lambda: out
 
     def resolve() -> List[bool]:
         out: List[bool] = []
         try:
+            fault_point("hostec.pool.resolve")
             for f in futures:
                 out.extend(f.result())
         except Exception as exc:  # worker died mid-run: inline fallback
             logger.warning(
                 "pool worker died mid-batch (%s); recomputing inline", exc
             )
-            shutdown_pool()
+            shutdown_pool(broken=True)
             return verify_parsed_batch(lanes)
+        # only a batch that made it THROUGH the pool resets the rebuild
+        # cooldown ramp — construction succeeding proves nothing about a
+        # persistently worker-killing environment
+        with _POOL_LOCK:
+            _POOL_GATE.record_success()
         return out
 
     return resolve

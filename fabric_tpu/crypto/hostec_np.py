@@ -84,7 +84,9 @@ import threading
 from typing import Callable, List, Optional, Sequence, Tuple
 
 from fabric_tpu.common import p256
+from fabric_tpu.common.faults import fault_point
 from fabric_tpu.common.flogging import must_get_logger
+from fabric_tpu.common.retry import CooldownGate
 from fabric_tpu.common.limbparams import (
     LIMB_BITS,
     LIMB_MASK,
@@ -1255,6 +1257,9 @@ def verify_parsed_batch(
 _POOL = None
 _POOL_PROCS = 1
 _POOL_LOCK = threading.Lock()
+# rebuild cooldown after breakage (see hostec._POOL_GATE); mutated only
+# under _POOL_LOCK
+_POOL_GATE = CooldownGate()
 
 _SHM_FIELDS = 5  # r, s, qx, qy, e limb matrices
 
@@ -1279,6 +1284,9 @@ def _pool():
     global _POOL, _POOL_PROCS
     with _POOL_LOCK:
         if _POOL is None:
+            if not _POOL_GATE.ready():
+                # recently broken: stay inline for the cooldown
+                return None
             procs = pool_procs()
             _POOL_PROCS = procs
             if procs <= 1:
@@ -1306,12 +1314,16 @@ def _pool():
     return _POOL or None
 
 
-def shutdown_pool() -> None:
+def shutdown_pool(broken: bool = False) -> None:
+    """Tear the pool down; ``broken=True`` arms the rebuild cooldown
+    (degrade paths only — clean teardowns leave the gate closed)."""
     global _POOL
     with _POOL_LOCK:
         if _POOL:
             _POOL.shutdown(wait=False, cancel_futures=True)
         _POOL = None
+        if broken:
+            _POOL_GATE.record_failure()
 
 
 def _shard_worker(shm_name: str, nlanes: int, lo: int, hi: int) -> bool:
@@ -1402,6 +1414,7 @@ def verify_parsed_batch_sharded(
     nshards = min(_POOL_PROCS, max(nlanes // MIN_SHARD_LANES, 1))
     step = (nlanes + nshards - 1) // nshards
     try:
+        fault_point("hostec_np.pool.submit")
         futures = [
             pool.submit(
                 _shard_worker, shm.name, nlanes, off, min(off + step, nlanes)
@@ -1410,7 +1423,7 @@ def verify_parsed_batch_sharded(
         ]
     except Exception as exc:  # BrokenProcessPool / shutdown race
         logger.warning("pool submit failed (%s); recomputing inline", exc)
-        shutdown_pool()
+        shutdown_pool(broken=True)
         shm.close()
         shm.unlink()
         out = verify_parsed_batch(lanes)
@@ -1425,14 +1438,19 @@ def verify_parsed_batch_sharded(
         if "out" in memo:
             return memo["out"]
         try:
+            fault_point("hostec_np.pool.resolve")
             for f in futures:
                 f.result()
             out = [bool(v) for v in verdict]
+            # a batch that made it THROUGH the pool resets the rebuild
+            # cooldown ramp (construction alone proves nothing)
+            with _POOL_LOCK:
+                _POOL_GATE.record_success()
         except Exception as exc:  # worker died mid-run: inline fallback
             logger.warning(
                 "pool worker died mid-batch (%s); recomputing inline", exc
             )
-            shutdown_pool()
+            shutdown_pool(broken=True)
             out = verify_parsed_batch(lanes)
         finally:
             shm.close()

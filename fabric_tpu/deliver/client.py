@@ -15,12 +15,16 @@ from __future__ import annotations
 
 import threading
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from typing import Callable, Iterable, List, Optional, Sequence
 
+from fabric_tpu.common.faults import InjectedFault, fault_point
+from fabric_tpu.common.retry import DELIVER_POLICY, Backoff, RetryPolicy
 from fabric_tpu.protos import ab_pb2, common_pb2, protoutil
 
-BACKOFF_BASE = 1.2  # blocksprovider.go:109
+# the reference ramp now lives in retry.DELIVER_POLICY (blocksprovider
+# .go:109 base 1.2); aliased here for back-compat with older callers
+BACKOFF_BASE = DELIVER_POLICY.multiplier
 MAX_RETRY_DELAY = 10.0
 MAX_TOTAL_DELAY = 60.0 * 60
 
@@ -86,6 +90,8 @@ class BlockDeliverer:
         sleeper: Callable[[float], None] = time.sleep,
         max_retry_delay: float = MAX_RETRY_DELAY,
         max_total_delay: float = MAX_TOTAL_DELAY,
+        retry_policy: Optional[RetryPolicy] = None,
+        retry_seed: Optional[int] = None,
     ):
         self.channel_id = channel_id
         self._endpoints = list(endpoints)
@@ -94,8 +100,22 @@ class BlockDeliverer:
         self._signer = signer
         self._verify_block = verify_block
         self._sleeper = sleeper
-        self._max_retry_delay = max_retry_delay
-        self._max_total_delay = max_total_delay
+        # the reference backoff (retry.DELIVER_POLICY: 1.2**n * 50ms,
+        # capped per-sleep and by a total-duration budget) with the
+        # legacy knobs overriding the caps; retry_policy overrides
+        # wholesale.  retry_seed arms ±20% seeded jitter so a fleet of
+        # deliverers retrying the same dead orderer desynchronizes —
+        # only when the chosen policy doesn't already set its own.
+        if retry_policy is None:
+            retry_policy = replace(
+                DELIVER_POLICY,
+                cap_s=max_retry_delay,
+                deadline_s=max_total_delay,
+            )
+        if retry_seed is not None and retry_policy.jitter == 0.0:
+            retry_policy = replace(retry_policy, jitter=0.2)
+        self._retry_policy = retry_policy
+        self._retry_seed = retry_seed
         self.stats = DelivererStats()
         self._stop = threading.Event()
         self._endpoint_idx = 0
@@ -130,14 +150,18 @@ class BlockDeliverer:
         """Pull until stopped, the budget is exhausted, or max_blocks
         arrive. Returns blocks received."""
         received = 0
-        failures = 0
-        total_sleep = 0.0
+        backoff = Backoff(
+            self._retry_policy, seed=self._retry_seed, sleeper=self._sleeper
+        )
         while not self._stop.is_set():
             endpoint = self._current_endpoint()
             if endpoint is None:
                 return received
             self.stats.connect_attempts += 1
             try:
+                # chaos seam: keyed per connection attempt, so a seeded
+                # plan flaps a deterministic prefix of attempts
+                fault_point("deliver.pull", key=self.stats.connect_attempts)
                 env = seek_envelope(
                     self.channel_id, self._next_block(), self._signer
                 )
@@ -162,20 +186,17 @@ class BlockDeliverer:
                     self._on_block(block)
                     received += 1
                     self.stats.blocks_received += 1
-                    failures = 0
+                    backoff.reset()  # progress restarts the ramp
                     if max_blocks is not None and received >= max_blocks:
                         return received
                 # clean end of stream: session served its range
                 return received
-            except (ConnectionError, OSError, StopIteration) as e:
+            except (ConnectionError, OSError, StopIteration, InjectedFault):
                 self.stats.failures += 1
-                failures += 1
                 self._failover()
-                delay = min(
-                    BACKOFF_BASE**failures * 0.05, self._max_retry_delay
-                )
-                total_sleep += delay
-                if total_sleep > self._max_total_delay:
+                if not backoff.sleep():
+                    # per-policy retry budget exhausted (deadline or
+                    # attempt cap): surface what we have instead of
+                    # sleeping forever against a dead fabric
                     return received
-                self._sleeper(delay)
         return received

@@ -22,6 +22,7 @@ import threading
 from typing import Callable, Dict, List, Optional, Sequence
 
 from fabric_tpu.comm.server import GRPCServer, STREAM_STREAM, channel_to
+from fabric_tpu.common.faults import fault_point, faults_enabled
 from fabric_tpu.common.flogging import must_get_logger
 from fabric_tpu.gossip.membership import LeaderElection, Membership
 from fabric_tpu.gossip.pull import PULL_MEMBERSHIP
@@ -122,6 +123,10 @@ class GossipNode:
         self._endpoints: Dict[str, str] = {}  # peer id -> endpoint
         self._conns: Dict[str, object] = {}  # endpoint -> grpc channel
         self._lock = threading.Lock()
+        # per-endpoint send sequence, so fault decisions key per stream
+        # open (a static endpoint key would degenerate a probabilistic
+        # plan into a permanent per-peer partition)
+        self._send_seq: Dict[str, int] = {}
         self._stop = threading.Event()
         self._tick_interval = tick_interval
 
@@ -456,6 +461,21 @@ class GossipNode:
         _depth: int = 0,
     ):
         try:
+            # chaos seam: "drop" silently loses the send (membership
+            # expiry + pull reconciliation must recover), "raise" takes
+            # the dead-peer path below; keyed per (endpoint, stream
+            # open) so probabilistic plans model a flaky link, not a
+            # permanent partition
+            if faults_enabled():
+                with self._lock:
+                    seq = self._send_seq.get(endpoint, 0)
+                    self._send_seq[endpoint] = seq + 1
+                spec = fault_point(
+                    "gossip.comm.send", key=(endpoint, seq),
+                    interprets=("drop",),
+                )
+                if spec is not None and spec.action == "drop":
+                    return
             conn = self._conn(endpoint)
             stub = conn.stream_stream(
                 "/gossip.Gossip/GossipStream",

@@ -48,6 +48,9 @@ import threading
 import time
 from typing import Callable, List, Optional, Sequence, Tuple
 
+from fabric_tpu.common.faults import fault_point
+from fabric_tpu.common.retry import DISPATCH_POLICY, RetryPolicy, call_with_retry
+
 
 class _Request:
     __slots__ = (
@@ -70,6 +73,15 @@ class _Request:
         assert self.result is not None
         return self.result
 
+    def fail_closed(self) -> None:
+        """Settle with all-False verdicts — a stopped/hung batcher must
+        never leave resolve() blocked and must never guess True.  A race
+        with a real settlement is benign: whichever lands first wins and
+        both outcomes are fail-closed (real verdicts or all-False)."""
+        if not self.event.is_set():
+            self.result = [False] * len(self.keys)
+            self.event.set()
+
 
 class VerifyBatcher:
     """submit() returns a resolver; call it to block for the verdicts of
@@ -81,12 +93,26 @@ class VerifyBatcher:
         max_batch: int = 16384,
         linger_s: float = 0.002,
         max_pending_lanes: int = 65536,
+        dispatch_retry: Optional[RetryPolicy] = None,
+        join_timeout_s: float = 10.0,
     ):
         self.provider = provider
         self.max_batch = max_batch
         self.linger_s = linger_s
+        # stop()'s patience for the dispatcher thread before settling
+        # stragglers fail-closed (shorten in tests with hung resolvers)
+        self.join_timeout_s = join_timeout_s
+        # bounded transient retry for a failed launch (pool hiccup,
+        # injected fault) before the error fans out to every resolver
+        self.dispatch_retry = dispatch_retry or DISPATCH_POLICY
         self._q: "queue.Queue[Optional[_Request]]" = queue.Queue()
         self._stop_lock = threading.Lock()
+        # every admitted-but-unsettled request, so stop() can settle
+        # stragglers fail-closed; guarded by its own lock (stop() holds
+        # _stop_lock around the sentinel put — reusing it here would
+        # deadlock the dispatcher's settle path against stop's join)
+        self._req_lock = threading.Lock()
+        self._inflight: set = set()
         self._max_pending_lanes = max_pending_lanes
         # all-or-nothing admission under one condition variable: a
         # per-lane semaphore loop would let two concurrent large submits
@@ -102,6 +128,12 @@ class VerifyBatcher:
             os.environ.get("FABRIC_TPU_BATCHER_RTT_MS", "25")
         )
         self.rtt_ema_ms: Optional[float] = None
+        # today _observe_rtt runs only on the dispatcher thread (every
+        # _settle call site is inside _run); the lock pins the EWMA
+        # read-modify-write as the invariant rather than an accident of
+        # the current call graph, so a future settle-from-elsewhere
+        # cannot silently introduce the race
+        self._rtt_lock = threading.Lock()
         # probe only launches small enough that device compute is
         # negligible next to transport RTT even on an attached chip
         # (64 lanes at ~65k verifies/s is ~1ms of compute; a 2048-lane
@@ -132,12 +164,13 @@ class VerifyBatcher:
         if lanes > self.RTT_PROBE_LANES:
             return
         ms = elapsed_s * 1000.0
-        self.rtt_ema_ms = (
-            ms
-            if self.rtt_ema_ms is None
-            else 0.8 * self.rtt_ema_ms + 0.2 * ms
-        )
-        self._last_mode = self.mode
+        with self._rtt_lock:
+            self.rtt_ema_ms = (
+                ms
+                if self.rtt_ema_ms is None
+                else 0.8 * self.rtt_ema_ms + 0.2 * ms
+            )
+            self._last_mode = self.mode
 
     def submit(
         self,
@@ -148,6 +181,10 @@ class VerifyBatcher:
         n = len(keys)
         if n == 0:
             return list
+        # chaos seam: an injected submit fault fails the CALLER before
+        # any batcher state is touched (no lanes to leak); unkeyed — a
+        # per-site seeded stream, not all-or-nothing per request size
+        fault_point("batcher.submit")
         # bounded admission: lanes are taken atomically (all or nothing)
         # and released at dispatch. An oversized request is capped so it
         # can't demand more lanes than exist.
@@ -155,6 +192,11 @@ class VerifyBatcher:
         req.permits = min(n, self._max_pending_lanes)
         with self._lanes_cv:
             while self._lanes_free < req.permits:
+                # stop() notifies this cv: an admission-blocked submitter
+                # must not wait forever on permits a wedged dispatcher
+                # will never release
+                if self._stopped:
+                    raise RuntimeError("batcher stopped")
                 self._lanes_cv.wait()
             self._lanes_free -= req.permits
         # the stop lock orders every put against the stop sentinel: no
@@ -165,6 +207,8 @@ class VerifyBatcher:
                     self._lanes_free += req.permits
                     self._lanes_cv.notify_all()
                 raise RuntimeError("batcher stopped")
+            with self._req_lock:
+                self._inflight.add(req)
             self._q.put(req)
         return req.resolve
 
@@ -224,22 +268,17 @@ class VerifyBatcher:
                 self._lanes_free += sum(r.permits for r in batch)
                 self._lanes_cv.notify_all()
             try:
-                dispatch = getattr(self.provider, "batch_verify_async", None)
-                if dispatch is None:
-                    # provider without an async seam: compute now, hand
-                    # back a trivial resolver (SoftwareProvider now HAS
-                    # batch_verify_async — on the hostec_np/hostec
-                    # tiers it shards across the process pool — through
-                    # one shared-memory block on the numpy tier — and
-                    # resolves later)
-                    verdicts = self.provider.batch_verify(keys, sigs, digests)
-                    resolver = lambda v=verdicts: v  # noqa: E731
-                else:
-                    resolver = dispatch(keys, sigs, digests)
+                resolver = self._launch(keys, sigs, digests)
             except BaseException as exc:  # fablint: disable=broad-except  # error propagated to every waiting caller via r.error
                 for r in batch:
-                    r.error = exc
-                    r.event.set()
+                    self._settle_error(r, exc)
+                if self._q.empty():
+                    # mirror the success path's idle drain: without it,
+                    # earlier launches still in `pending` would strand
+                    # their resolvers behind the blocking q.get() until
+                    # unrelated traffic (or stop) arrived
+                    while pending:
+                        self._settle(*pending.pop(0))
                 continue
             self.launches += 1
             self.lanes += len(keys)
@@ -256,6 +295,36 @@ class VerifyBatcher:
                 while pending:
                     self._settle(*pending.pop(0))
 
+    def _launch(self, keys: List, sigs: List[bytes], digests: List[bytes]):
+        """One device/provider launch with bounded transient retry: a
+        flapping backend (pool hiccup, injected fault) gets
+        dispatch_retry's capped-backoff attempts before the failure fans
+        out to every waiting resolver.  The fault site is unkeyed: the
+        per-site seeded stream re-rolls the decision on every attempt,
+        so a probabilistic plan models a flap the retry can ride out
+        (a batch-content key would re-fire identically per attempt)."""
+        dispatch = getattr(self.provider, "batch_verify_async", None)
+
+        def attempt(n: int):
+            fault_point("batcher.dispatch")
+            if dispatch is None:
+                # provider without an async seam: compute now, hand back
+                # a trivial resolver (SoftwareProvider HAS
+                # batch_verify_async — on the hostec_np/hostec tiers it
+                # shards across the process pool and resolves later)
+                verdicts = self.provider.batch_verify(keys, sigs, digests)
+                return lambda v=verdicts: v
+            return dispatch(keys, sigs, digests)
+
+        return call_with_retry(attempt, policy=self.dispatch_retry)
+
+    def _settle_error(self, r: _Request, exc: BaseException) -> None:
+        if not r.event.is_set():
+            r.error = exc
+            r.event.set()
+        with self._req_lock:
+            self._inflight.discard(r)
+
     def _settle(
         self,
         reqs: List[_Request],
@@ -269,21 +338,38 @@ class VerifyBatcher:
                 self._observe_rtt(lanes, time.perf_counter() - t0)
         except BaseException as exc:  # fablint: disable=broad-except  # error propagated to every waiting caller via r.error
             for r in reqs:
-                r.error = exc
-                r.event.set()
+                self._settle_error(r, exc)
             return
         off = 0
         for r in reqs:
             n = len(r.keys)
-            r.result = out[off : off + n]
+            if not r.event.is_set():  # stop() may have settled fail-closed
+                r.result = out[off : off + n]
+                r.event.set()
             off += n
-            r.event.set()
+            with self._req_lock:
+                self._inflight.discard(r)
 
     def stop(self) -> None:
+        """Idempotent shutdown.  After the dispatcher exits (or the join
+        times out on a hung resolver), every still-unsettled request is
+        settled fail-closed (all-False verdicts) so no resolve() caller
+        blocks forever and no lane is ever guessed VALID."""
         with self._stop_lock:
+            first = not self._stopped
             self._stopped = True
-            self._q.put(None)
-        self._thread.join(timeout=10.0)
+            if first:
+                self._q.put(None)
+        # wake submitters blocked on lane admission so they observe the
+        # stop instead of waiting for permits that will never come back
+        with self._lanes_cv:
+            self._lanes_cv.notify_all()
+        self._thread.join(timeout=self.join_timeout_s)
+        with self._req_lock:
+            leftovers = list(self._inflight)
+            self._inflight.clear()
+        for r in leftovers:
+            r.fail_closed()
 
 
 class BatchingProvider:

@@ -18,6 +18,7 @@ import queue
 import threading
 from typing import Callable, Optional
 
+from fabric_tpu.common.faults import fault_point
 from fabric_tpu.common.flogging import must_get_logger
 from fabric_tpu.protos import common_pb2
 
@@ -43,6 +44,12 @@ class CommitPipeline:
         self._idle.set()
         self._pending = 0
         self._pending_lock = threading.Lock()
+        # terminal triage for soak runs: drain() returning False means
+        # "not yet idle" — last_error (most recent commit exception,
+        # guarded by _pending_lock) and dead (committer thread gone
+        # without stop()) distinguish slow from dead
+        self.last_error: Optional[BaseException] = None
+        self._crashed = False
         self._committer = threading.Thread(
             target=self._commit_loop,
             name=f"commit-{channel.channel_id}",
@@ -94,6 +101,18 @@ class CommitPipeline:
 
     # -- consumer side -----------------------------------------------------
     def _commit_loop(self) -> None:
+        try:
+            self._commit_loop_inner()
+        except BaseException as exc:
+            # the loop only exits this way on a non-Exception escape
+            # (interpreter teardown, injected BaseException): latch the
+            # crash so dead stays True even after a cleanup stop()
+            with self._pending_lock:
+                self.last_error = exc
+            self._crashed = True
+            raise
+
+    def _commit_loop_inner(self) -> None:
         while not self._stopped.is_set():
             try:
                 item = self._prepared.get(timeout=0.2)
@@ -101,10 +120,18 @@ class CommitPipeline:
                 continue
             block, prepared = item
             try:
+                # chaos seam: keyed by block number, so a seeded plan
+                # fails a deterministic subset of commits
+                fault_point(
+                    "pipeline.commit",
+                    key=int(getattr(block.header, "number", 0)),
+                )
                 flags = self.channel.store_block(block, prepared=prepared)
                 if self.on_commit is not None:
                     self.on_commit(block, flags)
             except Exception as exc:  # noqa: BLE001 - surfaced to the owner
+                with self._pending_lock:
+                    self.last_error = exc
                 if self.on_error is not None:
                     self.on_error(block, exc)
                 else:
@@ -123,8 +150,21 @@ class CommitPipeline:
                         self._idle.set()
 
     def drain(self, timeout: float = 30.0) -> bool:
-        """Wait until every submitted block has committed."""
+        """Wait until every submitted block has committed.  Returns
+        False on timeout — check ``last_error`` (the loop's most recent
+        commit exception) and ``dead`` to tell a slow pipeline from a
+        wedged or crashed one."""
         return self._idle.wait(timeout)
+
+    @property
+    def dead(self) -> bool:
+        """True when the committer thread crashed or exited without
+        stop() — the pipeline will never drain (vs. merely slow).  The
+        crashed state is latched, so a cleanup stop() after the fact
+        does not mask it."""
+        return self._crashed or (
+            not self._committer.is_alive() and not self._stopped.is_set()
+        )
 
     def stop(self) -> None:
         self._stopped.set()

@@ -237,6 +237,22 @@ class BlockValidator:
         return flags
 
     # ------------------------------------------------------------------
+    def invalidate_identity_caches(self) -> None:
+        """MSPs/CRLs rotated: drop every identity-derived cache.  Called
+        by the config-tx path and by any out-of-band rotation (admin CRL
+        push, the fabchaos crl_rotation scenario).  The ident-cache
+        clear + generation bump is thread-safe — an in-flight stage-A
+        fill validated against the pre-rotation CRL compares generations
+        and drops.  The principal/pattern memos have a single
+        reader/writer (the validate() thread), so calling this from any
+        other thread is safe only while no validate() is in flight."""
+        with self._ident_lock:
+            self._ident_cache.clear()
+            self._ident_gen += 1
+        self._principal_cache.clear()
+        self._pattern_memo.clear()
+
+    # ------------------------------------------------------------------
     def collect_sig_jobs(
         self, parsed: Sequence[ParsedTx]
     ) -> Tuple[List[SigJob], Dict[int, Optional[Identity]], List, List[bytes], List[bytes]]:
@@ -407,17 +423,7 @@ class BlockValidator:
                         # config change can rotate MSPs/CRLs/policies:
                         # drop every derived cache (reference: channel
                         # resources bundle hot-swap invalidates them)
-                        with self._ident_lock:
-                            self._ident_cache.clear()
-                            self._ident_gen += 1
-                        # _principal_cache/_pattern_memo need no lock:
-                        # unlike _ident_cache (filled by stage A on the
-                        # deliver thread), they are read and written
-                        # only inside validate()/_batch_verify_sigs —
-                        # this very thread — so this clear cannot race
-                        # their fills
-                        self._principal_cache.clear()
-                        self._pattern_memo.clear()
+                        self.invalidate_identity_caches()
                 except Exception as e:
                     raise ValidationError(
                         f"error validating config tx: {e}"

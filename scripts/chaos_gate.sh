@@ -1,0 +1,41 @@
+#!/usr/bin/env bash
+# Seeded chaos smoke (< 60s): run the fabchaos smoke scenarios TWICE
+# with the same seed and require
+#   1. both runs green (every scenario's mask bit-exact + fail-closed
+#      assertions hold under injected faults), and
+#   2. byte-identical deterministic scorecards (replayability gate).
+set -uo pipefail
+
+cd "$(dirname "$0")/.."
+
+seed="${FABCHAOS_SEED:-7}"
+out1=$(mktemp /tmp/fabchaos.XXXXXX.json)
+out2=$(mktemp /tmp/fabchaos.XXXXXX.json)
+trap 'rm -f "$out1" "$out2"' EXIT
+
+run() {
+    # 25s per run keeps the two-run worst case inside the stage's <60s
+    # budget (a smoke run is ~5s on the 2-vCPU CI box)
+    timeout -k 5 25 python -m fabric_tpu.tools.fabchaos \
+        --seed "$seed" --scenario smoke --quiet > "$1"
+}
+
+if ! run "$out1"; then
+    echo "chaos_gate: smoke run 1 FAILED (seed $seed)" >&2
+    cat "$out1" >&2
+    exit 1
+fi
+if ! run "$out2"; then
+    echo "chaos_gate: smoke run 2 FAILED (seed $seed)" >&2
+    exit 1
+fi
+if ! cmp -s "$out1" "$out2"; then
+    echo "chaos_gate: scorecards DIVERGED across identical seeds" >&2
+    diff "$out1" "$out2" >&2 || true
+    exit 1
+fi
+echo "chaos_gate: OK (seed $seed, $(python -c "
+import json,sys
+card = json.load(open('$out1'))
+print(len(card['scenarios']), 'scenarios deterministic + green', end='')
+"))"

@@ -11,6 +11,9 @@
 #                                 the limb kernels are overflow-free under
 #                                 the canonical-limb contract and the mask
 #                                 paths fail closed
+#   6. chaos_gate.sh           -- seeded fabchaos smoke, run twice: mask
+#                                 bit-exact + fail-closed under injected
+#                                 faults, scorecards byte-identical
 #
 # Each stage runs even if an earlier one failed (one run reports ALL
 # broken gates) and prints its wall-clock time; the exit code is nonzero
@@ -36,16 +39,17 @@ run_stage() {
     echo "-- ${label}: $((SECONDS - t0))s"
 }
 
-run_stage "1/5 compileall" timeout -k 5 120 python -m compileall -q fabric_tpu
-run_stage "2/5 collect_gate" bash scripts/collect_gate.sh
+run_stage "1/6 compileall" timeout -k 5 120 python -m compileall -q fabric_tpu
+run_stage "2/6 collect_gate" bash scripts/collect_gate.sh
 # the linters' human output already prints findings as
 # path:line:col: rule: message — no JSON round-trip needed
-run_stage "3/5 fablint" timeout -k 5 60 python -m fabric_tpu.tools.fablint fabric_tpu/
-run_stage "4/5 fabdep" timeout -k 5 60 python -m fabric_tpu.tools.fabdep fabric_tpu/
-run_stage "5/5 fabflow" timeout -k 5 120 python -m fabric_tpu.tools.fabflow fabric_tpu/
+run_stage "3/6 fablint" timeout -k 5 60 python -m fabric_tpu.tools.fablint fabric_tpu/
+run_stage "4/6 fabdep" timeout -k 5 60 python -m fabric_tpu.tools.fabdep fabric_tpu/
+run_stage "5/6 fabflow" timeout -k 5 120 python -m fabric_tpu.tools.fabflow fabric_tpu/
+run_stage "6/6 chaos_gate" bash scripts/chaos_gate.sh
 
 if [ "$fail" -ne 0 ]; then
     echo "ci_gate: FAIL (stages:${failed_stages})" >&2
     exit 1
 fi
-echo "ci_gate: OK (compileall + collect + fablint + fabdep + fabflow)"
+echo "ci_gate: OK (compileall + collect + fablint + fabdep + fabflow + chaos)"

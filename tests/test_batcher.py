@@ -247,3 +247,220 @@ def test_forced_mode_env(monkeypatch):
         assert b.mode == "passthrough"
     finally:
         b.stop()
+
+
+class HangingResolveProvider:
+    """Resolver blocks until released — a wedged device tunnel."""
+
+    def __init__(self):
+        self.release = threading.Event()
+
+    def batch_verify_async(self, keys, sigs, digests):
+        def resolve():
+            self.release.wait(30)
+            return [True] * len(keys)
+
+        return resolve
+
+
+def test_stop_settles_hung_resolver_fail_closed():
+    """stop() must not leave resolve() callers blocked behind a hung
+    resolver: after the join times out, in-flight requests settle with
+    all-False verdicts (fail-closed, never a guessed True)."""
+    prov = HangingResolveProvider()
+    b = VerifyBatcher(prov, linger_s=0.0, join_timeout_s=0.2)
+    r = b.submit([b"ok", b"ok"], [b"s"] * 2, [b"d"] * 2)
+    time.sleep(0.05)  # let the dispatcher pick it up and hang
+    t0 = time.monotonic()
+    try:
+        b.stop()
+        out = r()
+    finally:
+        prov.release.set()
+    assert out == [False, False]
+    assert time.monotonic() - t0 < 5
+
+
+def test_stop_is_idempotent():
+    prov = FakeProvider()
+    b = VerifyBatcher(prov, linger_s=0.001)
+    r = b.submit([b"ok"], [b"s"], [b"d"])
+    b.stop()
+    b.stop()  # second stop: no deadlock, no double sentinel trouble
+    assert r() == [True]
+
+
+def test_stop_then_submit_raises_and_leaks_nothing():
+    prov = FakeProvider()
+    b = VerifyBatcher(prov, linger_s=0.001)
+    b.stop()
+    try:
+        b.submit([b"ok"], [b"s"], [b"d"])
+        raised = False
+    except RuntimeError:
+        raised = True
+    assert raised
+    assert b._lanes_free == b._max_pending_lanes  # admission released
+    assert not b._inflight
+
+
+class FlakyDispatchProvider:
+    """First dispatch attempts raise ConnectionError, then succeed —
+    exercises the bounded transient retry in the dispatcher."""
+
+    def __init__(self, failures):
+        self.failures = failures
+        self.attempts = 0
+
+    def batch_verify_async(self, keys, sigs, digests):
+        self.attempts += 1
+        if self.attempts <= self.failures:
+            raise ConnectionError("transient flap")
+        out = [k == b"ok" for k in keys]
+        return lambda: out
+
+
+def test_dispatch_retries_transient_then_succeeds():
+    from fabric_tpu.common.retry import RetryPolicy
+
+    prov = FlakyDispatchProvider(failures=2)
+    b = VerifyBatcher(
+        prov,
+        linger_s=0.0,
+        dispatch_retry=RetryPolicy(
+            base_s=0.001, multiplier=2, cap_s=0.01, deadline_s=1,
+            max_attempts=3,
+        ),
+    )
+    try:
+        assert b.submit([b"ok", b"no"], [b"s"] * 2, [b"d"] * 2)() == [
+            True,
+            False,
+        ]
+        assert prov.attempts == 3
+    finally:
+        b.stop()
+
+
+def test_dispatch_retry_budget_exhausted_propagates():
+    from fabric_tpu.common.retry import RetryPolicy
+
+    prov = FlakyDispatchProvider(failures=100)
+    b = VerifyBatcher(
+        prov,
+        linger_s=0.0,
+        dispatch_retry=RetryPolicy(
+            base_s=0.001, multiplier=2, cap_s=0.01, deadline_s=1,
+            max_attempts=2,
+        ),
+    )
+    try:
+        r = b.submit([b"ok"], [b"s"], [b"d"])
+        try:
+            r()
+            raised = False
+        except ConnectionError:
+            raised = True
+        assert raised
+        assert prov.attempts == 3  # 1 try + 2 retries
+    finally:
+        b.stop()
+
+
+def test_injected_submit_fault_fails_caller_without_leaking_lanes():
+    from fabric_tpu.common.faults import FaultPlan, InjectedFault, plan_installed
+
+    prov = FakeProvider()
+    b = VerifyBatcher(prov, linger_s=0.001, max_pending_lanes=8)
+    try:
+        with plan_installed(FaultPlan.parse("batcher.submit=raise:1.0")):
+            try:
+                b.submit([b"ok"], [b"s"], [b"d"])
+                raised = False
+            except InjectedFault:
+                raised = True
+        assert raised
+        assert b._lanes_free == 8  # nothing admitted, nothing leaked
+        # the batcher still works after the plan is gone
+        assert b.submit([b"ok"], [b"s"], [b"d"])() == [True]
+    finally:
+        b.stop()
+
+
+def test_stop_wakes_admission_blocked_submitter():
+    """A submitter blocked on lane admission (permits held by requests
+    queued behind a hung dispatcher) must be released by stop() with an
+    error — not wait forever on permits that will never come back."""
+    prov = HangingResolveProvider()
+    b = VerifyBatcher(
+        prov, linger_s=0.0, max_pending_lanes=2, join_timeout_s=0.2
+    )
+    # dispatched immediately (permits released at dispatch), then the
+    # dispatcher wedges inside the resolver
+    b.submit([b"ok", b"ok"], [b"s"] * 2, [b"d"] * 2)
+    time.sleep(0.05)
+    # queued behind the wedge: holds both permits
+    b.submit([b"ok", b"ok"], [b"s"] * 2, [b"d"] * 2)
+
+    outcome = []
+
+    def blocked_submit():
+        try:
+            b.submit([b"ok"], [b"s"], [b"d"])
+            outcome.append("admitted")
+        except RuntimeError:
+            outcome.append("stopped")
+
+    t = threading.Thread(target=blocked_submit, daemon=True)
+    t.start()
+    time.sleep(0.1)
+    assert not outcome  # genuinely blocked in admission
+    try:
+        b.stop()
+        t.join(timeout=2.0)
+    finally:
+        prov.release.set()
+    assert outcome == ["stopped"]
+
+
+class HoldFirstThenFailProvider:
+    """Launch 1 blocks until released (so launch 2 queues behind it),
+    launch 2 raises a hard error — the steady-state launch-failure
+    path must still drain launch 1's pending resolver."""
+
+    def __init__(self):
+        self.n = 0
+        self.release = threading.Event()
+
+    def batch_verify_async(self, keys, sigs, digests):
+        self.n += 1
+        if self.n == 1:
+            self.release.wait(5)
+            out = [k == b"ok" for k in keys]
+            return lambda: out
+        raise ValueError("hard provider error")
+
+
+def test_launch_failure_drains_pending_resolvers():
+    prov = HoldFirstThenFailProvider()
+    b = VerifyBatcher(prov, linger_s=0.0)
+    try:
+        ra = b.submit([b"ok"], [b"s"], [b"d"])
+        time.sleep(0.05)  # dispatcher takes A and blocks in its launch
+        rb = b.submit([b"ok"], [b"s"], [b"d"])
+        prov.release.set()  # A launches; B's launch then hard-fails
+        done = []
+        t = threading.Thread(target=lambda: done.append(ra()), daemon=True)
+        t.start()
+        t.join(timeout=3.0)
+        # pre-fix: A's resolver stayed pending behind the blocking
+        # q.get() and this join timed out
+        assert done == [[True]]
+        try:
+            rb()
+            raised = False
+        except ValueError:
+            raised = True
+        assert raised
+    finally:
+        b.stop()

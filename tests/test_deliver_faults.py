@@ -1,0 +1,206 @@
+"""Deliver-client endpoint failover under the shared retry/backoff
+helper and seeded fault plans (fabchaos satellite): endpoint 1 flaps N
+times, retries are bounded and policy-paced, the total-delay deadline is
+honored, and delivery resumes on endpoint 2."""
+
+from typing import List
+
+import pytest
+
+from fabric_tpu.common.faults import FaultPlan, plan_installed
+from fabric_tpu.common.retry import RetryPolicy
+from fabric_tpu.deliver.client import BlockDeliverer
+from fabric_tpu.protos import ab_pb2, common_pb2, protoutil
+from fabric_tpu.tools.fabchaos import _seek_start
+
+
+def _blocks(n: int) -> List[common_pb2.Block]:
+    return [protoutil.new_block(i, b"") for i in range(n)]
+
+
+def _endpoint(name: str, blocks, calls: List[str]):
+    def serve(env):
+        calls.append(name)
+        for b in blocks[_seek_start(env):]:
+            resp = ab_pb2.DeliverResponse()
+            resp.block.CopyFrom(b)
+            yield resp
+
+    return serve
+
+
+def _deliverer(blocks, calls, got, sleeps, endpoints=2, **kw):
+    eps = [_endpoint(f"ep{i}", blocks, calls) for i in range(endpoints)]
+    kw.setdefault(
+        "retry_policy",
+        RetryPolicy(base_s=0.05, multiplier=2.0, cap_s=0.4, deadline_s=30.0),
+    )
+    return BlockDeliverer(
+        "testchan",
+        eps,
+        on_block=lambda b: got.append(b.header.number),
+        next_block=lambda: len(got),
+        sleeper=lambda s: sleeps.append(round(s, 6)),
+        **kw,
+    )
+
+
+def test_flap_then_failover_resumes_on_endpoint_2():
+    blocks = _blocks(6)
+    calls, got, sleeps = [], [], []
+    flap_n = 3
+    with plan_installed(
+        FaultPlan.parse(f"deliver.pull=raise:1.0:max={flap_n}", seed=1)
+    ):
+        d = _deliverer(blocks, calls, got, sleeps)
+        received = d.run(max_blocks=6)
+    assert received == 6
+    assert got == [0, 1, 2, 3, 4, 5]
+    # bounded retries: exactly one backoff sleep per flap, on the ramp
+    assert sleeps == [0.05, 0.1, 0.2]
+    # attempts 1..3 flapped and failed over each time; with 2 endpoints
+    # attempt 4 lands on ep1 (index 3 % 2) and serves the whole range
+    assert calls == ["ep1"]
+
+
+def test_backoff_resets_after_successful_block():
+    """A flap AFTER progress restarts the exponential ramp (the
+    reference resets its failure counter per delivered block)."""
+    blocks = _blocks(4)
+    calls, got, sleeps = [], [], []
+    # attempts 1 and 3 fail: 1 flap, serve blocks, mid-stream failure
+    # is simulated by max_blocks-ing two sessions
+    with plan_installed(
+        FaultPlan.parse("deliver.pull=raise:1.0:max=1", seed=1)
+    ):
+        d = _deliverer(blocks, calls, got, sleeps)
+        assert d.run(max_blocks=2) == 2
+    with plan_installed(
+        FaultPlan.parse("deliver.pull=raise:1.0:max=1", seed=1)
+    ):
+        # fresh deliverer, same ramp start: the Backoff reset means the
+        # second session's first retry is base_s again, not the ramp tail
+        d2 = _deliverer(blocks, calls, got, sleeps)
+        assert d2.run(max_blocks=2) == 2
+    assert got == [0, 1, 2, 3]
+    assert sleeps == [0.05, 0.05]
+
+
+def test_deadline_honored_when_all_endpoints_dead():
+    blocks = _blocks(2)
+    calls, got, sleeps = [], [], []
+    with plan_installed(FaultPlan.parse("deliver.pull=raise:1.0", seed=1)):
+        d = _deliverer(
+            blocks, calls, got, sleeps,
+            retry_policy=RetryPolicy(
+                base_s=0.05, multiplier=2.0, cap_s=0.4, deadline_s=1.0
+            ),
+        )
+        received = d.run(max_blocks=2)
+    assert received == 0 and got == []
+    # nominal sleep budget: 0.05+0.1+0.2+0.4 = 0.75; adding the next
+    # 0.4 would breach the 1.0s deadline, so the session ends there
+    assert sleeps == [0.05, 0.1, 0.2, 0.4]
+    assert sum(sleeps) <= 1.0
+
+
+def test_max_attempts_bounds_retries():
+    blocks = _blocks(2)
+    calls, got, sleeps = [], [], []
+    with plan_installed(FaultPlan.parse("deliver.pull=raise:1.0", seed=1)):
+        d = _deliverer(
+            blocks, calls, got, sleeps,
+            retry_policy=RetryPolicy(
+                base_s=0.01, multiplier=2.0, cap_s=1.0, deadline_s=60.0,
+                max_attempts=3,
+            ),
+        )
+        assert d.run(max_blocks=2) == 0
+    assert len(sleeps) == 3
+
+
+def test_legacy_constructor_args_still_shape_the_policy():
+    """max_retry_delay/max_total_delay (the pre-retry.py surface) keep
+    working: they cap the per-sleep delay and the total budget."""
+    blocks = _blocks(1)
+    calls, got, sleeps = [], [], []
+    with plan_installed(FaultPlan.parse("deliver.pull=raise:1.0", seed=1)):
+        d = BlockDeliverer(
+            "testchan",
+            [_endpoint("ep0", blocks, calls)],
+            on_block=lambda b: got.append(b.header.number),
+            next_block=lambda: len(got),
+            sleeper=lambda s: sleeps.append(s),
+            max_retry_delay=0.08,
+            max_total_delay=0.3,
+        )
+        assert d.run(max_blocks=1) == 0
+    assert sleeps and max(sleeps) <= 0.08
+    assert sum(sleeps) <= 0.3
+
+
+def test_clean_path_unchanged_without_plan():
+    blocks = _blocks(5)
+    calls, got, sleeps = [], [], []
+    d = _deliverer(blocks, calls, got, sleeps)
+    assert d.run(max_blocks=5) == 5
+    assert sleeps == [] and calls == ["ep0"]
+    assert d.stats.failures == 0
+
+
+def test_update_endpoints_midstream_with_faults():
+    """A config refresh lands new endpoints while the old primary is
+    flapping: the pull resumes on the refreshed list."""
+    blocks = _blocks(4)
+    calls, got, sleeps = [], [], []
+    fresh_calls: List[str] = []
+    with plan_installed(
+        FaultPlan.parse("deliver.pull=raise:1.0:max=2", seed=1)
+    ):
+        d = _deliverer(blocks, calls, got, sleeps, endpoints=1)
+        # refresh as soon as the first backoff sleep happens
+        orig_sleeper = d._sleeper
+
+        def refresh_then_sleep(s):
+            d.update_endpoints([_endpoint("fresh", blocks, fresh_calls)])
+            orig_sleeper(s)
+
+        d._sleeper = refresh_then_sleep
+        assert d.run(max_blocks=4) == 4
+    assert got == [0, 1, 2, 3]
+    assert fresh_calls == ["fresh"]
+
+
+def test_retry_seed_arms_jitter_on_default_policy():
+    """retry_seed alone (no custom policy) must actually desynchronize
+    the ramp: ±20% seeded jitter on the reference policy."""
+    blocks = _blocks(1)
+    calls, got, sleeps = [], [], []
+    with plan_installed(FaultPlan.parse("deliver.pull=raise:1.0:max=4", seed=1)):
+        d = BlockDeliverer(
+            "testchan",
+            [_endpoint("ep0", blocks, calls)],
+            on_block=lambda b: got.append(b.header.number),
+            next_block=lambda: len(got),
+            sleeper=lambda s: sleeps.append(s),
+            retry_seed=42,
+        )
+        assert d.run(max_blocks=1) == 1
+    assert d._retry_policy.jitter == 0.2
+    base_ramp = [0.06 * 1.2**i for i in range(4)]
+    assert any(abs(s - b) > 1e-9 for s, b in zip(sleeps, base_ramp))
+    for s, b in zip(sleeps, base_ramp):
+        assert 0.8 * b - 1e-9 <= s <= 1.2 * b + 1e-9
+    # seeded: a second deliverer with the same seed replays identically
+    sleeps2 = []
+    with plan_installed(FaultPlan.parse("deliver.pull=raise:1.0:max=4", seed=1)):
+        d2 = BlockDeliverer(
+            "testchan",
+            [_endpoint("ep0", blocks, [])],
+            on_block=lambda b: None,
+            next_block=lambda: 0,
+            sleeper=lambda s: sleeps2.append(s),
+            retry_seed=42,
+        )
+        d2.run(max_blocks=1)
+    assert sleeps2[: len(sleeps)] == sleeps

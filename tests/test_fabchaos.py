@@ -1,0 +1,179 @@
+"""fabchaos scenario harness: determinism of the scorecard, the mask
+bit-exactness/fail-closed assertions of every scenario, and the CLI.
+Runs without cryptography (the validation plane rides the fake MSP)."""
+
+import json
+
+import pytest
+
+from fabric_tpu.common import faults
+from fabric_tpu.tools import fabchaos
+from fabric_tpu.tools.fabchaos import (
+    SCENARIOS,
+    SMOKE,
+    ChaosAssertionError,
+    LanePool,
+    StageClock,
+    run_scenarios,
+)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    yield
+    assert faults.active_plan() is None, "a scenario leaked its fault plan"
+
+
+BOUNDED = [n for n in SCENARIOS if n != "soak"]
+
+
+@pytest.mark.parametrize("name", BOUNDED)
+def test_scenario_passes_and_det_is_reproducible(name):
+    """Every bounded scenario runs green twice with identical
+    deterministic sections — the per-scenario core of the
+    --scenario all determinism gate."""
+    if name == "pool_chaos":
+        pytest.skip("runs in test_pool_chaos_degrades_inline (slow pool boot)")
+    det1, _ = SCENARIOS[name](11, StageClock(), 0.5)
+    det2, _ = SCENARIOS[name](11, StageClock(), 0.5)
+    assert det1 == det2
+    det3, _ = SCENARIOS[name](12, StageClock(), 0.5)
+    # a different seed must actually steer the workload (flags/masks/
+    # fault sets move); static config fields may coincide
+    assert det1.keys() == det3.keys()
+
+
+@pytest.mark.slow
+def test_pool_chaos_degrades_inline():
+    det, obs = SCENARIOS["pool_chaos"](11, StageClock(), 1.0)
+    assert det["mask_ok"] and det["degrade_inline_ok"]
+    assert obs["faults_fired"].get("hostec.pool.submit", 0) + obs[
+        "faults_fired"
+    ].get("hostec_np.pool.submit", 0) >= 1
+
+
+def test_run_scenarios_card_shape_and_ok():
+    card = run_scenarios(["verify_faults", "commit_storm"], seed=5, scale=0.5)
+    det = card["deterministic"]
+    assert det["ok"] is True
+    assert set(det["scenarios"]) == {"verify_faults", "commit_storm"}
+    assert det["scenarios"]["verify_faults"]["mask_ok"] is True
+    # observed carries stage latency summaries with p50/p99
+    stages = card["observed"]["stages"]["verify_faults"]
+    assert any("p99_ms" in s for s in stages.values())
+
+
+def test_failed_assertion_lands_in_card_not_raise(monkeypatch):
+    def exploding(seed, clock, scale=1.0):
+        raise ChaosAssertionError("deterministic failure message")
+
+    monkeypatch.setitem(SCENARIOS, "exploding", exploding)
+    card = run_scenarios(["exploding"], seed=1)
+    det = card["deterministic"]
+    assert det["ok"] is False
+    assert det["scenarios"]["exploding"] == {
+        "ok": False,
+        "assertion": "deterministic failure message",
+    }
+
+
+def test_lane_pool_ground_truth_vs_software_provider():
+    """The by-construction expected verdicts agree with the real
+    SoftwareProvider batch path on every corruption kind."""
+    import random
+
+    from fabric_tpu.crypto.bccsp import SoftwareProvider
+
+    rng = random.Random(99)
+    pool = LanePool(rng, n_keys=2, n_msgs=6)
+    keys, sigs, digests, expected, kinds = pool.lanes(rng, 48)
+    assert set(kinds) == set(fabchaos.LANE_KINDS)  # every kind sampled
+    out = SoftwareProvider().batch_verify(keys, sigs, digests)
+    assert list(out) == expected
+
+
+def test_corrupt_detect_scenario_catches_blindness():
+    det, _ = SCENARIOS["corrupt_detect"](3, StageClock())
+    assert det["corruption_detected"] and det["clean_after_uninstall"]
+
+
+def test_cli_smoke_stdout_is_deterministic(capsys):
+    rc1 = fabchaos.main(
+        ["--seed", "5", "--scenario", "commit_storm,deliver_flap", "--quiet"]
+    )
+    out1 = capsys.readouterr().out
+    rc2 = fabchaos.main(
+        ["--seed", "5", "--scenario", "commit_storm,deliver_flap", "--quiet"]
+    )
+    out2 = capsys.readouterr().out
+    assert rc1 == rc2 == 0
+    assert out1 == out2
+    card = json.loads(out1)
+    assert card["ok"] is True and card["seed"] == 5
+    # stdout is the deterministic section ONLY: no wall-clock leaks
+    assert "stages" not in out1 and "wall_s" not in out1
+
+
+def test_cli_out_file_carries_latencies(tmp_path, capsys):
+    out_path = tmp_path / "card.json"
+    rc = fabchaos.main(
+        [
+            "--seed", "5", "--scenario", "deliver_flap",
+            "--quiet", "--out", str(out_path),
+        ]
+    )
+    capsys.readouterr()
+    assert rc == 0
+    full = json.loads(out_path.read_text())
+    assert "deterministic" in full and "observed" in full
+    assert full["observed"]["stages"]["deliver_flap"]
+
+
+def test_cli_rejects_unknown_scenario(capsys):
+    assert fabchaos.main(["--scenario", "nope"]) == 2
+    capsys.readouterr()
+
+
+def test_cli_list_scenarios(capsys):
+    assert fabchaos.main(["--list-scenarios"]) == 0
+    out = capsys.readouterr().out
+    for name in SMOKE:
+        assert name in out
+
+
+def test_scorecard_for_bench_compact_shape():
+    card = fabchaos.scorecard_for_bench(seed=5, scale=0.4)
+    assert card["ok"] is True
+    assert set(card["scenarios"]) == set(SMOKE)
+    assert len(card["det_sha"]) == 16
+
+
+@pytest.mark.slow
+def test_soak_runs_rounds():
+    det, obs = SCENARIOS["soak"](1, StageClock(), 0.5, seconds=8.0)
+    assert obs["rounds"] >= 1
+
+
+def test_pipeline_dead_latches_across_stop():
+    """A committer killed by a non-Exception escape stays dead even
+    after a cleanup stop() — the soak triage workflow (drain -> stop ->
+    inspect) must not be lied to."""
+    from fabric_tpu.peer.pipeline import CommitPipeline
+    from fabric_tpu.protos import protoutil
+    from fabric_tpu.tools.fabchaos import _ChaosChannel
+
+    ch = _ChaosChannel("latch")
+    orig_store = ch.store_block
+
+    def killer(block, prepared=None):
+        raise KeyboardInterrupt("simulated interpreter-level escape")
+
+    ch.store_block = killer
+    pipe = CommitPipeline(ch)
+    pipe.submit(protoutil.new_block(0, b""))
+    pipe._committer.join(timeout=5)
+    assert pipe.dead
+    assert isinstance(pipe.last_error, KeyboardInterrupt)
+    pipe.stop()
+    assert pipe.dead  # latched: stop() does not mask the crash
+    ch.store_block = orig_store

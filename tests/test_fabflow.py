@@ -568,3 +568,54 @@ def test_bignum_cios_proof_holds_standalone():
     )
     assert findings == []
     assert stats["suppressed"] == 1  # qm_term's documented relational bet
+
+
+# ---------------------------------------------------------------------------
+# fabchaos interplay: fault-injection wrappers must not be able to hide
+# a fail-open handler from the analyzer (pinned firing fixture, PR 6)
+# ---------------------------------------------------------------------------
+
+
+def test_mask_fail_open_fires_on_fail_open_injection_wrapper():
+    """A *genuinely fail-open* chaos wrapper — swallowing InjectedFault
+    around a flag write and moving on — must still fire: fault_point
+    sites in the mask tier may only appear inside handlers that settle
+    an INVALID-family code, raise, or hand the exception onward (the
+    shapes the real batcher/pipeline seams use)."""
+    findings = flow(
+        """
+        from fabric_tpu.common.faults import InjectedFault, fault_point
+        from fabric_tpu.common.txflags import TxValidationCode
+
+        def settle(flags, i, data):
+            try:
+                fault_point("pipeline.commit", key=i)
+                flags.set_flag(i, compute_code(data))
+            except InjectedFault:
+                pass  # swallowed: the lane's flag is left unset
+        """,
+        path=MASK_PATH,
+        rules=["mask-fail-open"],
+    )
+    assert rule_ids(findings) == ["mask-fail-open"]
+
+
+def test_mask_fail_open_accepts_fail_closed_injection_wrapper():
+    """The real seam shape: an injected fault settles the lane with an
+    INVALID-family code (fail-closed) — no finding."""
+    findings = flow(
+        """
+        from fabric_tpu.common.faults import InjectedFault, fault_point
+        from fabric_tpu.common.txflags import TxValidationCode
+
+        def settle(flags, i, data):
+            try:
+                fault_point("pipeline.commit", key=i)
+                flags.set_flag(i, compute_code(data))
+            except InjectedFault:
+                flags.set_flag(i, TxValidationCode.INVALID_OTHER_REASON)
+        """,
+        path=MASK_PATH,
+        rules=["mask-fail-open"],
+    )
+    assert findings == []

@@ -1,0 +1,365 @@
+"""fabchaos foundations: the deterministic fault-injection registry
+(common/faults.py) and the shared retry/backoff helper (common/retry.py).
+No jax, no cryptography — pure host."""
+
+import threading
+import time
+
+import pytest
+
+from fabric_tpu.common import faults
+from fabric_tpu.common.faults import (
+    FaultPlan,
+    FaultSpec,
+    InjectedFault,
+    corrupt_verdicts,
+    fault_point,
+    plan_installed,
+)
+from fabric_tpu.common.retry import (
+    Backoff,
+    CooldownGate,
+    RetryPolicy,
+    call_with_retry,
+)
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan grammar + decisions
+# ---------------------------------------------------------------------------
+
+
+def test_parse_grammar_full():
+    plan = FaultPlan.parse(
+        "batcher.dispatch=raise:0.25:max=3;"
+        "pipeline.commit=delay:1.0:ms=50;"
+        "bccsp.verdict=corrupt:0.5:lanes=4;"
+        "gossip.comm.send=drop",
+        seed=9,
+    )
+    by_site = {s.site: s for s in plan.specs()}
+    assert by_site["batcher.dispatch"].action == "raise"
+    assert by_site["batcher.dispatch"].prob == 0.25
+    assert by_site["batcher.dispatch"].max_fires == 3
+    assert by_site["pipeline.commit"].delay_ms == 50
+    assert by_site["bccsp.verdict"].lanes == 4
+    assert by_site["gossip.comm.send"].prob == 1.0  # default
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        "no-equals-sign",
+        "site=explode",  # unknown action
+        "site=raise:2.0",  # prob out of range
+        "site=raise:0.5:bogus=1",  # unknown param
+        "site=raise:0.5:max=x",  # non-int param
+    ],
+)
+def test_parse_rejects_malformed(bad):
+    with pytest.raises((ValueError, TypeError)):
+        FaultPlan.parse(bad)
+
+
+def test_env_install_is_warn_never_raise(monkeypatch):
+    monkeypatch.setenv("FABRIC_TPU_FAULTS", "not a plan at all")
+    with pytest.warns(RuntimeWarning, match="FABRIC_TPU_FAULTS ignored"):
+        faults._install_from_env()
+    assert faults.active_plan() is None
+    monkeypatch.setenv("FABRIC_TPU_FAULTS", "x.y=raise:0.5")
+    monkeypatch.setenv("FABRIC_TPU_FAULTS_SEED", "42")
+    try:
+        faults._install_from_env()
+        plan = faults.active_plan()
+        assert plan is not None and plan.seed == 42
+    finally:
+        faults.clear_plan()
+
+
+def test_keyed_decisions_are_call_order_independent():
+    """Same (seed, site, key) -> same verdict, regardless of the order
+    or thread the checks run in — the determinism contract."""
+    p1 = FaultPlan.parse("s=raise:0.5", seed=13)
+    p2 = FaultPlan.parse("s=raise:0.5", seed=13)
+    keys = list(range(200))
+    d1 = {}
+    for k in keys:
+        d1[k] = p1.check("s", key=k) is not None
+    for k in reversed(keys):  # opposite order
+        assert (p2.check("s", key=k) is not None) == d1[k]
+    fired = sum(d1.values())
+    assert 0 < fired < len(keys)  # ~50%: actually probabilistic
+
+
+def test_seed_changes_decisions():
+    a = FaultPlan.parse("s=raise:0.5", seed=1)
+    b = FaultPlan.parse("s=raise:0.5", seed=2)
+    da = [a.check("s", key=k) is not None for k in range(64)]
+    db = [b.check("s", key=k) is not None for k in range(64)]
+    assert da != db
+
+
+def test_max_fires_caps_and_counts():
+    plan = FaultPlan.parse("s=raise:1.0:max=3", seed=0)
+    hits = sum(plan.check("s", key=i) is not None for i in range(10))
+    assert hits == 3
+    assert plan.fired() == {"s": 3}
+    plan.reset_counters()
+    assert plan.fired() == {}
+    assert plan.check("s", key=0) is not None
+
+
+def test_fault_point_disabled_is_none_and_free():
+    faults.clear_plan()
+    assert fault_point("anything", key=1) is None
+
+
+def test_fault_point_raise_delay_corrupt():
+    with plan_installed(FaultPlan.parse("a=raise;b=delay:1.0:ms=5;c=corrupt")):
+        with pytest.raises(InjectedFault, match="injected fault at a"):
+            fault_point("a")
+        t0 = time.perf_counter()
+        assert fault_point("b") is None  # delay is transparent
+        assert time.perf_counter() - t0 >= 0.004
+        spec = fault_point("c", interprets=("corrupt",))
+        assert spec is not None and spec.action == "corrupt"
+    # context manager cleared the plan
+    assert faults.active_plan() is None
+    assert fault_point("a") is None
+
+
+def test_corrupt_verdicts_width():
+    spec = FaultSpec("s", "corrupt", lanes=2)
+    assert corrupt_verdicts([True, True, True], spec) == [False, False, True]
+    all_spec = FaultSpec("s", "corrupt", lanes=0)
+    assert corrupt_verdicts([True, False], all_spec) == [False, True]
+
+
+def test_unkeyed_decisions_are_seed_reproducible_single_thread():
+    seq = [
+        FaultPlan.parse("s=raise:0.3", seed=5).check("s") is not None
+        for _ in range(1)
+    ]
+    a = FaultPlan.parse("s=raise:0.3", seed=5)
+    b = FaultPlan.parse("s=raise:0.3", seed=5)
+    sa = [a.check("s") is not None for _ in range(50)]
+    sb = [b.check("s") is not None for _ in range(50)]
+    assert sa == sb and seq[0] == sa[0]
+
+
+def test_plan_check_thread_safety_counts_exactly():
+    plan = FaultPlan.parse("s=raise:1.0:max=64", seed=0)
+    hits = []
+    lock = threading.Lock()
+
+    def worker():
+        got = sum(plan.check("s", key=i) is not None for i in range(32))
+        with lock:
+            hits.append(got)
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert sum(hits) == 64  # the cap is exact under contention
+
+
+# ---------------------------------------------------------------------------
+# RetryPolicy / Backoff / call_with_retry / CooldownGate
+# ---------------------------------------------------------------------------
+
+
+def test_backoff_ramp_cap_and_deadline():
+    sleeps = []
+    policy = RetryPolicy(base_s=0.1, multiplier=2.0, cap_s=0.4, deadline_s=1.2)
+    bo = Backoff(policy, sleeper=sleeps.append)
+    while bo.sleep():
+        pass
+    # 0.1 + 0.2 + 0.4 + 0.4 = 1.1 <= 1.2; the next 0.4 would breach
+    assert sleeps == [0.1, 0.2, 0.4, 0.4]
+    assert bo.total_delay_s == pytest.approx(1.1)
+
+
+def test_backoff_max_attempts_and_reset():
+    sleeps = []
+    policy = RetryPolicy(base_s=0.1, multiplier=2.0, cap_s=10, deadline_s=10,
+                         max_attempts=2)
+    bo = Backoff(policy, sleeper=sleeps.append)
+    assert bo.sleep() and bo.sleep() and not bo.sleep()
+    assert sleeps == [0.1, 0.2]
+    bo.reset()  # success restarts the ramp, deadline budget persists
+    assert bo.sleep()
+    assert sleeps[-1] == 0.1
+
+
+def test_backoff_jitter_seeded_deterministic():
+    policy = RetryPolicy(base_s=0.1, multiplier=1.0, cap_s=1, deadline_s=10,
+                         jitter=0.5, max_attempts=5)
+    a, b = [], []
+    boa = Backoff(policy, seed=3, sleeper=a.append)
+    bob = Backoff(policy, seed=3, sleeper=b.append)
+    for _ in range(5):
+        boa.sleep()
+        bob.sleep()
+    assert a == b
+    assert any(abs(x - 0.1) > 1e-9 for x in a)  # jitter actually applied
+    for x in a:
+        assert 0.05 - 1e-9 <= x <= 0.15 + 1e-9
+
+
+def test_call_with_retry_recovers_and_respects_budget():
+    calls = []
+
+    def flaky(attempt):
+        calls.append(attempt)
+        if attempt < 2:
+            raise InjectedFault("x")
+        return "ok"
+
+    assert (
+        call_with_retry(
+            flaky,
+            policy=RetryPolicy(base_s=0, multiplier=1, cap_s=0, deadline_s=1,
+                               max_attempts=5),
+            sleeper=lambda s: None,
+        )
+        == "ok"
+    )
+    assert calls == [0, 1, 2]
+
+    def always(attempt):
+        raise InjectedFault("y")
+
+    with pytest.raises(InjectedFault):
+        call_with_retry(
+            always,
+            policy=RetryPolicy(base_s=0, multiplier=1, cap_s=0, deadline_s=1,
+                               max_attempts=3),
+            sleeper=lambda s: None,
+        )
+
+
+def test_call_with_retry_nontransient_propagates_immediately():
+    calls = []
+
+    def broken(attempt):
+        calls.append(attempt)
+        raise ValueError("a bug, not weather")
+
+    with pytest.raises(ValueError):
+        call_with_retry(broken, sleeper=lambda s: None)
+    assert calls == [0]
+
+
+def test_cooldown_gate_escalates_and_resets():
+    now = [0.0]
+    gate = CooldownGate(
+        RetryPolicy(base_s=1.0, multiplier=2.0, cap_s=8.0,
+                    deadline_s=float("inf")),
+        clock=lambda: now[0],
+    )
+    assert gate.ready()
+    gate.record_failure()
+    assert not gate.ready()
+    now[0] = 1.0
+    assert gate.ready()
+    gate.record_failure()  # second failure: 2s cooldown
+    now[0] = 2.0
+    assert not gate.ready()
+    now[0] = 3.0
+    assert gate.ready()
+    gate.record_success()
+    gate.record_failure()  # ramp reset: back to 1s
+    now[0] = 4.1
+    assert gate.ready()
+
+
+# ---------------------------------------------------------------------------
+# seam integration: the hostec pool rebuild honors the cooldown
+# ---------------------------------------------------------------------------
+
+
+def test_hostec_broken_shutdown_arms_cooldown(monkeypatch):
+    from fabric_tpu.crypto import hostec
+
+    gate = hostec._POOL_GATE
+    monkeypatch.setattr(gate, "_failures", 0)
+    monkeypatch.setattr(gate, "_open_until", 0.0)
+    hostec.shutdown_pool(broken=True)
+    assert not gate.ready()
+    # a clean shutdown must NOT extend the cooldown
+    failures_before = gate._failures
+    hostec.shutdown_pool(broken=False)
+    assert gate._failures == failures_before
+    monkeypatch.setattr(gate, "_open_until", 0.0)
+    monkeypatch.setattr(gate, "_failures", 0)
+
+
+def test_multi_spec_site_budgets_are_independent():
+    """Two specs on one site each get their own max_fires budget (the
+    site-wide counter would starve the second spec)."""
+    plan = FaultPlan.parse("s=raise:1.0:max=2;s=corrupt:1.0:max=5", seed=0)
+    raises = corrupts = 0
+    for i in range(20):
+        spec = plan.check("s", key=i, interprets=("corrupt",))
+        if spec is None:
+            continue
+        if spec.action == "raise":
+            raises += 1
+        elif spec.action == "corrupt":
+            corrupts += 1
+    assert raises == 2
+    assert corrupts == 5
+    assert plan.fired() == {"s": 7}  # aggregated per site for scorecards
+
+
+def test_plan_installed_restores_previous_plan():
+    """A scoped plan (scenario runner) must restore the operator's
+    process-wide plan on exit, not disarm it — the FABRIC_TPU_FAULTS +
+    bench_chaos combination depends on it."""
+    outer = FaultPlan.parse("deliver.pull=raise:0.5", seed=1)
+    inner = FaultPlan.parse("batcher.submit=raise:1.0", seed=2)
+    faults.install_plan(outer)
+    try:
+        with plan_installed(inner):
+            assert faults.active_plan() is inner
+        assert faults.active_plan() is outer
+    finally:
+        faults.clear_plan()
+    assert faults.active_plan() is None
+
+
+def test_cooldown_gate_no_overflow_after_many_failures():
+    """A persistently-broken environment grows the failure count without
+    bound; the exponent must clamp instead of raising OverflowError."""
+    now = [0.0]
+    gate = CooldownGate(clock=lambda: now[0])
+    for _ in range(2000):
+        gate.record_failure()
+    assert not gate.ready()
+    bo = Backoff(
+        RetryPolicy(base_s=0.01, multiplier=2.0, cap_s=0.02,
+                    deadline_s=float("inf")),
+        sleeper=lambda s: None,
+    )
+    bo.attempts = 5000  # simulate a very long retry loop
+    assert bo.next_delay() == 0.02
+
+
+def test_uninterpreted_action_skipped_uncounted_with_warning():
+    """A corrupt/drop spec at a site that doesn't implement it must not
+    fire, not count, and must warn exactly once."""
+    plan = FaultPlan.parse("pipeline.commit=drop;pipeline.commit=raise:1.0:max=1")
+    with pytest.warns(RuntimeWarning, match="does not interpret 'drop'"):
+        spec = plan.check("pipeline.commit", key=1)
+    assert spec is not None and spec.action == "raise"  # falls through
+    import warnings as _w
+
+    with _w.catch_warnings():
+        _w.simplefilter("error")  # a second warning would raise here
+        assert plan.check("pipeline.commit", key=2) is None  # raise capped
+    assert plan.fired() == {"pipeline.commit": 1}  # only the raise counted
+    # a site that DOES interpret the action receives the spec
+    plan2 = FaultPlan.parse("bccsp.verdict=corrupt:1.0")
+    assert plan2.check("bccsp.verdict", interprets=("corrupt",)).action == "corrupt"

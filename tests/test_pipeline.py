@@ -280,3 +280,24 @@ def test_pipeline_surfaces_commit_errors(tmp_path, world):
         pipe.stop()
     assert ch.ledger.height == 1
     assert errors and errors[0][0] == 0
+
+
+def test_drain_false_surfaces_last_error(tmp_path, world):
+    """Satellite regression: a commit-loop failure must be recorded on
+    the pipeline (last_error) so a soak run that sees drain() == False
+    can tell 'slow' from 'dead' — pre-fix, the terminal exception was
+    visible only to the optional on_error callback."""
+    ch = Channel(
+        CHANNEL, str(tmp_path), world["mgr"], world["registry"], PROVIDER
+    )
+    blocks = _chain(world, 1)
+    pipe = CommitPipeline(ch)
+    try:
+        assert pipe.last_error is None and not pipe.dead
+        pipe.submit(blocks[0])
+        pipe.submit(blocks[0])  # duplicate -> block store rejects
+        assert pipe.drain(timeout=30)
+        assert pipe.last_error is not None
+        assert not pipe.dead  # the loop survived: slow/erroring, not dead
+    finally:
+        pipe.stop()
