@@ -11,8 +11,10 @@ The `detail.configs` object carries the measured numbers for configs
                bit-exact TRANSACTIONS_FILTER asserted (config #2).  The
                SW column is the OpenSSL-backed provider (the reference
                SW BCCSP's speed class), NOT the pure-Python oracle.
-  idemix     — batched Idemix verify: device Ate2 pairing kernel vs the
-               host oracle pairing, ms/sig (config #3).
+  idemix     — batched Idemix verify: the hostbn->scheme backend
+               ladder per-rung ms/sig at batch 8/64/256, plus the
+               device Ate2 pairing column, all vs the scheme oracle
+               (config #3).
   mvcc_5k    — 5k-tx MVCC validate-and-prepare, ms/block (config #4).
   multi_4ch  — 4 channels x 2k-tx blocks in one channel-axis device
                step, aggregate tx/s (config #5; sharding across chips is
@@ -388,18 +390,26 @@ def bench_block_1k(net, device_ok=True, n_txs=1000):
 
 
 def bench_idemix(device_ok=True, n_sigs=None):
-    """Config #3: batched Idemix verify, device Ate2 pairing kernel vs
-    the host oracle pairing (idemix/signature.go:243-296). Device lanes
-    default to 64 (VERDICT r4 #3: ms/sig must be read at batch >= 64,
-    where the fixed-length Miller-loop scan amortizes across the lane
-    dimension); host signature GENERATION costs ~2s each, so lanes are
-    tiled from 8 unique signatures — the device cost per lane is
-    data-independent (fixed-shape scan, no data-dependent branches)."""
+    """Config #3: batched Idemix verify across the idemix backend
+    ladder (hostbn numpy lanes -> scheme oracle; crypto/bccsp.py
+    IDEMIX_TIERS), per-rung ms/sig at batch 8/64/256 — mirroring the
+    host_ladder/sw_ec_backend reporting discipline so an oracle-rung
+    fallback can never masquerade as a hostbn number — plus the device
+    Ate2 pairing column when a chip answers.  Setup is
+    cryptography-free (ALG_NO_REVOCATION with an unsigned CRI, which
+    Ver with rev_pk=None never reads), so this config measures on any
+    box; host signature GENERATION costs ~1-2s each, so lanes are
+    tiled from 8 unique signatures."""
     import random
 
     from fabric_tpu import idemix
     from fabric_tpu.crypto import fp256bn as bncurve
+    from fabric_tpu.crypto.bccsp import (
+        available_idemix_backends,
+        idemix_backend_name,
+    )
     from fabric_tpu.idemix.batch import verify_signatures_batch
+    from fabric_tpu.protos import idemix_pb2
 
     if n_sigs is None:
         n_sigs = int(os.environ.get("BENCH_IDEMIX_SIGS", "64"))
@@ -411,8 +421,8 @@ def bench_idemix(device_ok=True, n_sigs=None):
     nonce = bncurve.big_to_bytes(bncurve.rand_mod_order(rng))
     req = idemix.new_cred_request(sk, nonce, ik.ipk, rng)
     cred = idemix.new_credential(ik, req, [11, 22, 33, 44], rng)
-    rev_key = idemix.generate_long_term_revocation_key()
-    cri = idemix.create_cri(rev_key, [], 0, idemix.ALG_NO_REVOCATION, rng)
+    cri = idemix_pb2.CredentialRevocationInformation()
+    cri.revocation_alg = idemix.ALG_NO_REVOCATION
     disclosure = [0, 0, 0, 0]
     msg = b"idemix bench message"
     uniq = []
@@ -423,66 +433,114 @@ def bench_idemix(device_ok=True, n_sigs=None):
                 cred, sk, nym, r_nym, ik.ipk, disclosure, msg, rh_index, cri, rng
             )
         )
-    sigs = [uniq[i % len(uniq)] for i in range(n_sigs)]
-    values = [[None, None, None, None]] * n_sigs
+
+    def batch_args(count):
+        sigs_c = [uniq[i % len(uniq)] for i in range(count)]
+        return (
+            sigs_c,
+            [disclosure] * count,
+            ik.ipk,
+            [msg] * count,
+            [[None, None, None, None]] * count,
+            rh_index,
+        )
 
     def run(device, count):
         start = time.perf_counter()
         out = verify_signatures_batch(
-            sigs[:count],
-            [disclosure] * count,
-            ik.ipk,
-            [msg] * count,
-            values[:count],
-            rh_index,
-            device_pairing=device,
+            *batch_args(count), device_pairing=device
         )
         return (time.perf_counter() - start) * 1000.0, out
 
-    # the host column is the PURE-HOST oracle (scheme.verify_signature —
-    # the reference's signature.go Ver path, no device anywhere), timed
-    # over a 2-sig sample so the config fits the bench budget; the
-    # batch path's `device_pairing=False` mode still runs its MSM on the
-    # device, which would time the TUNNEL, not the CPU.  One warm-up
-    # verify first amortizes one-time table builds (the device column
-    # gets the same warm-up below); full-batch device/host verdict
-    # parity is pinned by tests/test_pairing_kernel.py.
-    from fabric_tpu.idemix.scheme import verify_signature
-
-    def host_verify(count):
-        start = time.perf_counter()
-        outs = []
-        for i in range(count):
-            try:
-                verify_signature(
-                    sigs[i], disclosure, ik.ipk, msg,
-                    values[i], rh_index, None, 0,
-                )
-                outs.append(True)
-            except Exception:  # noqa: BLE001 - invalid signature
-                outs.append(False)
-        return (time.perf_counter() - start) * 1000.0, outs
-
-    n_host = min(2, n_sigs)
-    host_verify(1)  # warm-up (one-time table builds)
-    host_ms, host_out = host_verify(n_host)
+    # the oracle column is the PURE-HOST scheme rung
+    # (scheme.verify_signature — the reference's signature.go Ver path),
+    # timed over a small sample (it runs ~1s/sig here); one warm-up
+    # verify amortizes one-time table builds.
+    n_host = min(int(os.environ.get("BENCH_IDEMIX_ORACLE_SIGS", "4")), n_sigs)
+    verify_signatures_batch(*batch_args(1), backend="scheme")  # warm-up
+    start = time.perf_counter()
+    host_out = verify_signatures_batch(*batch_args(n_host), backend="scheme")
+    host_ms = (time.perf_counter() - start) * 1000.0
     if not all(host_out):
         raise RuntimeError("config #3 host verification failed")
+    oracle_ms_per_sig = host_ms / n_host
+
+    active = idemix_backend_name()
     result = {
         "sigs": n_sigs,
-        "host_ms_per_sig": round(host_ms / n_host, 1),
+        "idemix_backend": active,
+        "idemix_tiers_available": available_idemix_backends(),
+        "host_ms_per_sig": round(oracle_ms_per_sig, 1),
         "host_sample_sigs": n_host,
         "reference_cpu_ms_per_sig_class": "5-20",
-        "note": "host column is the PURE-host oracle "
-        "(scheme.verify_signature, python bignum) — honest about THIS "
-        "implementation but ~2 orders slower than the reference's "
-        "compiled amcl Go Ver (idemix/signature.go:243; "
-        "reference_cpu_ms_per_sig_class cites that implementation "
-        "class: a few pairings at ~1-5ms each on modern x86, not "
-        "measurable here without a Go toolchain). Read the device "
-        "column against BOTH numbers. Lanes are tiled from 8 unique "
-        "signatures (device cost per lane is data-independent).",
+        "note": "host column is the PURE-host oracle (the scheme rung, "
+        "python bignum) — honest about THIS implementation but ~2 "
+        "orders slower than the reference's compiled amcl Go Ver "
+        "(idemix/signature.go:243; reference_cpu_ms_per_sig_class "
+        "cites that class: a few pairings at ~1-5ms each on modern "
+        "x86). Read the hostbn ladder and device columns against BOTH "
+        "numbers. Lanes are tiled from 8 unique signatures.",
     }
+    if active == "scheme":
+        # never let an oracle-rung run pass as a batch-engine number
+        result["idemix_backend_warning"] = (
+            "running on the scheme ORACLE rung (~1 s/sig) — the hostbn "
+            "numpy tier is unavailable; batch columns are NOT "
+            "comparable to hostbn numbers"
+        )
+        print(
+            "bench: WARNING: idemix backend is the scheme oracle rung; "
+            "batch verify will be ~2 orders of magnitude slow",
+            file=sys.stderr,
+            flush=True,
+        )
+
+    # per-rung ladder: hostbn ms/sig at batch 8/64/256 (production
+    # entrypoint: the pool shards batches >= its threshold), masks
+    # asserted against the oracle sample each size
+    ladder = {"oracle_ms_per_sig": round(oracle_ms_per_sig, 1)}
+    if available_idemix_backends().get("hostbn"):
+        from fabric_tpu.crypto import hostbn
+        from fabric_tpu.idemix.scheme import ecp2_from_proto
+
+        hostbn.warm_schedules(ecp2_from_proto(ik.ipk.w))  # untimed build
+        sizes = [
+            int(s)
+            for s in os.environ.get(
+                "BENCH_IDEMIX_LADDER", "8,64,256"
+            ).split(",")
+            if s.strip()
+        ]
+        for size in sizes:
+            # acceptance sizes (>= 64, where the pool shards) get best
+            # of two passes: the first pays the cold worker spawn +
+            # per-worker schedule build, and this box's wall clock is
+            # noisy (host_ladder's discipline)
+            ms = None
+            for _pass in range(2 if size >= 64 else 1):
+                start = time.perf_counter()
+                out = verify_signatures_batch(
+                    *batch_args(size), backend="hostbn"
+                )
+                elapsed = (time.perf_counter() - start) * 1000.0
+                ms = elapsed if ms is None else min(ms, elapsed)
+                if out[:n_host] != host_out[: min(n_host, size)] or not all(
+                    out
+                ):
+                    raise RuntimeError(
+                        f"config #3 hostbn/oracle mask mismatch at {size}"
+                    )
+            ladder[str(size)] = {"hostbn_ms_per_sig": round(ms / size, 1)}
+            if size >= 64:
+                ladder[str(size)]["speedup_vs_oracle"] = round(
+                    oracle_ms_per_sig / (ms / size), 1
+                )
+        from fabric_tpu.idemix import batch as idemix_batch
+
+        idemix_batch.shutdown_pool()
+    else:
+        ladder["hostbn"] = "skipped (numpy not installed)"
+    result["ladder"] = ladder
     # The device Ate2 kernel's first compile is ~3.5 min on the TPU
     # (then cached; this bench's issuer key is seed-fixed so the program
     # caches across runs). BENCH_IDEMIX_DEVICE=0 opts out.

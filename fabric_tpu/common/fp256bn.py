@@ -459,6 +459,27 @@ def miller_loop(q: G2Point, p: G1Point) -> Fp12:
     return f
 
 
+def line_coeffs(
+    t: Tuple[Fp12, Fp12], q: Tuple[Fp12, Fp12]
+) -> Tuple[Fp12, Fp12]:
+    """(A, B) with l(P) = A + B·px + py — the chord/tangent line of
+    `_line` factored into P-independent Fp12 constants, so fixed-G2
+    Miller schedules (ops/pairing_kernel, crypto/hostbn) can precompute
+    them per issuer.  Expanding `_line`: (py − y1) − λ(px − x1) =
+    (λ·x1 − y1) + (−λ)·px + py.  Vertical lines cannot occur in the ate
+    chain of order-r points — raised, never silently mis-evaluated."""
+    x1, y1 = t
+    x2, y2 = q
+    if x1 == x2 and y1 == y2:
+        three_x2 = fp12_add(fp12_add(fp12_sqr(x1), fp12_sqr(x1)), fp12_sqr(x1))
+        lam = fp12_mul(three_x2, fp12_inv(fp12_add(y1, y1)))
+    else:
+        if x1 == x2:
+            raise ArithmeticError("vertical line in ate loop (unexpected)")
+        lam = fp12_mul(fp12_sub(y2, y1), fp12_inv(fp12_sub(x2, x1)))
+    return fp12_sub(fp12_mul(lam, x1), y1), fp12_neg(lam)
+
+
 _HARD_EXP = (pow(P, 4) - pow(P, 2) + 1) // R
 
 

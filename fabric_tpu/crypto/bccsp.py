@@ -155,6 +155,106 @@ def ec_backend_name() -> str:
 _ec = select_ec_backend("auto")
 
 
+# ---------------------------------------------------------------------------
+# Idemix verify backend ladder: hostbn (numpy limb-matrix FP256BN
+# pairing lanes, crypto/hostbn.py) -> scheme (the per-signature
+# idemix/scheme.py oracle).  Same contract discipline as EC_TIERS: one
+# accept/reject set across rungs (differentially tested), pins honored
+# hard, the auto walk warns-never-raises.  The "scheme" rung is a
+# SENTINEL (None): idemix/batch.py owns the oracle loop — the scheme
+# module lives a layer above crypto and is never imported from here.
+# ---------------------------------------------------------------------------
+
+IDEMIX_TIERS = ("hostbn", "scheme")
+
+
+def _load_idemix_backend(name: str):
+    """Backend module by tier name (None for the scheme-oracle rung);
+    raises ImportError/ValueError like _load_ec_backend."""
+    if name == "hostbn":
+        from fabric_tpu.crypto import hostbn
+
+        if not hostbn.HAVE_NUMPY:
+            raise ImportError("hostbn requires numpy")
+        return hostbn
+    if name == "scheme":
+        return None
+    raise ValueError(
+        f"unknown idemix backend {name!r} (expected one of {IDEMIX_TIERS})"
+    )
+
+
+def available_idemix_backends():
+    """Tier name -> usable right now (hostbn needs numpy; the scheme
+    oracle is always available)."""
+    out = {}
+    for name in IDEMIX_TIERS:
+        try:
+            _load_idemix_backend(name)
+            out[name] = True
+        except ImportError:
+            out[name] = False
+    return out
+
+
+def select_idemix_backend(name: str = "auto"):
+    """Select the process-wide Idemix batch-verify rung and return its
+    module (None = the scheme oracle).  ``auto`` honors
+    FABRIC_TPU_IDEMIX_BACKEND when it names a usable tier, else warns
+    and walks hostbn -> scheme — asking for ``auto`` NEVER raises.  An
+    explicitly named unavailable tier raises ImportError so a
+    configured expectation is never silently downgraded."""
+    global _idemix, _idemix_name
+    name = str(name or "auto").lower()
+    if name != "auto":
+        _idemix = _load_idemix_backend(name)
+        _idemix_name = name
+        return _idemix
+    env = os.environ.get("FABRIC_TPU_IDEMIX_BACKEND", "").lower()
+    if env and env != "auto":
+        try:
+            _idemix = _load_idemix_backend(env)
+            _idemix_name = env
+            return _idemix
+        except (ImportError, ValueError) as exc:
+            import warnings
+
+            warnings.warn(
+                f"FABRIC_TPU_IDEMIX_BACKEND: {exc}; using the "
+                "hostbn->scheme auto ladder",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+    try:
+        _idemix = _load_idemix_backend("hostbn")
+        _idemix_name = "hostbn"
+    except ImportError:
+        # loudly-in-the-log, silently-for-callers (EC ladder discipline)
+        logger.warning(
+            "hostbn idemix tier skipped (numpy not installed); "
+            "falling back to the scheme oracle rung"
+        )
+        _idemix = None
+        _idemix_name = "scheme"
+    return _idemix
+
+
+def idemix_backend():
+    """The active Idemix batch rung module (crypto/hostbn), or None
+    when the scheme-oracle rung is active."""
+    return _idemix
+
+
+def idemix_backend_name() -> str:
+    """Short tier name of the active Idemix rung (``hostbn``/``scheme``)."""
+    return _idemix_name
+
+
+_idemix = None
+_idemix_name = "scheme"
+_idemix = select_idemix_backend("auto")
+
+
 @dataclass(frozen=True)
 class ECDSAPublicKey:
     """An imported P-256 public key (reference bccsp/sw/ecdsakey.go analog)."""

@@ -13,6 +13,9 @@ Config shape (the core.yaml BCCSP block):
     SW:
       Hash: SHA2
       Security: 256
+      # optional tier pins (absent keys leave earlier pins alone):
+      # ECBackend: fastec | hostec_np | hostec | p256
+      # IdemixBackend: hostbn | scheme
     TPU:
       MinDeviceBatch: 32  # below this, verification stays on host
     PKCS11:
@@ -90,6 +93,33 @@ def provider_from_config(cfg: Optional[dict]) -> Provider:
                 f"BCCSP.SW.ECBackend {ec_backend!r} unavailable: {exc}"
             ) from exc
         logger.info("host EC backend: %s", ec_backend_name())
+
+    # Idemix batch-verify rung (hostbn -> scheme ladder, crypto/bccsp.py
+    # IDEMIX_TIERS): same contract as ECBackend — a KNOWN tier that
+    # cannot load is a hard error, an UNKNOWN name warns and keeps the
+    # current selection, an ABSENT key leaves an earlier pin alone.
+    if "IdemixBackend" in sw_cfg:
+        idemix_backend = str(sw_cfg["IdemixBackend"]).lower()
+        from fabric_tpu.crypto.bccsp import (
+            idemix_backend_name,
+            select_idemix_backend,
+        )
+
+        try:
+            select_idemix_backend(idemix_backend)
+        except ValueError:
+            logger.error(
+                "BCCSP.SW.IdemixBackend %r is not a known tier "
+                "(hostbn/scheme); keeping the current %s backend",
+                idemix_backend,
+                idemix_backend_name(),
+            )
+        except ImportError as exc:
+            raise FactoryError(
+                f"BCCSP.SW.IdemixBackend {idemix_backend!r} "
+                f"unavailable: {exc}"
+            ) from exc
+        logger.info("idemix batch backend: %s", idemix_backend_name())
 
     if default == "SW":
         return SoftwareProvider()

@@ -3,23 +3,41 @@ Signature.Ver, SURVEY.md §7 Stage 5 / BASELINE config #3).
 
 Per-block batching splits Signature.Ver into:
 
-* host: proto parse, the Ate-pairing structure check (Miller loop +
-  final exponentiation — still on the host oracle this round; the G1
-  work below is the device half of Stage 5), Fiat–Shamir SHA-256
-  recompute and challenge comparison;
-* device: the t1/t2/t3 commitment recomputations — each is a G1
-  multi-scalar multiplication — evaluated as ONE batched MSM kernel
-  call with 3 lanes per signature (fabric_tpu.ops.bn256_kernel).
+* host: proto parse, Fiat–Shamir SHA-256 recompute and challenge
+  comparison (shared by every rung);
+* batch math: the Ate-pairing structure check and the t1/t2/t3
+  commitment recomputations (G1 multi-scalar multiplications), routed
+  through the Idemix backend ladder (crypto/bccsp.py IDEMIX_TIERS):
+
+    hostbn  — numpy limb-matrix FP256BN lanes (crypto/hostbn.py):
+              fused-tower batched Miller loops + batched MSM, with
+              shared-nothing process-pool sharding for big batches
+              (degrade-to-inline on any pool failure);
+    scheme  — the per-signature idemix/scheme.py oracle loop (the
+              clarity-first rung; bench warns loudly when active);
+
+  plus the explicit device paths (``device_pairing=True`` runs the
+  precomputed-line Ate2 kernel, ops/pairing_kernel.py; ``backend="msm"``
+  keeps the host-oracle pairing with the XLA MSM kernel).
 
 Failure semantics per lane mirror verify_signature: every failed check
-maps to False in the result mask, never an exception across lanes.
+maps to False in the result mask, never an exception across lanes, and
+every rung produces the SAME mask bit-exactly (differentially tested,
+chaos-asserted via the ``idemix.verdict`` corrupt seam).
 """
 
 from __future__ import annotations
 
+import os
+import threading
 from typing import List, Optional, Sequence, Tuple
 
+from fabric_tpu.common.faults import corrupt_verdicts, fault_point
+from fabric_tpu.common.flogging import must_get_logger
+from fabric_tpu.common.retry import CooldownGate
+from fabric_tpu.crypto import bccsp
 from fabric_tpu.crypto import fp256bn as bn
+from fabric_tpu.crypto import hostec
 from fabric_tpu.idemix.scheme import (
     ALG_NO_REVOCATION,
     IdemixError,
@@ -28,8 +46,11 @@ from fabric_tpu.idemix.scheme import (
     _signature_challenge,
     ecp_from_proto,
     ecp2_from_proto,
+    verify_signature,
 )
 from fabric_tpu.protos import idemix_pb2
+
+logger = must_get_logger("idemix.batch")
 
 
 class _Parsed:
@@ -88,25 +109,7 @@ class _Parsed:
         self.t3_job = ([h_sk, h_rand, self.nym], [s_sk, s_r_nym, neg_c])
 
 
-def verify_signatures_batch(
-    signatures: Sequence[idemix_pb2.Signature],
-    disclosures: Sequence[Sequence[int]],
-    ipk: idemix_pb2.IssuerPublicKey,
-    msgs: Sequence[bytes],
-    attribute_values_list: Sequence[Sequence[Optional[int]]],
-    rh_index: int,
-    device_pairing: bool = False,
-) -> List[bool]:
-    """One device MSM pass for the whole batch; returns a per-signature
-    validity mask (BASELINE config #3's bit-exact mask contract).
-
-    device_pairing=True runs the Ate2 structure check on the
-    accelerator too (ops/pairing_kernel.py: precomputed-line Miller
-    loop, batched over the signatures); False keeps the host oracle
-    pairing (idemix/signature.go:288-296 semantics either way)."""
-    from fabric_tpu.ops.bn256_kernel import msm_host_batch
-
-    n = len(signatures)
+def _parse_lanes(signatures, disclosures, ipk, attribute_values_list, rh_index):
     parsed: List[Optional[_Parsed]] = []
     for sig, disclosure, values in zip(
         signatures, disclosures, attribute_values_list
@@ -119,6 +122,335 @@ def verify_signatures_batch(
             parsed.append(_Parsed(sig, disclosure, ipk, values, rh_index))
         except Exception:  # fablint: disable=broad-except  # lane becomes parsed=None, reported INVALID in the output mask
             parsed.append(None)
+    return parsed
+
+
+def _challenge_results(parsed, ipk, msgs, t_points) -> List[bool]:
+    """Fiat–Shamir recompute over the batch's t1/t2/t3 points.
+    ``t_points``: lane index -> (t1, t2, t3)."""
+    results = [False] * len(parsed)
+    for i, ts in t_points.items():
+        p = parsed[i]
+        t1, t2, t3 = ts
+        c = _signature_challenge(
+            t1, t2, t3, p.a_prime, p.a_bar, p.b_prime, p.nym,
+            b"", ipk.hash, p.disclosure, msgs[i],
+        )
+        results[i] = p.proof_c == _second_challenge(c, p.nonce)
+    return results
+
+
+def _chaos_verdicts(out: List[bool]) -> List[bool]:
+    """``idemix.verdict`` corrupt seam (the batch-rung analog of
+    ``bccsp.verdict``): only an installed fault plan reaches the flip —
+    it exists so the fabchaos idemix_storm gate can prove its bit-exact
+    mask assertion CATCHES a corrupted verdict."""
+    spec = fault_point("idemix.verdict", interprets=("corrupt",))
+    if spec is not None and spec.action == "corrupt":
+        return corrupt_verdicts(out, spec)
+    return out
+
+
+def verify_signatures_batch(
+    signatures: Sequence[idemix_pb2.Signature],
+    disclosures: Sequence[Sequence[int]],
+    ipk: idemix_pb2.IssuerPublicKey,
+    msgs: Sequence[bytes],
+    attribute_values_list: Sequence[Sequence[Optional[int]]],
+    rh_index: int,
+    device_pairing: bool = False,
+    backend: Optional[str] = None,
+    _pool_ok: bool = True,
+) -> List[bool]:
+    """Batch Signature.Ver; returns the per-signature validity mask
+    (BASELINE config #3's bit-exact mask contract — identical across
+    every rung).
+
+    Routing: ``device_pairing=True`` forces the device path (Ate2
+    pairing kernel + XLA MSM).  Otherwise ``backend`` picks a rung
+    explicitly ("hostbn" / "scheme" / "msm" — the legacy host-oracle
+    pairing + XLA MSM path), and None follows the process-wide ladder
+    (bccsp.idemix_backend_name())."""
+    n = len(signatures)
+    if n == 0:
+        return []
+    if device_pairing:
+        backend = "device"
+    elif backend is None:
+        backend = bccsp.idemix_backend_name()
+
+    if backend == "hostbn":
+        out = _verify_hostbn(
+            signatures, disclosures, ipk, msgs, attribute_values_list,
+            rh_index, pool_ok=_pool_ok,
+        )
+    elif backend == "scheme":
+        out = _verify_scheme(
+            signatures, disclosures, ipk, msgs, attribute_values_list,
+            rh_index,
+        )
+    elif backend in ("device", "msm"):
+        out = _verify_device(
+            signatures, disclosures, ipk, msgs, attribute_values_list,
+            rh_index, device_pairing=(backend == "device"),
+        )
+    else:
+        raise ValueError(f"unknown idemix batch backend {backend!r}")
+    # the corrupt seam fires ONCE per batch, in the coordinating
+    # process: pool workers (re-entering with _pool_ok=False) inherit an
+    # env-installed plan and would otherwise corrupt each shard AND the
+    # parent would corrupt the concatenation — two flips cancel and an
+    # armed fault could become a silent no-op
+    return _chaos_verdicts(out) if _pool_ok else out
+
+
+# ---------------------------------------------------------------------------
+# scheme rung: the per-signature oracle loop
+# ---------------------------------------------------------------------------
+
+
+def _verify_scheme(
+    signatures, disclosures, ipk, msgs, attribute_values_list, rh_index
+) -> List[bool]:
+    out = []
+    for sig, disclosure, msg, values in zip(
+        signatures, disclosures, msgs, attribute_values_list
+    ):
+        try:
+            verify_signature(
+                sig, disclosure, ipk, msg, values, rh_index, None, 0
+            )
+            out.append(True)
+        except Exception:  # fablint: disable=broad-except  # oracle rejection (any flavor) is a False lane, never a batch error
+            out.append(False)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# hostbn rung: numpy limb-matrix lanes (+ process-pool sharding)
+# ---------------------------------------------------------------------------
+
+MIN_POOL_SIGS = 64  # below this a pool round-trip costs more than it buys
+MIN_SHARD_SIGS = 16  # never split shards smaller than this
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name, "")
+    if raw:
+        try:
+            return max(int(raw), 1)
+        except ValueError:
+            pass
+    return default
+
+
+def _verify_hostbn(
+    signatures, disclosures, ipk, msgs, attribute_values_list, rh_index,
+    pool_ok: bool = True,
+) -> List[bool]:
+    from fabric_tpu.crypto import hostbn
+
+    n = len(signatures)
+    if pool_ok and n >= _env_int(
+        "FABRIC_TPU_HOSTBN_MIN_POOL", MIN_POOL_SIGS
+    ):
+        out = _verify_hostbn_pooled(
+            signatures, disclosures, ipk, msgs, attribute_values_list,
+            rh_index,
+        )
+        if out is not None:
+            return out
+
+    parsed = _parse_lanes(
+        signatures, disclosures, ipk, attribute_values_list, rh_index
+    )
+    w = ecp2_from_proto(ipk.w)
+    pairing_ok = hostbn.pairing_check_batch(
+        w,
+        [
+            (p.a_prime, p.a_bar) if p is not None else None
+            for p in parsed
+        ],
+    )
+    jobs: List[Tuple[list, list]] = []
+    owners: List[int] = []
+    for i, p in enumerate(parsed):
+        if p is None or not pairing_ok[i]:
+            continue
+        for job in (p.t1_job, p.t2_job, p.t3_job):
+            jobs.append(job)
+            owners.append(i)
+    t_points = {}
+    if jobs:
+        points = hostbn.msm_batch(jobs)
+        by_owner: dict = {}
+        for owner, pt in zip(owners, points):
+            by_owner.setdefault(owner, []).append(pt)
+        t_points = by_owner
+    return _challenge_results(parsed, ipk, msgs, t_points)
+
+
+# shared-nothing pool: shards are chunks of SIGNATURES (serialized
+# protos — the parse cost is trivial next to the lane math), workers run
+# the inline hostbn path and the parent concatenates in order
+_POOL = None
+_POOL_PROCS = 1
+_POOL_LOCK = threading.Lock()
+_POOL_GATE = CooldownGate()
+
+
+def pool_procs() -> int:
+    """Worker count (1 = pool disabled); FABRIC_TPU_HOSTBN_PROCS
+    overrides, falling back to hostec's discipline (malformed values
+    degrade to the default, never raise)."""
+    procs = os.environ.get("FABRIC_TPU_HOSTBN_PROCS", "")
+    if procs:
+        try:
+            return max(int(procs), 1)
+        except ValueError:
+            pass
+    return hostec.pool_procs()
+
+
+def _pool():
+    """Lazy shared ProcessPoolExecutor (forkserver/spawn preferred).
+    Broken or unavailable pools degrade to inline compute, never die."""
+    global _POOL, _POOL_PROCS
+    with _POOL_LOCK:
+        if _POOL is None:
+            if not _POOL_GATE.ready():
+                return None
+            procs = pool_procs()
+            _POOL_PROCS = procs
+            if procs <= 1:
+                _POOL = False
+                return None
+            import multiprocessing
+            from concurrent.futures import ProcessPoolExecutor
+
+            methods = multiprocessing.get_all_start_methods()
+            # FABRIC_TPU_HOSTEC_START is the process-wide start-method
+            # knob shared by every host pool (hostec, hostec_np, and
+            # this one — the PR 5 convention): the fork-with-threads
+            # hazard it guards against is per-interpreter, not per-pool
+            start = os.environ.get("FABRIC_TPU_HOSTEC_START", "")
+            if start not in methods:
+                for start in ("forkserver", "spawn", "fork"):
+                    if start in methods:
+                        break
+            try:
+                _POOL = ProcessPoolExecutor(
+                    max_workers=procs,
+                    mp_context=multiprocessing.get_context(start),
+                )
+            except Exception as exc:  # pragma: no cover - sandboxes
+                logger.warning(
+                    "idemix pool unavailable (%s); verifying inline", exc
+                )
+                _POOL = False
+    return _POOL or None
+
+
+def shutdown_pool(broken: bool = False) -> None:
+    """Tear the pool down; ``broken=True`` arms the rebuild cooldown
+    (degrade paths only — clean teardowns leave the gate closed)."""
+    global _POOL
+    with _POOL_LOCK:
+        if _POOL:
+            _POOL.shutdown(wait=False, cancel_futures=True)
+        _POOL = None
+        if broken:
+            _POOL_GATE.record_failure()
+
+
+def _pool_worker(
+    ipk_bytes, sig_blobs, disclosures, msgs, values, rh_index
+) -> List[bool]:
+    """Runs in a pool worker: re-parse the chunk and verify inline on
+    the hostbn rung (per-worker issuer schedules are cached across
+    batches by crypto/hostbn)."""
+    ipk = idemix_pb2.IssuerPublicKey.FromString(ipk_bytes)
+    sigs = [idemix_pb2.Signature.FromString(b) for b in sig_blobs]
+    return verify_signatures_batch(
+        sigs, disclosures, ipk, msgs, values, rh_index,
+        backend="hostbn", _pool_ok=False,
+    )
+
+
+def _verify_hostbn_pooled(
+    signatures, disclosures, ipk, msgs, attribute_values_list, rh_index
+) -> Optional[List[bool]]:
+    """Shard the batch across the process pool; None = caller verifies
+    inline (no pool, submit failure, worker death — degrade, never
+    die)."""
+    pool = _pool()
+    if pool is None:
+        return None
+    n = len(signatures)
+    nshards = min(
+        _POOL_PROCS,
+        max(n // _env_int("FABRIC_TPU_HOSTBN_MIN_SHARD", MIN_SHARD_SIGS), 1),
+    )
+    if nshards <= 1:
+        return None
+    step = (n + nshards - 1) // nshards
+    ipk_bytes = ipk.SerializeToString()
+    try:
+        fault_point("hostbn.pool.submit")
+        futures = [
+            pool.submit(
+                _pool_worker,
+                ipk_bytes,
+                [s.SerializeToString() for s in signatures[lo : lo + step]],
+                list(disclosures[lo : lo + step]),
+                list(msgs[lo : lo + step]),
+                list(attribute_values_list[lo : lo + step]),
+                rh_index,
+            )
+            for lo in range(0, n, step)
+        ]
+    except Exception as exc:  # BrokenProcessPool / shutdown race
+        logger.warning(
+            "idemix pool submit failed (%s); verifying inline", exc
+        )
+        shutdown_pool(broken=True)
+        return None
+    try:
+        fault_point("hostbn.pool.resolve")
+        out: List[bool] = []
+        for f in futures:
+            out.extend(f.result())
+        with _POOL_LOCK:
+            # a batch that made it THROUGH the pool resets the rebuild
+            # cooldown ramp (construction alone proves nothing)
+            _POOL_GATE.record_success()
+        return out
+    except Exception as exc:  # worker died mid-run: inline fallback
+        logger.warning(
+            "idemix pool worker died mid-batch (%s); verifying inline", exc
+        )
+        shutdown_pool(broken=True)
+        return None
+
+
+# ---------------------------------------------------------------------------
+# device / legacy-msm paths (XLA kernels)
+# ---------------------------------------------------------------------------
+
+
+def _verify_device(
+    signatures, disclosures, ipk, msgs, attribute_values_list, rh_index,
+    device_pairing: bool,
+) -> List[bool]:
+    """One device MSM pass for the whole batch; ``device_pairing=True``
+    runs the Ate2 structure check on the accelerator too
+    (ops/pairing_kernel.py), False keeps the host oracle pairing
+    (idemix/signature.go:288-296 semantics either way)."""
+    from fabric_tpu.ops.bn256_kernel import msm_host_batch
+
+    parsed = _parse_lanes(
+        signatures, disclosures, ipk, attribute_values_list, rh_index
+    )
 
     # pairing structure check: e(W, A') * e(g2, ABar)^-1 == 1
     w = ecp2_from_proto(ipk.w)
@@ -152,21 +484,14 @@ def verify_signatures_batch(
         for job in (p.t1_job, p.t2_job, p.t3_job):
             jobs.append(job)
             owners.append(i)
-    results = [False] * n
+    t_points = {}
     if jobs:
         k_max = max(len(b) for b, _ in jobs)
         bases = [list(b) + [None] * (k_max - len(b)) for b, _ in jobs]
         scalars = [list(s) + [0] * (k_max - len(s)) for _, s in jobs]
         points = msm_host_batch(bases, scalars)
-        by_owner = {}
+        by_owner: dict = {}
         for owner, pt in zip(owners, points):
             by_owner.setdefault(owner, []).append(pt)
-        for i, ts in by_owner.items():
-            p = parsed[i]
-            t1, t2, t3 = ts
-            c = _signature_challenge(
-                t1, t2, t3, p.a_prime, p.a_bar, p.b_prime, p.nym,
-                b"", ipk.hash, p.disclosure, msgs[i],
-            )
-            results[i] = p.proof_c == _second_challenge(c, p.nonce)
-    return results
+        t_points = by_owner
+    return _challenge_results(parsed, ipk, msgs, t_points)

@@ -56,30 +56,9 @@ _SIX_U_TWO = 6 * host.U + 2
 _N_BITS = bin(abs(_SIX_U_TWO))[3:]  # loop bits after the implicit MSB
 
 
-def _line_coeffs(
-    t: Tuple[host.Fp12, host.Fp12], q: Tuple[host.Fp12, host.Fp12]
-) -> Tuple[host.Fp12, host.Fp12]:
-    """(A, B) with l(P) = A + B·px + py, mirroring host _line for the
-    tangent (t==q) and chord cases.  Vertical lines cannot occur for
-    the order-r points used here — asserted."""
-    x1, y1 = t
-    x2, y2 = q
-    if x1 == x2 and y1 == y2:
-        three_x2 = host.fp12_add(
-            host.fp12_add(host.fp12_sqr(x1), host.fp12_sqr(x1)),
-            host.fp12_sqr(x1),
-        )
-        lam = host.fp12_mul(
-            three_x2, host.fp12_inv(host.fp12_add(y1, y1))
-        )
-    else:
-        assert x1 != x2, "vertical line in ate loop (unexpected)"
-        lam = host.fp12_mul(
-            host.fp12_sub(y2, y1), host.fp12_inv(host.fp12_sub(x2, x1))
-        )
-    a = host.fp12_sub(host.fp12_mul(lam, x1), y1)
-    b = host.fp12_neg(lam)
-    return a, b
+# (A, B) with l(P) = A + B·px + py — shared with crypto/hostbn, which
+# precomputes the same per-issuer schedules for its numpy lanes
+_line_coeffs = host.line_coeffs
 
 
 def _fp12_to_mont_rows(v: host.Fp12) -> np.ndarray:

@@ -1084,6 +1084,204 @@ def run_corrupt_detect(seed: int, clock: StageClock, scale: float = 1.0):
     return det, {"faults_fired": plan.fired()}
 
 
+# ---------------------------------------------------------------------------
+# idemix_storm: adversarial Idemix traffic through the batch rung
+# ---------------------------------------------------------------------------
+
+#: per-seed deterministic Idemix worlds (issuer keys cost seconds of
+#: host bignum; same seed -> same world, so caching preserves the
+#: determinism contract while the reproducibility test reruns scenarios)
+_IDEMIX_WORLDS: Dict[int, Dict] = {}
+
+
+def _idemix_world(seed: int) -> Dict:
+    """Issuer + credential + the adversarial signature flavor set, all
+    seeded; oracle (scheme rung) verdicts per flavor are the ground
+    truth the batch rung's mask is asserted against bit-exactly."""
+    world = _IDEMIX_WORLDS.get(seed)
+    if world is not None:
+        return world
+    import random as _random
+
+    from fabric_tpu import idemix
+    from fabric_tpu.crypto import fp256bn as bncurve
+    from fabric_tpu.idemix.batch import verify_signatures_batch
+    from fabric_tpu.protos import idemix_pb2
+
+    rng = _random.Random(seed * 1000003 + 11)
+    attrs = ["OU", "Role"]
+    rh_index = 1
+    ik = idemix.new_issuer_key(attrs, rng)
+    sk = bncurve.rand_mod_order(rng)
+    nonce = bncurve.big_to_bytes(bncurve.rand_mod_order(rng))
+    req = idemix.new_cred_request(sk, nonce, ik.ipk, rng)
+    cred = idemix.new_credential(ik, req, [21, 42], rng)
+    cri = idemix_pb2.CredentialRevocationInformation()
+    cri.revocation_alg = idemix.ALG_NO_REVOCATION
+
+    def sign(disclosure, msg):
+        nym, r_nym = idemix.make_nym(sk, ik.ipk, rng)
+        return idemix.new_signature(
+            cred, sk, nym, r_nym, ik.ipk, disclosure, msg, rh_index, cri, rng
+        )
+
+    hid, dis = [0, 0], [0, 1]
+    s_hid = sign(hid, b"storm m0")
+    s_dis = sign(dis, b"storm m1")
+    s_tmp = sign(hid, b"storm m2")
+
+    def variant(base, mutate):
+        sig = idemix_pb2.Signature()
+        sig.CopyFrom(base)
+        mutate(sig)
+        return sig
+
+    def bump_scalar(field):
+        def mutate(sig):
+            v = bncurve.big_from_bytes(getattr(sig, field))
+            setattr(sig, field, bncurve.big_to_bytes((v + 1) % bncurve.R))
+        return mutate
+
+    def off_curve(sig):
+        sig.a_bar.x = bncurve.big_to_bytes(12345)
+        sig.a_bar.y = bncurve.big_to_bytes(67890)
+
+    def identity_abar(sig):
+        sig.a_bar.x = bncurve.big_to_bytes(0)
+        sig.a_bar.y = bncurve.big_to_bytes(0)
+
+    def identity_aprime(sig):
+        sig.a_prime.x = bncurve.big_to_bytes(0)
+        sig.a_prime.y = bncurve.big_to_bytes(0)
+
+    # (flavor, sig, disclosure, msg, values)
+    flavors = [
+        ("valid_hidden", s_hid, hid, b"storm m0", [None, None]),
+        ("valid_disclosed", s_dis, dis, b"storm m1", [None, 42]),
+        ("wrong_message", s_tmp, hid, b"WRONG", [None, None]),
+        (
+            "corrupted_proof_scalar",
+            variant(s_hid, bump_scalar("proof_s_sk")),
+            hid, b"storm m0", [None, None],
+        ),
+        (
+            "bad_challenge",
+            variant(s_tmp, bump_scalar("proof_c")),
+            hid, b"storm m2", [None, None],
+        ),
+        (
+            "wrong_attribute_commitment",
+            s_dis, dis, b"storm m1", [None, 999],
+        ),
+        (
+            "off_group_point",
+            variant(s_hid, off_curve), hid, b"storm m0", [None, None],
+        ),
+        (
+            "identity_abar",
+            variant(s_tmp, identity_abar), hid, b"storm m2", [None, None],
+        ),
+        (
+            "identity_aprime",
+            variant(s_dis, identity_aprime), dis, b"storm m1", [None, 42],
+        ),
+    ]
+    expected = []
+    for _name, sig, disclosure, msg, values in flavors:
+        expected.extend(
+            verify_signatures_batch(
+                [sig], [disclosure], ik.ipk, [msg], [values], rh_index,
+                backend="scheme",
+            )
+        )
+    world = {
+        "ipk": ik.ipk,
+        "rh_index": rh_index,
+        "flavors": flavors,
+        "expected": expected,
+    }
+    if len(_IDEMIX_WORLDS) >= 4:
+        _IDEMIX_WORLDS.pop(next(iter(_IDEMIX_WORLDS)))
+    _IDEMIX_WORLDS[seed] = world
+    return world
+
+
+@scenario("idemix_storm")
+def run_idemix_storm(seed: int, clock: StageClock, scale: float = 1.0):
+    """Mixed valid/invalid Idemix signatures (bad challenge, wrong
+    attribute commitment, corrupted proof scalar, off-group point,
+    identity A'/ABar) through the ACTIVE batch rung (hostbn numpy lanes
+    when numpy is present, else the scheme oracle), mask asserted
+    bit-exact against the scheme.verify_signature ground truth — then
+    the ``idemix.verdict`` corrupt seam is armed and the SAME assertion
+    must catch the injected verdict flips (the idemix slice of
+    corrupt_detect).  Excluded from the CI smoke: the issuer/signature
+    setup costs seconds of host bignum."""
+    from fabric_tpu.crypto.bccsp import idemix_backend_name
+    from fabric_tpu.idemix.batch import verify_signatures_batch
+
+    rng = random.Random(seed * 1000003 + 12)
+    world = clock.timed("idemix.world", _idemix_world, seed)
+    flavors = world["flavors"]
+    expected_by_flavor = world["expected"]
+
+    # tile the flavor set to the lane count and shuffle, seeded
+    n_lanes = max(len(flavors), int(round(len(flavors) * 2 * scale)))
+    order = [i % len(flavors) for i in range(n_lanes)]
+    rng.shuffle(order)
+    sigs = [flavors[i][1] for i in order]
+    disclosures = [flavors[i][2] for i in order]
+    msgs = [flavors[i][3] for i in order]
+    values = [flavors[i][4] for i in order]
+    expected = [expected_by_flavor[i] for i in order]
+    check(
+        any(expected) and not all(expected),
+        "flavor set must mix valid and invalid lanes",
+    )
+
+    t0 = time.perf_counter()
+    out = verify_signatures_batch(
+        sigs, disclosures, world["ipk"], msgs, values, world["rh_index"]
+    )
+    clock.record("idemix.batch_verify", time.perf_counter() - t0)
+    check(
+        list(out) == expected,
+        f"idemix batch mask mismatch: got {mask_hash(out)} "
+        f"want {mask_hash(expected)}",
+    )
+
+    # the mask gate must CATCH an injected verdict corruption on the rung
+    plan = FaultPlan.parse("idemix.verdict=corrupt:1.0:lanes=2", seed=seed)
+    with plan_installed(plan):
+        corrupted = clock.timed(
+            "idemix.corrupted_batch",
+            verify_signatures_batch,
+            sigs, disclosures, world["ipk"], msgs, values, world["rh_index"],
+        )
+    check(
+        list(corrupted) != expected,
+        "idemix verdict corruption went UNDETECTED — the mask gate is blind",
+    )
+    n_flipped = sum(1 for a, b in zip(corrupted, expected) if a != b)
+    check(n_flipped == 2, f"corrupt width {n_flipped} != plan lanes=2")
+    clean = verify_signatures_batch(
+        sigs, disclosures, world["ipk"], msgs, values, world["rh_index"]
+    )
+    check(list(clean) == expected, "mask corrupt AFTER the plan was removed")
+
+    det = {
+        "backend": idemix_backend_name(),
+        "lanes": n_lanes,
+        "flavors": [name for name, *_ in flavors],
+        "mask": mask_hash(expected),
+        "valid_lanes": sum(expected),
+        "corruption_detected": True,
+        "flipped_lanes": n_flipped,
+        "clean_after_uninstall": True,
+    }
+    return det, {"faults_fired": plan.fired()}
+
+
 #: the <60s CI smoke: fast, no process pools, no real sleeps
 SMOKE = ("verify_faults", "commit_storm", "deliver_flap", "corrupt_detect")
 

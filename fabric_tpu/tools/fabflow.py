@@ -111,12 +111,18 @@ PAIR_L4 = 4 * (PAIR_MASK + 1) - 1
 PAIR_L32 = 32 * (PAIR_MASK + 1) - 1
 
 #: Files whose lane arithmetic carries the limb headroom contract.
+#: crypto/hostbn.py rides the SAME pair-limb contracts as hostec_np
+#: (PairMat/L4/L32 bounds below): its tower/group-law code drives
+#: hostec_np's proven kernels with the BN modulus — the MontCtx bound
+#: (m < 2^256) and the per-limb L4/L32 input contracts are
+#: modulus-independent, so the mechanized headroom proof transfers.
 LIMB_TIER = (
     "*fabric_tpu/ops/*.py",
     "*fabric_tpu/common/p256.py",
     "*fabric_tpu/common/fp256bn.py",
     "*fabric_tpu/crypto/hostec.py",
     "*fabric_tpu/crypto/hostec_np.py",
+    "*fabric_tpu/crypto/hostbn.py",
     "*fabric_tpu/ledger/mvcc_device.py",
 )
 

@@ -1,0 +1,122 @@
+#!/usr/bin/env bash
+# Idemix backend ladder micro-bench: prints ms/signature for the scheme
+# oracle (extrapolated from a few lanes) and the hostbn numpy rung at
+# batch 8/64/256 — WITHOUT importing jax or requiring the cryptography
+# package (setup uses an unsigned ALG_NO_REVOCATION CRI, which Ver with
+# rev_pk=None never reads).  The full bench (bench.py) owns the device
+# column and the JSON artifact; this script answers "what does the
+# Idemix host ladder do on THIS box" in ~2 min.
+#
+#   HOSTBN_BENCH_SIZES  comma-separated batch sizes   (default 8,64,256)
+#   HOSTBN_BENCH_POOL   1 = let the batch layer's process pool shard
+#                       sizes past its threshold (default 1)
+#
+# The payload runs from a real file (not a heredoc on stdin): the
+# process pool's spawn/forkserver workers re-import __main__, which
+# must therefore be importable.
+set -uo pipefail
+
+cd "$(dirname "$0")/.."
+
+payload="$(mktemp --suffix=.py)"
+trap 'rm -f "$payload"' EXIT
+
+cat >"$payload" <<'PY'
+import os
+import random
+import time
+
+
+def main():
+    sizes = [
+        int(s)
+        for s in os.environ.get("HOSTBN_BENCH_SIZES", "8,64,256").split(",")
+        if s.strip()
+    ]
+    if os.environ.get("HOSTBN_BENCH_POOL", "1") != "1":
+        # plain assignment: an exported FABRIC_TPU_HOSTBN_PROCS must not
+        # silently turn a requested inline run into a pooled one
+        os.environ["FABRIC_TPU_HOSTBN_PROCS"] = "1"
+
+    from fabric_tpu import idemix
+    from fabric_tpu.crypto import fp256bn as bn
+    from fabric_tpu.crypto.bccsp import (
+        available_idemix_backends,
+        idemix_backend_name,
+    )
+    from fabric_tpu.idemix import batch as ib
+    from fabric_tpu.protos import idemix_pb2
+
+    rng = random.Random(1234)
+    attrs = ["OU", "Role", "EnrollmentID", "RevocationHandle"]
+    rh_index = 3
+    print("building issuer/credential/signatures (host bignum)...")
+    ik = idemix.new_issuer_key(attrs, rng)
+    sk = bn.rand_mod_order(rng)
+    req = idemix.new_cred_request(
+        sk, bn.big_to_bytes(bn.rand_mod_order(rng)), ik.ipk, rng
+    )
+    cred = idemix.new_credential(ik, req, [11, 22, 33, 44], rng)
+    cri = idemix_pb2.CredentialRevocationInformation()
+    cri.revocation_alg = idemix.ALG_NO_REVOCATION
+    disclosure = [0, 0, 0, 0]
+    msg = b"hostbn bench message"
+    uniq = []
+    for _ in range(8):
+        nym, r_nym = idemix.make_nym(sk, ik.ipk, rng)
+        uniq.append(
+            idemix.new_signature(
+                cred, sk, nym, r_nym, ik.ipk, disclosure, msg,
+                rh_index, cri, rng,
+            )
+        )
+
+    def args(count):
+        return (
+            [uniq[i % len(uniq)] for i in range(count)],
+            [disclosure] * count,
+            ik.ipk,
+            [msg] * count,
+            [[None] * 4] * count,
+            rh_index,
+        )
+
+    rows = []
+    # oracle: a few lanes, extrapolated (a 256 batch would eat minutes)
+    ib.verify_signatures_batch(*args(1), backend="scheme")  # warm-up
+    t0 = time.perf_counter()
+    assert all(ib.verify_signatures_batch(*args(3), backend="scheme"))
+    oracle_ms = (time.perf_counter() - t0) * 1000.0 / 3
+    rows.append(("scheme (oracle, extrapolated)", "-", oracle_ms))
+
+    if available_idemix_backends().get("hostbn"):
+        from fabric_tpu.crypto import hostbn
+        from fabric_tpu.idemix.scheme import ecp2_from_proto
+
+        hostbn.warm_schedules(ecp2_from_proto(ik.ipk.w))
+        for size in sizes:
+            best = None
+            for _ in range(2 if size >= 64 else 1):
+                t0 = time.perf_counter()
+                out = ib.verify_signatures_batch(*args(size), backend="hostbn")
+                ms = (time.perf_counter() - t0) * 1000.0 / size
+                best = ms if best is None else min(best, ms)
+                assert all(out)
+            rows.append((f"hostbn @ {size}", f"{oracle_ms / best:.1f}x", best))
+        ib.shutdown_pool()
+
+    print()
+    print(f"idemix host ladder (active rung: {idemix_backend_name()})")
+    print(f"{'tier':32s} {'vs oracle':>10s} {'ms/sig':>10s}")
+    for name, speedup, ms in rows:
+        print(f"{name:32s} {speedup:>10s} {ms:10.1f}")
+    if not available_idemix_backends().get("hostbn"):
+        print(f"{'hostbn':32s} {'(numpy not installed)':>21s}")
+
+
+if __name__ == "__main__":
+    main()
+PY
+
+PYTHONPATH="$(pwd)${PYTHONPATH:+:$PYTHONPATH}" \
+    timeout -k 10 600 python "$payload"

@@ -97,6 +97,27 @@ def test_corrupt_detect_scenario_catches_blindness():
     assert det["corruption_detected"] and det["clean_after_uninstall"]
 
 
+def test_idemix_storm_flavors_and_verdict_gate():
+    """The idemix slice: every adversarial flavor present, the batch
+    rung's mask matched the scheme oracle (a mismatch would have been
+    a ChaosAssertionError), and the idemix.verdict corrupt seam was
+    caught by the same gate.  Seed 11 shares the reproducibility
+    test's cached world."""
+    det, obs = SCENARIOS["idemix_storm"](11, StageClock(), 0.5)
+    assert det["backend"] in ("hostbn", "scheme")
+    assert {
+        "bad_challenge",
+        "corrupted_proof_scalar",
+        "wrong_attribute_commitment",
+        "off_group_point",
+        "identity_abar",
+        "identity_aprime",
+    } <= set(det["flavors"])
+    assert 0 < det["valid_lanes"] < det["lanes"]
+    assert det["corruption_detected"] and det["clean_after_uninstall"]
+    assert obs["faults_fired"].get("idemix.verdict", 0) >= 1
+
+
 def test_cli_smoke_stdout_is_deterministic(capsys):
     rc1 = fabchaos.main(
         ["--seed", "5", "--scenario", "commit_storm,deliver_flap", "--quiet"]

@@ -558,6 +558,52 @@ def test_repo_suppressions_state_computed_bounds(repo_findings):
         )
 
 
+def test_limb_overflow_bn_pair_kernel_sensitivity():
+    """PR 7 (hostbn) sensitivity fixture: the pair-radix Montgomery MAC
+    + generic-REDC recurrence at the hostbn tier under the PairMat
+    contracts is clean (11 rows of L32·L4 products + q·m rows < 2^62.5,
+    the hostec_np proof with the BN modulus' m0inv multiply), and ONE
+    extra 4x-widened per-iteration product term pushes the accumulator
+    past uint64 and must fire."""
+    src_ok = """
+        import numpy as np
+
+        NPAIRS = 11
+        PAIR_BITS = 2 * 13
+        PAIR_MASK = (1 << PAIR_BITS) - 1
+
+        def bn_kernel(a: "PairMatL32", b: "PairMatL4", m_col: "PairMat", m0inv: int):
+            lanes = 4
+            t = np.zeros((2 * NPAIRS, lanes), dtype=np.uint64)
+            for i in range(NPAIRS):
+                t[i : i + NPAIRS] += a[i] * b
+            for i in range(NPAIRS):
+                q = ((t[i] & PAIR_MASK) * m0inv) & PAIR_MASK
+                t[i : i + NPAIRS - 1] += q * m_col[0 : NPAIRS - 1]
+                t[i + 1] += t[i] >> PAIR_BITS
+            return t
+        """
+    assert flow(
+        src_ok, path="fabric_tpu/crypto/hostbn.py", rules=["limb-overflow"]
+    ) == []
+    src_bad = src_ok.replace(
+        "t[i : i + NPAIRS] += a[i] * b",
+        "t[i : i + NPAIRS] += a[i] * b + (a[i] << np.uint64(2)) * b",
+    )
+    findings = flow(
+        src_bad, path="fabric_tpu/crypto/hostbn.py", rules=["limb-overflow"]
+    )
+    assert "limb-overflow" in rule_ids(findings)
+    assert any("exceeds uint64" in f.message for f in findings)
+
+
+def test_hostbn_is_in_the_limb_tier():
+    """crypto/hostbn.py carries the pair-limb contracts (the PR 7
+    tier-extension satellite): the tier glob must match it."""
+    ctx = fabflow.FileContext("fabric_tpu/crypto/hostbn.py")
+    assert ctx.matches(fabflow.LIMB_TIER)
+
+
 def test_bignum_cios_proof_holds_standalone():
     """The headline proof: bignum.py alone, under the canonical-limb
     contract, has no unsuppressed overflow — the 20-iteration CIOS

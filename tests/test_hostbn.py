@@ -1,0 +1,554 @@
+"""hostbn differential suite: the numpy limb-matrix FP256BN engine vs
+the fp256bn Python-int oracle — tower kernels on dense-limb and
+modulus-edge operands, pairing bilinearity and structure-check masks,
+batched MSM (every degenerate-lane flavor), tree-inversion edge lanes,
+the idemix batch rung's bit-exact mask vs scheme.verify_signature, the
+process-pool shard path (+ degrade-to-inline), and the numpy-absent
+ladder walk (same checklist shape as tests/test_hostec_np.py)."""
+
+import random
+import subprocess
+import sys
+
+import pytest
+
+from fabric_tpu.common import fp256bn as host
+from fabric_tpu.crypto import hostbn as hb
+
+pytestmark = pytest.mark.skipif(
+    not hb.HAVE_NUMPY, reason="hostbn needs numpy"
+)
+
+if hb.HAVE_NUMPY:
+    import numpy as np
+
+    from fabric_tpu.crypto.hostec_np import (
+        _FE,
+        _Field,
+        _ctx,
+        _invert_lanes,
+        ints_to_limbs13,
+        limbs13_to_pairs,
+        _pairs_to_int,
+    )
+
+P = host.P
+R = host.R
+RNG = random.Random(20260803)
+
+# dense-limb / modulus-edge Fp operands (the test convention from
+# tests/test_bignum.py: every pair limb saturated, and values hugging p)
+EDGE_VALUES = [0, 1, 2, P - 1, P - 2, (1 << 256) % P, int("3" * 77) % P]
+DENSE = int("0x" + "f" * 64, 16) % P
+
+
+def _field():
+    return _Field(_ctx(P))
+
+
+def _v_from_host(field, rows_per_lane):
+    lanes = len(rows_per_lane)
+    k = len(rows_per_lane[0])
+    flat = []
+    for r in range(k):
+        flat.extend(
+            (rows_per_lane[lane][r] * hb.R_MONT) % P for lane in range(lanes)
+        )
+    pairs = limbs13_to_pairs(ints_to_limbs13(flat))
+    return hb._V(
+        _FE(np.ascontiguousarray(pairs), 1, hb.PAIR_MASK), k, lanes
+    )
+
+
+def _v_to_host(field, v):
+    out = field.to_ints(field.carried(v.fe))
+    return [
+        [out[r * v.lanes + lane] for r in range(v.k)]
+        for lane in range(v.lanes)
+    ]
+
+
+def _fp12_rows(x):
+    rows = []
+    for c in x:
+        rows.extend([c[0], c[1]])
+    return rows
+
+
+def _rows_fp12(rows):
+    return tuple((rows[2 * i], rows[2 * i + 1]) for i in range(6))
+
+
+def _rand_fp12(rng):
+    return tuple((rng.randrange(P), rng.randrange(P)) for _ in range(6))
+
+
+# ---------------------------------------------------------------------------
+# Hard-part decomposition + tower kernels vs the oracle
+# ---------------------------------------------------------------------------
+
+
+def test_hard_exp_decomposition_exact():
+    """The λ x-power chain is only bit-exact with fp12_pow(s, HARD)
+    because the decomposition is EXACT — re-assert the integer identity
+    the module checks at import."""
+    x = host.U
+    lam0 = -36 * x**3 - 30 * x**2 - 18 * x - 2
+    lam1 = -36 * x**3 - 18 * x**2 - 12 * x + 1
+    lam2 = 6 * x**2 + 1
+    assert lam0 + lam1 * P + lam2 * P**2 + P**3 == host._HARD_EXP
+    assert (P**4 - P**2 + 1) % R == 0
+
+
+def test_fp12_tower_ops_vs_oracle():
+    """mul/sqr/conj/frobenius/inv bit-exact with the host tower on
+    random, dense-limb and modulus-edge lanes (zero lane included for
+    the inversion's pow(0) = 0 contract)."""
+    field = _field()
+    rng = random.Random(7)
+    lanes = [
+        _rand_fp12(rng),
+        tuple((DENSE, P - 1) for _ in range(6)),  # dense / edge limbs
+        tuple((EDGE_VALUES[i], EDGE_VALUES[-1 - i]) for i in range(6)),
+    ]
+    ys = [_rand_fp12(rng) for _ in lanes]
+    vx = _v_from_host(field, [_fp12_rows(x) for x in lanes])
+    vy = _v_from_host(field, [_fp12_rows(y) for y in ys])
+
+    got = [_rows_fp12(r) for r in _v_to_host(field, hb._fp12_mul(field, vx, vy))]
+    assert got == [host.fp12_mul(x, y) for x, y in zip(lanes, ys)]
+
+    got = [_rows_fp12(r) for r in _v_to_host(field, hb._fp12_sqr(field, vx))]
+    assert got == [host.fp12_sqr(x) for x in lanes]
+
+    got = [_rows_fp12(r) for r in _v_to_host(field, hb._fp12_conj(field, vx))]
+    assert got == [host.fp12_conj(x) for x in lanes]
+
+    for n in (1, 2, 3):
+        got = [
+            _rows_fp12(r)
+            for r in _v_to_host(field, hb._fp12_frob(field, vx, n))
+        ]
+        assert got == [host.fp12_frobenius(x, n) for x in lanes]
+
+    zlanes = lanes + [tuple((0, 0) for _ in range(6))]
+    vz = _v_from_host(field, [_fp12_rows(x) for x in zlanes])
+    got = [_rows_fp12(r) for r in _v_to_host(field, hb._fp12_inv(field, vz))]
+    assert got == [host.fp12_inv(x) for x in zlanes]
+
+
+def test_fp12_squaring_chain_edge_operands():
+    """8 chained squarings starting from dense-limb/edge operands stay
+    bit-exact (the lazy-bound renormalization discipline under
+    repeated composition — the shape tests/test_bignum.py pins for the
+    device kernels)."""
+    field = _field()
+    start = [
+        tuple((DENSE, P - 1) for _ in range(6)),
+        tuple((P - 2, 1) for _ in range(6)),
+    ]
+    v = _v_from_host(field, [_fp12_rows(x) for x in start])
+    want = list(start)
+    for _ in range(8):
+        v = hb._fp12_sqr(field, v)
+        want = [host.fp12_sqr(x) for x in want]
+    assert [_rows_fp12(r) for r in _v_to_host(field, v)] == want
+
+
+def test_tree_inversion_zero_and_odd_tails():
+    """_invert_lanes over the BN modulus: zero lanes come back zero
+    without poisoning the tree, odd widths keep their tail lane."""
+    field = _field()
+    for width in (1, 2, 3, 5, 7):
+        vals = [RNG.randrange(1, P) for _ in range(width)]
+        if width >= 3:
+            vals[1] = 0  # a zero lane mid-tree
+        mont = [(v * hb.R_MONT) % P for v in vals]
+        fe = _FE(
+            np.ascontiguousarray(limbs13_to_pairs(ints_to_limbs13(mont))),
+            1,
+            hb.PAIR_MASK,
+        )
+        inv = field.to_ints(_invert_lanes(field, fe))
+        for v, got in zip(vals, inv):
+            assert got == (pow(v, P - 2, P) if v else 0)
+
+
+# ---------------------------------------------------------------------------
+# Pairing structure check
+# ---------------------------------------------------------------------------
+
+
+def _oracle_check(w, a_prime, a_bar):
+    t = host.fp12_mul(
+        host.ate(w, a_prime), host.fp12_inv(host.ate(host.G2_GEN, a_bar))
+    )
+    return host.gt_is_unity(host.fexp(t))
+
+
+@pytest.fixture(scope="module")
+def pairing_world():
+    rng = random.Random(99)
+    sk = rng.randrange(R)
+    w = host.g2_mul(host.G2_GEN, sk)
+    hb.warm_schedules(w)
+    return rng, sk, w
+
+
+def test_pairing_check_mask_vs_oracle(pairing_world):
+    """The fused two-pairing batch agrees with the oracle verdict on
+    valid, mismatched, identity-ABar and invalid-lane flavors."""
+    rng, sk, w = pairing_world
+    a = host.g1_mul(host.G1_GEN, rng.randrange(1, R))
+    abar = host.g1_mul(a, sk)
+    other = host.g1_mul(host.G1_GEN, rng.randrange(1, R))
+    pairs = [
+        (a, abar),        # valid structure
+        (a, other),       # wrong ABar
+        (other, abar),    # wrong A'
+        None,             # pre-parse invalid lane
+        (a, None),        # identity ABar (miller = ONE in the oracle)
+    ]
+    got = hb.pairing_check_batch(w, pairs)
+    want = [
+        p is not None and _oracle_check(w, p[0], p[1]) for p in pairs
+    ]
+    assert got == want
+    assert got == [True, False, False, False, False]
+
+
+def test_pairing_bilinearity_spot(pairing_world):
+    """Bilinearity through the public check: with W = s·G2,
+    e(W, b·G1) == e(G2, sb·G1) for fresh (s, b) — and shifting either
+    side by one breaks it."""
+    rng, sk, w = pairing_world
+    b = rng.randrange(2, R)
+    pt = host.g1_mul(host.G1_GEN, b)
+    good = host.g1_mul(host.G1_GEN, (sk * b) % R)
+    off = host.g1_mul(host.G1_GEN, (sk * b + 1) % R)
+    assert hb.pairing_check_batch(w, [(pt, good), (pt, off)]) == [
+        True,
+        False,
+    ]
+
+
+# ---------------------------------------------------------------------------
+# Batched MSM
+# ---------------------------------------------------------------------------
+
+
+def _oracle_msm(bases, scalars):
+    acc = None
+    for b, s in zip(bases, scalars):
+        acc = host.g1_add(acc, host.g1_mul(b, s))
+    return acc
+
+
+def test_msm_batch_vs_oracle_mixed_jobs():
+    """Mixed base counts, identity bases, zero and order-edge scalars,
+    P + (−P) cancellation and duplicate bases (the P = Q patch path at
+    the slot-reduction level) — all against the affine oracle."""
+    rng = random.Random(5)
+    pts = [host.g1_mul(host.G1_GEN, rng.randrange(1, R)) for _ in range(6)]
+    pt = pts[0]
+    jobs = [
+        # generic jobs with differing K (exercises the K-grouping)
+        ([pts[1], pts[2], pts[3]], [rng.randrange(R) for _ in range(3)]),
+        (
+            [pts[i % 6] for i in range(8)],
+            [rng.randrange(R) for _ in range(8)],
+        ),
+        # identity base slot + zero scalar
+        ([pts[4], None, pts[5]], [rng.randrange(R), 7, 0]),
+        # order-edge scalars
+        ([pts[1], pts[2]], [R - 1, 1]),
+        # identity result: P + (−P)
+        ([pt, host.g1_neg(pt)], [1, 1]),
+        # duplicate base: slot reduction adds P = Q
+        ([pt, pt], [9, 9]),
+        # all-zero job -> identity
+        ([pts[3]], [0]),
+    ]
+    got = hb.msm_batch(jobs)
+    want = [_oracle_msm(b, s) for b, s in jobs]
+    assert got == want
+    assert got[4] is None and got[6] is None
+
+
+# ---------------------------------------------------------------------------
+# Idemix batch rung: mask vs the scheme oracle, pool path, ladder
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def idemix_world():
+    from fabric_tpu import idemix
+    from fabric_tpu.protos import idemix_pb2
+
+    rng = random.Random(7)
+    attrs = ["OU", "Role", "EnrollmentID", "RevocationHandle"]
+    rh_index = 3
+    ik = idemix.new_issuer_key(attrs, rng)
+    sk = host.rand_mod_order(rng)
+    nonce = host.big_to_bytes(host.rand_mod_order(rng))
+    req = idemix.new_cred_request(sk, nonce, ik.ipk, rng)
+    cred = idemix.new_credential(ik, req, [11, 22, 33, 44], rng)
+    cri = idemix_pb2.CredentialRevocationInformation()
+    cri.revocation_alg = idemix.ALG_NO_REVOCATION
+
+    def sign(disclosure, msg):
+        nym, r_nym = idemix.make_nym(sk, ik.ipk, rng)
+        return idemix.new_signature(
+            cred, sk, nym, r_nym, ik.ipk, disclosure, msg, rh_index, cri, rng
+        )
+
+    return ik, sign, rh_index
+
+
+def _flavor_lanes(idemix_world):
+    """(sigs, disclosures, msgs, values): valid lanes plus every
+    invalid flavor the ISSUE names."""
+    from fabric_tpu.protos import idemix_pb2
+
+    ik, sign, rh_index = idemix_world
+    hid, dis = [0, 0, 0, 0], [0, 1, 0, 0]
+    s0 = sign(hid, b"m0")
+    s1 = sign(dis, b"m1")
+
+    def variant(base, mutate):
+        sig = idemix_pb2.Signature()
+        sig.CopyFrom(base)
+        mutate(sig)
+        return sig
+
+    def bump(field):
+        def mutate(sig):
+            v = host.big_from_bytes(getattr(sig, field))
+            setattr(sig, field, host.big_to_bytes((v + 1) % R))
+        return mutate
+
+    def off_curve(sig):
+        sig.a_bar.x = host.big_to_bytes(3)
+        sig.a_bar.y = host.big_to_bytes(4)
+
+    def ident_abar(sig):
+        sig.a_bar.x = host.big_to_bytes(0)
+        sig.a_bar.y = host.big_to_bytes(0)
+
+    lanes = [
+        (s0, hid, b"m0", [None] * 4),                      # valid
+        (s1, dis, b"m1", [None, 22, None, None]),          # valid disclosed
+        (s0, hid, b"WRONG", [None] * 4),                   # bad challenge
+        (variant(s0, bump("proof_s_sk")), hid, b"m0", [None] * 4),
+        (variant(s1, bump("proof_c")), dis, b"m1", [None, 22, None, None]),
+        (s1, dis, b"m1", [None, 999, None, None]),         # wrong commitment
+        (variant(s0, off_curve), hid, b"m0", [None] * 4),  # off-group point
+        (variant(s0, ident_abar), hid, b"m0", [None] * 4),
+    ]
+    return (
+        [l[0] for l in lanes],
+        [l[1] for l in lanes],
+        [l[2] for l in lanes],
+        [l[3] for l in lanes],
+        rh_index,
+        ik.ipk,
+    )
+
+
+@pytest.fixture(scope="module")
+def flavor_batch(idemix_world):
+    from fabric_tpu.idemix.batch import verify_signatures_batch
+
+    sigs, disc, msgs, values, rh_index, ipk = _flavor_lanes(idemix_world)
+    oracle = verify_signatures_batch(
+        sigs, disc, ipk, msgs, values, rh_index, backend="scheme"
+    )
+    assert oracle == [True, True, False, False, False, False, False, False]
+    return sigs, disc, msgs, values, rh_index, ipk, oracle
+
+
+def test_batch_mask_bit_exact_vs_oracle(flavor_batch):
+    from fabric_tpu.idemix.batch import verify_signatures_batch
+
+    sigs, disc, msgs, values, rh_index, ipk, oracle = flavor_batch
+    got = verify_signatures_batch(
+        sigs, disc, ipk, msgs, values, rh_index, backend="hostbn"
+    )
+    assert got == oracle
+
+
+def test_batch_routes_through_active_ladder(flavor_batch):
+    """backend=None follows bccsp's ladder — hostbn here (numpy is
+    installed) — and yields the oracle mask."""
+    from fabric_tpu.crypto.bccsp import idemix_backend_name
+    from fabric_tpu.idemix.batch import verify_signatures_batch
+
+    sigs, disc, msgs, values, rh_index, ipk, oracle = flavor_batch
+    assert idemix_backend_name() == "hostbn"
+    got = verify_signatures_batch(sigs, disc, ipk, msgs, values, rh_index)
+    assert got == oracle
+
+
+def test_pool_path_and_degrade_inline(flavor_batch, monkeypatch):
+    """The shared-nothing pool shards the batch (order-preserving) and
+    a submit-time fault degrades to inline compute with the SAME mask
+    — degrade, never die."""
+    from fabric_tpu.common.faults import FaultPlan, plan_installed
+    from fabric_tpu.idemix import batch as ib
+
+    sigs, disc, msgs, values, rh_index, ipk, oracle = flavor_batch
+    # tile to 16 lanes and force the pool on at that size
+    tiled = [sigs[i % len(sigs)] for i in range(16)]
+    tdisc = [disc[i % len(sigs)] for i in range(16)]
+    tmsgs = [msgs[i % len(sigs)] for i in range(16)]
+    tvals = [values[i % len(sigs)] for i in range(16)]
+    texp = [oracle[i % len(sigs)] for i in range(16)]
+    monkeypatch.setenv("FABRIC_TPU_HOSTBN_MIN_POOL", "8")
+    monkeypatch.setenv("FABRIC_TPU_HOSTBN_MIN_SHARD", "8")
+    monkeypatch.setenv("FABRIC_TPU_HOSTBN_PROCS", "2")
+    try:
+        got = ib.verify_signatures_batch(
+            tiled, tdisc, ipk, tmsgs, tvals, rh_index, backend="hostbn"
+        )
+        assert got == texp
+        # injected submit failure: inline fallback, same mask, pool torn
+        plan = FaultPlan.parse("hostbn.pool.submit=raise:1.0", seed=3)
+        with plan_installed(plan):
+            got = ib.verify_signatures_batch(
+                tiled, tdisc, ipk, tmsgs, tvals, rh_index, backend="hostbn"
+            )
+        assert got == texp
+        assert plan.fired().get("hostbn.pool.submit", 0) >= 1
+    finally:
+        ib.shutdown_pool()
+
+
+def test_idemix_verdict_corrupt_seam(flavor_batch):
+    """The idemix.verdict corrupt site flips exactly the planned lanes
+    — the seam the chaos mask gate proves itself against."""
+    from fabric_tpu.common.faults import FaultPlan, plan_installed
+    from fabric_tpu.idemix.batch import verify_signatures_batch
+
+    sigs, disc, msgs, values, rh_index, ipk, oracle = flavor_batch
+    plan = FaultPlan.parse("idemix.verdict=corrupt:1.0:lanes=1", seed=5)
+    with plan_installed(plan):
+        got = verify_signatures_batch(
+            sigs, disc, ipk, msgs, values, rh_index, backend="hostbn"
+        )
+    assert sum(1 for a, b in zip(got, oracle) if a != b) == 1
+
+
+def test_idemix_verdict_fires_once_not_in_pool_workers(flavor_batch):
+    """The corrupt seam fires ONCE per batch, in the coordinating
+    process: the worker re-entry (_pool_ok=False) must NOT apply an
+    inherited plan, or shard flips and the parent's flips would cancel
+    and an armed fault could become a silent no-op."""
+    from fabric_tpu.common.faults import FaultPlan, plan_installed
+    from fabric_tpu.idemix.batch import verify_signatures_batch
+
+    sigs, disc, msgs, values, rh_index, ipk, oracle = flavor_batch
+    plan = FaultPlan.parse("idemix.verdict=corrupt:1.0", seed=5)
+    with plan_installed(plan):
+        worker_view = verify_signatures_batch(
+            sigs, disc, ipk, msgs, values, rh_index,
+            backend="hostbn", _pool_ok=False,
+        )
+    assert worker_view == oracle  # uncorrupted inside the worker path
+
+
+# ---------------------------------------------------------------------------
+# Ladder selection / numpy-absent degradation
+# ---------------------------------------------------------------------------
+
+
+def test_ladder_pin_and_auto(monkeypatch):
+    """Explicit pins honored; with numpy 'absent' the auto walk lands
+    on the scheme rung and a hostbn pin raises ImportError."""
+    from fabric_tpu.crypto import bccsp
+
+    before = bccsp.idemix_backend_name()
+    try:
+        assert bccsp.select_idemix_backend("hostbn") is hb
+        assert bccsp.idemix_backend_name() == "hostbn"
+        assert bccsp.select_idemix_backend("scheme") is None
+        assert bccsp.idemix_backend_name() == "scheme"
+        with pytest.raises(ValueError):
+            bccsp.select_idemix_backend("nope")
+        monkeypatch.setattr(hb, "HAVE_NUMPY", False)
+        assert bccsp.select_idemix_backend("auto") is None
+        assert bccsp.idemix_backend_name() == "scheme"
+        with pytest.raises(ImportError):
+            bccsp.select_idemix_backend("hostbn")
+    finally:
+        monkeypatch.setattr(hb, "HAVE_NUMPY", True)
+        bccsp.select_idemix_backend(before)
+
+
+def test_env_pin_malformed_warns_never_raises(monkeypatch):
+    from fabric_tpu.crypto import bccsp
+
+    before = bccsp.idemix_backend_name()
+    monkeypatch.setenv("FABRIC_TPU_IDEMIX_BACKEND", "bogus-tier")
+    try:
+        with pytest.warns(RuntimeWarning):
+            bccsp.select_idemix_backend("auto")
+        assert bccsp.idemix_backend_name() in ("hostbn", "scheme")
+    finally:
+        monkeypatch.delenv("FABRIC_TPU_IDEMIX_BACKEND", raising=False)
+        bccsp.select_idemix_backend(before)
+
+
+def test_factory_idemix_backend(monkeypatch):
+    """BCCSP.SW.IdemixBackend: known tiers select; unknown names warn
+    and keep the pin; a known-but-unavailable tier errors HARD."""
+    from fabric_tpu.crypto import bccsp, factory
+
+    before = bccsp.idemix_backend_name()
+    try:
+        factory.provider_from_config(
+            {"Default": "SW", "SW": {"IdemixBackend": "scheme"}}
+        )
+        assert bccsp.idemix_backend_name() == "scheme"
+        factory.provider_from_config(
+            {"Default": "SW", "SW": {"IdemixBackend": "hostbn"}}
+        )
+        assert bccsp.idemix_backend_name() == "hostbn"
+        # unknown name: keep the current selection, never raise
+        factory.provider_from_config(
+            {"Default": "SW", "SW": {"IdemixBackend": "hostbn_v99"}}
+        )
+        assert bccsp.idemix_backend_name() == "hostbn"
+        monkeypatch.setattr(hb, "HAVE_NUMPY", False)
+        with pytest.raises(factory.FactoryError):
+            factory.provider_from_config(
+                {"Default": "SW", "SW": {"IdemixBackend": "hostbn"}}
+            )
+    finally:
+        monkeypatch.setattr(hb, "HAVE_NUMPY", True)
+        bccsp.select_idemix_backend(before)
+
+
+def test_module_imports_without_numpy_subprocess():
+    """hostbn (and the idemix ladder around it) must import with numpy
+    genuinely blocked, walking to the scheme rung with a warning in the
+    log — the guarded-import discipline the collect gate relies on."""
+    code = (
+        "import sys\n"
+        "sys.modules['numpy'] = None\n"  # import numpy -> ImportError
+        "import fabric_tpu.crypto.hostbn as hb\n"
+        "assert not hb.HAVE_NUMPY\n"
+        "from fabric_tpu.crypto import bccsp\n"
+        "assert bccsp.select_idemix_backend('auto') is None\n"
+        "assert bccsp.idemix_backend_name() == 'scheme'\n"
+        "assert bccsp.available_idemix_backends() == "
+        "{'hostbn': False, 'scheme': True}\n"
+        "print('ok')\n"
+    )
+    res = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert res.returncode == 0, res.stderr
+    assert "ok" in res.stdout
