@@ -16,8 +16,12 @@ site                       seam
 ``hostec.pool.resolve``    hostec shard result join
 ``hostec_np.pool.submit``  hostec_np shm shard submission
 ``hostec_np.pool.resolve`` hostec_np shm shard result join
+``hostbn.pool.submit``     hostbn idemix shard submission
+``hostbn.pool.resolve``    hostbn idemix shard result join
 ``deliver.pull``           BlockDeliverer.run, per connection attempt
 ``gossip.comm.send``       GossipNode._send, per stream open
+``serve.dispatch``         SidecarServer verify handling, per request
+``idemix.verdict``         idemix/batch verdict mask (corrupt action)
 =========================  ==================================================
 
 A ``fault_point(site, key=...)`` call costs ONE module-global load and a

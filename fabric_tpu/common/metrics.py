@@ -27,6 +27,27 @@ DEFAULT_BUCKETS = (
 )
 
 
+def latency_summary(samples_s: Sequence[float]) -> Dict[str, float]:
+    """``{n, p50_ms, p99_ms, max_ms}`` over seconds-valued latency
+    samples (``{"n": 0}`` when empty) — the one quantile-index
+    definition shared by the serve sidecar's ServeStats, the commit
+    pipeline's stage reservoirs, and bench.py's client-side columns,
+    so the three surfaces can never silently diverge."""
+    if not samples_s:
+        return {"n": 0}
+    s = sorted(samples_s)
+
+    def pct(q: float) -> float:
+        return s[min(len(s) - 1, max(0, int(round(q * (len(s) - 1)))))]
+
+    return {
+        "n": len(s),
+        "p50_ms": round(pct(0.50) * 1e3, 3),
+        "p99_ms": round(pct(0.99) * 1e3, 3),
+        "max_ms": round(s[-1] * 1e3, 3),
+    }
+
+
 @dataclass(frozen=True)
 class MetricOpts:
     namespace: str = ""

@@ -20,6 +20,7 @@ on it.
 from __future__ import annotations
 
 import random
+import threading
 import time
 from dataclasses import dataclass
 from typing import Callable, Optional, Tuple, Type
@@ -145,13 +146,15 @@ def call_with_retry(
 
 
 class CooldownGate:
-    """Failure-driven circuit for expensive rebuilds (process pools).
+    """Failure-driven circuit for expensive rebuilds (process pools)
+    and dial attempts (the serve client).
 
     ``ready()`` answers "may we rebuild now?"; each ``record_failure()``
     opens the gate for an exponentially longer cooldown (policy delays),
-    ``record_success()`` closes it and resets the ramp.  Thread-safe via
-    the caller's lock discipline: pools already serialize rebuilds under
-    their _POOL_LOCK, so this object does no locking of its own."""
+    ``record_success()`` closes it and resets the ramp.  Thread-safe on
+    its own (leaf lock, acquired around state only — safe to call under
+    any caller lock): gates are now shared across serve worker threads,
+    not just callers that already hold a pool lock."""
 
     def __init__(
         self,
@@ -162,23 +165,27 @@ class CooldownGate:
             base_s=0.5, multiplier=2.0, cap_s=30.0, deadline_s=float("inf")
         )
         self._clock = clock
+        self._lock = threading.Lock()
         self._failures = 0
         self._open_until = 0.0
 
     def ready(self) -> bool:
-        return self._clock() >= self._open_until
+        with self._lock:
+            return self._clock() >= self._open_until
 
     def record_failure(self) -> None:
         p = self.policy
-        # clamp: a persistently-broken environment (this gate's whole
-        # reason to exist) grows _failures without bound, and
-        # multiplier**1024 raises OverflowError as a float
-        cooldown = min(
-            p.base_s * p.multiplier ** min(self._failures, 64), p.cap_s
-        )
-        self._failures += 1
-        self._open_until = self._clock() + cooldown
+        with self._lock:
+            # clamp: a persistently-broken environment (this gate's
+            # whole reason to exist) grows _failures without bound, and
+            # multiplier**1024 raises OverflowError as a float
+            cooldown = min(
+                p.base_s * p.multiplier ** min(self._failures, 64), p.cap_s
+            )
+            self._failures += 1
+            self._open_until = self._clock() + cooldown
 
     def record_success(self) -> None:
-        self._failures = 0
-        self._open_until = 0.0
+        with self._lock:
+            self._failures = 0
+            self._open_until = 0.0

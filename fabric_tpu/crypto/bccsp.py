@@ -473,21 +473,52 @@ def default_provider() -> Provider:
 def _default_provider_locked() -> Provider:
     global _default
     if _default is None:
-        try:
-            # BOUNDED probe: a dead accelerator tunnel makes the naive
-            # jax.devices() call hang forever (observed round 4) — a
-            # node start must degrade to the software provider instead
-            from fabric_tpu.utils.deviceprobe import accelerator_present
+        addr = os.environ.get("FABRIC_TPU_SERVE_ADDR", "")
+        if addr:
+            # resident-sidecar routing (fabric_tpu.serve): every default
+            # consumer (peer channels, the chaos harness) transparently
+            # sends its batches to the warm sidecar.  The rung builds
+            # WITHOUT contacting the sidecar (a peer may start before
+            # its sidecar; batch_verify re-dials behind a failure
+            # cooldown, so a late-arriving sidecar is picked up) and
+            # degrades through
+            # probe_provider() — an accelerator node with a stale env
+            # var keeps its device, never silently pins the SW rung
+            try:
+                from fabric_tpu.crypto.factory import provider_from_config
 
-            if accelerator_present():
-                from fabric_tpu.crypto.tpu_provider import TPUProvider
-
-                _default = TPUProvider()
-            else:
-                _default = SoftwareProvider()
-        except Exception as exc:
-            logger.warning(
-                "device probe failed (%s); using the software provider", exc
-            )
-            _default = SoftwareProvider()
+                _default = provider_from_config(
+                    {"Default": "SERVE", "SERVE": {"Address": addr}}
+                )
+                return _default
+            except Exception as exc:  # noqa: BLE001 - env routing best-effort
+                logger.warning(
+                    "FABRIC_TPU_SERVE_ADDR=%s unusable (%s); using the "
+                    "in-process provider ladder", addr, exc,
+                )
+        _default = probe_provider()
     return _default
+
+
+def probe_provider() -> Provider:
+    """The device-probe ladder, independent of any sidecar routing: the
+    TPU provider if an accelerator answers the bounded probe, else the
+    software provider.  Also the sidecar client's degrade target, so an
+    accelerator-attached node that loses its sidecar falls back to the
+    device, not to a hardcoded SW rung."""
+    try:
+        # BOUNDED probe: a dead accelerator tunnel makes the naive
+        # jax.devices() call hang forever (observed round 4) — a
+        # node start must degrade to the software provider instead
+        from fabric_tpu.utils.deviceprobe import accelerator_present
+
+        if accelerator_present():
+            from fabric_tpu.crypto.tpu_provider import TPUProvider
+
+            return TPUProvider()
+        return SoftwareProvider()
+    except Exception as exc:  # noqa: BLE001 - probe flake: SW serves
+        logger.warning(
+            "device probe failed (%s); using the software provider", exc
+        )
+        return SoftwareProvider()

@@ -5,7 +5,7 @@ sampleconfig/core.yaml:295-319 BCCSP section).
 Config shape (the core.yaml BCCSP block):
 
   BCCSP:
-    Default: TPU          # TPU | SW | PKCS11
+    Default: TPU          # TPU | SW | PKCS11 | SERVE
                           #  TPU is the accelerator provider (SURVEY
                           #  §2.12: architecturally the out-of-process
                           #  crypto-module slot); PKCS11 is a REAL
@@ -22,15 +22,23 @@ Config shape (the core.yaml BCCSP block):
       Library: /usr/lib/softhsm/libsofthsm2.so
       Pin: "98765432"
       Slot: 0             # optional; first token slot when omitted
+    SERVE:
+      Address: /tmp/fabserve.sock   # resident sidecar socket
+                          #  (fabric_tpu.serve: batch verifies route to
+                          #  the warm sidecar; degrade-to-in-process on
+                          #  sidecar death, fail-closed masks)
 
 TPU degrades to SW when no device answers; PKCS11 errors HARD on a
 missing library (an operator who configured an HSM must not silently
-run on software keys), like the reference's pkcs11factory.
+run on software keys), like the reference's pkcs11factory.  SERVE
+builds the sidecar client rung — registered by fabric_tpu.serve.client
+via register_provider_factory (dependency inversion: serve sits above
+crypto in the layer map, so the factory never imports it statically).
 """
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Callable, Dict, Optional
 
 from fabric_tpu.common import flogging
 from fabric_tpu.crypto.bccsp import Provider, SoftwareProvider
@@ -40,6 +48,49 @@ logger = flogging.must_get_logger("bccsp.factory")
 
 class FactoryError(Exception):
     pass
+
+
+# -- pluggable provider rungs (dependency inversion) ------------------------
+# Higher-layer packages (the serve sidecar lives above crypto in
+# tools/layers.toml) register their provider builders here instead of
+# being imported upward.  _LAZY_PROVIDER_MODULES maps a config Default
+# to the module whose import performs that registration — resolved via
+# importlib at runtime, so the layer map stays a static DAG.
+
+_PROVIDER_FACTORIES: Dict[str, Callable[[dict], Provider]] = {}
+_LAZY_PROVIDER_MODULES = {"SERVE": "fabric_tpu.serve.client"}
+
+
+def register_provider_factory(
+    name: str, builder: Callable[[dict], Provider]
+) -> None:
+    """Register a config ``Default:`` name -> provider builder (the
+    builder receives the full BCCSP config dict)."""
+    _PROVIDER_FACTORIES[name.upper()] = builder
+
+
+def _resolve_provider_factory(name: str) -> Optional[Callable]:
+    builder = _PROVIDER_FACTORIES.get(name)
+    if builder is not None:
+        return builder
+    module = _LAZY_PROVIDER_MODULES.get(name)
+    if module is None:
+        return None
+    import importlib
+
+    try:
+        importlib.import_module(module)  # import side effect: registers
+    except ImportError as exc:
+        raise FactoryError(
+            f"BCCSP default {name!r} needs {module} which failed to "
+            f"import: {exc}"
+        ) from exc
+    builder = _PROVIDER_FACTORIES.get(name)
+    if builder is None:
+        raise FactoryError(
+            f"{module} imported but did not register a {name!r} provider"
+        )
+    return builder
 
 
 def provider_from_config(cfg: Optional[dict]) -> Provider:
@@ -120,6 +171,20 @@ def provider_from_config(cfg: Optional[dict]) -> Provider:
                 f"unavailable: {exc}"
             ) from exc
         logger.info("idemix batch backend: %s", idemix_backend_name())
+
+    # Registered rungs first (SERVE and future out-of-process providers):
+    # the tier pins above already applied, so a rung's in-process
+    # fallback rides the operator's chosen ladder.
+    registered = _resolve_provider_factory(default)
+    if registered is not None:
+        try:
+            return registered(cfg)
+        except FactoryError:
+            raise
+        except Exception as exc:
+            raise FactoryError(
+                f"BCCSP default {default!r} provider failed to build: {exc}"
+            ) from exc
 
     if default == "SW":
         return SoftwareProvider()

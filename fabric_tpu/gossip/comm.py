@@ -121,6 +121,16 @@ class GossipNode:
         self._require_signed_alive = require_signed_alive
         self._verify_member_sig = pvt_verify_member_sig
         self._endpoints: Dict[str, str] = {}  # peer id -> endpoint
+        # bootstrap anchors (connect() targets): re-introduced on a
+        # paced per-anchor backoff until a member answers from that
+        # endpoint, so ONE lost hello on a lossy link cannot partition
+        # the pair forever (the reference keeps dialing its bootstrap
+        # peers; fabchaos gossip_storm drops stream opens and relies on
+        # this re-try) — the backoff caps the redial rate so a
+        # permanently-decommissioned anchor is not dialed every tick
+        # for the node's remaining lifetime
+        self._anchors: set = set()
+        self._anchor_backoff: Dict[str, list] = {}  # ep -> [next_tick, interval]
         self._conns: Dict[str, object] = {}  # endpoint -> grpc channel
         self._lock = threading.Lock()
         # per-endpoint send sequence, so fault decisions key per stream
@@ -550,13 +560,42 @@ class GossipNode:
     # tick would open streams constantly for nothing)
     PULL_EVERY = 5
     RECONCILE_EVERY = 5
+    #: cap on the per-anchor redial backoff (in ticks): a silent
+    #: bootstrap anchor is re-dialed at most once per cap window
+    ANCHOR_REDIAL_CAP_TICKS = 50
 
     def _tick_once(self) -> None:
         import random as _random
 
         self._tick_count += 1
         batch = self._intro_messages()
-        for endpoint in self._peer_endpoints():
+        member_endpoints = self._peer_endpoints()
+        for endpoint in member_endpoints:
+            self._send(endpoint, batch)
+        # bootstrap resilience: an anchor whose hello was lost (flaky
+        # link, chaos gossip.comm.send drop) gets re-introduced until a
+        # member answers from that endpoint — without this, one dropped
+        # connect() partitions the pair permanently because ticks only
+        # address peers ALREADY in the member view.  Redials are paced
+        # by a per-anchor exponential backoff in ticks (first retry on
+        # the next tick, doubling to ANCHOR_REDIAL_CAP_TICKS), so a
+        # dead anchor costs one dial per cap window, not one per tick.
+        known = set(member_endpoints)
+        with self._lock:
+            silent_anchors = []
+            for a in self._anchors:
+                if a in known:
+                    # answered: reset the ramp so a future re-silence
+                    # (restart, partition) retries fast again
+                    self._anchor_backoff.pop(a, None)
+                    continue
+                nxt = self._anchor_backoff.setdefault(a, [self._tick_count, 1])
+                if self._tick_count < nxt[0]:
+                    continue
+                nxt[1] = min(nxt[1] * 2, self.ANCHOR_REDIAL_CAP_TICKS)
+                nxt[0] = self._tick_count + nxt[1]
+                silent_anchors.append(a)
+        for endpoint in silent_anchors:
             self._send(endpoint, batch)
         # SWIM suspicion: direct-probe peers whose heartbeats stopped
         # reaching us BEFORE expiring them (push loss != death); their
@@ -659,7 +698,11 @@ class GossipNode:
 
     # -- lifecycle --------------------------------------------------------
     def connect(self, endpoint: str) -> None:
-        """Bootstrap: introduce ourselves to an anchor peer."""
+        """Bootstrap: introduce ourselves to an anchor peer.  The
+        endpoint is remembered: the tick loop re-introduces us until the
+        anchor shows up in the member view (lossy-link resilience)."""
+        with self._lock:
+            self._anchors.add(endpoint)
         self._send(endpoint, self._intro_messages())
 
     def start(self) -> str:

@@ -79,7 +79,7 @@ class _Request:
         with a real settlement is benign: whichever lands first wins and
         both outcomes are fail-closed (real verdicts or all-False)."""
         if not self.event.is_set():
-            self.result = [False] * len(self.keys)
+            self.result = [False] * len(self.keys)  # fabdep: disable=unguarded-shared-write  # documented benign race: both settlements are fail-closed, event.set publishes
             self.event.set()
 
 
@@ -172,12 +172,43 @@ class VerifyBatcher:
             )
             self._last_mode = self.mode
 
+    @property
+    def pending_lanes(self) -> int:
+        """Lanes currently admitted but not yet dispatched — the
+        admission-control fill signal the serve sidecar scales its
+        retry_after hint by."""
+        with self._lanes_cv:
+            return self._max_pending_lanes - self._lanes_free
+
     def submit(
         self,
         keys: Sequence,
         signatures: Sequence[bytes],
         digests: Sequence[bytes],
     ) -> Callable[[], List[bool]]:
+        resolver = self._admit(keys, signatures, digests, block=True)
+        assert resolver is not None  # blocking admission never rejects
+        return resolver
+
+    def try_submit(
+        self,
+        keys: Sequence,
+        signatures: Sequence[bytes],
+        digests: Sequence[bytes],
+    ) -> Optional[Callable[[], List[bool]]]:
+        """Non-blocking admission (the serve sidecar's front door): the
+        resolver when the lane budget admits the request NOW, else None
+        — the caller turns that into an explicit reject-with-retry-after
+        instead of stalling a socket thread on the condition variable."""
+        return self._admit(keys, signatures, digests, block=False)
+
+    def _admit(
+        self,
+        keys: Sequence,
+        signatures: Sequence[bytes],
+        digests: Sequence[bytes],
+        block: bool,
+    ) -> Optional[Callable[[], List[bool]]]:
         n = len(keys)
         if n == 0:
             return list
@@ -197,6 +228,8 @@ class VerifyBatcher:
                 # will never release
                 if self._stopped:
                     raise RuntimeError("batcher stopped")
+                if not block:
+                    return None
                 self._lanes_cv.wait()
             self._lanes_free -= req.permits
         # the stop lock orders every put against the stop sentinel: no

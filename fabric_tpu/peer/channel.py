@@ -90,7 +90,17 @@ class Channel:
         jobs, job_identity, keys, sigs, digests = (
             self.validator.collect_sig_jobs(parsed)
         )
-        ok_list = self.provider.batch_verify(keys, sigs, digests)
+        # dispatch WITHOUT waiting when the provider has an async seam
+        # (device kernels, pool shards, the serve sidecar): the returned
+        # resolver rides the prepared tuple and store_block collects the
+        # verdicts at stage B — block N's signature math overlaps block
+        # N-1's sequential commit epilogue across the full dispatch
+        # ladder, not just inside one provider
+        dispatch = getattr(self.provider, "batch_verify_async", None)
+        if dispatch is None:
+            ok_list = self.provider.batch_verify(keys, sigs, digests)
+        else:
+            ok_list = dispatch(keys, sigs, digests)
         return parsed, jobs, job_identity, ok_list
 
     def store_block(
@@ -111,6 +121,12 @@ class Channel:
         if prepared is None:
             prepared = self.prepare_block(block)
         parsed, jobs, job_identity, ok_list = prepared
+        if callable(ok_list):
+            # async-prepared tuple: resolve the verify dispatch now.  A
+            # resolver failure raises here and surfaces through the
+            # commit error path (the block is NOT committed — fail
+            # closed), same as a synchronous batch_verify failure would.
+            ok_list = ok_list()
         sig_results = self.validator.finish_sig_results(
             jobs, job_identity, ok_list
         )

@@ -16,10 +16,13 @@ from __future__ import annotations
 
 import queue
 import threading
-from typing import Callable, Optional
+import time
+from collections import deque
+from typing import Callable, Dict, Optional
 
 from fabric_tpu.common.faults import fault_point
 from fabric_tpu.common.flogging import must_get_logger
+from fabric_tpu.common.metrics import latency_summary
 from fabric_tpu.protos import common_pb2
 
 
@@ -50,6 +53,13 @@ class CommitPipeline:
         # without stop()) distinguish slow from dead
         self.last_error: Optional[BaseException] = None
         self._crashed = False
+        # per-stage latency reservoirs (bounded; newest samples win) —
+        # the honest p50/p99 surface the serve/bench paths read instead
+        # of re-deriving stage costs from wall-clock differences
+        self._stage_s: Dict[str, deque] = {
+            "prepare": deque(maxlen=2048),
+            "commit": deque(maxlen=2048),
+        }
         self._committer = threading.Thread(
             target=self._commit_loop,
             name=f"commit-{channel.channel_id}",
@@ -69,7 +79,10 @@ class CommitPipeline:
             self._pending += 1
             self._idle.clear()
         try:
+            t0 = time.perf_counter()
             prepared = self.channel.prepare_block(block)
+            with self._pending_lock:
+                self._stage_s["prepare"].append(time.perf_counter() - t0)
             # bounded put that watches _stopped: a plain blocking put on
             # a full queue after stop() would wait forever — the
             # committer has exited and will never drain it (pipeline
@@ -126,7 +139,10 @@ class CommitPipeline:
                     "pipeline.commit",
                     key=int(getattr(block.header, "number", 0)),
                 )
+                t0 = time.perf_counter()
                 flags = self.channel.store_block(block, prepared=prepared)
+                with self._pending_lock:
+                    self._stage_s["commit"].append(time.perf_counter() - t0)
                 if self.on_commit is not None:
                     self.on_commit(block, flags)
             except Exception as exc:  # noqa: BLE001 - surfaced to the owner
@@ -148,6 +164,15 @@ class CommitPipeline:
                     self._pending -= 1
                     if self._pending == 0:
                         self._idle.set()
+
+    def stage_stats(self) -> Dict[str, Dict[str, float]]:
+        """Per-stage latency summary over the bounded reservoirs:
+        {"prepare": {n, p50_ms, p99_ms}, "commit": {...}} — what
+        1907.08367's reordered-stage analysis wants measured, served
+        from the live pipeline instead of a one-off bench probe."""
+        with self._pending_lock:
+            samples = {k: list(v) for k, v in self._stage_s.items()}
+        return {stage: latency_summary(vals) for stage, vals in samples.items()}
 
     def drain(self, timeout: float = 30.0) -> bool:
         """Wait until every submitted block has committed.  Returns

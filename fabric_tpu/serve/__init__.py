@@ -1,0 +1,19 @@
+"""Resident validation sidecar (the ROADMAP "resident validation
+service + AOT/compile-cache runtime" subsystem).
+
+- :mod:`fabric_tpu.serve.protocol` — length-prefixed local socket
+  framing (VERIFY/PING/STATS/SHUTDOWN) with explicit admission-control
+  statuses (ST_BUSY + retry_after_ms).
+- :mod:`fabric_tpu.serve.registry` — bucketed program registry: warm
+  AOT executables per lane-bucket shape, with cold/cache/AOT warm-start
+  accounting.
+- :mod:`fabric_tpu.serve.server` — the sidecar process: owns the verify
+  backends for its lifetime, fronts them with the VerifyBatcher's
+  bounded-lane admission, serves batches over the socket.
+- :mod:`fabric_tpu.serve.client` — the BCCSP rung: SidecarProvider
+  routes batch verification through the sidecar and degrades to
+  in-process verification (fail-closed masks) when it dies.
+
+Import the submodules directly; this package namespace stays empty so
+importing it costs nothing in jax-free processes.
+"""

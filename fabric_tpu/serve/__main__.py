@@ -1,0 +1,8 @@
+"""``python -m fabric_tpu.serve`` — run the resident validation sidecar."""
+
+import sys
+
+from fabric_tpu.serve.server import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,474 @@
+"""Client shim: the sidecar as a BCCSP provider rung.
+
+``SidecarProvider`` speaks the serve protocol to a resident sidecar and
+presents the standard Provider SPI, so ``peer/pipeline``, the
+VerifyBatcher and the chaos harness route through the sidecar without
+knowing it exists.  Select it like any other rung::
+
+    provider_from_config({"Default": "SERVE", "SERVE": {"Address": addr}})
+    FABRIC_TPU_SERVE_ADDR=/tmp/fabserve.sock   # default_provider() routes
+
+Degrade contract (the mask discipline this file is in the fabflow MASK
+tier for):
+
+- ``ST_BUSY`` is admission control, not failure: the client retries on
+  the shared ``common.retry`` pacing, honoring the sidecar's
+  ``retry_after_ms`` hint, until the policy budget is spent.
+- A dead/stopping sidecar (connect failure, mid-batch socket death,
+  ST_STOPPING, budget exhausted) degrades to IN-PROCESS verification
+  through the local probe ladder (device if present, else SW) — masks
+  stay bit-exact, requests never fail just because the sidecar died.
+- If even the in-process fallback throws, the batch's mask is all-False
+  (fail-closed) — a lane is never guessed VALID on any failure path.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from fabric_tpu.common.flogging import must_get_logger
+from fabric_tpu.common.retry import Backoff, CooldownGate, RetryPolicy
+from fabric_tpu.serve import protocol as proto
+from fabric_tpu.serve.protocol import parse_address
+
+logger = must_get_logger("serve.client")
+
+#: Admission-control pacing: capped exponential between BUSY retries,
+#: bounded total wait before the client degrades to in-process verify.
+BUSY_POLICY = RetryPolicy(
+    base_s=0.01, multiplier=2.0, cap_s=0.5, deadline_s=10.0, max_attempts=16
+)
+
+
+class SidecarUnavailable(Exception):
+    """The sidecar cannot serve this request (dead socket, stopping,
+    protocol violation).  The provider degrades to in-process verify."""
+
+
+class SidecarClient:
+    """One pipelined connection to a sidecar.
+
+    ``submit_verify`` writes the request frame and returns a token;
+    ``await_verify`` demultiplexes response frames until the token's
+    reply arrives — concurrent callers cooperate under the receive lock,
+    and replies may arrive in ANY order (the server settles verify
+    requests concurrently): each frame is matched to its waiter by
+    request id.  Any socket failure fails every pending token with
+    :class:`SidecarUnavailable`: the waiters' provider degrades
+    in-process, so a sidecar killed mid-batch still yields bit-exact
+    masks.
+    """
+
+    def __init__(
+        self,
+        address: str,
+        connect_timeout_s: float = 5.0,
+        request_timeout_s: float = 120.0,
+    ):
+        self.address = address
+        self.connect_timeout_s = connect_timeout_s
+        self.request_timeout_s = request_timeout_s
+        self._sock = None
+        self._send_lock = threading.Lock()
+        self._recv_lock = threading.Lock()
+        self._state_lock = threading.Lock()
+        self._next_id = 0
+        # token -> {"event": Event, "reply": payload|None, "error": exc|None}
+        self._pending: Dict[int, Dict] = {}
+        # failure-driven dial circuit: a permanently-dead TCP endpoint
+        # (SYN blackholed) costs connect_timeout_s PER BATCH without it
+        # — every commit would stall ~5s before degrading.  CooldownGate
+        # carries its own leaf lock, so it is safe both under
+        # _state_lock (ready) and outside it (record_* after a dial).
+        self._dial_gate = CooldownGate()
+
+    # -- connection --------------------------------------------------------
+    def _connect(self):
+        import socket as _socket
+
+        family, target = parse_address(self.address)
+        sock = _socket.socket(family, _socket.SOCK_STREAM)
+        sock.settimeout(self.connect_timeout_s)
+        sock.connect(target)
+        sock.settimeout(self.request_timeout_s)
+        return sock
+
+    def _ensure_sock(self):
+        with self._state_lock:
+            if self._sock is not None:
+                return self._sock
+            if not self._dial_gate.ready():
+                raise SidecarUnavailable(
+                    f"connect {self.address}: cooling down after "
+                    "dial failure"
+                )
+        # dial OUTSIDE the state lock: a blackholed endpoint blocks in
+        # connect() for connect_timeout_s, and close()/_fail_all/the
+        # await_reply loop must not stall behind the dialer
+        try:
+            sock = self._connect()
+        except OSError as exc:
+            self._dial_gate.record_failure()
+            raise SidecarUnavailable(
+                f"connect {self.address}: {exc}"
+            ) from exc
+        self._dial_gate.record_success()
+        with self._state_lock:
+            if self._sock is None:
+                self._sock = sock
+                return sock
+            winner = self._sock
+        # a concurrent dialer won the install race: use its socket
+        try:
+            sock.close()
+        except OSError:
+            pass
+        return winner
+
+    def _fail_all(self, exc: Exception) -> None:
+        """Socket death: every pending waiter learns, the connection is
+        torn down (the next call reconnects)."""
+        with self._state_lock:
+            sock, self._sock = self._sock, None
+            pending = list(self._pending.values())
+            self._pending.clear()
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        for entry in pending:
+            entry["error"] = SidecarUnavailable(str(exc))
+            entry["event"].set()
+
+    def close(self) -> None:
+        self._fail_all(SidecarUnavailable("client closed"))
+
+    # -- request plumbing --------------------------------------------------
+    def submit(self, opcode: int, payload: bytes) -> int:
+        """Send one frame; returns the token to await.  Raises
+        SidecarUnavailable on any transport failure."""
+        sock = self._ensure_sock()
+        with self._send_lock:
+            with self._state_lock:
+                self._next_id = (self._next_id + 1) & 0xFFFFFFFF
+                token = self._next_id
+                self._pending[token] = {
+                    "event": threading.Event(), "reply": None, "error": None,
+                }
+            try:
+                proto.send_frame(sock, opcode, token, payload)
+            except OSError as exc:
+                self._fail_all(exc)
+                raise SidecarUnavailable(f"send: {exc}") from exc
+        return token
+
+    def await_reply(self, token: int) -> bytes:
+        """Block until the token's response payload arrives (cooperative
+        demux: whichever waiter holds the recv lock reads frames and
+        settles the tokens they answer)."""
+        deadline = time.monotonic() + self.request_timeout_s
+        while True:
+            with self._state_lock:
+                entry = self._pending.get(token)
+            if entry is None:
+                raise SidecarUnavailable("reply already consumed or failed")
+            if entry["event"].is_set():
+                with self._state_lock:
+                    self._pending.pop(token, None)
+                if entry["error"] is not None:
+                    raise entry["error"]
+                return entry["reply"]
+            got_lock = self._recv_lock.acquire(timeout=0.1)
+            if not got_lock:
+                if time.monotonic() > deadline:
+                    # give up on THIS token only: the demux holder is
+                    # legitimately blocked on a slower request, and the
+                    # connection is still healthy — tearing it down
+                    # would discard the holder's nearly-done server-side
+                    # work.  A late reply for this token is dropped by
+                    # the holder's gave-up branch below.  (A truly dead
+                    # sidecar is caught by the HOLDER's own socket
+                    # timeout, which does fail all waiters.)
+                    with self._state_lock:
+                        self._pending.pop(token, None)
+                    raise SidecarUnavailable("reply timeout")
+                continue
+            try:
+                if entry["event"].is_set():
+                    continue  # settled while we waited for the lock
+                sock = self._sock
+                if sock is None:
+                    raise SidecarUnavailable("connection lost")
+                try:
+                    frame = proto.recv_frame(sock)
+                except (OSError, proto.ProtocolError) as exc:
+                    self._fail_all(exc)
+                    raise SidecarUnavailable(f"recv: {exc}") from exc
+                if frame is None:
+                    self._fail_all(ConnectionError("sidecar closed stream"))
+                    raise SidecarUnavailable("sidecar closed the stream")
+                _opcode, rid, payload = frame
+                with self._state_lock:
+                    settled = self._pending.get(rid)
+                if settled is not None:
+                    settled["reply"] = payload
+                    settled["event"].set()
+                # else: reply for a token whose waiter gave up — drop
+            finally:
+                self._recv_lock.release()
+
+    def request(self, opcode: int, payload: bytes = b"") -> bytes:
+        return self.await_reply(self.submit(opcode, payload))
+
+    # -- typed helpers -----------------------------------------------------
+    def ping(self) -> bool:
+        status, _, _, _ = proto.decode_verify_response(
+            self.request(proto.OP_PING)
+        )
+        return status == proto.ST_OK
+
+    def stats(self) -> Dict:
+        import json
+
+        return json.loads(self.request(proto.OP_STATS).decode())
+
+    def shutdown(self) -> None:
+        self.request(proto.OP_SHUTDOWN)
+
+
+def encode_lanes(
+    keys: Sequence, signatures: Sequence[bytes], digests: Sequence[bytes]
+) -> bytes:
+    """Provider lanes -> wire payload, deduplicating repeated key
+    objects (the MSP cache reuses them) into the frame's key table.  A
+    key that cannot serialize maps to NO_KEY — the server verifies that
+    lane False, same as the in-process parse path."""
+    from fabric_tpu.common import p256
+
+    table: List[bytes] = []
+    index_of: Dict[int, int] = {}
+    lanes: List[Tuple[int, bytes, bytes]] = []
+    for key, sig, digest in zip(keys, signatures, digests, strict=True):
+        idx = proto.NO_KEY
+        if key is not None:
+            idx = index_of.get(id(key), -1)
+            if idx < 0:
+                try:
+                    raw = p256.pubkey_to_bytes(key.point)
+                except Exception as exc:  # noqa: BLE001 - bad key: dead lane
+                    logger.debug("unserializable key (%s); lane fails", exc)
+                    raw = None
+                if raw is None:
+                    idx = proto.NO_KEY
+                else:
+                    idx = len(table)
+                    table.append(raw)
+                    index_of[id(key)] = idx
+        lanes.append((idx, bytes(sig), bytes(digest)))
+    return proto.encode_verify_request(table, lanes)
+
+
+class SidecarProvider:
+    """BCCSP rung routing batch verification through a resident sidecar,
+    degrading to the in-process SW provider when the sidecar cannot
+    serve.  Single verify/sign/hash/key ops run in-process always — the
+    sidecar exists for the batch plane, and interactive single calls
+    must not inherit its failure modes."""
+
+    def __init__(
+        self,
+        address: Optional[str] = None,
+        fallback=None,
+        busy_policy: RetryPolicy = BUSY_POLICY,
+        sleeper: Callable[[float], None] = time.sleep,
+    ):
+        address = address or os.environ.get("FABRIC_TPU_SERVE_ADDR", "")
+        if not address:
+            raise ValueError(
+                "sidecar address required (FABRIC_TPU_SERVE_ADDR or "
+                "BCCSP.SERVE.Address)"
+            )
+        self.client = SidecarClient(address)
+        self.busy_policy = busy_policy
+        self._sleeper = sleeper
+        self._fallback = fallback
+        self._fallback_lock = threading.Lock()
+        self.degraded = False  # latched: any request served in-process
+        self.busy_rejects = 0  # admission rejections observed
+
+    # -- in-process fallback ----------------------------------------------
+    def fallback_provider(self):
+        with self._fallback_lock:
+            if self._fallback is None:
+                # the device-probe ladder, not a hardcoded SW rung: an
+                # accelerator-attached node whose sidecar dies (or whose
+                # FABRIC_TPU_SERVE_ADDR went stale) keeps its device
+                from fabric_tpu.crypto.bccsp import probe_provider
+
+                self._fallback = probe_provider()
+            return self._fallback
+
+    def _degrade(self, keys, signatures, digests, why) -> List[bool]:
+        """In-process verification when the sidecar cannot serve.  The
+        mask stays bit-exact (same ladder semantics); only if the local
+        path ALSO fails is the batch failed closed as all-False."""
+        if not self.degraded:
+            logger.warning(
+                "sidecar %s unavailable (%s); degrading to in-process "
+                "verification", self.client.address, why,
+            )
+        self.degraded = True
+        try:
+            mask = self.fallback_provider().batch_verify(
+                keys, signatures, digests
+            )
+            return list(mask)
+        except Exception as exc:  # noqa: BLE001 - double fault: fail closed
+            logger.error(
+                "in-process fallback failed too (%s): batch fails closed",
+                exc,
+            )
+            return [False] * len(keys)
+
+    # -- the remote verify loop -------------------------------------------
+    def _verify_once(self, payload: bytes) -> Tuple[int, int, Optional[List[bool]], str]:
+        return proto.decode_verify_response(
+            self.client.request(proto.OP_VERIFY, payload)
+        )
+
+    def batch_verify(
+        self, keys, signatures, digests
+    ) -> List[bool]:
+        n = len(keys)
+        if n == 0:
+            return []
+        try:
+            payload = encode_lanes(keys, signatures, digests)
+        except proto.ProtocolError as exc:
+            return self._degrade(keys, signatures, digests, exc)
+        bo = Backoff(self.busy_policy, sleeper=self._sleeper)
+        while True:
+            try:
+                status, retry_ms, mask, message = self._verify_once(payload)
+            except (SidecarUnavailable, proto.ProtocolError) as exc:
+                # a reply body that decodes to garbage (version skew,
+                # truncation) is as unusable as a dead socket: degrade,
+                # never let the exception escape past the mask contract
+                return self._degrade(keys, signatures, digests, exc)
+            if status == proto.ST_OK:
+                if mask is None or len(mask) != n:
+                    # a length-skewed mask is a protocol violation; never
+                    # stretch or truncate verdicts to fit
+                    return self._degrade(
+                        keys, signatures, digests,
+                        f"mask length {0 if mask is None else len(mask)} != {n}",
+                    )
+                return mask
+            if status == proto.ST_BUSY:
+                self.busy_rejects += 1  # fabdep: disable=unguarded-shared-write  # GIL-atomic add, stats only
+                delay = bo.next_delay()
+                if delay is None:
+                    return self._degrade(
+                        keys, signatures, digests, "admission budget spent"
+                    )
+                bo.sleep()
+                # honor the sidecar's patience hint, but clamp it to our
+                # own policy cap: retry_after_ms is a u32 off the wire and
+                # must never buy a server-controlled unbounded sleep
+                hint_s = min(retry_ms / 1000.0, self.busy_policy.cap_s)
+                if hint_s > delay:
+                    self._sleeper(hint_s - delay)
+                continue
+            if status == proto.ST_ERROR:
+                # transient per-request failure (injected fault, launch
+                # error): bounded retry like BUSY, then degrade
+                if bo.sleep():
+                    continue
+                return self._degrade(keys, signatures, digests, message)
+            # ST_STOPPING or unknown status: the sidecar is going away
+            return self._degrade(
+                keys, signatures, digests, message or f"status {status}"
+            )
+
+    def batch_verify_async(self, keys, signatures, digests):
+        """Pipelined dispatch: the request frame goes out NOW; the
+        resolver demuxes the reply later (stage-A/B overlap through the
+        socket).  Any failure at either end resolves through the same
+        degrade ladder as the sync path."""
+        n = len(keys)
+        if n == 0:
+            return list
+        try:
+            payload = encode_lanes(keys, signatures, digests)
+            token = self.client.submit(proto.OP_VERIFY, payload)
+        except (proto.ProtocolError, SidecarUnavailable) as exc:
+            why = exc
+
+            def degraded_resolve() -> List[bool]:
+                return self._degrade(keys, signatures, digests, why)
+
+            return degraded_resolve
+
+        def resolve() -> List[bool]:
+            try:
+                status, _, mask, _ = proto.decode_verify_response(
+                    self.client.await_reply(token)
+                )
+            except (SidecarUnavailable, proto.ProtocolError) as exc:
+                return self._degrade(keys, signatures, digests, exc)
+            if status == proto.ST_OK and mask is not None and len(mask) == n:
+                return mask
+            # BUSY/ERROR/STOPPING at resolve time: fall into the sync
+            # path, which owns the retry/degrade ladder
+            return self.batch_verify(keys, signatures, digests)
+
+        return resolve
+
+    # -- pass-through SPI --------------------------------------------------
+    def verify(self, key, signature: bytes, digest: bytes) -> bool:
+        return self.fallback_provider().verify(key, signature, digest)
+
+    def batch_hash(self, msgs):
+        return self.fallback_provider().batch_hash(msgs)
+
+    def hash(self, msg: bytes) -> bytes:
+        return self.fallback_provider().hash(msg)
+
+    def key_import(self, raw: bytes):
+        return self.fallback_provider().key_import(raw)
+
+    def key_gen(self):
+        return self.fallback_provider().key_gen()
+
+    def sign(self, key, digest: bytes) -> bytes:
+        return self.fallback_provider().sign(key, digest)
+
+    def describe_backend(self) -> str:
+        if self.degraded:
+            return (
+                f"serve-degraded({self.fallback_provider().describe_backend()})"
+            )
+        return f"serve:{self.client.address}"
+
+    def stop(self) -> None:
+        self.client.close()
+
+
+def _provider_from_config(cfg: dict):
+    """BCCSP factory hook: Default: SERVE -> SidecarProvider.  The SW
+    sub-config's tier pins were already applied by the factory, so the
+    in-process fallback rides the operator's chosen ladder."""
+    serve_cfg = (cfg or {}).get("SERVE") or {}
+    return SidecarProvider(address=serve_cfg.get("Address"))
+
+
+# Dependency inversion keeps the layer map acyclic: serve (layer 6) may
+# import crypto (layer 2), so the RUNG registers itself with the factory
+# instead of the factory importing upward.
+from fabric_tpu.crypto import factory as _factory  # noqa: E402
+
+_factory.register_provider_factory("SERVE", _provider_from_config)

@@ -1,0 +1,250 @@
+"""Wire protocol of the resident validation sidecar.
+
+Length-prefixed binary frames over a local stream socket (AF_UNIX path
+or 127.0.0.1 TCP) — the software analogue of the whole-block offload
+link in Blockchain Machine (PAPERS.md 2104.06968: the peer streams its
+validation workload to an attached verifier over a fixed framing).
+
+Frame layout (big-endian)::
+
+    magic   2s   b"FT"
+    version u8   PROTOCOL_VERSION
+    opcode  u8   OP_*
+    req_id  u32  caller-chosen; echoed verbatim on the response
+    length  u32  payload byte count (bounded by MAX_PAYLOAD)
+    payload length bytes
+
+A VERIFY request payload is a key-deduplicated lane table::
+
+    u16 n_keys, then per key:  u16 klen + klen bytes (SEC1 point)
+    u32 n_lanes, then per lane: u16 key_idx | u16 siglen + sig
+                                | u8 diglen + digest
+
+``key_idx == NO_KEY`` marks a lane with no usable key — the server MUST
+verify it as False (fail-closed), never error the whole batch.
+
+A VERIFY response payload::
+
+    u8  status    ST_OK | ST_BUSY | ST_ERROR | ST_STOPPING
+    u32 retry_after_ms   (admission control; meaningful for ST_BUSY)
+    u32 n         (ST_OK: lane count, mask bytes follow; else message)
+    n bytes       0/1 verdict per lane, or a UTF-8 message
+
+Admission-control contract: ST_BUSY is a *rejection*, not an error —
+the sidecar's lane budget is full and the client should retry after
+``retry_after_ms`` (``common.retry`` paces the client side).  ST_ERROR
+and ST_STOPPING are terminal for the request; the client shim degrades
+to in-process verification (masks stay correct, never guessed VALID).
+
+Every decode path raises :class:`ProtocolError` on malformed input —
+a corrupt frame must kill the one request, not wedge the stream.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import List, Optional, Sequence, Tuple
+
+PROTOCOL_VERSION = 1
+MAGIC = b"FT"
+
+# opcodes
+OP_PING = 1
+OP_VERIFY = 2
+OP_STATS = 3
+OP_SHUTDOWN = 4
+
+# response statuses
+ST_OK = 0
+ST_BUSY = 1
+ST_ERROR = 2
+ST_STOPPING = 3
+
+#: lane marker: no usable public key — the lane verifies False
+NO_KEY = 0xFFFF
+
+#: hard bound on one frame's payload; an oversized frame is a protocol
+#: violation (fail-closed: reject, never buffer unbounded attacker data)
+MAX_PAYLOAD = 64 << 20
+
+_HEADER = struct.Struct(">2sBBII")
+HEADER_SIZE = _HEADER.size
+
+
+class ProtocolError(Exception):
+    """Malformed frame or payload (bad magic, truncation, bounds)."""
+
+
+def parse_address(address: str) -> Tuple[int, object]:
+    """(family, bind/dial target): a path (contains '/') is AF_UNIX,
+    else 'host:port' TCP on localhost.  Wire-level address format,
+    shared by both ends (the client must not import the server)."""
+    import socket
+
+    if "/" in address:
+        return socket.AF_UNIX, address
+    host, _, port = address.rpartition(":")
+    if not host:
+        raise ValueError(f"address {address!r} is neither a path nor host:port")
+    return socket.AF_INET, (host, int(port))
+
+
+def pack_frame(opcode: int, req_id: int, payload: bytes) -> bytes:
+    if len(payload) > MAX_PAYLOAD:
+        raise ProtocolError(
+            f"payload {len(payload)} exceeds MAX_PAYLOAD {MAX_PAYLOAD}"
+        )
+    return _HEADER.pack(
+        MAGIC, PROTOCOL_VERSION, opcode, req_id & 0xFFFFFFFF, len(payload)
+    ) + payload
+
+
+def _recv_exact(sock, n: int) -> Optional[bytes]:
+    """n bytes off the socket; None on clean EOF at a frame boundary,
+    ProtocolError on EOF mid-frame."""
+    chunks: List[bytes] = []
+    got = 0
+    while got < n:
+        chunk = sock.recv(min(n - got, 1 << 20))
+        if not chunk:
+            if got == 0:
+                return None
+            raise ProtocolError(f"connection closed mid-frame ({got}/{n}B)")
+        chunks.append(chunk)
+        got += len(chunk)
+    return b"".join(chunks)
+
+
+def recv_frame(sock) -> Optional[Tuple[int, int, bytes]]:
+    """(opcode, req_id, payload), or None on clean EOF."""
+    head = _recv_exact(sock, HEADER_SIZE)
+    if head is None:
+        return None
+    magic, version, opcode, req_id, length = _HEADER.unpack(head)
+    if magic != MAGIC:
+        raise ProtocolError(f"bad magic {magic!r}")
+    if version != PROTOCOL_VERSION:
+        raise ProtocolError(f"unsupported protocol version {version}")
+    if length > MAX_PAYLOAD:
+        raise ProtocolError(f"frame length {length} exceeds MAX_PAYLOAD")
+    payload = _recv_exact(sock, length) if length else b""
+    if length and payload is None:
+        raise ProtocolError("connection closed before payload")
+    return opcode, req_id, payload or b""
+
+
+def send_frame(sock, opcode: int, req_id: int, payload: bytes) -> None:
+    sock.sendall(pack_frame(opcode, req_id, payload))
+
+
+# ---------------------------------------------------------------------------
+# VERIFY request: key-deduplicated lane table
+# ---------------------------------------------------------------------------
+
+
+def encode_verify_request(
+    key_table: Sequence[bytes],
+    lanes: Sequence[Tuple[int, bytes, bytes]],
+) -> bytes:
+    """key_table: SEC1 key bytes per distinct key; lanes: (key_idx, sig,
+    digest) with key_idx == NO_KEY for unusable-key lanes."""
+    if len(key_table) >= NO_KEY:
+        raise ProtocolError(f"too many distinct keys ({len(key_table)})")
+    out = [struct.pack(">H", len(key_table))]
+    for k in key_table:
+        if len(k) > 0xFFFF:
+            raise ProtocolError("key too long")
+        out.append(struct.pack(">H", len(k)))
+        out.append(k)
+    out.append(struct.pack(">I", len(lanes)))
+    for key_idx, sig, digest in lanes:
+        if len(sig) > 0xFFFF or len(digest) > 0xFF:
+            raise ProtocolError("lane field too long")
+        out.append(struct.pack(">HH", key_idx, len(sig)))
+        out.append(sig)
+        out.append(struct.pack(">B", len(digest)))
+        out.append(digest)
+    return b"".join(out)
+
+
+class _Reader:
+    __slots__ = ("buf", "off")
+
+    def __init__(self, buf: bytes):
+        self.buf = buf
+        self.off = 0
+
+    def take(self, n: int) -> bytes:
+        end = self.off + n
+        if end > len(self.buf):
+            raise ProtocolError("truncated payload")
+        out = self.buf[self.off : end]
+        self.off = end  # fabdep: disable=unguarded-shared-write  # request-scoped reader, single owner thread
+        return out
+
+    def u8(self) -> int:
+        return self.take(1)[0]
+
+    def u16(self) -> int:
+        return struct.unpack(">H", self.take(2))[0]
+
+    def u32(self) -> int:
+        return struct.unpack(">I", self.take(4))[0]
+
+
+def decode_verify_request(
+    payload: bytes,
+) -> Tuple[List[bytes], List[Tuple[int, bytes, bytes]]]:
+    r = _Reader(payload)
+    n_keys = r.u16()
+    keys = [r.take(r.u16()) for _ in range(n_keys)]
+    n_lanes = r.u32()
+    if n_lanes > MAX_PAYLOAD:  # cheap sanity before the loop allocates
+        raise ProtocolError(f"absurd lane count {n_lanes}")
+    lanes = []
+    for _ in range(n_lanes):
+        key_idx = r.u16()
+        sig = r.take(r.u16())
+        digest = r.take(r.u8())
+        if key_idx != NO_KEY and key_idx >= n_keys:
+            raise ProtocolError(f"lane key index {key_idx} out of range")
+        lanes.append((key_idx, sig, digest))
+    if r.off != len(payload):
+        raise ProtocolError("trailing bytes after lane table")
+    return keys, lanes
+
+
+# ---------------------------------------------------------------------------
+# VERIFY response
+# ---------------------------------------------------------------------------
+
+
+def encode_verify_response(
+    status: int,
+    mask: Optional[Sequence[bool]] = None,
+    message: str = "",
+    retry_after_ms: int = 0,
+) -> bytes:
+    if status == ST_OK:
+        body = bytes(1 if b else 0 for b in (mask or ()))
+    else:
+        body = message.encode("utf-8", "backslashreplace")[:4096]
+    return struct.pack(
+        ">BII", status, retry_after_ms & 0xFFFFFFFF, len(body)
+    ) + body
+
+
+def decode_verify_response(
+    payload: bytes,
+) -> Tuple[int, int, Optional[List[bool]], str]:
+    """(status, retry_after_ms, mask-or-None, message)."""
+    r = _Reader(payload)
+    status = r.u8()
+    retry_after_ms = r.u32()
+    n = r.u32()
+    body = r.take(n)
+    if r.off != len(payload):
+        raise ProtocolError("trailing bytes after response body")
+    if status == ST_OK:
+        return status, retry_after_ms, [b != 0 for b in body], ""
+    return status, retry_after_ms, None, body.decode("utf-8", "replace")

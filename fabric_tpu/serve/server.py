@@ -1,0 +1,662 @@
+"""The resident validation sidecar process.
+
+One long-lived process owns the verify backends (host EC ladder or the
+device provider), pre-warms the bucketed program registry at startup,
+and serves whole-batch validation requests over a local socket — the
+software analogue of 2104.06968's attached hardware validator, serving
+1907.08367's reordered validation stages from a warm process.  What a
+cold bench run pays per invocation (backend init, pool spin-up, minutes
+of XLA compile), the sidecar pays once per process lifetime.
+
+Request flow per VERIFY frame::
+
+    decode -> serve.dispatch fault seam -> ADMISSION (VerifyBatcher
+    bounded lanes, non-blocking) -> coalesced launch -> mask reply
+
+Admission control is the VerifyBatcher's bounded-lane budget surfaced
+as protocol backpressure: a request that does not fit is REJECTED with
+``ST_BUSY`` + ``retry_after_ms`` instead of blocking the socket thread
+— the client shim paces retries with ``common.retry`` and the peer's
+deliver loop stalls exactly like the reference's WaitReady discipline.
+
+Shutdown is fail-closed *and* mask-exact: in-flight requests settled by
+a dying batcher are answered ``ST_STOPPING`` (never an OK carrying
+guessed verdicts), so the client re-verifies in-process and masks stay
+bit-exact through a sidecar kill.
+
+Run it::
+
+    python -m fabric_tpu.serve --address /tmp/fabserve.sock \
+        --engine host --warm demo --aot-dir .jax_cache/serve_aot
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import socket
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from fabric_tpu.common.faults import fault_point
+from fabric_tpu.common.flogging import must_get_logger
+from fabric_tpu.common.metrics import latency_summary
+from fabric_tpu.serve import protocol as proto
+from fabric_tpu.serve.registry import (
+    BucketProgramRegistry,
+    DEFAULT_BUCKETS,
+    demo_limb_program,
+    verify_limb_program,
+)
+
+logger = must_get_logger("serve.server")
+
+ENGINES = ("auto", "host", "device")
+WARM_LADDERS = ("off", "demo", "verify")
+
+
+# wire-level address parsing lives with the protocol (shared by both
+# ends); re-exported here for back-compat with existing importers
+parse_address = proto.parse_address
+
+
+class ServeStats:
+    """Thread-safe request accounting; ``summary()`` is the STATS reply
+    and the ``configs.serve`` bench column."""
+
+    RESERVOIR = 8192
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.requests = 0
+        self.lanes = 0
+        self.rejects = 0
+        self.errors = 0
+        self.degraded_replies = 0
+        # newest-win sliding window: a long-lived sidecar that slows
+        # down later must not keep reporting startup-era p50/p99
+        self._latency_s: collections.deque = collections.deque(
+            maxlen=self.RESERVOIR
+        )
+        self.per_bucket: Dict[int, int] = {}
+
+    def record(self, lanes: int, bucket: int, seconds: float) -> None:
+        with self._lock:
+            self.requests += 1
+            self.lanes += lanes
+            self.per_bucket[bucket] = self.per_bucket.get(bucket, 0) + 1
+            self._latency_s.append(seconds)
+
+    def reject(self) -> None:
+        with self._lock:
+            self.rejects += 1
+
+    def error(self) -> None:
+        with self._lock:
+            self.errors += 1
+
+    def stopping_reply(self) -> None:
+        with self._lock:
+            self.degraded_replies += 1
+
+    def summary(self) -> Dict:
+        with self._lock:
+            return {
+                "requests": self.requests,
+                "lanes": self.lanes,
+                "rejects": self.rejects,
+                "errors": self.errors,
+                "degraded_replies": self.degraded_replies,
+                "per_bucket": {str(k): v for k, v in self.per_bucket.items()},
+                "request_latency": latency_summary(list(self._latency_s)),
+            }
+
+
+def build_provider(engine: str = "auto"):
+    """The sidecar's verify backend.  'host' is the SW EC ladder
+    (fastec -> hostec_np -> hostec); 'device' is the accelerator
+    provider; 'auto' defers to the shared bounded probe ladder
+    (``bccsp.probe_provider`` — one copy of the probe/degrade policy,
+    not a local fork that could drift)."""
+    from fabric_tpu.crypto.bccsp import SoftwareProvider
+
+    if engine not in ENGINES:
+        raise ValueError(f"unknown engine {engine!r} (expected {ENGINES})")
+    if engine == "auto":
+        from fabric_tpu.crypto.bccsp import probe_provider
+
+        provider = probe_provider()
+        return provider, (
+            "host" if isinstance(provider, SoftwareProvider) else "device"
+        )
+    if engine == "device":
+        from fabric_tpu.crypto.tpu_provider import TPUProvider
+
+        return TPUProvider(), "device"
+    return SoftwareProvider(), "host"
+
+
+class SidecarServer:
+    """Resident sidecar: socket front, VerifyBatcher middle, warm
+    bucketed backends behind.  Usable in-process (tests, fabchaos
+    serve_flap) or as the ``python -m fabric_tpu.serve`` daemon."""
+
+    def __init__(
+        self,
+        address: str,
+        engine: str = "auto",
+        provider=None,
+        buckets: Sequence[int] = DEFAULT_BUCKETS,
+        max_pending_lanes: int = 65536,
+        linger_s: float = 0.002,
+        warm_ladder: str = "off",
+        aot_dir: Optional[str] = None,
+        retry_after_base_ms: int = 25,
+    ):
+        from fabric_tpu.parallel.batcher import VerifyBatcher
+
+        if warm_ladder not in WARM_LADDERS:
+            raise ValueError(
+                f"unknown warm ladder {warm_ladder!r} (expected {WARM_LADDERS})"
+            )
+        self.address = address
+        self.buckets = tuple(buckets)
+        if provider is not None:
+            self.provider, self.engine = provider, engine
+        else:
+            self.provider, self.engine = build_provider(engine)
+        self.batcher = VerifyBatcher(
+            self.provider,
+            max_pending_lanes=max_pending_lanes,
+            linger_s=linger_s,
+        )
+        self.max_pending_lanes = max_pending_lanes
+        self.retry_after_base_ms = retry_after_base_ms
+        self.stats = ServeStats()
+        self.registry: Optional[BucketProgramRegistry] = None
+        self.warm_ladder = warm_ladder
+        self.aot_dir = aot_dir
+        self.warm_report: Dict = {}
+        self._listener: Optional[socket.socket] = None
+        self._threads: List[threading.Thread] = []
+        self._conns: List[socket.socket] = []
+        self._conn_lock = threading.Lock()
+        self._stopping = False
+        self._started = False
+
+    # -- warm-up -----------------------------------------------------------
+    def warm(self) -> Dict:
+        """Pre-warm before accepting traffic: spin the host pools with
+        one small batch, and AOT-warm the jax bucket ladder when asked.
+        Returns the warm report (bench's ``configs.serve.warm``)."""
+        t0 = time.perf_counter()
+        report: Dict = {"engine": self.engine, "ladder": self.warm_ladder}
+        report["host_warm_ms"] = round(self._warm_host() * 1000.0, 1)
+        if self.warm_ladder != "off":
+            fn, shapes_for = (
+                demo_limb_program()
+                if self.warm_ladder == "demo"
+                else verify_limb_program()
+            )
+            self.registry = BucketProgramRegistry.for_jax_program(
+                fn,
+                shapes_for,
+                buckets=self.buckets,
+                label=f"serve-{self.warm_ladder}",
+                aot_dir=self.aot_dir,
+            )
+            self.registry.warm()
+            report["per_bucket"] = {
+                str(k): v for k, v in self.registry.warm_report.items()
+            }
+            report["traces"] = self.registry.traces
+        report["total_warm_ms"] = round((time.perf_counter() - t0) * 1000.0, 1)
+        self.warm_report = report
+        return report
+
+    def _warm_host(self) -> float:
+        """One tiny batch through the provider so pool spin-up and key
+        tables are paid before the first real request."""
+        from fabric_tpu.crypto.bccsp import ECDSAPublicKey, ec_backend
+
+        t0 = time.perf_counter()
+        ec = ec_backend()
+        kp = ec.generate_keypair()
+        import hashlib as _hashlib
+
+        from fabric_tpu.common import der as _der
+
+        digest = _hashlib.sha256(b"serve warm lane").digest()
+        r, s = ec.sign_digest(kp.priv, digest)
+        sig = _der.marshal_signature(r, s)
+        key = ECDSAPublicKey(*kp.pub)
+        n = 8
+        mask = self.batcher.verify_batch([key] * n, [sig] * n, [digest] * n)
+        if list(mask) != [True] * n:
+            raise RuntimeError("warm-up batch failed verification")
+        return time.perf_counter() - t0
+
+    # -- socket front ------------------------------------------------------
+    def start(self) -> str:
+        """Bind + accept loop; returns the bound address (TCP port
+        resolved).  ``warm()`` is NOT implied — call it first so the
+        READY line means 'steady state will not compile'."""
+        family, target = parse_address(self.address)
+        listener = socket.socket(family, socket.SOCK_STREAM)
+        if family == socket.AF_UNIX:
+            try:
+                os.unlink(target)
+            except FileNotFoundError:
+                pass
+        else:
+            listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        listener.bind(target)
+        listener.listen(64)
+        if family != socket.AF_UNIX:
+            host, port = listener.getsockname()[:2]
+            self.address = f"{host}:{port}"
+        self._listener = listener
+        self._started = True
+        accept = threading.Thread(
+            target=self._accept_loop, name="serve-accept", daemon=True
+        )
+        accept.start()
+        with self._conn_lock:
+            self._threads.append(accept)
+        logger.info("sidecar serving on %s (engine %s)", self.address, self.engine)
+        return self.address
+
+    def _accept_loop(self) -> None:
+        while not self._stopping:
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return  # listener closed by stop()
+            t = threading.Thread(
+                target=self._serve_conn, args=(conn,),
+                name="serve-conn", daemon=True,
+            )
+            with self._conn_lock:
+                if self._stopping:
+                    conn.close()
+                    return
+                self._conns.append(conn)
+                # register BEFORE start: a connection that EOFs
+                # instantly would otherwise run its own cleanup-remove
+                # before the append, leaking a dead Thread object in
+                # the resident process forever
+                self._threads.append(t)
+            t.start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            self._serve_conn_inner(conn)
+        finally:
+            # a resident process accumulates reconnecting clients for
+            # its whole lifetime: drop this connection's bookkeeping as
+            # it closes or _conns/_threads grow without bound
+            try:
+                conn.close()
+            except OSError:
+                pass
+            with self._conn_lock:
+                try:
+                    self._conns.remove(conn)
+                except ValueError:
+                    pass  # stop() already claimed it
+                try:
+                    self._threads.remove(threading.current_thread())
+                except ValueError:
+                    pass
+
+    def _serve_conn_inner(self, conn: socket.socket) -> None:
+        # one writer lock per connection: verify requests settle on
+        # worker threads (the read loop keeps draining frames so a
+        # client's pipelined requests coalesce in the batcher instead of
+        # serializing behind each other), and interleaved sendall calls
+        # on a stream socket would corrupt frames
+        send_lock = threading.Lock()
+        workers: List[threading.Thread] = []
+        try:
+            while True:
+                frame = proto.recv_frame(conn)
+                if frame is None:
+                    return
+                opcode, req_id, payload = frame
+                if opcode == proto.OP_PING:
+                    self._send(
+                        conn, proto.OP_PING, req_id,
+                        proto.encode_verify_response(proto.ST_OK, mask=[]),
+                        send_lock,
+                    )
+                elif opcode == proto.OP_STATS:
+                    self._send(
+                        conn, proto.OP_STATS, req_id,
+                        json.dumps(self.describe()).encode(), send_lock,
+                    )
+                elif opcode == proto.OP_SHUTDOWN:
+                    self._send(
+                        conn, proto.OP_SHUTDOWN, req_id,
+                        proto.encode_verify_response(proto.ST_OK, mask=[]),
+                        send_lock,
+                    )
+                    threading.Thread(
+                        target=self.stop, name="serve-shutdown", daemon=True
+                    ).start()
+                    return
+                elif opcode == proto.OP_VERIFY:
+                    # concurrency is bounded by the batcher's admission
+                    # control: a request only occupies its worker past
+                    # decode if try_submit admitted its lanes
+                    w = threading.Thread(
+                        target=self._handle_verify,
+                        args=(conn, req_id, payload, send_lock),
+                        name="serve-verify", daemon=True,
+                    )
+                    w.start()
+                    workers.append(w)
+                    workers = [t for t in workers if t.is_alive()]
+                else:
+                    self._send(
+                        conn, opcode, req_id,
+                        proto.encode_verify_response(
+                            proto.ST_ERROR,
+                            message=f"unknown opcode {opcode}",
+                        ),
+                        send_lock,
+                    )
+        except proto.ProtocolError as exc:
+            # a desynced STREAM is unusable (bad magic/oversized frame —
+            # recv_frame cannot resync): answer if possible, close.
+            # Payload-level decode failures never reach here; they are
+            # answered ST_ERROR per request in _handle_verify.
+            logger.warning("protocol error on %s: %s", self.address, exc)
+            self._try_reply_error(conn, 0, exc, send_lock)
+        except OSError:
+            pass  # peer went away; nothing to answer
+        finally:
+            for w in workers:
+                w.join(timeout=2.0)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    # -- the verify path ---------------------------------------------------
+    def _handle_verify(
+        self, conn, req_id: int, payload: bytes, send_lock=None
+    ) -> None:
+        """Decode, admit, launch, reply (on a per-request worker thread;
+        replies may interleave out of order — the client demuxes by
+        request id).  Every failure path answers the client with a
+        non-OK status (the client's degrade path owns the mask then) —
+        this function must never reply OK with verdicts it did not
+        compute."""
+        t0 = time.perf_counter()
+        try:
+            # chaos seam: an injected dispatch fault fails THIS request
+            # with ST_ERROR before any batcher state is touched
+            fault_point("serve.dispatch")
+            keys, sigs, digests = self._decode_lanes(payload)
+            if self._stopping:
+                self.stats.stopping_reply()
+                self._reply_status(conn, req_id, proto.ST_STOPPING, send_lock=send_lock)
+                return
+            resolver = self.batcher.try_submit(keys, sigs, digests)
+            if resolver is None:
+                self.stats.reject()
+                self._reply_status(
+                    conn, req_id, proto.ST_BUSY,
+                    retry_after_ms=self.retry_after_ms(),
+                    send_lock=send_lock,
+                )
+                return
+            mask = resolver()
+            if self._stopping:
+                # the batcher may have settled this request fail-closed
+                # during shutdown; an OK here could carry guessed
+                # verdicts — tell the client to re-verify in-process
+                self.stats.stopping_reply()
+                self._reply_status(conn, req_id, proto.ST_STOPPING, send_lock=send_lock)
+                return
+            bucket = (
+                self.registry.bucket_for(len(mask))
+                if self.registry is not None
+                else len(mask)
+            )
+            # record BEFORE the reply frame: any client that has seen
+            # the OK must also see it in STATS (the chaos scorecard's
+            # served_after_restart reads stats right after a reply —
+            # recording after send made that a same-seed determinism
+            # race).  The local-socket send itself is excluded from the
+            # latency sample; it is microseconds against lane math.
+            self.stats.record(len(mask), bucket, time.perf_counter() - t0)
+            self._send(
+                conn, proto.OP_VERIFY, req_id,
+                proto.encode_verify_response(proto.ST_OK, mask=mask),
+                send_lock,
+            )
+        except Exception as exc:  # noqa: BLE001 - per-request fail-closed
+            # includes a payload-level ProtocolError: recv_frame already
+            # consumed the whole length-prefixed frame, so the stream is
+            # still in sync — a malformed payload fails THIS request
+            # with ST_ERROR, never the connection's other requests
+            logger.warning("verify request failed (%s); replying ST_ERROR", exc)
+            self.stats.error()
+            self._try_reply_error(conn, req_id, exc, send_lock)
+
+    def _decode_lanes(self, payload: bytes):
+        """Wire lanes -> provider lanes.  A key that fails SEC1 import
+        becomes None — the EC ladder verifies such lanes False, exactly
+        like the in-process parse path (fail-closed, never an error that
+        would take down the batch's good lanes)."""
+        from fabric_tpu.common import p256
+        from fabric_tpu.crypto.bccsp import ECDSAPublicKey
+
+        key_bytes, lanes = proto.decode_verify_request(payload)
+        key_objs: List[Optional[ECDSAPublicKey]] = []
+        for raw in key_bytes:
+            try:
+                x, y = p256.pubkey_from_bytes(raw)
+                key_objs.append(ECDSAPublicKey(x, y))
+            except Exception as exc:  # noqa: BLE001 - bad key: dead lane below
+                logger.debug("unusable key in verify request (%s)", exc)
+                key_objs.append(None)
+        keys = [
+            key_objs[idx] if idx != proto.NO_KEY else None
+            for idx, _, _ in lanes
+        ]
+        sigs = [sig for _, sig, _ in lanes]
+        digests = [d for _, _, d in lanes]
+        return keys, sigs, digests
+
+    def retry_after_ms(self) -> int:
+        """Admission-control hint: scale the base backoff by queue
+        fill so a saturated sidecar pushes clients further away."""
+        fill = self.batcher.pending_lanes / max(self.max_pending_lanes, 1)
+        return max(5, int(self.retry_after_base_ms * (1.0 + 3.0 * fill)))
+
+    @staticmethod
+    def _send(conn, opcode: int, req_id: int, payload: bytes, send_lock=None):
+        """One frame out, serialized under the connection's writer lock
+        when given (worker threads reply concurrently; interleaved
+        sendall calls would corrupt the stream)."""
+        if send_lock is not None:
+            with send_lock:
+                proto.send_frame(conn, opcode, req_id, payload)
+        else:
+            proto.send_frame(conn, opcode, req_id, payload)
+
+    def _reply_status(
+        self, conn, req_id: int, status: int, retry_after_ms: int = 0,
+        send_lock=None,
+    ) -> None:
+        reply = proto.encode_verify_response(
+            status, message="", retry_after_ms=retry_after_ms
+        )
+        try:
+            self._send(conn, proto.OP_VERIFY, req_id, reply, send_lock)
+        except OSError as exc:
+            logger.warning("reply failed (%s); client will degrade", exc)
+
+    def _try_reply_error(
+        self, conn, req_id: int, exc: BaseException, send_lock=None
+    ) -> None:
+        reply = proto.encode_verify_response(
+            proto.ST_ERROR, message=f"{type(exc).__name__}: {exc}"
+        )
+        try:
+            self._send(conn, proto.OP_VERIFY, req_id, reply, send_lock)
+        except OSError as send_exc:
+            logger.warning(
+                "error reply failed (%s) after %s; client will degrade",
+                send_exc, exc,
+            )
+
+    # -- introspection -----------------------------------------------------
+    def describe(self) -> Dict:
+        out = {
+            "address": self.address,
+            "engine": self.engine,
+            "buckets": list(self.buckets),
+            "max_pending_lanes": self.max_pending_lanes,
+            "pending_lanes": self.batcher.pending_lanes,
+            "launches": self.batcher.launches,
+            "batched_lanes": self.batcher.lanes,
+            "warm": self.warm_report,
+            "stats": self.stats.summary(),
+            "stopping": self._stopping,
+        }
+        if self.registry is not None:
+            out["registry"] = self.registry.stats()
+        return out
+
+    # -- shutdown ----------------------------------------------------------
+    def stop(self) -> None:
+        """Idempotent: refuse new work, settle the batcher (fail-closed),
+        close the socket front.  In-flight verify handlers observe
+        ``_stopping`` and answer ST_STOPPING, never guessed verdicts."""
+        with self._conn_lock:
+            if self._stopping:
+                return
+            self._stopping = True
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        self.batcher.stop()
+        with self._conn_lock:
+            conns = list(self._conns)
+            self._conns.clear()
+        for conn in conns:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+        with self._conn_lock:
+            threads = list(self._threads)
+        for t in threads:
+            if t is not threading.current_thread():
+                try:
+                    t.join(timeout=2.0)
+                except RuntimeError:
+                    pass  # registered but not yet started (append-before-start window)
+        family, target = parse_address(self.address)
+        if family == socket.AF_UNIX and self._started:
+            try:
+                os.unlink(target)
+            except OSError:
+                pass
+        logger.info("sidecar on %s stopped", self.address)
+
+
+# ---------------------------------------------------------------------------
+# CLI entrypoint: python -m fabric_tpu.serve
+# ---------------------------------------------------------------------------
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    import argparse
+    import signal
+
+    ap = argparse.ArgumentParser(
+        prog="fabric_tpu.serve",
+        description="resident validation sidecar: warm bucketed "
+        "executables + admission-controlled batch verify serving",
+    )
+    ap.add_argument(
+        "--address",
+        default=os.environ.get("FABRIC_TPU_SERVE_ADDR", "/tmp/fabserve.sock"),
+        help="unix socket path (contains '/') or host:port",
+    )
+    ap.add_argument("--engine", default="auto", choices=ENGINES)
+    ap.add_argument(
+        "--buckets",
+        default="",
+        help="comma-separated lane bucket ladder (default: "
+        + ",".join(str(b) for b in DEFAULT_BUCKETS) + ")",
+    )
+    ap.add_argument(
+        "--warm", default="off", choices=WARM_LADDERS,
+        help="jax bucket ladder to pre-warm: 'verify' = the real ECDSA "
+        "limb kernel (minutes cold), 'demo' = the CI-able ops.bignum "
+        "exponentiation ladder, 'off' = host warm-up only",
+    )
+    ap.add_argument(
+        "--aot-dir", default="",
+        help="directory for serialized AOT executables (warm restarts "
+        "skip trace AND compile); empty = persistent compile cache only",
+    )
+    ap.add_argument("--max-pending-lanes", type=int, default=65536)
+    ap.add_argument("--linger-ms", type=float, default=2.0)
+    args = ap.parse_args(argv)
+
+    buckets = (
+        tuple(int(b) for b in args.buckets.split(",") if b.strip())
+        if args.buckets
+        else DEFAULT_BUCKETS
+    )
+    server = SidecarServer(
+        args.address,
+        engine=args.engine,
+        buckets=buckets,
+        max_pending_lanes=args.max_pending_lanes,
+        linger_s=args.linger_ms / 1000.0,
+        warm_ladder=args.warm,
+        aot_dir=args.aot_dir or None,
+    )
+    warm = server.warm()
+    addr = server.start()
+    # the READY line is the contract with scripts/serve_gate.sh and the
+    # warm-restart test: one JSON line, stdout, after warm-up completes
+    print(
+        "SERVE_READY " + json.dumps(
+            {"address": addr, "warm": warm}, sort_keys=True
+        ),
+        flush=True,
+    )
+
+    done = threading.Event()
+
+    def _stop(signum, frame):  # noqa: ARG001 - signal signature
+        done.set()
+
+    signal.signal(signal.SIGTERM, _stop)
+    signal.signal(signal.SIGINT, _stop)
+    try:
+        while not done.is_set() and not server._stopping:
+            done.wait(0.2)
+    finally:
+        server.stop()
+        print(
+            "SERVE_EXIT " + json.dumps(server.stats.summary(), sort_keys=True),
+            flush=True,
+        )
+    return 0

@@ -135,11 +135,15 @@ LANE_FILES = (
 )
 
 #: Files whose exception discipline decides the VALID/INVALID mask.
+#: serve/ joined with the sidecar (PR 8): the client shim's degrade
+#: path RE-DERIVES the mask in-process on sidecar death, so its
+#: handlers are as mask-load-bearing as the validator's own.
 MASK_TIER = (
     "*fabric_tpu/validation/*.py",
     "*fabric_tpu/ledger/txparse.py",
     "*fabric_tpu/parallel/*.py",
     "*fabric_tpu/peer/pipeline.py",
+    "*fabric_tpu/serve/*.py",
 )
 
 #: Hardcoded literal -> the canonical name that should be imported.
@@ -3300,6 +3304,15 @@ def _is_flag_producing(fn: ast.AST, aliases: Dict[str, str]) -> bool:
             node.id == "TxValidationCode"
             or node.id in aliases
             or node.id == "flags"  # the ValidationFlags result threading
+            # boolean verdict masks (the serve plane's currency): a
+            # function that BINDS a mask/verdicts name produces lane
+            # verdicts, so its exception discipline is mask-load-bearing
+            # even though no TxValidationCode appears (the sidecar
+            # client/server trade raw bool masks; flags come later)
+            or (
+                isinstance(node.ctx, ast.Store)
+                and node.id in ("mask", "verdicts", "ok_list")
+            )
         ):
             return True
         if isinstance(node, ast.Attribute) and node.attr == "set_flag":

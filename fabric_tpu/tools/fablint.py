@@ -18,7 +18,7 @@ module-import    module-scope import of a heavy/optional third-party
                  ``pytest --collect-only`` in minimal environments.
 broad-except     bare ``except:`` anywhere, or ``except Exception`` in
                  the mask-critical paths (crypto/, validation/, ledger/,
-                 ops/, msp/, policy/, idemix/, parallel/) whose handler
+                 ops/, msp/, policy/, idemix/, parallel/, serve/) whose handler
                  neither re-raises nor logs: a silently swallowed
                  exception in a verify path flips lanes VALID.
 mutable-default  ``def f(x=[])`` — the default is shared across calls.
@@ -130,6 +130,7 @@ MASK_CRITICAL_DIRS = (
     "*fabric_tpu/policy/*",
     "*fabric_tpu/idemix/*",
     "*fabric_tpu/parallel/*",
+    "*fabric_tpu/serve/*",
 )
 
 #: Directories where ``assert`` must not guard validation decisions.
@@ -374,7 +375,7 @@ def _handler_handles(handler: ast.ExceptHandler) -> bool:
 @rule(
     "broad-except",
     "bare 'except:' anywhere, or 'except Exception' in mask-critical paths "
-    "(crypto/, validation/, ledger/, ops/, msp/, policy/, idemix/, "
+    "(crypto/, validation/, ledger/, ops/, msp/, policy/, idemix/, serve/, "
     "parallel/) that neither re-raises nor logs",
 )
 def check_broad_except(tree: ast.Module, source: str, ctx: FileContext) -> List[Finding]:

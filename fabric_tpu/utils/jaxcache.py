@@ -40,12 +40,27 @@ def pin_cpu_mesh(n_devices: int = 8) -> None:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    if "xla_force_host_platform_device_count" not in os.environ.get(
+    if "xla_force_host_platform_device_count" in os.environ.get(
         "XLA_FLAGS", ""
     ):
+        return
+    if hasattr(jax.config, "jax_num_cpu_devices"):
         try:
             jax.config.update("jax_num_cpu_devices", n_devices)
         except Exception:
-            # Backend already initialised (e.g. called twice in-process):
-            # callers assert on the resulting device count.
+            # Backend already initialised (called twice in-process):
+            # an in-process no-op by design — callers assert on the
+            # resulting device count.  Do NOT fall through to the env
+            # route: mutating XLA_FLAGS here would leak a forced device
+            # count into every later-spawned subprocess.
             pass
+        return
+    # This jax predates the dynamic key (0.4.37 has no
+    # jax_num_cpu_devices — the bench n_devices sweep found the silent
+    # no-op).  XLA_FLAGS is still honored because no backend exists
+    # until the first jax use; if one already exists this is a no-op
+    # and the caller's device-count assertion reports it.
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={n_devices}"
+    ).strip()

@@ -14,6 +14,9 @@
 #   6. chaos_gate.sh           -- seeded fabchaos smoke, run twice: mask
 #                                 bit-exact + fail-closed under injected
 #                                 faults, scorecards byte-identical
+#   7. serve_gate.sh           -- resident sidecar smoke: subprocess
+#                                 server, mixed batch through the client
+#                                 shim bit-exact, clean SHUTDOWN
 #
 # Each stage runs even if an earlier one failed (one run reports ALL
 # broken gates) and prints its wall-clock time; the exit code is nonzero
@@ -39,17 +42,18 @@ run_stage() {
     echo "-- ${label}: $((SECONDS - t0))s"
 }
 
-run_stage "1/6 compileall" timeout -k 5 120 python -m compileall -q fabric_tpu
-run_stage "2/6 collect_gate" bash scripts/collect_gate.sh
+run_stage "1/7 compileall" timeout -k 5 120 python -m compileall -q fabric_tpu
+run_stage "2/7 collect_gate" bash scripts/collect_gate.sh
 # the linters' human output already prints findings as
 # path:line:col: rule: message — no JSON round-trip needed
-run_stage "3/6 fablint" timeout -k 5 60 python -m fabric_tpu.tools.fablint fabric_tpu/
-run_stage "4/6 fabdep" timeout -k 5 60 python -m fabric_tpu.tools.fabdep fabric_tpu/
-run_stage "5/6 fabflow" timeout -k 5 120 python -m fabric_tpu.tools.fabflow fabric_tpu/
-run_stage "6/6 chaos_gate" bash scripts/chaos_gate.sh
+run_stage "3/7 fablint" timeout -k 5 60 python -m fabric_tpu.tools.fablint fabric_tpu/
+run_stage "4/7 fabdep" timeout -k 5 60 python -m fabric_tpu.tools.fabdep fabric_tpu/
+run_stage "5/7 fabflow" timeout -k 5 120 python -m fabric_tpu.tools.fabflow fabric_tpu/
+run_stage "6/7 chaos_gate" bash scripts/chaos_gate.sh
+run_stage "7/7 serve_gate" bash scripts/serve_gate.sh
 
 if [ "$fail" -ne 0 ]; then
     echo "ci_gate: FAIL (stages:${failed_stages})" >&2
     exit 1
 fi
-echo "ci_gate: OK (compileall + collect + fablint + fabdep + fabflow + chaos)"
+echo "ci_gate: OK (compileall + collect + fablint + fabdep + fabflow + chaos + serve)"

@@ -1,0 +1,95 @@
+#!/usr/bin/env bash
+# serve_gate: the resident-sidecar smoke (< 60s, jax-free).
+#
+# Starts the sidecar as a REAL subprocess (host engine), waits for its
+# SERVE_READY line, drives one mixed valid/invalid batch through the
+# SidecarProvider client shim, asserts the mask equals the in-process
+# ground truth bit-exactly, then performs a clean protocol SHUTDOWN and
+# requires the server process to exit 0.
+set -uo pipefail
+
+cd "$(dirname "$0")/.."
+
+SOCK_DIR="$(mktemp -d)"
+SOCK="${SOCK_DIR}/serve_gate.sock"
+LOG="$(mktemp)"
+
+cleanup() {
+    [ -n "${SRV_PID:-}" ] && kill "${SRV_PID}" 2>/dev/null
+    rm -rf "${SOCK_DIR}"
+    rm -f "${LOG}"
+}
+trap cleanup EXIT
+
+timeout -k 5 55 python -m fabric_tpu.serve \
+    --address "${SOCK}" --engine host --warm off >"${LOG}" 2>&1 &
+SRV_PID=$!
+
+# wait for the READY line (warm-up done, socket bound)
+for _ in $(seq 1 100); do
+    grep -q "^SERVE_READY" "${LOG}" 2>/dev/null && break
+    kill -0 "${SRV_PID}" 2>/dev/null || { echo "serve_gate: server died:" >&2; cat "${LOG}" >&2; exit 1; }
+    sleep 0.2
+done
+if ! grep -q "^SERVE_READY" "${LOG}"; then
+    echo "serve_gate: server never became ready:" >&2
+    cat "${LOG}" >&2
+    exit 1
+fi
+
+timeout -k 5 40 python - "${SOCK}" <<'EOF'
+import hashlib
+import sys
+
+from fabric_tpu.common import p256
+from fabric_tpu.crypto import der, hostec
+from fabric_tpu.crypto.bccsp import ECDSAPublicKey, SoftwareProvider
+from fabric_tpu.serve.client import SidecarProvider
+
+addr = sys.argv[1]
+d_priv = 0x1D1E5F
+pub = ECDSAPublicKey(*hostec.scalar_base_mult(d_priv))
+keys, sigs, digests, expected = [], [], [], []
+for i in range(48):
+    digest = hashlib.sha256(b"serve gate lane %d" % i).digest()
+    r, s = hostec.sign_digest(d_priv, digest)
+    sig = der.marshal_signature(r, s)
+    kind = i % 4
+    if kind == 1:  # corrupt signature
+        bad = bytearray(sig); bad[-1] ^= 0x5A; sig = bytes(bad)
+    elif kind == 2:  # high-S violation
+        sig = der.marshal_signature(r, p256.N - s)
+    elif kind == 3:  # garbage DER
+        sig = b"\x00garbage"
+    keys.append(pub); sigs.append(sig); digests.append(digest)
+    expected.append(kind == 0)
+
+provider = SidecarProvider(address=addr)
+mask = provider.batch_verify(keys, sigs, digests)
+assert list(mask) == expected, f"sidecar mask != ground truth: {mask}"
+assert not provider.degraded, "gate batch was served in-process, not by the sidecar"
+inproc = SoftwareProvider().batch_verify(keys, sigs, digests)
+assert list(mask) == list(inproc), "sidecar mask != in-process mask"
+stats = provider.client.stats()
+assert stats["stats"]["requests"] >= 1, stats
+provider.client.shutdown()
+print(f"serve_gate: mask exact over {len(mask)} mixed lanes "
+      f"({sum(mask)} valid), served by {stats['engine']} engine")
+EOF
+rc=$?
+if [ $rc -ne 0 ]; then
+    echo "serve_gate: client smoke FAILED" >&2
+    cat "${LOG}" >&2
+    exit $rc
+fi
+
+# the SHUTDOWN opcode must produce a clean exit
+wait "${SRV_PID}"
+srv_rc=$?
+SRV_PID=""
+if [ $srv_rc -ne 0 ]; then
+    echo "serve_gate: server exited rc=${srv_rc} after SHUTDOWN" >&2
+    cat "${LOG}" >&2
+    exit 1
+fi
+echo "serve_gate: OK (mixed batch exact, clean shutdown)"

@@ -41,6 +41,15 @@ from fabric_tpu.utils.jaxcache import enable_compile_cache  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 enable_compile_cache()
 
+# Opt-in persistent-cache forensics: FABRIC_TPU_CACHE_DEBUG=1 logs every
+# compilation-cache hit/miss/write with its key (the env-var route is
+# too late here for the same reason as above).
+if os.environ.get("FABRIC_TPU_CACHE_DEBUG") == "1":
+    jax.config.update(
+        "jax_debug_log_modules",
+        "jax._src.compiler,jax._src.compilation_cache",
+    )
+
 
 def pytest_configure(config):
     config.addinivalue_line(

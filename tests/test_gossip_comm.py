@@ -454,3 +454,34 @@ def test_signed_alive_membership(tmp_path):
     # re-bind peerA's pki_id to its own cert
     assert node.certstore.put(b"Org1MSP:peerA", rogue.serialize()) is False
     node.server.stop()
+
+
+def test_dropped_bootstrap_hello_recovers_via_anchor_retry():
+    """A lost connect() hello must not partition the pair forever: the
+    tick loop re-introduces bootstrap anchors until a member answers
+    from that endpoint (the brittleness the fabchaos gossip_storm
+    scenario surfaced — pre-fix, ticks only addressed peers ALREADY in
+    the member view, so one dropped hello was permanent)."""
+    from fabric_tpu.common.faults import FaultPlan, plan_installed
+
+    l1, l2 = FakeLedger(), FakeLedger()
+    n1, n2 = make_node("a1", l1), make_node("a2", l2)
+    n1.start()
+    n2.start()
+    try:
+        # drop exactly the first stream open: the bootstrap hello itself
+        plan = FaultPlan.parse(
+            "gossip.comm.send=drop:1.0:max=1", seed=7
+        )
+        with plan_installed(plan):
+            n2.connect(n1.addr)
+            assert plan.fired().get("gossip.comm.send", 0) == 1, (
+                "the hello was not dropped — test setup is stale"
+            )
+            assert wait_until(
+                lambda: "a2" in n1.membership.alive_peers()
+                and "a1" in n2.membership.alive_peers()
+            ), "anchor re-introduction never healed the dropped hello"
+    finally:
+        n1.stop()
+        n2.stop()

@@ -114,6 +114,11 @@ def run_verify(cases, lanes=16):
 
 
 class TestVerifyBatch:
+    # ~60s warm in isolation / ~180s inside the full suite, all
+    # execution (NOTES_BUILD tier-1 budget forensics) — slow-marked;
+    # the small-batch tests below keep kernel-vs-oracle parity on the
+    # SAME compiled program in tier-1.
+    @pytest.mark.slow
     def test_differential_vs_oracle(self):
         cases = []
         expect = []
@@ -178,6 +183,8 @@ class TestVariants:
     oracle too — CI otherwise only exercises the CPU-default inline
     path while the device runs a different trace."""
 
+    @pytest.mark.slow  # each variant is its own re-traced program:
+    # real minutes cold / tens of seconds warm on the gate box
     @pytest.mark.parametrize("variant", ["microcond", "micro"])
     def test_variant_differential(self, variant, monkeypatch):
         monkeypatch.setenv("FABRIC_TPU_KERNEL_VARIANT", variant)

@@ -18,15 +18,18 @@ from fabric_tpu.ops import fp12 as f12
 RNG = random.Random(20260731)
 
 # The pairing program is compiled ONCE for all issuer keys (line
-# schedules are runtime inputs), so the default suite now runs the
-# end-to-end unity differential ungated: it costs one program compile
-# (minutes cold on XLA:CPU, seconds against the warm cache) and checks
-# device/host verdict parity on valid/corrupt/absent lanes for a FRESH
-# issuer key every run.  FABRIC_TPU_PAIRING_TESTS=0 opts out entirely.
-# The two deep-debug differentials (per-step Miller values, which jits a
-# separate single-lane program, and the full idemix batch e2e, which
-# spends minutes in host-oracle signing/verification) stay behind
-# FABRIC_TPU_PAIRING_TESTS=1.
+# schedules are runtime inputs), and the persistent compile cache serves
+# every later run — but a WARM run of the end-to-end differentials still
+# costs minutes of pure XLA:CPU *execution* on the 2-vCPU gate box
+# (measured with FABRIC_TPU_CACHE_DEBUG=1: test_ate2_unity hits the
+# cache and still takes ~277s; test_ate2_sharded ~508s — see
+# NOTES_BUILD).  That is execution cost no cache can amortize, so the
+# heavy differentials carry @pytest.mark.slow and tier-1 (-m 'not
+# slow') keeps the cheap fp12 tower rung only; full runs (no -m
+# filter, CI-external soaks) still execute them.  FABRIC_TPU_PAIRING_TESTS=0
+# opts out of the kernel tests entirely; the two deep-debug
+# differentials (per-step Miller values, the idemix batch e2e) stay
+# behind FABRIC_TPU_PAIRING_TESTS=1.
 _mode = os.environ.get("FABRIC_TPU_PAIRING_TESTS", "")
 full_kernel = pytest.mark.skipif(
     _mode == "0",
@@ -78,6 +81,7 @@ def test_tower_ops_bit_exact():
     assert got[4] == host.fp12_conj(x)
 
 
+@pytest.mark.slow
 def test_inv_and_pow_bit_exact():
     x = rand_fp12()
     e = 0xDEADBEEF12345
@@ -118,6 +122,7 @@ def test_miller_values_bit_exact():
 
 
 @full_kernel
+@pytest.mark.slow
 def test_ate2_unity_matches_oracle():
     """e(W, A')·e(g2, ABar)^-1 == 1 holds iff ABar = A'^w-exponent
     structure matches; build a true pair from the BBS+ relation
@@ -199,6 +204,7 @@ def test_idemix_batch_device_pairing_matches_host():
 
 
 @full_kernel
+@pytest.mark.slow
 def test_ate2_sharded_matches_single_device():
     """Lane-sharded pairing over an 8-device mesh (SURVEY P6: the
     multi-chip scale-out of the idemix verify column) agrees lane-exact

@@ -77,6 +77,10 @@ def _sig_cases(n):
     return cases
 
 
+@pytest.mark.slow  # ~2min WARM on the 2-vCPU gate box (pure sharded-
+# program execution, cache already hit — NOTES_BUILD tier-1 budget
+# forensics); the multichannel grid test below keeps sharded-dispatch
+# parity in tier-1.
 def test_flat_sharded_matches_host(cpu8):
     cases = _sig_cases(48)
     expected = []

@@ -301,3 +301,85 @@ def test_drain_false_surfaces_last_error(tmp_path, world):
         assert not pipe.dead  # the loop survived: slow/erroring, not dead
     finally:
         pipe.stop()
+
+
+class _AsyncLadderProvider(SoftwareProvider):
+    """Provider with the async dispatch seam (device kernels, pool
+    shards, the serve sidecar): records dispatch/resolve ordering so
+    the tests can see prepare dispatching without waiting."""
+
+    def __init__(self):
+        super().__init__()
+        self.dispatched = 0
+        self.resolved = 0
+
+    def batch_verify_async(self, keys, sigs, digests):
+        out = SoftwareProvider.batch_verify(self, keys, sigs, digests)
+        self.dispatched += 1
+
+        def resolve():
+            self.resolved += 1
+            return out
+
+        return resolve
+
+
+def test_channel_prepare_dispatches_async_and_store_resolves(
+    tmp_path, world
+):
+    """Channel.prepare_block must NOT wait on a provider that exposes
+    batch_verify_async: the resolver rides the prepared tuple and
+    store_block collects the verdicts at stage B, so block N's
+    signature math overlaps block N-1's commit epilogue across the
+    whole dispatch ladder (serve sidecar included)."""
+    prov = _AsyncLadderProvider()
+    ch = Channel(
+        CHANNEL, str(tmp_path), world["mgr"], world["registry"], prov
+    )
+    block = _chain(world, 1)[0]
+    prepared = ch.prepare_block(block)
+    assert prov.dispatched == 1 and prov.resolved == 0, (
+        "prepare_block resolved the async dispatch instead of deferring"
+    )
+    assert callable(prepared[3]), "resolver did not ride the prepared tuple"
+    flags = ch.store_block(block, prepared=prepared)
+    assert prov.resolved == 1
+    assert ch.ledger.height == 1
+    assert bytes(flags) == b"\x00" * 3, "async-prepared masks not VALID"
+
+
+def test_channel_async_resolver_failure_fails_closed(tmp_path, world):
+    """A resolver that dies at stage B (sidecar lost mid-batch AND the
+    client shim's own degrade failed too) must surface through the
+    commit error path: the block is NOT committed — fail closed,
+    never fail open."""
+
+    class _DyingProvider(SoftwareProvider):
+        def batch_verify_async(self, keys, sigs, digests):
+            def resolve():
+                raise RuntimeError("dispatch lost")
+
+            return resolve
+
+    ch = Channel(
+        CHANNEL, str(tmp_path), world["mgr"], world["registry"],
+        _DyingProvider(),
+    )
+    block = _chain(world, 1)[0]
+    prepared = ch.prepare_block(block)
+    with pytest.raises(RuntimeError, match="dispatch lost"):
+        ch.store_block(block, prepared=prepared)
+    assert ch.ledger.height == 0
+
+    # and through the two-stage pipeline: on_error sees it, no commit
+    errors = []
+    pipe = CommitPipeline(
+        ch, on_error=lambda b, exc: errors.append(str(exc))
+    )
+    try:
+        pipe.submit(block)
+        assert pipe.drain(timeout=30)
+    finally:
+        pipe.stop()
+    assert errors and "dispatch lost" in errors[0]
+    assert ch.ledger.height == 0

@@ -38,7 +38,14 @@ def _cases(n, num_keys):
     return out
 
 
-@pytest.mark.parametrize("num_keys", [5, 40])  # 40 > KEY_BUCKET: fallback
+# 40 > KEY_BUCKET exercises the fallback; the in-bucket 5-key case is
+# ~90s of warm device execution on the 2-vCPU gate box (NOTES_BUILD
+# tier-1 budget forensics), so it is slow-marked and tier-1 keeps the
+# fallback case (which loads the same programs and the same mixed-lane
+# parity assertion).
+@pytest.mark.parametrize(
+    "num_keys", [pytest.param(5, marks=pytest.mark.slow), 40]
+)
 def test_bytes_path_matches_software(num_keys):
     cases = _cases(48, num_keys)
     expected = []
@@ -55,6 +62,9 @@ def test_bytes_path_matches_software(num_keys):
     assert any(expected) and not all(expected)
 
 
+@pytest.mark.slow  # ~2min of warm bytes-path execution on the gate box
+# (NOTES_BUILD tier-1 budget forensics); async resolver ordering stays
+# covered in tier-1 by test_pipeline's channel-level async tests
 def test_async_resolver_order():
     cases = _cases(40, 4)
     prov = TPUProvider()

@@ -1,0 +1,840 @@
+"""Resident validation sidecar (fabric_tpu.serve): protocol framing,
+the bucketed AOT program registry (zero compiles in steady state, warm
+restart from serialized executables), admission control, and the client
+shim's fail-closed degrade ladder — masks bit-exact vs the in-process
+path through every failure flavor, including sidecar kill mid-batch."""
+
+import hashlib
+import json
+import os
+import socket
+import struct
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from fabric_tpu.common import p256
+from fabric_tpu.crypto import der, hostec
+from fabric_tpu.crypto.bccsp import ECDSAPublicKey, SoftwareProvider
+from fabric_tpu.serve import protocol as proto
+from fabric_tpu.serve.client import (
+    SidecarClient,
+    SidecarProvider,
+    SidecarUnavailable,
+    encode_lanes,
+)
+from fabric_tpu.serve.registry import BucketProgramRegistry, bucket_for
+from fabric_tpu.serve.server import SidecarServer, parse_address
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# workload material
+# ---------------------------------------------------------------------------
+
+_D_PRIV = 0xA1B2C3D4E5F6
+_PUB = ECDSAPublicKey(*hostec.scalar_base_mult(_D_PRIV))
+
+LANE_KINDS = ("good", "bad_sig", "high_s", "garbage", "no_key")
+
+
+def mixed_lanes(n, seed=0):
+    """(keys, sigs, digests, expected) with deterministic per-lane
+    corruption kinds covering the parse, low-S and curve paths."""
+    keys, sigs, digests, expected = [], [], [], []
+    for i in range(n):
+        digest = hashlib.sha256(b"serve lane %d %d" % (seed, i)).digest()
+        r, s = hostec.sign_digest(_D_PRIV, digest)
+        sig = der.marshal_signature(r, s)
+        kind = LANE_KINDS[i % len(LANE_KINDS)]
+        key = _PUB
+        if kind == "bad_sig":
+            bad = bytearray(sig)
+            bad[-1] ^= 0x5A
+            sig = bytes(bad)
+        elif kind == "high_s":
+            sig = der.marshal_signature(r, p256.N - s)
+        elif kind == "garbage":
+            sig = b"\x00\x01garbage"
+        elif kind == "no_key":
+            key = None
+        keys.append(key)
+        sigs.append(sig)
+        digests.append(digest)
+        expected.append(kind == "good")
+    return keys, sigs, digests, expected
+
+
+@pytest.fixture
+def sidecar(tmp_path):
+    """A warm host-engine sidecar on a unix socket + teardown."""
+    addr = str(tmp_path / "serve.sock")
+    server = SidecarServer(addr, engine="host", warm_ladder="off",
+                           buckets=(64, 256))
+    server.warm()
+    server.start()
+    yield server
+    server.stop()
+
+
+# ---------------------------------------------------------------------------
+# protocol
+# ---------------------------------------------------------------------------
+
+
+class TestProtocol:
+    def _pipe(self):
+        a, b = socket.socketpair()
+        return a, b
+
+    def test_frame_roundtrip(self):
+        a, b = self._pipe()
+        proto.send_frame(a, proto.OP_VERIFY, 7, b"payload")
+        opcode, req_id, payload = proto.recv_frame(b)
+        assert (opcode, req_id, payload) == (proto.OP_VERIFY, 7, b"payload")
+        a.close()
+        assert proto.recv_frame(b) is None  # clean EOF
+
+    def test_bad_magic_rejected(self):
+        a, b = self._pipe()
+        a.sendall(b"XX" + b"\x00" * (proto.HEADER_SIZE - 2))
+        with pytest.raises(proto.ProtocolError, match="magic"):
+            proto.recv_frame(b)
+
+    def test_truncated_frame_rejected(self):
+        a, b = self._pipe()
+        frame = proto.pack_frame(proto.OP_PING, 1, b"full payload here")
+        a.sendall(frame[:-5])
+        a.close()
+        with pytest.raises(proto.ProtocolError, match="mid-frame|payload"):
+            proto.recv_frame(b)
+
+    def test_oversized_frame_rejected(self):
+        a, b = self._pipe()
+        head = struct.pack(
+            ">2sBBII", proto.MAGIC, proto.PROTOCOL_VERSION, proto.OP_VERIFY,
+            1, proto.MAX_PAYLOAD + 1,
+        )
+        a.sendall(head)
+        with pytest.raises(proto.ProtocolError, match="MAX_PAYLOAD"):
+            proto.recv_frame(b)
+
+    def test_verify_request_roundtrip(self):
+        table = [b"\x04" + b"\x01" * 64, b"\x04" + b"\x02" * 64]
+        lanes = [(0, b"sig0", b"d" * 32), (proto.NO_KEY, b"", b"e" * 32),
+                 (1, b"sig2", b"f" * 32)]
+        out_table, out_lanes = proto.decode_verify_request(
+            proto.encode_verify_request(table, lanes)
+        )
+        assert out_table == table
+        assert out_lanes == lanes
+
+    def test_verify_request_bad_key_index(self):
+        payload = proto.encode_verify_request([b"k"], [(0, b"s", b"d")])
+        # corrupt the lane's key index to 5 (only 1 key in the table)
+        bad = bytearray(payload)
+        off = 2 + 2 + 1 + 4  # n_keys + klen + key + n_lanes
+        struct.pack_into(">H", bad, off, 5)
+        with pytest.raises(proto.ProtocolError, match="out of range"):
+            proto.decode_verify_request(bytes(bad))
+
+    def test_verify_response_roundtrip(self):
+        mask = [True, False, True]
+        st, retry, out, msg = proto.decode_verify_response(
+            proto.encode_verify_response(proto.ST_OK, mask=mask)
+        )
+        assert (st, out, msg) == (proto.ST_OK, mask, "")
+        st, retry, out, msg = proto.decode_verify_response(
+            proto.encode_verify_response(
+                proto.ST_BUSY, message="full", retry_after_ms=40
+            )
+        )
+        assert (st, retry, out, msg) == (proto.ST_BUSY, 40, None, "full")
+
+    def test_encode_lanes_dedups_keys(self):
+        keys, sigs, digests, _ = mixed_lanes(10)
+        payload = encode_lanes(keys, sigs, digests)
+        table, lanes = proto.decode_verify_request(payload)
+        assert len(table) == 1  # one distinct key object
+        assert [i for i, _, _ in lanes].count(proto.NO_KEY) == 2  # no_key kind
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+
+class TestRegistry:
+    def test_bucket_for_ladder(self):
+        assert bucket_for(1, (128, 256)) == 128
+        assert bucket_for(128, (128, 256)) == 128
+        assert bucket_for(129, (128, 256)) == 256
+        assert bucket_for(300, (128, 256)) == 512  # oversize: top multiple
+
+    def test_warm_once_and_lookup(self):
+        built = []
+
+        def builder(bucket):
+            built.append(bucket)
+            return (lambda: bucket), {}
+
+        reg = BucketProgramRegistry((4, 8), builder, label="t")
+        reg.warm()
+        reg.warm()  # idempotent
+        assert built == [4, 8]
+        b, program = reg.program_for(3)
+        assert (b, program()) == (4, 4)
+        assert reg.program_for(8)[0] == 8
+
+    def test_unwarmed_bucket_is_an_error_not_a_compile(self):
+        reg = BucketProgramRegistry((4,), lambda b: ((lambda: b), {}))
+        with pytest.raises(KeyError, match="not warmed"):
+            reg.program_for(2)
+
+    def test_ladder_must_be_sorted_unique(self):
+        with pytest.raises(ValueError):
+            BucketProgramRegistry((8, 4), lambda b: ((lambda: b), {}))
+
+
+class TestJaxRegistry:
+    """The AOT path with the real (small) ops.bignum demo program."""
+
+    BUCKETS = (8,)
+
+    def _registry(self, aot_dir=None):
+        from fabric_tpu.serve.registry import demo_limb_program
+
+        fn, shapes_for = demo_limb_program()
+        return BucketProgramRegistry.for_jax_program(
+            fn, shapes_for, buckets=self.BUCKETS, label="test-demo",
+            aot_dir=aot_dir,
+        )
+
+    def test_steady_state_zero_compiles(self):
+        """The acceptance gate: after warm(), dispatching many requests
+        across the ladder triggers ZERO re-traces and ZERO XLA compile
+        events — asserted by the registry's trace counter AND the
+        process-wide jax compile-event counters."""
+        import numpy as np
+
+        from fabric_tpu.serve.registry import _CompileCounters
+
+        reg = self._registry()
+        reg.warm()
+        traces0 = reg.traces
+        c0, _h0 = _CompileCounters.snapshot()
+        bucket, program = reg.program_for(5)
+        x = np.arange(20 * bucket, dtype=np.uint32).reshape(20, bucket) % 8191
+        ref = np.asarray(program(x))
+        for _ in range(12):
+            bucket, program = reg.program_for(3 + (_ % 6))
+            out = np.asarray(program(x))
+            assert (out == ref).all()
+        c1, _h1 = _CompileCounters.snapshot()
+        assert reg.traces == traces0, "steady state re-traced a program"
+        assert c1 == c0, "steady state fired an XLA compile"
+
+    def test_aot_artifact_roundtrip(self, tmp_path):
+        """Cold warm() serializes executables; a second registry against
+        the same AOT dir loads them — aot_hit, no trace, no compile —
+        and computes bit-identical outputs."""
+        import numpy as np
+
+        aot = str(tmp_path / "aot")
+        cold = self._registry(aot_dir=aot)
+        cold.warm()
+        assert all(
+            not rep["aot_hit"] for rep in cold.warm_report.values()
+        )
+        warm = self._registry(aot_dir=aot)
+        warm.warm()
+        for b, rep in warm.warm_report.items():
+            assert rep["aot_hit"], f"bucket {b} missed the AOT artifact"
+            assert rep["xla_compiles"] == 0, f"bucket {b} recompiled"
+        assert warm.traces == 0, "AOT warm start re-traced"
+        x = np.arange(20 * 8, dtype=np.uint32).reshape(20, 8) % 8191
+        a = np.asarray(cold.program_for(8)[1](x))
+        b = np.asarray(warm.program_for(8)[1](x))
+        assert (a == b).all()
+
+    def test_stale_aot_artifact_falls_back_to_compile(self, tmp_path):
+        aot = str(tmp_path / "aot")
+        cold = self._registry(aot_dir=aot)
+        cold.warm()
+        for name in os.listdir(aot):
+            with open(os.path.join(aot, name), "wb") as fh:
+                fh.write(b"corrupt artifact")
+        rebuilt = self._registry(aot_dir=aot)
+        rebuilt.warm()  # must not raise
+        assert all(
+            not rep["aot_hit"] for rep in rebuilt.warm_report.values()
+        )
+
+
+# ---------------------------------------------------------------------------
+# sidecar end-to-end (host engine over a unix socket)
+# ---------------------------------------------------------------------------
+
+
+class TestSidecar:
+    def test_mixed_batch_bit_exact(self, sidecar):
+        keys, sigs, digests, expected = mixed_lanes(60)
+        provider = SidecarProvider(address=sidecar.address)
+        try:
+            mask = provider.batch_verify(keys, sigs, digests)
+            assert list(mask) == expected
+            inproc = SoftwareProvider().batch_verify(keys, sigs, digests)
+            assert list(mask) == list(inproc)
+            assert not provider.degraded
+            assert provider.describe_backend().startswith("serve:")
+        finally:
+            provider.stop()
+
+    def test_async_pipelined_requests(self, sidecar):
+        provider = SidecarProvider(address=sidecar.address)
+        try:
+            batches = [mixed_lanes(20, seed=s) for s in range(5)]
+            resolvers = [
+                provider.batch_verify_async(k, s, d)
+                for k, s, d, _ in batches
+            ]
+            for resolver, (_, _, _, expected) in zip(resolvers, batches):
+                assert list(resolver()) == expected
+        finally:
+            provider.stop()
+
+    def test_concurrent_connections(self, sidecar):
+        errs = []
+
+        def worker(i):
+            provider = SidecarProvider(address=sidecar.address)
+            try:
+                k, s, d, e = mixed_lanes(15, seed=i)
+                if list(provider.batch_verify(k, s, d)) != e:
+                    errs.append(i)
+            finally:
+                provider.stop()
+
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs
+
+    def test_stats_and_ping(self, sidecar):
+        provider = SidecarProvider(address=sidecar.address)
+        try:
+            k, s, d, e = mixed_lanes(10)
+            provider.batch_verify(k, s, d)
+            assert provider.client.ping()
+            stats = provider.client.stats()
+            assert stats["engine"] == "host"
+            assert stats["stats"]["requests"] >= 1
+            assert stats["stats"]["request_latency"]["n"] >= 1
+        finally:
+            provider.stop()
+
+    def test_tcp_address(self):
+        server = SidecarServer(
+            "127.0.0.1:0", engine="host", warm_ladder="off"
+        )
+        server.warm()
+        addr = server.start()
+        try:
+            assert parse_address(addr)[0] == socket.AF_INET
+            provider = SidecarProvider(address=addr)
+            k, s, d, e = mixed_lanes(12)
+            assert list(provider.batch_verify(k, s, d)) == e
+            provider.stop()
+        finally:
+            server.stop()
+
+    def test_garbage_frame_kills_connection_not_server(self, sidecar):
+        raw = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        raw.connect(sidecar.address)
+        raw.sendall(b"not a frame at all" * 4)
+        raw.close()
+        provider = SidecarProvider(address=sidecar.address)
+        try:
+            k, s, d, e = mixed_lanes(10)
+            assert list(provider.batch_verify(k, s, d)) == e
+        finally:
+            provider.stop()
+
+    def test_malformed_payload_fails_request_not_connection(self, sidecar):
+        """A frame whose HEADER parses but whose VERIFY payload does not
+        decode is answered ST_ERROR for THAT request id and the stream
+        keeps serving: recv_frame consumed the whole length-prefixed
+        frame, so the connection is still in sync."""
+        client = SidecarClient(sidecar.address)
+        try:
+            token = client.submit(proto.OP_VERIFY, b"\xff\xff\xff")
+            status, _, _, message = proto.decode_verify_response(
+                client.await_reply(token)
+            )
+            assert status == proto.ST_ERROR
+            assert "ProtocolError" in message
+            # the SAME connection still serves real work
+            k, s, d, e = mixed_lanes(10)
+            status, _, mask, _ = proto.decode_verify_response(
+                client.request(proto.OP_VERIFY, encode_lanes(k, s, d))
+            )
+            assert status == proto.ST_OK
+            assert mask == e
+        finally:
+            client.close()
+
+    def test_read_loop_stays_responsive_during_slow_verify(self, tmp_path):
+        """Verify requests settle on worker threads: while one request
+        is stalled in the batcher, the connection's read loop must keep
+        draining frames (a PING answers promptly) instead of
+        serializing every request behind the slow one."""
+        provider = GatedProvider()
+        server = SidecarServer(
+            str(tmp_path / "slow.sock"), engine="host", provider=provider,
+            warm_ladder="off", buckets=(64,), linger_s=0.0,
+        )
+        server.start()  # no warm(): the gate would stall the warm batch
+        client = SidecarClient(server.address)
+        try:
+            k, s, d, e = mixed_lanes(64, seed=9)
+            token = client.submit(proto.OP_VERIFY, encode_lanes(k, s, d))
+            assert provider.entered.wait(5.0)
+            t0 = time.monotonic()
+            assert client.ping()  # same connection, verify still gated
+            assert time.monotonic() - t0 < 5.0
+            assert not provider.gate.is_set()
+            provider.gate.set()
+            status, _, mask, _ = proto.decode_verify_response(
+                client.await_reply(token)
+            )
+            assert status == proto.ST_OK
+            assert mask == e
+        finally:
+            provider.gate.set()
+            client.close()
+            server.stop()
+
+
+# ---------------------------------------------------------------------------
+# admission control
+# ---------------------------------------------------------------------------
+
+
+class GatedProvider(SoftwareProvider):
+    """Computes verdicts eagerly but stalls the batcher's dispatcher on
+    a gate, so admitted-but-undispatched lanes accumulate."""
+
+    def __init__(self):
+        super().__init__()
+        self.gate = threading.Event()
+        self.entered = threading.Event()
+
+    def batch_verify_async(self, keys, sigs, digests):
+        out = SoftwareProvider.batch_verify(self, keys, sigs, digests)
+        self.entered.set()
+        self.gate.wait(10.0)
+        return lambda: out
+
+
+class TestAdmissionControl:
+    def _squeezed_server(self, tmp_path):
+        provider = GatedProvider()
+        server = SidecarServer(
+            str(tmp_path / "busy.sock"), engine="host", provider=provider,
+            warm_ladder="off", buckets=(64,), max_pending_lanes=96,
+            linger_s=0.0,
+        )
+        server.start()  # no warm(): the gate would stall the warm batch
+        return server, provider
+
+    def _fill(self, server, provider):
+        """Occupy the dispatcher + the lane budget; returns the gated
+        requests' resolvers and their expected masks."""
+        a = SidecarProvider(address=server.address, sleeper=lambda s: None)
+        b = SidecarProvider(address=server.address, sleeper=lambda s: None)
+        k1, s1, d1, e1 = mixed_lanes(64, seed=1)
+        r1 = a.batch_verify_async(k1, s1, d1)
+        assert provider.entered.wait(5.0)
+        k2, s2, d2, e2 = mixed_lanes(64, seed=2)
+        r2 = b.batch_verify_async(k2, s2, d2)
+        deadline = time.monotonic() + 5.0
+        while server.batcher.pending_lanes < 64 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert server.batcher.pending_lanes >= 64
+        return (a, b), (r1, e1), (r2, e2)
+
+    def test_full_sidecar_rejects_with_retry_after(self, tmp_path):
+        """A raw client sees ST_BUSY + a retry_after hint, never a block
+        or an error, while the budget is full; after release the same
+        request succeeds."""
+        server, provider = self._squeezed_server(tmp_path)
+        clients = ()
+        try:
+            clients, (r1, e1), (r2, e2) = self._fill(server, provider)
+            raw = SidecarClient(server.address)
+            k3, s3, d3, e3 = mixed_lanes(64, seed=3)
+            payload = encode_lanes(k3, s3, d3)
+            status, retry_ms, mask, _ = proto.decode_verify_response(
+                raw.request(proto.OP_VERIFY, payload)
+            )
+            assert status == proto.ST_BUSY
+            assert retry_ms >= 5
+            provider.gate.set()
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                status, _, mask, _ = proto.decode_verify_response(
+                    raw.request(proto.OP_VERIFY, payload)
+                )
+                if status == proto.ST_OK:
+                    break
+                time.sleep(0.02)
+            assert status == proto.ST_OK
+            assert mask == e3
+            assert list(r1()) == e1 and list(r2()) == e2
+            raw.close()
+        finally:
+            provider.gate.set()
+            for c in clients:
+                c.stop()
+            server.stop()
+
+    def test_client_shim_retries_then_degrades(self, tmp_path):
+        """The provider's BUSY pacing: bounded retries against a full
+        sidecar, then in-process degrade with a bit-exact mask."""
+        server, provider = self._squeezed_server(tmp_path)
+        clients = ()
+        try:
+            clients, (r1, e1), (r2, e2) = self._fill(server, provider)
+            third = SidecarProvider(
+                address=server.address, sleeper=lambda s: None
+            )
+            k3, s3, d3, e3 = mixed_lanes(64, seed=3)
+            mask = third.batch_verify(k3, s3, d3)
+            assert third.busy_rejects >= 1
+            assert third.degraded  # budget spent against the gated server
+            assert list(mask) == e3
+            provider.gate.set()
+            assert list(r1()) == e1 and list(r2()) == e2
+            third.stop()
+        finally:
+            provider.gate.set()
+            for c in clients:
+                c.stop()
+            server.stop()
+
+    def test_retry_after_scales_with_fill(self, sidecar):
+        base = sidecar.retry_after_ms()
+        assert base >= 5
+
+
+class TestTrySubmit:
+    def test_try_submit_rejects_when_full_and_recovers(self):
+        from fabric_tpu.parallel.batcher import VerifyBatcher
+
+        gate = threading.Event()
+        entered = threading.Event()
+
+        class Gated:
+            def batch_verify_async(self, keys, sigs, digests):
+                entered.set()
+                gate.wait(10.0)
+                out = [True] * len(keys)
+                return lambda: out
+
+        b = VerifyBatcher(Gated(), max_pending_lanes=8, linger_s=0.0)
+        try:
+            r1 = b.try_submit([object()] * 8, [b"s"] * 8, [b"d"] * 8)
+            assert r1 is not None
+            assert entered.wait(5.0)
+            r2 = b.try_submit([object()] * 8, [b"s"] * 8, [b"d"] * 8)
+            deadline = time.monotonic() + 5.0
+            while r2 is None and time.monotonic() < deadline:
+                # dispatcher may not have taken batch 1 yet; once it has,
+                # the budget frees and the retry must admit
+                if b.pending_lanes == 0:
+                    r2 = b.try_submit(
+                        [object()] * 8, [b"s"] * 8, [b"d"] * 8
+                    )
+                    break
+                r3 = b.try_submit([object()] * 8, [b"s"] * 8, [b"d"] * 8)
+                assert r3 is None  # full: must reject, never block
+                time.sleep(0.01)
+            gate.set()
+            assert r1() == [True] * 8
+            if r2 is not None:
+                assert r2() == [True] * 8
+        finally:
+            gate.set()
+            b.stop()
+
+
+# ---------------------------------------------------------------------------
+# degrade ladder (fail-closed, never fail-open)
+# ---------------------------------------------------------------------------
+
+
+class TestDegrade:
+    def test_dead_address_degrades_in_process(self, tmp_path):
+        provider = SidecarProvider(address=str(tmp_path / "nothing.sock"))
+        k, s, d, e = mixed_lanes(20)
+        assert list(provider.batch_verify(k, s, d)) == e
+        assert provider.degraded
+        assert provider.describe_backend().startswith("serve-degraded(")
+        provider.stop()
+
+    def test_dial_cooldown_skips_reconnect_spam(self, tmp_path, monkeypatch):
+        """After a failed dial the circuit opens: the next batch
+        degrades WITHOUT paying another connect attempt (a blackholed
+        TCP endpoint would otherwise cost connect_timeout_s per
+        batch on the commit path)."""
+        provider = SidecarProvider(address=str(tmp_path / "nothing.sock"))
+        calls = []
+        orig = provider.client._connect
+
+        def counting_connect():
+            calls.append(1)
+            return orig()
+
+        monkeypatch.setattr(provider.client, "_connect", counting_connect)
+        k, s, d, e = mixed_lanes(10)
+        try:
+            assert list(provider.batch_verify(k, s, d)) == e
+            dials = len(calls)
+            assert dials >= 1
+            assert not provider.client._dial_gate.ready()  # circuit open
+            assert list(provider.batch_verify(k, s, d)) == e
+            assert len(calls) == dials  # cooling down: no new dial
+        finally:
+            provider.stop()
+
+    def test_fallback_is_the_probe_ladder(self, tmp_path, monkeypatch):
+        """Degrade goes through bccsp.probe_provider() (device if one
+        answers, else SW) — an accelerator node whose sidecar dies, or
+        whose FABRIC_TPU_SERVE_ADDR went stale, must keep its device
+        rather than silently pinning the SW rung."""
+        import fabric_tpu.crypto.bccsp as bccsp
+
+        sentinel = SoftwareProvider()
+        monkeypatch.setattr(bccsp, "probe_provider", lambda: sentinel)
+        provider = SidecarProvider(address=str(tmp_path / "nothing.sock"))
+        try:
+            assert provider.fallback_provider() is sentinel
+        finally:
+            provider.stop()
+
+    def test_kill_mid_batch_degrades_bit_exact(self, tmp_path):
+        addr = str(tmp_path / "kill.sock")
+        gated = GatedProvider()
+        server = SidecarServer(
+            addr, engine="host", provider=gated, warm_ladder="off",
+            buckets=(64,),
+        )
+        server.start()
+        provider = SidecarProvider(address=addr, sleeper=lambda s: None)
+        try:
+            k, s, d, e = mixed_lanes(30)
+            resolver = provider.batch_verify_async(k, s, d)
+            assert gated.entered.wait(5.0)  # request is in flight
+            server.stop()  # kill with the batch mid-dispatch
+            gated.gate.set()
+            assert list(resolver()) == e  # re-verified in-process
+            assert provider.degraded
+        finally:
+            gated.gate.set()
+            provider.stop()
+            server.stop()
+
+    def test_double_fault_fails_closed_all_false(self, tmp_path):
+        """Sidecar dead AND the in-process fallback broken: the mask is
+        all-False — lanes are never guessed VALID."""
+
+        class BrokenFallback:
+            def batch_verify(self, keys, sigs, digests):
+                raise RuntimeError("fallback broken too")
+
+        provider = SidecarProvider(
+            address=str(tmp_path / "nothing.sock"), fallback=BrokenFallback()
+        )
+        k, s, d, _ = mixed_lanes(15)
+        assert provider.batch_verify(k, s, d) == [False] * 15
+
+    def test_mask_length_skew_is_rejected(self, sidecar, monkeypatch):
+        """An OK reply whose mask length disagrees with the request is a
+        protocol violation: degrade, never stretch/truncate verdicts."""
+        provider = SidecarProvider(address=sidecar.address)
+        real_decode = proto.decode_verify_response
+
+        def skewed(payload):
+            status, retry, mask, msg = real_decode(payload)
+            if status == proto.ST_OK and mask:
+                mask = mask[:-1]
+            return status, retry, mask, msg
+
+        monkeypatch.setattr(
+            "fabric_tpu.serve.client.proto.decode_verify_response", skewed
+        )
+        k, s, d, e = mixed_lanes(10)
+        assert list(provider.batch_verify(k, s, d)) == e
+        assert provider.degraded
+        provider.stop()
+
+    def test_injected_dispatch_fault_rides_retry(self, sidecar):
+        from fabric_tpu.common.faults import FaultPlan, plan_installed
+
+        provider = SidecarProvider(
+            address=sidecar.address, sleeper=lambda s: None
+        )
+        try:
+            k, s, d, e = mixed_lanes(25)
+            plan = FaultPlan.parse("serve.dispatch=raise:0.5", seed=3)
+            with plan_installed(plan):
+                for _ in range(4):
+                    assert list(provider.batch_verify(k, s, d)) == e
+            assert plan.fired().get("serve.dispatch", 0) >= 1
+        finally:
+            provider.stop()
+
+
+# ---------------------------------------------------------------------------
+# factory rung + env routing
+# ---------------------------------------------------------------------------
+
+
+class TestFactoryRung:
+    def test_default_serve_builds_sidecar_provider(self, sidecar):
+        from fabric_tpu.crypto.factory import provider_from_config
+
+        provider = provider_from_config(
+            {"Default": "SERVE", "SERVE": {"Address": sidecar.address}}
+        )
+        try:
+            assert isinstance(provider, SidecarProvider)
+            k, s, d, e = mixed_lanes(10)
+            assert list(provider.batch_verify(k, s, d)) == e
+        finally:
+            provider.stop()
+
+    def test_serve_without_address_is_a_factory_error(self, monkeypatch):
+        from fabric_tpu.crypto.factory import FactoryError, provider_from_config
+
+        monkeypatch.delenv("FABRIC_TPU_SERVE_ADDR", raising=False)
+        with pytest.raises(FactoryError):
+            provider_from_config({"Default": "SERVE"})
+
+    def test_unknown_default_still_errors(self):
+        from fabric_tpu.crypto.factory import FactoryError, provider_from_config
+
+        with pytest.raises(FactoryError, match="unknown BCCSP default"):
+            provider_from_config({"Default": "NOPE"})
+
+    def test_env_routes_default_provider(self, sidecar, monkeypatch):
+        import fabric_tpu.crypto.bccsp as bccsp
+
+        monkeypatch.setenv("FABRIC_TPU_SERVE_ADDR", sidecar.address)
+        monkeypatch.setattr(bccsp, "_default", None)
+        provider = bccsp.default_provider()
+        try:
+            assert isinstance(provider, SidecarProvider)
+            k, s, d, e = mixed_lanes(10)
+            assert list(provider.batch_verify(k, s, d)) == e
+        finally:
+            provider.stop()
+            monkeypatch.setattr(bccsp, "_default", None)
+
+    def test_pipeline_channel_routes_through_sidecar(self, sidecar):
+        """peer-plane integration: a provider built from the SERVE rung
+        slots into the validator seam like any other provider (the
+        Channel/BlockValidator only see the Provider SPI)."""
+        from fabric_tpu.crypto.factory import provider_from_config
+
+        provider = provider_from_config(
+            {"Default": "SERVE", "SERVE": {"Address": sidecar.address}}
+        )
+        try:
+            k, s, d, e = mixed_lanes(16)
+            resolver = provider.batch_verify_async(k, s, d)
+            assert list(resolver()) == e
+            assert sidecar.stats.summary()["requests"] >= 1
+        finally:
+            provider.stop()
+
+
+# ---------------------------------------------------------------------------
+# warm restart: sidecar subprocess twice against a persistent cache
+# ---------------------------------------------------------------------------
+
+
+class TestWarmRestart:
+    BUCKETS = "8,16"
+
+    def _run_sidecar(self, tmp_path, tag):
+        """Start ``python -m fabric_tpu.serve`` with the demo jax
+        ladder + a persistent AOT dir, drive one mixed batch through the
+        client shim, shut down cleanly.  Returns (warm_report, mask)."""
+        addr = str(tmp_path / f"warm-{tag}.sock")
+        aot = str(tmp_path / "aot")
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "fabric_tpu.serve",
+                "--address", addr, "--engine", "host",
+                "--warm", "demo", "--buckets", self.BUCKETS,
+                "--aot-dir", aot,
+            ],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+            env=env,
+            cwd=REPO_ROOT,
+        )
+        try:
+            ready = None
+            deadline = time.monotonic() + 240.0
+            while time.monotonic() < deadline:
+                line = proc.stdout.readline()
+                if not line:
+                    break
+                if line.startswith("SERVE_READY "):
+                    ready = json.loads(line[len("SERVE_READY "):])
+                    break
+            assert ready is not None, proc.stderr.read()
+            provider = SidecarProvider(address=addr)
+            keys, sigs, digests, expected = mixed_lanes(20, seed=99)
+            mask = provider.batch_verify(keys, sigs, digests)
+            assert list(mask) == expected
+            assert not provider.degraded
+            provider.client.shutdown()
+            provider.stop()
+            assert proc.wait(timeout=30) == 0
+            return ready["warm"], list(mask)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=10)
+
+    def test_second_start_is_aot_warm_with_identical_masks(self, tmp_path):
+        """ISSUE acceptance: run the sidecar twice against the same
+        persistent cache; the second start must be served entirely from
+        the AOT artifacts — zero XLA compiles, zero re-traces — and the
+        served masks must be identical."""
+        warm1, mask1 = self._run_sidecar(tmp_path, "cold")
+        warm2, mask2 = self._run_sidecar(tmp_path, "warm")
+        assert mask1 == mask2
+        buckets = [b.strip() for b in self.BUCKETS.split(",")]
+        for b in buckets:
+            rep1 = warm1["per_bucket"][b]
+            rep2 = warm2["per_bucket"][b]
+            assert not rep1["aot_hit"], f"first start already AOT at {b}"
+            assert rep2["aot_hit"], f"second start missed the AOT at {b}"
+            assert rep2["xla_compiles"] == 0, f"second start recompiled {b}"
+        assert warm2["traces"] == 0, "second start re-traced a program"
+        # the wall-clock claim, stated conservatively: the AOT warm start
+        # must beat the first start (which paid trace + compile/cache)
+        assert warm2["total_warm_ms"] < warm1["total_warm_ms"]
