@@ -88,6 +88,53 @@ def gen_triples(n, num_keys=8):
     return triples
 
 
+def bench_obs_overhead(triples, n_lanes=4096, passes=2):
+    """The fabobs acceptance microbench: the 4096-lane host verify with
+    the obs registry disabled vs enabled, best-of-``passes`` each,
+    interleaved D E D E so background drift hits both modes equally.
+    The disabled mode must cost <= 2% over the pre-instrumentation
+    baseline — disabled obs is one module-global load per obs point, so
+    the honest comparison here is disabled-vs-enabled on the SAME
+    binary (recorded in NOTES_BUILD next to the pre-PR absolute)."""
+    from fabric_tpu.common import fabobs
+    from fabric_tpu.crypto.bccsp import SoftwareProvider, ec_backend_name
+
+    lanes = triples[:n_lanes]
+    keys = [t[0] for t in lanes]
+    sigs = [t[1] for t in lanes]
+    digests = [t[2] for t in lanes]
+    sw = SoftwareProvider()
+    prev = fabobs.active()
+    times = {"disabled": [], "enabled": []}
+    try:
+        sw.batch_verify(keys[:256], sigs[:256], digests[:256])  # warm pools
+        for _ in range(passes):
+            for mode in ("disabled", "enabled"):
+                if mode == "disabled":
+                    fabobs.disable()
+                else:
+                    fabobs.enable()
+                t0 = time.perf_counter()
+                mask = sw.batch_verify(keys, sigs, digests)
+                times[mode].append(time.perf_counter() - t0)
+                if not all(mask):
+                    raise RuntimeError("overhead bench lanes must verify")
+    finally:
+        with fabobs._OBS_LOCK:
+            fabobs._OBS = prev
+    dis, ena = min(times["disabled"]), min(times["enabled"])
+    return {
+        "backend": ec_backend_name(),
+        "lanes": len(lanes),
+        "passes": passes,
+        "disabled_s": round(dis, 4),
+        "enabled_s": round(ena, 4),
+        "disabled_verifies_per_s": round(len(lanes) / dis, 1),
+        "enabled_verifies_per_s": round(len(lanes) / ena, 1),
+        "enabled_overhead_pct": round((ena - dis) / dis * 100.0, 2),
+    }
+
+
 def bench_cpu_baseline(triples, budget_s=2.0):
     """Single-core CPU column: the ACTUAL SoftwareProvider verify path
     (DER parse + low-S gate + OpenSSL curve math), i.e. the same code the
@@ -1235,6 +1282,12 @@ def main():
     from fabric_tpu.crypto.bccsp import ec_backend_name
 
     configs = {}
+    # observe the whole run: every emitted line carries the metrics
+    # snapshot scraped at emit time (bench_obs_overhead disables the
+    # registry around its own measurement passes and restores it)
+    from fabric_tpu.common import fabobs as _fabobs
+
+    _fabobs.ensure_enabled()
     triples = gen_triples(n)
     cpu_rate = bench_cpu_baseline(triples)
     # which scalar-EC tier the SW provider actually runs — guards against
@@ -1248,6 +1301,10 @@ def main():
         configs["host_ladder"] = bench_host_ladder(triples)
     except Exception as exc:  # noqa: BLE001 - ladder column is best-effort
         configs["host_ladder"] = {"error": str(exc)[:300]}
+    try:
+        configs["obs_overhead"] = bench_obs_overhead(triples)
+    except Exception as exc:  # noqa: BLE001 - obs column is best-effort
+        configs["obs_overhead"] = {"error": str(exc)[:300]}
     try:
         import subprocess
 
@@ -1294,6 +1351,12 @@ def main():
 
     def emit():
         result["detail"]["elapsed_s"] = round(time.monotonic() - t0, 1)
+        try:
+            # rung counters + stage histograms ride BENCH_*.json next to
+            # the throughput columns (ISSUE 10: configs.metrics_snapshot)
+            configs["metrics_snapshot"] = _fabobs.snapshot()
+        except Exception as exc:  # noqa: BLE001 - snapshot is best-effort
+            configs["metrics_snapshot"] = {"error": str(exc)[:200]}
         print(json.dumps(result), flush=True)
 
     emit()  # valid line on disk before any device call can hang

@@ -63,6 +63,8 @@ import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Union
 
+from fabric_tpu.common import fabobs
+
 ACTIONS = ("raise", "delay", "corrupt", "drop")
 
 
@@ -327,6 +329,11 @@ def fault_point(
     spec = plan.check(site, key, interprets)
     if spec is None:
         return None
+    # chaos runs become observable: every fired injection is a counter
+    # series (and an obs event) when the registry is enabled — metrics
+    # are memory-only, so the deterministic scorecard stays byte-exact
+    fabobs.obs_count("fabric_fault_fired_total", site=site)
+    fabobs.obs_event("fault.fired", site=site, action=spec.action)
     if spec.action == "raise":
         raise InjectedFault(site)
     if spec.action == "delay":

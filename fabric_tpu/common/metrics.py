@@ -48,6 +48,45 @@ def latency_summary(samples_s: Sequence[float]) -> Dict[str, float]:
     }
 
 
+def summary_from_histogram_state(
+    state: "_HistState", buckets: Sequence[float]
+) -> Dict[str, float]:
+    """``latency_summary``'s shape computed from accumulated histogram
+    state instead of raw samples: quantiles are the upper bound of the
+    bucket where the cumulative count crosses the rank (bucket-quantized,
+    so an exact-sample consumer should keep ``latency_summary``).  The
+    top open bucket has no upper bound; ranks landing there report a
+    LOWER BOUND on that bucket's mean — ``(sum - bounded_count *
+    top_bucket) / inf_count``, clamped to at least the top finite bound
+    — so a tail outlier can never be reported below the ladder it
+    overflowed.  Keys: ``{n, p50_ms, p99_ms, mean_ms}`` (``{"n": 0}``
+    when empty)."""
+    if state.total == 0:
+        return {"n": 0}
+
+    def pct(q: float) -> float:
+        rank = q * (state.total - 1) + 1
+        cum = 0
+        for ub, c in zip(buckets, state.counts):
+            cum += c
+            if cum >= rank:
+                return ub
+        inf_count = state.total - sum(state.counts)
+        if not inf_count:
+            return buckets[-1]
+        # bounded samples contribute at most bounded_count * top bucket
+        # to the sum, so this is a conservative mean of the +Inf bucket
+        bounded_cap = (state.total - inf_count) * buckets[-1]
+        return max(buckets[-1], (state.sum - bounded_cap) / inf_count)
+
+    return {
+        "n": state.total,
+        "p50_ms": round(pct(0.50) * 1e3, 3),
+        "p99_ms": round(pct(0.99) * 1e3, 3),
+        "mean_ms": round(state.sum / state.total * 1e3, 3),
+    }
+
+
 @dataclass(frozen=True)
 class MetricOpts:
     namespace: str = ""
@@ -75,6 +114,21 @@ class HistogramOpts(MetricOpts):
     buckets: Tuple[float, ...] = DEFAULT_BUCKETS
 
 
+def validate_label_values(
+    opts: MetricOpts, label_values: Sequence[str]
+) -> Tuple[str, ...]:
+    """Name/value pairs -> the series key ordered by ``opts.label_names``.
+    Shared by every provider's ``with_labels`` (the statsd path used to
+    construct a throwaway ``_Metric`` per call just to run this)."""
+    if len(label_values) % 2 != 0:
+        raise ValueError("label values must come in name/value pairs")
+    pairs = dict(zip(label_values[::2], label_values[1::2]))
+    missing = [n for n in opts.label_names if n not in pairs]
+    if missing:
+        raise ValueError(f"missing label values: {missing}")
+    return tuple(pairs[n] for n in opts.label_names)
+
+
 class _Metric:
     """One named metric family; label-tuple -> series state."""
 
@@ -85,13 +139,7 @@ class _Metric:
         self.series: Dict[Tuple[str, ...], object] = {}
 
     def _labels_key(self, label_values: Sequence[str]) -> Tuple[str, ...]:
-        if len(label_values) % 2 != 0:
-            raise ValueError("label values must come in name/value pairs")
-        pairs = dict(zip(label_values[::2], label_values[1::2]))
-        missing = [n for n in self.opts.label_names if n not in pairs]
-        if missing:
-            raise ValueError(f"missing label values: {missing}")
-        return tuple(pairs[n] for n in self.opts.label_names)
+        return validate_label_values(self.opts, label_values)
 
 
 class Counter:
@@ -135,6 +183,27 @@ class _HistState:
     sum: float = 0.0
 
 
+#: Public name for embedders (peer/pipeline keeps per-stage histogram
+#: state directly, summarized by ``summary_from_histogram_state``).
+HistogramState = _HistState
+
+
+def new_histogram_state(buckets: Sequence[float]) -> _HistState:
+    return _HistState(counts=[0] * len(buckets))
+
+
+def observe_into(
+    state: _HistState, buckets: Sequence[float], value: float
+) -> None:
+    """The one bucket-accumulation definition (shared by ``Histogram``
+    and embedded states).  NOT thread-safe; callers hold their lock."""
+    idx = bisect.bisect_left(buckets, value)
+    if idx < len(buckets):
+        state.counts[idx] += 1
+    state.total += 1
+    state.sum += value
+
+
 class Histogram:
     def __init__(self, metric: _Metric, labels: Tuple[str, ...] = ()):
         self._m = metric
@@ -148,13 +217,9 @@ class Histogram:
         with self._m.lock:
             state = self._m.series.get(self._labels)
             if state is None:
-                state = _HistState(counts=[0] * len(buckets))
+                state = new_histogram_state(buckets)
                 self._m.series[self._labels] = state
-            idx = bisect.bisect_left(buckets, value)
-            if idx < len(buckets):
-                state.counts[idx] += 1
-            state.total += 1
-            state.sum += value
+            observe_into(state, buckets, value)
 
 
 class Provider:
@@ -273,8 +338,7 @@ class StatsdProvider(Provider):
                 self._labels = labels
 
             def with_labels(self, *label_values: str) -> "Counter":
-                m = _Metric(opts, "counter")
-                return _C(m._labels_key(label_values))
+                return _C(validate_label_values(opts, label_values))
 
             def add(self, delta: float = 1.0) -> None:
                 provider._sink(
@@ -291,8 +355,7 @@ class StatsdProvider(Provider):
                 self._labels = labels
 
             def with_labels(self, *label_values: str) -> "Gauge":
-                m = _Metric(opts, "gauge")
-                return _G(m._labels_key(label_values))
+                return _G(validate_label_values(opts, label_values))
 
             def set(self, value: float) -> None:
                 provider._sink(
@@ -314,8 +377,7 @@ class StatsdProvider(Provider):
                 self._labels = labels
 
             def with_labels(self, *label_values: str) -> "Histogram":
-                m = _Metric(opts, "histogram")
-                return _H(m._labels_key(label_values))
+                return _H(validate_label_values(opts, label_values))
 
             def observe(self, value: float) -> None:
                 provider._sink(
@@ -325,22 +387,54 @@ class StatsdProvider(Provider):
         return _H()
 
 
+class _DisabledCounter(Counter):
+    """True no-op: ``with_labels`` returns SELF, so the labeled child is
+    just as disabled as the parent.  (The old per-instance lambda patch
+    only disabled the parent — ``with_labels()`` handed back a LIVE
+    base-class Counter that silently recorded and accumulated series
+    memory on a 'disabled' provider.)"""
+
+    def __init__(self):  # no backing _Metric at all: nothing to leak into
+        pass
+
+    def with_labels(self, *label_values: str) -> "Counter":
+        return self
+
+    def add(self, delta: float = 1.0) -> None:
+        return None
+
+
+class _DisabledGauge(Gauge):
+    def __init__(self):
+        pass
+
+    def with_labels(self, *label_values: str) -> "Gauge":
+        return self
+
+    def set(self, value: float) -> None:
+        return None
+
+    def add(self, delta: float) -> None:
+        return None
+
+
+class _DisabledHistogram(Histogram):
+    def __init__(self):
+        pass
+
+    def with_labels(self, *label_values: str) -> "Histogram":
+        return self
+
+    def observe(self, value: float) -> None:
+        return None
+
+
 class DisabledProvider(Provider):
     def new_counter(self, opts: MetricOpts) -> Counter:
-        m = _Metric(opts, "counter")
-        c = Counter(m)
-        c.add = lambda delta=1.0: None  # type: ignore[assignment]
-        return c
+        return _DisabledCounter()
 
     def new_gauge(self, opts: MetricOpts) -> Gauge:
-        m = _Metric(opts, "gauge")
-        g = Gauge(m)
-        g.set = lambda value: None  # type: ignore[assignment]
-        g.add = lambda delta: None  # type: ignore[assignment]
-        return g
+        return _DisabledGauge()
 
     def new_histogram(self, opts: HistogramOpts) -> Histogram:
-        m = _Metric(opts, "histogram")
-        h = Histogram(m)
-        h.observe = lambda value: None  # type: ignore[assignment]
-        return h
+        return _DisabledHistogram()

@@ -25,6 +25,7 @@ import time
 from dataclasses import dataclass
 from typing import Callable, Optional, Tuple, Type
 
+from fabric_tpu.common import fabobs
 from fabric_tpu.common.faults import InjectedFault
 
 #: Exception types a retry layer may treat as transient by default.
@@ -109,6 +110,10 @@ class Backoff:
         # it (deliverer run(), call_with_retry frame) — never shared
         self.attempts += 1  # fabdep: disable=unguarded-shared-write  # loop-scoped instance, single owner thread
         self.total_delay_s += delay  # fabdep: disable=unguarded-shared-write  # loop-scoped instance, single owner thread
+        # obs: retries are where backpressure and flaps become visible;
+        # the NOMINAL delay is recorded so fake sleepers chart the same
+        fabobs.obs_count("fabric_retry_attempts_total")
+        fabobs.obs_observe("fabric_retry_backoff_seconds", delay)
         if self._rng is not None:
             delay *= 1.0 + self.policy.jitter * (2.0 * self._rng.random() - 1.0)
         if delay > 0:

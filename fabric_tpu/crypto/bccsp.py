@@ -25,11 +25,13 @@ from __future__ import annotations
 
 import hashlib
 import threading
+import time
 from dataclasses import dataclass
 from typing import List, Optional, Sequence, Tuple
 
 import os
 
+from fabric_tpu.common import fabobs
 from fabric_tpu.common.faults import corrupt_verdicts, fault_point
 from fabric_tpu.common.flogging import must_get_logger
 from fabric_tpu.common import der, p256
@@ -147,6 +149,21 @@ def ec_backend_name() -> str:
     """Short tier name of the active backend
     (``fastec``/``hostec_np``/``hostec``/``p256``)."""
     return _ec.__name__.rsplit(".", 1)[-1]
+
+
+def ec_pool_ready() -> bool:
+    """Health view of the active EC tier's process pool: False while a
+    broken pool's rebuild cooldown is open (verifies still serve, but
+    inline — degraded throughput an operator should see on /healthz).
+    Tiers without a pool gate are trivially ready."""
+    gate = getattr(_ec, "_POOL_GATE", None)
+    if gate is None:
+        return True
+    try:
+        return bool(gate.ready())
+    except Exception as exc:  # noqa: BLE001 - health probe must not raise
+        logger.debug("ec pool gate probe failed (%s); reporting ready", exc)
+        return True
 
 
 # Import-time init: select_ec_backend("auto") never raises (see above),
@@ -400,11 +417,18 @@ class SoftwareProvider(Provider):
         # unkeyed: batch sizes are static in steady state, so a content
         # key would turn a probabilistic plan into all-or-nothing
         fault_point("bccsp.dispatch")
-        sharded = getattr(_ec, "verify_parsed_batch_sharded", None)
-        if sharded is None:
-            out = super().batch_verify(keys, signatures, digests)
-        else:
-            out = sharded(self._parse_lanes(keys, signatures, digests))()
+        rung = ec_backend_name()
+        t0 = time.perf_counter()
+        with fabobs.span("bccsp.batch_verify", rung=rung, lanes=len(keys)):
+            sharded = getattr(_ec, "verify_parsed_batch_sharded", None)
+            if sharded is None:
+                out = super().batch_verify(keys, signatures, digests)
+            else:
+                out = sharded(self._parse_lanes(keys, signatures, digests))()
+        fabobs.obs_count("fabric_verify_lanes_total", len(keys), rung=rung)
+        fabobs.obs_observe(
+            "fabric_verify_seconds", time.perf_counter() - t0, rung=rung
+        )
         return self._chaos_verdicts(list(out))
 
     def batch_verify_async(self, keys, signatures, digests):
@@ -415,13 +439,27 @@ class SoftwareProvider(Provider):
         before resolving.  Other tiers compute synchronously and hand
         back a trivial resolver."""
         fault_point("bccsp.dispatch")
+        rung = ec_backend_name()
+        t0 = time.perf_counter()
         sharded = getattr(_ec, "verify_parsed_batch_sharded", None)
         if sharded is None:
             out = Provider.batch_verify(self, keys, signatures, digests)
             inner = lambda v=out: v  # noqa: E731
         else:
             inner = sharded(self._parse_lanes(keys, signatures, digests))
-        return lambda: self._chaos_verdicts(list(inner()))
+        n = len(keys)
+
+        def resolve() -> List[bool]:
+            # latency spans dispatch -> resolve: the window a caller
+            # actually waits on this rung, pool shards included
+            verdicts = self._chaos_verdicts(list(inner()))
+            fabobs.obs_count("fabric_verify_lanes_total", n, rung=rung)
+            fabobs.obs_observe(
+                "fabric_verify_seconds", time.perf_counter() - t0, rung=rung
+            )
+            return verdicts
+
+        return resolve
 
 
 class PurePythonProvider(SoftwareProvider):
@@ -521,4 +559,5 @@ def probe_provider() -> Provider:
         logger.warning(
             "device probe failed (%s); using the software provider", exc
         )
+        fabobs.obs_count("fabric_degrade_total", seam="bccsp.probe")
         return SoftwareProvider()

@@ -56,6 +56,7 @@ from typing import Callable, List, Optional, Sequence, Tuple
 
 from fabric_tpu.common.faults import fault_point
 from fabric_tpu.common.flogging import must_get_logger
+from fabric_tpu.common import fabobs
 from fabric_tpu.common.retry import CooldownGate
 from fabric_tpu.common import p256
 from fabric_tpu.common.p256 import A, B, GX, GY, HALF_N, N, P, hash_to_int
@@ -444,6 +445,7 @@ def _pool():
                     max_workers=procs,
                     mp_context=multiprocessing.get_context(start),
                 )
+                fabobs.obs_count("fabric_pool_rebuilds_total", pool="hostec")
             except Exception as exc:  # pragma: no cover - restricted sandboxes
                 logger.warning(
                     "process pool unavailable (%s); verifying inline", exc
@@ -463,6 +465,10 @@ def shutdown_pool(broken: bool = False) -> None:
         _POOL = None
         if broken:
             _POOL_GATE.record_failure()
+    if broken:
+        fabobs.obs_count("fabric_pool_cooldowns_total", pool="hostec")
+        fabobs.obs_count("fabric_degrade_total", seam="hostec.pool")
+        fabobs.obs_trigger("hostec.pool_broken")
 
 
 def verify_parsed_batch_sharded(

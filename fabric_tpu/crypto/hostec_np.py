@@ -83,7 +83,7 @@ import os
 import threading
 from typing import Callable, List, Optional, Sequence, Tuple
 
-from fabric_tpu.common import p256
+from fabric_tpu.common import fabobs, p256
 from fabric_tpu.common.faults import fault_point
 from fabric_tpu.common.flogging import must_get_logger
 from fabric_tpu.common.retry import CooldownGate
@@ -1306,6 +1306,9 @@ def _pool():
                     max_workers=procs,
                     mp_context=multiprocessing.get_context(start),
                 )
+                fabobs.obs_count(
+                    "fabric_pool_rebuilds_total", pool="hostec_np"
+                )
             except Exception as exc:  # pragma: no cover - sandboxes
                 logger.warning(
                     "process pool unavailable (%s); verifying inline", exc
@@ -1324,6 +1327,10 @@ def shutdown_pool(broken: bool = False) -> None:
         _POOL = None
         if broken:
             _POOL_GATE.record_failure()
+    if broken:
+        fabobs.obs_count("fabric_pool_cooldowns_total", pool="hostec_np")
+        fabobs.obs_count("fabric_degrade_total", seam="hostec_np.pool")
+        fabobs.obs_trigger("hostec_np.pool_broken")
 
 
 def _shard_worker(shm_name: str, nlanes: int, lo: int, hi: int) -> bool:

@@ -22,7 +22,7 @@ from typing import Dict, List, Sequence, Tuple
 import numpy as np
 
 from fabric_tpu.common.flogging import must_get_logger
-from fabric_tpu.common import p256
+from fabric_tpu.common import fabobs, p256
 from fabric_tpu.crypto.bccsp import (
     ECDSAPublicKey,
     Provider,
@@ -145,6 +145,9 @@ class TPUProvider(Provider):
         signatures: Sequence[bytes],
         digests: Sequence[bytes],
     ) -> List[bool]:
+        if not type(self).degraded:
+            fabobs.obs_count("fabric_degrade_total", seam="tpu.dispatch")
+            fabobs.obs_trigger("tpu.degraded")
         type(self).degraded = True
         out: List[bool] = []
         for key, sig, dig in zip(keys, signatures, digests):
@@ -172,6 +175,7 @@ class TPUProvider(Provider):
         OpenSSL software path instead of raising. Committers never stop
         committing because the accelerator went away."""
         n = len(signatures)
+        t0 = time.perf_counter()
         prep, limbs = self.prep_bytes(keys, signatures, digests)
         attempts = max(int(os.environ.get("FABRIC_TPU_DISPATCH_RETRIES", "3")), 1)
         delay = 1.0
@@ -195,13 +199,19 @@ class TPUProvider(Provider):
 
         def resolve() -> List[bool]:
             try:
-                return [bool(v) for v in np.asarray(out)[:n]]
+                verdicts = [bool(v) for v in np.asarray(out)[:n]]
             except Exception as exc:  # noqa: BLE001 - async error surfaces here
                 logger.warning(
                     "async device result failed (%s); "
                     "falling back to software verify", exc,
                 )
                 return self._sw_verify_all(keys, signatures, digests)
+            fabobs.obs_count("fabric_verify_lanes_total", n, rung="device")
+            fabobs.obs_observe(
+                "fabric_verify_seconds",
+                time.perf_counter() - t0, rung="device",
+            )
+            return verdicts
 
         return resolve
 

@@ -30,8 +30,10 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from typing import List, Optional, Sequence, Tuple
 
+from fabric_tpu.common import fabobs
 from fabric_tpu.common.faults import corrupt_verdicts, fault_point
 from fabric_tpu.common.flogging import must_get_logger
 from fabric_tpu.common.retry import CooldownGate
@@ -178,6 +180,7 @@ def verify_signatures_batch(
         backend = "device"
     elif backend is None:
         backend = bccsp.idemix_backend_name()
+    t0 = time.perf_counter()
 
     if backend == "hostbn":
         out = _verify_hostbn(
@@ -196,6 +199,11 @@ def verify_signatures_batch(
         )
     else:
         raise ValueError(f"unknown idemix batch backend {backend!r}")
+    if _pool_ok:  # coordinating process only; shard workers stay silent
+        fabobs.obs_count("fabric_verify_lanes_total", n, rung=backend)
+        fabobs.obs_observe(
+            "fabric_verify_seconds", time.perf_counter() - t0, rung=backend
+        )
     # the corrupt seam fires ONCE per batch, in the coordinating
     # process: pool workers (re-entering with _pool_ok=False) inherit an
     # env-installed plan and would otherwise corrupt each shard AND the
@@ -343,6 +351,7 @@ def _pool():
                     max_workers=procs,
                     mp_context=multiprocessing.get_context(start),
                 )
+                fabobs.obs_count("fabric_pool_rebuilds_total", pool="hostbn")
             except Exception as exc:  # pragma: no cover - sandboxes
                 logger.warning(
                     "idemix pool unavailable (%s); verifying inline", exc
@@ -361,6 +370,10 @@ def shutdown_pool(broken: bool = False) -> None:
         _POOL = None
         if broken:
             _POOL_GATE.record_failure()
+    if broken:
+        fabobs.obs_count("fabric_pool_cooldowns_total", pool="hostbn")
+        fabobs.obs_count("fabric_degrade_total", seam="hostbn.pool")
+        fabobs.obs_trigger("hostbn.pool_broken")
 
 
 def _pool_worker(

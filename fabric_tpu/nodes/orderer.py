@@ -91,7 +91,14 @@ class OrdererNode:
         self.ops: Optional[System] = None
         interceptors = []
         if ops_address is not None:
-            self.ops = System(OpsOptions(listen_address=ops_address))
+            # same provider discipline as the peer shell: the fabobs
+            # data-plane registry IS the /metrics surface
+            from fabric_tpu.common import fabobs
+
+            obs = fabobs.ensure_enabled()
+            self.ops = System(
+                OpsOptions(listen_address=ops_address, provider=obs.provider)
+            )
             self.ops.register_checker("registrar", lambda: None)
             from fabric_tpu.comm.interceptors import (
                 LoggingInterceptor,

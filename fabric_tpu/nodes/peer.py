@@ -173,7 +173,16 @@ class PeerNode:
         self.committer_metrics = None
         interceptors = []
         if ops_address is not None:
-            self.ops = System(OpsOptions(listen_address=ops_address))
+            # the data plane (batcher, ladder rungs, pipeline stages,
+            # retries, fault fires) reports onto the SAME provider the
+            # ops server scrapes: first enabler wins process-wide, and
+            # this node's System serves whichever registry is live
+            from fabric_tpu.common import fabobs
+
+            obs = fabobs.ensure_enabled()
+            self.ops = System(
+                OpsOptions(listen_address=ops_address, provider=obs.provider)
+            )
             from fabric_tpu.comm.interceptors import (
                 LoggingInterceptor,
                 MetricsInterceptor,

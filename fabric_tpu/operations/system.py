@@ -37,6 +37,10 @@ VERSION = "0.1.0"
 class Options:
     listen_address: str = "127.0.0.1:0"
     metrics_provider: str = "prometheus"  # prometheus | statsd | disabled
+    # an already-live provider to serve instead of constructing one —
+    # how the serve sidecar and the node shells hand the fabobs
+    # data-plane registry to /metrics (overrides metrics_provider)
+    provider: Optional[Provider] = None
     statsd_sink: Optional[Callable[[str], None]] = None
     statsd_prefix: str = ""
     version: str = VERSION
@@ -62,7 +66,9 @@ class System:
         self._thread: Optional[threading.Thread] = None
 
         kind = self.options.metrics_provider
-        if kind == "prometheus":
+        if self.options.provider is not None:
+            self.provider = self.options.provider
+        elif kind == "prometheus":
             self.provider: Provider = PrometheusProvider()
         elif kind == "statsd":
             self.provider = StatsdProvider(
@@ -156,6 +162,22 @@ class System:
                         {"Version": system.options.version}
                     ).encode()
                     self._reply(200, body, "application/json")
+                elif self.path == "/trace":
+                    # fabobs flight recorder on demand: the bounded span
+                    # ring as Chrome trace-event JSON (404 when the obs
+                    # registry is disabled in this process)
+                    from fabric_tpu.common import fabobs
+
+                    reg = fabobs.active()
+                    if reg is None:
+                        self._reply(
+                            404, b"observability is not enabled",
+                            "text/plain",
+                        )
+                    else:
+                        self._reply(
+                            200, reg.dump().encode(), "application/json"
+                        )
                 elif self.path.startswith("/debug/pprof"):
                     self._pprof()
                 else:
@@ -207,7 +229,16 @@ class System:
                 length = int(self.headers.get("Content-Length", "0"))
                 try:
                     payload = json.loads(self.rfile.read(length) or b"{}")
-                    flogging.activate_spec(payload.get("spec", ""))
+                    spec = payload.get("spec", "") if isinstance(
+                        payload, dict
+                    ) else None
+                    if not isinstance(spec, str):
+                        # {"spec": ["not","a","string"]} used to escape
+                        # as an AttributeError out of activate_spec —
+                        # a malformed body must 400 and leave the
+                        # active spec untouched
+                        raise ValueError("logspec body must be {\"spec\": str}")
+                    flogging.activate_spec(spec)
                 except (ValueError, flogging.InvalidSpecError) as exc:
                     body = json.dumps({"error": str(exc)}).encode()
                     self._reply(400, body, "application/json")

@@ -48,6 +48,7 @@ import threading
 import time
 from typing import Callable, List, Optional, Sequence, Tuple
 
+from fabric_tpu.common import fabobs
 from fabric_tpu.common.faults import fault_point
 from fabric_tpu.common.retry import DISPATCH_POLICY, RetryPolicy, call_with_retry
 
@@ -55,6 +56,7 @@ from fabric_tpu.common.retry import DISPATCH_POLICY, RetryPolicy, call_with_retr
 class _Request:
     __slots__ = (
         "keys", "sigs", "digests", "event", "result", "error", "permits",
+        "t_submit",
     )
 
     def __init__(self, keys, sigs, digests):
@@ -65,6 +67,7 @@ class _Request:
         self.result: Optional[List[bool]] = None
         self.error: Optional[BaseException] = None
         self.permits = 0
+        self.t_submit = time.perf_counter()
 
     def resolve(self) -> List[bool]:
         self.event.wait()
@@ -229,9 +232,12 @@ class VerifyBatcher:
                 if self._stopped:
                     raise RuntimeError("batcher stopped")
                 if not block:
+                    fabobs.obs_count("fabric_batcher_busy_rejects_total")
                     return None
                 self._lanes_cv.wait()
             self._lanes_free -= req.permits
+            pending = self._max_pending_lanes - self._lanes_free
+        fabobs.obs_gauge("fabric_batcher_pending_lanes", pending)
         # the stop lock orders every put against the stop sentinel: no
         # request can land behind the None the dispatcher exits on
         with self._stop_lock:
@@ -300,8 +306,13 @@ class VerifyBatcher:
             with self._lanes_cv:
                 self._lanes_free += sum(r.permits for r in batch)
                 self._lanes_cv.notify_all()
+                released = self._max_pending_lanes - self._lanes_free
+            fabobs.obs_gauge("fabric_batcher_pending_lanes", released)
             try:
-                resolver = self._launch(keys, sigs, digests)
+                with fabobs.span(
+                    "batcher.launch", lanes=len(keys), requests=len(batch)
+                ):
+                    resolver = self._launch(keys, sigs, digests)
             except BaseException as exc:  # fablint: disable=broad-except  # error propagated to every waiting caller via r.error
                 for r in batch:
                     self._settle_error(r, exc)
@@ -315,6 +326,8 @@ class VerifyBatcher:
                 continue
             self.launches += 1
             self.lanes += len(keys)
+            fabobs.obs_count("fabric_batcher_launches_total", mode=self.mode)
+            fabobs.obs_observe("fabric_batcher_batch_lanes", len(keys))
             pending.append((batch, resolver, time.perf_counter(), len(keys)))
             # depth-4 pipeline: keep up to three launches in flight before
             # settling the oldest — on high-RTT transports (the TPU
@@ -349,7 +362,16 @@ class VerifyBatcher:
                 return lambda v=verdicts: v
             return dispatch(keys, sigs, digests)
 
-        return call_with_retry(attempt, policy=self.dispatch_retry)
+        def on_retry(exc: BaseException, attempt_n: int) -> None:
+            fabobs.obs_count("fabric_batcher_dispatch_retries_total")
+            fabobs.obs_event(
+                "batcher.dispatch_retry",
+                attempt=attempt_n, error=type(exc).__name__,
+            )
+
+        return call_with_retry(
+            attempt, policy=self.dispatch_retry, on_retry=on_retry
+        )
 
     def _settle_error(self, r: _Request, exc: BaseException) -> None:
         if not r.event.is_set():
@@ -366,19 +388,24 @@ class VerifyBatcher:
         lanes: int = 0,
     ) -> None:
         try:
-            out = list(resolver())
+            with fabobs.span("batcher.settle", lanes=lanes):
+                out = list(resolver())
             if t0:
                 self._observe_rtt(lanes, time.perf_counter() - t0)
         except BaseException as exc:  # fablint: disable=broad-except  # error propagated to every waiting caller via r.error
             for r in reqs:
                 self._settle_error(r, exc)
             return
+        now = time.perf_counter()
         off = 0
         for r in reqs:
             n = len(r.keys)
             if not r.event.is_set():  # stop() may have settled fail-closed
                 r.result = out[off : off + n]
                 r.event.set()
+                fabobs.obs_observe(
+                    "fabric_batcher_submit_wait_seconds", now - r.t_submit
+                )
             off += n
             with self._req_lock:
                 self._inflight.discard(r)
@@ -403,6 +430,16 @@ class VerifyBatcher:
             self._inflight.clear()
         for r in leftovers:
             r.fail_closed()
+        if leftovers:
+            # a fail-closed settlement is exactly the moment worth a
+            # flight-recorder snapshot: what led up to the hang is in
+            # the ring right now
+            fabobs.obs_count(
+                "fabric_batcher_fail_closed_total", len(leftovers)
+            )
+            fabobs.obs_trigger(
+                "batcher.fail_closed", requests=len(leftovers)
+            )
 
 
 class BatchingProvider:

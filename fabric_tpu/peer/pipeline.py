@@ -17,12 +17,17 @@ from __future__ import annotations
 import queue
 import threading
 import time
-from collections import deque
 from typing import Callable, Dict, Optional
 
+from fabric_tpu.common import fabobs
+from fabric_tpu.common.fabobs import STAGE_BUCKETS
 from fabric_tpu.common.faults import fault_point
 from fabric_tpu.common.flogging import must_get_logger
-from fabric_tpu.common.metrics import latency_summary
+from fabric_tpu.common.metrics import (
+    new_histogram_state,
+    observe_into,
+    summary_from_histogram_state,
+)
 from fabric_tpu.protos import common_pb2
 
 
@@ -53,12 +58,13 @@ class CommitPipeline:
         # without stop()) distinguish slow from dead
         self.last_error: Optional[BaseException] = None
         self._crashed = False
-        # per-stage latency reservoirs (bounded; newest samples win) —
-        # the honest p50/p99 surface the serve/bench paths read instead
-        # of re-deriving stage costs from wall-clock differences
-        self._stage_s: Dict[str, deque] = {
-            "prepare": deque(maxlen=2048),
-            "commit": deque(maxlen=2048),
+        # per-stage latency as metrics-SPI histogram state (PR 10: the
+        # raw-sample reservoirs became bucket accumulators — one
+        # definition shared with /metrics, constant memory for the
+        # process lifetime, summarized by summary_from_histogram_state)
+        self._stage_hist = {
+            "prepare": new_histogram_state(STAGE_BUCKETS),
+            "commit": new_histogram_state(STAGE_BUCKETS),
         }
         self._committer = threading.Thread(
             target=self._commit_loop,
@@ -80,9 +86,12 @@ class CommitPipeline:
             self._idle.clear()
         try:
             t0 = time.perf_counter()
-            prepared = self.channel.prepare_block(block)
-            with self._pending_lock:
-                self._stage_s["prepare"].append(time.perf_counter() - t0)
+            with fabobs.span(
+                "pipeline.prepare",
+                block=int(getattr(block.header, "number", 0)),
+            ):
+                prepared = self.channel.prepare_block(block)
+            self._observe_stage("prepare", time.perf_counter() - t0)
             # bounded put that watches _stopped: a plain blocking put on
             # a full queue after stop() would wait forever — the
             # committer has exited and will never drain it (pipeline
@@ -140,12 +149,16 @@ class CommitPipeline:
                     key=int(getattr(block.header, "number", 0)),
                 )
                 t0 = time.perf_counter()
-                flags = self.channel.store_block(block, prepared=prepared)
-                with self._pending_lock:
-                    self._stage_s["commit"].append(time.perf_counter() - t0)
+                with fabobs.span(
+                    "pipeline.commit",
+                    block=int(getattr(block.header, "number", 0)),
+                ):
+                    flags = self.channel.store_block(block, prepared=prepared)
+                self._observe_stage("commit", time.perf_counter() - t0)
                 if self.on_commit is not None:
                     self.on_commit(block, flags)
             except Exception as exc:  # noqa: BLE001 - surfaced to the owner
+                fabobs.obs_count("fabric_pipeline_commit_failures_total")
                 with self._pending_lock:
                     self.last_error = exc
                 if self.on_error is not None:
@@ -165,14 +178,26 @@ class CommitPipeline:
                     if self._pending == 0:
                         self._idle.set()
 
-    def stage_stats(self) -> Dict[str, Dict[str, float]]:
-        """Per-stage latency summary over the bounded reservoirs:
-        {"prepare": {n, p50_ms, p99_ms}, "commit": {...}} — what
-        1907.08367's reordered-stage analysis wants measured, served
-        from the live pipeline instead of a one-off bench probe."""
+    def _observe_stage(self, stage: str, seconds: float) -> None:
         with self._pending_lock:
-            samples = {k: list(v) for k, v in self._stage_s.items()}
-        return {stage: latency_summary(vals) for stage, vals in samples.items()}
+            observe_into(self._stage_hist[stage], STAGE_BUCKETS, seconds)
+        fabobs.obs_observe(
+            "fabric_pipeline_stage_seconds", seconds, stage=stage
+        )
+
+    def stage_stats(self) -> Dict[str, Dict[str, float]]:
+        """Per-stage latency summary over the accumulated histogram
+        state: {"prepare": {n, p50_ms, p99_ms, mean_ms}, "commit":
+        {...}} — what 1907.08367's reordered-stage analysis wants
+        measured, served from the live pipeline instead of a one-off
+        bench probe.  Quantiles are bucket upper bounds (STAGE_BUCKETS),
+        the same series a /metrics scrape sees."""
+        with self._pending_lock:
+            states = {
+                k: summary_from_histogram_state(v, STAGE_BUCKETS)
+                for k, v in self._stage_hist.items()
+            }
+        return states
 
     def drain(self, timeout: float = 30.0) -> bool:
         """Wait until every submitted block has committed.  Returns

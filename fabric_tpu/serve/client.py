@@ -29,6 +29,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+from fabric_tpu.common import fabobs
 from fabric_tpu.common.flogging import must_get_logger
 from fabric_tpu.common.retry import Backoff, CooldownGate, RetryPolicy
 from fabric_tpu.serve import protocol as proto
@@ -321,6 +322,13 @@ class SidecarProvider:
                 "sidecar %s unavailable (%s); degrading to in-process "
                 "verification", self.client.address, why,
             )
+            # the first degrade is the flight-recorder moment: dump what
+            # led here (obs failures swallow; the mask path continues).
+            # The counter sits in the same transition gate — the family
+            # counts degrade TRANSITIONS like every other seam, not one
+            # tick per batch served by a latched-degraded provider.
+            fabobs.obs_count("fabric_degrade_total", seam="serve.client")
+            fabobs.obs_trigger("serve.client_degraded")
         self.degraded = True
         try:
             mask = self.fallback_provider().batch_verify(
@@ -346,6 +354,7 @@ class SidecarProvider:
         n = len(keys)
         if n == 0:
             return []
+        t0 = time.perf_counter()
         try:
             payload = encode_lanes(keys, signatures, digests)
         except proto.ProtocolError as exc:
@@ -367,6 +376,11 @@ class SidecarProvider:
                         keys, signatures, digests,
                         f"mask length {0 if mask is None else len(mask)} != {n}",
                     )
+                fabobs.obs_count("fabric_verify_lanes_total", n, rung="serve")
+                fabobs.obs_observe(
+                    "fabric_verify_seconds",
+                    time.perf_counter() - t0, rung="serve",
+                )
                 return mask
             if status == proto.ST_BUSY:
                 self.busy_rejects += 1  # fabdep: disable=unguarded-shared-write  # GIL-atomic add, stats only
@@ -402,6 +416,7 @@ class SidecarProvider:
         n = len(keys)
         if n == 0:
             return list
+        t0 = time.perf_counter()
         try:
             payload = encode_lanes(keys, signatures, digests)
             token = self.client.submit(proto.OP_VERIFY, payload)
@@ -421,6 +436,11 @@ class SidecarProvider:
             except (SidecarUnavailable, proto.ProtocolError) as exc:
                 return self._degrade(keys, signatures, digests, exc)
             if status == proto.ST_OK and mask is not None and len(mask) == n:
+                fabobs.obs_count("fabric_verify_lanes_total", n, rung="serve")
+                fabobs.obs_observe(
+                    "fabric_verify_seconds",
+                    time.perf_counter() - t0, rung="serve",
+                )
                 return mask
             # BUSY/ERROR/STOPPING at resolve time: fall into the sync
             # path, which owns the retry/degrade ladder

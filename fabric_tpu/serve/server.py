@@ -40,6 +40,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from fabric_tpu.common import fabobs
 from fabric_tpu.common.faults import fault_point
 from fabric_tpu.common.flogging import must_get_logger
 from fabric_tpu.common.metrics import latency_summary
@@ -63,8 +64,13 @@ parse_address = proto.parse_address
 
 
 class ServeStats:
-    """Thread-safe request accounting; ``summary()`` is the STATS reply
-    and the ``configs.serve`` bench column."""
+    """Request accounting with a dual surface: ``summary()`` stays the
+    STATS reply and the ``configs.serve`` bench column (exact, local,
+    provider-free), while every recording call ALSO drives the fabobs
+    metric SPI — so a scrape of the mounted ops server's ``/metrics``
+    sees the same traffic as live ``fabric_serve_*`` series.  The SPI
+    emission is the zero-when-disabled fabobs hook; nothing here blocks
+    or raises on an obs failure."""
 
     RESERVOIR = 8192
 
@@ -88,18 +94,27 @@ class ServeStats:
             self.lanes += lanes
             self.per_bucket[bucket] = self.per_bucket.get(bucket, 0) + 1
             self._latency_s.append(seconds)
+        fabobs.obs_count("fabric_serve_requests_total", status="ok")
+        fabobs.obs_count("fabric_serve_lanes_total", lanes)
+        fabobs.obs_count(
+            "fabric_serve_bucket_requests_total", bucket=str(bucket)
+        )
+        fabobs.obs_observe("fabric_serve_request_seconds", seconds)
 
     def reject(self) -> None:
         with self._lock:
             self.rejects += 1
+        fabobs.obs_count("fabric_serve_requests_total", status="busy")
 
     def error(self) -> None:
         with self._lock:
             self.errors += 1
+        fabobs.obs_count("fabric_serve_requests_total", status="error")
 
     def stopping_reply(self) -> None:
         with self._lock:
             self.degraded_replies += 1
+        fabobs.obs_count("fabric_serve_requests_total", status="stopping")
 
     def summary(self) -> Dict:
         with self._lock:
@@ -154,6 +169,7 @@ class SidecarServer:
         warm_ladder: str = "off",
         aot_dir: Optional[str] = None,
         retry_after_base_ms: int = 25,
+        ops_address: Optional[str] = None,
     ):
         from fabric_tpu.parallel.batcher import VerifyBatcher
 
@@ -185,6 +201,15 @@ class SidecarServer:
         self._conn_lock = threading.Lock()
         self._stopping = False
         self._started = False
+        # optional mounted ops plane: /metrics + /healthz for THIS
+        # sidecar (started in start(), torn down in stop()).  The obs
+        # registry is enabled NOW, not at mount time, so warm() — which
+        # runs before start() — already lands its per-bucket series on
+        # the provider the ops server will scrape.
+        self.ops_address = ops_address
+        self.ops = None
+        if ops_address:
+            fabobs.ensure_enabled()
 
     # -- warm-up -----------------------------------------------------------
     def warm(self) -> Dict:
@@ -214,7 +239,31 @@ class SidecarServer:
             report["traces"] = self.registry.traces
         report["total_warm_ms"] = round((time.perf_counter() - t0) * 1000.0, 1)
         self.warm_report = report
+        self._export_warm_metrics(report)
         return report
+
+    @staticmethod
+    def _export_warm_metrics(report: Dict) -> None:
+        """Registry warm accounting -> per-bucket gauge series, so a
+        /metrics scrape carries the same cold/cache/AOT story as the
+        warm report without re-deriving it."""
+        for bucket, rep in (report.get("per_bucket") or {}).items():
+            fabobs.obs_gauge(
+                "fabric_serve_bucket_warm_ms",
+                rep.get("warm_ms", 0.0), bucket=str(bucket),
+            )
+            fabobs.obs_gauge(
+                "fabric_serve_bucket_xla_compiles",
+                rep.get("xla_compiles", 0), bucket=str(bucket),
+            )
+            fabobs.obs_gauge(
+                "fabric_serve_bucket_cache_hits",
+                rep.get("cache_hits", 0), bucket=str(bucket),
+            )
+            fabobs.obs_gauge(
+                "fabric_serve_bucket_aot_hit",
+                1.0 if rep.get("aot_hit") else 0.0, bucket=str(bucket),
+            )
 
     def _warm_host(self) -> float:
         """One tiny batch through the provider so pool spin-up and key
@@ -265,8 +314,77 @@ class SidecarServer:
         accept.start()
         with self._conn_lock:
             self._threads.append(accept)
+        if self.ops_address:
+            self.mount_operations()
         logger.info("sidecar serving on %s (engine %s)", self.address, self.engine)
         return self.address
+
+    # -- mounted operations plane ------------------------------------------
+    def mount_operations(self) -> str:
+        """Start the node-admin HTTP server inside the sidecar process:
+        ``/metrics`` serves the fabobs data-plane series live (batcher,
+        ladder rungs, serve requests, registry warm, faults, retries)
+        and ``/healthz`` runs the sidecar's registered checkers.  The
+        obs registry and the ops provider are the SAME PrometheusProvider
+        — first enabler wins, so a process already observed (env
+        FABRIC_TPU_OBS) mounts its existing provider."""
+        from fabric_tpu.operations import Options as OpsOptions, System
+
+        reg = fabobs.active()
+        if reg is not None:
+            system = System(
+                OpsOptions(
+                    listen_address=self.ops_address, provider=reg.provider
+                )
+            )
+        else:
+            system = System(OpsOptions(listen_address=self.ops_address))
+            fabobs.ensure_enabled(provider=system.provider)
+        self._register_health_checkers(system)
+        addr = system.start()
+        self.ops = system
+        self.ops_address = addr
+        logger.info("sidecar ops plane on %s (/metrics /healthz)", addr)
+        return addr
+
+    def _register_health_checkers(self, system) -> None:
+        """The sidecar's /healthz surface (healthz checker contract:
+        raise = unhealthy): batcher alive, registry warm, EC pool not in
+        cooldown, listener accepting."""
+
+        def batcher_check():
+            if self._stopping:
+                raise RuntimeError("sidecar is stopping")
+            thread = getattr(self.batcher, "_thread", None)
+            if getattr(self.batcher, "_stopped", False) or (
+                thread is not None and not thread.is_alive()
+            ):
+                raise RuntimeError("verify batcher is stopped or dead")
+
+        def registry_check():
+            if self.warm_ladder != "off" and (
+                self.registry is None or not self.registry.warmed
+            ):
+                raise RuntimeError(
+                    f"bucket registry not warmed (ladder {self.warm_ladder})"
+                )
+
+        def pool_check():
+            from fabric_tpu.crypto.bccsp import ec_pool_ready
+
+            if not ec_pool_ready():
+                raise RuntimeError(
+                    "EC verify pool is in rebuild cooldown (serving inline)"
+                )
+
+        def listener_check():
+            if self._listener is None or self._stopping:
+                raise RuntimeError("sidecar listener is not accepting")
+
+        system.register_checker("batcher", batcher_check)
+        system.register_checker("registry", registry_check)
+        system.register_checker("ec-pool", pool_check)
+        system.register_checker("listener", listener_check)
 
     def _accept_loop(self) -> None:
         while not self._stopping:
@@ -278,6 +396,7 @@ class SidecarServer:
                 target=self._serve_conn, args=(conn,),
                 name="serve-conn", daemon=True,
             )
+            fabobs.obs_count("fabric_serve_connections_total", event="open")
             with self._conn_lock:
                 if self._stopping:
                     conn.close()
@@ -310,6 +429,7 @@ class SidecarServer:
                     self._threads.remove(threading.current_thread())
                 except ValueError:
                     pass
+            fabobs.obs_count("fabric_serve_connections_total", event="close")
 
     def _serve_conn_inner(self, conn: socket.socket) -> None:
         # one writer lock per connection: verify requests settle on
@@ -399,7 +519,8 @@ class SidecarServer:
             # chaos seam: an injected dispatch fault fails THIS request
             # with ST_ERROR before any batcher state is touched
             fault_point("serve.dispatch")
-            keys, sigs, digests = self._decode_lanes(payload)
+            with fabobs.span("serve.decode", req_id=req_id):
+                keys, sigs, digests = self._decode_lanes(payload)
             if self._stopping:
                 self.stats.stopping_reply()
                 self._reply_status(conn, req_id, proto.ST_STOPPING, send_lock=send_lock)
@@ -413,7 +534,8 @@ class SidecarServer:
                     send_lock=send_lock,
                 )
                 return
-            mask = resolver()
+            with fabobs.span("serve.verify", req_id=req_id, lanes=len(keys)):
+                mask = resolver()
             if self._stopping:
                 # the batcher may have settled this request fail-closed
                 # during shutdown; an OK here could carry guessed
@@ -528,6 +650,7 @@ class SidecarServer:
             "warm": self.warm_report,
             "stats": self.stats.summary(),
             "stopping": self._stopping,
+            "ops_address": self.ops_address if self.ops is not None else None,
         }
         if self.registry is not None:
             out["registry"] = self.registry.stats()
@@ -542,7 +665,35 @@ class SidecarServer:
             if self._stopping:
                 return
             self._stopping = True
+        if self.ops is not None:
+            try:
+                self.ops.stop()
+            except Exception as exc:  # noqa: BLE001 - ops teardown best-effort
+                logger.warning("ops server stop failed (%s)", exc)
         if self._listener is not None:
+            # close() alone does NOT wake a thread blocked in accept()
+            # (the syscall keeps blocking on the detached fd — every
+            # stop used to eat the full 2s join timeout on the accept
+            # thread, ~25s across the serve test suite): shutdown the
+            # listener first, then poke it with a throwaway connect so
+            # the accept loop observes the stop NOW on platforms where
+            # shutdown on a listening socket is a no-op
+            try:
+                self._listener.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                family, target = parse_address(self.address)
+                poke = socket.socket(family, socket.SOCK_STREAM)
+                poke.settimeout(0.2)
+                try:
+                    poke.connect(target)
+                except OSError:
+                    pass
+                finally:
+                    poke.close()
+            except OSError:
+                pass
             try:
                 self._listener.close()
             except OSError:
@@ -616,6 +767,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     )
     ap.add_argument("--max-pending-lanes", type=int, default=65536)
     ap.add_argument("--linger-ms", type=float, default=2.0)
+    ap.add_argument(
+        "--ops-address", default=os.environ.get("FABRIC_TPU_OPS_ADDR", ""),
+        help="mount the operations HTTP server (/metrics /healthz) on "
+        "host:port ('127.0.0.1:0' = loopback ephemeral); empty = off",
+    )
     args = ap.parse_args(argv)
 
     buckets = (
@@ -631,14 +787,22 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         linger_s=args.linger_ms / 1000.0,
         warm_ladder=args.warm,
         aot_dir=args.aot_dir or None,
+        ops_address=args.ops_address or None,
     )
     warm = server.warm()
     addr = server.start()
-    # the READY line is the contract with scripts/serve_gate.sh and the
-    # warm-restart test: one JSON line, stdout, after warm-up completes
+    # the READY line is the contract with scripts/serve_gate.sh,
+    # scripts/obs_gate.sh (reads ops_address) and the warm-restart
+    # test: one JSON line, stdout, after warm-up completes
     print(
         "SERVE_READY " + json.dumps(
-            {"address": addr, "warm": warm}, sort_keys=True
+            {
+                "address": addr,
+                "ops_address": server.ops_address
+                if server.ops is not None else None,
+                "warm": warm,
+            },
+            sort_keys=True,
         ),
         flush=True,
     )

@@ -138,12 +138,17 @@ LANE_FILES = (
 #: serve/ joined with the sidecar (PR 8): the client shim's degrade
 #: path RE-DERIVES the mask in-process on sidecar death, so its
 #: handlers are as mask-load-bearing as the validator's own.
+#: common/fabobs.py joined with the observability registry (PR 10): its
+#: hooks run INSIDE every mask-critical seam, so the tier proves the
+#: wrappers themselves never write a flag or fail open — obs code must
+#: be provably unable to alter masks, not just trusted not to.
 MASK_TIER = (
     "*fabric_tpu/validation/*.py",
     "*fabric_tpu/ledger/txparse.py",
     "*fabric_tpu/parallel/*.py",
     "*fabric_tpu/peer/pipeline.py",
     "*fabric_tpu/serve/*.py",
+    "*fabric_tpu/common/fabobs.py",
 )
 
 #: Hardcoded literal -> the canonical name that should be imported.

@@ -17,6 +17,12 @@
 #   7. serve_gate.sh           -- resident sidecar smoke: subprocess
 #                                 server, mixed batch through the client
 #                                 shim bit-exact, clean SHUTDOWN
+#   8. obs_gate.sh            -- observability smoke: sidecar + mounted
+#                                 ops server, every canonical metric
+#                                 family live on /metrics, /healthz
+#                                 flips on batcher death, chaos
+#                                 scorecard byte-identical under
+#                                 instrumentation
 #
 # Each stage runs even if an earlier one failed (one run reports ALL
 # broken gates) and prints its wall-clock time; the exit code is nonzero
@@ -42,18 +48,19 @@ run_stage() {
     echo "-- ${label}: $((SECONDS - t0))s"
 }
 
-run_stage "1/7 compileall" timeout -k 5 120 python -m compileall -q fabric_tpu
-run_stage "2/7 collect_gate" bash scripts/collect_gate.sh
+run_stage "1/8 compileall" timeout -k 5 120 python -m compileall -q fabric_tpu
+run_stage "2/8 collect_gate" bash scripts/collect_gate.sh
 # the linters' human output already prints findings as
 # path:line:col: rule: message — no JSON round-trip needed
-run_stage "3/7 fablint" timeout -k 5 60 python -m fabric_tpu.tools.fablint fabric_tpu/
-run_stage "4/7 fabdep" timeout -k 5 60 python -m fabric_tpu.tools.fabdep fabric_tpu/
-run_stage "5/7 fabflow" timeout -k 5 120 python -m fabric_tpu.tools.fabflow fabric_tpu/
-run_stage "6/7 chaos_gate" bash scripts/chaos_gate.sh
-run_stage "7/7 serve_gate" bash scripts/serve_gate.sh
+run_stage "3/8 fablint" timeout -k 5 60 python -m fabric_tpu.tools.fablint fabric_tpu/
+run_stage "4/8 fabdep" timeout -k 5 60 python -m fabric_tpu.tools.fabdep fabric_tpu/
+run_stage "5/8 fabflow" timeout -k 5 120 python -m fabric_tpu.tools.fabflow fabric_tpu/
+run_stage "6/8 chaos_gate" bash scripts/chaos_gate.sh
+run_stage "7/8 serve_gate" bash scripts/serve_gate.sh
+run_stage "8/8 obs_gate" bash scripts/obs_gate.sh
 
 if [ "$fail" -ne 0 ]; then
     echo "ci_gate: FAIL (stages:${failed_stages})" >&2
     exit 1
 fi
-echo "ci_gate: OK (compileall + collect + fablint + fabdep + fabflow + chaos + serve)"
+echo "ci_gate: OK (compileall + collect + fablint + fabdep + fabflow + chaos + serve + obs)"

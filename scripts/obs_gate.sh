@@ -1,0 +1,153 @@
+#!/usr/bin/env bash
+# obs_gate: the observability smoke (~10-15s, jax-free).
+#
+#   1. In-process sidecar with the ops server mounted and a fault plan
+#      armed: drive one mixed verify batch through the client shim,
+#      scrape /metrics and require EVERY family in the canonical fabobs
+#      table present, with sane values on the exercised seams (serve
+#      requests, ladder rung lanes, batcher launches, dispatch retry,
+#      fault fire).  /healthz must be 200; after killing the batcher it
+#      must flip 503 naming the "batcher" checker.
+#   2. Replay the fabchaos smoke twice — once bare, once with
+#      FABRIC_TPU_OBS=1 — and byte-diff the deterministic scorecards:
+#      instrumentation must change NOTHING the determinism gate sees.
+set -uo pipefail
+
+cd "$(dirname "$0")/.."
+
+timeout -k 5 60 python - <<'EOF'
+import hashlib
+import json
+import re
+import sys
+import tempfile
+import urllib.error
+import urllib.request
+
+from fabric_tpu.common import der, fabobs
+from fabric_tpu.common.faults import FaultPlan, plan_installed
+from fabric_tpu.crypto import hostec
+from fabric_tpu.crypto.bccsp import ECDSAPublicKey, SoftwareProvider
+from fabric_tpu.serve.client import SidecarProvider
+from fabric_tpu.serve.server import SidecarServer
+
+tmp = tempfile.mkdtemp(prefix="obs_gate_")
+with fabobs.obs_installed(dump_dir=tmp):
+    server = SidecarServer(
+        f"{tmp}/obs_gate.sock", engine="host", ops_address="127.0.0.1:0",
+    )
+    try:
+        server.warm()
+        addr = server.start()
+        ops = server.ops_address
+        assert server.ops is not None, "ops server did not mount"
+
+        # mixed valid/invalid batch (the serve_gate lane recipe), with a
+        # one-shot dispatch fault armed so the retry + fault-fire
+        # families move too (the batcher's bounded retry rides it out)
+        d_priv = 0x0B5
+        pub = ECDSAPublicKey(*hostec.scalar_base_mult(d_priv))
+        keys, sigs, digests, expected = [], [], [], []
+        for i in range(48):
+            digest = hashlib.sha256(b"obs gate lane %d" % i).digest()
+            r, s = hostec.sign_digest(d_priv, digest)
+            sig = der.marshal_signature(r, s)
+            if i % 3 == 1:
+                bad = bytearray(sig); bad[-1] ^= 0x5A; sig = bytes(bad)
+            elif i % 3 == 2:
+                sig = b"\x00garbage"
+            keys.append(pub); sigs.append(sig); digests.append(digest)
+            expected.append(i % 3 == 0)
+        provider = SidecarProvider(address=addr)
+        with plan_installed(FaultPlan.parse("batcher.dispatch=raise:1.0:max=1")):
+            mask = provider.batch_verify(keys, sigs, digests)
+        assert list(mask) == expected, f"mask != ground truth: {mask}"
+        assert not provider.degraded, "batch was served in-process"
+        assert list(mask) == list(
+            SoftwareProvider().batch_verify(keys, sigs, digests)
+        ), "sidecar mask != in-process mask"
+
+        with urllib.request.urlopen(f"http://{ops}/metrics") as resp:
+            text = resp.read().decode()
+
+        missing = [
+            s.name for s in fabobs.CANONICAL_METRICS
+            if f"# TYPE {s.name} {s.kind}" not in text
+        ]
+        assert not missing, f"families missing from /metrics: {missing}"
+
+        def value(pattern):
+            m = re.search(pattern + r"\}? (\d+(?:\.\d+)?)", text)
+            return float(m.group(1)) if m else None
+
+        checks = {
+            'fabric_serve_requests_total{status="ok"': (1, None),
+            'fabric_serve_lanes_total': (48, None),
+            'fabric_batcher_launches_total{mode="coalesce"': (1, None),
+            'fabric_batcher_dispatch_retries_total': (1, 1),
+            'fabric_fault_fired_total{site="batcher.dispatch"': (1, 1),
+            'fabric_retry_attempts_total': (1, None),
+            'fabric_serve_connections_total{event="open"': (1, None),
+        }
+        for key, (lo, hi) in checks.items():
+            v = value(re.escape(key))
+            assert v is not None and v >= lo and (hi is None or v <= hi), (
+                f"{key}: got {v}, wanted >= {lo}"
+                + (f" and <= {hi}" if hi is not None else "")
+            )
+        rung = re.search(r'fabric_verify_lanes_total\{rung="(\w+)"\} (\d+)', text)
+        assert rung and int(rung.group(2)) >= 48 + 8, (  # batch + warm lanes
+            f"ladder rung lanes missing: {rung}"
+        )
+
+        with urllib.request.urlopen(f"http://{ops}/healthz") as resp:
+            assert json.load(resp)["status"] == "OK"
+        with urllib.request.urlopen(f"http://{ops}/trace") as resp:
+            trace = json.load(resp)
+        assert any(e["name"] == "serve.verify" for e in trace["traceEvents"])
+
+        server.batcher.stop()
+        try:
+            urllib.request.urlopen(f"http://{ops}/healthz")
+            raise SystemExit("healthz stayed 200 after batcher death")
+        except urllib.error.HTTPError as err:
+            assert err.code == 503, err.code
+            failed = {c["component"] for c in json.load(err)["failed_checks"]}
+            assert "batcher" in failed, failed
+        print(
+            f"obs_gate: /metrics all {len(fabobs.CANONICAL_METRICS)} canonical "
+            f"families live (rung {rung.group(1)}), healthz 200->503[batcher]"
+        )
+    finally:
+        server.stop()
+        import shutil
+        shutil.rmtree(tmp, ignore_errors=True)
+EOF
+rc=$?
+if [ $rc -ne 0 ]; then
+    echo "obs_gate: sidecar/metrics smoke FAILED" >&2
+    exit $rc
+fi
+
+# -- 2. instrumentation must not move the deterministic chaos scorecard --
+seed="${FABCHAOS_SEED:-7}"
+out_bare=$(mktemp /tmp/obsgate.XXXXXX.json)
+out_obs=$(mktemp /tmp/obsgate.XXXXXX.json)
+trap 'rm -f "$out_bare" "$out_obs"' EXIT
+
+if ! timeout -k 5 30 env -u FABRIC_TPU_OBS python -m fabric_tpu.tools.fabchaos \
+        --seed "$seed" --scenario smoke --quiet > "$out_bare"; then
+    echo "obs_gate: bare chaos smoke FAILED (seed $seed)" >&2
+    exit 1
+fi
+if ! timeout -k 5 30 env FABRIC_TPU_OBS=1 python -m fabric_tpu.tools.fabchaos \
+        --seed "$seed" --scenario smoke --quiet > "$out_obs"; then
+    echo "obs_gate: observed chaos smoke FAILED (seed $seed)" >&2
+    exit 1
+fi
+if ! cmp -s "$out_bare" "$out_obs"; then
+    echo "obs_gate: instrumentation CHANGED the deterministic scorecard" >&2
+    diff "$out_bare" "$out_obs" >&2 || true
+    exit 1
+fi
+echo "obs_gate: OK (canonical families live, healthz flips, chaos scorecard byte-identical under instrumentation)"

@@ -1,0 +1,544 @@
+"""fabobs: process-wide observability registry (metrics SPI + spans +
+flight recorder) and its wiring through the validation data plane.
+
+Discipline mirrors tests/test_faults.py: the disabled path is a no-op,
+installation is scoped, and — the mask-safety contract — an
+observability failure can never raise into (or alter) a verify path.
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from fabric_tpu.common import fabobs
+from fabric_tpu.common.fabobs import (
+    CANONICAL_METRICS,
+    CANONICAL_BY_NAME,
+    ObsRegistry,
+    obs_installed,
+)
+from fabric_tpu.common.faults import FaultPlan, InjectedFault, plan_installed
+from fabric_tpu.common.metrics import (
+    DisabledProvider,
+    HistogramOpts,
+    PrometheusProvider,
+    new_histogram_state,
+    observe_into,
+    summary_from_histogram_state,
+)
+
+
+@pytest.fixture(autouse=True)
+def _no_ambient_obs():
+    """Every test starts and ends with the registry disabled (an
+    env-enabled run must not leak series between tests)."""
+    prev = fabobs.active()
+    fabobs.disable()
+    yield
+    fabobs.disable()
+    if prev is not None:
+        with fabobs._OBS_LOCK:
+            fabobs._OBS = prev
+
+
+# ---------------- disabled path ----------------
+
+
+def test_disabled_hooks_are_noops():
+    assert not fabobs.enabled()
+    fabobs.obs_count("fabric_verify_lanes_total", 5, rung="hostec")
+    fabobs.obs_gauge("fabric_batcher_pending_lanes", 1)
+    fabobs.obs_observe("fabric_verify_seconds", 0.1, rung="hostec")
+    fabobs.obs_event("anything")
+    assert fabobs.obs_trigger("anything") is None
+    assert fabobs.snapshot() == {}
+    s = fabobs.span("x", lanes=3)
+    with s:
+        pass
+    # the shared no-op span: no allocation per call
+    assert fabobs.span("y") is fabobs.span("z")
+
+
+def test_disabled_span_is_reentrant():
+    s = fabobs.span("x")
+    with s:
+        with s:
+            pass
+
+
+# ---------------- installation ----------------
+
+
+def test_obs_installed_scopes_and_restores():
+    assert fabobs.active() is None
+    with obs_installed() as reg:
+        assert fabobs.active() is reg
+        assert fabobs.enabled()
+        inner = ObsRegistry()
+        with obs_installed(inner):
+            assert fabobs.active() is inner
+        assert fabobs.active() is reg
+    assert fabobs.active() is None
+
+
+def test_ensure_enabled_first_wins():
+    with obs_installed() as reg:
+        again = fabobs.ensure_enabled(provider=PrometheusProvider())
+        assert again is reg  # existing registry kept, new provider ignored
+
+
+def test_env_install_semantics(monkeypatch):
+    monkeypatch.setenv("FABRIC_TPU_OBS", "0")
+    fabobs._install_from_env()
+    assert not fabobs.enabled()
+    monkeypatch.setenv("FABRIC_TPU_OBS", "1")
+    monkeypatch.setenv("FABRIC_TPU_OBS_RING", "notanint")  # degrade, no raise
+    fabobs._install_from_env()
+    assert fabobs.enabled()
+    fabobs.disable()
+
+
+# ---------------- canonical table + metric sinks ----------------
+
+
+def test_every_canonical_family_registers_eagerly():
+    with obs_installed() as reg:
+        text = reg.render()
+        for spec in CANONICAL_METRICS:
+            assert f"# TYPE {spec.name} {spec.kind}" in text
+        # table introspection (README generation surface)
+        rows = fabobs.metric_table()
+        assert {r["name"] for r in rows} == set(CANONICAL_BY_NAME)
+
+
+def test_counter_gauge_histogram_record():
+    with obs_installed() as reg:
+        fabobs.obs_count("fabric_verify_lanes_total", 64, rung="hostec_np")
+        fabobs.obs_count("fabric_verify_lanes_total", 36, rung="hostec_np")
+        fabobs.obs_gauge("fabric_batcher_pending_lanes", 17)
+        fabobs.obs_observe("fabric_verify_seconds", 0.03, rung="hostec_np")
+        text = reg.render()
+        assert 'fabric_verify_lanes_total{rung="hostec_np"} 100' in text
+        assert "fabric_batcher_pending_lanes 17" in text
+        assert 'fabric_verify_seconds_count{rung="hostec_np"} 1' in text
+        snap = reg.snapshot()
+        assert snap["fabric_verify_lanes_total"]["series"]["rung=hostec_np"] == 100
+        hist = snap["fabric_verify_seconds"]["series"]["rung=hostec_np"]
+        assert hist["n"] == 1
+
+
+def test_unknown_family_and_bad_labels_swallowed():
+    with obs_installed() as reg:
+        fabobs.obs_count("not_in_the_table")
+        fabobs.obs_count("fabric_verify_lanes_total", 1, wrong_label="x")
+        assert reg.dropped >= 1  # bad labels accounted
+        # neither call raised, and the good series still works
+        fabobs.obs_count("fabric_verify_lanes_total", 1, rung="p256")
+        assert 'rung="p256"} 1' in reg.render()
+
+
+def test_obs_failure_cannot_raise_into_caller():
+    class ExplodingProvider(PrometheusProvider):
+        def new_counter(self, opts):
+            raise RuntimeError("boom")
+
+        def new_gauge(self, opts):
+            raise RuntimeError("boom")
+
+        def new_histogram(self, opts):
+            raise RuntimeError("boom")
+
+    with obs_installed(ObsRegistry(provider=ExplodingProvider())) as reg:
+        # construction swallowed every family; sinks still no-op cleanly
+        fabobs.obs_count("fabric_verify_lanes_total", 1, rung="hostec")
+        fabobs.obs_gauge("fabric_batcher_pending_lanes", 1)
+        with fabobs.span("still.works"):
+            pass
+        assert reg.dropped >= len(CANONICAL_METRICS)
+
+
+def test_counter_threads_sum_exactly():
+    with obs_installed() as reg:
+        def hammer():
+            for _ in range(500):
+                fabobs.obs_count("fabric_retry_attempts_total")
+
+        threads = [threading.Thread(target=hammer) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert "fabric_retry_attempts_total 4000" in reg.render()
+
+
+# ---------------- spans + flight recorder ----------------
+
+
+def test_span_nesting_and_trace_dump():
+    with obs_installed() as reg:
+        with fabobs.span("outer", kind="test") as outer:
+            with fabobs.span("inner") as inner:
+                time.sleep(0.002)
+            assert inner.parent_id == outer.span_id
+        events = reg.trace_events()
+        names = [e["name"] for e in events]
+        assert names == ["inner", "outer"]  # completion order
+        inner_ev = events[0]
+        assert inner_ev["ph"] == "X"
+        assert inner_ev["dur"] >= 1000  # us
+        payload = json.loads(reg.dump())
+        assert payload["traceEvents"][1]["args"]["kind"] == "test"
+        assert payload["displayTimeUnit"] == "ms"
+
+
+def test_span_exception_annotated_and_propagated():
+    with obs_installed() as reg:
+        with pytest.raises(ValueError):
+            with fabobs.span("failing"):
+                raise ValueError("real error passes through")
+        (ev,) = reg.trace_events()
+        assert ev["args"]["error"] == "ValueError"
+        assert fabobs.current_span() is None  # stack popped
+
+
+def test_cross_thread_parent_propagation():
+    with obs_installed() as reg:
+        captured = {}
+
+        def worker(parent):
+            with fabobs.span("child", parent=parent) as c:
+                captured["parent_id"] = c.parent_id
+
+        with fabobs.span("root") as root:
+            t = threading.Thread(target=worker, args=(root,))
+            t.start()
+            t.join()
+        assert captured["parent_id"] == root.span_id
+
+
+def test_flight_ring_is_bounded():
+    with obs_installed(ObsRegistry(ring=32)) as reg:
+        for i in range(100):
+            fabobs.obs_event("tick", i=i)
+        events = reg.trace_events()
+        assert len(events) == 32
+        assert events[-1]["args"]["i"] == 99  # newest win
+
+
+def test_trigger_dumps_bounded_files(tmp_path):
+    reg = ObsRegistry(dump_dir=str(tmp_path), max_dumps=2)
+    with obs_installed(reg):
+        fabobs.obs_event("before the fall")
+        p1 = fabobs.obs_trigger("batcher.fail_closed", requests=3)
+        p2 = fabobs.obs_trigger("serve.client_degraded")
+        p3 = fabobs.obs_trigger("one too many")
+        assert p1 and p2 and p3 is None  # capped
+        assert reg.dumped_paths() == [p1, p2]
+        payload = json.loads(open(p1).read())
+        names = [e["name"] for e in payload["traceEvents"]]
+        assert "before the fall" in names
+        assert "trigger:batcher.fail_closed" in names
+
+
+def test_trigger_without_dump_dir_records_event_only():
+    with obs_installed() as reg:
+        assert fabobs.obs_trigger("no.dir") is None
+        assert reg.trace_events()[-1]["name"] == "trigger:no.dir"
+
+
+# ---------------- histogram-state summary (metrics helper) ----------------
+
+
+def test_summary_from_histogram_state():
+    buckets = (0.001, 0.01, 0.1, 1.0)
+    state = new_histogram_state(buckets)
+    assert summary_from_histogram_state(state, buckets) == {"n": 0}
+    for v in (0.0005, 0.005, 0.005, 0.05, 5.0):
+        observe_into(state, buckets, v)
+    out = summary_from_histogram_state(state, buckets)
+    assert out["n"] == 5
+    assert out["p50_ms"] == 10.0  # 0.01 bucket upper bound
+    assert out["mean_ms"] == pytest.approx(1012.1, abs=0.1)
+    # the rank lands in the +Inf bucket: report a lower bound on THAT
+    # bucket's mean — never below the top finite bound, never the
+    # global mean (which would hide the very tail +Inf recorded)
+    assert out["p99_ms"] >= 1000.0
+    assert out["p99_ms"] == pytest.approx(1060.5, abs=0.1)
+    # a tail-heavy series must not report p99 under the ladder top
+    tail = new_histogram_state(buckets)
+    for _ in range(99):
+        observe_into(tail, buckets, 0.001)
+    observe_into(tail, buckets, 100.0)
+    assert summary_from_histogram_state(tail, buckets)["p99_ms"] >= 1000.0
+
+
+# ---------------- data-plane wiring ----------------
+
+
+class _StubProvider:
+    """Provider whose batches verify (lane % 2 == 0)."""
+
+    def batch_verify(self, keys, sigs, digests):
+        return [k % 2 == 0 for k in keys]
+
+
+def test_batcher_emits_canonical_series():
+    from fabric_tpu.parallel.batcher import VerifyBatcher
+
+    with obs_installed() as reg:
+        b = VerifyBatcher(_StubProvider(), max_pending_lanes=64)
+        try:
+            resolver = b.submit(list(range(8)), [b""] * 8, [b""] * 8)
+            assert resolver() == [True, False] * 4
+        finally:
+            b.stop()
+        text = reg.render()
+        assert 'fabric_batcher_launches_total{mode="coalesce"} 1' in text
+        assert "fabric_batcher_batch_lanes_count 1" in text
+        assert "fabric_batcher_submit_wait_seconds_count 1" in text
+
+
+def test_batcher_busy_reject_counted():
+    from fabric_tpu.parallel.batcher import VerifyBatcher
+
+    class _Slow:
+        def batch_verify(self, keys, sigs, digests):
+            time.sleep(0.2)
+            return [True] * len(keys)
+
+    with obs_installed() as reg:
+        b = VerifyBatcher(_Slow(), max_pending_lanes=4, linger_s=0.05)
+        try:
+            b.submit([1, 2, 3], [b""] * 3, [b""] * 3)
+            assert b.try_submit([1, 2, 3], [b""] * 3, [b""] * 3) is None
+        finally:
+            b.stop()
+        assert "fabric_batcher_busy_rejects_total 1" in reg.render()
+
+
+def test_batcher_fail_closed_counted_and_triggers_dump(tmp_path):
+    from fabric_tpu.parallel.batcher import VerifyBatcher
+
+    hang = threading.Event()
+
+    class _Hung:
+        def batch_verify_async(self, keys, sigs, digests):
+            def resolve():
+                hang.wait(5.0)
+                return [True] * len(keys)
+
+            return resolve
+
+    reg = ObsRegistry(dump_dir=str(tmp_path), max_dumps=4)
+    with obs_installed(reg):
+        b = VerifyBatcher(_Hung(), join_timeout_s=0.2)
+        r = b.submit([1], [b""], [b""])
+        time.sleep(0.05)  # let the dispatcher pick it up
+        b.stop()
+        hang.set()
+        assert r() == [False]  # settled fail-closed
+        assert "fabric_batcher_fail_closed_total 1" in reg.render()
+        assert len(reg.dumped_paths()) == 1  # trigger dumped the ring
+
+
+def test_bccsp_rung_series():
+    from fabric_tpu.crypto.bccsp import SoftwareProvider, ec_backend_name
+
+    from fabric_tpu.common import der, p256
+    from fabric_tpu.crypto import hostec
+    import hashlib
+
+    d = 0xA11CE
+    pub_pt = hostec.scalar_base_mult(d)
+    from fabric_tpu.crypto.bccsp import ECDSAPublicKey
+
+    digest = hashlib.sha256(b"obs lane").digest()
+    r, s = hostec.sign_digest(d, digest)
+    sig = der.marshal_signature(r, s)
+    key = ECDSAPublicKey(*pub_pt)
+    with obs_installed() as reg:
+        mask = SoftwareProvider().batch_verify([key] * 4, [sig] * 4, [digest] * 4)
+        assert mask == [True] * 4
+        rung = ec_backend_name()
+        assert f'fabric_verify_lanes_total{{rung="{rung}"}} 4' in reg.render()
+
+
+def test_obs_cannot_alter_mask():
+    """The mask-safety contract, empirically: a registry whose every
+    series write explodes must not change one verdict bit of a batch
+    routed through the instrumented provider path."""
+    from fabric_tpu.crypto.bccsp import SoftwareProvider
+
+    provider = SoftwareProvider()
+    keys = [None] * 3
+    sigs = [b"\x00bad"] * 3
+    digests = [b"\x00" * 32] * 3
+    baseline = provider.batch_verify(keys, sigs, digests)
+
+    reg = ObsRegistry()
+
+    def explode(*a, **k):
+        raise RuntimeError("series write exploded")
+
+    for inst in reg._instruments.values():
+        for attr in ("add", "observe", "set", "with_labels"):
+            if hasattr(inst, attr):
+                setattr(inst, attr, explode)
+    with obs_installed(reg):
+        mask = provider.batch_verify(keys, sigs, digests)
+    assert mask == baseline == [False, False, False]
+    assert reg.dropped > 0
+
+
+def test_pipeline_stage_stats_and_series():
+    from fabric_tpu.peer.pipeline import CommitPipeline
+    from fabric_tpu.protos import common_pb2
+
+    class _Chan:
+        channel_id = "obs-ch"
+
+        def prepare_block(self, block):
+            return "prep"
+
+        def store_block(self, block, prepared=None):
+            return "flags"
+
+    with obs_installed() as reg:
+        p = CommitPipeline(_Chan())
+        try:
+            for n in range(3):
+                blk = common_pb2.Block()
+                blk.header.number = n
+                p.submit(blk)
+            assert p.drain(5.0)
+        finally:
+            p.stop()
+        stats = p.stage_stats()
+        assert stats["prepare"]["n"] == 3
+        assert stats["commit"]["n"] == 3
+        assert stats["commit"]["p50_ms"] >= 0
+        text = reg.render()
+        assert 'fabric_pipeline_stage_seconds_count{stage="prepare"} 3' in text
+        assert 'fabric_pipeline_stage_seconds_count{stage="commit"} 3' in text
+
+
+def test_fault_fires_counted():
+    from fabric_tpu.common.faults import fault_point
+
+    with obs_installed() as reg:
+        with plan_installed(FaultPlan.parse("obs.site=raise:1.0:max=2")):
+            for _ in range(3):
+                try:
+                    fault_point("obs.site")
+                except InjectedFault:
+                    pass
+        assert 'fabric_fault_fired_total{site="obs.site"} 2' in reg.render()
+
+
+def test_retry_attempts_counted():
+    from fabric_tpu.common.retry import RetryPolicy, call_with_retry
+
+    calls = {"n": 0}
+
+    def flaky(attempt):
+        calls["n"] += 1
+        if attempt < 2:
+            raise ConnectionError("flap")
+        return "ok"
+
+    with obs_installed() as reg:
+        out = call_with_retry(
+            flaky,
+            policy=RetryPolicy(base_s=0.001, max_attempts=5),
+            sleeper=lambda s: None,
+        )
+        assert out == "ok" and calls["n"] == 3
+        text = reg.render()
+        assert "fabric_retry_attempts_total 2" in text
+        assert "fabric_retry_backoff_seconds_count 2" in text
+
+
+def test_serve_stats_emits_spi_series():
+    from fabric_tpu.serve.server import ServeStats
+
+    with obs_installed() as reg:
+        stats = ServeStats()
+        stats.record(lanes=128, bucket=128, seconds=0.004)
+        stats.record(lanes=64, bucket=128, seconds=0.002)
+        stats.reject()
+        stats.error()
+        stats.stopping_reply()
+        # the exact local summary API is unchanged...
+        summary = stats.summary()
+        assert summary["requests"] == 2 and summary["rejects"] == 1
+        assert summary["request_latency"]["n"] == 2
+        # ...and the same calls drove the SPI series
+        text = reg.render()
+        assert 'fabric_serve_requests_total{status="ok"} 2' in text
+        assert 'fabric_serve_requests_total{status="busy"} 1' in text
+        assert 'fabric_serve_requests_total{status="error"} 1' in text
+        assert 'fabric_serve_requests_total{status="stopping"} 1' in text
+        assert "fabric_serve_lanes_total 192" in text
+        assert 'fabric_serve_bucket_requests_total{bucket="128"} 2' in text
+
+
+def test_sidecar_ops_mount_metrics_and_healthz(tmp_path):
+    """The acceptance-criteria path: a sidecar with obs enabled answers
+    /metrics with the canonical families and /healthz flips 503 with the
+    named checker when the batcher dies."""
+    import urllib.error
+    import urllib.request
+
+    from fabric_tpu.serve.client import SidecarProvider
+    from fabric_tpu.serve.server import SidecarServer
+
+    with obs_installed():
+        server = SidecarServer(
+            str(tmp_path / "obs.sock"), engine="host",
+            ops_address="127.0.0.1:0",
+        )
+        try:
+            server.warm()
+            addr = server.start()
+            ops = server.ops_address
+            assert server.ops is not None
+
+            import hashlib
+
+            from fabric_tpu.common import der
+            from fabric_tpu.crypto import hostec
+            from fabric_tpu.crypto.bccsp import ECDSAPublicKey
+
+            d = 0xB0B
+            pub = ECDSAPublicKey(*hostec.scalar_base_mult(d))
+            digest = hashlib.sha256(b"ops lane").digest()
+            r, s = hostec.sign_digest(d, digest)
+            sig = der.marshal_signature(r, s)
+            provider = SidecarProvider(address=addr)
+            mask = provider.batch_verify([pub] * 8, [sig] * 8, [digest] * 8)
+            assert mask == [True] * 8
+
+            with urllib.request.urlopen(f"http://{ops}/metrics") as resp:
+                text = resp.read().decode()
+            for spec in CANONICAL_METRICS:
+                assert f"# TYPE {spec.name}" in text
+            assert 'fabric_serve_requests_total{status="ok"} 1' in text
+            with urllib.request.urlopen(f"http://{ops}/healthz") as resp:
+                assert json.load(resp)["status"] == "OK"
+            # the flight recorder is served on demand
+            with urllib.request.urlopen(f"http://{ops}/trace") as resp:
+                trace = json.load(resp)
+            assert any(
+                e["name"] == "serve.verify" for e in trace["traceEvents"]
+            )
+
+            server.batcher.stop()
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(f"http://{ops}/healthz")
+            payload = json.load(exc.value)
+            failed = {c["component"] for c in payload["failed_checks"]}
+            assert "batcher" in failed
+        finally:
+            server.stop()
