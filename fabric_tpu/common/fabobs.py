@@ -580,7 +580,7 @@ class ObsRegistry:
             with self._lock:
                 self.dropped += 1
             logger.debug("obs failure at %s swallowed: %s", where, exc)
-        except Exception:  # noqa: BLE001  # fablint: disable=broad-except  # last-ditch: even the swallow must not raise into a verify path
+        except Exception:  # noqa: BLE001 - last-ditch: even the swallow must not raise into a verify path
             pass
 
 

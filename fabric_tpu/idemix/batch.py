@@ -360,6 +360,14 @@ def _pool():
     return _POOL or None
 
 
+def reset_pool_cooldown() -> None:
+    """Close the rebuild cooldown and reset its ramp (harness seam:
+    fabchaos exercises the ``hostbn.pool.submit`` and
+    ``hostbn.pool.resolve`` faults back-to-back without waiting out
+    the exponential cooldown a broken-pool teardown arms)."""
+    _POOL_GATE.record_success()
+
+
 def shutdown_pool(broken: bool = False) -> None:
     """Tear the pool down; ``broken=True`` arms the rebuild cooldown
     (degrade paths only — clean teardowns leave the gate closed)."""

@@ -383,7 +383,7 @@ class SidecarProvider:
                 )
                 return mask
             if status == proto.ST_BUSY:
-                self.busy_rejects += 1  # fabdep: disable=unguarded-shared-write  # GIL-atomic add, stats only
+                self.busy_rejects += 1  # GIL-atomic add, stats only
                 delay = bo.next_delay()
                 if delay is None:
                     return self._degrade(

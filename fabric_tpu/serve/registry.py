@@ -102,11 +102,11 @@ class _CompileCounters:
             # cache hit; backend_compile duration events fire per real
             # XLA compile.  Counter writes are GIL-atomic int adds.
             if "cache_hit" in event:
-                cls.cache_hits += 1  # fabdep: disable=unguarded-shared-write  # GIL-atomic int add, monotonic counter
+                cls.cache_hits += 1  # GIL-atomic int add, monotonic counter
 
         def _on_duration(event: str, duration: float, **kwargs) -> None:
             if "backend_compile" in event:
-                cls.compiles += 1  # fabdep: disable=unguarded-shared-write  # GIL-atomic int add, monotonic counter
+                cls.compiles += 1  # GIL-atomic int add, monotonic counter
 
         jax.monitoring.register_event_listener(_on_event)
         jax.monitoring.register_event_duration_secs_listener(_on_duration)
@@ -233,7 +233,7 @@ class BucketProgramRegistry:
         counters = {"traces": 0}
 
         def traced(*args):
-            counters["traces"] += 1  # fabdep: disable=unguarded-shared-write  # GIL-atomic add; trace-time only
+            counters["traces"] += 1  # GIL-atomic add; trace-time only
             return fn(*args)
 
         def fingerprint(bucket: int) -> str:
@@ -276,7 +276,7 @@ class BucketProgramRegistry:
                 # so nothing in the process can dedupe it.  The cold
                 # path pays full price once; every restart loads the AOT.
                 def aot_traced(*args):
-                    counters["traces"] += 1  # fabdep: disable=unguarded-shared-write  # GIL-atomic add; trace-time only
+                    counters["traces"] += 1  # GIL-atomic add; trace-time only
                     return fn(*args)
 
                 aot_traced.__name__ = (

@@ -348,13 +348,14 @@ def run_verify_faults(seed: int, clock: StageClock, scale: float = 1.0):
         reqs.append(pool.lanes(rng, n))
 
     plan = FaultPlan.parse(
+        "batcher.submit=raise:0.2:max=6;"
         "batcher.dispatch=raise:0.35;bccsp.dispatch=raise:0.15:max=6",
         seed=seed,
     )
     provider = SoftwareProvider()
     from fabric_tpu.parallel.batcher import VerifyBatcher
 
-    outcomes = {"ok": 0, "injected": 0}
+    outcomes = {"ok": 0, "injected": 0, "submit_rejected": 0}
     mismatches: List[str] = []
     with plan_installed(plan):
         b = VerifyBatcher(
@@ -371,9 +372,14 @@ def run_verify_faults(seed: int, clock: StageClock, scale: float = 1.0):
             resolvers = []
             for keys, sigs, digests, expected, _kinds in reqs:
                 t0 = time.perf_counter()
-                resolvers.append(
-                    (b.submit(keys, sigs, digests), expected, t0)
-                )
+                try:
+                    # the submit seam fires BEFORE lane admission: a
+                    # rejected submit must leak nothing into pending
+                    resolver = b.submit(keys, sigs, digests)
+                except InjectedFault:
+                    outcomes["submit_rejected"] += 1
+                    continue
+                resolvers.append((resolver, expected, t0))
             for resolve, expected, t0 in resolvers:
                 try:
                     out = resolve()
@@ -392,8 +398,12 @@ def run_verify_faults(seed: int, clock: StageClock, scale: float = 1.0):
             b.stop()
     check(not mismatches, f"faulted verify flipped a verdict: {mismatches}")
     check(
-        outcomes["ok"] + outcomes["injected"] == len(reqs),
+        outcomes["ok"] + outcomes["injected"] == len(resolvers),
         "some resolvers neither settled nor raised (wedged batcher)",
+    )
+    check(
+        len(resolvers) + outcomes["submit_rejected"] == len(reqs),
+        "a submit neither returned a resolver nor raised InjectedFault",
     )
     det = {
         "requests": len(reqs),
@@ -1269,6 +1279,81 @@ def run_idemix_storm(seed: int, clock: StageClock, scale: float = 1.0):
     )
     check(list(clean) == expected, "mask corrupt AFTER the plan was removed")
 
+    # the hostbn pool seams: an injected submit failure AND a mid-batch
+    # resolve failure must each degrade to inline verification with the
+    # SAME mask (a pool death can never cost a verdict).  Env-scoped so
+    # the storm batch actually routes through the pool machinery
+    # (MIN_POOL default 64 >> the storm's lane count).
+    pool_faults: Dict[str, int] = {}
+    pool_degrade_ok = False
+    if idemix_backend_name() == "hostbn":
+        import os
+
+        from fabric_tpu.idemix import batch as idemix_batch
+
+        knobs = {
+            "FABRIC_TPU_HOSTBN_MIN_POOL": "4",
+            "FABRIC_TPU_HOSTBN_MIN_SHARD": "2",
+            "FABRIC_TPU_HOSTBN_PROCS": "2",
+        }
+        saved = {k: os.environ.get(k) for k in knobs}
+        os.environ.update(knobs)
+        try:
+            # an earlier batch in this process may have cached a pool
+            # built under the pre-knob worker count (or _POOL = False on
+            # a 1-CPU box); tear it down so _pool() re-reads the knobs
+            # and the fault seams are actually reached
+            idemix_batch.shutdown_pool()
+            idemix_batch.reset_pool_cooldown()
+            plan_pool = FaultPlan.parse(
+                "hostbn.pool.submit=raise:1.0:max=1;"
+                "hostbn.pool.resolve=raise:1.0:max=1",
+                seed=seed,
+            )
+            with plan_installed(plan_pool):
+                # leg A: submit fails before any future exists ->
+                # broken-pool teardown + inline recompute
+                out_a = clock.timed(
+                    "idemix.pool_submit_degrade",
+                    verify_signatures_batch,
+                    sigs, disclosures, world["ipk"], msgs, values,
+                    world["rh_index"],
+                )
+                check(
+                    list(out_a) == expected,
+                    f"hostbn pool submit-degrade flipped the mask: got "
+                    f"{mask_hash(out_a)} want {mask_hash(expected)}",
+                )
+                # leg B: close the cooldown the broken teardown armed,
+                # rebuild, and die mid-batch at the resolve seam
+                idemix_batch.reset_pool_cooldown()
+                out_b = clock.timed(
+                    "idemix.pool_resolve_degrade",
+                    verify_signatures_batch,
+                    sigs, disclosures, world["ipk"], msgs, values,
+                    world["rh_index"],
+                )
+                check(
+                    list(out_b) == expected,
+                    f"hostbn pool resolve-degrade flipped the mask: got "
+                    f"{mask_hash(out_b)} want {mask_hash(expected)}",
+                )
+            pool_faults = plan_pool.fired()
+            check(
+                pool_faults.get("hostbn.pool.submit", 0) == 1
+                and pool_faults.get("hostbn.pool.resolve", 0) == 1,
+                f"hostbn pool faults never armed: {pool_faults}",
+            )
+            pool_degrade_ok = True
+        finally:
+            idemix_batch.shutdown_pool()
+            idemix_batch.reset_pool_cooldown()
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
     det = {
         "backend": idemix_backend_name(),
         "lanes": n_lanes,
@@ -1278,8 +1363,9 @@ def run_idemix_storm(seed: int, clock: StageClock, scale: float = 1.0):
         "corruption_detected": True,
         "flipped_lanes": n_flipped,
         "clean_after_uninstall": True,
+        "pool_degrade_ok": pool_degrade_ok,
     }
-    return det, {"faults_fired": plan.fired()}
+    return det, {"faults_fired": plan.fired(), "pool_faults": pool_faults}
 
 
 # ---------------------------------------------------------------------------

@@ -81,7 +81,6 @@ Exit status: 0 = clean, 1 = findings, 2 = usage/IO error.
 
 from __future__ import annotations
 
-import argparse
 import ast
 import fnmatch
 import json
@@ -91,20 +90,20 @@ from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Dict, FrozenSet, Iterable, List, Optional, Sequence, Set, Tuple
 
+from fabric_tpu.tools import toolkit
+from fabric_tpu.tools.toolkit import (  # noqa: F401 - re-exported API
+    DEFAULT_EXCLUDES,
+    Finding,
+)
+
 __version__ = "1.0"
 
 # --------------------------------------------------------------------------
 # Configuration
 # --------------------------------------------------------------------------
 
-#: Generated / non-source artifacts fabdep never parses (same as fablint).
-DEFAULT_EXCLUDES = (
-    "*_pb2.py",
-    "*/__pycache__/*",
-    "*/native/*",
-    "*/protos/src/*",
-    "*/.git/*",
-)
+# Generated-artifact exclusions live in tools.toolkit.DEFAULT_EXCLUDES
+# (re-exported above), shared with fablint/fabflow/fabreg.
 
 #: rule-id -> one-line doc (the registry; passes emit by id).
 RULES: Dict[str, str] = {
@@ -160,33 +159,11 @@ LOCKISH_TOKENS = {
 #: ordered before any thread can see the instance.
 INIT_METHODS = {"__init__", "__post_init__", "__new__", "__set_name__"}
 
-_DISABLE_RE = re.compile(r"#\s*fabdep:\s*disable=([A-Za-z0-9_\-, ]+)")
 
 
 # --------------------------------------------------------------------------
 # Core data model
 # --------------------------------------------------------------------------
-
-
-@dataclass
-class Finding:
-    rule: str
-    path: str
-    line: int
-    col: int
-    message: str
-
-    def key(self) -> Tuple[str, int, int, str]:
-        return (self.path, self.line, self.col, self.rule)
-
-    def to_dict(self) -> Dict[str, object]:
-        return {
-            "rule": self.rule,
-            "path": self.path,
-            "line": self.line,
-            "col": self.col,
-            "message": self.message,
-        }
 
 
 @dataclass
@@ -249,12 +226,7 @@ class ModuleInfo:
 
 
 def parse_suppressions(source: str) -> Dict[int, Set[str]]:
-    out: Dict[int, Set[str]] = {}
-    for lineno, line in enumerate(source.splitlines(), start=1):
-        m = _DISABLE_RE.search(line)
-        if m:
-            out[lineno] = {r.strip() for r in m.group(1).split(",") if r.strip()}
-    return out
+    return toolkit.suppressed_rules(source, "fabdep")
 
 
 def _dotted(node: ast.AST) -> Optional[str]:
@@ -932,6 +904,10 @@ class Program:
         self.modules: Dict[str, ModuleInfo] = {}
         self.findings: List[Finding] = []
         self.suppressed = 0
+        #: the findings per-line suppressions absorbed (fabreg's
+        #: suppression-stale rule reads these to prove each comment
+        #: still covers a live finding)
+        self.suppressed_findings: List[Finding] = []
         # program-wide symbol tables (built in link())
         self.functions: Dict[str, FuncInfo] = {}
         self.class_methods: Dict[str, Dict[str, str]] = {}
@@ -995,6 +971,9 @@ class Program:
         disabled = info.suppressions.get(line, set())
         if rule in disabled or "all" in disabled:
             self.suppressed += 1
+            self.suppressed_findings.append(
+                Finding(rule, info.path, line, col, msg)
+            )
             return
         self.findings.append(Finding(rule, info.path, line, col, msg))
 
@@ -1572,24 +1551,42 @@ def graph_dot(program: Program, layer_map: LayerMap) -> str:
 # --------------------------------------------------------------------------
 
 
+#: rule -> the analysis pass that can emit it (for skip_unneeded_passes)
+_LAYERING_RULES = {"import-cycle", "layer-skip", "layer-unknown"}
+_CONCURRENCY_RULES = {
+    "unguarded-shared-write", "lock-order-cycle", "blocking-under-lock"
+}
+_EXPORT_RULES = {"dead-export"}
+
+
 def analyze(
     root: Path,
     layer_map: Optional[LayerMap] = None,
     ref_paths: Sequence[Path] = (),
     rule_ids: Optional[Iterable[str]] = None,
     excludes: Sequence[str] = DEFAULT_EXCLUDES,
+    skip_unneeded_passes: bool = False,
 ) -> Tuple[Program, List[Finding]]:
     """Run all passes over the package at `root`.  Returns the Program
-    (for graph output / tests) and the unsuppressed findings."""
+    (for graph output / tests) and the unsuppressed findings.
+
+    ``skip_unneeded_passes`` (opt-in: fabreg's suppression-stale rule
+    uses it) skips whole analysis passes when no active rule can come
+    from them — same unsuppressed findings, but ``program.suppressed``
+    then only counts the passes that ran, so the default keeps the
+    historical full-run accounting."""
     program = Program(root, excludes)
     program.load()
     program.link()
-    lm = layer_map or LayerMap()
-    program.layering_pass(lm)
-    program.concurrency_pass()
-    refs = load_ref_roots(ref_paths, excludes)
-    program.export_pass(refs)
     active = set(rule_ids) if rule_ids is not None else set(RULES)
+    lm = layer_map or LayerMap()
+    if not skip_unneeded_passes or active & _LAYERING_RULES:
+        program.layering_pass(lm)
+    if not skip_unneeded_passes or active & _CONCURRENCY_RULES:
+        program.concurrency_pass()
+    if not skip_unneeded_passes or active & _EXPORT_RULES:
+        refs = load_ref_roots(ref_paths, excludes)
+        program.export_pass(refs)
     findings = [
         f for f in program.findings
         if f.rule in active or f.rule == "io-error"
@@ -1615,26 +1612,21 @@ def default_ref_paths(root: Path) -> List[Path]:
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
-    parser = argparse.ArgumentParser(
-        prog="fabdep",
-        description="whole-program import-layering + concurrency analyzer "
+    parser = toolkit.build_parser(
+        "fabdep",
+        "whole-program import-layering + concurrency analyzer "
         "for fabric-tpu (dependency-free; never imports the analyzed code)",
+        paths_help="package root to analyze",
     )
-    parser.add_argument("paths", nargs="*", help="package root to analyze")
-    parser.add_argument("--json", action="store_true", help="machine-readable findings")
     parser.add_argument("--dot", action="store_true", help="print the package import graph as DOT and exit")
     parser.add_argument("--graph-json", action="store_true", help="print the package import graph as JSON and exit")
     parser.add_argument("--layers", metavar="FILE", help="layer map file (default: <root>/tools/layers.toml)")
     parser.add_argument("--refs", action="append", default=[], metavar="PATH", help="extra reference roots for the dead-export pass (default: sibling tests/ + repo-root *.py)")
     parser.add_argument("--no-default-refs", action="store_true", help="do not auto-add sibling tests/ and repo-root *.py as reference roots")
-    parser.add_argument("--rules", metavar="ID[,ID...]", help="run only these rule ids (default: all)")
-    parser.add_argument("--list-rules", action="store_true", help="print rule ids and exit")
-    parser.add_argument("--exclude", action="append", default=[], metavar="GLOB", help="extra exclusion globs")
     args = parser.parse_args(argv)
 
     if args.list_rules:
-        for rid in sorted(RULES):
-            print(f"{rid:24s} {RULES[rid]}")
+        toolkit.print_rule_list(RULES, width=24)
         return 0
 
     if len(args.paths) != 1:
@@ -1646,16 +1638,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         print(f"fabdep: error: not a directory: {root}", file=sys.stderr)
         return 2
 
-    rule_ids: Optional[List[str]] = None
-    if args.rules:
-        rule_ids = [r.strip() for r in args.rules.split(",") if r.strip()]
-        unknown = [r for r in rule_ids if r not in RULES]
-        if unknown:
-            print(
-                f"fabdep: error: unknown rule(s): {', '.join(unknown)}",
-                file=sys.stderr,
-            )
-            return 2
+    rule_ids, rc = toolkit.parse_rule_arg(args.rules, RULES, "fabdep")
+    if rc:
+        return rc
 
     layer_map = LayerMap()
     layer_file = Path(args.layers) if args.layers else default_layer_file(root)
@@ -1705,8 +1690,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             )
         )
     else:
-        for f in findings:
-            print(f"{f.path}:{f.line}:{f.col}: {f.rule}: {f.message}")
+        toolkit.print_findings(findings)
         print(
             f"fabdep: {len(findings)} finding(s), "
             f"{program.suppressed} suppressed, "

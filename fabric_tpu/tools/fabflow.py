@@ -74,15 +74,19 @@ Exit status: 0 = clean, 1 = findings, 2 = usage/IO error.
 
 from __future__ import annotations
 
-import argparse
 import ast
-import fnmatch
 import json
-import re
 import sys
-from dataclasses import dataclass
 from pathlib import Path
 from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from fabric_tpu.tools import toolkit
+from fabric_tpu.tools.toolkit import (  # noqa: F401 - re-exported API
+    DEFAULT_EXCLUDES,
+    FileContext,
+    Finding,
+    iter_py_files,
+)
 
 __version__ = "1.0"
 
@@ -164,14 +168,6 @@ DRIFT_CONSTANTS = {
 #: handler: VALID fails open, NOT_VALIDATED leaves the flag unset.
 FAIL_OPEN_MEMBERS = {"VALID", "NOT_VALIDATED"}
 
-DEFAULT_EXCLUDES = (
-    "*_pb2.py",
-    "*/__pycache__/*",
-    "*/native/*",
-    "*/protos/src/*",
-    "*/.git/*",
-)
-
 #: Interpreter budgets: loop-unroll cap, fixpoint iteration cap, and
 #: abstract-step budget per analyzed function (bail to ⊤ beyond).
 MAX_UNROLL = 512
@@ -180,29 +176,9 @@ FUNC_STEP_BUDGET = 400_000
 MAX_CALL_DEPTH = 10
 
 # --------------------------------------------------------------------------
-# Findings / suppression plumbing (mirrors fablint)
+# Findings / suppression plumbing (tools.toolkit, shared with
+# fablint/fabdep/fabreg)
 # --------------------------------------------------------------------------
-
-
-@dataclass
-class Finding:
-    rule: str
-    path: str
-    line: int
-    col: int
-    message: str
-
-    def key(self) -> Tuple[str, int, int, str]:
-        return (self.path, self.line, self.col, self.rule)
-
-    def to_dict(self) -> Dict[str, object]:
-        return {
-            "rule": self.rule,
-            "path": self.path,
-            "line": self.line,
-            "col": self.col,
-            "message": self.message,
-        }
 
 
 RULES: Dict[str, str] = {
@@ -226,29 +202,9 @@ RULES: Dict[str, str] = {
     ),
 }
 
-_DISABLE_RE = re.compile(
-    r"#\s*fabflow:\s*disable=([A-Za-z0-9_\-, ]+)(?:#\s*(.*))?"
-)
-
-
 def parse_suppressions(source: str) -> Dict[int, Tuple[Set[str], str]]:
     """line -> (disabled rule ids, reason text)."""
-    out: Dict[int, Tuple[Set[str], str]] = {}
-    for lineno, line in enumerate(source.splitlines(), start=1):
-        m = _DISABLE_RE.search(line)
-        if m:
-            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
-            out[lineno] = (rules, (m.group(2) or "").strip())
-    return out
-
-
-class FileContext:
-    def __init__(self, path: str) -> None:
-        self.path = path
-        self.posix = Path(path).as_posix()
-
-    def matches(self, patterns: Iterable[str]) -> bool:
-        return any(fnmatch.fnmatch(self.posix, pat) for pat in patterns)
+    return toolkit.parse_suppressions(source, "fabflow")
 
 
 def _dotted(node: ast.AST) -> Optional[str]:
@@ -990,6 +946,7 @@ class Analyzer:
         self.findings: Dict[Tuple[str, int, str], Finding] = {}
         self.suppressed = 0
         self._suppressed_keys: Set[Tuple[str, int, str]] = set()
+        self.suppressed_findings: List[Finding] = []
         self.memo: Dict[tuple, AbsVal] = {}
         self.in_flight: Set[tuple] = set()
 
@@ -1008,6 +965,9 @@ class Analyzer:
         if sup is not None and (rule in sup[0] or "all" in sup[0]):
             self.suppressed += 1
             self._suppressed_keys.add(key)
+            self.suppressed_findings.append(
+                Finding(rule, mod.path, line, col, message)
+            )
             return
         self.findings[key] = Finding(rule, mod.path, line, col, message)
 
@@ -3519,19 +3479,6 @@ def check_mask_fail_open(tree: ast.Module, ctx: FileContext) -> List[Finding]:
 # --------------------------------------------------------------------------
 
 
-def iter_py_files(paths: Sequence[str], excludes: Sequence[str]) -> List[str]:
-    out: List[str] = []
-    for raw in paths:
-        p = Path(raw)
-        candidates = sorted(p.rglob("*.py")) if p.is_dir() else [p]
-        for f in candidates:
-            posix = f.as_posix()
-            if any(fnmatch.fnmatch(posix, pat) for pat in excludes):
-                continue
-            out.append(str(f))
-    return out
-
-
 def _build_universe(
     sources: Dict[str, str]
 ) -> Tuple[Dict[str, ModuleInfo], List[Finding]]:
@@ -3557,10 +3504,13 @@ def _build_universe(
 def analyze_sources(
     sources: Dict[str, str],
     rule_ids: Optional[Iterable[str]] = None,
+    collect_suppressed: Optional[List[Finding]] = None,
 ) -> Tuple[List[Finding], Dict[str, int]]:
     """Analyze a set of {path: source}. Cross-module calls resolve
     within the set; the LIMB/MASK tier path patterns decide which
-    analyses run on each file."""
+    analyses run on each file.  ``collect_suppressed`` receives the
+    findings per-line suppressions absorbed (fabreg's
+    suppression-stale rule)."""
     active = set(rule_ids) if rule_ids is not None else set(RULES)
     for rid in active:
         if rid not in RULES:
@@ -3585,6 +3535,8 @@ def analyze_sources(
         sup = suppressions.get(f.path, {}).get(f.line)
         if sup is not None and (f.rule in sup[0] or "all" in sup[0]):
             suppressed += 1
+            if collect_suppressed is not None:
+                collect_suppressed.append(f)
         else:
             findings.append(f)
 
@@ -3621,6 +3573,8 @@ def analyze_sources(
                         )
         findings.extend(an.findings.values())
         suppressed += an.suppressed
+        if collect_suppressed is not None:
+            collect_suppressed.extend(an.suppressed_findings)
 
     findings.sort(key=Finding.key)
     stats = {"files": len(sources), "suppressed": suppressed}
@@ -3641,16 +3595,11 @@ def analyze_paths(
     paths: Sequence[str],
     rule_ids: Optional[Iterable[str]] = None,
     excludes: Sequence[str] = DEFAULT_EXCLUDES,
+    collect_suppressed: Optional[List[Finding]] = None,
 ) -> Tuple[List[Finding], Dict[str, int]]:
     files = iter_py_files(paths, excludes)
-    sources: Dict[str, str] = {}
-    io_findings: List[Finding] = []
-    for f in files:
-        try:
-            sources[f] = Path(f).read_text(encoding="utf-8")
-        except (OSError, UnicodeDecodeError) as exc:
-            io_findings.append(Finding("io-error", f, 1, 0, str(exc)))
-    findings, stats = analyze_sources(sources, rule_ids)
+    sources, io_findings = toolkit.read_sources(files)
+    findings, stats = analyze_sources(sources, rule_ids, collect_suppressed)
     findings.extend(io_findings)
     findings.sort(key=Finding.key)
     stats["files"] = len(files)
@@ -3675,52 +3624,23 @@ def suppression_reasons(
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
-    parser = argparse.ArgumentParser(
-        prog="fabflow",
-        description="value-range + dtype abstract interpreter for "
+    parser = toolkit.build_parser(
+        "fabflow",
+        "value-range + dtype abstract interpreter for "
         "fabric-tpu (dependency-free; never imports the analyzed code)",
-    )
-    parser.add_argument("paths", nargs="*", help="files or directories")
-    parser.add_argument("--json", action="store_true",
-                        help="machine-readable output")
-    parser.add_argument("--list-rules", action="store_true",
-                        help="print rule ids and exit")
-    parser.add_argument("--rules", metavar="ID[,ID...]",
-                        help="run only these rule ids (default: all)")
-    parser.add_argument(
-        "--exclude", action="append", default=[], metavar="GLOB",
-        help="extra exclusion globs",
     )
     args = parser.parse_args(argv)
 
     if args.list_rules:
-        for rid in sorted(RULES):
-            print(f"{rid:20s} {RULES[rid]}")
+        toolkit.print_rule_list(RULES, width=20)
         return 0
 
-    if not args.paths:
-        parser.print_usage(sys.stderr)
-        print("fabflow: error: no paths given", file=sys.stderr)
-        return 2
-
-    missing = [p for p in args.paths if not Path(p).exists()]
-    if missing:
-        print(
-            f"fabflow: error: no such file or directory: "
-            f"{', '.join(missing)}", file=sys.stderr,
-        )
-        return 2
-
-    rule_ids: Optional[List[str]] = None
-    if args.rules:
-        rule_ids = [r.strip() for r in args.rules.split(",") if r.strip()]
-        unknown = [r for r in rule_ids if r not in RULES]
-        if unknown:
-            print(
-                f"fabflow: error: unknown rule(s): {', '.join(unknown)}",
-                file=sys.stderr,
-            )
-            return 2
+    rc = toolkit.check_paths_exist(args.paths, "fabflow", parser)
+    if rc:
+        return rc
+    rule_ids, rc = toolkit.parse_rule_arg(args.rules, RULES, "fabflow")
+    if rc:
+        return rc
 
     excludes = tuple(DEFAULT_EXCLUDES) + tuple(args.exclude)
     findings, stats = analyze_paths(args.paths, rule_ids, excludes)
@@ -3738,8 +3658,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             )
         )
     else:
-        for f in findings:
-            print(f"{f.path}:{f.line}:{f.col}: {f.rule}: {f.message}")
+        toolkit.print_findings(findings)
         print(
             f"fabflow: {len(findings)} finding(s) in {stats['files']} "
             f"file(s) ({stats['suppressed']} suppressed)"

@@ -67,15 +67,19 @@ Exit status: 0 = clean, 1 = findings, 2 = usage/IO error.
 
 from __future__ import annotations
 
-import argparse
 import ast
-import fnmatch
 import json
-import re
 import sys
-from dataclasses import dataclass
 from pathlib import Path
 from typing import Callable, Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from fabric_tpu.tools import toolkit
+from fabric_tpu.tools.toolkit import (  # noqa: F401 - re-exported API
+    DEFAULT_EXCLUDES,
+    FileContext,
+    Finding,
+    iter_py_files,
+)
 
 __version__ = "1.0"
 
@@ -141,15 +145,6 @@ ASSERT_SECURITY_DIRS = (
     "*fabric_tpu/idemix/*",
 )
 
-#: Generated / non-source artifacts fablint never parses.
-DEFAULT_EXCLUDES = (
-    "*_pb2.py",
-    "*/__pycache__/*",
-    "*/native/*",
-    "*/protos/src/*",
-    "*/.git/*",
-)
-
 _LOG_METHODS = {
     "debug", "info", "warning", "warn", "error", "exception", "critical",
     "log",
@@ -172,32 +167,12 @@ _LIMB_LIMIT = 2 ** 32
 
 
 # --------------------------------------------------------------------------
-# Core machinery
+# Core machinery (Finding/FileContext/walker live in tools.toolkit —
+# the chassis shared with fabdep/fabflow/fabreg)
 # --------------------------------------------------------------------------
 
 
-@dataclass
-class Finding:
-    rule: str
-    path: str
-    line: int
-    col: int
-    message: str
-
-    def key(self) -> Tuple[str, int, int, str]:
-        return (self.path, self.line, self.col, self.rule)
-
-    def to_dict(self) -> Dict[str, object]:
-        return {
-            "rule": self.rule,
-            "path": self.path,
-            "line": self.line,
-            "col": self.col,
-            "message": self.message,
-        }
-
-
-RuleFn = Callable[[ast.Module, str, "FileContext"], List[Finding]]
+RuleFn = Callable[[ast.Module, str, FileContext], List[Finding]]
 
 #: rule-id -> (one-line doc, checker)
 RULES: Dict[str, Tuple[str, RuleFn]] = {}
@@ -211,28 +186,9 @@ def rule(rule_id: str, doc: str) -> Callable[[RuleFn], RuleFn]:
     return deco
 
 
-class FileContext:
-    """Per-file info shared by rules: posix path + path predicates."""
-
-    def __init__(self, path: str) -> None:
-        self.path = path
-        self.posix = Path(path).as_posix()
-
-    def matches(self, patterns: Iterable[str]) -> bool:
-        return any(fnmatch.fnmatch(self.posix, pat) for pat in patterns)
-
-
-_DISABLE_RE = re.compile(r"#\s*fablint:\s*disable=([A-Za-z0-9_\-, ]+)")
-
-
 def parse_suppressions(source: str) -> Dict[int, Set[str]]:
     """Map 1-based line number -> set of rule ids disabled on that line."""
-    out: Dict[int, Set[str]] = {}
-    for lineno, line in enumerate(source.splitlines(), start=1):
-        m = _DISABLE_RE.search(line)
-        if m:
-            out[lineno] = {r.strip() for r in m.group(1).split(",") if r.strip()}
-    return out
+    return toolkit.suppressed_rules(source, "fablint")
 
 
 def _dotted(node: ast.AST) -> Optional[str]:
@@ -784,27 +740,17 @@ def check_all_drift(tree: ast.Module, source: str, ctx: FileContext) -> List[Fin
 # --------------------------------------------------------------------------
 
 
-def iter_py_files(paths: Sequence[str], excludes: Sequence[str]) -> List[str]:
-    out: List[str] = []
-    for raw in paths:
-        p = Path(raw)
-        candidates = (
-            sorted(p.rglob("*.py")) if p.is_dir() else [p]
-        )
-        for f in candidates:
-            posix = f.as_posix()
-            if any(fnmatch.fnmatch(posix, pat) for pat in excludes):
-                continue
-            out.append(str(f))
-    return out
-
-
 def lint_source(
     source: str,
     path: str,
     rule_ids: Optional[Iterable[str]] = None,
+    collect_suppressed: Optional[List[Finding]] = None,
 ) -> Tuple[List[Finding], int]:
-    """Lint one source blob.  Returns (findings, suppressed_count)."""
+    """Lint one source blob.  Returns (findings, suppressed_count).
+    When ``collect_suppressed`` is given, the findings a per-line
+    suppression absorbed are appended to it (fabreg's
+    suppression-stale rule uses this to prove each comment still
+    earns its keep)."""
     ctx = FileContext(path)
     try:
         tree = ast.parse(source, filename=path)
@@ -820,26 +766,24 @@ def lint_source(
         )
     suppressions = parse_suppressions(source)
     active = set(rule_ids) if rule_ids is not None else set(RULES)
-    findings: List[Finding] = []
-    suppressed = 0
+    raw: List[Finding] = []
     for rid in sorted(active):
         if rid not in RULES:
             raise ValueError(f"unknown rule id {rid!r}")
         _, fn = RULES[rid]
-        for finding in fn(tree, source, ctx):
-            disabled = suppressions.get(finding.line, set())
-            if finding.rule in disabled or "all" in disabled:
-                suppressed += 1
-            else:
-                findings.append(finding)
+        raw.extend(fn(tree, source, ctx))
+    findings, suppressed = toolkit.apply_suppressions(raw, suppressions)
+    if collect_suppressed is not None:
+        collect_suppressed.extend(suppressed)
     findings.sort(key=Finding.key)
-    return findings, suppressed
+    return findings, len(suppressed)
 
 
 def lint_paths(
     paths: Sequence[str],
     rule_ids: Optional[Iterable[str]] = None,
     excludes: Sequence[str] = DEFAULT_EXCLUDES,
+    collect_suppressed: Optional[List[Finding]] = None,
 ) -> Tuple[List[Finding], Dict[str, int]]:
     """Lint files/directories.  Returns (findings, stats)."""
     files = iter_py_files(paths, excludes)
@@ -851,7 +795,9 @@ def lint_paths(
         except (OSError, UnicodeDecodeError) as exc:
             findings.append(Finding("io-error", f, 1, 0, str(exc)))
             continue
-        file_findings, file_suppressed = lint_source(source, f, rule_ids)
+        file_findings, file_suppressed = lint_source(
+            source, f, rule_ids, collect_suppressed
+        )
         findings.extend(file_findings)
         suppressed += file_suppressed
     findings.sort(key=Finding.key)
@@ -860,55 +806,26 @@ def lint_paths(
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
-    parser = argparse.ArgumentParser(
-        prog="fablint",
-        description="AST-based invariant linter for fabric-tpu "
+    parser = toolkit.build_parser(
+        "fablint",
+        "AST-based invariant linter for fabric-tpu "
         "(dependency-free; never imports the linted code)",
-    )
-    parser.add_argument("paths", nargs="*", help="files or directories to lint")
-    parser.add_argument("--json", action="store_true", help="machine-readable output")
-    parser.add_argument(
-        "--list-rules", action="store_true", help="print rule ids and exit"
-    )
-    parser.add_argument(
-        "--rules",
-        metavar="ID[,ID...]",
-        help="run only these rule ids (default: all)",
-    )
-    parser.add_argument(
-        "--exclude",
-        action="append",
-        default=[],
-        metavar="GLOB",
-        help="extra exclusion globs (added to the built-in generated-code list)",
+        paths_help="files or directories to lint",
     )
     args = parser.parse_args(argv)
 
     if args.list_rules:
-        for rid in sorted(RULES):
-            print(f"{rid:18s} {RULES[rid][0]}")
+        toolkit.print_rule_list(
+            {rid: doc for rid, (doc, _fn) in RULES.items()}, width=18
+        )
         return 0
 
-    if not args.paths:
-        parser.print_usage(sys.stderr)
-        print("fablint: error: no paths given", file=sys.stderr)
-        return 2
-
-    missing = [p for p in args.paths if not Path(p).exists()]
-    if missing:
-        print(
-            f"fablint: error: no such file or directory: "
-            f"{', '.join(missing)}", file=sys.stderr,
-        )
-        return 2
-
-    rule_ids: Optional[List[str]] = None
-    if args.rules:
-        rule_ids = [r.strip() for r in args.rules.split(",") if r.strip()]
-        unknown = [r for r in rule_ids if r not in RULES]
-        if unknown:
-            print(f"fablint: error: unknown rule(s): {', '.join(unknown)}", file=sys.stderr)
-            return 2
+    rc = toolkit.check_paths_exist(args.paths, "fablint", parser)
+    if rc:
+        return rc
+    rule_ids, rc = toolkit.parse_rule_arg(args.rules, RULES, "fablint")
+    if rc:
+        return rc
 
     excludes = tuple(DEFAULT_EXCLUDES) + tuple(args.exclude)
     findings, stats = lint_paths(args.paths, rule_ids, excludes)
@@ -926,8 +843,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             )
         )
     else:
-        for f in findings:
-            print(f"{f.path}:{f.line}:{f.col}: {f.rule}: {f.message}")
+        toolkit.print_findings(findings)
         print(
             f"fablint: {len(findings)} finding(s) in {stats['files']} file(s)"
             f" ({stats['suppressed']} suppressed)"

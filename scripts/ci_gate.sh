@@ -1,66 +1,108 @@
 #!/usr/bin/env bash
-# Combined fast CI gate (< 30s total), run before tier-1:
+# Combined fast CI gate (< 60s total), run before tier-1:
 #
-#   1. python -m compileall    -- every file byte-compiles
-#   2. collect_gate.sh         -- every test module imports cleanly
-#   3. fablint                 -- every per-file invariant rule passes
-#   4. fabdep                  -- whole-program gates: the package import
-#                                 graph is a layered DAG (tools/layers.toml)
-#                                 and the concurrency/API-surface rules pass
-#   5. fabflow                 -- value-range/dtype abstract interpreter:
-#                                 the limb kernels are overflow-free under
-#                                 the canonical-limb contract and the mask
-#                                 paths fail closed
-#   6. chaos_gate.sh           -- seeded fabchaos smoke, run twice: mask
-#                                 bit-exact + fail-closed under injected
-#                                 faults, scorecards byte-identical
-#   7. serve_gate.sh           -- resident sidecar smoke: subprocess
-#                                 server, mixed batch through the client
-#                                 shim bit-exact, clean SHUTDOWN
-#   8. obs_gate.sh            -- observability smoke: sidecar + mounted
-#                                 ops server, every canonical metric
-#                                 family live on /metrics, /healthz
-#                                 flips on batcher death, chaos
-#                                 scorecard byte-identical under
-#                                 instrumentation
+#   1. compileall   -- every file byte-compiles
+#   2. collect      -- every test module imports cleanly (collect_gate.sh)
+#   3. fablint      -- every per-file invariant rule passes
+#   4. fabdep       -- whole-program gates: the package import graph is
+#                      a layered DAG (tools/layers.toml) and the
+#                      concurrency/API-surface rules pass
+#   5. fabflow      -- value-range/dtype abstract interpreter: the limb
+#                      kernels are overflow-free under the canonical-limb
+#                      contract and the mask paths fail closed
+#   6. chaos        -- seeded fabchaos smoke, run twice: mask bit-exact +
+#                      fail-closed under injected faults, scorecards
+#                      byte-identical (chaos_gate.sh)
+#   7. serve        -- resident sidecar smoke: subprocess server, mixed
+#                      batch through the client shim bit-exact, clean
+#                      SHUTDOWN (serve_gate.sh)
+#   8. obs          -- observability smoke: sidecar + mounted ops server,
+#                      every canonical metric family live on /metrics,
+#                      /healthz flips on batcher death, chaos scorecard
+#                      byte-identical under instrumentation (obs_gate.sh)
+#   9. reg          -- declarative-contract drift: env registry, metric
+#                      table, fault-site table, suppression staleness
+#                      (reg_gate.sh)
 #
 # Each stage runs even if an earlier one failed (one run reports ALL
 # broken gates) and prints its wall-clock time; the exit code is nonzero
 # if ANY stage failed.
+#
+# --only <stage> re-runs a single stage (by number or name, e.g.
+# `--only 5` or `--only fabflow`) so a builder can iterate on one
+# failing gate without paying the full ~50s sweep.
 set -uo pipefail
 
 cd "$(dirname "$0")/.."
 
+only=""
+if [ "${1:-}" = "--only" ]; then
+    if [ -z "${2:-}" ]; then
+        echo "ci_gate: --only requires a stage number or name" >&2
+        exit 2
+    fi
+    only="$2"
+elif [ -n "${1:-}" ]; then
+    echo "ci_gate: unknown argument: $1 (usage: ci_gate.sh [--only STAGE])" >&2
+    exit 2
+fi
+
+STAGE_NAMES=(compileall collect fablint fabdep fabflow chaos serve obs reg)
+total=${#STAGE_NAMES[@]}
+
 fail=0
 failed_stages=""
+ran=0
+stage_idx=0
 
 run_stage() {
-    # run_stage <label> <command...>
-    local label="$1"
+    # run_stage <name> <command...>  (index derived from call order —
+    # one source of truth, no renumbering when a stage is inserted)
+    local name="$1"
     shift
-    echo "== ci_gate ${label} =="
+    stage_idx=$((stage_idx + 1))
+    if [ -n "$only" ] && [ "$only" != "$stage_idx" ] && [ "$only" != "$name" ]; then
+        return 0
+    fi
+    ran=$((ran + 1))
+    echo "== ci_gate ${stage_idx}/${total} ${name} =="
     local t0=$SECONDS
     if ! "$@"; then
-        echo "ci_gate: ${label} FAIL" >&2
+        echo "ci_gate: ${name} FAIL" >&2
         fail=1
-        failed_stages="${failed_stages} ${label}"
+        failed_stages="${failed_stages} ${name}"
     fi
-    echo "-- ${label}: $((SECONDS - t0))s"
+    echo "-- ${name}: $((SECONDS - t0))s"
 }
 
-run_stage "1/8 compileall" timeout -k 5 120 python -m compileall -q fabric_tpu
-run_stage "2/8 collect_gate" bash scripts/collect_gate.sh
+run_stage compileall timeout -k 5 120 python -m compileall -q fabric_tpu
+run_stage collect bash scripts/collect_gate.sh
 # the linters' human output already prints findings as
 # path:line:col: rule: message — no JSON round-trip needed
-run_stage "3/8 fablint" timeout -k 5 60 python -m fabric_tpu.tools.fablint fabric_tpu/
-run_stage "4/8 fabdep" timeout -k 5 60 python -m fabric_tpu.tools.fabdep fabric_tpu/
-run_stage "5/8 fabflow" timeout -k 5 120 python -m fabric_tpu.tools.fabflow fabric_tpu/
-run_stage "6/8 chaos_gate" bash scripts/chaos_gate.sh
-run_stage "7/8 serve_gate" bash scripts/serve_gate.sh
-run_stage "8/8 obs_gate" bash scripts/obs_gate.sh
+run_stage fablint timeout -k 5 60 python -m fabric_tpu.tools.fablint fabric_tpu/
+run_stage fabdep timeout -k 5 60 python -m fabric_tpu.tools.fabdep fabric_tpu/
+run_stage fabflow timeout -k 5 120 python -m fabric_tpu.tools.fabflow fabric_tpu/
+run_stage chaos bash scripts/chaos_gate.sh
+run_stage serve bash scripts/serve_gate.sh
+run_stage obs bash scripts/obs_gate.sh
+run_stage reg bash scripts/reg_gate.sh
 
+if [ "$stage_idx" -ne "$total" ]; then
+    echo "ci_gate: BUG: ${stage_idx} run_stage calls but ${total} stage names" >&2
+    exit 2
+fi
+
+if [ "$ran" -eq 0 ]; then
+    echo "ci_gate: no stage matched --only '$only'" \
+        "(stages: 1-${total} or ${STAGE_NAMES[*]})" >&2
+    exit 2
+fi
 if [ "$fail" -ne 0 ]; then
     echo "ci_gate: FAIL (stages:${failed_stages})" >&2
     exit 1
 fi
-echo "ci_gate: OK (compileall + collect + fablint + fabdep + fabflow + chaos + serve + obs)"
+if [ -n "$only" ]; then
+    echo "ci_gate: OK (--only ${only})"
+else
+    echo "ci_gate: OK (compileall + collect + fablint + fabdep + fabflow + chaos + serve + obs + reg)"
+fi

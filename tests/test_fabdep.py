@@ -622,6 +622,27 @@ def test_repo_has_zero_unsuppressed_findings(repo_analysis):
     assert findings == [], f"fabdep must stay clean:\n{pretty}"
 
 
+def test_toolkit_port_changed_nothing(repo_analysis):
+    """The PR 11 toolkit extraction is behavior-pinned: same chassis
+    objects, same rule ids, and the repo's suppressed count exactly as
+    before the port (program.suppressed_findings lists them for
+    fabreg's suppression-stale rule)."""
+    from fabric_tpu.tools import toolkit
+
+    assert fabdep.Finding is toolkit.Finding
+    assert fabdep.DEFAULT_EXCLUDES == toolkit.DEFAULT_EXCLUDES
+    assert sorted(fabdep.RULES) == [
+        "blocking-under-lock", "dead-export", "import-cycle", "layer-skip",
+        "layer-unknown", "lock-order-cycle", "unguarded-shared-write",
+    ]
+    program, _findings, _lm = repo_analysis
+    assert program.suppressed == 12
+    assert len(program.suppressed_findings) == 12
+    assert {f.rule for f in program.suppressed_findings} == {
+        "unguarded-shared-write"
+    }
+
+
 def test_repo_package_graph_is_a_layered_dag(repo_analysis):
     program, _findings, layer_map = repo_analysis
     graph = fabdep.graph_dict(program, layer_map)

@@ -546,6 +546,37 @@ def test_repo_is_clean(repo_findings):
     )
 
 
+def test_toolkit_port_changed_nothing(repo_findings):
+    """The PR 11 toolkit extraction is behavior-pinned: same chassis
+    objects, same rule ids, and the repo's suppressed count exactly as
+    before the port (the one qm_term relational-underflow bet)."""
+    from fabric_tpu.tools import toolkit
+
+    assert fabflow.Finding is toolkit.Finding
+    assert fabflow.DEFAULT_EXCLUDES == toolkit.DEFAULT_EXCLUDES
+    assert sorted(fabflow.RULES) == [
+        "const-drift", "dtype-narrowing", "float-contamination",
+        "limb-overflow", "mask-fail-open",
+    ]
+    _findings, stats = repo_findings
+    assert stats["suppressed"] == 1
+    collected = []
+    fabflow.analyze_sources(
+        {
+            "fabric_tpu/ops/fixture.py": (
+                "import numpy as np\n"
+                "def f(x):\n"
+                "    acc = np.uint64(2**63) + np.uint64(2**63)"
+                "  # fabflow: disable=limb-overflow  # fixture: 2**64\n"
+                "    return acc\n"
+            )
+        },
+        ["limb-overflow"],
+        collected,
+    )
+    assert [f.rule for f in collected] == ["limb-overflow"]
+
+
 def test_repo_suppressions_state_computed_bounds(repo_findings):
     _, stats = repo_findings
     reasons = fabflow.suppression_reasons([str(REPO_ROOT / "fabric_tpu")])

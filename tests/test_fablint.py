@@ -391,3 +391,21 @@ def test_repo_self_check_is_clean():
         f"{f.path}:{f.line}:{f.col}: {f.rule}: {f.message}" for f in findings
     )
     assert stats["files"] > 100  # the walk actually covered the tree
+
+
+def test_toolkit_port_changed_nothing():
+    """The PR 11 toolkit extraction is behavior-pinned: same chassis
+    objects, same rule ids, and the repo's suppressed count exactly as
+    before the port (every comment still absorbing the same finding —
+    fabreg's suppression-stale rule keeps this number honest)."""
+    from fabric_tpu.tools import toolkit
+
+    assert fablint.Finding is toolkit.Finding
+    assert fablint.DEFAULT_EXCLUDES == toolkit.DEFAULT_EXCLUDES
+    assert sorted(fablint.RULES) == [
+        "all-drift", "assert-security", "broad-except", "digest-compare",
+        "fork-start", "jit-impure", "limb-dtype", "module-import",
+        "mutable-default", "shell-injection",
+    ]
+    _findings, stats = fablint.lint_paths([str(REPO_ROOT / "fabric_tpu")])
+    assert stats["suppressed"] == 19
