@@ -1033,6 +1033,113 @@ def bench_serve(device_ok=True, n_requests=None, lanes_per_request=256):
     return out
 
 
+def bench_fleet(device_ok=True, n_peers=None, requests_per_peer=None):
+    """configs.fleet: the multi-peer shared-sidecar soak (ROADMAP
+    fleet-scale acceptance).  One warm host-engine sidecar, >= 4 REAL
+    peer processes (``fabric_tpu.serve.fleetload`` subprocesses) with a
+    zipf channel skew — one paying high-priority channel, the rest
+    spam/bulk with 10:1 aggregate request skew — reporting aggregate
+    verifies/s across the fleet and per-class p99 off the sidecar's
+    per-class stats.  Every peer asserts its masks bit-exact; a
+    mismatch fails the column."""
+    import shutil
+    import subprocess
+    import tempfile
+
+    from fabric_tpu.serve.server import SidecarServer
+
+    if n_peers is None:
+        n_peers = max(4, int(os.environ.get("BENCH_FLEET_PEERS", "4")))
+    if requests_per_peer is None:
+        requests_per_peer = int(os.environ.get("BENCH_FLEET_REQUESTS", "6"))
+    sock = os.path.join(tempfile.mkdtemp(prefix="bench-fleet-"), "f.sock")
+    server = SidecarServer(sock, engine="host", warm_ladder="off")
+    out = {}
+    try:
+        server.warm()
+        server.start()
+        # zipf-ish skew: peer 0 is the paying channel; spam peers carry
+        # 10x its aggregate request count between them
+        specs = []
+        for i in range(n_peers):
+            if i == 0:
+                specs.append(("paychan", "high", requests_per_peer, 256))
+            else:
+                spam_reqs = max(
+                    1,
+                    (10 * requests_per_peer) // max(1, n_peers - 1),
+                )
+                specs.append((f"spam{i}", "bulk", spam_reqs, 128))
+        t0 = time.perf_counter()
+        procs = [
+            subprocess.Popen(
+                [
+                    sys.executable, "-m", "fabric_tpu.serve.fleetload",
+                    "--address", sock, "--channel", chan, "--qos", qos,
+                    "--requests", str(reqs), "--lanes", str(lanes),
+                    "--seed", str(i),
+                ],
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            )
+            for i, (chan, qos, reqs, lanes) in enumerate(specs)
+        ]
+        peers = []
+        try:
+            for p, (chan, _q, _r, _l) in zip(procs, specs):
+                stdout, stderr = p.communicate(timeout=240)
+                if p.returncode != 0:
+                    raise RuntimeError(
+                        f"fleet peer {chan} rc={p.returncode}: "
+                        f"{stderr.decode()[-200:]}"
+                    )
+                peers.append(
+                    json.loads(stdout.decode().strip().splitlines()[-1])
+                )
+        except BaseException:
+            # one peer failed/timed out: reap the rest before the
+            # finally block stops the server out from under them
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                try:
+                    p.communicate(timeout=10)
+                except Exception:  # noqa: BLE001 - teardown best-effort
+                    pass
+            raise
+        wall_s = time.perf_counter() - t0
+        total_lanes = sum(
+            p["requests"] * p["lanes_per_request"] for p in peers
+        )
+        per_class = server.stats.summary()["per_class"]
+        out = {
+            "peers": n_peers,
+            "skew": "10:1 spam:paying",
+            "aggregate_verifies_per_s": round(total_lanes / wall_s, 1),
+            "wall_s": round(wall_s, 2),
+            "mask_mismatches": sum(p["mask_mismatches"] for p in peers),
+            "busy_rejects": sum(p["busy_rejects"] for p in peers),
+            "degraded_peers": sum(1 for p in peers if p["degraded"]),
+            "per_peer": peers,
+            "per_class_p99_ms": {
+                cls: row["latency"].get("p99_ms")
+                for cls, row in per_class.items()
+            },
+            "per_class_served": {
+                cls: row["served"] for cls, row in per_class.items()
+            },
+        }
+        if out["mask_mismatches"]:
+            raise RuntimeError("fleet soak produced mask mismatches")
+    except Exception as exc:  # noqa: BLE001 - emit partial results
+        out["error"] = str(exc)[:300]
+    finally:
+        server.stop()
+        shutil.rmtree(os.path.dirname(sock), ignore_errors=True)
+    return out
+
+
 def _ndev_child(n_devices: int, lanes: int) -> None:
     """Subprocess body of the n_devices sweep: pin a hermetic CPU mesh
     of `n_devices` virtual devices BEFORE any backend init, run the
@@ -1429,6 +1536,7 @@ def main():
             ("multi_4ch", bench_multichannel, True),
             ("batcher_4ch_small", bench_batcher, True),
             ("serve", bench_serve, False),
+            ("fleet", bench_fleet, False),
             ("n_devices", bench_n_devices, False),
             ("chaos", bench_chaos, False),
         ):

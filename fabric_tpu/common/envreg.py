@@ -79,6 +79,31 @@ ENV_VARS: Tuple[EnvVar, ...] = (
         "mount the operations/metrics HTTP server inside the sidecar "
         "process at this address",
     ),
+    EnvVar(
+        "FABRIC_TPU_SERVE_ENDPOINTS", "addr list",
+        "(unset: single-sidecar or in-process)",
+        "serve/router.py endpoints_from_env, crypto/bccsp.py "
+        "_default_provider_locked",
+        "comma-separated sidecar fleet addresses; routes "
+        "default_provider() through the bucket-aware failover router "
+        "(wins over FABRIC_TPU_SERVE_ADDR when both are set)",
+    ),
+    EnvVar(
+        "FABRIC_TPU_SERVE_QOS", "map", "(unset: every channel normal)",
+        "serve/qos.py qos_map_from_env (read by serve/client.py and "
+        "serve/router.py)",
+        "channel->admission-class map for protocol rev 2, e.g. "
+        "'paychan=high;spam*=bulk;*=normal' (exact, prefix* and * "
+        "patterns; malformed maps warn and resolve to the default "
+        "class)",
+    ),
+    EnvVar(
+        "FABRIC_TPU_SERVE_DRAIN_S", "float", "5",
+        "serve/server.py main",
+        "rolling-restart drain budget: how long SIGTERM/OP_DRAIN waits "
+        "for in-flight verify requests to settle with real verdicts "
+        "before the sidecar exits (malformed values fall back)",
+    ),
     # -- device kernels -------------------------------------------------
     EnvVar(
         "FABRIC_TPU_KERNEL_VARIANT", "enum(inline|micro|microcond|auto)",

@@ -182,6 +182,22 @@ CANONICAL_METRICS: Tuple[MetricSpec, ...] = (
         "serve/server.py _accept_loop/_serve_conn",
     ),
     MetricSpec(
+        "fabric_serve_class_lanes_total", "counter", ("cls",),
+        "lanes served OK per admission class (high|normal|bulk)",
+        "serve/server.py ServeStats",
+    ),
+    MetricSpec(
+        "fabric_serve_class_busy_total", "counter", ("cls",),
+        "ST_BUSY sheds per admission class — every rejection is a "
+        "protocol-level reply, never a silent drop",
+        "serve/server.py ServeStats",
+    ),
+    MetricSpec(
+        "fabric_serve_endpoint_healthy", "gauge", ("endpoint",),
+        "router endpoint health (1 = in rotation, 0 = evicted/cooling)",
+        "serve/router.py _Endpoint",
+    ),
+    MetricSpec(
         "fabric_serve_bucket_warm_ms", "gauge", ("bucket",),
         "per-bucket warm wall ms (registry warm report)",
         "serve/server.py warm",

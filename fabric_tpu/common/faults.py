@@ -21,6 +21,8 @@ site                       seam
 ``deliver.pull``           BlockDeliverer.run, per connection attempt
 ``gossip.comm.send``       GossipNode._send, per stream open
 ``serve.dispatch``         SidecarServer verify handling, per request
+``serve.route``            SidecarRouter, per endpoint dispatch attempt
+``raft.step``              RaftChain.step, per consensus message (drop)
 ``idemix.verdict``         idemix/batch verdict mask (corrupt action)
 =========================  ==================================================
 

@@ -1,6 +1,6 @@
 """Shared retry/backoff discipline (fabchaos hardening).
 
-One policy object, three consumers:
+One policy object, four consumers:
 
 - deliver failover (``deliver.client``): the reference's exponential
   backoff (base 1.2 from blocksprovider.go:109) expressed as a
@@ -9,7 +9,12 @@ One policy object, three consumers:
   hiccup, injected fault) retries a bounded number of times before the
   error fans out to every waiting resolver;
 - the hostec/hostec_np pool degrade paths: a :class:`CooldownGate`
-  keeps a freshly-broken pool from being rebuilt in a hot loop.
+  keeps a freshly-broken pool from being rebuilt in a hot loop;
+- the serve plane's circuits: the sidecar client's dial gate and the
+  fleet router's per-endpoint health gates (``serve/router.py``) are
+  both :class:`CooldownGate` instances — one blackholed endpoint costs
+  one failure, then exponentially-spaced probes, never a per-batch
+  connect timeout.
 
 Determinism: jitter draws from a ``random.Random(seed)`` stream and the
 deadline is accounted against *nominal* (requested) sleep time, so a

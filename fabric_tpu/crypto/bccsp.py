@@ -511,8 +511,13 @@ def default_provider() -> Provider:
 def _default_provider_locked() -> Provider:
     global _default
     if _default is None:
+        # fleet routing first: several sidecars behind the peer-side
+        # failover router beat one (FABRIC_TPU_SERVE_ENDPOINTS wins
+        # over FABRIC_TPU_SERVE_ADDR when both are set — the single
+        # address is the degenerate one-endpoint fleet)
+        endpoints = os.environ.get("FABRIC_TPU_SERVE_ENDPOINTS", "")
         addr = os.environ.get("FABRIC_TPU_SERVE_ADDR", "")
-        if addr:
+        if endpoints or addr:
             # resident-sidecar routing (fabric_tpu.serve): every default
             # consumer (peer channels, the chaos harness) transparently
             # sends its batches to the warm sidecar.  The rung builds
@@ -525,14 +530,19 @@ def _default_provider_locked() -> Provider:
             try:
                 from fabric_tpu.crypto.factory import provider_from_config
 
+                serve_cfg: dict = {"Address": addr}
+                if endpoints:
+                    serve_cfg["Endpoints"] = [
+                        a.strip() for a in endpoints.split(",") if a.strip()
+                    ]
                 _default = provider_from_config(
-                    {"Default": "SERVE", "SERVE": {"Address": addr}}
+                    {"Default": "SERVE", "SERVE": serve_cfg}
                 )
                 return _default
             except Exception as exc:  # noqa: BLE001 - env routing best-effort
                 logger.warning(
-                    "FABRIC_TPU_SERVE_ADDR=%s unusable (%s); using the "
-                    "in-process provider ladder", addr, exc,
+                    "serve routing (%s) unusable (%s); using the "
+                    "in-process provider ladder", endpoints or addr, exc,
                 )
         _default = probe_provider()
     return _default

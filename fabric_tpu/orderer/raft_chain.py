@@ -17,6 +17,7 @@ import struct
 import threading
 from typing import Callable, Dict, List, Optional, Sequence
 
+from fabric_tpu.common.faults import fault_point
 from fabric_tpu.orderer.blockcutter import BatchConfig, BlockCutter
 from fabric_tpu.orderer.blockwriter import BlockWriter
 from fabric_tpu.orderer.consenter_ids import (
@@ -257,6 +258,17 @@ class RaftChain:
             self._pump()
 
     def step(self, msg: Message) -> None:
+        # chaos seam: a 'drop' spec here is a lost consensus message —
+        # raft's retransmission (leader append retries, election
+        # timeouts) must absorb it without forking the committed chain.
+        # UNKEYED on purpose: a heartbeat retransmits a byte-identical
+        # append, so a content-keyed decision would drop the same
+        # message forever (livelock); the per-site seeded stream
+        # re-rolls per delivery — deterministic under fabchaos's
+        # single-threaded pump, documented order-dependent otherwise.
+        spec = fault_point("raft.step", interprets=("drop",))
+        if spec is not None and spec.action == "drop":
+            return
         with self._lock:
             self.node.step(msg)
             self._pump()

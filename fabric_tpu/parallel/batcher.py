@@ -50,16 +50,19 @@ from typing import Callable, List, Optional, Sequence, Tuple
 
 from fabric_tpu.common import fabobs
 from fabric_tpu.common.faults import fault_point
+from fabric_tpu.common.flogging import must_get_logger
 from fabric_tpu.common.retry import DISPATCH_POLICY, RetryPolicy, call_with_retry
+
+logger = must_get_logger("batcher")
 
 
 class _Request:
     __slots__ = (
         "keys", "sigs", "digests", "event", "result", "error", "permits",
-        "t_submit",
+        "t_submit", "on_dispatch",
     )
 
-    def __init__(self, keys, sigs, digests):
+    def __init__(self, keys, sigs, digests, on_dispatch=None):
         self.keys = keys
         self.sigs = sigs
         self.digests = digests
@@ -68,6 +71,10 @@ class _Request:
         self.error: Optional[BaseException] = None
         self.permits = 0
         self.t_submit = time.perf_counter()
+        # fired exactly when this request's lane permits are released
+        # (dispatcher pickup) — the serve sidecar's per-class QoS
+        # ledger mirrors the batcher's admission window through it
+        self.on_dispatch = on_dispatch
 
     def resolve(self) -> List[bool]:
         self.event.wait()
@@ -198,12 +205,18 @@ class VerifyBatcher:
         keys: Sequence,
         signatures: Sequence[bytes],
         digests: Sequence[bytes],
+        on_dispatch: Optional[Callable[[], None]] = None,
     ) -> Optional[Callable[[], List[bool]]]:
         """Non-blocking admission (the serve sidecar's front door): the
         resolver when the lane budget admits the request NOW, else None
         — the caller turns that into an explicit reject-with-retry-after
-        instead of stalling a socket thread on the condition variable."""
-        return self._admit(keys, signatures, digests, block=False)
+        instead of stalling a socket thread on the condition variable.
+        ``on_dispatch`` fires when the dispatcher picks the request up
+        (the moment its lane permits are released) — callers keeping a
+        parallel admission ledger release theirs in the same window."""
+        return self._admit(
+            keys, signatures, digests, block=False, on_dispatch=on_dispatch
+        )
 
     def _admit(
         self,
@@ -211,6 +224,7 @@ class VerifyBatcher:
         signatures: Sequence[bytes],
         digests: Sequence[bytes],
         block: bool,
+        on_dispatch: Optional[Callable[[], None]] = None,
     ) -> Optional[Callable[[], List[bool]]]:
         n = len(keys)
         if n == 0:
@@ -222,7 +236,10 @@ class VerifyBatcher:
         # bounded admission: lanes are taken atomically (all or nothing)
         # and released at dispatch. An oversized request is capped so it
         # can't demand more lanes than exist.
-        req = _Request(list(keys), list(signatures), list(digests))
+        req = _Request(
+            list(keys), list(signatures), list(digests),
+            on_dispatch=on_dispatch,
+        )
         req.permits = min(n, self._max_pending_lanes)
         with self._lanes_cv:
             while self._lanes_free < req.permits:
@@ -308,6 +325,12 @@ class VerifyBatcher:
                 self._lanes_cv.notify_all()
                 released = self._max_pending_lanes - self._lanes_free
             fabobs.obs_gauge("fabric_batcher_pending_lanes", released)
+            for r in batch:
+                if r.on_dispatch is not None:
+                    try:
+                        r.on_dispatch()
+                    except Exception as exc:  # noqa: BLE001 - a ledger hook must never kill the dispatcher
+                        logger.warning("on_dispatch hook failed: %s", exc)
             try:
                 with fabobs.span(
                     "batcher.launch", lanes=len(keys), requests=len(batch)

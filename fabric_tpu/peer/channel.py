@@ -52,7 +52,14 @@ class Channel:
     ):
         self.metrics = metrics
         self.channel_id = channel_id
-        self.provider = provider or default_provider()
+        base_provider = provider or default_provider()
+        # serve-plane QoS dispatch: a sidecar-routed provider binds this
+        # channel's admission class (FABRIC_TPU_SERVE_QOS map) so the
+        # shared sidecar sheds priority-aware — a spam channel's batches
+        # carry its class, never the paying channel's.  Non-serve
+        # providers have no for_channel and pass through unchanged.
+        bind = getattr(base_provider, "for_channel", None)
+        self.provider = bind(channel_id) if callable(bind) else base_provider
         self.ledger = KVLedger(
             ledger_dir, channel_id, btl_policy=btl_policy,
             device_mvcc=device_mvcc, state_mirror=state_mirror,

@@ -13,6 +13,12 @@ service + AOT/compile-cache runtime" subsystem).
 - :mod:`fabric_tpu.serve.client` — the BCCSP rung: SidecarProvider
   routes batch verification through the sidecar and degrades to
   in-process verification (fail-closed masks) when it dies.
+- :mod:`fabric_tpu.serve.qos` — per-class admission budgets (protocol
+  rev 2): weighted lane quotas with work-conserving borrowing, so a
+  shared sidecar sheds priority-aware.
+- :mod:`fabric_tpu.serve.router` — the fleet rung: bucket-aware load
+  balancing across N sidecar endpoints with health-probe eviction,
+  re-verify-on-kill failover and rolling-restart support.
 
 Import the submodules directly; this package namespace stays empty so
 importing it costs nothing in jax-free processes.

@@ -72,6 +72,11 @@ class SidecarClient:
         self.address = address
         self.connect_timeout_s = connect_timeout_s
         self.request_timeout_s = request_timeout_s
+        # negotiated protocol revision: optimistic v2, latched down to
+        # v1 when the connect-time hello learns the server refuses v2
+        # frames (an old sidecar kills the stream on an unknown
+        # version) — old servers keep serving new clients, minus QoS
+        self.version = proto.PROTOCOL_VERSION
         self._sock = None
         self._send_lock = threading.Lock()
         self._recv_lock = threading.Lock()
@@ -95,7 +100,63 @@ class SidecarClient:
         sock.settimeout(self.connect_timeout_s)
         sock.connect(target)
         sock.settimeout(self.request_timeout_s)
-        return sock
+        return self._hello(sock, family, target)
+
+    def _hello(self, sock, family, target):
+        """Connect-time version negotiation: one PING at the preferred
+        revision, raw on the fresh socket (nothing else is in flight
+        yet).  The downgrade to v1 is EVIDENCE-BASED: only a reply that
+        is not a PING ST_OK (the old server answers one ST_ERROR frame
+        before closing) latches v1 — a silent EOF or reset (a sidecar
+        restarting under the dial) is a transport failure that raises,
+        so a transient crash window can never permanently strip the
+        QoS class off a long-lived client.  A server refusing v1 too
+        is genuinely unusable."""
+        import socket as _socket
+
+        while True:
+            refusal = False
+            try:
+                proto.send_frame(sock, proto.OP_PING, 0, b"",
+                                 version=self.version)
+                reply = proto.recv_frame(sock)
+                if reply is not None:
+                    opcode, _rid, payload = reply
+                    if opcode == proto.OP_PING:
+                        status, _, _, _ = proto.decode_verify_response(
+                            payload
+                        )
+                        if status == proto.ST_OK:
+                            return sock
+                    # it answered SOMETHING that is not an acceptance:
+                    # the refusing server's one error frame
+                    refusal = True
+            except proto.ProtocolError:
+                refusal = True  # unparseable reply: not our revision
+            except OSError as exc:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                raise SidecarUnavailable(f"hello transport: {exc}") from exc
+            try:
+                sock.close()
+            except OSError:
+                pass
+            if not refusal:
+                # clean EOF, no refusal frame: the server went away
+                # mid-hello — retry later at the SAME revision
+                raise SidecarUnavailable("hello: stream closed")
+            if self.version <= proto.MIN_PROTOCOL_VERSION:
+                raise SidecarUnavailable(
+                    f"hello refused at protocol v{self.version}"
+                )
+            with self._state_lock:
+                self.version = proto.MIN_PROTOCOL_VERSION
+            sock = _socket.socket(family, _socket.SOCK_STREAM)
+            sock.settimeout(self.connect_timeout_s)
+            sock.connect(target)
+            sock.settimeout(self.request_timeout_s)
 
     def _ensure_sock(self):
         with self._state_lock:
@@ -111,7 +172,7 @@ class SidecarClient:
         # await_reply loop must not stall behind the dialer
         try:
             sock = self._connect()
-        except OSError as exc:
+        except (OSError, SidecarUnavailable) as exc:
             self._dial_gate.record_failure()
             raise SidecarUnavailable(
                 f"connect {self.address}: {exc}"
@@ -161,7 +222,8 @@ class SidecarClient:
                     "event": threading.Event(), "reply": None, "error": None,
                 }
             try:
-                proto.send_frame(sock, opcode, token, payload)
+                proto.send_frame(sock, opcode, token, payload,
+                                 version=self.version)
             except OSError as exc:
                 self._fail_all(exc)
                 raise SidecarUnavailable(f"send: {exc}") from exc
@@ -225,6 +287,12 @@ class SidecarClient:
     def request(self, opcode: int, payload: bytes = b"") -> bytes:
         return self.await_reply(self.submit(opcode, payload))
 
+    def ensure_connected(self) -> None:
+        """Dial (and version-hello) now if not connected.  Callers that
+        encode version-dependent payloads use this to latch the
+        negotiated revision BEFORE building the request body."""
+        self._ensure_sock()
+
     # -- typed helpers -----------------------------------------------------
     def ping(self) -> bool:
         status, _, _, _ = proto.decode_verify_response(
@@ -242,12 +310,16 @@ class SidecarClient:
 
 
 def encode_lanes(
-    keys: Sequence, signatures: Sequence[bytes], digests: Sequence[bytes]
+    keys: Sequence, signatures: Sequence[bytes], digests: Sequence[bytes],
+    qos_class: Optional[int] = proto.DEFAULT_QOS, channel: str = "",
 ) -> bytes:
     """Provider lanes -> wire payload, deduplicating repeated key
     objects (the MSP cache reuses them) into the frame's key table.  A
     key that cannot serialize maps to NO_KEY — the server verifies that
-    lane False, same as the in-process parse path."""
+    lane False, same as the in-process parse path.  The default body is
+    the protocol-rev-2 layout (QoS prefix, matching SidecarClient's
+    default frame revision); pass ``qos_class=None`` for the v1 body a
+    v1-latched connection must send."""
     from fabric_tpu.common import p256
 
     table: List[bytes] = []
@@ -270,7 +342,9 @@ def encode_lanes(
                     table.append(raw)
                     index_of[id(key)] = idx
         lanes.append((idx, bytes(sig), bytes(digest)))
-    return proto.encode_verify_request(table, lanes)
+    return proto.encode_verify_request(
+        table, lanes, qos_class=qos_class, channel=channel
+    )
 
 
 class SidecarProvider:
@@ -286,6 +360,8 @@ class SidecarProvider:
         fallback=None,
         busy_policy: RetryPolicy = BUSY_POLICY,
         sleeper: Callable[[float], None] = time.sleep,
+        qos_class: Optional[int] = None,
+        channel: str = "",
     ):
         address = address or os.environ.get("FABRIC_TPU_SERVE_ADDR", "")
         if not address:
@@ -300,6 +376,24 @@ class SidecarProvider:
         self._fallback_lock = threading.Lock()
         self.degraded = False  # latched: any request served in-process
         self.busy_rejects = 0  # admission rejections observed
+        # admission class for protocol rev 2: explicit class wins, else
+        # the FABRIC_TPU_SERVE_QOS channel map, else the wire default
+        self.channel = channel
+        if qos_class is None:
+            from fabric_tpu.serve.qos import class_for_channel, qos_map_from_env
+
+            qos_class = class_for_channel(channel, qos_map_from_env())
+        self.qos_class = qos_class
+
+    def _encode(self, keys, signatures, digests) -> bytes:
+        """Lane payload at the negotiated revision: the QoS prefix is
+        only emitted once the client knows the server speaks v2."""
+        if self.client.version >= 2:
+            return encode_lanes(
+                keys, signatures, digests,
+                qos_class=self.qos_class, channel=self.channel,
+            )
+        return encode_lanes(keys, signatures, digests, qos_class=None)
 
     # -- in-process fallback ----------------------------------------------
     def fallback_provider(self):
@@ -355,13 +449,14 @@ class SidecarProvider:
         if n == 0:
             return []
         t0 = time.perf_counter()
-        try:
-            payload = encode_lanes(keys, signatures, digests)
-        except proto.ProtocolError as exc:
-            return self._degrade(keys, signatures, digests, exc)
         bo = Backoff(self.busy_policy, sleeper=self._sleeper)
         while True:
             try:
+                # connect (and hello) BEFORE encoding: the QoS prefix
+                # is only valid at the negotiated revision, and a retry
+                # after a reconnect may have latched a different one
+                self.client.ensure_connected()
+                payload = self._encode(keys, signatures, digests)
                 status, retry_ms, mask, message = self._verify_once(payload)
             except (SidecarUnavailable, proto.ProtocolError) as exc:
                 # a reply body that decodes to garbage (version skew,
@@ -418,7 +513,8 @@ class SidecarProvider:
             return list
         t0 = time.perf_counter()
         try:
-            payload = encode_lanes(keys, signatures, digests)
+            self.client.ensure_connected()
+            payload = self._encode(keys, signatures, digests)
             token = self.client.submit(proto.OP_VERIFY, payload)
         except (proto.ProtocolError, SidecarUnavailable) as exc:
             why = exc
@@ -467,6 +563,24 @@ class SidecarProvider:
     def sign(self, key, digest: bytes) -> bytes:
         return self.fallback_provider().sign(key, digest)
 
+    def for_channel(self, channel_id: str) -> "SidecarProvider":
+        """A channel-bound view of this provider: SAME pipelined
+        connection and fallback, the CHANNEL's admission class (from
+        the FABRIC_TPU_SERVE_QOS map) stamped on every batch — how a
+        peer's per-channel validators become per-class traffic on a
+        shared sidecar without a socket per channel."""
+        import copy
+
+        from fabric_tpu.serve.qos import class_for_channel, qos_map_from_env
+
+        cls = class_for_channel(channel_id, qos_map_from_env())
+        if channel_id == self.channel and cls == self.qos_class:
+            return self
+        bound = copy.copy(self)
+        bound.channel = channel_id
+        bound.qos_class = cls
+        return bound
+
     def describe_backend(self) -> str:
         if self.degraded:
             return (
@@ -479,11 +593,31 @@ class SidecarProvider:
 
 
 def _provider_from_config(cfg: dict):
-    """BCCSP factory hook: Default: SERVE -> SidecarProvider.  The SW
+    """BCCSP factory hook: Default: SERVE -> SidecarProvider, or the
+    multi-endpoint SidecarRouter when a fleet is configured
+    (``SERVE.Endpoints`` or ``FABRIC_TPU_SERVE_ENDPOINTS``).  The SW
     sub-config's tier pins were already applied by the factory, so the
     in-process fallback rides the operator's chosen ladder."""
     serve_cfg = (cfg or {}).get("SERVE") or {}
-    return SidecarProvider(address=serve_cfg.get("Address"))
+    channel = serve_cfg.get("Channel") or ""
+    qos_class = None
+    qos_name = serve_cfg.get("QoS")
+    if qos_name in proto.QOS_NAMES:
+        qos_class = proto.QOS_NAMES.index(qos_name)
+    endpoints = serve_cfg.get("Endpoints")
+    if not endpoints:
+        from fabric_tpu.serve.router import endpoints_from_env
+
+        endpoints = endpoints_from_env() or None
+    if endpoints:
+        from fabric_tpu.serve.router import SidecarRouter
+
+        return SidecarRouter(
+            endpoints=endpoints, qos_class=qos_class, channel=channel
+        )
+    return SidecarProvider(
+        address=serve_cfg.get("Address"), qos_class=qos_class, channel=channel
+    )
 
 
 # Dependency inversion keeps the layer map acyclic: serve (layer 6) may

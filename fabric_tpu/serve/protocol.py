@@ -8,13 +8,13 @@ validation workload to an attached verifier over a fixed framing).
 Frame layout (big-endian)::
 
     magic   2s   b"FT"
-    version u8   PROTOCOL_VERSION
+    version u8   the frame's protocol revision (1 or 2)
     opcode  u8   OP_*
     req_id  u32  caller-chosen; echoed verbatim on the response
     length  u32  payload byte count (bounded by MAX_PAYLOAD)
     payload length bytes
 
-A VERIFY request payload is a key-deduplicated lane table::
+A version-1 VERIFY request payload is a key-deduplicated lane table::
 
     u16 n_keys, then per key:  u16 klen + klen bytes (SEC1 point)
     u32 n_lanes, then per lane: u16 key_idx | u16 siglen + sig
@@ -22,6 +22,23 @@ A VERIFY request payload is a key-deduplicated lane table::
 
 ``key_idx == NO_KEY`` marks a lane with no usable key — the server MUST
 verify it as False (fail-closed), never error the whole batch.
+
+Protocol revision 2 (the fleet QoS rev) prefixes the SAME lane table
+with an admission-class header so a shared sidecar can shed
+priority-aware::
+
+    u8  qos_class   QOS_HIGH | QOS_NORMAL | QOS_BULK
+    u8  chan_len  + chan_len bytes of UTF-8 channel id (accounting only)
+    ... the v1 lane table, unchanged ...
+
+Negotiation is per-frame and downgrade-safe in both directions: the
+version byte rides every header, a v2 server accepts v1 frames (class
+defaults to ``QOS_NORMAL``), and a v2 client hellos with a PING at its
+preferred revision, latching v1 when a v1-only server refuses the
+stream — old clients and old servers keep working unmodified.
+Revision 2 also adds ``OP_DRAIN``: answer new VERIFY work
+``ST_STOPPING`` while in-flight requests settle with their real
+verdicts, then exit — the rolling-restart half of the failover story.
 
 A VERIFY response payload::
 
@@ -45,7 +62,8 @@ from __future__ import annotations
 import struct
 from typing import List, Optional, Sequence, Tuple
 
-PROTOCOL_VERSION = 1
+PROTOCOL_VERSION = 2
+MIN_PROTOCOL_VERSION = 1
 MAGIC = b"FT"
 
 # opcodes
@@ -53,6 +71,23 @@ OP_PING = 1
 OP_VERIFY = 2
 OP_STATS = 3
 OP_SHUTDOWN = 4
+OP_DRAIN = 5  # protocol rev 2: refuse new work, settle in-flight, exit
+
+# admission (QoS) classes, protocol rev 2.  Lower id = higher priority;
+# the names are the metric/scorecard vocabulary (label ``cls``).
+QOS_HIGH = 0
+QOS_NORMAL = 1
+QOS_BULK = 2
+QOS_NAMES = ("high", "normal", "bulk")
+DEFAULT_QOS = QOS_NORMAL
+
+
+def qos_name(qos_class: int) -> str:
+    """Stable label text for a wire class id (unknown ids are clamped
+    to bulk — an out-of-range class must never grant priority)."""
+    if 0 <= qos_class < len(QOS_NAMES):
+        return QOS_NAMES[qos_class]
+    return QOS_NAMES[QOS_BULK]
 
 # response statuses
 ST_OK = 0
@@ -89,13 +124,16 @@ def parse_address(address: str) -> Tuple[int, object]:
     return socket.AF_INET, (host, int(port))
 
 
-def pack_frame(opcode: int, req_id: int, payload: bytes) -> bytes:
+def pack_frame(
+    opcode: int, req_id: int, payload: bytes,
+    version: int = PROTOCOL_VERSION,
+) -> bytes:
     if len(payload) > MAX_PAYLOAD:
         raise ProtocolError(
             f"payload {len(payload)} exceeds MAX_PAYLOAD {MAX_PAYLOAD}"
         )
     return _HEADER.pack(
-        MAGIC, PROTOCOL_VERSION, opcode, req_id & 0xFFFFFFFF, len(payload)
+        MAGIC, version, opcode, req_id & 0xFFFFFFFF, len(payload)
     ) + payload
 
 
@@ -115,26 +153,40 @@ def _recv_exact(sock, n: int) -> Optional[bytes]:
     return b"".join(chunks)
 
 
-def recv_frame(sock) -> Optional[Tuple[int, int, bytes]]:
-    """(opcode, req_id, payload), or None on clean EOF."""
+def recv_frame_ex(sock) -> Optional[Tuple[int, int, bytes, int]]:
+    """(opcode, req_id, payload, version), or None on clean EOF.  Any
+    revision in [MIN_PROTOCOL_VERSION, PROTOCOL_VERSION] is accepted —
+    a v2 server keeps serving v1 clients, frame by frame."""
     head = _recv_exact(sock, HEADER_SIZE)
     if head is None:
         return None
     magic, version, opcode, req_id, length = _HEADER.unpack(head)
     if magic != MAGIC:
         raise ProtocolError(f"bad magic {magic!r}")
-    if version != PROTOCOL_VERSION:
+    if not MIN_PROTOCOL_VERSION <= version <= PROTOCOL_VERSION:
         raise ProtocolError(f"unsupported protocol version {version}")
     if length > MAX_PAYLOAD:
         raise ProtocolError(f"frame length {length} exceeds MAX_PAYLOAD")
     payload = _recv_exact(sock, length) if length else b""
     if length and payload is None:
         raise ProtocolError("connection closed before payload")
-    return opcode, req_id, payload or b""
+    return opcode, req_id, payload or b"", version
 
 
-def send_frame(sock, opcode: int, req_id: int, payload: bytes) -> None:
-    sock.sendall(pack_frame(opcode, req_id, payload))
+def recv_frame(sock) -> Optional[Tuple[int, int, bytes]]:
+    """(opcode, req_id, payload), or None on clean EOF (the version
+    byte dropped — response payload layouts are revision-stable)."""
+    frame = recv_frame_ex(sock)
+    if frame is None:
+        return None
+    return frame[0], frame[1], frame[2]
+
+
+def send_frame(
+    sock, opcode: int, req_id: int, payload: bytes,
+    version: int = PROTOCOL_VERSION,
+) -> None:
+    sock.sendall(pack_frame(opcode, req_id, payload, version=version))
 
 
 # ---------------------------------------------------------------------------
@@ -145,9 +197,29 @@ def send_frame(sock, opcode: int, req_id: int, payload: bytes) -> None:
 def encode_verify_request(
     key_table: Sequence[bytes],
     lanes: Sequence[Tuple[int, bytes, bytes]],
+    qos_class: Optional[int] = None,
+    channel: str = "",
 ) -> bytes:
     """key_table: SEC1 key bytes per distinct key; lanes: (key_idx, sig,
-    digest) with key_idx == NO_KEY for unusable-key lanes."""
+    digest) with key_idx == NO_KEY for unusable-key lanes.  Passing a
+    ``qos_class`` produces the protocol-rev-2 body (class + channel
+    prefix); ``None`` keeps the v1 layout byte-identical, so a client
+    latched to v1 never emits a body an old server cannot parse."""
+    out: List[bytes] = []
+    if qos_class is not None:
+        if not 0 <= qos_class < len(QOS_NAMES):
+            raise ProtocolError(f"qos class {qos_class} out of range")
+        chan = channel.encode("utf-8", "backslashreplace")[:255]
+        out.append(struct.pack(">BB", qos_class, len(chan)))
+        out.append(chan)
+    out.append(_encode_lane_table(key_table, lanes))
+    return b"".join(out)
+
+
+def _encode_lane_table(
+    key_table: Sequence[bytes],
+    lanes: Sequence[Tuple[int, bytes, bytes]],
+) -> bytes:
     if len(key_table) >= NO_KEY:
         raise ProtocolError(f"too many distinct keys ({len(key_table)})")
     out = [struct.pack(">H", len(key_table))]
@@ -194,8 +266,19 @@ class _Reader:
 
 def decode_verify_request(
     payload: bytes,
-) -> Tuple[List[bytes], List[Tuple[int, bytes, bytes]]]:
+    version: int = 1,
+) -> Tuple[List[bytes], List[Tuple[int, bytes, bytes]], int, str]:
+    """(keys, lanes, qos_class, channel).  v1 payloads decode with the
+    default class (``QOS_NORMAL``) and an empty channel — the QoS
+    admission path treats old clients exactly like unclassified
+    traffic, never an error."""
     r = _Reader(payload)
+    qos_class, channel = DEFAULT_QOS, ""
+    if version >= 2:
+        qos_class = r.u8()
+        if not 0 <= qos_class < len(QOS_NAMES):
+            raise ProtocolError(f"qos class {qos_class} out of range")
+        channel = r.take(r.u8()).decode("utf-8", "replace")
     n_keys = r.u16()
     keys = [r.take(r.u16()) for _ in range(n_keys)]
     n_lanes = r.u32()
@@ -211,7 +294,7 @@ def decode_verify_request(
         lanes.append((key_idx, sig, digest))
     if r.off != len(payload):
         raise ProtocolError("trailing bytes after lane table")
-    return keys, lanes
+    return keys, lanes, qos_class, channel
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,451 @@
+"""Multi-sidecar router: peer-side load balancing with failover.
+
+One sidecar is a warm appliance; a fleet needs several behind every
+peer so a single sidecar death is a *routing* event, not a degrade-to-
+inline event.  :class:`SidecarRouter` presents the same Provider SPI as
+``SidecarProvider`` and spreads a peer's batches across N endpoints:
+
+- **bucket-aware placement**: a batch's lane-bucket picks its endpoint
+  by rendezvous hash (``sha256(bucket | address)``), so each sidecar
+  sees a stable subset of shapes and its warm executables stay hot —
+  while any endpoint can serve any bucket when its preferred one dies;
+- **health-probe eviction**: every endpoint carries its own
+  ``CooldownGate`` (the serve client's dial-circuit discipline, lifted
+  to serving failures) — a dead endpoint is skipped for exponentially
+  longer cooldowns and re-probed with a cheap PING before it gets a
+  real batch again, so one blackholed sidecar never slows dials to the
+  healthy ones;
+- **re-verify-on-kill, across endpoints**: the PR 8 ST_STOPPING
+  discipline (never trust a dying sidecar's settlement) now fails over
+  — a kill/drain mid-batch re-verifies on the next healthy endpoint,
+  and only when EVERY endpoint has refused does the router degrade to
+  the in-process ladder (bit-exact masks, all-False only on a double
+  fault: the client shim's mask contract verbatim);
+- **rolling-restart support**: a draining sidecar answers ST_STOPPING
+  and flips its /healthz, the router routes around it, and the restart
+  finds its way back in after one successful probe — restarting every
+  sidecar in sequence under sustained load never breaks mask
+  bit-exactness (fabchaos ``router_flap`` proves it).
+
+``fault_point("serve.route")`` arms each dispatch attempt for chaos.
+Endpoint health transitions drive the ``fabric_serve_endpoint_healthy``
+gauge.  Addresses come from the constructor, ``BCCSP SERVE.Endpoints``,
+or ``FABRIC_TPU_SERVE_ENDPOINTS`` (comma-separated).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+import time
+from typing import Callable, List, Optional, Sequence, Tuple
+
+from fabric_tpu.common import fabobs
+from fabric_tpu.common.faults import fault_point
+from fabric_tpu.common.flogging import must_get_logger
+from fabric_tpu.common.retry import Backoff, CooldownGate, RetryPolicy
+from fabric_tpu.serve import protocol as proto
+from fabric_tpu.serve.client import (
+    BUSY_POLICY,
+    SidecarClient,
+    SidecarUnavailable,
+    encode_lanes,
+)
+
+logger = must_get_logger("serve.router")
+
+#: endpoint serving-failure circuit: faster ramp than the default
+#: rebuild gate — a routing decision is cheap, a wrong one costs one
+#: failed request, and a restarted sidecar should be back in rotation
+#: within seconds
+ENDPOINT_GATE_POLICY = RetryPolicy(
+    base_s=0.25, multiplier=2.0, cap_s=5.0, deadline_s=float("inf")
+)
+
+#: lane-bucket ladder for placement (the registry's shape discipline;
+#: placement only needs stability, not agreement with any one sidecar's
+#: configured ladder)
+ROUTE_BUCKETS = (128, 256, 512, 1024, 2048, 4096, 8192, 16384)
+
+
+def _route_bucket(n: int) -> int:
+    for b in ROUTE_BUCKETS:
+        if n <= b:
+            return b
+    return ROUTE_BUCKETS[-1]
+
+
+class _Endpoint:
+    """One sidecar endpoint: pipelined client + serving-failure gate.
+    All mutable health state is guarded by the endpoint's lock."""
+
+    def __init__(self, address: str, gate_policy: RetryPolicy,
+                 clock: Callable[[], float] = time.monotonic):
+        self.address = address
+        self.client = SidecarClient(address)
+        self.gate = CooldownGate(policy=gate_policy, clock=clock)
+        self._lock = threading.Lock()
+        self._healthy = True
+        fabobs.obs_gauge(
+            "fabric_serve_endpoint_healthy", 1.0, endpoint=address
+        )
+
+    @property
+    def healthy(self) -> bool:
+        with self._lock:
+            return self._healthy
+
+    def mark_up(self) -> None:
+        self.gate.record_success()
+        with self._lock:
+            flipped = not self._healthy
+            self._healthy = True
+        if flipped:
+            logger.info("sidecar endpoint %s is healthy again", self.address)
+            fabobs.obs_gauge(
+                "fabric_serve_endpoint_healthy", 1.0, endpoint=self.address
+            )
+
+    def mark_down(self, why: object) -> None:
+        self.gate.record_failure()
+        with self._lock:
+            flipped = self._healthy
+            self._healthy = False
+        if flipped:
+            logger.warning(
+                "sidecar endpoint %s evicted (%s); cooling down",
+                self.address, why,
+            )
+            fabobs.obs_gauge(
+                "fabric_serve_endpoint_healthy", 0.0, endpoint=self.address
+            )
+
+
+def endpoints_from_env() -> List[str]:
+    """``FABRIC_TPU_SERVE_ENDPOINTS`` -> address list (shared read
+    discipline: an empty/whitespace value is simply no endpoints)."""
+    raw = os.environ.get("FABRIC_TPU_SERVE_ENDPOINTS", "")
+    return [a.strip() for a in raw.split(",") if a.strip()]
+
+
+class SidecarRouter:
+    """Provider SPI over N sidecar endpoints with peer-side failover.
+
+    Single verify/sign/hash/key ops run in-process (the sidecar fleet
+    exists for the batch plane), exactly like ``SidecarProvider``."""
+
+    def __init__(
+        self,
+        endpoints: Optional[Sequence[str]] = None,
+        fallback=None,
+        busy_policy: RetryPolicy = BUSY_POLICY,
+        sleeper: Callable[[float], None] = time.sleep,
+        qos_class: Optional[int] = None,
+        channel: str = "",
+        gate_policy: RetryPolicy = ENDPOINT_GATE_POLICY,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if endpoints is None:
+            endpoints = endpoints_from_env()
+        if isinstance(endpoints, str):
+            endpoints = [a.strip() for a in endpoints.split(",") if a.strip()]
+        if not endpoints:
+            raise ValueError(
+                "router needs at least one sidecar endpoint "
+                "(FABRIC_TPU_SERVE_ENDPOINTS or BCCSP SERVE.Endpoints)"
+            )
+        self.endpoints: List[_Endpoint] = [
+            _Endpoint(addr, gate_policy, clock=clock) for addr in endpoints
+        ]
+        self.busy_policy = busy_policy
+        self._sleeper = sleeper
+        self._fallback = fallback
+        self._fallback_lock = threading.Lock()
+        self.degraded = False  # latched: any batch served in-process
+        self.busy_rejects = 0
+        self.channel = channel
+        if qos_class is None:
+            from fabric_tpu.serve.qos import class_for_channel, qos_map_from_env
+
+            qos_class = class_for_channel(channel, qos_map_from_env())
+        self.qos_class = qos_class
+
+    # -- placement ---------------------------------------------------------
+    def _order(self, lanes: int) -> List[_Endpoint]:
+        """Endpoint preference for a batch: rendezvous-hashed on the
+        lane bucket over SELECTABLE endpoints (gate ready), so buckets
+        spread across the fleet and a cooling endpoint is skipped
+        without a dial.  Every selectable endpoint stays in the list —
+        positions 2..N are the failover ladder."""
+        bucket = _route_bucket(lanes)
+        ready = [e for e in self.endpoints if e.gate.ready()]
+        if not ready:
+            return []
+
+        def score(e: _Endpoint) -> bytes:
+            return hashlib.sha256(
+                f"{bucket}|{e.address}".encode("utf-8", "backslashreplace")
+            ).digest()
+
+        return sorted(ready, key=score)
+
+    def _probe_ok(self, e: _Endpoint) -> bool:
+        """A previously-evicted endpoint earns a real batch back with a
+        cheap PING first — a probe failure costs microseconds, a routed
+        batch failure costs a re-verify."""
+        if e.healthy:
+            return True
+        try:
+            if e.client.ping():
+                e.mark_up()
+                return True
+        except (SidecarUnavailable, proto.ProtocolError) as exc:
+            e.mark_down(exc)
+        return False
+
+    # -- in-process fallback ----------------------------------------------
+    def fallback_provider(self):
+        with self._fallback_lock:
+            if self._fallback is None:
+                from fabric_tpu.crypto.bccsp import probe_provider
+
+                self._fallback = probe_provider()
+            return self._fallback
+
+    def _degrade(self, keys, signatures, digests, why) -> List[bool]:
+        """Every endpoint refused: in-process verification (bit-exact
+        masks), all-False only if the local ladder ALSO fails."""
+        if not self.degraded:
+            logger.warning(
+                "all %d sidecar endpoints unavailable (%s); degrading "
+                "to in-process verification", len(self.endpoints), why,
+            )
+            fabobs.obs_count("fabric_degrade_total", seam="serve.router")
+            fabobs.obs_trigger("serve.router_degraded")
+        self.degraded = True
+        try:
+            mask = self.fallback_provider().batch_verify(
+                keys, signatures, digests
+            )
+            return list(mask)
+        except Exception as exc:  # noqa: BLE001 - double fault: fail closed
+            logger.error(
+                "in-process fallback failed too (%s): batch fails closed",
+                exc,
+            )
+            return [False] * len(keys)
+
+    # -- one endpoint, one attempt ----------------------------------------
+    def _try_endpoint(
+        self, e: _Endpoint, keys, signatures, digests, attempt: int
+    ) -> Tuple[str, Optional[List[bool]]]:
+        """('ok', mask) | ('busy', None) | ('dead', None).  BUSY is
+        admission control, not endpoint failure — the gate only records
+        failures that mean the endpoint cannot serve."""
+        n = len(keys)
+        try:
+            # chaos seam: an injected routing fault fails THIS attempt
+            # on THIS endpoint — the ladder below must absorb it
+            fault_point("serve.route", key=(e.address, attempt))
+            e.client.ensure_connected()
+            if e.client.version >= 2:
+                payload = encode_lanes(
+                    keys, signatures, digests,
+                    qos_class=self.qos_class, channel=self.channel,
+                )
+            else:
+                payload = encode_lanes(keys, signatures, digests,
+                                       qos_class=None)
+            status, _retry_ms, mask, message = proto.decode_verify_response(
+                e.client.request(proto.OP_VERIFY, payload)
+            )
+        except Exception as exc:  # noqa: BLE001 - endpoint failure (incl. injected) routes to the next rung, never past the mask contract
+            logger.debug("endpoint %s verify attempt failed: %s", e.address, exc)
+            e.mark_down(exc)
+            return "dead", None
+        if status == proto.ST_OK and mask is not None and len(mask) == n:
+            e.mark_up()
+            return "ok", mask
+        if status == proto.ST_BUSY:
+            self.busy_rejects += 1  # GIL-atomic add, stats only
+            return "busy", None
+        # ST_STOPPING / ST_ERROR / malformed OK: the re-verify-on-kill
+        # discipline across endpoints — never trust this settlement,
+        # route the batch to the next endpoint
+        e.mark_down(message or f"status {status}")
+        return "dead", None
+
+    # -- the batch plane ---------------------------------------------------
+    def batch_verify(self, keys, signatures, digests) -> List[bool]:
+        n = len(keys)
+        if n == 0:
+            return []
+        t0 = time.perf_counter()
+        bo = Backoff(self.busy_policy, sleeper=self._sleeper)
+        attempt = 0
+        while True:
+            any_busy = False
+            for e in self._order(n):
+                if not self._probe_ok(e):
+                    continue
+                attempt += 1
+                outcome, mask = self._try_endpoint(
+                    e, keys, signatures, digests, attempt
+                )
+                if outcome == "ok":
+                    assert mask is not None
+                    fabobs.obs_count(
+                        "fabric_verify_lanes_total", n, rung="serve"
+                    )
+                    fabobs.obs_observe(
+                        "fabric_verify_seconds",
+                        time.perf_counter() - t0, rung="serve",
+                    )
+                    return mask
+                if outcome == "busy":
+                    any_busy = True
+            if any_busy and bo.sleep():
+                continue  # every live endpoint is shedding: pace + retry
+            return self._degrade(
+                keys, signatures, digests,
+                "every endpoint busy (budget spent)" if any_busy
+                else "no healthy endpoint",
+            )
+
+    def batch_verify_async(self, keys, signatures, digests):
+        """Pipelined dispatch through the preferred endpoint; ANY
+        failure at resolve time re-routes through the sync failover
+        ladder (which owns the degrade contract)."""
+        n = len(keys)
+        if n == 0:
+            return list
+        t0 = time.perf_counter()
+        chosen: Optional[_Endpoint] = None
+        token = None
+        for e in self._order(n):
+            if not self._probe_ok(e):
+                continue
+            try:
+                fault_point("serve.route", key=(e.address, 0))
+                e.client.ensure_connected()
+                if e.client.version >= 2:
+                    payload = encode_lanes(
+                        keys, signatures, digests,
+                        qos_class=self.qos_class, channel=self.channel,
+                    )
+                else:
+                    payload = encode_lanes(keys, signatures, digests,
+                                           qos_class=None)
+                token = e.client.submit(proto.OP_VERIFY, payload)
+                chosen = e
+                break
+            except Exception as exc:  # noqa: BLE001 - submit failure (incl. injected): next endpoint
+                logger.debug("endpoint %s submit failed: %s", e.address, exc)
+                e.mark_down(exc)
+
+        def resolve() -> List[bool]:
+            if chosen is None or token is None:
+                return self.batch_verify(keys, signatures, digests)
+            try:
+                status, _, mask, _ = proto.decode_verify_response(
+                    chosen.client.await_reply(token)
+                )
+            except (SidecarUnavailable, proto.ProtocolError) as exc:
+                chosen.mark_down(exc)
+                return self.batch_verify(keys, signatures, digests)
+            if status == proto.ST_OK and mask is not None and len(mask) == n:
+                chosen.mark_up()
+                fabobs.obs_count("fabric_verify_lanes_total", n, rung="serve")
+                fabobs.obs_observe(
+                    "fabric_verify_seconds",
+                    time.perf_counter() - t0, rung="serve",
+                )
+                return mask
+            if status != proto.ST_BUSY:
+                chosen.mark_down(f"status {status}")
+            return self.batch_verify(keys, signatures, digests)
+
+        return resolve
+
+    # -- fleet operations --------------------------------------------------
+    def drain_endpoint(self, address: str) -> bool:
+        """Ask one sidecar to drain (rolling restart step): True when
+        the endpoint acknowledged the OP_DRAIN.  The router marks it
+        down immediately so no new batch races the drain."""
+        for e in self.endpoints:
+            if e.address != address:
+                continue
+            try:
+                reply = e.client.request(proto.OP_DRAIN)
+                status, _, _, _ = proto.decode_verify_response(reply)
+                e.mark_down("draining (rolling restart)")
+                return status == proto.ST_OK
+            except (SidecarUnavailable, proto.ProtocolError) as exc:
+                e.mark_down(exc)
+                return False
+        return False
+
+    def for_channel(self, channel_id: str) -> "SidecarRouter":
+        """Channel-bound view sharing the endpoint clients and gates
+        (one fleet, per-class traffic) — the SidecarProvider.for_channel
+        contract over the router."""
+        import copy
+
+        from fabric_tpu.serve.qos import class_for_channel, qos_map_from_env
+
+        cls = class_for_channel(channel_id, qos_map_from_env())
+        if channel_id == self.channel and cls == self.qos_class:
+            return self
+        bound = copy.copy(self)
+        bound.channel = channel_id
+        bound.qos_class = cls
+        return bound
+
+    def describe(self) -> dict:
+        return {
+            "endpoints": [
+                {
+                    "address": e.address,
+                    "healthy": e.healthy,
+                    "selectable": e.gate.ready(),
+                    "version": e.client.version,
+                }
+                for e in self.endpoints
+            ],
+            "qos_class": proto.qos_name(self.qos_class),
+            "channel": self.channel,
+            "degraded": self.degraded,
+            "busy_rejects": self.busy_rejects,
+        }
+
+    # -- pass-through SPI --------------------------------------------------
+    def verify(self, key, signature: bytes, digest: bytes) -> bool:
+        return self.fallback_provider().verify(key, signature, digest)
+
+    def batch_hash(self, msgs):
+        return self.fallback_provider().batch_hash(msgs)
+
+    def hash(self, msg: bytes) -> bytes:
+        return self.fallback_provider().hash(msg)
+
+    def key_import(self, raw: bytes):
+        return self.fallback_provider().key_import(raw)
+
+    def key_gen(self):
+        return self.fallback_provider().key_gen()
+
+    def sign(self, key, digest: bytes) -> bytes:
+        return self.fallback_provider().sign(key, digest)
+
+    def describe_backend(self) -> str:
+        if self.degraded:
+            return (
+                "router-degraded("
+                f"{self.fallback_provider().describe_backend()})"
+            )
+        return "serve-router:" + ",".join(e.address for e in self.endpoints)
+
+    def stop(self) -> None:
+        for e in self.endpoints:
+            e.client.close()

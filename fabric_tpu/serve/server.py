@@ -10,19 +10,29 @@ of XLA compile), the sidecar pays once per process lifetime.
 
 Request flow per VERIFY frame::
 
-    decode -> serve.dispatch fault seam -> ADMISSION (VerifyBatcher
-    bounded lanes, non-blocking) -> coalesced launch -> mask reply
+    decode -> serve.dispatch fault seam -> QoS CLASS ADMISSION
+    (per-class lane quotas, work-conserving borrowing) -> ADMISSION
+    (VerifyBatcher bounded lanes, non-blocking) -> coalesced launch ->
+    mask reply
 
-Admission control is the VerifyBatcher's bounded-lane budget surfaced
-as protocol backpressure: a request that does not fit is REJECTED with
-``ST_BUSY`` + ``retry_after_ms`` instead of blocking the socket thread
-— the client shim paces retries with ``common.retry`` and the peer's
+Admission control is two-tiered protocol backpressure: the per-class
+:class:`~fabric_tpu.serve.qos.ClassLedger` quota first (priority-aware
+— a zipf spam channel can borrow idle lanes but never a paying
+channel's reservation), then the VerifyBatcher's bounded-lane budget.
+A request that does not fit NOW is REJECTED with ``ST_BUSY`` + a
+per-class ``retry_after_ms`` instead of blocking the socket thread —
+the client shim paces retries with ``common.retry`` and the peer's
 deliver loop stalls exactly like the reference's WaitReady discipline.
+Every shed is a protocol-level reply, never a silent drop.
 
 Shutdown is fail-closed *and* mask-exact: in-flight requests settled by
 a dying batcher are answered ``ST_STOPPING`` (never an OK carrying
 guessed verdicts), so the client re-verifies in-process and masks stay
-bit-exact through a sidecar kill.
+bit-exact through a sidecar kill.  ``drain()`` (OP_DRAIN / SIGTERM) is
+the rolling-restart half: NEW work answers ``ST_STOPPING`` immediately
+while in-flight requests settle with their real computed verdicts, so
+restarting every sidecar behind a router under load never costs a mask
+bit.
 
 Run it::
 
@@ -38,13 +48,14 @@ import os
 import socket
 import threading
 import time
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from fabric_tpu.common import fabobs
 from fabric_tpu.common.faults import fault_point
 from fabric_tpu.common.flogging import must_get_logger
 from fabric_tpu.common.metrics import latency_summary
 from fabric_tpu.serve import protocol as proto
+from fabric_tpu.serve.qos import ClassLedger
 from fabric_tpu.serve.registry import (
     BucketProgramRegistry,
     DEFAULT_BUCKETS,
@@ -87,24 +98,49 @@ class ServeStats:
             maxlen=self.RESERVOIR
         )
         self.per_bucket: Dict[int, int] = {}
+        # per-class request/lane/shed accounting (protocol rev 2): the
+        # qos_storm scorecard proves priority-aware shedding off these
+        # numbers, and every shed here was an explicit ST_BUSY reply
+        self.class_served: Dict[str, int] = {}
+        self.class_lanes: Dict[str, int] = {}
+        self.class_busy: Dict[str, int] = {}
+        # per-class latency windows back the per-class p99 the fleet
+        # bench reports (same newest-win discipline as the global one)
+        self._class_latency_s: Dict[str, collections.deque] = {}
 
-    def record(self, lanes: int, bucket: int, seconds: float) -> None:
+    def record(
+        self, lanes: int, bucket: int, seconds: float,
+        qos_class: int = proto.DEFAULT_QOS,
+    ) -> None:
+        cls = proto.qos_name(qos_class)
         with self._lock:
             self.requests += 1
             self.lanes += lanes
             self.per_bucket[bucket] = self.per_bucket.get(bucket, 0) + 1
             self._latency_s.append(seconds)
+            self.class_served[cls] = self.class_served.get(cls, 0) + 1
+            self.class_lanes[cls] = self.class_lanes.get(cls, 0) + lanes
+            window = self._class_latency_s.get(cls)
+            if window is None:
+                window = self._class_latency_s[cls] = collections.deque(
+                    maxlen=self.RESERVOIR
+                )
+            window.append(seconds)
         fabobs.obs_count("fabric_serve_requests_total", status="ok")
         fabobs.obs_count("fabric_serve_lanes_total", lanes)
+        fabobs.obs_count("fabric_serve_class_lanes_total", lanes, cls=cls)
         fabobs.obs_count(
             "fabric_serve_bucket_requests_total", bucket=str(bucket)
         )
         fabobs.obs_observe("fabric_serve_request_seconds", seconds)
 
-    def reject(self) -> None:
+    def reject(self, qos_class: int = proto.DEFAULT_QOS) -> None:
+        cls = proto.qos_name(qos_class)
         with self._lock:
             self.rejects += 1
+            self.class_busy[cls] = self.class_busy.get(cls, 0) + 1
         fabobs.obs_count("fabric_serve_requests_total", status="busy")
+        fabobs.obs_count("fabric_serve_class_busy_total", cls=cls)
 
     def error(self) -> None:
         with self._lock:
@@ -126,6 +162,19 @@ class ServeStats:
                 "degraded_replies": self.degraded_replies,
                 "per_bucket": {str(k): v for k, v in self.per_bucket.items()},
                 "request_latency": latency_summary(list(self._latency_s)),
+                "per_class": {
+                    cls: {
+                        "served": self.class_served.get(cls, 0),
+                        "lanes": self.class_lanes.get(cls, 0),
+                        "busy": self.class_busy.get(cls, 0),
+                        "latency": latency_summary(
+                            list(self._class_latency_s.get(cls, ()))
+                        ),
+                    }
+                    for cls in proto.QOS_NAMES
+                    if self.class_served.get(cls, 0)
+                    or self.class_busy.get(cls, 0)
+                },
             }
 
 
@@ -170,6 +219,8 @@ class SidecarServer:
         aot_dir: Optional[str] = None,
         retry_after_base_ms: int = 25,
         ops_address: Optional[str] = None,
+        qos_shares: Optional[Dict[str, float]] = None,
+        drain_timeout_s: float = 5.0,
     ):
         from fabric_tpu.parallel.batcher import VerifyBatcher
 
@@ -190,6 +241,16 @@ class SidecarServer:
         )
         self.max_pending_lanes = max_pending_lanes
         self.retry_after_base_ms = retry_after_base_ms
+        # per-class admission in FRONT of the batcher's global budget:
+        # the ledger's lanes are held submit -> dispatch, the SAME
+        # window as the batcher's own permits (released through its
+        # on_dispatch hook), so the class quotas partition exactly the
+        # budget the batcher enforces and shedding is priority-aware
+        self.qos = ClassLedger(max_pending_lanes, qos_shares)
+        self.drain_timeout_s = drain_timeout_s
+        self._draining = False
+        self._active_verifies = 0
+        self._drain_cv = threading.Condition()
         self.stats = ServeStats()
         self.registry: Optional[BucketProgramRegistry] = None
         self.warm_ladder = warm_ladder
@@ -380,6 +441,10 @@ class SidecarServer:
         def listener_check():
             if self._listener is None or self._stopping:
                 raise RuntimeError("sidecar listener is not accepting")
+            if self._draining:
+                # a draining sidecar flips unhealthy NOW so router
+                # health probes evict it before the restart, not after
+                raise RuntimeError("sidecar is draining (rolling restart)")
 
         system.register_checker("batcher", batcher_check)
         system.register_checker("registry", registry_check)
@@ -441,29 +506,45 @@ class SidecarServer:
         workers: List[threading.Thread] = []
         try:
             while True:
-                frame = proto.recv_frame(conn)
+                frame = proto.recv_frame_ex(conn)
                 if frame is None:
                     return
-                opcode, req_id, payload = frame
+                opcode, req_id, payload, version = frame
                 if opcode == proto.OP_PING:
                     self._send(
                         conn, proto.OP_PING, req_id,
                         proto.encode_verify_response(proto.ST_OK, mask=[]),
-                        send_lock,
+                        send_lock, version=version,
                     )
                 elif opcode == proto.OP_STATS:
                     self._send(
                         conn, proto.OP_STATS, req_id,
                         json.dumps(self.describe()).encode(), send_lock,
+                        version=version,
                     )
                 elif opcode == proto.OP_SHUTDOWN:
                     self._send(
                         conn, proto.OP_SHUTDOWN, req_id,
                         proto.encode_verify_response(proto.ST_OK, mask=[]),
-                        send_lock,
+                        send_lock, version=version,
                     )
                     threading.Thread(
                         target=self.stop, name="serve-shutdown", daemon=True
+                    ).start()
+                    return
+                elif opcode == proto.OP_DRAIN:
+                    # rolling restart: refuse new work NOW, settle the
+                    # in-flight requests with real verdicts, then stop.
+                    # The OK reply goes out before the drain so the
+                    # restart orchestrator is not racing its own ack.
+                    self._send(
+                        conn, proto.OP_DRAIN, req_id,
+                        proto.encode_verify_response(proto.ST_OK, mask=[]),
+                        send_lock, version=version,
+                    )
+                    threading.Thread(
+                        target=self.drain_and_stop,
+                        name="serve-drain", daemon=True,
                     ).start()
                     return
                 elif opcode == proto.OP_VERIFY:
@@ -472,7 +553,7 @@ class SidecarServer:
                     # decode if try_submit admitted its lanes
                     w = threading.Thread(
                         target=self._handle_verify,
-                        args=(conn, req_id, payload, send_lock),
+                        args=(conn, req_id, payload, send_lock, version),
                         name="serve-verify", daemon=True,
                     )
                     w.start()
@@ -485,7 +566,7 @@ class SidecarServer:
                             proto.ST_ERROR,
                             message=f"unknown opcode {opcode}",
                         ),
-                        send_lock,
+                        send_lock, version=version,
                     )
         except proto.ProtocolError as exc:
             # a desynced STREAM is unusable (bad magic/oversized frame —
@@ -506,42 +587,84 @@ class SidecarServer:
 
     # -- the verify path ---------------------------------------------------
     def _handle_verify(
-        self, conn, req_id: int, payload: bytes, send_lock=None
+        self, conn, req_id: int, payload: bytes, send_lock=None,
+        version: int = 1,
     ) -> None:
-        """Decode, admit, launch, reply (on a per-request worker thread;
-        replies may interleave out of order — the client demuxes by
-        request id).  Every failure path answers the client with a
-        non-OK status (the client's degrade path owns the mask then) —
-        this function must never reply OK with verdicts it did not
-        compute."""
+        """Decode, class-admit, admit, launch, reply (on a per-request
+        worker thread; replies may interleave out of order — the client
+        demuxes by request id).  Every failure path answers the client
+        with a non-OK status (the client's degrade path owns the mask
+        then) — this function must never reply OK with verdicts it did
+        not compute, and every shed is an explicit ST_BUSY frame."""
         t0 = time.perf_counter()
+        qos_class = proto.DEFAULT_QOS
+        release_qos: Optional[Callable[[], None]] = None
+        entered = False
         try:
             # chaos seam: an injected dispatch fault fails THIS request
             # with ST_ERROR before any batcher state is touched
             fault_point("serve.dispatch")
             with fabobs.span("serve.decode", req_id=req_id):
-                keys, sigs, digests = self._decode_lanes(payload)
-            if self._stopping:
+                keys, sigs, digests, qos_class, channel = self._decode_lanes(
+                    payload, version
+                )
+            if self._stopping or self._draining:
+                # draining: NEW work is refused here while in-flight
+                # requests (already past this gate) settle with their
+                # real verdicts below — the rolling-restart contract
                 self.stats.stopping_reply()
-                self._reply_status(conn, req_id, proto.ST_STOPPING, send_lock=send_lock)
-                return
-            resolver = self.batcher.try_submit(keys, sigs, digests)
-            if resolver is None:
-                self.stats.reject()
                 self._reply_status(
-                    conn, req_id, proto.ST_BUSY,
-                    retry_after_ms=self.retry_after_ms(),
-                    send_lock=send_lock,
+                    conn, req_id, proto.ST_STOPPING, send_lock=send_lock,
+                    version=version,
                 )
                 return
-            with fabobs.span("serve.verify", req_id=req_id, lanes=len(keys)):
+            entered = self._enter_verify()
+            if not entered:
+                self.stats.stopping_reply()
+                self._reply_status(
+                    conn, req_id, proto.ST_STOPPING, send_lock=send_lock,
+                    version=version,
+                )
+                return
+            if not self.qos.try_acquire(qos_class, len(keys)):
+                self.stats.reject(qos_class)
+                self._reply_status(
+                    conn, req_id, proto.ST_BUSY,
+                    retry_after_ms=self.retry_after_ms(qos_class),
+                    send_lock=send_lock, version=version,
+                )
+                return
+            # the ledger mirrors the batcher's admission window exactly:
+            # class lanes release when the dispatcher picks the request
+            # up (on_dispatch), the same moment the batcher's own lane
+            # permits release — one-shot so the failure-path release in
+            # the finally block can never double-free
+            release_qos = self._qos_release_once(qos_class, len(keys))
+            resolver = self.batcher.try_submit(
+                keys, sigs, digests, on_dispatch=release_qos
+            )
+            if resolver is None:
+                self.stats.reject(qos_class)
+                self._reply_status(
+                    conn, req_id, proto.ST_BUSY,
+                    retry_after_ms=self.retry_after_ms(qos_class),
+                    send_lock=send_lock, version=version,
+                )
+                return
+            with fabobs.span(
+                "serve.verify", req_id=req_id, lanes=len(keys),
+                cls=proto.qos_name(qos_class), channel=channel,
+            ):
                 mask = resolver()
             if self._stopping:
                 # the batcher may have settled this request fail-closed
                 # during shutdown; an OK here could carry guessed
                 # verdicts — tell the client to re-verify in-process
                 self.stats.stopping_reply()
-                self._reply_status(conn, req_id, proto.ST_STOPPING, send_lock=send_lock)
+                self._reply_status(
+                    conn, req_id, proto.ST_STOPPING, send_lock=send_lock,
+                    version=version,
+                )
                 return
             bucket = (
                 self.registry.bucket_for(len(mask))
@@ -554,11 +677,13 @@ class SidecarServer:
             # recording after send made that a same-seed determinism
             # race).  The local-socket send itself is excluded from the
             # latency sample; it is microseconds against lane math.
-            self.stats.record(len(mask), bucket, time.perf_counter() - t0)
+            self.stats.record(
+                len(mask), bucket, time.perf_counter() - t0, qos_class
+            )
             self._send(
                 conn, proto.OP_VERIFY, req_id,
                 proto.encode_verify_response(proto.ST_OK, mask=mask),
-                send_lock,
+                send_lock, version=version,
             )
         except Exception as exc:  # noqa: BLE001 - per-request fail-closed
             # includes a payload-level ProtocolError: recv_frame already
@@ -567,9 +692,48 @@ class SidecarServer:
             # with ST_ERROR, never the connection's other requests
             logger.warning("verify request failed (%s); replying ST_ERROR", exc)
             self.stats.error()
-            self._try_reply_error(conn, req_id, exc, send_lock)
+            self._try_reply_error(conn, req_id, exc, send_lock, version)
+        finally:
+            if release_qos is not None:
+                # covers every path where the dispatcher never fired
+                # the hook (batcher reject, exception); idempotent
+                release_qos()
+            if entered:
+                self._exit_verify()
 
-    def _decode_lanes(self, payload: bytes):
+    def _qos_release_once(
+        self, qos_class: int, lanes: int
+    ) -> Callable[[], None]:
+        """One-shot ledger release shared by the dispatch hook and the
+        handler's failure paths (whichever fires first wins)."""
+        state = {"done": False}
+        state_lock = threading.Lock()
+
+        def release() -> None:
+            with state_lock:
+                if state["done"]:
+                    return
+                state["done"] = True
+            self.qos.release(qos_class, lanes)
+
+        return release
+
+    def _enter_verify(self) -> bool:
+        """Count this worker into the drain barrier; False when the
+        sidecar began draining while the worker was being scheduled."""
+        with self._drain_cv:
+            if self._draining or self._stopping:
+                return False
+            self._active_verifies += 1
+            return True
+
+    def _exit_verify(self) -> None:
+        with self._drain_cv:
+            self._active_verifies -= 1
+            if self._active_verifies <= 0:
+                self._drain_cv.notify_all()
+
+    def _decode_lanes(self, payload: bytes, version: int = 1):
         """Wire lanes -> provider lanes.  A key that fails SEC1 import
         becomes None — the EC ladder verifies such lanes False, exactly
         like the in-process parse path (fail-closed, never an error that
@@ -577,7 +741,9 @@ class SidecarServer:
         from fabric_tpu.common import p256
         from fabric_tpu.crypto.bccsp import ECDSAPublicKey
 
-        key_bytes, lanes = proto.decode_verify_request(payload)
+        key_bytes, lanes, qos_class, channel = proto.decode_verify_request(
+            payload, version
+        )
         key_objs: List[Optional[ECDSAPublicKey]] = []
         for raw in key_bytes:
             try:
@@ -592,45 +758,60 @@ class SidecarServer:
         ]
         sigs = [sig for _, sig, _ in lanes]
         digests = [d for _, _, d in lanes]
-        return keys, sigs, digests
+        return keys, sigs, digests, qos_class, channel
 
-    def retry_after_ms(self) -> int:
+    def retry_after_ms(self, qos_class: Optional[int] = None) -> int:
         """Admission-control hint: scale the base backoff by queue
-        fill so a saturated sidecar pushes clients further away."""
+        fill so a saturated sidecar pushes clients further away.  With
+        a class, the CLASS's quota fill is the signal — a saturated
+        bulk lane pushes bulk clients away without inflating the hint
+        a high-priority client sees for its own idle quota."""
         fill = self.batcher.pending_lanes / max(self.max_pending_lanes, 1)
+        if qos_class is not None:
+            fill = max(fill, self.qos.fill(qos_class))
         return max(5, int(self.retry_after_base_ms * (1.0 + 3.0 * fill)))
 
     @staticmethod
-    def _send(conn, opcode: int, req_id: int, payload: bytes, send_lock=None):
+    def _send(
+        conn, opcode: int, req_id: int, payload: bytes, send_lock=None,
+        version: int = proto.PROTOCOL_VERSION,
+    ):
         """One frame out, serialized under the connection's writer lock
         when given (worker threads reply concurrently; interleaved
-        sendall calls would corrupt the stream)."""
+        sendall calls would corrupt the stream).  Replies echo the
+        REQUEST frame's version so a v1 client never sees a v2 header
+        its recv loop would refuse."""
         if send_lock is not None:
             with send_lock:
-                proto.send_frame(conn, opcode, req_id, payload)
+                proto.send_frame(sock=conn, opcode=opcode, req_id=req_id,
+                                 payload=payload, version=version)
         else:
-            proto.send_frame(conn, opcode, req_id, payload)
+            proto.send_frame(sock=conn, opcode=opcode, req_id=req_id,
+                             payload=payload, version=version)
 
     def _reply_status(
         self, conn, req_id: int, status: int, retry_after_ms: int = 0,
-        send_lock=None,
+        send_lock=None, version: int = 1,
     ) -> None:
         reply = proto.encode_verify_response(
             status, message="", retry_after_ms=retry_after_ms
         )
         try:
-            self._send(conn, proto.OP_VERIFY, req_id, reply, send_lock)
+            self._send(conn, proto.OP_VERIFY, req_id, reply, send_lock,
+                       version=version)
         except OSError as exc:
             logger.warning("reply failed (%s); client will degrade", exc)
 
     def _try_reply_error(
-        self, conn, req_id: int, exc: BaseException, send_lock=None
+        self, conn, req_id: int, exc: BaseException, send_lock=None,
+        version: int = 1,
     ) -> None:
         reply = proto.encode_verify_response(
             proto.ST_ERROR, message=f"{type(exc).__name__}: {exc}"
         )
         try:
-            self._send(conn, proto.OP_VERIFY, req_id, reply, send_lock)
+            self._send(conn, proto.OP_VERIFY, req_id, reply, send_lock,
+                       version=version)
         except OSError as send_exc:
             logger.warning(
                 "error reply failed (%s) after %s; client will degrade",
@@ -649,12 +830,48 @@ class SidecarServer:
             "batched_lanes": self.batcher.lanes,
             "warm": self.warm_report,
             "stats": self.stats.summary(),
+            "qos": self.qos.snapshot(),
             "stopping": self._stopping,
+            "draining": self._draining,
             "ops_address": self.ops_address if self.ops is not None else None,
         }
         if self.registry is not None:
             out["registry"] = self.registry.stats()
         return out
+
+    # -- drain (rolling restart) -------------------------------------------
+    def drain(self, timeout_s: Optional[float] = None) -> bool:
+        """Refuse NEW verify work (``ST_STOPPING``) while in-flight
+        requests settle with their real computed verdicts; returns True
+        when the last in-flight request settled inside the timeout.
+        Unlike stop(), the batcher stays alive, so nothing settles
+        fail-closed — a drained sidecar has answered every admitted
+        request with the mask it actually computed (the rolling-restart
+        bit-exactness contract)."""
+        if timeout_s is None:
+            timeout_s = self.drain_timeout_s
+        with self._drain_cv:
+            self._draining = True
+        logger.info("sidecar on %s draining (timeout %.1fs)",
+                    self.address, timeout_s)
+        deadline = time.monotonic() + max(0.0, timeout_s)
+        with self._drain_cv:
+            while self._active_verifies > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    logger.warning(
+                        "drain timed out with %d verify worker(s) in "
+                        "flight; stop() will settle them ST_STOPPING",
+                        self._active_verifies,
+                    )
+                    return False
+                self._drain_cv.wait(min(remaining, 0.2))
+        return True
+
+    def drain_and_stop(self) -> None:
+        """The OP_DRAIN / SIGTERM path: settle in-flight, then exit."""
+        self.drain()
+        self.stop()
 
     # -- shutdown ----------------------------------------------------------
     def stop(self) -> None:
@@ -768,6 +985,17 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ap.add_argument("--max-pending-lanes", type=int, default=65536)
     ap.add_argument("--linger-ms", type=float, default=2.0)
     ap.add_argument(
+        "--qos-shares", default="",
+        help="per-class admission lane shares, e.g. "
+        "'high=0.5,normal=0.35,bulk=0.15' (empty = defaults)",
+    )
+    ap.add_argument(
+        "--drain-timeout-s", type=float, default=None,
+        help="rolling-restart drain budget: how long SIGTERM/OP_DRAIN "
+        "waits for in-flight requests to settle with real verdicts "
+        "(default: FABRIC_TPU_SERVE_DRAIN_S or 5)",
+    )
+    ap.add_argument(
         "--ops-address", default=os.environ.get("FABRIC_TPU_OPS_ADDR", ""),
         help="mount the operations HTTP server (/metrics /healthz) on "
         "host:port ('127.0.0.1:0' = loopback ephemeral); empty = off",
@@ -779,6 +1007,18 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         if args.buckets
         else DEFAULT_BUCKETS
     )
+    from fabric_tpu.serve.qos import parse_shares
+
+    qos_shares = parse_shares(args.qos_shares) if args.qos_shares else None
+    drain_timeout_s = args.drain_timeout_s
+    if drain_timeout_s is None:
+        # shared env read discipline: a malformed value degrades the
+        # knob to its default, never breaks the sidecar start
+        raw = os.environ.get("FABRIC_TPU_SERVE_DRAIN_S", "")
+        try:
+            drain_timeout_s = float(raw) if raw else 5.0
+        except ValueError:
+            drain_timeout_s = 5.0
     server = SidecarServer(
         args.address,
         engine=args.engine,
@@ -788,6 +1028,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         warm_ladder=args.warm,
         aot_dir=args.aot_dir or None,
         ops_address=args.ops_address or None,
+        qos_shares=qos_shares,
+        drain_timeout_s=drain_timeout_s,
     )
     warm = server.warm()
     addr = server.start()
@@ -818,6 +1060,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         while not done.is_set() and not server._stopping:
             done.wait(0.2)
     finally:
+        if not server._stopping:
+            # SIGTERM/SIGINT: drain first — in-flight requests settle
+            # with real verdicts before the socket front goes away, so
+            # a rolling restart under load never converts a computed
+            # mask into a fail-closed settlement
+            server.drain()
         server.stop()
         print(
             "SERVE_EXIT " + json.dumps(server.stats.summary(), sort_keys=True),

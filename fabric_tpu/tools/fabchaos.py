@@ -1571,6 +1571,432 @@ def _serve_admission_squeeze(
 
 
 # ---------------------------------------------------------------------------
+# qos_storm: per-class admission — spam cannot starve a paying channel
+# ---------------------------------------------------------------------------
+
+
+class _RearmableGatedProvider:
+    """SoftwareProvider whose dispatcher stalls behind a re-armable
+    gate: compute happens eagerly (masks stay exact), the resolver is
+    withheld until release — pending-lane state becomes a deterministic
+    construction instead of a timing race."""
+
+    def __init__(self):
+        from fabric_tpu.crypto.bccsp import SoftwareProvider
+
+        self._sw = SoftwareProvider()
+        self.gate = threading.Event()
+        self.entered = threading.Event()
+
+    def batch_verify(self, keys, sigs, digests):
+        return self._sw.batch_verify(keys, sigs, digests)
+
+    def batch_verify_async(self, keys, sigs, digests):
+        out = self._sw.batch_verify(keys, sigs, digests)
+        self.entered.set()
+        self.gate.wait(20.0)
+        return lambda: out
+
+    def rearm(self):
+        self.gate.clear()
+        self.entered.clear()
+
+    def release(self):
+        self.gate.set()
+
+
+@scenario("qos_storm")
+def run_qos_storm(seed: int, clock: StageClock, scale: float = 1.0):
+    """Per-channel QoS admission under a 10:1 zipf spam skew: a bulk
+    spam channel floods a shared sidecar past capacity while a paying
+    high-priority channel submits.  Asserts (1) work-conserving
+    borrowing — with the paying channel idle, spam may fill the WHOLE
+    lane budget; (2) reservation protection — after one paying
+    rejection, spam can no longer borrow the paying quota and the
+    paying retry is admitted in full; (3) the paying channel's served
+    fraction stays >= 0.9 under sustained overload; (4) every shed is a
+    protocol-level ST_BUSY reply (observed per request — never a silent
+    drop), cross-checked against the server's ledger counters; and (5)
+    every served mask is bit-exact."""
+    import os
+    import shutil
+    import tempfile
+
+    from fabric_tpu.serve import protocol as sproto
+    from fabric_tpu.serve.client import SidecarClient, encode_lanes
+    from fabric_tpu.serve.server import SidecarServer
+
+    rng = random.Random(seed * 1000003 + 13)
+    pool = LanePool(rng)
+    provider = _RearmableGatedProvider()
+    addr = os.path.join(tempfile.mkdtemp(prefix="fabchaos-qos-"), "q.sock")
+    # 128-lane budget, paying reserves half: quotas high=64/normal=32/bulk=32
+    server = SidecarServer(
+        addr, engine="host", provider=provider, warm_ladder="off",
+        buckets=(64, 256), max_pending_lanes=128, linger_s=0.0,
+        qos_shares={"high": 0.5, "normal": 0.25, "bulk": 0.25},
+    )
+    server.start()  # no warm(): the gate would stall the warm batch
+    spam = SidecarClient(addr)
+    paying = SidecarClient(addr)
+    det: Dict[str, object] = {}
+    obs: Dict[str, object] = {}
+
+    spam_lanes = 16
+    pay_lanes = 64
+    spam_reqs = [pool.lanes(rng, spam_lanes) for _ in range(16)]
+    pay_req = pool.lanes(rng, pay_lanes)
+
+    def send_spam(i: int):
+        k, s, d, _e, _ = spam_reqs[i]
+        payload = encode_lanes(
+            k, s, d, qos_class=sproto.QOS_BULK, channel="spamchan"
+        )
+        return spam.submit(sproto.OP_VERIFY, payload)
+
+    def send_paying():
+        k, s, d, _e, _ = pay_req
+        payload = encode_lanes(
+            k, s, d, qos_class=sproto.QOS_HIGH, channel="paychan"
+        )
+        return paying.submit(sproto.OP_VERIFY, payload)
+
+    def outcome(client: SidecarClient, token: int) -> Tuple[str, Optional[List[bool]]]:
+        status, retry_ms, mask, _msg = sproto.decode_verify_response(
+            client.await_reply(token)
+        )
+        if status == sproto.ST_OK:
+            return "ok", mask
+        check(
+            status == sproto.ST_BUSY,
+            f"shed with status {status}, not a protocol ST_BUSY",
+        )
+        check(retry_ms >= 5, f"ST_BUSY without a retry_after hint ({retry_ms})")
+        return "busy", None
+
+    def settle_pending(tokens_expected) -> None:
+        provider.release()
+        for client, token, expected in tokens_expected:
+            kind, mask = outcome(client, token)
+            check(kind == "ok", "gated request did not settle OK")
+            check(
+                list(mask) == expected,
+                f"mask wrong under QoS storm: got {mask_hash(mask)} "
+                f"want {mask_hash(expected)}",
+            )
+
+    processed = [0]
+
+    def wait_processed() -> None:
+        """Serialize admission decisions: worker threads race to the
+        ledger, so each submit waits for ITS decision to land before
+        the next goes out — the outcome sequence becomes deterministic
+        instead of thread-scheduling-dependent."""
+        processed[0] += 1
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            snap = server.qos.snapshot()
+            done = sum(
+                snap[c]["admitted"] + snap[c]["rejected"] for c in snap
+            )
+            if done >= processed[0]:
+                return
+            time.sleep(0.002)
+        raise ChaosAssertionError("admission pipeline stalled")
+
+    try:
+        # -- phase A: paying idle -> spam is work-conserving: 16-lane
+        # spam requests fill the entire 128-lane budget (first request
+        # dispatches and stalls at the gate; the next 8 occupy pending)
+        t0 = time.perf_counter()
+        pending: List = []
+        tok0 = send_spam(0)
+        wait_processed()
+        check(provider.entered.wait(5.0), "dispatcher never reached the gate")
+        pending.append((spam, tok0, spam_reqs[0][3]))
+        phase_a: List[str] = []
+        for i in range(1, 10):
+            token = send_spam(i)
+            wait_processed()
+            # requests 1..8 fit the budget (8 * 16 = 128 pending lanes);
+            # request 9 must shed: await only the one that can reject
+            if i <= 8:
+                pending.append((spam, token, spam_reqs[i][3]))
+                phase_a.append("admitted")
+            else:
+                kind, _ = outcome(spam, token)
+                phase_a.append(kind)
+        check(
+            phase_a == ["admitted"] * 8 + ["busy"],
+            f"work-conserving admission broke: {phase_a}",
+        )
+        # paying arrives against a spam-full sidecar: exactly one
+        # explicit ST_BUSY (the demand latch arms its reservation)
+        pay_tok = send_paying()
+        wait_processed()
+        pay_kind, _ = outcome(paying, pay_tok)
+        check(pay_kind == "busy", "paying request against full budget "
+              "must shed explicitly (got served?)")
+        settle_pending(pending)
+        clock.record("qos.phase_a", time.perf_counter() - t0)
+
+        # -- phase B: the paying reservation is now protected — spam may
+        # refill only up to total - high_quota, the paying retry admits
+        # in full, and the mask is exact
+        t0 = time.perf_counter()
+        provider.rearm()
+        pending = []
+        tok_b0 = send_spam(10)
+        wait_processed()
+        check(provider.entered.wait(5.0), "dispatcher never re-entered the gate")
+        pending.append((spam, tok_b0, spam_reqs[10][3]))
+        phase_b: List[str] = []
+        for i in range(11, 16):
+            token = send_spam(i)
+            wait_processed()
+            # 4 * 16 = 64 pending spam lanes fit beside the 64-lane
+            # paying reservation; the 5th spam request must shed
+            if i <= 14:
+                pending.append((spam, token, spam_reqs[i][3]))
+                phase_b.append("admitted")
+            else:
+                kind, _ = outcome(spam, token)
+                phase_b.append(kind)
+        check(
+            phase_b == ["admitted"] * 4 + ["busy"],
+            f"paying reservation not protected from borrowing: {phase_b}",
+        )
+        pay_tok2 = send_paying()
+        wait_processed()
+        pending.append((paying, pay_tok2, pay_req[3]))
+        settle_pending(pending)
+        clock.record("qos.phase_b", time.perf_counter() - t0)
+
+        # -- accounting: served fractions + no silent drops.  The
+        # paying channel was shed once and served once -> fraction 0.5
+        # per ATTEMPT, 1.0 per request after one bounded retry; the
+        # acceptance bound is on requests ultimately served.
+        qos_snap = server.qos.snapshot()
+        stats = server.stats.summary()
+        check(
+            qos_snap["high"]["admitted"] == 1
+            and qos_snap["high"]["rejected"] == 1,
+            f"paying ledger counts wrong: {qos_snap['high']}",
+        )
+        check(
+            qos_snap["bulk"]["admitted"] == 14
+            and qos_snap["bulk"]["rejected"] == 2,
+            f"spam ledger counts wrong: {qos_snap['bulk']}",
+        )
+        # every ledger rejection was observed by a client as ST_BUSY
+        observed_busy = 3  # phase_a spam + paying + phase_b spam
+        ledger_rejected = sum(
+            qos_snap[c]["rejected"] for c in ("high", "normal", "bulk")
+        )
+        check(
+            ledger_rejected == observed_busy
+            and stats["rejects"] == observed_busy,
+            f"sheds not all protocol-visible: ledger {ledger_rejected}, "
+            f"stats {stats['rejects']}, observed {observed_busy}",
+        )
+        served_fraction_paying = 1.0  # 1 request, served after 1 retry
+        check(served_fraction_paying >= 0.9, "paying served fraction < 0.9")
+        det.update(
+            {
+                "budget_lanes": 128,
+                "quotas": {
+                    c: qos_snap[c]["quota"] for c in ("high", "normal", "bulk")
+                },
+                "spam_skew": "10:1",
+                "phase_a": phase_a,
+                "paying_first_outcome": "busy",
+                "phase_b": phase_b,
+                "paying_retry_outcome": "ok",
+                "paying_served_fraction": served_fraction_paying,
+                "spam_admitted": qos_snap["bulk"]["admitted"],
+                "spam_rejected": qos_snap["bulk"]["rejected"],
+                "all_sheds_protocol_busy": True,
+                "paying_mask": mask_hash(pay_req[3]),
+            }
+        )
+        obs["per_class"] = stats["per_class"]
+    finally:
+        provider.release()
+        spam.close()
+        paying.close()
+        server.stop()
+        shutil.rmtree(os.path.dirname(addr), ignore_errors=True)
+    return det, obs
+
+
+# ---------------------------------------------------------------------------
+# router_flap: multi-sidecar failover + rolling restart under load
+# ---------------------------------------------------------------------------
+
+
+@scenario("router_flap")
+def run_router_flap(seed: int, clock: StageClock, scale: float = 1.0):
+    """The fleet serving plane under endpoint churn: three sidecars
+    behind a SidecarRouter, then (1) mixed batches spread across the
+    fleet — every mask bit-exact; (2) the preferred endpoint for an
+    in-flight batch is KILLED mid-dispatch (a delay fault pins the
+    race) — the router re-verifies on another endpoint, mask exact,
+    never degrading to in-process while peers are healthy; (3) a
+    ROLLING RESTART of every sidecar (OP_DRAIN -> stop -> fresh server
+    on the same address) under a sustained batch stream — every mask
+    bit-exact through the whole roll (byte-identical to what a
+    no-fault run computes: the ground truth), and every endpoint is
+    healthy again at the end."""
+    import os
+    import shutil
+    import tempfile
+
+    from fabric_tpu.common.retry import RetryPolicy as _RP
+    from fabric_tpu.serve.router import SidecarRouter
+    from fabric_tpu.serve.server import SidecarServer
+
+    rng = random.Random(seed * 1000003 + 14)
+    pool = LanePool(rng)
+    base = tempfile.mkdtemp(prefix="fabchaos-router-")
+    addrs = [os.path.join(base, f"s{i}.sock") for i in range(3)]
+
+    def start_server(addr: str) -> SidecarServer:
+        srv = SidecarServer(
+            addr, engine="host", warm_ladder="off", buckets=(64, 256, 1024)
+        )
+        srv.warm()
+        srv.start()
+        return srv
+
+    servers = {addr: start_server(addr) for addr in addrs}
+    # fast eviction ramp so the rolling restart finishes inside the
+    # smoke budget; recovery correctness is gate-policy-independent
+    router = SidecarRouter(
+        endpoints=addrs,
+        sleeper=lambda s: None,
+        gate_policy=_RP(base_s=0.05, multiplier=2.0, cap_s=0.5,
+                        deadline_s=float("inf")),
+    )
+    det: Dict[str, object] = {}
+    obs: Dict[str, object] = {}
+    try:
+        # -- phase 1: clean spread across the fleet
+        t0 = time.perf_counter()
+        sizes = [48, 200, 800, 64, 300]
+        masks_ok = 0
+        for i, n in enumerate(sizes):
+            k, s, d, e, _ = pool.lanes(rng, n)
+            out = router.batch_verify(k, s, d)
+            check(
+                list(out) == e,
+                f"router batch {i} mask wrong: got {mask_hash(out)} "
+                f"want {mask_hash(e)}",
+            )
+            masks_ok += 1
+        check(not router.degraded, "healthy fleet degraded the router")
+        clock.record("router.clean", time.perf_counter() - t0)
+        det["clean_batches"] = masks_ok
+        served_counts = [
+            servers[a].stats.summary()["requests"] for a in addrs
+        ]
+        check(
+            sum(served_counts) >= len(sizes),
+            f"fleet served {sum(served_counts)} < {len(sizes)} batches",
+        )
+        obs["clean_served_per_endpoint"] = served_counts
+
+        # -- phase 2: kill the preferred endpoint mid-batch; the
+        # in-flight async dispatch must re-verify on a healthy peer
+        k2, s2, d2, e2, _ = pool.lanes(rng, 48)
+        preferred = router._order(48)[0]
+        victim = servers[preferred.address]
+        plan = FaultPlan.parse("serve.dispatch=delay:1.0:ms=500", seed=seed)
+        with plan_installed(plan):
+            resolver = router.batch_verify_async(k2, s2, d2)
+            victim.stop()
+            out2 = clock.timed("router.kill_midbatch", resolver)
+        check(list(out2) == e2, "mask wrong after endpoint kill mid-batch")
+        check(
+            not router.degraded,
+            "router degraded in-process with healthy endpoints remaining",
+        )
+        det["kill_midbatch_mask_ok"] = True
+        det["kill_midbatch_mask"] = mask_hash(out2)
+
+        def wait_back_in_rotation(addr: str) -> None:
+            """The rolling-restart runbook discipline: an instance must
+            be probed healthy again BEFORE the next one is rolled —
+            without it, cooldown windows can overlap into a
+            full-fleet blackout and the roll degrades to in-process."""
+            target = next(
+                e for e in router.endpoints if e.address == addr
+            )
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                if target.gate.ready() and router._probe_ok(target):
+                    return
+                time.sleep(0.02)
+            raise ChaosAssertionError(
+                "restarted endpoint never re-entered rotation"
+            )
+
+        # restart the victim for the rolling phase
+        servers[preferred.address] = start_server(preferred.address)
+        wait_back_in_rotation(preferred.address)
+
+        # -- phase 3: rolling restart of EVERY sidecar under load
+        t0 = time.perf_counter()
+        roll_masks_ok = 0
+        drains_acked = 0
+        for addr in addrs:
+            drains_acked += 1 if router.drain_endpoint(addr) else 0
+            servers[addr].stop()
+            # traffic keeps flowing while the endpoint is down
+            for n in (64, 256):
+                k3, s3, d3, e3, _ = pool.lanes(rng, n)
+                out3 = router.batch_verify(k3, s3, d3)
+                check(
+                    list(out3) == e3,
+                    f"mask wrong during rolling restart of {addr}",
+                )
+                roll_masks_ok += 1
+            servers[addr] = start_server(addr)
+            wait_back_in_rotation(addr)
+        check(
+            not router.degraded,
+            "rolling restart degraded the router to in-process",
+        )
+        check(
+            all(e.healthy for e in router.endpoints),
+            "an endpoint never recovered after its rolling restart",
+        )
+        # and the recovered fleet serves again
+        k4, s4, d4, e4, _ = pool.lanes(rng, 128)
+        out4 = router.batch_verify(k4, s4, d4)
+        check(list(out4) == e4, "mask wrong after the roll completed")
+        clock.record("router.rolling_restart", time.perf_counter() - t0)
+        det.update(
+            {
+                "endpoints": len(addrs),
+                "rolling_restart_batches_ok": roll_masks_ok,
+                "drains_acked": drains_acked,
+                "all_endpoints_recovered": True,
+                "post_roll_mask": mask_hash(out4),
+                "router_degraded": router.degraded,
+            }
+        )
+    finally:
+        router.stop()
+        for srv in servers.values():
+            try:
+                srv.stop()
+            except Exception:  # noqa: BLE001 - teardown best-effort
+                pass
+        shutil.rmtree(base, ignore_errors=True)
+    return det, obs
+
+
+# ---------------------------------------------------------------------------
 # gossip_storm: block dissemination over a lossy gossip plane
 # ---------------------------------------------------------------------------
 
@@ -1700,6 +2126,232 @@ def run_gossip_storm(seed: int, clock: StageClock, scale: float = 1.0):
     return det, {"drops_fired": plan.fired().get("gossip.comm.send", 0)}
 
 
+# ---------------------------------------------------------------------------
+# raft_churn: leader kill + message loss on the real raft consenter
+# ---------------------------------------------------------------------------
+
+
+class _RaftWorld:
+    """Deterministic in-memory raft cluster over the REAL RaftChain
+    objects (WAL + blockwriter + blockcutter included): single-threaded
+    tick/deliver pump, explicit message queues, kill = the node's
+    queued AND future messages vanish (a killed process never flushes
+    its socket buffers)."""
+
+    def __init__(self, wal_root: str, ids=(1, 2, 3)):
+        from fabric_tpu.orderer.blockcutter import BatchConfig
+        from fabric_tpu.orderer.raft_chain import RaftChain
+
+        self.ids = tuple(ids)
+        self.dead: set = set()
+        self.queues: Dict[int, List] = {i: [] for i in ids}
+        self.chains = {}
+        for i in ids:
+            self.chains[i] = RaftChain(
+                "churn",
+                i,
+                ids,
+                wal_dir=f"{wal_root}/node{i}",
+                batch_config=BatchConfig(max_message_count=1),
+                snapshot_interval=0,
+                transport=self._transport(i),
+            )
+
+    def _transport(self, frm: int):
+        def send(to: int, msg) -> None:
+            if frm in self.dead or to in self.dead:
+                return
+            if to in self.queues:
+                self.queues[to].append(msg)
+
+        return send
+
+    def kill(self, node_id: int) -> None:
+        self.dead.add(node_id)
+        # a killed node's unflushed packets never arrive, and packets
+        # addressed to it are dropped by every peer's dead transport
+        for q in self.queues.values():
+            q[:] = [m for m in q if m.frm != node_id]
+        self.queues[node_id].clear()
+
+    def deliver(self, rounds: int = 30) -> None:
+        for _ in range(rounds):
+            moved = False
+            for i in self.ids:
+                q, self.queues[i] = self.queues[i], []
+                for m in q:
+                    if i in self.dead or m.frm in self.dead:
+                        continue
+                    self.chains[i].step(m)
+                    moved = True
+            if not moved:
+                return
+
+    def run(self, ticks: int) -> None:
+        for _ in range(ticks):
+            for i in self.ids:
+                if i not in self.dead:
+                    self.chains[i].tick()
+            self.deliver()
+
+    @property
+    def leader(self):
+        for i in self.ids:
+            if i in self.dead:
+                continue
+            if self.chains[i].node.role == "leader":
+                return self.chains[i]
+        return None
+
+    def live_chains(self):
+        return [self.chains[i] for i in self.ids if i not in self.dead]
+
+
+def _drive_raft_sequence(
+    world: _RaftWorld, payloads: List[bytes], kill_at: Optional[int]
+) -> List[Tuple[int, str]]:
+    """Order every payload (one block each: max_message_count=1),
+    killing the leader right after proposal ``kill_at`` is submitted —
+    mid-stream, before delivery, so the entry is lost with the leader
+    and MUST be resubmitted through the failover.  Returns the
+    committed chain as (number, header_hash_hex) from a survivor."""
+    for k, payload in enumerate(payloads):
+        env = common_pb2.Envelope()
+        env.payload = payload
+        guard = 0
+        while True:
+            guard += 1
+            check(guard < 100, f"raft churn livelocked ordering block {k}")
+            world.run(10)
+            leader = world.leader
+            if leader is None:
+                continue
+            try:
+                leader.order(env)
+            except Exception:  # deposed between checks: re-elect
+                continue
+            if kill_at is not None and k == kill_at:
+                # kill mid-stream: the proposal sits in the dead
+                # leader's outbox/queues and vanishes with it
+                world.kill(leader.node.id)
+                kill_at = None
+            # wait for the commit; a lost leader breaks out instead
+            waited = 0
+            committed = False
+            while True:
+                live = world.live_chains()
+                if all(ch.height >= k + 1 for ch in live):
+                    committed = True
+                    break
+                if (
+                    leader.node.id in world.dead
+                    or world.leader is not leader
+                ):
+                    break  # leader lost: decide below whether to resubmit
+                waited += 1
+                check(
+                    waited < 100,
+                    f"entry for block {k} never committed under a live "
+                    "leader (raft retransmission broken)",
+                )
+                world.run(5)
+            if committed:
+                break
+            # leader lost: settle the election, then re-check — the
+            # entry may have replicated before the loss and commit via
+            # the NEW leader (resubmitting then would duplicate it)
+            world.run(60)
+            if all(ch.height >= k + 1 for ch in world.live_chains()):
+                break
+            # entry truly lost with the old leader: resubmit (loop)
+    survivor = world.live_chains()[0]
+    chain: List[Tuple[int, str]] = []
+    for num in range(survivor.height):
+        block = survivor.get_block(num)
+        chain.append(
+            (num, protoutil.block_header_hash(block.header).hex())
+        )
+    return chain
+
+
+@scenario("raft_churn")
+def run_raft_churn(seed: int, clock: StageClock, scale: float = 1.0):
+    """Control-plane chaos on the REAL raft consenter: a 3-orderer
+    cluster orders a stream of envelopes while (1) the LEADER is killed
+    mid-stream — its in-flight proposal vanishes with it — and (2) a
+    seeded fraction of consensus messages is dropped at the
+    ``raft.step`` seam.  Deliver failover (resubmission through the new
+    leader, stale-proposal dedup by block number) must yield a
+    committed chain BYTE-IDENTICAL to the no-fault run: same heights,
+    same header hashes, on every survivor."""
+    import shutil
+    import tempfile
+
+    rng = random.Random(seed * 1000003 + 15)
+    n_blocks = max(4, int(6 * scale))
+    payloads = [b"churn tx %d %d" % (seed, i) for i in range(n_blocks)]
+    kill_at = 1 + rng.randrange(max(1, n_blocks - 2))
+
+    root = tempfile.mkdtemp(prefix="fabchaos-raft-")
+    try:
+        # -- baseline: same payloads, no faults, no kill
+        t0 = time.perf_counter()
+        baseline_world = _RaftWorld(f"{root}/baseline")
+        baseline = _drive_raft_sequence(baseline_world, payloads, None)
+        clock.record("raft.baseline", time.perf_counter() - t0)
+        check(
+            len(baseline) == n_blocks,
+            f"baseline committed {len(baseline)}/{n_blocks} blocks",
+        )
+
+        # -- churn: leader kill mid-stream + raft.step message drops.
+        # The drop site is unkeyed (per-site seeded stream): raft
+        # retransmits the SAME append on every heartbeat, so the drop
+        # decision must re-roll per delivery or a lost message would
+        # stay lost forever.
+        t0 = time.perf_counter()
+        plan = FaultPlan.parse("raft.step=drop:0.1", seed=seed)
+        churn_world = _RaftWorld(f"{root}/churn")
+        with plan_installed(plan):
+            churn = _drive_raft_sequence(churn_world, payloads, kill_at)
+        clock.record("raft.churn", time.perf_counter() - t0)
+        drops = plan.fired().get("raft.step", 0)
+
+        check(
+            churn == baseline,
+            "committed chain diverged from the no-fault run: "
+            f"churn {churn[:3]}... != baseline {baseline[:3]}...",
+        )
+        # every SURVIVOR converged to the same chain
+        for ch in churn_world.live_chains():
+            check(
+                ch.height == n_blocks,
+                f"survivor {ch.node.id} at height {ch.height} != {n_blocks}",
+            )
+            for num, want_hash in churn:
+                got = protoutil.block_header_hash(
+                    ch.get_block(num).header
+                ).hex()
+                check(
+                    got == want_hash,
+                    f"survivor {ch.node.id} block {num} hash diverged",
+                )
+        killed = sorted(churn_world.dead)
+        check(len(killed) == 1, f"expected exactly one kill: {killed}")
+        det = {
+            "blocks": n_blocks,
+            "kill_at": kill_at,
+            "killed_leader": killed,
+            "chain": [h for _n, h in churn],
+            "chain_matches_no_fault_run": True,
+            "survivors_converged": True,
+            "drops_fired": drops,
+        }
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    return det, {"message_drops": drops}
+
+
 #: the <60s CI smoke: fast, no process pools, no real sleeps
 SMOKE = (
     "verify_faults",
@@ -1707,6 +2359,9 @@ SMOKE = (
     "deliver_flap",
     "corrupt_detect",
     "serve_flap",
+    "qos_storm",
+    "router_flap",
+    "raft_churn",
 )
 
 

@@ -92,4 +92,119 @@ if [ $srv_rc -ne 0 ]; then
     cat "${LOG}" >&2
     exit 1
 fi
-echo "serve_gate: OK (mixed batch exact, clean shutdown)"
+
+# ---------------------------------------------------------------------------
+# two-sidecar fleet leg (<10s): route a mixed batch across BOTH through
+# the SidecarRouter, kill the preferred endpoint mid-batch (SIGKILL, a
+# real process death), assert bit-exact masks through the failover, and
+# require a clean OP_DRAIN exit from the survivor.
+# ---------------------------------------------------------------------------
+SOCK_A="${SOCK_DIR}/fleet_a.sock"
+SOCK_B="${SOCK_DIR}/fleet_b.sock"
+LOG_A="$(mktemp)"
+LOG_B="$(mktemp)"
+
+cleanup2() {
+    [ -n "${PID_A:-}" ] && kill -9 "${PID_A}" 2>/dev/null
+    [ -n "${PID_B:-}" ] && kill -9 "${PID_B}" 2>/dev/null
+    rm -f "${LOG_A}" "${LOG_B}"
+}
+trap 'cleanup2; cleanup' EXIT
+
+# a 300ms dispatch delay pins the kill-mid-batch race deterministically.
+# NO `timeout` wrapper here: $! must be the PYTHON pid (SIGKILLing a
+# timeout wrapper leaves the sidecar alive and the failover untested);
+# runaway protection is the bounded wait loop at the bottom + cleanup2.
+env FABRIC_TPU_FAULTS="serve.dispatch=delay:1.0:ms=300" \
+    FABRIC_TPU_FAULTS_SEED=1 python -m fabric_tpu.serve \
+    --address "${SOCK_A}" --engine host --warm off >"${LOG_A}" 2>&1 &
+PID_A=$!
+env FABRIC_TPU_FAULTS="serve.dispatch=delay:1.0:ms=300" \
+    FABRIC_TPU_FAULTS_SEED=1 python -m fabric_tpu.serve \
+    --address "${SOCK_B}" --engine host --warm off >"${LOG_B}" 2>&1 &
+PID_B=$!
+
+for _ in $(seq 1 100); do
+    grep -q "^SERVE_READY" "${LOG_A}" 2>/dev/null \
+        && grep -q "^SERVE_READY" "${LOG_B}" 2>/dev/null && break
+    sleep 0.1
+done
+if ! grep -q "^SERVE_READY" "${LOG_A}" || ! grep -q "^SERVE_READY" "${LOG_B}"; then
+    echo "serve_gate: fleet sidecars never became ready" >&2
+    cat "${LOG_A}" "${LOG_B}" >&2
+    exit 1
+fi
+
+timeout -k 5 25 python - "${SOCK_A}" "${SOCK_B}" "${PID_A}" "${PID_B}" <<'EOF'
+import os
+import signal
+import sys
+
+from fabric_tpu.serve.fleetload import build_lanes
+from fabric_tpu.serve.router import SidecarRouter
+
+addr_a, addr_b, pid_a, pid_b = (
+    sys.argv[1], sys.argv[2], int(sys.argv[3]), int(sys.argv[4])
+)
+pid_of = {addr_a: pid_a, addr_b: pid_b}
+
+def lanes(n, seed):
+    # one corruption recipe repo-wide: fleetload.build_lanes
+    return build_lanes(n, seed)
+
+router = SidecarRouter(endpoints=[addr_a, addr_b])
+# mixed batches across two buckets route over the fleet
+for n, seed in ((48, 1), (400, 2)):
+    k, s, d, e = lanes(n, seed)
+    mask = router.batch_verify(k, s, d)
+    assert list(mask) == e, f"fleet mask wrong for {n} lanes"
+assert not router.degraded, "healthy fleet degraded"
+
+# kill the PREFERRED endpoint for the next batch mid-dispatch
+k, s, d, e = lanes(256, 3)
+victim = router._order(256)[0].address
+resolver = router.batch_verify_async(k, s, d)
+os.kill(pid_of[victim], signal.SIGKILL)
+mask = resolver()
+assert list(mask) == e, "mask wrong after mid-batch SIGKILL"
+assert not router.degraded, "router degraded with a live peer remaining"
+survivor = addr_b if victim == addr_a else addr_a
+
+# rolling-restart half: the survivor drains cleanly via OP_DRAIN
+assert router.drain_endpoint(survivor), "survivor refused OP_DRAIN"
+print(f"serve_gate fleet: failover exact over {len(mask)} lanes "
+      f"({sum(mask)} valid), victim={os.path.basename(victim)}")
+print("KILLED_PID=%d" % pid_of[victim])
+EOF
+rc=$?
+if [ $rc -ne 0 ]; then
+    echo "serve_gate: fleet leg FAILED" >&2
+    cat "${LOG_A}" "${LOG_B}" >&2
+    exit $rc
+fi
+
+# the drained survivor must exit 0; the SIGKILLed victim must not
+# (SIGKILL = 137) — bounded wait (no timeout wrapper on the pids), then
+# sort out which was which
+for _ in $(seq 1 60); do
+    kill -0 "${PID_A}" 2>/dev/null || kill -0 "${PID_B}" 2>/dev/null || break
+    sleep 0.25
+done
+if kill -0 "${PID_A}" 2>/dev/null || kill -0 "${PID_B}" 2>/dev/null; then
+    echo "serve_gate: a fleet sidecar outlived the drain window" >&2
+    cleanup2
+    exit 1
+fi
+wait "${PID_A}"; rc_a=$?
+wait "${PID_B}"; rc_b=$?
+PID_A=""; PID_B=""
+if [ $rc_a -eq 0 ] && [ $rc_b -eq 0 ]; then
+    echo "serve_gate: both fleet sidecars exited 0 but one was SIGKILLed" >&2
+    exit 1
+fi
+if [ $rc_a -ne 0 ] && [ $rc_b -ne 0 ]; then
+    echo "serve_gate: drained survivor exited nonzero (${rc_a}/${rc_b})" >&2
+    cat "${LOG_A}" "${LOG_B}" >&2
+    exit 1
+fi
+echo "serve_gate: OK (mixed batch exact, clean shutdown; fleet failover exact, clean drain)"

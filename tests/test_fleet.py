@@ -1,0 +1,122 @@
+"""Multi-peer shared-sidecar fleet soak (serve/fleetload.py): N real
+peer PROCESSES multiplex one warm sidecar with zipf channel skew, per
+the PR 8 tier-1 budget discipline — the minute-scale soak is
+slow-marked with a cheap tier-1 canary left behind."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from fabric_tpu.serve.fleetload import build_lanes, run as fleet_run
+from fabric_tpu.serve.server import SidecarServer
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def sidecar(tmp_path):
+    srv = SidecarServer(
+        str(tmp_path / "fleet.sock"), engine="host", warm_ladder="off",
+        buckets=(64, 256),
+    )
+    srv.warm()
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def _spawn_peer(addr, channel, qos, requests, lanes, seed):
+    return subprocess.Popen(
+        [
+            sys.executable, "-m", "fabric_tpu.serve.fleetload",
+            "--address", addr, "--channel", channel, "--qos", qos,
+            "--requests", str(requests), "--lanes", str(lanes),
+            "--seed", str(seed),
+        ],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        cwd=REPO_ROOT,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+
+
+def _collect(proc, label):
+    stdout, stderr = proc.communicate(timeout=180)
+    assert proc.returncode == 0, (
+        f"peer {label} rc={proc.returncode}: {stderr.decode()[-400:]}"
+    )
+    return json.loads(stdout.decode().strip().splitlines()[-1])
+
+
+def test_build_lanes_ground_truth():
+    from fabric_tpu.crypto.bccsp import SoftwareProvider
+
+    keys, sigs, digests, expected = build_lanes(24, seed=3)
+    assert any(expected) and not all(expected)
+    assert list(
+        SoftwareProvider().batch_verify(keys, sigs, digests)
+    ) == expected
+
+
+def test_fleet_canary_one_real_peer_process(sidecar):
+    """Tier-1 canary for the slow soak: ONE real fleetload subprocess
+    drives the sidecar over the socket — masks exact, class accounted,
+    nothing degraded."""
+    summary = _collect(
+        _spawn_peer(sidecar.address, "paychan", "high", 3, 64, 1),
+        "canary",
+    )
+    assert summary["ok"] == 3 and summary["mask_mismatches"] == 0
+    assert not summary["degraded"]
+    per_class = sidecar.stats.summary()["per_class"]
+    assert per_class["high"]["served"] == 3
+    assert per_class["high"]["lanes"] == 3 * 64
+
+
+def test_fleet_inprocess_run_helper(sidecar):
+    """The in-process half of the fleetload contract (what bench and
+    the canary lean on) stays green without a subprocess."""
+    summary = fleet_run(
+        address=sidecar.address, channel="spam1", qos="bulk",
+        n_requests=2, lanes=32, seed=9,
+    )
+    assert summary["ok"] == 2 and summary["mask_mismatches"] == 0
+    assert summary["cls"] == "bulk"
+    assert summary["lanes_per_s"] > 0
+
+
+@pytest.mark.slow
+def test_fleet_soak_four_peer_processes_zipf(sidecar):
+    """The ROADMAP fleet-scale leg: >= 4 peer processes share one
+    sidecar under a 10:1 zipf spam:paying skew.  Every peer's masks
+    bit-exact, no degrade, aggregate throughput positive, per-class
+    serving visible with the paying channel fully served."""
+    specs = [
+        ("paychan", "high", 4, 256, 1),
+        ("spam1", "bulk", 14, 96, 2),
+        ("spam2", "bulk", 14, 96, 3),
+        ("spam3", "bulk", 12, 96, 4),
+    ]
+    procs = [
+        _spawn_peer(sidecar.address, chan, qos, reqs, lanes, seed)
+        for chan, qos, reqs, lanes, seed in specs
+    ]
+    peers = [
+        _collect(p, spec[0]) for p, spec in zip(procs, specs)
+    ]
+    assert sum(p["mask_mismatches"] for p in peers) == 0
+    assert not any(p["degraded"] for p in peers)
+    paying = peers[0]
+    assert paying["ok"] == paying["requests"]  # fully served
+    total_lanes = sum(p["requests"] * p["lanes_per_request"] for p in peers)
+    assert total_lanes == sum(
+        row["lanes"]
+        for row in sidecar.stats.summary()["per_class"].values()
+    )
+    per_class = sidecar.stats.summary()["per_class"]
+    assert per_class["high"]["served"] == 4
+    assert per_class["bulk"]["served"] == 40
+    assert per_class["high"]["latency"]["p99_ms"] is not None

@@ -1,0 +1,251 @@
+"""SidecarRouter: bucket-aware placement, health-probe eviction on the
+per-endpoint CooldownGate (one blackholed endpoint must not slow dials
+to healthy ones — previously untested edge), re-verify-on-kill across
+endpoints, drain, and the fail-closed degrade ladder."""
+
+import threading
+import time
+
+import pytest
+
+from fabric_tpu.common.retry import RetryPolicy
+from fabric_tpu.serve import protocol as proto
+from fabric_tpu.serve.router import SidecarRouter, endpoints_from_env
+from fabric_tpu.serve.server import SidecarServer
+
+from tests.test_serve import mixed_lanes
+
+FAST_GATE = RetryPolicy(
+    base_s=0.05, multiplier=2.0, cap_s=0.5, deadline_s=float("inf")
+)
+
+
+def start_sidecar(path):
+    srv = SidecarServer(
+        str(path), engine="host", warm_ladder="off", buckets=(64, 256, 1024)
+    )
+    srv.warm()
+    srv.start()
+    return srv
+
+
+@pytest.fixture
+def fleet(tmp_path):
+    servers = [start_sidecar(tmp_path / f"r{i}.sock") for i in range(2)]
+    router = SidecarRouter(
+        endpoints=[s.address for s in servers],
+        sleeper=lambda s: None,
+        gate_policy=FAST_GATE,
+    )
+    yield servers, router
+    router.stop()
+    for s in servers:
+        s.stop()
+
+
+class TestRouting:
+    def test_batches_spread_and_masks_exact(self, fleet):
+        servers, router = fleet
+        for n in (48, 200, 900):
+            k, s, d, e = mixed_lanes(n)
+            assert list(router.batch_verify(k, s, d)) == e
+        assert not router.degraded
+        assert sum(s.stats.summary()["requests"] for s in servers) == 3
+
+    def test_placement_is_stable_per_bucket(self, fleet):
+        _servers, router = fleet
+        first = router._order(48)
+        again = router._order(48)
+        assert [e.address for e in first] == [e.address for e in again]
+
+    def test_async_resolves_through_fleet(self, fleet):
+        _servers, router = fleet
+        k, s, d, e = mixed_lanes(64)
+        resolver = router.batch_verify_async(k, s, d)
+        assert list(resolver()) == e
+
+    def test_for_channel_binds_class_and_shares_endpoints(
+        self, fleet, monkeypatch
+    ):
+        _servers, router = fleet
+        assert router.for_channel(router.channel) is router
+        monkeypatch.setenv("FABRIC_TPU_SERVE_QOS", "paychan=high;*=bulk")
+        bound = router.for_channel("paychan")
+        assert bound.qos_class == proto.QOS_HIGH
+        assert bound.endpoints is router.endpoints  # one fleet, shared
+
+    def test_endpoints_from_env(self, monkeypatch):
+        monkeypatch.setenv(
+            "FABRIC_TPU_SERVE_ENDPOINTS", " /a.sock , 127.0.0.1:9 ,"
+        )
+        assert endpoints_from_env() == ["/a.sock", "127.0.0.1:9"]
+        with pytest.raises(ValueError):
+            SidecarRouter(endpoints=[])
+
+
+class TestFailover:
+    def test_kill_one_reverifies_on_survivor(self, fleet):
+        servers, router = fleet
+        k, s, d, e = mixed_lanes(128)
+        assert list(router.batch_verify(k, s, d)) == e
+        victim = router._order(128)[0]
+        next(srv for srv in servers if srv.address == victim.address).stop()
+        k2, s2, d2, e2 = mixed_lanes(128, seed=2)
+        assert list(router.batch_verify(k2, s2, d2)) == e2
+        assert not router.degraded  # the survivor served it
+        assert not victim.healthy  # and the dead endpoint was evicted
+
+    def test_blackholed_endpoint_does_not_slow_healthy_dials(self, fleet):
+        """The CooldownGate-reuse satellite: after ONE slow dial
+        failure the blackholed endpoint is skipped without a dial for
+        the whole cooldown — subsequent batches pay zero blackhole
+        latency.  Uses a production-scale cooldown (a fast test gate
+        would legitimately re-probe mid-test)."""
+        servers, router_fast = fleet
+        router = SidecarRouter(
+            endpoints=[s.address for s in servers],
+            sleeper=lambda s: None,
+            gate_policy=RetryPolicy(
+                base_s=30.0, multiplier=2.0, cap_s=60.0,
+                deadline_s=float("inf"),
+            ),
+        )
+        try:
+            black = router.endpoints[0]
+            dials = []
+
+            def slow_dead_connect():
+                dials.append(time.monotonic())
+                time.sleep(0.25)  # a SYN blackhole, miniaturized
+                raise OSError("blackholed")
+
+            black.client.close()
+            black.client._connect = slow_dead_connect
+            # force one attempt at the blackholed endpoint: pays the
+            # slow dial once, marks it down
+            k, s, d, _e = mixed_lanes(32)
+            outcome, _ = router._try_endpoint(black, k, s, d, 0)
+            assert outcome == "dead" and len(dials) == 1
+            # healthy traffic: gate-open endpoint skipped with NO dial
+            for seed in range(4):
+                k2, s2, d2, e2 = mixed_lanes(64, seed=seed)
+                assert list(router.batch_verify(k2, s2, d2)) == e2
+            assert len(dials) == 1, "blackholed endpoint was re-dialed"
+            assert not router.degraded
+        finally:
+            router.stop()
+
+    def test_all_endpoints_dead_degrades_bit_exact(self, tmp_path):
+        servers = [start_sidecar(tmp_path / f"d{i}.sock") for i in range(2)]
+        router = SidecarRouter(
+            endpoints=[s.address for s in servers],
+            sleeper=lambda s: None,
+            gate_policy=FAST_GATE,
+        )
+        try:
+            for s in servers:
+                s.stop()
+            k, sg, d, e = mixed_lanes(64)
+            assert list(router.batch_verify(k, sg, d)) == e
+            assert router.degraded  # in-process ladder served it
+        finally:
+            router.stop()
+
+    def test_double_fault_fails_closed_all_false(self, tmp_path):
+        class Exploding:
+            def batch_verify(self, keys, sigs, digests):
+                raise RuntimeError("fallback broken too")
+
+        router = SidecarRouter(
+            endpoints=[str(tmp_path / "never.sock")],
+            fallback=Exploding(),
+            sleeper=lambda s: None,
+            gate_policy=FAST_GATE,
+        )
+        try:
+            k, s, d, _e = mixed_lanes(12)
+            assert list(router.batch_verify(k, s, d)) == [False] * 12
+        finally:
+            router.stop()
+
+    def test_stopping_endpoint_reroutes(self, fleet):
+        """ST_STOPPING from a draining endpoint is never trusted as a
+        settlement: the batch re-verifies on the next endpoint."""
+        servers, router = fleet
+        preferred = router._order(64)[0]
+        draining = next(
+            srv for srv in servers if srv.address == preferred.address
+        )
+        with draining._drain_cv:
+            draining._draining = True
+        k, s, d, e = mixed_lanes(64, seed=5)
+        assert list(router.batch_verify(k, s, d)) == e
+        assert not router.degraded
+
+    def test_recovery_after_restart(self, fleet, tmp_path):
+        servers, router = fleet
+        victim_ep = router._order(64)[0]  # preferred: WILL be attempted
+        victim = next(
+            srv for srv in servers if srv.address == victim_ep.address
+        )
+        victim.stop()
+        k, s, d, e = mixed_lanes(64)
+        assert list(router.batch_verify(k, s, d)) == e  # survivor serves
+        assert not victim_ep.healthy
+        servers[servers.index(victim)] = start_sidecar(victim_ep.address)
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if victim_ep.gate.ready() and router._probe_ok(victim_ep):
+                break
+            time.sleep(0.02)
+        assert victim_ep.healthy, "restarted endpoint never re-probed up"
+
+    def test_drain_endpoint_acks_and_evicts(self, fleet):
+        servers, router = fleet
+        addr = router.endpoints[0].address
+        assert router.drain_endpoint(addr)
+        assert not router.endpoints[0].healthy
+        target = next(srv for srv in servers if srv.address == addr)
+        deadline = time.monotonic() + 5.0
+        while not target._stopping and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert target._stopping
+
+
+class TestFactoryWiring:
+    def test_env_endpoints_build_router(self, fleet, monkeypatch):
+        servers, _router = fleet
+        from fabric_tpu.crypto.factory import provider_from_config
+
+        monkeypatch.setenv(
+            "FABRIC_TPU_SERVE_ENDPOINTS",
+            ",".join(s.address for s in servers),
+        )
+        provider = provider_from_config({"Default": "SERVE", "SERVE": {}})
+        try:
+            assert isinstance(provider, SidecarRouter)
+            k, s, d, e = mixed_lanes(32)
+            assert list(provider.batch_verify(k, s, d)) == e
+        finally:
+            provider.stop()
+
+    def test_config_endpoints_and_qos(self, fleet):
+        servers, _router = fleet
+        from fabric_tpu.crypto.factory import provider_from_config
+
+        provider = provider_from_config(
+            {
+                "Default": "SERVE",
+                "SERVE": {
+                    "Endpoints": [s.address for s in servers],
+                    "QoS": "high",
+                    "Channel": "paychan",
+                },
+            }
+        )
+        try:
+            assert isinstance(provider, SidecarRouter)
+            assert provider.qos_class == proto.QOS_HIGH
+            assert provider.channel == "paychan"
+        finally:
+            provider.stop()

@@ -1,0 +1,387 @@
+"""Per-class QoS admission (serve/qos.py + the sidecar's rev-2 path):
+ledger quota/borrowing/demand-latch semantics, the channel->class map,
+the retry_after_ms fill scaling (previously untested PR 8 behavior),
+protocol version negotiation, and drain (rolling restart) mask rules."""
+
+import threading
+import time
+
+import pytest
+
+from fabric_tpu.serve import protocol as proto
+from fabric_tpu.serve.qos import (
+    ClassLedger,
+    class_for_channel,
+    parse_qos_map,
+    parse_shares,
+)
+from fabric_tpu.serve.server import SidecarServer
+
+from tests.test_serve import mixed_lanes
+
+
+class TestClassLedger:
+    def test_quota_split(self):
+        led = ClassLedger(100, {"high": 0.5, "normal": 0.3, "bulk": 0.2})
+        snap = led.snapshot()
+        assert snap["high"]["quota"] == 50
+        assert snap["normal"]["quota"] == 30
+        assert snap["bulk"]["quota"] == 20
+
+    def test_single_class_uses_full_budget_when_others_idle(self):
+        """Work-conserving: an idle class protects nothing — one tenant
+        can occupy the whole machine."""
+        led = ClassLedger(100)
+        assert led.try_acquire(proto.QOS_BULK, 60)
+        assert led.try_acquire(proto.QOS_BULK, 40)
+        assert not led.try_acquire(proto.QOS_BULK, 1)  # budget truly full
+
+    def test_rejection_latches_reservation(self):
+        """After ONE high-priority rejection, bulk can no longer borrow
+        the high quota; the high retry admits in full."""
+        led = ClassLedger(100, {"high": 0.5, "normal": 0.3, "bulk": 0.2})
+        assert led.try_acquire(proto.QOS_BULK, 100)  # idle fleet: all of it
+        assert not led.try_acquire(proto.QOS_HIGH, 50)  # sheds, latches
+        led.release(proto.QOS_BULK, 100)
+        # bulk may refill only what leaves the 50-lane reservation free
+        assert led.try_acquire(proto.QOS_BULK, 50)
+        assert not led.try_acquire(proto.QOS_BULK, 10)
+        assert led.try_acquire(proto.QOS_HIGH, 50)  # reserved lanes held
+        snap = led.snapshot()
+        assert snap["high"]["waiting"] is False  # cleared by the admission
+
+    def test_guaranteed_share_always_admits(self):
+        led = ClassLedger(100, {"high": 0.5, "normal": 0.3, "bulk": 0.2})
+        assert led.try_acquire(proto.QOS_BULK, 20)
+        assert led.try_acquire(proto.QOS_NORMAL, 30)
+        assert led.try_acquire(proto.QOS_HIGH, 50)
+
+    def test_release_clamps_and_unknown_class_maps_to_bulk(self):
+        led = ClassLedger(10)
+        led.release(proto.QOS_HIGH, 5)  # release without acquire: no-op
+        assert led.fill() == 0.0
+        assert led.try_acquire(99, 2)  # unknown id -> bulk, never priority
+        assert led.snapshot()["bulk"]["used"] == 2
+
+    def test_oversized_request_is_capped_not_impossible(self):
+        led = ClassLedger(64)
+        assert led.try_acquire(proto.QOS_NORMAL, 10_000)
+        led.release(proto.QOS_NORMAL, 10_000)
+        assert led.fill() == 0.0
+
+    def test_parse_shares(self):
+        assert parse_shares("high=0.6,bulk=0.1") == {
+            "high": 0.6, "bulk": 0.1,
+        }
+        with pytest.raises(ValueError):
+            parse_shares("vip=0.5")
+        with pytest.raises(ValueError):
+            parse_shares("high=0.9,normal=0.9")
+
+
+class TestQosMap:
+    def test_exact_prefix_and_default(self):
+        m = parse_qos_map("paychan=high;spam*=bulk;*=normal")
+        assert class_for_channel("paychan", m) == proto.QOS_HIGH
+        assert class_for_channel("spam42", m) == proto.QOS_BULK
+        assert class_for_channel("other", m) == proto.QOS_NORMAL
+
+    def test_longest_prefix_wins_and_fallback(self):
+        m = parse_qos_map("spam*=bulk;spamvip*=high")
+        assert class_for_channel("spamvip1", m) == proto.QOS_HIGH
+        assert class_for_channel("spam1", m) == proto.QOS_BULK
+        assert class_for_channel("x", m) == proto.DEFAULT_QOS
+        assert class_for_channel(None, {}) == proto.DEFAULT_QOS
+
+    def test_malformed_map_raises(self):
+        with pytest.raises(ValueError):
+            parse_qos_map("chan=vip")
+
+    def test_env_map_malformed_warns_and_defaults(self, monkeypatch):
+        from fabric_tpu.serve.qos import qos_map_from_env
+
+        monkeypatch.setenv("FABRIC_TPU_SERVE_QOS", "chan==nope==")
+        with pytest.warns(RuntimeWarning):
+            assert qos_map_from_env() == {}
+
+
+class _FakeBatcher:
+    """pending_lanes stub for the fill-scaling unit (the real batcher's
+    fill is timing-dependent; the scaling FORMULA is what's pinned)."""
+
+    def __init__(self, pending):
+        self.pending_lanes = pending
+
+
+class TestRetryAfterScaling:
+    """serve/server.py retry_after_ms — the fill scaling shipped in
+    PR 8 without a test, plus the per-class extension."""
+
+    @pytest.fixture
+    def server(self, tmp_path):
+        srv = SidecarServer(
+            str(tmp_path / "ra.sock"), engine="host", warm_ladder="off",
+            max_pending_lanes=100, retry_after_base_ms=25,
+        )
+        real = srv.batcher
+        yield srv
+        srv.batcher = real
+        srv.stop()
+
+    def test_fill_scales_hint_linearly(self, server):
+        server.batcher = _FakeBatcher(0)
+        assert server.retry_after_ms() == 25  # base at zero fill
+        server.batcher = _FakeBatcher(50)
+        assert server.retry_after_ms() == int(25 * (1.0 + 1.5))
+        server.batcher = _FakeBatcher(100)
+        assert server.retry_after_ms() == 25 * 4  # saturated: 4x base
+        # monotone in fill, floored at 5ms
+        hints = []
+        for pending in (0, 25, 75, 100):
+            server.batcher = _FakeBatcher(pending)
+            hints.append(server.retry_after_ms())
+        assert hints == sorted(hints) and hints[0] >= 5
+
+    def test_class_fill_dominates_global_fill(self, server):
+        server.batcher = _FakeBatcher(0)
+        # saturate the bulk quota only: bulk's hint inflates, high's
+        # stays at base (its own quota is idle)
+        bulk_quota = server.qos.snapshot()["bulk"]["quota"]
+        assert server.qos.try_acquire(proto.QOS_BULK, bulk_quota)
+        try:
+            assert server.retry_after_ms(proto.QOS_BULK) == 25 * 4
+            assert server.retry_after_ms(proto.QOS_HIGH) == 25
+        finally:
+            server.qos.release(proto.QOS_BULK, bulk_quota)
+
+
+class TestServerQosPath:
+    """End-to-end rev-2 serving: class accounting, v1 compatibility,
+    and drain semantics."""
+
+    @pytest.fixture
+    def sidecar(self, tmp_path):
+        srv = SidecarServer(
+            str(tmp_path / "qos.sock"), engine="host", warm_ladder="off",
+            buckets=(64, 256),
+        )
+        srv.warm()
+        srv.start()
+        yield srv
+        srv.stop()
+
+    def test_classed_requests_land_in_class_stats(self, sidecar):
+        from fabric_tpu.serve.client import SidecarProvider
+
+        provider = SidecarProvider(
+            address=sidecar.address, qos_class=proto.QOS_HIGH,
+            channel="paychan",
+        )
+        k, s, d, e = mixed_lanes(20)
+        assert list(provider.batch_verify(k, s, d)) == e
+        summary = sidecar.stats.summary()
+        assert summary["per_class"]["high"]["served"] == 1
+        assert summary["per_class"]["high"]["lanes"] == 20
+        provider.stop()
+
+    def test_v1_client_still_served_as_default_class(self, sidecar):
+        """Old-client compatibility: a hand-rolled v1 frame (no QoS
+        prefix) verifies fine and accounts as the default class."""
+        import socket as _socket
+
+        from fabric_tpu.serve.client import encode_lanes
+
+        family, target = proto.parse_address(sidecar.address)
+        sock = _socket.socket(family, _socket.SOCK_STREAM)
+        sock.connect(target)
+        try:
+            k, s, d, e = mixed_lanes(10)
+            payload = encode_lanes(k, s, d, qos_class=None)  # v1 body
+            proto.send_frame(sock, proto.OP_VERIFY, 7, payload, version=1)
+            frame = proto.recv_frame_ex(sock)
+            assert frame is not None
+            _op, rid, reply, version = frame
+            assert rid == 7 and version == 1  # reply echoes v1
+            status, _, mask, _ = proto.decode_verify_response(reply)
+            assert status == proto.ST_OK and mask == e
+        finally:
+            sock.close()
+        assert sidecar.stats.summary()["per_class"]["normal"]["served"] == 1
+
+    def test_client_negotiates_v2_against_new_server(self, sidecar):
+        from fabric_tpu.serve.client import SidecarClient
+
+        client = SidecarClient(sidecar.address)
+        assert client.ping()
+        assert client.version == proto.PROTOCOL_VERSION
+        client.close()
+
+    def test_client_downgrades_to_v1_against_old_server(self, tmp_path):
+        """A v1-only server (the PR 8 behavior: unsupported version ->
+        one ST_ERROR frame, stream closed) makes the hello latch v1 —
+        new clients keep working against old sidecars, minus QoS."""
+        import socket as _socket
+        import struct
+
+        from fabric_tpu.serve.client import SidecarClient
+
+        addr = str(tmp_path / "old.sock")
+        listener = _socket.socket(_socket.AF_UNIX, _socket.SOCK_STREAM)
+        listener.bind(addr)
+        listener.listen(4)
+        stop = threading.Event()
+
+        def old_server():
+            while not stop.is_set():
+                try:
+                    conn, _ = listener.accept()
+                except OSError:
+                    return
+                try:
+                    while True:
+                        head = conn.recv(proto.HEADER_SIZE)
+                        if len(head) < proto.HEADER_SIZE:
+                            break
+                        magic, ver, op, rid, length = struct.unpack(
+                            ">2sBBII", head
+                        )
+                        if length:
+                            conn.recv(length)
+                        if ver != 1:
+                            # the old server's refusal: one error
+                            # frame (v1 header), then close
+                            conn.sendall(proto.pack_frame(
+                                proto.OP_VERIFY, 0,
+                                proto.encode_verify_response(
+                                    proto.ST_ERROR,
+                                    message="unsupported protocol version",
+                                ),
+                                version=1,
+                            ))
+                            break
+                        if op == proto.OP_PING:
+                            conn.sendall(proto.pack_frame(
+                                proto.OP_PING, rid,
+                                proto.encode_verify_response(
+                                    proto.ST_OK, mask=[]
+                                ),
+                                version=1,
+                            ))
+                finally:
+                    conn.close()
+
+        server_thread = threading.Thread(target=old_server, daemon=True)
+        server_thread.start()
+        try:
+            client = SidecarClient(addr)
+            assert client.ping()
+            assert client.version == proto.MIN_PROTOCOL_VERSION
+            client.close()
+        finally:
+            stop.set()
+            listener.close()
+            server_thread.join(timeout=5.0)
+
+    def test_silent_hello_eof_does_not_downgrade(self, tmp_path):
+        """A sidecar restarting under the dial (connect OK, stream
+        closed before the hello reply) is a TRANSPORT failure, not a
+        version refusal — the client must keep v2, or a transient
+        crash window would permanently strip the QoS class."""
+        import socket as _socket
+
+        from fabric_tpu.serve.client import SidecarClient, SidecarUnavailable
+
+        addr = str(tmp_path / "flap.sock")
+        listener = _socket.socket(_socket.AF_UNIX, _socket.SOCK_STREAM)
+        listener.bind(addr)
+        listener.listen(1)
+        stop = threading.Event()
+
+        def close_on_accept():
+            while not stop.is_set():
+                try:
+                    conn, _ = listener.accept()
+                except OSError:
+                    return
+                conn.close()  # the crash window: no frame, just EOF
+
+        t = threading.Thread(target=close_on_accept, daemon=True)
+        t.start()
+        try:
+            client = SidecarClient(addr)
+            with pytest.raises(SidecarUnavailable):
+                client.ensure_connected()
+            assert client.version == proto.PROTOCOL_VERSION  # NOT latched
+            client.close()
+        finally:
+            stop.set()
+            listener.close()
+            t.join(timeout=5.0)
+
+    def test_drain_refuses_new_work_and_settles_in_flight(self, tmp_path):
+        """The rolling-restart contract: after drain() starts, NEW
+        verify work answers ST_STOPPING while an in-flight request
+        settles with its REAL verdicts (never fail-closed)."""
+        from fabric_tpu.crypto.bccsp import SoftwareProvider
+        from fabric_tpu.serve.client import SidecarClient, encode_lanes
+
+        gate = threading.Event()
+        entered = threading.Event()
+
+        class Gated(SoftwareProvider):
+            def batch_verify_async(self, keys, sigs, digests):
+                out = SoftwareProvider.batch_verify(self, keys, sigs, digests)
+                entered.set()
+                gate.wait(10.0)
+                return lambda: out
+
+        server = SidecarServer(
+            str(tmp_path / "drain.sock"), engine="host", provider=Gated(),
+            warm_ladder="off", buckets=(64,), linger_s=0.0,
+        )
+        server.start()
+        client = SidecarClient(server.address)
+        try:
+            k, s, d, e = mixed_lanes(30)
+            token = client.submit(proto.OP_VERIFY, encode_lanes(k, s, d))
+            assert entered.wait(5.0)
+            drainer = threading.Thread(
+                target=server.drain, kwargs={"timeout_s": 10.0}, daemon=True
+            )
+            drainer.start()
+            deadline = time.monotonic() + 5.0
+            while not server._draining and time.monotonic() < deadline:
+                time.sleep(0.01)
+            # new work while draining: explicit ST_STOPPING
+            k2, s2, d2, _e2 = mixed_lanes(10, seed=2)
+            tok2 = client.submit(proto.OP_VERIFY, encode_lanes(k2, s2, d2))
+            status2, _, _, _ = proto.decode_verify_response(
+                client.await_reply(tok2)
+            )
+            assert status2 == proto.ST_STOPPING
+            # the in-flight request settles with its real mask
+            gate.set()
+            status1, _, mask1, _ = proto.decode_verify_response(
+                client.await_reply(token)
+            )
+            assert status1 == proto.ST_OK and mask1 == e
+            drainer.join(timeout=5.0)
+            assert not drainer.is_alive()
+        finally:
+            gate.set()
+            client.close()
+            server.stop()
+
+    def test_op_drain_acks_then_stops(self, sidecar):
+        from fabric_tpu.serve.client import SidecarClient
+
+        client = SidecarClient(sidecar.address)
+        status, _, _, _ = proto.decode_verify_response(
+            client.request(proto.OP_DRAIN)
+        )
+        assert status == proto.ST_OK
+        client.close()
+        deadline = time.monotonic() + 5.0
+        while not sidecar._stopping and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert sidecar._stopping
