@@ -206,6 +206,26 @@ ENV_VARS: Tuple[EnvVar, ...] = (
         "(same seed = same injections, regardless of thread "
         "interleaving)",
     ),
+    EnvVar(
+        "FABRIC_TPU_CRASH_SITES", "site[@block] list",
+        "(unset: no kill points)",
+        "common/faults.py _install_from_env",
+        "fabcrash kill-point selector: 'site[@block]' entries joined by "
+        "';' — sugar for site=kill:max=1[:at=block] fault specs; the "
+        "process os._exit(137)s at the armed seam (the crash matrix's "
+        "deterministic SIGKILL stand-in); malformed values warn and "
+        "install nothing",
+    ),
+    EnvVar(
+        "FABRIC_TPU_RECOVERY_STRICT", "enum(0|1)", "1",
+        "ledger/blockstore.py recovery_strict (read by ledger/"
+        "pvtdatastore.py and ledger/kvledger.py)",
+        "crash-recovery strictness: 1 (default) refuses to open a store "
+        "whose damage one interrupted append cannot explain (fail "
+        "closed, loud log + refusal counter); 0 is operator-forced "
+        "salvage — truncate to the last whole record / rebuild derived "
+        "state from the chain, for forensics and manual repair",
+    ),
     # -- observability (fabobs) -------------------------------------------
     EnvVar(
         "FABRIC_TPU_OBS", "bool", "(unset: disabled)",

@@ -245,6 +245,32 @@ CANONICAL_METRICS: Tuple[MetricSpec, ...] = (
         "injected faults that actually fired, per site",
         "common/faults.py fault_point",
     ),
+    # -- crash-consistent commit plane (fabcrash, ledger/) -------------
+    MetricSpec(
+        "fabric_ledger_recovered_blocks_total", "counter", (),
+        "blocks replayed into state/pvt by restart recovery (the gap "
+        "between the block store and the state savepoint)",
+        "ledger/kvledger.py _recover",
+    ),
+    MetricSpec(
+        "fabric_ledger_torn_tail_total", "counter", ("store",),
+        "torn tail records truncated on recovery (chain|pvtdata)",
+        "ledger/blockstore.py _rebuild_index, ledger/pvtdatastore.py "
+        "_recover",
+    ),
+    MetricSpec(
+        "fabric_ledger_recovery_refusals_total", "counter", ("reason",),
+        "recoveries refused fail-closed (corrupt-chain|corrupt-pvtdata|"
+        "statedb-ahead): inconsistency recovery cannot repair forward",
+        "ledger/blockstore.py _refuse, ledger/pvtdatastore.py _refuse, "
+        "ledger/kvledger.py _recover",
+    ),
+    MetricSpec(
+        "fabric_mvcc_table_invalidations_total", "counter", (),
+        "resident MVCC version tables dropped because the state db "
+        "generation moved behind their back (stale reads fail closed)",
+        "ledger/mvcc_device.py ResidentDeviceValidator",
+    ),
 )
 
 CANONICAL_BY_NAME: Dict[str, MetricSpec] = {

@@ -24,6 +24,19 @@ site                       seam
 ``serve.route``            SidecarRouter, per endpoint dispatch attempt
 ``raft.step``              RaftChain.step, per consensus message (drop)
 ``idemix.verdict``         idemix/batch verdict mask (corrupt action)
+``blockstore.append.pre_fsync``   BlockStore.add_block, frame written
+                                  but not yet fsynced (kill window)
+``blockstore.append.post_fsync``  BlockStore.add_block, frame fsynced,
+                                  directory entry not yet (kill window)
+``blockstore.append.pre_index``   BlockStore.add_block, durable on disk,
+                                  in-memory index not updated (kill window)
+``kvledger.commit.pre_pvt``       KVLedger.commit, before the pvt store
+                                  write (kill window)
+``kvledger.commit.post_block``    KVLedger.commit, block appended, state
+                                  not yet committed (kill window)
+``persistent.commit.mid``         SqliteVersionedDB.commit_block, mid
+                                  transaction before the savepoint row
+                                  (kill window)
 =========================  ==================================================
 
 A ``fault_point(site, key=...)`` call costs ONE module-global load and a
@@ -45,11 +58,22 @@ Plan grammar (``FABRIC_TPU_FAULTS`` env var or :meth:`FaultPlan.parse`)::
 
     plan   := entry (";" entry)*
     entry  := site "=" action [":" prob] (":" param "=" int)*
-    action := "raise" | "delay" | "corrupt" | "drop"
+    action := "raise" | "delay" | "corrupt" | "drop" | "kill"
     params := max (max fires) | ms (delay millis) | lanes (corrupt width)
+              | at (fire only when the call key equals this int)
 
     FABRIC_TPU_FAULTS="batcher.dispatch=raise:0.2:max=3;deliver.pull=raise:0.5"
     FABRIC_TPU_FAULTS_SEED=7
+
+The ``kill`` action is the fabcrash crash-consistency harness: the
+process dies on the spot via ``os._exit(137)`` — no atexit hooks, no
+interpreter cleanup, no flushing of Python-buffered file data — the
+deterministic stand-in for SIGKILLing a peer mid-commit.  The ``at``
+param pins a kill (or any action) to one exact call key (a block
+number), which is how the crash matrix walks kill WINDOWS instead of
+kill probabilities.  ``FABRIC_TPU_CRASH_SITES`` is operator sugar for
+kill plans: ``site[@block]`` entries joined by ``;``/``,`` that merge
+into the installed plan alongside ``FABRIC_TPU_FAULTS``.
 
 A malformed env plan warns and installs nothing — chaos knobs must never
 poison a production import (the PR 1 env-var discipline).
@@ -67,7 +91,12 @@ from typing import Dict, List, Optional, Sequence, Union
 
 from fabric_tpu.common import fabobs
 
-ACTIONS = ("raise", "delay", "corrupt", "drop")
+ACTIONS = ("raise", "delay", "corrupt", "drop", "kill")
+
+#: the kill action's exit code: what a SIGKILLed process reports (128+9),
+#: so harnesses watching returncodes treat os._exit kills and real
+#: SIGKILLs identically
+KILL_EXIT_CODE = 137
 
 
 class InjectedFault(Exception):
@@ -85,11 +114,12 @@ class FaultSpec:
     """One armed fault: ``site=action:prob:param=...``."""
 
     site: str
-    action: str  # raise | delay | corrupt | drop
+    action: str  # raise | delay | corrupt | drop | kill
     prob: float = 1.0
     max_fires: int = 0  # 0 = unlimited
     delay_ms: int = 10  # delay action: sleep duration
     lanes: int = 1  # corrupt action: verdict lanes to flip
+    at_key: Optional[int] = None  # fire only when the call key == at_key
 
     def __post_init__(self):
         if self.action not in ACTIONS:
@@ -163,6 +193,8 @@ class FaultPlan:
                     kwargs["delay_ms"] = int(value)
                 elif name == "lanes":
                     kwargs["lanes"] = int(value)
+                elif name == "at":
+                    kwargs["at_key"] = int(value)
                 else:
                     raise ValueError(f"unknown fault param {name!r}")
             specs.append(FaultSpec(**kwargs))
@@ -204,6 +236,10 @@ class FaultPlan:
                 spec.action not in interprets
             ):
                 self._warn_uninterpreted(site, spec.action)
+                continue
+            if spec.at_key is not None and key != spec.at_key:
+                # window-pinned spec (crash matrix kill points): only the
+                # exact call key arms it; other calls pass untouched
                 continue
             if spec.prob < 1.0 and key is None:
                 with self._lock:
@@ -341,6 +377,14 @@ def fault_point(
     if spec.action == "delay":
         time.sleep(spec.delay_ms / 1000.0)
         return None
+    if spec.action == "kill":
+        # SIGKILL stand-in: die NOW, from any thread, with no interpreter
+        # cleanup — atexit hooks don't run and Python-buffered file data
+        # is lost, exactly the torn-write surface a real kill exposes.
+        # Whatever the seam already pushed to the OS survives (the OS
+        # flushes its own page cache); whatever sits in Python buffers
+        # does not.
+        os._exit(KILL_EXIT_CODE)
     return spec
 
 
@@ -357,28 +401,70 @@ def corrupt_verdicts(verdicts: Sequence[bool], spec: FaultSpec) -> List[bool]:
     return out
 
 
+def crash_specs_from_text(text: str) -> List[FaultSpec]:
+    """Parse the FABRIC_TPU_CRASH_SITES kill-point selector: ``site`` or
+    ``site@block`` entries joined by ``;``/``,`` — sugar for
+    ``site=kill:max=1`` / ``site=kill:at=block:max=1``.  The crash
+    matrix (tools/fabchaos crash scenarios) arms its subprocess peers
+    this way; raises ValueError on malformed entries."""
+    specs: List[FaultSpec] = []
+    for raw in text.replace(",", ";").split(";"):
+        entry = raw.strip()
+        if not entry:
+            continue
+        site, _sep, at = entry.partition("@")
+        site = site.strip()
+        if not site:
+            raise ValueError(f"crash site entry {entry!r} has no site")
+        specs.append(
+            FaultSpec(
+                site=site,
+                action="kill",
+                max_fires=1,
+                at_key=int(at) if at.strip() else None,
+            )
+        )
+    return specs
+
+
 def _install_from_env() -> None:
-    """Honor FABRIC_TPU_FAULTS at import so external runs (bench, a node
-    under soak) can be chaos'd without code changes.  Malformed values
-    warn and install nothing — never raise out of an import."""
+    """Honor FABRIC_TPU_FAULTS (+ the FABRIC_TPU_CRASH_SITES kill-point
+    sugar) at import so external runs (bench, a node under soak, the
+    crash matrix's subprocess peers) can be chaos'd without code
+    changes.  Malformed values warn and install nothing — never raise
+    out of an import."""
     text = os.environ.get("FABRIC_TPU_FAULTS", "")
-    if not text:
+    crash_text = os.environ.get("FABRIC_TPU_CRASH_SITES", "")
+    if not text and not crash_text:
         return
     seed_raw = os.environ.get("FABRIC_TPU_FAULTS_SEED", "0")
     try:
         seed = int(seed_raw)
     except ValueError:
         seed = 0
-    try:
-        install_plan(FaultPlan.parse(text, seed=seed))
-    except (ValueError, TypeError) as exc:
-        import warnings
+    import warnings
 
+    specs: List[FaultSpec] = []
+    try:
+        if text:
+            specs.extend(FaultPlan.parse(text, seed=seed).specs())
+    except (ValueError, TypeError) as exc:
         warnings.warn(
             f"FABRIC_TPU_FAULTS ignored (malformed: {exc})",
             RuntimeWarning,
             stacklevel=2,
         )
+    try:
+        if crash_text:
+            specs.extend(crash_specs_from_text(crash_text))
+    except (ValueError, TypeError) as exc:
+        warnings.warn(
+            f"FABRIC_TPU_CRASH_SITES ignored (malformed: {exc})",
+            RuntimeWarning,
+            stacklevel=2,
+        )
+    if specs:
+        install_plan(FaultPlan(specs, seed=seed))
 
 
 _install_from_env()

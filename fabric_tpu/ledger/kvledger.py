@@ -22,8 +22,9 @@ import hashlib
 import os
 from typing import Dict, List, Optional, Sequence, Tuple
 
-from fabric_tpu.common import flogging
-from fabric_tpu.ledger.blockstore import BlockStore
+from fabric_tpu.common import fabobs, flogging
+from fabric_tpu.common.faults import fault_point
+from fabric_tpu.ledger.blockstore import BlockStore, refuse_corrupt
 from fabric_tpu.ledger.mvcc import Validator
 from fabric_tpu.ledger.pvtdatastore import MissingEntry, PvtDataStore, PvtEntry
 from fabric_tpu.ledger.rwset import TxRwSet, Version
@@ -182,38 +183,106 @@ class KVLedger:
         # SURVEY P5: resolve block-internal MVCC invalidation chains on
         # device (mvcc_device.DeviceValidator) instead of the Python scan
         self.device_mvcc = device_mvcc
-        self.block_store = BlockStore(os.path.join(ledger_dir, f"{channel_id}.chain"))
-        self.pvt_store = PvtDataStore(
-            os.path.join(ledger_dir, f"{channel_id}.pvtdata"),
-            btl_policy=btl_policy,
-        )
-        if persistent:
-            from fabric_tpu.ledger.persistent import SqliteVersionedDB
-
-            self.state_db = SqliteVersionedDB(
-                os.path.join(ledger_dir, f"{channel_id}.state.db")
-            )
-        else:
-            self.state_db = VersionedDB()
-        from fabric_tpu.ledger.confighistory import ConfigHistoryMgr
-
-        self.config_history = ConfigHistoryMgr(
-            self.state_db if persistent else None
-        )
         self.history: Dict[Tuple[str, str], List[Version]] = {}
         self.commit_hash = b""
-        self._recover()
+        self._closed = False
+        try:
+            self.block_store = BlockStore(
+                os.path.join(ledger_dir, f"{channel_id}.chain")
+            )
+            self.pvt_store = PvtDataStore(
+                os.path.join(ledger_dir, f"{channel_id}.pvtdata"),
+                btl_policy=btl_policy,
+            )
+            if persistent:
+                from fabric_tpu.ledger.persistent import SqliteVersionedDB
+
+                self.state_db = SqliteVersionedDB(
+                    os.path.join(ledger_dir, f"{channel_id}.state.db")
+                )
+            else:
+                self.state_db = VersionedDB()
+            from fabric_tpu.ledger.confighistory import ConfigHistoryMgr
+
+            self.config_history = ConfigHistoryMgr(
+                self.state_db if persistent else None
+            )
+            self._recover()
+        except BaseException:
+            # a refused recovery — whether raised opening a store (a
+            # corrupt chain/pvtdata refuses in its constructor) or
+            # during replay — must not leak the file handles already
+            # open: the operator will reopen (possibly with
+            # RECOVERY_STRICT=0) or run the offline admin CLI against
+            # the same directory
+            self.close()
+            raise
 
     # -- recovery: replay the block store into derived state ---------------
     def _recover(self) -> None:
+        """Replay blocks the store has but the derived caches lack
+        (kv_ledger.go recoverDBs), hardened for the fabcrash kill
+        windows:
+
+        * block store AHEAD of the state db (crash after append, before
+          the sqlite transaction committed): replay the gap idempotently
+          into state + history + pvt (INSERT OR REPLACE semantics);
+        * pvt store BEHIND a stored block (its torn tail was truncated):
+          record missing-data markers so the reconciler re-fetches — the
+          hashed writes are on-block and already replayed;
+        * state db AHEAD of the block store (chain truncated behind our
+          back): nothing can be repaired forward — refuse to serve
+          (strict, the default) or rebuild the derived caches from the
+          chain (FABRIC_TPU_RECOVERY_STRICT=0 salvage)."""
+        height = self.block_store.height
         start = 0
         if self.persistent:
             savepoint = self.state_db.savepoint()
             if savepoint is not None:
+                if savepoint >= height:
+                    refuse_corrupt(
+                        logger,
+                        f"[{self.channel_id}] state db",
+                        f"savepoint {savepoint} is AHEAD of block store "
+                        f"height {height}: the chain lost committed "
+                        f"blocks behind our back",
+                        "statedb-ahead",
+                        "rebuild derived state from the surviving chain",
+                    )
+                    self.state_db.clear()
+                    savepoint = None
+            if savepoint is not None:
                 start = savepoint + 1
                 self.commit_hash = self.state_db.commit_hash()
+        # pvt torn-tail repair for blocks the state db already covers —
+        # the replay loop below repairs its own blocks' pvt gaps.  On a
+        # snapshot-bootstrapped ledger blocks below the base are not
+        # stored: nothing to derive markers from, start at the base.
+        for bn in range(
+            max(
+                self.pvt_store.last_committed_block + 1,
+                self.block_store.base_height,
+            ),
+            min(start, height),
+        ):
+            block = self.block_store.get_block_by_number(bn)
+            self._repair_pvt_gap(
+                block, self._extract_rwsets(block), self._codes(block)
+            )
+        recovered = 0
         for block in self.block_store.iter_blocks(start):
             self._apply_committed_block(block)
+            recovered += 1
+        if recovered and self.persistent:
+            # persistent mode replays only a crash gap (non-persistent
+            # replays the whole chain by design every open)
+            logger.warning(
+                "[%s] recovery replayed %d block(s) above state savepoint "
+                "into state/pvt", self.channel_id, recovered,
+            )
+            fabobs.obs_count(
+                "fabric_ledger_recovered_blocks_total", recovered
+            )
 
     def _apply_committed_block(self, block: common_pb2.Block) -> None:
         flags = self._extract_flags(block)
@@ -244,6 +313,8 @@ class KVLedger:
                     rwset, Version(block.header.number, tx_num), updates, hashed
                 )
         # pvt cleartext state is derived from the pvt store on replay
+        if self.pvt_store.last_committed_block < block.header.number:
+            self._repair_pvt_gap(block, rwsets, codes)
         pvt_batch = self._pvt_batch(
             block.header.number,
             self.pvt_store.get_pvt_data_by_block(block.header.number),
@@ -252,6 +323,32 @@ class KVLedger:
             verify_hashes=False,
         )
         self._commit_state(block, updates, hashed, pvt_batch)
+
+    def _codes(self, block: common_pb2.Block) -> List[TxValidationCode]:
+        flags = self._extract_flags(block)
+        return [TxValidationCode(int(c)) for c in flags.asarray()]
+
+    def _repair_pvt_gap(self, block, rwsets, codes) -> None:
+        """The pvt record for an already-stored block is gone (its torn
+        tail was truncated by recovery).  The cleartext cannot be
+        recreated locally — record missing markers for every collection
+        the block's VALID txs wrote, so the guard invariant (pvt store
+        never behind the chain) holds and the reconciler re-fetches.
+        The on-block hashed writes replay regardless."""
+        missing = [
+            MissingEntry(tx_num, ns_rw.namespace, coll.collection_name)
+            for tx_num, (rwset, code) in enumerate(zip(rwsets, codes))
+            if code == TxValidationCode.VALID and rwset is not None
+            for ns_rw in rwset.ns_rw_sets
+            for coll in ns_rw.coll_hashed
+            if coll.hashed_writes
+        ]
+        logger.warning(
+            "[%s] pvt store behind stored block %d on recovery: "
+            "recording %d missing-data marker(s) for the reconciler",
+            self.channel_id, block.header.number, len(missing),
+        )
+        self.pvt_store.commit(block.header.number, [], missing)
 
     def _extract_flags(self, block: common_pb2.Block) -> ValidationFlags:
         raw = bytes(block.metadata.metadata[common_pb2.TRANSACTIONS_FILTER])
@@ -346,10 +443,18 @@ class KVLedger:
         # block is already durable — skip, don't error, so redelivery of
         # the block can complete the interrupted commit.
         t1 = _time.perf_counter()
+        # kill window (fabcrash): nothing for this block is durable yet —
+        # a kill here loses the block entirely and the restart re-pulls it
+        fault_point("kvledger.commit.pre_pvt", key=int(block.header.number))
         if self.pvt_store.last_committed_block < block.header.number:
             self.pvt_store.commit(block.header.number, entries, missing)
 
         self.block_store.add_block(block)
+        # kill window (fabcrash): pvt + block durable, state db not —
+        # recovery replays this block into state/pvt idempotently
+        fault_point(
+            "kvledger.commit.post_block", key=int(block.header.number)
+        )
         t2 = _time.perf_counter()
         self._commit_state(block, updates, hashed, pvt_batch)
         t3 = _time.perf_counter()
@@ -555,7 +660,12 @@ class KVLedger:
         if self.persistent:
             self.state_db.clear()
         else:
+            # carry the generation stamp forward (+1): a resident MVCC
+            # table bound to the old db must see the rebuild as an
+            # out-of-band mutation, not a fresh generation-0 twin
+            old_generation = self.state_db.state_generation
             self.state_db = VersionedDB()
+            self.state_db.state_generation = old_generation + 1
         from fabric_tpu.ledger.confighistory import ConfigHistoryMgr
 
         self.config_history = ConfigHistoryMgr(
@@ -580,11 +690,19 @@ class KVLedger:
     def close(self) -> None:
         """Release file handles/connections (ledgermgmt.Close): required
         before another process (or the offline admin CLI) opens the same
-        ledger directory."""
-        self.block_store.close()
-        self.pvt_store.close()
-        if self.persistent:
-            self.state_db.close()
+        ledger directory.  Idempotent and safe on a partially-constructed
+        ledger — recovery error paths call it before re-raising, and a
+        crash-restart runbook may close defensively."""
+        if getattr(self, "_closed", False):
+            return
+        self._closed = True
+        for store in (
+            getattr(self, "block_store", None),
+            getattr(self, "pvt_store", None),
+            getattr(self, "state_db", None) if self.persistent else None,
+        ):
+            if store is not None:
+                store.close()
 
     # -- queries (qscc analog) --------------------------------------------
     @property

@@ -52,6 +52,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from fabric_tpu.common import fabobs
 from fabric_tpu.ledger.mvcc import Validator
 from fabric_tpu.ledger.rwset import TxRwSet, Version
 from fabric_tpu.ledger.statedb import (
@@ -317,8 +318,16 @@ class ResidentDeviceValidator(DeviceValidator):
     through validate_and_prepare_batch (the kvledger path). Blocks that
     fall back to the host oracle (range queries / metadata writes)
     refresh the resident entries of the keys they wrote via the pending
-    init queue; state mutated behind the validator's back requires
-    `invalidate()`.
+    init queue.  State mutated BEHIND the validator's back (rollback +
+    re-commit, rebuild_dbs, clear) is detected via an explicit
+    GENERATION STAMP: the db carries ``state_generation`` (bumped by
+    every out-of-band mutator), the table records the generation it was
+    built against, and every block checks the stamp BEFORE trusting the
+    table and AGAIN after the device launch — a stale table is dropped
+    and the block re-resolves against live state (host oracle for the
+    mid-block race, a fresh table otherwise).  A mask is never emitted
+    from a dead table generation; ``invalidate()`` remains the manual
+    seam.
 
     A key's slot is assigned on first sight and its committed version
     seeded from the host db ONCE (one probe per key lifetime, not one
@@ -330,13 +339,33 @@ class ResidentDeviceValidator(DeviceValidator):
         self._index: dict = {}  # (ns, coll, key) -> slot
         self._dev_versions = None  # lazily created on first device block
         self._pending_init: List[Tuple[int, Tuple[int, int]]] = []
+        # generation stamp: the db.state_generation this table was built
+        # against; None = no live table.  Deterministic invalidation
+        # counter for harness scorecards (fabobs mirrors it).
+        self._table_generation: Optional[int] = None
+        self.invalidations = 0
 
     # -- coherence ---------------------------------------------------------
+    def _db_generation(self) -> int:
+        return getattr(self.db, "state_generation", 0)
+
     def invalidate(self) -> None:
         """Drop the resident table (state changed behind our back)."""
         self._index.clear()
         self._dev_versions = None
         self._pending_init.clear()
+        self._table_generation = None
+
+    def _note_stale(self, block_num: int, when: str) -> None:
+        self.invalidations += 1
+        fabobs.obs_count("fabric_mvcc_table_invalidations_total")
+        logger.warning(
+            "resident MVCC table generation %s went stale %s block %d "
+            "(db generation %d): dropping residency and re-resolving "
+            "against live state",
+            self._table_generation, when, block_num, self._db_generation(),
+        )
+        self.invalidate()
 
     def _note_batches(self, updates: UpdateBatch, hashed: HashedUpdateBatch):
         """Queue refreshes for host-committed writes of tracked keys."""
@@ -395,6 +424,15 @@ class ResidentDeviceValidator(DeviceValidator):
             # commits still flow: tracked resident entries must refresh
             self._note_batches(out[1], out[2])
             return out
+        # generation check (per block, BEFORE the table is trusted):
+        # state changed behind our back invalidates every resident
+        # version — fail closed, re-resolve, never serve stale
+        gen_at_start = self._db_generation()
+        if (
+            self._dev_versions is not None
+            and self._table_generation != gen_at_start
+        ):
+            self._note_stale(block_num, "before")
         enc = self._encode_resident(tx_rwsets, incoming_codes, block_num)
         if enc is None:
             self.last_path = "host"
@@ -429,6 +467,8 @@ class ResidentDeviceValidator(DeviceValidator):
             self._dev_versions = jnp.full(
                 (self._cap, 2), -1, dtype=jnp.int32
             )
+        # stamp the table with the generation its seeds were read under
+        self._table_generation = gen_at_start
 
         T = len(tx_rwsets)
         K = max(n_keys, 1)
@@ -472,6 +512,18 @@ class ResidentDeviceValidator(DeviceValidator):
                 "validating this block on the host", exc,
             )
             self.invalidate()
+            self.last_path = "host"
+            out = self._host.validate_and_prepare_batch(
+                block_num, tx_rwsets, incoming_codes
+            )
+            self._note_batches(out[1], out[2])
+            return out
+
+        if self._db_generation() != gen_at_start:
+            # state mutated mid-block (between encode/launch and here):
+            # the verdicts came from a DEAD table generation — discard
+            # them unseen and re-resolve on the host against live state
+            self._note_stale(block_num, "during")
             self.last_path = "host"
             out = self._host.validate_and_prepare_batch(
                 block_num, tx_rwsets, incoming_codes

@@ -22,6 +22,7 @@ import sqlite3
 import threading
 from typing import Iterator, List, Optional, Tuple
 
+from fabric_tpu.common.faults import fault_point
 from fabric_tpu.ledger import queries as rich_queries
 from fabric_tpu.ledger.rwset import Version
 from fabric_tpu.ledger.statedb import (
@@ -71,13 +72,27 @@ class SqliteVersionedDB:
         # workers read while the commit pipeline writes); sqlite3 objects
         # are not thread-safe, so every access serializes on this lock
         self._lock = threading.RLock()
+        self._closed = False
+        # coherence stamp for device-resident derived caches
+        # (mvcc_device.ResidentDeviceValidator): bumped whenever state is
+        # mutated OUT OF BAND of the validator flow (clear / rebuild /
+        # rollback), so a resident version table can detect it went stale
+        # and must never emit a mask from a dead generation
+        self.state_generation = 0
         self._db = sqlite3.connect(path, check_same_thread=False)
         self._db.execute("PRAGMA journal_mode=WAL")
         self._db.execute("PRAGMA synchronous=NORMAL")
         self._db.executescript(_SCHEMA)
         self._db.commit()
 
+    def bump_generation(self) -> None:
+        self.state_generation += 1
+
     def close(self) -> None:
+        """Idempotent (recovery error paths may close twice)."""
+        if self._closed:
+            return
+        self._closed = True
         self._db.close()
 
     def _one(self, sql, params=()):
@@ -325,6 +340,11 @@ class SqliteVersionedDB:
                         ),
                     )
             if savepoint is not None:
+                # kill window (fabcrash): every row above is written but
+                # the transaction is uncommitted — a kill here rolls the
+                # whole block back on reopen (WAL discards), leaving the
+                # state db exactly one block behind the block store
+                fault_point("persistent.commit.mid", key=int(savepoint))
                 db.execute(
                     "INSERT OR REPLACE INTO meta VALUES ('savepoint', ?)",
                     (str(savepoint).encode(),),
@@ -335,8 +355,23 @@ class SqliteVersionedDB:
                     (commit_hash,),
                 )
 
+    def iter_all_pvt(
+        self,
+    ) -> Iterator[Tuple[str, str, str, VersionedValue]]:
+        """Deterministic walk of the cleartext private state (crash-
+        harness digests; the pvt sibling of iter_all_state)."""
+        for ns, coll, key, value, blk, txn in self._all(
+            "SELECT ns, coll, key, value, block, txn FROM pvt "
+            "ORDER BY ns, coll, key"
+        ):
+            yield ns, coll, key, VersionedValue(bytes(value), Version(blk, txn))
+
     def clear(self) -> None:
-        """Drop all derived data (peer node rebuild-dbs)."""
+        """Drop all derived data (peer node rebuild-dbs).  Out-of-band
+        state mutation: bumps the generation stamp so resident version
+        tables built over this db fail closed instead of serving stale
+        versions."""
+        self.bump_generation()
         with self._lock, self._db as db:
             for table in ("state", "hashed", "pvt", "history", "meta", "confighistory"):
                 try:

@@ -12,18 +12,38 @@ payloads) next to the block store, with:
   crash between pvtdata and block commit is detectable on recovery
   (store.go Commit + pendingCommit semantics).
 
-File format: one append-only file of varint-framed records:
+File format: one append-only file of doubly-checksummed records
+(``u32 len || u32 crc32(len) || body || u32 crc32(body)`` — the block
+store's frame discipline):
   record = {block_num, [(tx_num, ns, coll, rwset_bytes)], [missing keys]}
 serialized as a PvtBlockRecord proto-free binary layout (length-prefixed
-fields) — simple, deterministic, rebuildable by scan like the block store.
+fields) — simple, deterministic, rebuildable by scan like the block store,
+and carrying the same crash-consistency contract (fabcrash, PR 13): a torn
+tail record is truncated on recovery (loud log +
+``fabric_ledger_torn_tail_total``); damage one interrupted append cannot
+explain (including a corrupted length prefix, caught by the header
+checksum) fails closed via :class:`~fabric_tpu.ledger.blockstore.
+LedgerCorruptionError` (salvageable with FABRIC_TPU_RECOVERY_STRICT=0).
 """
 
 from __future__ import annotations
 
 import os
 import struct
+import zlib
 from dataclasses import dataclass, field
 from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from fabric_tpu.common.flogging import must_get_logger
+from fabric_tpu.common import fabobs
+from fabric_tpu.ledger.blockstore import (
+    frame_header,
+    fsync_dir,
+    read_frame_header,
+    refuse_corrupt,
+)
+
+logger = must_get_logger("pvtdatastore")
 
 
 @dataclass(frozen=True)
@@ -66,11 +86,20 @@ class PvtDataStore:
         self._by_block: Dict[int, List[PvtEntry]] = {}
         self._missing: Dict[int, List[MissingEntry]] = {}
         self._last_committed = -1
+        self._closed = False
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         self._recover()
         self._f = open(self.path, "ab")
 
     # -- persistence ------------------------------------------------------
+    def _refuse(self, why: str) -> None:
+        """Same fail-closed discipline as BlockStore._refuse: strict
+        (default) raises; FABRIC_TPU_RECOVERY_STRICT=0 salvages."""
+        refuse_corrupt(
+            logger, f"pvtdata store {self.path}", why, "corrupt-pvtdata",
+            "truncate to the last whole record",
+        )
+
     def _recover(self) -> None:
         if not os.path.exists(self.path):
             return
@@ -79,16 +108,58 @@ class PvtDataStore:
         buf = memoryview(data)
         off = 0
         valid_end = 0
-        while off + 4 <= len(buf):
-            try:
-                rec, off = _r_bytes(buf, off)
-                self._load_record(rec)
-            except (struct.error, ValueError, IndexError):
+        refused = False  # salvage truncation, NOT a benign torn tail
+        while off < len(data):
+            if off + 8 > len(buf):
+                break  # torn header at the tail
+            ln = read_frame_header(bytes(buf[off : off + 8]))
+            if ln is None:
+                # a full header failing its own checksum is corruption
+                # (a torn append leaves a PREFIX of a valid record)
+                self._refuse(f"record header checksum failed at offset {off}")
+                refused = True
                 break
+            end = off + 8 + ln + 4
+            if end > len(buf):
+                break  # header-validated length overshoots EOF: torn tail
+            body = bytes(buf[off + 8 : off + 8 + ln])
+            (crc,) = struct.unpack_from("<I", buf, off + 8 + ln)
+            if zlib.crc32(body) != crc:
+                # one interrupted append can only damage the LAST record
+                if end < len(data):
+                    self._refuse(f"checksum mismatch at offset {off}")
+                    refused = True
+                break
+            try:
+                self._load_record(body)
+            except (struct.error, ValueError, IndexError):
+                # checksum-valid but undecodable: fully written garbage,
+                # never a torn append
+                self._refuse(f"checksummed record at offset {off} does not parse")
+                refused = True
+                break
+            off = end
             valid_end = off
         if valid_end != len(data):
+            if refused:
+                logger.critical(
+                    "pvtdata store %s: salvage dropped %d bytes "
+                    "(FABRIC_TPU_RECOVERY_STRICT=0)",
+                    self.path, len(data) - valid_end,
+                )
+            else:
+                logger.warning(
+                    "pvtdata store %s: truncating %d-byte torn tail "
+                    "(crash recovery)", self.path, len(data) - valid_end,
+                )
+                fabobs.obs_count(
+                    "fabric_ledger_torn_tail_total", store="pvtdata"
+                )
             with open(self.path, "ab") as f:
                 f.truncate(valid_end)
+                f.flush()
+                os.fsync(f.fileno())
+            fsync_dir(self.path)
 
     def _load_record(self, rec: bytes) -> None:
         """Replay one record. Multiple records for the same block are the
@@ -148,11 +219,14 @@ class PvtDataStore:
             body += struct.pack("<IB", m.tx_num, int(m.eligible))
             _w_bytes(body, m.namespace.encode())
             _w_bytes(body, m.collection.encode())
-        out = bytearray()
-        _w_bytes(out, bytes(body))
+        body_bytes = bytes(body)
+        out = bytearray(frame_header(len(body_bytes)))
+        out += body_bytes
+        out += struct.pack("<I", zlib.crc32(body_bytes))
         self._f.write(out)
         self._f.flush()
         os.fsync(self._f.fileno())
+        fsync_dir(self.path)
 
     # -- commit path (store.go Commit) ------------------------------------
     def commit(
@@ -258,7 +332,15 @@ class PvtDataStore:
                     bnum, self._by_block[bnum], self._missing.get(bnum, [])
                 )
         os.replace(tmp, self.path)
+        fsync_dir(self.path)
         self._f = open(self.path, "ab")
+        self._closed = False
 
     def close(self) -> None:
-        self._f.close()
+        """Idempotent; tolerates a partially-constructed store."""
+        if self._closed:
+            return
+        self._closed = True
+        f = getattr(self, "_f", None)
+        if f is not None:
+            f.close()

@@ -134,6 +134,14 @@ class VersionedDB:
         self._sorted_keys: Dict[str, List[str]] = {}
         self._hashed: Dict[Tuple[str, str, bytes], VersionedValue] = {}
         self._pvt: Dict[Tuple[str, str, str], VersionedValue] = {}
+        # coherence stamp for device-resident derived caches (see
+        # SqliteVersionedDB.state_generation): out-of-band mutators
+        # (rollback / rebuild / anything bypassing the validator flow)
+        # must bump_generation() so resident version tables fail closed
+        self.state_generation = 0
+
+    def bump_generation(self) -> None:
+        self.state_generation += 1
 
     # -- reads ------------------------------------------------------------
     def get_state(self, ns: str, key: str) -> Optional[VersionedValue]:

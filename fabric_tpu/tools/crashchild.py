@@ -1,0 +1,461 @@
+"""crashchild — the subprocess peer the fabcrash crash matrix kills.
+
+The fabchaos ``crash_single`` / ``crash_matrix`` scenarios need a REAL
+peer process to die mid-commit: in-process fault injection can raise at
+a seam, but only a process death exercises what the durability seams
+actually promise — fsync ordering, torn tails, sqlite WAL rollback, and
+restart recovery.  This module is that process, kept import-light (no
+jax, no numpy, no crypto backends) so a matrix run's many child
+processes start in fractions of a second.
+
+Three entry points:
+
+* :func:`build_stream` (called in-process by the fabchaos parent) —
+  deterministically builds a multi-channel stream of endorsed blocks
+  (valid lanes, MVCC-conflict lanes, private-data collections) plus the
+  coordinator-style cleartext pvt payloads, serialized under a stream
+  directory.  Signatures come from a seeded null signer: structurally
+  valid envelopes (txparse parses them) whose crypto is never checked —
+  the crash surface under test is the COMMIT plane, not the validator.
+
+* ``commit`` mode — opens one :class:`~fabric_tpu.ledger.kvledger.
+  KVLedger` per channel (restart recovery runs implicitly) and drives
+  the remaining blocks through per-channel
+  :class:`~fabric_tpu.peer.pipeline.CommitPipeline` instances, so kill
+  points inside ``pipeline.commit`` / ``kvledger.commit`` /
+  ``blockstore.append`` / ``persistent.commit.mid`` fire on the real
+  stage-B thread.  Armed via ``FABRIC_TPU_CRASH_SITES`` in the child's
+  environment; a kill exits with
+  :data:`~fabric_tpu.common.faults.KILL_EXIT_CODE`.
+
+* ``recover`` mode — reopens the ledgers (recovery repairs torn tails /
+  replays the state gap), then RE-PULLS every missing block over the
+  existing deliver failover path (two endpoints serving the stream; the
+  parent arms a ``deliver.pull`` flap so failover is actually taken),
+  commits them, and writes ``digest.json``: per-channel chain-file
+  sha256, commit hash, concatenated VALID/INVALID masks, and full
+  state/hashed/pvt row digests.  The parent byte-diffs this digest
+  against the no-crash run's.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import struct
+import sys
+from typing import Dict, List, Optional, Tuple
+
+from fabric_tpu.common.retry import RetryPolicy
+from fabric_tpu.deliver.client import BlockDeliverer
+from fabric_tpu.ledger.kvledger import KVLedger
+from fabric_tpu.peer.pipeline import CommitPipeline
+from fabric_tpu.protos import ab_pb2, common_pb2, protoutil
+
+NAMESPACE = "cc"
+COLLECTION = "secret"
+
+
+# ---------------------------------------------------------------------------
+# Stream construction (parent side)
+# ---------------------------------------------------------------------------
+
+
+class _NullSigner:
+    """Structurally-valid, crypto-free signing identity: deterministic
+    seeded nonces (stable tx_ids) and content-hash 'signatures'.  The
+    commit plane never verifies them; txparse only needs the envelope
+    shape."""
+
+    def __init__(self, msp_id: str, rng):
+        self.msp_id = msp_id
+        self._serialized = protoutil.serialize_identity(
+            msp_id, b"crash:" + rng.getrandbits(64).to_bytes(8, "big")
+        )
+        self._rng = rng
+
+    def serialize(self) -> bytes:
+        return self._serialized
+
+    def new_nonce(self) -> bytes:
+        return self._rng.getrandbits(192).to_bytes(24, "big")
+
+    def sign(self, msg: bytes) -> bytes:
+        return hashlib.sha256(b"nullsig|" + msg).digest()
+
+
+def _tx_envelope(client, endorser, channel_id: str, txrw) -> bytes:
+    from fabric_tpu.endorser import (
+        create_proposal,
+        create_signed_tx,
+        endorse_proposal,
+    )
+    from fabric_tpu.ledger.rwset_proto import serialize_tx_rwset
+
+    bundle = create_proposal(client, channel_id, NAMESPACE, [b"invoke"])
+    responses = [
+        endorse_proposal(bundle, endorser, serialize_tx_rwset(txrw))
+    ]
+    return create_signed_tx(bundle, client, responses).SerializeToString()
+
+
+def build_stream(
+    stream_dir: str,
+    seed: int,
+    n_channels: int = 3,
+    n_blocks: int = 6,
+) -> None:
+    """Deterministic multi-channel block stream + pvt payloads on disk.
+
+    Per block and channel: tx0 writes a hot key with an oversized value
+    (every block frame exceeds the Python write buffer, so the payload
+    bypasses the buffer while the trailing checksum stays buffered — a
+    pre-fsync kill on ANY channel then leaves a GENUINELY torn frame
+    for recovery to truncate), tx1 carries a stale read (always an MVCC
+    conflict: masks are never all-VALID), tx2 writes a rotating key,
+    tx3 writes a private collection (hashed writes on-block, cleartext
+    in the pvt sidecar)."""
+    import random
+
+    from fabric_tpu.ledger import rwset as rw
+    from fabric_tpu.protos import kv_rwset_pb2
+
+    os.makedirs(stream_dir, exist_ok=True)
+    pvt_json: Dict[str, Dict[str, List] ] = {}
+    for ch in range(n_channels):
+        rng = random.Random(seed * 1000003 + 7919 * ch)
+        client = _NullSigner("CrashMSP", rng)
+        endorser = _NullSigner("CrashMSP", rng)
+        channel_id = f"ch{ch}"
+        model: Dict[str, Tuple[int, int]] = {}
+        hashed_model: Dict[bytes, Tuple[int, int]] = {}
+        prev = b""
+        frames = bytearray()
+        pvt_json[str(ch)] = {}
+        big = 12288
+        for bn in range(n_blocks):
+            hot = f"hot{ch}"
+            rot = f"k{bn % 5}"
+
+            def claim(committed):
+                return rw.Version(*committed) if committed else None
+
+            txs = []
+            # tx0: correct read claim + write of the hot key (valid)
+            txs.append(
+                rw.TxRwSet((
+                    rw.NsRwSet(
+                        NAMESPACE,
+                        (rw.KVRead(hot, claim(model.get(hot))),),
+                        (rw.KVWrite(hot, False, bytes([bn & 0xFF]) * big),),
+                    ),
+                ))
+            )
+            # tx1: stale claim -> deterministic MVCC conflict lane
+            txs.append(
+                rw.TxRwSet((
+                    rw.NsRwSet(
+                        NAMESPACE,
+                        (rw.KVRead(hot, rw.Version(bn, 99)),),
+                        (rw.KVWrite(hot, False, b"loser"),),
+                    ),
+                ))
+            )
+            # tx2: rotating key, correct claim (valid)
+            txs.append(
+                rw.TxRwSet((
+                    rw.NsRwSet(
+                        NAMESPACE,
+                        (rw.KVRead(rot, claim(model.get(rot))),),
+                        (rw.KVWrite(rot, False, b"v%d" % bn),),
+                    ),
+                ))
+            )
+            # tx3: private collection write (+ read of the previous
+            # pvt key at its true hashed version)
+            pkey = f"p{ch}_{bn}"
+            pval = b"secret %d %d" % (ch, bn)
+            kh = hashlib.sha256(pkey.encode()).digest()
+            reads = ()
+            prev_kh = hashlib.sha256(f"p{ch}_{bn-1}".encode()).digest()
+            if prev_kh in hashed_model:
+                reads = (
+                    rw.KVReadHash(
+                        prev_kh, rw.Version(*hashed_model[prev_kh])
+                    ),
+                )
+            txs.append(
+                rw.TxRwSet((
+                    rw.NsRwSet(
+                        NAMESPACE,
+                        (),
+                        (),
+                        (),
+                        (
+                            rw.CollHashedRwSet(
+                                COLLECTION,
+                                reads,
+                                (
+                                    rw.KVWriteHash(
+                                        kh,
+                                        False,
+                                        hashlib.sha256(pval).digest(),
+                                    ),
+                                ),
+                                (),
+                            ),
+                        ),
+                    ),
+                ))
+            )
+            kv = kv_rwset_pb2.KVRWSet()
+            w = kv.writes.add()
+            w.key = pkey
+            w.value = pval
+            pvt_json[str(ch)][str(bn)] = [
+                [3, NAMESPACE, COLLECTION, kv.SerializeToString().hex()]
+            ]
+
+            block = protoutil.new_block(bn, prev)
+            for txrw in txs:
+                block.data.data.append(
+                    _tx_envelope(client, endorser, channel_id, txrw)
+                )
+            protoutil.seal_block(block)
+            prev = protoutil.block_header_hash(block.header)
+            raw = block.SerializeToString()
+            frames += struct.pack("<I", len(raw)) + raw
+
+            # the model mirrors the sequential MVCC outcome: tx0/tx2/tx3
+            # are valid by construction, tx1 always conflicts
+            model[hot] = (bn, 0)
+            model[rot] = (bn, 2)
+            hashed_model[kh] = (bn, 3)
+        with open(os.path.join(stream_dir, f"ch{ch}.bin"), "wb") as f:
+            f.write(frames)
+    with open(os.path.join(stream_dir, "pvt.json"), "w") as f:
+        json.dump(pvt_json, f, sort_keys=True)
+    with open(os.path.join(stream_dir, "meta.json"), "w") as f:
+        json.dump({"channels": n_channels, "blocks": n_blocks}, f)
+
+
+# ---------------------------------------------------------------------------
+# Child side: load, commit, recover, digest
+# ---------------------------------------------------------------------------
+
+
+def load_stream(stream_dir: str):
+    with open(os.path.join(stream_dir, "meta.json")) as f:
+        meta = json.load(f)
+    blocks: List[List[common_pb2.Block]] = []
+    pvt: List[Dict[int, Dict[Tuple[int, str, str], bytes]]] = []
+    with open(os.path.join(stream_dir, "pvt.json")) as f:
+        pvt_json = json.load(f)
+    for ch in range(meta["channels"]):
+        chain: List[common_pb2.Block] = []
+        with open(os.path.join(stream_dir, f"ch{ch}.bin"), "rb") as f:
+            data = f.read()
+        off = 0
+        while off < len(data):
+            (ln,) = struct.unpack_from("<I", data, off)
+            off += 4
+            chain.append(
+                protoutil.unmarshal(common_pb2.Block, data[off : off + ln])
+            )
+            off += ln
+        blocks.append(chain)
+        per_block: Dict[int, Dict[Tuple[int, str, str], bytes]] = {}
+        for bn, entries in pvt_json.get(str(ch), {}).items():
+            per_block[int(bn)] = {
+                (tx, ns, coll): bytes.fromhex(raw)
+                for tx, ns, coll, raw in entries
+            }
+        pvt.append(per_block)
+    return meta, blocks, pvt
+
+
+def _open_ledgers(workdir: str, n_channels: int) -> List[KVLedger]:
+    ledger_dir = os.path.join(workdir, "ledger")
+    return [
+        KVLedger(ledger_dir, f"ch{ch}", persistent=True)
+        for ch in range(n_channels)
+    ]
+
+
+class _LedgerChannel:
+    """The minimal channel surface CommitPipeline drives: stage A is a
+    no-op (no validator in the crash child — the commit plane is the
+    surface under test), stage B is the real KVLedger.commit with the
+    coordinator-assembled pvt payloads."""
+
+    def __init__(self, channel_id, ledger, pvt_by_block):
+        self.channel_id = channel_id
+        self.ledger = ledger
+        self.pvt_by_block = pvt_by_block
+
+    def prepare_block(self, block):
+        return None
+
+    def store_block(self, block, prepared=None):
+        return self.ledger.commit(
+            block, pvt_data=self.pvt_by_block.get(block.header.number)
+        )
+
+
+def cmd_commit(workdir: str, stream_dir: str) -> int:
+    meta, blocks, pvt = load_stream(stream_dir)
+    ledgers = _open_ledgers(workdir, meta["channels"])
+    errors: List[str] = []
+    pipes = [
+        CommitPipeline(
+            _LedgerChannel(f"ch{ch}", ledgers[ch], pvt[ch]),
+            on_error=lambda b, exc, ch=ch: errors.append(
+                f"ch{ch} block {b.header.number}: {exc}"
+            ),
+        )
+        for ch in range(meta["channels"])
+    ]
+    start = [lg.height for lg in ledgers]
+    try:
+        for bn in range(meta["blocks"]):
+            for ch in range(meta["channels"]):
+                if bn < start[ch]:
+                    continue  # already durable from a previous life
+                pipes[ch].submit(blocks[ch][bn])
+        for pipe in pipes:
+            if not pipe.drain(timeout=60):
+                errors.append("pipeline failed to drain")
+    finally:
+        for pipe in pipes:
+            pipe.stop()
+        for lg in ledgers:
+            lg.close()
+    if errors:
+        print("\n".join(errors), file=sys.stderr)
+        return 1
+    return 0
+
+
+def _seek_start(env: common_pb2.Envelope) -> int:
+    payload = protoutil.unmarshal(common_pb2.Payload, env.payload)
+    seek = protoutil.unmarshal(ab_pb2.SeekInfo, payload.data)
+    return seek.start.specified.number
+
+
+def cmd_recover(workdir: str, stream_dir: str) -> int:
+    meta, blocks, pvt = load_stream(stream_dir)
+    ledgers = _open_ledgers(workdir, meta["channels"])
+    try:
+        for ch, ledger in enumerate(ledgers):
+            remaining = meta["blocks"] - ledger.height
+            if remaining <= 0:
+                continue
+
+            def endpoint(chain):
+                def serve(env):
+                    for b in chain[_seek_start(env) :]:
+                        resp = ab_pb2.DeliverResponse()
+                        resp.block.CopyFrom(b)
+                        yield resp
+
+                return serve
+
+            committed: List[int] = []
+
+            def on_block(block, ledger=ledger, ch=ch):
+                ledger.commit(
+                    block,
+                    pvt_data=pvt[ch].get(block.header.number),
+                )
+                committed.append(block.header.number)
+
+            deliverer = BlockDeliverer(
+                f"ch{ch}",
+                [endpoint(blocks[ch]), endpoint(blocks[ch])],
+                on_block=on_block,
+                next_block=lambda ledger=ledger: ledger.height,
+                retry_policy=RetryPolicy(
+                    base_s=0.01, multiplier=2.0, cap_s=0.05, deadline_s=30.0
+                ),
+            )
+            got = deliverer.run(max_blocks=remaining)
+            if got != remaining:
+                print(
+                    f"ch{ch}: re-pulled {got}/{remaining} blocks",
+                    file=sys.stderr,
+                )
+                return 1
+        digest = {
+            f"ch{ch}": _digest(
+                ledger,
+                os.path.join(workdir, "ledger", f"ch{ch}.chain"),
+            )
+            for ch, ledger in enumerate(ledgers)
+        }
+    finally:
+        for lg in ledgers:
+            lg.close()
+    with open(os.path.join(workdir, "digest.json"), "w") as f:
+        json.dump(digest, f, sort_keys=True, indent=1)
+    return 0
+
+
+def _digest(ledger: KVLedger, chain_path: str) -> Dict[str, object]:
+    """Everything the crash matrix byte-diffs: chain bytes, commit-hash
+    chain, stored VALID/INVALID masks, and the full derived state."""
+    out: Dict[str, object] = {
+        "height": ledger.height,
+        "commit_hash": ledger.commit_hash.hex(),
+        "savepoint": ledger.state_db.savepoint(),
+    }
+    with open(chain_path, "rb") as f:
+        out["chain_sha"] = hashlib.sha256(f.read()).hexdigest()
+    masks = hashlib.sha256()
+    for n in range(ledger.height):
+        block = ledger.block_store.get_block_by_number(n)
+        masks.update(
+            bytes(block.metadata.metadata[common_pb2.TRANSACTIONS_FILTER])
+        )
+    out["masks_sha"] = masks.hexdigest()
+    state = hashlib.sha256()
+    for ns, key, vv in ledger.state_db.iter_all_state():
+        state.update(
+            repr((ns, key, vv.value, vv.version.block_num, vv.version.tx_num)).encode()
+        )
+    out["state_sha"] = state.hexdigest()
+    hashed = hashlib.sha256()
+    for ns, coll, kh, vv in ledger.state_db.iter_all_hashed():
+        hashed.update(
+            repr((ns, coll, kh, vv.value, vv.version.block_num, vv.version.tx_num)).encode()
+        )
+    out["hashed_sha"] = hashed.hexdigest()
+    pvt = hashlib.sha256()
+    for ns, coll, key, vv in ledger.state_db.iter_all_pvt():
+        pvt.update(
+            repr((ns, coll, key, vv.value, vv.version.block_num, vv.version.tx_num)).encode()
+        )
+    out["pvt_sha"] = pvt.hexdigest()
+    return out
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="crashchild",
+        description="fabcrash subprocess peer: commit a block stream "
+        "(killable via FABRIC_TPU_CRASH_SITES) or recover + re-pull + "
+        "digest",
+    )
+    ap.add_argument("mode", choices=("commit", "recover"))
+    ap.add_argument("--dir", required=True, help="working directory (ledgers + digest)")
+    ap.add_argument("--stream", required=True, help="stream directory from build_stream")
+    args = ap.parse_args(argv)
+    os.makedirs(args.dir, exist_ok=True)
+    if args.mode == "commit":
+        return cmd_commit(args.dir, args.stream)
+    return cmd_recover(args.dir, args.stream)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -2352,6 +2352,329 @@ def run_raft_churn(seed: int, clock: StageClock, scale: float = 1.0):
     return det, {"message_drops": drops}
 
 
+# ---------------------------------------------------------------------------
+# fabcrash: deterministic process-kill matrix over the commit plane
+# ---------------------------------------------------------------------------
+
+#: every kill-eligible durability seam the crash matrix walks.  These
+#: literals double as the fabreg fault-site exercise proof — each one is
+#: a real fault_point site threaded through blockstore/kvledger/
+#: persistent/pipeline (see the README fault-point table).
+CRASH_SITES = (
+    "blockstore.append.pre_fsync",
+    "blockstore.append.post_fsync",
+    "blockstore.append.pre_index",
+    "kvledger.commit.pre_pvt",
+    "kvledger.commit.post_block",
+    "persistent.commit.mid",
+    "pipeline.commit",
+)
+
+
+def _run_crash_sites(seed: int, clock: StageClock, sites, scale: float):
+    """Shared crash-matrix driver: build a deterministic multi-channel
+    block stream, run a reference (no-crash) subprocess peer to digest
+    the converged state, then for each kill site SIGKILL-equivalent a
+    fresh peer mid-commit (os._exit at the armed fault point), restart
+    it, re-pull the missing blocks over the deliver failover path (a
+    deliver.pull flap is armed so failover is actually taken), and
+    require chain bytes + commit hash + VALID/INVALID masks + full
+    state/hashed/pvt digests byte-identical to the no-crash run."""
+    import os
+    import shutil
+    import subprocess
+    import tempfile
+
+    import fabric_tpu
+    from fabric_tpu.common.faults import KILL_EXIT_CODE
+    from fabric_tpu.tools import crashchild
+
+    n_channels = 3
+    n_blocks = max(5, int(6 * scale))
+    kill_block = max(2, n_blocks // 2)
+    repo_root = os.path.dirname(
+        os.path.dirname(os.path.abspath(fabric_tpu.__file__))
+    )
+    root = tempfile.mkdtemp(prefix="fabcrash_")
+    try:
+        stream = os.path.join(root, "stream")
+        crashchild.build_stream(
+            stream, seed=seed, n_channels=n_channels, n_blocks=n_blocks
+        )
+
+        base_env = {
+            k: v
+            for k, v in os.environ.items()
+            if not k.startswith("FABRIC_TPU_FAULTS")
+            and k != "FABRIC_TPU_CRASH_SITES"
+        }
+        base_env["PYTHONPATH"] = repo_root + os.pathsep + base_env.get(
+            "PYTHONPATH", ""
+        )
+
+        def child(mode: str, workdir: str, extra: Dict[str, str]):
+            env = dict(base_env)
+            env.update(extra)
+            return subprocess.run(
+                [
+                    sys.executable,
+                    "-m",
+                    "fabric_tpu.tools.crashchild",
+                    mode,
+                    "--dir",
+                    workdir,
+                    "--stream",
+                    stream,
+                ],
+                env=env,
+                capture_output=True,
+                text=True,
+                timeout=120,
+                cwd=repo_root,
+            )
+
+        ref_dir = os.path.join(root, "ref")
+        r = clock.timed("crash.reference_commit", child, "commit", ref_dir, {})
+        check(
+            r.returncode == 0,
+            f"reference commit run failed rc={r.returncode}",
+        )
+        r = child("recover", ref_dir, {})
+        check(
+            r.returncode == 0,
+            f"reference recover run failed rc={r.returncode}",
+        )
+        with open(os.path.join(ref_dir, "digest.json")) as fh:
+            ref_digest = json.load(fh)
+
+        per_site: Dict[str, Dict[str, object]] = {}
+        for site in sites:
+            workdir = os.path.join(root, site.replace(".", "_"))
+            r1 = clock.timed(
+                "crash.kill_run",
+                child,
+                "commit",
+                workdir,
+                {"FABRIC_TPU_CRASH_SITES": f"{site}@{kill_block}"},
+            )
+            check(
+                r1.returncode == KILL_EXIT_CODE,
+                f"{site}: kill run exited {r1.returncode}, want "
+                f"{KILL_EXIT_CODE}",
+            )
+            r2 = clock.timed(
+                "crash.restart_recover",
+                child,
+                "recover",
+                workdir,
+                {"FABRIC_TPU_FAULTS": "deliver.pull=raise:1.0:max=1"},
+            )
+            check(
+                r2.returncode == 0,
+                f"{site}: restart recovery failed rc={r2.returncode}",
+            )
+            with open(os.path.join(workdir, "digest.json")) as fh:
+                digest = json.load(fh)
+            check(
+                digest == ref_digest,  # fablint: disable=digest-compare  # JSON scorecard equality (convergence check), not a MAC comparison
+                f"{site}: restart state DIVERGED from the no-crash run "
+                f"(channels differing: "
+                f"{sorted(c for c in ref_digest if digest.get(c) != ref_digest[c])})",
+            )
+            per_site[site] = {"killed": True, "converged": True}
+
+        det = {
+            "channels": n_channels,
+            "blocks": n_blocks,
+            "kill_block": kill_block,
+            "sites": per_site,
+            "ref_digest_sha": hashlib.sha256(
+                json.dumps(ref_digest, sort_keys=True).encode()
+            ).hexdigest()[:16],
+        }
+        return det, {"sites_run": len(per_site)}
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+@scenario("crash_single")
+def run_crash_single(seed: int, clock: StageClock, scale: float = 1.0):
+    """Fast single-kill-site crash leg (the chaos_gate / tier-1 canary):
+    kill one subprocess peer at the block-durable/state-missing window
+    (kvledger.commit.post_block), restart, and byte-diff against the
+    no-crash run."""
+    return _run_crash_sites(
+        seed, clock, ("kvledger.commit.post_block",), scale
+    )
+
+
+@scenario("crash_matrix")
+def run_crash_matrix(seed: int, clock: StageClock, scale: float = 1.0):
+    """Full deterministic kill-point matrix: a subprocess peer commits a
+    multi-channel stream and is killed at EVERY durability seam in turn
+    (torn-tail truncation, state replay, pvt-guard redelivery, sqlite
+    WAL rollback all exercised); each restart must converge to chain
+    bytes, state commit-hash and validation masks byte-identical to the
+    no-crash same-seed run."""
+    return _run_crash_sites(seed, clock, CRASH_SITES, scale)
+
+
+@scenario("invalidation_storm")
+def run_invalidation_storm(seed: int, clock: StageClock, scale: float = 1.0):
+    """Resident-table invalidation storm (the ROADMAP fail-closed
+    headroom): a ResidentDeviceValidator streams blocks while the state
+    db is mutated BEHIND ITS BACK — rollback + re-commit between blocks,
+    a rebuild mid-stream, and one mutation landing between encode and
+    emit.  Every block's codes must match a fresh host oracle evaluated
+    against the LIVE db (zero stale-version reads), stale tables must be
+    dropped via the generation stamp (counted deterministically), and
+    the mid-block mutation must force the verdicts to re-resolve on the
+    host — never emitted from a dead table generation."""
+    from fabric_tpu.ledger.mvcc import Validator
+    from fabric_tpu.ledger.mvcc_device import ResidentDeviceValidator
+    from fabric_tpu.ledger.rwset import (
+        KVRead,
+        KVWrite,
+        NsRwSet,
+        TxRwSet,
+        Version,
+    )
+    from fabric_tpu.ledger.statedb import UpdateBatch, VersionedDB
+
+    rng = random.Random(seed * 1000003 + 6)
+    n_blocks = max(9, int(9 * scale))
+    keys = [f"k{i}" for i in range(10)]
+
+    db = VersionedDB()
+    # seed committed state
+    seed_batch = UpdateBatch()
+    for i, k in enumerate(keys):
+        seed_batch.put("cc", k, b"seed", Version(0, i))
+    db.apply_updates(seed_batch)
+
+    class _MidBlockMutator(ResidentDeviceValidator):
+        """Scenario-local seam: run a mutation after the encode pass
+        (slots assigned, launch imminent) — the window where only the
+        post-launch generation re-check can save the mask."""
+
+        mutate_after_encode = None
+
+        def _encode_resident(self, *args, **kwargs):
+            enc = super()._encode_resident(*args, **kwargs)
+            if self.mutate_after_encode is not None:
+                fn, self.mutate_after_encode = self.mutate_after_encode, None
+                fn()
+            return enc
+
+    res = _MidBlockMutator(db, capacity=64)
+
+    def behind_the_back_rollback(bn: int) -> None:
+        """Rollback + re-commit: rewrite a hot key's committed version
+        without going through the validator, then bump the generation
+        (the contract every out-of-band mutator carries)."""
+        batch = UpdateBatch()
+        batch.put("cc", keys[bn % len(keys)], b"rolled", Version(0, 90 + bn))
+        db.apply_updates(batch)
+        db.bump_generation()
+
+    def behind_the_back_rebuild(bn: int) -> None:
+        """rebuild_dbs analog: delete + rewrite several keys at new
+        versions, bump once."""
+        batch = UpdateBatch()
+        for i in range(0, len(keys), 2):
+            batch.put("cc", keys[i], b"rebuilt", Version(0, 70 + i))
+        batch.delete("cc", keys[1], Version(0, 60))
+        db.apply_updates(batch)
+        db.bump_generation()
+
+    mutate_between = {3: behind_the_back_rollback, 6: behind_the_back_rebuild}
+    mid_block_at = n_blocks - 1
+    expected_invalidations = len(mutate_between) + 1
+
+    codes_all: List[int] = []
+    device_blocks = 0
+    host_fallbacks = 0
+    for bn in range(1, n_blocks + 1):
+        rwsets = []
+        for t in range(12):
+            k = keys[min(int(rng.paretovariate(1.3)) - 1, len(keys) - 1)]
+            committed = db.get_version("cc", k)
+            stale = rng.random() < 0.25
+            claim = (
+                Version(committed.block_num, committed.tx_num + 1)
+                if (stale and committed is not None)
+                else committed
+            )
+            rwsets.append(
+                TxRwSet(
+                    (
+                        NsRwSet(
+                            "cc",
+                            (KVRead(k, claim),),
+                            (KVWrite(k, False, b"v%d" % bn),),
+                        ),
+                    )
+                )
+            )
+        incoming = [VALID] * len(rwsets)
+        if bn == mid_block_at:
+            res.mutate_after_encode = lambda: behind_the_back_rollback(99)
+        t0 = time.perf_counter()
+        res_codes, _res_up, _res_hup = res.validate_and_prepare_batch(
+            bn, rwsets, list(incoming)
+        )
+        clock.record("invalidation.block", time.perf_counter() - t0)
+        # ground truth: a fresh host oracle over the LIVE (possibly just
+        # mutated) db — any stale-table read diverges from this
+        host_codes, host_up, host_hup = Validator(db).validate_and_prepare_batch(
+            bn, rwsets, list(incoming)
+        )
+        check(
+            res_codes == host_codes,
+            f"block {bn}: resident codes diverged from live-state oracle "
+            f"(stale-version read served?) at indexes "
+            f"{[i for i, (a, b) in enumerate(zip(res_codes, host_codes)) if a != b][:8]}",
+        )
+        if bn == mid_block_at:
+            check(
+                res.last_path == "host",
+                "mid-block mutation did not force host re-resolution — "
+                "a mask was emitted from a dead table generation",
+            )
+            host_fallbacks += 1
+        else:
+            check(
+                res.last_path == "device",
+                f"block {bn}: expected the device-resident path",
+            )
+            device_blocks += 1
+        db.apply_updates(host_up, host_hup)
+        codes_all.extend(int(c) for c in res_codes)
+        if bn in mutate_between:
+            mutate_between[bn](bn)
+
+    check(
+        res.invalidations == expected_invalidations,
+        f"saw {res.invalidations} table invalidations, expected "
+        f"{expected_invalidations} (2 between-block + 1 mid-block)",
+    )
+    n_conflicts = sum(
+        1 for c in codes_all if c == int(TxValidationCode.MVCC_READ_CONFLICT)
+    )
+    check(n_conflicts > 0, "storm produced no conflicts — not a storm")
+    det = {
+        "blocks": n_blocks,
+        "txs": len(codes_all),
+        "mvcc_conflicts": n_conflicts,
+        "codes_sha": hashlib.sha256(bytes(codes_all)).hexdigest()[:16],
+        "invalidations": res.invalidations,
+        "device_blocks": device_blocks,
+        "mid_block_host_fallbacks": host_fallbacks,
+        "stale_reads_served": 0,
+    }
+    return det, {}
+
+
 #: the <60s CI smoke: fast, no process pools, no real sleeps
 SMOKE = (
     "verify_faults",

@@ -3,7 +3,11 @@
 # with the same seed and require
 #   1. both runs green (every scenario's mask bit-exact + fail-closed
 #      assertions hold under injected faults), and
-#   2. byte-identical deterministic scorecards (replayability gate).
+#   2. byte-identical deterministic scorecards (replayability gate),
+# then the fabcrash single-kill-site leg: a subprocess peer is killed
+# at a durability seam, restarted, and byte-diffed against the
+# no-crash run (the fast row of the crash matrix; the full matrix is
+# pytest-slow).
 set -uo pipefail
 
 cd "$(dirname "$0")/.."
@@ -11,7 +15,8 @@ cd "$(dirname "$0")/.."
 seed="${FABCHAOS_SEED:-7}"
 out1=$(mktemp /tmp/fabchaos.XXXXXX.json)
 out2=$(mktemp /tmp/fabchaos.XXXXXX.json)
-trap 'rm -f "$out1" "$out2"' EXIT
+out3=$(mktemp /tmp/fabchaos.XXXXXX.json)
+trap 'rm -f "$out1" "$out2" "$out3"' EXIT
 
 run() {
     # 25s per run keeps the two-run worst case inside the stage's <60s
@@ -34,8 +39,20 @@ if ! cmp -s "$out1" "$out2"; then
     diff "$out1" "$out2" >&2 || true
     exit 1
 fi
+
+# fabcrash leg: one kill site, subprocess kill + restart + byte-diff
+# (~5s: 4 child processes)
+if ! timeout -k 5 60 python -m fabric_tpu.tools.fabchaos \
+        --seed "$seed" --scenario crash_single --quiet > "$out3"; then
+    echo "chaos_gate: crash_single FAILED (seed $seed)" >&2
+    cat "$out3" >&2
+    exit 1
+fi
 echo "chaos_gate: OK (seed $seed, $(python -c "
 import json,sys
 card = json.load(open('$out1'))
-print(len(card['scenarios']), 'scenarios deterministic + green', end='')
+crash = json.load(open('$out3'))['scenarios']['crash_single']
+sites = ','.join(crash['sites'])
+print(len(card['scenarios']), 'scenarios deterministic + green;',
+      'crash_single converged at', sites, end='')
 "))"

@@ -1,0 +1,590 @@
+"""fabcrash — crash-consistent commit plane tests.
+
+Hand-corrupted stores through every repair/refuse rule of the
+checksummed-frame recovery (torn tail, half-written header, corrupted
+length prefix, checksum-valid garbage, mid-file damage), the
+blockstore-ahead /
+statedb-ahead recovery directions, double-recovery idempotence, the
+kill action + FABRIC_TPU_CRASH_SITES grammar, the resident-table
+generation stamp, and the subprocess kill canary.  The full
+crash_matrix lives here slow-marked; crash_single runs in tier-1 via
+tests/test_fabchaos.py's scenario sweep.
+"""
+
+import json
+import os
+import sqlite3
+import struct
+import subprocess
+import sys
+import zlib
+
+import pytest
+
+from fabric_tpu.common import faults
+from fabric_tpu.ledger.blockstore import BlockStore, LedgerCorruptionError
+from fabric_tpu.ledger.kvledger import KVLedger
+from fabric_tpu.protos import common_pb2, protoutil
+from fabric_tpu.tools import crashchild
+
+
+def make_block(number, prev_hash, payloads):
+    block = protoutil.new_block(number, prev_hash)
+    for p in payloads:
+        block.data.data.append(p)
+    return protoutil.seal_block(block)
+
+
+def store_with_blocks(path, n=2):
+    bs = BlockStore(path)
+    prev = b""
+    for i in range(n):
+        b = make_block(i, prev, [b"tx-%d" % i, b"x" * 64])
+        bs.add_block(b)
+        prev = protoutil.block_header_hash(b.header)
+    bs.close()
+    return prev
+
+
+def frame_offsets(path):
+    """[(offset, frame_end)] of every whole frame in a chain file
+    (u32 len + u32 hcrc + payload + u32 crc layout)."""
+    data = open(path, "rb").read()
+    out = []
+    off = 0
+    while off < len(data):
+        (ln,) = struct.unpack_from("<I", data, off)
+        end = off + 8 + ln + 4
+        out.append((off, end))
+        off = end
+    return out
+
+
+PAYLOAD_OFF = 12  # 8-byte header + a few bytes into the payload
+
+
+class TestBlockStoreRecovery:
+    def test_torn_partial_frame_truncated(self, tmp_path):
+        path = str(tmp_path / "ch.chain")
+        store_with_blocks(path, 2)
+        good = os.path.getsize(path)
+        from fabric_tpu.ledger.blockstore import frame_header
+        with open(path, "ab") as f:
+            f.write(frame_header(1000) + b"partial" * 10)  # short of 1000
+        bs = BlockStore(path)
+        assert bs.height == 2
+        assert bs.torn_tail_bytes > 0
+        assert os.path.getsize(path) == good
+        # and appending still works
+        prev = protoutil.block_header_hash(
+            bs.get_block_by_number(1).header
+        )
+        bs.add_block(make_block(2, prev, [b"c"]))
+        assert bs.height == 3
+        bs.close()
+
+    def test_half_written_header_truncated(self, tmp_path):
+        path = str(tmp_path / "ch.chain")
+        store_with_blocks(path, 1)
+        with open(path, "ab") as f:
+            f.write(b"\xff\x81")  # 2 of the 8 header bytes
+        bs = BlockStore(path)
+        assert bs.height == 1
+        assert bs.torn_tail_bytes == 2
+        bs.close()
+
+    def test_crc_corrupt_tail_frame_truncated(self, tmp_path):
+        """A checksum mismatch that reaches EOF is a torn tail: the last
+        block is dropped (re-pulled by the deliver plane), never served
+        damaged."""
+        path = str(tmp_path / "ch.chain")
+        store_with_blocks(path, 2)
+        frames = frame_offsets(path)
+        with open(path, "r+b") as f:
+            f.seek(frames[-1][0] + PAYLOAD_OFF)
+            byte = f.read(1)
+            f.seek(frames[-1][0] + PAYLOAD_OFF)
+            f.write(bytes([byte[0] ^ 0x5A]))
+        bs = BlockStore(path)
+        assert bs.height == 1
+        assert bs.torn_tail_bytes > 0
+        bs.close()
+
+    def test_crc_corrupt_mid_file_refuses(self, tmp_path):
+        """Damage with valid bytes AFTER it cannot be one interrupted
+        append: fail closed, do not silently truncate committed blocks."""
+        path = str(tmp_path / "ch.chain")
+        store_with_blocks(path, 2)
+        frames = frame_offsets(path)
+        with open(path, "r+b") as f:
+            f.seek(frames[0][0] + PAYLOAD_OFF)
+            byte = f.read(1)
+            f.seek(frames[0][0] + PAYLOAD_OFF)
+            f.write(bytes([byte[0] ^ 0x5A]))
+        with pytest.raises(LedgerCorruptionError):
+            BlockStore(path)
+
+    def test_corrupt_length_prefix_mid_file_refuses(self, tmp_path):
+        """A flipped bit inflating a mid-file frame's LENGTH would read
+        as a short frame and masquerade as a torn tail, silently
+        dropping every later committed block — the header checksum is
+        what catches it (review finding)."""
+        path = str(tmp_path / "ch.chain")
+        store_with_blocks(path, 2)
+        frames = frame_offsets(path)
+        with open(path, "r+b") as f:
+            f.seek(frames[0][0] + 1)  # inside frame 0's u32 length
+            byte = f.read(1)
+            f.seek(frames[0][0] + 1)
+            f.write(bytes([byte[0] ^ 0x40]))  # inflate the length
+        with pytest.raises(LedgerCorruptionError):
+            BlockStore(path)
+
+    def test_salvage_mode_truncates_instead(self, tmp_path, monkeypatch):
+        path = str(tmp_path / "ch.chain")
+        store_with_blocks(path, 2)
+        frames = frame_offsets(path)
+        with open(path, "r+b") as f:
+            f.seek(frames[0][0] + PAYLOAD_OFF)
+            byte = f.read(1)
+            f.seek(frames[0][0] + PAYLOAD_OFF)
+            f.write(bytes([byte[0] ^ 0x5A]))
+        monkeypatch.setenv("FABRIC_TPU_RECOVERY_STRICT", "0")
+        bs = BlockStore(path)  # operator-forced salvage
+        assert bs.height == 0
+        assert os.path.getsize(path) == 0
+        bs.close()
+
+    def test_checksum_valid_garbage_refuses(self, tmp_path):
+        """A frame that checksums clean but does not parse was fully
+        written — that is corruption, not a torn append."""
+        path = str(tmp_path / "ch.chain")
+        store_with_blocks(path, 1)
+        from fabric_tpu.ledger.blockstore import frame_header
+        garbage = b"\xff" * 24
+        with open(path, "ab") as f:
+            f.write(frame_header(len(garbage)))
+            f.write(garbage)
+            f.write(struct.pack("<I", zlib.crc32(garbage)))
+        with pytest.raises(LedgerCorruptionError):
+            BlockStore(path)
+
+    def test_empty_file_opens_clean(self, tmp_path):
+        path = str(tmp_path / "ch.chain")
+        open(path, "wb").close()
+        bs = BlockStore(path)
+        assert bs.height == 0 and bs.torn_tail_bytes == 0
+        bs.add_block(make_block(0, b"", [b"a"]))
+        bs.close()
+
+    def test_double_recovery_idempotent(self, tmp_path):
+        path = str(tmp_path / "ch.chain")
+        store_with_blocks(path, 2)
+        with open(path, "ab") as f:
+            f.write(b"\x40" + b"torn")
+        bs = BlockStore(path)
+        assert bs.torn_tail_bytes > 0
+        bs.close()
+        repaired = open(path, "rb").read()
+        bs2 = BlockStore(path)  # second recovery finds nothing to do
+        assert bs2.torn_tail_bytes == 0
+        assert bs2.height == 2
+        bs2.close()
+        assert open(path, "rb").read() == repaired
+
+    def test_read_detects_post_open_rot(self, tmp_path):
+        path = str(tmp_path / "ch.chain")
+        store_with_blocks(path, 1)
+        bs = BlockStore(path)
+        frames = frame_offsets(path)
+        with open(path, "r+b") as f:
+            f.seek(frames[0][0] + PAYLOAD_OFF)
+            byte = f.read(1)
+            f.seek(frames[0][0] + PAYLOAD_OFF)
+            f.write(bytes([byte[0] ^ 0x5A]))
+        with pytest.raises(LedgerCorruptionError):
+            bs.get_block_by_number(0)
+        bs.close()
+
+    def test_close_idempotent(self, tmp_path):
+        bs = BlockStore(str(tmp_path / "ch.chain"))
+        bs.close()
+        bs.close()
+
+    def test_failed_append_rolls_back_partial_frame(self, tmp_path):
+        """An injected raise (or a real ENOSPC/fsync error) mid-append
+        must not leave a partial frame for a redelivery retry to stack
+        a duplicate after — strict recovery would then refuse the
+        mid-file damage (review finding)."""
+        path = str(tmp_path / "ch.chain")
+        bs = BlockStore(path)
+        b0 = make_block(0, b"", [b"a" * 64])
+        bs.add_block(b0)
+        good = os.path.getsize(path)
+        b1 = make_block(
+            1, protoutil.block_header_hash(b0.header), [b"b" * 64]
+        )
+        plan = faults.FaultPlan.parse("blockstore.append.post_fsync=raise:max=1")
+        with faults.plan_installed(plan):
+            with pytest.raises(faults.InjectedFault):
+                bs.add_block(b1)
+        assert bs.height == 1
+        assert os.path.getsize(path) == good  # rolled back
+        bs.add_block(b1)  # redelivery retry succeeds cleanly
+        assert bs.height == 2
+        bs.close()
+        bs2 = BlockStore(path)  # and strict recovery has nothing to refuse
+        assert bs2.height == 2 and bs2.torn_tail_bytes == 0
+        bs2.close()
+
+
+# ---------------------------------------------------------------------------
+# KVLedger recovery directions (real endorsed blocks via the crash stream)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def stream(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("crashstream"))
+    crashchild.build_stream(d, seed=13, n_channels=1, n_blocks=4)
+    return d
+
+
+def commit_all(workdir, stream_dir):
+    meta, blocks, pvt = crashchild.load_stream(stream_dir)
+    ledger = KVLedger(os.path.join(workdir, "ledger"), "ch0")
+    for bn in range(meta["blocks"]):
+        ledger.commit(blocks[0][bn], pvt_data=pvt[0].get(bn))
+    return ledger
+
+
+def ledger_fingerprint(ledger):
+    return crashchild._digest(
+        ledger,
+        os.path.join(
+            os.path.dirname(ledger.state_db.path), "ch0.chain"
+        ),
+    )
+
+
+class TestKVLedgerRecovery:
+    def test_blockstore_ahead_full_replay(self, tmp_path, stream):
+        """Deleting the state db entirely (savepoint None) replays the
+        whole chain; the derived state converges to the no-crash twin."""
+        ref = commit_all(str(tmp_path / "ref"), stream)
+        want = ledger_fingerprint(ref)
+        ref.close()
+
+        crashed = commit_all(str(tmp_path / "crash"), stream)
+        crashed.close()
+        os.remove(os.path.join(str(tmp_path / "crash"), "ledger", "ch0.state.db"))
+        reopened = KVLedger(os.path.join(str(tmp_path / "crash"), "ledger"), "ch0")
+        got = ledger_fingerprint(reopened)
+        reopened.close()
+        assert got == want
+
+    def test_savepoint_rewind_idempotent_replay(self, tmp_path, stream):
+        """Rewinding the savepoint while keeping the rows replays blocks
+        over already-applied state — INSERT OR REPLACE idempotence must
+        converge to the same fingerprint."""
+        ref = commit_all(str(tmp_path / "ref"), stream)
+        want = ledger_fingerprint(ref)
+        ref.close()
+
+        crashed = commit_all(str(tmp_path / "crash"), stream)
+        crashed.close()
+        db_path = os.path.join(str(tmp_path / "crash"), "ledger", "ch0.state.db")
+        con = sqlite3.connect(db_path)
+        con.execute("UPDATE meta SET v=? WHERE k='savepoint'", (b"0",))
+        con.commit()
+        con.close()
+        reopened = KVLedger(os.path.join(str(tmp_path / "crash"), "ledger"), "ch0")
+        got = ledger_fingerprint(reopened)
+        reopened.close()
+        assert got == want
+
+    def test_statedb_ahead_refuses_then_salvages(
+        self, tmp_path, stream, monkeypatch
+    ):
+        """A chain truncated behind the state db's back cannot be
+        repaired forward: strict recovery refuses; RECOVERY_STRICT=0
+        rebuilds the derived state from the surviving chain."""
+        ledger = commit_all(str(tmp_path / "crash"), stream)
+        ledger.close()
+        chain = os.path.join(str(tmp_path / "crash"), "ledger", "ch0.chain")
+        offs = frame_offsets(chain)
+        with open(chain, "ab") as f:
+            f.truncate(offs[1][1])  # keep blocks 0..1, state has 0..3
+        with pytest.raises(LedgerCorruptionError):
+            KVLedger(os.path.join(str(tmp_path / "crash"), "ledger"), "ch0")
+
+        # the refused open must not leak handles: salvage works after
+        monkeypatch.setenv("FABRIC_TPU_RECOVERY_STRICT", "0")
+        salvaged = KVLedger(
+            os.path.join(str(tmp_path / "crash"), "ledger"), "ch0"
+        )
+        monkeypatch.delenv("FABRIC_TPU_RECOVERY_STRICT")
+        got = ledger_fingerprint(salvaged)
+        salvaged.close()
+
+        # reference twin that only ever committed 2 blocks
+        meta, blocks, pvt = crashchild.load_stream(stream)
+        ref = KVLedger(os.path.join(str(tmp_path / "ref2"), "ledger"), "ch0")
+        for bn in range(2):
+            ref.commit(blocks[0][bn], pvt_data=pvt[0].get(bn))
+        want = ledger_fingerprint(ref)
+        ref.close()
+        # the pvt store retained records above the salvage point, so the
+        # file digests legitimately differ; state/chain/masks must match
+        for key in ("height", "commit_hash", "chain_sha", "masks_sha",
+                    "state_sha", "hashed_sha", "savepoint"):
+            assert got[key] == want[key], key
+
+    def test_pvt_tail_lost_records_missing_markers(self, tmp_path, stream):
+        """A torn pvt tail whose block survived: recovery truncates the
+        record and registers missing-data markers so the reconciler can
+        re-fetch — the store never stays silently behind the chain."""
+        ledger = commit_all(str(tmp_path / "crash"), stream)
+        ledger.close()
+        pvt_path = os.path.join(
+            str(tmp_path / "crash"), "ledger", "ch0.pvtdata"
+        )
+        size = os.path.getsize(pvt_path)
+        with open(pvt_path, "ab") as f:
+            f.truncate(size - 3)  # tear the last record
+        reopened = KVLedger(os.path.join(str(tmp_path / "crash"), "ledger"), "ch0")
+        assert reopened.pvt_store.last_committed_block == 3
+        missing = reopened.pvt_store.get_missing_pvt_data()
+        assert 3 in missing and missing[3][0].collection == "secret"
+        # the on-block hashed writes were never lost
+        assert reopened.height == 4
+        reopened.close()
+
+    def test_close_idempotent(self, tmp_path, stream):
+        ledger = commit_all(str(tmp_path / "w"), stream)
+        ledger.close()
+        ledger.close()
+
+    def test_store_ctor_refusal_closes_earlier_stores(
+        self, tmp_path, stream, monkeypatch
+    ):
+        """A refusal raised from the pvt store CONSTRUCTOR (not
+        _recover) must still close the already-open block store, so the
+        documented retry-with-RECOVERY_STRICT=0 workflow works (review
+        finding)."""
+        ledger = commit_all(str(tmp_path / "crash"), stream)
+        ledger.close()
+        pvt_path = os.path.join(
+            str(tmp_path / "crash"), "ledger", "ch0.pvtdata"
+        )
+        offs = []
+        data = open(pvt_path, "rb").read()
+        off = 0
+        while off < len(data):
+            (ln,) = struct.unpack_from("<I", data, off)
+            offs.append(off)
+            off += 8 + ln + 4
+        with open(pvt_path, "r+b") as f:
+            f.seek(offs[0] + 12)  # payload of the FIRST record
+            byte = f.read(1)
+            f.seek(offs[0] + 12)
+            f.write(bytes([byte[0] ^ 0x5A]))
+        with pytest.raises(LedgerCorruptionError):
+            KVLedger(os.path.join(str(tmp_path / "crash"), "ledger"), "ch0")
+        monkeypatch.setenv("FABRIC_TPU_RECOVERY_STRICT", "0")
+        salvaged = KVLedger(
+            os.path.join(str(tmp_path / "crash"), "ledger"), "ch0"
+        )
+        assert salvaged.height == 4  # chain untouched by the pvt salvage
+        salvaged.close()
+
+    def test_nonpersistent_rebuild_carries_generation(self, tmp_path, stream):
+        meta, blocks, pvt = crashchild.load_stream(stream)
+        ledger = KVLedger(
+            os.path.join(str(tmp_path / "w"), "ledger"), "ch0",
+            persistent=False,
+        )
+        ledger.commit(blocks[0][0], pvt_data=pvt[0].get(0))
+        g0 = ledger.state_db.state_generation
+        ledger.rebuild_dbs()
+        assert ledger.state_db.state_generation > g0
+        ledger.close()
+
+    def test_snapshot_bootstrapped_pvt_gap_skips_missing_blocks(
+        self, tmp_path
+    ):
+        """The pvt-gap pre-loop must not dereference pre-snapshot blocks
+        the store does not hold (review finding): a bootstrapped ledger
+        whose pvt store is behind opens cleanly instead of crashing."""
+        from fabric_tpu.ledger.persistent import SqliteVersionedDB
+
+        ledger_dir = str(tmp_path / "ledger")
+        bs = BlockStore.bootstrap_from_snapshot(
+            os.path.join(ledger_dir, "ch0.chain"), height=2,
+            last_hash=b"\x01" * 32,
+        )
+        bs.close()
+        # state restored from the snapshot up to block 1; pvt store empty
+        db = SqliteVersionedDB(os.path.join(ledger_dir, "ch0.state.db"))
+        db.commit_block(
+            __import__(
+                "fabric_tpu.ledger.statedb", fromlist=["UpdateBatch"]
+            ).UpdateBatch(),
+            savepoint=1,
+        )
+        db.close()
+        ledger = KVLedger(ledger_dir, "ch0")
+        assert ledger.height == 2
+        ledger.close()
+
+
+# ---------------------------------------------------------------------------
+# kill action + crash-sites grammar
+# ---------------------------------------------------------------------------
+
+
+class TestKillAction:
+    def test_parse_kill_with_at(self):
+        plan = faults.FaultPlan.parse("a.b=kill:at=3:max=1")
+        (spec,) = plan.specs()
+        assert spec.action == "kill" and spec.at_key == 3 and spec.max_fires == 1
+
+    def test_at_key_gates_any_action(self):
+        plan = faults.FaultPlan.parse("a.b=raise:at=3")
+        assert plan.check("a.b", key=2) is None
+        assert plan.check("a.b", key=None) is None
+        assert plan.check("a.b", key=3).action == "raise"
+
+    def test_crash_specs_from_text(self):
+        specs = faults.crash_specs_from_text(
+            "blockstore.append.pre_fsync@3; kvledger.commit.pre_pvt"
+        )
+        assert [s.site for s in specs] == [
+            "blockstore.append.pre_fsync", "kvledger.commit.pre_pvt",
+        ]
+        assert specs[0].at_key == 3 and specs[1].at_key is None
+        assert all(s.action == "kill" and s.max_fires == 1 for s in specs)
+
+    def test_crash_specs_malformed_raises(self):
+        with pytest.raises(ValueError):
+            faults.crash_specs_from_text("@3")
+
+    def test_kill_exits_with_sigkill_code(self):
+        r = subprocess.run(
+            [
+                sys.executable,
+                "-c",
+                "from fabric_tpu.common import faults\n"
+                "faults.install_plan(faults.FaultPlan.parse('x=kill'))\n"
+                "faults.fault_point('x')\n"
+                "raise SystemExit(99)  # unreachable\n",
+            ],
+            capture_output=True,
+            timeout=60,
+        )
+        assert r.returncode == faults.KILL_EXIT_CODE
+
+    def test_env_crash_sites_merge_with_faults_plan(self):
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "from fabric_tpu.common import faults\n"
+             "plan = faults.active_plan()\n"
+             "sites = sorted(s.site for s in plan.specs())\n"
+             "print(sites)\n"],
+            capture_output=True, text=True, timeout=60,
+            env={**os.environ,
+                 "FABRIC_TPU_FAULTS": "deliver.pull=raise:0.5",
+                 "FABRIC_TPU_CRASH_SITES": "kvledger.commit.pre_pvt@2"},
+        )
+        assert r.returncode == 0, r.stderr
+        assert "deliver.pull" in r.stdout
+        assert "kvledger.commit.pre_pvt" in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# resident-table generation stamp
+# ---------------------------------------------------------------------------
+
+
+class TestGenerationStamp:
+    def test_sqlite_clear_bumps_generation(self, tmp_path):
+        from fabric_tpu.ledger.persistent import SqliteVersionedDB
+
+        db = SqliteVersionedDB(str(tmp_path / "s.db"))
+        g0 = db.state_generation
+        db.clear()
+        assert db.state_generation == g0 + 1
+        db.close()
+        db.close()  # idempotent
+
+    def test_kvledger_rebuild_bumps_generation(self, tmp_path, stream):
+        ledger = commit_all(str(tmp_path / "w"), stream)
+        g0 = ledger.state_db.state_generation
+        ledger.rebuild_dbs()
+        assert ledger.state_db.state_generation > g0
+        ledger.close()
+
+    def test_out_of_band_mutation_invalidates_resident_table(self):
+        jax = pytest.importorskip("jax")  # noqa: F841
+        from fabric_tpu.ledger import rwset as rw
+        from fabric_tpu.ledger.mvcc import Validator
+        from fabric_tpu.ledger.mvcc_device import ResidentDeviceValidator
+        from fabric_tpu.ledger.statedb import UpdateBatch, VersionedDB
+
+        VALID = __import__(
+            "fabric_tpu.common.txflags", fromlist=["TxValidationCode"]
+        ).TxValidationCode.VALID
+        db = VersionedDB()
+        seed = UpdateBatch()
+        seed.put("cc", "k0", b"seed", rw.Version(0, 0))
+        db.apply_updates(seed)
+        res = ResidentDeviceValidator(db, capacity=16)
+
+        b1 = [rw.TxRwSet((rw.NsRwSet(
+            "cc", (rw.KVRead("k0", rw.Version(0, 0)),),
+            (rw.KVWrite("k0", False, b"v1"),),
+        ),))]
+        codes, up, hup = res.validate_and_prepare_batch(1, b1, [VALID])
+        assert res.last_path == "device" and codes == [VALID]
+        db.apply_updates(up, hup)
+
+        # behind-the-back rollback + re-commit
+        ob = UpdateBatch()
+        ob.put("cc", "k0", b"rolled", rw.Version(0, 7))
+        db.apply_updates(ob)
+        db.bump_generation()
+
+        # a read claiming the table's (now dead) version must conflict,
+        # and one claiming the live version must pass
+        b2 = [
+            rw.TxRwSet((rw.NsRwSet(
+                "cc", (rw.KVRead("k0", rw.Version(1, 0)),), ()),)),
+            rw.TxRwSet((rw.NsRwSet(
+                "cc", (rw.KVRead("k0", rw.Version(0, 7)),), ()),)),
+        ]
+        codes2, _u, _h = res.validate_and_prepare_batch(
+            2, b2, [VALID, VALID]
+        )
+        host = Validator(db).validate_and_prepare_batch(
+            2, b2, [VALID, VALID]
+        )[0]
+        assert codes2 == host
+        assert res.invalidations == 1
+        assert res.last_path == "device"  # rebuilt table, live generation
+
+
+# ---------------------------------------------------------------------------
+# the full kill-point matrix (slow; crash_single is the tier-1 canary)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_crash_matrix_every_site_converges():
+    from fabric_tpu.tools.fabchaos import SCENARIOS, StageClock
+
+    det, obs = SCENARIOS["crash_matrix"](7, StageClock(), 1.0)
+    assert all(
+        entry["converged"] and entry["killed"]
+        for entry in det["sites"].values()
+    )
+    assert len(det["sites"]) == 7

@@ -27,8 +27,10 @@ def _no_leaked_plan():
 # idemix_storm spends ~45s of host-bignum world building per fresh
 # seed (scheme-oracle signing) even at scale 0.5 — slow-marked so
 # tier-1 keeps the budget; idemix mask parity stays covered there by
-# tests/test_hostbn.py's flavor differentials.
-_HEAVY = {"idemix_storm"}
+# tests/test_hostbn.py's flavor differentials.  crash_matrix spawns
+# ~16 subprocess peers (~10s/run); its one-site canary crash_single
+# stays in tier-1 (plus tests/test_crash.py's full-matrix slow test).
+_HEAVY = {"idemix_storm", "crash_matrix"}
 BOUNDED = [
     pytest.param(n, marks=pytest.mark.slow) if n in _HEAVY else n
     for n in SCENARIOS

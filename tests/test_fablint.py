@@ -408,4 +408,6 @@ def test_toolkit_port_changed_nothing():
         "mutable-default", "shell-injection",
     ]
     _findings, stats = fablint.lint_paths([str(REPO_ROOT / "fabric_tpu")])
-    assert stats["suppressed"] == 19
+    # 19 from the PR 11 port + the PR 13 fabcrash digest-compare
+    # suppression (JSON scorecard equality, not a MAC)
+    assert stats["suppressed"] == 20

@@ -49,13 +49,16 @@ class TestBlockStore:
         assert bs2.get_block_by_number(1).data.data[0] == b"b"
 
     def test_crash_recovery_truncates_partial_tail(self, tmp_path):
+        from fabric_tpu.ledger.blockstore import frame_header
+
         path = str(tmp_path / "ch.chain")
         bs = BlockStore(path)
         b0 = make_block(0, b"", [b"a"])
         bs.add_block(b0)
         bs.close()
         with open(path, "ab") as f:
-            f.write(b"\x50partial-write-from-a-crash")
+            # a torn append: valid header, payload cut off mid-write
+            f.write(frame_header(500) + b"partial-write-from-a-crash")
         bs2 = BlockStore(path)
         assert bs2.height == 1
         # and appending still works

@@ -123,9 +123,12 @@ def test_pvtdata_recovery_drops_torn_tail(tmp_path):
     store.commit(0, [good])
     store.close()
     size_after_good = os.path.getsize(path)
-    # simulate a crash mid-append: a frame claiming more bytes than exist
+    # simulate a crash mid-append: a torn record (valid header-checksummed
+    # length prefix, body cut off short of the claimed 1000 bytes)
+    from fabric_tpu.ledger.blockstore import frame_header
+
     with open(path, "ab") as f:
-        f.write((1000).to_bytes(4, "little") + b"partial body")
+        f.write(frame_header(1000) + b"partial body")
     again = PvtDataStore(path)
     assert again.get_pvt_data(0, 0) == [good]
     assert os.path.getsize(path) == size_after_good  # tail trimmed
