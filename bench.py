@@ -1121,6 +1121,17 @@ def bench_fleet(device_ok=True, n_peers=None, requests_per_peer=None):
             "mask_mismatches": sum(p["mask_mismatches"] for p in peers),
             "busy_rejects": sum(p["busy_rejects"] for p in peers),
             "degraded_peers": sum(1 for p in peers if p["degraded"]),
+            # tail-tolerance counters (fabtail): the soak quantifies
+            # hedge/deadline/eviction behavior, not just throughput
+            "hedges": sum(p.get("hedges", 0) for p in peers),
+            "hedge_wins": sum(p.get("hedge_wins", 0) for p in peers),
+            "deadline_expired": sum(
+                p.get("deadline_expired", 0) for p in peers
+            ),
+            "slow_evictions": sum(
+                p.get("slow_evictions", 0) for p in peers
+            ),
+            "server_deadline_shed": server.stats.summary()["deadline_shed"],
             "per_peer": peers,
             "per_class_p99_ms": {
                 cls: row["latency"].get("p99_ms")

@@ -104,6 +104,33 @@ ENV_VARS: Tuple[EnvVar, ...] = (
         "for in-flight verify requests to settle with real verdicts "
         "before the sidecar exits (malformed values fall back)",
     ),
+    EnvVar(
+        "FABRIC_TPU_SERVE_DEADLINE_MS", "int", "0 (no deadline)",
+        "serve/client.py deadline_ms_from_env (read by SidecarProvider "
+        "and serve/router.py SidecarRouter)",
+        "per-batch wire latency budget (protocol rev 3): every per-hop "
+        "wait — reply wait, busy-retry pacing, hedge polling — derives "
+        "from the remaining budget, the server sheds provably-"
+        "unfinishable work ST_BUSY, and an expired budget hands the "
+        "batch to the in-process ladder (malformed values disable the "
+        "knob)",
+    ),
+    EnvVar(
+        "FABRIC_TPU_SERVE_HEDGE_FRACTION", "float", "0.05",
+        "serve/router.py hedge_fraction_from_env",
+        "global hedge budget: extra (hedged) requests as a fraction of "
+        "primary requests, enforced by a count-based token bucket so "
+        "hedging can never amplify an overloaded fleet into collapse "
+        "(0 disables hedging; malformed values fall back)",
+    ),
+    EnvVar(
+        "FABRIC_TPU_SERVE_HEDGE_MIN_MS", "float", "20",
+        "serve/router.py hedge_min_ms_from_env",
+        "floor on the per-endpoint hedge delay (the delay itself is "
+        "2x the endpoint's observed p95, never a static knob): below "
+        "this a hedge would race ordinary jitter, not a gray failure "
+        "(malformed values fall back)",
+    ),
     # -- device kernels -------------------------------------------------
     EnvVar(
         "FABRIC_TPU_KERNEL_VARIANT", "enum(inline|micro|microcond|auto)",

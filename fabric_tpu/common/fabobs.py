@@ -197,6 +197,34 @@ CANONICAL_METRICS: Tuple[MetricSpec, ...] = (
         "router endpoint health (1 = in rotation, 0 = evicted/cooling)",
         "serve/router.py _Endpoint",
     ),
+    # -- tail tolerance (fabtail: serve/router.py, serve/server.py,
+    #    serve/client.py) ----------------------------------------------
+    MetricSpec(
+        "fabric_serve_hedges_total", "counter", (),
+        "hedged requests fired at a second endpoint after the primary "
+        "stayed silent past its learned hedge delay",
+        "serve/router.py _await_hedged",
+    ),
+    MetricSpec(
+        "fabric_serve_hedge_wins_total", "counter", (),
+        "hedges whose verdict arrived before the primary's (the loser "
+        "is cancelled best-effort via OP_CANCEL)",
+        "serve/router.py _await_hedged",
+    ),
+    MetricSpec(
+        "fabric_serve_deadline_expired_total", "counter", ("seam",),
+        "wire-deadline budgets that ran out (serve.server = provably-"
+        "unfinishable work shed ST_BUSY; serve.client / serve.router = "
+        "batches handed back to the in-process ladder)",
+        "serve/server.py ServeStats, serve/client.py, serve/router.py",
+    ),
+    MetricSpec(
+        "fabric_serve_slow_evictions_total", "counter", ("endpoint",),
+        "gray-failure evictions: endpoints alive but latency outliers "
+        "(EWMA far above the fleet best, or consecutive lost hedges) "
+        "pulled from rotation through the cooldown ladder",
+        "serve/router.py _evict_slow",
+    ),
     MetricSpec(
         "fabric_serve_bucket_warm_ms", "gauge", ("bucket",),
         "per-bucket warm wall ms (registry warm report)",

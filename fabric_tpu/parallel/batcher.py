@@ -59,10 +59,11 @@ logger = must_get_logger("batcher")
 class _Request:
     __slots__ = (
         "keys", "sigs", "digests", "event", "result", "error", "permits",
-        "t_submit", "on_dispatch",
+        "t_submit", "on_dispatch", "deadline_s",
     )
 
-    def __init__(self, keys, sigs, digests, on_dispatch=None):
+    def __init__(self, keys, sigs, digests, on_dispatch=None,
+                 deadline_s=None):
         self.keys = keys
         self.sigs = sigs
         self.digests = digests
@@ -75,6 +76,12 @@ class _Request:
         # (dispatcher pickup) — the serve sidecar's per-class QoS
         # ledger mirrors the batcher's admission window through it
         self.on_dispatch = on_dispatch
+        # wire-deadline discipline (serve protocol rev 3): the absolute
+        # time.monotonic() moment this request's budget expires, or
+        # None.  The dispatcher caps its coalescing linger by the
+        # TIGHTEST deadline in the batch — lanes with a live budget are
+        # launched, never lingered past it.
+        self.deadline_s = deadline_s
 
     def resolve(self) -> List[bool]:
         self.event.wait()
@@ -206,6 +213,7 @@ class VerifyBatcher:
         signatures: Sequence[bytes],
         digests: Sequence[bytes],
         on_dispatch: Optional[Callable[[], None]] = None,
+        deadline_s: Optional[float] = None,
     ) -> Optional[Callable[[], List[bool]]]:
         """Non-blocking admission (the serve sidecar's front door): the
         resolver when the lane budget admits the request NOW, else None
@@ -213,9 +221,12 @@ class VerifyBatcher:
         instead of stalling a socket thread on the condition variable.
         ``on_dispatch`` fires when the dispatcher picks the request up
         (the moment its lane permits are released) — callers keeping a
-        parallel admission ledger release theirs in the same window."""
+        parallel admission ledger release theirs in the same window.
+        ``deadline_s`` (absolute ``time.monotonic()``) caps how long the
+        dispatcher may linger this request for coalescing company."""
         return self._admit(
-            keys, signatures, digests, block=False, on_dispatch=on_dispatch
+            keys, signatures, digests, block=False, on_dispatch=on_dispatch,
+            deadline_s=deadline_s,
         )
 
     def _admit(
@@ -225,6 +236,7 @@ class VerifyBatcher:
         digests: Sequence[bytes],
         block: bool,
         on_dispatch: Optional[Callable[[], None]] = None,
+        deadline_s: Optional[float] = None,
     ) -> Optional[Callable[[], List[bool]]]:
         n = len(keys)
         if n == 0:
@@ -238,7 +250,7 @@ class VerifyBatcher:
         # can't demand more lanes than exist.
         req = _Request(
             list(keys), list(signatures), list(digests),
-            on_dispatch=on_dispatch,
+            on_dispatch=on_dispatch, deadline_s=deadline_s,
         )
         req.permits = min(n, self._max_pending_lanes)
         with self._lanes_cv:
@@ -283,7 +295,7 @@ class VerifyBatcher:
             # request, overlapping in flight (admission control already
             # happened at submit)
             return batch
-        deadline = (
+        waiter = (
             threading.Event()
         )  # fresh event as a precise, interruptible sleep
         while lanes < self.max_batch:
@@ -292,7 +304,19 @@ class VerifyBatcher:
             except queue.Empty:
                 if lanes >= self.max_batch // 2:
                     break  # big enough: don't trade latency for lanes
-                deadline.wait(self.linger_s)
+                # the linger window respects the TIGHTEST wire deadline
+                # in the batch: a budgeted request is dispatched, never
+                # lingered past the moment its client walks away
+                linger = self.linger_s
+                tightest = min(
+                    (r.deadline_s for r in batch
+                     if r.deadline_s is not None),
+                    default=None,
+                )
+                if tightest is not None:
+                    linger = min(linger, tightest - time.monotonic())
+                if linger > 0:
+                    waiter.wait(linger)
                 try:
                     nxt = self._q.get_nowait()
                 except queue.Empty:

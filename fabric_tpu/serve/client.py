@@ -25,6 +25,7 @@ tier for):
 from __future__ import annotations
 
 import os
+import select
 import threading
 import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
@@ -99,7 +100,10 @@ class SidecarClient:
         sock = _socket.socket(family, _socket.SOCK_STREAM)
         sock.settimeout(self.connect_timeout_s)
         sock.connect(target)
-        sock.settimeout(self.request_timeout_s)
+        # the hello stays on the CONNECT budget: it is one tiny
+        # round-trip, and a gray endpoint that accepts but never
+        # answers must stall a dialer (and the router's probe path)
+        # for seconds, not the full request timeout
         return self._hello(sock, family, target)
 
     def _hello(self, sock, family, target):
@@ -127,6 +131,8 @@ class SidecarClient:
                             payload
                         )
                         if status == proto.ST_OK:
+                            # negotiated: switch to the request budget
+                            sock.settimeout(self.request_timeout_s)
                             return sock
                     # it answered SOMETHING that is not an acceptance:
                     # the refusing server's one error frame
@@ -151,12 +157,14 @@ class SidecarClient:
                 raise SidecarUnavailable(
                     f"hello refused at protocol v{self.version}"
                 )
+            # step DOWN one revision per refusal (v3 -> v2 -> v1): a
+            # v2 server costs a v3 client only the deadline/cancel
+            # fields, never the QoS class it still understands
             with self._state_lock:
-                self.version = proto.MIN_PROTOCOL_VERSION
+                self.version -= 1
             sock = _socket.socket(family, _socket.SOCK_STREAM)
             sock.settimeout(self.connect_timeout_s)
             sock.connect(target)
-            sock.settimeout(self.request_timeout_s)
 
     def _ensure_sock(self):
         with self._state_lock:
@@ -229,11 +237,37 @@ class SidecarClient:
                 raise SidecarUnavailable(f"send: {exc}") from exc
         return token
 
-    def await_reply(self, token: int) -> bytes:
+    def await_reply(
+        self, token: int, timeout_s: Optional[float] = None
+    ) -> bytes:
         """Block until the token's response payload arrives (cooperative
         demux: whichever waiter holds the recv lock reads frames and
-        settles the tokens they answer)."""
-        deadline = time.monotonic() + self.request_timeout_s
+        settles the tokens they answer).  ``timeout_s`` overrides the
+        connection default — the wire-deadline discipline derives every
+        per-hop wait from the request's remaining budget instead of one
+        static constant."""
+        if timeout_s is None:
+            timeout_s = self.request_timeout_s
+        out = self._demux_wait(
+            token, time.monotonic() + max(0.0, timeout_s), give_up=True
+        )
+        assert out is not None  # give_up=True raises instead
+        return out
+
+    def poll_reply(self, token: int, wait_s: float) -> Optional[bytes]:
+        """Bounded, NON-consuming wait: the token's payload if it
+        settles within ``wait_s``, else None with the token still
+        pending — the hedged-verification primitive (the router polls
+        the primary for one hedge delay, then keeps both the primary
+        and the hedge in flight, first verdict wins).  Raises
+        SidecarUnavailable only on real transport failure."""
+        return self._demux_wait(
+            token, time.monotonic() + max(0.0, wait_s), give_up=False
+        )
+
+    def _demux_wait(
+        self, token: int, deadline: float, give_up: bool
+    ) -> Optional[bytes]:
         while True:
             with self._state_lock:
                 entry = self._pending.get(token)
@@ -245,20 +279,20 @@ class SidecarClient:
                 if entry["error"] is not None:
                     raise entry["error"]
                 return entry["reply"]
-            got_lock = self._recv_lock.acquire(timeout=0.1)
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                if not give_up:
+                    return None  # token stays pending (hedge polling)
+                # give up on THIS token only: the connection may be
+                # healthy and another waiter mid-demux — tearing it
+                # down would discard that waiter's nearly-done
+                # server-side work.  A late reply for this token is
+                # dropped by the demux's gave-up branch below.
+                with self._state_lock:
+                    self._pending.pop(token, None)
+                raise SidecarUnavailable("reply timeout")
+            got_lock = self._recv_lock.acquire(timeout=min(remaining, 0.1))
             if not got_lock:
-                if time.monotonic() > deadline:
-                    # give up on THIS token only: the demux holder is
-                    # legitimately blocked on a slower request, and the
-                    # connection is still healthy — tearing it down
-                    # would discard the holder's nearly-done server-side
-                    # work.  A late reply for this token is dropped by
-                    # the holder's gave-up branch below.  (A truly dead
-                    # sidecar is caught by the HOLDER's own socket
-                    # timeout, which does fail all waiters.)
-                    with self._state_lock:
-                        self._pending.pop(token, None)
-                    raise SidecarUnavailable("reply timeout")
                 continue
             try:
                 if entry["event"].is_set():
@@ -266,6 +300,16 @@ class SidecarClient:
                 sock = self._sock
                 if sock is None:
                     raise SidecarUnavailable("connection lost")
+                # select before recv: the demux holder must honor ITS
+                # deadline without consuming partial frames — a recv
+                # timeout mid-frame would desync the stream, a select
+                # timeout touches nothing (how a tight budget walks
+                # away from a dead-slow socket instead of parking on it)
+                readable, _, _ = select.select(
+                    [sock], [], [], min(remaining, 0.1)
+                )
+                if not readable:
+                    continue
                 try:
                     frame = proto.recv_frame(sock)
                 except (OSError, proto.ProtocolError) as exc:
@@ -284,8 +328,35 @@ class SidecarClient:
             finally:
                 self._recv_lock.release()
 
-    def request(self, opcode: int, payload: bytes = b"") -> bytes:
-        return self.await_reply(self.submit(opcode, payload))
+    def cancel(self, token: int) -> None:
+        """Best-effort abandon of an in-flight request: the local waiter
+        state is dropped NOW (a late reply falls into the demux's
+        gave-up branch), and on a rev-3 connection an OP_CANCEL frame
+        tells the server to shed or stop replying.  The frame goes out
+        even when the token is no longer pending — a reply-timeout
+        give-up already popped it, and THAT is exactly the abandonment
+        the server should hear about.  Never raises — a cancel races
+        the settlement by design, and both orders are correct (the
+        reply is either suppressed server-side or dropped
+        client-side)."""
+        with self._state_lock:
+            self._pending.pop(token, None)
+            sock = self._sock
+        if sock is None or self.version < 3:
+            return
+        try:
+            with self._send_lock:
+                proto.send_frame(
+                    sock, proto.OP_CANCEL, token, b"", version=self.version
+                )
+        except OSError as exc:
+            logger.debug("cancel frame for token %d failed: %s", token, exc)
+
+    def request(
+        self, opcode: int, payload: bytes = b"",
+        timeout_s: Optional[float] = None,
+    ) -> bytes:
+        return self.await_reply(self.submit(opcode, payload), timeout_s)
 
     def ensure_connected(self) -> None:
         """Dial (and version-hello) now if not connected.  Callers that
@@ -294,9 +365,12 @@ class SidecarClient:
         self._ensure_sock()
 
     # -- typed helpers -----------------------------------------------------
-    def ping(self) -> bool:
+    def ping(self, timeout_s: Optional[float] = None) -> bool:
+        """Liveness probe.  ``timeout_s`` matters: a health probe that
+        rides the full request timeout lets one gray endpoint stall the
+        whole probe path — the router passes its own short budget."""
         status, _, _, _ = proto.decode_verify_response(
-            self.request(proto.OP_PING)
+            self.request(proto.OP_PING, timeout_s=timeout_s)
         )
         return status == proto.ST_OK
 
@@ -309,17 +383,37 @@ class SidecarClient:
         self.request(proto.OP_SHUTDOWN)
 
 
+def deadline_ms_from_env() -> int:
+    """``FABRIC_TPU_SERVE_DEADLINE_MS`` -> per-batch latency budget in
+    milliseconds (0/unset = no deadline; the shared env read
+    discipline: malformed values warn and disable the knob, never break
+    a verify path)."""
+    raw = os.environ.get("FABRIC_TPU_SERVE_DEADLINE_MS", "")
+    if not raw:
+        return 0
+    try:
+        return max(0, int(raw))
+    except ValueError:
+        logger.warning(
+            "FABRIC_TPU_SERVE_DEADLINE_MS=%r ignored (not an int)", raw
+        )
+        return 0
+
+
 def encode_lanes(
     keys: Sequence, signatures: Sequence[bytes], digests: Sequence[bytes],
     qos_class: Optional[int] = proto.DEFAULT_QOS, channel: str = "",
+    deadline_ms: Optional[int] = None,
+    version: int = proto.PROTOCOL_VERSION,
 ) -> bytes:
     """Provider lanes -> wire payload, deduplicating repeated key
     objects (the MSP cache reuses them) into the frame's key table.  A
     key that cannot serialize maps to NO_KEY — the server verifies that
-    lane False, same as the in-process parse path.  The default body is
-    the protocol-rev-2 layout (QoS prefix, matching SidecarClient's
-    default frame revision); pass ``qos_class=None`` for the v1 body a
-    v1-latched connection must send."""
+    lane False, same as the in-process parse path.  ``version`` picks
+    the body layout, which MUST match the frame revision the payload
+    rides on: the default is the current revision (deadline_ms 0 = no
+    budget), and ``qos_class=None`` forces the v1 body a v1-latched
+    connection must send."""
     from fabric_tpu.common import p256
 
     table: List[bytes] = []
@@ -342,8 +436,16 @@ def encode_lanes(
                     table.append(raw)
                     index_of[id(key)] = idx
         lanes.append((idx, bytes(sig), bytes(digest)))
+    if qos_class is None:
+        version = 1  # explicit v1-body request (legacy calling style)
     return proto.encode_verify_request(
-        table, lanes, qos_class=qos_class, channel=channel
+        table, lanes,
+        qos_class=qos_class if version >= 2 else None,
+        channel=channel,
+        deadline_ms=(
+            (deadline_ms if deadline_ms is not None else 0)
+            if version >= 3 else None
+        ),
     )
 
 
@@ -362,6 +464,7 @@ class SidecarProvider:
         sleeper: Callable[[float], None] = time.sleep,
         qos_class: Optional[int] = None,
         channel: str = "",
+        deadline_ms: Optional[int] = None,
     ):
         address = address or os.environ.get("FABRIC_TPU_SERVE_ADDR", "")
         if not address:
@@ -376,6 +479,13 @@ class SidecarProvider:
         self._fallback_lock = threading.Lock()
         self.degraded = False  # latched: any request served in-process
         self.busy_rejects = 0  # admission rejections observed
+        self.deadline_expired = 0  # budgets that ran out before a verdict
+        # per-batch latency budget (wire deadline, protocol rev 3):
+        # every per-hop wait — reply wait, busy-retry pacing — derives
+        # from the remaining budget; 0 = no deadline (legacy behavior)
+        self.deadline_ms = (
+            deadline_ms if deadline_ms is not None else deadline_ms_from_env()
+        )
         # admission class for protocol rev 2: explicit class wins, else
         # the FABRIC_TPU_SERVE_QOS channel map, else the wire default
         self.channel = channel
@@ -385,15 +495,41 @@ class SidecarProvider:
             qos_class = class_for_channel(channel, qos_map_from_env())
         self.qos_class = qos_class
 
-    def _encode(self, keys, signatures, digests) -> bytes:
+    def _encode(
+        self, keys, signatures, digests,
+        remaining_s: Optional[float] = None,
+    ) -> bytes:
         """Lane payload at the negotiated revision: the QoS prefix is
-        only emitted once the client knows the server speaks v2."""
-        if self.client.version >= 2:
-            return encode_lanes(
-                keys, signatures, digests,
-                qos_class=self.qos_class, channel=self.channel,
-            )
-        return encode_lanes(keys, signatures, digests, qos_class=None)
+        only emitted once the client knows the server speaks v2, the
+        deadline field once it speaks v3 (carrying the budget REMAINING
+        at encode time — floored at 1ms so a nearly-spent budget never
+        decodes as 'no deadline' — or 0 when no budget is set)."""
+        return encode_lanes(
+            keys, signatures, digests,
+            qos_class=self.qos_class, channel=self.channel,
+            deadline_ms=(
+                max(1, int(remaining_s * 1000.0))
+                if remaining_s is not None else 0
+            ),
+            version=self.client.version,
+        )
+
+    def _deadline(self) -> Optional[float]:
+        """Absolute monotonic deadline for a batch entering now, or
+        None when no budget is configured."""
+        if not self.deadline_ms:
+            return None
+        return time.monotonic() + self.deadline_ms / 1000.0
+
+    def _expire(self, keys, signatures, digests, why: str) -> List[bool]:
+        """Budget ran out: hand the batch back to the in-process ladder
+        NOW instead of parking on a dead-slow socket (the mask stays
+        bit-exact through the same degrade path)."""
+        self.deadline_expired += 1  # GIL-atomic add, stats only
+        fabobs.obs_count(
+            "fabric_serve_deadline_expired_total", seam="serve.client"
+        )
+        return self._degrade(keys, signatures, digests, why)
 
     # -- in-process fallback ----------------------------------------------
     def fallback_provider(self):
@@ -437,28 +573,74 @@ class SidecarProvider:
             return [False] * len(keys)
 
     # -- the remote verify loop -------------------------------------------
-    def _verify_once(self, payload: bytes) -> Tuple[int, int, Optional[List[bool]], str]:
-        return proto.decode_verify_response(
-            self.client.request(proto.OP_VERIFY, payload)
-        )
+    def _verify_once(
+        self, payload: bytes, timeout_s: Optional[float] = None
+    ) -> Tuple[int, int, Optional[List[bool]], str]:
+        token = self.client.submit(proto.OP_VERIFY, payload)
+        try:
+            return proto.decode_verify_response(
+                self.client.await_reply(token, timeout_s)
+            )
+        except SidecarUnavailable:
+            # abandoning the wait (budget/timeout) must TELL the
+            # server: an uncancelled tight-deadline batch would make
+            # the slow sidecar compute a verdict nobody will read —
+            # exactly the capacity OP_CANCEL exists to reclaim
+            self.client.cancel(token)
+            raise
 
     def batch_verify(
         self, keys, signatures, digests
     ) -> List[bool]:
+        return self._batch_verify(keys, signatures, digests,
+                                  self._deadline())
+
+    def _batch_verify(
+        self, keys, signatures, digests, deadline: Optional[float]
+    ) -> List[bool]:
+        """The verify loop against an ALREADY-STARTED budget: the async
+        resolver re-enters here with its original deadline, so a
+        busy/error resolve can never restart the per-batch clock."""
         n = len(keys)
         if n == 0:
             return []
         t0 = time.perf_counter()
         bo = Backoff(self.busy_policy, sleeper=self._sleeper)
         while True:
+            remaining: Optional[float] = None
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return self._expire(
+                        keys, signatures, digests, "deadline budget expired"
+                    )
             try:
                 # connect (and hello) BEFORE encoding: the QoS prefix
                 # is only valid at the negotiated revision, and a retry
                 # after a reconnect may have latched a different one
                 self.client.ensure_connected()
-                payload = self._encode(keys, signatures, digests)
-                status, retry_ms, mask, message = self._verify_once(payload)
+                if deadline is not None:
+                    # re-derive AFTER the dial: a reconnect can eat
+                    # seconds, and both the reply wait and the budget
+                    # advertised on the wire must reflect what is
+                    # genuinely left, not the loop-top snapshot
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return self._expire(
+                            keys, signatures, digests,
+                            "deadline expired during connect",
+                        )
+                payload = self._encode(keys, signatures, digests, remaining)
+                status, retry_ms, mask, message = self._verify_once(
+                    payload, remaining
+                )
             except (SidecarUnavailable, proto.ProtocolError) as exc:
+                if deadline is not None and time.monotonic() >= deadline:
+                    # the BUDGET, not the transport, gave out: the
+                    # reply wait was derived from the remaining budget,
+                    # and its expiry hands the batch back (failover/
+                    # degrade) instead of parking on a dead-slow socket
+                    return self._expire(keys, signatures, digests, exc)
                 # a reply body that decodes to garbage (version skew,
                 # truncation) is as unusable as a dead socket: degrade,
                 # never let the exception escape past the mask contract
@@ -484,17 +666,42 @@ class SidecarProvider:
                     return self._degrade(
                         keys, signatures, digests, "admission budget spent"
                     )
+                if deadline is not None:
+                    # the BUSY pacing budget is capped by the request's
+                    # REMAINING wire deadline: a tight-deadline batch
+                    # fails over to the in-process ladder instead of
+                    # sleeping its whole budget away in admission retry
+                    remaining = deadline - time.monotonic()
+                    if delay >= remaining:
+                        return self._expire(
+                            keys, signatures, digests,
+                            "deadline expired during admission backoff",
+                        )
                 bo.sleep()
                 # honor the sidecar's patience hint, but clamp it to our
                 # own policy cap: retry_after_ms is a u32 off the wire and
-                # must never buy a server-controlled unbounded sleep
+                # must never buy a server-controlled unbounded sleep —
+                # and never more of the remaining deadline than exists
                 hint_s = min(retry_ms / 1000.0, self.busy_policy.cap_s)
+                if deadline is not None:
+                    hint_s = min(
+                        hint_s, max(0.0, deadline - time.monotonic())
+                    )
                 if hint_s > delay:
                     self._sleeper(hint_s - delay)
                 continue
             if status == proto.ST_ERROR:
                 # transient per-request failure (injected fault, launch
-                # error): bounded retry like BUSY, then degrade
+                # error): bounded retry like BUSY, then degrade — the
+                # same remaining-budget cap as the BUSY leg
+                delay = bo.next_delay()
+                if delay is not None and deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if delay >= remaining:
+                        return self._expire(
+                            keys, signatures, digests,
+                            "deadline expired during error backoff",
+                        )
                 if bo.sleep():
                     continue
                 return self._degrade(keys, signatures, digests, message)
@@ -512,9 +719,13 @@ class SidecarProvider:
         if n == 0:
             return list
         t0 = time.perf_counter()
+        deadline = self._deadline()
         try:
             self.client.ensure_connected()
-            payload = self._encode(keys, signatures, digests)
+            payload = self._encode(
+                keys, signatures, digests,
+                None if deadline is None else deadline - time.monotonic(),
+            )
             token = self.client.submit(proto.OP_VERIFY, payload)
         except (proto.ProtocolError, SidecarUnavailable) as exc:
             why = exc
@@ -525,11 +736,25 @@ class SidecarProvider:
             return degraded_resolve
 
         def resolve() -> List[bool]:
+            timeout_s: Optional[float] = None
+            if deadline is not None:
+                timeout_s = deadline - time.monotonic()
+                if timeout_s <= 0:
+                    self.client.cancel(token)
+                    return self._expire(
+                        keys, signatures, digests,
+                        "deadline expired before resolve",
+                    )
             try:
                 status, _, mask, _ = proto.decode_verify_response(
-                    self.client.await_reply(token)
+                    self.client.await_reply(token, timeout_s)
                 )
             except (SidecarUnavailable, proto.ProtocolError) as exc:
+                if deadline is not None and time.monotonic() >= deadline:
+                    # the budget, not the transport, gave out: the
+                    # batch is handed back to the in-process ladder
+                    # and a late reply is dropped by the demux
+                    return self._expire(keys, signatures, digests, exc)
                 return self._degrade(keys, signatures, digests, exc)
             if status == proto.ST_OK and mask is not None and len(mask) == n:
                 fabobs.obs_count("fabric_verify_lanes_total", n, rung="serve")
@@ -539,8 +764,9 @@ class SidecarProvider:
                 )
                 return mask
             # BUSY/ERROR/STOPPING at resolve time: fall into the sync
-            # path, which owns the retry/degrade ladder
-            return self.batch_verify(keys, signatures, digests)
+            # path, which owns the retry/degrade ladder — on the
+            # ORIGINAL budget, never a fresh one
+            return self._batch_verify(keys, signatures, digests, deadline)
 
         return resolve
 

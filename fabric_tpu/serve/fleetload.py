@@ -110,9 +110,22 @@ def run(
         else:
             mismatches += 1
     wall_s = time.perf_counter() - t_start
-    provider.stop()
     lat = sorted(latencies)
-    return {
+    # tail-tolerance counters (fabtail): hedge/eviction counters exist
+    # on the router only, deadline expiry on both provider shapes —
+    # the soak quantifies TAIL behavior, not just throughput
+    per_endpoint = None
+    if hasattr(provider, "describe"):
+        per_endpoint = [
+            {
+                "address": ep["address"],
+                "p99_ms": ep.get("p99_ms"),
+                "ewma_ms": ep.get("ewma_ms"),
+                "healthy": ep["healthy"],
+            }
+            for ep in provider.describe()["endpoints"]
+        ]
+    summary = {
         "channel": channel,
         "cls": proto.qos_name(qos_class),
         "requests": n_requests,
@@ -121,11 +134,19 @@ def run(
         "mask_mismatches": mismatches,
         "busy_rejects": provider.busy_rejects,
         "degraded": provider.degraded,
+        "deadline_expired": getattr(provider, "deadline_expired", 0),
+        "hedges": getattr(provider, "hedges", 0),
+        "hedge_wins": getattr(provider, "hedge_wins", 0),
+        "slow_evictions": getattr(provider, "slow_evictions", 0),
         "p50_ms": round(_pct(lat, 0.50) * 1e3, 3),
         "p99_ms": round(_pct(lat, 0.99) * 1e3, 3),
         "wall_s": round(wall_s, 3),
         "lanes_per_s": round(n_requests * lanes / max(wall_s, 1e-9), 1),
     }
+    if per_endpoint is not None:
+        summary["per_endpoint"] = per_endpoint
+    provider.stop()
+    return summary
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:

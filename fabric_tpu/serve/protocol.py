@@ -8,7 +8,7 @@ validation workload to an attached verifier over a fixed framing).
 Frame layout (big-endian)::
 
     magic   2s   b"FT"
-    version u8   the frame's protocol revision (1 or 2)
+    version u8   the frame's protocol revision (1, 2 or 3)
     opcode  u8   OP_*
     req_id  u32  caller-chosen; echoed verbatim on the response
     length  u32  payload byte count (bounded by MAX_PAYLOAD)
@@ -31,11 +31,33 @@ priority-aware::
     u8  chan_len  + chan_len bytes of UTF-8 channel id (accounting only)
     ... the v1 lane table, unchanged ...
 
+Protocol revision 3 (the tail-tolerance rev) inserts a per-request
+latency budget between the QoS prefix and the lane table::
+
+    u32 deadline_ms   remaining budget when the frame was sent
+                      (0 = no deadline — the v2 semantics exactly)
+    ... the v1 lane table, unchanged ...
+
+The deadline contract: the server sheds work it provably cannot finish
+inside the budget as an explicit ``ST_BUSY`` — never a silent drop,
+never a fabricated verdict — and caps its coalescing linger by the
+tightest in-flight budget.  Revision 3 also adds ``OP_CANCEL``: a
+fire-and-forget frame whose ``req_id`` names an in-flight VERIFY the
+client no longer wants (a hedge lost the race, a budget expired).
+Cancellation is best-effort bookkeeping, not a correctness lever: a
+cancel that arrives before dispatch sheds the work uncomputed, one
+that loses the race to the settlement merely suppresses the reply the
+client would drop anyway.  ``OP_CANCEL`` carries no response frame —
+it must never collide with the cancelled request's own reply in the
+client's demux.
+
 Negotiation is per-frame and downgrade-safe in both directions: the
-version byte rides every header, a v2 server accepts v1 frames (class
-defaults to ``QOS_NORMAL``), and a v2 client hellos with a PING at its
-preferred revision, latching v1 when a v1-only server refuses the
-stream — old clients and old servers keep working unmodified.
+version byte rides every header, a v3 server accepts v1/v2 frames
+(class defaults to ``QOS_NORMAL``, deadline to none), and a v3 client
+hellos with a PING at its preferred revision, stepping down one
+revision per refusal (v3 -> v2 -> v1) so each vintage of server keeps
+every feature it understands — an old server costs the client the
+newer fields, never the connection.
 Revision 2 also adds ``OP_DRAIN``: answer new VERIFY work
 ``ST_STOPPING`` while in-flight requests settle with their real
 verdicts, then exit — the rolling-restart half of the failover story.
@@ -62,7 +84,7 @@ from __future__ import annotations
 import struct
 from typing import List, Optional, Sequence, Tuple
 
-PROTOCOL_VERSION = 2
+PROTOCOL_VERSION = 3
 MIN_PROTOCOL_VERSION = 1
 MAGIC = b"FT"
 
@@ -72,6 +94,7 @@ OP_VERIFY = 2
 OP_STATS = 3
 OP_SHUTDOWN = 4
 OP_DRAIN = 5  # protocol rev 2: refuse new work, settle in-flight, exit
+OP_CANCEL = 6  # protocol rev 3: best-effort abandon of an in-flight VERIFY
 
 # admission (QoS) classes, protocol rev 2.  Lower id = higher priority;
 # the names are the metric/scorecard vocabulary (label ``cls``).
@@ -199,19 +222,33 @@ def encode_verify_request(
     lanes: Sequence[Tuple[int, bytes, bytes]],
     qos_class: Optional[int] = None,
     channel: str = "",
+    deadline_ms: Optional[int] = None,
 ) -> bytes:
     """key_table: SEC1 key bytes per distinct key; lanes: (key_idx, sig,
     digest) with key_idx == NO_KEY for unusable-key lanes.  Passing a
     ``qos_class`` produces the protocol-rev-2 body (class + channel
     prefix); ``None`` keeps the v1 layout byte-identical, so a client
-    latched to v1 never emits a body an old server cannot parse."""
+    latched to v1 never emits a body an old server cannot parse.
+    Passing ``deadline_ms`` (remaining latency budget; 0 = no deadline)
+    produces the rev-3 body — only valid on top of the QoS prefix, and
+    REQUIRED on every v3 frame: the body layout is keyed to the frame
+    revision, so a v3 sender with no budget passes 0, never None (a
+    v2-latched client passes None).  Callers with a live budget floor
+    it at 1 themselves — a budget that rounds to 0 must not decode as
+    'no deadline'."""
     out: List[bytes] = []
+    if deadline_ms is not None and qos_class is None:
+        raise ProtocolError(
+            "deadline_ms requires the rev-2 QoS prefix (qos_class)"
+        )
     if qos_class is not None:
         if not 0 <= qos_class < len(QOS_NAMES):
             raise ProtocolError(f"qos class {qos_class} out of range")
         chan = channel.encode("utf-8", "backslashreplace")[:255]
         out.append(struct.pack(">BB", qos_class, len(chan)))
         out.append(chan)
+    if deadline_ms is not None:
+        out.append(struct.pack(">I", max(0, int(deadline_ms)) & 0xFFFFFFFF))
     out.append(_encode_lane_table(key_table, lanes))
     return b"".join(out)
 
@@ -267,18 +304,22 @@ class _Reader:
 def decode_verify_request(
     payload: bytes,
     version: int = 1,
-) -> Tuple[List[bytes], List[Tuple[int, bytes, bytes]], int, str]:
-    """(keys, lanes, qos_class, channel).  v1 payloads decode with the
-    default class (``QOS_NORMAL``) and an empty channel — the QoS
-    admission path treats old clients exactly like unclassified
-    traffic, never an error."""
+) -> Tuple[List[bytes], List[Tuple[int, bytes, bytes]], int, str, int]:
+    """(keys, lanes, qos_class, channel, deadline_ms).  v1 payloads
+    decode with the default class (``QOS_NORMAL``) and an empty channel
+    — the QoS admission path treats old clients exactly like
+    unclassified traffic, never an error.  Pre-v3 payloads decode with
+    ``deadline_ms == 0`` (no deadline): an old client's work is never
+    shed on a budget it could not have set."""
     r = _Reader(payload)
-    qos_class, channel = DEFAULT_QOS, ""
+    qos_class, channel, deadline_ms = DEFAULT_QOS, "", 0
     if version >= 2:
         qos_class = r.u8()
         if not 0 <= qos_class < len(QOS_NAMES):
             raise ProtocolError(f"qos class {qos_class} out of range")
         channel = r.take(r.u8()).decode("utf-8", "replace")
+    if version >= 3:
+        deadline_ms = r.u32()
     n_keys = r.u16()
     keys = [r.take(r.u16()) for _ in range(n_keys)]
     n_lanes = r.u32()
@@ -294,7 +335,7 @@ def decode_verify_request(
         lanes.append((key_idx, sig, digest))
     if r.off != len(payload):
         raise ProtocolError("trailing bytes after lane table")
-    return keys, lanes, qos_class, channel
+    return keys, lanes, qos_class, channel, deadline_ms
 
 
 # ---------------------------------------------------------------------------

@@ -95,6 +95,12 @@ class ClassLedger:
         # and these counters are how a scorecard proves none were silent
         self.admitted: List[int] = [0] * len(proto.QOS_NAMES)
         self.rejected: List[int] = [0] * len(proto.QOS_NAMES)
+        # lifetime lane flow: acquired must equal released + in-flight
+        # at every instant — hedge_storm's balance proof that hedged
+        # lanes are neither leaked nor double-released (release() would
+        # otherwise clamp a double-free invisibly at zero)
+        self.lanes_acquired = 0
+        self.lanes_released = 0
 
     def _clamped(self, qos_class: int) -> int:
         return qos_class if 0 <= qos_class < len(self._used) else proto.QOS_BULK
@@ -127,6 +133,7 @@ class ClassLedger:
             self._used[c] += n
             self._waiting[c] = False
             self.admitted[c] += 1
+            self.lanes_acquired += n
             return True
 
     def release(self, qos_class: int, lanes: int) -> None:
@@ -134,6 +141,20 @@ class ClassLedger:
         n = min(max(1, lanes), self.total)
         with self._lock:
             self._used[c] = max(0, self._used[c] - n)
+            self.lanes_released += n
+
+    def balance(self) -> Dict[str, int]:
+        """Lifetime lane-flow balance: ``leaked`` must be 0 at quiesce
+        and can never go negative unless a release was double-fired —
+        the hedge/cancel bookkeeping proof the det scorecard pins."""
+        with self._lock:
+            return {
+                "acquired": self.lanes_acquired,
+                "released": self.lanes_released,
+                "in_flight": sum(self._used),
+                "leaked": self.lanes_acquired - self.lanes_released
+                - sum(self._used),
+            }
 
     def fill(self, qos_class: Optional[int] = None) -> float:
         """Queue-fill fraction: the class's used/quota when given (the

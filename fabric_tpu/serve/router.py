@@ -1,4 +1,5 @@
-"""Multi-sidecar router: peer-side load balancing with failover.
+"""Multi-sidecar router: peer-side load balancing with failover and
+tail tolerance.
 
 One sidecar is a warm appliance; a fleet needs several behind every
 peer so a single sidecar death is a *routing* event, not a degrade-to-
@@ -12,9 +13,26 @@ inline event.  :class:`SidecarRouter` presents the same Provider SPI as
 - **health-probe eviction**: every endpoint carries its own
   ``CooldownGate`` (the serve client's dial-circuit discipline, lifted
   to serving failures) — a dead endpoint is skipped for exponentially
-  longer cooldowns and re-probed with a cheap PING before it gets a
-  real batch again, so one blackholed sidecar never slows dials to the
-  healthy ones;
+  longer cooldowns and re-probed with a cheap short-timeout PING before
+  it gets a real batch again, so one blackholed sidecar never slows
+  dials to the healthy ones;
+- **hedged verification** (fabtail): every endpoint carries a latency
+  tracker (EWMA + bounded reservoir); when the preferred endpoint has
+  not answered within a hedge delay derived from its own OBSERVED
+  quantiles (never a static knob), the router fires the same batch at
+  the next-ranked endpoint — first verdict wins, the loser is
+  cancelled best-effort over OP_CANCEL, and a global token-bucket
+  hedge budget (default <= 5% extra requests) guarantees hedging can
+  never amplify an overloaded fleet into collapse.  Verification is
+  pure, so first-wins is mask-safe by construction;
+- **gray-failure eviction** (fabtail): an endpoint that is alive but a
+  latency outlier — its EWMA far above the fleet's best, or it keeps
+  losing its own hedges — is evicted through the same CooldownGate
+  ladder as a dead one and earns traffic back through probes;
+- **wire deadlines** (fabtail): with a per-batch budget configured
+  (``deadline_ms`` / ``FABRIC_TPU_SERVE_DEADLINE_MS``), every per-hop
+  wait derives from the REMAINING budget; an expired budget hands the
+  batch to the in-process ladder instead of parking on a slow socket;
 - **re-verify-on-kill, across endpoints**: the PR 8 ST_STOPPING
   discipline (never trust a dying sidecar's settlement) now fails over
   — a kill/drain mid-batch re-verifies on the next healthy endpoint,
@@ -29,12 +47,16 @@ inline event.  :class:`SidecarRouter` presents the same Provider SPI as
 
 ``fault_point("serve.route")`` arms each dispatch attempt for chaos.
 Endpoint health transitions drive the ``fabric_serve_endpoint_healthy``
-gauge.  Addresses come from the constructor, ``BCCSP SERVE.Endpoints``,
-or ``FABRIC_TPU_SERVE_ENDPOINTS`` (comma-separated).
+gauge; hedges/wins/evictions drive ``fabric_serve_hedges_total``,
+``fabric_serve_hedge_wins_total`` and
+``fabric_serve_slow_evictions_total``.  Addresses come from the
+constructor, ``BCCSP SERVE.Endpoints``, or
+``FABRIC_TPU_SERVE_ENDPOINTS`` (comma-separated).
 """
 
 from __future__ import annotations
 
+import collections
 import hashlib
 import os
 import threading
@@ -50,6 +72,7 @@ from fabric_tpu.serve.client import (
     BUSY_POLICY,
     SidecarClient,
     SidecarUnavailable,
+    deadline_ms_from_env,
     encode_lanes,
 )
 
@@ -68,6 +91,46 @@ ENDPOINT_GATE_POLICY = RetryPolicy(
 #: configured ladder)
 ROUTE_BUCKETS = (128, 256, 512, 1024, 2048, 4096, 8192, 16384)
 
+#: default global hedge budget: extra (hedged) requests as a fraction
+#: of primary requests.  5% bounds the amplification an overloaded
+#: fleet can see from its own tail-chasing.
+DEFAULT_HEDGE_FRACTION = 0.05
+
+#: floor on the derived hedge delay (ms): below this the hedge would
+#: race ordinary jitter, not a gray failure
+DEFAULT_HEDGE_MIN_MS = 20.0
+
+
+def hedge_fraction_from_env() -> float:
+    """``FABRIC_TPU_SERVE_HEDGE_FRACTION`` -> budget fraction in
+    [0, 1]; 0 disables hedging (shared env read discipline: malformed
+    values warn and fall back to the default)."""
+    raw = os.environ.get("FABRIC_TPU_SERVE_HEDGE_FRACTION", "")
+    if not raw:
+        return DEFAULT_HEDGE_FRACTION
+    try:
+        return min(1.0, max(0.0, float(raw)))
+    except ValueError:
+        logger.warning(
+            "FABRIC_TPU_SERVE_HEDGE_FRACTION=%r ignored (not a float)", raw
+        )
+        return DEFAULT_HEDGE_FRACTION
+
+
+def hedge_min_ms_from_env() -> float:
+    """``FABRIC_TPU_SERVE_HEDGE_MIN_MS`` -> hedge-delay floor in ms
+    (malformed values warn and fall back)."""
+    raw = os.environ.get("FABRIC_TPU_SERVE_HEDGE_MIN_MS", "")
+    if not raw:
+        return DEFAULT_HEDGE_MIN_MS
+    try:
+        return max(0.0, float(raw))
+    except ValueError:
+        logger.warning(
+            "FABRIC_TPU_SERVE_HEDGE_MIN_MS=%r ignored (not a float)", raw
+        )
+        return DEFAULT_HEDGE_MIN_MS
+
 
 def _route_bucket(n: int) -> int:
     for b in ROUTE_BUCKETS:
@@ -76,17 +139,88 @@ def _route_bucket(n: int) -> int:
     return ROUTE_BUCKETS[-1]
 
 
+class _LatencyTracker:
+    """Per-endpoint observed service latency: EWMA for the outlier
+    signal, a bounded newest-win reservoir for quantiles (the hedge
+    delay derives from the endpoint's OWN p9x, not a static knob)."""
+
+    WINDOW = 128
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._window: collections.deque = collections.deque(
+            maxlen=self.WINDOW
+        )
+        self.ewma_s: Optional[float] = None
+        self.samples = 0
+
+    def record(self, seconds: float) -> None:
+        with self._lock:
+            self._window.append(seconds)
+            self.samples += 1
+            self.ewma_s = (
+                seconds
+                if self.ewma_s is None
+                else 0.8 * self.ewma_s + 0.2 * seconds
+            )
+
+    def quantile(self, q: float) -> Optional[float]:
+        with self._lock:
+            if not self._window:
+                return None
+            xs = sorted(self._window)
+            return xs[min(len(xs) - 1, int(q * (len(xs) - 1)))]
+
+
+class _HedgeBudget:
+    """Count-based token bucket bounding hedges to a fraction of
+    primary requests: each primary dispatch earns ``fraction`` tokens
+    (capped at ``burst``), each hedge spends one.  No clocks — the
+    bound holds per request count, so an overloaded fleet cannot be
+    amplified past ``burst + fraction * requests`` extra lanes and the
+    chaos scorecard replays bit-identically."""
+
+    def __init__(self, fraction: float, burst: float = 2.0):
+        self.fraction = max(0.0, fraction)
+        self.burst = max(1.0, burst)
+        self._lock = threading.Lock()
+        self._tokens = min(1.0, self.burst) if self.fraction > 0 else 0.0
+        self.earned = 0  # primary requests seen
+
+    def earn(self) -> None:
+        if self.fraction <= 0:
+            return
+        with self._lock:
+            self.earned += 1
+            self._tokens = min(self.burst, self._tokens + self.fraction)
+
+    def try_spend(self) -> bool:
+        if self.fraction <= 0:
+            return False
+        with self._lock:
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                return True
+            return False
+
+
 class _Endpoint:
-    """One sidecar endpoint: pipelined client + serving-failure gate.
-    All mutable health state is guarded by the endpoint's lock."""
+    """One sidecar endpoint: pipelined client + serving-failure gate +
+    latency tracker.  All mutable health state is guarded by the
+    endpoint's lock."""
 
     def __init__(self, address: str, gate_policy: RetryPolicy,
                  clock: Callable[[], float] = time.monotonic):
         self.address = address
         self.client = SidecarClient(address)
         self.gate = CooldownGate(policy=gate_policy, clock=clock)
+        self.tracker = _LatencyTracker()
         self._lock = threading.Lock()
         self._healthy = True
+        # consecutive hedges this endpoint lost while primary — the
+        # gray-failure signal for an endpoint that never answers first
+        # (its latencies never land in the tracker at all)
+        self.hedge_losses = 0
         fabobs.obs_gauge(
             "fabric_serve_endpoint_healthy", 1.0, endpoint=address
         )
@@ -112,6 +246,7 @@ class _Endpoint:
         with self._lock:
             flipped = self._healthy
             self._healthy = False
+            self.hedge_losses = 0
         if flipped:
             logger.warning(
                 "sidecar endpoint %s evicted (%s); cooling down",
@@ -120,6 +255,18 @@ class _Endpoint:
             fabobs.obs_gauge(
                 "fabric_serve_endpoint_healthy", 0.0, endpoint=self.address
             )
+
+    def hedge_delay_s(self, floor_s: float) -> float:
+        """The wait before this endpoint's unanswered batch is hedged:
+        2x its own observed p95 (a healthy endpoint almost never takes
+        that long, so hedges fire on genuine tail events), floored so
+        ordinary jitter never triggers one.  Before any sample exists
+        the delay is a multiple of the floor — conservative until the
+        endpoint has shown its shape."""
+        q95 = self.tracker.quantile(0.95)
+        if q95 is None:
+            return floor_s * 5.0
+        return max(floor_s, 2.0 * q95)
 
 
 def endpoints_from_env() -> List[str]:
@@ -130,10 +277,26 @@ def endpoints_from_env() -> List[str]:
 
 
 class SidecarRouter:
-    """Provider SPI over N sidecar endpoints with peer-side failover.
+    """Provider SPI over N sidecar endpoints with peer-side failover,
+    hedging and wire deadlines.
 
     Single verify/sign/hash/key ops run in-process (the sidecar fleet
     exists for the batch plane), exactly like ``SidecarProvider``."""
+
+    #: health probes get their OWN short budget: a gray endpoint that
+    #: answers nothing must cost the probe path seconds, never the full
+    #: request timeout
+    PROBE_TIMEOUT_S = 2.0
+    #: demux poll slice while a hedge race is in flight
+    POLL_SLICE_S = 0.02
+    #: gray-failure eviction: an endpoint whose EWMA exceeds
+    #: SLOW_FACTOR x the best peer EWMA (and the absolute floor) after
+    #: SLOW_MIN_SAMPLES, or that loses HEDGE_LOSS_EVICT consecutive
+    #: hedges, is evicted through the cooldown ladder
+    SLOW_FACTOR = 4.0
+    SLOW_FLOOR_S = 0.05
+    SLOW_MIN_SAMPLES = 8
+    HEDGE_LOSS_EVICT = 2
 
     def __init__(
         self,
@@ -145,6 +308,9 @@ class SidecarRouter:
         channel: str = "",
         gate_policy: RetryPolicy = ENDPOINT_GATE_POLICY,
         clock: Callable[[], float] = time.monotonic,
+        deadline_ms: Optional[int] = None,
+        hedge_fraction: Optional[float] = None,
+        hedge_min_ms: Optional[float] = None,
     ):
         if endpoints is None:
             endpoints = endpoints_from_env()
@@ -164,6 +330,21 @@ class SidecarRouter:
         self._fallback_lock = threading.Lock()
         self.degraded = False  # latched: any batch served in-process
         self.busy_rejects = 0
+        self.deadline_expired = 0
+        self.hedges = 0
+        self.hedge_wins = 0
+        self.slow_evictions = 0
+        self.deadline_ms = (
+            deadline_ms if deadline_ms is not None else deadline_ms_from_env()
+        )
+        self.hedge_min_s = (
+            hedge_min_ms if hedge_min_ms is not None else hedge_min_ms_from_env()
+        ) / 1000.0
+        self.hedge_budget = _HedgeBudget(
+            hedge_fraction
+            if hedge_fraction is not None
+            else hedge_fraction_from_env()
+        )
         self.channel = channel
         if qos_class is None:
             from fabric_tpu.serve.qos import class_for_channel, qos_map_from_env
@@ -177,7 +358,7 @@ class SidecarRouter:
         lane bucket over SELECTABLE endpoints (gate ready), so buckets
         spread across the fleet and a cooling endpoint is skipped
         without a dial.  Every selectable endpoint stays in the list —
-        positions 2..N are the failover ladder."""
+        positions 2..N are the failover (and hedge) ladder."""
         bucket = _route_bucket(lanes)
         ready = [e for e in self.endpoints if e.gate.ready()]
         if not ready:
@@ -190,19 +371,114 @@ class SidecarRouter:
 
         return sorted(ready, key=score)
 
-    def _probe_ok(self, e: _Endpoint) -> bool:
+    def _probe_ok(
+        self, e: _Endpoint, timeout_s: Optional[float] = None
+    ) -> bool:
         """A previously-evicted endpoint earns a real batch back with a
         cheap PING first — a probe failure costs microseconds, a routed
-        batch failure costs a re-verify."""
+        batch failure costs a re-verify.  The probe rides its OWN short
+        timeout (one gray endpoint must never stall the health-probe
+        path for the duration of a full request timeout), further
+        capped by the caller's remaining wire budget when one exists."""
         if e.healthy:
             return True
+        probe_s = self.PROBE_TIMEOUT_S
+        if timeout_s is not None:
+            probe_s = min(probe_s, max(0.0, timeout_s))
         try:
-            if e.client.ping():
+            if e.client.ping(timeout_s=probe_s):
                 e.mark_up()
                 return True
         except (SidecarUnavailable, proto.ProtocolError) as exc:
             e.mark_down(exc)
         return False
+
+    # -- deadlines ---------------------------------------------------------
+    def _deadline(self) -> Optional[float]:
+        if not self.deadline_ms:
+            return None
+        return time.monotonic() + self.deadline_ms / 1000.0
+
+    def _expire(self, keys, signatures, digests, why) -> List[bool]:
+        """The batch's wire budget ran out before any endpoint
+        answered: hand it to the in-process ladder NOW (bit-exact mask
+        through the same degrade path, never a wait past the budget)."""
+        self.deadline_expired += 1  # GIL-atomic add, stats only
+        fabobs.obs_count(
+            "fabric_serve_deadline_expired_total", seam="serve.router"
+        )
+        return self._degrade(keys, signatures, digests, why)
+
+    # -- gray-failure eviction ---------------------------------------------
+    def _note_latency(self, e: _Endpoint, seconds: float) -> None:
+        """A served verdict: record the sample, reset the hedge-loss
+        streak, and evict the endpoint if its observed latency is an
+        outlier against the fleet's best (the sidecar is alive — it
+        answered — but too slow to keep in rotation)."""
+        e.tracker.record(seconds)
+        with e._lock:
+            e.hedge_losses = 0
+        # the outlier baseline is the best of the endpoints currently
+        # IN ROTATION: a dead/evicted peer's EWMA is frozen at its
+        # healthy-era values, and judging the survivor against a
+        # ghost's baseline would evict the only live endpoint forever
+        best: Optional[float] = None
+        for other in self.endpoints:
+            if (
+                other is e
+                or other.tracker.ewma_s is None
+                or not other.healthy
+                or not other.gate.ready()
+            ):
+                continue
+            if best is None or other.tracker.ewma_s < best:
+                best = other.tracker.ewma_s
+        if (
+            best is not None
+            and e.tracker.samples >= self.SLOW_MIN_SAMPLES
+            and e.tracker.ewma_s is not None
+            and e.tracker.ewma_s > max(self.SLOW_FLOOR_S,
+                                       self.SLOW_FACTOR * best)
+        ):
+            self._evict_slow(
+                e,
+                f"latency outlier: ewma {e.tracker.ewma_s * 1e3:.1f}ms vs "
+                f"fleet best {best * 1e3:.1f}ms",
+            )
+
+    def _note_hedge_loss(self, e: _Endpoint) -> None:
+        """The primary lost its own hedge: the endpoint is alive (the
+        socket is fine) but did not answer inside 2x its own p95 — the
+        gray-failure signature.  A short streak evicts it."""
+        with e._lock:
+            e.hedge_losses += 1
+            streak = e.hedge_losses
+        if streak >= self.HEDGE_LOSS_EVICT:
+            self._evict_slow(
+                e, f"lost {streak} consecutive hedges (gray failure)"
+            )
+
+    def _evict_slow(self, e: _Endpoint, why: str) -> None:
+        # never slow-evict the LAST endpoint in rotation: a slow
+        # verdict still beats degrading the whole fleet in-process —
+        # gray eviction is a relative judgment and needs a peer to
+        # route to (death eviction has no such choice and keeps its
+        # own path through mark_down)
+        if not any(
+            other.healthy and other.gate.ready()
+            for other in self.endpoints
+            if other is not e
+        ):
+            logger.warning(
+                "endpoint %s is a latency outlier (%s) but the only "
+                "one in rotation; keeping it", e.address, why,
+            )
+            return
+        self.slow_evictions += 1  # GIL-atomic add, stats only
+        fabobs.obs_count(
+            "fabric_serve_slow_evictions_total", endpoint=e.address
+        )
+        e.mark_down(why)
 
     # -- in-process fallback ----------------------------------------------
     def fallback_provider(self):
@@ -214,8 +490,9 @@ class SidecarRouter:
             return self._fallback
 
     def _degrade(self, keys, signatures, digests, why) -> List[bool]:
-        """Every endpoint refused: in-process verification (bit-exact
-        masks), all-False only if the local ladder ALSO fails."""
+        """Every endpoint refused (or the budget expired): in-process
+        verification (bit-exact masks), all-False only if the local
+        ladder ALSO fails."""
         if not self.degraded:
             logger.warning(
                 "all %d sidecar endpoints unavailable (%s); degrading "
@@ -236,36 +513,57 @@ class SidecarRouter:
             )
             return [False] * len(keys)
 
-    # -- one endpoint, one attempt ----------------------------------------
-    def _try_endpoint(
-        self, e: _Endpoint, keys, signatures, digests, attempt: int
-    ) -> Tuple[str, Optional[List[bool]]]:
-        """('ok', mask) | ('busy', None) | ('dead', None).  BUSY is
-        admission control, not endpoint failure — the gate only records
-        failures that mean the endpoint cannot serve."""
-        n = len(keys)
+    # -- one endpoint, one (hedged) attempt --------------------------------
+    def _payload_for(
+        self, e: _Endpoint, keys, signatures, digests,
+        deadline: Optional[float],
+    ) -> bytes:
+        """Lane payload at THIS endpoint's negotiated revision, with
+        the budget REMAINING at encode time when both ends speak v3
+        (0 = no budget; the body layout is keyed to the frame rev)."""
+        return encode_lanes(
+            keys, signatures, digests,
+            qos_class=self.qos_class, channel=self.channel,
+            deadline_ms=(
+                max(1, int((deadline - time.monotonic()) * 1000.0))
+                if deadline is not None else 0
+            ),
+            version=e.client.version,
+        )
+
+    def _submit_to(
+        self, e: _Endpoint, keys, signatures, digests, attempt: int,
+        deadline: Optional[float],
+    ) -> Optional[int]:
+        """One pipelined dispatch; the token, or None with the endpoint
+        marked down (the ladder owns what happens next)."""
         try:
             # chaos seam: an injected routing fault fails THIS attempt
-            # on THIS endpoint — the ladder below must absorb it
+            # on THIS endpoint — the ladder must absorb it
             fault_point("serve.route", key=(e.address, attempt))
             e.client.ensure_connected()
-            if e.client.version >= 2:
-                payload = encode_lanes(
-                    keys, signatures, digests,
-                    qos_class=self.qos_class, channel=self.channel,
-                )
-            else:
-                payload = encode_lanes(keys, signatures, digests,
-                                       qos_class=None)
-            status, _retry_ms, mask, message = proto.decode_verify_response(
-                e.client.request(proto.OP_VERIFY, payload)
-            )
+            payload = self._payload_for(e, keys, signatures, digests, deadline)
+            return e.client.submit(proto.OP_VERIFY, payload)
         except Exception as exc:  # noqa: BLE001 - endpoint failure (incl. injected) routes to the next rung, never past the mask contract
-            logger.debug("endpoint %s verify attempt failed: %s", e.address, exc)
+            logger.debug("endpoint %s submit failed: %s", e.address, exc)
+            e.mark_down(exc)
+            return None
+
+    def _interpret(
+        self, e: _Endpoint, payload: bytes, n: int, t_submit: float,
+    ) -> Tuple[str, Optional[List[bool]]]:
+        """One reply payload -> ('ok', mask) | ('busy', None) |
+        ('dead', None), with health/latency bookkeeping applied."""
+        try:
+            status, _retry_ms, mask, message = proto.decode_verify_response(
+                payload
+            )
+        except proto.ProtocolError as exc:
             e.mark_down(exc)
             return "dead", None
         if status == proto.ST_OK and mask is not None and len(mask) == n:
             e.mark_up()
+            self._note_latency(e, time.monotonic() - t_submit)
             return "ok", mask
         if status == proto.ST_BUSY:
             self.busy_rejects += 1  # GIL-atomic add, stats only
@@ -276,8 +574,178 @@ class SidecarRouter:
         e.mark_down(message or f"status {status}")
         return "dead", None
 
+    def _try_endpoint(
+        self, e: _Endpoint, keys, signatures, digests, attempt: int,
+        deadline: Optional[float] = None,
+    ) -> Tuple[str, Optional[List[bool]]]:
+        """One UN-hedged attempt at one endpoint — the failover
+        ladder's unit: ('ok', mask) | ('busy', None) | ('dead', None)
+        | ('expired', None).  BUSY is admission control, not endpoint
+        failure — the gate only records failures that mean the
+        endpoint cannot serve."""
+        token = self._submit_to(e, keys, signatures, digests, attempt,
+                                deadline)
+        if token is None:
+            return "dead", None
+        return self._await_hedged(
+            e, token, time.monotonic(), (), keys, signatures, digests,
+            attempt, deadline,
+        )
+
+    def _await_hedged(
+        self,
+        primary: _Endpoint,
+        token: int,
+        t_submit: float,
+        alternates: Sequence[_Endpoint],
+        keys, signatures, digests,
+        attempt: int,
+        deadline: Optional[float],
+    ) -> Tuple[str, Optional[List[bool]]]:
+        """Wait for the primary's verdict, firing at most ONE hedge at
+        the next-ranked endpoint once the primary has been silent for
+        its learned hedge delay.  First verdict wins; the loser is
+        cancelled best-effort (OP_CANCEL + local demux drop), so a
+        verdict from a lost race can never be seen — mask-safety does
+        not even depend on verification being pure, though it is.
+
+        Returns ('ok', mask) | ('busy', None) | ('dead', None) |
+        ('expired', None)."""
+        n = len(keys)
+        # overall wall cap: the request timeout (the legacy bound) or
+        # the remaining wire budget, whichever is tighter
+        stop_at = t_submit + primary.client.request_timeout_s
+        if deadline is not None:
+            stop_at = min(stop_at, deadline)
+        hedge_delay = primary.hedge_delay_s(self.hedge_min_s)
+        hedge: Optional[_Endpoint] = None
+        hedge_token: Optional[int] = None
+        hedge_t0 = 0.0
+        hedge_tried = False
+        prim_alive = True
+        saw_busy = False
+
+        def _drop(e: Optional[_Endpoint], tok: Optional[int]) -> None:
+            if e is not None and tok is not None:
+                e.client.cancel(tok)
+
+        while True:
+            now = time.monotonic()
+            if now >= stop_at:
+                # walk away from every outstanding socket: the budget
+                # (or the request timeout) is the contract, not hope
+                _drop(primary if prim_alive else None, token)
+                _drop(hedge, hedge_token)
+                if deadline is not None and now >= deadline:
+                    return "expired", None
+                if prim_alive:
+                    primary.mark_down("request timeout")
+                return ("busy" if saw_busy else "dead"), None
+            if not prim_alive and hedge is None:
+                return ("busy" if saw_busy else "dead"), None
+            # fire the hedge once the primary has been silent too long
+            if (
+                prim_alive
+                and hedge is None
+                and not hedge_tried
+                and alternates
+                and now - t_submit >= hedge_delay
+                and (deadline is None or now < deadline)
+            ):
+                hedge_tried = True
+                if self.hedge_budget.try_spend():
+                    for alt in alternates:
+                        if not alt.healthy:
+                            # a hedge goes only to a known-good peer:
+                            # dialing a cold/unhealthy alternate here
+                            # would stall THIS loop (and the primary's
+                            # reply sitting in its socket) for a
+                            # connect timeout — the exact tail event
+                            # hedging exists to cut
+                            continue
+                        tok = self._submit_to(
+                            alt, keys, signatures, digests, attempt, deadline
+                        )
+                        if tok is not None:
+                            hedge, hedge_token, hedge_t0 = alt, tok, now
+                            self.hedges += 1  # GIL-atomic add, stats only
+                            fabobs.obs_count("fabric_serve_hedges_total")
+                            logger.info(
+                                "hedging %d-lane batch: %s silent for "
+                                "%.0fms, firing at %s",
+                                n, primary.address,
+                                (now - t_submit) * 1e3, alt.address,
+                            )
+                            break
+            # poll the primary
+            if prim_alive:
+                slice_s = min(self.POLL_SLICE_S, max(0.0, stop_at - now))
+                if hedge is None:
+                    # no race yet: wait in one chunk up to the hedge
+                    # fire moment (or the wall cap)
+                    slice_s = max(
+                        slice_s,
+                        min(
+                            (t_submit + hedge_delay) - now
+                            if alternates and not hedge_tried
+                            else self.POLL_SLICE_S * 5,
+                            stop_at - now,
+                        ),
+                    )
+                try:
+                    payload = primary.client.poll_reply(token, slice_s)
+                except SidecarUnavailable as exc:
+                    prim_alive = False
+                    primary.mark_down(exc)
+                    payload = None
+                if payload is not None:
+                    outcome = self._interpret(primary, payload, n, t_submit)
+                    if outcome[0] == "ok":
+                        _drop(hedge, hedge_token)
+                        return outcome
+                    prim_alive = False
+                    if outcome[0] == "busy":
+                        saw_busy = True
+                    if hedge is None:
+                        return outcome
+            # poll the hedge
+            if hedge is not None and hedge_token is not None:
+                try:
+                    payload = hedge.client.poll_reply(
+                        hedge_token, self.POLL_SLICE_S
+                    )
+                except SidecarUnavailable as exc:
+                    hedge.mark_down(exc)
+                    hedge, hedge_token = None, None
+                    payload = None
+                if payload is not None and hedge is not None:
+                    outcome = self._interpret(
+                        hedge, payload, n, hedge_t0
+                    )
+                    if outcome[0] == "ok":
+                        self.hedge_wins += 1  # GIL-atomic add, stats only
+                        fabobs.obs_count("fabric_serve_hedge_wins_total")
+                        # the primary lost a race it should have won:
+                        # cancel it and score the gray-failure streak
+                        if prim_alive:
+                            _drop(primary, token)
+                            self._note_hedge_loss(primary)
+                        return outcome
+                    if outcome[0] == "busy":
+                        saw_busy = True
+                    hedge, hedge_token = None, None
+
     # -- the batch plane ---------------------------------------------------
     def batch_verify(self, keys, signatures, digests) -> List[bool]:
+        return self._batch_verify(keys, signatures, digests,
+                                  self._deadline())
+
+    def _batch_verify(
+        self, keys, signatures, digests, deadline: Optional[float]
+    ) -> List[bool]:
+        """The sync ladder against an ALREADY-STARTED budget: the async
+        resolver re-enters here with its original deadline, so a
+        busy/dead resolve can never restart the per-batch clock."""
         n = len(keys)
         if n == 0:
             return []
@@ -287,11 +755,35 @@ class SidecarRouter:
         while True:
             any_busy = False
             for e in self._order(n):
-                if not self._probe_ok(e):
+                remaining: Optional[float] = None
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return self._expire(
+                            keys, signatures, digests,
+                            "deadline budget expired",
+                        )
+                # the probe is capped by the remaining budget; the dial
+                # inside a submit still rides connect_timeout_s, but a
+                # blackholed endpoint pays that once and then cools
+                # behind its dial gate, never per batch
+                if not self._probe_ok(e, timeout_s=remaining):
                     continue
                 attempt += 1
-                outcome, mask = self._try_endpoint(
-                    e, keys, signatures, digests, attempt
+                token = self._submit_to(
+                    e, keys, signatures, digests, attempt, deadline
+                )
+                if token is None:
+                    continue
+                self.hedge_budget.earn()
+                # hedge alternates: the rest of the failover ladder, in
+                # preference order (already gate-selected; probed when
+                # the hedge actually fires costs a dial we skip — a
+                # submit failure just walks to the next alternate)
+                outcome, mask = self._await_hedged(
+                    e, token, time.monotonic(),
+                    [a for a in self._order(n) if a is not e],
+                    keys, signatures, digests, attempt, deadline,
                 )
                 if outcome == "ok":
                     assert mask is not None
@@ -303,10 +795,25 @@ class SidecarRouter:
                         time.perf_counter() - t0, rung="serve",
                     )
                     return mask
+                if outcome == "expired":
+                    return self._expire(
+                        keys, signatures, digests, "deadline budget expired"
+                    )
                 if outcome == "busy":
                     any_busy = True
-            if any_busy and bo.sleep():
-                continue  # every live endpoint is shedding: pace + retry
+            if any_busy:
+                delay = bo.next_delay()
+                if delay is not None and deadline is not None:
+                    # the pacing budget is capped by the remaining wire
+                    # budget — fail over/degrade instead of sleeping
+                    # past it (the client shim's discipline, fleetwide)
+                    if delay >= deadline - time.monotonic():
+                        return self._expire(
+                            keys, signatures, digests,
+                            "deadline expired during admission backoff",
+                        )
+                if bo.sleep():
+                    continue  # every live endpoint is shedding: pace + retry
             return self._degrade(
                 keys, signatures, digests,
                 "every endpoint busy (budget spent)" if any_busy
@@ -314,57 +821,53 @@ class SidecarRouter:
             )
 
     def batch_verify_async(self, keys, signatures, digests):
-        """Pipelined dispatch through the preferred endpoint; ANY
-        failure at resolve time re-routes through the sync failover
-        ladder (which owns the degrade contract)."""
+        """Pipelined dispatch through the preferred endpoint; the
+        resolver waits with the SAME hedged ladder as the sync path,
+        and ANY failure re-routes through sync failover (which owns
+        the degrade contract)."""
         n = len(keys)
         if n == 0:
             return list
         t0 = time.perf_counter()
+        deadline = self._deadline()
         chosen: Optional[_Endpoint] = None
         token = None
+        t_submit = 0.0
         for e in self._order(n):
             if not self._probe_ok(e):
                 continue
-            try:
-                fault_point("serve.route", key=(e.address, 0))
-                e.client.ensure_connected()
-                if e.client.version >= 2:
-                    payload = encode_lanes(
-                        keys, signatures, digests,
-                        qos_class=self.qos_class, channel=self.channel,
-                    )
-                else:
-                    payload = encode_lanes(keys, signatures, digests,
-                                           qos_class=None)
-                token = e.client.submit(proto.OP_VERIFY, payload)
+            token = self._submit_to(e, keys, signatures, digests, 0, deadline)
+            if token is not None:
                 chosen = e
+                t_submit = time.monotonic()
+                self.hedge_budget.earn()
                 break
-            except Exception as exc:  # noqa: BLE001 - submit failure (incl. injected): next endpoint
-                logger.debug("endpoint %s submit failed: %s", e.address, exc)
-                e.mark_down(exc)
 
         def resolve() -> List[bool]:
             if chosen is None or token is None:
-                return self.batch_verify(keys, signatures, digests)
-            try:
-                status, _, mask, _ = proto.decode_verify_response(
-                    chosen.client.await_reply(token)
-                )
-            except (SidecarUnavailable, proto.ProtocolError) as exc:
-                chosen.mark_down(exc)
-                return self.batch_verify(keys, signatures, digests)
-            if status == proto.ST_OK and mask is not None and len(mask) == n:
-                chosen.mark_up()
+                return self._batch_verify(keys, signatures, digests,
+                                          deadline)
+            outcome, mask = self._await_hedged(
+                chosen, token, t_submit,
+                [a for a in self._order(n) if a is not chosen],
+                keys, signatures, digests, 0, deadline,
+            )
+            if outcome == "ok":
+                assert mask is not None
                 fabobs.obs_count("fabric_verify_lanes_total", n, rung="serve")
                 fabobs.obs_observe(
                     "fabric_verify_seconds",
                     time.perf_counter() - t0, rung="serve",
                 )
                 return mask
-            if status != proto.ST_BUSY:
-                chosen.mark_down(f"status {status}")
-            return self.batch_verify(keys, signatures, digests)
+            if outcome == "expired":
+                return self._expire(
+                    keys, signatures, digests, "deadline budget expired"
+                )
+            # busy/dead at resolve time: the sync ladder owns retries,
+            # failover and the degrade contract — on the ORIGINAL
+            # budget, never a fresh one
+            return self._batch_verify(keys, signatures, digests, deadline)
 
         return resolve
 
@@ -387,9 +890,9 @@ class SidecarRouter:
         return False
 
     def for_channel(self, channel_id: str) -> "SidecarRouter":
-        """Channel-bound view sharing the endpoint clients and gates
-        (one fleet, per-class traffic) — the SidecarProvider.for_channel
-        contract over the router."""
+        """Channel-bound view sharing the endpoint clients, gates and
+        hedge budget (one fleet, per-class traffic) — the
+        SidecarProvider.for_channel contract over the router."""
         import copy
 
         from fabric_tpu.serve.qos import class_for_channel, qos_map_from_env
@@ -410,6 +913,15 @@ class SidecarRouter:
                     "healthy": e.healthy,
                     "selectable": e.gate.ready(),
                     "version": e.client.version,
+                    "ewma_ms": (
+                        round(e.tracker.ewma_s * 1e3, 3)
+                        if e.tracker.ewma_s is not None else None
+                    ),
+                    "p99_ms": (
+                        round((e.tracker.quantile(0.99) or 0.0) * 1e3, 3)
+                        if e.tracker.samples else None
+                    ),
+                    "samples": e.tracker.samples,
                 }
                 for e in self.endpoints
             ],
@@ -417,6 +929,10 @@ class SidecarRouter:
             "channel": self.channel,
             "degraded": self.degraded,
             "busy_rejects": self.busy_rejects,
+            "hedges": self.hedges,
+            "hedge_wins": self.hedge_wins,
+            "slow_evictions": self.slow_evictions,
+            "deadline_expired": self.deadline_expired,
         }
 
     # -- pass-through SPI --------------------------------------------------

@@ -92,6 +92,18 @@ class ServeStats:
         self.rejects = 0
         self.errors = 0
         self.degraded_replies = 0
+        # tail-tolerance accounting (protocol rev 3): work shed because
+        # its wire budget provably could not be met, and requests the
+        # client abandoned via OP_CANCEL (pre-dispatch sheds vs replies
+        # suppressed after the verdict was computed)
+        self.deadline_shed = 0
+        self.class_deadline_shed: Dict[str, int] = {}
+        self.cancelled_pre = 0
+        self.cancelled_post = 0
+        # monotone per-bucket service-time floor: the fastest this
+        # sidecar has EVER served the bucket — the evidence behind the
+        # "provably cannot finish" deadline shed (no evidence = serve)
+        self.min_service_s: Dict[int, float] = {}
         # newest-win sliding window: a long-lived sidecar that slows
         # down later must not keep reporting startup-era p50/p99
         self._latency_s: collections.deque = collections.deque(
@@ -118,6 +130,9 @@ class ServeStats:
             self.lanes += lanes
             self.per_bucket[bucket] = self.per_bucket.get(bucket, 0) + 1
             self._latency_s.append(seconds)
+            prior = self.min_service_s.get(bucket)
+            if prior is None or seconds < prior:
+                self.min_service_s[bucket] = seconds
             self.class_served[cls] = self.class_served.get(cls, 0) + 1
             self.class_lanes[cls] = self.class_lanes.get(cls, 0) + lanes
             window = self._class_latency_s.get(cls)
@@ -152,6 +167,38 @@ class ServeStats:
             self.degraded_replies += 1
         fabobs.obs_count("fabric_serve_requests_total", status="stopping")
 
+    def deadline_reject(self, qos_class: int = proto.DEFAULT_QOS) -> None:
+        """An explicit ST_BUSY shed because the request's wire budget
+        provably cannot be met — counted apart from admission rejects
+        (the QoS ledger never saw this request, so the qos_storm
+        ledger/stats cross-check stays exact), attributed per class
+        like every other shed."""
+        cls = proto.qos_name(qos_class)
+        with self._lock:
+            self.deadline_shed += 1
+            self.class_deadline_shed[cls] = (
+                self.class_deadline_shed.get(cls, 0) + 1
+            )
+        fabobs.obs_count(
+            "fabric_serve_deadline_expired_total", seam="serve.server"
+        )
+        fabobs.obs_count(
+            "fabric_serve_requests_total", status="deadline_shed"
+        )
+
+    def cancel(self, pre_dispatch: bool) -> None:
+        with self._lock:
+            if pre_dispatch:
+                self.cancelled_pre += 1
+            else:
+                self.cancelled_post += 1
+
+    def floor_s(self, bucket: int) -> Optional[float]:
+        """The bucket's best-ever service time (evidence floor for the
+        deadline shed), or None before the first served request."""
+        with self._lock:
+            return self.min_service_s.get(bucket)
+
     def summary(self) -> Dict:
         with self._lock:
             return {
@@ -160,6 +207,9 @@ class ServeStats:
                 "rejects": self.rejects,
                 "errors": self.errors,
                 "degraded_replies": self.degraded_replies,
+                "deadline_shed": self.deadline_shed,
+                "cancelled_pre": self.cancelled_pre,
+                "cancelled_post": self.cancelled_post,
                 "per_bucket": {str(k): v for k, v in self.per_bucket.items()},
                 "request_latency": latency_summary(list(self._latency_s)),
                 "per_class": {
@@ -167,6 +217,9 @@ class ServeStats:
                         "served": self.class_served.get(cls, 0),
                         "lanes": self.class_lanes.get(cls, 0),
                         "busy": self.class_busy.get(cls, 0),
+                        "deadline_shed": self.class_deadline_shed.get(
+                            cls, 0
+                        ),
                         "latency": latency_summary(
                             list(self._class_latency_s.get(cls, ()))
                         ),
@@ -174,8 +227,38 @@ class ServeStats:
                     for cls in proto.QOS_NAMES
                     if self.class_served.get(cls, 0)
                     or self.class_busy.get(cls, 0)
+                    or self.class_deadline_shed.get(cls, 0)
                 },
             }
+
+
+class _CancelSet:
+    """Per-connection registry of OP_CANCELled request ids, shared by
+    the read loop (writer) and the verify workers (consumers).  Bounded
+    LRU: a cancel that arrives after its request already settled leaves
+    an id nobody will ever take — the cap stops a cancel-spamming
+    client from growing server memory."""
+
+    MAX = 1024
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._ids: "collections.OrderedDict[int, None]" = (
+            collections.OrderedDict()
+        )
+
+    def add(self, req_id: int) -> None:
+        with self._lock:
+            self._ids[req_id] = None
+            self._ids.move_to_end(req_id)
+            while len(self._ids) > self.MAX:
+                self._ids.popitem(last=False)
+
+    def take(self, req_id: int) -> bool:
+        """True exactly once per cancelled id (the taker owns the
+        suppression; a second racer sees False — no double-count)."""
+        with self._lock:
+            return self._ids.pop(req_id, 0) is None
 
 
 def build_provider(engine: str = "auto"):
@@ -221,6 +304,7 @@ class SidecarServer:
         ops_address: Optional[str] = None,
         qos_shares: Optional[Dict[str, float]] = None,
         drain_timeout_s: float = 5.0,
+        chaos_key: Optional[int] = None,
     ):
         from fabric_tpu.parallel.batcher import VerifyBatcher
 
@@ -248,6 +332,11 @@ class SidecarServer:
         # budget the batcher enforces and shedding is priority-aware
         self.qos = ClassLedger(max_pending_lanes, qos_shares)
         self.drain_timeout_s = drain_timeout_s
+        # chaos addressing: when set, the serve.dispatch fault point is
+        # keyed by this int so a plan's at= pin can fault ONE sidecar
+        # of an in-process fleet (the gray-failure scenarios); None
+        # keeps the PR 12 unkeyed per-site stream semantics unchanged
+        self.chaos_key = chaos_key
         self._draining = False
         self._active_verifies = 0
         self._drain_cv = threading.Condition()
@@ -504,13 +593,22 @@ class SidecarServer:
         # on a stream socket would corrupt frames
         send_lock = threading.Lock()
         workers: List[threading.Thread] = []
+        cancelled = _CancelSet()
         try:
             while True:
                 frame = proto.recv_frame_ex(conn)
                 if frame is None:
                     return
                 opcode, req_id, payload, version = frame
-                if opcode == proto.OP_PING:
+                if opcode == proto.OP_CANCEL:
+                    # fire-and-forget by contract: NO reply frame (a
+                    # response here could collide with the cancelled
+                    # request's own reply in the client's demux).  The
+                    # worker that owns req_id sheds pre-dispatch or
+                    # suppresses its reply; a cancel for an id that
+                    # already settled ages out of the bounded set.
+                    cancelled.add(req_id)
+                elif opcode == proto.OP_PING:
                     self._send(
                         conn, proto.OP_PING, req_id,
                         proto.encode_verify_response(proto.ST_OK, mask=[]),
@@ -553,7 +651,8 @@ class SidecarServer:
                     # decode if try_submit admitted its lanes
                     w = threading.Thread(
                         target=self._handle_verify,
-                        args=(conn, req_id, payload, send_lock, version),
+                        args=(conn, req_id, payload, send_lock, version,
+                              cancelled),
                         name="serve-verify", daemon=True,
                     )
                     w.start()
@@ -588,26 +687,28 @@ class SidecarServer:
     # -- the verify path ---------------------------------------------------
     def _handle_verify(
         self, conn, req_id: int, payload: bytes, send_lock=None,
-        version: int = 1,
+        version: int = 1, cancelled: Optional[_CancelSet] = None,
     ) -> None:
         """Decode, class-admit, admit, launch, reply (on a per-request
         worker thread; replies may interleave out of order — the client
         demuxes by request id).  Every failure path answers the client
         with a non-OK status (the client's degrade path owns the mask
         then) — this function must never reply OK with verdicts it did
-        not compute, and every shed is an explicit ST_BUSY frame."""
+        not compute, and every shed is an explicit ST_BUSY frame (a
+        cancelled request excepted: its client explicitly abandoned the
+        reply, which is the one sanctioned silence)."""
         t0 = time.perf_counter()
         qos_class = proto.DEFAULT_QOS
         release_qos: Optional[Callable[[], None]] = None
         entered = False
         try:
             # chaos seam: an injected dispatch fault fails THIS request
-            # with ST_ERROR before any batcher state is touched
-            fault_point("serve.dispatch")
+            # with ST_ERROR before any batcher state is touched (keyed
+            # only when the operator addressed this sidecar explicitly)
+            fault_point("serve.dispatch", key=self.chaos_key)
             with fabobs.span("serve.decode", req_id=req_id):
-                keys, sigs, digests, qos_class, channel = self._decode_lanes(
-                    payload, version
-                )
+                (keys, sigs, digests, qos_class, channel,
+                 deadline_ms) = self._decode_lanes(payload, version)
             if self._stopping or self._draining:
                 # draining: NEW work is refused here while in-flight
                 # requests (already past this gate) settle with their
@@ -626,6 +727,32 @@ class SidecarServer:
                     version=version,
                 )
                 return
+            if cancelled is not None and cancelled.take(req_id):
+                # the client abandoned this request before any batcher
+                # state was touched: shed uncomputed, nothing to reply
+                # (the one silence the protocol sanctions), no lanes to
+                # release — the QoS ledger never saw the request
+                self.stats.cancel(pre_dispatch=True)
+                return
+            if deadline_ms > 0:
+                bucket_est = (
+                    self.registry.bucket_for(len(keys))
+                    if self.registry is not None else len(keys)
+                )
+                floor = self.stats.floor_s(bucket_est)
+                if floor is not None and deadline_ms / 1000.0 < floor:
+                    # the budget is smaller than the FASTEST this
+                    # sidecar has ever served the bucket: provably
+                    # unfinishable — shed as an explicit ST_BUSY so the
+                    # client fails over/degrades NOW instead of paying
+                    # the full service time for a verdict it will drop
+                    self.stats.deadline_reject(qos_class)
+                    self._reply_status(
+                        conn, req_id, proto.ST_BUSY,
+                        retry_after_ms=self.retry_after_ms(qos_class),
+                        send_lock=send_lock, version=version,
+                    )
+                    return
             if not self.qos.try_acquire(qos_class, len(keys)):
                 self.stats.reject(qos_class)
                 self._reply_status(
@@ -641,7 +768,11 @@ class SidecarServer:
             # the finally block can never double-free
             release_qos = self._qos_release_once(qos_class, len(keys))
             resolver = self.batcher.try_submit(
-                keys, sigs, digests, on_dispatch=release_qos
+                keys, sigs, digests, on_dispatch=release_qos,
+                deadline_s=(
+                    time.monotonic() + deadline_ms / 1000.0
+                    if deadline_ms > 0 else None
+                ),
             )
             if resolver is None:
                 self.stats.reject(qos_class)
@@ -665,6 +796,17 @@ class SidecarServer:
                     conn, req_id, proto.ST_STOPPING, send_lock=send_lock,
                     version=version,
                 )
+                return
+            if cancelled is not None and cancelled.take(req_id):
+                # a cancel lost the race to the settlement: the verdict
+                # was computed but the client stopped listening —
+                # suppress the reply (the client's demux would drop it
+                # anyway) and account the wasted work.  QoS lanes were
+                # already released at dispatcher pickup; the one-shot
+                # release makes the finally-block release a no-op, so a
+                # cancel racing a settle can neither leak nor
+                # double-release lanes.
+                self.stats.cancel(pre_dispatch=False)
                 return
             bucket = (
                 self.registry.bucket_for(len(mask))
@@ -741,9 +883,8 @@ class SidecarServer:
         from fabric_tpu.common import p256
         from fabric_tpu.crypto.bccsp import ECDSAPublicKey
 
-        key_bytes, lanes, qos_class, channel = proto.decode_verify_request(
-            payload, version
-        )
+        (key_bytes, lanes, qos_class, channel,
+         deadline_ms) = proto.decode_verify_request(payload, version)
         key_objs: List[Optional[ECDSAPublicKey]] = []
         for raw in key_bytes:
             try:
@@ -758,7 +899,7 @@ class SidecarServer:
         ]
         sigs = [sig for _, sig, _ in lanes]
         digests = [d for _, _, d in lanes]
-        return keys, sigs, digests, qos_class, channel
+        return keys, sigs, digests, qos_class, channel, deadline_ms
 
     def retry_after_ms(self, qos_class: Optional[int] = None) -> int:
         """Admission-control hint: scale the base backoff by queue

@@ -1997,6 +1997,460 @@ def run_router_flap(seed: int, clock: StageClock, scale: float = 1.0):
 
 
 # ---------------------------------------------------------------------------
+# fabtail: gray_failure / hedge_storm / deadline_storm
+# ---------------------------------------------------------------------------
+
+
+def _start_tail_server(addr: str, chaos_key: int, **kw):
+    from fabric_tpu.serve.server import SidecarServer
+
+    srv = SidecarServer(
+        addr, engine="host", warm_ladder="off", buckets=(64, 256),
+        chaos_key=chaos_key, **kw,
+    )
+    srv.warm()
+    srv.start()
+    return srv
+
+
+@scenario("gray_failure")
+def run_gray_failure(seed: int, clock: StageClock, scale: float = 1.0):
+    """The third production failure mode (after death and overload): a
+    sidecar that is alive, answers PING, and is dead slow.  Two
+    sidecars behind a hedging router; the batch's PREFERRED endpoint is
+    delay-faulted at ``serve.dispatch`` (pinned to that one server via
+    its chaos key).  Asserts: (1) every mask stays bit-exact vs the
+    by-construction ground truth (the same-seed no-fault expectation);
+    (2) hedges fire and win — time-to-verdict for every faulted batch
+    stays BELOW the injected delay, i.e. the tail is bounded by the
+    hedge, not the gray sidecar; (3) after a short streak of lost
+    hedges the gray endpoint is EVICTED through the same cooldown
+    ladder as a dead one; (4) with the fault lifted it earns traffic
+    back through a probe — recovery, same ladder as death."""
+    import os
+    import shutil
+    import tempfile
+
+    from fabric_tpu.common.retry import RetryPolicy as _RP
+    from fabric_tpu.serve.router import SidecarRouter
+
+    rng = random.Random(seed * 1000003 + 15)
+    pool = LanePool(rng)
+    base = tempfile.mkdtemp(prefix="fabchaos-gray-")
+    addrs = [os.path.join(base, f"g{i}.sock") for i in range(2)]
+    servers = {
+        addr: _start_tail_server(addr, chaos_key=i + 1)
+        for i, addr in enumerate(addrs)
+    }
+    delay_ms = 1200
+    n_lanes = 32
+    router = SidecarRouter(
+        endpoints=addrs,
+        sleeper=lambda s: None,
+        # short recovery gate so the earn-back leg fits the smoke
+        gate_policy=_RP(base_s=1.0, multiplier=2.0, cap_s=1.0,
+                        deadline_s=float("inf")),
+        hedge_fraction=1.0,  # the BUDGET bound is hedge_storm's proof
+        # hedging disarmed for the warm phase (a cold first batch on a
+        # loaded box can outlast the pre-sample delay and flap the det
+        # counts); armed with a tiny floor before the fault phase
+        hedge_min_ms=10_000.0,
+    )
+    det: Dict[str, object] = {}
+    obs: Dict[str, object] = {}
+    all_masks: List[bool] = []
+    try:
+        # -- phase 1: healthy warm-up — the preferred endpoint's
+        # latency tracker learns its real quantiles (the hedge delay is
+        # derived from OBSERVED latency, never a static knob)
+        t0 = time.perf_counter()
+        warm_batches = 4
+        for _ in range(warm_batches):
+            k, s, d, e, _ = pool.lanes(rng, n_lanes)
+            out = router.batch_verify(k, s, d)
+            check(list(out) == e, "mask wrong during healthy warm-up")
+            all_masks.extend(out)
+        check(router.hedges == 0, "healthy fleet hedged")
+        clock.record("gray.warm", time.perf_counter() - t0)
+
+        # the batch size pins the preferred endpoint; THAT one goes gray
+        router.hedge_min_s = 0.015  # arm hedging, floor 15ms
+        victim = router._order(n_lanes)[0]
+        gray = servers[victim.address]
+        plan = FaultPlan.parse(
+            f"serve.dispatch=delay:1.0:ms={delay_ms}:at={gray.chaos_key}",
+            seed=seed,
+        )
+        faulted_batches = 4
+        faulted_walls: List[float] = []
+        with plan_installed(plan):
+            for _ in range(faulted_batches):
+                k, s, d, e, _ = pool.lanes(rng, n_lanes)
+                t1 = time.perf_counter()
+                out = router.batch_verify(k, s, d)
+                wall = time.perf_counter() - t1
+                faulted_walls.append(wall)
+                clock.record("gray.faulted_verdict", wall)
+                check(
+                    list(out) == e,
+                    f"mask wrong under gray failure: got {mask_hash(out)} "
+                    f"want {mask_hash(e)}",
+                )
+                all_masks.extend(out)
+        # hedges: the first two faulted batches route to the gray
+        # preferred endpoint, go silent past the learned delay, hedge,
+        # and the hedge WINS (the gray reply is 1.2s out); two straight
+        # lost hedges evict the gray endpoint, so the last two batches
+        # route direct — token accounting is count-based, so these are
+        # exact, not racy
+        check(router.hedges == 2, f"expected 2 hedges, got {router.hedges}")
+        check(
+            router.hedge_wins == 2,
+            f"expected 2 hedge wins, got {router.hedge_wins}",
+        )
+        check(
+            router.slow_evictions == 1,
+            f"expected 1 gray eviction, got {router.slow_evictions}",
+        )
+        check(not victim.healthy, "gray endpoint still in rotation")
+        check(
+            not router.degraded,
+            "router degraded in-process with a healthy endpoint up",
+        )
+        # the tail is bounded by the HEDGE, not the gray sidecar: every
+        # faulted verdict landed before the injected delay alone would
+        # have let the gray endpoint answer
+        tail_bounded = all(w < delay_ms / 1000.0 for w in faulted_walls)
+        check(
+            tail_bounded,
+            "a faulted batch waited out the gray sidecar instead of "
+            "hedging/failing over",
+        )
+
+        # -- phase 3: fault lifted — the evicted endpoint earns traffic
+        # back through the probe ladder, exactly like a restart
+        deadline = time.monotonic() + 10.0
+        recovered = False
+        while time.monotonic() < deadline:
+            if victim.gate.ready() and router._probe_ok(victim):
+                recovered = True
+                break
+            time.sleep(0.05)
+        check(recovered, "gray endpoint never earned its way back")
+        k, s, d, e, _ = pool.lanes(rng, n_lanes)
+        out = router.batch_verify(k, s, d)
+        check(list(out) == e, "mask wrong after gray recovery")
+        all_masks.extend(out)
+        det.update(
+            {
+                "endpoints": 2,
+                "delay_ms": delay_ms,
+                "warm_batches": warm_batches,
+                "faulted_batches": faulted_batches,
+                "hedges": router.hedges,
+                "hedge_wins": router.hedge_wins,
+                "slow_evictions": router.slow_evictions,
+                "gray_evicted": True,
+                "tail_bounded": tail_bounded,
+                "recovered": recovered,
+                "router_degraded": router.degraded,
+                "masks_sha": mask_hash(all_masks),
+            }
+        )
+        obs["faulted_walls_ms"] = [round(w * 1e3, 1) for w in faulted_walls]
+        obs["victim_stats"] = gray.stats.summary()
+    finally:
+        router.stop()
+        for srv in servers.values():
+            try:
+                srv.stop()
+            except Exception:  # noqa: BLE001 - teardown best-effort
+                pass
+        shutil.rmtree(base, ignore_errors=True)
+    return det, obs
+
+
+@scenario("hedge_storm")
+def run_hedge_storm(seed: int, clock: StageClock, scale: float = 1.0):
+    """Fleet-wide load with hedging armed and EVERY sidecar slow: the
+    pathological regime where naive hedging amplifies an overloaded
+    fleet into collapse.  Four driver threads push batches through one
+    hedging router over two uniformly delay-faulted sidecars.  Asserts:
+    (1) hedge-issued extra requests stay under the configured token-
+    bucket budget (burst + fraction * primaries — the count-based bound
+    holds by construction and is cross-checked against the router's
+    protocol-level counters); (2) the QoS ledger's lane accounting
+    balances to zero leaked / double-released lanes on every server
+    once traffic quiesces (hedged + cancelled lanes included); (3) no
+    admission collapse: every batch is served with a bit-exact mask,
+    none degrade to in-process."""
+    import os
+    import shutil
+    import tempfile
+
+    from fabric_tpu.serve.router import SidecarRouter
+
+    rng = random.Random(seed * 1000003 + 16)
+    pool = LanePool(rng)
+    base = tempfile.mkdtemp(prefix="fabchaos-hedge-")
+    addrs = [os.path.join(base, f"h{i}.sock") for i in range(2)]
+    servers = {
+        addr: _start_tail_server(addr, chaos_key=i + 1,
+                                 max_pending_lanes=64)
+        for i, addr in enumerate(addrs)
+    }
+    hedge_fraction = 0.1
+    n_threads, per_thread, n_lanes = 4, 5, 16
+    router = SidecarRouter(
+        endpoints=addrs,
+        hedge_fraction=hedge_fraction,
+        hedge_min_ms=5.0,
+    )
+    det: Dict[str, object] = {}
+    obs: Dict[str, object] = {}
+    # per-thread deterministic workloads, generated before threading
+    work = [
+        [pool.lanes(random.Random(seed * 4049 + t * 97 + i), n_lanes)
+         for i in range(per_thread)]
+        for t in range(n_threads)
+    ]
+    results: List[List[Optional[List[bool]]]] = [
+        [None] * per_thread for _ in range(n_threads)
+    ]
+    errors: List[str] = []
+    err_lock = threading.Lock()
+
+    def drive(t: int) -> None:
+        for i, (k, s, d, e, _kinds) in enumerate(work[t]):
+            out = clock.timed("hedge.verdict", router.batch_verify, k, s, d)
+            results[t][i] = list(out)
+            if list(out) != e:
+                with err_lock:
+                    errors.append(f"thread {t} batch {i} mask mismatch")
+
+    plan = FaultPlan.parse("serve.dispatch=delay:1.0:ms=60", seed=seed)
+    try:
+        with plan_installed(plan):
+            t0 = time.perf_counter()
+            threads = [
+                threading.Thread(target=drive, args=(t,))
+                for t in range(n_threads)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(30.0)
+            clock.record("hedge.storm_wall", time.perf_counter() - t0)
+        check(not errors, "; ".join(sorted(errors)[:3]))
+        check(
+            all(r is not None for row in results for r in row),
+            "a driver thread never finished",
+        )
+        n_primary = router.hedge_budget.earned
+        budget_cap = router.hedge_budget.burst + hedge_fraction * n_primary
+        check(
+            router.hedges <= budget_cap,
+            f"hedges {router.hedges} exceed budget cap {budget_cap}",
+        )
+        check(
+            not router.degraded,
+            "admission collapse: the fleet degraded to in-process",
+        )
+        # quiesce, then the ledger lane-flow balance must be exact on
+        # every server: acquired == released, zero in flight, zero
+        # leaked — hedged and cancelled lanes included (a double
+        # release would drive `leaked` negative, a leak positive)
+        balanced = True
+        quiesce_deadline = time.monotonic() + 10.0
+        for srv in servers.values():
+            while time.monotonic() < quiesce_deadline:
+                if srv.qos.balance()["in_flight"] == 0:
+                    break
+                time.sleep(0.02)
+            bal = srv.qos.balance()
+            if bal["in_flight"] != 0 or bal["leaked"] != 0:
+                balanced = False
+        check(balanced, "QoS ledger lane accounting did not balance")
+        # protocol-level cross-check: every served request the ledger
+        # admitted is visible in the servers' stats (no silent lanes)
+        ledger_admitted = sum(
+            sum(srv.qos.admitted) for srv in servers.values()
+        )
+        stats_requests = sum(
+            srv.stats.summary()["requests"]
+            + srv.stats.summary()["cancelled_post"]
+            for srv in servers.values()
+        )
+        check(
+            ledger_admitted == stats_requests,
+            f"ledger admitted {ledger_admitted} != protocol-visible "
+            f"{stats_requests}",
+        )
+        masks_flat: List[bool] = []
+        for row in results:
+            for r in row:
+                masks_flat.extend(r or [])
+        det.update(
+            {
+                "endpoints": 2,
+                "threads": n_threads,
+                "batches": n_threads * per_thread,
+                "mask_mismatches": 0,
+                "hedges_within_budget": True,
+                "budget_fraction": hedge_fraction,
+                "ledger_balanced": True,
+                "ledger_matches_protocol": True,
+                "no_admission_collapse": True,
+                "masks_sha": mask_hash(masks_flat),
+            }
+        )
+        obs["hedges"] = router.hedges
+        obs["hedge_wins"] = router.hedge_wins
+        obs["primaries"] = n_primary
+        obs["busy_rejects"] = router.busy_rejects
+        obs["per_server"] = [
+            {
+                "stats": srv.stats.summary(),
+                "qos_balance": srv.qos.balance(),
+            }
+            for srv in servers.values()
+        ]
+    finally:
+        router.stop()
+        for srv in servers.values():
+            try:
+                srv.stop()
+            except Exception:  # noqa: BLE001 - teardown best-effort
+                pass
+        shutil.rmtree(base, ignore_errors=True)
+    return det, obs
+
+
+@scenario("deadline_storm")
+def run_deadline_storm(seed: int, clock: StageClock, scale: float = 1.0):
+    """Aggressive wire budgets against a dead-slow sidecar: (1) the
+    SERVER sheds work it provably cannot finish (budget below its
+    best-ever service time for the bucket) as an explicit ST_BUSY —
+    never a silent drop, never a fabricated verdict; (2) a CLIENT whose
+    budget expires hands the batch to the in-process ladder and the
+    mask is bit-exact (degrade, not guess); (3) only a DOUBLE fault
+    (expired budget AND broken fallback) produces all-False."""
+    import os
+    import shutil
+    import tempfile
+
+    from fabric_tpu.serve import protocol as sproto
+    from fabric_tpu.serve.client import SidecarClient, SidecarProvider, encode_lanes
+
+    rng = random.Random(seed * 1000003 + 17)
+    pool = LanePool(rng)
+    base = tempfile.mkdtemp(prefix="fabchaos-deadline-")
+    addr = os.path.join(base, "d.sock")
+    server = _start_tail_server(addr, chaos_key=1)
+    det: Dict[str, object] = {}
+    obs: Dict[str, object] = {}
+    all_masks: List[bool] = []
+    try:
+        # -- leg 1 (no faults): the server learns its per-bucket floor,
+        # then sheds a 1ms-budget request as an explicit ST_BUSY
+        raw = SidecarClient(addr)
+        k, s, d, e, _ = pool.lanes(rng, 64)
+        status, _, mask, _ = sproto.decode_verify_response(
+            raw.request(sproto.OP_VERIFY, encode_lanes(k, s, d))
+        )
+        check(status == sproto.ST_OK and list(mask) == e,
+              "floor-establishing request failed")
+        all_masks.extend(mask)
+        status2, retry_ms, mask2, _ = sproto.decode_verify_response(
+            raw.request(
+                sproto.OP_VERIFY, encode_lanes(k, s, d, deadline_ms=1)
+            )
+        )
+        check(
+            status2 == sproto.ST_BUSY and mask2 is None,
+            f"provably-unfinishable budget answered status {status2}, "
+            "not an explicit ST_BUSY",
+        )
+        check(retry_ms >= 5, "deadline shed without a retry_after hint")
+        check(
+            server.stats.deadline_shed == 1,
+            f"deadline_shed counted {server.stats.deadline_shed}, not 1",
+        )
+        raw.close()
+
+        # -- leg 2: delay-faulted sidecar + 40ms client budgets — every
+        # batch expires, degrades to the in-process ladder, and the
+        # mask is STILL bit-exact (an expired budget buys an earlier
+        # failover, never a fabricated verdict)
+        n_batches = 3
+        plan = FaultPlan.parse("serve.dispatch=delay:1.0:ms=600", seed=seed)
+        with plan_installed(plan):
+            provider = SidecarProvider(address=addr, deadline_ms=40)
+            t0 = time.perf_counter()
+            for _ in range(n_batches):
+                k, s, d, e, _ = pool.lanes(rng, 24)
+                out = clock.timed(
+                    "deadline.expired_verdict", provider.batch_verify,
+                    k, s, d,
+                )
+                check(
+                    list(out) == e,
+                    "mask wrong after deadline degrade: got "
+                    f"{mask_hash(out)} want {mask_hash(e)}",
+                )
+                all_masks.extend(out)
+            wall = time.perf_counter() - t0
+            check(
+                provider.deadline_expired == n_batches,
+                f"{provider.deadline_expired} budgets expired, "
+                f"expected {n_batches}",
+            )
+            check(provider.degraded, "expired budgets never degraded")
+            # the whole leg must complete far below the injected delay
+            # times the batch count: budgets bound time-to-verdict
+            check(
+                wall < n_batches * 0.6,
+                "deadline leg waited out the slow sidecar",
+            )
+            provider.stop()
+
+            # -- leg 3: expired budget AND broken fallback: the ONLY
+            # path to all-False (fail closed, never fabricated VALID)
+            class _Exploding:
+                def batch_verify(self, keys, sigs, digests):
+                    raise RuntimeError("fallback broken too")
+
+            double = SidecarProvider(
+                address=addr, deadline_ms=40, fallback=_Exploding()
+            )
+            k, s, d, e, _ = pool.lanes(rng, 16)
+            out = double.batch_verify(k, s, d)
+            check(
+                list(out) == [False] * len(k),
+                "double fault did not fail closed all-False",
+            )
+            double.stop()
+        det.update(
+            {
+                "floor_request_lanes": 64,
+                "server_shed_status": "busy",
+                "server_deadline_shed": server.stats.deadline_shed,
+                "client_budget_ms": 40,
+                "expired_batches": n_batches,
+                "deadline_expired": n_batches,
+                "masks_exact": True,
+                "all_false_on_double_fault": True,
+                "masks_sha": mask_hash(all_masks),
+            }
+        )
+        obs["server_stats"] = server.stats.summary()
+    finally:
+        server.stop()
+        shutil.rmtree(base, ignore_errors=True)
+    return det, obs
+
+
+# ---------------------------------------------------------------------------
 # gossip_storm: block dissemination over a lossy gossip plane
 # ---------------------------------------------------------------------------
 
@@ -2684,6 +3138,9 @@ SMOKE = (
     "serve_flap",
     "qos_storm",
     "router_flap",
+    "gray_failure",
+    "hedge_storm",
+    "deadline_storm",
     "raft_churn",
 )
 

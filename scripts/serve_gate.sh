@@ -207,4 +207,100 @@ if [ $rc_a -ne 0 ] && [ $rc_b -ne 0 ]; then
     cat "${LOG_A}" "${LOG_B}" >&2
     exit 1
 fi
-echo "serve_gate: OK (mixed batch exact, clean shutdown; fleet failover exact, clean drain)"
+
+# ---------------------------------------------------------------------------
+# hedge leg (<10s, fabtail): two subprocess sidecars, ONE delay-faulted
+# (gray: alive, answers PING, dead slow — a per-process env fault plan).
+# The hedging router must win the race on the healthy peer with a mask
+# bit-exact vs ground truth, bounded far below the injected delay.
+# ---------------------------------------------------------------------------
+SOCK_G="${SOCK_DIR}/hedge_gray.sock"
+SOCK_H="${SOCK_DIR}/hedge_ok.sock"
+LOG_G="$(mktemp)"
+LOG_H="$(mktemp)"
+
+cleanup3() {
+    [ -n "${PID_G:-}" ] && kill -9 "${PID_G}" 2>/dev/null
+    [ -n "${PID_H:-}" ] && kill -9 "${PID_H}" 2>/dev/null
+    rm -f "${LOG_G}" "${LOG_H}"
+}
+trap 'cleanup3; cleanup2; cleanup' EXIT
+
+# the router prefers endpoints by rendezvous hash on the lane bucket
+# (96 lanes -> bucket 128): the PREFERRED one goes gray, so every
+# batch routes into the delay fault and must be rescued by a hedge
+SOCK_G=$(python -c "
+import hashlib, sys
+key = lambda a: hashlib.sha256(('128|' + a).encode()).digest()
+print(min(sys.argv[1:], key=key))
+" "${SOCK_DIR}/hedge_gray.sock" "${SOCK_DIR}/hedge_ok.sock")
+if [ "${SOCK_G}" = "${SOCK_DIR}/hedge_gray.sock" ]; then
+    SOCK_H="${SOCK_DIR}/hedge_ok.sock"
+else
+    SOCK_H="${SOCK_DIR}/hedge_gray.sock"
+fi
+
+env FABRIC_TPU_FAULTS="serve.dispatch=delay:1.0:ms=2000" \
+    FABRIC_TPU_FAULTS_SEED=1 python -m fabric_tpu.serve \
+    --address "${SOCK_G}" --engine host --warm off >"${LOG_G}" 2>&1 &
+PID_G=$!
+python -m fabric_tpu.serve \
+    --address "${SOCK_H}" --engine host --warm off >"${LOG_H}" 2>&1 &
+PID_H=$!
+
+for _ in $(seq 1 100); do
+    grep -q "^SERVE_READY" "${LOG_G}" 2>/dev/null \
+        && grep -q "^SERVE_READY" "${LOG_H}" 2>/dev/null && break
+    sleep 0.1
+done
+if ! grep -q "^SERVE_READY" "${LOG_G}" || ! grep -q "^SERVE_READY" "${LOG_H}"; then
+    echo "serve_gate: hedge-leg sidecars never became ready" >&2
+    cat "${LOG_G}" "${LOG_H}" >&2
+    exit 1
+fi
+
+timeout -k 5 30 python - "${SOCK_G}" "${SOCK_H}" <<'EOF'
+import sys
+import time
+
+from fabric_tpu.serve.fleetload import build_lanes
+from fabric_tpu.serve.router import SidecarRouter
+
+gray, healthy = sys.argv[1], sys.argv[2]
+# EVERY batch that prefers the gray endpoint must be rescued by a
+# hedge: generous budget, tiny learned-delay floor
+router = SidecarRouter(endpoints=[gray, healthy],
+                       hedge_fraction=1.0, hedge_min_ms=25.0)
+k, s, d, e = build_lanes(96, 5)
+walls = []
+for _ in range(3):
+    t0 = time.monotonic()
+    mask = router.batch_verify(k, s, d)
+    walls.append(time.monotonic() - t0)
+    assert list(mask) == e, "mask wrong under gray failure"
+assert not router.degraded, "router degraded with a healthy peer up"
+# the gray endpoint answers only after its 2s delay fault: any verdict
+# faster than that was won by a hedge or served direct post-eviction
+assert max(walls) < 2.0, f"tail not bounded: {walls}"
+assert router.hedges >= 1 and router.hedge_wins >= 1, router.describe()
+print("serve_gate hedge: %d hedges, %d wins, %d slow evictions, "
+      "max wall %.0fms (delay 2000ms), masks exact"
+      % (router.hedges, router.hedge_wins, router.slow_evictions,
+         max(walls) * 1e3))
+router.stop()
+EOF
+rc=$?
+if [ $rc -ne 0 ]; then
+    echo "serve_gate: hedge leg FAILED" >&2
+    cat "${LOG_G}" "${LOG_H}" >&2
+    exit $rc
+fi
+kill "${PID_G}" "${PID_H}" 2>/dev/null
+for _ in $(seq 1 40); do
+    kill -0 "${PID_G}" 2>/dev/null || kill -0 "${PID_H}" 2>/dev/null || break
+    sleep 0.25
+done
+cleanup3
+PID_G=""; PID_H=""
+
+echo "serve_gate: OK (mixed batch exact, clean shutdown; fleet failover exact, clean drain; hedge wins over gray sidecar)"

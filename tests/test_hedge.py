@@ -1,0 +1,590 @@
+"""fabtail hedged verification + gray-failure eviction (serve/router)
+and the OP_CANCEL races (serve/server): hedge delay from observed
+quantiles, token-bucket budget math, first-verdict-wins with loser
+cancellation, cancel-after-settle / settle-after-cancel /
+cancel-before-dispatch, hedge-loser-after-degrade discarded unseen,
+latency-outlier eviction, and the short-timeout health probe.  The
+fleet-scale soaks are slow-marked; the unit tests here are their
+tier-1 canaries."""
+
+import threading
+import time
+
+import pytest
+
+from fabric_tpu.common.faults import FaultPlan, plan_installed
+from fabric_tpu.common.retry import RetryPolicy
+from fabric_tpu.crypto.bccsp import SoftwareProvider
+from fabric_tpu.serve import protocol as proto
+from fabric_tpu.serve.client import SidecarClient, encode_lanes
+from fabric_tpu.serve.router import (
+    SidecarRouter,
+    _HedgeBudget,
+    _LatencyTracker,
+    hedge_fraction_from_env,
+    hedge_min_ms_from_env,
+)
+from fabric_tpu.serve.server import SidecarServer
+
+from tests.test_serve import mixed_lanes
+
+FAST_GATE = RetryPolicy(
+    base_s=0.05, multiplier=2.0, cap_s=0.5, deadline_s=float("inf")
+)
+
+
+def start_sidecar(addr, chaos_key=None, provider=None):
+    server = SidecarServer(
+        str(addr), engine="host", warm_ladder="off", buckets=(64, 256),
+        chaos_key=chaos_key, provider=provider,
+    )
+    if provider is None:
+        server.warm()
+    server.start()
+    return server
+
+
+class _GatedProvider:
+    """Dispatch stalls behind a re-armable gate: compute happens
+    eagerly (masks stay exact), the resolver is withheld until
+    release — settle timing becomes a construction, not a race."""
+
+    def __init__(self):
+        self._sw = SoftwareProvider()
+        self.gate = threading.Event()
+        self.entered = threading.Event()
+
+    def batch_verify(self, keys, sigs, digests):
+        return self._sw.batch_verify(keys, sigs, digests)
+
+    def batch_verify_async(self, keys, sigs, digests):
+        out = self._sw.batch_verify(keys, sigs, digests)
+        self.entered.set()
+        self.gate.wait(20.0)
+        return lambda: out
+
+    def release(self):
+        self.gate.set()
+
+    def rearm(self):
+        self.gate.clear()
+        self.entered.clear()
+
+
+# ---------------------------------------------------------------------------
+# units: tracker + budget + env knobs
+# ---------------------------------------------------------------------------
+
+
+class TestLatencyTracker:
+    def test_quantiles_and_ewma(self):
+        t = _LatencyTracker()
+        assert t.quantile(0.95) is None
+        for ms in (10, 20, 30, 40, 1000):
+            t.record(ms / 1000.0)
+        assert t.samples == 5
+        assert t.quantile(0.0) == 0.010
+        assert t.quantile(1.0) == 1.0
+        assert 0.0 < t.ewma_s < 1.0
+
+    def test_window_is_bounded_newest_win(self):
+        t = _LatencyTracker()
+        for _ in range(t.WINDOW + 50):
+            t.record(0.001)
+        t.record(5.0)
+        assert len(t._window) == t.WINDOW
+        assert t.quantile(1.0) == 5.0  # the newest sample survived
+
+
+class TestHedgeBudget:
+    def test_fraction_bounds_spend(self):
+        b = _HedgeBudget(fraction=0.5, burst=2.0)
+        assert b.try_spend()  # the initial token
+        assert not b.try_spend()  # bucket empty
+        b.earn()
+        assert not b.try_spend()  # 0.5 tokens: not yet
+        b.earn()
+        assert b.try_spend()  # 1.0 earned across 2 primaries
+        # lifetime bound: spends <= burst + fraction * earned, always
+        spends = 2
+        assert spends <= b.burst + b.fraction * b.earned
+
+    def test_burst_caps_idle_accrual(self):
+        b = _HedgeBudget(fraction=1.0, burst=2.0)
+        for _ in range(100):
+            b.earn()
+        assert b.try_spend() and b.try_spend()
+        assert not b.try_spend()  # never more than burst banked
+
+    def test_zero_fraction_disables(self):
+        b = _HedgeBudget(fraction=0.0)
+        b.earn()
+        assert not b.try_spend()
+
+    def test_env_knobs(self, monkeypatch):
+        monkeypatch.setenv("FABRIC_TPU_SERVE_HEDGE_FRACTION", "0.2")
+        monkeypatch.setenv("FABRIC_TPU_SERVE_HEDGE_MIN_MS", "7.5")
+        assert hedge_fraction_from_env() == 0.2
+        assert hedge_min_ms_from_env() == 7.5
+        monkeypatch.setenv("FABRIC_TPU_SERVE_HEDGE_FRACTION", "junk")
+        monkeypatch.setenv("FABRIC_TPU_SERVE_HEDGE_MIN_MS", "junk")
+        assert hedge_fraction_from_env() == 0.05  # malformed: default
+        assert hedge_min_ms_from_env() == 20.0
+
+
+# ---------------------------------------------------------------------------
+# hedged verification end to end
+# ---------------------------------------------------------------------------
+
+
+class TestHedgedVerify:
+    def test_hedge_wins_against_gray_endpoint(self, tmp_path):
+        """One sidecar delay-faulted (alive, answers PING, dead slow):
+        the hedge fires after the learned delay, wins on the healthy
+        peer, the mask is bit-exact, and the gray loser's reply is
+        suppressed server-side (OP_CANCEL) or dropped client-side."""
+        servers = {
+            str(tmp_path / f"h{i}.sock"): start_sidecar(
+                tmp_path / f"h{i}.sock", chaos_key=i + 1
+            )
+            for i in range(2)
+        }
+        router = SidecarRouter(
+            endpoints=list(servers), gate_policy=FAST_GATE,
+            hedge_fraction=1.0, hedge_min_ms=10_000.0,  # disarmed for warm
+        )
+        try:
+            k, s, d, e = mixed_lanes(32)
+            # warm: the preferred endpoint's tracker learns its shape
+            for _ in range(3):
+                assert list(router.batch_verify(k, s, d)) == e
+            assert router.hedges == 0
+            router.hedge_min_s = 0.010  # armed: floor 10ms
+            victim = router._order(32)[0]
+            gray = servers[victim.address]
+            plan = FaultPlan.parse(
+                f"serve.dispatch=delay:1.0:ms=1500:at={gray.chaos_key}",
+                seed=3,
+            )
+            with plan_installed(plan):
+                t0 = time.monotonic()
+                mask = router.batch_verify(k, s, d)
+                wall = time.monotonic() - t0
+            assert list(mask) == e
+            assert router.hedges == 1 and router.hedge_wins == 1
+            assert wall < 1.5  # bounded by the hedge, not the gray delay
+            assert not router.degraded
+            # the loser is eventually accounted: either its OP_CANCEL
+            # landed before dispatch/reply (cancelled_*) — never a
+            # protocol error, never a served double-count
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                st = gray.stats.summary()
+                if st["cancelled_pre"] + st["cancelled_post"] >= 1:
+                    break
+                time.sleep(0.05)
+            st = gray.stats.summary()
+            assert st["cancelled_pre"] + st["cancelled_post"] == 1
+            assert gray.qos.balance()["leaked"] == 0
+        finally:
+            router.stop()
+            for srv in servers.values():
+                srv.stop()
+
+    def test_hedge_budget_denies_without_tokens(self, tmp_path):
+        """fraction=0 turns hedging off entirely: the gray endpoint is
+        simply waited on (legacy behavior) — proof the budget gates the
+        hedge path, so an overloaded fleet cannot be amplified."""
+        servers = [
+            start_sidecar(tmp_path / f"n{i}.sock", chaos_key=i + 1)
+            for i in range(2)
+        ]
+        router = SidecarRouter(
+            endpoints=[s.address for s in servers],
+            gate_policy=FAST_GATE, hedge_fraction=0.0, hedge_min_ms=5.0,
+        )
+        try:
+            k, s, d, e = mixed_lanes(16)
+            victim_addr = router._order(16)[0].address
+            gray = next(x for x in servers if x.address == victim_addr)
+            plan = FaultPlan.parse(
+                f"serve.dispatch=delay:1.0:ms=300:at={gray.chaos_key}",
+                seed=3,
+            )
+            with plan_installed(plan):
+                t0 = time.monotonic()
+                mask = router.batch_verify(k, s, d)
+                wall = time.monotonic() - t0
+            assert list(mask) == e
+            assert router.hedges == 0
+            assert wall >= 0.3  # waited the gray delay out: no hedge
+        finally:
+            router.stop()
+            for srv in servers:
+                srv.stop()
+
+    def test_hedge_loser_after_degrade_discarded_unseen(self, tmp_path):
+        """Both endpoints dead slow + a tight budget: the router
+        degrades to the in-process ladder (bit-exact), and the late
+        verdicts — primary's AND any hedge's — are discarded unseen
+        (cancelled server-side or dropped by the demux).  The ledger
+        must still balance once the slow workers finish."""
+        servers = [
+            start_sidecar(tmp_path / f"s{i}.sock", chaos_key=i + 1)
+            for i in range(2)
+        ]
+        router = SidecarRouter(
+            endpoints=[s.address for s in servers],
+            gate_policy=FAST_GATE, hedge_fraction=1.0, hedge_min_ms=5.0,
+            deadline_ms=80,
+        )
+        try:
+            k, s, d, e = mixed_lanes(24)
+            plan = FaultPlan.parse("serve.dispatch=delay:1.0:ms=600", seed=3)
+            with plan_installed(plan):
+                t0 = time.monotonic()
+                mask = router.batch_verify(k, s, d)
+                wall = time.monotonic() - t0
+            assert list(mask) == e  # the in-process ladder, bit-exact
+            assert router.deadline_expired == 1
+            assert router.degraded
+            assert wall < 0.5  # the budget, not the 600ms delay
+            # late verdicts from the abandoned sockets must vanish:
+            # wait for the slow workers, then check nothing leaked
+            deadline = time.monotonic() + 5.0
+            for srv in servers:
+                while time.monotonic() < deadline:
+                    if srv.qos.balance()["in_flight"] == 0:
+                        break
+                    time.sleep(0.05)
+                assert srv.qos.balance()["leaked"] == 0
+            # and the router still serves normally afterwards
+            mask2 = router.batch_verify(k, s, d)
+            assert list(mask2) == e
+        finally:
+            router.stop()
+            for srv in servers:
+                srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# gray-failure eviction
+# ---------------------------------------------------------------------------
+
+
+class TestGrayEviction:
+    def test_consecutive_hedge_losses_evict(self, tmp_path):
+        """Two straight lost hedges pull the gray endpoint from
+        rotation through the CooldownGate ladder (counted as a slow
+        eviction), and with the fault lifted it earns its way back
+        through a probe — the same ladder as death."""
+        servers = {
+            str(tmp_path / f"e{i}.sock"): start_sidecar(
+                tmp_path / f"e{i}.sock", chaos_key=i + 1
+            )
+            for i in range(2)
+        }
+        router = SidecarRouter(
+            endpoints=list(servers), gate_policy=FAST_GATE,
+            hedge_fraction=1.0, hedge_min_ms=10_000.0,  # disarmed for warm
+        )
+        try:
+            k, s, d, e = mixed_lanes(32)
+            for _ in range(3):
+                assert list(router.batch_verify(k, s, d)) == e
+            router.hedge_min_s = 0.010  # armed: floor 10ms
+            victim = router._order(32)[0]
+            gray = servers[victim.address]
+            plan = FaultPlan.parse(
+                f"serve.dispatch=delay:1.0:ms=1500:at={gray.chaos_key}",
+                seed=5,
+            )
+            with plan_installed(plan):
+                for _ in range(router.HEDGE_LOSS_EVICT):
+                    assert list(router.batch_verify(k, s, d)) == e
+                assert router.slow_evictions == 1
+                assert not victim.healthy
+                # while evicted, traffic routes direct to the healthy
+                # peer — no hedge, no gray wait
+                t0 = time.monotonic()
+                assert list(router.batch_verify(k, s, d)) == e
+                assert time.monotonic() - t0 < 1.0
+            # fault lifted: the probe ladder brings it back
+            deadline = time.monotonic() + 5.0
+            back = False
+            while time.monotonic() < deadline:
+                if victim.gate.ready() and router._probe_ok(victim):
+                    back = True
+                    break
+                time.sleep(0.02)
+            assert back and victim.healthy
+        finally:
+            router.stop()
+            for srv in servers.values():
+                srv.stop()
+
+    def test_last_endpoint_never_slow_evicted(self, tmp_path):
+        """Gray eviction is a RELATIVE judgment: with every peer dead,
+        the slow survivor stays in rotation (a slow verdict beats
+        degrading the fleet in-process) — and a dead peer's frozen
+        healthy-era EWMA must not serve as the outlier baseline."""
+        servers = [
+            start_sidecar(tmp_path / f"l{i}.sock") for i in range(2)
+        ]
+        router = SidecarRouter(
+            endpoints=[s.address for s in servers], gate_policy=FAST_GATE,
+        )
+        try:
+            fast, slow = router.endpoints
+            # fast serves quickly, then dies with its EWMA frozen
+            for _ in range(router.SLOW_MIN_SAMPLES):
+                fast.tracker.record(0.005)
+            fast.mark_down("crashed")
+            # the survivor is 60ms — an outlier against the ghost's
+            # 5ms, but the only endpoint in rotation: never evicted
+            for _ in range(router.SLOW_MIN_SAMPLES * 2):
+                router._note_latency(slow, 0.06)
+            assert router.slow_evictions == 0
+            assert slow.healthy
+            k, s, d, e = mixed_lanes(16)
+            assert list(router.batch_verify(k, s, d)) == e
+            assert not router.degraded
+        finally:
+            router.stop()
+            for srv in servers:
+                srv.stop()
+
+    def test_ewma_outlier_eviction_math(self, tmp_path):
+        """The latency-outlier rule on recorded samples: an endpoint
+        whose EWMA sits far above the fleet best (and the absolute
+        floor) is evicted on its next served verdict."""
+        servers = [
+            start_sidecar(tmp_path / f"w{i}.sock") for i in range(2)
+        ]
+        router = SidecarRouter(
+            endpoints=[s.address for s in servers], gate_policy=FAST_GATE,
+        )
+        try:
+            fast, slow = router.endpoints
+            for _ in range(router.SLOW_MIN_SAMPLES):
+                fast.tracker.record(0.01)
+                slow.tracker.record(0.01)
+            # the slow endpoint drifts: its EWMA crosses 4x fleet best
+            for _ in range(router.SLOW_MIN_SAMPLES):
+                router._note_latency(slow, 0.5)
+            assert router.slow_evictions >= 1
+            assert not slow.healthy
+            assert fast.healthy
+        finally:
+            router.stop()
+            for srv in servers:
+                srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# OP_CANCEL races (the bookkeeping the tentpole calls the hard part)
+# ---------------------------------------------------------------------------
+
+
+class TestCancelRaces:
+    def _lanes_payload(self, n=16, seed=0, deadline_ms=0):
+        k, s, d, e = mixed_lanes(n, seed=seed)
+        return encode_lanes(k, s, d, deadline_ms=deadline_ms), e
+
+    def test_cancel_after_settle_is_a_noop(self, tmp_path):
+        """A cancel that loses the race to the settlement: the client
+        already consumed the reply, the server's stale cancel id ages
+        out of the bounded set — nothing double-counts, the connection
+        keeps serving."""
+        server = start_sidecar(tmp_path / "c1.sock")
+        client = SidecarClient(server.address)
+        try:
+            payload, e = self._lanes_payload()
+            token = client.submit(proto.OP_VERIFY, payload)
+            status, _, mask, _ = proto.decode_verify_response(
+                client.await_reply(token)
+            )
+            assert status == proto.ST_OK and list(mask) == e
+            client.cancel(token)  # local no-op: already consumed
+            # the stale server-side cancel (raw frame, same rid)
+            proto.send_frame(
+                client._sock, proto.OP_CANCEL, token, b"", version=3
+            )
+            payload2, e2 = self._lanes_payload(seed=2)
+            status2, _, mask2, _ = proto.decode_verify_response(
+                client.request(proto.OP_VERIFY, payload2)
+            )
+            assert status2 == proto.ST_OK and list(mask2) == e2
+            st = server.stats.summary()
+            assert st["cancelled_pre"] == 0 and st["cancelled_post"] == 0
+        finally:
+            client.close()
+            server.stop()
+
+    def test_settle_after_cancel_suppresses_reply(self, tmp_path):
+        """A cancel that beats the settlement: the verdict is computed
+        but the reply is suppressed (cancelled_post), lanes release
+        exactly once (no leak, no double-release), and the connection
+        keeps serving."""
+        gp = _GatedProvider()
+        server = start_sidecar(tmp_path / "c2.sock", provider=gp)
+        client = SidecarClient(server.address)
+        try:
+            payload, _e = self._lanes_payload()
+            token = client.submit(proto.OP_VERIFY, payload)
+            assert gp.entered.wait(5.0)  # dispatched, held at the gate
+            client.cancel(token)
+            time.sleep(0.1)  # let the cancel frame land in the set
+            gp.release()
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                if server.stats.summary()["cancelled_post"] == 1:
+                    break
+                time.sleep(0.02)
+            st = server.stats.summary()
+            assert st["cancelled_post"] == 1
+            assert st["requests"] == 0  # never recorded as served
+            assert server.qos.balance()["leaked"] == 0
+            gp.rearm()
+            payload2, e2 = self._lanes_payload(seed=3)
+            tok2 = client.submit(proto.OP_VERIFY, payload2)
+            assert gp.entered.wait(5.0)
+            gp.release()
+            status, _, mask, _ = proto.decode_verify_response(
+                client.await_reply(tok2)
+            )
+            assert status == proto.ST_OK and list(mask) == e2
+        finally:
+            gp.release()
+            client.close()
+            server.stop()
+
+    def test_cancel_before_dispatch_sheds_uncomputed(self, tmp_path):
+        """A cancel that arrives while the worker is still ahead of
+        admission (pinned by a dispatch delay): the request is shed
+        uncomputed (cancelled_pre), the QoS ledger never sees it."""
+        server = start_sidecar(tmp_path / "c3.sock")
+        client = SidecarClient(server.address)
+        try:
+            acquired_before = server.qos.balance()["acquired"]
+            plan = FaultPlan.parse("serve.dispatch=delay:1.0:ms=300", seed=1)
+            payload, _e = self._lanes_payload()
+            with plan_installed(plan):
+                token = client.submit(proto.OP_VERIFY, payload)
+                client.cancel(token)  # lands while the worker sleeps
+                deadline = time.monotonic() + 5.0
+                while time.monotonic() < deadline:
+                    if server.stats.summary()["cancelled_pre"] == 1:
+                        break
+                    time.sleep(0.02)
+            st = server.stats.summary()
+            assert st["cancelled_pre"] == 1
+            assert server.qos.balance()["acquired"] == acquired_before
+            payload2, e2 = self._lanes_payload(seed=4)
+            status, _, mask, _ = proto.decode_verify_response(
+                client.request(proto.OP_VERIFY, payload2)
+            )
+            assert status == proto.ST_OK and list(mask) == e2
+        finally:
+            client.close()
+            server.stop()
+
+    def test_cancel_not_sent_below_v3(self, tmp_path):
+        """A v2-latched connection never emits OP_CANCEL (an old server
+        would kill the stream on the unknown opcode): cancel() is a
+        local drop only."""
+        server = start_sidecar(tmp_path / "c4.sock")
+        client = SidecarClient(server.address)
+        try:
+            payload, _e = self._lanes_payload()
+            client.ensure_connected()
+            client.version = 2  # the old-vintage latch
+            sent = []
+            orig = proto.send_frame
+
+            def spy(sock, opcode, req_id, body, version=3):
+                sent.append(opcode)
+                return orig(sock, opcode, req_id, body, version=version)
+
+            token = client.submit(proto.OP_VERIFY, payload)
+            import fabric_tpu.serve.client as client_mod
+
+            client_mod.proto.send_frame, restore = spy, orig
+            try:
+                client.cancel(token)
+            finally:
+                client_mod.proto.send_frame = restore
+            assert proto.OP_CANCEL not in sent
+        finally:
+            client.close()
+            server.stop()
+
+
+# ---------------------------------------------------------------------------
+# short-timeout health probes (satellite regression)
+# ---------------------------------------------------------------------------
+
+
+class TestProbeTimeout:
+    def test_probe_does_not_ride_full_request_timeout(self, tmp_path):
+        """An endpoint that accepts connections but never answers (the
+        gray worst case) must fail a health probe within the probe's
+        own short budget — pre-fix it held the probe path for the full
+        120s request timeout."""
+        import socket as _socket
+
+        addr = str(tmp_path / "black.sock")
+        listener = _socket.socket(_socket.AF_UNIX, _socket.SOCK_STREAM)
+        listener.bind(addr)
+        listener.listen(4)
+        held = []
+
+        def hold_forever():
+            while True:
+                try:
+                    conn, _ = listener.accept()
+                except OSError:
+                    return
+                held.append(conn)  # accept, never answer
+
+        t = threading.Thread(target=hold_forever, daemon=True)
+        t.start()
+        router = SidecarRouter(endpoints=[addr], gate_policy=FAST_GATE)
+        try:
+            target = router.endpoints[0]
+            target.mark_down("make the probe path run")
+            t0 = time.monotonic()
+            assert not router._probe_ok(target)
+            wall = time.monotonic() - t0
+            # dial + hello ride the connect budget, the ping its probe
+            # budget: seconds, never the 120s request timeout
+            assert wall < 15.0
+        finally:
+            router.stop()
+            listener.close()
+            for c in held:
+                c.close()
+            t.join(timeout=2.0)
+
+
+# ---------------------------------------------------------------------------
+# fleet-scale soaks (slow; the scenarios above are the tier-1 canaries)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_gray_failure_soak_rotating_seeds():
+    from fabric_tpu.tools.fabchaos import SCENARIOS, StageClock
+
+    for i in range(3):
+        det, _ = SCENARIOS["gray_failure"](31 + i * 101, StageClock(), 1.0)
+        assert det["tail_bounded"] and det["gray_evicted"] and det["recovered"]
+
+
+@pytest.mark.slow
+def test_hedge_storm_soak_rotating_seeds():
+    from fabric_tpu.tools.fabchaos import SCENARIOS, StageClock
+
+    for i in range(3):
+        det, _ = SCENARIOS["hedge_storm"](47 + i * 101, StageClock(), 1.0)
+        assert det["hedges_within_budget"] and det["ledger_balanced"]

@@ -127,14 +127,14 @@ class TestProtocol:
         table = [b"\x04" + b"\x01" * 64, b"\x04" + b"\x02" * 64]
         lanes = [(0, b"sig0", b"d" * 32), (proto.NO_KEY, b"", b"e" * 32),
                  (1, b"sig2", b"f" * 32)]
-        out_table, out_lanes, qos, chan = proto.decode_verify_request(
+        out_table, out_lanes, qos, chan, _dl = proto.decode_verify_request(
             proto.encode_verify_request(table, lanes)
         )
         assert out_table == table
         assert out_lanes == lanes
         assert (qos, chan) == (proto.DEFAULT_QOS, "")
         # protocol rev 2: the QoS prefix rides the same lane table
-        out_table, out_lanes, qos, chan = proto.decode_verify_request(
+        out_table, out_lanes, qos, chan, _dl = proto.decode_verify_request(
             proto.encode_verify_request(
                 table, lanes, qos_class=proto.QOS_HIGH, channel="paychan"
             ),
@@ -169,9 +169,9 @@ class TestProtocol:
     def test_encode_lanes_dedups_keys(self):
         keys, sigs, digests, _ = mixed_lanes(10)
         payload = encode_lanes(keys, sigs, digests)
-        # encode_lanes defaults to the rev-2 body (QoS prefix)
-        table, lanes, _qos, _chan = proto.decode_verify_request(
-            payload, version=2
+        # encode_lanes defaults to the current-revision body
+        table, lanes, _qos, _chan, _dl = proto.decode_verify_request(
+            payload, version=proto.PROTOCOL_VERSION
         )
         assert len(table) == 1  # one distinct key object
         assert [i for i, _, _ in lanes].count(proto.NO_KEY) == 2  # no_key kind

@@ -1,0 +1,481 @@
+"""fabtail wire deadlines (serve protocol rev 3): v3 framing, the
+v1/v2/v3 negotiation downgrade matrix (old server x new client, new
+server x old client — deadline/hedge fields dropped cleanly, masks
+identical), the server's provably-unfinishable ST_BUSY shed, the
+client's budget-derived waits (BUSY retry capped by the remaining
+deadline — the PR 14 satellite regression), and the batcher's
+deadline-capped linger."""
+
+import struct
+import threading
+import time
+
+import pytest
+
+from fabric_tpu.crypto.bccsp import SoftwareProvider
+from fabric_tpu.serve import protocol as proto
+from fabric_tpu.serve.client import (
+    SidecarClient,
+    SidecarProvider,
+    deadline_ms_from_env,
+    encode_lanes,
+)
+from fabric_tpu.serve.server import SidecarServer
+
+from tests.test_serve import mixed_lanes
+
+
+@pytest.fixture
+def sidecar(tmp_path):
+    addr = str(tmp_path / "dl.sock")
+    server = SidecarServer(addr, engine="host", warm_ladder="off",
+                           buckets=(64, 256))
+    server.warm()
+    server.start()
+    yield server
+    server.stop()
+
+
+# ---------------------------------------------------------------------------
+# protocol rev 3 framing
+# ---------------------------------------------------------------------------
+
+
+class TestProtocolV3:
+    TABLE = [b"\x04" + b"\x01" * 64]
+    LANES = [(0, b"sig", b"d" * 32), (proto.NO_KEY, b"", b"e" * 32)]
+
+    def test_deadline_roundtrip(self):
+        payload = proto.encode_verify_request(
+            self.TABLE, self.LANES, qos_class=proto.QOS_HIGH,
+            channel="paychan", deadline_ms=1234,
+        )
+        keys, lanes, qos, chan, dl = proto.decode_verify_request(
+            payload, version=3
+        )
+        assert (keys, lanes) == (self.TABLE, self.LANES)
+        assert (qos, chan, dl) == (proto.QOS_HIGH, "paychan", 1234)
+
+    def test_zero_deadline_means_none(self):
+        payload = proto.encode_verify_request(
+            self.TABLE, self.LANES, qos_class=proto.QOS_NORMAL,
+            deadline_ms=0,
+        )
+        *_rest, dl = proto.decode_verify_request(payload, version=3)
+        assert dl == 0
+
+    def test_pre_v3_bodies_carry_no_deadline_bytes(self):
+        """The v1/v2 layouts are byte-identical to their PR 12 shapes:
+        the deadline field exists only on v3 bodies."""
+        v2 = proto.encode_verify_request(
+            self.TABLE, self.LANES, qos_class=proto.QOS_BULK
+        )
+        v3 = proto.encode_verify_request(
+            self.TABLE, self.LANES, qos_class=proto.QOS_BULK, deadline_ms=7
+        )
+        assert len(v3) == len(v2) + 4
+        *_r2, dl2 = proto.decode_verify_request(v2, version=2)
+        assert dl2 == 0  # old body: no budget, never an error
+        v1 = proto.encode_verify_request(self.TABLE, self.LANES)
+        *_r1, dl1 = proto.decode_verify_request(v1, version=1)
+        assert dl1 == 0
+
+    def test_deadline_requires_qos_prefix(self):
+        with pytest.raises(proto.ProtocolError, match="QoS prefix"):
+            proto.encode_verify_request(
+                self.TABLE, self.LANES, qos_class=None, deadline_ms=5
+            )
+
+    def test_encode_lanes_version_picks_body_layout(self):
+        k, s, d, _e = mixed_lanes(4)
+        for version in (1, 2, 3):
+            payload = encode_lanes(k, s, d, version=version)
+            out = proto.decode_verify_request(payload, version=version)
+            assert len(out[1]) == 4
+        # the v1 and v2 bodies must be what an old decoder expects
+        assert encode_lanes(k, s, d, version=1) == encode_lanes(
+            k, s, d, qos_class=None
+        )
+
+    def test_cancel_opcode_value_is_v3(self):
+        assert proto.OP_CANCEL == 6
+        assert proto.PROTOCOL_VERSION == 3
+
+
+# ---------------------------------------------------------------------------
+# negotiation downgrade matrix
+# ---------------------------------------------------------------------------
+
+
+def _old_server(addr, max_version):
+    """A protocol-vN-capped sidecar fake: refuses frames above
+    ``max_version`` with one v1 ST_ERROR frame then closes (the PR 8
+    behavior a real old binary exhibits), answers PING, and serves
+    VERIFY through the real decode + SoftwareProvider so masks are
+    comparable bit-exactly against a current server."""
+    import socket as _socket
+
+    listener = _socket.socket(_socket.AF_UNIX, _socket.SOCK_STREAM)
+    listener.bind(addr)
+    listener.listen(8)
+    stop = threading.Event()
+    sw = SoftwareProvider()
+
+    def serve_conn(conn):
+        try:
+            while not stop.is_set():
+                head = b""
+                while len(head) < proto.HEADER_SIZE:
+                    chunk = conn.recv(proto.HEADER_SIZE - len(head))
+                    if not chunk:
+                        return
+                    head += chunk
+                _magic, ver, op, rid, length = struct.unpack(
+                    ">2sBBII", head
+                )
+                payload = b""
+                while len(payload) < length:
+                    chunk = conn.recv(length - len(payload))
+                    if not chunk:
+                        return
+                    payload += chunk
+                if ver > max_version:
+                    conn.sendall(proto.pack_frame(
+                        proto.OP_VERIFY, 0,
+                        proto.encode_verify_response(
+                            proto.ST_ERROR,
+                            message="unsupported protocol version",
+                        ),
+                        version=1,
+                    ))
+                    return
+                if op == proto.OP_PING:
+                    conn.sendall(proto.pack_frame(
+                        proto.OP_PING, rid,
+                        proto.encode_verify_response(proto.ST_OK, mask=[]),
+                        version=ver,
+                    ))
+                elif op == proto.OP_VERIFY:
+                    from fabric_tpu.common import p256 as _p256
+                    from fabric_tpu.crypto.bccsp import ECDSAPublicKey
+
+                    key_bytes, lanes, _q, _c, dl = (
+                        proto.decode_verify_request(payload, ver)
+                    )
+                    assert dl == 0, "an old server must never see a deadline"
+                    keys = []
+                    for raw in key_bytes:
+                        try:
+                            keys.append(
+                                ECDSAPublicKey(*_p256.pubkey_from_bytes(raw))
+                            )
+                        except Exception:  # noqa: BLE001 - dead lane
+                            keys.append(None)
+                    ks = [
+                        keys[i] if i != proto.NO_KEY else None
+                        for i, _, _ in lanes
+                    ]
+                    mask = sw.batch_verify(
+                        ks, [s for _, s, _ in lanes], [d for _, _, d in lanes]
+                    )
+                    conn.sendall(proto.pack_frame(
+                        proto.OP_VERIFY, rid,
+                        proto.encode_verify_response(proto.ST_OK, mask=mask),
+                        version=ver,
+                    ))
+        finally:
+            conn.close()
+
+    def accept_loop():
+        while not stop.is_set():
+            try:
+                conn, _ = listener.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=serve_conn, args=(conn,), daemon=True
+            ).start()
+
+    t = threading.Thread(target=accept_loop, daemon=True)
+    t.start()
+
+    def teardown():
+        stop.set()
+        listener.close()
+        t.join(timeout=5.0)
+
+    return teardown
+
+
+class TestNegotiationMatrix:
+    @pytest.mark.parametrize("max_version", [1, 2])
+    def test_new_client_steps_down_to_old_server(self, tmp_path, max_version):
+        """v3 client x vN-only server: the hello steps down ONE
+        revision per refusal, the deadline (and QoS, at v1) fields are
+        dropped cleanly, and masks are identical to the in-process
+        ground truth."""
+        addr = str(tmp_path / f"old{max_version}.sock")
+        teardown = _old_server(addr, max_version)
+        try:
+            provider = SidecarProvider(address=addr, deadline_ms=5000)
+            k, s, d, e = mixed_lanes(20)
+            mask = provider.batch_verify(k, s, d)
+            assert list(mask) == e
+            assert provider.client.version == max_version
+            assert not provider.degraded
+            provider.stop()
+        finally:
+            teardown()
+
+    @pytest.mark.parametrize("version", [1, 2])
+    def test_old_client_against_new_server(self, sidecar, version):
+        """vN client x v3 server: the raw old-style frame (no deadline,
+        no QoS at v1) is served with a mask identical to what a current
+        client gets — downgrade-safe both ways."""
+        k, s, d, e = mixed_lanes(20, seed=3)
+        client = SidecarClient(sidecar.address)
+        client.ensure_connected()
+        # force the old vintage AFTER the hello (the fake old binary)
+        client.version = version
+        payload = encode_lanes(k, s, d, version=version)
+        status, _, mask, _ = proto.decode_verify_response(
+            client.request(proto.OP_VERIFY, payload)
+        )
+        assert status == proto.ST_OK and list(mask) == e
+        client.close()
+        # matrix cross-check: the new-protocol mask is identical
+        new = SidecarProvider(address=sidecar.address)
+        assert list(new.batch_verify(k, s, d)) == e
+        new.stop()
+
+    def test_new_pair_negotiates_v3(self, sidecar):
+        client = SidecarClient(sidecar.address)
+        assert client.ping()
+        assert client.version == 3
+        client.close()
+
+
+# ---------------------------------------------------------------------------
+# server-side deadline shed
+# ---------------------------------------------------------------------------
+
+
+class TestServerShed:
+    def test_no_evidence_no_shed(self, sidecar):
+        """A fresh sidecar has no service-time floor for the bucket:
+        even a 1ms budget is SERVED (shed only on evidence — a verdict
+        computed late beats one refused on a guess)."""
+        k, s, d, e = mixed_lanes(16)
+        client = SidecarClient(sidecar.address)
+        status, _, mask, _ = proto.decode_verify_response(
+            client.request(
+                proto.OP_VERIFY, encode_lanes(k, s, d, deadline_ms=1)
+            )
+        )
+        assert status == proto.ST_OK and list(mask) == e
+        client.close()
+
+    def test_provably_unfinishable_budget_sheds_busy(self, sidecar):
+        """Once the bucket's best-ever service time exists, a budget
+        below it is shed as an explicit ST_BUSY + retry hint — never a
+        silent drop, never a fabricated verdict — and counted apart
+        from admission rejects (the qos ledger cross-check)."""
+        k, s, d, e = mixed_lanes(64, seed=1)
+        client = SidecarClient(sidecar.address)
+        status, _, mask, _ = proto.decode_verify_response(
+            client.request(proto.OP_VERIFY, encode_lanes(k, s, d))
+        )
+        assert status == proto.ST_OK and list(mask) == e  # floor learned
+        status2, retry_ms, mask2, _ = proto.decode_verify_response(
+            client.request(
+                proto.OP_VERIFY, encode_lanes(k, s, d, deadline_ms=1)
+            )
+        )
+        assert status2 == proto.ST_BUSY and mask2 is None
+        assert retry_ms >= 5
+        assert sidecar.stats.deadline_shed == 1
+        assert sidecar.stats.rejects == 0  # not an admission reject
+        assert sidecar.qos.balance()["leaked"] == 0
+        client.close()
+
+
+# ---------------------------------------------------------------------------
+# client budget-derived waits
+# ---------------------------------------------------------------------------
+
+
+def _busy_server(addr):
+    """A sidecar fake that answers the hello then replies ST_BUSY with
+    an absurd retry_after hint to every VERIFY — the admission-storm
+    worst case for a budgeted client."""
+    import socket as _socket
+
+    listener = _socket.socket(_socket.AF_UNIX, _socket.SOCK_STREAM)
+    listener.bind(addr)
+    listener.listen(4)
+    stop = threading.Event()
+
+    def serve():
+        while not stop.is_set():
+            try:
+                conn, _ = listener.accept()
+            except OSError:
+                return
+            try:
+                while True:
+                    frame = proto.recv_frame_ex(conn)
+                    if frame is None:
+                        break
+                    op, rid, _payload, ver = frame
+                    if op == proto.OP_PING:
+                        body = proto.encode_verify_response(
+                            proto.ST_OK, mask=[]
+                        )
+                    else:
+                        body = proto.encode_verify_response(
+                            proto.ST_BUSY, retry_after_ms=60_000
+                        )
+                    conn.sendall(proto.pack_frame(op, rid, body, version=ver))
+            except (OSError, proto.ProtocolError):
+                pass
+            finally:
+                conn.close()
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+
+    def teardown():
+        stop.set()
+        listener.close()
+        t.join(timeout=5.0)
+
+    return teardown
+
+
+class TestClientBudget:
+    def test_busy_retry_capped_by_remaining_deadline(self, tmp_path):
+        """The PR 14 satellite regression: the ST_BUSY retry policy is
+        a fixed global 10s budget — with a wire deadline it must be
+        capped by the REMAINING budget, so a tight-deadline batch fails
+        over to the in-process ladder instead of sleeping past it (and
+        the server's 60s retry hint must not buy a sleep either)."""
+        addr = str(tmp_path / "busy.sock")
+        teardown = _busy_server(addr)
+        slept = []
+
+        def sleeper(s):
+            slept.append(s)
+            time.sleep(s)
+
+        try:
+            provider = SidecarProvider(
+                address=addr, deadline_ms=80, sleeper=sleeper
+            )
+            k, s, d, e = mixed_lanes(12)
+            t0 = time.monotonic()
+            mask = provider.batch_verify(k, s, d)
+            wall = time.monotonic() - t0
+            assert list(mask) == e  # in-process ladder, bit-exact
+            assert provider.degraded
+            assert provider.deadline_expired == 1
+            # every individual pace was bounded by the budget remaining
+            # at its moment, and the whole loop gave up around the 80ms
+            # budget — nowhere near the 10s global policy (or the 60s
+            # server hint)
+            assert all(x <= 0.08 + 1e-9 for x in slept)
+            assert wall < 5.0
+            provider.stop()
+        finally:
+            teardown()
+
+    def test_no_deadline_keeps_legacy_policy(self, tmp_path):
+        """Without a budget the BUSY loop still runs the global policy
+        (bounded by max_attempts) — the deadline knob is additive."""
+        addr = str(tmp_path / "busy2.sock")
+        teardown = _busy_server(addr)
+        slept = []
+        try:
+            provider = SidecarProvider(address=addr, sleeper=slept.append)
+            k, s, d, e = mixed_lanes(8)
+            assert list(provider.batch_verify(k, s, d)) == e
+            assert provider.degraded
+            assert provider.deadline_expired == 0
+            assert len(slept) > 3  # the policy's retries actually paced
+            provider.stop()
+        finally:
+            teardown()
+
+    def test_expired_budget_hands_back_in_process(self, sidecar):
+        """A sidecar that answers but too slowly: the budget-derived
+        reply wait walks away and the in-process ladder serves the
+        batch bit-exact (degrade, never a guessed verdict)."""
+        gate = threading.Event()
+        real = sidecar.provider
+
+        class _Slow:
+            def batch_verify(self, keys, sigs, digests):
+                gate.wait(5.0)
+                return real.batch_verify(keys, sigs, digests)
+
+        sidecar.batcher.provider = _Slow()
+        try:
+            provider = SidecarProvider(address=sidecar.address,
+                                       deadline_ms=60)
+            k, s, d, e = mixed_lanes(16, seed=2)
+            t0 = time.monotonic()
+            mask = provider.batch_verify(k, s, d)
+            assert list(mask) == e
+            assert provider.deadline_expired == 1
+            assert time.monotonic() - t0 < 3.0
+            provider.stop()
+        finally:
+            gate.set()
+            sidecar.batcher.provider = real
+
+    def test_deadline_env_knob(self, monkeypatch):
+        monkeypatch.setenv("FABRIC_TPU_SERVE_DEADLINE_MS", "250")
+        assert deadline_ms_from_env() == 250
+        monkeypatch.setenv("FABRIC_TPU_SERVE_DEADLINE_MS", "nope")
+        assert deadline_ms_from_env() == 0  # malformed: knob disabled
+        monkeypatch.delenv("FABRIC_TPU_SERVE_DEADLINE_MS")
+        assert deadline_ms_from_env() == 0
+
+
+# ---------------------------------------------------------------------------
+# batcher linger respects the tightest deadline
+# ---------------------------------------------------------------------------
+
+
+class TestBatcherDeadlineLinger:
+    def test_tight_deadline_caps_linger(self):
+        """A budgeted request must dispatch when its deadline nears,
+        not wait out a long linger window hoping for company."""
+        from fabric_tpu.parallel.batcher import VerifyBatcher
+
+        b = VerifyBatcher(SoftwareProvider(), linger_s=1.0)
+        try:
+            k, s, d, e = mixed_lanes(8)
+            t0 = time.monotonic()
+            resolver = b.try_submit(
+                k, s, d, deadline_s=time.monotonic() + 0.05
+            )
+            assert resolver is not None
+            assert list(resolver()) == e
+            assert time.monotonic() - t0 < 0.8  # not the 1s linger
+        finally:
+            b.stop()
+
+    def test_unbudgeted_requests_keep_the_linger(self):
+        """No deadline = the PR 8 coalescing behavior, unchanged."""
+        from fabric_tpu.parallel.batcher import VerifyBatcher
+
+        b = VerifyBatcher(SoftwareProvider(), linger_s=0.15)
+        try:
+            k, s, d, e = mixed_lanes(8)
+            t0 = time.monotonic()
+            assert list(b.verify_batch(k, s, d)) == e
+            # the linger window was actually honored (>= one window,
+            # generous upper bound for a loaded box)
+            assert 0.1 <= time.monotonic() - t0 < 5.0
+        finally:
+            b.stop()
