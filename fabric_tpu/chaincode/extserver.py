@@ -257,7 +257,7 @@ class ChaincodeListener:
             name=f"cc-read-{ccid.name}",
             daemon=True,
         )
-        reader.start()
+        reader.start()  # fablife: disable=thread-unjoined  # stream-lifetime reader: it exits when the gRPC request_iterator is exhausted at stream teardown, and handler.close() unblocks the write side via the out_q sentinel — the RPC framework owns the stream, so there is no owner stop() to join from
 
         registered = CCM()
         registered.type = CCM.REGISTERED
@@ -338,7 +338,7 @@ class ChaincodeListener:
                 except Exception as exc:  # noqa: BLE001 - RpcError et al.
                     first_q.put(exc)
 
-            threading.Thread(target=_take_first, daemon=True).start()
+            threading.Thread(target=_take_first, daemon=True).start()  # fablife: disable=thread-unjoined  # one-shot iterator poke bounded by first_q.get's timeout below: it exits the moment next() yields or raises, and the gRPC iterator it wraps has no joinable owner
             try:
                 first = first_q.get(timeout=timeout)
             except queue.Empty:
@@ -379,7 +379,7 @@ class ChaincodeListener:
             finally:
                 conn.close()
 
-        threading.Thread(
+        threading.Thread(  # fablife: disable=thread-unjoined  # connection-lifetime reader: it exits when the dialed ccaas stream ends and closes its conn in its own finally — the stream teardown IS the release path, there is no owner stop() to join from
             target=read_then_close,
             name=f"ccaas-read-{name}",
             daemon=True,

@@ -169,6 +169,9 @@ class _Session:
         )
         self.ready = threading.Event()
         self.stopped = threading.Event()
+        # the serve thread when start(block=False) spawned one; stop()
+        # reaps it so a torn-down session leaves no reader behind
+        self._thread: Optional[threading.Thread] = None
 
     def _gen(self):
         reg = CCM()
@@ -231,28 +234,38 @@ class _Session:
             self.ready.set()
             return
         if msg.type in (CCM.INIT, CCM.TRANSACTION):
-            threading.Thread(
+            threading.Thread(  # fablife: disable=thread-unjoined  # per-transaction executor bounded by the tx round-trip: its verdict returns through out_q, and stop()'s out_q None sentinel unblocks the stream it feeds
                 target=self._run_tx, args=(msg,), daemon=True
             ).start()
         elif msg.type in (CCM.RESPONSE, CCM.ERROR):
             self.resp_q.put(msg)
 
     def serve(self) -> None:
-        stream = self.channel.stream_stream(
-            "/protos.ChaincodeSupport/Register",
-            request_serializer=CCM.SerializeToString,
-            response_deserializer=CCM.FromString,
-        )(self._gen())
-        for msg in stream:
-            self._dispatch(msg)
-            if self.stopped.is_set():
-                break
+        try:
+            stream = self.channel.stream_stream(
+                "/protos.ChaincodeSupport/Register",
+                request_serializer=CCM.SerializeToString,
+                response_deserializer=CCM.FromString,
+            )(self._gen())
+            for msg in stream:
+                self._dispatch(msg)
+                if self.stopped.is_set():
+                    break
+        except Exception:
+            # stop() closes the channel under the reader: the resulting
+            # CANCELLED is the teardown handshake, not an error — but a
+            # live session's stream failure must stay loud
+            if not self.stopped.is_set():
+                raise
 
     def stop(self) -> None:
         self.stopped.set()
         self.out_q.put(None)
         if self.channel is not None:
             self.channel.close()
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=2.0)
 
 
 class CcaasServer:
@@ -309,9 +322,11 @@ class CcaasServer:
                     except ValueError:
                         pass
 
-        threading.Thread(
+        rt = threading.Thread(
             target=read_loop, name=f"ccaas-read-{self.chaincode_id}", daemon=True
-        ).start()
+        )
+        session._thread = rt  # session.stop() reaps its reader
+        rt.start()
         # response stream: REGISTER first, then the session's replies
         yield from session._gen()
 
@@ -346,5 +361,6 @@ def start(
     t = threading.Thread(
         target=session.serve, name=f"ccshim-{chaincode_id}", daemon=True
     )
+    session._thread = t
     t.start()
     return session

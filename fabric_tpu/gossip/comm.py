@@ -153,6 +153,23 @@ class GossipNode:
             },
         )
         self._thread: Optional[threading.Thread] = None
+        # in-flight fire-and-forget send threads (forward/push/probe):
+        # registered so stop() can reap them instead of leaving sends
+        # racing the conn teardown (pruned on every spawn, so the list
+        # is bounded by concurrent sends, not node lifetime)
+        self._senders: List[threading.Thread] = []
+
+    def _spawn_send(self, endpoint: str, messages) -> None:
+        """One async send on its own reaped thread (every push/forward
+        path funnels through here — the fablife thread-unjoined
+        discipline: no unowned Thread.start())."""
+        t = threading.Thread(
+            target=self._send, args=(endpoint, messages), daemon=True
+        )
+        with self._lock:
+            self._senders = [s for s in self._senders if s.is_alive()]
+            self._senders.append(t)
+        t.start()
 
     def _pull_block_in(self, block: common_pb2.Block) -> None:
         """Pulled blocks enter through the same ordered payload buffer
@@ -286,11 +303,7 @@ class GossipNode:
                     fwd = [intro, msg]
                 for endpoint in self._peer_endpoints():
                     if endpoint != alive.membership.endpoint:
-                        threading.Thread(
-                            target=self._send,
-                            args=(endpoint, fwd),
-                            daemon=True,
-                        ).start()
+                        self._spawn_send(endpoint, fwd)
         elif kind == "data_msg":
             # msgstore dedup: a block seen within the TTL is neither
             # re-buffered nor re-forwarded (msgstore stops forward loops
@@ -307,9 +320,7 @@ class GossipNode:
             peers = self._peer_endpoints()
             _random.shuffle(peers)
             for endpoint in peers[:3]:
-                threading.Thread(
-                    target=self._send, args=(endpoint, [msg]), daemon=True
-                ).start()
+                self._spawn_send(endpoint, [msg])
         elif kind == "state_request":
             blocks = self.state.handle_state_request(
                 msg.state_request.start_seq_num,
@@ -532,9 +543,7 @@ class GossipNode:
         # re-buffered or re-forwarded by us
         self._msgstore.add(("data", block.header.number))
         for endpoint in self._peer_endpoints():
-            threading.Thread(
-                target=self._send, args=(endpoint, [msg]), daemon=True
-            ).start()
+            self._spawn_send(endpoint, [msg])
 
     def _peer_endpoints(self) -> List[str]:
         with self._lock:
@@ -605,9 +614,7 @@ class GossipNode:
                 ep = self._endpoints.get(pid)
             if ep:
                 probe = self.pull.hello(PULL_MEMBERSHIP)
-                threading.Thread(
-                    target=self._send, args=(ep, [probe]), daemon=True
-                ).start()
+                self._spawn_send(ep, [probe])
         # anti-entropy: ask ONE taller peer for the missing range
         rng = self.state.missing_range(self._peer_heights())
         if rng is not None:
@@ -651,9 +658,7 @@ class GossipNode:
         if not messages:
             return
         for endpoint in self._peer_endpoints():
-            threading.Thread(
-                target=self._send, args=(endpoint, messages), daemon=True
-            ).start()
+            self._spawn_send(endpoint, messages)
 
     def enable_reconciliation(self, missing_provider, reconcile_commit) -> None:
         """missing_provider() -> {block: [MissingEntry]};
@@ -721,6 +726,22 @@ class GossipNode:
 
     def stop(self) -> None:
         self._stop.set()
+        # reap the tick loop BEFORE tearing down the conns it uses: a
+        # mid-_tick_once thread surviving stop() is exactly the
+        # leaked-per-node lifetime class fablife pins (the loop observes
+        # _stop within one tick interval)
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=2.0)
+        with self._lock:
+            senders = list(self._senders)
+            self._senders.clear()
+        for s in senders:
+            if s is not threading.current_thread():
+                try:
+                    s.join(timeout=1.0)
+                except RuntimeError:
+                    pass  # registered but not yet started (append-before-start window)
         with self._lock:
             conns = list(self._conns.values())
             self._conns.clear()

@@ -249,6 +249,6 @@ class SnapshotRequestManager:
         if wait:
             work()
         else:
-            threading.Thread(
+            threading.Thread(  # fablife: disable=thread-unjoined  # one-shot export whose completion is PUBLISHED in generated[committed]; the manager has no teardown surface, and wait=True is the synchronous path for callers that need the join semantics
                 target=work, name=f"snapshot-{committed}", daemon=True
             ).start()

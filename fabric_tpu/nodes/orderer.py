@@ -64,6 +64,10 @@ class OrdererNode:
         # at join time (main.go initializeClusterClientConfig).
         self.raft_node_id = raft_node_id
         self.raft_tick_seconds = raft_tick_seconds
+        # ticker threads (created by start(); stop() joins them, and
+        # must stay a safe no-op before start)
+        self._flusher: Optional[threading.Thread] = None
+        self._raft_ticker: Optional[threading.Thread] = None
         self._cluster_root_ca = cluster_root_ca or None
         self.cluster_client = ClusterClient(
             raft_node_id, {}, root_ca=self._cluster_root_ca
@@ -297,6 +301,12 @@ class OrdererNode:
     def stop(self) -> None:
         if getattr(self, "_stopped", None) is not None:
             self._stopped.set()
+        # reap the cutter/raft loops: both poll _stopped, so the joins
+        # settle within one tick — an unjoined ticker surviving stop()
+        # keeps firing raft ticks into a torn-down registrar
+        for t in (self._flusher, self._raft_ticker):
+            if t is not None and t is not threading.current_thread():
+                t.join(timeout=2.0)
         for follower in list(self.registrar.followers.values()):
             follower.stop()
         self.cluster_client.stop()

@@ -289,3 +289,9 @@ class System:
             self._server.shutdown()
             self._server.server_close()
             self._server = None
+        # shutdown() blocks until serve_forever returns; the join makes
+        # the reap explicit (and covers the not-yet-serving window)
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=2.0)
+            self._thread = None

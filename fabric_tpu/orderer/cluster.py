@@ -195,6 +195,12 @@ class _Remote:
         self._stopped = True
         self.q.put(None)
         self._reset_channel()
+        # reap the sender: it exits on the None sentinel (or the 50ms
+        # reconnect poll observing _stopped) — leaving it running leaks
+        # one thread per remote across reconnect churn
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=2.0)
 
 
 class ClusterClient:

@@ -84,7 +84,7 @@ class _Request:
         self.deadline_s = deadline_s
 
     def resolve(self) -> List[bool]:
-        self.event.wait()
+        self.event.wait()  # fablife: disable=blocking-unbudgeted  # bounded by the batcher lifetime, not a wire budget: stop() settles every admitted request fail-closed (event.set), so this wait can never outlive the batcher; wire deadlines cap it upstream via deadline_s
         if self.error is not None:
             raise self.error
         assert self.result is not None
@@ -263,7 +263,7 @@ class VerifyBatcher:
                 if not block:
                     fabobs.obs_count("fabric_batcher_busy_rejects_total")
                     return None
-                self._lanes_cv.wait()
+                self._lanes_cv.wait()  # fablife: disable=blocking-unbudgeted  # released by dispatch (lane permits freed) and by stop(), which sets _stopped and notify_all()s this cv — the loop re-checks _stopped every wake, so the wait is bounded by batcher teardown
             self._lanes_free -= req.permits
             pending = self._max_pending_lanes - self._lanes_free
         fabobs.obs_gauge("fabric_batcher_pending_lanes", pending)
@@ -285,7 +285,7 @@ class VerifyBatcher:
 
     # -- dispatcher ------------------------------------------------------
     def _take_batch(self) -> Optional[List[_Request]]:
-        first = self._q.get()
+        first = self._q.get()  # fablife: disable=blocking-unbudgeted  # the dispatcher's idle park, not a request hop: stop() posts the None sentinel this get() returns on, after settling in-flight work fail-closed
         if first is None:
             return None
         batch = [first]

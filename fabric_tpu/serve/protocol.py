@@ -166,7 +166,7 @@ def _recv_exact(sock, n: int) -> Optional[bytes]:
     chunks: List[bytes] = []
     got = 0
     while got < n:
-        chunk = sock.recv(min(n - got, 1 << 20))
+        chunk = sock.recv(min(n - got, 1 << 20))  # fablife: disable=blocking-unbudgeted  # the socket's timeout is owned by the CALLER (server arms per-conn settimeout; the client demux select-bounds before reading): protocol.py is the framing layer and must not override it
         if not chunk:
             if got == 0:
                 return None

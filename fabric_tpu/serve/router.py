@@ -360,7 +360,7 @@ class SidecarRouter:
         without a dial.  Every selectable endpoint stays in the list —
         positions 2..N are the failover (and hedge) ladder."""
         bucket = _route_bucket(lanes)
-        ready = [e for e in self.endpoints if e.gate.ready()]
+        ready = [e for e in self.endpoints if e.gate.ready()]  # fablife: disable=pair-imbalance  # selection-filter read, not a guarded attempt: the gate's verdict is recorded by _Endpoint.mark_up/mark_down on the health transitions that own it
         if not ready:
             return []
 
@@ -428,7 +428,7 @@ class SidecarRouter:
                 other is e
                 or other.tracker.ewma_s is None
                 or not other.healthy
-                or not other.gate.ready()
+                or not other.gate.ready()  # fablife: disable=pair-imbalance  # selection-filter read: verdicts are recorded by _Endpoint.mark_up/mark_down, the health transitions that own the gate
             ):
                 continue
             if best is None or other.tracker.ewma_s < best:
@@ -465,7 +465,7 @@ class SidecarRouter:
         # route to (death eviction has no such choice and keeps its
         # own path through mark_down)
         if not any(
-            other.healthy and other.gate.ready()
+            other.healthy and other.gate.ready()  # fablife: disable=pair-imbalance  # selection-filter read: verdicts are recorded by _Endpoint.mark_up/mark_down, the health transitions that own the gate
             for other in self.endpoints
             if other is not e
         ):

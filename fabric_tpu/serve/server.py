@@ -626,9 +626,15 @@ class SidecarServer:
                         proto.encode_verify_response(proto.ST_OK, mask=[]),
                         send_lock, version=version,
                     )
-                    threading.Thread(
+                    # registered on _threads like every other serve
+                    # thread: stop() skips joining current_thread, so
+                    # the self-stop cannot deadlock on itself
+                    st = threading.Thread(
                         target=self.stop, name="serve-shutdown", daemon=True
-                    ).start()
+                    )
+                    with self._conn_lock:
+                        self._threads.append(st)
+                    st.start()
                     return
                 elif opcode == proto.OP_DRAIN:
                     # rolling restart: refuse new work NOW, settle the
@@ -640,10 +646,13 @@ class SidecarServer:
                         proto.encode_verify_response(proto.ST_OK, mask=[]),
                         send_lock, version=version,
                     )
-                    threading.Thread(
+                    dt = threading.Thread(
                         target=self.drain_and_stop,
                         name="serve-drain", daemon=True,
-                    ).start()
+                    )
+                    with self._conn_lock:
+                        self._threads.append(dt)
+                    dt.start()
                     return
                 elif opcode == proto.OP_VERIFY:
                     # concurrency is bounded by the batcher's admission

@@ -1933,7 +1933,7 @@ def run_router_flap(seed: int, clock: StageClock, scale: float = 1.0):
             )
             deadline = time.monotonic() + 10.0
             while time.monotonic() < deadline:
-                if target.gate.ready() and router._probe_ok(target):
+                if target.gate.ready() and router._probe_ok(target):  # fablife: disable=pair-imbalance  # scenario OBSERVES the router's gate state; the verdict is recorded by the router's own mark_up/mark_down inside _probe_ok's health path
                     return
                 time.sleep(0.02)
             raise ChaosAssertionError(
@@ -2132,7 +2132,7 @@ def run_gray_failure(seed: int, clock: StageClock, scale: float = 1.0):
         deadline = time.monotonic() + 10.0
         recovered = False
         while time.monotonic() < deadline:
-            if victim.gate.ready() and router._probe_ok(victim):
+            if victim.gate.ready() and router._probe_ok(victim):  # fablife: disable=pair-imbalance  # scenario OBSERVES the router's gate state; the verdict is recorded by the router's own mark_up/mark_down inside _probe_ok's health path
                 recovered = True
                 break
             time.sleep(0.05)

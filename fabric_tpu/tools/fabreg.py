@@ -722,11 +722,9 @@ def _check_det_hazard(scan: Scan, active: Set[str]) -> List[Finding]:
 # -- suppression-stale -------------------------------------------------------
 
 
-def _norm(path: str) -> str:
-    try:
-        return Path(path).resolve().as_posix()
-    except OSError:
-        return Path(path).as_posix()
+# the staleness protocol's shared normalizer (both sides of the
+# live-keys comparison must match byte-for-byte)
+_norm = toolkit.normalize_path
 
 
 def _pkg_root_for(path: str) -> Optional[Path]:
@@ -831,6 +829,36 @@ def _live_keys_fabdep(
     return live
 
 
+def _live_keys_registered(
+    tool: str, comments: List[SuppComment], scan: Scan
+) -> Set[Tuple[str, int, str]]:
+    """Staleness for a registry-declared analyzer: lazily import its
+    module and ask its ``live_suppression_keys(sources, rules)``
+    protocol hook (see toolkit.AnalyzerSpec)."""
+    spec = toolkit.analyzer_spec(tool)
+    if spec is None:
+        return set()
+    try:
+        import importlib
+
+        module = importlib.import_module(spec.module)
+        hook = getattr(module, "live_suppression_keys")
+    except (ImportError, AttributeError):
+        # a registry row without a reachable protocol hook judges
+        # nothing (its comments are all reported stale — loud, so the
+        # drift is fixed, rather than silently un-checked)
+        return set()
+    needed: Set[str] = set()
+    for c in comments:
+        needed |= c.rules
+    try:
+        return set(hook(dict(scan.sources), needed))
+    except (OSError, ValueError):
+        # unreadable/malformed analyzer config (e.g. pairs.toml gone):
+        # judge nothing — the comments all read stale, loudly
+        return set()
+
+
 def _check_suppression_stale(
     scan: Scan, active: Set[str], own_suppressed: List[Finding]
 ) -> List[Finding]:
@@ -838,11 +866,15 @@ def _check_suppression_stale(
         return []
     by_tool: Dict[str, List[SuppComment]] = {}
     for c in scan.comments:
-        if c.tool != "fabreg" and not FileContext(c.path).matches(PKG_SCOPE):
-            # the sibling gates only analyze the package tree, so their
-            # comments outside it are inert; fabreg's own gate scans
-            # tests/ and bench.py too — its comments are judged
-            # everywhere they are honored
+        spec = toolkit.analyzer_spec(c.tool)
+        if spec is not None and spec.pkg_scope_only and not (
+            FileContext(c.path).matches(PKG_SCOPE)
+        ):
+            # a gate that only analyzes the package tree never honors
+            # comments outside it — they are inert, not stale; tools
+            # whose gates also scan tests/ and bench.py (fabreg,
+            # fablife) declare pkg_scope_only=False in the registry and
+            # are judged everywhere they are honored
             continue
         by_tool.setdefault(c.tool, []).append(c)
 
@@ -856,6 +888,13 @@ def _check_suppression_stale(
     live["fabreg"] = {
         (_norm(f.path), f.line, f.rule) for f in own_suppressed
     }
+    # post-toolkit analyzers (fablife, and any future registry row):
+    # resolved through the toolkit registry's staleness protocol, so a
+    # sixth analyzer is picked up without editing this function
+    for tool, comments in by_tool.items():
+        if tool in toolkit.LEGACY_ANALYZER_TOOLS:
+            continue
+        live[tool] = _live_keys_registered(tool, comments, scan)
 
     out: List[Finding] = []
     for tool, comments in sorted(by_tool.items()):

@@ -47,9 +47,65 @@ DEFAULT_EXCLUDES = (
     "*/.git/*",
 )
 
-#: The analyzer family whose suppression comments share the grammar
-#: above (fabreg's suppression-stale rule scans for all of them).
-ANALYZER_TOOLS = ("fablint", "fabdep", "fabflow", "fabreg")
+@dataclass(frozen=True)
+class AnalyzerSpec:
+    """One registered analyzer — the single source of truth fabreg's
+    suppression-stale rule iterates, so a new analyzer is picked up by
+    adding a row HERE (plus implementing the staleness protocol in its
+    module) without editing fabreg.
+
+    ``module``: dotted import path.  For post-toolkit analyzers the
+    module must expose ``live_suppression_keys(sources, rules) ->
+    {(normalized_path, line, rule), ...}`` — the set of suppression
+    comments that still absorb a finding.  The three pre-toolkit tools
+    (fablint/fabdep/fabflow) predate the protocol; fabreg carries
+    legacy adapters for exactly those names and resolves everything
+    else through this registry.
+
+    ``pkg_scope_only``: True when the tool's CI gate analyzes only the
+    package tree — its suppression comments outside it are inert and
+    never judged stale.  Tools whose gates also scan tests/ and
+    bench.py (fabreg, fablife) set False."""
+
+    name: str
+    module: str
+    pkg_scope_only: bool = True
+
+
+#: The analyzer registry (fabreg's suppression-stale rule scans every
+#: row's suppression comments; all share the grammar above).
+ANALYZER_SPECS: Tuple["AnalyzerSpec", ...] = (
+    AnalyzerSpec("fablint", "fabric_tpu.tools.fablint"),
+    AnalyzerSpec("fabdep", "fabric_tpu.tools.fabdep"),
+    AnalyzerSpec("fabflow", "fabric_tpu.tools.fabflow"),
+    AnalyzerSpec("fabreg", "fabric_tpu.tools.fabreg", pkg_scope_only=False),
+    AnalyzerSpec("fablife", "fabric_tpu.tools.fablife", pkg_scope_only=False),
+)
+
+#: Historical shape: the tool-name tuple (derived from the registry).
+ANALYZER_TOOLS = tuple(spec.name for spec in ANALYZER_SPECS)
+
+#: The pre-toolkit tools fabreg adapts by hand; everything else must
+#: implement the ``live_suppression_keys`` protocol.
+LEGACY_ANALYZER_TOOLS = ("fablint", "fabdep", "fabflow", "fabreg")
+
+
+def analyzer_spec(name: str) -> Optional["AnalyzerSpec"]:
+    for spec in ANALYZER_SPECS:
+        if spec.name == name:
+            return spec
+    return None
+
+
+def normalize_path(path: str) -> str:
+    """The ONE path normalization the suppression-staleness protocol
+    keys on: fabreg compares ``live_suppression_keys`` results against
+    comment locations, and both sides must normalize identically or
+    every suppression silently reads stale."""
+    try:
+        return Path(path).resolve().as_posix()
+    except OSError:
+        return Path(path).as_posix()
 
 
 @dataclass

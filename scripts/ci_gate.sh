@@ -23,6 +23,11 @@
 #   9. reg          -- declarative-contract drift: env registry, metric
 #                      table, fault-site table, suppression staleness
 #                      (reg_gate.sh)
+#  10. life         -- resource-lifetime + wire-trust: threads joined
+#                      from teardown, fd/tempdir releases on exception
+#                      edges, pairs.toml acquire/release discharge,
+#                      wire ints clamped, request-path waits budgeted
+#                      (life_gate.sh)
 #
 # Each stage runs even if an earlier one failed (one run reports ALL
 # broken gates) and prints its wall-clock time; the exit code is nonzero
@@ -47,7 +52,7 @@ elif [ -n "${1:-}" ]; then
     exit 2
 fi
 
-STAGE_NAMES=(compileall collect fablint fabdep fabflow chaos serve obs reg)
+STAGE_NAMES=(compileall collect fablint fabdep fabflow chaos serve obs reg life)
 total=${#STAGE_NAMES[@]}
 
 fail=0
@@ -86,6 +91,7 @@ run_stage chaos bash scripts/chaos_gate.sh
 run_stage serve bash scripts/serve_gate.sh
 run_stage obs bash scripts/obs_gate.sh
 run_stage reg bash scripts/reg_gate.sh
+run_stage life bash scripts/life_gate.sh
 
 if [ "$stage_idx" -ne "$total" ]; then
     echo "ci_gate: BUG: ${stage_idx} run_stage calls but ${total} stage names" >&2
@@ -104,5 +110,5 @@ fi
 if [ -n "$only" ]; then
     echo "ci_gate: OK (--only ${only})"
 else
-    echo "ci_gate: OK (compileall + collect + fablint + fabdep + fabflow + chaos + serve + obs + reg)"
+    echo "ci_gate: OK (compileall + collect + fablint + fabdep + fabflow + chaos + serve + obs + reg + life)"
 fi

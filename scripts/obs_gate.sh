@@ -31,8 +31,13 @@ from fabric_tpu.crypto.bccsp import ECDSAPublicKey, SoftwareProvider
 from fabric_tpu.serve.client import SidecarProvider
 from fabric_tpu.serve.server import SidecarServer
 
+# the rmtree rides a finally armed IMMEDIATELY after mkdtemp (the
+# fablife fd-leak discipline): a failure while mounting obs or
+# constructing the server must not leak the dir across CI runs
+import shutil
 tmp = tempfile.mkdtemp(prefix="obs_gate_")
-with fabobs.obs_installed(dump_dir=tmp):
+try:
+  with fabobs.obs_installed(dump_dir=tmp):
     server = SidecarServer(
         f"{tmp}/obs_gate.sock", engine="host", ops_address="127.0.0.1:0",
     )
@@ -120,8 +125,8 @@ with fabobs.obs_installed(dump_dir=tmp):
         )
     finally:
         server.stop()
-        import shutil
-        shutil.rmtree(tmp, ignore_errors=True)
+finally:
+    shutil.rmtree(tmp, ignore_errors=True)
 EOF
 rc=$?
 if [ $rc -ne 0 ]; then

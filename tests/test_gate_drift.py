@@ -1,0 +1,72 @@
+"""Gate-script drift guards.
+
+ci_gate's stage list exists in two places a human must keep in sync:
+the ``STAGE_NAMES`` array in ``scripts/ci_gate.sh`` and the README
+"Running" table.  PR 15 added stage 10 (life) and this guard so the
+NEXT stage cannot be added in one place only.  It also pins the
+cross-language facet fablife cannot see: the gate scripts are bash, so
+a ``mkdtemp`` in an embedded-python heredoc (the obs_gate shape) or a
+``mktemp`` in shell is outside the analyzer's reach — every one must
+be paired with its release in the same script."""
+
+import re
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+CI_GATE = REPO_ROOT / "scripts" / "ci_gate.sh"
+README = REPO_ROOT / "README.md"
+
+
+def ci_gate_stage_names():
+    m = re.search(r"^STAGE_NAMES=\(([^)]*)\)", CI_GATE.read_text(), re.M)
+    assert m, "ci_gate.sh lost its STAGE_NAMES array"
+    return m.group(1).split()
+
+
+def test_ci_gate_stage_list_matches_the_readme_running_table():
+    names = ci_gate_stage_names()
+    m = re.search(
+        r"<!-- ci_gate stages: ([a-z ]+) -->", README.read_text()
+    )
+    assert m, (
+        "README.md lost its machine-readable ci_gate stage marker "
+        "(<!-- ci_gate stages: ... --> above the Running block)"
+    )
+    assert m.group(1).split() == names, (
+        f"ci_gate.sh stages {names} != README Running table "
+        f"{m.group(1).split()}: a stage was added in one place only"
+    )
+
+
+def test_ci_gate_run_stage_calls_match_the_stage_list():
+    text = CI_GATE.read_text()
+    names = ci_gate_stage_names()
+    calls = re.findall(r"^run_stage (\S+)", text, re.M)
+    assert calls == names, (
+        f"run_stage call order {calls} != STAGE_NAMES {names}"
+    )
+    # the life stage exists and wires the fablife gate
+    assert "life" in names
+    assert "life_gate.sh" in text
+
+
+def test_every_gate_script_releases_its_tempdirs():
+    # the tempdir classes fablife cannot see: bash mktemp (needs a trap
+    # rm) and python mkdtemp inside a heredoc (needs an rmtree in the
+    # same script) — the serve/obs gate leak class fixed across PRs
+    for script in sorted((REPO_ROOT / "scripts").glob("*.sh")):
+        text = script.read_text()
+        if "mkdtemp(" in text:
+            assert "rmtree(" in text, (
+                f"{script.name}: mkdtemp without rmtree — the gate "
+                f"leaks a /tmp dir per CI run"
+            )
+        if re.search(r"\$\(mktemp\b", text):
+            # either the trap rm's inline, or it invokes a cleanup
+            # function that rm's (the serve_gate shape)
+            assert re.search(r"^trap ", text, re.M) and re.search(
+                r"\brm -r?f?\b", text
+            ), (
+                f"{script.name}: mktemp without a trap-covered rm — "
+                f"the gate leaks a /tmp file per CI run"
+            )

@@ -87,6 +87,22 @@ def test_membership_and_data_push():
         n2.stop()
 
 
+def test_stop_reaps_tick_thread():
+    # regression (fablife thread-unjoined): stop() used to leave the
+    # tick loop running — a thread leaked per gossip node, and a
+    # mid-_tick_once survivor raced the conn teardown below it
+    ledger = FakeLedger(make_chain(1))
+    node = make_node("reaper", ledger, tick=0.05)
+    node.start()
+    t = node._thread
+    assert t is not None and t.is_alive()
+    try:
+        node.stop()
+        assert not t.is_alive(), "stop() must join the tick loop"
+    finally:
+        node.stop()  # idempotent-safe cleanup if the assert fired
+
+
 def test_anti_entropy_catches_up_lagging_peer():
     chain = make_chain(5)
     tall, lagging = FakeLedger(chain), FakeLedger()

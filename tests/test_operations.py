@@ -186,6 +186,20 @@ def _get(system, path):
     return urllib.request.urlopen(f"http://{system.addr}{path}")
 
 
+def test_ops_stop_reaps_serve_thread():
+    # regression (fablife thread-unjoined): stop() relied on
+    # shutdown() settling serve_forever but never reaped the thread;
+    # the join is now explicit and the handle cleared
+    system = System(Options(listen_address="127.0.0.1:0"))
+    system.start()
+    t = system._thread
+    assert t is not None and t.is_alive()
+    system.stop()
+    assert not t.is_alive(), "stop() must join the serve thread"
+    assert system._thread is None
+    flogging.reset()
+
+
 def test_ops_version_and_metrics(ops_system):
     with _get(ops_system, "/version") as resp:
         assert json.load(resp)["Version"]
