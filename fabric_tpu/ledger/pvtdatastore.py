@@ -168,6 +168,14 @@ class PvtDataStore:
         the in-memory state at the time of the crash."""
         buf = memoryview(rec)
         (block_num, n_entries, n_missing) = struct.unpack_from("<QII", buf, 0)
+        # Each entry consumes >= 4 bytes, so a count larger than the crc'd
+        # body is a corrupt or hostile record: refuse it before the loops
+        # allocate per-count (the decode_verify_request discipline).
+        if n_entries > len(rec) or n_missing > len(rec):
+            raise ValueError(
+                f"pvt record counts exceed body size (entries={n_entries} "
+                f"missing={n_missing} len={len(rec)})"
+            )
         off = 16
         entries = []
         for _ in range(n_entries):

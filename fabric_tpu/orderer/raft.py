@@ -120,14 +120,33 @@ def message_from_bytes(raw: bytes) -> Message:
     pos = head_len
     snap_term, snap_len = struct.unpack_from("<QI", raw, pos)
     pos += struct.calcsize("<QI")
+    # Wire lengths are untrusted: a slice past the end of `raw` would
+    # silently truncate (returning a short snapshot/entry as if it were
+    # whole), so every decoded length is checked against the payload
+    # before use and the frame is rejected loudly instead.
+    if pos + snap_len > len(raw):
+        raise ValueError(
+            f"raft message snapshot length {snap_len} overruns the "
+            f"{len(raw)}-byte payload"
+        )
     snap_data = raw[pos : pos + snap_len]
     pos += snap_len
     (n_entries,) = struct.unpack_from("<I", raw, pos)
     pos += 4
+    if n_entries > len(raw):
+        raise ValueError(
+            f"raft message entry count {n_entries} exceeds the "
+            f"{len(raw)}-byte payload"
+        )
     entries = []
     for _ in range(n_entries):
         index, eterm, etype, dlen = struct.unpack_from("<QQBI", raw, pos)
         pos += struct.calcsize("<QQBI")
+        if pos + dlen > len(raw):
+            raise ValueError(
+                f"raft entry data length {dlen} overruns the "
+                f"{len(raw)}-byte payload"
+            )
         entries.append(Entry(index, eterm, etype, raw[pos : pos + dlen]))
         pos += dlen
     return Message(

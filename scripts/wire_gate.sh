@@ -1,0 +1,28 @@
+#!/usr/bin/env bash
+# fabwire gate: wire-format conformance — every declared encoder/decoder
+# pair's field layout (order/width/endianness) symmetric at every
+# negotiated revision, revision-gated fields unreachable below their
+# introducing rev (tools/wire.toml is the revision table), no
+# wire-decoded length reaching recv/read/range/allocation/sleep without
+# a MAX_PAYLOAD-class dominating bound, every OP_*/ST_* dispatch total
+# or fail-closed, and every durability-store read twin re-verifying the
+# header/payload crc its write twin emits.
+#
+# Dependency-free and import-free: fabwire abstractly executes the
+# encode/decode bodies with ast on the shared toolkit chassis — it
+# never imports the analyzed modules, so this gate passes/fails
+# identically in minimal environments (no cryptography, no jax, no
+# numpy).  Scans the package only: tests craft deliberately skewed and
+# truncated frames by design.  Runs in ~2s.
+set -uo pipefail
+
+cd "$(dirname "$0")/.."
+
+timeout -k 5 60 python -m fabric_tpu.tools.fabwire fabric_tpu/
+rc=$?
+
+if [ "$rc" -ne 0 ]; then
+    echo "wire_gate: FAIL (fabwire rc=$rc)" >&2
+    exit 1
+fi
+echo "wire_gate: OK"

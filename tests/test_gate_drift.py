@@ -48,6 +48,30 @@ def test_ci_gate_run_stage_calls_match_the_stage_list():
     # the life stage exists and wires the fablife gate
     assert "life" in names
     assert "life_gate.sh" in text
+    # PR 17: stage 11 wires the fabwire gate
+    assert names[-1] == "wire" and len(names) == 11
+    assert "wire_gate.sh" in text
+
+
+def test_every_wire_toml_surface_exists_on_disk():
+    """A renamed module must not silently drop out of wire analysis:
+    fabwire only checks codec/enum/store rows whose module path matches
+    a scanned file, so a stale path would make every check on that
+    surface vacuously pass.  Every declared path must exist."""
+    from fabric_tpu.tools import fabwire
+
+    spec = fabwire.load_default_wire()
+    declared = set(spec.surfaces)
+    declared.update(c.module for c in spec.codecs)
+    declared.update(e.module for e in spec.enums)
+    declared.update(s.module for s in spec.stores)
+    missing = sorted(
+        mod for mod in declared if not (REPO_ROOT / mod).is_file()
+    )
+    assert missing == [], (
+        f"tools/wire.toml names modules that do not exist: {missing} — "
+        f"update the table when a framing surface moves"
+    )
 
 
 def test_every_gate_script_releases_its_tempdirs():

@@ -134,6 +134,38 @@ def test_pvtdata_recovery_drops_torn_tail(tmp_path):
     assert os.path.getsize(path) == size_after_good  # tail trimmed
 
 
+def test_pvtdata_recovery_rejects_absurd_record_counts(tmp_path):
+    """Regression: _load_record sized its entry/missing loops off u32
+    counts read from the record verbatim — a crc-valid but corrupt or
+    hostile record could drive a 2**31-iteration loop. Counts larger
+    than the record body (each entry consumes >= 4 bytes) are now
+    refused loudly before any per-count work."""
+    import struct
+    import zlib
+
+    from fabric_tpu.ledger.blockstore import LedgerCorruptionError, frame_header
+
+    path = str(tmp_path / "pvt")
+    store = PvtDataStore(path)
+    good = PvtEntry(0, "mycc", "c", kvrwset_bytes([("k", b"v")]))
+    store.commit(0, [good])
+    store.close()
+    # a fully crc-framed record whose entry count dwarfs its body: the
+    # count bound raises ValueError, which recovery's fail-closed
+    # discipline surfaces as strict-mode corruption refusal
+    body = struct.pack("<QII", 1, 2**31, 0)
+    with open(path, "ab") as f:
+        f.write(frame_header(len(body)) + body)
+        f.write(struct.pack("<I", zlib.crc32(body)))
+    with pytest.raises(LedgerCorruptionError, match="does not parse"):
+        PvtDataStore(path)
+    # the missing-marker count is bounded the same way
+    body2 = struct.pack("<QII", 1, 0, 2**31)
+    store2 = PvtDataStore.__new__(PvtDataStore)
+    with pytest.raises(ValueError, match="exceed"):
+        store2._load_record(body2)
+
+
 def test_pvtdata_rollback_rewinds_store(tmp_path):
     store = PvtDataStore(str(tmp_path / "pvt"))
     e0 = PvtEntry(0, "mycc", "c", kvrwset_bytes([("k0", b"v0")]))

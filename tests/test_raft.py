@@ -277,3 +277,47 @@ def test_membership_eviction(tmp_path):
     c.run(10)
     live = [c.chains[i] for i in keep]
     assert all(ch.height >= 1 for ch in live)
+
+
+# ---------------- wire codec bounds ----------------
+
+
+def test_message_codec_rejects_inflated_wire_lengths():
+    """Regression: message_from_bytes used to slice snap_data/entry data
+    with decoded lengths verbatim — an inflated length silently returned
+    a TRUNCATED blob as if it were whole, and an inflated entry count
+    sized a loop off a u32 the peer chose. Every decoded length is now
+    checked against the payload and rejected loudly."""
+    from fabric_tpu.orderer.raft import Message, message_from_bytes, message_to_bytes
+
+    m = Message(
+        kind="snap", term=3, frm=1, to=2, snap_index=7, snap_term=2,
+        snap_data=b"snapshot-bytes",
+        entries=(Entry(8, 3, 0, b"payload"),),
+    )
+    raw = message_to_bytes(m)
+    assert message_from_bytes(raw) == m  # round-trip intact
+
+    head_len = struct.calcsize("<BQQQQQQBBQQQQ")
+    # inflate snap_len past the end of the payload
+    torn_snap = (
+        raw[:head_len]
+        + struct.pack("<QI", m.snap_term, len(raw))
+        + raw[head_len + struct.calcsize("<QI"):]
+    )
+    with pytest.raises(ValueError, match="snapshot length"):
+        message_from_bytes(torn_snap)
+
+    # inflate the entry count: the loop must not run off the wire value
+    n_off = head_len + struct.calcsize("<QI") + len(m.snap_data)
+    huge_count = raw[:n_off] + struct.pack("<I", 2**31) + raw[n_off + 4:]
+    with pytest.raises(ValueError, match="entry count"):
+        message_from_bytes(huge_count)
+
+    # inflate one entry's data length
+    dlen_off = n_off + 4 + struct.calcsize("<QQB")
+    torn_entry = (
+        raw[:dlen_off] + struct.pack("<I", len(raw)) + raw[dlen_off + 4:]
+    )
+    with pytest.raises(ValueError, match="data length"):
+        message_from_bytes(torn_entry)

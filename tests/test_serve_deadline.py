@@ -479,3 +479,82 @@ class TestBatcherDeadlineLinger:
             assert 0.1 <= time.monotonic() - t0 < 5.0
         finally:
             b.stop()
+
+
+# ---------------------------------------------------------------------------
+# byte-stability drift guard: the full negotiation matrix, field-exact
+# ---------------------------------------------------------------------------
+
+
+class TestCodecDriftGuard:
+    """Every (client-rev x server-rev) cell of the negotiation matrix
+    round-trips encode -> decode with field-exact equality, and the
+    response codec is field-exact at every status.  This is the dynamic
+    twin of fabwire's static layout comparison (tools/wire.toml codec
+    serve.verify_request / serve.verify_response): a layout change that
+    slips past one guard is caught by the other."""
+
+    def test_request_matrix_every_cell_field_exact(self):
+        k, s, d, _e = mixed_lanes(6)
+        # the lane table is revision-independent: pin it once from the
+        # current-rev body and demand identity in every cell
+        ref_keys, ref_lanes, *_rest = proto.decode_verify_request(
+            encode_lanes(k, s, d, version=proto.PROTOCOL_VERSION),
+            version=proto.PROTOCOL_VERSION,
+        )
+        for client_rev in (1, 2, 3):
+            for server_rev in (1, 2, 3):
+                neg = min(client_rev, server_rev)
+                payload = encode_lanes(
+                    k, s, d, qos_class=proto.QOS_HIGH, channel="paychan",
+                    deadline_ms=250, version=neg,
+                )
+                keys, lanes, qos, chan, dl = proto.decode_verify_request(
+                    payload, version=neg
+                )
+                assert keys == ref_keys, f"cell ({client_rev},{server_rev})"
+                assert lanes == ref_lanes, f"cell ({client_rev},{server_rev})"
+                if neg >= 2:
+                    assert (qos, chan) == (proto.QOS_HIGH, "paychan")
+                else:
+                    # v1 bodies carry no prefix: the server treats the
+                    # client as unclassified traffic, never an error
+                    assert (qos, chan) == (proto.DEFAULT_QOS, "")
+                assert dl == (250 if neg >= 3 else 0)
+
+    def test_request_matrix_prefix_byte_arithmetic(self):
+        """The rev deltas are exactly the declared gated fields: v2
+        adds the 2-byte QoS prefix + channel bytes, v3 adds the 4-byte
+        deadline — nothing else moves."""
+        k, s, d, _e = mixed_lanes(4)
+        chan = "paychan"
+        v1 = encode_lanes(k, s, d, qos_class=None, version=1)
+        v2 = encode_lanes(
+            k, s, d, qos_class=proto.QOS_HIGH, channel=chan, version=2
+        )
+        v3 = encode_lanes(
+            k, s, d, qos_class=proto.QOS_HIGH, channel=chan,
+            deadline_ms=250, version=3,
+        )
+        assert len(v2) == len(v1) + 2 + len(chan.encode())
+        assert len(v3) == len(v2) + 4
+        # the shared suffix (the lane table) is byte-identical
+        assert v2.endswith(v1)
+        assert v3.endswith(v1)
+
+    def test_response_round_trip_field_exact_at_every_status(self):
+        mask = [True, False, True, True]
+        cells = [
+            (proto.ST_OK, mask, "", 0),
+            (proto.ST_BUSY, None, "shed: hot bucket", 40),
+            (proto.ST_ERROR, None, "engine exploded", 0),
+            (proto.ST_STOPPING, None, "draining", 125),
+        ]
+        for status, mask_in, msg, retry in cells:
+            payload = proto.encode_verify_response(
+                status, mask=mask_in, message=msg, retry_after_ms=retry
+            )
+            out = proto.decode_verify_response(payload)
+            want_mask = mask if status == proto.ST_OK else None
+            want_msg = "" if status == proto.ST_OK else msg
+            assert out == (status, retry, want_mask, want_msg)
