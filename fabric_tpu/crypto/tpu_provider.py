@@ -74,23 +74,45 @@ class TPUProvider(Provider):
         self._jax = jax
         self._pk = pk
         self._software = SoftwareProvider()
-        self._key_limb_cache: Dict[bytes, Tuple[np.ndarray, np.ndarray]] = {}
+        self._key_limb_cache: Dict[
+            bytes, Tuple[np.ndarray, np.ndarray, bool]
+        ] = {}
 
-    def _key_limbs(self, key: ECDSAPublicKey) -> Tuple[np.ndarray, np.ndarray, bool]:
-        """Per-key (x limbs, y limbs, on_curve) cached by SKI — mirrors the
-        MSP identity cache the reference leans on (msp/cache, SURVEY.md
-        §2.2). The on-curve gate matters: the complete-addition formulas
-        are only defined for curve points, so off-curve keys must fail in
-        the host mask, exactly as SoftwareProvider fails them."""
-        ski = key.ski()
-        hit = self._key_limb_cache.get(ski)
-        if hit is None:
-            on_curve = p256.is_on_curve((key.x, key.y))
-            hit = (bn.int_to_limbs(key.x), bn.int_to_limbs(key.y), on_curve)
+    def _key_columns(self, distinct: Sequence[ECDSAPublicKey]):
+        """(x limbs, y limbs, on_curve) per DISTINCT key, cached by SKI —
+        mirrors the MSP identity cache the reference leans on (msp/cache,
+        SURVEY.md §2.2). Cache misses convert in ONE vectorized
+        be_bytes_to_limbs call per coordinate instead of a per-key
+        int_to_limbs loop (PR 18, fabtrace transfer-in-loop). The
+        on-curve gate matters: the complete-addition formulas are only
+        defined for curve points, so off-curve keys must fail in the
+        host mask, exactly as SoftwareProvider fails them."""
+        skis = [key.ski() for key in distinct]
+        missing = [
+            i for i, ski in enumerate(skis)
+            if ski not in self._key_limb_cache
+        ]
+        if missing:
+            xb = np.frombuffer(
+                b"".join(distinct[i].x.to_bytes(32, "big") for i in missing),
+                dtype=np.uint8,
+            ).reshape(len(missing), 32)
+            yb = np.frombuffer(
+                b"".join(distinct[i].y.to_bytes(32, "big") for i in missing),
+                dtype=np.uint8,
+            ).reshape(len(missing), 32)
+            xl = be_bytes_to_limbs(xb)
+            yl = be_bytes_to_limbs(yb)
             if len(self._key_limb_cache) > 65536:
                 self._key_limb_cache.clear()
-            self._key_limb_cache[ski] = hit
-        return hit
+            for j, i in enumerate(missing):
+                key = distinct[i]
+                self._key_limb_cache[skis[i]] = (
+                    np.ascontiguousarray(xl[:, j]),
+                    np.ascontiguousarray(yl[:, j]),
+                    p256.is_on_curve((key.x, key.y)),
+                )
+        return [self._key_limb_cache[ski] for ski in skis]
 
     # Below this count the device round-trip (and worse, a first-time XLA
     # compile) costs more than host verification; interactive paths (MSP
@@ -260,21 +282,20 @@ class TPUProvider(Provider):
         MSP cache reuses key objects for repeated identities), plus the
         per-lane column index. Shared by the bytes and limb paths."""
         columns: Dict[int, int] = {}
-        kx_cols: List[np.ndarray] = []
-        ky_cols: List[np.ndarray] = []
-        on_curve_flags: List[bool] = []
+        distinct: List[ECDSAPublicKey] = []
         idx = np.zeros(len(keys), dtype=np.int32)
         for i, key in enumerate(keys):
             col = columns.get(id(key))
             if col is None:
-                kx, ky, on_curve = self._key_limbs(key)
-                col = len(kx_cols)
+                col = len(distinct)
                 columns[id(key)] = col
-                kx_cols.append(kx)
-                ky_cols.append(ky)
-                on_curve_flags.append(on_curve)
+                distinct.append(key)
             idx[i] = col
-        return kx_cols, ky_cols, np.asarray(on_curve_flags, dtype=bool), idx
+        cols = self._key_columns(distinct)
+        kx_cols = [c[0] for c in cols]
+        ky_cols = [c[1] for c in cols]
+        on_curve = np.asarray([c[2] for c in cols], dtype=bool)
+        return kx_cols, ky_cols, on_curve, idx
 
     def prep_bytes(
         self,

@@ -453,16 +453,20 @@ class ResidentDeviceValidator(DeviceValidator):
         inits = list(merged.items())
         self._pending_init = []
 
-        # capacity growth (doubling) before the launch that needs it
+        # capacity growth (doubling) before the launch that needs it:
+        # resolve the final capacity on host first, then extend the
+        # device table ONCE — the old per-doubling concatenate allocated
+        # (and for each new shape compiled) one intermediate per pass
+        old_cap = self._cap
         while len(self._index) > self._cap:
-            if self._dev_versions is not None:
-                self._dev_versions = jnp.concatenate(
-                    [
-                        self._dev_versions,
-                        jnp.full((self._cap, 2), -1, dtype=jnp.int32),
-                    ]
-                )
             self._cap *= 2
+        if self._dev_versions is not None and self._cap > old_cap:
+            self._dev_versions = jnp.concatenate(
+                [
+                    self._dev_versions,
+                    jnp.full((self._cap - old_cap, 2), -1, dtype=jnp.int32),
+                ]
+            )
         if self._dev_versions is None:
             self._dev_versions = jnp.full(
                 (self._cap, 2), -1, dtype=jnp.int32

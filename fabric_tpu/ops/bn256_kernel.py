@@ -145,12 +145,12 @@ def pack_points(pts: Sequence[host.G1Point]) -> np.ndarray:
     for pt in pts:
         if pt is None:
             xs.append(0)
-            ys.append(to_mont_int(1))
+            ys.append(to_mont_int(1))  # fabtrace: disable=transfer-in-loop  # MSM point-ingest worklist row (NOTES_BUILD PR 18): per-point host Montgomery encode pending a columnar pack over the whole batch
             zs.append(0)
         else:
-            xs.append(to_mont_int(pt[0]))
-            ys.append(to_mont_int(pt[1]))
-            zs.append(to_mont_int(1))
+            xs.append(to_mont_int(pt[0]))  # fabtrace: disable=transfer-in-loop  # MSM point-ingest worklist row (NOTES_BUILD PR 18): per-point host Montgomery encode pending a columnar pack over the whole batch
+            ys.append(to_mont_int(pt[1]))  # fabtrace: disable=transfer-in-loop  # MSM point-ingest worklist row (NOTES_BUILD PR 18): per-point host Montgomery encode pending a columnar pack over the whole batch
+            zs.append(to_mont_int(1))  # fabtrace: disable=transfer-in-loop  # MSM point-ingest worklist row (NOTES_BUILD PR 18): per-point host Montgomery encode pending a columnar pack over the whole batch
     return np.stack(
         [bn.ints_to_limbs(xs), bn.ints_to_limbs(ys), bn.ints_to_limbs(zs)]
     )
@@ -271,7 +271,7 @@ def msm_host_batch(
     k_count = len(bases_per_lane[0])
     bases = np.stack(
         [
-            pack_points([bases_per_lane[i][k] for i in range(b_count)])
+            pack_points([bases_per_lane[i][k] for i in range(b_count)])  # fabtrace: disable=transfer-in-loop  # rides the pack_points MSM ingest worklist row: the per-K-column loop vectorizes together with the point encode it wraps
             for k in range(k_count)
         ]
     )

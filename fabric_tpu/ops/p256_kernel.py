@@ -206,9 +206,9 @@ def g_small_table() -> np.ndarray:
     for d in range(1, 16):
         acc = p256.point_add(acc, p256.GENERATOR)
         x, y = acc
-        table[d, 0] = bn.int_to_limbs((x * _R) % p256.P)
-        table[d, 1] = bn.int_to_limbs((y * _R) % p256.P)
-        table[d, 2] = bn.int_to_limbs(one_m)
+        table[d, 0] = bn.int_to_limbs((x * _R) % p256.P)  # fabtrace: disable=transfer-in-loop  # one-time generator table: 15 fixed rows built once per process (memoized in _G_TABLE above), never per lane
+        table[d, 1] = bn.int_to_limbs((y * _R) % p256.P)  # fabtrace: disable=transfer-in-loop  # one-time generator table: 15 fixed rows built once per process (memoized in _G_TABLE above), never per lane
+        table[d, 2] = bn.int_to_limbs(one_m)  # fabtrace: disable=transfer-in-loop  # one-time generator table: 15 fixed rows built once per process (memoized in _G_TABLE above), never per lane
     _G_TABLE = table
     return table
 

@@ -76,7 +76,8 @@ class MultiChannelValidator:
         for c, ch in enumerate(channels):
             validator, block, parsed, jobs, job_identity, limbs = per_channel[ch]
             n = limbs[-1].shape[0]
-            ok_list = [bool(v) for v in np.asarray(masks[c, :n])]
+            # masks is already a host ndarray (materialized once above)
+            ok_list = [bool(v) for v in masks[c, :n]]
             sig_results = validator.finish_sig_results(
                 jobs, job_identity, ok_list
             )

@@ -4,6 +4,13 @@
 - ``fabdep``   — whole-program import layering + concurrency analysis
 - ``fabflow``  — value-range/dtype abstract interpreter (the limb
   headroom proof) + mask-soundness pass
+- ``fabreg``   — declarative-contract drift (env registry, metric
+  table, fault sites, suppression staleness)
+- ``fablife``  — resource-lifetime + wire-trust analysis
+- ``fabwire``  — wire-format conformance (encode/decode layout
+  symmetry, rev gating, bounded lengths, dispatch totality)
+- ``fabtrace`` — device-plane trace discipline (recompile hazards,
+  hidden host syncs, per-lane transfer inventory; ``hotpath.toml``)
 
 Everything in this package is dependency-free stdlib so the gates run in
 minimal environments (no ``cryptography``, no ``jax``) without importing

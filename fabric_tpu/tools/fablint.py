@@ -2,12 +2,14 @@
 
 The pipeline's correctness contract is bit-exactness of the VALID/INVALID
 mask across backend tiers.  The bug classes that silently break that
-contract — swallowed exceptions in verify paths, impure host code inside
-jitted kernels, module-scope imports of optional packages that kill test
-collection — are exactly what static analysis catches before a bench run
-ever does.  fablint walks the AST of every source file (it never imports
-the code it inspects, so it runs in minimal environments without
-``cryptography``/``jax``) and enforces ~10 project-specific rules.
+contract — swallowed exceptions in verify paths, module-scope imports of
+optional packages that kill test collection — are exactly what static
+analysis catches before a bench run ever does.  fablint walks the AST of
+every source file (it never imports the code it inspects, so it runs in
+minimal environments without ``cryptography``/``jax``) and enforces ~10
+project-specific rules.  (The jit-impure rule moved to fabtrace in PR 18,
+promoted from this file's name heuristic to real dataflow over traced
+bodies.)
 
 Rules
 -----
@@ -22,10 +24,6 @@ broad-except     bare ``except:`` anywhere, or ``except Exception`` in
                  neither re-raises nor logs: a silently swallowed
                  exception in a verify path flips lanes VALID.
 mutable-default  ``def f(x=[])`` — the default is shared across calls.
-jit-impure       host/impure calls (time.*, random.*, np.random.*,
-                 print, np.asarray/np.array, .block_until_ready()) inside
-                 a jitted function: they run at trace time, bake one
-                 value into the compiled kernel, or force a host sync.
 limb-dtype       integer literal > 2**32 fed to an array constructor
                  without an explicit ``dtype=``: platform-default int
                  truncates limbs and corrupts the bignum pipeline.
@@ -156,12 +154,6 @@ _ARRAY_CTORS = {
     "array", "asarray", "full", "full_like", "arange", "constant",
 }
 _ARRAY_ROOTS = {"np", "jnp", "numpy", "jax"}
-
-_IMPURE_ROOTS = {"time", "random"}
-_IMPURE_DOTTED = {
-    "np.asarray", "numpy.asarray", "np.array", "numpy.array",
-    "np.random", "numpy.random",
-}
 
 _LIMB_LIMIT = 2 ** 32
 
@@ -403,78 +395,10 @@ def check_mutable_default(tree: ast.Module, source: str, ctx: FileContext) -> Li
     return findings
 
 
-def _is_jit_expr(node: ast.AST) -> bool:
-    """True for `jax.jit` / `jit` / `partial(jax.jit, ...)` expressions."""
-    dn = _dotted(node)
-    if dn in ("jax.jit", "jit"):
-        return True
-    if isinstance(node, ast.Call):
-        fn = _dotted(node.func)
-        if fn in ("partial", "functools.partial") and node.args:
-            return _is_jit_expr(node.args[0])
-        # jax.jit(...) used as a decorator factory
-        return _is_jit_expr(node.func)
-    return False
-
-
-@rule(
-    "jit-impure",
-    "impure/host call (time.*, random.*, np.random.*, print, np.asarray/"
-    "np.array, .block_until_ready()) inside a jitted function",
-)
-def check_jit_impure(tree: ast.Module, source: str, ctx: FileContext) -> List[Finding]:
-    jitted: List[ast.AST] = []
-    jitted_names: Set[str] = set()
-
-    for node in ast.walk(tree):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            if any(_is_jit_expr(dec) for dec in node.decorator_list):
-                jitted.append(node)
-        elif isinstance(node, ast.Call) and _is_jit_expr(node.func):
-            # fn_jit = jax.jit(fn) / jax.jit(run, ...) / partial(jax.jit)(fn)
-            if node.args and isinstance(node.args[0], ast.Name):
-                jitted_names.add(node.args[0].id)
-
-    if jitted_names:
-        for node in ast.walk(tree):
-            if (
-                isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
-                and node.name in jitted_names
-                and node not in jitted
-            ):
-                jitted.append(node)
-
-    findings: List[Finding] = []
-    for fn in jitted:
-        for node in ast.walk(fn):
-            if not isinstance(node, ast.Call):
-                continue
-            dn = _dotted(node.func)
-            bad: Optional[str] = None
-            if dn == "print":
-                bad = "print"
-            elif dn is not None:
-                root = dn.split(".")[0]
-                if root in _IMPURE_ROOTS:
-                    bad = dn
-                elif any(dn == d or dn.startswith(d + ".") for d in _IMPURE_DOTTED):
-                    bad = dn
-            if (
-                bad is None
-                and isinstance(node.func, ast.Attribute)
-                and node.func.attr == "block_until_ready"
-            ):
-                bad = ".block_until_ready()"
-            if bad is not None:
-                findings.append(
-                    Finding(
-                        "jit-impure", ctx.path, node.lineno, node.col_offset,
-                        f"{bad} inside jitted function "
-                        f"{getattr(fn, 'name', '<lambda>')!r}: runs at trace "
-                        f"time / forces a host sync, not per call",
-                    )
-                )
-    return findings
+# jit-impure lived here through PR 17 as a name heuristic over
+# syntactically-jitted functions; PR 18 moved it to fabtrace, which owns
+# the traced-body dataflow (mutable module state, os.environ) the
+# heuristic could not see.
 
 
 def _looks_like_dtype(node: ast.AST) -> bool:

@@ -1,0 +1,1485 @@
+"""fabtrace — device-plane trace-discipline analyzer for fabric-tpu.
+
+The serve registry enforces "steady state is provably compile-free" at
+RUNTIME (``program_for`` on an unwarmed bucket raises).  fabtrace is the
+static twin of that bucket discipline: an abstract interpreter over the
+device tier and its hot-path callers that tracks two facts per value —
+*shape provenance* (drawn from the bucket ladder / module constants vs.
+data-dependent) and *residency* (host vs. device vs. tracer) — and pins
+the JAX-plane invariants none of the six sibling analyzers see: a jit
+call site going shape-polymorphic, a hidden host sync landing inside a
+pipeline stage, per-lane host<->device conversions inside loops (the
+columnar-ingest worklist), and traced values escaping the trace.
+
+Like fabwire/fablife, the repo-specific knowledge lives in a declarative
+table, ``tools/hotpath.toml``, not in the analyzer: which functions are
+pipeline stages (and which of them are legal sync boundaries), which
+modules form the device tier, which call leaves are host<->device
+conversions, which functions project onto the bucket ladder, which
+module constants are static shape sources, and which helpers shape their
+output from a size argument.  Extending the pipeline extends the table —
+the analyzer does not change.
+
+Rules
+-----
+recompile-hazard    a jit/pjit call site fed an argument whose shape is
+                    provably data-dependent (built from ``len()`` /
+                    ``.shape`` sizes that never pass through a declared
+                    bucket-ladder projection).  Steady state must be
+                    statically compile-free: every device-bound shape
+                    comes from the bucket ladder or a module constant.
+static-arg-churn    a ``static_argnums``/``static_argnames`` parameter
+                    of a jitted callable fed a per-call-varying value at
+                    a call site — every distinct value is a separate
+                    compile-cache entry (compile-cache explosion).
+host-sync-hot-path  ``.item()``, ``float()``/``int()``/``bool()`` on a
+                    device value, ``np.asarray(device_val)``,
+                    ``device_get`` or ``.block_until_ready()`` inside a
+                    function hotpath.toml declares a pipeline stage.
+                    Syncs are legal only at declared stage boundaries
+                    (``boundary = true`` rows).
+transfer-in-loop    a declared host<->device conversion leaf (or a local
+                    helper that performs one) called inside a per-lane /
+                    per-tx loop body in a declared device-tier module.
+                    Every finding is one row of the vectorized-ingest
+                    refactor worklist (ROADMAP open item #1).
+tracer-leak         a value derived from a traced function's inputs
+                    stored into instance state, a global, or an
+                    enclosing-scope container — the tracer outlives the
+                    traced call and poisons later traces.
+jit-impure          impure host calls (time.*, random.*, np.random.*,
+                    os.environ/os.getenv, print, np.asarray/np.array,
+                    ``.block_until_ready()``) or reads of mutated module
+                    state inside a traced body: they run once at trace
+                    time, bake one value into the compiled program, or
+                    force a host sync.  Promoted from fablint's name
+                    heuristic (PR 18), behavior-pinned.
+
+Abstract domains
+----------------
+Shape provenance is a three-point lattice per size expression: STATIC
+(int literals, declared ladder constants, module int constants, and any
+value returned by a declared ``[[bucket]]`` projection — ``_bucket``,
+``_next_pow2``, ``bucket_for`` — regardless of its argument), DATA
+(``len()``, ``.shape[...]``, ``sum()`` and arithmetic over them), and
+UNKNOWN (parameters, opaque calls).  Arrays carry the provenance of the
+size argument that built them (``np.zeros((20, n))`` is DATA-shaped when
+``n`` is; a declared ``[[shaper]]`` helper is classified by its declared
+size argument).  Only provably-DATA shapes fire — UNKNOWN stays silent,
+so the rule reports certain hazards, not every unproven site.
+
+Residency is host / device / unknown: jit-callable results, ``jnp.*``
+calls and ``device_put`` produce device values; ``np.*`` constructors
+and ``device_get`` produce host values.  "Tracer" residency is implied
+by position: any value inside a traced body is a tracer, which is what
+the tracer-leak and jit-impure rules key on.
+
+Never imports the analyzed code (pure ``ast`` on the toolkit chassis) —
+runs identically with or without jax/numpy/cryptography installed.
+
+Suppression
+-----------
+Per line, toolkit grammar: ``# fabtrace: disable=rule-id  # <reason>``.
+The reason must name the bound that makes the site safe (one-time
+per-kernel shipping, chunk-granular drain, trace-time constant bounded
+by the tower size, ...) — reviewed via the NOTES_BUILD triage ledger,
+judged stale by fabreg through the toolkit registry protocol.
+
+Usage
+-----
+    python -m fabric_tpu.tools.fabtrace [--json] [--list-rules]
+        [--rules a,b] [--hotpath FILE] PATH...
+
+Exit status: 0 = clean, 1 = findings, 2 = usage/IO/hotpath-table error
+(a half-read stage table checking nothing would be silent drift — parse
+errors are loud by design).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import sys
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from fabric_tpu.tools import toolkit
+from fabric_tpu.tools.toolkit import (  # noqa: F401 - re-exported API
+    DEFAULT_EXCLUDES,
+    Finding,
+    iter_py_files,
+)
+
+__version__ = "1.0"
+
+RULES: Dict[str, str] = {
+    "recompile-hazard": (
+        "a jit/pjit call site fed an argument whose shape is provably "
+        "data-dependent (len()/.shape sizes that never pass a declared "
+        "bucket-ladder projection) — steady state must be statically "
+        "compile-free"
+    ),
+    "static-arg-churn": (
+        "a static_argnums/static_argnames parameter of a jitted "
+        "callable fed a per-call-varying value: every distinct value is "
+        "a separate compile-cache entry"
+    ),
+    "host-sync-hot-path": (
+        ".item(), float()/int()/bool() on a device value, "
+        "np.asarray(device_val), device_get or block_until_ready inside "
+        "a declared pipeline stage (tools/hotpath.toml; syncs are legal "
+        "only at boundary = true stages)"
+    ),
+    "transfer-in-loop": (
+        "a declared host<->device conversion called inside a per-lane/"
+        "per-tx loop body in a device-tier module — one row of the "
+        "vectorized-ingest refactor worklist"
+    ),
+    "tracer-leak": (
+        "a value derived from a traced function's inputs stored into "
+        "instance state, a global, or an enclosing-scope container "
+        "that outlives the traced call"
+    ),
+    "jit-impure": (
+        "impure/host call (time.*, random.*, np.random.*, os.environ/"
+        "os.getenv, print, np.asarray/np.array, .block_until_ready()) "
+        "or a read of mutated module state inside a traced body"
+    ),
+}
+
+#: device-plane discipline is runtime-package business; tests craft
+#: shape-polymorphic and syncing fixtures all day (that is their job)
+PKG_SCOPE = ("*fabric_tpu/*",)
+
+#: shape-provenance lattice points
+_STATIC, _DATA, _UNKNOWN = "static", "data", "unknown"
+#: residency lattice points
+_HOST, _DEVICE, _RES_UNKNOWN = "host", "device", "unknown"
+
+_NP_ROOTS = {"np", "numpy"}
+_DEV_ROOTS = {"jnp", "jax"}
+#: array constructors whose first argument IS the output shape
+_SHAPE_CTORS = {"zeros", "ones", "full", "empty"}
+#: container-mutator method leaves (tracer-leak escape sinks and the
+#: module-mutable-state detector)
+_MUTATORS = {"append", "extend", "add", "update", "insert", "setdefault"}
+
+#: jit-impure call sets (fablint parity, PR 18 migration) + the os/env
+#: reads the dataflow promotion adds
+_IMPURE_ROOTS = {"time", "random"}
+_IMPURE_DOTTED = {
+    "np.asarray", "numpy.asarray", "np.array", "numpy.array",
+    "np.random", "numpy.random",
+    "os.getenv", "os.urandom", "os.putenv",
+}
+_IMPURE_ENV = {"os.environ", "environ"}
+
+
+# ---------------------------------------------------------------------------
+# hotpath.toml
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class StageSpec:
+    module: str
+    function: str
+    boundary: bool = False
+
+
+@dataclass(frozen=True)
+class HotpathSpec:
+    stages: Tuple[StageSpec, ...] = ()
+    devices: Tuple[str, ...] = ()
+    transfers: Tuple[str, ...] = ()
+    buckets: Tuple[str, ...] = ()
+    ladders: Tuple[str, ...] = ()
+    shapers: Tuple[Tuple[str, int], ...] = ()
+
+
+def default_hotpath_file() -> Path:
+    return Path(__file__).resolve().parent / "hotpath.toml"
+
+
+def _parse_value(raw: str, where: str):
+    raw = raw.strip()
+    if raw in ("true", "false"):
+        return raw == "true"
+    if raw.startswith('"') and raw.endswith('"') and len(raw) >= 2:
+        return raw[1:-1]
+    if raw.lstrip("-").isdigit():
+        return int(raw)
+    raise ValueError(
+        f"{where}: expected \"string\", integer or true/false"
+    )
+
+
+_SECTIONS = ("stage", "device", "transfer", "bucket", "ladder", "shaper")
+
+#: per-section (required keys, optional keys with defaults)
+_SECTION_KEYS: Dict[str, Tuple[Tuple[str, ...], Dict[str, object]]] = {
+    "stage": (("module", "function"), {"boundary": False}),
+    "device": (("module",), {}),
+    "transfer": (("call",), {}),
+    "bucket": (("function",), {}),
+    "ladder": (("name",), {}),
+    "shaper": (("function", "arg"), {}),
+}
+
+
+def parse_hotpath(text: str, path: str = "<hotpath>") -> HotpathSpec:
+    """Parse the tiny TOML subset shared with wire.toml/pairs.toml/
+    layers.toml.  LOUD on any malformed line, unknown section, unknown
+    key or missing key: a half-read stage table silently checking
+    nothing would be config drift."""
+    entries: List[Tuple[str, Dict[str, object], int]] = []
+    current: Optional[Dict[str, object]] = None
+    for n, raw in enumerate(text.splitlines(), start=1):
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        if line.startswith("[[") and line.endswith("]]"):
+            section = line[2:-2].strip()
+            if section not in _SECTIONS:
+                raise ValueError(f"{path}:{n}: unknown section {line!r}")
+            current = {}
+            entries.append((section, current, n))
+            continue
+        if line.startswith("["):
+            raise ValueError(f"{path}:{n}: unknown section {line!r}")
+        if "=" not in line:
+            raise ValueError(f"{path}:{n}: expected 'key = value'")
+        if current is None:
+            raise ValueError(f"{path}:{n}: key outside a [[section]] entry")
+        key, _, value = line.partition("=")
+        if "#" in value and not value.strip().startswith('"'):
+            value = value.split("#", 1)[0]
+        current[key.strip()] = _parse_value(
+            value, f"{path}:{n}: {key.strip()}"
+        )
+
+    stages: List[StageSpec] = []
+    devices: List[str] = []
+    transfers: List[str] = []
+    buckets: List[str] = []
+    ladders: List[str] = []
+    shapers: List[Tuple[str, int]] = []
+    for section, entry, n in entries:
+        where = f"{path}:{n}: [[{section}]]"
+        required, optional = _SECTION_KEYS[section]
+        for key in required:
+            if key not in entry:
+                raise ValueError(f"{where}: missing required key {key!r}")
+        for key in entry:
+            if key not in required and key not in optional:
+                raise ValueError(f"{where}: unknown key {key!r}")
+        for key, val in entry.items():
+            want = bool if key == "boundary" else (
+                int if key == "arg" else str
+            )
+            if not isinstance(val, want):
+                raise ValueError(
+                    f"{where}: {key} must be a {want.__name__}"
+                )
+        if section in ("stage", "device"):
+            mod = entry["module"]
+            if not str(mod).endswith(".py"):
+                raise ValueError(
+                    f"{where}: module must be a .py path, got {mod!r}"
+                )
+        if section == "stage":
+            if not entry["function"]:
+                raise ValueError(f"{where}: function must be non-empty")
+            stages.append(
+                StageSpec(
+                    str(entry["module"]), str(entry["function"]),
+                    bool(entry.get("boundary", False)),
+                )
+            )
+        elif section == "device":
+            devices.append(str(entry["module"]))
+        elif section == "transfer":
+            if not entry["call"]:
+                raise ValueError(f"{where}: call must be non-empty")
+            transfers.append(str(entry["call"]))
+        elif section == "bucket":
+            if not entry["function"]:
+                raise ValueError(f"{where}: function must be non-empty")
+            buckets.append(str(entry["function"]))
+        elif section == "ladder":
+            if not entry["name"]:
+                raise ValueError(f"{where}: name must be non-empty")
+            ladders.append(str(entry["name"]))
+        elif section == "shaper":
+            if not entry["function"]:
+                raise ValueError(f"{where}: function must be non-empty")
+            if int(entry["arg"]) < 0:
+                raise ValueError(f"{where}: arg must be >= 0")
+            shapers.append((str(entry["function"]), int(entry["arg"])))
+    return HotpathSpec(
+        stages=tuple(stages),
+        devices=tuple(devices),
+        transfers=tuple(transfers),
+        buckets=tuple(buckets),
+        ladders=tuple(ladders),
+        shapers=tuple(shapers),
+    )
+
+
+def load_default_hotpath() -> HotpathSpec:
+    path = default_hotpath_file()
+    return parse_hotpath(path.read_text(encoding="utf-8"), str(path))
+
+
+# ---------------------------------------------------------------------------
+# AST helpers
+# ---------------------------------------------------------------------------
+
+
+def _leaf(func: ast.expr) -> Optional[str]:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _root(node: ast.expr) -> Optional[str]:
+    dn = _dotted(node)
+    return dn.split(".", 1)[0] if dn else None
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """True for jax.jit / jit / pjit / partial(jax.jit, ...) shapes."""
+    dn = _dotted(node)
+    if dn in ("jax.jit", "jit", "pjit", "jax.pjit"):
+        return True
+    if isinstance(node, ast.Call):
+        fn = _dotted(node.func)
+        if fn in ("partial", "functools.partial") and node.args:
+            return _is_jit_expr(node.args[0])
+        return _is_jit_expr(node.func)
+    return False
+
+
+def _transfer_match(func: ast.expr, transfers: Sequence[str]) -> Optional[str]:
+    """The declared conversion a call matches: dotted rows need the
+    dotted suffix, bare rows match the call leaf."""
+    dn = _dotted(func)
+    leaf = _leaf(func)
+    for declared in transfers:
+        if "." in declared:
+            if dn == declared or (dn and dn.endswith("." + declared)):
+                return declared
+        elif leaf == declared:
+            return declared
+    return None
+
+
+def _const_strs(node: ast.expr) -> List[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                out.append(elt.value)
+        return out
+    return []
+
+
+def _const_ints(node: ast.expr) -> List[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                out.append(elt.value)
+        return out
+    return []
+
+
+def _local_stores(fn: ast.AST) -> Set[str]:
+    """Every name the function binds locally (params included)."""
+    out: Set[str] = set()
+    args = getattr(fn, "args", None)
+    if args is not None:
+        for a in (
+            list(args.posonlyargs) + list(args.args)
+            + list(args.kwonlyargs)
+            + ([args.vararg] if args.vararg else [])
+            + ([args.kwarg] if args.kwarg else [])
+        ):
+            out.add(a.arg)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and isinstance(
+            node.ctx, (ast.Store, ast.Del)
+        ):
+            out.add(node.id)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# per-module index: functions, constants, jit callables, traced bodies
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class _JitInfo:
+    """One jitted callable: its callable leaf name, the traced body when
+    resolvable in-module, and the declared static arguments."""
+
+    name: str
+    fn: Optional[ast.FunctionDef] = None
+    static_names: Tuple[str, ...] = ()
+    static_nums: Tuple[int, ...] = ()
+    params: Tuple[str, ...] = ()
+
+
+def _jit_statics(
+    keywords: Sequence[ast.keyword],
+) -> Tuple[Tuple[str, ...], Tuple[int, ...]]:
+    names: Tuple[str, ...] = ()
+    nums: Tuple[int, ...] = ()
+    for kw in keywords:
+        if kw.arg == "static_argnames":
+            names = tuple(_const_strs(kw.value))
+        elif kw.arg == "static_argnums":
+            nums = tuple(_const_ints(kw.value))
+    return names, nums
+
+
+def _decorator_statics(
+    dec: ast.expr,
+) -> Optional[Tuple[Tuple[str, ...], Tuple[int, ...]]]:
+    """(static_argnames, static_argnums) when the decorator is a jit
+    shape, else None."""
+    if not _is_jit_expr(dec):
+        return None
+    if isinstance(dec, ast.Call):
+        return _jit_statics(dec.keywords)
+    return (), ()
+
+
+def _fn_params(fn: ast.FunctionDef) -> Tuple[str, ...]:
+    args = fn.args
+    return tuple(
+        a.arg for a in list(args.posonlyargs) + list(args.args)
+    )
+
+
+class _ModIndex:
+    """Import-free per-file symbol map: functions (plain and
+    Class.method), module int constants, jit callables + traced bodies,
+    jit factories, and module-level mutable state."""
+
+    def __init__(self, path: str, tree: ast.Module):
+        self.path = path
+        self.tree = tree
+        self.functions: Dict[str, ast.FunctionDef] = {}
+        self.int_consts: Dict[str, int] = {}
+        self.jit_callables: Dict[str, _JitInfo] = {}
+        self.traced: List[ast.FunctionDef] = []
+        self.mutable_globals: Set[str] = set()
+        self._collect_functions()
+        self._collect_consts_and_mutables()
+        self._collect_jit()
+
+    def _collect_functions(self) -> None:
+        for node in self.tree.body:
+            if isinstance(node, ast.FunctionDef):
+                self.functions[node.name] = node
+            elif isinstance(node, ast.ClassDef):
+                for sub in node.body:
+                    if isinstance(sub, ast.FunctionDef):
+                        self.functions[f"{node.name}.{sub.name}"] = sub
+        # nested defs (closure kernels: pairing's run, registry's
+        # traced) resolve by bare name only when unambiguous
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.FunctionDef) \
+                    and node.name not in self.functions \
+                    and not any(
+                        q.rsplit(".", 1)[-1] == node.name
+                        for q in self.functions
+                    ):
+                self.functions[node.name] = node
+
+    def lookup(self, name: str) -> Optional[ast.FunctionDef]:
+        if name in self.functions:
+            return self.functions[name]
+        hits = [
+            fn for qual, fn in self.functions.items()
+            if qual.rsplit(".", 1)[-1] == name
+        ]
+        if len(hits) == 1:
+            return hits[0]
+        return None
+
+    def _collect_consts_and_mutables(self) -> None:
+        candidates: Set[str] = set()
+        for node in self.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                name = node.targets[0].id
+                v = node.value
+                if isinstance(v, ast.Constant) and isinstance(v.value, int) \
+                        and not isinstance(v.value, bool):
+                    self.int_consts[name] = v.value
+                elif isinstance(v, (ast.List, ast.Dict, ast.Set,
+                                    ast.ListComp, ast.DictComp,
+                                    ast.SetComp)):
+                    candidates.add(name)
+                elif isinstance(v, ast.Call) and _leaf(v.func) in (
+                    "list", "dict", "set", "defaultdict", "deque",
+                ):
+                    candidates.add(name)
+        if not candidates:
+            return
+        mutated: Set[str] = set()
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in (_MUTATORS | {"pop", "clear"}) \
+                    and isinstance(node.func.value, ast.Name):
+                mutated.add(node.func.value.id)
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    if isinstance(t, ast.Subscript) \
+                            and isinstance(t.value, ast.Name):
+                        mutated.add(t.value.id)
+        self.mutable_globals = candidates & mutated
+
+    def _collect_jit(self) -> None:
+        traced_names: Set[str] = set()
+        factories: Set[str] = set()
+        for qual, fn in self.functions.items():
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Return) and node.value is not None \
+                        and isinstance(node.value, ast.Call) \
+                        and _is_jit_expr(node.value.func):
+                    factories.add(qual.rsplit(".", 1)[-1])
+        # decorated traced functions
+        for fn in self.functions.values():
+            for dec in fn.decorator_list:
+                statics = _decorator_statics(dec)
+                if statics is None:
+                    continue
+                names, nums = statics
+                self.traced.append(fn)
+                self.jit_callables[fn.name] = _JitInfo(
+                    fn.name, fn, names, nums, _fn_params(fn)
+                )
+                break
+        # jit-wrap call sites: fn_jit = jax.jit(fn, ...) and the names
+        # they trace
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Call) and _is_jit_expr(node.func):
+                if node.args and isinstance(node.args[0], ast.Name):
+                    traced_names.add(node.args[0].id)
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            target = node.targets[0]
+            leaf = None
+            if isinstance(target, ast.Name):
+                leaf = target.id
+            elif isinstance(target, ast.Attribute):
+                leaf = target.attr
+            if leaf is None:
+                continue
+            v = node.value
+            if isinstance(v, ast.Call) and _is_jit_expr(v.func) \
+                    and not _is_jit_expr(v):
+                # X = partial(jax.jit, ...) binds the transform, not a
+                # callable over arrays — only direct jax.jit(...) counts
+                pass
+            if isinstance(v, ast.Call) and _dotted(v.func) in (
+                "jax.jit", "jit", "pjit", "jax.pjit",
+            ):
+                names, nums = _jit_statics(v.keywords)
+                traced_fn = None
+                params: Tuple[str, ...] = ()
+                if v.args and isinstance(v.args[0], ast.Name):
+                    traced_fn = self.lookup(v.args[0].id)
+                    if traced_fn is not None:
+                        params = _fn_params(traced_fn)
+                self.jit_callables.setdefault(
+                    leaf, _JitInfo(leaf, traced_fn, names, nums, params)
+                )
+            elif isinstance(v, ast.Call) and _leaf(v.func) in factories:
+                self.jit_callables.setdefault(leaf, _JitInfo(leaf))
+        # functions traced via jax.jit(name) without a decorator
+        for fn in self.functions.values():
+            if fn.name in traced_names and fn not in self.traced:
+                self.traced.append(fn)
+
+
+# ---------------------------------------------------------------------------
+# shape-provenance / residency engine
+# ---------------------------------------------------------------------------
+
+
+def _combine(*tags: str) -> str:
+    if any(t == _DATA for t in tags):
+        return _DATA
+    if tags and all(t == _STATIC for t in tags):
+        return _STATIC
+    return _UNKNOWN
+
+
+class _FnScan:
+    """One function's forward pass: builds the size/array environment in
+    statement order and checks jit call sites (recompile-hazard +
+    static-arg-churn) and, for declared stage functions, host syncs.
+    Nested function bodies are separate scopes (and, for stages,
+    separate execution times — a closure dispatched now but drained at
+    the boundary must not be charged to this stage)."""
+
+    def __init__(
+        self,
+        path: str,
+        spec: HotpathSpec,
+        mod: _ModIndex,
+        jit_table: Dict[str, _JitInfo],
+        active: Set[str],
+        out: List[Finding],
+        sync_stage: Optional[str] = None,
+    ):
+        self.path = path
+        self.spec = spec
+        self.mod = mod
+        self.jit_table = jit_table
+        self.active = active
+        self.out = out
+        self.sync_stage = sync_stage
+        self.sizes: Dict[str, str] = {}
+        self.arrays: Dict[str, Tuple[str, str]] = {}
+        self.shapers = dict(spec.shapers)
+        self.ladders = set(spec.ladders)
+        self.buckets = set(spec.buckets)
+
+    # -- sizes -------------------------------------------------------------
+    def size_tag(self, node: ast.expr) -> str:
+        if isinstance(node, ast.Constant):
+            if isinstance(node.value, bool) or isinstance(node.value, int):
+                return _STATIC
+            return _UNKNOWN
+        if isinstance(node, ast.Name):
+            if node.id in self.sizes:
+                return self.sizes[node.id]
+            if node.id in self.ladders or node.id in self.mod.int_consts:
+                return _STATIC
+            return _UNKNOWN
+        if isinstance(node, ast.Attribute):
+            if node.attr in self.ladders:
+                return _STATIC
+            return _UNKNOWN
+        if isinstance(node, ast.Subscript):
+            base = node.value
+            if isinstance(base, ast.Attribute) and base.attr == "shape":
+                return _DATA
+            if isinstance(base, ast.Name) and base.id in self.ladders:
+                return _STATIC
+            if isinstance(base, ast.Attribute) and base.attr in self.ladders:
+                return _STATIC
+            return _UNKNOWN
+        if isinstance(node, ast.Call):
+            leaf = _leaf(node.func)
+            if leaf in self.buckets:
+                return _STATIC
+            if leaf in ("len", "sum"):
+                return _DATA
+            if leaf in ("min", "max") and node.args:
+                return _combine(*(self.size_tag(a) for a in node.args))
+            return _UNKNOWN
+        if isinstance(node, ast.BinOp):
+            return _combine(self.size_tag(node.left),
+                            self.size_tag(node.right))
+        if isinstance(node, ast.UnaryOp):
+            return self.size_tag(node.operand)
+        if isinstance(node, ast.IfExp):
+            return _combine(self.size_tag(node.body),
+                            self.size_tag(node.orelse))
+        return _UNKNOWN
+
+    def _shape_arg_tag(self, node: ast.expr) -> str:
+        if isinstance(node, (ast.Tuple, ast.List)):
+            if not node.elts:
+                return _UNKNOWN
+            return _combine(*(self.size_tag(e) for e in node.elts))
+        return self.size_tag(node)
+
+    # -- arrays ------------------------------------------------------------
+    def array_info(self, node: ast.expr) -> Tuple[str, str]:
+        if isinstance(node, ast.Name):
+            return self.arrays.get(node.id, (_UNKNOWN, _RES_UNKNOWN))
+        if isinstance(node, ast.Starred):
+            return self.array_info(node.value)
+        if isinstance(node, ast.Subscript):
+            _shape, res = self.array_info(node.value)
+            return (_UNKNOWN, res)
+        if isinstance(node, ast.BinOp):
+            return self.array_info(node.left)
+        if isinstance(node, ast.Call):
+            return self._call_info(node)
+        return (_UNKNOWN, _RES_UNKNOWN)
+
+    def _call_info(self, call: ast.Call) -> Tuple[str, str]:
+        func = call.func
+        leaf = _leaf(func)
+        root = _root(func)
+        res = _RES_UNKNOWN
+        if root in _NP_ROOTS:
+            res = _HOST
+        elif root in _DEV_ROOTS:
+            res = _DEVICE
+        if leaf in _SHAPE_CTORS and call.args:
+            return (self._shape_arg_tag(call.args[0]), res)
+        if leaf == "arange" and call.args:
+            return (self.size_tag(call.args[0]), res)
+        if leaf in ("asarray", "array") and call.args:
+            inner_shape, inner_res = self.array_info(call.args[0])
+            return (inner_shape, res if res != _RES_UNKNOWN else inner_res)
+        if leaf == "device_put" and call.args:
+            return (self.array_info(call.args[0])[0], _DEVICE)
+        if leaf == "device_get" and call.args:
+            return (self.array_info(call.args[0])[0], _HOST)
+        if leaf in self.shapers:
+            idx = self.shapers[leaf]
+            if idx < len(call.args):
+                return (self.size_tag(call.args[idx]), res)
+            return (_UNKNOWN, res)
+        if leaf in self.jit_table:
+            return (_UNKNOWN, _DEVICE)
+        if leaf == "reshape" and isinstance(func, ast.Attribute):
+            base = self.array_info(func.value)
+            shape = _combine(
+                *(self.size_tag(a) for a in call.args)
+            ) if call.args else _UNKNOWN
+            return (shape, base[1] if res == _RES_UNKNOWN else res)
+        if leaf in ("astype", "copy", "ravel", "flatten") \
+                and isinstance(func, ast.Attribute):
+            return self.array_info(func.value)
+        if res != _RES_UNKNOWN:
+            # any other np.*/jnp.* call: shape unknown, residency by root
+            return (_UNKNOWN, res)
+        return (_UNKNOWN, _RES_UNKNOWN)
+
+    # -- statement walk ----------------------------------------------------
+    def run(self, fn: ast.AST) -> None:
+        self._stmts(fn.body)
+
+    def _stmts(self, body: Sequence[ast.stmt]) -> None:
+        for st in body:
+            self._stmt(st)
+
+    def _stmt(self, st: ast.stmt) -> None:
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+            return
+        if isinstance(st, ast.Assign):
+            self._expr(st.value)
+            self._bind(st.targets, st.value)
+        elif isinstance(st, ast.AnnAssign):
+            if st.value is not None:
+                self._expr(st.value)
+                self._bind([st.target], st.value)
+        elif isinstance(st, ast.AugAssign):
+            self._expr(st.value)
+            if isinstance(st.target, ast.Name):
+                old = self.sizes.get(st.target.id, _UNKNOWN)
+                self.sizes[st.target.id] = _combine(
+                    old, self.size_tag(st.value)
+                )
+        elif isinstance(st, (ast.Return, ast.Expr)):
+            if st.value is not None:
+                self._expr(st.value)
+        elif isinstance(st, ast.Assert):
+            self._expr(st.test)
+        elif isinstance(st, ast.Raise):
+            if st.exc is not None:
+                self._expr(st.exc)
+        elif isinstance(st, ast.If):
+            self._expr(st.test)
+            self._stmts(st.body)
+            self._stmts(st.orelse)
+        elif isinstance(st, (ast.For, ast.AsyncFor)):
+            self._expr(st.iter)
+            self._clear_target(st.target)
+            self._stmts(st.body)
+            self._stmts(st.orelse)
+        elif isinstance(st, ast.While):
+            self._expr(st.test)
+            self._stmts(st.body)
+            self._stmts(st.orelse)
+        elif isinstance(st, (ast.With, ast.AsyncWith)):
+            for item in st.items:
+                self._expr(item.context_expr)
+                if item.optional_vars is not None:
+                    self._clear_target(item.optional_vars)
+            self._stmts(st.body)
+        elif isinstance(st, ast.Try):
+            self._stmts(st.body)
+            for h in st.handlers:
+                self._stmts(h.body)
+            self._stmts(st.orelse)
+            self._stmts(st.finalbody)
+
+    def _clear_target(self, target: ast.expr) -> None:
+        for node in ast.walk(target):
+            if isinstance(node, ast.Name):
+                self.sizes.pop(node.id, None)
+                self.arrays.pop(node.id, None)
+
+    def _bind(self, targets: Sequence[ast.expr], value: ast.expr) -> None:
+        if len(targets) != 1 or not isinstance(targets[0], ast.Name):
+            for t in targets:
+                self._clear_target(t)
+            return
+        name = targets[0].id
+        self.sizes[name] = self.size_tag(value)
+        self.arrays[name] = self.array_info(value)
+
+    # -- call-site checks --------------------------------------------------
+    def _expr(self, node: ast.expr) -> None:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                self._check_call(sub)
+
+    def _check_call(self, call: ast.Call) -> None:
+        # the sync pass re-walks declared stage functions the general
+        # pass already scanned — jit-site checks run only in the
+        # general pass or every stage hazard would be reported twice
+        if self.sync_stage is not None:
+            self._check_sync(call)
+            return
+        leaf = _leaf(call.func)
+        info = self.jit_table.get(leaf) if leaf else None
+        if info is not None:
+            self._check_jit_site(call, info)
+
+    def _check_jit_site(self, call: ast.Call, info: _JitInfo) -> None:
+        static_positions: Set[int] = set(info.static_nums)
+        for nm in info.static_names:
+            if nm in info.params:
+                static_positions.add(info.params.index(nm))
+        if "recompile-hazard" in self.active:
+            for i, arg in enumerate(call.args):
+                if isinstance(arg, ast.Starred):
+                    shape, _res = self.array_info(arg.value)
+                elif i in static_positions:
+                    continue
+                else:
+                    shape, _res = self.array_info(arg)
+                if shape == _DATA:
+                    self.out.append(
+                        Finding(
+                            "recompile-hazard", self.path,
+                            call.lineno, call.col_offset,
+                            f"argument {i} of jitted callable "
+                            f"{info.name!r} has a data-dependent shape "
+                            f"(never passed through the bucket ladder): "
+                            f"every distinct batch size is a fresh XLA "
+                            f"compile",
+                        )
+                    )
+            for kw in call.keywords:
+                if kw.arg is None or kw.arg in info.static_names:
+                    continue
+                shape, _res = self.array_info(kw.value)
+                if shape == _DATA:
+                    self.out.append(
+                        Finding(
+                            "recompile-hazard", self.path,
+                            call.lineno, call.col_offset,
+                            f"argument {kw.arg!r} of jitted callable "
+                            f"{info.name!r} has a data-dependent shape "
+                            f"(never passed through the bucket ladder): "
+                            f"every distinct batch size is a fresh XLA "
+                            f"compile",
+                        )
+                    )
+        if "static-arg-churn" in self.active:
+            churned: List[str] = []
+            for i in static_positions:
+                if i < len(call.args) and not isinstance(
+                    call.args[i], ast.Starred
+                ) and self.size_tag(call.args[i]) == _DATA:
+                    churned.append(
+                        info.params[i] if i < len(info.params) else str(i)
+                    )
+            for kw in call.keywords:
+                if kw.arg in info.static_names \
+                        and self.size_tag(kw.value) == _DATA:
+                    churned.append(kw.arg)
+            for nm in churned:
+                self.out.append(
+                    Finding(
+                        "static-arg-churn", self.path,
+                        call.lineno, call.col_offset,
+                        f"static argument {nm!r} of jitted callable "
+                        f"{info.name!r} is fed a per-call-varying value: "
+                        f"every distinct value is a separate "
+                        f"compile-cache entry",
+                    )
+                )
+
+    def _check_sync(self, call: ast.Call) -> None:
+        func = call.func
+        leaf = _leaf(func)
+        dn = _dotted(func)
+        bad: Optional[str] = None
+        if leaf == "block_until_ready":
+            bad = ".block_until_ready()"
+        elif leaf == "item" and not call.args \
+                and isinstance(func, ast.Attribute) \
+                and self.array_info(func.value)[1] == _DEVICE:
+            bad = ".item()"
+        elif dn in ("float", "int", "bool") and len(call.args) == 1 \
+                and self.array_info(call.args[0])[1] == _DEVICE:
+            bad = f"{dn}()"
+        elif dn in ("np.asarray", "numpy.asarray", "np.array",
+                    "numpy.array") and call.args \
+                and self.array_info(call.args[0])[1] == _DEVICE:
+            bad = dn
+        elif leaf == "device_get" and call.args:
+            bad = "device_get"
+        if bad is not None:
+            self.out.append(
+                Finding(
+                    "host-sync-hot-path", self.path,
+                    call.lineno, call.col_offset,
+                    f"{bad} inside pipeline stage {self.sync_stage!r}: "
+                    f"host syncs are legal only at declared stage "
+                    f"boundaries (tools/hotpath.toml boundary = true)",
+                )
+            )
+
+
+# ---------------------------------------------------------------------------
+# transfer-in-loop
+# ---------------------------------------------------------------------------
+
+
+def _loop_calls(loop: ast.AST) -> List[ast.Call]:
+    """Call nodes that execute per iteration.  A For's iter and a
+    comprehension's FIRST iterable are evaluated once and excluded;
+    nested function defs run at another time and are excluded (they are
+    scanned as functions of their own)."""
+    once: Set[int] = set()
+    if isinstance(loop, (ast.For, ast.AsyncFor)):
+        for sub in ast.walk(loop.iter):
+            once.add(id(sub))
+    elif isinstance(loop, (ast.ListComp, ast.SetComp, ast.DictComp,
+                           ast.GeneratorExp)):
+        # the FIRST generator's iterable is evaluated once, eagerly;
+        # later generators and all ifs run per iteration
+        if loop.generators:
+            for sub in ast.walk(loop.generators[0].iter):
+                once.add(id(sub))
+    out: List[ast.Call] = []
+    skip: Set[int] = set()
+    for sub in ast.walk(loop):
+        if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)) and sub is not loop:
+            for inner in ast.walk(sub):
+                skip.add(id(inner))
+            skip.discard(id(sub))
+    for sub in ast.walk(loop):
+        if id(sub) in skip or id(sub) in once or sub is loop:
+            continue
+        if isinstance(sub, ast.Call):
+            out.append(sub)
+    return out
+
+
+def _iter_loops(fn: ast.AST):
+    """Loop nodes of one function, excluding nested function scopes."""
+    skip: Set[int] = set()
+    for sub in ast.walk(fn):
+        if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)) and sub is not fn:
+            for inner in ast.walk(sub):
+                skip.add(id(inner))
+            skip.discard(id(sub))
+    for sub in ast.walk(fn):
+        if id(sub) in skip:
+            continue
+        if isinstance(sub, (ast.For, ast.AsyncFor, ast.While, ast.ListComp,
+                            ast.SetComp, ast.DictComp, ast.GeneratorExp)):
+            yield sub
+
+
+def _check_transfers(
+    path: str,
+    mod: _ModIndex,
+    spec: HotpathSpec,
+    out: List[Finding],
+) -> None:
+    # local helpers that perform a conversion directly (one level of
+    # interprocedural reach: a loop over self._key_limbs(key) is a
+    # per-lane conversion even though int_to_limbs is one call away)
+    bearing: Dict[str, str] = {}
+    for qual, fn in mod.functions.items():
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                declared = _transfer_match(node.func, spec.transfers)
+                if declared is not None:
+                    bearing[qual.rsplit(".", 1)[-1]] = declared
+                    break
+    seen: Set[int] = set()
+    scanned_fns = list(dict.fromkeys(mod.functions.values()))
+    for fn in scanned_fns:
+        for loop in _iter_loops(fn):
+            for call in _loop_calls(loop):
+                if id(call) in seen:
+                    continue
+                declared = _transfer_match(call.func, spec.transfers)
+                if declared is not None:
+                    seen.add(id(call))
+                    out.append(
+                        Finding(
+                            "transfer-in-loop", path,
+                            call.lineno, call.col_offset,
+                            f"host<->device conversion {declared!r} "
+                            f"inside a per-lane loop in "
+                            f"{getattr(fn, 'name', '<module>')!r} — one "
+                            f"row of the vectorized-ingest worklist "
+                            f"(hoist or batch the conversion)",
+                        )
+                    )
+                    continue
+                # module-map resolution is only sound for local calls:
+                # bare names and self.X methods.  other.validate(...) is
+                # some other object's method that merely shares a leaf.
+                is_local = isinstance(call.func, ast.Name) or (
+                    isinstance(call.func, ast.Attribute)
+                    and isinstance(call.func.value, ast.Name)
+                    and call.func.value.id in ("self", "cls")
+                )
+                leaf = _leaf(call.func)
+                if is_local and leaf in bearing and leaf not in spec.buckets:
+                    seen.add(id(call))
+                    out.append(
+                        Finding(
+                            "transfer-in-loop", path,
+                            call.lineno, call.col_offset,
+                            f"call to {leaf!r} (which performs "
+                            f"{bearing[leaf]!r}) inside a per-lane loop "
+                            f"in {getattr(fn, 'name', '<module>')!r} — "
+                            f"one row of the vectorized-ingest worklist "
+                            f"(hoist or batch the conversion)",
+                        )
+                    )
+
+
+# ---------------------------------------------------------------------------
+# tracer-leak + jit-impure (traced bodies)
+# ---------------------------------------------------------------------------
+
+
+def _tainted_names(fn: ast.FunctionDef) -> Set[str]:
+    """Names derived from the traced function's inputs (params and
+    anything computed from them or from device ops) — the values that
+    are tracers during a trace."""
+    tainted: Set[str] = set(_fn_params(fn))
+    args = fn.args
+    for a in list(args.kwonlyargs) + (
+        [args.vararg] if args.vararg else []
+    ) + ([args.kwarg] if args.kwarg else []):
+        tainted.add(a.arg)
+
+    def expr_tainted(node: ast.expr) -> bool:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load) \
+                    and sub.id in tainted:
+                return True
+            if isinstance(sub, ast.Call) and _root(sub.func) in _DEV_ROOTS:
+                return True
+        return False
+
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and expr_tainted(node.value):
+            tainted.add(node.targets[0].id)
+    return tainted
+
+
+def _check_tracer_leak(
+    path: str, fn: ast.FunctionDef, out: List[Finding]
+) -> None:
+    tainted = _tainted_names(fn)
+    local = _local_stores(fn)
+    declared_global: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.Global, ast.Nonlocal)):
+            declared_global.update(node.names)
+
+    def value_tainted(node: ast.expr) -> bool:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load) \
+                    and sub.id in tainted:
+                return True
+            if isinstance(sub, ast.Call) and _root(sub.func) in _DEV_ROOTS:
+                return True
+        return False
+
+    def flag(node: ast.AST, where: str) -> None:
+        out.append(
+            Finding(
+                "tracer-leak", path, node.lineno, node.col_offset,
+                f"traced value escapes {where} in traced function "
+                f"{fn.name!r}: the tracer outlives the trace and "
+                f"poisons later calls",
+            )
+        )
+
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            if not value_tainted(node.value):
+                # a global-declared name rebound even to a pure value
+                # still leaks trace-scoped state across calls
+                if not any(
+                    isinstance(t, ast.Name) and t.id in declared_global
+                    for t in targets
+                ):
+                    continue
+            for t in targets:
+                if isinstance(t, ast.Attribute):
+                    flag(node, "into instance/module state")
+                elif isinstance(t, ast.Subscript) \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id not in local:
+                    flag(node, "into an enclosing-scope container")
+                elif isinstance(t, ast.Name) and t.id in declared_global:
+                    flag(node, "through a global/nonlocal binding")
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _MUTATORS \
+                and isinstance(node.func.value, ast.Name) \
+                and node.func.value.id not in local \
+                and any(value_tainted(a) for a in node.args):
+            flag(node, "into an enclosing-scope container")
+
+
+def _check_jit_impure(
+    path: str, fn: ast.FunctionDef, mod: _ModIndex, out: List[Finding]
+) -> None:
+    local = _local_stores(fn)
+
+    def impure_call(node: ast.Call) -> Optional[str]:
+        dn = _dotted(node.func)
+        if dn == "print":
+            return "print"
+        if dn is not None:
+            root = dn.split(".")[0]
+            if root in _IMPURE_ROOTS:
+                return dn
+            if any(dn == d or dn.startswith(d + ".")
+                   for d in _IMPURE_DOTTED):
+                return dn
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "block_until_ready":
+            return ".block_until_ready()"
+        return None
+
+    # ast.walk is breadth-first: a flagged Subscript is seen before its
+    # inner os.environ Attribute — counting both would double-report
+    env_counted: Set[int] = set()
+    for node in ast.walk(fn):
+        bad: Optional[str] = None
+        if isinstance(node, ast.Call):
+            bad = impure_call(node)
+        elif isinstance(node, ast.Subscript) \
+                and _dotted(node.value) in _IMPURE_ENV:
+            bad = "os.environ[...]"
+            env_counted.add(id(node.value))
+        elif isinstance(node, ast.Attribute) and node.attr == "environ" \
+                and _dotted(node) in _IMPURE_ENV \
+                and id(node) not in env_counted:
+            bad = "os.environ"
+        elif isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load) \
+                and node.id in mod.mutable_globals \
+                and node.id not in local:
+            bad = f"mutable module state {node.id!r}"
+        if bad is not None:
+            out.append(
+                Finding(
+                    "jit-impure", path, node.lineno, node.col_offset,
+                    f"{bad} inside traced function {fn.name!r}: runs at "
+                    f"trace time / forces a host sync, not per call",
+                )
+            )
+
+
+# ---------------------------------------------------------------------------
+# per-file analysis
+# ---------------------------------------------------------------------------
+
+
+def _qualnames(tree: ast.Module):
+    """(qualname, FunctionDef) pairs: top-level, Class.method, and
+    nested defs under their bare name."""
+    for node in tree.body:
+        if isinstance(node, ast.FunctionDef):
+            yield node.name, node
+        elif isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, ast.FunctionDef):
+                    yield f"{node.name}.{sub.name}", sub
+
+
+def _stage_functions(
+    path: str, tree: ast.Module, spec: HotpathSpec
+) -> List[Tuple[ast.FunctionDef, StageSpec]]:
+    posix = Path(path).as_posix()
+    rows = [s for s in spec.stages if posix.endswith(s.module)]
+    if not rows:
+        return []
+    out: List[Tuple[ast.FunctionDef, StageSpec]] = []
+    quals = list(_qualnames(tree))
+    for row in rows:
+        for qual, fn in quals:
+            if qual == row.function or (
+                "." not in row.function
+                and qual.rsplit(".", 1)[-1] == row.function
+            ):
+                out.append((fn, row))
+    return out
+
+
+class _FileAnalyzer:
+    def __init__(
+        self,
+        path: str,
+        tree: ast.Module,
+        mods: Dict[str, _ModIndex],
+        jit_table: Dict[str, _JitInfo],
+        spec: HotpathSpec,
+        active: Set[str],
+    ):
+        self.path = path
+        self.tree = tree
+        self.mod = mods[path]
+        self.jit_table = jit_table
+        self.spec = spec
+        self.active = active
+
+    def run(self) -> List[Finding]:
+        out: List[Finding] = []
+        posix = Path(self.path).as_posix()
+        if {"recompile-hazard", "static-arg-churn"} & self.active:
+            for fn in dict.fromkeys(self.mod.functions.values()):
+                _FnScan(
+                    self.path, self.spec, self.mod, self.jit_table,
+                    self.active, out,
+                ).run(fn)
+        if "host-sync-hot-path" in self.active:
+            for fn, row in _stage_functions(self.path, self.tree, self.spec):
+                if row.boundary:
+                    continue
+                _FnScan(
+                    self.path, self.spec, self.mod, self.jit_table,
+                    self.active, out, sync_stage=row.function,
+                ).run(fn)
+        if "transfer-in-loop" in self.active and any(
+            posix.endswith(m) for m in self.spec.devices
+        ):
+            _check_transfers(self.path, self.mod, self.spec, out)
+        if {"tracer-leak", "jit-impure"} & self.active:
+            for fn in self.mod.traced:
+                if "tracer-leak" in self.active:
+                    _check_tracer_leak(self.path, fn, out)
+                if "jit-impure" in self.active:
+                    _check_jit_impure(self.path, fn, self.mod, out)
+        return [f for f in out if f.rule in self.active]
+
+
+# ---------------------------------------------------------------------------
+# drivers (the toolkit analyzer contract)
+# ---------------------------------------------------------------------------
+
+
+def analyze_sources(
+    sources: Dict[str, str],
+    rule_ids: Optional[Iterable[str]] = None,
+    hotpath: Optional[HotpathSpec] = None,
+    collect_suppressed: Optional[List[Finding]] = None,
+) -> Tuple[List[Finding], Dict[str, int]]:
+    """Analyze {path: source}.  ``hotpath`` defaults to the packaged
+    ``tools/hotpath.toml`` (loud ValueError when missing/malformed)."""
+    active = set(rule_ids) if rule_ids is not None else set(RULES)
+    for rid in active:
+        if rid not in RULES:
+            raise ValueError(f"unknown rule id {rid!r}")
+    if hotpath is None:
+        hotpath = load_default_hotpath()
+
+    mods: Dict[str, _ModIndex] = {}
+    trees: Dict[str, ast.Module] = {}
+    findings: List[Finding] = []
+    for path, source in sorted(sources.items()):
+        try:
+            tree = ast.parse(source, filename=path)
+        except SyntaxError as exc:
+            findings.append(
+                Finding(
+                    "syntax-error", path, exc.lineno or 1,
+                    exc.offset or 0, f"cannot parse: {exc.msg}",
+                )
+            )
+            continue
+        trees[path] = tree
+        mods[path] = _ModIndex(path, tree)
+
+    # the cross-file jit-callable table: a leaf defined jitted anywhere
+    # (verify_batch_jit in p256_kernel) is a jit call site everywhere
+    # (tpu_provider's self._pk.verify_batch_jit)
+    jit_table: Dict[str, _JitInfo] = {}
+    for path in sorted(mods):
+        for leaf, info in mods[path].jit_callables.items():
+            jit_table.setdefault(leaf, info)
+
+    n_suppressed = 0
+    for path, tree in sorted(trees.items()):
+        raw = _FileAnalyzer(
+            path, tree, mods, jit_table, hotpath, active
+        ).run()
+        raw.sort(key=Finding.key)
+        supp = toolkit.suppressed_rules(sources[path], "fabtrace")
+        kept, suppressed = toolkit.apply_suppressions(raw, supp)
+        findings.extend(kept)
+        n_suppressed += len(suppressed)
+        if collect_suppressed is not None:
+            collect_suppressed.extend(suppressed)
+    findings.sort(key=Finding.key)
+    stats = {"files": len(sources), "suppressed": n_suppressed}
+    return findings, stats
+
+
+def analyze_source(
+    source: str,
+    path: str,
+    rule_ids: Optional[Iterable[str]] = None,
+    hotpath: Optional[HotpathSpec] = None,
+) -> Tuple[List[Finding], int]:
+    """Single-blob convenience (fixtures/tests)."""
+    findings, stats = analyze_sources({path: source}, rule_ids, hotpath)
+    return findings, stats["suppressed"]
+
+
+def analyze_paths(
+    paths: Sequence[str],
+    rule_ids: Optional[Iterable[str]] = None,
+    excludes: Sequence[str] = DEFAULT_EXCLUDES,
+    hotpath: Optional[HotpathSpec] = None,
+) -> Tuple[List[Finding], Dict[str, int]]:
+    files = iter_py_files(paths, excludes)
+    sources, io_findings = toolkit.read_sources(files)
+    findings, stats = analyze_sources(sources, rule_ids, hotpath)
+    findings.extend(io_findings)
+    findings.sort(key=Finding.key)
+    stats["files"] = len(files)
+    return findings, stats
+
+
+def live_suppression_keys(
+    sources: Dict[str, str], rules: Set[str]
+) -> Set[Tuple[str, int, str]]:
+    """The toolkit analyzer-registry staleness protocol (consumed by
+    fabreg's suppression-stale): (normalized path, line, rule) for
+    every fabtrace suppression that still absorbs a finding."""
+    needed = set(RULES) if "all" in rules else (rules & set(RULES))
+    if not needed:
+        return set()
+    suppressed: List[Finding] = []
+    analyze_sources(sources, needed, collect_suppressed=suppressed)
+    return {
+        (toolkit.normalize_path(f.path), f.line, f.rule)
+        for f in suppressed
+    }
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = toolkit.build_parser(
+        "fabtrace",
+        "device-plane trace-discipline analyzer for fabric-tpu "
+        "(dependency-free; never imports the analyzed code)",
+    )
+    parser.add_argument(
+        "--hotpath",
+        metavar="FILE",
+        help="pipeline-stage table (default: tools/hotpath.toml next to "
+        "this module)",
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        toolkit.print_rule_list(RULES, width=20)
+        return 0
+
+    rc = toolkit.check_paths_exist(args.paths, "fabtrace", parser)
+    if rc:
+        return rc
+    rule_ids, rc = toolkit.parse_rule_arg(args.rules, RULES, "fabtrace")
+    if rc:
+        return rc
+
+    hotpath: Optional[HotpathSpec] = None
+    try:
+        if args.hotpath is not None:
+            hotpath = parse_hotpath(
+                Path(args.hotpath).read_text(encoding="utf-8"),
+                args.hotpath,
+            )
+        else:
+            hotpath = load_default_hotpath()
+    except (OSError, ValueError) as exc:
+        print(f"fabtrace: error: hotpath table: {exc}", file=sys.stderr)
+        return 2
+
+    excludes = tuple(DEFAULT_EXCLUDES) + tuple(args.exclude)
+    findings, stats = analyze_paths(args.paths, rule_ids, excludes, hotpath)
+
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "version": __version__,
+                    "files": stats["files"],
+                    "suppressed": stats["suppressed"],
+                    "findings": [f.to_dict() for f in findings],
+                },
+                indent=2,
+            )
+        )
+    else:
+        toolkit.print_findings(findings)
+        print(
+            f"fabtrace: {len(findings)} finding(s) in {stats['files']} "
+            f"file(s) ({stats['suppressed']} suppressed)"
+        )
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -81,6 +81,7 @@ ANALYZER_SPECS: Tuple["AnalyzerSpec", ...] = (
     AnalyzerSpec("fabreg", "fabric_tpu.tools.fabreg", pkg_scope_only=False),
     AnalyzerSpec("fablife", "fabric_tpu.tools.fablife", pkg_scope_only=False),
     AnalyzerSpec("fabwire", "fabric_tpu.tools.fabwire"),
+    AnalyzerSpec("fabtrace", "fabric_tpu.tools.fabtrace"),
 )
 
 #: Historical shape: the tool-name tuple (derived from the registry).

@@ -33,6 +33,12 @@
 #                      fields unreachable below their rev, wire lengths
 #                      bounded, OP_*/ST_* dispatch total, store read
 #                      twins re-verify frame crcs (wire_gate.sh)
+#  12. trace        -- device-plane trace discipline: jit call sites
+#                      statically compile-free (shapes from the bucket
+#                      ladder), no hidden host sync in declared pipeline
+#                      stages, no per-lane host<->device conversion in
+#                      device-tier loops, no tracer leaks or trace-time
+#                      impurity (trace_gate.sh, tools/hotpath.toml)
 #
 # Each stage runs even if an earlier one failed (one run reports ALL
 # broken gates) and prints its wall-clock time; the exit code is nonzero
@@ -57,7 +63,7 @@ elif [ -n "${1:-}" ]; then
     exit 2
 fi
 
-STAGE_NAMES=(compileall collect fablint fabdep fabflow chaos serve obs reg life wire)
+STAGE_NAMES=(compileall collect fablint fabdep fabflow chaos serve obs reg life wire trace)
 total=${#STAGE_NAMES[@]}
 
 fail=0
@@ -98,6 +104,7 @@ run_stage obs bash scripts/obs_gate.sh
 run_stage reg bash scripts/reg_gate.sh
 run_stage life bash scripts/life_gate.sh
 run_stage wire bash scripts/wire_gate.sh
+run_stage trace bash scripts/trace_gate.sh
 
 if [ "$stage_idx" -ne "$total" ]; then
     echo "ci_gate: BUG: ${stage_idx} run_stage calls but ${total} stage names" >&2
@@ -116,5 +123,5 @@ fi
 if [ -n "$only" ]; then
     echo "ci_gate: OK (--only ${only})"
 else
-    echo "ci_gate: OK (compileall + collect + fablint + fabdep + fabflow + chaos + serve + obs + reg + life + wire)"
+    echo "ci_gate: OK (compileall + collect + fablint + fabdep + fabflow + chaos + serve + obs + reg + life + wire + trace)"
 fi

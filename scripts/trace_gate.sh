@@ -1,0 +1,30 @@
+#!/usr/bin/env bash
+# fabtrace gate: device-plane trace discipline — every jit/pjit call
+# site statically compile-free (argument shapes provably drawn from the
+# bucket ladder / module constants), static_argnums/static_argnames fed
+# per-call-stable values, no hidden host sync (.item(), float()/int()/
+# bool(), np.asarray, block_until_ready) inside a declared pipeline
+# stage outside its boundary = true sync points, no host<->device
+# conversion inside per-lane loops in the device tier (the
+# vectorized-ingest worklist), no tracer escaping a traced body, and no
+# impure host call / mutable-module-state read at trace time
+# (tools/hotpath.toml is the stage/device/transfer table).
+#
+# Dependency-free and import-free: fabtrace abstractly interprets shape
+# provenance and residency with ast on the shared toolkit chassis — it
+# never imports the analyzed modules, so this gate passes/fails
+# identically in minimal environments (no cryptography, no jax, no
+# numpy).  Scans the package only: tests craft shape-polymorphic and
+# syncing fixtures by design.  Runs in ~2s.
+set -uo pipefail
+
+cd "$(dirname "$0")/.."
+
+timeout -k 5 60 python -m fabric_tpu.tools.fabtrace fabric_tpu/
+rc=$?
+
+if [ "$rc" -ne 0 ]; then
+    echo "trace_gate: FAIL (fabtrace rc=$rc)" >&2
+    exit 1
+fi
+echo "trace_gate: OK"

@@ -156,49 +156,17 @@ def test_mutable_default_none_sentinel_is_clean():
                 rules=["mutable-default"]) == []
 
 
-def test_jit_impure_fires_in_decorated_function():
-    src = """
-    @jax.jit
-    def kernel(x):
-        print(x)
-        return x
-    """
-    findings = lint(src, path="fabric_tpu/ops/fixture.py",
-                    rules=["jit-impure"])
-    assert rule_ids(findings) == ["jit-impure"]
+# The jit-impure firing fixtures moved to tests/test_fabtrace.py in
+# PR 18 (behavior-pinned) when the rule migrated to fabtrace's
+# traced-body dataflow.
 
 
-def test_jit_impure_fires_via_jit_assignment_and_host_sync():
-    src = """
-    def kernel(x):
-        t = time.time()
-        np.asarray(x).block_until_ready()
-        return x
-
-    kernel_jit = jax.jit(kernel)
-    """
-    findings = lint(src, path="fabric_tpu/ops/fixture.py",
-                    rules=["jit-impure"])
-    assert len(findings) >= 2
-
-
-def test_jit_impure_pure_kernel_is_clean():
-    src = """
-    @partial(jax.jit, static_argnames=("n",))
-    def kernel(x, n):
-        return jnp.sum(x) + n
-    """
-    assert lint(src, path="fabric_tpu/ops/fixture.py",
-                rules=["jit-impure"]) == []
-
-
-def test_jit_impure_unjitted_host_wrapper_is_clean():
-    src = """
-    def host_wrapper(x):
-        return np.asarray(x)
-    """
-    assert lint(src, path="fabric_tpu/ops/fixture.py",
-                rules=["jit-impure"]) == []
+def test_jit_impure_is_retired_from_fablint():
+    assert "jit-impure" not in fablint.RULES
+    assert lint(
+        "@jax.jit\ndef kernel(x):\n    print(x)\n    return x\n",
+        path="fabric_tpu/ops/fixture.py",
+    ) == []
 
 
 def test_limb_dtype_fires_without_dtype():
@@ -374,7 +342,7 @@ def test_cli_list_rules_and_bad_rule(capsys):
     out = capsys.readouterr().out
     for rid in fablint.RULES:
         assert rid in out
-    assert len(fablint.RULES) >= 10
+    assert len(fablint.RULES) >= 9
     assert fablint.main(["--rules", "no-such-rule", "x.py"]) == 2
     assert fablint.main([]) == 2
     assert fablint.main(["no/such/dir"]) == 2  # usage error, not a finding
@@ -402,9 +370,10 @@ def test_toolkit_port_changed_nothing():
 
     assert fablint.Finding is toolkit.Finding
     assert fablint.DEFAULT_EXCLUDES == toolkit.DEFAULT_EXCLUDES
+    # jit-impure left for fabtrace in PR 18 (behavior-pinned there)
     assert sorted(fablint.RULES) == [
         "all-drift", "assert-security", "broad-except", "digest-compare",
-        "fork-start", "jit-impure", "limb-dtype", "module-import",
+        "fork-start", "limb-dtype", "module-import",
         "mutable-default", "shell-injection",
     ]
     _findings, stats = fablint.lint_paths([str(REPO_ROOT / "fabric_tpu")])

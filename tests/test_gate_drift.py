@@ -49,8 +49,11 @@ def test_ci_gate_run_stage_calls_match_the_stage_list():
     assert "life" in names
     assert "life_gate.sh" in text
     # PR 17: stage 11 wires the fabwire gate
-    assert names[-1] == "wire" and len(names) == 11
+    assert "wire" in names
     assert "wire_gate.sh" in text
+    # PR 18: stage 12 wires the fabtrace gate
+    assert names[-1] == "trace" and len(names) == 12
+    assert "trace_gate.sh" in text
 
 
 def test_every_wire_toml_surface_exists_on_disk():
@@ -71,6 +74,27 @@ def test_every_wire_toml_surface_exists_on_disk():
     assert missing == [], (
         f"tools/wire.toml names modules that do not exist: {missing} — "
         f"update the table when a framing surface moves"
+    )
+
+
+def test_every_hotpath_toml_surface_exists_on_disk():
+    """Same discipline as the wire.toml pin: fabtrace only scans stage
+    and device rows whose module path matches a file on disk, so a
+    renamed module would make every check on that surface vacuously
+    pass.  Every declared path must exist."""
+    from fabric_tpu.tools import fabtrace
+
+    spec = fabtrace.load_default_hotpath()
+    declared = {s.module for s in spec.stages}
+    declared.update(spec.devices)
+    missing = sorted(
+        mod
+        for mod in declared
+        if not (REPO_ROOT / "fabric_tpu" / mod).is_file()
+    )
+    assert missing == [], (
+        f"tools/hotpath.toml names modules that do not exist: {missing} "
+        f"— update the table when a pipeline stage moves"
     )
 
 

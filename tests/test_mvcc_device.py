@@ -416,6 +416,43 @@ def test_resident_multi_block_sequence_matches_oracle():
         db.apply_updates(host_up, hashed=host_hup)
 
 
+def test_resident_capacity_growth_multiple_doublings_in_one_batch():
+    """PR 18 regression (fabtrace transfer-in-loop): capacity growth now
+    resolves the final capacity on host and extends the device version
+    table with ONE concatenate instead of one per doubling.  A first
+    batch that jumps the index 8x past the initial capacity exercises
+    the multi-doubling path; verdicts and the refreshed table must stay
+    oracle-exact across the growth event and a follow-up block."""
+    from fabric_tpu.ledger.mvcc_device import ResidentDeviceValidator
+
+    db = seeded_db(n_keys=70)
+    res = ResidentDeviceValidator(db, capacity=8)  # index will pass 64
+    for block_num in (1, 2):
+        rwsets = []
+        for t in range(20):
+            i = (block_num * 20 + t * 3) % 70
+            reads = [rw.KVRead(f"k{i}", db.get_version("cc", f"k{i}"))]
+            writes = [rw.KVWrite(f"k{(i + 1) % 70}", False, b"v")]
+            rwsets.append(
+                rw.TxRwSet(
+                    (rw.NsRwSet("cc", tuple(reads), tuple(writes), (), ()),)
+                )
+            )
+        incoming = [VALID] * len(rwsets)
+        host_codes, host_up, host_hup = Validator(db).validate_and_prepare_batch(
+            block_num, rwsets, list(incoming)
+        )
+        res_codes, res_up, res_hup = res.validate_and_prepare_batch(
+            block_num, rwsets, list(incoming)
+        )
+        assert res.last_path == "device"
+        assert res_codes == host_codes
+        assert batches_equal(res_up, host_up)
+        assert batches_equal(res_hup, host_hup)
+        db.apply_updates(host_up, hashed=host_hup)
+    assert res._cap >= len(res._index)
+
+
 def test_resident_host_fallback_refreshes_table():
     """A range-query block takes the host path; the resident table must
     refresh the keys it wrote, so the NEXT device block still agrees."""

@@ -211,3 +211,58 @@ def test_multichannel_rejects_unknown_channel(cpu8, net):
     mc = MultiChannelValidator(mesh, {"ch0": _validator(net, "ch0")})
     with pytest.raises(KeyError):
         mc.validate({"nope": _channel_block(net, "nope", 1)})
+
+
+def test_multichannel_epilogue_slices_host_mask_per_channel(monkeypatch):
+    """PR 18 regression (fabtrace transfer-in-loop): the per-channel
+    epilogue slices the ONE host materialization of the sharded mask —
+    no second np.asarray copy per channel.  Fakes keep it device-free:
+    each channel's ok_list must be exactly its own mask row's first n
+    lanes, with the padded tail dropped."""
+    from types import SimpleNamespace
+
+    from fabric_tpu.parallel import multichannel as mc
+
+    class FakeSharded:
+        data_size = 1
+        channel_size = 1
+
+        def verify_channels(self, *stacked):
+            return stacked[-1]  # the (channels, lanes) ok plane
+
+    class FakePrep:
+        def prep_limbs(self, keys, sigs, digests):
+            import fabric_tpu.ops.bignum as bn
+
+            n = len(keys)
+            limbs = tuple(
+                np.zeros((bn.NLIMBS, n), dtype=np.uint32) for _ in range(5)
+            )
+            ok = np.array([i % 2 == 0 for i in range(n)])
+            return (*limbs, ok)
+
+    class FakeValidator:
+        def __init__(self, n):
+            self.n = n
+
+        def collect_sig_jobs(self, parsed):
+            jobs = list(range(self.n))
+            return jobs, jobs, jobs, jobs, jobs
+
+        def finish_sig_results(self, jobs, job_identity, ok_list):
+            return ok_list
+
+        def validate(self, block, parsed, sig_results=None):
+            return sig_results
+
+    monkeypatch.setattr(mc, "parse_block", lambda data: data)
+    v = mc.MultiChannelValidator.__new__(mc.MultiChannelValidator)
+    v.validators = {"a": FakeValidator(3), "b": FakeValidator(5)}
+    v.sharded = FakeSharded()
+    v._prep = FakePrep()
+    v.last_device_ms = 0.0
+
+    block = SimpleNamespace(data=SimpleNamespace(data=[]))
+    out = v.validate({"a": block, "b": block})
+    assert out["a"] == [True, False, True]
+    assert out["b"] == [True, False, True, False, True]

@@ -75,3 +75,40 @@ def test_async_resolver_order():
         [c[0] for c in cases], [c[1] for c in cases], [c[2] for c in cases]
     )
     assert r1() == r2()
+
+
+def test_key_columns_vectorized_matches_per_key_reference():
+    """PR 18 regression (fabtrace transfer-in-loop): the key-column
+    dedup now converts cache-miss keys with one vectorized
+    be_bytes_to_limbs call per coordinate instead of a per-key
+    int_to_limbs loop.  Columns, on-curve flags, lane indices and the
+    SKI cache must match the per-key reference exactly — including an
+    off-curve key, id()-deduped repeats, and a pure cache-hit pass."""
+    import numpy as np
+
+    from fabric_tpu.ops import bignum as bn
+
+    pts = []
+    acc = None
+    for _ in range(4):
+        acc = p256.point_add(acc, p256.GENERATOR)
+        pts.append(acc)
+    keys = [ECDSAPublicKey(x, y) for x, y in pts]
+    keys.append(ECDSAPublicKey(12345, 67890))  # off-curve
+
+    prov = TPUProvider.__new__(TPUProvider)  # no device/jax needed
+    prov._key_limb_cache = {}
+    seq = [keys[0], keys[1], keys[0], keys[4], keys[2], keys[1], keys[3]]
+    kx, ky, on_curve, idx = prov._dedup_key_columns(seq)
+    assert list(idx) == [0, 1, 0, 2, 3, 1, 4]
+    order = [keys[0], keys[1], keys[4], keys[2], keys[3]]
+    for col, key in enumerate(order):
+        assert np.array_equal(kx[col], bn.int_to_limbs(key.x))
+        assert np.array_equal(ky[col], bn.int_to_limbs(key.y))
+        assert on_curve[col] == p256.is_on_curve((key.x, key.y))
+    assert list(on_curve) == [True, True, False, True, True]
+    # second pass is pure cache hits and must return identical columns
+    kx2, ky2, on_curve2, idx2 = prov._dedup_key_columns(seq)
+    assert list(idx2) == list(idx) and list(on_curve2) == list(on_curve)
+    assert all(np.array_equal(a, b) for a, b in zip(kx, kx2))
+    assert all(np.array_equal(a, b) for a, b in zip(ky, ky2))
