@@ -108,7 +108,7 @@ class Launcher:
             with open(dest, "wb") as f:
                 f.write(data)
         with open(os.path.join(dirs["metadata"], "metadata.json"), "w") as f:
-            json.dump(meta, f)
+            json.dump(meta, f, sort_keys=True)
         return meta
 
     def launch(
@@ -127,6 +127,7 @@ class Launcher:
             json.dump(
                 {"chaincode_id": pkg.package_id, "peer_address": peer_address},
                 f,
+                sort_keys=True,
             )
 
         # external builders get first claim (externalbuilder.go detect loop)

@@ -97,7 +97,7 @@ class DeliverHandler:
                     common_pb2.SignatureHeader, payload.header.signature_header
                 )
                 expires = identity_expiration(shdr.creator)
-                if expires is not None and expires < datetime.datetime.now(
+                if expires is not None and expires < datetime.datetime.now(  # fabdet: disable=wallclock-in-det  # cert-expiry admission gate: SEMANTICALLY time-dependent (identity validity window) — it gates stream access; the delivered block bytes come solely from the store
                     datetime.timezone.utc
                 ):
                     raise DeliverError(common_pb2.FORBIDDEN, "client identity expired")
@@ -113,7 +113,7 @@ class DeliverHandler:
             start, stop = self._resolve_range(seek, source)
             number = start
             while number <= stop:
-                if expires is not None and expires < datetime.datetime.now(
+                if expires is not None and expires < datetime.datetime.now(  # fabdet: disable=wallclock-in-det  # mid-stream session-expiry recheck (deliver.go toFilteredBlock loop): semantically time-dependent access control, not block-content nondeterminism
                     datetime.timezone.utc
                 ):
                     raise DeliverError(common_pb2.FORBIDDEN, "session expired")

@@ -288,7 +288,8 @@ class PeerNode:
         os.makedirs(os.path.dirname(self._sources_path()), exist_ok=True)
         with open(self._sources_path(), "w") as f:
             json.dump(
-                {"\x00".join(k): v for k, v in self._cc_sources.items()}, f
+                {"\x00".join(k): v for k, v in self._cc_sources.items()}, f,
+                sort_keys=True,
             )
 
     # -- private data distribution (endorser.go distributePrivateData) ----

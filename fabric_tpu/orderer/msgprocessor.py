@@ -135,7 +135,7 @@ class ExpirationFilter:
             cert = x509.load_pem_x509_certificate(sid.id_bytes)
         except Exception:
             return  # not an x509 identity; sig filter will judge it
-        now = datetime.datetime.now(datetime.timezone.utc)
+        now = datetime.datetime.now(datetime.timezone.utc)  # fabdet: disable=wallclock-in-det  # identity-expiration admission filter (msgprocessor.go expiration discipline): semantically time-dependent gate on which envelopes are ADMITTED; block bytes are built from the admitted envelopes, not from the clock
         if cert.not_valid_after_utc < now:
             raise MsgProcessorError("identity expired")
 

@@ -592,7 +592,7 @@ class SidecarProvider:
     def batch_verify(
         self, keys, signatures, digests
     ) -> List[bool]:
-        return self._batch_verify(keys, signatures, digests,
+        return self._batch_verify(keys, signatures, digests,  # fabdet: disable=wallclock-in-det  # wire deadline budget: deadline_ms carries the budget REMAINING at encode time — a semantically time-derived protocol field (masks, not deadlines, are the replay contract)
                                   self._deadline())
 
     def _batch_verify(
@@ -630,7 +630,7 @@ class SidecarProvider:
                             keys, signatures, digests,
                             "deadline expired during connect",
                         )
-                payload = self._encode(keys, signatures, digests, remaining)
+                payload = self._encode(keys, signatures, digests, remaining)  # fabdet: disable=wallclock-in-det  # remaining-budget recompute before re-encode: the deadline_ms wire field is semantically time-derived by contract (masks are the det surface)
                 status, retry_ms, mask, message = self._verify_once(
                     payload, remaining
                 )
@@ -722,7 +722,7 @@ class SidecarProvider:
         deadline = self._deadline()
         try:
             self.client.ensure_connected()
-            payload = self._encode(
+            payload = self._encode(  # fabdet: disable=wallclock-in-det  # async-submit remaining budget: deadline_ms is a semantically time-derived wire field by contract (masks are the det surface)
                 keys, signatures, digests,
                 None if deadline is None else deadline - time.monotonic(),
             )

@@ -521,7 +521,7 @@ class SidecarRouter:
         """Lane payload at THIS endpoint's negotiated revision, with
         the budget REMAINING at encode time when both ends speak v3
         (0 = no budget; the body layout is keyed to the frame rev)."""
-        return encode_lanes(
+        return encode_lanes(  # fabdet: disable=wallclock-in-det  # per-endpoint re-encode with the budget REMAINING: deadline_ms is a semantically time-derived wire field by contract (masks, not deadlines, are the replay surface)
             keys, signatures, digests,
             qos_class=self.qos_class, channel=self.channel,
             deadline_ms=(
@@ -737,7 +737,7 @@ class SidecarRouter:
 
     # -- the batch plane ---------------------------------------------------
     def batch_verify(self, keys, signatures, digests) -> List[bool]:
-        return self._batch_verify(keys, signatures, digests,
+        return self._batch_verify(keys, signatures, digests,  # fabdet: disable=wallclock-in-det  # wire deadline budget: deadline_ms carries the budget REMAINING at encode time — semantically time-derived protocol field (masks are the replay contract)
                                   self._deadline())
 
     def _batch_verify(
@@ -836,7 +836,7 @@ class SidecarRouter:
         for e in self._order(n):
             if not self._probe_ok(e):
                 continue
-            token = self._submit_to(e, keys, signatures, digests, 0, deadline)
+            token = self._submit_to(e, keys, signatures, digests, 0, deadline)  # fabdet: disable=wallclock-in-det  # failover submit with the remaining budget: deadline_ms is a semantically time-derived wire field by contract (masks are the det surface)
             if token is not None:
                 chosen = e
                 t_submit = time.monotonic()

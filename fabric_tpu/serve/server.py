@@ -617,7 +617,8 @@ class SidecarServer:
                 elif opcode == proto.OP_STATS:
                     self._send(
                         conn, proto.OP_STATS, req_id,
-                        json.dumps(self.describe()).encode(), send_lock,
+                        json.dumps(self.describe(), sort_keys=True).encode(),
+                        send_lock,
                         version=version,
                     )
                 elif opcode == proto.OP_SHUTDOWN:

@@ -238,7 +238,8 @@ def build_stream(
     with open(os.path.join(stream_dir, "pvt.json"), "w") as f:
         json.dump(pvt_json, f, sort_keys=True)
     with open(os.path.join(stream_dir, "meta.json"), "w") as f:
-        json.dump({"channels": n_channels, "blocks": n_blocks}, f)
+        json.dump({"channels": n_channels, "blocks": n_blocks}, f,
+                  sort_keys=True)
 
 
 # ---------------------------------------------------------------------------

@@ -2143,7 +2143,7 @@ def run_gray_failure(seed: int, clock: StageClock, scale: float = 1.0):
         out = router.batch_verify(k, s, d)
         check(list(out) == e, "mask wrong after gray recovery")
         all_masks.extend(out)
-        det.update(
+        det.update(  # fabdet: disable=wallclock-in-det  # tail_bounded/recovered are check()-dominated: any run reaching this sink records the constant True — a timing excursion CRASHES the scenario instead of flapping the scorecard bytes
             {
                 "endpoints": 2,
                 "delay_ms": delay_ms,

@@ -1,0 +1,1393 @@
+"""fabdet — whole-program byte-determinism taint analyzer for fabric-tpu.
+
+Byte-determinism is this repo's verification currency: chaos_gate
+byte-diffs same-seed scorecards, crash_matrix byte-diffs crashchild
+digests, the snapshot plane sha256-seals its files, and the two big
+ROADMAP items (verify-once verdict certificates, snapshot-streaming
+bootstrap) are only *sound* if two peers compute byte-identical
+artifacts.  fabdet statically guards that property: it is an
+interprocedural, flow-sensitive taint analysis that tracks
+nondeterminism *sources* (wall clocks, unseeded randomness, process
+environment, hash/fs iteration order, unsorted JSON encoding) into
+declared det *surfaces* (the functions that emit persisted,
+cross-peer-compared, or replay-diffed bytes).
+
+The surface table is declarative — ``tools/det.toml`` — so the
+verdict-certificate and snapshot-bootstrap builders extend the gate by
+adding ``[[surface]]`` rows, never by editing the analyzer (the
+fabreg/fabwire discipline).  Each row declares::
+
+    [[surface]]
+    name = "snapshot-files"                  # unique id for messages
+    module = "fabric_tpu/ledger/snapshot.py" # path, pinned on disk
+    functions = ["_w", "generate_snapshot"]  # fnmatch over qualnames
+    tier = "cross-peer"        # persisted | cross-peer | replay
+    doc = "why these bytes must be deterministic"
+    # optional:
+    # mode = "det-dict"        # fabchaos scorecard mode (see below)
+    # decorator = "scenario"   # det-dict: analyze decorated functions
+    # sinks = ["execute"]      # extra call leaves whose args are sinks
+
+Tier semantics: ``persisted`` bytes are re-read/byte-diffed across
+process restarts on ONE node (store frames, AOT artifacts, metadata
+files); ``cross-peer`` bytes are compared between peers (wire bodies,
+rwset hashes, snapshot files, block content); ``replay`` bytes are
+byte-diffed between same-seed runs (chaos scorecards, crash digests).
+All three demand the same discipline — the tier names which contract a
+finding breaks, and which regression test a fix needs.
+
+Two surface modes:
+
+* ``outputs`` (default): the function's *emissions* are the sink —
+  returned/yielded values, arguments of ``.write()``/``json.dump``
+  calls inside it, arguments it passes to other declared surfaces, and
+  any extra per-row ``sinks`` leaves.  A tainted branch condition that
+  gates a ``raise``/``return``/``break`` inside the function is also
+  reported (a delivery stream that cuts off on wall-clock is not
+  byte-deterministic for a replaying twin).
+* ``det-dict`` (the fabreg ``det-hazard`` semantics, promoted here and
+  retired there): the sink is the scenario's deterministic scorecard —
+  writes into the ``det`` dict (or whatever name the decorated
+  function returns as its tuple's first element).  The observed
+  section stays free: ``time.perf_counter()`` flowing only into
+  ``obs`` is fine, and ``random.Random(seed)`` draws are exempt.
+
+Whole-program half: EVERY function in the scanned tree is walked once,
+so a helper that forwards its argument into ``pack_frame`` propagates
+"reaches a det surface" to its own callers (memoized per-function
+summaries: taint of the return value under clean arguments, which
+parameters flow to the return, and which parameters reach a surface
+sink).  Calls are resolved through the per-module import table, so the
+analysis crosses module boundaries without ever importing analyzed
+code — pure ``ast`` on the toolkit chassis, dependency-free, runs
+identically without numpy/jax/cryptography.
+
+Rules (``--list-rules``): wallclock-in-det, unseeded-random-in-det,
+env-in-det, hash-order-hazard, fs-order-hazard, unsorted-serialize.
+``json.dump`` to a file handle is treated as a persisted surface *by
+construction* wherever it appears (the bytes land on disk); bare
+``json.dumps`` only fires when its result actually flows into a
+declared surface, so transient in-process encodings stay silent.  A
+``[[surface]]`` row whose declared function is absent from its scanned
+module is reported as an always-on ``surface-missing`` finding — a
+renamed emitter must not silently drop out of the gate.
+
+Suppression grammar (shared toolkit chassis)::
+
+    # fabdet: disable=rule-id[,rule-id...]  # <reason naming the contract>
+
+fabreg's ``suppression-stale`` judges every fabdet suppression through
+``toolkit.ANALYZER_SPECS`` (this module implements the
+``live_suppression_keys`` staleness protocol), so a suppression whose
+finding no longer fires is itself a finding.
+
+Exit codes: 0 clean, 1 findings, 2 usage/IO/det-table error.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+import json
+import sys
+from dataclasses import dataclass
+from pathlib import Path
+from typing import (
+    Dict,
+    Iterable,
+    List,
+    NamedTuple,
+    Optional,
+    Sequence,
+    Set,
+    Tuple,
+)
+
+from fabric_tpu.tools import toolkit
+from fabric_tpu.tools.toolkit import DEFAULT_EXCLUDES, Finding, iter_py_files
+
+__version__ = "1.0"
+
+RULES: Dict[str, str] = {
+    "wallclock-in-det": (
+        "wall/monotonic clock read (time.time/perf_counter/datetime.now"
+        "/...) flowing into a declared det surface, or gating its "
+        "output path"
+    ),
+    "unseeded-random-in-det": (
+        "module-level random.*, os.urandom, uuid1/uuid4 or secrets.* "
+        "value flowing into a det surface (random.Random(seed) draws "
+        "are the sanctioned discipline and stay exempt)"
+    ),
+    "env-in-det": (
+        "process-environment value (pid, id(), hostname, os.environ) "
+        "flowing into a det surface — differs per host/process, "
+        "identical input or not"
+    ),
+    "hash-order-hazard": (
+        "builtin hash() or set/frozenset iteration order feeding a det "
+        "surface — PYTHONHASHSEED-dependent bytes (in-process cache "
+        "keys that never reach a surface stay silent)"
+    ),
+    "fs-order-hazard": (
+        "os.listdir/scandir/glob/iterdir order feeding a det surface "
+        "without a dominating sorted() — directory order is "
+        "filesystem-dependent"
+    ),
+    "unsorted-serialize": (
+        "json.dump to disk, or json.dumps feeding a det surface, "
+        "without sort_keys=True or provably ordered construction — "
+        "dict insertion order is code-path-dependent"
+    ),
+}
+
+#: surface tiers — which byte-determinism contract a surface serves
+TIERS = ("persisted", "cross-peer", "replay")
+
+_MISSING_RULE = "surface-missing"  # always-on, like fabwire syntax-error
+
+
+# ---------------------------------------------------------------------------
+# det.toml — declarative surface table (tiny TOML subset, loud errors)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class SurfaceSpec:
+    """One ``[[surface]]`` row of det.toml."""
+
+    name: str
+    module: str
+    tier: str
+    doc: str
+    functions: Tuple[str, ...] = ()   # fnmatch patterns over qualnames
+    mode: str = "outputs"             # "outputs" | "det-dict"
+    decorator: str = ""               # det-dict: decorator selecting fns
+    sinks: Tuple[str, ...] = ()       # extra sink call leaves
+
+
+@dataclass(frozen=True)
+class DetSpec:
+    surfaces: Tuple[SurfaceSpec, ...]
+
+
+def default_det_file() -> Path:
+    return Path(__file__).resolve().parent / "det.toml"
+
+
+def _parse_value(raw: str, where: str):
+    raw = raw.strip()
+    if raw in ("true", "false"):
+        return raw == "true"
+    if raw.startswith('"') and raw.endswith('"') and len(raw) >= 2:
+        return raw[1:-1]
+    if raw.lstrip("-").isdigit():
+        return int(raw)
+    if raw.startswith("[") and raw.endswith("]"):
+        inner = raw[1:-1].strip()
+        if not inner:
+            return []
+        items: List[object] = []
+        for part in inner.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if part.startswith('"') and part.endswith('"'):
+                items.append(part[1:-1])
+            elif part.lstrip("-").isdigit():
+                items.append(int(part))
+            else:
+                raise ValueError(
+                    f"{where}: list items must be \"quoted\" or integers"
+                )
+        return items
+    raise ValueError(
+        f"{where}: expected \"string\", integer, [list] or true/false"
+    )
+
+
+def parse_det(text: str, path: str = "<det>") -> DetSpec:
+    """Parse the tiny TOML subset shared with wire.toml/pairs.toml.
+    LOUD on any malformed line or missing key: a half-read surface
+    table silently checking nothing would be config drift."""
+    entries: List[Dict[str, object]] = []
+    current: Optional[Dict[str, object]] = None
+    for n, raw in enumerate(text.splitlines(), start=1):
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        if line.startswith("[[") and line.endswith("]]"):
+            section = line[2:-2].strip()
+            if section != "surface":
+                raise ValueError(f"{path}:{n}: unknown section {line!r}")
+            current = {}
+            entries.append(current)
+            continue
+        if line.startswith("["):
+            raise ValueError(f"{path}:{n}: unknown section {line!r}")
+        if "=" not in line:
+            raise ValueError(f"{path}:{n}: expected 'key = value'")
+        if current is None:
+            raise ValueError(f"{path}:{n}: key outside a [[surface]] entry")
+        key, _, value = line.partition("=")
+        if "#" in value and not value.strip().startswith('"'):
+            value = value.split("#", 1)[0]
+        current[key.strip()] = _parse_value(value, f"{path}:{n}")
+
+    def strs(value: object, where: str) -> Tuple[str, ...]:
+        if isinstance(value, str):
+            return (value,)
+        if isinstance(value, list) and all(isinstance(v, str) for v in value):
+            return tuple(value)
+        raise ValueError(f"{where}: expected a string or list of strings")
+
+    surfaces: List[SurfaceSpec] = []
+    seen_names: Set[str] = set()
+    for i, e in enumerate(entries, start=1):
+        where = f"{path}: [[surface]] #{i}"
+        for k in ("name", "module", "tier", "doc"):
+            if k not in e:
+                raise ValueError(f"{where}: missing required key {k!r}")
+        name = str(e["name"])
+        if name in seen_names:
+            raise ValueError(f"{where}: duplicate surface name {name!r}")
+        seen_names.add(name)
+        tier = str(e["tier"])
+        if tier not in TIERS:
+            raise ValueError(
+                f"{where}: tier must be one of {'/'.join(TIERS)}, "
+                f"got {tier!r}"
+            )
+        mode = str(e.get("mode", "outputs"))
+        if mode not in ("outputs", "det-dict"):
+            raise ValueError(
+                f"{where}: mode must be \"outputs\" or \"det-dict\", "
+                f"got {mode!r}"
+            )
+        functions = strs(e.get("functions", []), where)
+        decorator = str(e.get("decorator", ""))
+        if mode == "det-dict":
+            if not decorator:
+                raise ValueError(
+                    f"{where}: det-dict surfaces need a 'decorator' "
+                    f"selector"
+                )
+        elif not functions:
+            raise ValueError(
+                f"{where}: outputs surfaces need a non-empty 'functions' "
+                f"list"
+            )
+        surfaces.append(
+            SurfaceSpec(
+                name=name,
+                module=str(e["module"]),
+                tier=tier,
+                doc=str(e["doc"]),
+                functions=functions,
+                mode=mode,
+                decorator=decorator,
+                sinks=strs(e.get("sinks", []), where),
+            )
+        )
+    return DetSpec(surfaces=tuple(surfaces))
+
+
+def load_default_det() -> DetSpec:
+    f = default_det_file()
+    return parse_det(f.read_text(encoding="utf-8"), str(f))
+
+
+# ---------------------------------------------------------------------------
+# nondeterminism sources
+# ---------------------------------------------------------------------------
+
+#: wall/monotonic clock reads (any clock in a det surface is a hazard —
+#: monotonic values differ per process even with identical input)
+_WALL_EXACT = {
+    "time.time", "time.time_ns", "time.monotonic", "time.monotonic_ns",
+    "time.perf_counter", "time.perf_counter_ns", "time.process_time",
+    "time.process_time_ns", "time.clock_gettime",
+}
+_DT_LEAVES = {"now", "utcnow", "today"}
+
+#: random.Random(seed)/random.seed(n) construct the seeded discipline
+#: the scorecard contract is built on; everything else on the module
+#: draws the unseeded global stream
+_RAND_EXEMPT_LEAVES = {"Random", "seed"}
+_RAND_EXACT = {
+    "os.urandom", "urandom", "uuid.uuid1", "uuid.uuid4", "uuid1", "uuid4",
+}
+#: numpy-style seeded constructors (fabdet never imports numpy; these
+#: are matched purely on dotted-name shape)
+_NP_RAND_EXEMPT = {"default_rng", "RandomState", "Generator", "seed"}
+
+_ENV_EXACT = {
+    "os.getpid", "getpid", "os.getppid", "getppid", "id",
+    "socket.gethostname", "gethostname", "platform.node", "os.uname",
+    "os.getenv", "getenv", "os.environ.get", "environ.get",
+}
+
+_FS_EXACT = {
+    "os.listdir", "listdir", "os.scandir", "scandir",
+    "glob.glob", "glob.iglob", "iglob",
+}
+_FS_LEAVES = {"iterdir", "rglob"}  # pathlib; bare .glob handled below
+
+#: calls whose result is order- and value-independent of the input's
+#: hazards (a count is deterministic even over an unordered set)
+_CLEANSE_ALL = {"len", "bool", "isinstance", "hasattr", "callable"}
+#: calls that impose a deterministic order (or are order-independent
+#: folds) — they clear hash/fs order taint but keep value taints (a
+#: sorted list of timestamps is still timestamps)
+_CLEANSE_ORDER = {"sorted", "min", "max", "sum"}
+
+#: container mutators that fold argument taint into the receiver
+_MUTATORS = {"append", "add", "extend", "insert", "appendleft", "update",
+             "setdefault"}
+
+_ORDER_KINDS = {"hash", "fs"}
+#: kinds reported through the five value rules ("json" is special-cased
+#: into unsorted-serialize at surface boundaries; "param" is summary
+#: plumbing)
+_VALUE_KINDS = {"wall", "rand", "env", "hash", "fs"}
+
+
+class Taint(NamedTuple):
+    kind: str   # wall | rand | env | hash | fs | json | param
+    rule: str   # rule id ("" for param)
+    path: str   # file that introduced the taint
+    line: int   # source line that introduced it
+    desc: str   # dotted source name, or param index for kind="param"
+
+
+def _strip_order(taints: Set[Taint]) -> Set[Taint]:
+    return {t for t in taints if t.kind not in _ORDER_KINDS}
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _classify_source(dn: str) -> Optional[Tuple[str, str]]:
+    """dotted call name -> (taint kind, rule id), or None."""
+    if dn in _WALL_EXACT:
+        return ("wall", "wallclock-in-det")
+    parts = dn.split(".")
+    root, leaf = parts[0], parts[-1]
+    if root == "datetime" and leaf in _DT_LEAVES:
+        return ("wall", "wallclock-in-det")
+    if dn in _RAND_EXACT:
+        return ("rand", "unseeded-random-in-det")
+    if root == "random" and leaf not in _RAND_EXEMPT_LEAVES:
+        return ("rand", "unseeded-random-in-det")
+    if root == "secrets":
+        return ("rand", "unseeded-random-in-det")
+    if "random" in parts[1:-1] and leaf not in _NP_RAND_EXEMPT:
+        return ("rand", "unseeded-random-in-det")
+    if dn in _ENV_EXACT:
+        return ("env", "env-in-det")
+    if dn in _FS_EXACT:
+        return ("fs", "fs-order-hazard")
+    if leaf in _FS_LEAVES and len(parts) > 1:
+        return ("fs", "fs-order-hazard")
+    if leaf == "glob" and len(parts) > 1 and root != "glob":
+        return ("fs", "fs-order-hazard")
+    if dn == "hash":
+        return ("hash", "hash-order-hazard")
+    return None
+
+
+# ---------------------------------------------------------------------------
+# program index: modules, imports, call resolution
+# ---------------------------------------------------------------------------
+
+
+def _path_dotted(posix: str) -> str:
+    p = posix[:-3] if posix.endswith(".py") else posix
+    return p.lstrip("./").replace("/", ".")
+
+
+class _Module:
+    """Per-file symbol map: top-level functions + Class.method, plus
+    the import alias table call resolution crosses modules with."""
+
+    def __init__(self, path: str, tree: ast.Module):
+        self.path = path
+        self.posix = Path(path).as_posix()
+        self.dotted = _path_dotted(self.posix)
+        self.tree = tree
+        self.functions: Dict[str, ast.AST] = {}
+        self.cls_of: Dict[str, str] = {}
+        self.aliases: Dict[str, str] = {}
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions[node.name] = node
+            elif isinstance(node, ast.ClassDef):
+                for sub in node.body:
+                    if isinstance(
+                        sub, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    ):
+                        q = f"{node.name}.{sub.name}"
+                        self.functions[q] = sub
+                        self.cls_of[q] = node.name
+            elif isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname:
+                        self.aliases[a.asname] = a.name
+                    else:
+                        root = a.name.split(".", 1)[0]
+                        self.aliases.setdefault(root, root)
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    base_parts = self.dotted.split(".")[: -node.level - 1]
+                    base = ".".join(
+                        base_parts + ([node.module] if node.module else [])
+                    )
+                else:
+                    base = node.module or ""
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    self.aliases[a.asname or a.name] = (
+                        f"{base}.{a.name}" if base else a.name
+                    )
+
+
+class _Summary(NamedTuple):
+    """Interprocedural function summary: taints of the return value
+    under clean arguments, parameter indices that flow to the return,
+    and parameter indices that reach a det-surface sink inside."""
+
+    ret: frozenset
+    param_ret: frozenset
+    param_surface: frozenset
+
+
+_EMPTY_SUMMARY = _Summary(frozenset(), frozenset(), frozenset())
+
+
+def _param_names(fn: ast.AST) -> List[str]:
+    a = fn.args
+    return [p.arg for p in list(a.posonlyargs) + list(a.args)] + [
+        p.arg for p in a.kwonlyargs
+    ]
+
+
+class _Program:
+    """The whole-program view: module index, surface bindings, memoized
+    summaries, and the finding sink."""
+
+    def __init__(
+        self,
+        modules: Dict[str, _Module],
+        det: DetSpec,
+        active: Set[str],
+    ):
+        self.modules = modules
+        self.det = det
+        self.active = active
+        self.by_dotted: Dict[str, _Module] = {
+            m.dotted: m for m in modules.values()
+        }
+        self._summaries: Dict[Tuple[str, str], _Summary] = {}
+        self._in_progress: Set[Tuple[str, str]] = set()
+        self._findings: Dict[Tuple[str, str, int, int], Finding] = {}
+        # (path, qualname) -> SurfaceSpec for outputs-mode surfaces;
+        # det-dict specs are matched per module
+        self.surfaces: Dict[Tuple[str, str], SurfaceSpec] = {}
+        self.detdict: Dict[str, List[SurfaceSpec]] = {}
+        self.missing: List[Finding] = []
+        for mod in modules.values():
+            for spec in det.surfaces:
+                if not self._module_matches(mod.posix, spec.module):
+                    continue
+                if spec.mode == "det-dict":
+                    self.detdict.setdefault(mod.path, []).append(spec)
+                    continue
+                for pat in spec.functions:
+                    hits = [
+                        q
+                        for q in mod.functions
+                        if q == pat or fnmatch.fnmatch(q, pat)
+                    ]
+                    if not hits:
+                        self.missing.append(
+                            Finding(
+                                _MISSING_RULE, mod.path, 1, 0,
+                                f"det.toml surface {spec.name!r} declares "
+                                f"function {pat!r} absent from "
+                                f"{spec.module} — the det gate is "
+                                f"vacuously passing on it; update "
+                                f"det.toml when an emitter moves",
+                            )
+                        )
+                    for q in hits:
+                        self.surfaces[(mod.path, q)] = spec
+
+    @staticmethod
+    def _module_matches(posix: str, pattern: str) -> bool:
+        if "*" in pattern or "?" in pattern:
+            return fnmatch.fnmatch(posix, pattern) or fnmatch.fnmatch(
+                posix, "*/" + pattern
+            )
+        return posix == pattern or posix.endswith("/" + pattern)
+
+    def find_module(self, dotted: str) -> Optional[_Module]:
+        m = self.by_dotted.get(dotted)
+        if m is not None:
+            return m
+        tail = "." + dotted
+        hits = [
+            mod for d, mod in self.by_dotted.items() if d.endswith(tail)
+        ]
+        return hits[0] if len(hits) == 1 else None
+
+    def resolve(
+        self, mod: _Module, dn: str, cur_class: Optional[str]
+    ) -> Optional[Tuple[_Module, str, ast.AST]]:
+        """Resolve a dotted call to (module, qualname, def) or None."""
+        parts = dn.split(".")
+        if parts[0] == "self" and cur_class is not None and len(parts) == 2:
+            q = f"{cur_class}.{parts[1]}"
+            fn = mod.functions.get(q)
+            return (mod, q, fn) if fn is not None else None
+        if len(parts) <= 2 and dn in mod.functions:
+            return (mod, dn, mod.functions[dn])
+        if parts[0] in mod.aliases:
+            full = mod.aliases[parts[0]]
+            if len(parts) > 1:
+                full = full + "." + ".".join(parts[1:])
+            fparts = full.split(".")
+            for cut in (1, 2):
+                if len(fparts) <= cut:
+                    continue
+                target = self.find_module(".".join(fparts[:-cut]))
+                if target is None:
+                    continue
+                q = ".".join(fparts[-cut:])
+                fn = target.functions.get(q)
+                if fn is not None:
+                    return (target, q, fn)
+        return None
+
+    def summary(self, mod: _Module, qual: str) -> _Summary:
+        key = (mod.path, qual)
+        cached = self._summaries.get(key)
+        if cached is not None:
+            return cached
+        if key in self._in_progress:
+            return _EMPTY_SUMMARY  # cycle: assume clean (no fixpoint)
+        self._in_progress.add(key)
+        try:
+            fn = mod.functions[qual]
+            w = _FlowWalker(self, mod, fn, qual, summary_mode=True)
+            w.run()
+            s = _Summary(
+                frozenset(t for t in w.ret if t.kind != "param"),
+                frozenset(
+                    int(t.desc) for t in w.ret if t.kind == "param"
+                ),
+                frozenset(w.param_surface),
+            )
+        except RecursionError:
+            s = _EMPTY_SUMMARY
+        self._in_progress.discard(key)
+        self._summaries[key] = s
+        return s
+
+    def emit(
+        self, rule: str, path: str, line: int, col: int, msg: str
+    ) -> None:
+        if rule not in self.active:
+            return
+        key = (rule, path, line, col)
+        if key not in self._findings:
+            self._findings[key] = Finding(rule, path, line, col, msg)
+
+    def findings(self) -> List[Finding]:
+        out = list(self._findings.values()) + list(self.missing)
+        out.sort(key=Finding.key)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# the flow-sensitive walker
+# ---------------------------------------------------------------------------
+
+#: per-kind remedy fragments for sink messages
+_REMEDY = {
+    "wall": (
+        "the emitted bytes become clock-dependent; derive the value "
+        "from input or move it to an observed/diagnostic field"
+    ),
+    "rand": (
+        "draw from a seeded random.Random(seed) or keep the value out "
+        "of the det bytes"
+    ),
+    "env": (
+        "pid/host/env values diverge across processes and hosts on "
+        "identical input"
+    ),
+    "hash": (
+        "impose an order with sorted() before emitting — iteration "
+        "order is PYTHONHASHSEED-dependent"
+    ),
+    "fs": (
+        "wrap the directory listing in sorted() before emitting — "
+        "directory order is filesystem-dependent"
+    ),
+}
+
+
+def _union(sets: Iterable[Set[Taint]]) -> Set[Taint]:
+    out: Set[Taint] = set()
+    for s in sets:
+        out |= s
+    return out
+
+
+def _has_exit(stmts: Sequence[ast.AST]) -> bool:
+    for st in stmts:
+        for sub in ast.walk(st):
+            if isinstance(
+                sub,
+                (ast.Raise, ast.Return, ast.Break, ast.Continue,
+                 ast.Yield, ast.YieldFrom),
+            ):
+                return True
+    return False
+
+
+def _provably_ordered(node: ast.AST) -> bool:
+    """Value whose serialization cannot depend on dict/hash order."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, (ast.List, ast.Tuple)):
+        return all(_provably_ordered(e) for e in node.elts)
+    if isinstance(node, ast.Call) and _dotted(node.func) == "sorted":
+        return True
+    if isinstance(node, ast.JoinedStr):
+        return True
+    return False
+
+
+class _FlowWalker:
+    """One pass over one function: statement-ordered, flow-sensitive
+    (rebinding a name replaces its taint — ``x = sorted(x)`` cleanses),
+    branch bodies walked inline in source order (taints union across
+    branches; fabreg's det-hazard source-order semantics)."""
+
+    def __init__(
+        self,
+        prog: _Program,
+        mod: _Module,
+        fn: ast.AST,
+        qual: str,
+        summary_mode: bool = False,
+    ):
+        self.prog = prog
+        self.mod = mod
+        self.fn = fn
+        self.qual = qual
+        self.summary_mode = summary_mode
+        self.cur_class = mod.cls_of.get(qual)
+        self.t: Dict[str, Set[Taint]] = {}
+        self.ret: Set[Taint] = set()
+        self.param_surface: Set[int] = set()
+        self.surface: Optional[SurfaceSpec] = (
+            None if summary_mode else prog.surfaces.get((mod.path, qual))
+        )
+        self.det_names: Set[str] = set()
+        if summary_mode:
+            for i, nm in enumerate(_param_names(fn)):
+                self.t[nm] = {Taint("param", "", mod.path, 0, str(i))}
+        else:
+            for spec in prog.detdict.get(mod.path, []):
+                if self._decorated_with(fn, spec.decorator):
+                    self.det_names = {"det"}
+                    for n in ast.walk(fn):
+                        if (
+                            isinstance(n, ast.Return)
+                            and isinstance(n.value, (ast.Tuple, ast.List))
+                            and n.value.elts
+                            and isinstance(n.value.elts[0], ast.Name)
+                        ):
+                            self.det_names.add(n.value.elts[0].id)
+                    break
+
+    @staticmethod
+    def _decorated_with(fn: ast.AST, name: str) -> bool:
+        for d in fn.decorator_list:
+            target = d.func if isinstance(d, ast.Call) else d
+            dn = _dotted(target)
+            if dn and dn.rsplit(".", 1)[-1] == name:
+                return True
+        return False
+
+    def run(self) -> None:
+        self._stmts(self.fn.body)
+
+    # -- statements ---------------------------------------------------------
+
+    def _stmts(self, body: Sequence[ast.AST]) -> None:
+        for st in body:
+            self._stmt(st)
+
+    def _stmt(self, st: ast.AST) -> None:
+        if isinstance(
+            st, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        ):
+            return  # nested defs are walked via their own qualnames only
+        if isinstance(st, ast.Assign):
+            self._assign(st)
+        elif isinstance(st, ast.AnnAssign):
+            if st.value is not None:
+                ts = self._eval(st.value)
+                if self._detdict_target(st.target):
+                    self._det_emit(ts, st)
+                else:
+                    self._bind(st.target, ts)
+        elif isinstance(st, ast.AugAssign):
+            ts = self._eval(st.value)
+            if self._detdict_target(st.target):
+                self._det_emit(ts, st)
+            elif isinstance(st.target, ast.Name):
+                self.t.setdefault(st.target.id, set()).update(ts)
+            elif isinstance(st.target, ast.Subscript) and isinstance(
+                st.target.value, ast.Name
+            ):
+                self.t.setdefault(st.target.value.id, set()).update(ts)
+        elif isinstance(st, ast.Expr):
+            self._eval(st.value)
+        elif isinstance(st, ast.Return):
+            self._return(st)
+        elif isinstance(st, (ast.For, ast.AsyncFor)):
+            it = self._eval(st.iter)
+            self._bind(st.target, it)
+            self._stmts(st.body)
+            self._stmts(st.orelse)
+        elif isinstance(st, ast.While):
+            ts = self._eval(st.test)
+            self._control(ts, st, list(st.body) + list(st.orelse))
+            self._stmts(st.body)
+            self._stmts(st.orelse)
+        elif isinstance(st, ast.If):
+            ts = self._eval(st.test)
+            self._control(ts, st, list(st.body) + list(st.orelse))
+            self._stmts(st.body)
+            self._stmts(st.orelse)
+        elif isinstance(st, (ast.With, ast.AsyncWith)):
+            for item in st.items:
+                ts = self._eval(item.context_expr)
+                if item.optional_vars is not None:
+                    self._bind(item.optional_vars, ts)
+            self._stmts(st.body)
+        elif isinstance(st, ast.Try):
+            self._stmts(st.body)
+            for h in st.handlers:
+                if h.name:
+                    self.t[h.name] = set()
+                self._stmts(h.body)
+            self._stmts(st.orelse)
+            self._stmts(st.finalbody)
+        elif isinstance(st, ast.Raise):
+            if st.exc is not None:
+                self._eval(st.exc)
+        elif isinstance(st, ast.Assert):
+            self._eval(st.test)
+            if st.msg is not None:
+                self._eval(st.msg)
+        elif isinstance(st, ast.Delete):
+            for tgt in st.targets:
+                if isinstance(tgt, ast.Name):
+                    self.t.pop(tgt.id, None)
+        elif isinstance(st, getattr(ast, "Match", ())):
+            self._eval(st.subject)
+            for case in st.cases:
+                self._stmts(case.body)
+
+    def _assign(self, st: ast.Assign) -> None:
+        ts = self._eval(st.value)
+        for tgt in st.targets:
+            if self._detdict_target(tgt):
+                self._det_emit(ts, st)
+                continue  # the det name itself stays clean (fabreg shape)
+            if (
+                isinstance(tgt, (ast.Tuple, ast.List))
+                and isinstance(st.value, (ast.Tuple, ast.List))
+                and len(tgt.elts) == len(st.value.elts)
+            ):
+                # elementwise unpack: taint only names actually bound
+                # to a hazardous element
+                for t_el, v_el in zip(tgt.elts, st.value.elts):
+                    self._bind(t_el, self._eval(v_el))
+                continue
+            self._bind(tgt, ts)
+
+    def _bind(self, target: ast.AST, ts: Set[Taint]) -> None:
+        if isinstance(target, ast.Name):
+            self.t[target.id] = set(ts)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                self._bind(el, ts)
+        elif isinstance(target, ast.Starred):
+            self._bind(target.value, ts)
+        elif isinstance(target, ast.Subscript) and isinstance(
+            target.value, ast.Name
+        ):
+            # container[key] = v: the container accumulates the VALUE's
+            # taint; the key indexes storage and never becomes output
+            # bytes itself (id()-keyed dedup maps stay silent)
+            self.t.setdefault(target.value.id, set()).update(ts)
+
+    def _detdict_target(self, tgt: ast.AST) -> bool:
+        if not self.det_names:
+            return False
+        if isinstance(tgt, ast.Name) and tgt.id in self.det_names:
+            return True
+        return (
+            isinstance(tgt, ast.Subscript)
+            and isinstance(tgt.value, ast.Name)
+            and tgt.value.id in self.det_names
+        )
+
+    def _return(self, st: ast.Return) -> None:
+        ts = self._eval(st.value) if st.value is not None else set()
+        if self.summary_mode:
+            self.ret |= ts
+        if self.surface is not None:
+            self._emit_sink(
+                ts, st,
+                f"returned by det surface {self.surface.name!r}",
+                self.surface,
+            )
+        if (
+            self.det_names
+            and isinstance(st.value, (ast.Tuple, ast.List))
+            and st.value.elts
+        ):
+            first = st.value.elts[0]
+            if isinstance(first, ast.Name):
+                if first.id not in self.det_names:
+                    self._det_emit(self.t.get(first.id, set()), st)
+            else:
+                self._det_emit(self._eval(first), st)
+
+    def _control(
+        self, ts: Set[Taint], node: ast.AST, body: Sequence[ast.AST]
+    ) -> None:
+        """A tainted branch condition that gates an exit of a declared
+        surface makes the emitted stream clock/env-dependent."""
+        if self.surface is None or self.summary_mode:
+            return
+        vts = [t for t in ts if t.kind in _VALUE_KINDS]
+        if not vts or not _has_exit(body):
+            return
+        seen: Set[str] = set()
+        for t in sorted(vts):
+            if t.rule in seen:
+                continue
+            seen.add(t.rule)
+            line = t.line if (t.path == self.mod.path and t.line) else node.lineno
+            self.prog.emit(
+                t.rule, self.mod.path, line, node.col_offset,
+                f"{t.desc} gates the output path of det surface "
+                f"{self.surface.name!r} [{self.surface.tier}] — a "
+                f"replaying twin diverges when clock/environment "
+                f"differ; derive the guard from input or suppress "
+                f"naming the semantic contract",
+            )
+
+    # -- expressions --------------------------------------------------------
+
+    def _eval(self, node: Optional[ast.AST]) -> Set[Taint]:
+        if node is None:
+            return set()
+        if isinstance(node, ast.Constant):
+            return set()
+        if isinstance(node, ast.Name):
+            return self.t.get(node.id, set())
+        if isinstance(node, ast.Call):
+            return self._call(node)
+        if isinstance(node, ast.Attribute):
+            return self._eval(node.value)
+        if isinstance(node, ast.Subscript):
+            dn = _dotted(node.value)
+            ts = self._eval(node.value) | self._eval(node.slice)
+            if dn in ("os.environ", "environ"):
+                ts = ts | {
+                    Taint("env", "env-in-det", self.mod.path,
+                          node.lineno, f"{dn}[...]")
+                }
+            return ts
+        if isinstance(node, ast.BinOp):
+            return self._eval(node.left) | self._eval(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self._eval(node.operand)
+        if isinstance(node, ast.BoolOp):
+            return _union(self._eval(v) for v in node.values)
+        if isinstance(node, ast.Compare):
+            ts = set(self._eval(node.left))
+            for op, comp in zip(node.ops, node.comparators):
+                cts = self._eval(comp)
+                if isinstance(op, (ast.In, ast.NotIn)):
+                    cts = _strip_order(cts)  # membership is order-free
+                ts |= cts
+            return ts
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return _union(self._eval(e) for e in node.elts)
+        if isinstance(node, ast.Set):
+            return _union(self._eval(e) for e in node.elts) | {
+                Taint("hash", "hash-order-hazard", self.mod.path,
+                      node.lineno, "set literal")
+            }
+        if isinstance(node, ast.Dict):
+            return _union(
+                self._eval(e)
+                for e in list(node.keys) + list(node.values)
+                if e is not None
+            )
+        if isinstance(node, (ast.ListComp, ast.GeneratorExp)):
+            return self._comp(node, [node.elt])
+        if isinstance(node, ast.SetComp):
+            return self._comp(node, [node.elt]) | {
+                Taint("hash", "hash-order-hazard", self.mod.path,
+                      node.lineno, "set comprehension")
+            }
+        if isinstance(node, ast.DictComp):
+            return self._comp(node, [node.key, node.value])
+        if isinstance(node, ast.IfExp):
+            return (
+                self._eval(node.test)
+                | self._eval(node.body)
+                | self._eval(node.orelse)
+            )
+        if isinstance(node, ast.JoinedStr):
+            return _union(self._eval(v) for v in node.values)
+        if isinstance(node, ast.FormattedValue):
+            return self._eval(node.value)
+        if isinstance(node, ast.Lambda):
+            return set()
+        if isinstance(node, ast.Starred):
+            return self._eval(node.value)
+        if isinstance(node, ast.Await):
+            return self._eval(node.value)
+        if isinstance(node, ast.NamedExpr):
+            ts = self._eval(node.value)
+            self._bind(node.target, ts)
+            return ts
+        if isinstance(node, (ast.Yield, ast.YieldFrom)):
+            ts = self._eval(node.value) if node.value is not None else set()
+            if self.surface is not None and not self.summary_mode:
+                self._emit_sink(
+                    ts, node,
+                    f"yielded by det surface {self.surface.name!r}",
+                    self.surface,
+                )
+            return set()
+        if isinstance(node, ast.Slice):
+            return (
+                self._eval(node.lower)
+                | self._eval(node.upper)
+                | self._eval(node.step)
+            )
+        return set()
+
+    def _comp(self, node: ast.AST, exprs: Sequence[ast.AST]) -> Set[Taint]:
+        for gen in node.generators:
+            it = self._eval(gen.iter)
+            self._bind(gen.target, it)
+            for cond in gen.ifs:
+                self._eval(cond)
+        return _union(self._eval(e) for e in exprs)
+
+    # -- calls --------------------------------------------------------------
+
+    def _call(self, node: ast.Call) -> Set[Taint]:
+        dn = _dotted(node.func)
+        leaf = node.func.attr if isinstance(node.func, ast.Attribute) else None
+        base_ts: Set[Taint] = set()
+        recv = None
+        if isinstance(node.func, ast.Attribute):
+            base_ts = self._eval(node.func.value)
+            if isinstance(node.func.value, ast.Name):
+                recv = node.func.value.id
+        arg_ts = [self._eval(a) for a in node.args]
+        kw_ts = [(kw.arg, self._eval(kw.value)) for kw in node.keywords]
+        passed = _union(arg_ts) | _union(t for _, t in kw_ts)
+        all_ts = passed | base_ts
+
+        # det-dict sinks: det.update({...}) / det.setdefault(k, v)
+        if (
+            self.det_names
+            and leaf in ("update", "setdefault")
+            and recv is not None
+            and recv in self.det_names
+        ):
+            self._det_emit(passed, node)
+            return set()
+
+        # receiver mutation folds argument taint into the receiver
+        if leaf in _MUTATORS and recv is not None:
+            self.t.setdefault(recv, set()).update(passed)
+        if leaf == "sort" and recv is not None and recv in self.t:
+            self.t[recv] = _strip_order(self.t[recv])
+
+        if dn is not None:
+            src = _classify_source(dn)
+            if src is not None:
+                kind, rule = src
+                return all_ts | {
+                    Taint(kind, rule, self.mod.path, node.lineno, dn + "()")
+                }
+            if dn in ("set", "frozenset"):
+                return all_ts | {
+                    Taint("hash", "hash-order-hazard", self.mod.path,
+                          node.lineno, dn + "()")
+                }
+            if dn == "json.dump":
+                self._json_dump(node)
+                return set()
+            if dn == "json.dumps":
+                return passed | self._json_dumps(node)
+            if dn in _CLEANSE_ALL:
+                return set()
+            if dn in _CLEANSE_ORDER:
+                return _strip_order(all_ts)
+            resolved = self.prog.resolve(self.mod, dn, self.cur_class)
+            if resolved is not None:
+                rmod, rqual, rfn = resolved
+                spec = self.prog.surfaces.get((rmod.path, rqual))
+                if spec is not None:
+                    self._surface_args(spec, node, arg_ts, kw_ts)
+                    return set()
+                s = self.prog.summary(rmod, rqual)
+                out: Set[Taint] = set(s.ret)
+                if s.param_ret or s.param_surface:
+                    pnames = _param_names(rfn)
+                    offset = 1 if pnames[:1] == ["self"] else 0
+                    for i, ts in enumerate(arg_ts):
+                        self._param_flow(s, i + offset, ts, out, node, dn)
+                    for kwname, ts in kw_ts:
+                        if kwname is not None and kwname in pnames:
+                            self._param_flow(
+                                s, pnames.index(kwname), ts, out, node, dn
+                            )
+                        else:
+                            out |= ts  # **kwargs: conservative
+                return out
+
+        # write-like sinks inside a declared surface function
+        if (
+            self.surface is not None
+            and not self.summary_mode
+            and leaf is not None
+            and (
+                leaf in ("write", "writelines")
+                or leaf in self.surface.sinks
+            )
+        ):
+            self._emit_sink(
+                passed, node,
+                f"written out by det surface {self.surface.name!r}",
+                self.surface,
+            )
+            return set()
+        if (
+            self.summary_mode
+            and leaf is not None
+            and self.prog.surfaces.get((self.mod.path, self.qual))
+            is not None
+            and (
+                leaf in ("write", "writelines")
+                or leaf
+                in self.prog.surfaces[(self.mod.path, self.qual)].sinks
+            )
+        ):
+            for t in passed:
+                if t.kind == "param":
+                    self.param_surface.add(int(t.desc))
+            return set()
+        return all_ts
+
+    def _param_flow(
+        self,
+        s: _Summary,
+        idx: int,
+        ts: Set[Taint],
+        out: Set[Taint],
+        node: ast.AST,
+        dn: str,
+    ) -> None:
+        if idx in s.param_ret:
+            out |= ts
+        if idx in s.param_surface and ts:
+            for t in ts:
+                if t.kind == "param":
+                    self.param_surface.add(int(t.desc))
+            if not self.summary_mode:
+                self._emit_sink(
+                    ts, node,
+                    f"passed through {dn}() into a det surface", None,
+                )
+
+    def _surface_args(
+        self,
+        spec: SurfaceSpec,
+        node: ast.Call,
+        arg_ts: Sequence[Set[Taint]],
+        kw_ts: Sequence[Tuple[Optional[str], Set[Taint]]],
+    ) -> None:
+        for ts in list(arg_ts) + [t for _, t in kw_ts]:
+            for t in ts:
+                if t.kind == "param":
+                    self.param_surface.add(int(t.desc))
+            if not self.summary_mode:
+                self._emit_sink(
+                    ts, node,
+                    f"passed to det surface {spec.name!r}", spec,
+                )
+
+    def _emit_sink(
+        self,
+        ts: Set[Taint],
+        node: ast.AST,
+        what: str,
+        spec: Optional[SurfaceSpec],
+    ) -> None:
+        if self.summary_mode:
+            return
+        tier = f" [{spec.tier}]" if spec is not None else ""
+        seen: Set[str] = set()
+        for t in sorted(ts):
+            if t.kind in _VALUE_KINDS and t.rule not in seen:
+                seen.add(t.rule)
+                self.prog.emit(
+                    t.rule, self.mod.path, node.lineno, node.col_offset,
+                    f"{t.desc} (line {t.line}) {what}{tier}: "
+                    f"{_REMEDY[t.kind]}",
+                )
+            elif t.kind == "json" and "unsorted-serialize" not in seen:
+                seen.add("unsorted-serialize")
+                line = t.line if t.path == self.mod.path else node.lineno
+                self.prog.emit(
+                    "unsorted-serialize", self.mod.path, line,
+                    node.col_offset,
+                    f"json.dumps without sort_keys=True {what}{tier} — "
+                    f"dict insertion order is code-path-dependent; pass "
+                    f"sort_keys=True",
+                )
+
+    def _det_emit(self, ts: Set[Taint], node: ast.AST) -> None:
+        seen: Set[str] = set()
+        for t in sorted(ts):
+            if t.kind not in _VALUE_KINDS or t.rule in seen:
+                continue
+            seen.add(t.rule)
+            self.prog.emit(
+                t.rule, self.mod.path, node.lineno, node.col_offset,
+                f"{t.desc} flows into the deterministic scorecard "
+                f"output of scenario {self.fn.name!r}: the chaos "
+                f"gate's same-seed byte-diff will flap; move it to "
+                f"the observed section or derive it from the seed",
+            )
+
+    def _json_dump(self, node: ast.Call) -> None:
+        if self._json_ok(node):
+            return
+        self.prog.emit(
+            "unsorted-serialize", self.mod.path, node.lineno,
+            node.col_offset,
+            "json.dump without sort_keys=True persists dict-order-"
+            "dependent bytes (a persisted det surface by construction); "
+            "pass sort_keys=True or dump a provably ordered value",
+        )
+
+    def _json_dumps(self, node: ast.Call) -> Set[Taint]:
+        if self._json_ok(node):
+            return set()
+        return {
+            Taint("json", "unsorted-serialize", self.mod.path,
+                  node.lineno, "json.dumps()")
+        }
+
+    @staticmethod
+    def _json_ok(node: ast.Call) -> bool:
+        for kw in node.keywords:
+            if kw.arg == "sort_keys":
+                return not (
+                    isinstance(kw.value, ast.Constant)
+                    and kw.value.value is False
+                )
+        return bool(node.args) and _provably_ordered(node.args[0])
+
+
+# ---------------------------------------------------------------------------
+# drivers
+# ---------------------------------------------------------------------------
+
+
+def analyze_sources(
+    sources: Dict[str, str],
+    rule_ids: Optional[Iterable[str]] = None,
+    det: Optional[DetSpec] = None,
+    collect_suppressed: Optional[List[Finding]] = None,
+) -> Tuple[List[Finding], Dict[str, int]]:
+    """Analyze {path: source}.  ``det`` defaults to the packaged
+    ``tools/det.toml`` (loud ValueError when missing/malformed)."""
+    active = set(rule_ids) if rule_ids is not None else set(RULES)
+    for rid in active:
+        if rid not in RULES:
+            raise ValueError(f"unknown rule id {rid!r}")
+    if det is None:
+        det = load_default_det()
+
+    modules: Dict[str, _Module] = {}
+    hard: List[Finding] = []
+    for path, source in sorted(sources.items()):
+        try:
+            tree = ast.parse(source, filename=path)
+        except SyntaxError as exc:
+            hard.append(
+                Finding(
+                    "syntax-error", path, exc.lineno or 1,
+                    exc.offset or 0, f"cannot parse: {exc.msg}",
+                )
+            )
+            continue
+        modules[path] = _Module(path, tree)
+
+    prog = _Program(modules, det, active)
+    for path, mod in sorted(modules.items()):
+        for qual in sorted(mod.functions):
+            _FlowWalker(prog, mod, mod.functions[qual], qual).run()
+
+    by_path: Dict[str, List[Finding]] = {}
+    for f in prog.findings():
+        by_path.setdefault(f.path, []).append(f)
+    findings: List[Finding] = list(hard)
+    n_suppressed = 0
+    for path in sorted(by_path):
+        supp = toolkit.suppressed_rules(sources.get(path, ""), "fabdet")
+        kept, suppressed = toolkit.apply_suppressions(by_path[path], supp)
+        findings.extend(kept)
+        n_suppressed += len(suppressed)
+        if collect_suppressed is not None:
+            collect_suppressed.extend(suppressed)
+    findings.sort(key=Finding.key)
+    stats = {"files": len(sources), "suppressed": n_suppressed}
+    return findings, stats
+
+
+def analyze_source(
+    source: str,
+    path: str,
+    rule_ids: Optional[Iterable[str]] = None,
+    det: Optional[DetSpec] = None,
+) -> Tuple[List[Finding], int]:
+    """Single-blob convenience (fixtures/tests)."""
+    findings, stats = analyze_sources({path: source}, rule_ids, det)
+    return findings, stats["suppressed"]
+
+
+def analyze_paths(
+    paths: Sequence[str],
+    rule_ids: Optional[Iterable[str]] = None,
+    excludes: Sequence[str] = DEFAULT_EXCLUDES,
+    det: Optional[DetSpec] = None,
+) -> Tuple[List[Finding], Dict[str, int]]:
+    files = iter_py_files(paths, excludes)
+    sources, io_findings = toolkit.read_sources(files)
+    findings, stats = analyze_sources(sources, rule_ids, det)
+    findings.extend(io_findings)
+    findings.sort(key=Finding.key)
+    stats["files"] = len(files)
+    return findings, stats
+
+
+def live_suppression_keys(
+    sources: Dict[str, str], rules: Set[str]
+) -> Set[Tuple[str, int, str]]:
+    """The toolkit analyzer-registry staleness protocol (consumed by
+    fabreg's suppression-stale): (normalized path, line, rule) for
+    every fabdet suppression that still absorbs a finding."""
+    needed = set(RULES) if "all" in rules else (rules & set(RULES))
+    if not needed:
+        return set()
+    suppressed: List[Finding] = []
+    analyze_sources(sources, needed, collect_suppressed=suppressed)
+    return {
+        (toolkit.normalize_path(f.path), f.line, f.rule)
+        for f in suppressed
+    }
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = toolkit.build_parser(
+        "fabdet",
+        "whole-program byte-determinism taint analyzer for fabric-tpu "
+        "(dependency-free; never imports the analyzed code)",
+    )
+    parser.add_argument(
+        "--det",
+        metavar="FILE",
+        help="surface table (default: tools/det.toml next to this module)",
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        toolkit.print_rule_list(RULES, width=22)
+        return 0
+
+    rc = toolkit.check_paths_exist(args.paths, "fabdet", parser)
+    if rc:
+        return rc
+    rule_ids, rc = toolkit.parse_rule_arg(args.rules, RULES, "fabdet")
+    if rc:
+        return rc
+
+    det: Optional[DetSpec] = None
+    try:
+        if args.det is not None:
+            det = parse_det(
+                Path(args.det).read_text(encoding="utf-8"), args.det
+            )
+        else:
+            det = load_default_det()
+    except (OSError, ValueError) as exc:
+        print(f"fabdet: error: det table: {exc}", file=sys.stderr)
+        return 2
+
+    excludes = tuple(DEFAULT_EXCLUDES) + tuple(args.exclude)
+    findings, stats = analyze_paths(args.paths, rule_ids, excludes, det)
+
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "version": __version__,
+                    "files": stats["files"],
+                    "suppressed": stats["suppressed"],
+                    "findings": [f.to_dict() for f in findings],
+                },
+                indent=2,
+            )
+        )
+    else:
+        toolkit.print_findings(findings)
+        print(
+            f"fabdet: {len(findings)} finding(s) in {stats['files']} "
+            f"file(s) ({stats['suppressed']} suppressed)"
+        )
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

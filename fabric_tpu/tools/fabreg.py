@@ -37,10 +37,10 @@ suppression-stale a ``# fablint:/fabdep:/fabflow:/fabreg: disable=``
                   suppressed rules and requires every comment to still
                   absorb a finding.  Suppressions must not outlive
                   their cause.
-det-hazard        an unseeded ``random.*`` call, wall-clock read, or
-                  PID/``id()``-derived value flowing into a fabchaos
-                  scenario's deterministic scorecard (``det``) output —
-                  the chaos gate byte-diffs that section across runs.
+
+The byte-determinism taint rules that used to live here (the
+``det-hazard`` rule over chaos scorecards) are fabdet's whole-program
+job now — see ``fabric_tpu/tools/fabdet.py`` and ``tools/det.toml``.
 
 Suppression
 -----------
@@ -111,10 +111,6 @@ RULES: Dict[str, str] = {
         "a fablint/fabdep/fabflow/fabreg disable= comment whose rule no "
         "longer fires at that line"
     ),
-    "det-hazard": (
-        "unseeded random.*, wall-clock, or PID/id()-derived value flowing "
-        "into a fabchaos deterministic-scorecard (det) output"
-    ),
 }
 
 ENV_PREFIX = "FABRIC_TPU_"
@@ -144,16 +140,6 @@ PKG_SCOPE = ("*fabric_tpu/*",)
 ENVREG_FILE = ("*fabric_tpu/common/envreg.py",)
 FABOBS_FILE = ("*fabric_tpu/common/fabobs.py",)
 CHAOS_FILE = ("*fabric_tpu/tools/fabchaos.py",)
-DET_SCOPE = ("*fabchaos*.py",)
-
-#: calls whose value must never reach a deterministic scorecard
-_DET_BANNED_EXACT = {
-    "time.time", "time.time_ns", "time.monotonic", "time.monotonic_ns",
-    "time.perf_counter", "time.perf_counter_ns",
-    "os.getpid", "getpid", "id",
-    "uuid.uuid1", "uuid.uuid4",
-}
-_DET_BANNED_DATETIME_LEAVES = {"now", "utcnow", "today"}
 
 
 def _dotted(node: ast.AST) -> Optional[str]:
@@ -558,167 +544,6 @@ def _check_fault_sites(
     return out
 
 
-# -- det-hazard --------------------------------------------------------------
-
-
-def _is_banned_call(node: ast.Call) -> Optional[str]:
-    dn = _dotted(node.func)
-    if dn is None:
-        return None
-    if dn in _DET_BANNED_EXACT:
-        return dn
-    root = dn.split(".", 1)[0]
-    leaf = dn.rsplit(".", 1)[-1]
-    if root == "random" and leaf not in ("Random", "seed"):
-        # module-level random.* draws from the unseeded global stream;
-        # random.Random(seed) / random.seed(n) construct the seeded
-        # discipline the scorecard contract is built on
-        return dn
-    if root == "datetime" and leaf in _DET_BANNED_DATETIME_LEAVES:
-        return dn
-    return None
-
-
-def _walk_in_order(node: ast.AST):
-    """Depth-first pre-order traversal.  ``ast.walk`` is breadth-first,
-    which visits a nested ``t = time.time()`` AFTER a later top-level
-    ``det[...] = t`` — the taint pass below needs source order."""
-    for child in ast.iter_child_nodes(node):
-        yield child
-        yield from _walk_in_order(child)
-
-
-def _banned_in(node: ast.AST, tainted: Set[str]) -> Optional[str]:
-    for sub in ast.walk(node):
-        if isinstance(sub, ast.Call):
-            hit = _is_banned_call(sub)
-            if hit:
-                return hit
-        if isinstance(sub, ast.Name) and sub.id in tainted:
-            return f"value derived from it ({sub.id})"
-    return None
-
-
-def _check_det_hazard(scan: Scan, active: Set[str]) -> List[Finding]:
-    if "det-hazard" not in active:
-        return []
-    out: List[Finding] = []
-    for path, source in scan.sources.items():
-        ctx = FileContext(path)
-        if not ctx.matches(DET_SCOPE):
-            continue
-        try:
-            tree = ast.parse(source, filename=path)
-        except SyntaxError:
-            continue  # already reported by the scan pass
-        for fn in ast.walk(tree):
-            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                continue
-            decorated = any(
-                isinstance(d, ast.Call)
-                and (_dotted(d.func) or "").rsplit(".", 1)[-1] == "scenario"
-                for d in fn.decorator_list
-            )
-            if not decorated:
-                continue
-            # names whose dicts feed the deterministic section: 'det'
-            # plus whatever the scenario returns as its first element
-            det_names = {"det"}
-            for node in ast.walk(fn):
-                if (
-                    isinstance(node, ast.Return)
-                    and isinstance(node.value, (ast.Tuple, ast.List))
-                    and node.value.elts
-                    and isinstance(node.value.elts[0], ast.Name)
-                ):
-                    det_names.add(node.value.elts[0].id)
-            tainted: Set[str] = set()
-
-            def _flag(node: ast.AST, src: str) -> None:
-                out.append(
-                    Finding(
-                        "det-hazard", path, node.lineno, node.col_offset,
-                        f"{src} flows into the deterministic scorecard "
-                        f"output of scenario {fn.name!r}: the chaos "
-                        f"gate's same-seed byte-diff will flap; move it "
-                        f"to the observed section or derive it from the "
-                        f"seed",
-                    )
-                )
-
-            for node in _walk_in_order(fn):
-                if isinstance(node, (ast.Assign, ast.AugAssign)):
-                    targets = (
-                        node.targets
-                        if isinstance(node, ast.Assign)
-                        else [node.target]
-                    )
-                    src = _banned_in(node.value, tainted)
-                    det_target = any(
-                        (isinstance(t, ast.Name) and t.id in det_names)
-                        or (
-                            isinstance(t, ast.Subscript)
-                            and isinstance(t.value, ast.Name)
-                            and t.value.id in det_names
-                        )
-                        for t in targets
-                    )
-                    if src is not None:
-                        if det_target:
-                            _flag(node, src)
-                        elif (
-                            isinstance(node, ast.Assign)
-                            and len(targets) == 1
-                            and isinstance(targets[0], (ast.Tuple, ast.List))
-                            and isinstance(node.value, (ast.Tuple, ast.List))
-                            and len(targets[0].elts)
-                            == len(node.value.elts)
-                        ):
-                            # elementwise unpack: taint only the names
-                            # actually bound to a hazardous element
-                            for t_el, v_el in zip(
-                                targets[0].elts, node.value.elts
-                            ):
-                                if (
-                                    isinstance(t_el, ast.Name)
-                                    and _banned_in(v_el, tainted)
-                                ):
-                                    tainted.add(t_el.id)
-                        else:
-                            for t in targets:
-                                for sub in ast.walk(t):
-                                    if isinstance(sub, ast.Name):
-                                        tainted.add(sub.id)
-                elif isinstance(node, ast.Call):
-                    # det.update({...}) / det.setdefault(k, v)
-                    f = node.func
-                    if (
-                        isinstance(f, ast.Attribute)
-                        and f.attr in ("update", "setdefault")
-                        and isinstance(f.value, ast.Name)
-                        and f.value.id in det_names
-                    ):
-                        for arg in list(node.args) + [
-                            kw.value for kw in node.keywords
-                        ]:
-                            src = _banned_in(arg, tainted)
-                            if src is not None:
-                                _flag(node, src)
-                                break
-                elif isinstance(node, ast.Return) and isinstance(
-                    node.value, (ast.Tuple, ast.List)
-                ):
-                    if node.value.elts:
-                        first = node.value.elts[0]
-                        if not isinstance(first, ast.Name):
-                            src = _banned_in(first, tainted)
-                            if src is not None:
-                                _flag(node, src)
-                        elif first.id in tainted:
-                            _flag(node, f"tainted {first.id!r}")
-    return out
-
-
 # -- suppression-stale -------------------------------------------------------
 
 
@@ -977,7 +802,6 @@ def analyze_sources(
     raw += _check_env(scan, eval_rules)
     raw += _check_metrics(scan, eval_rules)
     raw += _check_fault_sites(scan, eval_rules, readme_text)
-    raw += _check_det_hazard(scan, eval_rules)
 
     findings: List[Finding] = []
     suppressed_all: List[Finding] = []

@@ -82,6 +82,7 @@ ANALYZER_SPECS: Tuple["AnalyzerSpec", ...] = (
     AnalyzerSpec("fablife", "fabric_tpu.tools.fablife", pkg_scope_only=False),
     AnalyzerSpec("fabwire", "fabric_tpu.tools.fabwire"),
     AnalyzerSpec("fabtrace", "fabric_tpu.tools.fabtrace"),
+    AnalyzerSpec("fabdet", "fabric_tpu.tools.fabdet"),
 )
 
 #: Historical shape: the tool-name tuple (derived from the registry).

@@ -39,6 +39,11 @@
 #                      stages, no per-lane host<->device conversion in
 #                      device-tier loops, no tracer leaks or trace-time
 #                      impurity (trace_gate.sh, tools/hotpath.toml)
+#  13. det          -- whole-program byte-determinism taint: no
+#                      wall-clock, unseeded-random, hash/set-order,
+#                      fs-order, unsorted-serialize, or environment
+#                      value flows into a declared det surface
+#                      (det_gate.sh, tools/det.toml)
 #
 # Each stage runs even if an earlier one failed (one run reports ALL
 # broken gates) and prints its wall-clock time; the exit code is nonzero
@@ -63,7 +68,7 @@ elif [ -n "${1:-}" ]; then
     exit 2
 fi
 
-STAGE_NAMES=(compileall collect fablint fabdep fabflow chaos serve obs reg life wire trace)
+STAGE_NAMES=(compileall collect fablint fabdep fabflow chaos serve obs reg life wire trace det)
 total=${#STAGE_NAMES[@]}
 
 fail=0
@@ -105,6 +110,7 @@ run_stage reg bash scripts/reg_gate.sh
 run_stage life bash scripts/life_gate.sh
 run_stage wire bash scripts/wire_gate.sh
 run_stage trace bash scripts/trace_gate.sh
+run_stage det bash scripts/det_gate.sh
 
 if [ "$stage_idx" -ne "$total" ]; then
     echo "ci_gate: BUG: ${stage_idx} run_stage calls but ${total} stage names" >&2
@@ -123,5 +129,5 @@ fi
 if [ -n "$only" ]; then
     echo "ci_gate: OK (--only ${only})"
 else
-    echo "ci_gate: OK (compileall + collect + fablint + fabdep + fabflow + chaos + serve + obs + reg + life + wire + trace)"
+    echo "ci_gate: OK (compileall + collect + fablint + fabdep + fabflow + chaos + serve + obs + reg + life + wire + trace + det)"
 fi

@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# fabdet gate: whole-program byte-determinism taint discipline — no
+# wall-clock read, unseeded random draw, PYTHONHASHSEED-dependent
+# hash/set order, unsorted directory listing, unsorted json.dump(s), or
+# pid/hostname/environ value flows into a declared det surface
+# (tools/det.toml: crashchild digests, snapshot files + signable
+# metadata, fabchaos det scorecards, blockstore/pvt frame writers,
+# serve/protocol encoders, commit-hash rows, merkle digests, AOT
+# artifact blobs).  New det surfaces extend the gate by adding a
+# [[surface]] row, never by editing the analyzer.
+#
+# Dependency-free and import-free: fabdet propagates taint
+# interprocedurally with ast on the shared toolkit chassis — it never
+# imports the analyzed modules, so this gate passes/fails identically
+# in minimal environments (no cryptography, no jax, no numpy).  Scans
+# the package only: tests craft nondeterminism fixtures by design.
+# Runs in ~2s.
+set -uo pipefail
+
+cd "$(dirname "$0")/.."
+
+timeout -k 5 60 python -m fabric_tpu.tools.fabdet fabric_tpu/
+rc=$?
+
+if [ "$rc" -ne 0 ]; then
+    echo "det_gate: FAIL (fabdet rc=$rc)" >&2
+    exit 1
+fi
+echo "det_gate: OK"

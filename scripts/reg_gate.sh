@@ -3,8 +3,9 @@
 # env read declared in common/envreg.py (and every row live), every
 # fabobs emit site named + labeled per CANONICAL_METRICS (and every
 # family emitted), every fault_point site in the README table and
-# exercised by a fabchaos scenario, every analyzer suppression still
-# absorbing a finding, and no det-hazard in the chaos scorecard.
+# exercised by a fabchaos scenario, and every analyzer suppression
+# still absorbing a finding.  (Det-surface taint, formerly the
+# det-hazard rule here, is det_gate.sh / fabdet's whole-program job.)
 #
 # Dependency-free and import-free: fabreg parses source with
 # ast/tokenize (re-running fablint/fabdep/fabflow rule subsets for the

@@ -1,0 +1,1034 @@
+"""fabdet unit tests: a firing fixture + negative control per rule
+(with the PR-19 sweep's triage re-created in fixture form: unsorted
+``json.dump`` of build metadata fires ``unsorted-serialize``, a
+wall-clock guard gating a det surface's output path fires
+``wallclock-in-det`` — the in-process hash-cache key and the
+sorted-listdir MSP walk are the negative controls), the
+behavior-pinned fabreg det-hazard migration fixtures run VERBATIM,
+loud det.toml parse errors (exit 2 from the CLI), suppression
+semantics, CLI plumbing, the toolkit analyzer-registry protocol, the
+byte-stability regressions for the sweep's real fixes, and the repo
+self-check (the CI gate invariant: ``fabdet fabric_tpu/`` reports 0
+unsuppressed findings).
+
+Fixture code lives in *strings* on purpose: only genuine AST shapes
+may feed the rules, and the fixtures deliberately read clocks, draw
+unseeded randomness and serialize unsorted dicts in ways det-surface
+code must never exhibit.  The analyzer itself must run without
+jax/numpy/cryptography — pinned here by a subprocess whose import
+machinery poisons those modules."""
+
+import json
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from fabric_tpu.tools import fabdet, fabreg, toolkit
+from fabric_tpu.tools.fabdet import (
+    DetSpec,
+    SurfaceSpec,
+    parse_det,
+)
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+STORE = "fabric_tpu/store.py"
+CHAOS_PATH = "fabric_tpu/tools/fabchaos.py"
+
+#: one fixture table exercising every mode: an outputs surface (a
+#: frame writer), a method-qualified outputs surface, a sqlite-row
+#: surface with an extra `execute` sink, and the fabchaos det-dict
+#: scorecard surface
+SPEC = DetSpec(
+    surfaces=(
+        SurfaceSpec(
+            name="frames", module="fabric_tpu/store.py", tier="persisted",
+            doc="fixture frame writer", functions=("write_frame",),
+        ),
+        SurfaceSpec(
+            name="blocks", module="fabric_tpu/block.py", tier="persisted",
+            doc="fixture method surface", functions=("Store.add_block",),
+        ),
+        SurfaceSpec(
+            name="rows", module="fabric_tpu/db.py", tier="persisted",
+            doc="fixture sqlite rows", functions=("DB.commit",),
+            sinks=("execute",),
+        ),
+        SurfaceSpec(
+            name="scorecard", module=CHAOS_PATH, tier="replay",
+            doc="fixture chaos scorecard", mode="det-dict",
+            decorator="scenario",
+        ),
+    )
+)
+
+
+def det(sources, rules=None, spec=SPEC):
+    findings, _stats = fabdet.analyze_sources(
+        {p: textwrap.dedent(s) for p, s in sources.items()},
+        rules,
+        det=spec,
+    )
+    return findings
+
+
+def one(src, path=STORE, rules=None, spec=SPEC):
+    findings, _n = fabdet.analyze_source(
+        textwrap.dedent(src), path, rules, det=spec
+    )
+    return findings
+
+
+def rule_ids(findings):
+    return [f.rule for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# wallclock-in-det: clock values flowing into a surface
+# ---------------------------------------------------------------------------
+
+
+def test_wallclock_fires_on_time_into_surface():
+    findings = one(
+        """
+        import time
+
+        def write_frame(f):
+            stamp = time.time()
+            f.write(str(stamp).encode())
+        """
+    )
+    assert rule_ids(findings) == ["wallclock-in-det"]
+    assert "frames" in findings[0].message
+
+
+def test_wallclock_fires_on_datetime_now():
+    findings = one(
+        """
+        import datetime
+
+        def write_frame(f):
+            f.write(datetime.datetime.now().isoformat().encode())
+        """
+    )
+    assert rule_ids(findings) == ["wallclock-in-det"]
+
+
+def test_wallclock_negative_input_derived_bytes_are_clean():
+    findings = one(
+        """
+        def write_frame(f, seq):
+            f.write(seq.to_bytes(4, "big"))
+        """
+    )
+    assert findings == []
+
+
+def test_wallclock_non_surface_function_is_out_of_scope():
+    # a diagnostic latency probe in the same module, NOT a declared
+    # surface: clocks are fine outside the det contract
+    findings = one(
+        """
+        import time
+
+        def observe_latency():
+            return time.perf_counter()
+        """,
+        path="fabric_tpu/x.py",
+    )
+    assert findings == []
+
+
+def test_wallclock_guard_gating_the_output_path_fires():
+    # the deliver/server.py cert-expiry shape: the clock never lands in
+    # the bytes, but it decides WHETHER the surface emits — a replaying
+    # twin with a different clock diverges
+    findings = one(
+        """
+        import time
+
+        def write_frame(f, deadline):
+            if time.monotonic() > deadline:
+                raise RuntimeError("expired")
+            f.write(b"frame")
+        """
+    )
+    assert rule_ids(findings) == ["wallclock-in-det"]
+
+
+def test_wallclock_interprocedural_same_module_helper():
+    findings = one(
+        """
+        import time
+
+        def _stamp():
+            return time.time()
+
+        def write_frame(f):
+            f.write(str(_stamp()).encode())
+        """
+    )
+    assert rule_ids(findings) == ["wallclock-in-det"]
+
+
+def test_wallclock_cross_module_through_an_import():
+    findings = det(
+        {
+            "fabric_tpu/util.py": """
+                import time
+
+                def stamp():
+                    return time.time()
+                """,
+            STORE: """
+                from fabric_tpu.util import stamp
+
+                def write_frame(f):
+                    f.write(str(stamp()).encode())
+                """,
+        }
+    )
+    assert rule_ids(findings) == ["wallclock-in-det"]
+    assert findings[0].path == STORE
+
+
+def test_wallclock_method_surface_via_self_helper():
+    findings = one(
+        """
+        import time
+
+        class Store:
+            def _now(self):
+                return time.time()
+
+            def add_block(self, f, block):
+                f.write(block + str(self._now()).encode())
+        """,
+        path="fabric_tpu/block.py",
+    )
+    assert rule_ids(findings) == ["wallclock-in-det"]
+
+
+def test_wallclock_tainted_argument_into_a_surface_call_fires():
+    # the router _payload_for shape: the clock value is computed in a
+    # NON-surface caller and handed to the surface as an argument
+    findings = one(
+        """
+        import time
+
+        def write_frame(f, stamp):
+            f.write(str(stamp).encode())
+
+        def caller(f):
+            write_frame(f, time.monotonic())
+        """
+    )
+    assert rule_ids(findings) == ["wallclock-in-det"]
+
+
+# ---------------------------------------------------------------------------
+# unseeded-random-in-det
+# ---------------------------------------------------------------------------
+
+
+def test_random_fires_on_module_level_draw():
+    findings = one(
+        """
+        import random
+
+        def write_frame(f):
+            f.write(bytes([random.randrange(256)]))
+        """
+    )
+    assert rule_ids(findings) == ["unseeded-random-in-det"]
+
+
+def test_random_fires_on_urandom_and_uuid4():
+    findings = one(
+        """
+        import os
+        import uuid
+
+        def write_frame(f):
+            f.write(os.urandom(8))
+            f.write(uuid.uuid4().bytes)
+        """
+    )
+    assert rule_ids(findings) == ["unseeded-random-in-det"] * 2
+
+
+def test_random_negative_seeded_constructor_is_exempt():
+    # the fabreg precedent: random.Random(seed) is the sanctioned
+    # seeded discipline the det contract is built on
+    findings = one(
+        """
+        import random
+
+        def write_frame(f, seed):
+            rng = random.Random(seed)
+            f.write(bytes([rng.randrange(256)]))
+        """
+    )
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# env-in-det
+# ---------------------------------------------------------------------------
+
+
+def test_env_fires_on_pid_into_surface():
+    findings = one(
+        """
+        import os
+
+        def write_frame(f):
+            f.write(str(os.getpid()).encode())
+        """
+    )
+    assert rule_ids(findings) == ["env-in-det"]
+
+
+def test_env_fires_on_environ_read_into_surface():
+    findings = one(
+        """
+        import os
+
+        def write_frame(f):
+            f.write(os.environ["HOME"].encode())
+        """
+    )
+    assert rule_ids(findings) == ["env-in-det"]
+
+
+def test_env_negative_pid_outside_the_surface_is_clean():
+    # the registry _save_aot shape: a pid-derived TEMP FILENAME is
+    # process-local plumbing; only surface bytes are the contract
+    findings = one(
+        """
+        import os
+
+        def scratch_name(base):
+            return f"{base}.{os.getpid()}.tmp"
+        """,
+        path="fabric_tpu/x.py",
+    )
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# hash-order-hazard
+# ---------------------------------------------------------------------------
+
+
+def test_hash_order_fires_on_set_iteration_into_surface():
+    findings = one(
+        """
+        def write_frame(f, keys):
+            seen = set(keys)
+            for k in seen:
+                f.write(k)
+        """
+    )
+    assert rule_ids(findings) == ["hash-order-hazard"]
+
+
+def test_hash_order_sorted_set_iteration_is_clean():
+    findings = one(
+        """
+        def write_frame(f, keys):
+            for k in sorted(set(keys)):
+                f.write(k)
+        """
+    )
+    assert findings == []
+
+
+def test_hash_order_in_process_cache_key_stays_silent():
+    # the policy/ast.py:75 shape: hash() feeding an in-process memo
+    # dict that never reaches a det surface
+    findings = one(
+        """
+        _cache = {}
+
+        def lookup(source):
+            key = hash(source)
+            if key not in _cache:
+                _cache[key] = len(source)
+            return _cache[key]
+        """,
+        path="fabric_tpu/x.py",
+    )
+    assert findings == []
+
+
+def test_hash_order_membership_test_is_order_free():
+    # `x in seen` consumes the set without observing its order
+    findings = one(
+        """
+        def write_frame(f, keys, allow):
+            ok = set(allow)
+            for k in keys:
+                if k in ok:
+                    f.write(k)
+        """
+    )
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# fs-order-hazard
+# ---------------------------------------------------------------------------
+
+
+def test_fs_order_fires_on_unsorted_listdir_into_surface():
+    findings = one(
+        """
+        import os
+
+        def write_frame(f, d):
+            for name in os.listdir(d):
+                f.write(name.encode())
+        """
+    )
+    assert rule_ids(findings) == ["fs-order-hazard"]
+
+
+def test_fs_order_sorted_listdir_is_clean():
+    # the msp/configbuilder.py:93 shape — the clean negative control
+    findings = one(
+        """
+        import os
+
+        def write_frame(f, d):
+            for name in sorted(os.listdir(d)):
+                f.write(name.encode())
+        """
+    )
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# unsorted-serialize
+# ---------------------------------------------------------------------------
+
+
+def test_unsorted_serialize_fires_on_json_dump_anywhere():
+    # json.dump writes a file: persisted-by-construction, no [[surface]]
+    # row needed (the extbuilder metadata.json shape)
+    findings = one(
+        """
+        import json
+
+        def save(meta, f):
+            json.dump(meta, f)
+        """,
+        path="fabric_tpu/x.py",
+    )
+    assert rule_ids(findings) == ["unsorted-serialize"]
+
+
+def test_unsorted_serialize_sort_keys_is_clean():
+    findings = one(
+        """
+        import json
+
+        def save(meta, f):
+            json.dump(meta, f, sort_keys=True)
+        """,
+        path="fabric_tpu/x.py",
+    )
+    assert findings == []
+
+
+def test_unsorted_serialize_provably_ordered_value_is_clean():
+    findings = one(
+        """
+        import json
+
+        def save(f, d):
+            json.dump(["a", "b", 3], f)
+            json.dump(sorted(d.items()), f)
+        """,
+        path="fabric_tpu/x.py",
+    )
+    assert findings == []
+
+
+def test_unsorted_dumps_fires_only_at_a_surface_boundary():
+    # json.dumps returns a string: only a hazard once those bytes reach
+    # a det surface (the serve OP_STATS shape) — a debug repr is fine
+    clean = one(
+        """
+        import json
+
+        def debug_repr(d):
+            return json.dumps(d)
+        """,
+        path="fabric_tpu/x.py",
+    )
+    assert clean == []
+    findings = one(
+        """
+        import json
+
+        def write_frame(f, d):
+            f.write(json.dumps(d).encode())
+        """
+    )
+    assert rule_ids(findings) == ["unsorted-serialize"]
+
+
+def test_unsorted_dumps_sorted_at_the_surface_is_clean():
+    findings = one(
+        """
+        import json
+
+        def write_frame(f, d):
+            f.write(json.dumps(d, sort_keys=True).encode())
+        """
+    )
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# sqlite-row sinks (the persistent.commit_hash surface shape)
+# ---------------------------------------------------------------------------
+
+
+def test_extra_sink_execute_fires_on_clock_row():
+    findings = one(
+        """
+        import time
+
+        class DB:
+            def commit(self, cur, height):
+                cur.execute(
+                    "insert into savepoints values (?, ?)",
+                    (height, time.time()),
+                )
+        """,
+        path="fabric_tpu/db.py",
+    )
+    assert rule_ids(findings) == ["wallclock-in-det"]
+
+
+def test_extra_sink_execute_input_derived_rows_are_clean():
+    findings = one(
+        """
+        class DB:
+            def commit(self, cur, height, digest):
+                cur.execute(
+                    "insert into savepoints values (?, ?)",
+                    (height, digest),
+                )
+        """,
+        path="fabric_tpu/db.py",
+    )
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# det-dict mode: the fabreg det-hazard fixtures, VERBATIM (PR-11 ->
+# PR-19 behavior pin; only the expected rule ids are new)
+# ---------------------------------------------------------------------------
+
+DET_PREAMBLE = textwrap.dedent(
+    """
+    import os
+    import random
+    import time
+
+    def scenario(name):
+        def deco(fn):
+            return fn
+        return deco
+    """
+)
+
+
+def test_det_dict_fires_on_wall_clock_in_det():
+    findings = det(
+        {
+            CHAOS_PATH: DET_PREAMBLE + textwrap.dedent("""
+                @scenario("s")
+                def run_s(seed, clock, scale=1.0):
+                    det = {"stamp": time.time()}
+                    return det, {}
+                """)
+        },
+    )
+    assert rule_ids(findings) == ["wallclock-in-det"]
+    assert "run_s" in findings[0].message
+
+
+def test_det_dict_fires_on_tainted_name_and_unseeded_random():
+    findings = det(
+        {
+            CHAOS_PATH: DET_PREAMBLE + textwrap.dedent("""
+                @scenario("s")
+                def run_s(seed, clock, scale=1.0):
+                    pid = os.getpid()
+                    det = {}
+                    det["who"] = pid
+                    det["roll"] = random.randrange(6)
+                    return det, {}
+                """)
+        },
+    )
+    assert rule_ids(findings) == ["env-in-det", "unseeded-random-in-det"]
+
+
+def test_det_dict_taint_respects_source_order_in_nested_blocks():
+    # a banned value bound inside a nested block, consumed later at the
+    # top level: breadth-first traversal would visit the det write
+    # first and miss the taint
+    findings = det(
+        {
+            CHAOS_PATH: DET_PREAMBLE + textwrap.dedent("""
+                @scenario("s")
+                def run_s(seed, clock, scale=1.0):
+                    det = {}
+                    if scale > 0:
+                        t = time.time()
+                    det["elapsed"] = t
+                    return det, {}
+                """)
+        },
+    )
+    assert rule_ids(findings) == ["wallclock-in-det"]
+
+
+def test_det_dict_augassign_and_tuple_unpack():
+    # det["x"] += <clock> and a, b = time.time(), 1 -> det both count
+    findings = det(
+        {
+            CHAOS_PATH: DET_PREAMBLE + textwrap.dedent("""
+                @scenario("s")
+                def run_s(seed, clock, scale=1.0):
+                    det = {"elapsed": 0.0}
+                    det["elapsed"] += time.perf_counter()
+                    a, b = time.time(), 1
+                    det["t"] = a
+                    det["n"] = b
+                    return det, {}
+                """)
+        },
+    )
+    # the AugAssign and the tainted `a`; `b` is bound to the clean
+    # element and stays untainted
+    assert rule_ids(findings) == ["wallclock-in-det"] * 2
+
+
+def test_det_dict_negative_seeded_rng_and_observed_clock():
+    findings = det(
+        {
+            CHAOS_PATH: DET_PREAMBLE + textwrap.dedent("""
+                @scenario("s")
+                def run_s(seed, clock, scale=1.0):
+                    rng = random.Random(seed)
+                    t0 = time.perf_counter()
+                    det = {"n": rng.randrange(4)}
+                    obs = {"elapsed": time.perf_counter() - t0}
+                    return det, obs
+                """)
+        },
+    )
+    assert findings == []
+
+
+def test_det_dict_only_applies_to_declared_scorecard_modules():
+    findings = det(
+        {
+            "fabric_tpu/serve/m.py": DET_PREAMBLE + textwrap.dedent("""
+                @scenario("s")
+                def run_s(seed, clock, scale=1.0):
+                    det = {"stamp": time.time()}
+                    return det, {}
+                """)
+        },
+    )
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# det.toml: the packaged table + loud parse errors
+# ---------------------------------------------------------------------------
+
+
+def test_packaged_det_table_parses_and_names_the_surfaces():
+    spec = fabdet.load_default_det()
+    names = {s.name for s in spec.surfaces}
+    assert {
+        "chaos-scorecard", "crash-digest", "snapshot-files",
+        "rwset-hashes", "verify-frames", "lane-payload",
+        "deliver-stream", "orderer-admission", "block-frames",
+        "pvt-frames", "commit-hash", "aot-artifact",
+    } <= names
+    by_name = {s.name: s for s in spec.surfaces}
+    assert by_name["chaos-scorecard"].mode == "det-dict"
+    assert by_name["chaos-scorecard"].decorator == "scenario"
+    assert by_name["chaos-scorecard"].tier == "replay"
+    assert by_name["commit-hash"].sinks == ("execute",)
+    assert by_name["commit-hash"].tier == "persisted"
+    assert by_name["lane-payload"].tier == "cross-peer"
+    for s in spec.surfaces:
+        assert s.tier in fabdet.TIERS
+        assert s.doc  # every surface names its contract
+
+
+@pytest.mark.parametrize(
+    "text,err",
+    [
+        ("[[bogus]]\n", "unknown section"),
+        ("[sideways]\n", "unknown section"),
+        ("name = \"x\"\n", "outside a"),
+        ("[[surface]]\nname - \"x\"\n", "expected 'key = value'"),
+        ("[[surface]]\nname = maybe\n", "expected"),
+        ("[[surface]]\nname = \"x\"\n", "missing required key"),
+        (
+            "[[surface]]\nname = \"x\"\nmodule = \"m.py\"\n"
+            "tier = \"sideways\"\ndoc = \"d\"\nfunctions = [\"f\"]\n",
+            "tier must be one of",
+        ),
+        (
+            "[[surface]]\nname = \"x\"\nmodule = \"m.py\"\n"
+            "tier = \"replay\"\ndoc = \"d\"\nmode = \"maybe\"\n",
+            "mode must be",
+        ),
+        (
+            "[[surface]]\nname = \"x\"\nmodule = \"m.py\"\n"
+            "tier = \"replay\"\ndoc = \"d\"\nmode = \"det-dict\"\n",
+            "need a 'decorator'",
+        ),
+        (
+            "[[surface]]\nname = \"x\"\nmodule = \"m.py\"\n"
+            "tier = \"replay\"\ndoc = \"d\"\n",
+            "non-empty 'functions'",
+        ),
+        (
+            "[[surface]]\nname = \"x\"\nmodule = \"m.py\"\n"
+            "tier = \"replay\"\ndoc = \"d\"\nfunctions = [\"f\"]\n"
+            "[[surface]]\nname = \"x\"\nmodule = \"n.py\"\n"
+            "tier = \"replay\"\ndoc = \"d\"\nfunctions = [\"g\"]\n",
+            "duplicate surface name",
+        ),
+    ],
+)
+def test_det_table_parse_errors_are_loud(text, err):
+    with pytest.raises(ValueError, match=err):
+        parse_det(text, "<bad>")
+
+
+def test_cli_rejects_bad_det_table(tmp_path, capsys):
+    bad = tmp_path / "det.toml"
+    bad.write_text("[[bogus]]\n")
+    target = tmp_path / "fabric_tpu" / "m.py"
+    target.parent.mkdir()
+    target.write_text("x = 1\n")
+    rc = fabdet.main(["--det", str(bad), str(target)])
+    assert rc == 2
+    assert "det table" in capsys.readouterr().err
+
+
+def test_cli_rejects_missing_det_table(tmp_path, capsys):
+    target = tmp_path / "fabric_tpu" / "m.py"
+    target.parent.mkdir()
+    target.write_text("x = 1\n")
+    rc = fabdet.main(["--det", str(tmp_path / "nope.toml"), str(target)])
+    assert rc == 2
+    assert "det table" in capsys.readouterr().err
+
+
+def test_declared_surface_missing_from_its_module_is_a_finding():
+    # a functions pattern matching nothing = the gate is vacuously
+    # passing on that surface: always-on, not maskable via --rules
+    spec = DetSpec(
+        surfaces=(
+            SurfaceSpec(
+                name="frames", module=STORE, tier="persisted",
+                doc="fixture", functions=("write_frame", "gone_writer"),
+            ),
+        )
+    )
+    findings = one(
+        """
+        def write_frame(f, b):
+            f.write(b)
+        """,
+        rules=["wallclock-in-det"],
+        spec=spec,
+    )
+    assert rule_ids(findings) == ["surface-missing"]
+    assert "gone_writer" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# suppressions, CLI, syntax errors
+# ---------------------------------------------------------------------------
+
+
+def test_suppression_absorbs_finding_and_is_counted():
+    src = textwrap.dedent(
+        """
+        import time
+
+        def write_frame(f):
+            f.write(str(time.time()).encode())  # fabdet: disable=wallclock-in-det  # fixture stamps by design
+        """
+    )
+    findings, n = fabdet.analyze_source(src, STORE, det=SPEC)
+    assert findings == []
+    assert n == 1
+
+
+def test_suppression_for_another_rule_does_not_absorb():
+    src = textwrap.dedent(
+        """
+        import time
+
+        def write_frame(f):
+            f.write(str(time.time()).encode())  # fabdet: disable=env-in-det  # wrong rule
+        """
+    )
+    findings, n = fabdet.analyze_source(src, STORE, det=SPEC)
+    assert rule_ids(findings) == ["wallclock-in-det"]
+    assert n == 0
+
+
+def test_suppression_disable_all_silences_the_line():
+    src = textwrap.dedent(
+        """
+        import time
+
+        def write_frame(f):
+            f.write(str(time.time()).encode())  # fabdet: disable=all  # fixture
+        """
+    )
+    findings, n = fabdet.analyze_source(src, STORE, det=SPEC)
+    assert findings == []
+    assert n == 1
+
+
+def test_cli_json_and_exit_codes(tmp_path, capsys):
+    table = tmp_path / "det.toml"
+    table.write_text(
+        "[[surface]]\n"
+        "name = \"frames\"\n"
+        "module = \"fabric_tpu/m.py\"\n"
+        "tier = \"persisted\"\n"
+        "doc = \"fixture\"\n"
+        "functions = [\"write_frame\"]\n"
+    )
+    bad = tmp_path / "fabric_tpu" / "m.py"
+    bad.parent.mkdir()
+    bad.write_text(
+        "import time\n\n"
+        "def write_frame(f):\n"
+        "    f.write(str(time.time()).encode())\n"
+    )
+    rc = fabdet.main(["--json", "--det", str(table), str(bad)])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert out["files"] == 1
+    assert [f["rule"] for f in out["findings"]] == ["wallclock-in-det"]
+
+    clean = tmp_path / "fabric_tpu" / "ok.py"
+    clean.write_text("x = 1\n")
+    assert fabdet.main(["--det", str(table), str(clean)]) == 0
+    capsys.readouterr()
+
+    assert fabdet.main(["--list-rules"]) == 0
+    listed = capsys.readouterr().out
+    for rid in fabdet.RULES:
+        assert rid in listed
+
+    assert fabdet.main(["--rules", "no-such-rule", str(clean)]) == 2
+    assert fabdet.main([str(tmp_path / "missing.py")]) == 2
+    assert fabdet.main([]) == 2
+
+
+def test_syntax_error_is_reported_not_raised():
+    findings = one("def broken(:\n")
+    assert rule_ids(findings) == ["syntax-error"]
+
+
+def test_analyzer_never_imports_the_analyzed_stack():
+    # the gate runs in minimal CI images: fabdet must sweep the whole
+    # package with jax/jaxlib/numpy/cryptography UNIMPORTABLE.  A None
+    # entry in sys.modules makes any import of the name raise.
+    code = textwrap.dedent(
+        """
+        import sys
+
+        for name in ("jax", "jaxlib", "numpy", "cryptography"):
+            sys.modules[name] = None
+        from fabric_tpu.tools import fabdet
+
+        rc = fabdet.main(["fabric_tpu/"])
+        sys.exit(rc)
+        """
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        cwd=REPO_ROOT,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 finding(s)" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# toolkit registry + fabreg staleness protocol + the det-hazard
+# retirement pins
+# ---------------------------------------------------------------------------
+
+
+def test_fabdet_is_registered_with_the_toolkit():
+    assert "fabdet" in toolkit.ANALYZER_TOOLS
+    spec = toolkit.analyzer_spec("fabdet")
+    assert spec is not None
+    assert spec.module == "fabric_tpu.tools.fabdet"
+    # package-scoped: tests craft nondeterminism fixtures by design
+    assert spec.pkg_scope_only is True
+
+
+def test_live_suppression_keys_reports_absorbing_comments():
+    # the protocol hook gets no det argument (fabreg calls it blind),
+    # so the fixture lives at a packaged-table surface: merkle.py's
+    # functions = ["*"] row matches any function
+    src = textwrap.dedent(
+        """
+        import time
+
+        def digest(leaves):
+            return str(time.time()).encode()  # fabdet: disable=wallclock-in-det  # fixture stamps by design
+        """
+    )
+    path = "fabric_tpu/ledger/merkle.py"
+    keys = fabdet.live_suppression_keys({path: src}, {"wallclock-in-det"})
+    assert len(keys) == 1
+    ((got_path, line, rule),) = keys
+    assert rule == "wallclock-in-det"
+    assert got_path.endswith("fabric_tpu/ledger/merkle.py")
+    assert line == 5
+
+
+def test_fabreg_suppression_stale_judges_fabdet_via_the_registry():
+    stale = textwrap.dedent(
+        """
+        def quiet():
+            x = 1  # fabdet: disable=wallclock-in-det  # outlived its cause
+            return x
+        """
+    )
+    findings, _stats = fabreg.analyze_sources(
+        {"fabric_tpu/stale.py": stale},
+        rule_ids=["suppression-stale"],
+    )
+    assert rule_ids(findings) == ["suppression-stale"]
+    assert "fabdet" in findings[0].message
+
+
+def test_fabreg_lost_exactly_the_det_hazard_rule(capsys):
+    # the retirement pin: fabreg's rule table is one line shorter and
+    # det-hazard is fabdet's whole-program job now
+    assert "det-hazard" not in fabreg.RULES
+    assert len(fabreg.RULES) == 7
+    assert len(fabdet.RULES) == 6
+    assert set(fabdet.RULES) == {
+        "wallclock-in-det", "unseeded-random-in-det", "env-in-det",
+        "hash-order-hazard", "fs-order-hazard", "unsorted-serialize",
+    }
+    assert fabreg.main(["--list-rules"]) == 0
+    listed = [
+        ln for ln in capsys.readouterr().out.splitlines() if ln.strip()
+    ]
+    assert len(listed) == 7
+    assert not any("det-hazard" in ln for ln in listed)
+
+
+# ---------------------------------------------------------------------------
+# byte-stability regressions for the PR-19 sweep's real fixes
+# ---------------------------------------------------------------------------
+
+
+def test_extbuilder_metadata_json_bytes_are_key_order_independent(tmp_path):
+    # pre-fix, metadata.json followed the package meta's insertion
+    # order (type, label, path); sorted dumps make the persisted bytes
+    # a pure function of the meta's CONTENT
+    from fabric_tpu.chaincode.extbuilder import Launcher
+    from fabric_tpu.chaincode.package import PackageStore, package
+
+    raw = package("cc", {"main.py": b"x = 1\n"}, path="src/cc")
+    store = PackageStore(str(tmp_path / "pkgs"))
+    pkg = store.install(raw)
+    launcher = Launcher(str(tmp_path / "run"))
+    dirs = launcher._dirs(pkg)
+    meta = launcher._materialize(pkg, dirs)
+    written = (
+        Path(dirs["metadata"]) / "metadata.json"
+    ).read_bytes()
+    reordered = {k: meta[k] for k in sorted(meta, reverse=True)}
+    assert written == json.dumps(reordered, sort_keys=True).encode()
+
+
+def test_peer_local_sources_bytes_are_approve_order_independent(tmp_path):
+    # per-peer lifecycle state: two peers that approved the same
+    # bindings in a different order must persist identical bytes
+    from fabric_tpu.nodes.peer import PeerNode
+
+    blobs = []
+    for order in ((("ch", "zeta"), ("ch", "alpha")),
+                  (("ch", "alpha"), ("ch", "zeta"))):
+        peer = PeerNode.__new__(PeerNode)
+        root = tmp_path / f"peer-{len(blobs)}"
+        peer.work_dir = str(root)
+        peer._cc_sources = {}
+        for channel_id, name in order:
+            peer.approve_chaincode(channel_id, name, f"pkg:{name}")
+        blobs.append(Path(peer._sources_path()).read_bytes())
+    assert blobs[0] == blobs[1]
+
+
+def test_crashchild_stream_build_is_byte_identical_across_runs(tmp_path):
+    # the crash matrix's precondition: same seed -> byte-identical
+    # stream dir, INCLUDING meta.json (the sweep's unsorted-dump fix)
+    from fabric_tpu.tools import crashchild
+
+    digests = []
+    for run in ("a", "b"):
+        d = tmp_path / run
+        d.mkdir()
+        crashchild.build_stream(str(d), seed=7, n_channels=2, n_blocks=3)
+        digests.append(
+            {p.name: p.read_bytes() for p in sorted(d.iterdir())}
+        )
+    assert digests[0] == digests[1]
+    assert "meta.json" in digests[0]
+    meta = json.loads(digests[0]["meta.json"])
+    assert meta == {"channels": 2, "blocks": 3}
+    assert list(json.loads(digests[0]["meta.json"])) == sorted(meta)
+
+
+# ---------------------------------------------------------------------------
+# repo self-check: the CI gate invariant
+# ---------------------------------------------------------------------------
+
+
+def test_repo_has_zero_unsuppressed_findings():
+    findings, stats = fabdet.analyze_paths([str(REPO_ROOT / "fabric_tpu")])
+    assert findings == [], "\n".join(
+        f"{f.path}:{f.line}: {f.rule}: {f.message}" for f in findings
+    )
+    assert stats["files"] > 150
+    # the triaged by-design suppressions (NOTES_BUILD PR 19 ledger):
+    # the deliver cert/session-expiry gates (2), the orderer
+    # identity-expiration admission check (1), the serve wire-deadline
+    # budget sites (client 3 + router 3), and the check()-dominated
+    # gray-failure scorecard constants (1)
+    assert stats["suppressed"] == 10

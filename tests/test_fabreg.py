@@ -347,133 +347,6 @@ def test_fault_site_drift_without_readme_checks_scenarios_only():
 
 
 # ---------------------------------------------------------------------------
-# det-hazard
-# ---------------------------------------------------------------------------
-
-DET_PREAMBLE = textwrap.dedent(
-    """
-    import os
-    import random
-    import time
-
-    def scenario(name):
-        def deco(fn):
-            return fn
-        return deco
-    """
-)
-
-
-def test_det_hazard_fires_on_wall_clock_in_det():
-    findings = analyze(
-        {
-            CHAOS_PATH: DET_PREAMBLE + textwrap.dedent("""
-                @scenario("s")
-                def run_s(seed, clock, scale=1.0):
-                    det = {"stamp": time.time()}
-                    return det, {}
-                """)
-        },
-        rules=["det-hazard"],
-    )
-    assert rule_ids(findings) == ["det-hazard"]
-
-
-def test_det_hazard_fires_on_tainted_name_and_unseeded_random():
-    findings = analyze(
-        {
-            CHAOS_PATH: DET_PREAMBLE + textwrap.dedent("""
-                @scenario("s")
-                def run_s(seed, clock, scale=1.0):
-                    pid = os.getpid()
-                    det = {}
-                    det["who"] = pid
-                    det["roll"] = random.randrange(6)
-                    return det, {}
-                """)
-        },
-        rules=["det-hazard"],
-    )
-    assert rule_ids(findings) == ["det-hazard"] * 2
-
-
-def test_det_hazard_taint_respects_source_order_in_nested_blocks():
-    # a banned value bound inside a nested block, consumed later at the
-    # top level: breadth-first traversal would visit the det write
-    # first and miss the taint
-    findings = analyze(
-        {
-            CHAOS_PATH: DET_PREAMBLE + textwrap.dedent("""
-                @scenario("s")
-                def run_s(seed, clock, scale=1.0):
-                    det = {}
-                    if scale > 0:
-                        t = time.time()
-                    det["elapsed"] = t
-                    return det, {}
-                """)
-        },
-        rules=["det-hazard"],
-    )
-    assert rule_ids(findings) == ["det-hazard"]
-
-
-def test_det_hazard_augassign_and_tuple_unpack():
-    # det["x"] += <clock> and a, b = time.time(), 1 -> det both count
-    findings = analyze(
-        {
-            CHAOS_PATH: DET_PREAMBLE + textwrap.dedent("""
-                @scenario("s")
-                def run_s(seed, clock, scale=1.0):
-                    det = {"elapsed": 0.0}
-                    det["elapsed"] += time.perf_counter()
-                    a, b = time.time(), 1
-                    det["t"] = a
-                    det["n"] = b
-                    return det, {}
-                """)
-        },
-        rules=["det-hazard"],
-    )
-    # the AugAssign and the tainted `a`; `b` is bound to the clean
-    # element and stays untainted
-    assert rule_ids(findings) == ["det-hazard"] * 2
-
-
-def test_det_hazard_negative_seeded_rng_and_observed_clock():
-    findings = analyze(
-        {
-            CHAOS_PATH: DET_PREAMBLE + textwrap.dedent("""
-                @scenario("s")
-                def run_s(seed, clock, scale=1.0):
-                    rng = random.Random(seed)
-                    t0 = time.perf_counter()
-                    det = {"n": rng.randrange(4)}
-                    obs = {"elapsed": time.perf_counter() - t0}
-                    return det, obs
-                """)
-        },
-        rules=["det-hazard"],
-    )
-    assert findings == []
-
-
-def test_det_hazard_only_applies_to_fabchaos_files():
-    findings = analyze(
-        {
-            "fabric_tpu/serve/m.py": DET_PREAMBLE + textwrap.dedent("""
-                @scenario("s")
-                def run_s(seed, clock, scale=1.0):
-                    det = {"stamp": time.time()}
-                    return det, {}
-                """)
-        },
-        rules=["det-hazard"],
-    )
-    assert findings == []
-
-
-# ---------------------------------------------------------------------------
 # suppression-stale
 # ---------------------------------------------------------------------------
 

@@ -52,8 +52,11 @@ def test_ci_gate_run_stage_calls_match_the_stage_list():
     assert "wire" in names
     assert "wire_gate.sh" in text
     # PR 18: stage 12 wires the fabtrace gate
-    assert names[-1] == "trace" and len(names) == 12
+    assert "trace" in names
     assert "trace_gate.sh" in text
+    # PR 19: stage 13 wires the fabdet gate
+    assert names[-1] == "det" and len(names) == 13
+    assert "det_gate.sh" in text
 
 
 def test_every_wire_toml_surface_exists_on_disk():
@@ -95,6 +98,26 @@ def test_every_hotpath_toml_surface_exists_on_disk():
     assert missing == [], (
         f"tools/hotpath.toml names modules that do not exist: {missing} "
         f"— update the table when a pipeline stage moves"
+    )
+
+
+def test_every_det_toml_surface_exists_on_disk():
+    """Same discipline as the wire.toml/hotpath.toml pins: fabdet only
+    binds [[surface]] rows whose module path matches a scanned file, so
+    a renamed emitter would make every taint check on that surface
+    vacuously pass.  Every declared path must exist.  (The other half —
+    a declared FUNCTION gone from a live module — is fabdet's own
+    always-on surface-missing finding.)"""
+    from fabric_tpu.tools import fabdet
+
+    spec = fabdet.load_default_det()
+    declared = {s.module for s in spec.surfaces}
+    missing = sorted(
+        mod for mod in declared if not (REPO_ROOT / mod).is_file()
+    )
+    assert missing == [], (
+        f"tools/det.toml names modules that do not exist: {missing} — "
+        f"update the table when a det emitter moves"
     )
 
 
